@@ -1,0 +1,74 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Tasks / actors / objects core runtime (reference capability: Ray Core), with JAX/XLA as
+the tensor substrate: collectives ride ICI inside compiled programs instead of NCCL, and
+the AI libraries (train/ data/ rllib/ serve/ tune/) are JAX-first.
+
+NOTE: importing ray_tpu does NOT import jax — the core runtime is accelerator-agnostic
+and worker processes decide platform visibility at spawn time.
+"""
+from ._version import __version__  # noqa: F401
+from .core.actor import ActorClass, ActorHandle, method  # noqa: F401
+from .core.api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .core.exceptions import (  # noqa: F401
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .core.object_ref import ObjectRef  # noqa: F401
+from .core.runtime_context import get_runtime_context  # noqa: F401
+from .core.task_spec import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "method",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "WorkerCrashedError",
+    "GetTimeoutError",
+    "TaskCancelledError",
+    "ObjectLostError",
+    "RayTpuError",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

@@ -1,0 +1,1 @@
+from . import global_state  # noqa: F401

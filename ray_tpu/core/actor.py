@@ -1,0 +1,189 @@
+"""ActorClass / ActorHandle / ActorMethod: the @ray_tpu.remote class API.
+
+Capability parity: reference python/ray/actor.py (ActorClass:1111, ActorClass._remote:1402,
+ActorMethod._remote:784, ActorHandle:1784). Method calls are dispatched FIFO to the actor's
+pinned worker process (pipelined through its pipe, like the reference's sequential actor
+submit queue, src/ray/core_worker/transport/sequential_actor_submit_queue.h).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from . import global_state
+from .ids import ActorID, ObjectID, TaskID
+from .task import build_resources, compute_fn_id, encode_args, register_function
+from .task_spec import TaskSpec
+
+_DEFAULT_ACTOR_OPTIONS = dict(
+    num_cpus=1.0,
+    num_tpus=0.0,
+    resources=None,
+    max_restarts=0,
+    max_task_retries=0,
+    name=None,
+    namespace="",
+    lifetime=None,  # None | "detached"
+    scheduling_strategy="DEFAULT",
+    runtime_env=None,
+)
+
+
+def extract_method_meta(cls) -> Dict[str, Dict[str, Any]]:
+    meta = {}
+    for name, member in inspect.getmembers(cls, predicate=callable):
+        if name.startswith("__") and name != "__call__":
+            continue
+        num_returns = getattr(member, "_num_returns", 1)
+        meta[name] = {"num_returns": num_returns}
+    return meta
+
+
+def method(*, num_returns: int = 1):
+    """Decorator matching reference @ray.method(num_returns=...)."""
+
+    def deco(fn):
+        fn._num_returns = num_returns
+        return fn
+
+    return deco
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, num_returns=self._num_returns)
+
+    def options(self, num_returns: Optional[int] = None, **_ignored):
+        m = ActorMethod(self._handle, self._name, num_returns or self._num_returns)
+        return m
+
+    def _remote(self, args, kwargs, num_returns: int = 1):
+        ctx = global_state.worker()
+        meta, arg_refs, pins = encode_args(ctx, args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.generate(),
+            kind="actor_method",
+            fn_id=b"\x00" * 16,
+            fn_bytes=None,
+            name=f"{self._name}",
+            args_meta=meta,
+            arg_refs=arg_refs,
+            num_returns=num_returns,
+            return_ids=[ObjectID.generate() for _ in range(num_returns)],
+            actor_id=self._handle._actor_id,
+            method_name=self._name,
+        )
+        refs = ctx.submit(spec)
+        del pins  # safe to release: submit() pinned the args
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Actor method {self._name} must be invoked with .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_meta: Dict[str, Dict[str, Any]], owned: bool = False):
+        object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_method_meta", method_meta)
+        object.__setattr__(self, "_owned", owned)
+
+    def __del__(self):
+        # Reference semantics: a non-detached actor dies when its original handle goes
+        # out of scope (python/ray/actor.py handle GC). Serialized copies are borrows.
+        if getattr(self, "_owned", False):
+            try:
+                from . import global_state
+
+                w = global_state.try_worker()
+                if w is not None:
+                    w.kill_actor(self._actor_id, no_restart=True, from_gc=True)
+            except Exception:
+                pass
+
+    def __getattr__(self, name: str):
+        meta = object.__getattribute__(self, "_method_meta")
+        if name in meta:
+            return ActorMethod(self, name, meta[name].get("num_returns", 1))
+        if name.startswith("_"):
+            raise AttributeError(name)
+        # Unknown methods still get a handle (meta may be stale after code update).
+        return ActorMethod(self, name, 1)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:16]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_meta))
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+
+class ActorClass:
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = {**_DEFAULT_ACTOR_OPTIONS, **options}
+        self._cls_bytes: Optional[bytes] = None
+        self._cls_id: Optional[bytes] = None
+        self.__name__ = getattr(cls, "__name__", "ActorClass")
+
+    def options(self, **options) -> "ActorClass":
+        ac = ActorClass(self._cls, **{**self._options, **options})
+        ac._cls_bytes = self._cls_bytes
+        ac._cls_id = self._cls_id
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, opts) -> ActorHandle:
+        ctx = global_state.worker()
+        if self._cls_bytes is None:
+            self._cls_bytes = cloudpickle.dumps(self._cls)
+            self._cls_id = compute_fn_id(self._cls_bytes)
+        register_function(ctx, self._cls_id, self._cls_bytes)
+        meta, arg_refs, pins = encode_args(ctx, args, kwargs)
+        actor_id = ActorID.generate()
+        method_meta = extract_method_meta(self._cls)
+        runtime_env = dict(opts.get("runtime_env") or {})
+        runtime_env["methods"] = method_meta
+        if opts.get("lifetime") == "detached":
+            runtime_env["detached"] = True
+        spec = TaskSpec(
+            task_id=TaskID.generate(),
+            kind="actor_creation",
+            fn_id=self._cls_id,
+            fn_bytes=None,
+            name=f"{self.__name__}.__init__",
+            args_meta=meta,
+            arg_refs=arg_refs,
+            num_returns=1,
+            return_ids=[ObjectID.generate()],
+            resources=build_resources(opts),
+            scheduling_strategy=opts["scheduling_strategy"],
+            max_retries=0,
+            actor_id=actor_id,
+            max_restarts=opts["max_restarts"],
+            actor_name=opts.get("name"),
+            actor_namespace=opts.get("namespace") or "",
+            runtime_env=runtime_env,
+        )
+        ctx.submit(spec)
+        del pins  # safe to release: submit() pinned the args
+        return ActorHandle(actor_id, method_meta, owned=True)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()."
+        )

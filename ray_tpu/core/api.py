@@ -1,0 +1,154 @@
+"""Public API: init/shutdown/remote/get/put/wait/kill/cancel/get_actor + cluster state.
+
+Capability parity: reference python/ray/_private/worker.py (init:1341, get:2754, put:2890,
+wait:2955, get_actor:3100, remote:3441, shutdown:1970).
+"""
+from __future__ import annotations
+
+import atexit
+import inspect
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from . import global_state
+from .actor import ActorClass, ActorHandle
+from .exceptions import GetTimeoutError
+from .ids import NodeID
+from .node import Cluster, DriverContext
+from .object_ref import ObjectRef
+from .resources import normalize_resources
+from .task import RemoteFunction
+
+
+def init(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    *,
+    worker_env: Optional[Dict[str, str]] = None,
+    max_workers_per_node: Optional[int] = None,
+    ignore_reinit_error: bool = True,
+    **_compat,
+) -> None:
+    """Start the in-process cluster (head node) and connect the driver."""
+    if global_state.is_initialized():
+        if ignore_reinit_error:
+            return
+        raise RuntimeError("ray_tpu.init() called twice")
+    if num_cpus is None:
+        num_cpus = float(os.environ.get("RAY_TPU_NUM_CPUS", os.cpu_count() or 1))
+    if num_tpus is None:
+        num_tpus = float(os.environ.get("RAY_TPU_NUM_TPUS", "0"))
+    total = normalize_resources(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+    kwargs: Dict[str, Any] = {}
+    if max_workers_per_node is not None:
+        kwargs["max_workers_per_node"] = max_workers_per_node
+    cluster = Cluster(total, worker_env=worker_env, **kwargs)
+    global_state.set_cluster(cluster)
+    global_state.set_worker(DriverContext(cluster))
+    atexit.register(shutdown)
+
+
+def shutdown() -> None:
+    cluster = global_state.try_cluster()
+    if cluster is not None:
+        cluster.shutdown()
+    global_state.set_cluster(None)
+    global_state.set_worker(None)
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def is_initialized() -> bool:
+    return global_state.is_initialized()
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes (reference worker.py:3441)."""
+
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **options)
+        return RemoteFunction(target, **options)
+
+    if len(args) == 1 and not options and (inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        return wrap(args[0])
+    if args:
+        raise TypeError("remote() takes keyword options only, e.g. @remote(num_cpus=2)")
+    return wrap
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    ctx = global_state.worker()
+    try:
+        return ctx.get(refs, timeout)
+    except TimeoutError as e:
+        raise GetTimeoutError(str(e)) from None
+
+
+def put(value: Any) -> ObjectRef:
+    return global_state.worker().put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return global_state.worker().wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    global_state.worker().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    global_state.worker().cancel(ref.id, force)
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    return global_state.worker().get_named_actor(name, namespace)
+
+
+# -- cluster state ---------------------------------------------------------------------
+def cluster_resources() -> Dict[str, float]:
+    cluster = global_state.try_cluster()
+    if cluster is None:
+        return {}
+    out: Dict[str, float] = {}
+    for node in cluster.nodes():
+        for k, v in node.ledger.total.items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def available_resources() -> Dict[str, float]:
+    cluster = global_state.try_cluster()
+    if cluster is None:
+        return {}
+    out: Dict[str, float] = {}
+    for node in cluster.nodes():
+        for k, v in node.ledger.available().items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def nodes() -> List[Dict[str, Any]]:
+    cluster = global_state.try_cluster()
+    if cluster is None:
+        return []
+    return [
+        {
+            "NodeID": info.node_id.hex(),
+            "Alive": info.alive,
+            "Resources": info.resources,
+            "Labels": info.labels,
+        }
+        for info in cluster.gcs.nodes(alive_only=False)
+    ]

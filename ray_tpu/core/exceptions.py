@@ -1,0 +1,60 @@
+"""User-facing exception types.
+
+Capability parity: reference python/ray/exceptions.py (RayTaskError, RayActorError,
+GetTimeoutError, ObjectLostError, WorkerCrashedError, ...).
+"""
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task; re-raised at ray_tpu.get()."""
+
+    def __init__(self, cause: BaseException, task_desc: str = "", tb_str: str = ""):
+        self.cause = cause
+        self.task_desc = task_desc
+        if tb_str:
+            self.tb_str = tb_str
+        elif isinstance(cause, BaseException):
+            self.tb_str = "".join(
+                traceback.format_exception(type(cause), cause, cause.__traceback__)
+            )
+        else:
+            self.tb_str = ""
+        super().__init__(f"task {task_desc} failed: {cause!r}\n{self.tb_str}")
+
+    def __reduce__(self):
+        return (TaskError, (self.cause, self.task_desc, self.tb_str))
+
+
+class ActorError(RayTpuError):
+    """The actor died (process exit, creation failure, or kill) before/while executing."""
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass

@@ -1,0 +1,147 @@
+"""GCS: cluster metadata service — node table, KV store, named actors, pubsub.
+
+Capability parity: reference src/ray/gcs/gcs_server/ (GcsNodeManager, GcsInternalKVManager,
+GcsActorManager's named-actor registry, pubsub hub). Round-1 deployment is in-process with
+thread-safe tables; the interface is kept narrow so a later out-of-process gRPC service can
+slot in without changing callers.
+"""
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .ids import ActorID, NodeID
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    start_time: float = field(default_factory=time.time)
+
+
+class KVStore:
+    """Namespaced key-value store (reference: GcsInternalKVManager, gcs_kv_manager.h:104)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple[str, bytes], bytes] = {}
+
+    def put(self, key: bytes, value: bytes, namespace: str = "", overwrite: bool = True) -> bool:
+        with self._lock:
+            k = (namespace, key)
+            if not overwrite and k in self._data:
+                return False
+            self._data[k] = value
+            return True
+
+    def get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
+        with self._lock:
+            return self._data.get((namespace, key))
+
+    def delete(self, key: bytes, namespace: str = "") -> bool:
+        with self._lock:
+            return self._data.pop((namespace, key), None) is not None
+
+    def exists(self, key: bytes, namespace: str = "") -> bool:
+        with self._lock:
+            return (namespace, key) in self._data
+
+    def keys(self, prefix: bytes = b"", namespace: str = "") -> List[bytes]:
+        with self._lock:
+            return [k for (ns, k) in self._data if ns == namespace and k.startswith(prefix)]
+
+
+class PubSub:
+    """Channel-based pubsub (reference: src/ray/pubsub/ long-poll publisher/subscriber)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: Dict[str, List[Callable[[Any], None]]] = {}
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> Callable[[], None]:
+        with self._lock:
+            self._subs.setdefault(channel, []).append(callback)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._subs[channel].remove(callback)
+                except (KeyError, ValueError):
+                    pass
+
+        return unsubscribe
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            # Pattern subscribers: a subscription to "node:*" sees "node:added".
+            cbs = []
+            for ch, lst in self._subs.items():
+                if ch == channel or fnmatch.fnmatch(channel, ch):
+                    cbs.extend(lst)
+        for cb in cbs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+class GCS:
+    def __init__(self):
+        self.kv = KVStore()
+        self.pubsub = PubSub()
+        self._lock = threading.Lock()
+        self._nodes: Dict[NodeID, NodeInfo] = {}
+        self._named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name) -> id
+
+    # -- node table ----------------------------------------------------------------
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self._nodes[info.node_id] = info
+        self.pubsub.publish("node:added", info)
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info:
+                info.alive = False
+        if info:
+            self.pubsub.publish("node:removed", info)
+
+    def nodes(self, alive_only: bool = True) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.alive or not alive_only]
+
+    def get_node(self, node_id: NodeID) -> Optional[NodeInfo]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    # -- named actors ---------------------------------------------------------------
+    def register_named_actor(self, name: str, namespace: str, actor_id: ActorID) -> bool:
+        with self._lock:
+            key = (namespace, name)
+            if key in self._named_actors:
+                return False
+            self._named_actors[key] = actor_id
+            return True
+
+    def get_named_actor(self, name: str, namespace: str) -> Optional[ActorID]:
+        with self._lock:
+            return self._named_actors.get((namespace, name))
+
+    def unregister_named_actor(self, name: str, namespace: str) -> None:
+        with self._lock:
+            self._named_actors.pop((namespace, name), None)
+
+    def list_named_actors(self, namespace: Optional[str] = None) -> List[Tuple[str, str]]:
+        with self._lock:
+            return [
+                (ns, name)
+                for (ns, name) in self._named_actors
+                if namespace is None or ns == namespace
+            ]

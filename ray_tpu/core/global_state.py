@@ -1,0 +1,41 @@
+"""Process-global runtime context: either the driver (with an in-process cluster) or a
+worker (with a pipe to the node service).
+
+Capability parity: reference python/ray/_private/worker.py global_worker singleton.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+_worker: Optional[Any] = None  # DriverContext or WorkerContext
+_cluster: Optional[Any] = None  # Cluster (driver process only)
+
+
+def set_worker(w) -> None:
+    global _worker
+    _worker = w
+
+
+def worker():
+    if _worker is None:
+        raise RuntimeError(
+            "ray_tpu is not initialized; call ray_tpu.init() first"
+        )
+    return _worker
+
+
+def try_worker():
+    return _worker
+
+
+def set_cluster(c) -> None:
+    global _cluster
+    _cluster = c
+
+
+def try_cluster():
+    return _cluster
+
+
+def is_initialized() -> bool:
+    return _worker is not None

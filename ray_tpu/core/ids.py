@@ -1,0 +1,74 @@
+"""Unique identifiers for objects, tasks, actors, nodes, jobs, placement groups.
+
+Capability parity: reference src/ray/common/id.h (JobID/TaskID/ObjectID/ActorID/NodeID).
+We keep flat 16-byte random ids; lineage is tracked in owner tables instead of being
+embedded in the id bits (simpler, and reconstruction metadata lives with the owner).
+"""
+from __future__ import annotations
+
+import os
+import binascii
+
+
+class BaseID:
+    SIZE = 16
+    __slots__ = ("_bytes",)
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(f"{type(self).__name__} requires {self.SIZE} bytes")
+        self._bytes = id_bytes
+
+    @classmethod
+    def generate(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(binascii.unhexlify(hex_str))
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()[:16]})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass

@@ -1,0 +1,884 @@
+"""Node service: worker pool, router, scheduler, actor manager, driver context.
+
+Capability parity: reference raylet (src/ray/raylet/node_manager.h:124 — worker leases,
+dependency management, dispatch) + GCS actor manager (gcs_actor_manager.h:333) + the
+cluster task manager scheduling policies (scheduling/cluster_task_manager.h:44). The
+round-1 deployment runs the node service inside the driver process with spawned worker
+processes; the same Cluster object models multiple virtual nodes (reference analog:
+ray.cluster_utils.Cluster multi-raylet fixture) so multi-node scheduling semantics are
+testable on one host.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing
+import multiprocessing.connection
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from . import global_state, object_store
+from .exceptions import (
+    ActorDiedError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .gcs import GCS, NodeInfo
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .object_ref import ObjectRef
+from .object_store import ObjectStore
+from .placement_group import PlacementGroup, PlacementGroupManager
+from .resources import ResourceLedger
+from .task_spec import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    TaskSpec,
+)
+
+_mp = multiprocessing.get_context("spawn")
+
+DEFAULT_MAX_WORKERS_PER_NODE = int(os.environ.get("RAY_TPU_MAX_WORKERS_PER_NODE", "16"))
+WORKER_START_TIMEOUT_S = 60.0
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: WorkerID, process, conn, node: "NodeRuntime", accel: str):
+        self.worker_id = worker_id
+        self.process = process
+        self.conn = conn
+        self.node = node
+        self.accel = accel
+        self.state = "starting"  # starting | idle | busy | blocked | dead
+        self.known_fns: set = set()
+        self.inflight: deque = deque()  # TaskSpecs sent, results pending (FIFO)
+        self.resources_held: Dict[str, float] = {}
+        self.bundle_ledger: Optional[ResourceLedger] = None
+        self.actor_id: Optional[ActorID] = None
+        self._send_lock = threading.Lock()
+        self.blocked_reqs: set = set()
+
+    def send(self, msg) -> None:
+        with self._send_lock:
+            self.conn.send_bytes(cloudpickle.dumps(msg))
+
+    def alive(self) -> bool:
+        return self.state != "dead" and self.process.is_alive()
+
+
+class NodeRuntime:
+    def __init__(self, cluster: "Cluster", node_id: NodeID, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None, max_workers: int = DEFAULT_MAX_WORKERS_PER_NODE):
+        self.cluster = cluster
+        self.node_id = node_id
+        self.ledger = ResourceLedger(resources)
+        self.labels = labels or {}
+        self.max_workers = max_workers
+        self.idle: Dict[str, List[WorkerHandle]] = {}
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self.alive = True
+
+    def num_workers(self) -> int:
+        return len(self.workers)
+
+    def pop_idle(self, accel: str) -> Optional[WorkerHandle]:
+        pool = self.idle.get(accel)
+        while pool:
+            w = pool.pop()
+            if w.alive():
+                return w
+        return None
+
+    def push_idle(self, w: WorkerHandle) -> None:
+        w.state = "idle"
+        self.idle.setdefault(w.accel, []).append(w)
+
+    def spawn_worker(self, accel: str) -> Optional[WorkerHandle]:
+        if len(self.workers) >= self.max_workers:
+            return None
+        from .worker import worker_main
+
+        worker_id = WorkerID.generate()
+        parent_conn, child_conn = _mp.Pipe(duplex=True)
+        env = dict(self.cluster.worker_env)
+        proc = _mp.Process(
+            target=worker_main,
+            args=(child_conn, self.node_id.hex(), worker_id.hex(), accel, env),
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        w = WorkerHandle(worker_id, proc, parent_conn, self, accel)
+        self.workers[worker_id] = w
+        self.cluster._register_conn(w)
+        return w
+
+
+class ActorState:
+    def __init__(self, actor_id: ActorID, creation_spec: TaskSpec, method_meta: Dict[str, Any]):
+        self.actor_id = actor_id
+        self.creation_spec = creation_spec
+        self.method_meta = method_meta
+        self.state = "pending"  # pending | alive | restarting | dead
+        self.worker: Optional[WorkerHandle] = None
+        self.restarts_used = 0
+        self.death_cause: Optional[Exception] = None
+        self.name: Optional[str] = creation_spec.actor_name
+        self.namespace: str = creation_spec.actor_namespace
+        self.detached = bool(creation_spec.runtime_env and creation_spec.runtime_env.get("detached"))
+        self.handle_count = 0
+        self.kill_on_creation = False
+
+
+class TaskState:
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.worker: Optional[WorkerHandle] = None
+        self.resources_node: Optional[NodeRuntime] = None
+        self.resources: Dict[str, float] = {}
+        self.bundle_ledger: Optional[ResourceLedger] = None
+        self.cancelled = False
+
+
+class Cluster:
+    """The whole single-host deployment: GCS + object store + N virtual nodes + router."""
+
+    def __init__(self, resources: Dict[str, float], worker_env: Optional[Dict[str, str]] = None,
+                 max_workers_per_node: int = DEFAULT_MAX_WORKERS_PER_NODE):
+        self.gcs = GCS()
+        self.store = ObjectStore()
+        self.pg_manager = PlacementGroupManager()
+        self.worker_env = worker_env or {}
+        self.fn_table: Dict[bytes, bytes] = {}
+        self.actors: Dict[ActorID, ActorState] = {}
+        self.tasks: Dict[TaskID, TaskState] = {}
+        self.pending: deque = deque()  # TaskSpecs waiting for dispatch
+        self.pending_pgs: List[PlacementGroup] = []
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, NodeRuntime] = {}
+        self._node_order: List[NodeID] = []
+        self._spread_counter = itertools.count()
+        self._conns: Dict[Any, WorkerHandle] = {}
+        self._wakeup_r, self._wakeup_w = _mp.Pipe(duplex=False)
+        self._shutdown = False
+        self._router_thread = threading.Thread(target=self._router, daemon=True, name="rt-router")
+        self.head_node = self.add_node(resources)
+        self._router_thread.start()
+
+    # -- topology --------------------------------------------------------------------
+    def add_node(self, resources: Dict[str, float], labels: Optional[Dict[str, str]] = None,
+                 max_workers: int = DEFAULT_MAX_WORKERS_PER_NODE) -> NodeRuntime:
+        node_id = NodeID.generate()
+        node = NodeRuntime(self, node_id, resources, labels, max_workers)
+        with self._lock:
+            self._nodes[node_id] = node
+            self._node_order.append(node_id)
+        self.gcs.register_node(NodeInfo(node_id=node_id, resources=dict(resources), labels=labels or {}))
+        self._schedule()
+        return node
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            node.alive = False
+            workers = list(node.workers.values())
+        for w in workers:
+            self._kill_worker(w, WorkerCrashedError(f"node {node_id.hex()[:8]} removed"))
+        self.gcs.remove_node(node_id)
+
+    def get_node_runtime(self, node_id: NodeID) -> Optional[NodeRuntime]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def nodes(self) -> List[NodeRuntime]:
+        with self._lock:
+            return [self._nodes[nid] for nid in self._node_order if self._nodes[nid].alive]
+
+    # -- router (multiplexes all worker pipes) ----------------------------------------
+    def _register_conn(self, w: WorkerHandle) -> None:
+        with self._lock:
+            self._conns[w.conn] = w
+        try:
+            self._wakeup_w.send_bytes(b"x")
+        except Exception:
+            pass
+
+    def _router(self) -> None:
+        while not self._shutdown:
+            with self._lock:
+                conns = list(self._conns.keys())
+            ready = multiprocessing.connection.wait([self._wakeup_r] + conns, timeout=1.0)
+            for conn in ready:
+                if conn is self._wakeup_r:
+                    try:
+                        self._wakeup_r.recv_bytes()
+                    except Exception:
+                        pass
+                    continue
+                with self._lock:
+                    w = self._conns.get(conn)
+                if w is None:
+                    continue
+                try:
+                    raw = conn.recv_bytes()
+                except (EOFError, OSError):
+                    self._on_worker_death(w)
+                    continue
+                try:
+                    msg = cloudpickle.loads(raw)
+                    self._handle_message(w, msg)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _handle_message(self, w: WorkerHandle, msg: Tuple) -> None:
+        kind = msg[0]
+        if kind == "ready":
+            with self._lock:
+                if w.state == "starting":
+                    w.node.push_idle(w)
+            self._schedule()
+        elif kind == "result":
+            self._on_result(w, msg[1], msg[2], msg[3])
+        elif kind == "submit":
+            self.submit(msg[1])
+        elif kind == "get":
+            _, req_id, oids, timeout = msg
+            self._async_reply(w, req_id, lambda: [self.store.location(oid, timeout) for oid in oids],
+                              blocking=True)
+        elif kind == "wait":
+            _, req_id, oids, num_returns, timeout = msg
+            self._async_reply(w, req_id, lambda: self.store.wait(oids, num_returns, timeout),
+                              blocking=True)
+        elif kind == "put":
+            _, oid, loc = msg
+            self.store.add(oid, loc)
+            self.store.incref(oid)
+            self._schedule()
+        elif kind == "decref":
+            self.store.decref(msg[1])
+        elif kind == "register_fn":
+            _, fn_id, fn_bytes = msg
+            self.fn_table[fn_id] = fn_bytes
+            w.known_fns.add(fn_id)
+        elif kind == "fetch_fn":
+            _, req_id, fn_id = msg
+            fn_bytes = self.fn_table.get(fn_id)
+            if fn_bytes is None:
+                self._reply(w, req_id, False, KeyError(f"unknown function {fn_id.hex()[:12]}"))
+            else:
+                w.known_fns.add(fn_id)
+                self._reply(w, req_id, True, fn_bytes)
+        elif kind == "kill_actor":
+            self.kill_actor(msg[1], no_restart=msg[2], from_gc=msg[3] if len(msg) > 3 else False)
+        elif kind == "cancel":
+            self.cancel(msg[1], force=msg[2])
+        elif kind == "get_named_actor":
+            _, req_id, name, namespace = msg
+            try:
+                handle = self.get_named_actor_handle(name, namespace)
+                self._reply(w, req_id, True, handle)
+            except Exception as e:  # noqa: BLE001
+                self._reply(w, req_id, False, e)
+        elif kind == "lookup_pg":
+            _, req_id, pg_id = msg
+            pg = self.pg_manager.lookup(pg_id)
+            if pg is None:
+                with self._lock:
+                    pg = next((p for p in self.pending_pgs if p.id == pg_id), None)
+            data = None
+            if pg is not None:
+                data = (pg.bundle_specs, pg.strategy, pg.name, pg.is_ready, pg._failed)
+            self._reply(w, req_id, True, data)
+        elif kind == "pg_ready_ref":
+            _, req_id, pg_id = msg
+            self._async_reply(w, req_id, lambda: self._pg_ready_blocking(pg_id), blocking=True)
+        elif kind == "create_pg":
+            _, req_id, bundles, strategy, name = msg
+            pg = self.create_placement_group(bundles, strategy, name)
+            self._reply(w, req_id, True, pg.id)
+        elif kind == "remove_pg":
+            self.remove_placement_group(msg[1])
+
+    def _reply(self, w: WorkerHandle, req_id: int, ok: bool, value) -> None:
+        try:
+            w.send(("reply", req_id, ok, value))
+        except Exception:
+            pass
+
+    def _async_reply(self, w: WorkerHandle, req_id: int, fn, blocking: bool = False) -> None:
+        """Run fn on a waiter thread and reply; a blocking worker releases its resources."""
+        if blocking:
+            self._mark_blocked(w)
+
+        def run():
+            try:
+                value = fn()
+                ok = True
+            except BaseException as e:  # noqa: BLE001
+                value, ok = e, False
+            if blocking:
+                self._unmark_blocked(w)
+            self._reply(w, req_id, ok, value)
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _mark_blocked(self, w: WorkerHandle) -> None:
+        with self._lock:
+            if w.state == "busy" and not w.blocked_reqs:
+                w.state = "blocked"
+                if w.resources_held:
+                    (w.bundle_ledger or w.node.ledger).release(w.resources_held)
+            w.blocked_reqs.add(threading.get_ident())
+        self._schedule()
+
+    def _unmark_blocked(self, w: WorkerHandle) -> None:
+        with self._lock:
+            w.blocked_reqs.discard(threading.get_ident())
+            if w.state == "blocked" and not w.blocked_reqs:
+                w.state = "busy"
+                if w.resources_held:
+                    (w.bundle_ledger or w.node.ledger).force_acquire(w.resources_held)
+
+    def _pg_ready_blocking(self, pg_id: PlacementGroupID):
+        pg = self.pg_manager.lookup(pg_id)
+        if pg is None:
+            with self._lock:
+                pg = next((p for p in self.pending_pgs if p.id == pg_id), None)
+        if pg is None:
+            raise ValueError(f"unknown placement group {pg_id!r}")
+        pg.wait(None)
+        return True
+
+    # -- submission --------------------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> None:
+        for oid in spec.return_ids:
+            self.store.incref(oid)
+        # Pin args until the task reaches a terminal state (reference: TaskManager holds
+        # dependencies for retryable tasks, task_manager.cc).
+        for oid in spec.arg_refs:
+            self.store.incref(oid)
+        if spec.fn_bytes is not None and spec.fn_id not in self.fn_table:
+            self.fn_table[spec.fn_id] = spec.fn_bytes
+        with self._lock:
+            self.tasks[spec.task_id] = TaskState(spec)
+            if spec.kind == "actor_creation":
+                st = ActorState(spec.actor_id, spec, method_meta=spec.runtime_env.get("methods", {}) if spec.runtime_env else {})
+                self.actors[spec.actor_id] = st
+                if spec.actor_name:
+                    ok = self.gcs.register_named_actor(spec.actor_name, spec.actor_namespace, spec.actor_id)
+                    if not ok:
+                        self._fail_returns(spec, ValueError(f"actor name {spec.actor_name!r} already taken"))
+                        return
+            self.pending.append(spec)
+        self._schedule()
+
+    # -- scheduling --------------------------------------------------------------------
+    def _schedule(self) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            # Try to place pending placement groups first (they gate dependent tasks).
+            still_pgs = []
+            for pg in self.pending_pgs:
+                nodes = [(n.node_id, n.ledger) for n in self.nodes()]
+                if not self.pg_manager.try_place(pg, nodes):
+                    still_pgs.append(pg)
+            self.pending_pgs = still_pgs
+
+            remaining = deque()
+            while self.pending:
+                spec = self.pending.popleft()
+                ts = self.tasks.get(spec.task_id)
+                if ts is not None and ts.cancelled:
+                    continue
+                if not self._try_dispatch(spec):
+                    remaining.append(spec)
+            self.pending = remaining
+
+    def _args_ready(self, spec: TaskSpec) -> Tuple[str, Optional[List]]:
+        """Returns ("ready", locs) | ("pending", None) | ("failed", None)."""
+        locs = []
+        for oid in spec.arg_refs:
+            try:
+                loc = self.store.try_location(oid)
+            except Exception as e:  # noqa: BLE001  -- an arg failed: propagate to returns
+                self._fail_returns(spec, e)
+                return "failed", None
+            if loc is None:
+                return "pending", None
+            locs.append(loc)
+        return "ready", locs
+
+    def _try_dispatch(self, spec: TaskSpec) -> bool:
+        """Returns True if the task left the pending queue (dispatched or failed)."""
+        if spec.kind == "actor_method":
+            return self._try_dispatch_actor_method(spec)
+
+        status, locs = self._args_ready(spec)
+        if status == "failed":
+            return True
+        if status == "pending":
+            return False
+
+        placement = self._choose_placement(spec)
+        if placement is None:
+            return False
+        node, ledger, resources = placement
+        accel = "tpu" if resources.get("TPU", 0) > 0 else "cpu"
+        worker = node.pop_idle(accel)
+        if worker is None:
+            worker = node.spawn_worker(accel)
+            if worker is None:
+                ledger.release(resources)
+                return False
+            # Worker is starting; it will announce "ready". Reserve it for this task by
+            # dispatching immediately — the pipe buffers until the worker loop starts.
+        worker.state = "busy"
+        worker.resources_held = resources
+        worker.bundle_ledger = ledger if ledger is not node.ledger else None
+        self._send_task(worker, spec, locs)
+        ts = self.tasks[spec.task_id]
+        ts.worker = worker
+        ts.resources_node = node
+        ts.resources = resources
+        ts.bundle_ledger = worker.bundle_ledger
+        if spec.kind == "actor_creation":
+            st = self.actors[spec.actor_id]
+            st.worker = worker
+            worker.actor_id = spec.actor_id
+        return True
+
+    def _try_dispatch_actor_method(self, spec: TaskSpec) -> bool:
+        st = self.actors.get(spec.actor_id)
+        if st is None or st.state == "dead":
+            cause = st.death_cause if st else None
+            self._fail_returns(spec, ActorDiedError(f"actor {spec.actor_id!r} is dead: {cause!r}"))
+            return True
+        if st.state != "alive":
+            return False  # queued until creation finishes / restart completes
+        status, locs = self._args_ready(spec)
+        if status == "failed":
+            return True
+        if status == "pending":
+            return False
+        self._send_task(st.worker, spec, locs)
+        ts = self.tasks[spec.task_id]
+        ts.worker = st.worker
+        return True
+
+    def _send_task(self, worker: WorkerHandle, spec: TaskSpec, locs: List) -> None:
+        if spec.fn_id in worker.known_fns:
+            spec.fn_bytes = None
+        else:
+            spec.fn_bytes = self.fn_table.get(spec.fn_id, spec.fn_bytes)
+            worker.known_fns.add(spec.fn_id)
+        worker.inflight.append(spec.task_id)
+        worker.send(("task", spec, locs))
+
+    def _choose_placement(self, spec: TaskSpec):
+        """Pick (node, ledger, resources) honoring the scheduling strategy; None = wait."""
+        strategy = spec.scheduling_strategy
+        resources = dict(spec.resources)
+        if isinstance(strategy, PlacementGroupSchedulingStrategy) or spec.pg_id is not None:
+            pg_id = spec.pg_id or strategy.placement_group.id
+            bundle_index = spec.pg_bundle_index if spec.pg_id else strategy.placement_group_bundle_index
+            bundles = self.pg_manager.bundles(pg_id)
+            if not bundles:
+                return None  # PG not placed yet
+            candidates = bundles if bundle_index < 0 else [bundles[bundle_index]]
+            for b in candidates:
+                if b.ledger.try_acquire(resources):
+                    node = self._nodes.get(b.node_id)
+                    if node is None or not node.alive:
+                        b.ledger.release(resources)
+                        continue
+                    return node, b.ledger, resources
+            return None
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            node = self._nodes.get(NodeID.from_hex(strategy.node_id))
+            if node is not None and node.alive and node.ledger.try_acquire(resources):
+                return node, node.ledger, resources
+            if not strategy.soft:
+                if node is None or not node.alive:
+                    self._fail_returns(spec, WorkerCrashedError(f"node {strategy.node_id} unavailable"))
+                return None
+            # soft: fall through to default
+        nodes = self.nodes()
+        if not nodes:
+            return None
+        if strategy == "SPREAD":
+            start = next(self._spread_counter) % len(nodes)
+            ordered = nodes[start:] + nodes[:start]
+        else:
+            # Hybrid default: prefer the head node, then least-utilized (reference:
+            # hybrid_scheduling_policy.h — prefer local, spill to top-k by utilization).
+            ordered = sorted(nodes, key=lambda n: (n is not self.head_node, n.ledger.utilization()))
+        for node in ordered:
+            if node.ledger.try_acquire(resources):
+                return node, node.ledger, resources
+        return None
+
+    # -- results & failure -------------------------------------------------------------
+    def _on_result(self, w: WorkerHandle, task_id: TaskID, payload, err_info) -> None:
+        with self._lock:
+            ts = self.tasks.get(task_id)
+            if w.inflight and w.inflight[0] == task_id:
+                w.inflight.popleft()
+        spec = ts.spec if ts else None
+
+        # Application exceptions retry only when retry_exceptions is set (reference
+        # semantics: max_retries covers worker crashes; see _on_worker_death).
+        retry = (
+            err_info is not None
+            and spec is not None
+            and spec.retry_exceptions
+            and spec.attempt < spec.max_retries
+        )
+        if retry:
+            for oid, loc in payload:
+                if loc[0] == "shm":
+                    try:
+                        from multiprocessing import shared_memory
+
+                        seg = shared_memory.SharedMemory(name=loc[1])
+                        seg.close()
+                        seg.unlink()
+                    except Exception:
+                        pass
+            spec.attempt += 1
+            with self._lock:
+                self.pending.append(spec)
+        else:
+            for oid, loc in payload:
+                self.store.add(oid, loc)
+
+        with self._lock:
+            if spec is not None and spec.kind == "actor_creation":
+                st = self.actors.get(spec.actor_id)
+                if st is not None:
+                    if err_info is None:
+                        st.state = "alive"
+                        st.worker = w
+                        if st.kill_on_creation:
+                            threading.Thread(
+                                target=self.kill_actor, args=(st.actor_id, True), daemon=True
+                            ).start()
+                    elif not retry:
+                        st.state = "dead"
+                        st.death_cause = RuntimeError(f"actor creation failed: {err_info[1]}")
+                        self._drain_actor_queue(st)
+                # Actor worker stays busy/pinned; resources held for actor lifetime.
+            elif spec is not None and spec.kind == "actor_method":
+                pass  # no per-method resources
+            elif ts is not None and ts.resources:
+                (ts.bundle_ledger or ts.resources_node.ledger).release(ts.resources)
+                w.resources_held = {}
+                w.bundle_ledger = None
+            if spec is not None and spec.kind == "task" and w.state in ("busy", "blocked"):
+                w.node.push_idle(w)
+            if not retry and ts is not None:
+                self.tasks.pop(task_id, None)
+            if not retry and spec is not None:
+                if not (spec.kind == "actor_creation" and spec.max_restarts != 0):
+                    # Actor-creation args stay pinned while restarts remain (the
+                    # creation spec is resubmitted with the same arg refs).
+                    self._unpin_args(spec)
+        self._schedule()
+
+    def _drain_actor_queue(self, st: ActorState) -> None:
+        """Fail every pending method of a dead actor (caller holds the lock)."""
+        remaining = deque()
+        while self.pending:
+            spec = self.pending.popleft()
+            if spec.kind == "actor_method" and spec.actor_id == st.actor_id:
+                self._fail_returns(spec, ActorDiedError(f"actor died: {st.death_cause!r}"))
+            else:
+                remaining.append(spec)
+        self.pending = remaining
+
+    def _fail_returns(self, spec: TaskSpec, err: Exception) -> None:
+        wrapped = err if isinstance(err, (TaskError, ActorDiedError, WorkerCrashedError, TaskCancelledError)) else TaskError(err, spec.name)
+        for oid in spec.return_ids:
+            self.store.mark_failed(oid, wrapped)
+        self.tasks.pop(spec.task_id, None)
+        self._unpin_args(spec)
+
+    def _unpin_args(self, spec: TaskSpec) -> None:
+        for oid in spec.arg_refs:
+            self.store.decref(oid)
+        spec.arg_refs = []
+
+    def _on_worker_death(self, w: WorkerHandle) -> None:
+        with self._lock:
+            if w.state == "dead":
+                return
+            w.state = "dead"
+            self._conns.pop(w.conn, None)
+            w.node.workers.pop(w.worker_id, None)
+            pool = w.node.idle.get(w.accel)
+            if pool and w in pool:
+                pool.remove(w)
+            inflight = list(w.inflight)
+            w.inflight.clear()
+            if w.resources_held:
+                (w.bundle_ledger or w.node.ledger).release(w.resources_held)
+                w.resources_held = {}
+        err = WorkerCrashedError(f"worker {w.worker_id.hex()[:8]} died unexpectedly")
+        for task_id in inflight:
+            ts = self.tasks.get(task_id)
+            if ts is None:
+                continue
+            spec = ts.spec
+            if ts.cancelled:
+                self._fail_returns(spec, TaskCancelledError(f"task {spec.name} cancelled"))
+            elif spec.attempt < spec.max_retries and spec.kind == "task":
+                spec.attempt += 1
+                with self._lock:
+                    self.pending.append(spec)
+            else:
+                self._fail_returns(spec, err)
+        if w.actor_id is not None:
+            self._on_actor_worker_death(w.actor_id, err)
+        self._schedule()
+
+    def _on_actor_worker_death(self, actor_id: ActorID, err: Exception) -> None:
+        with self._lock:
+            st = self.actors.get(actor_id)
+            if st is None or st.state == "dead":
+                return
+            spec = st.creation_spec
+            if st.restarts_used < spec.max_restarts or spec.max_restarts == -1:
+                st.restarts_used += 1
+                st.state = "restarting"
+                st.worker = None
+                respawn = TaskSpec(**{**spec.__dict__})
+                respawn.task_id = TaskID.generate()
+                respawn.return_ids = [ObjectID.generate()]
+                respawn.attempt = 0
+                st.creation_spec = respawn
+                self.tasks[respawn.task_id] = TaskState(respawn)
+                self.store.incref(respawn.return_ids[0])
+                self.pending.append(respawn)
+            else:
+                st.state = "dead"
+                st.death_cause = err
+                self._drain_actor_queue(st)
+                if st.name:
+                    self.gcs.unregister_named_actor(st.name, st.namespace)
+                if spec.max_restarts != 0:
+                    self._unpin_args(spec)
+
+    # -- actor management ----------------------------------------------------------------
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
+        with self._lock:
+            st = self.actors.get(actor_id)
+            if st is None:
+                return
+            if from_gc and st.detached:
+                return
+            if no_restart:
+                st.creation_spec.max_restarts = st.restarts_used  # exhaust restarts
+            if st.state in ("pending", "restarting"):
+                st.kill_on_creation = True
+                return
+            w = st.worker
+        if w is None:
+            return
+        if from_gc:
+            # Graceful: the exit message queues behind already-dispatched methods.
+            try:
+                w.send(("exit",))
+            except Exception:
+                pass
+        else:
+            self._kill_worker(w, ActorDiedError("actor was killed via ray_tpu.kill()"))
+
+    def _kill_worker(self, w: WorkerHandle, err: Exception) -> None:
+        try:
+            w.process.terminate()
+        except Exception:
+            pass
+        self._on_worker_death(w)
+
+    def get_named_actor_handle(self, name: str, namespace: str = ""):
+        actor_id = self.gcs.get_named_actor(name, namespace)
+        if actor_id is None:
+            raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
+        st = self.actors.get(actor_id)
+        from .actor import ActorHandle
+
+        return ActorHandle(actor_id, st.method_meta if st else {})
+
+    def actor_state(self, actor_id: ActorID) -> Optional[str]:
+        with self._lock:
+            st = self.actors.get(actor_id)
+            return st.state if st else None
+
+    # -- placement groups ---------------------------------------------------------------
+    def create_placement_group(self, bundles: List[Dict[str, float]], strategy: str, name: str = "") -> PlacementGroup:
+        pg = PlacementGroup(PlacementGroupID.generate(), bundles, strategy, name)
+        with self._lock:
+            self.pending_pgs.append(pg)
+        self._schedule()
+        return pg
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            self.pending_pgs = [p for p in self.pending_pgs if p.id != pg_id]
+        self.pg_manager.remove(pg_id)
+        self._schedule()
+
+    # -- task cancel --------------------------------------------------------------------
+    def cancel(self, oid: ObjectID, force: bool = False) -> None:
+        with self._lock:
+            target = None
+            for task_id, ts in self.tasks.items():
+                if oid in ts.spec.return_ids:
+                    target = ts
+                    break
+            if target is None:
+                return
+            target.cancelled = True
+            in_queue = any(s.task_id == target.spec.task_id for s in self.pending)
+        if in_queue:
+            self._fail_returns(target.spec, TaskCancelledError(f"task {target.spec.name} cancelled"))
+        elif force and target.worker is not None and target.worker.actor_id is None:
+            self._kill_worker(target.worker, TaskCancelledError("force-cancelled"))
+            self._fail_returns(target.spec, TaskCancelledError(f"task {target.spec.name} cancelled"))
+
+    # -- shutdown -----------------------------------------------------------------------
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self._lock:
+            workers = [w for n in self._nodes.values() for w in list(n.workers.values())]
+        for w in workers:
+            try:
+                w.send(("exit",))
+            except Exception:
+                pass
+        deadline = time.monotonic() + 2.0
+        for w in workers:
+            t = max(0.05, deadline - time.monotonic())
+            w.process.join(timeout=t)
+            if w.process.is_alive():
+                w.process.terminate()
+        try:
+            self._wakeup_w.send_bytes(b"x")
+        except Exception:
+            pass
+        self._router_thread.join(timeout=2.0)
+        self.store.free_all()
+
+
+class DriverContext:
+    """Driver-side implementation of the runtime API (same surface as WorkerContext)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.node_id_hex = cluster.head_node.node_id.hex()
+        self.accel = "driver"
+        self._registered_fns: set = set()
+
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self.cluster.submit(spec)
+        return [ObjectRef(oid, owned=True) for oid in spec.return_ids]
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        values = []
+        for r in ref_list:
+            t = None if deadline is None else max(0.0, deadline - time.monotonic())
+            loc = self.cluster.store.location(r.id, t)
+            values.append(object_store.resolve(loc))
+        return values[0] if single else values
+
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.generate()
+        loc = object_store.materialize(value, oid)
+        self.cluster.store.add(oid, loc)
+        self.cluster.store.incref(oid)
+        return ObjectRef(oid, owned=True)
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        oids = [r.id for r in refs]
+        ready_ids, pending_ids = self.cluster.store.wait(oids, num_returns, timeout)
+        by_id = {r.id: r for r in refs}
+        return [by_id[i] for i in ready_ids], [by_id[i] for i in pending_ids]
+
+    def decref(self, oid: ObjectID) -> None:
+        self.cluster.store.decref(oid)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
+        self.cluster.kill_actor(actor_id, no_restart, from_gc)
+
+    def cancel(self, oid: ObjectID, force: bool = False) -> None:
+        self.cluster.cancel(oid, force)
+
+    def get_named_actor(self, name: str, namespace: str = ""):
+        return self.cluster.get_named_actor_handle(name, namespace)
+
+    def register_fn(self, fn_id: bytes, fn_bytes: bytes) -> None:
+        self.cluster.fn_table[fn_id] = fn_bytes
+
+    def fn_known(self, fn_id: bytes) -> bool:
+        return fn_id in self.cluster.fn_table
+
+    def lookup_placement_group(self, pg_id):
+        return self.cluster.pg_manager.lookup(pg_id)
+
+    def pg_ready_ref(self, pg):
+        return self.put(True) if pg.is_ready else self._pg_ready_async(pg)
+
+    def _pg_ready_async(self, pg):
+        oid = ObjectID.generate()
+        self.cluster.store.incref(oid)
+
+        def run():
+            try:
+                pg.wait(None)
+                self.cluster.store.add(oid, object_store.materialize(True, oid))
+            except Exception as e:  # noqa: BLE001
+                self.cluster.store.mark_failed(oid, e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return ObjectRef(oid, owned=True)
+
+    def create_placement_group(self, bundles, strategy, name):
+        return self.cluster.create_placement_group(bundles, strategy, name).id
+
+    def remove_placement_group(self, pg_id):
+        self.cluster.remove_placement_group(pg_id)
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def runtime_context(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id_hex,
+            "worker_id": "driver",
+            "task_id": None,
+            "actor_id": None,
+            "accel": self.accel,
+        }

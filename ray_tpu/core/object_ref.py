@@ -1,0 +1,62 @@
+"""ObjectRef: a future for a value in the object store.
+
+Capability parity: reference ObjectRef (python/ray/_raylet.pyx) + distributed refcounting
+(src/ray/core_worker/reference_count.cc). Ownership model: the driver node coordinator owns
+the directory; driver-side refs participate in refcounting via their Python lifetime
+(__del__ -> decref). Worker-side refs are borrowed and do not decref (the owner's ref
+pins the object for the duration of the borrow).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "_owned", "__weakref__")
+
+    def __init__(self, oid: ObjectID, owned: bool = False):
+        self.id = oid
+        self._owned = owned
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the object's value."""
+        from . import global_state
+
+        return global_state.worker().as_future(self)
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __reduce__(self):
+        # Refs are serialized as borrows; ownership never transfers through pickling.
+        return (ObjectRef, (self.id,))
+
+    def __del__(self):
+        if self._owned:
+            from . import global_state
+
+            try:
+                w = global_state.try_worker()
+                if w is not None:
+                    w.decref(self.id)
+            except Exception:
+                pass
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()

@@ -1,0 +1,219 @@
+"""Two-tier object store: inline bytes for small objects, POSIX shared memory for large.
+
+Capability parity: reference plasma store (src/ray/object_manager/plasma/store.h:55) +
+CoreWorker memory store (src/ray/core_worker/store_provider/). Differences by design:
+- Producers (any process) create the shared-memory segment themselves and register only
+  metadata with the node coordinator, so large task returns and puts never copy through a
+  pipe (plasma's create/seal protocol, without a separate store daemon).
+- Readers map segments zero-copy; numpy arrays deserialized from a segment are views over
+  the mapping (pickle5 out-of-band buffers, see serialization.py).
+"""
+from __future__ import annotations
+
+import threading
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .ids import ObjectID
+
+# Objects below this many serialized bytes travel inline through control pipes.
+INLINE_THRESHOLD = 100 * 1024
+
+# Location tuples:  ("inline", frame_bytes, is_error) | ("shm", name, nbytes, is_error)
+Location = Tuple
+
+
+class ObjectLost(Exception):
+    pass
+
+
+def materialize(obj: Any, oid: ObjectID, is_error: bool = False) -> Location:
+    """Serialize obj and place it: small -> inline bytes, large -> new shm segment."""
+    ser = serialization.serialize(obj)
+    size = ser.frame_bytes
+    if size < INLINE_THRESHOLD:
+        return ("inline", ser.to_bytes(), is_error)
+    name = "rt_" + oid.hex()[:24]
+    seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+    try:
+        ser.write_into(seg.buf)
+    finally:
+        seg.close()
+    return ("shm", name, size, is_error)
+
+
+class _SegmentCache:
+    """Per-process cache of opened read-side segments.
+
+    Deserialized arrays are zero-copy views over the mapping, so segments stay mapped
+    until the process exits or the coordinator broadcasts a free.
+    """
+
+    def __init__(self):
+        self._segs: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def open(self, name: str) -> shared_memory.SharedMemory:
+        with self._lock:
+            seg = self._segs.get(name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=name)
+                self._segs[name] = seg
+            return seg
+
+    def drop(self, name: str) -> None:
+        with self._lock:
+            seg = self._segs.pop(name, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except Exception:
+                pass
+
+
+_segment_cache = _SegmentCache()
+
+
+def resolve(loc: Location) -> Any:
+    """Reconstruct the Python value at a location. Raises if it is an error object."""
+    kind = loc[0]
+    if kind == "inline":
+        _, frame, is_error = loc
+        value = serialization.loads(frame)
+    elif kind == "shm":
+        _, name, size, is_error = loc
+        seg = _segment_cache.open(name)
+        value = serialization.deserialize_frame(memoryview(seg.buf)[:size])
+    else:
+        raise ValueError(f"unknown location kind {kind!r}")
+    if is_error:
+        raise value
+    return value
+
+
+class ObjectStore:
+    """Node-side coordinator: object directory, pending waits, refcounts, eviction."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._locations: Dict[ObjectID, Location] = {}
+        self._events: Dict[ObjectID, threading.Event] = {}
+        self._refcounts: Dict[ObjectID, int] = {}
+        self._failed: Dict[ObjectID, Exception] = {}
+
+    # -- directory -----------------------------------------------------------------
+    def add(self, oid: ObjectID, loc: Location) -> None:
+        with self._lock:
+            self._locations[oid] = loc
+            ev = self._events.pop(oid, None)
+        if ev is not None:
+            ev.set()
+
+    def mark_failed(self, oid: ObjectID, err: Exception) -> None:
+        with self._lock:
+            self._failed[oid] = err
+            ev = self._events.pop(oid, None)
+        if ev is not None:
+            ev.set()
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._locations or oid in self._failed
+
+    def location(self, oid: ObjectID, timeout: Optional[float] = None) -> Location:
+        """Block until oid is available and return its location."""
+        with self._lock:
+            loc = self._locations.get(oid)
+            if loc is not None:
+                return loc
+            if oid in self._failed:
+                raise self._failed[oid]
+            ev = self._events.get(oid)
+            if ev is None:
+                ev = threading.Event()
+                self._events[oid] = ev
+        if not ev.wait(timeout):
+            raise TimeoutError(f"timed out waiting for {oid!r}")
+        with self._lock:
+            if oid in self._failed:
+                raise self._failed[oid]
+            return self._locations[oid]
+
+    def try_location(self, oid: ObjectID) -> Optional[Location]:
+        with self._lock:
+            if oid in self._failed:
+                raise self._failed[oid]
+            return self._locations.get(oid)
+
+    def wait(self, oids: List[ObjectID], num_returns: int, timeout: Optional[float]):
+        """ray.wait semantics: first num_returns ready (by input order), rest not-ready."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectID] = []
+        pending = list(oids)
+        while True:
+            still_pending = []
+            for oid in pending:
+                with self._lock:
+                    done = oid in self._locations or oid in self._failed
+                if done:
+                    ready.append(oid)
+                else:
+                    still_pending.append(oid)
+            pending = still_pending
+            if len(ready) >= num_returns or not pending:
+                return ready, pending
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready, pending
+            time.sleep(0.001)
+
+    # -- lifetime ------------------------------------------------------------------
+    def incref(self, oid: ObjectID, n: int = 1) -> None:
+        with self._lock:
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + n
+
+    def decref(self, oid: ObjectID, n: int = 1) -> None:
+        free = False
+        with self._lock:
+            c = self._refcounts.get(oid, 0) - n
+            if c <= 0:
+                self._refcounts.pop(oid, None)
+                free = True
+            else:
+                self._refcounts[oid] = c
+        if free:
+            self._free(oid)
+
+    def _free(self, oid: ObjectID) -> None:
+        with self._lock:
+            loc = self._locations.pop(oid, None)
+            self._failed.pop(oid, None)
+        if loc is not None and loc[0] == "shm":
+            name = loc[1]
+            _segment_cache.drop(name)
+            try:
+                seg = shared_memory.SharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+            except Exception:
+                pass
+
+    def free_all(self) -> None:
+        with self._lock:
+            oids = list(self._locations.keys())
+        for oid in oids:
+            self._free(oid)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            shm_bytes = sum(l[2] for l in self._locations.values() if l[0] == "shm")
+            inline_bytes = sum(len(l[1]) for l in self._locations.values() if l[0] == "inline")
+            return {
+                "num_objects": len(self._locations),
+                "shm_bytes": shm_bytes,
+                "inline_bytes": inline_bytes,
+            }

@@ -1,0 +1,259 @@
+"""Placement groups: atomic gang reservation of resource bundles across nodes.
+
+Capability parity: reference python/ray/util/placement_group.py (PlacementGroup:42,
+PACK/SPREAD/STRICT_PACK/STRICT_SPREAD) and the GCS 2-phase-commit scheduler
+(gcs_placement_group_scheduler.h PrepareResources:381 / CommitBundleResources:458).
+In-process deployment does prepare (try_acquire on every bundle, with rollback on any
+failure) then commit (record bundle sub-ledgers) under one scheduler pass — the same
+all-or-nothing semantics without the cross-daemon RPC.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .exceptions import PlacementGroupError
+from .ids import NodeID, PlacementGroupID
+from .resources import ResourceLedger
+
+
+@dataclass
+class Bundle:
+    index: int
+    resources: Dict[str, float]
+    node_id: Optional[NodeID] = None
+    ledger: Optional[ResourceLedger] = None  # tracks use *within* the reservation
+
+
+class PlacementGroup:
+    """User handle. Compare reference PlacementGroup (placement_group.py:42)."""
+
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]], strategy: str, name: str = ""):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self.name = name
+        self._ready_event = threading.Event()
+        self._failed: Optional[str] = None
+
+    def ready(self):
+        """Returns an ObjectRef resolving when the group is placed (reference API shape)."""
+        from . import global_state
+
+        return global_state.worker().pg_ready_ref(self)
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        poll = getattr(self, "_remote_poll", None)
+        if poll is not None:
+            # Worker-side replica handle: poll the node service.
+            import time as _time
+
+            deadline = None if timeout_seconds is None else _time.monotonic() + timeout_seconds
+            while True:
+                data = poll(self.id)
+                if data is not None and data[3]:  # is_ready
+                    if data[4]:
+                        raise PlacementGroupError(data[4])
+                    self._ready_event.set()
+                    return True
+                if deadline is not None and _time.monotonic() >= deadline:
+                    return False
+                _time.sleep(0.02)
+        ok = self._ready_event.wait(timeout_seconds)
+        if ok and self._failed:
+            raise PlacementGroupError(self._failed)
+        return ok
+
+    @property
+    def is_ready(self) -> bool:
+        poll = getattr(self, "_remote_poll", None)
+        if poll is not None:
+            data = poll(self.id)
+            return bool(data is not None and data[3] and not data[4])
+        return self._ready_event.is_set() and not self._failed
+
+    def __reduce__(self):
+        # Serialized handles carry only the id; receivers look up the live group.
+        return (_restore_pg, (self.id,))
+
+
+def _restore_pg(pg_id):
+    from . import global_state
+
+    cluster = global_state.try_cluster()
+    if cluster is not None:
+        live = cluster.pg_manager.lookup(pg_id)
+        if live is not None:
+            return live
+        with cluster._lock:
+            for p in cluster.pending_pgs:
+                if p.id == pg_id:
+                    return p
+    pg = PlacementGroup.__new__(PlacementGroup)
+    pg.id = pg_id
+    pg.bundle_specs = []
+    pg.strategy = "PACK"
+    pg.name = ""
+    pg._ready_event = threading.Event()
+    pg._failed = None
+    w = global_state.try_worker()
+    if w is not None and cluster is None:
+        # Worker process: hydrate from the node service and poll through it.
+        data = w.lookup_placement_group(pg_id)
+        if data is not None:
+            pg.bundle_specs, pg.strategy, pg.name = data[0], data[1], data[2]
+            if data[3] and not data[4]:
+                pg._ready_event.set()
+        pg._remote_poll = lambda pid: w.lookup_placement_group(pid)
+    return pg
+
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroupManager:
+    """Places bundles on nodes atomically; owns committed reservations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: Dict[PlacementGroupID, Tuple[PlacementGroup, List[Bundle]]] = {}
+
+    def lookup(self, pg_id: PlacementGroupID) -> Optional[PlacementGroup]:
+        with self._lock:
+            entry = self._groups.get(pg_id)
+            return entry[0] if entry else None
+
+    def bundles(self, pg_id: PlacementGroupID) -> List[Bundle]:
+        with self._lock:
+            entry = self._groups.get(pg_id)
+            return entry[1] if entry else []
+
+    def try_place(
+        self,
+        pg: PlacementGroup,
+        nodes: List[Tuple[NodeID, ResourceLedger]],
+    ) -> bool:
+        """Prepare+commit: reserve every bundle or nothing. Returns False if infeasible now."""
+        strategy = pg.strategy
+        placement = self._plan(pg.bundle_specs, strategy, nodes)
+        if placement is None:
+            return False
+        # Prepare: acquire all, rollback on any failure (concurrent acquirers may race us).
+        acquired: List[Tuple[ResourceLedger, Dict[str, float]]] = []
+        ok = True
+        for (node_id, ledger), spec in zip(placement, pg.bundle_specs):
+            if ledger.try_acquire(spec):
+                acquired.append((ledger, spec))
+            else:
+                ok = False
+                break
+        if not ok:
+            for ledger, spec in acquired:
+                ledger.release(spec)
+            return False
+        # Commit.
+        bundles = []
+        for i, ((node_id, _ledger), spec) in enumerate(zip(placement, pg.bundle_specs)):
+            bundles.append(
+                Bundle(index=i, resources=spec, node_id=node_id, ledger=ResourceLedger(spec))
+            )
+        with self._lock:
+            self._groups[pg.id] = (pg, bundles)
+        pg._ready_event.set()
+        return True
+
+    def _plan(
+        self,
+        specs: List[Dict[str, float]],
+        strategy: str,
+        nodes: List[Tuple[NodeID, ResourceLedger]],
+    ) -> Optional[List[Tuple[NodeID, ResourceLedger]]]:
+        """Choose a node per bundle honoring the strategy, against current availability."""
+        if not nodes:
+            return None
+        # Work against a snapshot of availability so multi-bundle fits are planned coherently.
+        avail = {nid: dict(ledger.available()) for nid, ledger in nodes}
+
+        def fits(nid, spec):
+            a = avail[nid]
+            return all(a.get(k, 0.0) + 1e-9 >= v for k, v in spec.items() if v > 1e-9)
+
+        def take(nid, spec):
+            a = avail[nid]
+            for k, v in spec.items():
+                if v > 1e-9:
+                    a[k] = a.get(k, 0.0) - v
+
+        by_id = dict(nodes)
+        plan: List[Tuple[NodeID, ResourceLedger]] = []
+
+        if strategy in ("PACK", "STRICT_PACK"):
+            # Try to land everything on one node first.
+            for nid, ledger in nodes:
+                snapshot = dict(avail[nid])
+                if all(self._fits_seq(snapshot, specs)):
+                    return [(nid, ledger)] * len(specs)
+            if strategy == "STRICT_PACK":
+                return None
+            # PACK falls back to best-effort greedy.
+            for spec in specs:
+                placed = False
+                for nid, ledger in nodes:
+                    if fits(nid, spec):
+                        take(nid, spec)
+                        plan.append((nid, ledger))
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return plan
+
+        if strategy in ("SPREAD", "STRICT_SPREAD"):
+            used_nodes = set()
+            for spec in specs:
+                placed = False
+                # Prefer nodes not already used by this group.
+                ordered = sorted(nodes, key=lambda nl: (nl[0] in used_nodes,))
+                for nid, ledger in ordered:
+                    if strategy == "STRICT_SPREAD" and nid in used_nodes:
+                        continue
+                    if fits(nid, spec):
+                        take(nid, spec)
+                        used_nodes.add(nid)
+                        plan.append((nid, ledger))
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return plan
+
+        raise PlacementGroupError(f"unknown strategy {strategy!r}")
+
+    @staticmethod
+    def _fits_seq(avail: Dict[str, float], specs: List[Dict[str, float]]):
+        for spec in specs:
+            ok = all(avail.get(k, 0.0) + 1e-9 >= v for k, v in spec.items() if v > 1e-9)
+            yield ok
+            if not ok:
+                return
+            for k, v in spec.items():
+                if v > 1e-9:
+                    avail[k] = avail.get(k, 0.0) - v
+
+    def remove(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            entry = self._groups.pop(pg_id, None)
+        if entry is None:
+            return
+        _pg, bundles = entry
+        # Return reserved capacity to the owning node ledgers.
+        from . import global_state
+
+        cluster = global_state.try_cluster()
+        if cluster is None:
+            return
+        for b in bundles:
+            node = cluster.get_node_runtime(b.node_id)
+            if node is not None:
+                node.ledger.release(b.resources)

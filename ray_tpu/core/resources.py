@@ -1,0 +1,115 @@
+"""Per-node resource ledger with atomic acquire/release.
+
+Capability parity: reference LocalResourceManager / ClusterResourceManager
+(src/ray/raylet/scheduling/). Resources are float-valued named capacities
+(CPU, TPU, memory, custom); TPU pod-slice head resources ("TPU-v5e-8-head")
+follow the reference's accelerator-manager convention (python/ray/_private/
+accelerators/tpu.py:376).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+EPS = 1e-9
+
+
+class ResourceLedger:
+    def __init__(self, total: Dict[str, float]):
+        self._lock = threading.Lock()
+        self.total = dict(total)
+        self._available = dict(total)
+
+    def available(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._available)
+
+    def can_fit(self, request: Dict[str, float]) -> bool:
+        with self._lock:
+            return self._can_fit_locked(request)
+
+    def _can_fit_locked(self, request: Dict[str, float]) -> bool:
+        for k, v in request.items():
+            if v <= EPS:
+                continue
+            if self._available.get(k, 0.0) + EPS < v:
+                return False
+        return True
+
+    def feasible(self, request: Dict[str, float]) -> bool:
+        """Could this request EVER fit on this node (against total, not available)?"""
+        with self._lock:
+            for k, v in request.items():
+                if v <= EPS:
+                    continue
+                if self.total.get(k, 0.0) + EPS < v:
+                    return False
+            return True
+
+    def try_acquire(self, request: Dict[str, float]) -> bool:
+        with self._lock:
+            if not self._can_fit_locked(request):
+                return False
+            for k, v in request.items():
+                if v > EPS:
+                    self._available[k] = self._available.get(k, 0.0) - v
+            return True
+
+    def release(self, request: Dict[str, float]) -> None:
+        with self._lock:
+            for k, v in request.items():
+                if v > EPS:
+                    self._available[k] = min(
+                        self.total.get(k, 0.0), self._available.get(k, 0.0) + v
+                    )
+
+    def force_acquire(self, request: Dict[str, float]) -> None:
+        """Acquire allowing temporary oversubscription (worker resuming from a block)."""
+        with self._lock:
+            for k, v in request.items():
+                if v > EPS:
+                    self._available[k] = self._available.get(k, 0.0) - v
+
+    def add_capacity(self, extra: Dict[str, float]) -> None:
+        with self._lock:
+            for k, v in extra.items():
+                self.total[k] = self.total.get(k, 0.0) + v
+                self._available[k] = self._available.get(k, 0.0) + v
+
+    def remove_capacity(self, sub: Dict[str, float]) -> None:
+        with self._lock:
+            for k, v in sub.items():
+                self.total[k] = max(0.0, self.total.get(k, 0.0) - v)
+                self._available[k] = self._available.get(k, 0.0) - v
+
+    def utilization(self) -> float:
+        with self._lock:
+            used = 0.0
+            cap = 0.0
+            for k, t in self.total.items():
+                if t <= EPS:
+                    continue
+                used += t - self._available.get(k, 0.0)
+                cap += t
+            return used / cap if cap > EPS else 0.0
+
+
+def normalize_resources(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    memory: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if num_cpus is not None:
+        out["CPU"] = float(num_cpus)
+    if num_tpus is not None:
+        out["TPU"] = float(num_tpus)
+    if memory is not None:
+        out["memory"] = float(memory)
+    if resources:
+        for k, v in resources.items():
+            if k in ("CPU", "TPU", "memory") and k in out:
+                raise ValueError(f"duplicate resource {k}")
+            out[k] = float(v)
+    return out

@@ -1,0 +1,33 @@
+"""Runtime context introspection (reference: python/ray/runtime_context.py)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from . import global_state
+
+
+@dataclass
+class RuntimeContext:
+    node_id: str
+    worker_id: str
+    task_id: Optional[str]
+    actor_id: Optional[str]
+    accel: str
+
+    def get_node_id(self) -> str:
+        return self.node_id
+
+    def get_task_id(self) -> Optional[str]:
+        return self.task_id
+
+    def get_actor_id(self) -> Optional[str]:
+        return self.actor_id
+
+    def get_worker_id(self) -> str:
+        return self.worker_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    info = global_state.worker().runtime_context()
+    return RuntimeContext(**info)

@@ -1,0 +1,107 @@
+"""Serialization with zero-copy out-of-band buffers.
+
+Capability parity: reference python/ray/_private/serialization.py + vendored cloudpickle.
+Uses pickle protocol 5: large contiguous buffers (numpy arrays, jax host arrays, bytes)
+are extracted out-of-band so they can be placed in shared memory and mapped zero-copy by
+readers instead of being copied through the pickle stream.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass
+from typing import Any, List, Sequence
+
+import cloudpickle
+
+# Buffers smaller than this stay inline in the pickle stream (header overhead not worth it).
+_OOB_THRESHOLD = 1 << 16
+
+
+@dataclass
+class SerializedObject:
+    """A pickled object split into metadata stream + raw out-of-band buffers."""
+
+    meta: bytes
+    buffers: List[pickle.PickleBuffer]
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self.meta) + sum(b.raw().nbytes for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten into one contiguous frame: [n][meta_len][meta][buf_len buf]*."""
+        out = io.BytesIO()
+        nbufs = len(self.buffers)
+        out.write(nbufs.to_bytes(4, "little"))
+        out.write(len(self.meta).to_bytes(8, "little"))
+        out.write(self.meta)
+        for b in self.buffers:
+            raw = b.raw()
+            out.write(raw.nbytes.to_bytes(8, "little"))
+            out.write(raw)
+        return out.getvalue()
+
+    def write_into(self, mv: memoryview) -> None:
+        """Write the flattened frame into a preallocated buffer (e.g. shared memory)."""
+        off = 0
+        nbufs = len(self.buffers)
+        mv[off : off + 4] = nbufs.to_bytes(4, "little")
+        off += 4
+        mv[off : off + 8] = len(self.meta).to_bytes(8, "little")
+        off += 8
+        mv[off : off + len(self.meta)] = self.meta
+        off += len(self.meta)
+        for b in self.buffers:
+            raw = b.raw().cast("B")
+            mv[off : off + 8] = raw.nbytes.to_bytes(8, "little")
+            off += 8
+            mv[off : off + raw.nbytes] = raw
+            off += raw.nbytes
+
+    @property
+    def frame_bytes(self) -> int:
+        return 4 + 8 + len(self.meta) + sum(8 + b.raw().nbytes for b in self.buffers)
+
+
+def serialize(obj: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+
+    def callback(buf: pickle.PickleBuffer) -> bool:
+        if buf.raw().nbytes >= _OOB_THRESHOLD:
+            buffers.append(buf)
+            return False  # out-of-band
+        return True  # keep inline
+
+    meta = cloudpickle.dumps(obj, protocol=5, buffer_callback=callback)
+    return SerializedObject(meta=meta, buffers=buffers)
+
+
+def deserialize(meta: bytes, buffers: Sequence[Any]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def deserialize_frame(mv: memoryview) -> Any:
+    """Inverse of SerializedObject.to_bytes/write_into. Buffers are zero-copy views of mv."""
+    off = 0
+    nbufs = int.from_bytes(mv[off : off + 4], "little")
+    off += 4
+    meta_len = int.from_bytes(mv[off : off + 8], "little")
+    off += 8
+    meta = bytes(mv[off : off + meta_len])
+    off += meta_len
+    buffers = []
+    for _ in range(nbufs):
+        blen = int.from_bytes(mv[off : off + 8], "little")
+        off += 8
+        buffers.append(mv[off : off + blen])
+        off += blen
+    return deserialize(meta, buffers)
+
+
+def dumps(obj: Any) -> bytes:
+    return serialize(obj).to_bytes()
+
+
+def loads(data: bytes) -> Any:
+    return deserialize_frame(memoryview(data))

@@ -1,0 +1,164 @@
+"""RemoteFunction: the @ray_tpu.remote task API.
+
+Capability parity: reference python/ray/remote_function.py (RemoteFunction:41, _remote:308).
+Functions are cloudpickled once, registered in the cluster function table keyed by content
+hash, and referenced by id afterwards (reference: function_manager.py export via GCS KV).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from . import global_state
+from .ids import ObjectID, TaskID
+from .object_ref import ObjectRef
+from .object_store import INLINE_THRESHOLD
+from .task_spec import TaskSpec, _RefMarker
+
+_DEFAULT_TASK_OPTIONS = dict(
+    num_cpus=1.0,
+    num_tpus=0.0,
+    resources=None,
+    num_returns=1,
+    max_retries=3,
+    retry_exceptions=False,
+    scheduling_strategy="DEFAULT",
+    name=None,
+    runtime_env=None,
+)
+
+
+def compute_fn_id(fn_bytes: bytes) -> bytes:
+    return hashlib.sha256(fn_bytes).digest()[:16]
+
+
+def build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    return res
+
+
+def encode_args(ctx, args, kwargs):
+    """Split top-level ObjectRef args out for pre-dispatch resolution; auto-put large args.
+
+    Returns (meta, arg_refs, pins). `pins` are owned refs created by auto-put; the caller
+    MUST keep them alive until ctx.submit() has pinned the args in the task manager,
+    otherwise their __del__ frees the object before dispatch.
+    """
+    arg_refs = []
+    pins = []
+
+    def enc(a):
+        if isinstance(a, ObjectRef):
+            m = _RefMarker(len(arg_refs))
+            arg_refs.append(a.id)
+            return m
+        return a
+
+    proc_args = [enc(a) for a in args]
+    proc_kwargs = {k: enc(v) for k, v in kwargs.items()}
+    meta = cloudpickle.dumps((proc_args, proc_kwargs), protocol=5)
+    if len(meta) > INLINE_THRESHOLD:
+        # Move every non-trivial argument through the object store (zero-copy shm)
+        # instead of copying it through the control pipe with every dispatch.
+        def enc_big(a):
+            if isinstance(a, _RefMarker):
+                return a
+            if _rough_size(a) > 4096:
+                ref = ctx.put(a)
+                pins.append(ref)
+                m = _RefMarker(len(arg_refs))
+                arg_refs.append(ref.id)
+                return m
+            return a
+
+        proc_args = [enc_big(a) for a in proc_args]
+        proc_kwargs = {k: enc_big(v) for k, v in proc_kwargs.items()}
+        meta = cloudpickle.dumps((proc_args, proc_kwargs), protocol=5)
+    return meta, arg_refs, pins
+
+
+def _rough_size(a) -> int:
+    try:
+        import numpy as np
+
+        if isinstance(a, np.ndarray):
+            return a.nbytes
+    except Exception:
+        pass
+    try:
+        return len(a)
+    except TypeError:
+        return 0
+
+
+_registered_fns: set = set()
+
+
+def register_function(ctx, fn_id: bytes, fn_bytes: bytes) -> None:
+    key = (id(ctx), fn_id)
+    if key not in _registered_fns:
+        ctx.register_fn(fn_id, fn_bytes)
+        _registered_fns.add(key)
+
+
+class RemoteFunction:
+    def __init__(self, fn, **options):
+        self._fn = fn
+        self._options = {**_DEFAULT_TASK_OPTIONS, **options}
+        self._fn_bytes: Optional[bytes] = None
+        self._fn_id: Optional[bytes] = None
+        self.__name__ = getattr(fn, "__name__", "anonymous")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def _ensure_pickled(self):
+        if self._fn_bytes is None:
+            self._fn_bytes = cloudpickle.dumps(self._fn)
+            self._fn_id = compute_fn_id(self._fn_bytes)
+        return self._fn_id, self._fn_bytes
+
+    def options(self, **options) -> "RemoteFunction":
+        rf = RemoteFunction(self._fn, **{**self._options, **options})
+        rf._fn_bytes = self._fn_bytes
+        rf._fn_id = self._fn_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, opts):
+        ctx = global_state.worker()
+        fn_id, fn_bytes = self._ensure_pickled()
+        register_function(ctx, fn_id, fn_bytes)
+        meta, arg_refs, pins = encode_args(ctx, args, kwargs)
+        num_returns = opts["num_returns"]
+        spec = TaskSpec(
+            task_id=TaskID.generate(),
+            kind="task",
+            fn_id=fn_id,
+            fn_bytes=None,
+            name=opts.get("name") or self.__name__,
+            args_meta=meta,
+            arg_refs=arg_refs,
+            num_returns=num_returns,
+            return_ids=[ObjectID.generate() for _ in range(num_returns)],
+            resources=build_resources(opts),
+            scheduling_strategy=opts["scheduling_strategy"],
+            max_retries=opts["max_retries"],
+            retry_exceptions=opts["retry_exceptions"],
+            runtime_env=opts.get("runtime_env"),
+        )
+        refs = ctx.submit(spec)
+        del pins  # safe to release: submit() pinned the args
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote()."
+        )

@@ -1,0 +1,68 @@
+"""TaskSpec and scheduling strategies.
+
+Capability parity: reference TaskSpecification (src/ray/common/task/) and
+python/ray/util/scheduling_strategies.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: Any  # PlacementGroup handle
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str  # hex
+    soft: bool = False
+
+
+@dataclass
+class SpreadSchedulingStrategy:
+    pass
+
+
+# "DEFAULT" | "SPREAD" | NodeAffinitySchedulingStrategy | PlacementGroupSchedulingStrategy
+SchedulingStrategyT = Any
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    kind: str  # "task" | "actor_creation" | "actor_method"
+    fn_id: bytes  # content hash of the serialized callable / class
+    fn_bytes: Optional[bytes]  # cloudpickled callable; None if receiver has it cached
+    name: str
+    args_meta: bytes  # cloudpickled (args, kwargs) with top-level refs as _RefMarker
+    arg_refs: List[ObjectID]  # top-level ObjectRef args, resolved before dispatch
+    num_returns: int
+    return_ids: List[ObjectID]
+    resources: Dict[str, float] = field(default_factory=dict)
+    scheduling_strategy: SchedulingStrategyT = "DEFAULT"
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    max_restarts: int = 0  # actor creation only
+    actor_name: Optional[str] = None
+    actor_namespace: str = ""
+    runtime_env: Optional[Dict[str, Any]] = None
+    # Filled by the scheduler:
+    node_id: Optional[NodeID] = None
+    pg_id: Optional[PlacementGroupID] = None
+    pg_bundle_index: int = -1
+    attempt: int = 0
+
+
+@dataclass
+class _RefMarker:
+    """Placeholder inside args_meta for a top-level ObjectRef argument."""
+
+    index: int

@@ -1,0 +1,284 @@
+"""Worker process: task-execution loop + upcall channel back to the node service.
+
+Capability parity: reference CoreWorker task execution loop
+(src/ray/core_worker/core_worker.cc ExecuteTask:3298, _raylet.pyx task_execution_handler:2318)
+and python/ray/_private/workers/default_worker.py. One process per worker; a duplex pipe to
+the node service carries task dispatch downstream and submissions/gets/puts upstream, so
+nested tasks and ray_tpu.get() inside tasks work exactly like the reference.
+
+Accelerator isolation: workers are spawned with an `accel` tag. "cpu" workers set
+JAX_PLATFORMS=cpu before anything imports jax so they never grab the TPU chip; "tpu"
+workers leave platform selection alone (they own the chip while scheduled, enforced by the
+TPU resource ledger — reference analog: TPU_VISIBLE_CHIPS in accelerators/tpu.py:118).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from . import global_state, object_store, serialization
+from .exceptions import TaskError
+from .ids import ActorID, ObjectID, TaskID, WorkerID
+from .object_ref import ObjectRef
+from .task_spec import TaskSpec, _RefMarker
+
+
+class WorkerContext:
+    """The worker-side implementation of the runtime API (get/put/submit/...)."""
+
+    def __init__(self, conn, node_id_hex: str, worker_id_hex: str, accel: str):
+        self.conn = conn
+        self.node_id_hex = node_id_hex
+        self.worker_id_hex = worker_id_hex
+        self.accel = accel
+        self._req_counter = 0
+        self._pending_tasks: deque = deque()
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._registered_fns: set = set()
+        self._send_lock = threading.Lock()
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self.current_task_id: Optional[TaskID] = None
+        self._exit = False
+
+    # -- transport -----------------------------------------------------------------
+    def _send(self, msg) -> None:
+        with self._send_lock:
+            self.conn.send_bytes(cloudpickle.dumps(msg))
+
+    def _recv(self):
+        return cloudpickle.loads(self.conn.recv_bytes())
+
+    def _next_req_id(self) -> int:
+        self._req_counter += 1
+        return self._req_counter
+
+    def _request(self, msg_type: str, *payload):
+        """Send an upcall and block for its reply, buffering unrelated inbound messages."""
+        req_id = self._next_req_id()
+        self._send((msg_type, req_id) + payload)
+        while True:
+            msg = self._recv()
+            kind = msg[0]
+            if kind == "reply" and msg[1] == req_id:
+                ok, value = msg[2], msg[3]
+                if not ok:
+                    raise value
+                return value
+            elif kind == "task":
+                self._pending_tasks.append(msg)
+            elif kind == "free":
+                object_store._segment_cache.drop(msg[1])
+            elif kind == "exit":
+                self._exit = True
+                # Still need our reply; keep draining.
+            else:
+                # Unmatched replies (cancelled requests) are dropped.
+                pass
+
+    # -- runtime API (mirrors DriverContext) ----------------------------------------
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._send(("submit", spec))
+        return [ObjectRef(oid, owned=True) for oid in spec.return_ids]
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        oids = [r.id for r in ref_list]
+        locs = self._request("get", oids, timeout)
+        values = [object_store.resolve(loc) for loc in locs]
+        return values[0] if single else values
+
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.generate()
+        loc = object_store.materialize(value, oid)
+        self._send(("put", oid, loc))
+        return ObjectRef(oid, owned=True)
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        oids = [r.id for r in refs]
+        ready_ids, pending_ids = self._request("wait", oids, num_returns, timeout)
+        by_id = {r.id: r for r in refs}
+        return [by_id[i] for i in ready_ids], [by_id[i] for i in pending_ids]
+
+    def decref(self, oid: ObjectID) -> None:
+        try:
+            self._send(("decref", oid))
+        except Exception:
+            pass
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
+        self._send(("kill_actor", actor_id, no_restart, from_gc))
+
+    def cancel(self, oid: ObjectID, force: bool = False) -> None:
+        self._send(("cancel", oid, force))
+
+    def get_named_actor(self, name: str, namespace: str):
+        return self._request("get_named_actor", name, namespace)
+
+    def register_fn(self, fn_id: bytes, fn_bytes: bytes) -> None:
+        if fn_id not in self._registered_fns:
+            self._send(("register_fn", fn_id, fn_bytes))
+            self._registered_fns.add(fn_id)
+
+    def fn_known(self, fn_id: bytes) -> bool:
+        return fn_id in self._fn_cache or fn_id in self._registered_fns
+
+    def lookup_placement_group(self, pg_id):
+        return self._request("lookup_pg", pg_id)
+
+    def pg_ready_ref(self, pg):
+        # Blocks until placed, then returns a trivially-ready ref; callers always
+        # ray_tpu.get() the result of pg.ready() so the semantics match.
+        self._request("pg_ready_ref", pg.id)
+        return self.put(True)
+
+    def create_placement_group(self, bundles, strategy, name):
+        return self._request("create_pg", bundles, strategy, name)
+
+    def remove_placement_group(self, pg_id):
+        self._send(("remove_pg", pg_id))
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def runtime_context(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id_hex,
+            "worker_id": self.worker_id_hex,
+            "task_id": self.current_task_id.hex() if self.current_task_id else None,
+            "actor_id": self.actor_id.hex() if self.actor_id else None,
+            "accel": self.accel,
+        }
+
+    # -- execution -----------------------------------------------------------------
+    def _load_fn(self, spec: TaskSpec):
+        fn = self._fn_cache.get(spec.fn_id)
+        if fn is None:
+            if spec.fn_bytes is None:
+                fn_bytes = self._request("fetch_fn", spec.fn_id)
+            else:
+                fn_bytes = spec.fn_bytes
+            fn = cloudpickle.loads(fn_bytes)
+            self._fn_cache[spec.fn_id] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec, resolved_locs: List) -> Tuple[list, dict]:
+        args, kwargs = cloudpickle.loads(spec.args_meta)
+        values = [object_store.resolve(loc) for loc in resolved_locs]
+
+        def sub(x):
+            return values[x.index] if isinstance(x, _RefMarker) else x
+
+        args = [sub(a) for a in args]
+        kwargs = {k: sub(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def execute(self, spec: TaskSpec, resolved_locs: List) -> None:
+        self.current_task_id = spec.task_id
+        try:
+            args, kwargs = self._resolve_args(spec, resolved_locs)
+            if spec.kind == "actor_creation":
+                cls = self._load_fn(spec)
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_id = spec.actor_id
+                results = [None]
+            elif spec.kind == "actor_method":
+                method = getattr(self.actor_instance, spec.method_name)
+                out = method(*args, **kwargs)
+                results = self._split_returns(out, spec.num_returns)
+            else:
+                fn = self._load_fn(spec)
+                out = fn(*args, **kwargs)
+                results = self._split_returns(out, spec.num_returns)
+            payload = []
+            for oid, value in zip(spec.return_ids, results):
+                payload.append((oid, object_store.materialize(value, oid)))
+            self._send(("result", spec.task_id, payload, None))
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            err = TaskError(e, task_desc=spec.name, tb_str=tb)
+            try:
+                payload = [
+                    (oid, object_store.materialize(err, oid, is_error=True))
+                    for oid in spec.return_ids
+                ]
+                self._send(("result", spec.task_id, payload, (spec.name, tb, type(e).__name__)))
+            except Exception:
+                # Even the error failed to serialize; report a plain failure.
+                err2 = TaskError(RuntimeError(f"unserializable error: {tb}"), spec.name)
+                payload = [
+                    (oid, object_store.materialize(err2, oid, is_error=True))
+                    for oid in spec.return_ids
+                ]
+                self._send(("result", spec.task_id, payload, (spec.name, tb, type(e).__name__)))
+        finally:
+            self.current_task_id = None
+
+    @staticmethod
+    def _split_returns(out, num_returns: int):
+        if num_returns == 1:
+            return [out]
+        out_t = tuple(out)
+        if len(out_t) != num_returns:
+            raise ValueError(f"expected {num_returns} return values, got {len(out_t)}")
+        return list(out_t)
+
+    # -- main loop -------------------------------------------------------------------
+    def main_loop(self) -> None:
+        self._send(("ready", self.worker_id_hex))
+        while not self._exit:
+            if self._pending_tasks:
+                msg = self._pending_tasks.popleft()
+            else:
+                try:
+                    msg = self._recv()
+                except (EOFError, OSError):
+                    break
+            kind = msg[0]
+            if kind == "task":
+                _, spec, resolved_locs = msg
+                self.execute(spec, resolved_locs)
+            elif kind == "free":
+                object_store._segment_cache.drop(msg[1])
+            elif kind == "exit":
+                break
+            # Stray replies from cancelled requests are ignored.
+
+
+def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dict[str, str]):
+    """Entry point of a spawned worker process."""
+    for k, v in env.items():
+        os.environ[k] = v
+    if accel == "cpu":
+        # Never let a CPU worker initialize the TPU runtime.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ctx = WorkerContext(conn, node_id_hex, worker_id_hex, accel)
+    global_state.set_worker(ctx)
+    try:
+        ctx.main_loop()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+        sys.exit(0)

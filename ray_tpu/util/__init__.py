@@ -1,0 +1,5 @@
+from .placement_group_api import (  # noqa: F401
+    placement_group,
+    remove_placement_group,
+    placement_group_table,
+)

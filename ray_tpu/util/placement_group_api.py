@@ -1,0 +1,69 @@
+"""Placement group public API (reference: python/ray/util/placement_group.py)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core import global_state
+from ..core.placement_group import VALID_STRATEGIES, PlacementGroup
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for b in bundles:
+        if not b or all(v <= 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b!r}")
+    cluster = global_state.try_cluster()
+    if cluster is not None:
+        return cluster.create_placement_group([dict(b) for b in bundles], strategy, name)
+    # Worker process: create via upcall, then fetch a local replica handle.
+    ctx = global_state.worker()
+    pg_id = ctx.create_placement_group([dict(b) for b in bundles], strategy, name)
+    import threading
+
+    pg = PlacementGroup.__new__(PlacementGroup)
+    pg.id = pg_id
+    pg.bundle_specs = [dict(b) for b in bundles]
+    pg.strategy = strategy
+    pg.name = name
+    pg._ready_event = threading.Event()
+    pg._failed = None
+    pg._remote_poll = lambda pid: ctx.lookup_placement_group(pid)
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    ctx = global_state.worker()
+    ctx.remove_placement_group(pg.id)
+
+
+def placement_group_table() -> Dict[str, dict]:
+    cluster = global_state.try_cluster()
+    if cluster is None:
+        return {}
+    out = {}
+    with cluster.pg_manager._lock:
+        for pg_id, (pg, bundles) in cluster.pg_manager._groups.items():
+            out[pg_id.hex()] = {
+                "name": pg.name,
+                "strategy": pg.strategy,
+                "bundles": {b.index: b.resources for b in bundles},
+                "node_ids": {b.index: b.node_id.hex() for b in bundles},
+                "state": "CREATED",
+            }
+    for pg in cluster.pending_pgs:
+        out[pg.id.hex()] = {
+            "name": pg.name,
+            "strategy": pg.strategy,
+            "bundles": dict(enumerate(pg.bundle_specs)),
+            "node_ids": {},
+            "state": "PENDING",
+        }
+    return out
