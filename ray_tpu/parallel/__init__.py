@@ -1,0 +1,16 @@
+"""Parallelism primitives: device meshes, sharding rules, collective helpers.
+
+TPU-native replacement for the reference's process-group/NCCL plane
+(reference: python/ray/util/collective/collective.py, python/ray/train/torch/config.py):
+instead of bootstrapping NCCL communicators, we describe a `jax.sharding.Mesh` once and
+let XLA insert collectives (psum/all_gather/reduce_scatter/ppermute) over ICI/DCN.
+"""
+from .mesh import MeshSpec, build_mesh, local_mesh, use_mesh  # noqa: F401
+from .sharding import (  # noqa: F401
+    AxisRules,
+    LogicalAxis,
+    logical_to_mesh_axes,
+    named_sharding,
+    shard_pytree,
+    with_sharding_constraint,
+)

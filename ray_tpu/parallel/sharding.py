@@ -1,0 +1,116 @@
+"""Logical-axis sharding rules.
+
+Model code annotates arrays with *logical* axis names ("batch", "embed", "heads", …);
+a single `AxisRules` table maps logical names to mesh axes. Changing the parallelism
+strategy = changing the table, not the model. This is the GSPMD idiom the reference
+delegates to external libraries (FSDP/DeepSpeed — SURVEY.md §2.3) but is native here.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+LogicalAxis = Optional[str]
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+class AxisRules:
+    """Mapping logical axis name → mesh axis (or tuple of mesh axes, or None)."""
+
+    def __init__(self, rules: Dict[str, MeshAxes]):
+        self.rules = dict(rules)
+
+    def __getitem__(self, logical: LogicalAxis) -> MeshAxes:
+        if logical is None:
+            return None
+        return self.rules.get(logical)
+
+    def spec(self, logical_axes: Sequence[LogicalAxis]) -> P:
+        return P(*(self[a] for a in logical_axes))
+
+
+# Default rules for transformer training. Parameter axes ("embed"/"mlp"/"heads"/"vocab")
+# and activation axes ("act_*") are distinct logical names because a PartitionSpec may not
+# reuse a mesh axis: batch → (dp, fsdp) shards activations ZeRO-style while embed → fsdp
+# shards parameters, and the two never appear on the same array.
+TRAIN_RULES = AxisRules(
+    {
+        # parameters
+        "embed": "fsdp",
+        "heads": "tp",
+        "kv_heads": "tp",
+        "head_dim": None,
+        "mlp": "tp",
+        "vocab": "tp",
+        "expert": "ep",
+        "stage": "pp",
+        # activations
+        "batch": ("dp", "fsdp"),
+        "seq": "sp",
+        "act_embed": None,
+        "act_heads": "tp",
+        "act_kv_heads": "tp",
+        "act_mlp": "tp",
+        "act_vocab": "tp",
+    }
+)
+
+# Inference: params replicated across dp, sharded over tp; KV cache sharded over heads
+# (tp) and batch (dp).
+INFER_RULES = AxisRules(
+    {
+        "embed": None,
+        "heads": "tp",
+        "kv_heads": "tp",
+        "head_dim": None,
+        "mlp": "tp",
+        "vocab": "tp",
+        "expert": "ep",
+        "stage": "pp",
+        "batch": "dp",
+        "seq": "sp",
+        "act_embed": None,
+        "act_heads": "tp",
+        "act_kv_heads": "tp",
+        "act_mlp": "tp",
+        "act_vocab": "tp",
+    }
+)
+
+
+def logical_to_mesh_axes(
+    logical_axes: Sequence[LogicalAxis], rules: AxisRules = TRAIN_RULES
+) -> P:
+    return rules.spec(logical_axes)
+
+
+def named_sharding(
+    mesh: Mesh, *logical_axes: LogicalAxis, rules: AxisRules = TRAIN_RULES
+) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(logical_axes))
+
+
+def shard_pytree(tree, axes_tree, mesh: Mesh, rules: AxisRules = TRAIN_RULES):
+    """device_put a pytree according to a parallel tree of logical-axes tuples.
+
+    `axes_tree` mirrors `tree`; each leaf is a tuple of logical axis names (or None)
+    matching the array rank.
+    """
+
+    def _put(x, axes):
+        return jax.device_put(x, named_sharding(mesh, *axes, rules=rules))
+
+    return jax.tree.map(_put, tree, axes_tree, is_leaf=lambda x: x is None)
+
+
+def with_sharding_constraint(x, *logical_axes: LogicalAxis, rules: AxisRules = TRAIN_RULES):
+    """In-jit sharding hint using logical names. No-op outside jit or without a mesh."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()  # jax>=0.4.35 path
+        if mesh is None or mesh.empty:
+            return x
+    except Exception:
+        return x
+    return jax.lax.with_sharding_constraint(x, rules.spec(logical_axes))

@@ -11,6 +11,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
 
+import jax  # noqa: E402
+
+# The environment's sitecustomize may register an accelerator PJRT plugin and force the
+# platform at the jax-config level, which ignores the env var — override after import.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
