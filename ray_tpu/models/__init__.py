@@ -1,0 +1,8 @@
+"""Model zoo: JAX-native transformer families with GSPMD logical-axis sharding.
+
+The reference framework ships no models (it orchestrates torch/vLLM models —
+SURVEY.md §2.7); a TPU-native stack needs its own, so the flagship Llama family
+lives here and Train/Serve/RLlib build on it.
+"""
+from .config import ModelConfig, get_config, register_config  # noqa: F401
+from . import llama  # noqa: F401

@@ -1,0 +1,113 @@
+"""Transformer model configs + registry.
+
+Sizes follow the public Llama-2/-3 architecture descriptions (RMSNorm, RoPE, GQA,
+SwiGLU, untied or tied embeddings).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"  # activation dtype; params kept f32, cast in forward
+    remat: bool = True  # jax.checkpoint each layer (HBM <-> FLOPs trade)
+    scan_layers: bool = True  # stack layer params + lax.scan (fast compile)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def n_params(self) -> int:
+        """Approximate parameter count (embeddings + blocks + norms)."""
+        emb = self.vocab_size * self.d_model * (1 if self.tie_embeddings else 2)
+        attn = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        return emb + self.n_layers * (attn + mlp + norms) + self.d_model
+
+
+_REGISTRY: Dict[str, ModelConfig] = {}
+
+
+def register_config(cfg: ModelConfig) -> ModelConfig:
+    _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    cfg = _REGISTRY[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+register_config(
+    ModelConfig(
+        name="test-tiny",
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=128,
+        dtype="float32",
+        scan_layers=True,
+    )
+)
+register_config(
+    ModelConfig(
+        name="llama3-8b",
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        max_seq_len=8192,
+        rope_theta=500000.0,
+    )
+)
+register_config(
+    ModelConfig(
+        name="llama3-70b",
+        vocab_size=128256,
+        d_model=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        d_ff=28672,
+        max_seq_len=8192,
+    )
+)
+register_config(
+    ModelConfig(
+        name="llama2-7b",
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=32,
+        d_ff=11008,
+        max_seq_len=4096,
+        rope_theta=10000.0,
+    )
+)

@@ -1,0 +1,255 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Pure-JAX (pytree params, no Module framework) so every transform — pjit, scan, remat,
+shard_map — composes without adapters. Architecture: RMSNorm, RoPE (rotate-half / HF
+convention), GQA, SwiGLU. Layers are stacked on a leading axis and iterated with
+`lax.scan` (+ optional `jax.checkpoint`) so compile time is O(1) in depth and XLA tiles
+every matmul onto the MXU with static shapes.
+
+The reference framework has no model code (models come from torch/vLLM; SURVEY.md §2.7);
+this is the flagship model its Train/Serve equivalents here exercise.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import attention
+from ray_tpu.parallel.sharding import with_sharding_constraint as wsc
+
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------- init
+
+def param_axes(cfg: ModelConfig) -> Params:
+    """Logical-axis tree mirroring init() output (leading 'layer' axis when scanned)."""
+    lyr = ("layer",) if cfg.scan_layers else ()
+
+    def L(*axes):
+        return lyr + axes
+
+    layers = {
+        "attn_norm": L("embed"),
+        "wq": L("embed", "heads", "head_dim"),
+        "wk": L("embed", "kv_heads", "head_dim"),
+        "wv": L("embed", "kv_heads", "head_dim"),
+        "wo": L("heads", "head_dim", "embed"),
+        "mlp_norm": L("embed"),
+        "w_gate": L("embed", "mlp"),
+        "w_up": L("embed", "mlp"),
+        "w_down": L("mlp", "embed"),
+    }
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layers if cfg.scan_layers else [dict(layers) for _ in range(cfg.n_layers)],
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Initialize parameters (f32). Scaled-normal init, wo/w_down scaled by depth."""
+    k_emb, k_head, k_layers = jax.random.split(rng, 3)
+    d, hd, nh, nkv, ff = cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.d_ff
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(jnp.float32)
+
+    def layer_init(key):
+        ks = jax.random.split(key, 7)
+        s_in = d**-0.5
+        s_out = (2 * cfg.n_layers * d) ** -0.5
+        return {
+            "attn_norm": jnp.ones((d,), jnp.float32),
+            "wq": norm(ks[0], (d, nh, hd), s_in),
+            "wk": norm(ks[1], (d, nkv, hd), s_in),
+            "wv": norm(ks[2], (d, nkv, hd), s_in),
+            "wo": norm(ks[3], (nh, hd, d), s_out),
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "w_gate": norm(ks[4], (d, ff), s_in),
+            "w_up": norm(ks[5], (d, ff), s_in),
+            "w_down": norm(ks[6], (ff, d), (2 * cfg.n_layers * ff) ** -0.5),
+        }
+
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    if cfg.scan_layers:
+        layers = jax.vmap(layer_init)(layer_keys)
+    else:
+        layers = [layer_init(k) for k in layer_keys]
+
+    params: Params = {
+        "embed": norm(k_emb, (cfg.vocab_size, d), 1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(k_head, (d, cfg.vocab_size), d**-0.5)
+    return params
+
+
+# ------------------------------------------------------------------------- kernels
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps) * scale).astype(dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotate-half RoPE (HF Llama convention). x: [B, S, H, D], positions: [B, S]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# ------------------------------------------------------------------------- forward
+
+class KVCache(NamedTuple):
+    """Stacked-per-layer KV cache for autoregressive decode.
+
+    k/v: [L, B, max_len, n_kv_heads, head_dim]; length: current fill (same per batch
+    row — the paged engine in serve/ handles ragged batches above this level).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # scalar int32
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> KVCache:
+    dtype = dtype or cfg.activation_dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype), length=jnp.zeros((), jnp.int32)
+    )
+
+
+def _block(
+    x: jax.Array,
+    lp: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,
+    segment_ids: Optional[jax.Array],
+    cache_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
+    cache_len: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
+    """One decoder block. Returns (x, updated (k,v) for this layer if caching)."""
+    dt = x.dtype
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
+    q = wsc(rope(q, positions, cfg.rope_theta), "batch", "seq", "act_heads", "head_dim")
+    k = rope(k, positions, cfg.rope_theta)
+
+    new_kv = None
+    if cache_kv is not None:
+        ck, cv = cache_kv
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_len, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_len, axis=1)
+        new_kv = (ck, cv)
+        attn = attention(
+            q, ck, cv, causal=True, q_offset=cache_len, kv_valid_len=cache_len + q.shape[1]
+        )
+    else:
+        attn = attention(q, k, v, causal=True, segment_ids=segment_ids)
+    o = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dt))
+    x = wsc(x + o, "batch", "seq", "act_embed")
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
+    up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
+    ff = wsc(jax.nn.silu(gate) * up, "batch", "seq", "act_mlp")
+    down = jnp.einsum("bsf,fd->bsd", ff, lp["w_down"].astype(dt))
+    return wsc(x + down, "batch", "seq", "act_embed"), new_kv
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    cache: Optional[KVCache] = None,
+) -> Tuple[jax.Array, Optional[KVCache]]:
+    """tokens [B, S] -> (logits [B, S, vocab] f32, updated cache or None)."""
+    b, s = tokens.shape
+    if positions is None:
+        start = cache.length if cache is not None else 0
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :] + start, (b, s))
+    x = params["embed"].astype(cfg.activation_dtype)[tokens]
+    x = wsc(x, "batch", "seq", "act_embed")
+
+    if cfg.scan_layers:
+        if cache is not None:
+
+            def body(carry, xs):
+                h = carry
+                lp, ck, cv = xs
+                h, new_kv = _block(h, lp, cfg, positions, segment_ids, (ck, cv), cache.length)
+                return h, new_kv
+
+            fn = jax.checkpoint(body) if cfg.remat else body
+            x, (nk, nv) = jax.lax.scan(fn, x, (params["layers"], cache.k, cache.v))
+            new_cache = KVCache(k=nk, v=nv, length=cache.length + s)
+        else:
+
+            def body(carry, lp):
+                h, _ = _block(carry, lp, cfg, positions, segment_ids)
+                return h, None
+
+            fn = jax.checkpoint(body) if cfg.remat else body
+            x, _ = jax.lax.scan(fn, x, params["layers"])
+            new_cache = None
+    else:
+        new_cache = None
+        ks, vs = [], []
+        for i, lp in enumerate(params["layers"]):
+            if cache is not None:
+                x, kv = _block(x, lp, cfg, positions, segment_ids, (cache.k[i], cache.v[i]), cache.length)
+                ks.append(kv[0])
+                vs.append(kv[1])
+            else:
+                x, _ = _block(x, lp, cfg, positions, segment_ids)
+        if cache is not None:
+            new_cache = KVCache(jnp.stack(ks), jnp.stack(vs), cache.length + s)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.activation_dtype))
+    logits = wsc(logits.astype(jnp.float32), "batch", "seq", "act_vocab")
+    return logits, new_cache
+
+
+def loss_fn(
+    params: Params,
+    batch: Dict[str, jax.Array],
+    cfg: ModelConfig,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross entropy. batch: tokens [B,S]; optional loss_mask/segment_ids."""
+    tokens = batch["tokens"]
+    logits, _ = forward(
+        params, tokens[:, :-1], cfg, segment_ids=batch.get("segment_ids")
+    )
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    mask = jnp.ones_like(ll) if mask is None else mask[:, 1:].astype(ll.dtype)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = -(ll * mask).sum() / denom
+    return loss, {"loss": loss, "tokens": denom}

@@ -1,0 +1,6 @@
+"""TPU compute kernels: flash attention (Pallas), ring attention, fused ops.
+
+The reference has no kernels of its own (attention lives in vLLM/torch — SURVEY.md §2.3);
+here they are first-class because long-context and MFU targets depend on them.
+"""
+from .attention import attention  # noqa: F401

@@ -1,0 +1,103 @@
+"""Attention entry point with backend dispatch.
+
+`attention()` is the single call sites use; it routes to the Pallas TPU flash kernel
+when running on TPU and to a pure-XLA reference implementation elsewhere (CPU tests,
+debugging). Both accept GQA (n_kv_heads <= n_heads) and causal masking.
+
+Shapes (batch, seq, heads, head_dim) throughout — "BSHD".
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] by head repetition (GQA)."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    q_offset: Optional[jax.Array] = None,
+    kv_valid_len: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Pure-XLA attention. Numerically the ground truth for the Pallas kernel tests.
+
+    q: [B, Sq, H, D]; k/v: [B, Skv, Hkv, D]. Returns [B, Sq, H, D].
+    `segment_ids`: [B, Skv] int array; attention only within equal segments (packing).
+    `q_offset`: kv index of query row 0 (decode-with-cache); default aligns the ends.
+    `kv_valid_len`: kv slots >= this are masked out (padded cache tail).
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    # f32 logits regardless of input dtype: MXU accumulates in f32 on TPU anyway.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    sq, skv = q.shape[1], k.shape[1]
+    kj = jnp.arange(skv)[None, :]
+    if causal:
+        if q_offset is None:
+            q_offset = skv - sq
+        qi = jnp.arange(sq)[:, None] + q_offset
+        logits = jnp.where(kj <= qi, logits, -jnp.inf)
+    if kv_valid_len is not None:
+        logits = jnp.where(kj < kv_valid_len, logits, -jnp.inf)
+    if segment_ids is not None:
+        seg_q = segment_ids[:, -sq:]
+        mask = seg_q[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(mask[:, None, :, :], logits, -jnp.inf)
+    # Rows with no valid kv (fully masked) softmax to NaN; zero them instead.
+    probs = jnp.nan_to_num(jax.nn.softmax(logits, axis=-1))
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    q_offset: Optional[jax.Array] = None,
+    kv_valid_len: Optional[jax.Array] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching attention. impl: auto|pallas|reference.
+
+    The Pallas path currently covers the training shape (no cache offsets, optional
+    segment ids); decode-with-cache shapes use the XLA path, which fuses well anyway.
+    """
+    if impl == "auto":
+        on_tpu = jax.default_backend() not in ("cpu", "gpu")
+        impl = "pallas" if (on_tpu and q_offset is None and kv_valid_len is None) else "reference"
+    if impl == "pallas":
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+    return attention_reference(
+        q,
+        k,
+        v,
+        causal=causal,
+        segment_ids=segment_ids,
+        scale=scale,
+        q_offset=q_offset,
+        kv_valid_len=kv_valid_len,
+    )

@@ -1,0 +1,96 @@
+"""Model correctness tests on CPU (8 virtual devices)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import get_config, llama
+from ray_tpu.parallel import MeshSpec, build_mesh, use_mesh
+from ray_tpu.parallel.sharding import TRAIN_RULES, shard_pytree
+
+CFG = get_config("test-tiny")
+
+
+def _params():
+    return llama.init(jax.random.PRNGKey(0), CFG)
+
+
+def test_forward_shapes():
+    params = _params()
+    tokens = jnp.arange(32, dtype=jnp.int32).reshape(2, 16) % CFG.vocab_size
+    logits, cache = llama.forward(params, tokens, CFG)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert cache is None
+    assert logits.dtype == jnp.float32
+
+
+def test_loss_and_grad_finite():
+    params = _params()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, CFG.vocab_size)
+    (loss, aux), grads = jax.value_and_grad(llama.loss_fn, has_aux=True)(
+        params, {"tokens": tokens}, CFG
+    )
+    assert np.isfinite(float(loss))
+    flat = jax.tree.leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+    assert float(loss) > 0
+
+
+def test_decode_matches_full_forward():
+    """Prefill+decode through KV cache must reproduce the full-sequence logits."""
+    params = _params()
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 12), 0, CFG.vocab_size)
+    full_logits, _ = llama.forward(params, tokens, CFG)
+
+    cache = llama.init_kv_cache(CFG, batch=1, max_len=16, dtype=jnp.float32)
+    prefill_logits, cache = llama.forward(params, tokens[:, :8], CFG, cache=cache)
+    np.testing.assert_allclose(
+        np.asarray(prefill_logits), np.asarray(full_logits[:, :8]), rtol=2e-4, atol=2e-4
+    )
+    # Decode one token at a time.
+    for i in range(8, 12):
+        step_logits, cache = llama.forward(params, tokens[:, i : i + 1], CFG, cache=cache)
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full_logits[:, i]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_segment_ids_isolate_packed_sequences():
+    """Packed sequences must not attend across segment boundaries."""
+    params = _params()
+    a = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0, CFG.vocab_size)
+    b = jax.random.randint(jax.random.PRNGKey(4), (1, 6), 0, CFG.vocab_size)
+    packed = jnp.concatenate([a, b], axis=1)
+    seg = jnp.concatenate([jnp.zeros((1, 6), jnp.int32), jnp.ones((1, 6), jnp.int32)], axis=1)
+    packed_logits, _ = llama.forward(params, packed, CFG, segment_ids=seg)
+    solo_logits, _ = llama.forward(params, a, CFG)
+    # Segment a inside the pack must match running a alone (positions restart not modeled;
+    # use same positions explicitly).
+    np.testing.assert_allclose(
+        np.asarray(packed_logits[:, :6]), np.asarray(solo_logits), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_sharded_train_step():
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    params = _params()
+    axes = llama.param_axes(CFG)
+    params = shard_pytree(params, axes, mesh, TRAIN_RULES)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (8, 17), 0, CFG.vocab_size)
+
+    @jax.jit
+    def step(p, batch):
+        (loss, _), grads = jax.value_and_grad(llama.loss_fn, has_aux=True)(p, batch, CFG)
+        new_p = jax.tree.map(lambda w, g: w - 1e-3 * g, p, grads)
+        return loss, new_p
+
+    with use_mesh(mesh):
+        loss, new_params = step(params, {"tokens": tokens})
+    assert np.isfinite(float(loss))
+    # sgd-updated params keep the parameter shardings (grads get resharded to match)
+    w = new_params["layers"]["w_gate"]
+    assert w.sharding.spec == params["layers"]["w_gate"].sharding.spec
+
+
+def test_n_params_reasonable():
+    cfg8b = get_config("llama3-8b")
+    assert 7.5e9 < cfg8b.n_params < 8.6e9
