@@ -1,0 +1,102 @@
+"""Benchmark: Llama train-step MFU on the available accelerator.
+
+Prints ONE JSON line: {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}.
+Baseline: the north-star target of 40% MFU via the stock Trainer API (BASELINE.json),
+scored here as achieved-MFU / 0.40 on the single-chip flagship-family model.
+
+All diagnostics go to stderr; stdout carries only the JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# TPU bf16 peak FLOP/s per chip by device-kind substring; fallback conservative.
+PEAK_FLOPS = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops_for(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    on_cpu = backend == "cpu"
+    dev = jax.devices()[0]
+    log(f"backend={backend} device={dev.device_kind if hasattr(dev, 'device_kind') else dev}")
+
+    from ray_tpu.models import get_config
+    from ray_tpu.train import init_state, make_optimizer, make_train_step
+
+    model_name = os.environ.get("BENCH_MODEL", "test-tiny" if on_cpu else "llama-500m")
+    batch = int(os.environ.get("BENCH_BATCH", "4" if on_cpu else "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "64" if on_cpu else "2048"))
+    steps = int(os.environ.get("BENCH_STEPS", "3" if on_cpu else "20"))
+
+    cfg = get_config(model_name)
+    log(f"model={model_name} n_params={cfg.n_params/1e9:.3f}B batch={batch} seq={seq}")
+
+    tx = make_optimizer(total_steps=1000)
+    state = init_state(jax.random.PRNGKey(0), cfg, tx)
+    step = make_train_step(cfg, tx)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+    batch_dict = {"tokens": tokens}
+
+    t0 = time.perf_counter()
+    state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics["loss"])
+    log(f"compile+first step: {time.perf_counter() - t0:.1f}s loss={float(metrics['loss']):.3f}")
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+    flops_per_token = 6 * cfg.n_params  # standard fwd+bwd transformer estimate
+    mfu = tokens_per_sec * flops_per_token / peak_flops_for(dev)
+    log(
+        f"step={dt*1e3:.1f}ms tokens/s={tokens_per_sec:,.0f} "
+        f"mfu={mfu:.3f} loss={float(metrics['loss']):.3f}"
+    )
+
+    if on_cpu:
+        # CPU run is a smoke test; MFU vs TPU peak is meaningless there.
+        result = {
+            "metric": "train_step_tokens_per_sec_cpu_smoke",
+            "value": round(tokens_per_sec, 1),
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+        }
+    else:
+        result = {
+            "metric": f"train_mfu_{model_name}_b{batch}_s{seq}",
+            "value": round(mfu, 4),
+            "unit": "mfu_fraction",
+            "vs_baseline": round(mfu / 0.40, 4),
+        }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,34 @@ register_config(
     )
 )
 register_config(
+    # Single-chip bench model (~0.4B): same architecture family as llama3, sized so that
+    # f32 params + Adam state + remat activations fit one v5e chip's 16 GiB HBM.
+    ModelConfig(
+        name="llama-500m",
+        vocab_size=32000,
+        d_model=1536,
+        n_layers=12,
+        n_heads=12,
+        n_kv_heads=6,
+        d_ff=4096,
+        max_seq_len=2048,
+        rope_theta=500000.0,
+    )
+)
+register_config(
+    ModelConfig(
+        name="llama-1b",
+        vocab_size=32000,
+        d_model=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=5632,
+        max_seq_len=2048,
+        rope_theta=500000.0,
+    )
+)
+register_config(
     ModelConfig(
         name="llama3-8b",
         vocab_size=128256,

@@ -1,0 +1,6 @@
+"""ray_tpu.train: Train-API-shaped distributed training on TPU.
+
+Reference capability: python/ray/train/ (SURVEY.md §2.4). The `JaxTrainer` here is the
+north-star API the reference lacks (no JaxTrainer exists upstream — SURVEY.md §2.4 note).
+"""
+from .step import TrainState, init_state, make_optimizer, make_train_step  # noqa: F401

@@ -1,0 +1,80 @@
+"""Canonical jitted train step: loss -> grads -> optax update, GSPMD-sharded.
+
+This is the compute core `JaxTrainer` drives; it is also what `__graft_entry__` and
+`bench.py` exercise. One function builds the whole step so XLA fuses grad + update and
+the optimizer state inherits the parameter shardings (ZeRO-for-free under fsdp).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import ModelConfig, llama
+from ray_tpu.parallel import build_mesh, MeshSpec, use_mesh
+from ray_tpu.parallel.sharding import AxisRules, TRAIN_RULES, named_sharding, shard_pytree
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def make_optimizer(
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+    warmup_steps: int = 100,
+    total_steps: int = 10000,
+) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def init_state(
+    rng: jax.Array,
+    cfg: ModelConfig,
+    tx: optax.GradientTransformation,
+    mesh=None,
+    rules: AxisRules = TRAIN_RULES,
+) -> TrainState:
+    params = llama.init(rng, cfg)
+    if mesh is not None:
+        params = shard_pytree(params, llama.param_axes(cfg), mesh, rules)
+        with use_mesh(mesh):
+            opt_state = jax.jit(tx.init)(params)
+    else:
+        opt_state = tx.init(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    tx: optax.GradientTransformation,
+    loss_fn: Optional[Callable] = None,
+    donate: bool = True,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict[str, jax.Array]]]:
+    loss_fn = loss_fn or llama.loss_fn
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, cfg
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = dict(aux)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return TrainState(state.step + 1, new_params, new_opt), metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
