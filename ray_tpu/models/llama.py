@@ -242,8 +242,9 @@ def loss_fn(
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross entropy. batch: tokens [B,S]; optional loss_mask/segment_ids."""
     tokens = batch["tokens"]
+    seg = batch.get("segment_ids")
     logits, _ = forward(
-        params, tokens[:, :-1], cfg, segment_ids=batch.get("segment_ids")
+        params, tokens[:, :-1], cfg, segment_ids=None if seg is None else seg[:, :-1]
     )
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)

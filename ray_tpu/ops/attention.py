@@ -86,7 +86,14 @@ def attention(
     """
     if impl == "auto":
         on_tpu = jax.default_backend() not in ("cpu", "gpu")
-        impl = "pallas" if (on_tpu and q_offset is None and kv_valid_len is None) else "reference"
+        # The pallas kernel's causal mask assumes query row i is absolute position i,
+        # i.e. Sq == Skv; any offset/partial-window shape takes the XLA path.
+        same_len = q.shape[1] == k.shape[1]
+        impl = (
+            "pallas"
+            if (on_tpu and q_offset is None and kv_valid_len is None and (same_len or not causal))
+            else "reference"
+        )
     if impl == "pallas":
         from .flash_attention import flash_attention
 

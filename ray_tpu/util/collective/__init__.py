@@ -1,0 +1,24 @@
+"""ray_tpu.util.collective — collective communication on actors.
+
+Reference capability: python/ray/util/collective/. See collective.py module docstring for
+the TPU-native backend design.
+"""
+from .collective import (  # noqa: F401
+    CollectiveActorMixin,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    declare_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from .types import Backend, ReduceOp  # noqa: F401

@@ -1,0 +1,97 @@
+"""Group coordinator actor: rendezvous + host-plane collective data exchange.
+
+Reference analogue: the named NCCLUniqueIDStore actor (python/ray/util/collective/util.py:9)
+and the Rendezvous class (collective_group/nccl_collective_group.py:29). Here the coordinator
+does double duty: (1) rendezvous/bootstrap metadata (world size, jax.distributed coordinator
+address for the XLA backend), (2) a poll-based exchange board for SHM-backend collectives.
+
+Clients never block inside coordinator methods (the actor is single-threaded FIFO); they
+poll. Entries are garbage-collected once every participant has fetched them.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class GroupCoordinator:
+    """Per-collective-group named actor. Name: `ray_tpu.collective.<group_name>`."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        # key -> {rank: payload}
+        self._boards: Dict[str, Dict[int, Any]] = {}
+        # key -> set of ranks that have fetched the completed board
+        self._fetched: Dict[str, set] = {}
+        self._meta: Dict[str, Any] = {}
+
+    # -- metadata (rendezvous) ---------------------------------------------------------
+    def set_meta(self, key: str, value: Any) -> None:
+        self._meta[key] = value
+
+    def get_meta(self, key: str) -> Any:
+        return self._meta.get(key)
+
+    # -- exchange board ----------------------------------------------------------------
+    def contribute(self, key: str, rank: int, payload: Any) -> None:
+        self._boards.setdefault(key, {})[rank] = payload
+
+    def poll(self, key: str, rank: int, expected: Optional[int] = None) -> Tuple[bool, Optional[List[Any]]]:
+        """Return (ready, payload-list-in-rank-order). Marks `rank` as fetched when ready."""
+        want = expected if expected is not None else self.world_size
+        board = self._boards.get(key)
+        if board is None or len(board) < want:
+            return False, None
+        out = [board[r] for r in sorted(board)]
+        fetched = self._fetched.setdefault(key, set())
+        fetched.add(rank)
+        # Every group member fetches the completed board (even ops with one contributor,
+        # e.g. broadcast), so GC only once all world_size ranks have read it.
+        if len(fetched) >= self.world_size:
+            self._boards.pop(key, None)
+            self._fetched.pop(key, None)
+        return True, out
+
+    def poll_one(self, key: str, rank: int, src_rank: int) -> Tuple[bool, Any]:
+        """Point-to-point fetch: wait for src_rank's payload only (send/recv)."""
+        board = self._boards.get(key)
+        if board is None or src_rank not in board:
+            return False, None
+        payload = board.pop(src_rank)
+        if not board:
+            self._boards.pop(key, None)
+        return True, payload
+
+    def world(self) -> int:
+        return self.world_size
+
+
+def wait_poll(coordinator, key: str, rank: int, timeout_s: float, expected: Optional[int] = None):
+    """Client-side poll loop against the coordinator actor handle."""
+    from ... import get  # late import to avoid cycle
+
+    deadline = time.monotonic() + timeout_s
+    sleep = 0.0005
+    while True:
+        ready, out = get(coordinator.poll.remote(key, rank, expected))
+        if ready:
+            return out
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"collective op {key!r} timed out after {timeout_s}s (rank {rank})")
+        time.sleep(sleep)
+        sleep = min(sleep * 2, 0.01)
+
+
+def wait_poll_one(coordinator, key: str, rank: int, src_rank: int, timeout_s: float):
+    from ... import get
+
+    deadline = time.monotonic() + timeout_s
+    sleep = 0.0005
+    while True:
+        ready, out = get(coordinator.poll_one.remote(key, rank, src_rank))
+        if ready:
+            return out
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"recv {key!r} from rank {src_rank} timed out (rank {rank})")
+        time.sleep(sleep)
+        sleep = min(sleep * 2, 0.01)

@@ -1,0 +1,89 @@
+"""Collective types (reference capability: python/ray/util/collective/types.py).
+
+Backends:
+  - SHM: host-plane collectives over the cluster object store + a coordinator actor
+    (the Gloo-analogue; reference gloo_collective_group.py). Works anywhere, meant for
+    control-plane tensors (weight broadcast, metric reduction), NOT the training hot path.
+  - XLA: tensor-plane collectives compiled by XLA over ICI (psum/all_gather/ppermute inside
+    shard_map / pjit). Group init bootstraps `jax.distributed` across member processes
+    (reference nccl_collective_group.py:128 rendezvous analogue). The hot path for tensors.
+  - NCCL/GLOO/MPI: not supported on TPU (reference types.py:29-46 likewise raises on MPI).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+
+class Backend(str, Enum):
+    SHM = "shm"
+    XLA = "xla"
+    NCCL = "nccl"
+    GLOO = "gloo"
+    MPI = "mpi"
+
+    @classmethod
+    def parse(cls, value: "Backend | str") -> "Backend":
+        b = cls(value.lower()) if isinstance(value, str) else value
+        if b in (Backend.NCCL, Backend.GLOO):
+            raise ValueError(
+                f"backend {b.value!r} is GPU/CPU-cluster specific and unsupported on TPU; "
+                "use 'xla' (ICI tensor plane) or 'shm' (host plane)"
+            )
+        if b is Backend.MPI:
+            raise NotImplementedError("MPI is not supported (matches reference behavior)")
+        return b
+
+
+class ReduceOp(Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+
+
+@dataclass
+class AllReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BroadcastOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000
