@@ -1,0 +1,2 @@
+"""ray_tpu.air — shared configuration for Train/Tune (reference: python/ray/air/)."""
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig  # noqa: F401

@@ -1,0 +1,72 @@
+"""Shared AIR-style run configuration.
+
+Reference capability: python/ray/air/config.py — ScalingConfig (:98), FailureConfig (:320),
+CheckpointConfig (:370), RunConfig (:519). TPU-native twist: ScalingConfig speaks chips and
+pod-slice topologies, not GPUs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers, and what each one holds.
+
+    On TPU, `num_workers` is the number of *host processes* (one per TPU VM host);
+    `chips_per_worker` is the accelerator count each host contributes to the global mesh.
+    `use_tpu=False` gives CPU workers (tests, data-only jobs).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: float = 0.0
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None  # e.g. "v5e-16": schedule workers onto one slice
+
+    @property
+    def total_workers(self) -> int:
+        return self.num_workers
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", self.cpus_per_worker)
+        if self.use_tpu or self.chips_per_worker:
+            res.setdefault("TPU", self.chips_per_worker or 1.0)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """Reference air/config.py:320. max_failures: worker-group restarts allowed; <0 = infinite."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference air/config.py:370. Top-k retention ordered by a reported metric."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # "max" | "min"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """Reference air/config.py:519."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1

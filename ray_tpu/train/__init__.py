@@ -2,5 +2,27 @@
 
 Reference capability: python/ray/train/ (SURVEY.md §2.4). The `JaxTrainer` here is the
 north-star API the reference lacks (no JaxTrainer exists upstream — SURVEY.md §2.4 note).
+
+Public surface mirrors ray.train: report/get_context/get_checkpoint/get_dataset_shard
+inside the worker loop; JaxTrainer(...).fit() on the driver; ScalingConfig/RunConfig etc.
+re-exported from ray_tpu.air.
 """
+from ..air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .backend import Backend, BackendConfig  # noqa: F401
+from .checkpoint import Checkpoint  # noqa: F401
+from .data_parallel_trainer import DataParallelTrainer, JaxTrainer  # noqa: F401
+from .jax_backend import JaxBackend, JaxConfig  # noqa: F401
+from .result import Result  # noqa: F401
+from .session import (  # noqa: F401
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from .step import TrainState, init_state, make_optimizer, make_train_step  # noqa: F401

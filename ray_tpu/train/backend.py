@@ -1,0 +1,36 @@
+"""Backend plugin interface: framework-specific worker-group setup.
+
+Reference capability: python/ray/train/backend.py — BackendConfig (:16), Backend (:32)
+with hooks on_start (:45), on_training_start (:53), on_shutdown (:49). The reference's
+_TorchBackend runs torch.distributed rendezvous here; our JaxBackend (jax_backend.py)
+bootstraps the jax.distributed universe the same way.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Type
+
+if TYPE_CHECKING:
+    from .worker_group import WorkerGroup
+
+
+@dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self) -> Type["Backend"]:
+        return Backend
+
+
+class Backend:
+    """Hooks run by BackendExecutor around worker-group lifecycle."""
+
+    share_cwd: bool = True
+
+    def on_start(self, worker_group: "WorkerGroup", backend_config: BackendConfig) -> None:
+        """After workers are up, before the user loop starts (process-group setup)."""
+
+    def on_training_start(self, worker_group: "WorkerGroup", backend_config: BackendConfig) -> None:
+        """Right before user train loops launch."""
+
+    def on_shutdown(self, worker_group: "WorkerGroup", backend_config: BackendConfig) -> None:
+        """Before workers are torn down."""

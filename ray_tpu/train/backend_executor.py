@@ -1,0 +1,187 @@
+"""BackendExecutor: drives the worker group through a training run.
+
+Reference capability: python/ray/train/_internal/backend_executor.py — BackendExecutor
+(:73), start (:146), start_training (:460) — plus the v2 controller's failure handling
+(v2/_internal/execution/controller/controller.py:94): on worker failure the whole group is
+torn down and restarted from the latest checkpoint, up to FailureConfig.max_failures.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorError, RayTpuError
+
+from ..air.config import FailureConfig, ScalingConfig
+from .backend import BackendConfig
+from .checkpoint import Checkpoint
+from .checkpoint_manager import CheckpointManager
+from .result import Result
+from .session import TrainContext
+from .worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingFailedError(RuntimeError):
+    """Raised when training fails beyond the failure policy's budget."""
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+        checkpoint_manager: Optional[CheckpointManager] = None,
+        failure_config: Optional[FailureConfig] = None,
+        experiment_name: str = "",
+        poll_interval_s: float = 0.05,
+    ):
+        self.backend_config = backend_config
+        self.backend = backend_config.backend_cls()
+        self.scaling_config = scaling_config
+        self.checkpoint_manager = checkpoint_manager
+        self.failure_config = failure_config or FailureConfig()
+        self.experiment_name = experiment_name
+        self.poll_interval_s = poll_interval_s
+        self.worker_group: Optional[WorkerGroup] = None
+        self._latest_metrics: Dict[str, Any] = {}
+        self._history: List[Dict[str, Any]] = []
+
+    # -- lifecycle ---------------------------------------------------------------------
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            num_workers=self.scaling_config.num_workers,
+            resources_per_worker=self.scaling_config.worker_resources(),
+            placement_strategy=self.scaling_config.placement_strategy,
+        )
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(
+        self,
+        train_fn: Callable[[Dict[str, Any]], None],
+        train_loop_config: Dict[str, Any],
+        datasets: Optional[Dict[str, Any]] = None,
+        checkpoint: Optional[Checkpoint] = None,
+    ) -> None:
+        assert self.worker_group is not None, "call start() first"
+        self.backend.on_training_start(self.worker_group, self.backend_config)
+        node_ranks = self.worker_group.node_ranks()
+        local_counts: Dict[int, int] = {}
+        refs = []
+        for rank, w in enumerate(self.worker_group.workers):
+            nr = node_ranks[rank]
+            local_rank = local_counts.get(nr, 0)
+            local_counts[nr] = local_rank + 1
+            ctx = TrainContext(
+                world_size=len(self.worker_group),
+                world_rank=rank,
+                local_rank=local_rank,
+                local_world_size=node_ranks.count(nr),
+                node_rank=nr,
+                experiment_name=self.experiment_name,
+            )
+            shards = _split_datasets(datasets, rank, len(self.worker_group))
+            staging = (
+                self.checkpoint_manager.staging_dir if self.checkpoint_manager else None
+            )
+            refs.append(
+                w.start_session.remote(
+                    train_fn, dict(train_loop_config), ctx, checkpoint, shards, staging
+                )
+            )
+        ray_tpu.get(refs)
+
+    def poll(self) -> Dict[str, Any]:
+        """One poll cycle. Returns {"finished": bool}; raises on worker failure."""
+        assert self.worker_group is not None
+        polls = ray_tpu.get([w.poll_session.remote() for w in self.worker_group.workers])
+        # Drain reports BEFORE surfacing errors: checkpoints reported ahead of a crash are
+        # exactly what the restart resumes from. Metrics: rank 0 is canonical.
+        rank0_reports = polls[0]["reports"]
+        for rep in rank0_reports:
+            metrics = rep["metrics"]
+            self._latest_metrics = metrics
+            self._history.append(metrics)
+            ckpt = rep["checkpoint"]
+            if ckpt is not None and self.checkpoint_manager is not None:
+                self.checkpoint_manager.register(ckpt, metrics)
+        for rank, p in enumerate(polls):
+            if p["error"]:
+                raise TrainingFailedError(f"worker rank {rank} failed:\n{p['error']}")
+        return {"finished": all(p["finished"] for p in polls)}
+
+    def run_until_complete(
+        self,
+        train_fn: Callable[[Dict[str, Any]], None],
+        train_loop_config: Dict[str, Any],
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_checkpoint: Optional[Checkpoint] = None,
+    ) -> Result:
+        """Full run with group-restart failure policy."""
+        failures_allowed = self.failure_config.max_failures
+        checkpoint = resume_checkpoint
+        if checkpoint is None and self.checkpoint_manager is not None:
+            checkpoint = self.checkpoint_manager.latest_checkpoint
+        error: Optional[str] = None
+        while True:
+            try:
+                if self.worker_group is None:
+                    self.start()
+                self.start_training(train_fn, train_loop_config, datasets, checkpoint)
+                while True:
+                    state = self.poll()
+                    if state["finished"]:
+                        break
+                    time.sleep(self.poll_interval_s)
+                break  # success
+            except (TrainingFailedError, ActorError, RayTpuError) as e:
+                logger.warning("training worker group failed: %s", e)
+                self.shutdown(graceful=False)
+                if failures_allowed == 0:
+                    error = str(e)
+                    break
+                if failures_allowed > 0:
+                    failures_allowed -= 1
+                # Restart from the most recent durable checkpoint.
+                if self.checkpoint_manager is not None:
+                    checkpoint = self.checkpoint_manager.latest_checkpoint or resume_checkpoint
+        latest_ckpt = (
+            self.checkpoint_manager.latest_checkpoint if self.checkpoint_manager else None
+        )
+        best_ckpt = self.checkpoint_manager.best_checkpoint if self.checkpoint_manager else None
+        return Result(
+            metrics=self._latest_metrics,
+            checkpoint=latest_ckpt,
+            best_checkpoint=best_ckpt,
+            error=error,
+            metrics_dataframe=list(self._history),
+        )
+
+    def shutdown(self, graceful: bool = True) -> None:
+        if self.worker_group is None:
+            return
+        if graceful:
+            try:
+                self.backend.on_shutdown(self.worker_group, self.backend_config)
+                ray_tpu.get([w.end_session.remote() for w in self.worker_group.workers])
+            except Exception:
+                pass
+        self.worker_group.shutdown()
+        self.worker_group = None
+
+
+def _split_datasets(datasets: Optional[Dict[str, Any]], rank: int, world: int):
+    """Per-worker dataset shards (reference _internal/data_config.py). Datasets exposing
+    split_at_indices/streaming_split get sharded; plain iterables pass through whole."""
+    if not datasets:
+        return {}
+    out = {}
+    for name, ds in datasets.items():
+        if hasattr(ds, "split_for_workers"):
+            out[name] = ds.split_for_workers(world)[rank]
+        else:
+            out[name] = ds
+    return out

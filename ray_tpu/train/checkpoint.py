@@ -1,0 +1,67 @@
+"""Directory-based checkpoints.
+
+Reference capability: python/ray/train/_checkpoint.py:56 (Checkpoint) — a checkpoint is a
+URI/path-addressed directory; frameworks read/write inside it. Orbax handles the jax pytree
+serialization (see train/orbax_utils.py); this class is deliberately format-agnostic.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+
+class Checkpoint:
+    """A reference to a directory holding a model snapshot."""
+
+    _METADATA_FILE = ".metadata.json"
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"Checkpoint.from_directory: {path} is not a directory")
+        return cls(path)
+
+    @contextmanager
+    def as_directory(self) -> Iterator[str]:
+        """Yield a local directory with the checkpoint contents (zero-copy: local paths
+        are yielded directly; a remote-fs implementation would download here)."""
+        yield self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or os.path.join(tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    # -- metadata ----------------------------------------------------------------------
+    def _meta_path(self) -> str:
+        return os.path.join(self.path, self._METADATA_FILE)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        if os.path.exists(self._meta_path()):
+            with open(self._meta_path()) as f:
+                return json.load(f)
+        return {}
+
+    def set_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(self._meta_path(), "w") as f:
+            json.dump(metadata, f)
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        merged = self.get_metadata()
+        merged.update(metadata)
+        self.set_metadata(merged)
+
+    def __repr__(self) -> str:
+        return f"Checkpoint(path={self.path!r})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))

@@ -1,0 +1,117 @@
+"""Checkpoint persistence + top-k retention.
+
+Reference capability: python/ray/train/_internal/checkpoint_manager.py and
+_internal/storage.py (StorageContext). Worker-reported checkpoints are moved into the run
+storage directory as checkpoint_{:06d}; retention ordered by CheckpointConfig's score
+attribute (ties/no-score: recency).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..air.config import CheckpointConfig
+from .checkpoint import Checkpoint
+
+
+@dataclass
+class _TrackedCheckpoint:
+    checkpoint: Checkpoint
+    index: int
+    metrics: Dict[str, Any] = field(default_factory=dict)
+
+
+class CheckpointManager:
+    def __init__(self, storage_dir: str, config: Optional[CheckpointConfig] = None):
+        self.storage_dir = os.path.abspath(storage_dir)
+        os.makedirs(self.storage_dir, exist_ok=True)
+        self.config = config or CheckpointConfig()
+        self._tracked: List[_TrackedCheckpoint] = []
+        self._next_index = 0
+        # Rerunning with the same RunConfig.name must continue the index sequence, not
+        # collide with (and nest inside) existing checkpoint_NNNNNN directories.
+        for entry in sorted(os.listdir(self.storage_dir)):
+            path = os.path.join(self.storage_dir, entry)
+            if entry.startswith("checkpoint_") and os.path.isdir(path):
+                ckpt = Checkpoint(path)
+                meta = ckpt.get_metadata()
+                idx = meta.get("index", int(entry.split("_")[1]))
+                self._tracked.append(_TrackedCheckpoint(ckpt, idx, meta.get("metrics", {})))
+                self._next_index = max(self._next_index, idx + 1)
+
+    @property
+    def staging_dir(self) -> str:
+        """Where worker sessions stage checkpoints before registration (same fs)."""
+        return os.path.join(self.storage_dir, ".staging")
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
+        """Persist a worker-reported checkpoint into run storage; returns the durable one."""
+        idx = self._next_index
+        self._next_index += 1
+        dest = os.path.join(self.storage_dir, f"checkpoint_{idx:06d}")
+        if os.path.abspath(checkpoint.path) != dest:
+            # Move when possible (same filesystem) to avoid double disk usage.
+            try:
+                shutil.move(checkpoint.path, dest)
+            except (OSError, shutil.Error):
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        durable = Checkpoint(dest)
+        durable.update_metadata({"index": idx, "metrics": {k: _jsonable(v) for k, v in metrics.items()}})
+        self._tracked.append(_TrackedCheckpoint(durable, idx, metrics))
+        self._enforce_retention()
+        return durable
+
+    def _score(self, t: _TrackedCheckpoint):
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            return t.index
+        v = t.metrics.get(attr)
+        if v is None:
+            return float("-inf") if self.config.checkpoint_score_order == "max" else float("inf")
+        return v
+
+    def _enforce_retention(self) -> None:
+        k = self.config.num_to_keep
+        if k is None or len(self._tracked) <= k:
+            return
+        reverse = self.config.checkpoint_score_order == "max"
+        ranked = sorted(self._tracked, key=self._score, reverse=reverse)
+        keep = set(id(t) for t in ranked[:k])
+        # Never delete the most recent checkpoint — it's the resume point.
+        latest = max(self._tracked, key=lambda t: t.index)
+        keep.add(id(latest))
+        survivors = []
+        for t in self._tracked:
+            if id(t) in keep:
+                survivors.append(t)
+            else:
+                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+        self._tracked = survivors
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=lambda t: t.index).checkpoint
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        reverse = self.config.checkpoint_score_order == "max"
+        return sorted(self._tracked, key=self._score, reverse=reverse)[0].checkpoint
+
+    def list(self) -> List[Checkpoint]:
+        return [t.checkpoint for t in sorted(self._tracked, key=lambda t: t.index)]
+
+
+def _jsonable(v):
+    try:
+        import json
+
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return repr(v)

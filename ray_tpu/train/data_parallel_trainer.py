@@ -1,0 +1,95 @@
+"""DataParallelTrainer / JaxTrainer: the stock Trainer API.
+
+Reference capability: python/ray/train/data_parallel_trainer.py:26 (SPMD: run
+train_loop_per_worker on N workers) + base_trainer.py:651 (fit()). The reference routes
+fit() through a 1-trial Tune run; here fit() drives the BackendExecutor directly and the
+Tune integration wraps trainers the other way around (ray_tpu.tune can take a Trainer as a
+trainable), which keeps the hot path free of trial bookkeeping.
+
+JaxTrainer is the piece SURVEY.md §2.4 calls out as new work: the reference has no JAX
+trainer; this one follows the Backend-plugin shape with jax.distributed bootstrap.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Union
+
+from ..air.config import RunConfig, ScalingConfig
+from .backend import BackendConfig
+from .backend_executor import BackendExecutor
+from .checkpoint import Checkpoint
+from .checkpoint_manager import CheckpointManager
+from .jax_backend import JaxConfig
+from .result import Result
+
+TrainLoop = Union[Callable[[], None], Callable[[Dict[str, Any]], None]]
+
+
+def _default_storage_path() -> str:
+    return os.environ.get(
+        "RAY_TPU_STORAGE_PATH", os.path.join(os.path.expanduser("~"), "ray_tpu_results")
+    )
+
+
+class DataParallelTrainer:
+    _default_backend_config: Callable[[], BackendConfig] = BackendConfig
+
+    def __init__(
+        self,
+        train_loop_per_worker: TrainLoop,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or type(self)._default_backend_config()
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        name = self.run_config.name or f"train_{time.strftime('%Y%m%d_%H%M%S')}"
+        storage = self.run_config.storage_path or _default_storage_path()
+        run_dir = os.path.join(storage, name)
+        ckpt_manager = CheckpointManager(run_dir, self.run_config.checkpoint_config)
+        executor = BackendExecutor(
+            backend_config=self.backend_config,
+            scaling_config=self.scaling_config,
+            checkpoint_manager=ckpt_manager,
+            failure_config=self.run_config.failure_config,
+            experiment_name=name,
+        )
+        train_fn = _normalize_train_fn(self.train_loop_per_worker)
+        try:
+            result = executor.run_until_complete(
+                train_fn,
+                self.train_loop_config,
+                datasets=self.datasets,
+                resume_checkpoint=self.resume_from_checkpoint,
+            )
+        finally:
+            executor.shutdown()
+        result.path = run_dir
+        return result
+
+
+def _normalize_train_fn(fn: TrainLoop) -> Callable[[Dict[str, Any]], None]:
+    import inspect
+
+    sig = inspect.signature(fn)
+    if len(sig.parameters) == 0:
+        return lambda config: fn()
+    return fn  # type: ignore[return-value]
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Train-shaped JAX trainer (north star: SURVEY.md §7 phase 3)."""
+
+    _default_backend_config = JaxConfig

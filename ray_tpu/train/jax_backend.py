@@ -1,0 +1,131 @@
+"""JaxConfig / JaxBackend: the jax.distributed process-group bootstrap.
+
+Reference shape: python/ray/train/torch/config.py — TorchConfig (:36), _TorchBackend
+(:153), _setup_torch_process_group (:66). The reference rendezvouses a NCCL process group;
+here the worker group forms ONE jax.distributed universe so workers can build a global
+device Mesh spanning every chip of the pod slice, and gradient sync happens *inside* pjit
+programs as XLA collectives over ICI — there is no NCCL analogue to configure.
+
+SURVEY.md §2.4 notes JaxTrainer does not exist in the reference; this follows the Backend
+plugin shape it prescribes.
+"""
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass
+from typing import Dict, Optional, Type
+
+from .backend import Backend, BackendConfig
+from .worker_group import WorkerGroup
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Backend config for JAX workers.
+
+    distributed: form a jax.distributed universe across workers (multi-host pods). Off by
+      default for single-host/CPU test runs where each worker keeps a private runtime.
+    platform: value for JAX_PLATFORMS in workers ("" = leave as-is / auto-detect TPU).
+    collective_group: also create a host-plane shm collective group named "train" over the
+      workers (out-of-jit weight broadcast / metric reduction; reference's gloo group).
+    """
+
+    distributed: bool = False
+    platform: str = ""
+    coordinator_port: int = 0
+    collective_group: bool = True
+    # Unique per run unless pinned: two concurrent trainers must not share a coordinator.
+    collective_group_name: str = ""
+    env: Optional[Dict[str, str]] = None  # extra env vars set in workers before jax import
+
+    @property
+    def backend_cls(self) -> Type["JaxBackend"]:
+        return JaxBackend
+
+
+def _init_jax_distributed(coordinator_address: str, num_processes: int, process_id: int) -> None:
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _pick_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig) -> None:
+        if backend_config.collective_group and not backend_config.collective_group_name:
+            import uuid
+
+            backend_config.collective_group_name = f"train_{uuid.uuid4().hex[:8]}"
+        group_name = backend_config.collective_group_name
+        envs = []
+        for rank in range(len(worker_group)):
+            env = {
+                "RAY_TPU_TRAIN_WORLD_SIZE": str(len(worker_group)),
+                "RAY_TPU_TRAIN_RANK": str(rank),
+            }
+            if backend_config.collective_group:
+                env["RAY_TPU_TRAIN_COLLECTIVE_GROUP"] = group_name
+            if backend_config.platform:
+                env["JAX_PLATFORMS"] = backend_config.platform
+            if backend_config.env:
+                env.update(backend_config.env)
+            envs.append(env)
+        worker_group.set_env(envs)
+
+        if backend_config.distributed and len(worker_group) > 1:
+            host = worker_group.execute_single(0, socket.gethostname)
+            # Pick the port ON worker 0's host — a driver-side free port proves nothing
+            # about the machine that will actually bind it.
+            port = backend_config.coordinator_port or worker_group.execute_single(0, _pick_port)
+            addr = f"{host}:{port}"
+            import ray_tpu
+
+            refs = [
+                w.run_fn.remote(_init_jax_distributed, addr, len(worker_group), rank)
+                for rank, w in enumerate(worker_group.workers)
+            ]
+            ray_tpu.get(refs)
+
+        if backend_config.collective_group:
+            from ray_tpu.util import collective as col
+
+            # Clear any stale coordinator (e.g. from a crashed prior generation of this
+            # run) so the new generation's sequence numbers start on clean boards.
+            col.kill_coordinator(group_name)
+            col.create_collective_group(
+                worker_group.workers,
+                len(worker_group),
+                list(range(len(worker_group))),
+                backend="shm",
+                group_name=group_name,
+            )
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: JaxConfig) -> None:
+        def _shutdown():
+            import jax
+
+            try:
+                if jax.process_count() > 1:
+                    jax.distributed.shutdown()
+            except Exception:
+                pass
+
+        try:
+            worker_group.execute(_shutdown)
+        except Exception:
+            pass
+        if backend_config.collective_group and backend_config.collective_group_name:
+            from ray_tpu.util import collective as col
+
+            col.kill_coordinator(backend_config.collective_group_name)

@@ -1,0 +1,89 @@
+"""Orbax-backed pytree (de)serialization into directory Checkpoints.
+
+Reference capability: checkpoint payload handling that python/ray/train delegates to
+torch.save / framework code; here orbax-checkpoint is the JAX-native format (sharded
+arrays restore onto the current mesh layout). Falls back to a pickle of host numpy arrays
+if orbax is unavailable.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+from .checkpoint import Checkpoint
+
+_STATE_DIR = "state"
+_PICKLE_FILE = "state.pkl"
+
+
+def save_pytree(tree: Any, directory: str) -> Checkpoint:
+    """Write a jax pytree into `directory` and return a Checkpoint pointing at it."""
+    os.makedirs(directory, exist_ok=True)
+    try:
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(os.path.abspath(directory), _STATE_DIR)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(path, tree, force=True)
+    except ImportError:
+        import jax
+
+        host_tree = jax.tree_util.tree_map(lambda x: _to_numpy(x), tree)
+        with open(os.path.join(directory, _PICKLE_FILE), "wb") as f:
+            pickle.dump(host_tree, f)
+    return Checkpoint(directory)
+
+
+def load_pytree(checkpoint: Checkpoint, target: Optional[Any] = None) -> Any:
+    """Restore a pytree from a Checkpoint. `target` (a pytree of like-structured arrays,
+    possibly sharded) guides structure and placement when given."""
+    with checkpoint.as_directory() as d:
+        orbax_path = os.path.join(d, _STATE_DIR)
+        pickle_path = os.path.join(d, _PICKLE_FILE)
+        if os.path.isdir(orbax_path):
+            import orbax.checkpoint as ocp
+
+            with ocp.PyTreeCheckpointer() as ckptr:
+                if target is not None:
+                    import jax
+
+                    abstract = jax.tree_util.tree_map(_abstractify, target)
+                    return ckptr.restore(orbax_path, item=abstract)
+                return ckptr.restore(orbax_path)
+        if os.path.exists(pickle_path):
+            with open(pickle_path, "rb") as f:
+                tree = pickle.load(f)
+            if target is not None:
+                import jax
+
+                # Re-place host arrays to match the target's sharding.
+                return jax.tree_util.tree_map(
+                    lambda t, x: jax.device_put(x, t.sharding) if hasattr(t, "sharding") else x,
+                    target,
+                    tree,
+                )
+            return tree
+    raise FileNotFoundError(f"no pytree state found in {checkpoint.path}")
+
+
+def _to_numpy(x):
+    import numpy as np
+
+    try:
+        return np.asarray(x)
+    except Exception:
+        return x
+
+
+def _abstractify(x):
+    import jax
+
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        try:
+            import orbax.checkpoint as ocp
+
+            return ocp.utils.to_shape_dtype_struct(x)
+        except Exception:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x

@@ -1,0 +1,21 @@
+"""Result of a training run (reference: python/ray/train/result.py / air Result)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .checkpoint import Checkpoint
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    best_checkpoint: Optional[Checkpoint] = None
+    path: Optional[str] = None
+    error: Optional[str] = None
+    metrics_dataframe: Optional[List[Dict[str, Any]]] = None  # metric history (list of dicts)
+
+    @property
+    def best_checkpoints(self) -> List[Checkpoint]:
+        return [c for c in [self.best_checkpoint] if c is not None]

@@ -1,0 +1,159 @@
+"""Per-worker training session: the `ray_tpu.train.report()` plumbing.
+
+Reference capability: python/ray/train/_internal/session.py — _TrainSession (:112),
+report (:405), public ray.train.report (:672) and get_context
+(python/ray/train/context.py:117). The user's train loop runs on a daemon thread inside
+the worker actor; report() enqueues (metrics, checkpoint) for the driver-side executor to
+drain. Checkpoints are staged into run storage *before* report() returns (worker-side
+persistence, like Train v2's storage upload), so callers may delete their local snapshot
+directory immediately after reporting.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+@dataclass
+class TrainContext:
+    """Reference: ray.train.get_context() — world/rank topology of the worker group."""
+
+    world_size: int
+    world_rank: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    experiment_name: str = ""
+    trial_name: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+
+class _TrainSession:
+    def __init__(
+        self,
+        train_fn: Callable[[Dict[str, Any]], None],
+        config: Dict[str, Any],
+        context: TrainContext,
+        checkpoint: Optional[Checkpoint] = None,
+        dataset_shards: Optional[Dict[str, Any]] = None,
+        staging_dir: Optional[str] = None,
+    ):
+        self.train_fn = train_fn
+        self.config = config
+        self.context = context
+        self.starting_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.staging_dir = staging_dir
+        self.results: "queue.Queue" = queue.Queue()
+        self.error: Optional[BaseException] = None
+        self.finished = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        def run():
+            global _session
+            try:
+                self.train_fn(self.config)
+            except BaseException as e:  # noqa: BLE001 — report worker crash faithfully
+                self.error = e
+            finally:
+                self.finished.set()
+
+        self._thread = threading.Thread(target=run, daemon=True, name="train_loop")
+        self._thread.start()
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+        if checkpoint is not None and self.staging_dir is not None:
+            # Stage into run storage now: the caller may delete its snapshot dir the
+            # moment report() returns, long before the driver polls.
+            os.makedirs(self.staging_dir, exist_ok=True)
+            dest = os.path.join(self.staging_dir, f"staged_{uuid.uuid4().hex[:12]}")
+            try:
+                shutil.move(checkpoint.path, dest)
+            except (OSError, shutil.Error):
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            checkpoint = Checkpoint(dest)
+        self.results.put({"metrics": metrics, "checkpoint": checkpoint})
+
+    def drain(self, max_items: Optional[int] = None) -> list:
+        out = []
+        while max_items is None or len(out) < max_items:
+            try:
+                out.append(self.results.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+
+def _set_session(s: Optional[_TrainSession]) -> None:
+    global _session
+    with _session_lock:
+        _session = s
+
+
+def _get_session() -> Optional[_TrainSession]:
+    with _session_lock:
+        return _session
+
+
+# -- public API (mirrors ray.train.*) --------------------------------------------------
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+    """Reference: ray.train.report (session.py:672)."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a training worker")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.get_context() called outside a training worker")
+    return s.context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.get_checkpoint() called outside a training worker")
+    return s.starting_checkpoint
+
+
+def get_dataset_shard(dataset_name: str = "train"):
+    """Reference: ray.train.get_dataset_shard — this worker's split of a Dataset."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("get_dataset_shard() called outside a training worker")
+    shard = s.dataset_shards.get(dataset_name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset shard named {dataset_name!r}; passed datasets: {list(s.dataset_shards)}"
+        )
+    return shard

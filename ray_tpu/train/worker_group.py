@@ -1,0 +1,161 @@
+"""WorkerGroup: N training-worker actors, optionally inside a placement group.
+
+Reference capability: python/ray/train/_internal/worker_group.py:102 (WorkerGroup,
+RayTrainWorker). Worker actors host a _TrainSession on a daemon thread; the executor
+polls them for reports.
+"""
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.task_spec import PlacementGroupSchedulingStrategy
+from ray_tpu.util import placement_group_api as pg_api
+
+from .session import TrainContext, _TrainSession, _set_session
+
+
+class RayTrainWorker:
+    """The per-worker actor (reference worker_group.py RayTrainWorker)."""
+
+    def __init__(self):
+        self._session: Optional[_TrainSession] = None
+
+    def get_metadata(self) -> Dict[str, Any]:
+        ctx = ray_tpu.get_runtime_context()
+        return {
+            "node_id": ctx.get_node_id(),
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+        }
+
+    def set_env(self, env: Dict[str, str]) -> None:
+        os.environ.update(env)
+
+    def run_fn(self, fn: Callable, *args, **kwargs):
+        """Execute an arbitrary function in the worker (backend hooks use this)."""
+        return fn(*args, **kwargs)
+
+    def start_session(
+        self,
+        train_fn: Callable[[Dict[str, Any]], None],
+        config: Dict[str, Any],
+        context: TrainContext,
+        checkpoint=None,
+        dataset_shards: Optional[Dict[str, Any]] = None,
+        staging_dir: Optional[str] = None,
+    ) -> None:
+        if self._session is not None and not self._session.finished.is_set():
+            raise RuntimeError("a training session is already running in this worker")
+        self._session = _TrainSession(
+            train_fn, config, context, checkpoint, dataset_shards, staging_dir
+        )
+        _set_session(self._session)
+        self._session.start()
+
+    def poll_session(self) -> Dict[str, Any]:
+        s = self._session
+        if s is None:
+            return {"reports": [], "finished": True, "error": None}
+        reports = s.drain()
+        err = None
+        if s.finished.is_set() and s.error is not None:
+            import traceback
+
+            err = "".join(traceback.format_exception(s.error)).strip()
+        return {"reports": reports, "finished": s.finished.is_set(), "error": err}
+
+    def end_session(self) -> None:
+        self._session = None
+        _set_session(None)
+
+    def _ray_tpu_collective_init(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend, group_name)
+
+
+@dataclass
+class WorkerMetadata:
+    node_id: str
+    hostname: str
+    pid: int
+
+
+class WorkerGroup:
+    """Creates and addresses the N RayTrainWorker actors."""
+
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        placement_strategy: str = "PACK",
+        use_placement_group: bool = True,
+        worker_cls: type = RayTrainWorker,
+    ):
+        self.num_workers = num_workers
+        self._pg = None
+        actor_cls = ray_tpu.remote(worker_cls)
+        num_cpus = resources_per_worker.get("CPU", 1.0)
+        num_tpus = resources_per_worker.get("TPU", 0.0)
+        extra = {k: v for k, v in resources_per_worker.items() if k not in ("CPU", "TPU")}
+        opts: Dict[str, Any] = dict(num_cpus=num_cpus, num_tpus=num_tpus)
+        if extra:
+            opts["resources"] = extra
+        if use_placement_group and num_workers > 1:
+            bundle = dict(resources_per_worker)
+            bundle.setdefault("CPU", num_cpus)
+            self._pg = pg_api.placement_group(
+                [dict(bundle) for _ in range(num_workers)], strategy=placement_strategy
+            )
+            ray_tpu.get(self._pg.ready())
+        self.workers = []
+        for i in range(num_workers):
+            o = dict(opts)
+            if self._pg is not None:
+                o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group=self._pg, placement_group_bundle_index=i
+                )
+            self.workers.append(actor_cls.options(**o).remote())
+        metas = ray_tpu.get([w.get_metadata.remote() for w in self.workers])
+        self.metadata: List[WorkerMetadata] = [WorkerMetadata(**m) for m in metas]
+
+    def __len__(self) -> int:
+        return self.num_workers
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run fn on every worker, return results in rank order."""
+        return ray_tpu.get([w.run_fn.remote(fn, *args, **kwargs) for w in self.workers])
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self.workers[rank].run_fn.remote(fn, *args, **kwargs))
+
+    def set_env(self, envs: List[Dict[str, str]]) -> None:
+        ray_tpu.get([w.set_env.remote(e) for w, e in zip(self.workers, envs)])
+
+    def node_ranks(self) -> List[int]:
+        """Map each worker to a dense node index (for local_rank computation)."""
+        node_order: List[str] = []
+        ranks = []
+        for m in self.metadata:
+            if m.node_id not in node_order:
+                node_order.append(m.node_id)
+            ranks.append(node_order.index(m.node_id))
+        return ranks
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self._pg is not None:
+            try:
+                pg_api.remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None

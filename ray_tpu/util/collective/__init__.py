@@ -16,6 +16,7 @@ from .collective import (  # noqa: F401
     get_rank,
     init_collective_group,
     is_group_initialized,
+    kill_coordinator,
     recv,
     reduce,
     reducescatter,

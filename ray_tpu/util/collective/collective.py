@@ -59,11 +59,16 @@ def _get_or_create_coordinator(group_name: str, world_size: int):
         pass
     coord_cls = ray_tpu.remote(GroupCoordinator)
     try:
-        return coord_cls.options(
+        coord = coord_cls.options(
             name=name, namespace=_NAMESPACE, lifetime="detached", num_cpus=0
         ).remote(world_size)
+        # Name collisions surface on the first method call, not at .remote() — round-trip
+        # before trusting the handle, else a lost creation race leaves a dead coordinator
+        # and the group rendezvous hangs.
+        ray_tpu.get(coord.world.remote(), timeout=30)
+        return coord
     except Exception:
-        # Lost the creation race: another rank registered it first.
+        # Lost the creation race: another rank registered the name first.
         return ray_tpu.get_actor(name, namespace=_NAMESPACE)
 
 
@@ -130,6 +135,19 @@ class CollectiveActorMixin:
 def destroy_collective_group(group_name: str = "default") -> None:
     with _lock:
         _groups.pop(group_name, None)
+
+
+def kill_coordinator(group_name: str = "default") -> None:
+    """Driver-side teardown of a group's detached coordinator actor. Call after all
+    members are done (e.g. worker-group shutdown) so the name can be reused with a
+    different world size."""
+    import ray_tpu
+
+    try:
+        coord = ray_tpu.get_actor(_coordinator_name(group_name), namespace=_NAMESPACE)
+        ray_tpu.kill(coord)
+    except Exception:
+        pass
 
 
 def is_group_initialized(group_name: str = "default") -> bool:

@@ -1,0 +1,142 @@
+"""Train library tests (reference analogue: python/ray/train tests; SURVEY.md §2.4).
+
+Training loops here are numpy-cheap — the jitted TPU step core has its own tests
+(test_llama.py); these cover the Trainer/session/checkpoint/failure machinery.
+"""
+import json
+import os
+
+import pytest
+
+from ray_tpu.air import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train import Checkpoint, JaxConfig, JaxTrainer
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+
+
+def _loop_basic(config):
+    import ray_tpu.train as train
+
+    ctx = train.get_context()
+    for step in range(config["steps"]):
+        train.report({"step": step, "rank": ctx.get_world_rank(), "ws": ctx.get_world_size()})
+
+
+def test_jax_trainer_reports(rt, tmp_path):
+    trainer = JaxTrainer(
+        _loop_basic,
+        train_loop_config={"steps": 3},
+        backend_config=JaxConfig(collective_group=False),
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=0.5),
+        run_config=RunConfig(name="t_basic", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["ws"] == 2
+    assert result.metrics["rank"] == 0  # rank-0 metrics are canonical
+    steps = [m["step"] for m in result.metrics_dataframe]
+    assert steps == [0, 1, 2]
+    assert result.path == str(tmp_path / "t_basic")
+
+
+def _loop_ckpt(config):
+    import tempfile
+
+    import ray_tpu.train as train
+
+    ctx = train.get_context()
+    ckpt = train.get_checkpoint()
+    start = 0
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            start = json.load(open(os.path.join(d, "state.json")))["step"] + 1
+    for step in range(start, config["steps"]):
+        if step == 3 and ckpt is None and config.get("fail_once"):
+            raise RuntimeError("injected worker failure")
+        checkpoint = None
+        if ctx.get_world_rank() == 0:
+            d = tempfile.mkdtemp(prefix="wkr_ckpt_")
+            json.dump({"step": step}, open(os.path.join(d, "state.json"), "w"))
+            checkpoint = Checkpoint.from_directory(d)
+        train.report({"step": step}, checkpoint=checkpoint)
+
+
+def test_checkpoint_and_restart_on_failure(rt, tmp_path):
+    trainer = JaxTrainer(
+        _loop_ckpt,
+        train_loop_config={"steps": 6, "fail_once": True},
+        backend_config=JaxConfig(collective_group=False),
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=0.5),
+        run_config=RunConfig(
+            name="t_restart",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # Resumed at step 3 from the step-2 checkpoint and ran to 5.
+    assert result.metrics["step"] == 5
+    assert result.checkpoint is not None
+    meta = result.checkpoint.get_metadata()
+    assert meta["metrics"]["step"] == 5
+
+
+def test_failure_budget_exhausted(rt, tmp_path):
+    trainer = JaxTrainer(
+        lambda config: (_ for _ in ()).throw(RuntimeError("always fails")),
+        backend_config=JaxConfig(collective_group=False),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t_fail", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+    assert "always fails" in result.error
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    import tempfile
+
+    mgr = CheckpointManager(
+        str(tmp_path / "run"),
+        CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="acc", checkpoint_score_order="max"),
+    )
+    for i, acc in enumerate([0.1, 0.9, 0.5, 0.2]):
+        d = tempfile.mkdtemp()
+        open(os.path.join(d, "w.txt"), "w").write(str(i))
+        mgr.register(Checkpoint(d), {"acc": acc})
+    kept = mgr.list()
+    # top-2 by acc = (0.9, 0.5) plus the latest (acc 0.2) is never deleted
+    accs = sorted(c.get_metadata()["metrics"]["acc"] for c in kept)
+    assert accs == [0.2, 0.5, 0.9]
+    assert mgr.best_checkpoint.get_metadata()["metrics"]["acc"] == 0.9
+    assert mgr.latest_checkpoint.get_metadata()["metrics"]["acc"] == 0.2
+
+
+def _loop_fast(config):
+    import ray_tpu.train as train
+
+    for step in range(100):
+        train.report({"step": step})
+
+
+def test_fast_loop_reports_not_dropped(rt, tmp_path):
+    """A loop that finishes within one poll interval must not lose trailing reports."""
+    trainer = JaxTrainer(
+        _loop_fast,
+        backend_config=JaxConfig(collective_group=False),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t_fast", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert [m["step"] for m in result.metrics_dataframe] == list(range(100))
+
+
+def test_session_api_outside_worker_raises():
+    import ray_tpu.train as train
+
+    with pytest.raises(RuntimeError):
+        train.report({})
+    with pytest.raises(RuntimeError):
+        train.get_context()
