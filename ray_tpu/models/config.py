@@ -27,6 +27,13 @@ class ModelConfig:
     dtype: str = "bfloat16"  # activation dtype; params kept f32, cast in forward
     remat: bool = True  # jax.checkpoint each layer (HBM <-> FLOPs trade)
     scan_layers: bool = True  # stack layer params + lax.scan (fast compile)
+    # Attention backend: auto|pallas|reference|ring|ulysses. ring/ulysses are the
+    # sequence-parallel collectives (ops/ring_attention.py) — use with an sp>1 mesh.
+    attention_impl: str = "auto"
+    # Pipeline parallelism: >1 splits the layer stack into this many stages over the
+    # "pp" mesh axis (parallel/pipeline.py); requires n_layers % pipeline_stages == 0.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0  # 0 -> = pipeline_stages
 
     @property
     def head_dim(self) -> int:

@@ -164,8 +164,25 @@ def _block(
         attn = attention(
             q, ck, cv, causal=True, q_offset=cache_len, kv_valid_len=cache_len + q.shape[1]
         )
+    elif cfg.attention_impl in ("ring", "ulysses"):
+        # Sequence-parallel attention: activations stay seq-sharded over "sp"; KV chunks
+        # ride the ICI ring (ops/ring_attention.py). If "sp" is already bound manually
+        # (pipeline stage traced with extra_manual=("sp",)), call the collective form
+        # directly — nested shard_map is not composable.
+        from ray_tpu.ops import ring_attention as ra
+        from ray_tpu.parallel.sharding import active_manual_axes
+
+        if "sp" in active_manual_axes():
+            if cfg.attention_impl == "ring":
+                attn = ra.ring_attention(q, k, v, causal=True, segment_ids=segment_ids)
+            else:
+                attn = ra.ulysses_attention(q, k, v, causal=True)
+        else:
+            attn = ra.ring_attention_sharded(
+                q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl
+            )
     else:
-        attn = attention(q, k, v, causal=True, segment_ids=segment_ids)
+        attn = attention(q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl)
     o = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dt))
     x = wsc(x + o, "batch", "seq", "act_embed")
 
@@ -175,6 +192,62 @@ def _block(
     ff = wsc(jax.nn.silu(gate) * up, "batch", "seq", "act_mlp")
     down = jnp.einsum("bsf,fd->bsd", ff, lp["w_down"].astype(dt))
     return wsc(x + down, "batch", "seq", "act_embed"), new_kv
+
+
+def _pipeline_layers(
+    x: jax.Array,
+    params: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,
+    segment_ids: Optional[jax.Array],
+) -> jax.Array:
+    """Run the layer stack as cfg.pipeline_stages pipeline stages over the "pp" axis.
+
+    Stage-stacks the scanned layer params [L, ...] -> [pp, L/pp, ...] and feeds the
+    GPipe schedule (parallel/pipeline.py). Training path only (no KV cache); packed
+    sequences (segment_ids) are not yet microbatch-aware.
+    """
+    from ray_tpu.parallel.pipeline import pipeline
+
+    pp = cfg.pipeline_stages
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pipeline_stages {pp}")
+    if not cfg.scan_layers:
+        raise ValueError("pipeline_stages > 1 requires scan_layers=True (stacked params)")
+    if segment_ids is not None:
+        raise NotImplementedError("segment_ids with pipeline_stages > 1 not supported yet")
+    layers = params["layers"]
+    stacked = jax.tree_util.tree_map(
+        lambda p: p.reshape(pp, cfg.n_layers // pp, *p.shape[1:]), layers
+    )
+    seq_manual = cfg.attention_impl in ("ring", "ulysses")
+
+    def stage_fn(stage_params, xm):
+        # Positions rebuilt per microbatch (the no-cache path is always 0..S-1); under
+        # a seq-manual stage, xm holds only this device's chunk of the sequence.
+        s_loc = xm.shape[1]
+        start = jax.lax.axis_index("sp") * s_loc if seq_manual else 0
+        pos = jnp.broadcast_to(start + jnp.arange(s_loc)[None, :], (xm.shape[0], s_loc))
+
+        def body(carry, lp):
+            h, _ = _block(carry, lp, cfg, pos, None)
+            return h, None
+
+        fn = jax.checkpoint(body) if cfg.remat else body
+        out, _ = jax.lax.scan(fn, xm, stage_params)
+        return out
+
+    m = cfg.pipeline_microbatches or pp
+    from jax.sharding import PartitionSpec as P
+
+    return pipeline(
+        stage_fn,
+        stacked,
+        x,
+        num_microbatches=m,
+        x_spec=P(None, "sp", None) if seq_manual else None,
+        extra_manual=("sp",) if seq_manual else (),
+    )
 
 
 def forward(
@@ -194,7 +267,10 @@ def forward(
     x = params["embed"].astype(cfg.activation_dtype)[tokens]
     x = wsc(x, "batch", "seq", "act_embed")
 
-    if cfg.scan_layers:
+    if cfg.pipeline_stages > 1 and cache is None:
+        x = _pipeline_layers(x, params, cfg, positions, segment_ids)
+        new_cache = None
+    elif cfg.scan_layers:
         if cache is not None:
 
             def body(carry, xs):

@@ -1,0 +1,212 @@
+"""Ring attention: sequence-parallel attention over the `sp` mesh axis.
+
+Long-context capability the reference lacks natively (SURVEY.md §2.3 "Sequence/context
+parallelism" row and §5: Ray delegates long context to vLLM/DeepSpeed; here it is
+first-class). Two schemes:
+
+- `ring_attention`: blockwise attention with online-softmax accumulation while K/V chunks
+  rotate around the ICI ring via `lax.ppermute` (Ring Attention, Liu et al.). Memory per
+  chip is O(S_local²) for the running tile, activations stay sequence-sharded end-to-end.
+- `ulysses_attention`: all-to-all reshard (seq-sharded → head-sharded), full-sequence
+  attention locally, reshard back (DeepSpeed-Ulysses). Cheaper at short rings when
+  n_heads % sp == 0; two all-to-alls instead of sp ppermutes.
+
+Both are *collective* ops: they must run inside `shard_map` (or any SPMD region) where
+`axis_name` is bound. `*_sharded` wrappers apply the shard_map with the framework's
+standard activation layout P((dp,fsdp), sp, tp, None) over BSHD tensors.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _repeat_kv_heads(k: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def _chunk_accumulate(q, k, v, scale, q_pos, kv_pos, causal, m, l, acc, seg_q=None, seg_kv=None):
+    """Fold one KV chunk into running online-softmax stats.
+
+    q: [B,Sq,H,D]; k/v: [B,Skv,H,D]; q_pos/kv_pos: global positions [Sq]/[Skv];
+    m,l: [B,H,Sq] f32; acc: [B,H,Sq,D] f32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    mask = jnp.ones((q_pos.shape[0], kv_pos.shape[0]), bool)
+    if causal:
+        mask = kv_pos[None, :] <= q_pos[:, None]
+    if seg_q is not None:
+        mask = mask[None, :, :] & (seg_q[:, :, None] == seg_kv[:, None, :])
+        mask = mask[:, None, :, :]  # [B,1,Sq,Skv]
+    else:
+        mask = mask[None, None, :, :]
+    s = jnp.where(mask, s, NEG_INF)
+    m_chunk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_chunk)
+    # p is explicitly zeroed where masked: exp(s - m_new) is garbage when a whole row is
+    # masked in this chunk (s == m_new == NEG_INF → exp(0) = 1).
+    p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1)
+    acc_new = alpha[..., None] * acc + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Collective ring attention. Call inside shard_map with seq sharded over axis_name.
+
+    q/k/v: LOCAL chunks [B, S_local, H|Hkv, D] (BSHD); the global sequence is the
+    concatenation over the ring in axis-index order. Returns local out [B, S_local, H, D].
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv_heads(k, n_rep)
+    v = _repeat_kv_heads(v, n_rep)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_loc, h, _ = q.shape
+    q_pos = idx * s_loc + jnp.arange(s_loc)
+
+    # The scan carry must carry q's full varying-axes set (sp, plus any outer manual
+    # axes like pp when nested inside a pipeline stage) or scan rejects the carry types.
+    try:
+        vma = tuple(jax.typeof(q).vma)
+    except Exception:
+        vma = (axis_name,)
+    _vary = (
+        (lambda z: lax.pcast(z, vma, to="varying"))
+        if hasattr(lax, "pcast")
+        else (lambda z: lax.pvary(z, vma))
+    ) if vma else (lambda z: z)
+    m0 = _vary(jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, s_loc), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, h, s_loc, d), jnp.float32))
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def body(carry, step):
+        k_cur, v_cur, seg_cur, m, l, acc = carry
+        src = (idx - step) % sp  # ring shift moved chunk `src` onto this device at `step`
+        kv_pos = src * s_loc + jnp.arange(s_loc)
+        m, l, acc = _chunk_accumulate(
+            q, k_cur, v_cur, scale, q_pos, kv_pos, causal, m, l, acc,
+            seg_q=segment_ids, seg_kv=seg_cur,
+        )
+        # Rotate AFTER consuming; on the last step the rotation restores original owners
+        # (and XLA dead-code-eliminates it if unused).
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        seg_nxt = (
+            lax.ppermute(seg_cur, axis_name, perm) if seg_cur is not None else None
+        )
+        return (k_nxt, v_nxt, seg_nxt, m, l, acc), None
+
+    (_, _, _, m, l, acc), _ = lax.scan(
+        body, (k, v, segment_ids, m0, l0, acc0), jnp.arange(sp)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    attn_fn=None,
+) -> jax.Array:
+    """Collective Ulysses attention: all-to-all seq↔heads reshard around full attention.
+
+    Requires n_heads (and n_kv_heads) divisible by the axis size. attn_fn defaults to the
+    framework's dispatching `ops.attention` so the local full-seq attention still hits the
+    Pallas kernel on TPU.
+    """
+    from .attention import attention as default_attn
+
+    attn_fn = attn_fn or default_attn
+    sp = lax.psum(1, axis_name)
+
+    def to_seq(x):  # [B, S/sp, H, D] -> [B, S, H/sp, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_heads(x):  # [B, S, H/sp, D] -> [B, S/sp, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    if q.shape[2] % sp or k.shape[2] % sp:
+        raise ValueError(
+            f"ulysses needs heads divisible by sp axis: q heads {q.shape[2]}, "
+            f"kv heads {k.shape[2]}, sp {sp}"
+        )
+    out = attn_fn(to_seq(q), to_seq(k), to_seq(v), causal=causal, scale=scale)
+    return to_heads(out)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh=None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    impl: str = "ring",
+    axis_name: str = "sp",
+) -> jax.Array:
+    """shard_map wrapper over global BSHD tensors, manual over the `sp` axis ONLY.
+
+    Batch/head dims stay in GSPMD auto mode (dp/fsdp/tp — and pp when nested inside a
+    pipeline stage), so this composes with every other parallelism axis. Usable inside a
+    jitted train step traced under `use_mesh(mesh)` (mesh=None → ambient mesh).
+    """
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    spec = P(None, axis_name, None, None)
+    in_specs = (spec, spec, spec)
+    args = (q, k, v)
+    kwargs = dict(axis_name=axis_name, causal=causal, scale=scale)
+    if segment_ids is not None:
+        if impl != "ring":
+            raise NotImplementedError("segment_ids only supported with impl='ring'")
+        in_specs = in_specs + (P(None, axis_name),)
+        args = args + (segment_ids,)
+
+        def wrapped(q, k, v, seg):
+            return ring_attention(q, k, v, segment_ids=seg, **kwargs)
+
+    else:
+
+        def wrapped(q, k, v):
+            return fn(q, k, v, **kwargs)
+
+    mapped = jax.shard_map(
+        wrapped,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=spec,
+        axis_names={axis_name},
+    )
+    return mapped(*args)

@@ -1,0 +1,122 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the `pp` mesh axis.
+
+Reference capability: the reference only *places* TP×PP workers for vLLM
+(SURVEY.md §2.3 TP/PP row); the actual pipeline engine is external. Here it is native:
+stages are mesh shards, activations hop stage→stage via `lax.ppermute` over ICI/DCN, and
+the whole schedule compiles into the train step (bubbles and all), so autodiff gives the
+1F1B-equivalent gradient accumulation for free.
+
+Layout: stage-stacked params (leading axis = pp, sharded over "pp"); inputs split into M
+microbatches. The schedule runs M + pp - 1 ticks; each tick every stage runs its layer on
+its current microbatch and ppermutes the result forward. Other mesh axes (dp/fsdp/tp/sp)
+stay in GSPMD "auto" mode inside the stage function — pipeline composes with them.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_spmd(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x_mb: jax.Array,
+    *,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Collective pipeline schedule; call inside shard_map manual over `axis_name`.
+
+    stage_fn(params, x) -> y with y.shape == x.shape (a transformer block stack).
+    stage_params: THIS stage's params. x_mb: [M, ...] microbatches (same array on every
+    stage; only stage 0 consumes it). Returns [M, ...] outputs on every stage.
+    """
+    pp = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    ticks = m + pp - 1
+    # pcast-to-varying: the carry is device-varying from tick 1 on; the init must match
+    # the full varying set (pp plus any other manual axes x_mb carries, e.g. sp) —
+    # adding only the axes the value doesn't already vary over.
+    def _vary(z):
+        try:
+            want = set(jax.typeof(x_mb).vma) | {axis_name}
+            have = set(jax.typeof(z).vma)
+        except Exception:
+            want, have = {axis_name}, set()
+        need = tuple(want - have)
+        if not need:
+            return z
+        if hasattr(lax, "pcast"):
+            return lax.pcast(z, need, to="varying")
+        return lax.pvary(z, need)
+
+    y0 = _vary(jnp.zeros_like(x_mb))
+    buf0 = _vary(jnp.zeros_like(x_mb[0]))
+    fwd = [(i, i + 1) for i in range(pp - 1)]  # non-circular: stage 0 receives zeros
+
+    def body(carry, t):
+        buf, y = carry
+        inp = jnp.where(stage == 0, x_mb[jnp.clip(t, 0, m - 1)], buf)
+        out = stage_fn(stage_params, inp)
+        mb = t - (pp - 1)
+        done = lax.dynamic_update_index_in_dim(y, out, jnp.clip(mb, 0, m - 1), 0)
+        y = jnp.where((stage == pp - 1) & (mb >= 0), done, y)
+        buf_next = lax.ppermute(out, axis_name, fwd) if pp > 1 else buf
+        return (buf_next, y), None
+
+    (_, y), _ = lax.scan(body, (buf0, y0), jnp.arange(ticks))
+    # Hand the last stage's outputs to every stage (loss is then computed redundantly —
+    # the SPMD idiom; XLA keeps one copy per pp group member).
+    return lax.psum(jnp.where(stage == pp - 1, y, jnp.zeros_like(y)), axis_name)
+
+
+def pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    num_microbatches: int,
+    mesh=None,
+    axis_name: str = "pp",
+    x_spec: P = None,
+    extra_manual: tuple = (),
+) -> jax.Array:
+    """Driver-level wrapper: global [B, ...] input, stage-stacked params.
+
+    stacked_params: pytree whose leaves have leading dim pp, sharded P("pp", ...).
+    Splits x into `num_microbatches`, runs the schedule, returns [B, ...] outputs.
+    Jit-friendly: trace under use_mesh(mesh) or pass mesh explicitly.
+
+    `extra_manual` names additional mesh axes the stage itself handles collectively
+    (e.g. "sp" when the stage runs ring attention); `x_spec` is the PartitionSpec of one
+    microbatch [B/M, ...] over those axes. Nested shard_map is not composable (sdy
+    rejects re-bound axes), so pp and sp share ONE manual region here.
+    """
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by num_microbatches {num_microbatches}")
+    x_mb = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    manual = {axis_name, *extra_manual}
+    mb_spec = P(None, *(x_spec or P())) if (x_spec or extra_manual) else P()
+
+    def inner(params, x_mb):
+        from .sharding import manual_axes
+
+        local = jax.tree_util.tree_map(lambda p: p[0], params)  # drop stage axis (len 1)
+        with manual_axes(*manual):
+            return pipeline_spmd(stage_fn, local, x_mb, axis_name=axis_name)
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    mapped = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(param_specs, mb_spec),
+        out_specs=mb_spec,
+        axis_names=manual,
+    )
+    y_mb = mapped(stacked_params, x_mb)
+    return y_mb.reshape(b, *x.shape[1:])

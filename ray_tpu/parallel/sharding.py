@@ -105,12 +105,54 @@ def shard_pytree(tree, axes_tree, mesh: Mesh, rules: AxisRules = TRAIN_RULES):
     return jax.tree.map(_put, tree, axes_tree, is_leaf=lambda x: x is None)
 
 
+_MANUAL_AXES: "contextvars.ContextVar[frozenset]" = None  # initialized below
+
+
 def with_sharding_constraint(x, *logical_axes: LogicalAxis, rules: AxisRules = TRAIN_RULES):
-    """In-jit sharding hint using logical names. No-op outside jit or without a mesh."""
+    """In-jit sharding hint using logical names. No-op outside jit or without a mesh.
+
+    Mesh axes currently bound manually (inside a shard_map region entered via
+    `manual_axes()`) are dropped from the constraint — GSPMD may only constrain auto axes.
+    """
     try:
         mesh = jax.sharding.get_abstract_mesh()  # jax>=0.4.35 path
         if mesh is None or mesh.empty:
             return x
     except Exception:
         return x
-    return jax.lax.with_sharding_constraint(x, rules.spec(logical_axes))
+    spec = rules.spec(logical_axes)
+    manual = active_manual_axes()
+    if manual:
+        def _filt(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, tuple):
+                kept = tuple(a for a in entry if a not in manual)
+                return kept if kept else None
+            return None if entry in manual else entry
+
+        spec = P(*(_filt(e) for e in spec))
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+# -- manual-axes context ---------------------------------------------------------------
+# shard_map callees (pipeline stages, ring attention) trace model code while some mesh
+# axes are manual; with_sharding_constraint must not reference those. Code entering a
+# manual region wraps the trace in `with manual_axes("pp", "sp"): ...`.
+import contextlib as _contextlib
+import contextvars as _contextvars
+
+_MANUAL_AXES = _contextvars.ContextVar("ray_tpu_manual_axes", default=frozenset())
+
+
+def active_manual_axes() -> frozenset:
+    return _MANUAL_AXES.get()
+
+
+@_contextlib.contextmanager
+def manual_axes(*names: str):
+    token = _MANUAL_AXES.set(_MANUAL_AXES.get() | frozenset(names))
+    try:
+        yield
+    finally:
+        _MANUAL_AXES.reset(token)

@@ -1,0 +1,77 @@
+"""Ring / Ulysses sequence-parallel attention vs the XLA reference (8-device CPU mesh)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import attention_reference
+from ray_tpu.ops.ring_attention import ring_attention_sharded
+from ray_tpu.parallel import local_mesh, use_mesh
+
+
+def _qkv(b=2, s=32, h=4, hkv=4, d=8, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, hkv, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(causal):
+    mesh = local_mesh(dp=2, sp=2, tp=2)
+    q, k, v = _qkv()
+    ref = attention_reference(q, k, v, causal=causal)
+    with use_mesh(mesh):
+        out = ring_attention_sharded(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gqa():
+    mesh = local_mesh(sp=4, tp=2)
+    q, k, v = _qkv(h=4, hkv=2)
+    ref = attention_reference(q, k, v, causal=True)
+    with use_mesh(mesh):
+        out = ring_attention_sharded(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_segment_ids():
+    mesh = local_mesh(dp=2, sp=4)
+    q, k, v = _qkv(b=2, s=32, h=2, hkv=2)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 16), jnp.int32), jnp.ones((2, 16), jnp.int32)], axis=1
+    )
+    ref = attention_reference(q, k, v, causal=True, segment_ids=seg)
+    with use_mesh(mesh):
+        out = ring_attention_sharded(q, k, v, mesh=mesh, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_matches_reference():
+    mesh = local_mesh(dp=2, sp=4)
+    q, k, v = _qkv(h=4, hkv=4)
+    ref = attention_reference(q, k, v, causal=True)
+    with use_mesh(mesh):
+        out = ring_attention_sharded(q, k, v, mesh=mesh, causal=True, impl="ulysses")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_grads_flow():
+    """AD through the ring (ppermute + scan) must produce finite grads."""
+    mesh = local_mesh(dp=2, sp=2, tp=2)
+    q, k, v = _qkv(s=16)
+
+    def loss(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh=mesh, causal=True) ** 2)
+
+    with use_mesh(mesh):
+        g = jax.grad(loss)(q, k, v)
+    assert all(bool(jnp.isfinite(x).all()) for x in g)
+
+    # Matches reference grads.
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4, rtol=1e-4)
