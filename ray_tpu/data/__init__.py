@@ -1,0 +1,116 @@
+"""ray_tpu.data: lazy distributed datasets over the ray_tpu object store.
+
+Capability parity: reference python/ray/data/ (read_api.py, dataset.py). Blocks are
+arrow tables; the streaming executor schedules map stages as ray_tpu tasks/actor pools
+with bounded in-flight work; `iter_jax_batches` hands sharded device arrays to trainers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import aggregate  # noqa: F401
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Quantile, Std, Sum  # noqa: F401
+from .block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from .context import DataContext  # noqa: F401
+from .dataset import Dataset, GroupedData  # noqa: F401
+from .datasource import (  # noqa: F401
+    BinaryDatasource,
+    CSVDatasource,
+    Datasink,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    TextDatasource,
+)
+from .iterator import DataIterator  # noqa: F401
+from .logical import Read
+
+
+def _read(ds: Datasource, parallelism: int = -1) -> Dataset:
+    return Dataset(Read(ds, parallelism))
+
+
+def range(n: int, *, parallelism: int = -1, column: str = "id") -> Dataset:  # noqa: A001
+    return _read(RangeDatasource(n, column), parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return _read(ItemsDatasource(list(items)), parallelism)
+
+
+def from_numpy(arrays, *, parallelism: int = -1) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    return _read(NumpyDatasource(arrays), parallelism)
+
+
+def from_pandas(df, *, parallelism: int = -1) -> Dataset:
+    import pyarrow as pa
+
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    return from_arrow(table)
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset._from_blocks([table])
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None, parallelism: int = -1, **kw) -> Dataset:
+    return _read(ParquetDatasource(paths, columns=columns, **kw), parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _read(CSVDatasource(paths, **kw), parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _read(JSONDatasource(paths, **kw), parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _read(BinaryDatasource(paths, **kw), parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _read(TextDatasource(paths, **kw), parallelism)
+
+
+def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
+    return _read(ds, parallelism)
+
+
+__all__ = [
+    "Dataset",
+    "GroupedData",
+    "DataIterator",
+    "DataContext",
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "Datasource",
+    "Datasink",
+    "range",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "from_arrow",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_binary_files",
+    "read_text",
+    "read_datasource",
+    "AggregateFn",
+    "Count",
+    "Sum",
+    "Min",
+    "Max",
+    "Mean",
+    "Std",
+    "Quantile",
+]

@@ -1,0 +1,81 @@
+"""Aggregations (reference python/ray/data/aggregate.py: AggregateFn, Count/Sum/Min/...)."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .block import Block, BlockAccessor
+
+
+class AggregateFn:
+    def __init__(self, on: Optional[str], name: str, fn: Callable[[np.ndarray], float]):
+        self.on = on
+        self.name = name
+        self.fn = fn
+
+
+class Count(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(on, "count()" if on is None else f"count({on})", lambda a: len(a))
+
+
+class Sum(AggregateFn):
+    def __init__(self, on: str):
+        super().__init__(on, f"sum({on})", lambda a: np.sum(a))
+
+
+class Min(AggregateFn):
+    def __init__(self, on: str):
+        super().__init__(on, f"min({on})", lambda a: np.min(a))
+
+
+class Max(AggregateFn):
+    def __init__(self, on: str):
+        super().__init__(on, f"max({on})", lambda a: np.max(a))
+
+
+class Mean(AggregateFn):
+    def __init__(self, on: str):
+        super().__init__(on, f"mean({on})", lambda a: float(np.mean(a)))
+
+
+class Std(AggregateFn):
+    def __init__(self, on: str, ddof: int = 1):
+        super().__init__(on, f"std({on})", lambda a: float(np.std(a, ddof=ddof)) if len(a) > ddof else 0.0)
+
+
+class Quantile(AggregateFn):
+    def __init__(self, on: str, q: float = 0.5):
+        super().__init__(on, f"quantile({on})", lambda a: float(np.quantile(a, q)))
+
+
+class AbsMax(AggregateFn):
+    def __init__(self, on: str):
+        super().__init__(on, f"abs_max({on})", lambda a: float(np.max(np.abs(a))))
+
+
+def aggregate_block(block: Block, key: Optional[str], aggs: List[AggregateFn]) -> Block:
+    """Apply aggregations to one (hash-partitioned) block, optionally grouped by key."""
+    acc = BlockAccessor.for_block(block)
+    cols = acc.to_numpy()
+    if acc.num_rows() == 0:
+        return BlockAccessor.empty()
+    if key is None:
+        row = {}
+        for agg in aggs:
+            arr = cols[agg.on] if agg.on else next(iter(cols.values()))
+            row[agg.name] = agg.fn(arr)
+        return pa.Table.from_pylist([row])
+    keys = cols[key]
+    uniq = sorted(set(keys.tolist()))
+    rows = []
+    for k in uniq:
+        mask = keys == k
+        row = {key: k}
+        for agg in aggs:
+            arr = cols[agg.on][mask] if agg.on else keys[mask]
+            row[agg.name] = agg.fn(arr)
+        rows.append(row)
+    return pa.Table.from_pylist(rows)

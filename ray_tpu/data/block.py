@@ -1,0 +1,195 @@
+"""Blocks: the unit of data movement in ray_tpu.data.
+
+Capability parity: reference python/ray/data/block.py (Block/BlockAccessor/BlockMetadata)
+and _internal/arrow_block.py / pandas_block.py. A block is a pyarrow.Table travelling
+through the object store as an ObjectRef; accessors convert between batch formats.
+
+TPU-first note: the "numpy" batch format (dict[str, np.ndarray]) is the native handoff
+into jax.device_put / trainer ingest — columnar, zero-copy via arrow buffers where dtypes allow.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+# A Block is a pyarrow Table. Batches handed to UDFs are format-converted views.
+Block = pa.Table
+# What UDFs may return / datasources may yield; normalized via BlockAccessor.batch_to_block.
+DataBatch = Union[pa.Table, Dict[str, np.ndarray], "pandas.DataFrame", List[dict]]
+
+TENSOR_COLUMN_DTYPE = object  # multi-dim ndarrays are stored as arrow lists per row
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    """Stats carried alongside a block ref (reference block.py BlockMetadata)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: Optional[List[str]] = None
+    exec_stats: Optional[Dict[str, float]] = None
+
+
+def _numpy_to_arrow_array(arr: np.ndarray) -> pa.Array:
+    if arr.ndim == 1:
+        if arr.dtype.kind == "U" or arr.dtype == object:
+            return pa.array(arr.tolist())
+        return pa.array(arr)
+    # Multi-dim tensor column -> FixedSizeList so round-trips preserve shape.
+    inner_len = int(np.prod(arr.shape[1:]))
+    flat = pa.array(np.ascontiguousarray(arr).reshape(-1))
+    fsl = pa.FixedSizeListArray.from_arrays(flat, inner_len)
+    return fsl
+
+
+class BlockAccessor:
+    """Format conversions + row ops over one block (reference BlockAccessor)."""
+
+    def __init__(self, block: pa.Table):
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # ---- construction -------------------------------------------------------
+    @staticmethod
+    def batch_to_block(batch: DataBatch, tensor_shapes: Optional[Dict[str, tuple]] = None) -> Block:
+        """Normalize any UDF/datasource output into a pyarrow Table."""
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, pa.RecordBatch):
+            return pa.Table.from_batches([batch])
+        if isinstance(batch, dict):
+            cols, names, meta_shapes = [], [], {}
+            for name, col in batch.items():
+                arr = np.asarray(col) if not isinstance(col, np.ndarray) else col
+                if arr.ndim == 0:
+                    arr = arr.reshape(1)
+                if arr.ndim > 1:
+                    meta_shapes[name] = arr.shape[1:]
+                cols.append(_numpy_to_arrow_array(arr))
+                names.append(name)
+            t = pa.Table.from_arrays(cols, names=names)
+            if meta_shapes:
+                md = dict(t.schema.metadata or {})
+                for k, shp in meta_shapes.items():
+                    md[f"tensor_shape:{k}".encode()] = repr(tuple(int(s) for s in shp)).encode()
+                t = t.replace_schema_metadata(md)
+            return t
+        try:
+            import pandas as pd
+
+            if isinstance(batch, pd.DataFrame):
+                return pa.Table.from_pandas(batch, preserve_index=False)
+        except ImportError:
+            pass
+        if isinstance(batch, list):  # list of row dicts
+            if not batch:
+                return pa.table({})
+            return pa.Table.from_pylist(batch)
+        raise TypeError(f"cannot convert batch of type {type(batch)} to a block")
+
+    @staticmethod
+    def empty() -> Block:
+        return pa.table({})
+
+    # ---- introspection ------------------------------------------------------
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def get_metadata(self, input_files: Optional[List[str]] = None, exec_stats=None) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=self.num_rows(),
+            size_bytes=self.size_bytes(),
+            schema=self.schema(),
+            input_files=input_files,
+            exec_stats=exec_stats,
+        )
+
+    def _tensor_shape(self, name: str) -> Optional[tuple]:
+        md = self._table.schema.metadata or {}
+        raw = md.get(f"tensor_shape:{name}".encode())
+        return eval(raw.decode()) if raw else None  # noqa: S307 - repr of int tuple
+
+    # ---- batch formats ------------------------------------------------------
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def to_numpy(self, columns: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        out = {}
+        for name in columns or self._table.column_names:
+            col = self._table.column(name)
+            shape = self._tensor_shape(name)
+            if shape is not None or pa.types.is_fixed_size_list(col.type) or pa.types.is_list(col.type):
+                arr = np.asarray(col.combine_chunks().to_pylist())
+                if shape is not None:
+                    arr = arr.reshape((len(arr),) + tuple(shape))
+            else:
+                try:
+                    arr = col.combine_chunks().to_numpy(zero_copy_only=False)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                    arr = np.asarray(col.to_pylist(), dtype=object)
+            out[name] = arr
+        return out
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_batch_format(self, batch_format: Optional[str]):
+        if batch_format in (None, "default", "numpy"):
+            return self.to_numpy()
+        if batch_format == "pyarrow":
+            return self.to_arrow()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        raise ValueError(f"unknown batch_format {batch_format!r} (use numpy|pyarrow|pandas)")
+
+    # ---- row ops ------------------------------------------------------------
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        cols = self.to_numpy()
+        names = list(cols)
+        for i in range(self.num_rows()):
+            yield {n: cols[n][i] for n in names}
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take(self, indices: np.ndarray) -> Block:
+        return self._table.take(pa.array(indices))
+
+    def sample(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.default_rng(seed)
+        idx = rng.choice(self.num_rows(), size=min(n, self.num_rows()), replace=False)
+        return self.take(idx)
+
+    def sort(self, key: str, descending: bool = False) -> Block:
+        order = "descending" if descending else "ascending"
+        return self._table.sort_by([(key, order)])
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0]
+        if not blocks:
+            return BlockAccessor.empty()
+        try:
+            return pa.concat_tables(blocks, promote_options="default")
+        except TypeError:
+            return pa.concat_tables(blocks)
+
+    def split_by_sizes(self, sizes: List[int]) -> List[Block]:
+        out, off = [], 0
+        for s in sizes:
+            out.append(self.slice(off, off + s))
+            off += s
+        return out

@@ -1,0 +1,32 @@
+"""DataContext: per-driver execution configuration.
+
+Capability parity: reference python/ray/data/context.py:285 (DataContext).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    default_batch_size: int = 1024
+    read_op_min_num_blocks: int = 8
+    # Streaming executor backpressure: max block refs buffered between operators.
+    max_inflight_tasks_per_op: int = 8
+    op_output_buffer_limit: int = 16
+    actor_pool_min_size: int = 1
+    actor_pool_max_size: int = 4
+    use_push_based_shuffle: bool = False
+    enable_progress_bars: bool = False
+    seed: Optional[int] = None
+
+    _current: "Optional[DataContext]" = None
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        if DataContext._current is None:
+            DataContext._current = DataContext()
+        return DataContext._current

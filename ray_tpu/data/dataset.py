@@ -1,0 +1,352 @@
+"""Dataset: the lazy distributed data API.
+
+Capability parity: reference python/ray/data/dataset.py:160 — map_batches (:449),
+iter_batches (:4664), materialize (:5626), plus filter/flat_map/sort/shuffle/groupby/
+split/union/zip/write_* and schema/count/take introspection.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu
+
+from . import logical as L
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .block import BlockAccessor, BlockMetadata
+from .context import DataContext
+from .datasource import CSVDatasink, Datasink, JSONDatasink, ParquetDatasink
+from .execution import RefBundle, StreamingExecutor
+from .iterator import DataIterator
+from .stats import DatasetStats
+
+
+class Dataset:
+    def __init__(self, plan: L.LogicalOperator, ctx: Optional[DataContext] = None):
+        self._plan = plan
+        self._ctx = ctx or DataContext.get_current()
+        self._materialized: Optional[List[RefBundle]] = None
+        self._stats: Optional[DatasetStats] = None
+
+    # -- plan builders --------------------------------------------------------
+    def _with(self, op: L.LogicalOperator) -> "Dataset":
+        return Dataset(op, self._ctx)
+
+    def _input_op(self) -> L.LogicalOperator:
+        # Chain from materialized blocks if available (so reuse skips recompute).
+        if self._materialized is not None:
+            return L.InputData([b for b, _ in self._materialized], [m for _, m in self._materialized])
+        return self._plan
+
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional[str] = None,
+        fn_args: Tuple = (),
+        fn_kwargs: Optional[Dict] = None,
+        fn_constructor_args: Tuple = (),
+        fn_constructor_kwargs: Optional[Dict] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        concurrency: Optional[Any] = None,
+        **_compat,
+    ) -> "Dataset":
+        if isinstance(fn, type) and compute is None:
+            compute = "actors"
+        spec = L.MapSpec(
+            kind="map_batches", fn=fn, fn_args=fn_args, fn_kwargs=fn_kwargs or {},
+            fn_constructor_args=fn_constructor_args, fn_constructor_kwargs=fn_constructor_kwargs or {},
+            batch_size=batch_size, batch_format=batch_format,
+        )
+        remote_args = {}
+        if num_cpus is not None:
+            remote_args["num_cpus"] = num_cpus
+        if num_tpus:
+            remote_args["num_tpus"] = num_tpus
+        return self._with(L.AbstractMap(self._input_op(), spec, compute, remote_args, concurrency))
+
+    def map(self, fn: Callable[[Dict], Dict], **kw) -> "Dataset":
+        return self._with(L.AbstractMap(self._input_op(), L.MapSpec(kind="map_rows", fn=fn)))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]], **kw) -> "Dataset":
+        return self._with(L.AbstractMap(self._input_op(), L.MapSpec(kind="flat_map", fn=fn)))
+
+    def filter(self, fn: Callable[[Dict], bool], **kw) -> "Dataset":
+        return self._with(L.AbstractMap(self._input_op(), L.MapSpec(kind="filter", fn=fn)))
+
+    def add_column(self, name: str, fn: Callable[[Dict[str, np.ndarray]], np.ndarray]) -> "Dataset":
+        return self._with(L.AbstractMap(self._input_op(), L.MapSpec(kind="add_column", fn=fn, fn_args=(name,))))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._with(L.AbstractMap(self._input_op(), L.MapSpec(kind="drop_columns", fn=None, fn_args=(cols,))))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._with(L.AbstractMap(self._input_op(), L.MapSpec(kind="select_columns", fn=None, fn_args=(cols,))))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self._with(L.AbstractMap(self._input_op(), L.MapSpec(kind="rename_columns", fn=None, fn_args=(mapping,))))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(L.Limit(self._input_op(), n))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(L.Sort(self._input_op(), key, descending))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(L.RandomShuffle(self._input_op(), seed))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(L.Repartition(self._input_op(), num_blocks))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(L.Union(self._input_op(), [o._input_op() for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(L.Zip(self._input_op(), other._input_op()))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -- execution ------------------------------------------------------------
+    def materialize(self) -> "Dataset":
+        if self._materialized is None:
+            ex = StreamingExecutor(self._ctx)
+            self._materialized = ex.execute(self._plan)
+            self._stats = ex.stats
+        return self
+
+    def _bundles(self) -> List[RefBundle]:
+        self.materialize()
+        return self._materialized
+
+    def stats(self) -> str:
+        self.materialize()
+        return self._stats.summary() if self._stats else ""
+
+    # -- consumption ----------------------------------------------------------
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._bundles())
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_rows()
+
+    def iter_torch_batches(self, **kw):
+        return self.iterator().iter_torch_batches(**kw)
+
+    def iter_jax_batches(self, **kw):
+        return self.iterator().iter_jax_batches(**kw)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out = []
+        for row in self.iter_rows():
+            out.append({k: (v.item() if isinstance(v, np.generic) else v) for k, v in row.items()})
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return [
+            {k: (v.item() if isinstance(v, np.generic) else v) for k, v in row.items()}
+            for row in self.iter_rows()
+        ]
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        total = 0
+        for b, m in self._bundles():
+            total += m.num_rows if m.num_rows >= 0 else BlockAccessor.for_block(ray_tpu.get(b)).num_rows()
+        return total
+
+    def num_blocks(self) -> int:
+        return len(self._bundles())
+
+    def schema(self):
+        for b, m in self._bundles():
+            if m.schema is not None:
+                return m.schema
+            return BlockAccessor.for_block(ray_tpu.get(b)).schema()
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def size_bytes(self) -> int:
+        return sum(max(m.size_bytes, 0) for _, m in self._bundles())
+
+    # -- aggregation shortcuts -------------------------------------------------
+    def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
+        ds = self._with(L.Aggregate(self._input_op(), None, list(aggs)))
+        rows = ds.take_all()
+        return rows[0] if rows else {}
+
+    def sum(self, on: str):
+        return self.aggregate(Sum(on)).get(f"sum({on})")
+
+    def min(self, on: str):
+        return self.aggregate(Min(on)).get(f"min({on})")
+
+    def max(self, on: str):
+        return self.aggregate(Max(on)).get(f"max({on})")
+
+    def mean(self, on: str):
+        return self.aggregate(Mean(on)).get(f"mean({on})")
+
+    def std(self, on: str):
+        return self.aggregate(Std(on)).get(f"std({on})")
+
+    # -- splitting ------------------------------------------------------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        bundles = self._bundles()
+        if equal:
+            total = self.count()
+            per = total // n
+            sizes = [per] * n
+            # rows beyond n*per are dropped (reference split(equal=True) semantics)
+            merged = BlockAccessor.concat([ray_tpu.get(b) for b, _ in bundles])
+            acc = BlockAccessor.for_block(merged)
+            out, start = [], 0
+            for s in sizes:
+                blk = acc.slice(start, start + s)
+                start += s
+                out.append(Dataset._from_blocks([blk]))
+            return out
+        shards: List[List[RefBundle]] = [[] for _ in range(n)]
+        for i, bundle in enumerate(bundles):
+            shards[i % n].append(bundle)
+        return [Dataset._from_bundles(s) for s in shards]
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        merged = BlockAccessor.concat([ray_tpu.get(b) for b, _ in self._bundles()])
+        acc = BlockAccessor.for_block(merged)
+        out, prev = [], 0
+        for idx in list(indices) + [acc.num_rows()]:
+            out.append(Dataset._from_blocks([acc.slice(prev, idx)]))
+            prev = idx
+        return out
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False, seed: Optional[int] = None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        n = ds.count()
+        n_test = int(n * test_size) if isinstance(test_size, float) else test_size
+        parts = ds.split_at_indices([n - n_test])
+        return parts[0], parts[1]
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        import os as _os
+        import zlib as _zlib
+
+        rng_seed = seed if seed is not None else int.from_bytes(_os.urandom(4), "little")
+
+        def sample_fn(batch: Dict[str, np.ndarray], fraction=fraction, rng_seed=rng_seed):
+            n = len(next(iter(batch.values()))) if batch else 0
+            # Salt by batch content so each block draws an independent mask.
+            salt = _zlib.crc32(next(iter(batch.values())).tobytes()[:1024]) if n else 0
+            rng = np.random.default_rng((rng_seed, salt))
+            mask = rng.random(n) < fraction
+            return {k: v[mask] for k, v in batch.items()}
+
+        return self.map_batches(sample_fn, batch_format="numpy")
+
+    # -- writes ---------------------------------------------------------------
+    def _write(self, sink: Datasink) -> List[str]:
+        ds = self._with(L.Write(self._input_op(), sink))
+        return [r["path"] for r in ds.take_all()]
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(ParquetDatasink(path))
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(CSVDatasink(path))
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(JSONDatasink(path))
+
+    # -- conversion -----------------------------------------------------------
+    def to_pandas(self):
+        return BlockAccessor.concat([ray_tpu.get(b) for b, _ in self._bundles()]).to_pandas()
+
+    def to_arrow(self):
+        return BlockAccessor.concat([ray_tpu.get(b) for b, _ in self._bundles()])
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        return BlockAccessor.for_block(self.to_arrow()).to_numpy()
+
+    # -- internal constructors -------------------------------------------------
+    @staticmethod
+    def _from_blocks(blocks: List[Any]) -> "Dataset":
+        refs = [ray_tpu.put(b) for b in blocks]
+        metas = [BlockAccessor.for_block(b).get_metadata() for b in blocks]
+        ds = Dataset(L.InputData(refs, metas))
+        ds._materialized = list(zip(refs, metas))
+        return ds
+
+    @staticmethod
+    def _from_bundles(bundles: List[RefBundle]) -> "Dataset":
+        ds = Dataset(L.InputData([b for b, _ in bundles], [m for _, m in bundles]))
+        ds._materialized = list(bundles)
+        return ds
+
+    def __repr__(self):
+        try:
+            cols = self.columns() if self._materialized is not None else None
+        except Exception:
+            cols = None
+        if cols is not None:
+            return f"Dataset(num_blocks={len(self._materialized)}, columns={cols})"
+        return f"Dataset(plan={'->'.join(str(o) for o in self._plan.chain())})"
+
+
+class GroupedData:
+    """Reference python/ray/data/grouped_data.py."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        return self._ds._with(L.Aggregate(self._ds._input_op(), self._key, list(aggs)))
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        key = self._key
+
+        def apply(batch: Dict[str, np.ndarray]):
+            keys = batch[key]
+            out = []
+            for k in sorted(set(keys.tolist())):
+                mask = keys == k
+                group = {c: v[mask] for c, v in batch.items()}
+                out.append(BlockAccessor.batch_to_block(fn(group)))
+            return BlockAccessor.concat(out)
+
+        # groups must be colocated: sort by key first, single output block per input
+        return self._ds.sort(key).repartition(1).map_batches(apply, batch_format="numpy")

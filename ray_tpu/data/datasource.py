@@ -1,0 +1,249 @@
+"""Datasources and datasinks.
+
+Capability parity: reference python/ray/data/datasource/ + _internal/datasource/
+(parquet/csv/json/range/binary read; parquet/csv/json write). A Datasource yields
+ReadTasks — serializable thunks each producing one block — which the executor schedules
+as ray_tpu tasks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .block import Block, BlockAccessor, BlockMetadata
+
+
+@dataclasses.dataclass
+class ReadTask:
+    """One schedulable unit of reading; fn() -> iterable of Blocks."""
+
+    fn: Callable[[], Iterable[Block]]
+    metadata: BlockMetadata
+
+
+class Datasource:
+    """ABC (reference datasource.py:Datasource)."""
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files) if not f.startswith("."))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files found for {paths}")
+    return out
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, column: str = "id"):
+        self.n = n
+        self.column = column
+
+    def estimate_inmemory_data_size(self):
+        return self.n * 8
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        tasks = []
+        per = self.n // parallelism
+        rem = self.n % parallelism
+        start = 0
+        for i in range(parallelism):
+            cnt = per + (1 if i < rem else 0)
+            if cnt == 0:
+                continue
+            s, e, col = start, start + cnt, self.column
+
+            def fn(s=s, e=e, col=col):
+                yield pa.table({col: np.arange(s, e, dtype=np.int64)})
+
+            tasks.append(ReadTask(fn, BlockMetadata(num_rows=cnt, size_bytes=cnt * 8)))
+            start += cnt
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self.items = items
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self.items)
+        parallelism = max(1, min(parallelism, n or 1))
+        tasks = []
+        per, rem, start = n // parallelism, n % parallelism, 0
+        for i in range(parallelism):
+            cnt = per + (1 if i < rem else 0)
+            if cnt == 0:
+                continue
+            chunk = self.items[start : start + cnt]
+
+            def fn(chunk=chunk):
+                if chunk and isinstance(chunk[0], dict):
+                    yield pa.Table.from_pylist(chunk)
+                else:
+                    yield BlockAccessor.batch_to_block({"item": np.asarray(chunk)})
+
+            tasks.append(ReadTask(fn, BlockMetadata(num_rows=cnt, size_bytes=0)))
+            start += cnt
+        return tasks
+
+
+class _FileDatasource(Datasource):
+    def __init__(self, paths, **read_kwargs):
+        self.paths = _expand_paths(paths)
+        self.read_kwargs = read_kwargs
+
+    def _read_file(self, path: str) -> Block:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.paths:
+            def fn(path=path):
+                yield self._read_file(path)
+
+            size = os.path.getsize(path) if os.path.exists(path) else 0
+            tasks.append(ReadTask(fn, BlockMetadata(num_rows=-1, size_bytes=size, input_files=[path])))
+        return tasks
+
+
+class ParquetDatasource(_FileDatasource):
+    def __init__(self, paths, columns: Optional[List[str]] = None, **kw):
+        super().__init__(paths, **kw)
+        self.columns = columns
+
+    def _read_file(self, path: str) -> Block:
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, columns=self.columns, **self.read_kwargs)
+
+
+class CSVDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Block:
+        from pyarrow import csv
+
+        return csv.read_csv(path, **self.read_kwargs)
+
+
+class JSONDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Block:
+        from pyarrow import json as pj
+
+        return pj.read_json(path, **self.read_kwargs)
+
+
+class BinaryDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        return pa.table({"bytes": pa.array([data], type=pa.binary()), "path": [path]})
+
+
+class TextDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Block:
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return pa.table({"text": lines})
+
+
+class NumpyDatasource(Datasource):
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        self.arrays = arrays
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(next(iter(self.arrays.values())))
+        parallelism = max(1, min(parallelism, n or 1))
+        per, rem, start = n // parallelism, n % parallelism, 0
+        tasks = []
+        for i in range(parallelism):
+            cnt = per + (1 if i < rem else 0)
+            if cnt == 0:
+                continue
+            chunk = {k: v[start : start + cnt] for k, v in self.arrays.items()}
+
+            def fn(chunk=chunk):
+                yield BlockAccessor.batch_to_block(chunk)
+
+            tasks.append(ReadTask(fn, BlockMetadata(num_rows=cnt, size_bytes=sum(v.nbytes for v in chunk.values()))))
+            start += cnt
+        return tasks
+
+
+# ---- sinks ------------------------------------------------------------------
+
+
+class Datasink:
+    """Write ABC (reference datasource.py:Datasink). write() runs inside a task."""
+
+    def write(self, block: Block, task_index: int) -> str:
+        raise NotImplementedError
+
+
+class _FileDatasink(Datasink):
+    extension = "bin"
+
+    def __init__(self, path: str, filename_prefix: str = "part"):
+        self.path = path
+        self.filename_prefix = filename_prefix
+        os.makedirs(path, exist_ok=True)
+
+    def _target(self, task_index: int) -> str:
+        return os.path.join(self.path, f"{self.filename_prefix}-{task_index:06d}.{self.extension}")
+
+
+class ParquetDatasink(_FileDatasink):
+    extension = "parquet"
+
+    def write(self, block: Block, task_index: int) -> str:
+        import pyarrow.parquet as pq
+
+        target = self._target(task_index)
+        pq.write_table(block, target)
+        return target
+
+
+class CSVDatasink(_FileDatasink):
+    extension = "csv"
+
+    def write(self, block: Block, task_index: int) -> str:
+        from pyarrow import csv
+
+        target = self._target(task_index)
+        csv.write_csv(block, target)
+        return target
+
+
+class JSONDatasink(_FileDatasink):
+    extension = "json"
+
+    def write(self, block: Block, task_index: int) -> str:
+        import json
+
+        target = self._target(task_index)
+        rows = block.to_pylist()
+        with open(target, "w") as f:
+            for r in rows:
+                f.write(json.dumps({k: (v.tolist() if isinstance(v, np.ndarray) else v) for k, v in r.items()}) + "\n")
+        return target

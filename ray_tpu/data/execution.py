@@ -1,0 +1,443 @@
+"""Physical execution: streaming executor over ray_tpu tasks/actors.
+
+Capability parity: reference python/ray/data/_internal/execution/ — StreamingExecutor
+(streaming_executor.py:52), TaskPoolMapOperator / ActorPoolMapOperator
+(operators/*.py), backpressure policies. Map stages stream block-by-block with bounded
+in-flight tasks; all-to-all stages (sort/shuffle/aggregate/repartition) are barriers,
+as in the reference.
+"""
+from __future__ import annotations
+
+import os
+import time
+import types
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+
+from .block import Block, BlockAccessor, BlockMetadata
+from .context import DataContext
+from . import logical as L
+from .stats import DatasetStats, OpStats
+
+# ---- UDF application (runs inside worker tasks) -----------------------------
+
+
+def _apply_one_spec(spec: L.MapSpec, block: Block, fn_impl) -> Block:
+    acc = BlockAccessor.for_block(block)
+    kind = spec.kind
+    if kind == "map_batches":
+        out_blocks = []
+        n = acc.num_rows()
+        bs = spec.batch_size or n or 1
+        for start in range(0, max(n, 1), bs) if n else []:
+            batch = BlockAccessor.for_block(acc.slice(start, min(start + bs, n))).to_batch_format(spec.batch_format)
+            res = fn_impl(batch, *spec.fn_args, **spec.fn_kwargs)
+            if isinstance(res, types.GeneratorType) or (
+                hasattr(res, "__next__") and not isinstance(res, (dict, list, pa.Table))
+            ):
+                for r in res:
+                    out_blocks.append(BlockAccessor.batch_to_block(r))
+            else:
+                out_blocks.append(BlockAccessor.batch_to_block(res))
+        return BlockAccessor.concat(out_blocks)
+    if kind == "map_rows":
+        rows = [fn_impl(r, *spec.fn_args, **spec.fn_kwargs) for r in acc.iter_rows()]
+        return pa.Table.from_pylist(rows) if rows else BlockAccessor.empty()
+    if kind == "flat_map":
+        rows = []
+        for r in acc.iter_rows():
+            rows.extend(fn_impl(r, *spec.fn_args, **spec.fn_kwargs))
+        return pa.Table.from_pylist(rows) if rows else BlockAccessor.empty()
+    if kind == "filter":
+        mask = np.array([bool(fn_impl(r, *spec.fn_args, **spec.fn_kwargs)) for r in acc.iter_rows()])
+        return acc.take(np.nonzero(mask)[0]) if len(mask) else block
+    if kind == "add_column":
+        name, = spec.fn_args
+        col = fn_impl(acc.to_batch_format("numpy"))
+        return block.append_column(name, pa.array(np.asarray(col)))
+    if kind == "drop_columns":
+        return block.drop_columns(list(spec.fn_args[0]))
+    if kind == "select_columns":
+        return block.select(list(spec.fn_args[0]))
+    if kind == "rename_columns":
+        mapping = spec.fn_args[0]
+        return block.rename_columns([mapping.get(c, c) for c in block.column_names])
+    raise ValueError(f"unknown map kind {kind}")
+
+
+def _resolve_fn(spec: L.MapSpec, instances: Dict[int, Any], idx: int):
+    if isinstance(spec.fn, type):  # class-based UDF -> instantiate once per worker
+        if idx not in instances:
+            instances[idx] = spec.fn(*spec.fn_constructor_args, **spec.fn_constructor_kwargs)
+        return instances[idx]
+    return spec.fn
+
+
+def _map_block(specs: List[L.MapSpec], block: Block) -> Tuple[Block, BlockMetadata]:
+    instances: Dict[int, Any] = {}
+    for i, spec in enumerate(specs):
+        block = _apply_one_spec(spec, block, _resolve_fn(spec, instances, i))
+    return block, BlockAccessor.for_block(block).get_metadata()
+
+
+class _MapWorker:
+    """Actor-pool UDF host (reference actor_pool_map_operator.py:_MapWorker)."""
+
+    def __init__(self, specs: List[L.MapSpec]):
+        self.specs = specs
+        self.instances: Dict[int, Any] = {}
+        for i, spec in enumerate(self.specs):  # eager init so failures surface at pool start
+            _resolve_fn(spec, self.instances, i)
+
+    def ready(self) -> bool:
+        return True
+
+    def map_block(self, block: Block) -> Tuple[Block, BlockMetadata]:
+        for i, spec in enumerate(self.specs):
+            block = _apply_one_spec(spec, block, _resolve_fn(spec, self.instances, i))
+        return block, BlockAccessor.for_block(block).get_metadata()
+
+
+def _read_task_fn(read_fn, specs: List[L.MapSpec]):
+    blocks = list(read_fn())
+    block = BlockAccessor.concat(blocks) if len(blocks) != 1 else blocks[0]
+    return _map_block(specs, block) if specs else (block, BlockAccessor.for_block(block).get_metadata())
+
+
+def _write_block(datasink, block: Block, task_index: int) -> Tuple[str, int]:
+    path = datasink.write(block, task_index)
+    return path, block.num_rows
+
+
+# ---- all-to-all kernels (run as tasks) --------------------------------------
+
+
+def _partition_by_boundaries(block: Block, key: str, boundaries: List[Any]) -> List[Block]:
+    """Ascending range-partition; descending order is applied at merge time."""
+    acc = BlockAccessor.for_block(block)
+    sorted_block = acc.sort(key, descending=False)
+    col = BlockAccessor.for_block(sorted_block).to_numpy([key])[key]
+    cuts = [int(i) for i in np.searchsorted(col, boundaries, side="left")]
+    parts, prev = [], 0
+    for c in cuts + [len(col)]:
+        parts.append(BlockAccessor.for_block(sorted_block).slice(prev, c))
+        prev = c
+    return parts
+
+
+def _merge_sorted(key: str, descending: bool, *parts: Block) -> Tuple[Block, BlockMetadata]:
+    merged = BlockAccessor.concat(list(parts))
+    merged = BlockAccessor.for_block(merged).sort(key, descending)
+    return merged, BlockAccessor.for_block(merged).get_metadata()
+
+
+def _random_split_block(block: Block, n_out: int, seed: int, salt: int = 0) -> List[Block]:
+    rng = np.random.default_rng((seed, salt))
+    acc = BlockAccessor.for_block(block)
+    assign = rng.integers(0, n_out, size=acc.num_rows())
+    return [acc.take(np.nonzero(assign == p)[0]) for p in range(n_out)]
+
+
+def _merge_shuffled(seed: int, *parts: Block) -> Tuple[Block, BlockMetadata]:
+    merged = BlockAccessor.concat(list(parts))
+    acc = BlockAccessor.for_block(merged)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(acc.num_rows())
+    out = acc.take(perm)
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+def _hash_partition(block: Block, key: str, n_out: int) -> List[Block]:
+    acc = BlockAccessor.for_block(block)
+    col = acc.to_numpy([key])[key]
+    # Deterministic across worker processes (Python hash() is per-process salted).
+    hashes = np.array(
+        [zlib.crc32(repr(v).encode()) % n_out for v in col.tolist()], dtype=np.int64
+    )
+    return [acc.take(np.nonzero(hashes == p)[0]) for p in range(n_out)]
+
+
+def _agg_partition(key: Optional[str], aggs, *parts: Block) -> Tuple[Block, BlockMetadata]:
+    from .aggregate import aggregate_block
+
+    merged = BlockAccessor.concat(list(parts))
+    out = aggregate_block(merged, key, aggs)
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+# ---- executor ----------------------------------------------------------------
+
+_remote_cache: Dict[Tuple, Any] = {}
+
+
+def _remote(fn, **opts):
+    k = (fn.__name__, tuple(sorted(opts.items())))
+    if k not in _remote_cache:
+        _remote_cache[k] = ray_tpu.remote(**({"num_cpus": 1} | opts))(fn)
+    return _remote_cache[k]
+
+
+RefBundle = Tuple[Any, BlockMetadata]  # (ObjectRef[Block] | Block, metadata)
+
+
+class StreamingExecutor:
+    """Lower an optimized logical plan and run it (reference streaming_executor.py:52).
+
+    Map/read/write stages stream with at most ctx.max_inflight_tasks_per_op concurrent
+    tasks per stage (backpressure); all-to-all stages barrier.
+    """
+
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+        self.stats = DatasetStats()
+
+    # -- public ---------------------------------------------------------------
+    def execute(self, plan: L.LogicalOperator) -> List[RefBundle]:
+        plan = L.optimize(plan)
+        bundles: List[RefBundle] = []
+        for op in plan.chain():
+            bundles = self._execute_op(op, bundles)
+        return bundles
+
+    # -- per-op dispatch ------------------------------------------------------
+    def _execute_op(self, op: L.LogicalOperator, inputs: List[RefBundle]) -> List[RefBundle]:
+        t0 = time.perf_counter()
+        name = op.name
+        if isinstance(op, L.InputData):
+            out = [(b, m) for b, m in zip(op.blocks, op.metadata)]
+        elif isinstance(op, L.Read):
+            out = self._run_read(op)
+        elif isinstance(op, L.AbstractMap):
+            out = self._run_map(op, inputs)
+        elif isinstance(op, L.Limit):
+            out = self._run_limit(op, inputs)
+        elif isinstance(op, L.Sort):
+            out = self._run_sort(op, inputs)
+        elif isinstance(op, L.RandomShuffle):
+            out = self._run_shuffle(op, inputs)
+        elif isinstance(op, L.Repartition):
+            out = self._run_repartition(op, inputs)
+        elif isinstance(op, L.Aggregate):
+            out = self._run_aggregate(op, inputs)
+        elif isinstance(op, L.Union):
+            out = list(inputs)
+            for other in op.others:
+                out.extend(StreamingExecutor(self.ctx).execute(other))
+        elif isinstance(op, L.Zip):
+            out = self._run_zip(op, inputs)
+        elif isinstance(op, L.Write):
+            out = self._run_write(op, inputs)
+        else:
+            raise NotImplementedError(f"op {op}")
+        self.stats.ops.append(
+            OpStats(name=name, wall_s=time.perf_counter() - t0, num_outputs=len(out),
+                    output_rows=sum(m.num_rows for _, m in out if m.num_rows >= 0))
+        )
+        return out
+
+    # -- streaming map machinery ----------------------------------------------
+    def _stream_tasks(self, submits: List[Any]) -> List[RefBundle]:
+        """Run thunks with bounded in-flight tasks; preserve input order.
+
+        Each thunk submits a num_returns=2 task -> (block_ref, meta_ref). Only metadata
+        is fetched to the driver; blocks stay in the object store (no driver funnel).
+        """
+        cap = self.ctx.max_inflight_tasks_per_op
+        results: Dict[int, RefBundle] = {}
+        inflight: Dict[Any, Tuple[int, Any]] = {}
+        it = iter(enumerate(submits))
+        pending = True
+        while pending or inflight:
+            while pending and len(inflight) < cap:
+                try:
+                    i, thunk = next(it)
+                except StopIteration:
+                    pending = False
+                    break
+                block_ref, meta_ref = thunk()
+                inflight[meta_ref] = (i, block_ref)
+            if not inflight:
+                continue
+            done, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=10.0)
+            for meta_ref in done:
+                i, block_ref = inflight.pop(meta_ref)
+                results[i] = (block_ref, ray_tpu.get(meta_ref))
+        return [results[i] for i in sorted(results)]
+
+    def _run_read(self, op: L.Read) -> List[RefBundle]:
+        parallelism = op.parallelism if op.parallelism > 0 else self.ctx.read_op_min_num_blocks
+        read_tasks = op.datasource.get_read_tasks(parallelism)
+        fused_specs = getattr(op, "_fused_specs", [])
+        remote_read = _remote(_read_task_fn).options(num_returns=2)
+        return self._stream_tasks([
+            (lambda rt=rt: remote_read.remote(rt.fn, fused_specs)) for rt in read_tasks
+        ])
+
+    def _run_map(self, op: L.AbstractMap, inputs: List[RefBundle]) -> List[RefBundle]:
+        opts = {k: v for k, v in op.ray_remote_args.items() if k in ("num_cpus", "num_tpus", "resources")}
+        if op.compute == "actors":
+            return self._run_actor_pool_map(op, inputs, opts)
+        remote_map = _remote(_map_block, **opts).options(num_returns=2)
+        return self._stream_tasks([
+            (lambda b=b: remote_map.remote(op.specs, b)) for b, _ in inputs
+        ])
+
+    def _run_actor_pool_map(self, op: L.AbstractMap, inputs: List[RefBundle], opts) -> List[RefBundle]:
+        conc = op.concurrency
+        if isinstance(conc, tuple):
+            pool_size = conc[1]
+        elif isinstance(conc, int):
+            pool_size = conc
+        else:
+            pool_size = self.ctx.actor_pool_max_size
+        pool_size = max(1, min(pool_size, len(inputs) or 1))
+        Worker = ray_tpu.remote(**({"num_cpus": 1} | opts))(_MapWorker)
+        actors = [Worker.remote(op.specs) for _ in range(pool_size)]
+        ray_tpu.get([a.ready.remote() for a in actors])
+        try:
+            results: Dict[int, RefBundle] = {}
+            idle = deque(actors)
+            inflight: Dict[Any, Tuple[int, Any, Any]] = {}
+            queue = deque(enumerate(inputs))
+            while queue or inflight:
+                while queue and idle:
+                    i, (b, _) = queue.popleft()
+                    actor = idle.popleft()
+                    block_ref, meta_ref = actor.map_block.options(num_returns=2).remote(b)
+                    inflight[meta_ref] = (i, actor, block_ref)
+                done, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=10.0)
+                for meta_ref in done:
+                    i, actor, block_ref = inflight.pop(meta_ref)
+                    idle.append(actor)
+                    results[i] = (block_ref, ray_tpu.get(meta_ref))
+            return [results[i] for i in sorted(results)]
+        finally:
+            for a in actors:
+                ray_tpu.kill(a)
+
+    def _run_write(self, op: L.Write, inputs: List[RefBundle]) -> List[RefBundle]:
+        remote_write = _remote(_write_block)
+        refs = [remote_write.remote(op.datasink, b, i) for i, (b, _) in enumerate(inputs)]
+        out = []
+        for r in refs:
+            path, rows = ray_tpu.get(r)
+            out.append((ray_tpu.put(pa.table({"path": [path], "num_rows": [rows]})), BlockMetadata(1, 0)))
+        return out
+
+    # -- all-to-all ------------------------------------------------------------
+    def _run_limit(self, op: L.Limit, inputs: List[RefBundle]) -> List[RefBundle]:
+        out, remaining = [], op.limit
+        for b, m in inputs:
+            if remaining <= 0:
+                break
+            n = m.num_rows if m.num_rows >= 0 else BlockAccessor.for_block(ray_tpu.get(b)).num_rows()
+            if n <= remaining:
+                out.append((b, m))
+                remaining -= n
+            else:
+                block = BlockAccessor.for_block(ray_tpu.get(b)).slice(0, remaining)
+                out.append((ray_tpu.put(block), BlockAccessor.for_block(block).get_metadata()))
+                remaining = 0
+        return out
+
+    def _sample_boundaries(self, inputs: List[RefBundle], key: str, n_parts: int) -> List[Any]:
+        samples = []
+        for b, _ in inputs[: max(n_parts * 2, 8)]:
+            block = ray_tpu.get(b)
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows():
+                s = acc.sample(min(32, acc.num_rows()), seed=0)
+                samples.append(BlockAccessor.for_block(s).to_numpy([key])[key])
+        if not samples:
+            return []
+        allv = np.sort(np.concatenate(samples))
+        return [allv[int(len(allv) * (i + 1) / n_parts) - 1] for i in range(n_parts - 1)]
+
+    def _two_phase(self, inputs, map_fn, map_args, reduce_fn, reduce_args, n_parts) -> List[RefBundle]:
+        """Generic shuffle: map each block into n_parts partitions, reduce per-partition.
+
+        Partition blocks and reduced outputs stay in the object store; the driver only
+        routes refs (map side: num_returns=n_parts, reduce side: num_returns=2).
+        """
+        rreduce = _remote(reduce_fn).options(num_returns=2)
+        out = []
+        reduce_refs = []
+        if n_parts == 1:
+            # Single partition: the map phase is a no-op, reduce over the raw blocks.
+            reduce_refs.append(rreduce.remote(*reduce_args, *[b for b, _ in inputs]))
+        else:
+            rmap = _remote(map_fn).options(num_returns=n_parts)
+            per_index_args = map_args if callable(map_args) else (lambda i: map_args)
+            part_refs = [rmap.remote(b, *per_index_args(i)) for i, (b, _) in enumerate(inputs)]
+            for p in range(n_parts):
+                parts = [pl[p] for pl in part_refs]
+                reduce_refs.append(rreduce.remote(*reduce_args, *parts))
+        for block_ref, meta_ref in reduce_refs:
+            out.append((block_ref, ray_tpu.get(meta_ref)))
+        return out
+
+    def _run_sort(self, op: L.Sort, inputs: List[RefBundle]) -> List[RefBundle]:
+        if not inputs:
+            return []
+        n_parts = max(1, len(inputs))
+        boundaries = self._sample_boundaries(inputs, op.key, n_parts)
+        n_parts = len(boundaries) + 1
+        out = self._two_phase(
+            inputs,
+            _partition_by_boundaries, (op.key, boundaries),
+            _merge_sorted, (op.key, op.descending),
+            n_parts,
+        )
+        return out[::-1] if op.descending else out
+
+    def _run_shuffle(self, op: L.RandomShuffle, inputs: List[RefBundle]) -> List[RefBundle]:
+        if not inputs:
+            return []
+        n_parts = len(inputs)
+        seed = op.seed if op.seed is not None else int.from_bytes(os.urandom(4), "little")
+        return self._two_phase(
+            inputs, _random_split_block, lambda i: (n_parts, seed, i), _merge_shuffled, (seed,), n_parts
+        )
+
+    def _run_repartition(self, op: L.Repartition, inputs: List[RefBundle]) -> List[RefBundle]:
+        blocks = [ray_tpu.get(b) for b, _ in inputs]
+        merged = BlockAccessor.concat(blocks)
+        acc = BlockAccessor.for_block(merged)
+        n = acc.num_rows()
+        k = max(1, op.num_blocks)
+        per, rem, start = n // k, n % k, 0
+        out = []
+        for i in range(k):
+            cnt = per + (1 if i < rem else 0)
+            blk = acc.slice(start, start + cnt)
+            start += cnt
+            out.append((ray_tpu.put(blk), BlockAccessor.for_block(blk).get_metadata()))
+        return out
+
+    def _run_aggregate(self, op: L.Aggregate, inputs: List[RefBundle]) -> List[RefBundle]:
+        if not inputs:
+            return []
+        if op.key is None:  # global aggregate: single reduce
+            rreduce = _remote(_agg_partition).options(num_returns=2)
+            block_ref, meta_ref = rreduce.remote(None, op.aggs, *[b for b, _ in inputs])
+            return [(block_ref, ray_tpu.get(meta_ref))]
+        n_parts = min(len(inputs), 8)
+        return self._two_phase(inputs, _hash_partition, (op.key, n_parts), _agg_partition, (op.key, op.aggs), n_parts)
+
+    def _run_zip(self, op: L.Zip, inputs: List[RefBundle]) -> List[RefBundle]:
+        other = StreamingExecutor(self.ctx).execute(op.other)
+        left = BlockAccessor.concat([ray_tpu.get(b) for b, _ in inputs])
+        right = BlockAccessor.concat([ray_tpu.get(b) for b, _ in other])
+        if left.num_rows != right.num_rows:
+            raise ValueError(f"zip row mismatch: {left.num_rows} vs {right.num_rows}")
+        for name in right.column_names:
+            col = right.column(name)
+            out_name = name if name not in left.column_names else f"{name}_1"
+            left = left.append_column(out_name, col)
+        return [(ray_tpu.put(left), BlockAccessor.for_block(left).get_metadata())]

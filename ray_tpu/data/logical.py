@@ -1,0 +1,239 @@
+"""Logical plan: lazy operator DAG + rule-based optimizer.
+
+Capability parity: reference python/ray/data/_internal/logical/ (operators, optimizers.py,
+rules/operator_fusion). A Dataset holds a chain of LogicalOperators; on execution the plan
+is optimized (map fusion) and lowered to physical operators (execution.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class LogicalOperator:
+    """One node in the logical DAG (single upstream chain; Union/Zip hold extra inputs)."""
+
+    name = "Op"
+
+    def __init__(self, input_op: Optional["LogicalOperator"] = None):
+        self.input_op = input_op
+
+    def chain(self) -> List["LogicalOperator"]:
+        ops, op = [], self
+        while op is not None:
+            ops.append(op)
+            op = op.input_op
+        return list(reversed(ops))
+
+    def __repr__(self):
+        return self.name
+
+
+class Read(LogicalOperator):
+    """Leaf: produces blocks from a datasource's read tasks."""
+
+    name = "Read"
+
+    def __init__(self, datasource, parallelism: int = -1):
+        super().__init__(None)
+        self.datasource = datasource
+        self.parallelism = parallelism
+
+
+class InputData(LogicalOperator):
+    """Leaf: pre-materialized blocks (from_items / from_numpy / materialized sets)."""
+
+    name = "InputData"
+
+    def __init__(self, blocks: List[Any], metadata: List[Any]):
+        super().__init__(None)
+        self.blocks = blocks  # list of ObjectRef[Block] or raw Blocks
+        self.metadata = metadata
+
+
+@dataclasses.dataclass
+class MapSpec:
+    """A batch transform: block -> block. Fusable with neighbors.
+
+    kind: map_batches|map_rows|filter|flat_map|add_column|drop_columns|select_columns
+    """
+
+    kind: str
+    fn: Any  # callable, or class for actor-pool compute
+    fn_args: Tuple = ()
+    fn_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    fn_constructor_args: Tuple = ()
+    fn_constructor_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    batch_size: Optional[int] = None
+    batch_format: Optional[str] = "numpy"
+    zero_copy_batch: bool = False
+
+
+class AbstractMap(LogicalOperator):
+    """Any row/batch transform, carrying compute strategy + resource requests."""
+
+    name = "Map"
+
+    def __init__(
+        self,
+        input_op,
+        spec: MapSpec,
+        compute: Optional[str] = None,  # None=tasks, "actors"=actor pool
+        ray_remote_args: Optional[Dict[str, Any]] = None,
+        concurrency: Optional[Any] = None,
+    ):
+        super().__init__(input_op)
+        self.specs = [spec]
+        self.compute = compute
+        self.ray_remote_args = ray_remote_args or {}
+        self.concurrency = concurrency
+        self.name = {
+            "map_batches": "MapBatches",
+            "map_rows": "Map",
+            "filter": "Filter",
+            "flat_map": "FlatMap",
+        }.get(spec.kind, "Map")
+
+    def fused_with(self, other: "AbstractMap") -> "AbstractMap":
+        out = AbstractMap(self.input_op, self.specs[0], self.compute, self.ray_remote_args, self.concurrency)
+        out.specs = self.specs + other.specs
+        out.name = f"{self.name}->{other.name}"
+        # Downstream actor-pool compute wins (GPU/stateful UDF dominates placement).
+        out.compute = other.compute or self.compute
+        out.ray_remote_args = {**self.ray_remote_args, **other.ray_remote_args}
+        out.concurrency = other.concurrency or self.concurrency
+        return out
+
+
+class Limit(LogicalOperator):
+    name = "Limit"
+
+    def __init__(self, input_op, limit: int):
+        super().__init__(input_op)
+        self.limit = limit
+
+
+class Sort(LogicalOperator):
+    name = "Sort"
+
+    def __init__(self, input_op, key: str, descending: bool = False):
+        super().__init__(input_op)
+        self.key = key
+        self.descending = descending
+
+
+class RandomShuffle(LogicalOperator):
+    name = "RandomShuffle"
+
+    def __init__(self, input_op, seed: Optional[int] = None):
+        super().__init__(input_op)
+        self.seed = seed
+
+
+class Repartition(LogicalOperator):
+    name = "Repartition"
+
+    def __init__(self, input_op, num_blocks: int):
+        super().__init__(input_op)
+        self.num_blocks = num_blocks
+
+
+class Aggregate(LogicalOperator):
+    name = "Aggregate"
+
+    def __init__(self, input_op, key: Optional[str], aggs: List[Any]):
+        super().__init__(input_op)
+        self.key = key
+        self.aggs = aggs
+
+
+class Union(LogicalOperator):
+    name = "Union"
+
+    def __init__(self, input_op, others: List[LogicalOperator]):
+        super().__init__(input_op)
+        self.others = others
+
+
+class Zip(LogicalOperator):
+    name = "Zip"
+
+    def __init__(self, input_op, other: LogicalOperator):
+        super().__init__(input_op)
+        self.other = other
+
+
+class Write(LogicalOperator):
+    name = "Write"
+
+    def __init__(self, input_op, datasink):
+        super().__init__(input_op)
+        self.datasink = datasink
+
+
+# ---- optimizer --------------------------------------------------------------
+
+
+def _rebuild(chain: List[LogicalOperator]) -> LogicalOperator:
+    prev = None
+    for op in chain:
+        op.input_op = prev if not isinstance(op, (Read, InputData)) else None
+        prev = op
+    return prev
+
+
+def fuse_maps(plan: LogicalOperator) -> LogicalOperator:
+    """OperatorFusion rule: merge adjacent AbstractMap ops into one physical stage.
+
+    Mirrors reference _internal/logical/rules/operator_fusion.py — fusing avoids a full
+    serialize->object store->deserialize round trip per stage.
+    """
+    chain = plan.chain()
+    out: List[LogicalOperator] = []
+    for op in chain:
+        if (
+            out
+            and isinstance(op, AbstractMap)
+            and isinstance(out[-1], AbstractMap)
+            and _compatible(out[-1], op)
+        ):
+            out[-1] = out[-1].fused_with(op)
+        else:
+            out.append(op)
+    return _rebuild(out)
+
+
+def _compatible(a: AbstractMap, b: AbstractMap) -> bool:
+    # Task-pool ops fuse freely; an actor-pool op can absorb upstream task ops but two
+    # distinct actor-pool stages keep their own pools (distinct constructors).
+    if a.compute == "actors" and b.compute == "actors":
+        return False
+    if a.compute == "actors" and b.compute is None:
+        return True
+    return True
+
+
+def fuse_read_maps(plan: LogicalOperator) -> LogicalOperator:
+    """Fuse task-pool map stages directly into read tasks (skips one store round trip)."""
+    chain = plan.chain()
+    if (
+        len(chain) >= 2
+        and isinstance(chain[0], Read)
+        and isinstance(chain[1], AbstractMap)
+        and chain[1].compute != "actors"
+        and not getattr(chain[0], "_fused_specs", None)
+    ):
+        chain[0]._fused_specs = chain[1].specs
+        chain[0].name = f"Read->{chain[1].name}"
+        chain = [chain[0]] + chain[2:]
+    return _rebuild(chain)
+
+
+def optimize(plan: LogicalOperator) -> LogicalOperator:
+    # Plan nodes are shared between Datasets derived from a common parent; rules mutate
+    # (relink input_op, set _fused_specs), so optimize a shallow copy of the chain.
+    import copy
+
+    copies = [copy.copy(op) for op in plan.chain()]
+    plan = _rebuild(copies)
+    return fuse_read_maps(fuse_maps(plan))

@@ -1,0 +1,26 @@
+"""Per-operator execution stats (reference python/ray/data/_internal/stats.py)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass
+class OpStats:
+    name: str
+    wall_s: float
+    num_outputs: int
+    output_rows: int
+
+
+@dataclasses.dataclass
+class DatasetStats:
+    ops: List[OpStats] = dataclasses.field(default_factory=list)
+
+    def summary(self) -> str:
+        lines = ["Operator stats:"]
+        for op in self.ops:
+            lines.append(
+                f"  {op.name}: {op.wall_s * 1e3:.1f}ms, {op.num_outputs} blocks, {op.output_rows} rows"
+            )
+        return "\n".join(lines)

@@ -99,6 +99,14 @@ def pipeline(
     b = x.shape[0]
     if b % num_microbatches:
         raise ValueError(f"batch {b} not divisible by num_microbatches {num_microbatches}")
+    env_mesh = mesh if mesh is not None else jax.sharding.get_abstract_mesh()
+    pp_size = env_mesh.shape.get(axis_name) if getattr(env_mesh, "shape", None) else None
+    leading = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stacked_params)}
+    if pp_size is not None and leading and leading != {pp_size}:
+        raise ValueError(
+            f"stacked_params leading dims {sorted(leading)} must equal mesh '{axis_name}' "
+            f"size {pp_size}; a mismatch would silently drop pipeline stages"
+        )
     x_mb = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
     manual = {axis_name, *extra_manual}
     mb_spec = P(None, *(x_spec or P())) if (x_spec or extra_manual) else P()

@@ -1,0 +1,148 @@
+"""ray_tpu.data tests (reference test strategy: python/ray/data/tests/)."""
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+@pytest.fixture(autouse=True)
+def _cluster(rt):
+    yield
+
+
+def test_range_count_take(rt):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert rows == [{"id": i} for i in range(5)]
+    assert ds.num_blocks() == 4
+
+
+def test_from_items_and_schema(rt):
+    ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}], parallelism=1)
+    assert ds.count() == 2
+    assert set(ds.columns()) == {"a", "b"}
+    assert ds.take_all()[1]["b"] == "y"
+
+
+def test_map_batches_numpy(rt):
+    ds = rd.range(32, parallelism=2).map_batches(lambda b: {"id": b["id"] * 2})
+    out = ds.take_all()
+    assert [r["id"] for r in out] == [i * 2 for i in range(32)]
+
+
+def test_map_batches_fusion(rt):
+    ds = (
+        rd.range(16, parallelism=2)
+        .map_batches(lambda b: {"id": b["id"] + 1})
+        .map_batches(lambda b: {"id": b["id"] * 10})
+    )
+    ds.materialize()
+    # two map stages fused into one physical stage
+    names = [op.name for op in ds._stats.ops]
+    assert any("->" in n for n in names), names
+    assert [r["id"] for r in ds.take(3)] == [10, 20, 30]
+
+
+def test_map_filter_flat_map(rt):
+    ds = rd.range(10, parallelism=2).map(lambda r: {"v": r["id"] + 1})
+    assert ds.sum("v") == sum(range(1, 11))
+    ds2 = rd.range(10, parallelism=2).filter(lambda r: r["id"] % 2 == 0)
+    assert ds2.count() == 5
+    ds3 = rd.from_items([{"x": 1}], parallelism=1).flat_map(lambda r: [{"x": r["x"]}, {"x": r["x"] + 1}])
+    assert [r["x"] for r in ds3.take_all()] == [1, 2]
+
+
+def test_actor_pool_map(rt):
+    class AddConst:
+        def __init__(self, c):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(20, parallelism=4).map_batches(
+        AddConst, fn_constructor_args=(100,), concurrency=2
+    )
+    assert sorted(r["id"] for r in ds.take_all()) == [i + 100 for i in range(20)]
+
+
+def test_sort_and_shuffle(rt):
+    ds = rd.from_items([{"k": v} for v in [5, 3, 8, 1, 9, 2, 7, 0, 4, 6]], parallelism=3)
+    assert [r["k"] for r in ds.sort("k").take_all()] == list(range(10))
+    assert [r["k"] for r in ds.sort("k", descending=True).take_all()] == list(range(9, -1, -1))
+    shuffled = ds.random_shuffle(seed=42)
+    assert sorted(r["k"] for r in shuffled.take_all()) == list(range(10))
+
+
+def test_groupby_aggregate(rt):
+    ds = rd.from_items([{"g": i % 3, "v": i} for i in range(12)], parallelism=3)
+    out = {r["g"]: r["sum(v)"] for r in ds.groupby("g").sum("v").take_all()}
+    assert out == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+    assert ds.mean("v") == pytest.approx(5.5)
+    assert ds.max("v") == 11
+
+
+def test_limit_union_zip(rt):
+    ds = rd.range(100, parallelism=4).limit(7)
+    assert ds.count() == 7
+    u = rd.range(3, parallelism=1).union(rd.range(3, parallelism=1))
+    assert u.count() == 6
+    z = rd.range(4, parallelism=2).zip(rd.range(4, parallelism=2).map_batches(lambda b: {"y": b["id"] * 3}))
+    rows = z.take_all()
+    assert all(r["y"] == r["id"] * 3 for r in rows)
+
+
+def test_split_and_iteration(rt):
+    ds = rd.range(30, parallelism=6)
+    shards = ds.split(3)
+    assert sum(s.count() for s in shards) == 30
+    batches = list(ds.iter_batches(batch_size=8, batch_format="numpy"))
+    assert sum(len(b["id"]) for b in batches) == 30
+    assert all(isinstance(b["id"], np.ndarray) for b in batches)
+    # batch boundary spanning blocks
+    assert len(batches[0]["id"]) == 8
+
+
+def test_tensor_columns_roundtrip(rt):
+    arr = np.arange(24, dtype=np.float32).reshape(6, 2, 2)
+    ds = rd.from_numpy({"x": arr}, parallelism=2)
+    out = np.concatenate([b["x"] for b in ds.iter_batches(batch_size=3)])
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_parquet_roundtrip(rt, tmp_path):
+    ds = rd.range(50, parallelism=2).map_batches(lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    paths = ds.write_parquet(str(tmp_path / "out"))
+    assert len(paths) == 2
+    back = rd.read_parquet(str(tmp_path / "out"))
+    assert back.count() == 50
+    assert back.sum("sq") == sum(i * i for i in range(50))
+
+
+def test_csv_json_roundtrip(rt, tmp_path):
+    ds = rd.from_items([{"a": i, "s": f"row{i}"} for i in range(10)], parallelism=1)
+    ds.write_csv(str(tmp_path / "csv"))
+    assert rd.read_csv(str(tmp_path / "csv")).count() == 10
+    ds.write_json(str(tmp_path / "json"))
+    back = rd.read_json(str(tmp_path / "json"))
+    assert back.sort("a").take(2) == [{"a": 0, "s": "row0"}, {"a": 1, "s": "row1"}]
+
+
+def test_iter_jax_batches(rt):
+    ds = rd.range(16, parallelism=2)
+    batches = list(ds.iter_jax_batches(batch_size=8))
+    assert len(batches) == 2
+    import jax
+
+    assert isinstance(batches[0]["id"], jax.Array)
+
+
+def test_random_sample_and_train_test_split(rt):
+    ds = rd.range(100, parallelism=4)
+    train, test = ds.train_test_split(0.2)
+    assert train.count() == 80 and test.count() == 20
+    s = ds.random_sample(0.5, seed=0)
+    assert 20 < s.count() < 80
