@@ -69,4 +69,5 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: Optional[dict] = None  # stop criteria, e.g. {"training_iteration": 10}
     verbose: int = 1

@@ -120,7 +120,15 @@ class BlockAccessor:
     def _tensor_shape(self, name: str) -> Optional[tuple]:
         md = self._table.schema.metadata or {}
         raw = md.get(f"tensor_shape:{name}".encode())
-        return eval(raw.decode()) if raw else None  # noqa: S307 - repr of int tuple
+        if not raw:
+            return None
+        import ast
+
+        try:  # literal_eval only — metadata may come from untrusted files
+            shape = ast.literal_eval(raw.decode())
+        except (ValueError, SyntaxError):
+            return None
+        return shape if isinstance(shape, tuple) and all(isinstance(s, int) for s in shape) else None
 
     # ---- batch formats ------------------------------------------------------
     def to_arrow(self) -> pa.Table:

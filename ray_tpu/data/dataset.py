@@ -212,29 +212,23 @@ class Dataset:
         if equal:
             total = self.count()
             per = total // n
-            sizes = [per] * n
             # rows beyond n*per are dropped (reference split(equal=True) semantics)
-            merged = BlockAccessor.concat([ray_tpu.get(b) for b, _ in bundles])
-            acc = BlockAccessor.for_block(merged)
-            out, start = [], 0
-            for s in sizes:
-                blk = acc.slice(start, start + s)
-                start += s
-                out.append(Dataset._from_blocks([blk]))
-            return out
+            ex = StreamingExecutor(self._ctx)
+            shards_bundles = ex._slice_to_layout(bundles, [per] * n)
+            return [Dataset._from_bundles([sb]) for sb in shards_bundles]
         shards: List[List[RefBundle]] = [[] for _ in range(n)]
         for i, bundle in enumerate(bundles):
             shards[i % n].append(bundle)
         return [Dataset._from_bundles(s) for s in shards]
 
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
-        merged = BlockAccessor.concat([ray_tpu.get(b) for b, _ in self._bundles()])
-        acc = BlockAccessor.for_block(merged)
-        out, prev = [], 0
-        for idx in list(indices) + [acc.num_rows()]:
-            out.append(Dataset._from_blocks([acc.slice(prev, idx)]))
-            prev = idx
-        return out
+        bundles = self._bundles()
+        total = self.count()
+        bounds = [0] + list(indices) + [total]
+        sizes = [max(0, bounds[i + 1] - bounds[i]) for i in range(len(bounds) - 1)]
+        ex = StreamingExecutor(self._ctx)
+        shards_bundles = ex._slice_to_layout(bundles, sizes)
+        return [Dataset._from_bundles([sb]) for sb in shards_bundles]
 
     def train_test_split(self, test_size: float, *, shuffle: bool = False, seed: Optional[int] = None):
         ds = self.random_shuffle(seed=seed) if shuffle else self

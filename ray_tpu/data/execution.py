@@ -163,6 +163,21 @@ def _hash_partition(block: Block, key: str, n_out: int) -> List[Block]:
     return [acc.take(np.nonzero(hashes == p)[0]) for p in range(n_out)]
 
 
+def _slice_concat(ranges: List[Tuple[int, int, int]], *blocks: Block) -> Tuple[Block, BlockMetadata]:
+    """Assemble one output block from [(input_idx, start, end)] row ranges."""
+    parts = [BlockAccessor.for_block(blocks[i]).slice(s, e) for i, s, e in ranges if e > s]
+    out = BlockAccessor.concat(parts)
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+def _zip_blocks(left: Block, right: Block) -> Tuple[Block, BlockMetadata]:
+    for name in right.column_names:
+        col = right.column(name)
+        out_name = name if name not in left.column_names else f"{name}_1"
+        left = left.append_column(out_name, col)
+    return left, BlockAccessor.for_block(left).get_metadata()
+
+
 def _agg_partition(key: Optional[str], aggs, *parts: Block) -> Tuple[Block, BlockMetadata]:
     from .aggregate import aggregate_block
 
@@ -405,20 +420,49 @@ class StreamingExecutor:
             inputs, _random_split_block, lambda i: (n_parts, seed, i), _merge_shuffled, (seed,), n_parts
         )
 
-    def _run_repartition(self, op: L.Repartition, inputs: List[RefBundle]) -> List[RefBundle]:
-        blocks = [ray_tpu.get(b) for b, _ in inputs]
-        merged = BlockAccessor.concat(blocks)
-        acc = BlockAccessor.for_block(merged)
-        n = acc.num_rows()
-        k = max(1, op.num_blocks)
-        per, rem, start = n // k, n % k, 0
-        out = []
-        for i in range(k):
-            cnt = per + (1 if i < rem else 0)
-            blk = acc.slice(start, start + cnt)
-            start += cnt
-            out.append((ray_tpu.put(blk), BlockAccessor.for_block(blk).get_metadata()))
+    def _block_rows(self, inputs: List[RefBundle]) -> List[int]:
+        rows = []
+        for b, m in inputs:
+            if m.num_rows >= 0:
+                rows.append(m.num_rows)
+            else:
+                rows.append(BlockAccessor.for_block(ray_tpu.get(b)).num_rows())
+        return rows
+
+    def _slice_to_layout(self, inputs: List[RefBundle], sizes: List[int]) -> List[RefBundle]:
+        """Re-chunk inputs into blocks of the given sizes via worker-side slice tasks."""
+        rows = self._block_rows(inputs)
+        rslice = _remote(_slice_concat).options(num_returns=2)
+        # walk (input_idx, offset) across the concatenated row space
+        out, ii, off = [], 0, 0
+        refs = [b for b, _ in inputs]
+        for size in sizes:
+            ranges, need = [], size
+            touched = []
+            while need > 0 and ii < len(rows):
+                take = min(need, rows[ii] - off)
+                if take > 0:
+                    ranges.append((ii, off, off + take))
+                    touched.append(ii)
+                    off += take
+                    need -= take
+                if off >= rows[ii]:
+                    ii += 1
+                    off = 0
+            # remap input indices to the compact arg list for this task
+            uniq = sorted(set(i for i, _, _ in ranges))
+            remap = {g: l for l, g in enumerate(uniq)}
+            local_ranges = [(remap[i], s, e) for i, s, e in ranges]
+            block_ref, meta_ref = rslice.remote(local_ranges, *[refs[g] for g in uniq])
+            out.append((block_ref, ray_tpu.get(meta_ref)))
         return out
+
+    def _run_repartition(self, op: L.Repartition, inputs: List[RefBundle]) -> List[RefBundle]:
+        n = sum(self._block_rows(inputs))
+        k = max(1, op.num_blocks)
+        per, rem = n // k, n % k
+        sizes = [per + (1 if i < rem else 0) for i in range(k)]
+        return self._slice_to_layout(inputs, sizes)
 
     def _run_aggregate(self, op: L.Aggregate, inputs: List[RefBundle]) -> List[RefBundle]:
         if not inputs:
@@ -432,12 +476,12 @@ class StreamingExecutor:
 
     def _run_zip(self, op: L.Zip, inputs: List[RefBundle]) -> List[RefBundle]:
         other = StreamingExecutor(self.ctx).execute(op.other)
-        left = BlockAccessor.concat([ray_tpu.get(b) for b, _ in inputs])
-        right = BlockAccessor.concat([ray_tpu.get(b) for b, _ in other])
-        if left.num_rows != right.num_rows:
-            raise ValueError(f"zip row mismatch: {left.num_rows} vs {right.num_rows}")
-        for name in right.column_names:
-            col = right.column(name)
-            out_name = name if name not in left.column_names else f"{name}_1"
-            left = left.append_column(out_name, col)
-        return [(ray_tpu.put(left), BlockAccessor.for_block(left).get_metadata())]
+        left_rows = self._block_rows(inputs)
+        right_rows = self._block_rows(other)
+        if sum(left_rows) != sum(right_rows):
+            raise ValueError(f"zip row mismatch: {sum(left_rows)} vs {sum(right_rows)}")
+        # align the right side to the left block layout, then zip block pairs in tasks
+        aligned = self._slice_to_layout(other, left_rows)
+        rzip = _remote(_zip_blocks).options(num_returns=2)
+        pairs = [rzip.remote(lb, rb) for (lb, _), (rb, _) in zip(inputs, aligned)]
+        return [(block_ref, ray_tpu.get(meta_ref)) for block_ref, meta_ref in pairs]

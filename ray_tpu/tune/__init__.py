@@ -1,0 +1,57 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Capability parity: reference python/ray/tune/ — Tuner (tuner.py:43), tune.run
+(tune.py:267), Trainable, schedulers (ASHA/PBT/median-stopping), search spaces
+(basic variant generator), ResultGrid.
+"""
+from .result_grid import Result, ResultGrid  # noqa: F401
+from .schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .session import get_checkpoint, report  # noqa: F401
+from .trainable import Trainable  # noqa: F401
+from .tune_controller import Trial, TuneController  # noqa: F401
+from .tuner import TuneConfig, Tuner, run  # noqa: F401
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "run",
+    "Trainable",
+    "report",
+    "get_checkpoint",
+    "ResultGrid",
+    "Result",
+    "Trial",
+    "TuneController",
+    "TrialScheduler",
+    "FIFOScheduler",
+    "AsyncHyperBandScheduler",
+    "ASHAScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "BasicVariantGenerator",
+    "Searcher",
+    "grid_search",
+    "choice",
+    "uniform",
+    "loguniform",
+    "randint",
+    "sample_from",
+]

@@ -1,0 +1,78 @@
+"""ResultGrid / Result (reference python/ray/tune/result_grid.py)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from .tune_controller import ERROR, Trial
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    config: Dict[str, Any]
+    error: Optional[str] = None
+    checkpoint: Any = None
+    metrics_dataframe: Any = None
+
+    @property
+    def trial_id(self) -> str:
+        return self.config.get("__trial_id__", "")
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial]):
+        self._trials = trials
+        self._results = []
+        for t in trials:
+            ckpt = None
+            if t.checkpoint is not None:
+                import ray_tpu
+                from ray_tpu import ObjectRef
+
+                if isinstance(t.checkpoint, ObjectRef):
+                    try:
+                        ckpt = ray_tpu.get(t.checkpoint)
+                    except Exception:
+                        ckpt = None
+                else:
+                    ckpt = t.checkpoint
+            df = None
+            try:
+                df = t.metrics_dataframe
+            except Exception:
+                pass
+            self._results.append(
+                Result(metrics=t.last_result, config=t.config, error=t.error, checkpoint=ckpt, metrics_dataframe=df)
+            )
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: str, mode: str = "min") -> Result:
+        assert mode in ("min", "max")
+        candidates = [r for r in self._results if r.metrics and metric in r.metrics]
+        if not candidates:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        keyfn = lambda r: r.metrics[metric]  # noqa: E731
+        return min(candidates, key=keyfn) if mode == "min" else max(candidates, key=keyfn)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            rows.append(row)
+        return pd.DataFrame(rows)

@@ -1,0 +1,170 @@
+"""Trial schedulers: FIFO, ASHA, median-stopping, PBT.
+
+Capability parity: reference python/ray/tune/schedulers/ — trial_scheduler.py decisions,
+async_hyperband.py (ASHA brackets with halving rungs), median_stopping_rule.py, pbt.py
+(exploit bottom quantile from top quantile + perturb).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference async_hyperband.py): asynchronous successive halving.
+
+    At each rung (time_attr = grace_period * reduction_factor^k), a trial stops unless
+    its metric is in the top 1/reduction_factor of completed rung entries.
+    """
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        max_t: int = 100,
+        reduction_factor: float = 3.0,
+    ):
+        assert mode in ("min", "max")
+        self.metric, self.mode, self.time_attr = metric, mode, time_attr
+        self.grace_period, self.max_t, self.rf = grace_period, max_t, reduction_factor
+        self._rungs: Dict[int, List[float]] = {}
+        self._recorded: Dict[int, set] = {}
+        rung, t = 0, grace_period
+        self._milestones = []
+        while t < max_t:
+            self._milestones.append(t)
+            rung += 1
+            t = int(grace_period * reduction_factor**rung)
+
+    def _sign(self, v: float) -> float:
+        return -v if self.mode == "min" else v
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        metric = result.get(self.metric)
+        if metric is None:
+            return CONTINUE
+        for milestone in self._milestones:
+            seen = self._recorded.setdefault(milestone, set())
+            if t >= milestone and trial.trial_id not in seen:
+                seen.add(trial.trial_id)
+                rung = self._rungs.setdefault(milestone, [])
+                rung.append(self._sign(metric))
+                k = max(1, int(len(rung) / self.rf))
+                cutoff = sorted(rung, reverse=True)[k - 1]
+                if self._sign(metric) < cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running mean is worse than the median of completed means."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min", grace_period: int = 3):
+        self.metric, self.mode, self.grace = metric, mode, grace_period
+        self._histories: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        v = result.get(self.metric)
+        if v is None:
+            return CONTINUE
+        h = self._histories.setdefault(trial.trial_id, [])
+        h.append(float(v))
+        if result.get("training_iteration", 0) < self.grace or len(self._histories) < 3:
+            return CONTINUE
+        means = {tid: sum(hh) / len(hh) for tid, hh in self._histories.items() if hh}
+        med = sorted(means.values())[len(means) // 2]
+        mine = means[trial.trial_id]
+        worse = mine > med if self.mode == "min" else mine < med
+        return STOP if worse else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference pbt.py): periodically clone top-quantile state into bottom quantile
+    and perturb hyperparameters. The controller performs the actual exploit via the
+    decisions this scheduler returns in `trial._pbt_exploit`.
+    """
+
+    def __init__(
+        self,
+        metric: str = "reward",
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        self.metric, self.mode, self.time_attr = metric, mode, time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self._scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = {}
+
+    def _sign(self, v):
+        return v if self.mode == "max" else -v
+
+    def _perturb(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_p or key not in out:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self.rng)
+                elif isinstance(spec, list):
+                    out[key] = self.rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                factor = self.rng.choice([0.8, 1.2])
+                if isinstance(out[key], (int, float)) and not isinstance(out[key], bool):
+                    out[key] = type(out[key])(out[key] * factor)
+        return out
+
+    def on_trial_complete(self, trial, result) -> None:
+        # finished trials can't donate state; drop them from the exploit pool
+        self._scores.pop(trial.trial_id, None)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        v = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if v is not None:
+            self._scores[trial.trial_id] = self._sign(float(v))
+        if t - self._last_perturb.get(trial.trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        if len(self._scores) < 2:
+            return CONTINUE
+        ranked = sorted(self._scores.items(), key=lambda kv: kv[1])
+        k = max(1, int(len(ranked) * self.quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom:
+            donor = self.rng.choice(top)
+            trial._pbt_exploit = {"donor": donor, "perturb": self._perturb}
+        return CONTINUE

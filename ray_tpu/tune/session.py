@@ -1,0 +1,43 @@
+"""tune.report / tune.get_checkpoint from inside a function trainable.
+
+Capability parity: reference ray.tune session API (ray/tune/trainable/session shims).
+Per-worker (actor process) globals; a trainable actor hosts exactly one trial.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+_reporter: Optional[Callable[[Dict[str, Any]], None]] = None
+_restore_getter: Optional[Callable[[], Any]] = None
+_checkpoint: Any = None
+
+
+def _set_reporter(reporter, restore_getter) -> None:
+    global _reporter, _restore_getter, _checkpoint
+    with _lock:
+        _reporter = reporter
+        _restore_getter = restore_getter
+        _checkpoint = None
+
+
+def _last_checkpoint() -> Any:
+    with _lock:
+        return _checkpoint
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Any = None) -> None:
+    global _checkpoint
+    with _lock:
+        rep = _reporter
+        if checkpoint is not None:
+            _checkpoint = checkpoint
+    if rep is None:
+        raise RuntimeError("tune.report() called outside a Tune function trainable")
+    rep(dict(metrics))
+
+
+def get_checkpoint() -> Any:
+    with _lock:
+        return _restore_getter() if _restore_getter else None

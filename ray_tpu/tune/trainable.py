@@ -1,0 +1,130 @@
+"""Trainable: the unit of execution Tune schedules.
+
+Capability parity: reference python/ray/tune/trainable/trainable.py (class API:
+setup/step/save_checkpoint/load_checkpoint) and function_trainable.py (user function +
+session.report stream). The actor hosting a trainable exposes step()/save()/restore()
+to the TuneController.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Any, Callable, Dict, Optional
+
+DONE = "done"
+TRAINING_ITERATION = "training_iteration"
+
+
+class Trainable:
+    """Class API: subclass and implement setup/step (+ optional save/load checkpoint)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = dict(config or {})
+        self._iteration = 0
+        self.setup(self.config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Any:
+        return None
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        pass
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        """PBT exploit hook; return True if in-place reset is supported."""
+        return False
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- controller-facing ----------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        result = self.step() or {}
+        self._iteration += 1
+        result.setdefault(TRAINING_ITERATION, self._iteration)
+        result.setdefault(DONE, False)
+        return result
+
+    def save(self) -> Any:
+        return {"state": self.save_checkpoint(), "iteration": self._iteration}
+
+    def restore(self, payload: Any) -> None:
+        self._iteration = payload.get("iteration", 0)
+        self.load_checkpoint(payload.get("state"))
+
+    def reset(self, new_config: Dict[str, Any]) -> bool:
+        ok = self.reset_config(new_config)
+        if ok:
+            self.config = dict(new_config)
+        return ok
+
+    def stop(self) -> None:
+        self.cleanup()
+
+
+class FunctionTrainable(Trainable):
+    """Wrap `def train_fn(config)` calling tune.report(...) into the step() protocol.
+
+    The function runs on a daemon thread; each report() becomes one step() result
+    (reference function_trainable.py queue handoff).
+    """
+
+    _fn: Callable[[Dict[str, Any]], None] = None  # bound by make_function_trainable
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        # maxsize=1 -> report() blocks until the controller consumes the result, pacing
+        # the function with the scheduler (reference function_trainable.py semantics;
+        # a free-running function would make early stopping save zero compute and
+        # desynchronize checkpoints from iterations).
+        self._results: _queue.Queue = _queue.Queue(maxsize=1)
+        self._error: Optional[BaseException] = None
+        self._restore_payload = None
+
+        def runner():
+            from . import session
+
+            session._set_reporter(self._results.put, lambda: self._restore_payload)
+            try:
+                self._fn(self.config)
+                self._results.put({DONE: True})
+            except BaseException as e:  # noqa: BLE001
+                self._error = e
+                self._results.put({DONE: True, "_error": repr(e)})
+
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._started = False
+
+    def step(self) -> Dict[str, Any]:
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        result = self._results.get()
+        # only surface the failure on its terminal sentinel; queued valid results first
+        if result.get("_error") and self._error is not None:
+            raise self._error
+        return result
+
+    def save_checkpoint(self) -> Any:
+        from . import session
+
+        return session._last_checkpoint()
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self._restore_payload = checkpoint
+
+
+def make_function_trainable(fn: Callable[[Dict[str, Any]], None]) -> type:
+    return type(f"func_{getattr(fn, '__name__', 'trainable')}", (FunctionTrainable,), {"_fn": staticmethod(fn)})
+
+
+def wrap_trainable(trainable) -> type:
+    if isinstance(trainable, type) and issubclass(trainable, Trainable):
+        return trainable
+    if callable(trainable):
+        return make_function_trainable(trainable)
+    raise TypeError(f"not a trainable: {trainable!r}")

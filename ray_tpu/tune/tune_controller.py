@@ -1,0 +1,207 @@
+"""TuneController: drives trial actors to completion.
+
+Capability parity: reference python/ray/tune/execution/tune_controller.py:68 — creates
+trial actors, steps them, routes results through the scheduler, handles failures
+(FailureConfig.max_failures restarts from last checkpoint), performs PBT exploits.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from .search import BasicVariantGenerator, Searcher
+from .trainable import DONE, wrap_trainable
+
+PENDING, RUNNING, TERMINATED, ERROR = "PENDING", "RUNNING", "TERMINATED", "ERROR"
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    last_result: Optional[Dict[str, Any]] = None
+    results: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    num_failures: int = 0
+    checkpoint: Any = None  # ObjectRef of last saved payload
+    _actor: Any = None
+    _pending: Any = None  # in-flight step() ref
+    _pbt_exploit: Optional[Dict[str, Any]] = None
+
+    @property
+    def metrics_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.results)
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        searcher: Optional[Searcher] = None,
+        scheduler: Optional[TrialScheduler] = None,
+        num_samples: int = 1,
+        max_concurrent_trials: int = 4,
+        max_failures: int = 0,
+        stop: Optional[Dict[str, Any]] = None,
+        checkpoint_frequency: int = 1,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        seed: Optional[int] = None,
+    ):
+        self.trainable_cls = wrap_trainable(trainable)
+        self.searcher = searcher or BasicVariantGenerator(param_space or {}, num_samples, seed)
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent_trials
+        self.max_failures = max_failures
+        self.stop_criteria = stop or {}
+        self.checkpoint_frequency = checkpoint_frequency
+        res = dict(resources_per_trial or {"CPU": 1})
+        self._actor_cls = ray_tpu.remote(
+            num_cpus=res.get("CPU", 1), num_tpus=res.get("TPU", 0)
+        )(self.trainable_cls)
+        self.trials: List[Trial] = []
+
+    # -- lifecycle -------------------------------------------------------------
+    def _next_trial(self) -> Optional[Trial]:
+        tid = uuid.uuid4().hex[:8]
+        cfg = self.searcher.suggest(tid)
+        if cfg is None:
+            return None
+        t = Trial(trial_id=tid, config=cfg)
+        self.trials.append(t)
+        return t
+
+    def _start(self, trial: Trial, restore_from: Any = None) -> None:
+        trial._actor = self._actor_cls.remote(trial.config)
+        if restore_from is not None:
+            ray_tpu.get(trial._actor.restore.remote(restore_from))
+        trial.status = RUNNING
+        trial._pending = trial._actor.train.remote()
+
+    def _stop_trial(self, trial: Trial, status: str, error: Optional[str] = None) -> None:
+        trial.status = status
+        trial.error = error
+        if status == TERMINATED and trial.checkpoint is not None:
+            # resolve the in-flight save before killing the actor, else the kill races it
+            try:
+                trial.checkpoint = ray_tpu.get(trial.checkpoint)
+            except Exception:
+                trial.checkpoint = None
+        if trial._actor is not None:
+            try:
+                ray_tpu.kill(trial._actor)
+            except Exception:
+                pass
+            trial._actor = None
+        trial._pending = None
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+
+    def _should_stop(self, result: Dict[str, Any]) -> bool:
+        if result.get(DONE):
+            return True
+        for k, v in self.stop_criteria.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    def _maybe_checkpoint(self, trial: Trial, result: Dict[str, Any]) -> None:
+        it = result.get("training_iteration", 0)
+        if self.checkpoint_frequency and it % self.checkpoint_frequency == 0 and trial._actor is not None:
+            trial.checkpoint = trial._actor.save.remote()
+
+    def _handle_failure(self, trial: Trial, err: Exception) -> None:
+        trial.num_failures += 1
+        if trial.num_failures <= self.max_failures:
+            restore = trial.checkpoint
+            try:
+                ray_tpu.kill(trial._actor)
+            except Exception:
+                pass
+            try:
+                self._start(trial, restore_from=restore)
+            except Exception:
+                # checkpoint ref itself failed (e.g. save raced the crash): fresh start
+                trial.checkpoint = None
+                try:
+                    self._start(trial, restore_from=None)
+                except Exception as e2:  # noqa: BLE001
+                    self._stop_trial(trial, ERROR, error=repr(e2))
+        else:
+            self._stop_trial(trial, ERROR, error=repr(err))
+
+    def _apply_pbt_exploit(self, trial: Trial) -> None:
+        info = trial._pbt_exploit
+        trial._pbt_exploit = None
+        donor = next((t for t in self.trials if t.trial_id == info["donor"]), None)
+        if donor is None or donor._actor is None:
+            # donor already finished — keep training without exploiting
+            trial._pending = trial._actor.train.remote()
+            return
+        donor_ckpt = ray_tpu.get(donor._actor.save.remote())
+        new_config = info["perturb"](donor.config)
+        # Try in-place reset; otherwise restart the actor with the new config.
+        ok = ray_tpu.get(trial._actor.reset.remote(new_config))
+        if not ok:
+            ray_tpu.kill(trial._actor)
+            trial._actor = self._actor_cls.remote(new_config)
+        trial.config = new_config
+        ray_tpu.get(trial._actor.restore.remote(donor_ckpt))
+        trial._pending = trial._actor.train.remote()
+
+    # -- main loop -------------------------------------------------------------
+    def run(self) -> List[Trial]:
+        active: List[Trial] = []
+        while True:
+            while len(active) < self.max_concurrent:
+                t = self._next_trial()
+                if t is None:
+                    break
+                self._start(t)
+                active.append(t)
+            if not active:
+                break
+            for t in active:  # safety: a RUNNING trial must always have a step in flight
+                if t._pending is None and t._actor is not None:
+                    t._pending = t._actor.train.remote()
+            pending = {t._pending: t for t in active if t._pending is not None}
+            if not pending:
+                break
+            done, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=30.0)
+            for ref in done:
+                trial = pending[ref]
+                try:
+                    result = ray_tpu.get(ref)
+                except Exception as e:  # noqa: BLE001 - actor/task failure
+                    self._handle_failure(trial, e)
+                    if trial.status == ERROR:
+                        active.remove(trial)
+                    continue
+                bare_completion = result.get(DONE) and not (
+                    set(result) - {DONE, "_error", "training_iteration"}
+                )
+                if bare_completion and trial.last_result is not None:
+                    # function finished: keep the last metrics, just mark terminal
+                    trial.last_result = {**trial.last_result, DONE: True}
+                else:
+                    trial.last_result = result
+                    trial.results.append(result)
+                self._maybe_checkpoint(trial, result)
+                decision = self.scheduler.on_trial_result(trial, result)
+                if self._should_stop(result) or decision == STOP:
+                    self._stop_trial(trial, TERMINATED)
+                    active.remove(trial)
+                elif trial._pbt_exploit is not None:
+                    self._apply_pbt_exploit(trial)
+                else:
+                    trial._pending = trial._actor.train.remote()
+        return self.trials

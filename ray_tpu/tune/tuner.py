@@ -1,0 +1,84 @@
+"""Tuner: the public entrypoint (reference python/ray/tune/tuner.py:43, tune.py:267)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from .result_grid import ResultGrid
+from .schedulers import TrialScheduler
+from .search import Searcher
+from .tune_controller import TuneController
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    seed: Optional[int] = None
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config=None,  # air.RunConfig
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        stop = None
+        max_failures = 0
+        checkpoint_freq = 1
+        if self.run_config is not None:
+            stop = getattr(self.run_config, "stop", None)
+            fc = getattr(self.run_config, "failure_config", None)
+            if fc is not None:
+                max_failures = max(0, getattr(fc, "max_failures", 0))
+            cc = getattr(self.run_config, "checkpoint_config", None)
+            if cc is not None:
+                checkpoint_freq = getattr(cc, "checkpoint_frequency", 1)
+        controller = TuneController(
+            self.trainable,
+            param_space=self.param_space,
+            searcher=self.tune_config.search_alg,
+            scheduler=self.tune_config.scheduler,
+            num_samples=self.tune_config.num_samples,
+            max_concurrent_trials=self.tune_config.max_concurrent_trials,
+            max_failures=max_failures,
+            stop=stop,
+            checkpoint_frequency=checkpoint_freq,
+            seed=self.tune_config.seed,
+        )
+        return ResultGrid(controller.run())
+
+
+def run(
+    trainable,
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    num_samples: int = 1,
+    scheduler: Optional[TrialScheduler] = None,
+    stop: Optional[Dict[str, Any]] = None,
+    max_concurrent_trials: int = 4,
+    **_compat,
+) -> ResultGrid:
+    """tune.run (reference tune.py:267)."""
+    controller = TuneController(
+        trainable,
+        param_space=config,
+        scheduler=scheduler,
+        num_samples=num_samples,
+        max_concurrent_trials=max_concurrent_trials,
+        stop=stop,
+    )
+    return ResultGrid(controller.run())
