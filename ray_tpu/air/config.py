@@ -53,7 +53,7 @@ class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"  # "max" | "min"
-    checkpoint_frequency: int = 0
+    checkpoint_frequency: int = 1
     checkpoint_at_end: Optional[bool] = None
 
     def __post_init__(self):

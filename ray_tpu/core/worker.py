@@ -268,8 +268,23 @@ def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dic
     for k, v in env.items():
         os.environ[k] = v
     if accel == "cpu":
-        # Never let a CPU worker initialize the TPU runtime.
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # Never let a CPU worker initialize the TPU runtime. The env var alone is not
+        # enough: the sandbox sitecustomize may have pre-imported jax and registered an
+        # accelerator PJRT plugin that overrides platform selection at the config level
+        # (see tests/conftest.py for the same dance driver-side). The config update must
+        # land before any backend query in this process.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if "jax" in sys.modules:
+            try:
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            except Exception as e:  # noqa: BLE001
+                print(
+                    f"[ray_tpu worker] WARNING: failed to force cpu platform on "
+                    f"pre-imported jax ({e!r}); this cpu worker may grab the TPU",
+                    file=sys.stderr,
+                )
     ctx = WorkerContext(conn, node_id_hex, worker_id_hex, accel)
     global_state.set_worker(ctx)
     try:

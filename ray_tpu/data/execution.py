@@ -165,8 +165,8 @@ def _hash_partition(block: Block, key: str, n_out: int) -> List[Block]:
 
 def _slice_concat(ranges: List[Tuple[int, int, int]], *blocks: Block) -> Tuple[Block, BlockMetadata]:
     """Assemble one output block from [(input_idx, start, end)] row ranges."""
-    parts = [BlockAccessor.for_block(blocks[i]).slice(s, e) for i, s, e in ranges if e > s]
-    out = BlockAccessor.concat(parts)
+    parts = [BlockAccessor.for_block(blocks[i]).slice(s, e) for i, s, e in ranges]
+    out = BlockAccessor.concat(parts) if any(p.num_rows for p in parts) else parts[0]
     return out, BlockAccessor.for_block(out).get_metadata()
 
 
@@ -438,17 +438,17 @@ class StreamingExecutor:
         refs = [b for b, _ in inputs]
         for size in sizes:
             ranges, need = [], size
-            touched = []
             while need > 0 and ii < len(rows):
                 take = min(need, rows[ii] - off)
                 if take > 0:
                     ranges.append((ii, off, off + take))
-                    touched.append(ii)
                     off += take
                     need -= take
                 if off >= rows[ii]:
                     ii += 1
                     off = 0
+            if not ranges and refs:
+                ranges = [(0, 0, 0)]  # empty shard keeps the schema of block 0
             # remap input indices to the compact arg list for this task
             uniq = sorted(set(i for i, _, _ in ranges))
             remap = {g: l for l, g in enumerate(uniq)}

@@ -1,0 +1,16 @@
+"""ray_tpu.rllib: JAX-first reinforcement learning.
+
+Capability parity: reference rllib/ new API stack — Algorithm/AlgorithmConfig,
+Learner/LearnerGroup, RLModule, EnvRunner(Group), ConnectorV2, PPO.
+"""
+from .algorithms.algorithm import Algorithm  # noqa: F401
+from .algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from .algorithms.ppo import PPO, PPOConfig, PPOLearner  # noqa: F401
+from .connectors import ConnectorPipelineV2, ConnectorV2, GeneralAdvantageEstimation  # noqa: F401
+from .core.learner import Learner  # noqa: F401
+from .core.learner_group import LearnerGroup  # noqa: F401
+from .core.rl_module import Columns, MLPModule, RLModule, RLModuleSpec  # noqa: F401
+from .env.env_runner import SingleAgentEnvRunner  # noqa: F401
+from .env.env_runner_group import EnvRunnerGroup  # noqa: F401
+from .env.episode import SingleAgentEpisode  # noqa: F401
+from .utils.metrics_logger import MetricsLogger  # noqa: F401

@@ -1,0 +1,87 @@
+"""Algorithm: the top-level control loop, usable standalone or under Tune.
+
+Capability parity: reference rllib/algorithms/algorithm.py — is a Tune Trainable;
+train() -> training_step(); checkpointing via get/set_state (Checkpointable tree:
+Algorithm -> LearnerGroup -> Learner -> RLModule params).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu import tune
+
+from ..core.learner import Learner
+from ..core.learner_group import LearnerGroup
+from ..core.rl_module import RLModuleSpec
+from ..env.env_runner_group import EnvRunnerGroup
+from ..utils.metrics_logger import MetricsLogger
+from .algorithm_config import AlgorithmConfig
+
+
+class Algorithm(tune.Trainable):
+    learner_class: type = Learner
+
+    def __init__(self, config):
+        if isinstance(config, dict):  # Tune passes plain dicts
+            base = self.get_default_config()
+            for k, v in config.items():
+                setattr(base, k, v)
+            config = base
+        self._algo_config = config
+        super().__init__({})
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return AlgorithmConfig(cls)
+
+    # -- Trainable hooks -------------------------------------------------------
+    def setup(self, _config: Dict[str, Any]) -> None:
+        cfg = self._algo_config
+        self.metrics = MetricsLogger()
+        self.env_runner_group = EnvRunnerGroup(cfg)
+        import gymnasium as gym
+
+        probe = cfg.env_maker()()
+        self.module_spec = RLModuleSpec(
+            module_class=cfg.rl_module_class,
+            observation_space=probe.observation_space,
+            action_space=probe.action_space,
+            model_config=cfg.model_config,
+        )
+        probe.close()
+        self.learner_group = LearnerGroup(cfg, self.module_spec, self.learner_class)
+        # host-side module copy for connectors (GAE bootstrap values)
+        self._module = self.module_spec.build()
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def step(self) -> Dict[str, Any]:
+        return self.training_step()
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Any:
+        return {"learner": self.learner_group.get_state(), "config": None}
+
+    def load_checkpoint(self, state: Any) -> None:
+        self.learner_group.set_state(state["learner"])
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def cleanup(self) -> None:
+        try:
+            self.env_runner_group.stop()
+        finally:
+            self.learner_group.shutdown()
+
+    stop = cleanup  # reference Algorithm.stop()
+
+    # -- convenience -----------------------------------------------------------
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def evaluate(self, num_timesteps: int = 1000) -> Dict[str, Any]:
+        eps = self.env_runner_group.sample(num_timesteps, explore=False)
+        rets = [float(e["rewards"].sum()) for e in eps if e["terminated"] or e["truncated"]]
+        return {"evaluation": {"episode_return_mean": float(np.mean(rets)) if rets else None}}

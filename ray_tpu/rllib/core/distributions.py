@@ -1,0 +1,110 @@
+"""Action distributions (reference rllib/models/distributions.py, torch/jax-agnostic).
+
+Pure numpy/jax implementations: logits come from the RLModule; sampling happens host-side
+in env runners (numpy) and log-prob/entropy gradients device-side in the learner (jax).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class Distribution:
+    @staticmethod
+    def sample_np(dist_inputs: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        raise NotImplementedError
+
+    @staticmethod
+    def logp_np(dist_inputs: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    @staticmethod
+    def logp_jax(dist_inputs, actions):
+        raise NotImplementedError
+
+    @staticmethod
+    def entropy_jax(dist_inputs):
+        raise NotImplementedError
+
+
+class Categorical(Distribution):
+    """Discrete actions; dist_inputs = logits [B, n]."""
+
+    @staticmethod
+    def sample_np(logits: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        z = logits - logits.max(axis=-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=-1, keepdims=True)
+        cum = np.cumsum(p, axis=-1)
+        r = rng.random(size=(len(p), 1))
+        # float32 cum[-1] can be slightly < 1.0; clamp so r in the tail stays in range
+        return np.minimum((r > cum).sum(axis=-1), p.shape[-1] - 1).astype(np.int64)
+
+    @staticmethod
+    def logp_np(logits: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        z = logits - logits.max(axis=-1, keepdims=True)
+        logz = np.log(np.exp(z).sum(axis=-1))
+        return z[np.arange(len(z)), actions.astype(np.int64)] - logz
+
+    @staticmethod
+    def logp_jax(logits, actions):
+        import jax.numpy as jnp
+        from jax.nn import log_softmax
+
+        lp = log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(lp, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy_jax(logits):
+        import jax.numpy as jnp
+        from jax.nn import log_softmax, softmax
+
+        lp = log_softmax(logits, axis=-1)
+        return -jnp.sum(softmax(logits, axis=-1) * lp, axis=-1)
+
+    @staticmethod
+    def greedy_np(logits: np.ndarray) -> np.ndarray:
+        return logits.argmax(axis=-1)
+
+
+class DiagGaussian(Distribution):
+    """Continuous actions; dist_inputs = [mean, log_std] concat on last dim [B, 2*d]."""
+
+    @staticmethod
+    def _split(x):
+        d = x.shape[-1] // 2
+        return x[..., :d], x[..., d:]
+
+    @staticmethod
+    def sample_np(inputs: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        mean, log_std = DiagGaussian._split(inputs)
+        return mean + np.exp(log_std) * rng.standard_normal(mean.shape)
+
+    @staticmethod
+    def logp_np(inputs: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        mean, log_std = DiagGaussian._split(inputs)
+        var = np.exp(2 * log_std)
+        return (-0.5 * ((actions - mean) ** 2 / var + 2 * log_std + np.log(2 * np.pi))).sum(-1)
+
+    @staticmethod
+    def logp_jax(inputs, actions):
+        import jax.numpy as jnp
+
+        d = inputs.shape[-1] // 2
+        mean, log_std = inputs[..., :d], inputs[..., d:]
+        var = jnp.exp(2 * log_std)
+        return (-0.5 * ((actions - mean) ** 2 / var + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+
+    @staticmethod
+    def entropy_jax(inputs):
+        import jax.numpy as jnp
+
+        d = inputs.shape[-1] // 2
+        log_std = inputs[..., d:]
+        return (log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e)).sum(-1)
+
+    @staticmethod
+    def greedy_np(inputs: np.ndarray) -> np.ndarray:
+        mean, _ = DiagGaussian._split(inputs)
+        return mean

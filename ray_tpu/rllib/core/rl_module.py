@@ -1,0 +1,149 @@
+"""RLModule: the neural-network abstraction.
+
+Capability parity: reference rllib/core/rl_module/rl_module.py — forward_inference /
+forward_exploration / forward_train, get/set_state, inference-only view. JAX-first: a
+module is a (init, apply) pair over a param pytree; the same pytree runs host-side
+(numpy, env runners) and device-side (jax, learner) — no torch/DDP wrapping needed.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .distributions import Categorical, DiagGaussian
+
+Columns = type("Columns", (), {
+    "OBS": "obs",
+    "ACTIONS": "actions",
+    "REWARDS": "rewards",
+    "TERMINATEDS": "terminateds",
+    "TRUNCATEDS": "truncateds",
+    "ACTION_DIST_INPUTS": "action_dist_inputs",
+    "ACTION_LOGP": "action_logp",
+    "VF_PREDS": "vf_preds",
+    "ADVANTAGES": "advantages",
+    "VALUE_TARGETS": "value_targets",
+})
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Reference rl_module.py RLModuleSpec: how to build the module."""
+
+    module_class: Optional[type] = None
+    observation_space: Any = None
+    action_space: Any = None
+    model_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def build(self) -> "RLModule":
+        cls = self.module_class or MLPModule
+        return cls(self.observation_space, self.action_space, self.model_config)
+
+
+class RLModule:
+    """forward_* operate on dict batches and return dict outputs."""
+
+    def __init__(self, observation_space, action_space, model_config: Dict[str, Any]):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.model_config = dict(model_config or {})
+
+    # -- abstract -------------------------------------------------------------
+    def init_params(self, seed: int = 0) -> Any:
+        raise NotImplementedError
+
+    def apply_jax(self, params: Any, obs) -> Dict[str, Any]:
+        """Device-side forward (jax arrays in/out); used by the learner under jit."""
+        raise NotImplementedError
+
+    def apply_np(self, params: Any, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        """Host-side forward (numpy); used by env runners."""
+        raise NotImplementedError
+
+    @property
+    def action_dist_cls(self):
+        raise NotImplementedError
+
+    # -- RLModule API shape ----------------------------------------------------
+    def forward_inference(self, params, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = self.apply_np(params, batch[Columns.OBS])
+        return out
+
+    def forward_exploration(self, params, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return self.apply_np(params, batch[Columns.OBS])
+
+    def forward_train(self, params, batch: Dict[str, Any]) -> Dict[str, Any]:
+        return self.apply_jax(params, batch[Columns.OBS])
+
+
+def _mlp_init(rng: np.random.Generator, sizes) -> list:
+    layers = []
+    for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+        scale = np.sqrt(2.0 / fan_in)
+        layers.append({
+            "w": (rng.standard_normal((fan_in, fan_out)) * scale).astype(np.float32),
+            "b": np.zeros((fan_out,), np.float32),
+        })
+    return layers
+
+
+def _mlp_apply_np(layers, x: np.ndarray, final_linear: bool = True) -> np.ndarray:
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1 or not final_linear:
+            x = np.tanh(x)
+    return x
+
+
+def _mlp_apply_jax(layers, x, final_linear: bool = True):
+    import jax.numpy as jnp
+
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1 or not final_linear:
+            x = jnp.tanh(x)
+    return x
+
+
+class MLPModule(RLModule):
+    """Default policy+value MLP (reference catalog default: separate pi/vf trunks)."""
+
+    def __init__(self, observation_space, action_space, model_config):
+        super().__init__(observation_space, action_space, model_config)
+        self.hiddens = tuple(model_config.get("fcnet_hiddens", (64, 64)))
+        self.obs_dim = int(np.prod(observation_space.shape))
+        import gymnasium as gym
+
+        if isinstance(action_space, gym.spaces.Discrete):
+            self.out_dim = int(action_space.n)
+            self._dist_cls = Categorical
+        else:
+            self.act_dim = int(np.prod(action_space.shape))
+            self.out_dim = 2 * self.act_dim
+            self._dist_cls = DiagGaussian
+
+    @property
+    def action_dist_cls(self):
+        return self._dist_cls
+
+    def init_params(self, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        pi = _mlp_init(rng, (self.obs_dim, *self.hiddens, self.out_dim))
+        # near-zero final policy layer -> near-uniform initial policy
+        pi[-1]["w"] *= 0.01
+        vf = _mlp_init(rng, (self.obs_dim, *self.hiddens, 1))
+        return {"pi": pi, "vf": vf}
+
+    def apply_np(self, params, obs):
+        obs = obs.reshape(len(obs), -1).astype(np.float32)
+        logits = _mlp_apply_np(params["pi"], obs)
+        vf = _mlp_apply_np(params["vf"], obs)[..., 0]
+        return {Columns.ACTION_DIST_INPUTS: logits, Columns.VF_PREDS: vf}
+
+    def apply_jax(self, params, obs):
+        obs = obs.reshape(len(obs), -1)
+        logits = _mlp_apply_jax(params["pi"], obs)
+        vf = _mlp_apply_jax(params["vf"], obs)[..., 0]
+        return {Columns.ACTION_DIST_INPUTS: logits, Columns.VF_PREDS: vf}

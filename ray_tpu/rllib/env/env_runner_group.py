@@ -1,0 +1,62 @@
+"""EnvRunnerGroup: fault-tolerant set of rollout actors.
+
+Capability parity: reference rllib/env/env_runner_group.py:71 — parallel sample(),
+sync_weights from the learner group, restart of failed runners.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .env_runner import SingleAgentEnvRunner
+
+
+class EnvRunnerGroup:
+    def __init__(self, config: "AlgorithmConfig"):  # noqa: F821
+        self.config = config
+        self.n = max(1, config.num_env_runners)
+        self._actor_cls = ray_tpu.remote(num_cpus=1)(SingleAgentEnvRunner)
+        self.runners = [self._actor_cls.remote(config, i) for i in range(self.n)]
+        self._last_weights_ref = None
+
+    def sample(self, num_timesteps_total: Optional[int] = None, explore: bool = True) -> List[Dict[str, np.ndarray]]:
+        per = None
+        if num_timesteps_total:
+            per = max(1, num_timesteps_total // self.n)
+        refs = [r.sample.remote(per, explore) for r in self.runners]
+        episodes: List[Dict[str, np.ndarray]] = []
+        for i, ref in enumerate(refs):
+            try:
+                episodes.extend(ray_tpu.get(ref))
+            except Exception:
+                # runner died: restart it (reference EnvRunnerGroup FT path)
+                self.runners[i] = self._actor_cls.remote(self.config, i)
+                if self._last_weights_ref is not None:
+                    self.runners[i].set_weights.remote(self._last_weights_ref)
+        return episodes
+
+    def sync_weights(self, weights) -> None:
+        """Push inference weights to all runners (reference sync_weights)."""
+        ref = ray_tpu.put(weights)
+        self._last_weights_ref = ref
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
+
+    def get_metrics(self) -> List[Dict[str, Any]]:
+        out = []
+        for r in self.runners:
+            try:
+                out.append(ray_tpu.get(r.get_metrics.remote()))
+            except Exception:
+                pass
+        return out
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.get(r.stop.remote())
+                ray_tpu.kill(r)
+            except Exception:
+                pass

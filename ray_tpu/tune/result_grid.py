@@ -14,10 +14,7 @@ class Result:
     error: Optional[str] = None
     checkpoint: Any = None
     metrics_dataframe: Any = None
-
-    @property
-    def trial_id(self) -> str:
-        return self.config.get("__trial_id__", "")
+    trial_id: str = ""
 
 
 class ResultGrid:
@@ -43,7 +40,8 @@ class ResultGrid:
             except Exception:
                 pass
             self._results.append(
-                Result(metrics=t.last_result, config=t.config, error=t.error, checkpoint=ckpt, metrics_dataframe=df)
+                Result(metrics=t.last_result, config=t.config, error=t.error,
+                       checkpoint=ckpt, metrics_dataframe=df, trial_id=t.trial_id)
             )
 
     def __len__(self):

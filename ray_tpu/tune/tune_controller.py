@@ -20,6 +20,19 @@ from .trainable import DONE, wrap_trainable
 PENDING, RUNNING, TERMINATED, ERROR = "PENDING", "RUNNING", "TERMINATED", "ERROR"
 
 
+def _graceful_stop(actor, timeout: float = 10.0) -> None:
+    """Run Trainable.stop() (cleanup of nested actors, e.g. rllib groups) before kill."""
+    try:
+        ref = actor.stop.remote()
+        ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(actor)
+    except Exception:
+        pass
+
+
 @dataclasses.dataclass
 class Trial:
     trial_id: str
@@ -97,10 +110,7 @@ class TuneController:
             except Exception:
                 trial.checkpoint = None
         if trial._actor is not None:
-            try:
-                ray_tpu.kill(trial._actor)
-            except Exception:
-                pass
+            _graceful_stop(trial._actor)
             trial._actor = None
         trial._pending = None
         self.scheduler.on_trial_complete(trial, trial.last_result)
@@ -152,7 +162,7 @@ class TuneController:
         # Try in-place reset; otherwise restart the actor with the new config.
         ok = ray_tpu.get(trial._actor.reset.remote(new_config))
         if not ok:
-            ray_tpu.kill(trial._actor)
+            _graceful_stop(trial._actor)
             trial._actor = self._actor_cls.remote(new_config)
         trial.config = new_config
         ray_tpu.get(trial._actor.restore.remote(donor_ckpt))
