@@ -1,0 +1,9 @@
+"""Native (C++) plane of ray_tpu.
+
+The reference's performance-critical runtime is C++ (SURVEY.md §2.1). Here the
+native pieces live as C-ABI shared libraries loaded via ctypes (no pybind11 in
+the image), built lazily by g++ with the compiled .so cached next to the source.
+"""
+from .build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,35 @@
+"""Lazy g++ build of the native libraries, cached by source mtime."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load_library(stem: str, extra_flags=()) -> ctypes.CDLL:
+    """Compile <stem>.cc to lib<stem>.so if stale, then dlopen it."""
+    with _LOCK:
+        if stem in _CACHE:
+            return _CACHE[stem]
+        src = os.path.join(_DIR, f"{stem}.cc")
+        so = os.path.join(_DIR, f"lib{stem}.so")
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            tmp = so + f".tmp.{os.getpid()}"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
+                   "-lpthread", "-lrt", *extra_flags]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(f"native build failed:\n{proc.stderr}")
+            os.replace(tmp, so)  # atomic vs concurrent builders
+        lib = ctypes.CDLL(so)
+        _CACHE[stem] = lib
+        return lib

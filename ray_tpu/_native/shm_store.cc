@@ -1,0 +1,454 @@
+// Plasma-equivalent shared-memory object arena (C++, native plane).
+//
+// Capability parity: reference plasma store (src/ray/object_manager/plasma/store.h:55,
+// plasma_allocator.h over dlmalloc, obj_lifecycle_mgr.h) — a per-node shared-memory
+// region where any process creates/seals objects and any process maps them zero-copy.
+// Designed differently from plasma: no store daemon and no socket protocol. The arena
+// is one POSIX shm segment containing a boundary-tag heap plus an open-addressing
+// object table, guarded by a robust process-shared mutex — so create/seal/get are
+// nanosecond-scale library calls (plasma pays a round-trip through the store process;
+// see plasma.fbs wire protocol). Crash-safety: the robust mutex recovers the lock from
+// dead owners; unsealed objects from dead writers are garbage-collected by sweep().
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+//
+// Build: g++ -O2 -shared -fPIC -o libshm_store.so shm_store.cc -lpthread -lrt
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <signal.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055534852ULL;  // "RTPUSHR"
+constexpr uint32_t kAlign = 64;                   // cache-line align allocations
+constexpr uint32_t kIdLen = 20;                   // ObjectID bytes
+
+// Object table entry states.
+enum : uint32_t {
+  kEmpty = 0,
+  kAllocated = 1,  // created, being written
+  kSealed = 2,     // immutable, readable
+  kTombstone = 3,  // deleted (keeps probe chains alive)
+  kCondemned = 4,  // deleted while readers hold pins; freed on last unpin
+};
+
+struct Entry {
+  uint8_t id[kIdLen];
+  uint32_t state;
+  uint32_t owner_pid;   // creator, for dead-writer GC of unsealed objects
+  uint64_t offset;      // data offset from arena base
+  uint64_t size;
+  uint32_t pin_count;   // readers holding zero-copy views (delete defers on >0)
+  uint32_t _pad;
+};
+
+// Free block header (boundary-tag list threaded through the heap).
+struct FreeBlock {
+  uint64_t size;       // total block size including header
+  uint64_t next;       // offset of next free block (0 = end)
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;
+  uint64_t table_offset;
+  uint64_t table_cap;      // power of two
+  uint64_t heap_offset;
+  uint64_t free_head;      // offset of first free block (0 = none)
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  uint64_t peak_used;
+  pthread_mutex_t mutex;
+};
+
+struct Handle {
+  void* base;
+  uint64_t size;
+  int owner;  // created (vs attached)
+};
+
+inline Header* H(Handle* h) { return reinterpret_cast<Header*>(h->base); }
+inline Entry* table(Handle* h) {
+  return reinterpret_cast<Entry*>(static_cast<char*>(h->base) + H(h)->table_offset);
+}
+
+inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 20-byte id.
+  uint64_t x = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kIdLen; i++) {
+    x ^= id[i];
+    x *= 1099511628211ULL;
+  }
+  return x;
+}
+
+int lock(Handle* h) {
+  int rc = pthread_mutex_lock(&H(h)->mutex);
+  if (rc == EOWNERDEAD) {
+    // Previous holder died mid-critical-section; state is still consistent for our
+    // coarse-grained usage (each op completes table+heap updates under the lock).
+    pthread_mutex_consistent(&H(h)->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+void unlock(Handle* h) { pthread_mutex_unlock(&H(h)->mutex); }
+
+Entry* find(Handle* h, const uint8_t* id, int for_insert) {
+  Header* hd = H(h);
+  Entry* t = table(h);
+  uint64_t mask = hd->table_cap - 1;
+  uint64_t i = hash_id(id) & mask;
+  Entry* first_tomb = nullptr;
+  for (uint64_t probes = 0; probes <= mask; probes++, i = (i + 1) & mask) {
+    Entry* e = &t[i];
+    if (e->state == kEmpty) {
+      if (for_insert) return first_tomb ? first_tomb : e;
+      return nullptr;
+    }
+    if (e->state == kTombstone) {
+      if (for_insert && !first_tomb) first_tomb = e;
+      continue;
+    }
+    if (memcmp(e->id, id, kIdLen) == 0) return e;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+// Best-fit allocation from the free list. Returns data offset or 0.
+uint64_t heap_alloc(Handle* h, uint64_t want) {
+  Header* hd = H(h);
+  want = align_up(want, kAlign);
+  uint64_t best = 0, best_prev = 0, best_size = ~0ULL;
+  uint64_t prev = 0, cur = hd->free_head;
+  char* base = static_cast<char*>(h->base);
+  while (cur) {
+    FreeBlock* fb = reinterpret_cast<FreeBlock*>(base + cur);
+    if (fb->size >= want && fb->size < best_size) {
+      best = cur;
+      best_prev = prev;
+      best_size = fb->size;
+      if (fb->size == want) break;
+    }
+    prev = cur;
+    cur = fb->next;
+  }
+  if (!best) return 0;
+  FreeBlock* fb = reinterpret_cast<FreeBlock*>(base + best);
+  uint64_t remain = fb->size - want;
+  uint64_t next = fb->next;
+  if (remain >= kAlign + sizeof(FreeBlock)) {
+    uint64_t rest = best + want;
+    FreeBlock* rb = reinterpret_cast<FreeBlock*>(base + rest);
+    rb->size = remain;
+    rb->next = next;
+    next = rest;
+  } else {
+    want = fb->size;  // absorb the sliver
+  }
+  if (best_prev) {
+    reinterpret_cast<FreeBlock*>(base + best_prev)->next = next;
+  } else {
+    hd->free_head = next;
+  }
+  hd->used_bytes += want;
+  if (hd->used_bytes > hd->peak_used) hd->peak_used = hd->used_bytes;
+  return best;
+}
+
+// Free with address-ordered insert + coalescing of adjacent blocks.
+void heap_free(Handle* h, uint64_t off, uint64_t size) {
+  Header* hd = H(h);
+  size = align_up(size, kAlign);
+  char* base = static_cast<char*>(h->base);
+  uint64_t prev = 0, cur = hd->free_head;
+  while (cur && cur < off) {
+    prev = cur;
+    cur = reinterpret_cast<FreeBlock*>(base + cur)->next;
+  }
+  FreeBlock* nb = reinterpret_cast<FreeBlock*>(base + off);
+  nb->size = size;
+  nb->next = cur;
+  if (prev) {
+    FreeBlock* pb = reinterpret_cast<FreeBlock*>(base + prev);
+    pb->next = off;
+    if (prev + pb->size == off) {  // merge prev+new
+      pb->size += nb->size;
+      pb->next = nb->next;
+      nb = pb;
+      off = prev;
+    }
+  } else {
+    hd->free_head = off;
+  }
+  if (nb->next && off + nb->size == nb->next) {  // merge new+next
+    FreeBlock* xb = reinterpret_cast<FreeBlock*>(base + nb->next);
+    nb->size += xb->size;
+    nb->next = xb->next;
+  }
+  hd->used_bytes -= size;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create + initialize an arena. Returns handle or null.
+void* rt_store_create(const char* name, uint64_t total_size, uint64_t table_cap) {
+  // round table_cap up to a power of two
+  uint64_t cap = 1;
+  while (cap < table_cap) cap <<= 1;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(total_size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, total_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* hd = reinterpret_cast<Header*>(base);
+  memset(hd, 0, sizeof(Header));
+  hd->total_size = total_size;
+  hd->table_offset = align_up(sizeof(Header), kAlign);
+  hd->table_cap = cap;
+  hd->heap_offset = align_up(hd->table_offset + cap * sizeof(Entry), kAlign);
+  if (hd->heap_offset + kAlign + sizeof(FreeBlock) > total_size) {
+    munmap(base, total_size);
+    shm_unlink(name);
+    return nullptr;  // table does not leave room for a heap
+  }
+  memset(static_cast<char*>(base) + hd->table_offset, 0, cap * sizeof(Entry));
+  // one big free block
+  hd->free_head = hd->heap_offset;
+  FreeBlock* fb = reinterpret_cast<FreeBlock*>(static_cast<char*>(base) + hd->heap_offset);
+  fb->size = total_size - hd->heap_offset;
+  fb->next = 0;
+  hd->used_bytes = 0;
+  hd->num_objects = 0;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hd->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+  hd->magic = kMagic;  // last: marks init complete for attachers
+
+  Handle* h = new Handle{base, total_size, 1};
+  return h;
+}
+
+void* rt_store_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  Header* hd = reinterpret_cast<Header*>(base);
+  if (hd->magic != kMagic) {
+    munmap(base, st.st_size);
+    return nullptr;
+  }
+  Handle* h = new Handle{base, static_cast<uint64_t>(st.st_size), 0};
+  return h;
+}
+
+void rt_store_close(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (!h) return;
+  munmap(h->base, h->size);
+  delete h;
+}
+
+int rt_store_unlink(const char* name) { return shm_unlink(name); }
+
+// Allocate an object. Returns data offset; 0 = OOM; -1 (as uint64 max) = exists.
+uint64_t rt_alloc(void* hv, const uint8_t* id, uint64_t size) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return 0;
+  Entry* e = find(h, id, 0);
+  if (e) {
+    unlock(h);
+    return ~0ULL;
+  }
+  uint64_t off = heap_alloc(h, size ? size : 1);
+  if (off) {
+    Entry* slot = find(h, id, 1);
+    if (!slot) {  // table full
+      heap_free(h, off, size ? size : 1);
+      off = 0;
+    } else {
+      memcpy(slot->id, id, kIdLen);
+      slot->state = kAllocated;
+      slot->owner_pid = static_cast<uint32_t>(getpid());
+      slot->offset = off;
+      slot->size = size;
+      H(h)->num_objects++;
+    }
+  }
+  unlock(h);
+  return off;
+}
+
+int rt_seal(void* hv, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return -1;
+  Entry* e = find(h, id, 0);
+  int rc = -1;
+  if (e && e->state == kAllocated) {
+    e->state = kSealed;
+    rc = 0;
+  }
+  unlock(h);
+  return rc;
+}
+
+// Look up a sealed object and take a reader pin (zero-copy view protection).
+// 0 = found (pinned); -1 = missing; -2 = present but unsealed.
+int rt_get(void* hv, const uint8_t* id, uint64_t* offset, uint64_t* size) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return -1;
+  Entry* e = find(h, id, 0);
+  int rc = -1;
+  if (e) {
+    if (e->state == kSealed) {
+      *offset = e->offset;
+      *size = e->size;
+      e->pin_count++;
+      rc = 0;
+    } else {
+      rc = -2;
+    }
+  }
+  unlock(h);
+  return rc;
+}
+
+// Drop a reader pin taken by rt_get. Frees the block if the object was deleted
+// while pinned (kCondemned) and this was the last pin.
+int rt_unpin(void* hv, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return -1;
+  Entry* e = find(h, id, 0);
+  int rc = -1;
+  if (e && (e->state == kSealed || e->state == kCondemned) && e->pin_count > 0) {
+    e->pin_count--;
+    if (e->state == kCondemned && e->pin_count == 0) {
+      heap_free(h, e->offset, e->size ? e->size : 1);
+      e->state = kTombstone;
+    }
+    rc = 0;
+  }
+  unlock(h);
+  return rc;
+}
+
+int rt_delete(void* hv, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return -1;
+  Entry* e = find(h, id, 0);
+  int rc = -1;
+  if (e && (e->state == kAllocated || e->state == kSealed)) {
+    if (e->pin_count > 0) {
+      // readers still hold views; defer the free to the last unpin
+      e->state = kCondemned;
+    } else {
+      heap_free(h, e->offset, e->size ? e->size : 1);
+      e->state = kTombstone;
+    }
+    H(h)->num_objects--;
+    rc = 0;
+  }
+  unlock(h);
+  return rc;
+}
+
+// Coordinator-driven GC: delete entries whose creator is dead and whose id is
+// not in the keep set (dead workers' unsealed writes AND sealed-but-unreported
+// outputs; keep = every id the coordinator's object directory still references).
+// keep_blob is n_keep contiguous 20-byte ids. Returns entries collected.
+int rt_gc_dead_owners(void* hv, const uint8_t* keep_blob, uint64_t n_keep) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return -1;
+  Header* hd = H(h);
+  Entry* t = table(h);
+  int n = 0;
+  for (uint64_t i = 0; i < hd->table_cap; i++) {
+    Entry* e = &t[i];
+    if (e->state != kAllocated && e->state != kSealed) continue;
+    if (!e->owner_pid || kill(e->owner_pid, 0) == 0 || errno != ESRCH) continue;
+    bool keep = false;
+    for (uint64_t k = 0; k < n_keep; k++) {
+      if (memcmp(keep_blob + k * kIdLen, e->id, kIdLen) == 0) {
+        keep = true;
+        break;
+      }
+    }
+    if (keep) continue;
+    if (e->pin_count > 0) {
+      e->state = kCondemned;
+    } else {
+      heap_free(h, e->offset, e->size ? e->size : 1);
+      e->state = kTombstone;
+    }
+    hd->num_objects--;
+    n++;
+  }
+  unlock(h);
+  return n;
+}
+
+// GC unsealed objects whose creator died (crash during write). Returns count freed.
+int rt_sweep(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return -1;
+  Header* hd = H(h);
+  Entry* t = table(h);
+  int n = 0;
+  for (uint64_t i = 0; i < hd->table_cap; i++) {
+    Entry* e = &t[i];
+    if (e->state == kAllocated && e->owner_pid && kill(e->owner_pid, 0) != 0 &&
+        errno == ESRCH) {
+      heap_free(h, e->offset, e->size ? e->size : 1);
+      e->state = kTombstone;
+      hd->num_objects--;
+      n++;
+    }
+  }
+  unlock(h);
+  return n;
+}
+
+void rt_stats(void* hv, uint64_t* used, uint64_t* capacity, uint64_t* num_objects,
+              uint64_t* peak) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return;
+  Header* hd = H(h);
+  *used = hd->used_bytes;
+  *capacity = hd->total_size - hd->heap_offset;
+  *num_objects = hd->num_objects;
+  *peak = hd->peak_used;
+  unlock(h);
+}
+
+}  // extern "C"

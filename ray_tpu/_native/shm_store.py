@@ -1,0 +1,147 @@
+"""ctypes wrapper over the C++ shared-memory object arena (shm_store.cc).
+
+Python maps the same POSIX shm segment with mmap for zero-copy buffer views; the
+C++ side owns all metadata (object table, heap) inside the segment, so any number
+of processes share one arena with no daemon (contrast: reference plasma store
+socket protocol, src/ray/object_manager/plasma/plasma.fbs).
+"""
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+from typing import Optional, Tuple
+
+from .build import load_library
+
+_ID_LEN = 20
+
+
+def _lib():
+    lib = load_library("shm_store")
+    if not getattr(lib, "_rt_configured", False):
+        u64 = ctypes.c_uint64
+        lib.rt_store_create.restype = ctypes.c_void_p
+        lib.rt_store_create.argtypes = [ctypes.c_char_p, u64, u64]
+        lib.rt_store_open.restype = ctypes.c_void_p
+        lib.rt_store_open.argtypes = [ctypes.c_char_p]
+        lib.rt_store_close.argtypes = [ctypes.c_void_p]
+        lib.rt_store_unlink.argtypes = [ctypes.c_char_p]
+        lib.rt_alloc.restype = u64
+        lib.rt_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64]
+        lib.rt_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        lib.rt_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_sweep.argtypes = [ctypes.c_void_p]
+        lib.rt_gc_dead_owners.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64]
+        lib.rt_stats.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(u64)] * 4
+        lib._rt_configured = True
+    return lib
+
+
+class Arena:
+    """One node-wide shared-memory object arena."""
+
+    def __init__(self, name: str, handle, size: int, owner: bool):
+        self.name = name
+        self._h = handle
+        self._lib = _lib()
+        self.owner = owner
+        fd = os.open(f"/dev/shm{name}", os.O_RDWR)
+        try:
+            self._map = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._map)
+
+    # -- lifecycle -------------------------------------------------------------
+    @classmethod
+    def create(cls, name: str, capacity: int, table_cap: int = 0) -> "Arena":
+        if table_cap <= 0:
+            # ~48 B/entry; keep the table under ~3% of the arena, within [1024, 1M].
+            table_cap = max(1024, min(1 << 20, capacity // 2048))
+        h = _lib().rt_store_create(name.encode(), capacity, table_cap)
+        if not h:
+            raise OSError(f"failed to create arena {name}")
+        return cls(name, h, capacity, owner=True)
+
+    @classmethod
+    def open(cls, name: str) -> "Arena":
+        h = _lib().rt_store_open(name.encode())
+        if not h:
+            raise OSError(f"failed to open arena {name}")
+        size = os.stat(f"/dev/shm{name}").st_size
+        return cls(name, h, size, owner=False)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rt_store_close(self._h)
+            self._h = None
+            try:
+                self._view.release()
+                self._map.close()
+            except BufferError:
+                # zero-copy views of objects are still alive; the mapping stays until
+                # they are dropped (process exit at the latest)
+                pass
+
+    def unlink(self) -> None:
+        self._lib.rt_store_unlink(self.name.encode())
+
+    # -- object ops ------------------------------------------------------------
+    @staticmethod
+    def _id(oid: bytes) -> bytes:
+        if len(oid) != _ID_LEN:
+            oid = (oid + b"\0" * _ID_LEN)[:_ID_LEN]
+        return oid
+
+    def create_object(self, oid: bytes, size: int) -> Optional[memoryview]:
+        """Allocate; returns a writable view or None (OOM / already exists)."""
+        off = self._lib.rt_alloc(self._h, self._id(oid), size)
+        if off in (0, 0xFFFFFFFFFFFFFFFF):
+            return None
+        return self._view[off:off + size]
+
+    def seal(self, oid: bytes) -> None:
+        if self._lib.rt_seal(self._h, self._id(oid)) != 0:
+            raise KeyError(f"seal failed for {oid.hex()}")
+
+    def get(self, oid: bytes) -> Optional[memoryview]:
+        """Read-side lookup; returns a view of the sealed object or None.
+
+        Takes a reader PIN: the caller (object_store.resolve) must arrange a
+        matching unpin() once no zero-copy views of this object remain. A
+        delete() while pinned defers the free until the last unpin."""
+        off, size = ctypes.c_uint64(), ctypes.c_uint64()
+        rc = self._lib.rt_get(self._h, self._id(oid), ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        return self._view[off.value:off.value + size.value]
+
+    def unpin(self, oid: bytes) -> None:
+        if self._h:  # no-op after close (late weakref finalizers at shutdown)
+            self._lib.rt_unpin(self._h, self._id(oid))
+
+    def delete(self, oid: bytes) -> bool:
+        return self._lib.rt_delete(self._h, self._id(oid)) == 0
+
+    def sweep(self) -> int:
+        """GC unsealed objects from dead writers; returns number collected."""
+        return self._lib.rt_sweep(self._h)
+
+    def gc_dead_owners(self, keep_ids) -> int:
+        """GC all objects whose creator process died, except ids in keep_ids
+        (the coordinator's live object directory)."""
+        blob = b"".join(self._id(i) for i in keep_ids)
+        return self._lib.rt_gc_dead_owners(self._h, blob, len(keep_ids))
+
+    def stats(self) -> Tuple[int, int, int, int]:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        peak = ctypes.c_uint64()
+        self._lib.rt_stats(self._h, ctypes.byref(used), ctypes.byref(cap),
+                           ctypes.byref(n), ctypes.byref(peak))
+        return used.value, cap.value, n.value, peak.value

@@ -1,0 +1,162 @@
+"""C++ shared-memory arena tests (reference: plasma store tests,
+src/ray/object_manager/plasma/ + test_plasma*; SURVEY.md §2.1)."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._native.shm_store import Arena
+
+
+@pytest.fixture()
+def arena():
+    name = f"/rtpu_t_{os.getpid()}_{os.urandom(2).hex()}"
+    a = Arena.create(name, 4 << 20)
+    yield a
+    a.unlink()
+    a.close()
+
+
+def test_create_seal_get_delete(arena):
+    oid = b"x" * 20
+    buf = arena.create_object(oid, 100)
+    buf[:5] = b"hello"
+    # unsealed objects are not readable
+    assert arena.get(oid) is None
+    arena.seal(oid)
+    v = arena.get(oid)  # takes a reader pin
+    assert bytes(v[:5]) == b"hello"
+    del buf, v
+    arena.unpin(oid)
+    assert arena.delete(oid)
+    assert arena.get(oid) is None
+
+
+def test_delete_defers_while_pinned(arena):
+    """delete() under a live reader pin must not recycle the memory."""
+    oid = b"p" * 20
+    buf = arena.create_object(oid, 1000)
+    buf[:6] = b"pinned"
+    del buf
+    arena.seal(oid)
+    v = arena.get(oid)  # pin
+    used_before, _, _, _ = arena.stats()
+    assert arena.delete(oid)  # logically gone...
+    assert arena.get(oid) is None
+    used, _, _, _ = arena.stats()
+    assert used == used_before  # ...but memory still held for the reader
+    assert bytes(v[:6]) == b"pinned"  # view remains valid
+    del v
+    arena.unpin(oid)  # last pin drops -> block actually freed
+    used, _, _, _ = arena.stats()
+    assert used < used_before
+
+
+def test_duplicate_create_rejected(arena):
+    oid = b"d" * 20
+    assert arena.create_object(oid, 10) is not None
+    assert arena.create_object(oid, 10) is None
+
+
+def test_oom_returns_none_and_free_recovers(arena):
+    used0, cap, n0, _ = arena.stats()
+    big = b"big" + b"\0" * 17
+    assert arena.create_object(big, cap - 64) is not None
+    arena.seal(big)
+    assert arena.create_object(b"y" * 20, 1024) is None  # full
+    assert arena.delete(big)
+    assert arena.create_object(b"y" * 20, 1024) is not None  # space reclaimed
+
+
+def test_fragmentation_coalescing(arena):
+    ids = [bytes([i]) * 20 for i in range(64)]
+    for oid in ids:
+        assert arena.create_object(oid, 16 * 1024) is not None
+        arena.seal(oid)
+    # free alternating then the rest -> allocator must coalesce to one block
+    for oid in ids[::2]:
+        assert arena.delete(oid)
+    for oid in ids[1::2]:
+        assert arena.delete(oid)
+    used, cap, n, _ = arena.stats()
+    assert (used, n) == (0, 0)
+    assert arena.create_object(b"Z" * 20, cap - 64) is not None
+
+
+def _child_reader(name, oid, q):
+    a = Arena.open(name)
+    v = a.get(oid)
+    q.put(bytes(v[:8]) if v is not None else None)
+    a.close()
+
+
+def _child_writer(name, oid, q):
+    a = Arena.open(name)
+    buf = a.create_object(oid, 64)
+    buf[:8] = b"fromkid!"
+    del buf
+    a.seal(oid)
+    q.put(True)
+    a.close()
+
+
+def test_cross_process_read_write(arena):
+    ctx = mp.get_context("spawn")
+    oid1, oid2 = b"a" * 20, b"b" * 20
+    buf = arena.create_object(oid1, 64)
+    buf[:8] = b"frompar!"
+    del buf
+    arena.seal(oid1)
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_reader, args=(arena.name, oid1, q))
+    p.start()
+    assert q.get(timeout=30) == b"frompar!"
+    p.join()
+    p = ctx.Process(target=_child_writer, args=(arena.name, oid2, q))
+    p.start()
+    assert q.get(timeout=30) is True
+    p.join()
+    assert bytes(arena.get(oid2)[:8]) == b"fromkid!"
+
+
+def _crash_writer(name, oid):
+    a = Arena.open(name)
+    a.create_object(oid, 64)  # never sealed
+    os._exit(1)
+
+
+def test_sweep_collects_dead_writers(arena):
+    ctx = mp.get_context("spawn")
+    oid = b"c" * 20
+    p = ctx.Process(target=_crash_writer, args=(arena.name, oid))
+    p.start()
+    p.join()
+    assert arena.sweep() == 1
+    # slot is reusable again
+    assert arena.create_object(oid, 64) is not None
+
+
+def test_store_integration_large_object_roundtrip(rt):
+    """ray.put/get of a large array must ride the arena zero-copy path."""
+    arr = np.arange(1 << 20, dtype=np.float32)  # 4 MB
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    from ray_tpu.core import global_state
+
+    cluster = global_state.try_cluster()
+    if cluster.arena_name:  # arena active: the object must be accounted there
+        stats = cluster.store.stats()
+        assert stats["arena_bytes"] >= arr.nbytes
+
+
+def test_store_integration_worker_returns_large(rt):
+    @rt.remote
+    def make(n):
+        return np.ones(n, dtype=np.float64)
+
+    refs = [make.remote(1 << 17) for _ in range(4)]  # 1 MB each, from workers
+    for r in refs:
+        v = rt.get(r)
+        assert v.shape == (1 << 17,) and v[0] == 1.0
