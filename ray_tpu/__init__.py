@@ -40,6 +40,7 @@ from .core.task_spec import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from .runtime_env import RuntimeEnv  # noqa: F401
 
 __all__ = [
     "__version__",

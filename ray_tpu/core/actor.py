@@ -28,6 +28,7 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     lifetime=None,  # None | "detached"
     scheduling_strategy="DEFAULT",
     runtime_env=None,
+    max_concurrency=1,
 )
 
 
@@ -84,6 +85,12 @@ class ActorMethod:
         del pins  # safe to release: submit() pinned the args
         return refs[0] if num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting (reference actor.py bind -> ray.dag)."""
+        from ray_tpu.dag.compiled import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(f"Actor method {self._name} must be invoked with .remote()")
 
@@ -111,6 +118,9 @@ class ActorHandle:
         meta = object.__getattribute__(self, "_method_meta")
         if name in meta:
             return ActorMethod(self, name, meta[name].get("num_returns", 1))
+        if name == "__ray_call__":
+            # run an arbitrary fn(instance, *args) on the actor (reference actor.py)
+            return ActorMethod(self, "__ray_call__", 1)
         if name.startswith("_"):
             raise AttributeError(name)
         # Unknown methods still get a handle (meta may be stale after code update).
@@ -155,10 +165,7 @@ class ActorClass:
         meta, arg_refs, pins = encode_args(ctx, args, kwargs)
         actor_id = ActorID.generate()
         method_meta = extract_method_meta(self._cls)
-        runtime_env = dict(opts.get("runtime_env") or {})
-        runtime_env["methods"] = method_meta
-        if opts.get("lifetime") == "detached":
-            runtime_env["detached"] = True
+        runtime_env = dict(opts.get("runtime_env") or {}) or None
         spec = TaskSpec(
             task_id=TaskID.generate(),
             kind="actor_creation",
@@ -177,6 +184,9 @@ class ActorClass:
             actor_name=opts.get("name"),
             actor_namespace=opts.get("namespace") or "",
             runtime_env=runtime_env,
+            method_meta=method_meta,
+            detached=opts.get("lifetime") == "detached",
+            max_concurrency=max(1, int(opts.get("max_concurrency") or 1)),
         )
         ctx.submit(spec)
         del pins  # safe to release: submit() pinned the args

@@ -27,6 +27,7 @@ def init(
     *,
     worker_env: Optional[Dict[str, str]] = None,
     max_workers_per_node: Optional[int] = None,
+    object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = True,
     **_compat,
 ) -> None:
@@ -43,6 +44,8 @@ def init(
     kwargs: Dict[str, Any] = {}
     if max_workers_per_node is not None:
         kwargs["max_workers_per_node"] = max_workers_per_node
+    if object_store_memory is not None:
+        kwargs["object_store_memory"] = object_store_memory
     cluster = Cluster(total, worker_env=worker_env, **kwargs)
     global_state.set_cluster(cluster)
     global_state.set_worker(DriverContext(cluster))

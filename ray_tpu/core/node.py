@@ -130,7 +130,7 @@ class ActorState:
         self.death_cause: Optional[Exception] = None
         self.name: Optional[str] = creation_spec.actor_name
         self.namespace: str = creation_spec.actor_namespace
-        self.detached = bool(creation_spec.runtime_env and creation_spec.runtime_env.get("detached"))
+        self.detached = creation_spec.detached
         self.handle_count = 0
         self.kill_on_creation = False
 
@@ -143,18 +143,36 @@ class TaskState:
         self.resources: Dict[str, float] = {}
         self.bundle_ledger: Optional[ResourceLedger] = None
         self.cancelled = False
+        # timeline events (reference: GcsTaskManager task events / ray.timeline)
+        self.submitted_at: float = time.time()
+        self.dispatched_at: Optional[float] = None
 
 
 class Cluster:
     """The whole single-host deployment: GCS + object store + N virtual nodes + router."""
 
     def __init__(self, resources: Dict[str, float], worker_env: Optional[Dict[str, str]] = None,
-                 max_workers_per_node: int = DEFAULT_MAX_WORKERS_PER_NODE):
+                 max_workers_per_node: int = DEFAULT_MAX_WORKERS_PER_NODE,
+                 object_store_memory: Optional[int] = None):
         self.gcs = GCS()
         self.store = ObjectStore()
         self.pg_manager = PlacementGroupManager()
         self.worker_env = worker_env or {}
+        # Node-wide C++ shared-memory arena for large objects (plasma equivalent).
+        # Workers attach via the env var; falls back to per-object segments if the
+        # native build or shm creation fails.
+        if object_store_memory is None:
+            object_store_memory = int(
+                os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", 512 * 1024 * 1024)
+            )
+        self.arena_name = (
+            object_store.init_arena(object_store_memory) if object_store_memory > 0 else None
+        )
+        if self.arena_name:
+            self.worker_env.setdefault(object_store._ARENA_ENV, self.arena_name)
         self.fn_table: Dict[bytes, bytes] = {}
+        self.metrics_by_worker: Dict[Any, list] = {}
+        self.task_events: deque = deque(maxlen=10000)
         self.actors: Dict[ActorID, ActorState] = {}
         self.tasks: Dict[TaskID, TaskState] = {}
         self.pending: deque = deque()  # TaskSpecs waiting for dispatch
@@ -265,6 +283,9 @@ class Cluster:
             self._schedule()
         elif kind == "decref":
             self.store.decref(msg[1])
+        elif kind == "metrics":
+            # periodic per-worker metric snapshot (util/metrics.py push thread)
+            self.metrics_by_worker[w.worker_id] = msg[1]
         elif kind == "register_fn":
             _, fn_id, fn_bytes = msg
             self.fn_table[fn_id] = fn_bytes
@@ -371,7 +392,7 @@ class Cluster:
         with self._lock:
             self.tasks[spec.task_id] = TaskState(spec)
             if spec.kind == "actor_creation":
-                st = ActorState(spec.actor_id, spec, method_meta=spec.runtime_env.get("methods", {}) if spec.runtime_env else {})
+                st = ActorState(spec.actor_id, spec, method_meta=spec.method_meta)
                 self.actors[spec.actor_id] = st
                 if spec.actor_name:
                     ok = self.gcs.register_named_actor(spec.actor_name, spec.actor_namespace, spec.actor_id)
@@ -482,6 +503,9 @@ class Cluster:
             spec.fn_bytes = self.fn_table.get(spec.fn_id, spec.fn_bytes)
             worker.known_fns.add(spec.fn_id)
         worker.inflight.append(spec.task_id)
+        ts = self.tasks.get(spec.task_id)
+        if ts is not None:
+            ts.dispatched_at = time.time()
         worker.send(("task", spec, locs))
 
     def _choose_placement(self, spec: TaskSpec):
@@ -533,6 +557,9 @@ class Cluster:
             ts = self.tasks.get(task_id)
             if w.inflight and w.inflight[0] == task_id:
                 w.inflight.popleft()
+            elif task_id in w.inflight:
+                # threaded actors (max_concurrency>1) complete methods out of order
+                w.inflight.remove(task_id)
         spec = ts.spec if ts else None
 
         # Application exceptions retry only when retry_exceptions is set (reference
@@ -545,7 +572,12 @@ class Cluster:
         )
         if retry:
             for oid, loc in payload:
-                if loc[0] == "shm":
+                if loc[0] == "arena":
+                    try:
+                        object_store._open_arena(loc[1]).delete(loc[2])
+                    except Exception:
+                        pass
+                elif loc[0] == "shm":
                     try:
                         from multiprocessing import shared_memory
 
@@ -586,6 +618,17 @@ class Cluster:
             if spec is not None and spec.kind == "task" and w.state in ("busy", "blocked"):
                 w.node.push_idle(w)
             if not retry and ts is not None:
+                self.task_events.append({
+                    "task_id": task_id.hex(),
+                    "name": ts.spec.name,
+                    "kind": ts.spec.kind,
+                    "worker_id": w.worker_id.hex(),
+                    "node_id": w.node.node_id.hex(),
+                    "submitted_at": ts.submitted_at,
+                    "dispatched_at": ts.dispatched_at,
+                    "finished_at": time.time(),
+                    "error": err_info[2] if err_info else None,
+                })
                 self.tasks.pop(task_id, None)
             if not retry and spec is not None:
                 if not (spec.kind == "actor_creation" and spec.max_restarts != 0):
@@ -593,6 +636,24 @@ class Cluster:
                     # creation spec is resubmitted with the same arg refs).
                     self._unpin_args(spec)
         self._schedule()
+
+    def _gc_arena_after_death(self) -> None:
+        """Reclaim arena space from a dead worker: unsealed half-writes and sealed
+        outputs whose result message never reached us (reference analog: plasma
+        disconnect cleanup + ObjectLifecycleManager)."""
+        arena = object_store._default_arena()
+        if arena is None:
+            return
+        with self.store._lock:
+            keep = [oid.binary() for oid in self.store._locations]
+
+        def gc():
+            try:
+                arena.gc_dead_owners(keep)
+            except Exception:
+                pass
+
+        threading.Thread(target=gc, daemon=True, name="arena-gc").start()
 
     def _drain_actor_queue(self, st: ActorState) -> None:
         """Fail every pending method of a dead actor (caller holds the lock)."""
@@ -632,6 +693,8 @@ class Cluster:
             if w.resources_held:
                 (w.bundle_ledger or w.node.ledger).release(w.resources_held)
                 w.resources_held = {}
+            self.metrics_by_worker.pop(w.worker_id, None)
+        self._gc_arena_after_death()
         err = WorkerCrashedError(f"worker {w.worker_id.hex()[:8]} died unexpectedly")
         for task_id in inflight:
             ts = self.tasks.get(task_id)
@@ -777,6 +840,7 @@ class Cluster:
             pass
         self._router_thread.join(timeout=2.0)
         self.store.free_all()
+        object_store.destroy_arena()
 
 
 class DriverContext:

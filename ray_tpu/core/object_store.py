@@ -1,15 +1,18 @@
-"""Two-tier object store: inline bytes for small objects, POSIX shared memory for large.
+"""Three-tier object store: inline bytes, C++ shared-memory arena, per-object segments.
 
 Capability parity: reference plasma store (src/ray/object_manager/plasma/store.h:55) +
 CoreWorker memory store (src/ray/core_worker/store_provider/). Differences by design:
-- Producers (any process) create the shared-memory segment themselves and register only
-  metadata with the node coordinator, so large task returns and puts never copy through a
-  pipe (plasma's create/seal protocol, without a separate store daemon).
-- Readers map segments zero-copy; numpy arrays deserialized from a segment are views over
-  the mapping (pickle5 out-of-band buffers, see serialization.py).
+- Large objects live in one node-wide C++ arena (_native/shm_store.cc): create/seal are
+  library calls into shared memory, not a socket round-trip to a plasma daemon; the
+  allocator is a boundary-tag heap (plasma uses dlmalloc behind a store process).
+- When the arena is full or absent, producers fall back to creating a per-object POSIX
+  shm segment themselves (this doubles as "spilling" pressure relief).
+- Readers map zero-copy; numpy arrays deserialized from the arena or a segment are views
+  over the mapping (pickle5 out-of-band buffers, see serialization.py).
 """
 from __future__ import annotations
 
+import os
 import threading
 from multiprocessing import shared_memory
 from typing import Any, Dict, List, Optional, Tuple
@@ -20,8 +23,76 @@ from .ids import ObjectID
 # Objects below this many serialized bytes travel inline through control pipes.
 INLINE_THRESHOLD = 100 * 1024
 
-# Location tuples:  ("inline", frame_bytes, is_error) | ("shm", name, nbytes, is_error)
+# Location tuples:
+#   ("inline", frame_bytes, is_error)
+#   ("arena", arena_name, oid_bytes, nbytes, is_error)
+#   ("shm", name, nbytes, is_error)
 Location = Tuple
+
+# ------------------------------------------------------------------- arena plumbing
+_ARENA_ENV = "RAY_TPU_ARENA"
+_arena_lock = threading.Lock()
+_arenas: Dict[str, Any] = {}
+_arena_default: Optional[Any] = None
+_arena_disabled = False
+
+
+def init_arena(capacity: int) -> Optional[str]:
+    """Create this node's arena (coordinator side). Returns its name or None."""
+    global _arena_default, _arena_disabled
+    name = f"/rtpu_arena_{os.getpid()}_{os.urandom(3).hex()}"
+    try:
+        from ray_tpu._native.shm_store import Arena
+
+        a = Arena.create(name, capacity)
+    except Exception:
+        _arena_disabled = True
+        return None
+    with _arena_lock:
+        _arenas[name] = a
+        _arena_default = a
+    os.environ[_ARENA_ENV] = name  # driver-side materialize in this process
+    return name
+
+
+def destroy_arena() -> None:
+    global _arena_default
+    with _arena_lock:
+        a = _arena_default
+        _arena_default = None
+    if a is not None and a.owner:
+        try:
+            a.unlink()
+            a.close()
+        except Exception:
+            pass
+        os.environ.pop(_ARENA_ENV, None)
+
+
+def _open_arena(name: str):
+    with _arena_lock:
+        a = _arenas.get(name)
+    if a is None:
+        from ray_tpu._native.shm_store import Arena
+
+        a = Arena.open(name)
+        with _arena_lock:
+            _arenas[name] = a
+    return a
+
+
+def _default_arena():
+    """Writer-side arena: created locally (coordinator) or attached via env (workers)."""
+    global _arena_default, _arena_disabled
+    if _arena_default is None and not _arena_disabled:
+        name = os.environ.get(_ARENA_ENV)
+        if not name:
+            return None
+        try:
+            _arena_default = _open_arena(name)
+        except Exception:
+            _arena_disabled = True
+    return _arena_default
 
 
 class ObjectLost(Exception):
@@ -29,11 +100,21 @@ class ObjectLost(Exception):
 
 
 def materialize(obj: Any, oid: ObjectID, is_error: bool = False) -> Location:
-    """Serialize obj and place it: small -> inline bytes, large -> new shm segment."""
+    """Serialize obj and place it: small -> inline, large -> arena, overflow -> segment."""
     ser = serialization.serialize(obj)
     size = ser.frame_bytes
     if size < INLINE_THRESHOLD:
         return ("inline", ser.to_bytes(), is_error)
+    arena = _default_arena()
+    if arena is not None:
+        buf = arena.create_object(oid.binary(), size)
+        if buf is not None:
+            try:
+                ser.write_into(buf)
+            finally:
+                buf.release()
+            arena.seal(oid.binary())
+            return ("arena", arena.name, oid.binary(), size, is_error)
     name = "rt_" + oid.hex()[:24]
     seg = shared_memory.SharedMemory(name=name, create=True, size=size)
     try:
@@ -81,6 +162,25 @@ def resolve(loc: Location) -> Any:
     if kind == "inline":
         _, frame, is_error = loc
         value = serialization.loads(frame)
+    elif kind == "arena":
+        _, name, oid_bytes, size, is_error = loc
+        arena = _open_arena(name)
+        view = arena.get(oid_bytes)  # takes a reader pin
+        if view is None:
+            raise ObjectLost(f"arena object {oid_bytes.hex()} was freed or lost")
+        value = serialization.deserialize_frame(view[:size])
+        # Zero-copy views into the arena stay valid while the value lives: hold the
+        # pin until the value is collected (plasma analog: client buffer refcount).
+        # Roots that can't carry a finalizer (tuple/list/dict) get a private copy
+        # instead, so the pin can drop immediately.
+        try:
+            import weakref
+
+            weakref.finalize(value, arena.unpin, bytes(oid_bytes))
+        except TypeError:
+            copy = bytearray(view[:size])
+            value = serialization.deserialize_frame(memoryview(copy))
+            arena.unpin(oid_bytes)
     elif kind == "shm":
         _, name, size, is_error = loc
         seg = _segment_cache.open(name)
@@ -190,7 +290,14 @@ class ObjectStore:
         with self._lock:
             loc = self._locations.pop(oid, None)
             self._failed.pop(oid, None)
-        if loc is not None and loc[0] == "shm":
+        if loc is None:
+            return
+        if loc[0] == "arena":
+            try:
+                _open_arena(loc[1]).delete(loc[2])
+            except Exception:
+                pass
+        elif loc[0] == "shm":
             name = loc[1]
             _segment_cache.drop(name)
             try:
@@ -211,9 +318,11 @@ class ObjectStore:
     def stats(self) -> Dict[str, int]:
         with self._lock:
             shm_bytes = sum(l[2] for l in self._locations.values() if l[0] == "shm")
+            arena_bytes = sum(l[3] for l in self._locations.values() if l[0] == "arena")
             inline_bytes = sum(len(l[1]) for l in self._locations.values() if l[0] == "inline")
             return {
                 "num_objects": len(self._locations),
                 "shm_bytes": shm_bytes,
+                "arena_bytes": arena_bytes,
                 "inline_bytes": inline_bytes,
             }

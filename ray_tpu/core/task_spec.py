@@ -54,6 +54,10 @@ class TaskSpec:
     actor_name: Optional[str] = None
     actor_namespace: str = ""
     runtime_env: Optional[Dict[str, Any]] = None
+    # actor-creation control plane (not part of the user-facing runtime_env):
+    method_meta: Dict[str, Any] = field(default_factory=dict)
+    detached: bool = False
+    max_concurrency: int = 1
     # Filled by the scheduler:
     node_id: Optional[NodeID] = None
     pg_id: Optional[PlacementGroupID] = None

@@ -17,7 +17,7 @@ import os
 import sys
 import threading
 import traceback
-from collections import deque
+import queue
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -38,14 +38,27 @@ class WorkerContext:
         self.worker_id_hex = worker_id_hex
         self.accel = accel
         self._req_counter = 0
-        self._pending_tasks: deque = deque()
+        self._req_lock = threading.Lock()
+        self._reply_slots: Dict[int, list] = {}  # req_id -> [Event, ok, value]
+        self._task_queue: "queue.Queue" = queue.Queue()
         self._fn_cache: Dict[bytes, Any] = {}
         self._registered_fns: set = set()
         self._send_lock = threading.Lock()
+        self._recv_thread: Optional[threading.Thread] = None
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
-        self.current_task_id: Optional[TaskID] = None
+        self._method_pool = None
+        # per-thread: concurrent methods of a threaded actor each track their own task
+        self._task_ctx = threading.local()
         self._exit = False
+
+    @property
+    def current_task_id(self) -> Optional[TaskID]:
+        return getattr(self._task_ctx, "task_id", None)
+
+    @current_task_id.setter
+    def current_task_id(self, value: Optional[TaskID]) -> None:
+        self._task_ctx.task_id = value
 
     # -- transport -----------------------------------------------------------------
     def _send(self, msg) -> None:
@@ -56,31 +69,67 @@ class WorkerContext:
         return cloudpickle.loads(self.conn.recv_bytes())
 
     def _next_req_id(self) -> int:
-        self._req_counter += 1
-        return self._req_counter
+        with self._req_lock:
+            self._req_counter += 1
+            return self._req_counter
+
+    def _ensure_recv_thread(self) -> None:
+        """Demux thread: the ONLY reader of the pipe. Replies wake their waiting thread
+        via per-request events; tasks queue for the main loop. This makes the runtime
+        API safe from any thread in the worker (threaded actors: serve proxy/replicas,
+        train session reporter threads, ...)."""
+        if self._recv_thread is not None:
+            return
+        def recv_loop():
+            while True:
+                try:
+                    msg = self._recv()
+                except (EOFError, OSError):
+                    self._exit = True
+                    # Fail every blocked _request() waiter (any thread) — otherwise
+                    # a thread inside ray_tpu.get() would hang forever when the
+                    # coordinator dies without an orderly shutdown.
+                    with self._req_lock:
+                        slots = list(self._reply_slots.values())
+                        self._reply_slots.clear()
+                    err = ConnectionError("lost connection to the node coordinator")
+                    for slot in slots:
+                        slot[1], slot[2] = False, err
+                        slot[0].set()
+                    self._task_queue.put(("exit",))
+                    return
+                kind = msg[0]
+                if kind == "reply":
+                    with self._req_lock:
+                        slot = self._reply_slots.pop(msg[1], None)
+                    if slot is not None:
+                        slot[1], slot[2] = msg[2], msg[3]
+                        slot[0].set()
+                    # Unmatched replies (cancelled requests) are dropped.
+                elif kind == "free":
+                    object_store._segment_cache.drop(msg[1])
+                elif kind == "exit":
+                    self._exit = True
+                    self._task_queue.put(("exit",))
+                else:  # task and anything main-loop-bound
+                    self._task_queue.put(msg)
+
+        self._recv_thread = threading.Thread(target=recv_loop, daemon=True, name="ray-tpu-recv")
+        self._recv_thread.start()
 
     def _request(self, msg_type: str, *payload):
-        """Send an upcall and block for its reply, buffering unrelated inbound messages."""
+        """Send an upcall and block for its reply (thread-safe)."""
+        self._ensure_recv_thread()
         req_id = self._next_req_id()
+        slot = [threading.Event(), None, None]
+        with self._req_lock:
+            self._reply_slots[req_id] = slot
         self._send((msg_type, req_id) + payload)
-        while True:
-            msg = self._recv()
-            kind = msg[0]
-            if kind == "reply" and msg[1] == req_id:
-                ok, value = msg[2], msg[3]
-                if not ok:
-                    raise value
-                return value
-            elif kind == "task":
-                self._pending_tasks.append(msg)
-            elif kind == "free":
-                object_store._segment_cache.drop(msg[1])
-            elif kind == "exit":
-                self._exit = True
-                # Still need our reply; keep draining.
-            else:
-                # Unmatched replies (cancelled requests) are dropped.
-                pass
+        slot[0].wait()
+        ok, value = slot[1], slot[2]
+        if not ok:
+            raise value
+        return value
 
     # -- runtime API (mirrors DriverContext) ----------------------------------------
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -112,6 +161,10 @@ class WorkerContext:
             self._send(("decref", oid))
         except Exception:
             pass
+
+    def push_metrics(self, snapshot: list) -> None:
+        """One-way metric snapshot to the coordinator (util/metrics.py)."""
+        self._send(("metrics", snapshot))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
         self._send(("kill_actor", actor_id, no_restart, from_gc))
@@ -192,17 +245,76 @@ class WorkerContext:
         return args, kwargs
 
     def execute(self, spec: TaskSpec, resolved_locs: List) -> None:
+        # Threaded actors (reference max_concurrency): methods run on a pool so a
+        # replica can serve requests concurrently (serve batching/long polls).
+        if (
+            spec.kind == "actor_method"
+            and self._method_pool is not None
+        ):
+            self._method_pool.submit(self._execute_inner, spec, resolved_locs)
+            return
+        self._execute_inner(spec, resolved_locs)
+
+    def _execute_inner(self, spec: TaskSpec, resolved_locs: List) -> None:
         self.current_task_id = spec.task_id
         try:
+            from ray_tpu.runtime_env import applied as _renv_applied
+
             args, kwargs = self._resolve_args(spec, resolved_locs)
+            if spec.kind == "task" and spec.runtime_env:
+                with _renv_applied(spec.runtime_env):
+                    return self._execute_body(spec, args, kwargs)
+            if spec.kind == "actor_creation" and spec.runtime_env:
+                # actors keep their runtime env for their lifetime
+                with _renv_applied(spec.runtime_env, permanent=True):
+                    pass
+            return self._execute_body(spec, args, kwargs)
+        except BaseException as e:  # noqa: BLE001
+            self._send_error(spec, e)
+        finally:
+            self.current_task_id = None
+
+    def _send_error(self, spec: TaskSpec, e: BaseException) -> None:
+        """Report a task failure (body, arg resolution, or runtime-env application)."""
+        tb = traceback.format_exc()
+        err = TaskError(e, task_desc=spec.name, tb_str=tb)
+        try:
+            payload = [
+                (oid, object_store.materialize(err, oid, is_error=True))
+                for oid in spec.return_ids
+            ]
+        except Exception:
+            # the exception object itself failed to serialize; report a plain failure
+            err2 = TaskError(RuntimeError(f"unserializable error: {tb}"), spec.name)
+            payload = [
+                (oid, object_store.materialize(err2, oid, is_error=True))
+                for oid in spec.return_ids
+            ]
+        self._send(("result", spec.task_id, payload, (spec.name, tb, type(e).__name__)))
+
+    def _execute_body(self, spec: TaskSpec, args, kwargs) -> None:
+        try:
             if spec.kind == "actor_creation":
                 cls = self._load_fn(spec)
                 self.actor_instance = cls(*args, **kwargs)
                 self.actor_id = spec.actor_id
+                mc = spec.max_concurrency
+                if mc > 1:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._method_pool = ThreadPoolExecutor(
+                        max_workers=mc, thread_name_prefix="actor-method"
+                    )
                 results = [None]
             elif spec.kind == "actor_method":
-                method = getattr(self.actor_instance, spec.method_name)
-                out = method(*args, **kwargs)
+                if spec.method_name == "__ray_call__":
+                    # Escape hatch (reference ActorHandle.__ray_call__): run an arbitrary
+                    # function against the actor instance. Used by dag/ exec loops.
+                    fn = args[0]
+                    out = fn(self.actor_instance, *args[1:], **kwargs)
+                else:
+                    method = getattr(self.actor_instance, spec.method_name)
+                    out = method(*args, **kwargs)
                 results = self._split_returns(out, spec.num_returns)
             else:
                 fn = self._load_fn(spec)
@@ -213,22 +325,7 @@ class WorkerContext:
                 payload.append((oid, object_store.materialize(value, oid)))
             self._send(("result", spec.task_id, payload, None))
         except BaseException as e:  # noqa: BLE001
-            tb = traceback.format_exc()
-            err = TaskError(e, task_desc=spec.name, tb_str=tb)
-            try:
-                payload = [
-                    (oid, object_store.materialize(err, oid, is_error=True))
-                    for oid in spec.return_ids
-                ]
-                self._send(("result", spec.task_id, payload, (spec.name, tb, type(e).__name__)))
-            except Exception:
-                # Even the error failed to serialize; report a plain failure.
-                err2 = TaskError(RuntimeError(f"unserializable error: {tb}"), spec.name)
-                payload = [
-                    (oid, object_store.materialize(err2, oid, is_error=True))
-                    for oid in spec.return_ids
-                ]
-                self._send(("result", spec.task_id, payload, (spec.name, tb, type(e).__name__)))
+            self._send_error(spec, e)
         finally:
             self.current_task_id = None
 
@@ -243,24 +340,16 @@ class WorkerContext:
 
     # -- main loop -------------------------------------------------------------------
     def main_loop(self) -> None:
+        self._ensure_recv_thread()
         self._send(("ready", self.worker_id_hex))
         while not self._exit:
-            if self._pending_tasks:
-                msg = self._pending_tasks.popleft()
-            else:
-                try:
-                    msg = self._recv()
-                except (EOFError, OSError):
-                    break
+            msg = self._task_queue.get()
             kind = msg[0]
             if kind == "task":
                 _, spec, resolved_locs = msg
                 self.execute(spec, resolved_locs)
-            elif kind == "free":
-                object_store._segment_cache.drop(msg[1])
             elif kind == "exit":
                 break
-            # Stray replies from cancelled requests are ignored.
 
 
 def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dict[str, str]):

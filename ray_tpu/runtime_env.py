@@ -1,0 +1,82 @@
+"""RuntimeEnv: per-task/actor execution environment.
+
+Capability parity: reference python/ray/runtime_env/runtime_env.py:157 (RuntimeEnv)
++ _private/runtime_env/ plugins. Supported here: ``env_vars`` (applied around task
+execution; kept for an actor's lifetime), ``py_modules`` (local paths prepended to
+sys.path), ``working_dir`` (chdir for the duration). Cloud plugins (pip/conda/
+container) are out of scope on a hermetic single image — validated and rejected
+explicitly rather than silently ignored.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_SUPPORTED = {"env_vars", "py_modules", "working_dir"}
+_UNSUPPORTED = {"pip", "conda", "container", "uv", "image_uri"}
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-env mapping (reference RuntimeEnv is also dict-like)."""
+
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 py_modules: Optional[List[str]] = None,
+                 working_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        bad = set(kwargs) & _UNSUPPORTED
+        if bad:
+            raise ValueError(
+                f"runtime_env fields {sorted(bad)} require package installation, "
+                f"which is unavailable in this environment")
+        unknown = set(kwargs) - _SUPPORTED
+        if unknown:
+            raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
+        if env_vars:
+            if not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env_vars.items()):
+                raise TypeError("env_vars must be Dict[str, str]")
+            self["env_vars"] = dict(env_vars)
+        if py_modules:
+            self["py_modules"] = [str(p) for p in py_modules]
+        if working_dir:
+            self["working_dir"] = str(working_dir)
+        self.update(kwargs)
+
+
+@contextlib.contextmanager
+def applied(runtime_env: Optional[Dict[str, Any]], permanent: bool = False):
+    """Apply env_vars/py_modules/working_dir; restore on exit unless permanent
+    (actors keep their env for their lifetime, reference worker-per-env)."""
+    if not runtime_env:
+        yield
+        return
+    env_vars = runtime_env.get("env_vars") or {}
+    py_modules = runtime_env.get("py_modules") or []
+    working_dir = runtime_env.get("working_dir")
+
+    saved_env = {k: os.environ.get(k) for k in env_vars}
+    saved_cwd = os.getcwd() if working_dir else None
+    added_paths = []
+    try:
+        os.environ.update(env_vars)
+        for p in py_modules:
+            if p not in sys.path:
+                sys.path.insert(0, p)
+                added_paths.append(p)
+        if working_dir:
+            os.chdir(working_dir)
+        yield
+    finally:
+        if not permanent:
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            for p in added_paths:
+                with contextlib.suppress(ValueError):
+                    sys.path.remove(p)
+            if saved_cwd is not None:
+                os.chdir(saved_cwd)
