@@ -1,0 +1,139 @@
+"""serve public API: run/delete/status/shutdown/handles.
+
+Capability parity: reference python/ray/serve/api.py (serve.run :691) +
+_private/api.py serve_start — get-or-create controller actor, deploy application
+graphs, proxy bring-up, handle acquisition.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from .controller import CONTROLLER_NAME, ServeController
+from .deployment import Application, Deployment
+from .handle import DeploymentHandle
+
+_PROXY_NAME = "SERVE_PROXY"
+
+
+@dataclasses.dataclass
+class _HandleMarker:
+    app_name: str
+    deployment_name: str
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        cls = ray_tpu.remote(num_cpus=0.1, name=CONTROLLER_NAME, lifetime="detached")(ServeController)
+        handle = cls.remote()
+        ray_tpu.get(handle.ping.remote())
+        return handle
+
+
+def start(http_options: Optional[Dict[str, Any]] = None, **_compat) -> None:
+    """Bring up controller + HTTP proxy (reference serve.start)."""
+    _get_or_create_controller()
+    http_options = http_options or {}
+    try:
+        ray_tpu.get_actor(_PROXY_NAME)
+    except ValueError:
+        from .proxy import ProxyActor
+
+        cls = ray_tpu.remote(num_cpus=0.1, name=_PROXY_NAME, lifetime="detached")(ProxyActor)
+        proxy = cls.remote(http_options.get("host", "127.0.0.1"), http_options.get("port", 8000))
+        ray_tpu.get(proxy.ready.remote())
+
+
+def run(
+    target: Application,
+    *,
+    name: str = "default",
+    route_prefix: str = "/",
+    blocking: bool = False,
+    **_compat,
+) -> DeploymentHandle:
+    """Deploy an application graph; returns the ingress handle (reference api.py:691)."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects an Application (deployment.bind(...))")
+    controller = _get_or_create_controller()
+
+    apps: list = []
+    target._collect(apps)
+
+    def encode(value):
+        if isinstance(value, Application):
+            return _HandleMarker(name, value.deployment.name)
+        return value
+
+    payload = []
+    for bound in apps:
+        payload.append({
+            "name": bound.deployment.name,
+            "serialized_init": {
+                "target": bound.deployment._target,
+                "args": tuple(encode(a) for a in bound.args),
+                "kwargs": {k: encode(v) for k, v in bound.kwargs.items()},
+            },
+            "config": bound.deployment.config,
+            "is_ingress": bound is target,
+        })
+    ray_tpu.get(controller.deploy_application.remote(name, route_prefix, payload))
+    handle = DeploymentHandle(name, target.deployment.name)
+    # wait until the ingress deployment has at least one running replica
+    import time
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        info = ray_tpu.get(controller.get_deployment_info.remote(name, target.deployment.name))
+        if info and info["num_running"] >= 1:
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError(f"app {name!r} failed to reach RUNNING within 60s: {info}")
+    return handle
+
+
+def delete(name: str, _blocking: bool = True) -> None:
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_application.remote(name))
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.status.remote())
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    controller = _get_or_create_controller()
+    st = ray_tpu.get(controller.status.remote())
+    if name not in st:
+        raise ValueError(f"no app named {name!r}")
+    table = ray_tpu.get(controller.get_routing_table.remote())
+    for info in table.values():
+        if info["app"] == name:
+            return DeploymentHandle(name, info["deployment"])
+    raise ValueError(f"app {name!r} has no ingress")
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.shutdown.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass

@@ -1,0 +1,105 @@
+"""@serve.batch: dynamic request batching.
+
+Capability parity: reference python/ray/serve/batching.py — queue calls until
+max_batch_size or batch_wait_timeout_s, invoke the wrapped fn once with the list of
+inputs, scatter results. Thread-based (replicas execute requests on worker threads).
+"""
+from __future__ import annotations
+
+import functools
+import queue as _queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class _Batcher:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]], max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.q: _queue.Queue = _queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, instance, item) -> Future:
+        fut: Future = Future()
+        self.q.put((instance, item, fut))
+        return fut
+
+    def _loop(self) -> None:
+        while True:
+            instance, item, fut = self.q.get()
+            batch = [(instance, item, fut)]
+            # drain up to max_batch_size within the wait timeout
+            import time
+
+            deadline = time.monotonic() + self.timeout_s
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self.q.get(timeout=remaining))
+                except _queue.Empty:
+                    break
+            items = [b[1] for b in batch]
+            inst = batch[0][0]
+            try:
+                results = self.fn(inst, items) if inst is not None else self.fn(items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch fn returned {len(results)} results for {len(items)} inputs"
+                    )
+                for (_, _, f), r in zip(batch, results):
+                    f.set_result(r)
+            except BaseException as e:  # noqa: BLE001
+                for _, _, f in batch:
+                    if not f.done():
+                        f.set_exception(e)
+
+
+_creation_lock = threading.Lock()
+
+
+def _get_batcher(wrapper, fn, max_batch_size: int, timeout_s: float) -> _Batcher:
+    b = getattr(wrapper, "_batcher", None)
+    if b is None:
+        with _creation_lock:
+            b = getattr(wrapper, "_batcher", None)
+            if b is None:
+                b = _Batcher(fn, max_batch_size, timeout_s)
+                wrapper._batcher = b
+    return b
+
+
+def batch(
+    _fn: Optional[Callable] = None,
+    *,
+    max_batch_size: int = 8,
+    batch_wait_timeout_s: float = 0.01,
+):
+    """Decorator: fn(self, requests: List) -> List (reference @serve.batch)."""
+
+    def wrap(fn):
+        # The batcher (thread + queue + locks) is created lazily in the process that
+        # first calls the wrapper — unpicklable state must not live in the closure,
+        # since deployment classes are cloudpickled to replicas.
+        is_method = "." in getattr(fn, "__qualname__", "")
+
+        if is_method:
+            @functools.wraps(fn)
+            def method_wrapper(self, item):
+                return _get_batcher(method_wrapper, fn, max_batch_size, batch_wait_timeout_s).submit(self, item).result()
+
+            return method_wrapper
+
+        @functools.wraps(fn)
+        def fn_wrapper(item):
+            return _get_batcher(fn_wrapper, fn, max_batch_size, batch_wait_timeout_s).submit(None, item).result()
+
+        return fn_wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

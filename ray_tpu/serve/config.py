@@ -1,0 +1,30 @@
+"""Serve configs (reference python/ray/serve/config.py, schema.py)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Reference serve/config.py AutoscalingConfig — request-rate driven."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 10.0
+    metrics_interval_s: float = 1.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: Optional[int] = 1
+    max_ongoing_requests: int = 8
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 5.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    user_config: Optional[Dict[str, Any]] = None
+    version: Optional[str] = None

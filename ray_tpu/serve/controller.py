@@ -1,0 +1,257 @@
+"""ServeController: the singleton reconciliation actor.
+
+Capability parity: reference python/ray/serve/_private/controller.py:88 +
+application_state.py + deployment_state.py — target-state reconciliation loop,
+replica health checks, rolling updates on version change, request-rate autoscaling
+(autoscaling_state.py). Handles/proxies poll get_routing_table() (long-poll analog).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+STARTING, RUNNING, STOPPING = "STARTING", "RUNNING", "STOPPING"
+
+
+class _ReplicaState:
+    def __init__(self, actor, version):
+        self.actor = actor
+        self.version = version
+        self.state = STARTING
+        self.health_ref = None
+        self.last_health_ok = time.time()
+
+
+class _DeploymentState:
+    """Reference deployment_state.py:1379 — one deployment's replica set."""
+
+    def __init__(self, name: str, app_name: str, info: Dict[str, Any]):
+        self.name = name
+        self.app_name = app_name
+        self.info = info  # serialized_init, config, route_prefix, is_ingress
+        self.replicas: List[_ReplicaState] = []
+        self.target_num: int = info["config"].num_replicas or 1
+        ac = info["config"].autoscaling_config
+        if ac:
+            self.target_num = max(ac.min_replicas, 1)
+        self.autoscale_metric: float = 0.0
+        self._last_scale_change = 0.0
+
+    def running(self) -> List[_ReplicaState]:
+        return [r for r in self.replicas if r.state == RUNNING]
+
+
+class ServeController:
+    def __init__(self):
+        self.deployments: Dict[str, _DeploymentState] = {}  # key: app/deployment
+        self.apps: Dict[str, Dict[str, Any]] = {}  # app -> {route_prefix, ingress, deployments}
+        self._lock = threading.RLock()
+        self._shutdown = False
+        self._reconcile_thread = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._reconcile_thread.start()
+
+    # -- deploy API ------------------------------------------------------------
+    def deploy_application(self, app_name: str, route_prefix: str, deployments: List[Dict[str, Any]]) -> None:
+        """deployments: [{name, serialized_init, config, is_ingress}]"""
+        with self._lock:
+            self.apps[app_name] = {
+                "route_prefix": route_prefix,
+                "ingress": next(d["name"] for d in deployments if d["is_ingress"]),
+                "deployments": [d["name"] for d in deployments],
+            }
+            for d in deployments:
+                key = f"{app_name}/{d['name']}"
+                existing = self.deployments.get(key)
+                if existing is not None and existing.info["config"].version != d["config"].version:
+                    # version change -> rolling update: mark old replicas for replacement
+                    existing.info = d
+                    for r in existing.replicas:
+                        if r.version != d["config"].version:
+                            r.state = STOPPING
+                    existing.target_num = d["config"].num_replicas or existing.target_num
+                elif existing is None:
+                    self.deployments[key] = _DeploymentState(d["name"], app_name, d)
+                else:
+                    existing.info = d
+                    if d["config"].num_replicas:
+                        existing.target_num = d["config"].num_replicas
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            app = self.apps.pop(app_name, None)
+            if not app:
+                return
+            for dname in app["deployments"]:
+                ds = self.deployments.pop(f"{app_name}/{dname}", None)
+                if ds:
+                    for r in ds.replicas:
+                        self._stop_replica(r)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for app in list(self.apps):
+                self.delete_application(app)
+            self._shutdown = True
+
+    # -- read APIs (handles/proxies poll these; reference LongPollHost) ---------
+    def get_routing_table(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {}
+            for app_name, app in self.apps.items():
+                key = f"{app_name}/{app['ingress']}"
+                ds = self.deployments.get(key)
+                out[app["route_prefix"]] = {
+                    "app": app_name,
+                    "deployment": app["ingress"],
+                    "replicas": [r.actor for r in ds.running()] if ds else [],
+                }
+            return out
+
+    def get_replicas(self, app_name: str, deployment_name: str) -> List[Any]:
+        with self._lock:
+            ds = self.deployments.get(f"{app_name}/{deployment_name}")
+            return [r.actor for r in ds.running()] if ds else []
+
+    def get_deployment_info(self, app_name: str, deployment_name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            ds = self.deployments.get(f"{app_name}/{deployment_name}")
+            if ds is None:
+                return None
+            return {
+                "target_num_replicas": ds.target_num,
+                "num_running": len(ds.running()),
+                "states": [r.state for r in ds.replicas],
+            }
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                app: {
+                    "route_prefix": info["route_prefix"],
+                    "deployments": {
+                        d: self.get_deployment_info(app, d) for d in info["deployments"]
+                    },
+                }
+                for app, info in self.apps.items()
+            }
+
+    def ping(self) -> bool:
+        return True
+
+    # -- autoscaling input (handles push router stats; reference autoscaling_state) --
+    def record_handle_metrics(self, app_name: str, deployment_name: str, ongoing: float) -> None:
+        with self._lock:
+            ds = self.deployments.get(f"{app_name}/{deployment_name}")
+            if ds is not None:
+                # EWMA smooth so momentary spikes don't flap the replica count
+                ds.autoscale_metric = 0.6 * ds.autoscale_metric + 0.4 * ongoing
+
+    # -- reconciliation --------------------------------------------------------
+    def _start_replica(self, ds: _DeploymentState) -> None:
+        import ray_tpu
+
+        opts = dict(ds.info["config"].ray_actor_options or {})
+        actor_opts = {"num_cpus": opts.get("num_cpus", 1)}
+        if opts.get("num_tpus"):
+            actor_opts["num_tpus"] = opts["num_tpus"]
+        # replicas serve concurrent requests up to max_ongoing_requests (threaded actor)
+        moq = ds.info["config"].max_ongoing_requests
+        if moq and moq > 1:
+            actor_opts["max_concurrency"] = moq
+        from .replica import Replica
+
+        cls = ray_tpu.remote(**actor_opts)(Replica)
+        actor = cls.remote(ds.name, ds.info["serialized_init"], ds.info["config"].user_config)
+        r = _ReplicaState(actor, ds.info["config"].version)
+        r.health_ref = actor.check_health.remote()
+        ds.replicas.append(r)
+
+    def _stop_replica(self, r: _ReplicaState) -> None:
+        import ray_tpu
+
+        try:
+            r.actor.prepare_shutdown.remote()
+            ray_tpu.kill(r.actor, no_restart=True)
+        except Exception:
+            pass
+
+    def _autoscale(self, ds: _DeploymentState, now: float) -> None:
+        ac = ds.info["config"].autoscaling_config
+        if ac is None:
+            return
+        desired = ds.autoscale_metric / max(ac.target_ongoing_requests, 1e-6)
+        import math
+
+        desired = int(math.ceil(desired))
+        desired = max(ac.min_replicas, min(ac.max_replicas, desired))
+        if desired > ds.target_num and now - ds._last_scale_change >= ac.upscale_delay_s:
+            ds.target_num = desired
+            ds._last_scale_change = now
+        elif desired < ds.target_num and now - ds._last_scale_change >= ac.downscale_delay_s:
+            ds.target_num = desired
+            ds._last_scale_change = now
+
+    def _reconcile_once(self) -> None:
+        import ray_tpu
+
+        now = time.time()
+        with self._lock:
+            states = list(self.deployments.values())
+        for ds in states:
+            with self._lock:
+                self._autoscale(ds, now)
+                # promote STARTING replicas whose health check came back
+                for r in ds.replicas:
+                    if r.state == STARTING and r.health_ref is not None:
+                        done, _ = ray_tpu.wait([r.health_ref], num_returns=1, timeout=0)
+                        if done:
+                            try:
+                                ray_tpu.get(r.health_ref)
+                                r.state = RUNNING
+                                r.last_health_ok = now
+                            except Exception:
+                                r.state = STOPPING
+                            r.health_ref = None
+                # periodic health checks on RUNNING replicas
+                period = ds.info["config"].health_check_period_s
+                for r in ds.replicas:
+                    if r.state == RUNNING and r.health_ref is None and now - r.last_health_ok > period:
+                        r.health_ref = r.actor.check_health.remote()
+                    elif r.state == RUNNING and r.health_ref is not None:
+                        done, _ = ray_tpu.wait([r.health_ref], num_returns=1, timeout=0)
+                        if done:
+                            try:
+                                ray_tpu.get(r.health_ref)
+                                r.last_health_ok = now
+                            except Exception:
+                                r.state = STOPPING
+                            r.health_ref = None
+                        elif now - r.last_health_ok > period + ds.info["config"].health_check_timeout_s:
+                            r.state = STOPPING
+                            r.health_ref = None
+                # remove STOPPING
+                for r in [x for x in ds.replicas if x.state == STOPPING]:
+                    self._stop_replica(r)
+                    ds.replicas.remove(r)
+                # scale to target: count live (non-stopping) replicas of current version
+                live = [r for r in ds.replicas if r.state in (STARTING, RUNNING)]
+                for _ in range(ds.target_num - len(live)):
+                    self._start_replica(ds)
+                extra = len(live) - ds.target_num
+                for r in reversed(live):
+                    if extra <= 0:
+                        break
+                    if r.state == RUNNING or r.state == STARTING:
+                        r.state = STOPPING
+                        extra -= 1
+
+    def _reconcile_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+            except Exception:
+                pass
+            time.sleep(0.2)

@@ -1,0 +1,111 @@
+"""@serve.deployment decorator, Deployment, Application (bind graph).
+
+Capability parity: reference python/ray/serve/api.py:322 (@deployment), deployment.py
+(Deployment.options/bind), and the DAG-lite Application model: bound deployments with
+constructor args; nested bound deployments become DeploymentHandles at replica init.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from .config import AutoscalingConfig, DeploymentConfig
+
+
+@dataclasses.dataclass
+class Application:
+    """A bound deployment graph; pass to serve.run()."""
+
+    deployment: "Deployment"
+    args: Tuple
+    kwargs: Dict[str, Any]
+
+    def _collect(self, out: List["Application"]) -> None:
+        for a in list(self.args) + list(self.kwargs.values()):
+            if isinstance(a, Application):
+                a._collect(out)
+        if all(x.deployment.name != self.deployment.name for x in out):
+            out.append(self)
+
+
+class Deployment:
+    def __init__(self, target: Union[type, Callable], name: str, config: DeploymentConfig):
+        self._target = target
+        self.name = name
+        self.config = config
+
+    def options(
+        self,
+        *,
+        name: Optional[str] = None,
+        num_replicas: Optional[Union[int, str]] = None,
+        max_ongoing_requests: Optional[int] = None,
+        autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
+        ray_actor_options: Optional[Dict[str, Any]] = None,
+        user_config: Optional[Dict[str, Any]] = None,
+        version: Optional[str] = None,
+        health_check_period_s: Optional[float] = None,
+        **_compat,
+    ) -> "Deployment":
+        cfg = dataclasses.replace(self.config)
+        if isinstance(num_replicas, str) and num_replicas == "auto":
+            autoscaling_config = autoscaling_config or AutoscalingConfig()
+            num_replicas = None
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            cfg.autoscaling_config = autoscaling_config
+            cfg.num_replicas = None
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        if user_config is not None:
+            cfg.user_config = dict(user_config)
+        if version is not None:
+            cfg.version = version
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        return Deployment(self._target, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name})"
+
+
+def deployment(
+    _target: Optional[Union[type, Callable]] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Optional[Union[int, str]] = None,
+    max_ongoing_requests: int = 8,
+    autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+    user_config: Optional[Dict[str, Any]] = None,
+    version: Optional[str] = None,
+    health_check_period_s: float = 5.0,
+    **_compat,
+):
+    """@serve.deployment (reference api.py:322)."""
+
+    def wrap(target):
+        cfg = DeploymentConfig(
+            num_replicas=1,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options or {},
+            user_config=user_config,
+            version=version,
+            health_check_period_s=health_check_period_s,
+        )
+        d = Deployment(target, name or getattr(target, "__name__", "deployment"), cfg)
+        if num_replicas is not None or autoscaling_config is not None:
+            d = d.options(num_replicas=num_replicas, autoscaling_config=autoscaling_config)
+        return d
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap

@@ -1,0 +1,149 @@
+"""DeploymentHandle + router: client-side load balancing.
+
+Capability parity: reference python/ray/serve/handle.py:639 (DeploymentHandle),
+_private/router.py + request_router/pow_2_router.py:27 (power-of-two-choices on
+in-flight counts), DeploymentResponse futures. Handles refresh their replica set from
+the controller (long-poll analog) and push autoscaling metrics back.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+from .controller import CONTROLLER_NAME
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef (reference handle.py)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref) if timeout_s is None else ray_tpu.get(self._ref)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class _Router:
+    """Power-of-two-choices over locally tracked in-flight counts."""
+
+    def __init__(self):
+        self.inflight: Dict[Any, int] = {}
+        self.lock = threading.Lock()
+
+    def pick(self, replicas: List[Any]) -> Any:
+        with self.lock:
+            if len(replicas) == 1:
+                return replicas[0]
+            a, b = random.sample(replicas, 2)
+            return a if self.inflight.get(a, 0) <= self.inflight.get(b, 0) else b
+
+    def on_send(self, replica) -> None:
+        with self.lock:
+            self.inflight[replica] = self.inflight.get(replica, 0) + 1
+
+    def on_done(self, replica) -> None:
+        with self.lock:
+            self.inflight[replica] = max(0, self.inflight.get(replica, 0) - 1)
+
+    def total_inflight(self) -> int:
+        with self.lock:
+            return sum(self.inflight.values())
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: str, method_name: str = "__call__"):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._method = method_name
+        self._router = _Router()
+        self._replicas: List[Any] = []
+        self._last_refresh = 0.0
+        self._refresh_interval = 1.0
+        self._metrics_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- plumbing --------------------------------------------------------------
+    def _controller(self):
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._last_refresh < self._refresh_interval and self._replicas:
+            return
+        replicas = ray_tpu.get(
+            self._controller().get_replicas.remote(self.app_name, self.deployment_name)
+        )
+        self._replicas = replicas
+        self._last_refresh = now
+
+    def _ensure_metrics_push(self) -> None:
+        if self._metrics_thread is not None:
+            return
+
+        def push():
+            while not self._closed:
+                try:
+                    self._controller().record_handle_metrics.remote(
+                        self.app_name, self.deployment_name, float(self._router.total_inflight())
+                    )
+                except Exception:
+                    pass
+                time.sleep(1.0)
+
+        self._metrics_thread = threading.Thread(target=push, daemon=True)
+        self._metrics_thread.start()
+
+    # -- public ----------------------------------------------------------------
+    def options(self, method_name: Optional[str] = None, **_compat) -> "DeploymentHandle":
+        h = DeploymentHandle(self.app_name, self.deployment_name, method_name or self._method)
+        h._router = self._router  # share in-flight view across method handles
+        h._replicas = self._replicas
+        h._last_refresh = self._last_refresh
+        return h
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._ensure_metrics_push()
+        deadline = time.time() + 30.0
+        while True:
+            self._refresh()
+            if self._replicas:
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"no running replicas for {self.app_name}/{self.deployment_name}"
+                )
+            time.sleep(0.1)
+            self._last_refresh = 0.0  # force re-poll
+        replica = self._router.pick(self._replicas)
+        self._router.on_send(replica)
+        try:
+            ref = replica.handle_request.remote(self._method, args, kwargs)
+        except Exception:
+            self._router.on_done(replica)
+            raise
+
+        resp = DeploymentResponse(ref)
+
+        def _done_watcher():
+            try:
+                ray_tpu.wait([ref], num_returns=1, timeout=None)
+            except Exception:
+                pass
+            finally:
+                self._router.on_done(replica)
+
+        threading.Thread(target=_done_watcher, daemon=True).start()
+        return resp

@@ -1,0 +1,101 @@
+"""HTTP proxy: per-node ingress routing requests to deployment handles.
+
+Capability parity: reference python/ray/serve/_private/proxy.py (HTTPProxy :699,
+ProxyActor :1021) — route-prefix matching, JSON request/response bridging to handles.
+aiohttp replaces uvicorn (not baked into this image); the blocking handle call runs on
+an executor thread so the event loop keeps accepting connections.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from .controller import CONTROLLER_NAME
+from .handle import DeploymentHandle
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes: Dict[str, Dict[str, Any]] = {}
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve_forever, daemon=True)
+        self._thread.start()
+
+    def ready(self) -> bool:
+        self._ready.wait(timeout=30)
+        return self._ready.is_set()
+
+    def _refresh_routes(self) -> None:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        self._routes = ray_tpu.get(controller.get_routing_table.remote())
+
+    def _match(self, path: str):
+        best = None
+        for prefix, info in self._routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, info)
+        return best
+
+    def _serve_forever(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def handler(request: "web.Request") -> "web.Response":
+            self._refresh_routes()
+            m = self._match(request.path)
+            if m is None:
+                return web.Response(status=404, text=f"no route for {request.path}")
+            prefix, info = m
+            key = f"{info['app']}/{info['deployment']}"
+            if key not in self._handles:
+                self._handles[key] = DeploymentHandle(info["app"], info["deployment"])
+            handle = self._handles[key]
+            if request.can_read_body:
+                try:
+                    payload = await request.json()
+                except json.JSONDecodeError:
+                    payload = (await request.read()).decode()
+            else:
+                payload = dict(request.query)
+
+            request_dict = {
+                "path": request.path[len(prefix.rstrip("/")):] or "/",
+                "method": request.method,
+                "query": dict(request.query),
+                "body": payload,
+            }
+
+            def call():
+                return handle.options(method_name="__http__").remote(request_dict).result()
+
+            try:
+                result = await loop.run_in_executor(None, call)
+            except Exception as e:  # noqa: BLE001 - surface as 500
+                return web.Response(status=500, text=repr(e))
+            if isinstance(result, (dict, list)):
+                return web.json_response(result)
+            if isinstance(result, bytes):
+                return web.Response(body=result)
+            return web.Response(text=str(result))
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._ready.set()
+        loop.run_forever()
+
+    def stop(self) -> None:
+        pass

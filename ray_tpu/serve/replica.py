@@ -1,0 +1,84 @@
+"""Replica: the actor hosting one copy of a deployment's user code.
+
+Capability parity: reference python/ray/serve/_private/replica.py (1,903 LoC) —
+user callable host, health check, reconfigure via user_config, graceful shutdown.
+"""
+from __future__ import annotations
+
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class Replica:
+    def __init__(
+        self,
+        deployment_name: str,
+        serialized_init: Dict[str, Any],
+        user_config: Optional[Dict[str, Any]] = None,
+    ):
+        self.deployment_name = deployment_name
+        cls_or_fn = serialized_init["target"]
+
+        def decode(v):
+            from .api import _HandleMarker
+            from .handle import DeploymentHandle
+
+            if isinstance(v, _HandleMarker):
+                return DeploymentHandle(v.app_name, v.deployment_name)
+            return v
+
+        args = tuple(decode(a) for a in serialized_init.get("args", ()))
+        kwargs = {k: decode(v) for k, v in serialized_init.get("kwargs", {}).items()}
+        if inspect.isclass(cls_or_fn):
+            self.callable = cls_or_fn(*args, **kwargs)
+        else:
+            self.callable = cls_or_fn
+        self._num_served = 0
+        self._started_at = time.time()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- request path ----------------------------------------------------------
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        self._num_served += 1
+        if method_name == "__http__":
+            # Proxy path: full request dict {path, method, query, body}. Ingress classes
+            # that define handle_http get it verbatim; plain callables get just the body
+            # (reference: replica ASGI wrapping vs plain-handle calls).
+            request = args[0]
+            fn = getattr(self.callable, "handle_http", None)
+            if fn is not None:
+                return fn(request)
+            method_name, args = "__call__", (request["body"],)
+        if method_name in ("__call__", None):
+            target = self.callable if callable(self.callable) else None
+            if target is None:
+                raise AttributeError(f"deployment {self.deployment_name} is not callable")
+            return target(*args, **kwargs)
+        return getattr(self.callable, method_name)(*args, **kwargs)
+
+    # -- control plane ---------------------------------------------------------
+    def check_health(self) -> bool:
+        fn = getattr(self.callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def reconfigure(self, user_config: Dict[str, Any]) -> None:
+        fn = getattr(self.callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"num_served": self._num_served, "uptime_s": time.time() - self._started_at}
+
+    def prepare_shutdown(self) -> None:
+        fn = getattr(self.callable, "__del__", None)
+        # graceful user shutdown hook (reference: replica graceful_shutdown path)
+        hook = getattr(self.callable, "shutdown", None)
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                pass
