@@ -1,0 +1,24 @@
+"""ray_tpu.llm — LLM serving + batch inference, TPU-native.
+
+Capability parity with the reference's python/ray/llm/ (SURVEY.md §2.7): an
+``LLMEngine`` ABC with a JAX engine instead of vLLM (slot-based continuous
+batching, device-resident KV cache, TP over ICI via pjit), an ``LLMServer``
+Serve deployment exposing OpenAI-compatible chat/completions, a multi-model
+router (``build_openai_app``), and a Ray-Data batch-inference ``Processor``.
+"""
+from .config import LLMConfig, SamplingParams
+from .engine import JaxLLMEngine, LLMEngine, RequestOutput
+from .server import LLMServer, build_openai_app
+from .batch import Processor, build_llm_processor
+
+__all__ = [
+    "LLMConfig",
+    "SamplingParams",
+    "LLMEngine",
+    "JaxLLMEngine",
+    "RequestOutput",
+    "LLMServer",
+    "build_openai_app",
+    "Processor",
+    "build_llm_processor",
+]

@@ -1,0 +1,102 @@
+"""Batch LLM inference over ray_tpu.data Datasets.
+
+Capability parity: reference python/ray/llm/_internal/batch/processor/base.py:107
+(``Processor`` — a chain of stages applied to a Dataset) and stages/ (chat template,
+tokenize, engine, detokenize). The engine stage is a stateful actor UDF holding a
+``JaxLLMEngine`` (reference vllm_engine_stage.py), so the model loads once per
+actor and each data block rides the continuous batcher.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .config import LLMConfig, SamplingParams
+from .server import render_chat_template
+
+
+class ChatTemplateStage:
+    """messages -> prompt string (reference chat_template_stage.py)."""
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        prompts = [render_chat_template(m) for m in batch["messages"]]
+        out = dict(batch)
+        out["prompt"] = np.array(prompts, dtype=object)
+        return out
+
+
+class LLMEngineStage:
+    """Stateful actor UDF running generation (reference vllm_engine_stage.py)."""
+
+    def __init__(self, llm_config: LLMConfig, sampling_params: Optional[Dict[str, Any]] = None):
+        from .engine import JaxLLMEngine
+
+        self.engine = JaxLLMEngine(llm_config)
+        self.engine.start()
+        self.params = SamplingParams(**(sampling_params or {}))
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        import queue as _q
+        import threading
+
+        prompts = list(batch["prompt"])
+        results: List[Any] = [None] * len(prompts)
+
+        # Feed all prompts concurrently so the continuous batcher fills its slots.
+        def worker(i):
+            results[i] = self.engine.generate_sync(str(prompts[i]), self.params)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        out = dict(batch)
+        out["generated_text"] = np.array([r.text for r in results], dtype=object)
+        out["num_generated_tokens"] = np.array(
+            [r.num_generated_tokens for r in results], np.int64
+        )
+        return out
+
+
+class Processor:
+    """A configured chain of stages over a Dataset (reference base.py:107)."""
+
+    def __init__(self, stages: List[Any]):
+        self.stages = stages
+
+    def __call__(self, dataset):
+        for stage in self.stages:
+            dataset = stage(dataset)
+        return dataset
+
+
+def build_llm_processor(
+    llm_config: LLMConfig,
+    *,
+    sampling_params: Optional[Dict[str, Any]] = None,
+    preprocess: Optional[Callable] = None,
+    postprocess: Optional[Callable] = None,
+    batch_size: int = 16,
+    concurrency: int = 1,
+    has_messages: bool = False,
+) -> Processor:
+    """Build the standard chat->generate processor (reference build_llm_processor)."""
+
+    stages: List[Any] = []
+    if preprocess is not None:
+        stages.append(lambda ds: ds.map(preprocess))
+    if has_messages:
+        stages.append(lambda ds: ds.map_batches(ChatTemplateStage(), batch_size=batch_size))
+    stages.append(
+        lambda ds: ds.map_batches(
+            LLMEngineStage,
+            fn_constructor_args=(llm_config, sampling_params),
+            batch_size=batch_size,
+            concurrency=concurrency,
+        )
+    )
+    if postprocess is not None:
+        stages.append(lambda ds: ds.map(postprocess))
+    return Processor(stages)

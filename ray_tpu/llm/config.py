@@ -1,0 +1,72 @@
+"""LLM engine/server configuration.
+
+Capability parity: reference python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_models.py:40 (``VLLMEngineConfig`` — model id, engine_kwargs, TP/PP degrees
+:125-139 mapped to resource bundles). Here the engine is JAX, so parallelism
+degrees map to mesh axes instead of placement-group bundles.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling controls (reference vLLM SamplingParams surface)."""
+
+    max_tokens: int = 64
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    stop_token_ids: Optional[List[int]] = None
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    """Model + engine knobs for ``JaxLLMEngine`` / ``LLMServer``.
+
+    ``model_id`` is the served-model name (OpenAI ``model`` field); ``model_source``
+    picks the ray_tpu.models config (e.g. "byte-tiny", "llama3-8b") or is a
+    ModelConfig instance directly.
+    """
+
+    model_id: str = "llama"
+    model_source: Union[str, Any] = "byte-tiny"
+    # engine
+    max_num_seqs: int = 8  # decode slots (continuous-batching width)
+    max_model_len: int = 1024  # KV capacity per slot
+    prefill_buckets: Optional[List[int]] = None  # pad-to lengths; default powers of 2
+    dtype: str = "bfloat16"
+    # parallelism: mesh axes for the in-process device mesh
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    # serving
+    tokenizer: str = "byte"  # "byte" | "hf:<name-or-path>"
+    accelerator_type: Optional[str] = None
+    deployment_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    engine_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def resolve_model_config(self):
+        from ray_tpu.models.config import ModelConfig, get_config
+
+        if isinstance(self.model_source, ModelConfig):
+            return self.model_source
+        return get_config(self.model_source, **self.engine_kwargs)
+
+    def buckets(self) -> List[int]:
+        if self.prefill_buckets:
+            return sorted(self.prefill_buckets)
+        out, b = [], 16
+        while b < self.max_model_len:
+            out.append(b)
+            b *= 2
+        out.append(self.max_model_len)
+        return out

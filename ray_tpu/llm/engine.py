@@ -1,0 +1,312 @@
+"""LLMEngine ABC + JaxLLMEngine: continuous batching on a device mesh.
+
+Capability parity: reference python/ray/llm/_internal/serve/deployments/llm/
+llm_engine.py:15 (``LLMEngine`` — start, generate stream) and vllm_engine.py:180
+(``VLLMEngine`` — the continuous-batching loop lives in vLLM's AsyncLLMEngine).
+Here the loop is explicit and TPU-shaped: a scheduler thread that (1) admits
+waiting requests into free cache slots via a bucketed prefill jit, (2) advances
+all active slots with one fused decode+sample step, (3) streams tokens out
+through per-request queues. Every device computation has static shapes, so after
+warmup the loop replays cached XLA executables only.
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import LLMConfig, SamplingParams
+from . import model_runner
+from .tokenizer import get_tokenizer
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    """One streamed chunk: the tokens emitted since the previous chunk."""
+
+    request_id: str
+    token_ids: List[int]
+    text: str = ""
+    finished: bool = False
+    finish_reason: Optional[str] = None  # "stop" | "length"
+    num_prompt_tokens: int = 0
+    num_generated_tokens: int = 0
+
+
+class LLMEngine(abc.ABC):
+    """Engine interface (reference llm_engine.py:15)."""
+
+    @abc.abstractmethod
+    def start(self) -> None: ...
+
+    @abc.abstractmethod
+    def generate(self, prompt: Any, params: SamplingParams, request_id: Optional[str] = None
+                 ) -> Iterator[RequestOutput]: ...
+
+    @abc.abstractmethod
+    def shutdown(self) -> None: ...
+
+
+class _Request:
+    def __init__(self, req_id: str, prompt_ids: List[int], params: SamplingParams):
+        self.id = req_id
+        self.prompt_ids = prompt_ids
+        self.params = params
+        self.out_queue: "queue.Queue[RequestOutput]" = queue.Queue()
+        self.generated = 0
+        self.slot = -1
+        self.pending_text: List[int] = []  # undecoded ids (byte tokenizer is stateless)
+
+
+class JaxLLMEngine(LLMEngine):
+    """Slot-based continuous batching over jitted prefill/decode (model_runner.py)."""
+
+    def __init__(self, config: LLMConfig, params=None, mesh=None):
+        self.config = config
+        self.model_config = config.resolve_model_config()
+        self.tokenizer = get_tokenizer(config.tokenizer)
+        self._mesh = mesh
+        self._params_in = params
+        self._started = False
+        self._shutdown = False
+        self._waiting: "queue.Queue[_Request]" = queue.Queue()
+        self._active: Dict[int, Optional[_Request]] = {}
+        self._lock = threading.Lock()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._wakeup = threading.Event()
+        # metrics (scraped by LLMServer / autoscaling)
+        self.num_pending = 0
+        self.num_active = 0
+        self.total_generated = 0
+
+    # -- lifecycle ---------------------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            return
+        cfg = self.model_config
+        c = self.config
+        if self._mesh is None:
+            # dp*tp devices out of the local set (an engine may intentionally use a
+            # subset, e.g. one replica per chip on a multi-chip host).
+            from jax.sharding import Mesh
+
+            n = c.data_parallel_size * c.tensor_parallel_size
+            devs = jax.devices()
+            if len(devs) < n:
+                raise ValueError(f"need {n} devices for dp×tp, have {len(devs)}")
+            self._mesh = Mesh(
+                np.asarray(devs[:n]).reshape(c.data_parallel_size, c.tensor_parallel_size),
+                ("dp", "tp"),
+            )
+        if c.max_num_seqs % c.data_parallel_size:
+            raise ValueError("max_num_seqs must be divisible by data_parallel_size")
+        if self._params_in is None:
+            self._params_in = llama_init_cached(cfg)
+        self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
+        self._params_in = None
+        self.state = model_runner.init_state(cfg, c.max_num_seqs, c.max_model_len, self._mesh)
+        self._active = {s: None for s in range(c.max_num_seqs)}
+        self._rng = jax.random.PRNGKey(0)
+        # host mirrors of per-slot sampling params
+        n = c.max_num_seqs
+        self._temp = np.zeros((n,), np.float32)
+        self._top_p = np.ones((n,), np.float32)
+        self._top_k = np.zeros((n,), np.int32)
+        self._last_tokens = np.zeros((n,), np.int32)
+        self._started = True
+        self._loop_thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
+        self._loop_thread.start()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self._wakeup.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+
+    # -- API ---------------------------------------------------------------------
+    def generate(self, prompt, params: SamplingParams, request_id: Optional[str] = None
+                 ) -> Iterator[RequestOutput]:
+        if not self._started:
+            self.start()
+        if isinstance(prompt, str):
+            prompt_ids = self.tokenizer.encode(prompt)
+        else:
+            prompt_ids = list(prompt)
+        limit = self.config.max_model_len - params.max_tokens
+        if len(prompt_ids) > limit:
+            prompt_ids = prompt_ids[-limit:]
+        req = _Request(request_id or uuid.uuid4().hex, prompt_ids, params)
+        with self._lock:
+            self.num_pending += 1
+        self._waiting.put(req)
+        self._wakeup.set()
+
+        while True:
+            out = req.out_queue.get()
+            yield out
+            if out.finished:
+                return
+
+    def generate_sync(self, prompt, params: SamplingParams) -> RequestOutput:
+        """Collect the full generation into one RequestOutput."""
+        ids: List[int] = []
+        last = None
+        for chunk in self.generate(prompt, params):
+            ids.extend(chunk.token_ids)
+            last = chunk
+        return RequestOutput(
+            request_id=last.request_id,
+            token_ids=ids,
+            text=self.tokenizer.decode(ids),
+            finished=True,
+            finish_reason=last.finish_reason,
+            num_prompt_tokens=last.num_prompt_tokens,
+            num_generated_tokens=len(ids),
+        )
+
+    def metrics(self) -> Dict[str, Any]:
+        return {
+            "num_pending": self.num_pending,
+            "num_active": self.num_active,
+            "total_generated": self.total_generated,
+        }
+
+    # -- scheduler loop ------------------------------------------------------------
+    def _free_slots(self) -> List[int]:
+        return [s for s, r in self._active.items() if r is None]
+
+    def _admit(self) -> None:
+        cfg, c = self.model_config, self.config
+        for slot in self._free_slots():
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                return
+            s_pad = next(b for b in c.buckets() if b >= len(req.prompt_ids))
+            tokens = np.zeros((1, s_pad), np.int32)
+            tokens[0, : len(req.prompt_ids)] = req.prompt_ids
+            self.state, last_logits = model_runner.prefill(
+                self.params, self.state, jnp.asarray(tokens),
+                jnp.int32(len(req.prompt_ids)), jnp.int32(slot), cfg,
+            )
+            self._rng, sub = jax.random.split(self._rng)
+            p = req.params
+            tok = int(model_runner.sample_tokens(
+                sub, last_logits[None, :],
+                jnp.asarray([p.temperature], jnp.float32),
+                jnp.asarray([p.top_p], jnp.float32),
+                jnp.asarray([p.top_k], jnp.int32),
+            )[0])
+            req.slot = slot
+            self._active[slot] = req
+            self._temp[slot], self._top_p[slot], self._top_k[slot] = (
+                p.temperature, p.top_p, p.top_k)
+            self._last_tokens[slot] = tok
+            with self._lock:
+                self.num_pending -= 1
+                self.num_active += 1
+            self._emit(req, tok)
+
+    def _emit(self, req: _Request, tok: int) -> None:
+        req.generated += 1
+        self.total_generated += 1
+        stops = req.params.stop_token_ids or [self.tokenizer.eos_token_id]
+        finished, reason = False, None
+        if tok in stops:
+            finished, reason = True, "stop"
+        elif req.generated >= req.params.max_tokens:
+            finished, reason = True, "length"
+        emit_ids = [] if reason == "stop" else [tok]
+        text = self.tokenizer.decode(emit_ids) if emit_ids else ""
+        req.out_queue.put(RequestOutput(
+            request_id=req.id, token_ids=emit_ids, text=text, finished=finished,
+            finish_reason=reason, num_prompt_tokens=len(req.prompt_ids),
+            num_generated_tokens=req.generated,
+        ))
+        if finished:
+            self._release(req)
+
+    def _release(self, req: _Request) -> None:
+        if req.slot >= 0:
+            self._active[req.slot] = None
+            req.slot = -1
+            with self._lock:
+                self.num_active -= 1
+
+    def _step_decode(self) -> None:
+        cfg = self.model_config
+        active_mask = np.array([r is not None for r in self._active.values()], bool)
+        # Also stop slots that hit cache capacity.
+        self.state, logits = model_runner.decode_step(
+            self.params, self.state, jnp.asarray(self._last_tokens),
+            jnp.asarray(active_mask), cfg,
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        toks = np.asarray(model_runner.sample_tokens(
+            sub, logits, jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._top_k)))
+        lengths = np.asarray(self.state.lengths)
+        for slot, req in list(self._active.items()):
+            if req is None:
+                continue
+            tok = int(toks[slot])
+            self._last_tokens[slot] = tok
+            self._emit(req, tok)
+            r2 = self._active[slot]
+            if r2 is not None and lengths[slot] >= self.config.max_model_len - 1:
+                r2.out_queue.put(RequestOutput(
+                    request_id=r2.id, token_ids=[], finished=True, finish_reason="length",
+                    num_prompt_tokens=len(r2.prompt_ids), num_generated_tokens=r2.generated,
+                ))
+                self._release(r2)
+
+    def _loop(self) -> None:
+        while not self._shutdown:
+            try:
+                self._admit()
+                if any(r is not None for r in self._active.values()):
+                    self._step_decode()
+                else:
+                    self._wakeup.wait(timeout=0.05)
+                    self._wakeup.clear()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+                # fail all in-flight requests rather than hanging clients
+                for slot, req in list(self._active.items()):
+                    if req is not None:
+                        req.out_queue.put(RequestOutput(
+                            request_id=req.id, token_ids=[], finished=True,
+                            finish_reason="error"))
+                        self._release(req)
+                while True:
+                    try:
+                        req = self._waiting.get_nowait()
+                    except queue.Empty:
+                        break
+                    req.out_queue.put(RequestOutput(
+                        request_id=req.id, token_ids=[], finished=True,
+                        finish_reason="error"))
+                time.sleep(0.1)
+
+
+_INIT_CACHE: Dict[str, Any] = {}
+
+
+def llama_init_cached(cfg):
+    """Random-init params once per config (tests/demo path; real use loads a checkpoint)."""
+    from ray_tpu.models import llama
+
+    key = cfg.name
+    if key not in _INIT_CACHE:
+        _INIT_CACHE[key] = llama.init(jax.random.PRNGKey(0), cfg)
+    return _INIT_CACHE[key]

@@ -1,0 +1,164 @@
+"""Jitted prefill/decode over a slot-based device-resident KV cache.
+
+This is the TPU replacement for vLLM's GPU model runner (reference
+python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:180): instead
+of paged attention over dynamically allocated GPU blocks, the cache is a static
+[L, slots, max_len, kv_heads, head_dim] array — XLA-friendly static shapes, with
+raggedness expressed as a per-slot ``lengths`` vector that masks attention and
+indexes scatter-writes. Slots are the continuous-batching unit: prefill fills one
+slot, decode advances all slots in a single fused step.
+
+Sharding: params via INFER_RULES (heads/mlp/vocab → tp), cache kv_heads → tp and
+slots → dp, so TP rides ICI inside each decode step and DP widens throughput.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.models.config import ModelConfig
+from ray_tpu.parallel.sharding import INFER_RULES, named_sharding, shard_pytree
+
+from . import sampling
+
+
+class DecodeState(NamedTuple):
+    """Device-resident serving state. lengths[s] = tokens currently cached in slot s."""
+
+    k: jax.Array  # [L, slots, max_len, kv_heads, head_dim]
+    v: jax.Array
+    lengths: jax.Array  # [slots] int32
+
+
+CACHE_SPEC = P(None, "dp", None, "tp", None)
+LENGTHS_SPEC = P("dp")
+
+
+def init_state(cfg: ModelConfig, slots: int, max_len: int, mesh: Mesh) -> DecodeState:
+    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    dtype = cfg.activation_dtype
+    kv_sh = NamedSharding(mesh, CACHE_SPEC)
+    len_sh = NamedSharding(mesh, LENGTHS_SPEC)
+    return DecodeState(
+        k=jax.device_put(jnp.zeros(shape, dtype), kv_sh),
+        v=jax.device_put(jnp.zeros(shape, dtype), kv_sh),
+        lengths=jax.device_put(jnp.zeros((slots,), jnp.int32), len_sh),
+    )
+
+
+def shard_params(params, cfg: ModelConfig, mesh: Mesh):
+    return shard_pytree(params, llama.param_axes(cfg), mesh, INFER_RULES)
+
+
+# ------------------------------------------------------------------------- prefill
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
+def prefill(
+    params,
+    state: DecodeState,
+    tokens: jax.Array,  # [1, S_pad] int32 (padded to a bucket length)
+    true_len: jax.Array,  # scalar int32
+    slot: jax.Array,  # scalar int32
+    cfg: ModelConfig,
+) -> Tuple[DecodeState, jax.Array]:
+    """Run the prompt through the model, install its KV into `slot`, return the
+    logits at the last real token ([vocab] f32)."""
+    s_pad = tokens.shape[1]
+    tmp = llama.init_kv_cache(cfg, batch=1, max_len=s_pad, dtype=state.k.dtype)
+    logits, tmp = llama.forward(params, tokens, cfg, cache=tmp)
+    # install [L, 1, S_pad, KV, HD] into the big cache at (slot, 0)
+    start = (0, slot, 0, 0, 0)
+    k = jax.lax.dynamic_update_slice(state.k, tmp.k, start)
+    v = jax.lax.dynamic_update_slice(state.v, tmp.v, start)
+    lengths = state.lengths.at[slot].set(true_len)
+    last = logits[0, true_len - 1].astype(jnp.float32)
+    return DecodeState(k=k, v=v, lengths=lengths), last
+
+
+# -------------------------------------------------------------------------- decode
+
+def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths):
+    """One layer's decode for all slots. x [S,1,D]; ck/cv [S,max_len,KV,HD];
+    returns (x, ck, cv) with this step's K/V scattered in at position lengths[s]."""
+    dt = x.dtype
+    s, max_len = ck.shape[0], ck.shape[1]
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    g = cfg.n_heads // kvh
+    pos = lengths[:, None]  # [S,1] — the new token's position
+
+    h = llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("sld,dhk->slhk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("sld,dhk->slhk", h, lp["wk"].astype(dt))
+    vv = jnp.einsum("sld,dhk->slhk", h, lp["wv"].astype(dt))
+    q = llama.rope(q, pos, cfg.rope_theta)
+    k = llama.rope(k, pos, cfg.rope_theta)
+
+    rows = jnp.arange(s)
+    ck = ck.at[rows, lengths].set(k[:, 0].astype(ck.dtype))
+    cv = cv.at[rows, lengths].set(vv[:, 0].astype(cv.dtype))
+
+    qg = q[:, 0].reshape(s, kvh, g, hd) * (hd**-0.5)
+    scores = jnp.einsum("skgd,stkd->skgt", qg.astype(jnp.float32), ck.astype(jnp.float32))
+    valid = (jnp.arange(max_len)[None, :] <= lengths[:, None])[:, None, None, :]
+    scores = jnp.where(valid, scores, sampling.NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("skgt,stkd->skgd", w, cv.astype(jnp.float32)).astype(dt)
+    o = o.reshape(s, 1, cfg.n_heads, hd)
+    x = x + jnp.einsum("slhk,hkd->sld", o, lp["wo"].astype(dt))
+
+    h = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("sld,df->slf", h, lp["w_gate"].astype(dt))
+    up = jnp.einsum("sld,df->slf", h, lp["w_up"].astype(dt))
+    down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt))
+    return x + down, ck, cv
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
+def decode_step(
+    params,
+    state: DecodeState,
+    tokens: jax.Array,  # [slots] int32 — last sampled token per slot
+    active: jax.Array,  # [slots] bool — inactive slots compute but don't advance
+    cfg: ModelConfig,
+) -> Tuple[DecodeState, jax.Array]:
+    """One decode step for every slot. Returns (state, logits [slots, vocab] f32).
+
+    Inactive slots still flow through the matmuls (static shapes) but their cache
+    write lands at position lengths[s] of a slot whose contents the next prefill
+    overwrites, and their length does not advance.
+    """
+    x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
+
+    if cfg.scan_layers:
+        def body(carry, xs):
+            h = carry
+            lp, ck, cv = xs
+            h, ck, cv = _decode_block(h, lp, cfg, ck, cv, state.lengths)
+            return h, (ck, cv)
+
+        x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], state.k, state.v))
+    else:
+        nk, nv = [], []
+        for i, lp in enumerate(params["layers"]):
+            x, ck, cv = _decode_block(x, lp, cfg, state.k[i], state.v[i], state.lengths)
+            nk.append(ck)
+            nv.append(cv)
+        nk, nv = jnp.stack(nk), jnp.stack(nv)
+
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))[:, 0]
+    lengths = jnp.where(active, state.lengths + 1, state.lengths)
+    return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
+
+
+# ------------------------------------------------------------------------- sampler
+
+@jax.jit
+def sample_tokens(rng, logits, temperature, top_p, top_k):
+    return sampling.sample(rng, logits, temperature, top_p, top_k)

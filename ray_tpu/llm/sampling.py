@@ -1,0 +1,49 @@
+"""Jittable token sampling with per-slot parameters.
+
+Continuous batching means every decode step samples for all active slots at once,
+each with its own temperature/top-p/top-k — so the sampler is a single vectorized
+jit-compatible function over [B, V] logits (no per-request Python).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+def _apply_top_k(logits: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Mask logits outside each row's top-k. top_k[B] int32, 0 = disabled."""
+    v = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, v), v)
+    kth = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filtering per row. top_p[B] float, 1.0 = disabled."""
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens while cumulative prob (exclusive) < top_p; always keep the first
+    keep = (cum - probs) < top_p[:, None]
+    cutoff = jnp.where(keep, sorted_logits, jnp.inf).min(axis=-1)
+    return jnp.where(logits < cutoff[:, None], NEG_INF, logits)
+
+
+def sample(
+    rng: jax.Array,
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+) -> jax.Array:
+    """logits [B, V] f32 -> token ids [B]. temperature==0 rows sample greedily."""
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    scaled = _apply_top_k(scaled, top_k)
+    scaled = _apply_top_p(scaled, top_p)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

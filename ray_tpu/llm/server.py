@@ -1,0 +1,154 @@
+"""LLMServer Serve deployment + OpenAI-compatible router.
+
+Capability parity: reference python/ray/llm/_internal/serve/deployments/llm/
+llm_server.py:409 (``LLMServer`` — Serve deployment wrapping an engine, OpenAI
+chat/completions) and serve/routers/ + builders/ (``build_openai_app`` multi-model
+ingress). The engine here is ``JaxLLMEngine`` (TP over the replica's device mesh)
+instead of vLLM.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .config import LLMConfig, SamplingParams
+from .engine import JaxLLMEngine
+
+
+def _sampling_from_body(body: Dict[str, Any]) -> SamplingParams:
+    return SamplingParams(
+        max_tokens=int(body.get("max_tokens", 64)),
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        seed=body.get("seed"),
+    )
+
+
+def render_chat_template(messages: List[Dict[str, str]]) -> str:
+    """Minimal chat template (reference: HF chat templates via vLLM's tokenizer)."""
+    parts = [f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages]
+    return "\n".join(parts) + "\nassistant:"
+
+
+class LLMServer:
+    """Serve deployment hosting one model's engine.
+
+    Deploy via ``build_openai_app`` or directly:
+        app = serve.deployment(LLMServer).bind(llm_config)
+    """
+
+    def __init__(self, llm_config: LLMConfig, engine: Optional[JaxLLMEngine] = None):
+        self.llm_config = llm_config
+        self.engine = engine or JaxLLMEngine(llm_config)
+        self.engine.start()
+
+    # -- OpenAI endpoints --------------------------------------------------------
+    def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = render_chat_template(body.get("messages", []))
+        out = self.engine.generate_sync(prompt, _sampling_from_body(body))
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.llm_config.model_id),
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": out.text},
+                "finish_reason": out.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": out.num_prompt_tokens,
+                "completion_tokens": out.num_generated_tokens,
+                "total_tokens": out.num_prompt_tokens + out.num_generated_tokens,
+            },
+        }
+
+    def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        out = self.engine.generate_sync(body.get("prompt", ""), _sampling_from_body(body))
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.llm_config.model_id),
+            "choices": [{"index": 0, "text": out.text, "finish_reason": out.finish_reason}],
+            "usage": {
+                "prompt_tokens": out.num_prompt_tokens,
+                "completion_tokens": out.num_generated_tokens,
+                "total_tokens": out.num_prompt_tokens + out.num_generated_tokens,
+            },
+        }
+
+    def model_id(self) -> str:
+        return self.llm_config.model_id
+
+    def metrics(self) -> Dict[str, Any]:
+        return self.engine.metrics()
+
+    def check_health(self) -> None:
+        if self.engine._shutdown:
+            raise RuntimeError("engine stopped")
+
+    def shutdown(self) -> None:
+        self.engine.shutdown()
+
+
+class OpenAIRouter:
+    """Multi-model ingress: routes /v1/* to per-model LLMServer deployments."""
+
+    def __init__(self, **model_handles):
+        # model_id -> DeploymentHandle to an LLMServer deployment
+        self.handles = model_handles
+
+    def _pick(self, model: Optional[str]):
+        if model in self.handles:
+            return self.handles[model]
+        if model is None and len(self.handles) == 1:
+            return next(iter(self.handles.values()))
+        raise ValueError(f"unknown model {model!r}; served: {sorted(self.handles)}")
+
+    def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        path, body = request["path"], request.get("body") or {}
+        if path.endswith("/models"):
+            return {
+                "object": "list",
+                "data": [
+                    {"id": m, "object": "model", "owned_by": "ray_tpu"}
+                    for m in sorted(self.handles)
+                ],
+            }
+        model = body.get("model") if isinstance(body, dict) else None
+        handle = self._pick(model)
+        if path.endswith("/chat/completions"):
+            return handle.options(method_name="chat").remote(body).result()
+        if path.endswith("/completions"):
+            return handle.options(method_name="completions").remote(body).result()
+        raise ValueError(f"unsupported path {path!r}")
+
+    # direct-handle convenience (tests, in-cluster clients)
+    def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.handle_http({"path": "/v1/chat/completions", "method": "POST", "body": body})
+
+    def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.handle_http({"path": "/v1/completions", "method": "POST", "body": body})
+
+
+def build_openai_app(llm_configs: List[LLMConfig], name_prefix: str = "llm"):
+    """Build a Serve Application: OpenAIRouter ingress + one LLMServer per model.
+
+    Reference builders/build_openai_app. Returns an Application for serve.run().
+    """
+    from ray_tpu import serve
+
+    servers = {}
+    for cfg in llm_configs:
+        d = serve.deployment(LLMServer).options(
+            name=f"{name_prefix}:{cfg.model_id}",
+            num_replicas=cfg.deployment_config.get("num_replicas", 1),
+            max_ongoing_requests=cfg.deployment_config.get("max_ongoing_requests", 64),
+            ray_actor_options=cfg.deployment_config.get("ray_actor_options"),
+        )
+        servers[cfg.model_id] = d.bind(cfg)
+    router = serve.deployment(OpenAIRouter).options(name=f"{name_prefix}-router")
+    return router.bind(**servers)

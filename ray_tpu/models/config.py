@@ -81,6 +81,21 @@ register_config(
     )
 )
 register_config(
+    # Tiny serving-test model whose vocab covers the byte-level tokenizer (259 ids).
+    ModelConfig(
+        name="byte-tiny",
+        vocab_size=512,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=256,
+        dtype="float32",
+        scan_layers=True,
+    )
+)
+register_config(
     # Single-chip bench model (~0.4B): same architecture family as llama3, sized so that
     # f32 params + Adam state + remat activations fit one v5e chip's 16 GiB HBM.
     ModelConfig(
