@@ -1,0 +1,35 @@
+"""ray_tpu.dag — Compiled Graphs (aDAG) over shared-memory channels.
+
+Capability parity: reference python/ray/dag/ (CompiledDAG, InputNode,
+MultiOutputNode, .bind/.experimental_compile API; SURVEY.md §2.3). See
+compiled.py for the TPU stance on device-to-device channels.
+
+Usage (reference API shape):
+    with InputNode() as inp:
+        x = a1.step.bind(inp)
+        y = a2.step.bind(x)
+    dag = y.experimental_compile()
+    out = dag.execute(5).get()
+    dag.teardown()
+"""
+from .compiled import (
+    ClassMethodNode,
+    CompiledDAG,
+    CompiledDAGRef,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+    bind,
+)
+
+__all__ = [
+    "InputNode",
+    "InputAttributeNode",
+    "MultiOutputNode",
+    "ClassMethodNode",
+    "DAGNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "bind",
+]

@@ -1,0 +1,110 @@
+"""Single-slot shared-memory channels for compiled actor DAGs.
+
+Capability parity: reference python/ray/experimental/channel/ — the
+``shared_memory_channel.py`` mutable-plasma-object transport that Compiled Graphs
+use to skip per-call task RPC. Here a channel is one POSIX shm segment with a
+seqlock header: the writer bumps a sequence (odd = writing, even = ready), the
+reader spins until a new even sequence appears. Single writer, single reader;
+fan-out edges get one channel per consumer.
+
+The reference's NCCL channel (torch_tensor_nccl_channel.py) has no analogue
+here by design: device tensors between jitted stages should ride ICI inside one
+pjit program or via jax.device_put — see dag/compiled.py docstring.
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import Any
+
+import cloudpickle
+
+_HEADER = struct.Struct("<QQQ")  # seq, ack, payload_len
+
+
+class ChannelFullError(ValueError):
+    pass
+
+
+class ShmChannel:
+    """One-slot SPSC channel with backpressure over a named shm segment.
+
+    The writer blocks until the reader has acked the previous value (reference:
+    compiled-graph channels apply backpressure so pipelined executions cannot
+    overwrite unread results)."""
+
+    def __init__(self, name: str, capacity: int, create: bool = False):
+        self.name = name
+        self.capacity = capacity
+        if create:
+            self._seg = shared_memory.SharedMemory(name=name, create=True,
+                                                   size=capacity + _HEADER.size)
+            _HEADER.pack_into(self._seg.buf, 0, 0, 0, 0)
+        else:
+            self._seg = shared_memory.SharedMemory(name=name)
+        self._last_read = 0
+
+    # -- wire ------------------------------------------------------------------
+    def write(self, value: Any, timeout: float = None) -> None:
+        payload = cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        if len(payload) > self.capacity:
+            raise ChannelFullError(
+                f"serialized value ({len(payload)} B) exceeds channel capacity "
+                f"({self.capacity} B); pass a larger buffer_size to experimental_compile")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:  # backpressure: previous value must be consumed
+            seq, ack, _ = _HEADER.unpack_from(self._seg.buf, 0)
+            if seq == 0 or ack == seq:
+                break
+            spins += 1
+            time.sleep(0 if spins < 1000 else 0.0002)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} write timed out (no reader ack)")
+        _HEADER.pack_into(self._seg.buf, 0, seq + 1, ack, len(payload))  # odd: writing
+        self._seg.buf[_HEADER.size:_HEADER.size + len(payload)] = payload
+        _HEADER.pack_into(self._seg.buf, 0, seq + 2, ack, len(payload))  # even: ready
+
+    def read(self, timeout: float = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:
+            seq, ack, ln = _HEADER.unpack_from(self._seg.buf, 0)
+            if seq % 2 == 0 and seq != self._last_read and seq != 0:
+                payload = bytes(self._seg.buf[_HEADER.size:_HEADER.size + ln])
+                self._last_read = seq
+                value = pickle.loads(payload)
+                # publish the ack so the writer may reuse the slot
+                _HEADER.pack_into(self._seg.buf, 0, seq, seq, ln)
+                return value
+            spins += 1
+            if spins < 1000:
+                time.sleep(0)  # yield, stay hot
+            else:
+                time.sleep(0.0002)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} read timed out")
+
+    # -- lifecycle -------------------------------------------------------------
+    def close(self) -> None:
+        try:
+            self._seg.close()
+        except BufferError:
+            pass
+
+    def destroy(self) -> None:
+        try:
+            self._seg.close()
+        except BufferError:
+            pass
+        try:
+            seg = shared_memory.SharedMemory(name=self.name)
+            seg.unlink()
+            seg.close()
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        return (ShmChannel, (self.name, self.capacity, False))

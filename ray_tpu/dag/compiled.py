@@ -1,0 +1,332 @@
+"""Compiled actor DAGs: µs-scale repeated dispatch without per-call task RPC.
+
+Capability parity: reference python/ray/dag/compiled_dag_node.py:808
+(``CompiledDAG``) — an actor-method DAG is compiled once into (a) a channel per
+edge and (b) one persistent exec loop per participating actor (reference
+``do_exec_tasks`` :191); ``execute()`` then just writes the input channel and
+reads the output channel (driver ``_execute_until`` :2476).
+
+TPU note (why there is no NCCL-channel analogue): between JAX stages the fast
+path for device data is either (1) fuse the stages into ONE jitted program so
+XLA moves activations over ICI itself — do this whenever all stages are pure
+functions — or (2) pass jax.Arrays through the channel, which hands over a
+host copy (fine for rollouts/weights at DCN scale). Compiled DAGs here exist
+for the orchestration win: pipelines of stateful actors (prefill/decode
+disaggregation, env-runner → learner) dispatched at shared-memory latency.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .channel import ShmChannel
+
+_DEFAULT_BUFFER = 4 * 1024 * 1024
+
+
+class DAGNode:
+    def __init__(self):
+        self._id = uuid.uuid4().hex
+
+    def experimental_compile(self, *, buffer_size_bytes: int = _DEFAULT_BUFFER,
+                             submit_timeout: float = 30.0,
+                             max_inflight_executions: int = 2) -> "CompiledDAG":
+        return CompiledDAG(self, buffer_size_bytes, submit_timeout,
+                           max_inflight_executions)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to execute() (reference dag/input_node.py)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getitem__(self, key) -> "InputAttributeNode":
+        return InputAttributeNode(self, key)
+
+
+class InputAttributeNode(DAGNode):
+    """input[key] / input.attr access (reference dag/input_node.py)."""
+
+    def __init__(self, parent: InputNode, key: Any):
+        super().__init__()
+        self.parent = parent
+        self.key = key
+
+
+class ClassMethodNode(DAGNode):
+    """One actor-method call in the graph (reference dag/class_node.py)."""
+
+    def __init__(self, actor, method_name: str, args: tuple, kwargs: dict):
+        super().__init__()
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+    def upstream(self) -> List[DAGNode]:
+        return [a for a in list(self.args) + list(self.kwargs.values())
+                if isinstance(a, DAGNode)]
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__()
+        self.outputs = list(outputs)
+
+
+def bind(actor_method, *args, **kwargs) -> ClassMethodNode:
+    """actor.method.bind(...) — builds a node instead of submitting a task."""
+    return ClassMethodNode(actor_method._handle, actor_method._name, args, kwargs)
+
+
+# ------------------------------------------------------------------ exec loop
+
+def _actor_exec_loop(instance, tasks: List[Dict], stop_name: str):
+    """Runs inside the actor (via __ray_call__): read inputs, call methods, write
+    outputs, until the stop channel fires. tasks are in topological order."""
+    stop = ShmChannel(stop_name, 256)
+    chans: Dict[str, ShmChannel] = {}
+
+    def ch(name_cap):
+        name, cap = name_cap
+        if name not in chans:
+            chans[name] = ShmChannel(name, cap)
+        return chans[name]
+
+    while True:
+        for t in tasks:
+            # Block on the first input; by protocol every input for one round is
+            # written before the next round can start. Every channel payload is a
+            # (status, value) pair so upstream errors propagate instead of computing.
+            vals = {}
+            stopped = False
+            upstream_err = None
+            for key, src in t["inputs"].items():
+                c = ch(src)
+                while True:
+                    try:
+                        status, v = c.read(timeout=0.2)
+                        if status == "err":
+                            upstream_err = v
+                        else:
+                            vals[key] = v
+                        break
+                    except TimeoutError:
+                        try:
+                            stop.read(timeout=0)
+                            stopped = True
+                            break
+                        except TimeoutError:
+                            continue
+                if stopped:
+                    break
+            if stopped:
+                return
+            if upstream_err is not None:
+                wrapped = ("err", upstream_err)
+            else:
+                args = [vals[("a", i)] if ("a", i) in vals else v
+                        for i, v in enumerate(t["args"])]
+                kwargs = {k: vals.get(("k", k), v) for k, v in t["kwargs"].items()}
+                try:
+                    out = getattr(instance, t["method"])(*args, **kwargs)
+                    wrapped = ("ok", out)
+                except Exception as e:  # noqa: BLE001 - surfaced at the output channel
+                    wrapped = ("err", e)
+            for dst in t["outputs"]:
+                while True:  # backpressured write, interruptible by teardown
+                    try:
+                        ch(dst).write(wrapped, timeout=0.2)
+                        break
+                    except TimeoutError:
+                        try:
+                            stop.read(timeout=0)
+                            return
+                        except TimeoutError:
+                            continue
+
+
+class CompiledDAGRef:
+    """Future for one execute() round (reference compiled_dag_ref.py)."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int):
+        self._dag = dag
+        self._idx = idx
+
+    def get(self, timeout: Optional[float] = None):
+        return self._dag._get_result(self._idx, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, buffer_size: int, submit_timeout: float,
+                 max_inflight_executions: int = 2):
+        self._buffer = buffer_size
+        self._timeout = submit_timeout
+        # Single-slot channels bound the safe pipeline depth (reference analog:
+        # max_inflight_executions on compiled_dag_node.py; exceeding it raises
+        # rather than deadlocking on channel backpressure).
+        self._max_inflight = max_inflight_executions
+        self._lock = threading.Lock()
+        self._results: Dict[int, Any] = {}
+        self._next_submit = 0
+        self._next_read = 0
+        self._torn_down = False
+
+        outputs = root.outputs if isinstance(root, MultiOutputNode) else [root]
+        self._n_outputs = len(outputs)
+        self._single = not isinstance(root, MultiOutputNode)
+
+        # topo-sort the ClassMethodNodes
+        order: List[ClassMethodNode] = []
+        seen = {}
+
+        def visit(n: DAGNode):
+            if n._id in seen:
+                return
+            seen[n._id] = True
+            if isinstance(n, ClassMethodNode):
+                for u in n.upstream():
+                    visit(u)
+                order.append(n)
+            elif isinstance(n, InputAttributeNode):
+                pass
+            elif isinstance(n, MultiOutputNode):
+                for u in n.outputs:
+                    visit(u)
+
+        for o in outputs:
+            visit(o)
+        if not order:
+            raise ValueError("compiled DAG contains no actor method calls")
+
+        prefix = f"rtdag_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        self._stop_name = f"{prefix}_stop"
+        self._stop = ShmChannel(self._stop_name, 256, create=True)
+        self._all_channels: List[ShmChannel] = [self._stop]
+
+        def new_chan(tag):
+            c = ShmChannel(f"{prefix}_{tag}", self._buffer, create=True)
+            self._all_channels.append(c)
+            return c
+
+        # input channels: one per (consumer-node, arg-position) that reads the input
+        self._input_chans: List[tuple] = []  # (channel, key-extractor)
+        node_out: Dict[str, List] = {n._id: [] for n in order}  # downstream channel specs
+        per_actor: Dict[Any, List[Dict]] = {}
+        chan_i = 0
+
+        for n in order:
+            task = {"method": n.method_name, "args": [], "kwargs": {}, "inputs": {},
+                    "outputs": []}
+
+            def wire(pos_key, v):
+                nonlocal chan_i
+                if isinstance(v, (InputNode, InputAttributeNode)):
+                    c = new_chan(f"in{chan_i}")
+                    chan_i += 1
+                    key = v.key if isinstance(v, InputAttributeNode) else None
+                    self._input_chans.append((c, key))
+                    task["inputs"][pos_key] = (c.name, c.capacity)
+                    return None
+                if isinstance(v, ClassMethodNode):
+                    c = new_chan(f"e{chan_i}")
+                    chan_i += 1
+                    node_out[v._id].append((c.name, c.capacity))
+                    task["inputs"][pos_key] = (c.name, c.capacity)
+                    return None
+                return v  # constant
+            task["args"] = [wire(("a", i), v) for i, v in enumerate(n.args)]
+            task["kwargs"] = {k: wire(("k", k), v) for k, v in n.kwargs.items()}
+            task["_node"] = n
+            per_actor.setdefault(n.actor, []).append(task)
+
+        # output channels for DAG outputs
+        self._output_chans: List[ShmChannel] = []
+        for o in outputs:
+            if not isinstance(o, ClassMethodNode):
+                raise TypeError("DAG outputs must be actor method nodes")
+            c = new_chan(f"out{chan_i}")
+            chan_i += 1
+            node_out[o._id].append((c.name, c.capacity))
+            self._output_chans.append(c)
+
+        # attach intermediate output specs to tasks
+        for tasks in per_actor.values():
+            for t in tasks:
+                t["outputs"] = node_out[t.pop("_node")._id]
+
+        # launch one exec loop per actor (long-running actor task)
+        self._loop_refs = []
+        for actor, tasks in per_actor.items():
+            self._loop_refs.append(
+                actor.__ray_call__.remote(_actor_exec_loop, tasks, self._stop_name)
+            )
+
+    # -- execution -----------------------------------------------------------------
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        with self._lock:
+            if self._next_submit - self._next_read >= self._max_inflight:
+                raise RuntimeError(
+                    f"{self._next_submit - self._next_read} executions in flight; "
+                    f"call .get() on earlier results or raise max_inflight_executions")
+            idx = self._next_submit
+            self._next_submit += 1
+            value = args[0] if len(args) == 1 and not kwargs else (args, kwargs)
+            for c, key in self._input_chans:
+                if key is None:
+                    c.write(("ok", value), timeout=self._timeout)
+                elif isinstance(value, dict) or isinstance(key, int):
+                    c.write(("ok", value[key]), timeout=self._timeout)
+                else:
+                    c.write(("ok", getattr(value, key)), timeout=self._timeout)
+        return CompiledDAGRef(self, idx)
+
+    def _get_result(self, idx: int, timeout: Optional[float]):
+        with self._lock:
+            while self._next_read <= idx:
+                outs = []
+                for c in self._output_chans:
+                    status, v = c.read(timeout=timeout or self._timeout)
+                    outs.append((status, v))
+                for status, v in outs:
+                    if status == "err":
+                        self._results[self._next_read] = ("err", v)
+                        break
+                else:
+                    vals = [v for _, v in outs]
+                    self._results[self._next_read] = (
+                        "ok", vals[0] if self._single else vals)
+                self._next_read += 1
+        status, v = self._results.pop(idx)
+        if status == "err":
+            raise v
+        return v
+
+    # -- lifecycle -------------------------------------------------------------------
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        self._stop.write(True)
+        try:
+            import ray_tpu
+
+            ray_tpu.wait(self._loop_refs, num_returns=len(self._loop_refs), timeout=5.0)
+        except Exception:
+            pass
+        for c in self._all_channels:
+            c.destroy()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

@@ -1,0 +1,173 @@
+"""Compiled Graph (aDAG) tests (reference: python/ray/dag/tests; SURVEY.md §2.3)."""
+import time
+
+import pytest
+
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.dag.channel import ChannelFullError, ShmChannel
+
+
+def test_channel_roundtrip_and_backpressure():
+    import os
+
+    c = ShmChannel(f"rtch_{os.getpid()}", 1024, create=True)
+    try:
+        r = ShmChannel(c.name, 1024)
+        c.write({"a": 1})
+        assert r.read(timeout=1) == {"a": 1}
+        c.write([1, 2, 3])
+        assert r.read(timeout=1) == [1, 2, 3]
+        # backpressure: unread value blocks the next write
+        c.write("x")
+        with pytest.raises(TimeoutError):
+            c.write("y", timeout=0.2)
+        assert r.read(timeout=1) == "x"
+        c.write("y", timeout=1)  # ack arrived, slot reusable
+        assert r.read(timeout=1) == "y"
+        with pytest.raises(ChannelFullError):
+            c.write(b"z" * 2048)
+        r.close()
+    finally:
+        c.destroy()
+
+
+@pytest.fixture(scope="module")
+def actors(rt):
+    @rt.remote
+    class Adder:
+        def __init__(self, inc):
+            self.inc = inc
+            self.calls = 0
+
+        def add(self, x):
+            self.calls += 1
+            return x + self.inc
+
+        def boom(self, x):
+            raise ValueError(f"boom on {x}")
+
+        def num_calls(self):
+            return self.calls
+
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    yield a, b
+    for h in (a, b):
+        rt.kill(h)
+
+
+def test_linear_dag(rt, actors):
+    a, b = actors
+    with InputNode() as inp:
+        x = a.add.bind(inp)
+        y = b.add.bind(x)
+    dag = y.experimental_compile()
+    try:
+        assert dag.execute(5).get() == 16  # (5+1)+10
+        assert dag.execute(100).get() == 111
+        # repeated dispatch reuses the compiled loops — no new tasks per call
+        for i in range(20):
+            assert dag.execute(i).get() == i + 11
+    finally:
+        dag.teardown()
+
+
+def test_multi_output_fanout(rt, actors):
+    a, b = actors
+    with InputNode() as inp:
+        x = a.add.bind(inp)
+        y = b.add.bind(inp)
+    dag = MultiOutputNode([x, y]).experimental_compile()
+    try:
+        assert dag.execute(7).get() == [8, 17]
+    finally:
+        dag.teardown()
+
+
+def test_input_attribute_nodes(rt, actors):
+    a, b = actors
+    with InputNode() as inp:
+        x = a.add.bind(inp["left"])
+        y = b.add.bind(inp["right"])
+    dag = MultiOutputNode([x, y]).experimental_compile()
+    try:
+        assert dag.execute({"left": 1, "right": 2}).get() == [2, 12]
+    finally:
+        dag.teardown()
+
+
+def test_dag_pipelining_multiple_inflight(rt, actors):
+    a, b = actors
+    with InputNode() as inp:
+        y = b.add.bind(a.add.bind(inp))
+    dag = y.experimental_compile(max_inflight_executions=4)
+    try:
+        # window of 2: submit ahead of reads, results stay ordered
+        r0, r1 = dag.execute(0), dag.execute(1)
+        out = []
+        for i in range(2, 8):
+            out.append(r0.get())
+            r0, r1 = r1, dag.execute(i)
+        out += [r0.get(), r1.get()]
+        assert out == [i + 11 for i in range(8)]
+        # exceeding the declared depth raises instead of deadlocking
+        refs = [dag.execute(i) for i in range(4)]
+        with pytest.raises(RuntimeError, match="in flight"):
+            dag.execute(99)
+        assert [r.get() for r in refs] == [i + 11 for i in range(4)]
+    finally:
+        dag.teardown()
+
+
+def test_dag_exception_propagates(rt, actors):
+    a, b = actors
+    with InputNode() as inp:
+        y = a.boom.bind(inp)
+    dag = y.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            dag.execute(1).get()
+    finally:
+        dag.teardown()
+
+
+def test_actor_usable_after_teardown(rt, actors):
+    a, _ = actors
+    with InputNode() as inp:
+        y = a.add.bind(inp)
+    dag = y.experimental_compile()
+    assert dag.execute(1).get() == 2
+    dag.teardown()
+    # after teardown the exec loop exits and normal method calls work again
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            assert rt.get(a.add.remote(5), timeout=5) == 6
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        raise AssertionError("actor still blocked after teardown")
+
+
+def test_dag_throughput_beats_task_path(rt, actors):
+    """The compiled path must beat per-call task submission on repeated dispatch."""
+    a, _ = actors
+    n = 50
+    t0 = time.perf_counter()
+    for i in range(n):
+        rt.get(a.add.remote(i))
+    task_path = time.perf_counter() - t0
+
+    with InputNode() as inp:
+        y = a.add.bind(inp)
+    dag = y.experimental_compile()
+    try:
+        dag.execute(0).get()  # warm
+        t0 = time.perf_counter()
+        for i in range(n):
+            dag.execute(i).get()
+        dag_path = time.perf_counter() - t0
+    finally:
+        dag.teardown()
+    assert dag_path < task_path, (dag_path, task_path)
