@@ -1,0 +1,79 @@
+"""Dashboard-lite: HTTP endpoints for cluster state + Prometheus metrics.
+
+Capability parity: reference python/ray/dashboard/ (DashboardHead head.py:48 +
+per-node agent; modules: state, metrics, reporter). The React UI is out of scope;
+the data plane — JSON state endpoints and a Prometheus scrape target — is here,
+served from the driver process (our GCS-equivalent lives in-process).
+
+Endpoints:
+    GET /api/summary        cluster summary
+    GET /api/nodes|workers|actors|tasks|objects|placement_groups
+    GET /api/timeline       chrome-trace JSON
+    GET /metrics            Prometheus exposition text
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._ready = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="rt-dashboard")
+        self._thread.start()
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError("dashboard failed to start")
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        from ray_tpu.util import state as st
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        tables = {
+            "nodes": st.list_nodes,
+            "workers": st.list_workers,
+            "actors": st.list_actors,
+            "tasks": st.list_tasks,
+            "objects": st.list_objects,
+            "placement_groups": st.list_placement_groups,
+        }
+
+        async def api(request: "web.Request") -> "web.Response":
+            name = request.match_info["name"]
+            if name == "summary":
+                return web.json_response(st.summarize_cluster())
+            if name == "timeline":
+                return web.json_response(st.timeline())
+            fn = tables.get(name)
+            if fn is None:
+                return web.Response(status=404, text=f"unknown table {name}")
+            return web.json_response(fn())
+
+        async def metrics(request: "web.Request") -> "web.Response":
+            return web.Response(text=st.prometheus_metrics(),
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/api/{name}", api)
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._ready.set()
+        loop.run_forever()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)

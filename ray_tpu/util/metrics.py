@@ -1,0 +1,236 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Capability parity: reference python/ray/util/metrics.py (Counter :164, Histogram
+:217, Gauge :295) + the dashboard-agent scrape path (C++ DEFINE_stats ->
+OpenCensus -> Prometheus; SURVEY.md §5). Here each process keeps a local registry;
+worker processes push deltas to the node coordinator over their control pipe every
+REPORT_INTERVAL_S (the reference's agent scrape, inverted), and the aggregated view
+is served by the state API / dashboard exporter (util/state.py, dashboard.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPORT_INTERVAL_S = 2.0
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+]
+
+
+class _Registry:
+    """Per-process metric registry; worker side pushes deltas to the coordinator."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, "Metric"] = {}
+        self._push_thread: Optional[threading.Thread] = None
+
+    def register(self, m: "Metric") -> None:
+        with self._lock:
+            existing = self._metrics.get(m.name)
+            if existing is not None and existing.TYPE != m.TYPE:
+                raise ValueError(f"metric {m.name!r} already registered as {existing.TYPE}")
+            self._metrics[m.name] = m
+        self._ensure_push_thread()
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [m._export() for m in self._metrics.values()]
+
+    def _ensure_push_thread(self) -> None:
+        """Workers push snapshots to the coordinator (no-op on the driver, whose
+        registry is read directly by the state API)."""
+        if self._push_thread is not None:
+            return
+        from ray_tpu.core import global_state
+
+        w = global_state.try_worker()
+        if w is None or not hasattr(w, "push_metrics"):
+            return
+
+        def loop():
+            while True:
+                time.sleep(REPORT_INTERVAL_S)
+                try:
+                    snap = self.snapshot()
+                    if snap:
+                        w.push_metrics(snap)
+                except Exception:
+                    return  # pipe closed: worker exiting
+
+        self._push_thread = threading.Thread(target=loop, daemon=True, name="metrics-push")
+        self._push_thread.start()
+
+
+_registry = _Registry()
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    TYPE = "base"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name is required")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        _registry.register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        if tags:
+            out.update(tags)
+        return out
+
+    def _export(self) -> dict:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonic counter (reference metrics.py:164)."""
+
+    TYPE = "counter"
+
+    def __init__(self, name, description="", tag_keys=None):
+        self._values: Dict[Tuple, float] = defaultdict(float)
+        super().__init__(name, description, tag_keys)
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() value must be >= 0")
+        with self._lock:
+            self._values[_tag_key(self._merged(tags))] += value
+
+    def _export(self) -> dict:
+        with self._lock:
+            return {"name": self.name, "type": self.TYPE, "description": self.description,
+                    "values": {k: v for k, v in self._values.items()}}
+
+
+class Gauge(Metric):
+    """Last-value gauge (reference metrics.py:295)."""
+
+    TYPE = "gauge"
+
+    def __init__(self, name, description="", tag_keys=None):
+        self._values: Dict[Tuple, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_tag_key(self._merged(tags))] = float(value)
+
+    def _export(self) -> dict:
+        with self._lock:
+            return {"name": self.name, "type": self.TYPE, "description": self.description,
+                    "values": dict(self._values)}
+
+
+class Histogram(Metric):
+    """Bucketed histogram (reference metrics.py:217)."""
+
+    TYPE = "histogram"
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=None):
+        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        self._buckets: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = defaultdict(float)
+        self._counts: Dict[Tuple, int] = defaultdict(int)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            buckets = self._buckets.setdefault(key, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            buckets[i] += 1
+            self._sums[key] += value
+            self._counts[key] += 1
+
+    def _export(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name, "type": self.TYPE, "description": self.description,
+                "boundaries": self.boundaries,
+                "values": {k: {"buckets": list(v), "sum": self._sums[k],
+                               "count": self._counts[k]}
+                           for k, v in self._buckets.items()},
+            }
+
+
+# ------------------------------------------------------------------- aggregation
+
+def merge_snapshots(snaps: List[List[dict]]) -> Dict[str, dict]:
+    """Merge per-process snapshots (driver registry + worker pushes) by metric name."""
+    out: Dict[str, dict] = {}
+    for snap in snaps:
+        for m in snap:
+            cur = out.get(m["name"])
+            if cur is None:
+                import copy
+
+                out[m["name"]] = copy.deepcopy(m)
+                continue
+            if m["type"] == "counter":
+                for k, v in m["values"].items():
+                    cur["values"][k] = cur["values"].get(k, 0.0) + v
+            elif m["type"] == "gauge":
+                cur["values"].update(m["values"])
+            elif m["type"] == "histogram":
+                for k, v in m["values"].items():
+                    tgt = cur["values"].get(k)
+                    if tgt is None:
+                        cur["values"][k] = {"buckets": list(v["buckets"]),
+                                            "sum": v["sum"], "count": v["count"]}
+                    else:
+                        tgt["buckets"] = [a + b for a, b in zip(tgt["buckets"], v["buckets"])]
+                        tgt["sum"] += v["sum"]
+                        tgt["count"] += v["count"]
+    return out
+
+
+def prometheus_text(merged: Dict[str, dict], prefix: str = "ray_tpu") -> str:
+    """Render merged metrics in Prometheus exposition format (reference: the
+    dashboard agent's re-export; dashboard/modules/metrics)."""
+    lines = []
+    for name, m in sorted(merged.items()):
+        full = f"{prefix}_{name}"
+        lines.append(f"# HELP {full} {m.get('description', '')}")
+        lines.append(f"# TYPE {full} {m['type']}")
+
+        def fmt_tags(key_tuple, extra=None):
+            items = list(key_tuple) + (list(extra.items()) if extra else [])
+            if not items:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in items)
+            return "{" + inner + "}"
+
+        if m["type"] in ("counter", "gauge"):
+            for k, v in m["values"].items():
+                lines.append(f"{full}{fmt_tags(k)} {v}")
+        else:
+            for k, v in m["values"].items():
+                cum = 0
+                for bound, cnt in zip(m["boundaries"] + [float("inf")], v["buckets"]):
+                    cum += cnt
+                    lines.append(f'{full}_bucket{fmt_tags(k, {"le": bound})} {cum}')
+                lines.append(f"{full}_sum{fmt_tags(k)} {v['sum']}")
+                lines.append(f"{full}_count{fmt_tags(k)} {v['count']}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,190 @@
+"""State API: list/get cluster entities + timeline export.
+
+Capability parity: reference python/ray/util/state/ (api.py list_tasks/actors/
+objects/nodes, state_cli.py `ray list ...`) backed by GcsTaskManager +
+state_aggregator.py, and `ray.timeline` (python/ray/_private/state.py:986).
+Here the cluster lives in the driver process, so the aggregator reads the
+Cluster structures directly; worker metrics arrive via the pipe push
+(core/node.py "metrics" message).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import global_state
+
+
+def _cluster():
+    c = global_state.try_cluster()
+    if c is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return c
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    c = _cluster()
+    out = []
+    for node in c.nodes():
+        out.append({
+            "node_id": node.node_id.hex(),
+            "alive": node.alive,
+            "resources_total": dict(node.ledger.total),
+            "resources_available": node.ledger.available(),
+            "num_workers": len(node.workers),
+        })
+    return out
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    c = _cluster()
+    out = []
+    with c._lock:
+        for node in c._nodes.values():
+            for w in node.workers.values():
+                out.append({
+                    "worker_id": w.worker_id.hex(),
+                    "node_id": node.node_id.hex(),
+                    "pid": w.process.pid,
+                    "state": w.state,
+                    "accelerator": w.accel,
+                    "num_inflight": len(w.inflight),
+                })
+    return out
+
+
+def list_tasks(filters: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
+    """Pending/running tasks plus recent finished ones (bounded ring)."""
+    c = _cluster()
+    out = []
+    with c._lock:
+        for ts in c.tasks.values():
+            state = "RUNNING" if ts.dispatched_at else "PENDING"
+            out.append({
+                "task_id": ts.spec.task_id.hex(),
+                "name": ts.spec.name,
+                "kind": ts.spec.kind,
+                "state": state,
+                "submitted_at": ts.submitted_at,
+            })
+        for ev in c.task_events:
+            out.append({
+                "task_id": ev["task_id"],
+                "name": ev["name"],
+                "kind": ev["kind"],
+                "state": "FAILED" if ev["error"] else "FINISHED",
+                "submitted_at": ev["submitted_at"],
+            })
+    if filters:
+        out = [t for t in out if all(t.get(k) == v for k, v in filters.items())]
+    return out
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    c = _cluster()
+    out = []
+    with c._lock:
+        for st in c.actors.values():
+            out.append({
+                "actor_id": st.actor_id.hex(),
+                "class_name": st.creation_spec.name.replace(".__init__", ""),
+                "state": st.state.upper(),
+                "name": st.name,
+                "namespace": st.namespace,
+                "pid": st.worker.process.pid if st.worker else None,
+                "node_id": st.worker.node.node_id.hex() if st.worker else None,
+                "restarts": st.restarts_used,
+            })
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    c = _cluster()
+    store = c.store
+    out = []
+    with store._lock:
+        for oid, loc in store._locations.items():
+            kind = loc[0]
+            size = (len(loc[1]) if kind == "inline"
+                    else loc[3] if kind == "arena" else loc[2])
+            out.append({
+                "object_id": oid.hex(),
+                "tier": kind,
+                "size_bytes": size,
+                "refcount": store._refcounts.get(oid, 0),
+            })
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    c = _cluster()
+    out = []
+    with c.pg_manager._lock:
+        entries = list(c.pg_manager._groups.values())
+    for pg, bundles in entries:
+        out.append({
+            "placement_group_id": pg.id.hex(),
+            "ready": pg._ready_event.is_set(),
+            "strategy": pg.strategy,
+            "name": pg.name,
+            "bundles": [dict(b.resources) for b in bundles],
+        })
+    return out
+
+
+def summarize_cluster() -> Dict[str, Any]:
+    c = _cluster()
+    return {
+        "nodes": len(list_nodes()),
+        "workers": len(list_workers()),
+        "actors": len(list_actors()),
+        "pending_tasks": len([t for t in list_tasks() if t["state"] == "PENDING"]),
+        "objects": c.store.stats(),
+    }
+
+
+# -------------------------------------------------------------------- metrics
+
+def get_metrics() -> Dict[str, dict]:
+    """Aggregated metrics: driver registry + latest worker pushes."""
+    from ray_tpu.util import metrics as m
+
+    c = _cluster()
+    snaps = [m._registry.snapshot()]
+    snaps.extend(c.metrics_by_worker.values())
+    return m.merge_snapshots(snaps)
+
+
+def prometheus_metrics() -> str:
+    from ray_tpu.util import metrics as m
+
+    return m.prometheus_text(get_metrics())
+
+
+# -------------------------------------------------------------------- timeline
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace events for finished tasks (reference ray.timeline,
+    python/ray/_private/state.py:986 + profiling.py chrome_tracing_dump)."""
+    c = _cluster()
+    events = []
+    with c._lock:
+        evs = list(c.task_events)
+    for ev in evs:
+        if ev["dispatched_at"] is None:
+            continue
+        events.append({
+            "cat": "task",
+            "ph": "X",  # complete event
+            "name": ev["name"],
+            "pid": ev["node_id"][:8],
+            "tid": ev["worker_id"][:8],
+            "ts": ev["dispatched_at"] * 1e6,
+            "dur": (ev["finished_at"] - ev["dispatched_at"]) * 1e6,
+            "args": {"task_id": ev["task_id"], "error": ev["error"]},
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -1,0 +1,130 @@
+"""Metrics + state API + timeline + dashboard tests (reference: ray.util.metrics,
+python/ray/util/state, ray.timeline; SURVEY.md §5)."""
+import time
+
+import pytest
+
+from ray_tpu.util import metrics as rm
+from ray_tpu.util import state as rs
+
+
+def test_counter_gauge_histogram_local():
+    c = rm.Counter("t_requests", description="reqs", tag_keys=("route",))
+    c.inc(1.0, tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(5.0, tags={"route": "/b"})
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = rm.Gauge("t_depth")
+    g.set(3.0)
+    g.set(7.0)
+    h = rm.Histogram("t_lat", boundaries=[0.1, 1.0])
+    for v in (0.05, 0.5, 5.0, 0.5):
+        h.observe(v)
+    merged = rm.merge_snapshots([rm._registry.snapshot()])
+    assert merged["t_requests"]["values"][(("route", "/a"),)] == 3.0
+    assert merged["t_requests"]["values"][(("route", "/b"),)] == 5.0
+    assert merged["t_depth"]["values"][()] == 7.0
+    hv = merged["t_lat"]["values"][()]
+    assert hv["buckets"] == [1, 2, 1] and hv["count"] == 4
+    text = rm.prometheus_text(merged)
+    assert 'ray_tpu_t_requests{route="/a"} 3.0' in text
+    assert "ray_tpu_t_lat_count 4" in text
+
+
+def test_merge_across_processes_shapes():
+    snap_a = [{"name": "m", "type": "counter", "description": "", "values": {(): 2.0}}]
+    snap_b = [{"name": "m", "type": "counter", "description": "", "values": {(): 3.0}}]
+    merged = rm.merge_snapshots([snap_a, snap_b])
+    assert merged["m"]["values"][()] == 5.0
+
+
+def test_state_api_lists(rt):
+    @rt.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="state-test-actor").remote()
+    assert rt.get(a.ping.remote()) == "pong"
+
+    nodes = rs.list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+    assert nodes[0]["resources_total"]["CPU"] > 0
+
+    actors = rs.list_actors()
+    mine = [x for x in actors if x["name"] == "state-test-actor"]
+    assert len(mine) == 1 and mine[0]["state"] == "ALIVE"
+
+    workers = rs.list_workers()
+    assert any(w["state"] in ("busy", "idle") for w in workers)
+
+    big = rt.put(b"x" * 200_000)
+    objs = rs.list_objects()
+    assert any(o["size_bytes"] >= 200_000 for o in objs)
+    del big
+
+    summary = rs.summarize_cluster()
+    assert summary["nodes"] >= 1 and summary["actors"] >= 1
+    rt.kill(a)
+
+
+def test_task_timeline(rt):
+    @rt.remote
+    def work(x):
+        time.sleep(0.05)
+        return x
+
+    rt.get([work.remote(i) for i in range(3)])
+    events = rs.timeline()
+    mine = [e for e in events if e["name"] == "work"]
+    assert len(mine) >= 3
+    for e in mine:
+        assert e["ph"] == "X" and e["dur"] >= 0.04e6
+
+
+def test_worker_metrics_flow_to_driver(rt):
+    @rt.remote
+    def emit():
+        from ray_tpu.util import metrics as m
+
+        c = m.Counter("t_worker_side")
+        c.inc(4.0)
+        m._registry._ensure_push_thread()
+        # force one immediate push (don't wait for the interval)
+        from ray_tpu.core import global_state
+
+        global_state.worker().push_metrics(m._registry.snapshot())
+        return True
+
+    assert rt.get(emit.remote())
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        merged = rs.get_metrics()
+        if "t_worker_side" in merged:
+            assert merged["t_worker_side"]["values"][()] == 4.0
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("worker metrics never reached the driver")
+
+
+def test_dashboard_http(rt):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(port=18265)
+    try:
+        with urllib.request.urlopen("http://127.0.0.1:18265/api/summary", timeout=5) as r:
+            summary = json.loads(r.read())
+        assert summary["nodes"] >= 1
+        with urllib.request.urlopen("http://127.0.0.1:18265/api/nodes", timeout=5) as r:
+            nodes = json.loads(r.read())
+        assert nodes and nodes[0]["alive"]
+        with urllib.request.urlopen("http://127.0.0.1:18265/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "# TYPE" in text or text.strip() == ""
+    finally:
+        dash.stop()
