@@ -1,0 +1,19 @@
+"""ray_tpu.autoscaler — demand-driven node scaling (autoscaler v2 shape).
+
+Capability parity: reference python/ray/autoscaler/v2/ (Autoscaler autoscaler.py:42,
+instance_manager/, scheduler.py bin-packing against pending demand, monitor.py) +
+the v1 NodeProvider ABC (node_provider.py) and the fake provider used for tests
+(_private/fake_multi_node/node_provider.py). TPU-shaped: node types are pod-slices
+(a v5e-8 slice is one schedulable node with 8 TPU resources + a slice-head
+resource), and the provider contract is "provision a slice", not "launch a VM".
+"""
+from .node_provider import FakeNodeProvider, NodeProvider, NodeType
+from .autoscaler import Autoscaler, AutoscalingConfig
+
+__all__ = [
+    "NodeProvider",
+    "FakeNodeProvider",
+    "NodeType",
+    "Autoscaler",
+    "AutoscalingConfig",
+]

@@ -1,0 +1,186 @@
+"""Autoscaler: bin-pack pending demand onto node types, scale the provider.
+
+Capability parity: reference python/ray/autoscaler/v2/ — `Autoscaler`
+(autoscaler.py:42) polling `GcsAutoscalerStateManager`-style cluster state,
+`scheduler.py` bin-packing pending resource requests onto `available_node_types`,
+launching/terminating through the instance manager; plus v1's idle-node
+termination (StandardAutoscaler, _private/autoscaler.py:172).
+
+Demand sources here: the Cluster's pending task/actor queue (resource shapes that
+could not be placed) and pending placement groups (whole-bundle-list demand —
+slices must fit atomically, the TPU analogue of STRICT_PACK on `TPU-...-head`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from .node_provider import NodeProvider
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    idle_timeout_s: float = 60.0
+    upscale_interval_s: float = 1.0
+    max_concurrent_launches: int = 100
+
+
+def _fits(resources: Dict[str, float], capacity: Dict[str, float]) -> bool:
+    return all(capacity.get(k, 0.0) >= v for k, v in resources.items() if v > 0)
+
+
+def bin_pack(demands: List[Dict[str, float]], node_types: List, existing_headroom:
+             List[Dict[str, float]]) -> Dict[str, int]:
+    """First-fit-decreasing pack of resource demands; returns {node_type: count} to add.
+
+    Reference analog: autoscaler v2 scheduler.py's ResourceDemandScheduler.
+    """
+    headroom = [dict(h) for h in existing_headroom]
+    to_launch: Dict[str, int] = defaultdict(int)
+    virtual: List[Dict[str, float]] = []
+
+    for demand in sorted(demands, key=lambda d: -sum(d.values())):
+        placed = False
+        for cap in headroom + virtual:
+            if _fits(demand, cap):
+                for k, v in demand.items():
+                    cap[k] = cap.get(k, 0.0) - v
+                placed = True
+                break
+        if placed:
+            continue
+        # pick the smallest node type that fits the demand
+        candidates = [t for t in node_types if _fits(demand, t.resources)]
+        if not candidates:
+            continue  # infeasible demand: surfaced via pending_infeasible
+        best = min(candidates, key=lambda t: sum(t.resources.values()))
+        to_launch[best.name] += 1
+        cap = dict(best.resources)
+        for k, v in demand.items():
+            cap[k] = cap.get(k, 0.0) - v
+        virtual.append(cap)
+    return dict(to_launch)
+
+
+class Autoscaler:
+    """Reconciles cluster demand against the provider. Runs as a driver thread."""
+
+    def __init__(self, provider: NodeProvider,
+                 config: Optional[AutoscalingConfig] = None,
+                 cluster=None):
+        from ray_tpu.core import global_state
+
+        self.provider = provider
+        self.config = config or AutoscalingConfig()
+        self._cluster = cluster or global_state.try_cluster()
+        if self._cluster is None:
+            raise RuntimeError("ray_tpu is not initialized")
+        self._stop = threading.Event()
+        self._idle_since: Dict[object, float] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    # -- demand/cluster views ----------------------------------------------------
+    def pending_demands(self) -> List[Dict[str, float]]:
+        c = self._cluster
+        out = []
+        with c._lock:
+            for spec in c.pending:
+                if spec.resources:
+                    out.append(dict(spec.resources))
+            for pg in c.pending_pgs:
+                out.extend(dict(b) for b in pg.bundle_specs)
+        return out
+
+    def _headroom(self) -> List[Dict[str, float]]:
+        return [n.ledger.available() for n in self._cluster.nodes() if n.alive]
+
+    def _provider_count(self, node_type: str) -> int:
+        return sum(1 for i in self.provider.non_terminated_nodes()
+                   if i.node_type == node_type)
+
+    # -- reconciliation ----------------------------------------------------------
+    def step(self) -> Dict[str, int]:
+        """One reconcile pass: launch for unmet demand, terminate idle nodes.
+        Returns the launch decision (for tests/observability)."""
+        poll = getattr(self.provider, "poll", None)
+        if poll is not None:
+            poll()
+
+        demands = self.pending_demands()
+        launched: Dict[str, int] = {}
+        if demands:
+            pending_caps = [dict(self.provider.node_types[i.node_type].resources)
+                            for i in self.provider.non_terminated_nodes()
+                            if i.status == "requested"]
+            decision = bin_pack(demands, list(self.provider.node_types.values()),
+                                self._headroom() + pending_caps)
+            for node_type, count in decision.items():
+                t = self.provider.node_types[node_type]
+                have = self._provider_count(node_type)
+                count = min(count, max(0, t.max_nodes - have),
+                            self.config.max_concurrent_launches)
+                for _ in range(count):
+                    self.provider.create_node(node_type)
+                if count:
+                    launched[node_type] = count
+
+        # min_nodes floors
+        for t in self.provider.node_types.values():
+            deficit = t.min_nodes - self._provider_count(t.name)
+            for _ in range(max(0, deficit)):
+                self.provider.create_node(t.name)
+
+        self._terminate_idle()
+        return launched
+
+    def _terminate_idle(self) -> None:
+        """Terminate provider nodes idle past the timeout (never the head node).
+
+        Idle = full resource headroom (nothing scheduled) and no live workers
+        holding state (actors pin their node implicitly via held resources).
+        """
+        now = time.time()
+        c = self._cluster
+        by_node_id = {}
+        get_nid = getattr(self.provider, "_node_ids", None)
+        if get_nid is None:
+            return  # provider doesn't expose node identity; skip scale-down
+        with self.provider._lock:
+            for inst_id, nid in self.provider._node_ids.items():
+                by_node_id[nid] = inst_id
+        for node in c.nodes():
+            inst_id = by_node_id.get(node.node_id)
+            if inst_id is None or not node.alive:
+                continue
+            avail = node.ledger.available()
+            if avail == node.ledger.total:
+                since = self._idle_since.setdefault(node.node_id, now)
+                if now - since >= self.config.idle_timeout_s:
+                    self.provider.terminate_node(inst_id)
+                    self._idle_since.pop(node.node_id, None)
+            else:
+                self._idle_since.pop(node.node_id, None)
+
+    # -- lifecycle ----------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.config.upscale_interval_s):
+                try:
+                    self.step()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="rt-autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

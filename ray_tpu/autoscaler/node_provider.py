@@ -1,0 +1,112 @@
+"""NodeProvider ABC + fake provider.
+
+Capability parity: reference python/ray/autoscaler/node_provider.py (NodeProvider
+ABC: create_node/terminate_node/non_terminated_nodes) and
+_private/fake_multi_node/node_provider.py (nodes "launched" locally so autoscaler
+logic is testable without a cloud). A TPU provider creates pod-slices: the unit
+of scaling is a whole slice (you cannot add half a v5e-64), mirroring how the
+reference's TPUAcceleratorManager models `TPU-{pod}-head` resources (tpu.py:376).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeType:
+    """A provisionable node shape (reference: available_node_types in cluster YAML)."""
+
+    name: str
+    resources: Dict[str, float]
+    max_nodes: int = 10
+    min_nodes: int = 0
+
+
+@dataclasses.dataclass
+class NodeInstance:
+    instance_id: str
+    node_type: str
+    status: str  # "requested" | "running" | "terminated"
+
+
+class NodeProvider(abc.ABC):
+    """Provision/terminate nodes of declared types."""
+
+    def __init__(self, node_types: List[NodeType]):
+        self.node_types = {t.name: t for t in node_types}
+
+    @abc.abstractmethod
+    def create_node(self, node_type: str) -> NodeInstance: ...
+
+    @abc.abstractmethod
+    def terminate_node(self, instance_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def non_terminated_nodes(self) -> List[NodeInstance]: ...
+
+
+class FakeNodeProvider(NodeProvider):
+    """Adds/removes nodes on the in-process Cluster — the fake_multi_node analogue.
+
+    `launch_delay_steps` simulates slow cloud provisioning: a created node stays
+    "requested" for N polls before joining, which exercises the autoscaler's
+    pending-request accounting.
+    """
+
+    def __init__(self, node_types: List[NodeType], launch_delay_steps: int = 0):
+        super().__init__(node_types)
+        self._lock = threading.Lock()
+        self._instances: Dict[str, NodeInstance] = {}
+        self._countdown: Dict[str, int] = {}
+        self._node_ids: Dict[str, object] = {}  # instance -> core NodeID
+        self.launch_delay_steps = launch_delay_steps
+
+    def create_node(self, node_type: str) -> NodeInstance:
+        t = self.node_types[node_type]
+        inst = NodeInstance(instance_id=f"fake-{uuid.uuid4().hex[:8]}",
+                            node_type=t.name, status="requested")
+        with self._lock:
+            self._instances[inst.instance_id] = inst
+            self._countdown[inst.instance_id] = self.launch_delay_steps
+        return inst
+
+    def terminate_node(self, instance_id: str) -> None:
+        from ray_tpu.core import global_state
+
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None or inst.status == "terminated":
+                return
+            inst.status = "terminated"
+            node_id = self._node_ids.pop(instance_id, None)
+        if node_id is not None:
+            cluster = global_state.try_cluster()
+            if cluster is not None:
+                cluster.remove_node(node_id)
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        with self._lock:
+            return [i for i in self._instances.values() if i.status != "terminated"]
+
+    def poll(self) -> None:
+        """Advance simulated provisioning; 'requested' nodes join the cluster."""
+        from ray_tpu.core import global_state
+
+        with self._lock:
+            pending = [i for i in self._instances.values() if i.status == "requested"]
+        for inst in pending:
+            with self._lock:
+                if self._countdown[inst.instance_id] > 0:
+                    self._countdown[inst.instance_id] -= 1
+                    continue
+            cluster = global_state.try_cluster()
+            if cluster is None:
+                continue
+            node = cluster.add_node(dict(self.node_types[inst.node_type].resources))
+            with self._lock:
+                inst.status = "running"
+                self._node_ids[inst.instance_id] = node.node_id

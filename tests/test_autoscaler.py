@@ -1,0 +1,128 @@
+"""Autoscaler tests against the fake provider (reference:
+python/ray/tests/autoscaler/ + fake_multi_node; SURVEY.md §2.10)."""
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    FakeNodeProvider,
+    NodeType,
+)
+from ray_tpu.autoscaler.autoscaler import bin_pack
+
+
+CPU4 = NodeType("cpu-4", {"CPU": 4.0}, max_nodes=5)
+SLICE8 = NodeType("v5e-8", {"CPU": 8.0, "TPU": 8.0, "TPU-v5e-8-head": 1.0}, max_nodes=4)
+
+
+def test_bin_pack_basic():
+    # 6 CPUs of demand, empty cluster -> needs two cpu-4 nodes
+    out = bin_pack([{"CPU": 3.0}, {"CPU": 3.0}], [CPU4], [])
+    assert out == {"cpu-4": 2}
+    # fits in existing headroom -> nothing to launch
+    out = bin_pack([{"CPU": 3.0}], [CPU4], [{"CPU": 4.0}])
+    assert out == {}
+    # TPU demand picks the slice type, not the cpu type
+    out = bin_pack([{"TPU": 8.0}], [CPU4, SLICE8], [])
+    assert out == {"v5e-8": 1}
+    # infeasible demand is skipped
+    out = bin_pack([{"TPU": 64.0}], [CPU4, SLICE8], [])
+    assert out == {}
+
+
+def test_bin_pack_packs_multiple_small_demands():
+    out = bin_pack([{"CPU": 1.0}] * 6, [CPU4], [])
+    assert out == {"cpu-4": 2}  # 4 + 2 packed onto two nodes
+
+
+def test_autoscaler_scales_up_for_pending_tasks(rt):
+    provider = FakeNodeProvider([NodeType("big", {"CPU": 4.0, "bigmem": 4.0})])
+    scaler = Autoscaler(provider, AutoscalingConfig(idle_timeout_s=3600))
+
+    @rt.remote(resources={"bigmem": 2.0})
+    def needs_big():
+        return 42
+
+    refs = [needs_big.remote() for _ in range(4)]
+    # demand is visible while tasks are unplaceable
+    deadline = time.time() + 5
+    while time.time() < deadline and not scaler.pending_demands():
+        time.sleep(0.05)
+    assert scaler.pending_demands(), "pending demand never registered"
+
+    launched = scaler.step()
+    assert launched.get("big", 0) >= 1
+    scaler.step()  # provider poll: requested -> running joins the cluster
+    assert rt.get(refs, timeout=60) == [42, 42, 42, 42]
+    # cleanup: drop the extra nodes
+    for inst in provider.non_terminated_nodes():
+        provider.terminate_node(inst.instance_id)
+
+
+def test_autoscaler_respects_max_nodes(rt):
+    provider = FakeNodeProvider([NodeType("cap", {"CPU": 1.0, "capres": 1.0}, max_nodes=2)])
+    scaler = Autoscaler(provider, AutoscalingConfig(idle_timeout_s=3600))
+
+    @rt.remote(resources={"capres": 1.0})
+    def f():
+        return 1
+
+    refs = [f.remote() for _ in range(5)]  # demand for 5 nodes, cap 2
+    deadline = time.time() + 5
+    while time.time() < deadline and len(scaler.pending_demands()) < 5:
+        time.sleep(0.05)
+    scaler.step()
+    assert len(provider.non_terminated_nodes()) == 2
+    scaler.step()
+    assert len(provider.non_terminated_nodes()) == 2  # no over-launch
+    # all five eventually run by cycling through the two capped nodes
+    assert rt.get(refs, timeout=60) == [1] * 5
+    assert len(provider.non_terminated_nodes()) == 2
+    for inst in provider.non_terminated_nodes():
+        provider.terminate_node(inst.instance_id)
+
+
+def test_autoscaler_terminates_idle_nodes(rt):
+    provider = FakeNodeProvider([NodeType("idle-type", {"idleres": 2.0})])
+    scaler = Autoscaler(provider, AutoscalingConfig(idle_timeout_s=0.2))
+    provider.create_node("idle-type")
+    scaler.step()  # joins cluster
+    assert len(provider.non_terminated_nodes()) == 1
+    time.sleep(0.3)
+    scaler.step()  # idle past timeout -> terminated
+    assert len(provider.non_terminated_nodes()) == 0
+
+
+def test_autoscaler_min_nodes_floor(rt):
+    provider = FakeNodeProvider([NodeType("floor", {"floorres": 1.0}, min_nodes=2)])
+    scaler = Autoscaler(provider, AutoscalingConfig(idle_timeout_s=3600))
+    scaler.step()
+    assert len(provider.non_terminated_nodes()) == 2
+    for inst in provider.non_terminated_nodes():
+        provider.terminate_node(inst.instance_id)
+
+
+def test_launch_delay_counts_as_pending(rt):
+    """Requested-but-not-joined nodes must suppress duplicate launches."""
+    provider = FakeNodeProvider([NodeType("slow", {"CPU": 2.0, "slowres": 2.0})],
+                                launch_delay_steps=3)
+    scaler = Autoscaler(provider, AutoscalingConfig(idle_timeout_s=3600))
+
+    @rt.remote(resources={"slowres": 1.0})
+    def g():
+        return 7
+
+    ref = g.remote()
+    deadline = time.time() + 5
+    while time.time() < deadline and not scaler.pending_demands():
+        time.sleep(0.05)
+    scaler.step()
+    assert len(provider.non_terminated_nodes()) == 1
+    for _ in range(5):  # while provisioning, no duplicate launch
+        scaler.step()
+    assert len(provider.non_terminated_nodes()) == 1
+    assert rt.get(ref, timeout=60) == 7
+    for inst in provider.non_terminated_nodes():
+        provider.terminate_node(inst.instance_id)
