@@ -1,0 +1,5 @@
+"""Job submission (reference python/ray/dashboard/modules/job/ + JobSubmissionClient)."""
+from .manager import JobInfo, JobManager, JobStatus
+from .client import JobSubmissionClient
+
+__all__ = ["JobManager", "JobInfo", "JobStatus", "JobSubmissionClient"]

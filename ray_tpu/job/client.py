@@ -1,0 +1,41 @@
+"""JobSubmissionClient — the reference SDK surface (python/ray/dashboard/modules/
+job/sdk.py:36, submit_job :126) over the local JobManager. The reference client
+speaks HTTP to the dashboard; here jobs are tracked in the shared session dir, so
+a client in any process sees the same jobs as the CLI."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .manager import JobInfo, JobManager
+
+
+class JobSubmissionClient:
+    def __init__(self, address: Optional[str] = None,
+                 session_dir: Optional[str] = None):
+        # address kept for API compatibility; the local manager needs none
+        self._mgr = JobManager(session_dir)
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, Any]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        return self._mgr.submit_job(entrypoint, runtime_env=runtime_env,
+                                    metadata=metadata, submission_id=submission_id)
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._mgr.get_job_status(job_id)
+
+    def get_job_info(self, job_id: str) -> JobInfo:
+        return self._mgr.get_job_info(job_id)
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._mgr.get_job_logs(job_id)
+
+    def list_jobs(self) -> List[JobInfo]:
+        return self._mgr.list_jobs()
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._mgr.stop_job(job_id)
+
+    def wait_job(self, job_id: str, timeout: Optional[float] = None) -> str:
+        return self._mgr.wait_job(job_id, timeout)

@@ -1,0 +1,150 @@
+"""ray-tpu CLI: start/stop/status/submit/job (reference python/ray/scripts/
+scripts.py — `ray start` :676, `ray submit` :1718, `ray stop` :1184, plus the
+`ray job` group from dashboard/modules/job/cli.py).
+
+Single-host note: the runtime is in-process (no separate GCS/raylet daemons), so
+`start` records the head session + brings up the dashboard for external
+observation, and drivers attach by just calling ray_tpu.init() — the reference's
+`ray.init(address=...)` flow collapses to session-dir discovery.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ray_tpu.job.manager import JobManager, default_session_dir
+
+
+def _session_file() -> str:
+    return os.path.join(default_session_dir(), "head.json")
+
+
+def cmd_start(args) -> int:
+    os.makedirs(default_session_dir(), exist_ok=True)
+    info = {
+        "started_at": time.time(),
+        "pid": os.getpid(),
+        "num_cpus": args.num_cpus,
+        "dashboard_port": args.dashboard_port,
+    }
+    with open(_session_file(), "w") as f:
+        json.dump(info, f)
+    print(f"ray_tpu head session recorded at {_session_file()}")
+    if args.block:
+        import ray_tpu
+        from ray_tpu.dashboard import Dashboard
+
+        ray_tpu.init(num_cpus=args.num_cpus)
+        dash = Dashboard(port=args.dashboard_port)
+        print(f"dashboard: http://127.0.0.1:{args.dashboard_port}/api/summary")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            dash.stop()
+            ray_tpu.shutdown()
+    return 0
+
+
+def cmd_stop(args) -> int:
+    try:
+        os.remove(_session_file())
+        print("head session cleared")
+    except FileNotFoundError:
+        print("no head session")
+    return 0
+
+
+def cmd_status(args) -> int:
+    try:
+        with open(_session_file()) as f:
+            info = json.load(f)
+        print(json.dumps(info, indent=2))
+    except FileNotFoundError:
+        print("no head session; run `ray-tpu start`")
+        return 1
+    return 0
+
+
+def cmd_submit(args) -> int:
+    mgr = JobManager()
+    entry = " ".join([sys.executable, args.script] + args.script_args)
+    job_id = mgr.submit_job(entry)
+    print(f"submitted {job_id}")
+    status = mgr.wait_job(job_id)
+    print(mgr.get_job_logs(job_id), end="")
+    print(f"job {job_id}: {status}")
+    return 0 if status == "SUCCEEDED" else 1
+
+
+def cmd_job(args) -> int:
+    mgr = JobManager()
+    if args.job_cmd == "submit":
+        entry = args.entrypoint
+        job_id = mgr.submit_job(entry)
+        print(job_id)
+        if not args.no_wait:
+            status = mgr.wait_job(job_id)
+            print(mgr.get_job_logs(job_id), end="")
+            return 0 if status == "SUCCEEDED" else 1
+        return 0
+    if args.job_cmd == "list":
+        for info in mgr.list_jobs():
+            print(f"{info.job_id}\t{info.status}\t{info.entrypoint}")
+        return 0
+    if args.job_cmd == "status":
+        print(mgr.get_job_status(args.job_id))
+        return 0
+    if args.job_cmd == "logs":
+        print(mgr.get_job_logs(args.job_id), end="")
+        return 0
+    if args.job_cmd == "stop":
+        print("stopped" if mgr.stop_job(args.job_id) else "not running")
+        return 0
+    return 2
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="record head session (optionally --block with dashboard)")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="clear head session")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="show head session")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("submit", help="run a python script as a job")
+    sp.add_argument("script")
+    sp.add_argument("script_args", nargs="*")
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("job", help="job management")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--no-wait", action="store_true")
+    j.add_argument("entrypoint")
+    j = jsub.add_parser("list")
+    j = jsub.add_parser("status")
+    j.add_argument("job_id")
+    j = jsub.add_parser("logs")
+    j.add_argument("job_id")
+    j = jsub.add_parser("stop")
+    j.add_argument("job_id")
+    sp.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Job submission + CLI + runtime_env tests (reference:
+python/ray/dashboard/modules/job tests, runtime_env tests; SURVEY.md §2.10)."""
+import os
+import sys
+import time
+
+import pytest
+
+from ray_tpu.job import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture()
+def client(tmp_path):
+    return JobSubmissionClient(session_dir=str(tmp_path))
+
+
+def test_job_submit_succeeds(client, tmp_path):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    assert client.wait_job(job_id, timeout=30) == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info.return_code == 0 and info.end_time is not None
+
+
+def test_job_failure_reported(client):
+    job_id = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_job(job_id, timeout=30) == JobStatus.FAILED
+    assert client.get_job_info(job_id).return_code == 3
+
+
+def test_job_runtime_env_vars(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import os; print(os.environ['MY_FLAG'])\"",
+        runtime_env={"env_vars": {"MY_FLAG": "flag-value-42"}})
+    client.wait_job(job_id, timeout=30)
+    assert "flag-value-42" in client.get_job_logs(job_id)
+
+
+def test_job_stop(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    deadline = time.time() + 10
+    while time.time() < deadline and client.get_job_status(job_id) != JobStatus.RUNNING:
+        time.sleep(0.05)
+    assert client.stop_job(job_id)
+    assert client.get_job_status(job_id) == JobStatus.STOPPED
+
+
+def test_job_list(client):
+    a = client.submit_job(entrypoint=f"{sys.executable} -c 'print(1)'")
+    b = client.submit_job(entrypoint=f"{sys.executable} -c 'print(2)'")
+    client.wait_job(a, timeout=30)
+    client.wait_job(b, timeout=30)
+    ids = {j.job_id for j in client.list_jobs()}
+    assert {a, b} <= ids
+
+
+def test_cli_job_flow(tmp_path, monkeypatch, capsys):
+    from ray_tpu.scripts.cli import main
+
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    rc = main(["job", "submit", f"{sys.executable} -c \"print('cli-job-ok')\""])
+    out = capsys.readouterr().out
+    assert rc == 0 and "cli-job-ok" in out
+    rc = main(["job", "list"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "SUCCEEDED" in out
+    rc = main(["status"])
+    capsys.readouterr()
+    assert rc == 1  # no head session yet
+    rc = main(["start", "--num-cpus", "2"])
+    capsys.readouterr()
+    assert rc == 0
+    rc = main(["status"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "num_cpus" in out
+    rc = main(["stop"])
+    assert rc == 0
+
+
+def test_runtime_env_validation():
+    import ray_tpu
+
+    with pytest.raises(ValueError, match="require package installation"):
+        ray_tpu.RuntimeEnv(pip=["requests"])
+    with pytest.raises(ValueError, match="unknown"):
+        ray_tpu.RuntimeEnv(bogus_field=1)
+    env = ray_tpu.RuntimeEnv(env_vars={"A": "1"}, working_dir="/tmp")
+    assert env["env_vars"] == {"A": "1"}
+
+
+def test_task_runtime_env_vars(rt):
+    @rt.remote(runtime_env={"env_vars": {"TASK_RENV": "task-env-val"}})
+    def read_env():
+        return os.environ.get("TASK_RENV"), os.environ.get("PRESERVED", "absent")
+
+    val, _ = rt.get(read_env.remote())
+    assert val == "task-env-val"
+
+    # the env var must not leak into tasks without the runtime_env
+    @rt.remote
+    def read_plain():
+        return os.environ.get("TASK_RENV")
+
+    # may land on the same worker; applied() must have restored the env
+    assert rt.get(read_plain.remote()) is None
+
+
+def test_actor_runtime_env_persists(rt):
+    @rt.remote(runtime_env={"env_vars": {"ACTOR_RENV": "actor-env-val"}})
+    class A:
+        def read(self):
+            return os.environ.get("ACTOR_RENV")
+
+    a = A.remote()
+    assert rt.get(a.read.remote()) == "actor-env-val"
+    rt.kill(a)
