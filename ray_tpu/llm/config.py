@@ -48,6 +48,7 @@ class LLMConfig:
     # parallelism: mesh axes for the in-process device mesh
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
+    expert_parallel_size: int = 1  # MoE models: experts shard over "ep"
     # serving
     tokenizer: str = "byte"  # "byte" | "hf:<name-or-path>"
     accelerator_type: Optional[str] = None

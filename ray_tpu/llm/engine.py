@@ -94,17 +94,19 @@ class JaxLLMEngine(LLMEngine):
         cfg = self.model_config
         c = self.config
         if self._mesh is None:
-            # dp*tp devices out of the local set (an engine may intentionally use a
-            # subset, e.g. one replica per chip on a multi-chip host).
+            # dp*ep*tp devices out of the local set (an engine may intentionally use
+            # a subset, e.g. one replica per chip on a multi-chip host).
             from jax.sharding import Mesh
 
-            n = c.data_parallel_size * c.tensor_parallel_size
+            n = c.data_parallel_size * c.expert_parallel_size * c.tensor_parallel_size
             devs = jax.devices()
             if len(devs) < n:
-                raise ValueError(f"need {n} devices for dp×tp, have {len(devs)}")
+                raise ValueError(f"need {n} devices for dp×ep×tp, have {len(devs)}")
             self._mesh = Mesh(
-                np.asarray(devs[:n]).reshape(c.data_parallel_size, c.tensor_parallel_size),
-                ("dp", "tp"),
+                np.asarray(devs[:n]).reshape(
+                    c.data_parallel_size, c.expert_parallel_size, c.tensor_parallel_size
+                ),
+                ("dp", "ep", "tp"),
             )
         if c.max_num_seqs % c.data_parallel_size:
             raise ValueError("max_num_seqs must be divisible by data_parallel_size")

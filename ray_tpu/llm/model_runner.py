@@ -70,7 +70,10 @@ def prefill(
     logits at the last real token ([vocab] f32)."""
     s_pad = tokens.shape[1]
     tmp = llama.init_kv_cache(cfg, batch=1, max_len=s_pad, dtype=state.k.dtype)
-    logits, tmp = llama.forward(params, tokens, cfg, cache=tmp)
+    # pad positions beyond the real prompt must not claim MoE expert capacity
+    token_mask = (jnp.arange(s_pad)[None, :] < true_len).astype(jnp.float32)
+    logits, tmp, _ = llama.forward(params, tokens, cfg, cache=tmp,
+                                   token_mask=token_mask, return_aux=True)
     # install [L, 1, S_pad, KV, HD] into the big cache at (slot, 0)
     start = (0, slot, 0, 0, 0)
     k = jax.lax.dynamic_update_slice(state.k, tmp.k, start)
@@ -82,9 +85,10 @@ def prefill(
 
 # -------------------------------------------------------------------------- decode
 
-def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths):
+def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
     """One layer's decode for all slots. x [S,1,D]; ck/cv [S,max_len,KV,HD];
-    returns (x, ck, cv) with this step's K/V scattered in at position lengths[s]."""
+    returns (x, ck, cv) with this step's K/V scattered in at position lengths[s].
+    `active` [S] keeps inactive slots out of MoE expert capacity."""
     dt = x.dtype
     s, max_len = ck.shape[0], ck.shape[1]
     kvh, hd = cfg.n_kv_heads, cfg.head_dim
@@ -112,9 +116,16 @@ def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths):
     x = x + jnp.einsum("slhk,hkd->sld", o, lp["wo"].astype(dt))
 
     h = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("sld,df->slf", h, lp["w_gate"].astype(dt))
-    up = jnp.einsum("sld,df->slf", h, lp["w_up"].astype(dt))
-    down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt))
+    if cfg.n_experts > 0:
+        from ray_tpu.models import moe as _moe
+
+        y2, _ = _moe.moe_mlp(h[:, 0], lp["router"], lp["w_gate"], lp["w_up"],
+                             lp["w_down"], cfg, mask=active.astype(jnp.float32))
+        down = y2[:, None, :]
+    else:
+        gate = jnp.einsum("sld,df->slf", h, lp["w_gate"].astype(dt))
+        up = jnp.einsum("sld,df->slf", h, lp["w_up"].astype(dt))
+        down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt))
     return x + down, ck, cv
 
 
@@ -138,14 +149,15 @@ def decode_step(
         def body(carry, xs):
             h = carry
             lp, ck, cv = xs
-            h, ck, cv = _decode_block(h, lp, cfg, ck, cv, state.lengths)
+            h, ck, cv = _decode_block(h, lp, cfg, ck, cv, state.lengths, active)
             return h, (ck, cv)
 
         x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], state.k, state.v))
     else:
         nk, nv = [], []
         for i, lp in enumerate(params["layers"]):
-            x, ck, cv = _decode_block(x, lp, cfg, state.k[i], state.v[i], state.lengths)
+            x, ck, cv = _decode_block(x, lp, cfg, state.k[i], state.v[i],
+                                      state.lengths, active)
             nk.append(ck)
             nv.append(cv)
         nk, nv = jnp.stack(nk), jnp.stack(nv)

@@ -34,6 +34,12 @@ class ModelConfig:
     # "pp" mesh axis (parallel/pipeline.py); requires n_layers % pipeline_stages == 0.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0  # 0 -> = pipeline_stages
+    # Mixture-of-experts (0 = dense). Experts shard over the "ep" mesh axis; dispatch
+    # is static capacity-based einsum (models/moe.py) so shapes stay XLA-friendly.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -146,6 +152,38 @@ register_config(
         n_kv_heads=8,
         d_ff=28672,
         max_seq_len=8192,
+    )
+)
+register_config(
+    ModelConfig(
+        name="moe-tiny",
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=96,
+        max_seq_len=128,
+        dtype="float32",
+        scan_layers=True,
+        n_experts=4,
+        moe_top_k=2,
+    )
+)
+register_config(
+    # Mixtral-8x7B architecture description (public): 8 experts, top-2 routing.
+    ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        max_seq_len=32768,
+        rope_theta=1e6,
+        n_experts=8,
+        moe_top_k=2,
     )
 )
 register_config(

@@ -40,10 +40,17 @@ def param_axes(cfg: ModelConfig) -> Params:
         "wv": L("embed", "kv_heads", "head_dim"),
         "wo": L("heads", "head_dim", "embed"),
         "mlp_norm": L("embed"),
-        "w_gate": L("embed", "mlp"),
-        "w_up": L("embed", "mlp"),
-        "w_down": L("mlp", "embed"),
     }
+    if cfg.n_experts > 0:
+        from . import moe as _moe
+
+        layers.update({k: L(*axes) for k, axes in _moe.EXPERT_AXES.items()})
+    else:
+        layers.update({
+            "w_gate": L("embed", "mlp"),
+            "w_up": L("embed", "mlp"),
+            "w_down": L("mlp", "embed"),
+        })
     axes = {
         "embed": ("vocab", "embed"),
         "layers": layers if cfg.scan_layers else [dict(layers) for _ in range(cfg.n_layers)],
@@ -66,17 +73,25 @@ def init(rng: jax.Array, cfg: ModelConfig) -> Params:
         ks = jax.random.split(key, 7)
         s_in = d**-0.5
         s_out = (2 * cfg.n_layers * d) ** -0.5
-        return {
+        out = {
             "attn_norm": jnp.ones((d,), jnp.float32),
             "wq": norm(ks[0], (d, nh, hd), s_in),
             "wk": norm(ks[1], (d, nkv, hd), s_in),
             "wv": norm(ks[2], (d, nkv, hd), s_in),
             "wo": norm(ks[3], (nh, hd, d), s_out),
             "mlp_norm": jnp.ones((d,), jnp.float32),
-            "w_gate": norm(ks[4], (d, ff), s_in),
-            "w_up": norm(ks[5], (d, ff), s_in),
-            "w_down": norm(ks[6], (ff, d), (2 * cfg.n_layers * ff) ** -0.5),
         }
+        if cfg.n_experts > 0:
+            from . import moe as _moe
+
+            out.update(_moe.init_expert_weights(ks[4], cfg))
+        else:
+            out.update({
+                "w_gate": norm(ks[4], (d, ff), s_in),
+                "w_up": norm(ks[5], (d, ff), s_in),
+                "w_down": norm(ks[6], (ff, d), (2 * cfg.n_layers * ff) ** -0.5),
+            })
+        return out
 
     layer_keys = jax.random.split(k_layers, cfg.n_layers)
     if cfg.scan_layers:
@@ -145,8 +160,9 @@ def _block(
     segment_ids: Optional[jax.Array],
     cache_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
     cache_len: Optional[jax.Array] = None,
-) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
-    """One decoder block. Returns (x, updated (k,v) for this layer if caching)."""
+    token_mask: Optional[jax.Array] = None,
+):
+    """One decoder block. Returns (x, updated (k,v) if caching, moe aux loss)."""
     dt = x.dtype
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
@@ -187,11 +203,23 @@ def _block(
     x = wsc(x + o, "batch", "seq", "act_embed")
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
-    up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
-    ff = wsc(jax.nn.silu(gate) * up, "batch", "seq", "act_mlp")
-    down = jnp.einsum("bsf,fd->bsd", ff, lp["w_down"].astype(dt))
-    return wsc(x + down, "batch", "seq", "act_embed"), new_kv
+    if cfg.n_experts > 0:
+        from . import moe as _moe
+
+        b, s, d = h.shape
+        y2, aux = _moe.moe_mlp(
+            h.reshape(b * s, d), lp["router"], lp["w_gate"], lp["w_up"],
+            lp["w_down"], cfg,
+            mask=None if token_mask is None else token_mask.reshape(b * s),
+        )
+        down = y2.reshape(b, s, d)
+    else:
+        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
+        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
+        ff = wsc(jax.nn.silu(gate) * up, "batch", "seq", "act_mlp")
+        down = jnp.einsum("bsf,fd->bsd", ff, lp["w_down"].astype(dt))
+        aux = jnp.zeros((), jnp.float32)
+    return wsc(x + down, "batch", "seq", "act_embed"), new_kv, aux
 
 
 def _pipeline_layers(
@@ -212,6 +240,11 @@ def _pipeline_layers(
     pp = cfg.pipeline_stages
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by pipeline_stages {pp}")
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "MoE with pipeline_stages > 1 is not supported yet: the pipeline body "
+            "cannot thread the load-balancing aux loss, and silently dropping it "
+            "would let experts collapse")
     if not cfg.scan_layers:
         raise ValueError("pipeline_stages > 1 requires scan_layers=True (stacked params)")
     if segment_ids is not None:
@@ -230,7 +263,7 @@ def _pipeline_layers(
         pos = jnp.broadcast_to(start + jnp.arange(s_loc)[None, :], (xm.shape[0], s_loc))
 
         def body(carry, lp):
-            h, _ = _block(carry, lp, cfg, pos, None)
+            h, _, _ = _block(carry, lp, cfg, pos, None)  # aux loss unsupported w/ pp
             return h, None
 
         fn = jax.checkpoint(body) if cfg.remat else body
@@ -258,14 +291,20 @@ def forward(
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
     cache: Optional[KVCache] = None,
-) -> Tuple[jax.Array, Optional[KVCache]]:
-    """tokens [B, S] -> (logits [B, S, vocab] f32, updated cache or None)."""
+    return_aux: bool = False,
+    token_mask: Optional[jax.Array] = None,  # [B, S] 1=real; MoE capacity masking
+):
+    """tokens [B, S] -> (logits [B, S, vocab] f32, updated cache or None).
+
+    With return_aux=True also returns the summed MoE load-balancing loss (zero for
+    dense configs) as a third element."""
     b, s = tokens.shape
     if positions is None:
         start = cache.length if cache is not None else 0
         positions = jnp.broadcast_to(jnp.arange(s)[None, :] + start, (b, s))
     x = params["embed"].astype(cfg.activation_dtype)[tokens]
     x = wsc(x, "batch", "seq", "act_embed")
+    aux_total = jnp.zeros((), jnp.float32)
 
     if cfg.pipeline_stages > 1 and cache is None:
         x = _pipeline_layers(x, params, cfg, positions, segment_ids)
@@ -276,31 +315,38 @@ def forward(
             def body(carry, xs):
                 h = carry
                 lp, ck, cv = xs
-                h, new_kv = _block(h, lp, cfg, positions, segment_ids, (ck, cv), cache.length)
-                return h, new_kv
+                h, new_kv, aux = _block(h, lp, cfg, positions, segment_ids, (ck, cv),
+                                        cache.length, token_mask)
+                return h, (new_kv, aux)
 
             fn = jax.checkpoint(body) if cfg.remat else body
-            x, (nk, nv) = jax.lax.scan(fn, x, (params["layers"], cache.k, cache.v))
+            x, ((nk, nv), auxs) = jax.lax.scan(fn, x, (params["layers"], cache.k, cache.v))
             new_cache = KVCache(k=nk, v=nv, length=cache.length + s)
+            aux_total = auxs.sum()
         else:
 
             def body(carry, lp):
-                h, _ = _block(carry, lp, cfg, positions, segment_ids)
-                return h, None
+                h, _, aux = _block(carry, lp, cfg, positions, segment_ids,
+                                   token_mask=token_mask)
+                return h, aux
 
             fn = jax.checkpoint(body) if cfg.remat else body
-            x, _ = jax.lax.scan(fn, x, params["layers"])
+            x, auxs = jax.lax.scan(fn, x, params["layers"])
             new_cache = None
+            aux_total = auxs.sum()
     else:
         new_cache = None
         ks, vs = [], []
         for i, lp in enumerate(params["layers"]):
             if cache is not None:
-                x, kv = _block(x, lp, cfg, positions, segment_ids, (cache.k[i], cache.v[i]), cache.length)
+                x, kv, aux = _block(x, lp, cfg, positions, segment_ids,
+                                    (cache.k[i], cache.v[i]), cache.length, token_mask)
                 ks.append(kv[0])
                 vs.append(kv[1])
             else:
-                x, _ = _block(x, lp, cfg, positions, segment_ids)
+                x, _, aux = _block(x, lp, cfg, positions, segment_ids,
+                                   token_mask=token_mask)
+            aux_total = aux_total + aux
         if cache is not None:
             new_cache = KVCache(jnp.stack(ks), jnp.stack(vs), cache.length + s)
 
@@ -308,6 +354,8 @@ def forward(
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.activation_dtype))
     logits = wsc(logits.astype(jnp.float32), "batch", "seq", "act_vocab")
+    if return_aux:
+        return logits, new_cache, aux_total
     return logits, new_cache
 
 
@@ -319,8 +367,9 @@ def loss_fn(
     """Next-token cross entropy. batch: tokens [B,S]; optional loss_mask/segment_ids."""
     tokens = batch["tokens"]
     seg = batch.get("segment_ids")
-    logits, _ = forward(
-        params, tokens[:, :-1], cfg, segment_ids=None if seg is None else seg[:, :-1]
+    logits, _, aux = forward(
+        params, tokens[:, :-1], cfg,
+        segment_ids=None if seg is None else seg[:, :-1], return_aux=True,
     )
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -328,5 +377,6 @@ def loss_fn(
     mask = batch.get("loss_mask")
     mask = jnp.ones_like(ll) if mask is None else mask[:, 1:].astype(ll.dtype)
     denom = jnp.maximum(mask.sum(), 1.0)
-    loss = -(ll * mask).sum() / denom
-    return loss, {"loss": loss, "tokens": denom}
+    ce = -(ll * mask).sum() / denom
+    loss = ce + aux
+    return loss, {"loss": loss, "ce_loss": ce, "moe_aux_loss": aux, "tokens": denom}

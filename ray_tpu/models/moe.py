@@ -1,0 +1,143 @@
+"""Mixture-of-experts MLP with static capacity-based dispatch, TPU-first.
+
+The reference has no MoE implementation (vLLM-internal only; SURVEY.md §2.3 row
+"Expert parallel (EP/MoE): absent — must be built natively"). This is the
+GShard/Switch dispatch pattern expressed as einsums over one-hot dispatch masks:
+every shape is static (tokens × experts × capacity), so XLA tiles the expert
+matmuls onto the MXU and GSPMD turns the "expert" axis sharding ("ep" mesh axis)
+into all-to-alls on ICI — no ragged host-side routing.
+
+Capacity semantics: tokens are processed in fixed-size groups (GShard-style, so
+dispatch memory stays linear in sequence length); within a group each expert
+takes at most C = ceil(capacity_factor · k · g / E) tokens. An overflow slot is
+dropped for that expert and its gate weight is simply lost — the token's MLP
+output is underweighted by that fraction (no renormalization over survivors).
+With top_k=1 the raw router probability gates the output (Switch), keeping the
+router differentiable through the task loss; with top_k>1 the top-k gate values
+renormalize to sum to 1 (Mixtral convention).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import with_sharding_constraint as wsc
+
+from .config import ModelConfig
+
+# Tokens per dispatch group: dispatch/combine tensors are [g, E, C] with C ∝ g/E,
+# so per-group memory is O(g²) and total is O(T·g) — bounded, unlike one [T, E, C]
+# block whose memory grows as O(T²).
+MOE_GROUP_SIZE = 4096
+
+
+def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
+    c = int(cfg.moe_capacity_factor * cfg.moe_top_k * n_tokens / cfg.n_experts) + 1
+    return max(4, min(c, n_tokens))
+
+
+def _group_size(t: int) -> int:
+    """Largest divisor of t that is <= MOE_GROUP_SIZE (t and groups stay static)."""
+    if t <= MOE_GROUP_SIZE:
+        return t
+    for g in range(MOE_GROUP_SIZE, 0, -1):
+        if t % g == 0:
+            return g
+    return t
+
+
+def _moe_group(x, mask, router_w, w_gate, w_up, w_down, cfg: ModelConfig):
+    """Dispatch one token group. x [g, D]; mask [g] 1.0=real token, 0.0=pad/inactive."""
+    g, d = x.shape
+    e, k = cfg.n_experts, cfg.moe_top_k
+    c = expert_capacity(cfg, g)
+    dt = x.dtype
+
+    logits = jnp.einsum("td,de->te", x, router_w.astype(dt)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [g, E]
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [g, k]
+    if k > 1:
+        gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    # k == 1: raw top-1 prob gates the output (Switch) so the router receives
+    # task-loss gradient; renormalizing would pin the gate to exactly 1.0.
+
+    # Position of each (token, slot) within its expert's capacity. Slot-major order
+    # (all top-1 picks get priority over top-2 picks, GShard convention). Masked
+    # tokens (padding, inactive decode slots) never claim capacity.
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32) * mask[:, None, None]
+    slot_major = onehot.transpose(1, 0, 2).reshape(k * g, e)  # [k*g, E]
+    pos_flat = jnp.cumsum(slot_major, axis=0) - slot_major  # rank among same-expert picks
+    pos = pos_flat.reshape(k, g, e).transpose(1, 0, 2)  # [g, k, E]
+    keep = (pos < c) * onehot  # drop overflow beyond capacity
+
+    # dispatch/combine tensors
+    pos_idx = jnp.minimum(pos.astype(jnp.int32), c - 1)
+    pos_onehot = jax.nn.one_hot(pos_idx, c, dtype=jnp.float32)  # [g, k, E, C]
+    dispatch = jnp.einsum("tke,tkec->tec", keep, pos_onehot)  # [g, E, C] 0/1
+    combine = jnp.einsum("tk,tke,tkec->tec", gate_vals, keep, pos_onehot)
+
+    # route tokens to expert buffers, run experts, route back
+    xin = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x)  # [E, C, D]
+    xin = wsc(xin, "act_expert", None, "act_embed")
+    gate = jnp.einsum("ecd,edf->ecf", xin, w_gate.astype(dt))
+    up = jnp.einsum("ecd,edf->ecf", xin, w_up.astype(dt))
+    act = wsc(jax.nn.silu(gate) * up, "act_expert", None, "act_mlp")
+    out = jnp.einsum("ecf,efd->ecd", act, w_down.astype(dt))  # [E, C, D]
+    y = jnp.einsum("tec,ecd->td", combine.astype(dt), out)  # [g, D]
+
+    # load-balancing loss (Switch eq. 4) over real tokens only: E * sum_e f_e * P_e
+    denom = jnp.maximum(mask.sum(), 1.0)
+    me = (probs * mask[:, None]).sum(axis=0) / denom
+    ce = (keep.sum(axis=1)).sum(axis=0) / denom
+    aux = (me * ce).sum() * e * cfg.moe_aux_loss_coef
+    return y, aux
+
+
+def moe_mlp(
+    x: jax.Array,  # [T, D] tokens
+    router_w: jax.Array,  # [D, E]
+    w_gate: jax.Array,  # [E, D, F]
+    w_up: jax.Array,  # [E, D, F]
+    w_down: jax.Array,  # [E, F, D]
+    cfg: ModelConfig,
+    mask: Optional[jax.Array] = None,  # [T] 1.0 = real token
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns ([T, D] output, scalar load-balancing aux loss)."""
+    t, d = x.shape
+    if mask is None:
+        mask = jnp.ones((t,), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    g = _group_size(t)
+    if g == t:
+        return _moe_group(x, mask, router_w, w_gate, w_up, w_down, cfg)
+    xg = x.reshape(t // g, g, d)
+    mg = mask.reshape(t // g, g)
+    yg, auxg = jax.vmap(
+        lambda xi, mi: _moe_group(xi, mi, router_w, w_gate, w_up, w_down, cfg)
+    )(xg, mg)
+    return yg.reshape(t, d), auxg.mean()
+
+
+def init_expert_weights(key: jax.Array, cfg: ModelConfig):
+    """Per-layer MoE parameter block (replaces the dense w_gate/w_up/w_down)."""
+    d, f, e = cfg.d_model, cfg.d_ff, cfg.n_experts
+    ks = jax.random.split(key, 4)
+    s_in = d**-0.5
+    s_out = (2 * cfg.n_layers * f) ** -0.5
+    return {
+        "router": jax.random.normal(ks[0], (d, e), jnp.float32) * s_in,
+        "w_gate": jax.random.normal(ks[1], (e, d, f), jnp.float32) * s_in,
+        "w_up": jax.random.normal(ks[2], (e, d, f), jnp.float32) * s_in,
+        "w_down": jax.random.normal(ks[3], (e, f, d), jnp.float32) * s_out,
+    }
+
+
+EXPERT_AXES = {
+    "router": ("embed", "expert"),
+    "w_gate": ("expert", "embed", "mlp"),
+    "w_up": ("expert", "embed", "mlp"),
+    "w_down": ("expert", "mlp", "embed"),
+}

@@ -54,6 +54,7 @@ TRAIN_RULES = AxisRules(
         "act_kv_heads": "tp",
         "act_mlp": "tp",
         "act_vocab": "tp",
+        "act_expert": "ep",
     }
 )
 
@@ -76,6 +77,7 @@ INFER_RULES = AxisRules(
         "act_kv_heads": "tp",
         "act_mlp": "tp",
         "act_vocab": "tp",
+        "act_expert": "ep",
     }
 )
 
