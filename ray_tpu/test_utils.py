@@ -1,0 +1,128 @@
+"""Chaos / fault-injection utilities for tests and resilience drills.
+
+Capability parity: reference ray._private.test_utils kill primitives —
+`RayletKiller`, `WorkerKillerActor`, `EC2InstanceTerminator(WithGracePeriod)`
+(imported by release/nightly_tests/setup_chaos.py:6-13) and the chaos suites in
+python/ray/tests/chaos/. These are product-adjacent tools: resilience tests and
+game-day drills script them directly.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+from ray_tpu.core import global_state
+
+
+def _cluster():
+    c = global_state.try_cluster()
+    if c is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return c
+
+
+class WorkerKiller:
+    """Kill worker processes (SIGKILL) — the reference WorkerKillerActor.
+
+    Targets busy workers first (that's where interesting recovery paths live).
+    """
+
+    def __init__(self, kill_interval_s: float = 1.0, max_kills: int = 5):
+        self.kill_interval_s = kill_interval_s
+        self.max_kills = max_kills
+        self.kills_done = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _pick(self):
+        c = _cluster()
+        with c._lock:
+            workers = [w for n in c._nodes.values() for w in n.workers.values()
+                       if w.state in ("busy", "blocked", "idle")]
+        busy = [w for w in workers if w.state in ("busy", "blocked")]
+        pool = busy or workers
+        return random.choice(pool) if pool else None
+
+    def kill_one(self) -> bool:
+        w = self._pick()
+        if w is None:
+            return False
+        try:
+            w.process.kill()
+            self.kills_done += 1
+            return True
+        except Exception:
+            return False
+
+    def run_policy(self) -> None:
+        """Background kill loop until max_kills (reference chaos setup)."""
+        def loop():
+            while not self._stop.wait(self.kill_interval_s):
+                if self.kills_done >= self.max_kills:
+                    return
+                self.kill_one()
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="worker-killer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class NodeKiller:
+    """Remove whole nodes (the reference RayletKiller / instance terminator).
+
+    Never touches the head node, matching the reference's choice to keep the GCS
+    alive during chaos runs.
+    """
+
+    def __init__(self):
+        self.killed: List[str] = []
+
+    def kill_node(self, node_id=None) -> Optional[str]:
+        c = _cluster()
+        candidates = [n for n in c.nodes() if n is not c.head_node]
+        if node_id is not None:
+            candidates = [n for n in candidates if n.node_id == node_id]
+        if not candidates:
+            return None
+        node = random.choice(candidates)
+        c.remove_node(node.node_id)
+        self.killed.append(node.node_id.hex())
+        return node.node_id.hex()
+
+
+def kill_worker_running(task_name: str) -> bool:
+    """Kill the worker currently executing a dispatched task with this name
+    (deterministic chaos: reference WorkerKillerActor targets by task)."""
+    c = _cluster()
+    with c._lock:
+        for ts in c.tasks.values():
+            if ts.spec.name == task_name and ts.worker is not None:
+                try:
+                    ts.worker.process.kill()
+                    return True
+                except Exception:
+                    return False
+    return False
+
+
+def wait_for_condition(predicate, timeout: float = 10.0, interval: float = 0.05,
+                       message: str = "condition not met") -> None:
+    """Reference ray._private.test_utils.wait_for_condition."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise TimeoutError(message)
+
+
+def get_actor_state(actor_handle) -> Optional[str]:
+    c = _cluster()
+    st = c.actors.get(actor_handle._actor_id)
+    return st.state if st is not None else None

@@ -1,0 +1,91 @@
+"""Chaos suite: workloads must survive injected worker/node kills.
+
+Reference analog: python/ray/tests/chaos/ + setup_chaos.py kill policies
+(SURVEY.md §4 fault-tolerance tests)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.test_utils import NodeKiller, WorkerKiller, wait_for_condition
+
+
+
+
+def test_retryable_tasks_survive_worker_kills(rt):
+    @rt.remote(max_retries=5)
+    def slow_add(a, b):
+        time.sleep(0.3)
+        return a + b
+
+    refs = [slow_add.remote(i, 1000) for i in range(12)]
+    killer = WorkerKiller(kill_interval_s=0.25, max_kills=3)
+    killer.run_policy()
+    try:
+        results = rt.get(refs, timeout=120)
+    finally:
+        killer.stop()
+    assert sorted(results) == [i + 1000 for i in range(12)]
+    assert killer.kills_done >= 1  # chaos actually happened
+
+
+def test_restartable_actor_survives_kills(rt):
+    @rt.remote(max_restarts=10, max_task_retries=10)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert rt.get(c.bump.remote()) == 1
+    killer = WorkerKiller(kill_interval_s=0.3, max_kills=2)
+    killer.run_policy()
+    ok = 0
+    try:
+        for _ in range(20):
+            try:
+                rt.get(c.bump.remote(), timeout=30)
+                ok += 1
+            except Exception:
+                pass  # a call may race the restart window
+            time.sleep(0.1)
+    finally:
+        killer.stop()
+    # actor must keep serving after restarts
+    assert ok >= 10
+    assert rt.get(c.bump.remote(), timeout=30) >= 1
+
+
+def test_node_kill_reschedules_tasks(rt):
+    cluster = __import__("ray_tpu.core.global_state", fromlist=["x"]).try_cluster()
+    extra = cluster.add_node({"CPU": 2.0})
+
+    @rt.remote(max_retries=3)
+    def work(i):
+        time.sleep(0.2)
+        return i * 2
+
+    refs = [work.remote(i) for i in range(10)]
+    time.sleep(0.3)
+    nk = NodeKiller()
+    killed = nk.kill_node(extra.node_id)
+    assert killed is not None
+    assert sorted(rt.get(refs, timeout=120)) == [i * 2 for i in range(10)]
+
+
+def test_unretryable_task_fails_cleanly(rt):
+    from ray_tpu.test_utils import kill_worker_running
+
+    @rt.remote(max_retries=0)
+    def stuck():
+        time.sleep(30)
+        return "nope"
+
+    ref = stuck.remote()
+    wait_for_condition(lambda: kill_worker_running("stuck"), timeout=10,
+                       message="never saw the stuck task running")
+    with pytest.raises(Exception):
+        rt.get(ref, timeout=60)
