@@ -16,6 +16,7 @@ from .api import (  # noqa: F401
     status,
 )
 from .batching import batch  # noqa: F401
+from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .config import AutoscalingConfig, DeploymentConfig  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
@@ -36,4 +37,6 @@ __all__ = [
     "AutoscalingConfig",
     "DeploymentConfig",
     "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
 ]

@@ -32,14 +32,41 @@ class DeploymentResponse:
 
 
 class _Router:
-    """Power-of-two-choices over locally tracked in-flight counts."""
+    """Power-of-two-choices over locally tracked in-flight counts, with
+    model-affinity for multiplexed requests (reference: multiplexed replica
+    ranking in request_router)."""
 
     def __init__(self):
         self.inflight: Dict[Any, int] = {}
+        self.model_map: Dict[str, set] = {}  # model_id -> replicas observed hosting it
         self.lock = threading.Lock()
 
-    def pick(self, replicas: List[Any]) -> Any:
+    # a model-holder this many requests deeper than an alternative loses affinity
+    SPILLOVER_THRESHOLD = 2
+
+    def pick(self, replicas: List[Any], model_id: Optional[str] = None) -> Any:
         with self.lock:
+            if model_id:
+                live = {r for r in self.model_map.get(model_id, ()) if r in replicas}
+                self.model_map[model_id] = live  # prune dead replicas
+                choice = None
+                if live:
+                    choice = min(live, key=lambda r: self.inflight.get(r, 0))
+                    others = [r for r in replicas if r not in live]
+                    if others:
+                        # reference behavior: affinity ranks first but overload
+                        # spills to a non-holder (which then loads the model)
+                        alt = min(random.sample(others, min(2, len(others))),
+                                  key=lambda r: self.inflight.get(r, 0))
+                        if (self.inflight.get(choice, 0)
+                                > self.inflight.get(alt, 0) + self.SPILLOVER_THRESHOLD):
+                            choice = alt
+                if choice is None:
+                    choice = (replicas[0] if len(replicas) == 1
+                              else min(random.sample(replicas, 2),
+                                       key=lambda r: self.inflight.get(r, 0)))
+                self.model_map[model_id].add(choice)
+                return choice
             if len(replicas) == 1:
                 return replicas[0]
             a, b = random.sample(replicas, 2)
@@ -59,10 +86,12 @@ class _Router:
 
 
 class DeploymentHandle:
-    def __init__(self, app_name: str, deployment_name: str, method_name: str = "__call__"):
+    def __init__(self, app_name: str, deployment_name: str, method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._method = method_name
+        self._multiplexed_model_id = multiplexed_model_id
         self._router = _Router()
         self._replicas: List[Any] = []
         self._last_refresh = 0.0
@@ -102,9 +131,14 @@ class DeploymentHandle:
         self._metrics_thread.start()
 
     # -- public ----------------------------------------------------------------
-    def options(self, method_name: Optional[str] = None, **_compat) -> "DeploymentHandle":
-        h = DeploymentHandle(self.app_name, self.deployment_name, method_name or self._method)
-        h._router = self._router  # share in-flight view across method handles
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None, **_compat) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.app_name, self.deployment_name, method_name or self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._multiplexed_model_id,
+        )
+        h._router = self._router  # share in-flight + model-affinity view
         h._replicas = self._replicas
         h._last_refresh = self._last_refresh
         return h
@@ -127,8 +161,12 @@ class DeploymentHandle:
                 )
             time.sleep(0.1)
             self._last_refresh = 0.0  # force re-poll
-        replica = self._router.pick(self._replicas)
+        replica = self._router.pick(self._replicas, self._multiplexed_model_id or None)
         self._router.on_send(replica)
+        if self._multiplexed_model_id:
+            from .multiplex import MULTIPLEX_KWARG
+
+            kwargs = {**kwargs, MULTIPLEX_KWARG: self._multiplexed_model_id}
         try:
             ref = replica.handle_request.remote(self._method, args, kwargs)
         except Exception:

@@ -1,0 +1,106 @@
+"""Model multiplexing: many models share a replica pool with LRU residency.
+
+Capability parity: reference python/ray/serve/multiplex.py (@serve.multiplexed
++ serve.get_multiplexed_model_id) — a replica lazily loads models through the
+decorated loader, keeps at most max_num_models_per_replica resident (LRU
+eviction), and handles route model-affine: a request for model M prefers a
+replica that already holds M (reference: router's multiplexed replica ranking).
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+MULTIPLEX_KWARG = "__serve_multiplexed_model_id"
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request: the model id this request was routed for."""
+    return _current_model_id.get()
+
+
+def _set_multiplexed_model_id(model_id: str):
+    return _current_model_id.set(model_id)
+
+
+class _MultiplexWrapper:
+    """Per-replica LRU of loaded models around the user's loader function."""
+
+    def __init__(self, loader: Callable, max_num_models: int, owner=None):
+        self._loader = loader
+        self._owner = owner  # instance for bound-method loaders
+        self.max_num_models = max_num_models
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        import weakref
+
+        self._bound_map = weakref.WeakKeyDictionary()  # instance -> bound wrapper
+
+    def __get__(self, obj, objtype=None):
+        # method decorator support: one bound wrapper (and LRU) per instance
+        if obj is None:
+            return self
+        try:
+            bound = self._bound_map.get(obj)
+            if bound is None:
+                bound = _MultiplexWrapper(self._loader, self.max_num_models, owner=obj)
+                self._bound_map[obj] = bound
+            return bound
+        except TypeError:  # non-weakref-able instance: uncached bind
+            return _MultiplexWrapper(self._loader, self.max_num_models, owner=obj)
+
+    def __call__(self, model_id: Optional[str] = None) -> Any:
+        if model_id is None:
+            model_id = get_multiplexed_model_id()
+        if not model_id:
+            raise ValueError(
+                "no multiplexed model id: pass one explicitly or set "
+                "handle.options(multiplexed_model_id=...) on the caller")
+        with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+        # load outside the lock (loads can be slow); racing loads of the same id
+        # resolve by last-writer-wins, matching the reference's per-id lock window
+        args = (self._owner, model_id) if self._owner is not None else (model_id,)
+        model = self._loader(*args)
+        with self._lock:
+            self._models[model_id] = model
+            self._models.move_to_end(model_id)
+            while len(self._models) > self.max_num_models:
+                evicted_id, evicted = self._models.popitem(last=False)
+                del_fn = getattr(evicted, "__del__", None)
+                if callable(del_fn):
+                    try:
+                        del_fn()
+                    except Exception:
+                        pass
+        return model
+
+    def loaded_model_ids(self):
+        with self._lock:
+            return list(self._models)
+
+    # cloudpickle support: the LRU and lock are per-process state, only the loader
+    # and the capacity travel with the deployment class
+    def __getstate__(self):
+        return {"loader": self._loader, "max_num_models": self.max_num_models}
+
+    def __setstate__(self, state):
+        self.__init__(state["loader"], state["max_num_models"])
+
+
+def multiplexed(func: Optional[Callable] = None, *, max_num_models_per_replica: int = 3):
+    """Decorator for a model loader fn/method (reference @serve.multiplexed)."""
+
+    def wrap(f):
+        return _MultiplexWrapper(f, max_num_models_per_replica)
+
+    if func is not None:
+        return wrap(func)
+    return wrap

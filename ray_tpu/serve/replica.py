@@ -42,6 +42,12 @@ class Replica:
     # -- request path ----------------------------------------------------------
     def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
         self._num_served += 1
+        from .multiplex import MULTIPLEX_KWARG, _set_multiplexed_model_id
+
+        model_id = kwargs.pop(MULTIPLEX_KWARG, None)
+        # always (re)set: a request without a model id must not inherit the previous
+        # request's id from this thread's context
+        _set_multiplexed_model_id(model_id or "")
         if method_name == "__http__":
             # Proxy path: full request dict {path, method, query, body}. Ingress classes
             # that define handle_http get it verbatim; plain callables get just the body

@@ -1,0 +1,89 @@
+"""Model multiplexing tests (reference python/ray/serve/multiplex.py +
+tests/test_multiplex.py; SURVEY.md §2.6 batching/multiplex row)."""
+import pytest
+
+from ray_tpu import serve
+from ray_tpu.serve.multiplex import _MultiplexWrapper
+
+
+def test_lru_eviction_unit():
+    loads = []
+
+    @serve.multiplexed(max_num_models_per_replica=2)
+    def get_model(model_id):
+        loads.append(model_id)
+        return f"model-{model_id}"
+
+    assert get_model("a") == "model-a"
+    assert get_model("b") == "model-b"
+    assert get_model("a") == "model-a"  # cache hit, no reload
+    assert loads == ["a", "b"]
+    get_model("c")  # evicts b (LRU)
+    assert sorted(get_model.loaded_model_ids()) == ["a", "c"]
+    get_model("b")  # reload after eviction
+    assert loads == ["a", "b", "c", "b"]
+
+
+def test_method_decorator_binds_per_instance():
+    class Host:
+        def __init__(self, tag):
+            self.tag = tag
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return f"{self.tag}:{model_id}"
+
+    h1, h2 = Host("x"), Host("y")
+    assert h1.get_model("m") == "x:m"
+    assert h2.get_model("m") == "y:m"
+    # per-instance LRUs persist across alternating access (no thrash)
+    assert h1.get_model.loaded_model_ids() == ["m"]
+    assert h2.get_model.loaded_model_ids() == ["m"]
+    assert h1.get_model("m2") == "x:m2"
+    assert sorted(h1.get_model.loaded_model_ids()) == ["m", "m2"]
+    assert h2.get_model.loaded_model_ids() == ["m"]
+
+
+def test_multiplexed_serving_end_to_end(rt):
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class MultiModel:
+        def __init__(self):
+            self.loads = 0
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loads += 1
+            return f"weights-of-{model_id}"
+
+        def __call__(self, body):
+            model_id = serve.get_multiplexed_model_id()
+            model = self.get_model(model_id)
+            return {"model": model_id, "weights": model, "loads": self.loads}
+
+    serve.run(MultiModel.bind(), name="mux-app", route_prefix="/mux")
+    try:
+        h = serve.get_app_handle("mux-app")
+        out = h.options(multiplexed_model_id="m1").remote({}).result()
+        assert out == {"model": "m1", "weights": "weights-of-m1", "loads": 1}
+        # repeated m1 requests ride the same replica's cache: loads stays 1
+        for _ in range(5):
+            out = h.options(multiplexed_model_id="m1").remote({}).result()
+        assert out["loads"] == 1
+        out2 = h.options(multiplexed_model_id="m2").remote({}).result()
+        assert out2["weights"] == "weights-of-m2"
+        # a request WITHOUT a model id must not inherit the previous one
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="no multiplexed model id"):
+            h.remote({}).result()
+    finally:
+        serve.delete("mux-app")
+
+
+def test_missing_model_id_is_an_error():
+    @serve.multiplexed
+    def get_model(model_id):
+        return model_id
+
+    with pytest.raises(ValueError, match="no multiplexed model id"):
+        get_model()
