@@ -864,7 +864,7 @@ class DriverContext:
         for r in ref_list:
             t = None if deadline is None else max(0.0, deadline - time.monotonic())
             loc = self.cluster.store.location(r.id, t)
-            values.append(object_store.resolve(loc))
+            values.append(object_store.resolve(loc, oid=r.id))
         return values[0] if single else values
 
     def put(self, value) -> ObjectRef:

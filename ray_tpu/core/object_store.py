@@ -101,6 +101,12 @@ class ObjectLost(Exception):
 
 def materialize(obj: Any, oid: ObjectID, is_error: bool = False) -> Location:
     """Serialize obj and place it: small -> inline, large -> arena, overflow -> segment."""
+    from ray_tpu.experimental import device_objects
+
+    if not is_error and device_objects.is_device_array(obj):
+        # same-process resolves return the original device array (no host copy);
+        # the serialized host copy below stays the durable/cross-process form
+        device_objects.stash(oid.binary(), obj)
     ser = serialization.serialize(obj)
     size = ser.frame_bytes
     if size < INLINE_THRESHOLD:
@@ -156,8 +162,17 @@ class _SegmentCache:
 _segment_cache = _SegmentCache()
 
 
-def resolve(loc: Location) -> Any:
-    """Reconstruct the Python value at a location. Raises if it is an error object."""
+def resolve(loc: Location, oid: Optional[ObjectID] = None) -> Any:
+    """Reconstruct the Python value at a location. Raises if it is an error object.
+
+    When oid is given, a device-resident original in this process (jax.Array fast
+    path, experimental/device_objects.py) is returned without deserializing."""
+    if oid is not None:
+        from ray_tpu.experimental import device_objects
+
+        hit = device_objects.lookup(oid.binary())
+        if hit is not None:
+            return hit
     kind = loc[0]
     if kind == "inline":
         _, frame, is_error = loc
@@ -287,6 +302,9 @@ class ObjectStore:
             self._free(oid)
 
     def _free(self, oid: ObjectID) -> None:
+        from ray_tpu.experimental import device_objects
+
+        device_objects.drop(oid.binary())
         with self._lock:
             loc = self._locations.pop(oid, None)
             self._failed.pop(oid, None)

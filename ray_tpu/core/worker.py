@@ -141,7 +141,7 @@ class WorkerContext:
         ref_list = [refs] if single else list(refs)
         oids = [r.id for r in ref_list]
         locs = self._request("get", oids, timeout)
-        values = [object_store.resolve(loc) for loc in locs]
+        values = [object_store.resolve(loc, oid=o) for o, loc in zip(oids, locs)]
         return values[0] if single else values
 
     def put(self, value) -> ObjectRef:
@@ -235,7 +235,8 @@ class WorkerContext:
 
     def _resolve_args(self, spec: TaskSpec, resolved_locs: List) -> Tuple[list, dict]:
         args, kwargs = cloudpickle.loads(spec.args_meta)
-        values = [object_store.resolve(loc) for loc in resolved_locs]
+        values = [object_store.resolve(loc, oid=o)
+                  for o, loc in zip(spec.arg_refs, resolved_locs)]
 
         def sub(x):
             return values[x.index] if isinstance(x, _RefMarker) else x

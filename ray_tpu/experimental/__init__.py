@@ -1,0 +1,1 @@
+"""Experimental features (reference python/ray/experimental/)."""

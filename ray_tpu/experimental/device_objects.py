@@ -1,0 +1,58 @@
+"""Device-resident object fast path.
+
+Capability parity: reference python/ray/experimental/gpu_object_manager/
+(GPUObjectManager gpu_object_manager.py:54 — tensors stay on device, refs travel
+through plasma, NCCL transfer on demand). TPU shape of the idea: a jax.Array put
+into the object store keeps its device buffers alive in the producing process
+(weak registry), so a same-process resolve returns the ORIGINAL array — zero
+copies, zero device↔host traffic. Cross-process consumers fall back to the
+serialized host copy (device_put on deserialize); cross-host transfer rides DCN
+the same way. Weak references mean the fast path never extends object lifetime:
+if the producer drops the array, consumers transparently use the durable copy.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Optional
+
+_registry: "weakref.WeakValueDictionary[bytes, Any]" = weakref.WeakValueDictionary()
+
+
+def is_device_array(obj: Any) -> bool:
+    """True for jax.Array values (checked without importing jax eagerly)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        return isinstance(obj, jax.Array)
+    except Exception:
+        return False
+
+
+def stash(oid_bytes: bytes, obj: Any) -> None:
+    try:
+        _registry[oid_bytes] = obj
+    except TypeError:
+        pass  # not weakref-able
+
+
+def lookup(oid_bytes: Optional[bytes]) -> Optional[Any]:
+    if oid_bytes is None:
+        return None
+    hit = _registry.get(oid_bytes)
+    if hit is None:
+        return None
+    # a donated/deleted array (jit donate_argnums) keeps its Python shell alive;
+    # fall back to the durable serialized copy instead of handing out dead buffers
+    try:
+        if hit.is_deleted():
+            return None
+    except Exception:
+        pass
+    return hit
+
+
+def drop(oid_bytes: bytes) -> None:
+    _registry.pop(oid_bytes, None)
