@@ -8,7 +8,7 @@ router (``build_openai_app``), and a Ray-Data batch-inference ``Processor``.
 """
 from .config import LLMConfig, SamplingParams
 from .engine import JaxLLMEngine, LLMEngine, RequestOutput
-from .server import LLMServer, build_openai_app
+from .server import LLMServer, PDRouter, build_openai_app, build_pd_openai_app
 from .batch import Processor, build_llm_processor
 
 __all__ = [
@@ -18,7 +18,9 @@ __all__ = [
     "JaxLLMEngine",
     "RequestOutput",
     "LLMServer",
+    "PDRouter",
     "build_openai_app",
+    "build_pd_openai_app",
     "Processor",
     "build_llm_processor",
 ]

@@ -56,13 +56,15 @@ class LLMEngine(abc.ABC):
 
 
 class _Request:
-    def __init__(self, req_id: str, prompt_ids: List[int], params: SamplingParams):
+    def __init__(self, req_id: str, prompt_ids: List[int], params: SamplingParams,
+                 prefill_kv=None):
         self.id = req_id
         self.prompt_ids = prompt_ids
         self.params = params
         self.out_queue: "queue.Queue[RequestOutput]" = queue.Queue()
         self.generated = 0
         self.slot = -1
+        self.prefill_kv = prefill_kv  # (k, v, first_token): P/D-disagg transfer-in
         self.pending_text: List[int] = []  # undecoded ids (byte tokenizer is stateless)
 
 
@@ -80,8 +82,11 @@ class JaxLLMEngine(LLMEngine):
         self._waiting: "queue.Queue[_Request]" = queue.Queue()
         self._active: Dict[int, Optional[_Request]] = {}
         self._lock = threading.Lock()
+        self._start_lock = threading.Lock()
+        self._rng_lock = threading.Lock()
         self._loop_thread: Optional[threading.Thread] = None
         self._wakeup = threading.Event()
+        self.state = None  # decode KV state, allocated on first decode admission
         # metrics (scraped by LLMServer / autoscaling)
         self.num_pending = 0
         self.num_active = 0
@@ -89,43 +94,75 @@ class JaxLLMEngine(LLMEngine):
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> None:
-        if self._started:
-            return
-        cfg = self.model_config
-        c = self.config
-        if self._mesh is None:
-            # dp*ep*tp devices out of the local set (an engine may intentionally use
-            # a subset, e.g. one replica per chip on a multi-chip host).
-            from jax.sharding import Mesh
+        """Load + shard params (thread-safe, idempotent). The decode KV state and
+        scheduler loop are allocated lazily on first decode use, so a dedicated
+        prefill replica (P/D disaggregation) never pays for them."""
+        with self._start_lock:
+            if self._started:
+                return
+            cfg = self.model_config
+            c = self.config
+            if self._mesh is None:
+                # dp*ep*tp devices out of the local set (an engine may intentionally
+                # use a subset, e.g. one replica per chip on a multi-chip host).
+                from jax.sharding import Mesh
 
-            n = c.data_parallel_size * c.expert_parallel_size * c.tensor_parallel_size
-            devs = jax.devices()
-            if len(devs) < n:
-                raise ValueError(f"need {n} devices for dp×ep×tp, have {len(devs)}")
-            self._mesh = Mesh(
-                np.asarray(devs[:n]).reshape(
-                    c.data_parallel_size, c.expert_parallel_size, c.tensor_parallel_size
-                ),
-                ("dp", "ep", "tp"),
-            )
-        if c.max_num_seqs % c.data_parallel_size:
-            raise ValueError("max_num_seqs must be divisible by data_parallel_size")
-        if self._params_in is None:
-            self._params_in = llama_init_cached(cfg)
-        self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
-        self._params_in = None
-        self.state = model_runner.init_state(cfg, c.max_num_seqs, c.max_model_len, self._mesh)
-        self._active = {s: None for s in range(c.max_num_seqs)}
-        self._rng = jax.random.PRNGKey(0)
-        # host mirrors of per-slot sampling params
-        n = c.max_num_seqs
-        self._temp = np.zeros((n,), np.float32)
-        self._top_p = np.ones((n,), np.float32)
-        self._top_k = np.zeros((n,), np.int32)
-        self._last_tokens = np.zeros((n,), np.int32)
-        self._started = True
-        self._loop_thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
-        self._loop_thread.start()
+                n = c.data_parallel_size * c.expert_parallel_size * c.tensor_parallel_size
+                devs = jax.devices()
+                if len(devs) < n:
+                    raise ValueError(f"need {n} devices for dp×ep×tp, have {len(devs)}")
+                self._mesh = Mesh(
+                    np.asarray(devs[:n]).reshape(
+                        c.data_parallel_size, c.expert_parallel_size,
+                        c.tensor_parallel_size
+                    ),
+                    ("dp", "ep", "tp"),
+                )
+            if c.max_num_seqs % c.data_parallel_size:
+                raise ValueError("max_num_seqs must be divisible by data_parallel_size")
+            if self._params_in is None:
+                self._params_in = llama_init_cached(cfg)
+            self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
+            self._params_in = None
+            self._active = {s: None for s in range(c.max_num_seqs)}
+            self._rng = jax.random.PRNGKey(0)
+            # host mirrors of per-slot sampling params
+            n = c.max_num_seqs
+            self._temp = np.zeros((n,), np.float32)
+            self._top_p = np.ones((n,), np.float32)
+            self._top_k = np.zeros((n,), np.int32)
+            self._last_tokens = np.zeros((n,), np.int32)
+            self._started = True
+
+    def _ensure_decode_started(self) -> None:
+        """Allocate the decode KV state + scheduler loop on first decode use."""
+        with self._start_lock:
+            if self._loop_thread is not None:
+                return
+            if self.state is None:
+                self.state = model_runner.init_state(
+                    self.model_config, self.config.max_num_seqs,
+                    self.config.max_model_len, self._mesh)
+            self._loop_thread = threading.Thread(target=self._loop, daemon=True,
+                                                 name="llm-engine")
+            self._loop_thread.start()
+
+    def _next_rng(self):
+        with self._rng_lock:
+            self._rng, sub = jax.random.split(self._rng)
+            return sub
+
+    def _encode_prompt(self, prompt, params: SamplingParams) -> List[int]:
+        """Tokenize + truncate so the generation fits max_model_len."""
+        ids = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        limit = max(1, self.config.max_model_len - params.max_tokens)
+        return ids[-limit:] if len(ids) > limit else ids
+
+    def _pad_to_bucket(self, prompt_ids: List[int]):
+        s_pad = next(b for b in self.config.buckets() if b >= len(prompt_ids))
+        tokens = np.zeros((1, s_pad), np.int32)
+        tokens[0, : len(prompt_ids)] = prompt_ids
+        return tokens
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -136,21 +173,61 @@ class JaxLLMEngine(LLMEngine):
     # -- API ---------------------------------------------------------------------
     def generate(self, prompt, params: SamplingParams, request_id: Optional[str] = None
                  ) -> Iterator[RequestOutput]:
-        if not self._started:
-            self.start()
-        if isinstance(prompt, str):
-            prompt_ids = self.tokenizer.encode(prompt)
-        else:
-            prompt_ids = list(prompt)
-        limit = self.config.max_model_len - params.max_tokens
-        if len(prompt_ids) > limit:
-            prompt_ids = prompt_ids[-limit:]
+        self.start()
+        self._ensure_decode_started()
+        prompt_ids = self._encode_prompt(prompt, params)
         req = _Request(request_id or uuid.uuid4().hex, prompt_ids, params)
         with self._lock:
             self.num_pending += 1
         self._waiting.put(req)
         self._wakeup.set()
 
+        while True:
+            out = req.out_queue.get()
+            yield out
+            if out.finished:
+                return
+
+    # -- P/D disaggregation (reference: prefill_decode_disagg deployments) ---------
+    def prefill_only(self, prompt, params: SamplingParams) -> Dict[str, Any]:
+        """Run prefill and return transferable KV + the sampled first token.
+        Used by prefill replicas; the result feeds generate_from_prefill on a
+        decode replica (host arrays: the cross-replica hop is host/DCN). Does NOT
+        allocate the decode state — prefill replicas stay KV-cache-free."""
+        self.start()
+        prompt_ids = self._encode_prompt(prompt, params)
+        tokens = self._pad_to_bucket(prompt_ids)
+        k, v, last_logits = model_runner.prefill_detached(
+            self.params, jnp.asarray(tokens), jnp.int32(len(prompt_ids)),
+            self.model_config,
+        )
+        tok = int(model_runner.sample_tokens(
+            self._next_rng(), last_logits[None, :],
+            jnp.asarray([params.temperature], jnp.float32),
+            jnp.asarray([params.top_p], jnp.float32),
+            jnp.asarray([params.top_k], jnp.int32),
+        )[0])
+        return {
+            "k": np.asarray(k), "v": np.asarray(v),
+            "prompt_ids": prompt_ids, "first_token": tok,
+        }
+
+    def generate_from_prefill(self, prefill_result: Dict[str, Any],
+                              params: SamplingParams,
+                              request_id: Optional[str] = None
+                              ) -> Iterator[RequestOutput]:
+        """Continue decoding from a transferred prefill (decode replica side)."""
+        self.start()
+        self._ensure_decode_started()
+        req = _Request(
+            request_id or uuid.uuid4().hex, list(prefill_result["prompt_ids"]), params,
+            prefill_kv=(prefill_result["k"], prefill_result["v"],
+                        int(prefill_result["first_token"])),
+        )
+        with self._lock:
+            self.num_pending += 1
+        self._waiting.put(req)
+        self._wakeup.set()
         while True:
             out = req.out_queue.get()
             yield out
@@ -192,21 +269,28 @@ class JaxLLMEngine(LLMEngine):
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 return
-            s_pad = next(b for b in c.buckets() if b >= len(req.prompt_ids))
-            tokens = np.zeros((1, s_pad), np.int32)
-            tokens[0, : len(req.prompt_ids)] = req.prompt_ids
-            self.state, last_logits = model_runner.prefill(
-                self.params, self.state, jnp.asarray(tokens),
-                jnp.int32(len(req.prompt_ids)), jnp.int32(slot), cfg,
-            )
-            self._rng, sub = jax.random.split(self._rng)
             p = req.params
-            tok = int(model_runner.sample_tokens(
-                sub, last_logits[None, :],
-                jnp.asarray([p.temperature], jnp.float32),
-                jnp.asarray([p.top_p], jnp.float32),
-                jnp.asarray([p.top_k], jnp.int32),
-            )[0])
+            if req.prefill_kv is not None:
+                # P/D disaggregation: KV computed by a prefill replica; install it
+                # and emit the first token the prefill side already sampled.
+                k, v, tok = req.prefill_kv
+                req.prefill_kv = None
+                self.state = model_runner.install_kv(
+                    self.state, jnp.asarray(k), jnp.asarray(v),
+                    jnp.int32(len(req.prompt_ids)), jnp.int32(slot),
+                )
+            else:
+                tokens = self._pad_to_bucket(req.prompt_ids)
+                self.state, last_logits = model_runner.prefill(
+                    self.params, self.state, jnp.asarray(tokens),
+                    jnp.int32(len(req.prompt_ids)), jnp.int32(slot), cfg,
+                )
+                tok = int(model_runner.sample_tokens(
+                    self._next_rng(), last_logits[None, :],
+                    jnp.asarray([p.temperature], jnp.float32),
+                    jnp.asarray([p.top_p], jnp.float32),
+                    jnp.asarray([p.top_k], jnp.int32),
+                )[0])
             req.slot = slot
             self._active[slot] = req
             self._temp[slot], self._top_p[slot], self._top_k[slot] = (
@@ -251,9 +335,8 @@ class JaxLLMEngine(LLMEngine):
             self.params, self.state, jnp.asarray(self._last_tokens),
             jnp.asarray(active_mask), cfg,
         )
-        self._rng, sub = jax.random.split(self._rng)
         toks = np.asarray(model_runner.sample_tokens(
-            sub, logits, jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            self._next_rng(), logits, jnp.asarray(self._temp), jnp.asarray(self._top_p),
             jnp.asarray(self._top_k)))
         lengths = np.asarray(self.state.lengths)
         for slot, req in list(self._active.items()):

@@ -83,6 +83,42 @@ def prefill(
     return DecodeState(k=k, v=v, lengths=lengths), last
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill_detached(
+    params,
+    tokens: jax.Array,  # [1, S_pad]
+    true_len: jax.Array,  # scalar int32
+    cfg: ModelConfig,
+):
+    """Prefill WITHOUT installing into a decode state: returns (k, v, last_logits)
+    with k/v [L, 1, S_pad, KV, HD]. The P/D-disaggregated serving path runs this on
+    a prefill replica; the KV then travels (host/DCN) to a decode replica which
+    installs it via install_kv (reference: prefill_decode_disagg deployments)."""
+    s_pad = tokens.shape[1]
+    tmp = llama.init_kv_cache(cfg, batch=1, max_len=s_pad, dtype=cfg.activation_dtype)
+    token_mask = (jnp.arange(s_pad)[None, :] < true_len).astype(jnp.float32)
+    logits, tmp, _ = llama.forward(params, tokens, cfg, cache=tmp,
+                                   token_mask=token_mask, return_aux=True)
+    last = logits[0, true_len - 1].astype(jnp.float32)
+    return tmp.k, tmp.v, last
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def install_kv(
+    state: DecodeState,
+    k: jax.Array,  # [L, 1, S_pad, KV, HD]
+    v: jax.Array,
+    true_len: jax.Array,  # scalar int32
+    slot: jax.Array,  # scalar int32
+) -> DecodeState:
+    """Install transferred prefill KV into a decode slot."""
+    start = (0, slot, 0, 0, 0)
+    nk = jax.lax.dynamic_update_slice(state.k, k.astype(state.k.dtype), start)
+    nv = jax.lax.dynamic_update_slice(state.v, v.astype(state.v.dtype), start)
+    lengths = state.lengths.at[slot].set(true_len)
+    return DecodeState(k=nk, v=nv, lengths=lengths)
+
+
 # -------------------------------------------------------------------------- decode
 
 def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):

@@ -32,6 +32,48 @@ def render_chat_template(messages: List[Dict[str, str]]) -> str:
     return "\n".join(parts) + "\nassistant:"
 
 
+def _usage(prompt_tokens: int, completion_tokens: int) -> Dict[str, int]:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def _chat_envelope(model: str, text: str, finish_reason, usage) -> Dict[str, Any]:
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish_reason,
+        }],
+        "usage": usage,
+    }
+
+
+def _completion_envelope(model: str, text: str, finish_reason, usage) -> Dict[str, Any]:
+    return {
+        "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+        "usage": usage,
+    }
+
+
+def _models_list(model_ids) -> Dict[str, Any]:
+    return {
+        "object": "list",
+        "data": [{"id": m, "object": "model", "owned_by": "ray_tpu"}
+                 for m in sorted(model_ids)],
+    }
+
+
 class LLMServer:
     """Serve deployment hosting one model's engine.
 
@@ -48,36 +90,34 @@ class LLMServer:
     def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
         prompt = render_chat_template(body.get("messages", []))
         out = self.engine.generate_sync(prompt, _sampling_from_body(body))
-        return {
-            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
-            "object": "chat.completion",
-            "created": int(time.time()),
-            "model": body.get("model", self.llm_config.model_id),
-            "choices": [{
-                "index": 0,
-                "message": {"role": "assistant", "content": out.text},
-                "finish_reason": out.finish_reason,
-            }],
-            "usage": {
-                "prompt_tokens": out.num_prompt_tokens,
-                "completion_tokens": out.num_generated_tokens,
-                "total_tokens": out.num_prompt_tokens + out.num_generated_tokens,
-            },
-        }
+        return _chat_envelope(
+            body.get("model", self.llm_config.model_id), out.text, out.finish_reason,
+            _usage(out.num_prompt_tokens, out.num_generated_tokens))
 
     def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         out = self.engine.generate_sync(body.get("prompt", ""), _sampling_from_body(body))
+        return _completion_envelope(
+            body.get("model", self.llm_config.model_id), out.text, out.finish_reason,
+            _usage(out.num_prompt_tokens, out.num_generated_tokens))
+
+    # -- P/D disaggregation endpoints (reference prefill_decode_disagg/) ---------
+    def prefill(self, prompt: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.engine.prefill_only(prompt, _sampling_from_body(body))
+
+    def decode_from_prefill(self, prefill_result: Dict[str, Any],
+                            body: Dict[str, Any]) -> Dict[str, Any]:
+        params = _sampling_from_body(body)
+        ids: List[int] = []
+        last = None
+        for chunk in self.engine.generate_from_prefill(prefill_result, params):
+            ids.extend(chunk.token_ids)
+            last = chunk
         return {
-            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
-            "object": "text_completion",
-            "created": int(time.time()),
-            "model": body.get("model", self.llm_config.model_id),
-            "choices": [{"index": 0, "text": out.text, "finish_reason": out.finish_reason}],
-            "usage": {
-                "prompt_tokens": out.num_prompt_tokens,
-                "completion_tokens": out.num_generated_tokens,
-                "total_tokens": out.num_prompt_tokens + out.num_generated_tokens,
-            },
+            "text": self.engine.tokenizer.decode(ids),
+            "token_ids": ids,
+            "finish_reason": last.finish_reason,
+            "num_prompt_tokens": len(prefill_result["prompt_ids"]),
+            "num_generated_tokens": len(ids),
         }
 
     def model_id(self) -> str:
@@ -111,13 +151,7 @@ class OpenAIRouter:
     def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
         path, body = request["path"], request.get("body") or {}
         if path.endswith("/models"):
-            return {
-                "object": "list",
-                "data": [
-                    {"id": m, "object": "model", "owned_by": "ray_tpu"}
-                    for m in sorted(self.handles)
-                ],
-            }
+            return _models_list(self.handles)
         model = body.get("model") if isinstance(body, dict) else None
         handle = self._pick(model)
         if path.endswith("/chat/completions"):
@@ -132,6 +166,61 @@ class OpenAIRouter:
 
     def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         return self.handle_http({"path": "/v1/completions", "method": "POST", "body": body})
+
+
+class PDRouter:
+    """Prefill/decode-disaggregated ingress: prompts prefill on one replica pool,
+    the KV crosses to a decode pool that streams the completion (reference
+    python/ray/llm/_internal/serve/deployments/prefill_decode_disagg/). On TPU the
+    hop is a host-array transfer through the object store (DCN across hosts)."""
+
+    def __init__(self, prefill_handle, decode_handle, model_id: str):
+        self.prefill_handle = prefill_handle
+        self.decode_handle = decode_handle
+        self.model_id = model_id
+
+    def _run(self, prompt: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        pre = self.prefill_handle.options(method_name="prefill").remote(
+            prompt, body).result()
+        return self.decode_handle.options(method_name="decode_from_prefill").remote(
+            pre, body).result()
+
+    def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        out = self._run(render_chat_template(body.get("messages", [])), body)
+        return _chat_envelope(
+            body.get("model", self.model_id), out["text"], out["finish_reason"],
+            _usage(out["num_prompt_tokens"], out["num_generated_tokens"]))
+
+    def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        out = self._run(body.get("prompt", ""), body)
+        return _completion_envelope(
+            body.get("model", self.model_id), out["text"], out["finish_reason"],
+            _usage(out["num_prompt_tokens"], out["num_generated_tokens"]))
+
+    def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        path, body = request["path"], request.get("body") or {}
+        if path.endswith("/models"):
+            return _models_list([self.model_id])
+        if path.endswith("/chat/completions"):
+            return self.chat(body)
+        if path.endswith("/completions"):
+            return self.completions(body)
+        raise ValueError(f"unsupported path {path!r}")
+
+
+def build_pd_openai_app(llm_config: LLMConfig, *, num_prefill: int = 1,
+                        num_decode: int = 1, name_prefix: str = "llm-pd"):
+    """Prefill/decode-disaggregated serving app (reference build: P/D deployments)."""
+    from ray_tpu import serve
+
+    prefill = serve.deployment(LLMServer).options(
+        name=f"{name_prefix}:prefill", num_replicas=num_prefill,
+        max_ongoing_requests=32).bind(llm_config)
+    decode = serve.deployment(LLMServer).options(
+        name=f"{name_prefix}:decode", num_replicas=num_decode,
+        max_ongoing_requests=64).bind(llm_config)
+    router = serve.deployment(PDRouter).options(name=f"{name_prefix}-router")
+    return router.bind(prefill, decode, llm_config.model_id)
 
 
 def build_openai_app(llm_configs: List[LLMConfig], name_prefix: str = "llm"):
