@@ -68,6 +68,42 @@ class Categorical(Distribution):
         return logits.argmax(axis=-1)
 
 
+class EpsilonGreedyQ(Distribution):
+    """Epsilon-greedy over Q-values (DQN exploration).
+
+    dist_inputs: [B, A+1] — Q-values with the CURRENT epsilon appended as the
+    last column (the module owns epsilon as a non-trained parameter so the
+    schedule rides the normal weight-sync path to env runners)."""
+
+    @staticmethod
+    def sample_np(inputs: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        q, eps = inputs[:, :-1], float(inputs[0, -1])
+        greedy = q.argmax(axis=-1)
+        rand = rng.integers(0, q.shape[1], size=len(q))
+        take_rand = rng.random(len(q)) < eps
+        return np.where(take_rand, rand, greedy)
+
+    @staticmethod
+    def greedy_np(inputs: np.ndarray) -> np.ndarray:
+        return inputs[:, :-1].argmax(axis=-1)
+
+    @staticmethod
+    def logp_np(inputs: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        return np.zeros(len(actions), np.float32)  # DQN losses never use logp
+
+    @staticmethod
+    def logp_jax(inputs, actions):
+        import jax.numpy as jnp
+
+        return jnp.zeros(inputs.shape[0], jnp.float32)
+
+    @staticmethod
+    def entropy_jax(inputs):
+        import jax.numpy as jnp
+
+        return jnp.zeros(inputs.shape[0], jnp.float32)
+
+
 class DiagGaussian(Distribution):
     """Continuous actions; dist_inputs = [mean, log_std] concat on last dim [B, 2*d]."""
 

@@ -147,3 +147,59 @@ class MLPModule(RLModule):
         logits = _mlp_apply_jax(params["pi"], obs)
         vf = _mlp_apply_jax(params["vf"], obs)[..., 0]
         return {Columns.ACTION_DIST_INPUTS: logits, Columns.VF_PREDS: vf}
+
+
+class DQNModule(RLModule):
+    """Q-network for discrete actions (reference dqn_rainbow_rl_module).
+
+    Params carry a non-trained "epsilon" leaf: its task-loss gradient is exactly
+    zero (loss never reads it), so the optimizer leaves it alone, and the DQN
+    algorithm overwrites it per the schedule before syncing weights to runners —
+    exploration state rides the ordinary weight-sync path."""
+
+    def __init__(self, observation_space, action_space, model_config):
+        super().__init__(observation_space, action_space, model_config)
+        import gymnasium as gym
+
+        if not isinstance(action_space, gym.spaces.Discrete):
+            raise ValueError("DQNModule requires a Discrete action space")
+        self.hiddens = tuple(model_config.get("fcnet_hiddens", (64, 64)))
+        self.obs_dim = int(np.prod(observation_space.shape))
+        self.num_actions = int(action_space.n)
+
+    @property
+    def action_dist_cls(self):
+        from .distributions import EpsilonGreedyQ
+
+        return EpsilonGreedyQ
+
+    def init_params(self, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        q = _mlp_init(rng, (self.obs_dim, *self.hiddens, self.num_actions))
+        return {"q": q, "epsilon": np.float32(1.0)}
+
+    def q_values_np(self, params, obs: np.ndarray) -> np.ndarray:
+        obs = obs.reshape(len(obs), -1).astype(np.float32)
+        return _mlp_apply_np(params["q"], obs)
+
+    def q_values_jax(self, params, obs):
+        obs = obs.reshape(len(obs), -1)
+        return _mlp_apply_jax(params["q"], obs)
+
+    def apply_np(self, params, obs):
+        q = self.q_values_np(params, obs)
+        eps = np.full((len(q), 1), float(params["epsilon"]), np.float32)
+        return {
+            Columns.ACTION_DIST_INPUTS: np.concatenate([q, eps], axis=1),
+            Columns.VF_PREDS: q.max(axis=-1),
+        }
+
+    def apply_jax(self, params, obs):
+        import jax.numpy as jnp
+
+        q = self.q_values_jax(params, obs)
+        eps = jnp.full((q.shape[0], 1), params["epsilon"])
+        return {
+            Columns.ACTION_DIST_INPUTS: jnp.concatenate([q, eps], axis=1),
+            Columns.VF_PREDS: q.max(axis=-1),
+        }

@@ -1,0 +1,151 @@
+"""DQN tests (reference rllib/algorithms/dqn tests; SURVEY.md §2.5 algorithms row)."""
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.core.distributions import EpsilonGreedyQ
+from ray_tpu.rllib.utils.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+def _fake_episode(t, obs_dim=4, terminated=True, offset=0.0):
+    obs = np.arange((t + 1) * obs_dim, dtype=np.float32).reshape(t + 1, obs_dim) + offset
+    return {
+        "obs": obs[:-1],
+        "next_obs_last": obs[-1],
+        "actions": np.arange(t) % 2,
+        "rewards": np.ones(t, np.float32),
+        "terminated": terminated,
+        "truncated": False,
+    }
+
+
+def test_replay_buffer_transitions_and_dones():
+    buf = ReplayBuffer(capacity=100)
+    assert buf.add_episodes([_fake_episode(5)]) == 5
+    assert len(buf) == 5
+    batch = buf.sample(32, np.random.default_rng(0))
+    assert batch["obs"].shape == (32, 4)
+    # next_obs must be obs shifted by one step
+    st = buf._storage
+    np.testing.assert_array_equal(st["next_obs"][0], st["obs"][1])
+    assert st["dones"][4] == 1.0 and st["dones"][:4].sum() == 0
+    # truncation does not set done (bootstrap continues)
+    buf2 = ReplayBuffer(capacity=100)
+    buf2.add_episodes([{**_fake_episode(3), "terminated": False, "truncated": True}])
+    assert buf2._storage["dones"][:3].sum() == 0
+
+
+def test_replay_buffer_ring_wraps():
+    buf = ReplayBuffer(capacity=8)
+    buf.add_episodes([_fake_episode(20)])
+    assert len(buf) == 8
+
+
+def test_prioritized_replay_weights_and_updates():
+    buf = PrioritizedReplayBuffer(capacity=64, alpha=0.6, beta=0.4)
+    buf.add_episodes([_fake_episode(16)])
+    rng = np.random.default_rng(0)
+    batch = buf.sample(8, rng)
+    assert "weights" in batch and "batch_indexes" in batch
+    assert batch["weights"].max() <= 1.0 + 1e-6
+    buf.update_priorities(batch["batch_indexes"], np.full(8, 100.0))
+    # heavily-prioritized samples dominate subsequent draws
+    counts = np.zeros(len(buf))
+    for _ in range(50):
+        b = buf.sample(4, rng)
+        for i in b["batch_indexes"]:
+            counts[i] += 1
+    hot = set(batch["batch_indexes"].tolist())
+    cold = [i for i in range(len(buf)) if i not in hot]
+    assert counts[list(hot)].sum() > counts[cold].sum()
+
+
+def test_replay_buffer_n_step():
+    """3-step transitions: discounted reward sums, obs[t+3] targets, γ³ bootstrap."""
+    g = 0.9
+    buf = ReplayBuffer(capacity=100, n_step=3, gamma=g)
+    ep = _fake_episode(6, terminated=True)
+    ep["rewards"] = np.arange(1, 7, dtype=np.float32)  # 1..6
+    buf.add_episodes([ep])
+    st = buf._storage
+    # transition 0: r = 1 + g*2 + g^2*3
+    assert abs(st["rewards"][0] - (1 + g * 2 + g * g * 3)) < 1e-5
+    # next_obs of transition 0 is obs[3]
+    np.testing.assert_array_equal(st["next_obs"][0], st["obs"][3])
+    # window clips at the end: transition 5 only sees reward 6
+    assert abs(st["rewards"][5] - 6.0) < 1e-5
+    # terminal reaches the last n transitions
+    np.testing.assert_array_equal(st["dones"][:6], [0, 0, 0, 1, 1, 1])
+
+
+def test_epsilon_greedy_dist():
+    q = np.array([[0.0, 5.0, 1.0]] * 1000, np.float32)
+    rng = np.random.default_rng(0)
+    # epsilon 0 -> always greedy
+    inp = np.concatenate([q, np.zeros((1000, 1), np.float32)], axis=1)
+    assert (EpsilonGreedyQ.sample_np(inp, rng) == 1).all()
+    assert (EpsilonGreedyQ.greedy_np(inp) == 1).all()
+    # epsilon 1 -> roughly uniform
+    inp = np.concatenate([q, np.ones((1000, 1), np.float32)], axis=1)
+    acts = EpsilonGreedyQ.sample_np(inp, rng)
+    assert len(np.unique(acts)) == 3
+    assert 200 < (acts == 0).sum() < 500
+
+
+def test_dqn_learns_cartpole(rt):
+    """DQN must improve over random on CartPole within a few iterations."""
+    from ray_tpu.rllib import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                     rollout_fragment_length=64)
+        .training(
+            lr=1e-3, gamma=0.99, train_batch_size=128,
+            replay_buffer_capacity=20_000,
+            num_steps_sampled_before_learning_starts=500,
+            target_network_update_freq=50,
+            epsilon=(1.0, 0.05), epsilon_timesteps=3000,
+            num_updates_per_iteration=64,
+            sample_timesteps_per_iteration=512,
+        )
+    )
+    algo = config.build_algo()
+    try:
+        first_return = None
+        best = -np.inf
+        for i in range(20):
+            result = algo.step()
+            ret = result.get("episode_return_mean")
+            if ret is not None:
+                if first_return is None:
+                    first_return = ret
+                best = max(best, ret)
+        assert result["epsilon"] < 0.5  # schedule actually decayed
+        assert result["mean_q"] > 5.0  # Q-values moved well off init
+        assert best >= 28.0, (first_return, best)
+        assert best > first_return + 6.0, (first_return, best)
+    finally:
+        algo.stop()
+
+
+def test_dqn_prioritized_replay_runs(rt):
+    from ray_tpu.rllib import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                     rollout_fragment_length=32)
+        .training(prioritized_replay=True, train_batch_size=32,
+                  num_steps_sampled_before_learning_starts=100,
+                  num_updates_per_iteration=4,
+                  sample_timesteps_per_iteration=128)
+    )
+    algo = config.build_algo()
+    try:
+        for _ in range(3):
+            result = algo.step()
+        assert np.isfinite(result.get("total_loss", 0.0))
+    finally:
+        algo.stop()
