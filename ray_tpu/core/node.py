@@ -286,6 +286,13 @@ class Cluster:
         elif kind == "metrics":
             # periodic per-worker metric snapshot (util/metrics.py push thread)
             self.metrics_by_worker[w.worker_id] = msg[1]
+        elif kind == "kv":
+            _, req_id, op = msg[:3]
+            args = msg[3:]
+            try:
+                self._reply(w, req_id, True, getattr(self.gcs.kv, op)(*args))
+            except Exception as e:  # noqa: BLE001
+                self._reply(w, req_id, False, e)
         elif kind == "register_fn":
             _, fn_id, fn_bytes = msg
             self.fn_table[fn_id] = fn_bytes

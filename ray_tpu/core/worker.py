@@ -166,6 +166,10 @@ class WorkerContext:
         """One-way metric snapshot to the coordinator (util/metrics.py)."""
         self._send(("metrics", snapshot))
 
+    def kv_request(self, op: str, *args):
+        """Cluster KV access from a worker (reference: GCS KV over the core worker)."""
+        return self._request("kv", op, *args)
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
         self._send(("kill_actor", actor_id, no_restart, from_gc))
 

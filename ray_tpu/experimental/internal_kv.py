@@ -1,0 +1,41 @@
+"""Cluster-wide internal KV (reference python/ray/experimental/internal_kv.py
+over GcsInternalKVManager, gcs_kv_manager.h:104). Driver talks to the in-process
+GCS table directly; workers go through their control pipe."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.core import global_state
+
+
+def _kv(op: str, *args):
+    cluster = global_state.try_cluster()
+    if cluster is not None:
+        return getattr(cluster.gcs.kv, op)(*args)
+    w = global_state.worker()
+    return w.kv_request(op, *args)
+
+
+def _internal_kv_initialized() -> bool:
+    return global_state.try_worker() is not None
+
+
+def _internal_kv_put(key: bytes, value: bytes, overwrite: bool = True,
+                     namespace: str = "") -> bool:
+    return _kv("put", key, value, namespace, overwrite)
+
+
+def _internal_kv_get(key: bytes, namespace: str = "") -> Optional[bytes]:
+    return _kv("get", key, namespace)
+
+
+def _internal_kv_del(key: bytes, namespace: str = "") -> bool:
+    return _kv("delete", key, namespace)
+
+
+def _internal_kv_exists(key: bytes, namespace: str = "") -> bool:
+    return _kv("exists", key, namespace)
+
+
+def _internal_kv_list(prefix: bytes, namespace: str = "") -> List[bytes]:
+    return _kv("keys", prefix, namespace)

@@ -1,0 +1,100 @@
+"""ActorPool: distribute work over a fixed set of actors.
+
+Capability parity: reference python/ray/util/actor_pool.py — map/map_unordered/
+submit/get_next(_unordered)/has_next/has_free plus push/pop_idle.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List = []
+
+    # -- submission ------------------------------------------------------------
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queues if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref.id] = (actor, ref)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    # -- retrieval -------------------------------------------------------------
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor)
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order. On timeout the pool state is intact
+        (reference semantics: the caller may retry the same get_next)."""
+        if self._next_return_index >= self._next_task_index:
+            raise StopIteration("no more results to get")
+        ref = self._index_to_future[self._next_return_index]
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out; call again to retry")
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        actor, _ = self._future_to_actor.pop(ref.id)
+        try:
+            return ray_tpu.get(ref)
+        finally:
+            self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result in completion order."""
+        if not self._future_to_actor:
+            raise StopIteration("no more results to get")
+        refs = [ref for _, ref in self._future_to_actor.values()]
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        actor, _ = self._future_to_actor.pop(ref.id)
+        for idx, f in list(self._index_to_future.items()):
+            if f.id == ref.id:
+                del self._index_to_future[idx]
+                break
+        try:
+            return ray_tpu.get(ref)
+        finally:
+            self._return_actor(actor)
+
+    # -- bulk ------------------------------------------------------------------
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # -- membership ------------------------------------------------------------
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    def push(self, actor) -> None:
+        self._return_actor(actor)
+
+    def pop_idle(self):
+        return self._idle.pop() if self.has_free() else None

@@ -1,0 +1,119 @@
+"""Distributed FIFO queue backed by an actor.
+
+Capability parity: reference python/ray/util/queue.py (Queue over an async
+actor: put/get with block/timeout, qsize/empty/full, batch ops, Empty/Full
+re-exported). The reference parks blocked callers on the actor's asyncio loop;
+here the actor is strictly non-blocking and CLIENTS poll with a short sleep —
+an arbitrary number of blocked producers/consumers can wait without consuming
+any actor concurrency (no thread-pool deadlock), at ~5 ms wakeup granularity.
+"""
+from __future__ import annotations
+
+import queue as _stdlib_queue
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+import ray_tpu
+
+Empty = _stdlib_queue.Empty
+Full = _stdlib_queue.Full
+
+_POLL_S = 0.005
+
+
+class _QueueActor:
+    """Non-blocking FIFO state; all blocking lives client-side."""
+
+    def __init__(self, maxsize: int):
+        self._maxsize = maxsize
+        self._q: deque = deque()
+
+    def try_put(self, item) -> bool:
+        if self._maxsize and len(self._q) >= self._maxsize:
+            return False
+        self._q.append(item)
+        return True
+
+    def try_get(self):
+        if self._q:
+            return True, self._q.popleft()
+        return False, None
+
+    def try_put_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing insert."""
+        if self._maxsize and len(self._q) + len(items) > self._maxsize:
+            return False
+        self._q.extend(items)
+        return True
+
+    def try_get_batch(self, n: int):
+        """All-or-nothing removal."""
+        if len(self._q) < n:
+            return False, None
+        return True, [self._q.popleft() for _ in range(n)]
+
+    def qsize(self) -> int:
+        return len(self._q)
+
+    def full(self) -> bool:
+        return bool(self._maxsize) and len(self._q) >= self._maxsize
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        opts = {"num_cpus": 0.1, **(actor_options or {})}
+        self._actor = ray_tpu.remote(**opts)(_QueueActor).remote(maxsize)
+
+    def _poll(self, attempt, block: bool, timeout: Optional[float], exc):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, value = attempt()
+            if ok:
+                return value
+            if not block:
+                raise exc
+            if deadline is not None and time.monotonic() >= deadline:
+                raise exc
+            time.sleep(_POLL_S)
+
+    # -- single ----------------------------------------------------------------
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> None:
+        self._poll(lambda: (ray_tpu.get(self._actor.try_put.remote(item)), None),
+                   block, timeout, Full())
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        return self._poll(lambda: ray_tpu.get(self._actor.try_get.remote()),
+                          block, timeout, Empty())
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    # -- batch (atomic: reference put_nowait_batch/get_nowait_batch) -----------
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        if not ray_tpu.get(self._actor.try_put_batch.remote(list(items))):
+            raise Full(f"cannot add {len(items)} items to a queue of size "
+                       f"{self.qsize()} (maxsize {self.maxsize})")
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        ok, items = ray_tpu.get(self._actor.try_get_batch.remote(num_items))
+        if not ok:
+            raise Empty(f"queue holds fewer than {num_items} items")
+        return items
+
+    # -- introspection ---------------------------------------------------------
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return ray_tpu.get(self._actor.full.remote())
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self._actor)

@@ -1,0 +1,167 @@
+"""ActorPool, Queue, internal_kv tests (reference ray.util tests)."""
+import threading
+import time
+
+import pytest
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def pool_actors(rt):
+    @rt.remote(num_cpus=0.5)
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+        def slow_double(self, x):
+            time.sleep(0.2 if x == 0 else 0.01)
+            return x * 2
+
+    actors = [Doubler.remote() for _ in range(3)]
+    yield actors
+    for a in actors:
+        rt.kill(a)
+
+
+def test_actor_pool_map_ordered(rt, pool_actors):
+    pool = ActorPool(pool_actors)
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+    assert out == [2 * i for i in range(10)]
+
+
+def test_actor_pool_map_unordered(rt, pool_actors):
+    pool = ActorPool(pool_actors)
+    out = list(pool.map_unordered(lambda a, v: a.slow_double.remote(v), range(8)))
+    assert sorted(out) == [2 * i for i in range(8)]
+
+
+def test_actor_pool_submit_get_next(rt, pool_actors):
+    pool = ActorPool(pool_actors)
+    pool.submit(lambda a, v: a.double.remote(v), 5)
+    pool.submit(lambda a, v: a.double.remote(v), 6)
+    assert pool.has_next()
+    assert pool.get_next() == 10
+    assert pool.get_next() == 12
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_queue_fifo_and_nowait(rt):
+    q = Queue(maxsize=2)
+    try:
+        q.put("a")
+        q.put("b")
+        with pytest.raises(Full):
+            q.put_nowait("c")
+        assert q.qsize() == 2 and q.full()
+        assert q.get() == "a"
+        assert q.get() == "b"
+        assert q.empty()
+        with pytest.raises(Empty):
+            q.get_nowait()
+    finally:
+        q.shutdown()
+
+
+def test_queue_cross_task_producer_consumer(rt):
+    q = Queue()
+    try:
+        @rt.remote
+        def producer(queue_handle, n):
+            for i in range(n):
+                queue_handle.put(i)
+            return "done"
+
+        ref = producer.remote(q, 5)
+        got = [q.get(timeout=30) for _ in range(5)]
+        assert got == list(range(5))
+        assert rt.get(ref) == "done"
+    finally:
+        q.shutdown()
+
+
+def test_actor_pool_get_next_timeout_preserves_state(rt, pool_actors):
+    pool = ActorPool(pool_actors)
+    pool.submit(lambda a, v: a.slow_double.remote(v), 0)  # the slow one (0.2s)
+    with pytest.raises(TimeoutError):
+        pool.get_next(timeout=0.01)
+    # state intact: retrying yields the result, nothing was lost
+    assert pool.get_next(timeout=30) == 0
+
+
+def test_queue_batch_ops_are_atomic(rt):
+    q = Queue(maxsize=4)
+    try:
+        q.put_nowait_batch([1, 2, 3])
+        with pytest.raises(Full):
+            q.put_nowait_batch([4, 5])  # would exceed maxsize: nothing inserted
+        assert q.qsize() == 3
+        with pytest.raises(Empty):
+            q.get_nowait_batch(5)  # more than present: nothing consumed
+        assert q.qsize() == 3
+        assert q.get_nowait_batch(3) == [1, 2, 3]
+        assert q.empty()
+    finally:
+        q.shutdown()
+
+
+def test_queue_many_blocked_consumers_no_deadlock(rt):
+    q = Queue()
+    try:
+        results = []
+        lock = threading.Lock()
+
+        def consumer():
+            v = q.get(timeout=30)
+            with lock:
+                results.append(v)
+
+        threads = [threading.Thread(target=consumer) for _ in range(20)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # all 20 blocked client-side
+        for i in range(20):
+            q.put(i)
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(results) == list(range(20))
+    finally:
+        q.shutdown()
+
+
+def test_queue_blocking_get_with_timeout(rt):
+    q = Queue()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(Empty):
+            q.get(timeout=0.3)
+        assert time.monotonic() - t0 >= 0.25
+    finally:
+        q.shutdown()
+
+
+def test_internal_kv_driver_and_worker(rt):
+    from ray_tpu.experimental import internal_kv as kv
+
+    assert kv._internal_kv_put(b"k1", b"v1")
+    assert kv._internal_kv_get(b"k1") == b"v1"
+    assert kv._internal_kv_exists(b"k1")
+    assert not kv._internal_kv_put(b"k1", b"v2", overwrite=False)
+    assert kv._internal_kv_get(b"k1") == b"v1"
+
+    @rt.remote
+    def from_worker():
+        from ray_tpu.experimental import internal_kv as wkv
+
+        wkv._internal_kv_put(b"k2", b"from-worker", True, "ns")
+        return (wkv._internal_kv_get(b"k1"), wkv._internal_kv_list(b"k"))
+
+    v, keys = rt.get(from_worker.remote())
+    assert v == b"v1"
+    assert b"k1" in keys
+    assert kv._internal_kv_get(b"k2", namespace="ns") == b"from-worker"
+    assert kv._internal_kv_del(b"k1")
+    assert kv._internal_kv_get(b"k1") is None
