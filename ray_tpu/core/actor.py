@@ -66,11 +66,14 @@ class ActorMethod:
         return m
 
     def _remote(self, args, kwargs, num_returns: int = 1):
+        from ray_tpu.util.tracing import get_trace_context
+
         ctx = global_state.worker()
         meta, arg_refs, pins = encode_args(ctx, args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.generate(),
             kind="actor_method",
+            trace_ctx=get_trace_context(),
             fn_id=b"\x00" * 16,
             fn_bytes=None,
             name=f"{self._name}",
@@ -157,6 +160,8 @@ class ActorClass:
         return self._remote(args, kwargs, self._options)
 
     def _remote(self, args, kwargs, opts) -> ActorHandle:
+        from ray_tpu.util.tracing import get_trace_context
+
         ctx = global_state.worker()
         if self._cls_bytes is None:
             self._cls_bytes = cloudpickle.dumps(self._cls)
@@ -187,6 +192,7 @@ class ActorClass:
             method_meta=method_meta,
             detached=opts.get("lifetime") == "detached",
             max_concurrency=max(1, int(opts.get("max_concurrency") or 1)),
+            trace_ctx=get_trace_context(),
         )
         ctx.submit(spec)
         del pins  # safe to release: submit() pinned the args

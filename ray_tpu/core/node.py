@@ -173,6 +173,7 @@ class Cluster:
         self.fn_table: Dict[bytes, bytes] = {}
         self.metrics_by_worker: Dict[Any, list] = {}
         self.task_events: deque = deque(maxlen=10000)
+        self.trace_spans: deque = deque(maxlen=10000)
         self.actors: Dict[ActorID, ActorState] = {}
         self.tasks: Dict[TaskID, TaskState] = {}
         self.pending: deque = deque()  # TaskSpecs waiting for dispatch
@@ -286,6 +287,9 @@ class Cluster:
         elif kind == "metrics":
             # periodic per-worker metric snapshot (util/metrics.py push thread)
             self.metrics_by_worker[w.worker_id] = msg[1]
+        elif kind == "spans":
+            with self._lock:  # readers iterate under the same lock (state.get_trace)
+                self.trace_spans.extend(msg[1])
         elif kind == "kv":
             _, req_id, op = msg[:3]
             args = msg[3:]
@@ -848,6 +852,10 @@ class Cluster:
         self._router_thread.join(timeout=2.0)
         self.store.free_all()
         object_store.destroy_arena()
+        # stale spans must not leak into a future cluster's trace (util/tracing.py)
+        from ray_tpu.util import tracing
+
+        tracing.drain_local_spans()
 
 
 class DriverContext:

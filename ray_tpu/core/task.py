@@ -152,6 +152,7 @@ class RemoteFunction:
             max_retries=opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
             runtime_env=opts.get("runtime_env"),
+            trace_ctx=_trace_ctx(),
         )
         refs = ctx.submit(spec)
         del pins  # safe to release: submit() pinned the args
@@ -162,3 +163,9 @@ class RemoteFunction:
             f"Remote function {self.__name__} cannot be called directly; "
             f"use {self.__name__}.remote()."
         )
+
+
+def _trace_ctx():
+    from ray_tpu.util.tracing import get_trace_context
+
+    return get_trace_context()

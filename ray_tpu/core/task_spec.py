@@ -58,6 +58,9 @@ class TaskSpec:
     method_meta: Dict[str, Any] = field(default_factory=dict)
     detached: bool = False
     max_concurrency: int = 1
+    # tracing context propagation (util/tracing.py; reference: TaskSpec-embedded
+    # otel context in tracing_helper.py)
+    trace_ctx: Optional[Dict[str, str]] = None
     # Filled by the scheduler:
     node_id: Optional[NodeID] = None
     pg_id: Optional[PlacementGroupID] = None

@@ -170,6 +170,10 @@ class WorkerContext:
         """Cluster KV access from a worker (reference: GCS KV over the core worker)."""
         return self._request("kv", op, *args)
 
+    def push_spans(self, spans: list) -> None:
+        """One-way trace-span batch to the coordinator (util/tracing.py)."""
+        self._send(("spans", spans))
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
         self._send(("kill_actor", actor_id, no_restart, from_gc))
 
@@ -265,15 +269,28 @@ class WorkerContext:
         try:
             from ray_tpu.runtime_env import applied as _renv_applied
 
-            args, kwargs = self._resolve_args(spec, resolved_locs)
-            if spec.kind == "task" and spec.runtime_env:
-                with _renv_applied(spec.runtime_env):
-                    return self._execute_body(spec, args, kwargs)
-            if spec.kind == "actor_creation" and spec.runtime_env:
-                # actors keep their runtime env for their lifetime
-                with _renv_applied(spec.runtime_env, permanent=True):
-                    pass
-            return self._execute_body(spec, args, kwargs)
+            import contextlib
+
+            if spec.trace_ctx is not None:
+                from ray_tpu.util import tracing
+
+                # a propagated context IS the enable signal (workers may have been
+                # spawned before the driver called enable_tracing)
+                tracing.enable_tracing()
+                tracing.set_trace_context(spec.trace_ctx)
+                span_cm = tracing.span(f"task::{spec.name}", {"kind": spec.kind})
+            else:
+                span_cm = contextlib.nullcontext()
+            with span_cm:
+                args, kwargs = self._resolve_args(spec, resolved_locs)
+                if spec.kind == "task" and spec.runtime_env:
+                    with _renv_applied(spec.runtime_env):
+                        return self._execute_body(spec, args, kwargs)
+                if spec.kind == "actor_creation" and spec.runtime_env:
+                    # actors keep their runtime env for their lifetime
+                    with _renv_applied(spec.runtime_env, permanent=True):
+                        pass
+                return self._execute_body(spec, args, kwargs)
         except BaseException as e:  # noqa: BLE001
             self._send_error(spec, e)
         finally:

@@ -162,6 +162,22 @@ def prometheus_metrics() -> str:
     return m.prometheus_text(get_metrics())
 
 
+# -------------------------------------------------------------------- tracing
+
+def get_trace() -> List[Dict[str, Any]]:
+    """All collected spans: worker-pushed + driver-local (util/tracing.py).
+
+    Driver-local spans are folded into the cluster's persistent ring on read so
+    repeated calls keep returning them."""
+    from ray_tpu.util import tracing
+
+    c = _cluster()
+    local = tracing.drain_local_spans()
+    with c._lock:
+        c.trace_spans.extend(local)
+        return list(c.trace_spans)
+
+
 # -------------------------------------------------------------------- timeline
 
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:

@@ -1,0 +1,113 @@
+"""Opt-in distributed tracing: spans with cross-task context propagation.
+
+Capability parity: reference python/ray/util/tracing/tracing_helper.py (opt-in
+OpenTelemetry wrapping — spans injected around task submit/execute, context
+propagated inside the TaskSpec). OTel isn't in this image, so spans are plain
+dicts in the OTel shape; the trace context rides TaskSpec.trace_ctx, worker
+spans flow to the coordinator over the control pipe, and util.state exposes the
+merged trace (chrome-trace exportable alongside the task timeline).
+
+Usage:
+    from ray_tpu.util import tracing
+    tracing.enable_tracing()           # or RAY_TPU_TRACING=1 before init
+    with tracing.span("ingest", {"rows": 100}):
+        ... ray_tpu.get(f.remote()) ...   # task executions become child spans
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_ENV = "RAY_TPU_TRACING"
+_enabled = False
+_local_spans: List[dict] = []
+_lock = threading.Lock()
+
+# (trace_id, span_id) of the active span in this thread/task
+_ctx: contextvars.ContextVar = contextvars.ContextVar("rt_trace_ctx", default=None)
+
+
+def enable_tracing() -> None:
+    """Enable in this process and (via env) in workers spawned afterwards."""
+    global _enabled
+    _enabled = True
+    os.environ[_ENV] = "1"
+
+
+def is_tracing_enabled() -> bool:
+    return _enabled or os.environ.get(_ENV) == "1"
+
+
+def get_trace_context() -> Optional[Dict[str, str]]:
+    """Serializable context for propagation into a TaskSpec."""
+    if not is_tracing_enabled():
+        return None
+    cur = _ctx.get()
+    if cur is None:
+        # root: start a fresh trace at first emission
+        cur = (uuid.uuid4().hex, "")
+        _ctx.set(cur)
+    return {"trace_id": cur[0], "parent_span_id": cur[1]}
+
+
+def set_trace_context(ctx: Optional[Dict[str, str]]):
+    if ctx is None:
+        return None
+    return _ctx.set((ctx["trace_id"], ctx.get("parent_span_id", "")))
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """Record a span; nested spans/tasks become children."""
+    if not is_tracing_enabled():
+        yield None
+        return
+    parent = _ctx.get()
+    trace_id = parent[0] if parent else uuid.uuid4().hex
+    span_id = uuid.uuid4().hex[:16]
+    token = _ctx.set((trace_id, span_id))
+    rec = {
+        "name": name,
+        "trace_id": trace_id,
+        "span_id": span_id,
+        "parent_span_id": parent[1] if parent else "",
+        "start_time": time.time(),
+        "attributes": dict(attributes or {}),
+        "pid": os.getpid(),
+    }
+    try:
+        yield rec
+    finally:
+        rec["end_time"] = time.time()
+        _ctx.reset(token)
+        with _lock:
+            _local_spans.append(rec)
+        _maybe_flush()
+
+
+def drain_local_spans() -> List[dict]:
+    with _lock:
+        out = list(_local_spans)
+        _local_spans.clear()
+    return out
+
+
+def _maybe_flush() -> None:
+    """Workers push spans to the coordinator; the driver keeps them local
+    (util/state.get_trace collects both)."""
+    from ray_tpu.core import global_state
+
+    w = global_state.try_worker()
+    if w is None or not hasattr(w, "push_spans"):
+        return
+    spans = drain_local_spans()
+    if spans:
+        try:
+            w.push_spans(spans)
+        except Exception:
+            pass

@@ -1,0 +1,70 @@
+"""Tracing tests (reference python/ray/util/tracing; SURVEY.md §5 tracing row)."""
+import time
+
+import pytest
+
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(autouse=True)
+def _enable():
+    import os
+
+    tracing.enable_tracing()
+    yield
+    os.environ.pop("RAY_TPU_TRACING", None)
+    tracing._enabled = False
+
+
+def test_span_nesting_and_timing():
+    with tracing.span("outer", {"k": "v"}) as outer:
+        time.sleep(0.02)
+        with tracing.span("inner") as inner:
+            time.sleep(0.01)
+    spans = tracing.drain_local_spans()
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["inner"]["parent_span_id"] == by_name["outer"]["span_id"]
+    assert by_name["inner"]["trace_id"] == by_name["outer"]["trace_id"]
+    assert by_name["outer"]["end_time"] - by_name["outer"]["start_time"] >= 0.025
+    assert by_name["outer"]["attributes"] == {"k": "v"}
+
+
+def test_task_spans_propagate_trace(rt):
+    from ray_tpu.util import state as rs
+
+    @rt.remote
+    def traced_work(x):
+        from ray_tpu.util import tracing as wtracing
+
+        with wtracing.span("user-span-in-task"):
+            return x + 1
+
+    with tracing.span("driver-root"):
+        assert rt.get(traced_work.remote(1)) == 2
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        spans = rs.get_trace()
+        names = {s["name"] for s in spans}
+        if {"driver-root", "task::traced_work", "user-span-in-task"} <= names:
+            break
+        time.sleep(0.1)
+    by_name = {s["name"]: s for s in spans}
+    root = by_name["driver-root"]
+    task_span = by_name["task::traced_work"]
+    user = by_name["user-span-in-task"]
+    # one trace across process boundaries, correctly parented
+    assert task_span["trace_id"] == root["trace_id"]
+    assert task_span["parent_span_id"] == root["span_id"]
+    assert user["parent_span_id"] == task_span["span_id"]
+
+
+def test_disabled_tracing_is_free(rt):
+    import os
+
+    os.environ.pop("RAY_TPU_TRACING", None)
+    tracing._enabled = False
+    with tracing.span("nope") as s:
+        assert s is None
+    assert tracing.drain_local_spans() == []
+    assert tracing.get_trace_context() is None
