@@ -80,6 +80,45 @@ def cmd_submit(args) -> int:
     return 0 if status == "SUCCEEDED" else 1
 
 
+def cmd_serve(args) -> int:
+    """serve deploy/status/shutdown (reference serve CLI over ServeDeploySchema).
+
+    Single-host note: the runtime is in-process, so the serving cluster lives in
+    THIS process — deploy therefore blocks (apps would vanish on exit otherwise),
+    and status/shutdown only see apps deployed by the same process (programmatic
+    use: ray_tpu.serve.status()/shutdown() in the driver)."""
+    import ray_tpu
+
+    ray_tpu.init()
+    from ray_tpu import serve
+
+    if args.serve_cmd == "deploy":
+        names = serve.apply_config_file(args.config)
+        print(f"deployed: {', '.join(names)}", flush=True)
+        if args.no_block:
+            print("warning: --no-block exits immediately and tears the apps down "
+                  "(in-process runtime)", file=sys.stderr)
+            return 0
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        return 0
+    if args.serve_cmd == "status":
+        st = serve.status()
+        if not st:
+            print("no apps in this process (serve runs in the deploying process; "
+                  "use ray_tpu.serve.status() in the driver)", file=sys.stderr)
+        print(json.dumps(st, indent=2, default=str))
+        return 0
+    if args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down (this process's session)")
+        return 0
+    return 2
+
+
 def cmd_job(args) -> int:
     mgr = JobManager()
     if args.job_cmd == "submit":
@@ -127,6 +166,15 @@ def main(argv=None) -> int:
     sp.add_argument("script")
     sp.add_argument("script_args", nargs="*")
     sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    s = ssub.add_parser("deploy")
+    s.add_argument("config")
+    s.add_argument("--no-block", action="store_true")
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("job", help="job management")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

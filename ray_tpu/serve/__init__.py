@@ -17,6 +17,7 @@ from .api import (  # noqa: F401
 )
 from .batching import batch  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
+from .schema import apply_config, apply_config_file  # noqa: F401
 from .config import AutoscalingConfig, DeploymentConfig  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
@@ -39,4 +40,6 @@ __all__ = [
     "batch",
     "multiplexed",
     "get_multiplexed_model_id",
+    "apply_config",
+    "apply_config_file",
 ]

@@ -1,0 +1,129 @@
+"""Declarative Serve config (reference python/ray/serve/schema.py + `serve deploy`).
+
+Config shape (YAML/JSON/dict):
+
+    applications:
+      - name: my-app
+        route_prefix: /api
+        import_path: my_module:app        # module attr holding an Application
+                                          # or a builder callable returning one
+        args: {}                          # kwargs for a builder import_path
+        deployments:                      # per-deployment overrides
+          - name: Model
+            num_replicas: 2
+            max_ongoing_requests: 16
+            user_config: {...}
+
+`apply_config` deploys every listed application (reference ServeDeploySchema →
+controller deploy_apps).
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional
+
+from .deployment import Application
+
+
+def _load_target(import_path: str, args: Optional[Dict[str, Any]] = None) -> Application:
+    module_name, _, attr = import_path.partition(":")
+    if not attr:
+        raise ValueError(f"import_path must be 'module:attr', got {import_path!r}")
+    mod = importlib.import_module(module_name)
+    target = getattr(mod, attr)
+    if isinstance(target, Application):
+        if args:
+            raise ValueError(f"{import_path} is an Application; args need a builder")
+        return target
+    if callable(target):
+        app = target(**(args or {}))
+        if not isinstance(app, Application):
+            raise TypeError(f"builder {import_path} must return an Application")
+        return app
+    raise TypeError(f"{import_path} is neither an Application nor a builder")
+
+
+def _apply_overrides(app: Application, overrides: List[Dict[str, Any]]) -> Application:
+    """Rebind the graph with per-deployment option overrides (by deployment name)."""
+    if not overrides:
+        return app
+    by_name = {o["name"]: {k: v for k, v in o.items() if k != "name"} for o in overrides}
+
+    def rebind(a: Application) -> Application:
+        new_args = tuple(rebind(x) if isinstance(x, Application) else x for x in a.args)
+        new_kwargs = {k: rebind(v) if isinstance(v, Application) else v
+                      for k, v in a.kwargs.items()}
+        d = a.deployment
+        if d.name in by_name:
+            d = d.options(**by_name[d.name])
+        return Application(d, new_args, new_kwargs)
+
+    return rebind(app)
+
+
+def _deployment_names(app: Application) -> List[str]:
+    collected: List[Application] = []
+    app._collect(collected)
+    return [a.deployment.name for a in collected]
+
+
+def apply_config(config: Dict[str, Any]) -> List[str]:
+    """Declaratively deploy the config (reference ServeDeploySchema semantics):
+    every listed application is deployed/updated and any OTHER currently-running
+    app is deleted — the config is the full desired state. Returns app names."""
+    from . import api
+
+    if not isinstance(config, dict) or not isinstance(config.get("applications"), list):
+        raise ValueError("serve config must be a dict with an 'applications' list")
+
+    apps = config["applications"]
+    prefixes: Dict[str, str] = {}
+    for app_cfg in apps:
+        prefix = app_cfg.get("route_prefix", "/")
+        other = prefixes.get(prefix)
+        if other is not None:
+            raise ValueError(
+                f"applications {other!r} and {app_cfg.get('name', 'default')!r} both "
+                f"use route_prefix {prefix!r}; routes must be unique")
+        prefixes[prefix] = app_cfg.get("name", "default")
+
+    deployed = []
+    for app_cfg in apps:
+        name = app_cfg.get("name", "default")
+        app = _load_target(app_cfg["import_path"], app_cfg.get("args"))
+        overrides = app_cfg.get("deployments", [])
+        known = set(_deployment_names(app))
+        unknown = [o["name"] for o in overrides if o["name"] not in known]
+        if unknown:
+            raise ValueError(
+                f"app {name!r}: deployment overrides {unknown} match no deployment "
+                f"in the graph (have: {sorted(known)})")
+        app = _apply_overrides(app, overrides)
+        api.run(app, name=name, route_prefix=app_cfg.get("route_prefix", "/"))
+        deployed.append(name)
+
+    # declarative: remove apps not in the config
+    for existing in list(api.status()):
+        if existing not in deployed:
+            api.delete(existing)
+    return deployed
+
+
+def apply_config_file(path: str) -> List[str]:
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        config = json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            import yaml
+
+            config = yaml.safe_load(text)
+        except ImportError as e:
+            raise ValueError(f"{path} is not JSON and pyyaml is unavailable") from e
+    if not isinstance(config, dict):
+        raise ValueError(f"{path}: serve config must parse to a mapping, "
+                         f"got {type(config).__name__}")
+    return apply_config(config)

@@ -1,0 +1,132 @@
+"""Declarative serve config tests (reference serve deploy + schema.py)."""
+import sys
+import types
+
+import pytest
+
+from ray_tpu import serve
+
+
+def _install_fake_module():
+    mod = types.ModuleType("fake_serve_targets")
+
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting="hello"):
+            self.greeting = greeting
+
+        def __call__(self, body):
+            return f"{self.greeting} {body.get('who', 'world')}"
+
+    mod.app = Greeter.bind()
+
+    def build_app(greeting="hey"):
+        return Greeter.options(name="Greeter").bind(greeting)
+
+    mod.build_app = build_app
+    mod.Greeter = Greeter
+    sys.modules["fake_serve_targets"] = mod
+    return mod
+
+
+def test_apply_config_app_and_builder(rt):
+    _install_fake_module()
+    config = {
+        "applications": [
+            {"name": "cfg-app", "route_prefix": "/cfg",
+             "import_path": "fake_serve_targets:app"},
+            {"name": "cfg-built", "route_prefix": "/built",
+             "import_path": "fake_serve_targets:build_app",
+             "args": {"greeting": "yo"}},
+        ]
+    }
+    names = serve.apply_config(config)
+    try:
+        assert names == ["cfg-app", "cfg-built"]
+        h = serve.get_app_handle("cfg-app")
+        assert h.remote({"who": "cfg"}).result() == "hello cfg"
+        h2 = serve.get_app_handle("cfg-built")
+        assert h2.remote({}).result() == "yo world"
+    finally:
+        serve.delete("cfg-app")
+        serve.delete("cfg-built")
+
+
+def test_apply_config_deployment_overrides(rt):
+    _install_fake_module()
+    config = {
+        "applications": [{
+            "name": "cfg-ovr", "route_prefix": "/ovr",
+            "import_path": "fake_serve_targets:app",
+            "deployments": [{"name": "Greeter", "num_replicas": 2}],
+        }]
+    }
+    serve.apply_config(config)
+    try:
+        import time
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = serve.status()
+            info = st["cfg-ovr"]["deployments"]["Greeter"]
+            if info and info["num_running"] == 2:
+                break
+            time.sleep(0.2)
+        assert info["target_num_replicas"] == 2
+    finally:
+        serve.delete("cfg-ovr")
+
+
+def test_apply_config_file_json(rt, tmp_path):
+    _install_fake_module()
+    import json
+
+    p = tmp_path / "serve.json"
+    p.write_text(json.dumps({"applications": [
+        {"name": "cfg-file", "route_prefix": "/f",
+         "import_path": "fake_serve_targets:app"}]}))
+    names = serve.apply_config_file(str(p))
+    try:
+        assert names == ["cfg-file"]
+    finally:
+        serve.delete("cfg-file")
+
+
+def test_declarative_replaces_previous_apps(rt):
+    _install_fake_module()
+    serve.apply_config({"applications": [
+        {"name": "decl-a", "route_prefix": "/a", "import_path": "fake_serve_targets:app"}]})
+    serve.apply_config({"applications": [
+        {"name": "decl-b", "route_prefix": "/b", "import_path": "fake_serve_targets:app"}]})
+    try:
+        st = serve.status()
+        assert "decl-b" in st and "decl-a" not in st  # config is the full desired state
+    finally:
+        serve.delete("decl-b")
+
+
+def test_config_validation_errors(rt):
+    _install_fake_module()
+    with pytest.raises(ValueError, match="applications"):
+        serve.apply_config({"bogus": []})
+    with pytest.raises(ValueError, match="route_prefix"):
+        serve.apply_config({"applications": [
+            {"name": "x", "import_path": "fake_serve_targets:app"},
+            {"name": "y", "import_path": "fake_serve_targets:app"},
+        ]})  # both default to "/"
+    with pytest.raises(ValueError, match="match no deployment"):
+        serve.apply_config({"applications": [
+            {"name": "z", "route_prefix": "/z",
+             "import_path": "fake_serve_targets:app",
+             "deployments": [{"name": "Typo", "num_replicas": 2}]},
+        ]})
+
+
+def test_bad_import_paths():
+    from ray_tpu.serve.schema import _load_target
+
+    with pytest.raises(ValueError, match="module:attr"):
+        _load_target("no_colon_here")
+    _install_fake_module()
+    with pytest.raises(TypeError, match="neither"):
+        _load_target("fake_serve_targets:Greeter")  # a Deployment, not an Application
