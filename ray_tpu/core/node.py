@@ -287,6 +287,10 @@ class Cluster:
         elif kind == "metrics":
             # periodic per-worker metric snapshot (util/metrics.py push thread)
             self.metrics_by_worker[w.worker_id] = msg[1]
+        elif kind == "tqdm":
+            from ray_tpu.experimental.tqdm_ray import _render_local
+
+            _render_local(msg[1])
         elif kind == "spans":
             with self._lock:  # readers iterate under the same lock (state.get_trace)
                 self.trace_spans.extend(msg[1])

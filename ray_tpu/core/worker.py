@@ -174,6 +174,10 @@ class WorkerContext:
         """One-way trace-span batch to the coordinator (util/tracing.py)."""
         self._send(("spans", spans))
 
+    def push_tqdm(self, state: dict) -> None:
+        """One-way progress-bar state to the coordinator (experimental/tqdm_ray.py)."""
+        self._send(("tqdm", state))
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
         self._send(("kill_actor", actor_id, no_restart, from_gc))
 

@@ -32,6 +32,9 @@ from .logical import Read
 
 
 def _read(ds: Datasource, parallelism: int = -1) -> Dataset:
+    from ray_tpu.usage import record_library_usage
+
+    record_library_usage("data")
     return Dataset(Read(ds, parallelism))
 
 

@@ -1,0 +1,101 @@
+"""Distributed-safe progress bars.
+
+Capability parity: reference python/ray/experimental/tqdm_ray.py — tqdm-shaped
+bars whose updates from worker processes relay to the driver (instead of each
+process fighting over the terminal). Worker-side bars push state through the
+metrics channel; the driver renders one line per bar on stderr.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+_RENDER_MIN_INTERVAL = 0.1
+
+
+class tqdm:  # noqa: N801 - reference exports the lowercase name
+    def __init__(self, iterable=None, desc: str = "", total: Optional[int] = None,
+                 position: int = 0, **_compat):
+        self._iterable = iterable
+        self.desc = desc
+        self.total = total if total is not None else (
+            len(iterable) if hasattr(iterable, "__len__") else None)
+        self.n = 0
+        self._uuid = uuid.uuid4().hex
+        self._last_render = 0.0
+        self._closed = False
+
+    # -- tqdm API ---------------------------------------------------------------
+    def update(self, n: int = 1) -> None:
+        self.n += n
+        self._emit()
+
+    def set_description(self, desc: str) -> None:
+        self.desc = desc
+        self._emit()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._emit(force=True)
+
+    def __iter__(self):
+        for x in self._iterable:
+            yield x
+            self.update(1)
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- relay ------------------------------------------------------------------
+    def _state(self) -> Dict[str, Any]:
+        return {"uuid": self._uuid, "desc": self.desc, "n": self.n,
+                "total": self.total, "closed": self._closed}
+
+    def _emit(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_render < _RENDER_MIN_INTERVAL:
+            return
+        self._last_render = now
+        from ray_tpu.core import global_state
+
+        w = global_state.try_worker()
+        if w is not None and hasattr(w, "push_tqdm"):
+            try:  # worker: relay to the driver over its one-way channel
+                w.push_tqdm(self._state())
+                return
+            except Exception:
+                pass
+        _render_local(self._state())
+
+
+_render_lock = threading.Lock()
+_last_rendered_uuid: list = [None]
+
+
+def _render_local(state: Dict[str, Any]) -> None:
+    """Driver-side render. Concurrent bars interleave: when a different bar than
+    the previous one renders, the old line is finalized with a newline first so
+    bars never clobber each other mid-line."""
+    with _render_lock:
+        n, total = state["n"], state["total"]
+        frac = f"{n}/{total}" if total else str(n)
+        bar = ""
+        if total:
+            filled = int(20 * min(1.0, n / max(total, 1)))
+            bar = "[" + "#" * filled + "-" * (20 - filled) + "] "
+        if (_last_rendered_uuid[0] is not None
+                and _last_rendered_uuid[0] != state["uuid"]):
+            sys.stderr.write("\n")
+        end = "\n" if state.get("closed") else "\r"
+        _last_rendered_uuid[0] = None if state.get("closed") else state["uuid"]
+        sys.stderr.write(f"{state['desc']}: {bar}{frac}{end}")
+        sys.stderr.flush()

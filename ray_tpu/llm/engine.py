@@ -100,6 +100,9 @@ class JaxLLMEngine(LLMEngine):
         with self._start_lock:
             if self._started:
                 return
+            from ray_tpu.usage import record_library_usage
+
+            record_library_usage("llm")
             cfg = self.model_config
             c = self.config
             if self._mesh is None:

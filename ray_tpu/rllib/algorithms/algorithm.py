@@ -38,6 +38,9 @@ class Algorithm(tune.Trainable):
 
     # -- Trainable hooks -------------------------------------------------------
     def setup(self, _config: Dict[str, Any]) -> None:
+        from ray_tpu.usage import record_library_usage
+
+        record_library_usage("rllib")
         cfg = self._algo_config
         self.metrics = MetricsLogger()
         self.env_runner_group = EnvRunnerGroup(cfg)

@@ -57,6 +57,9 @@ def run(
     **_compat,
 ) -> DeploymentHandle:
     """Deploy an application graph; returns the ingress handle (reference api.py:691)."""
+    from ray_tpu.usage import record_library_usage
+
+    record_library_usage("serve")
     if isinstance(target, Deployment):
         target = target.bind()
     if not isinstance(target, Application):

@@ -55,6 +55,9 @@ class DataParallelTrainer:
         self.resume_from_checkpoint = resume_from_checkpoint
 
     def fit(self) -> Result:
+        from ray_tpu.usage import record_library_usage
+
+        record_library_usage("train")
         name = self.run_config.name or f"train_{time.strftime('%Y%m%d_%H%M%S')}"
         storage = self.run_config.storage_path or _default_storage_path()
         run_dir = os.path.join(storage, name)

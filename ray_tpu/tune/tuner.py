@@ -36,6 +36,9 @@ class Tuner:
         self.run_config = run_config
 
     def fit(self) -> ResultGrid:
+        from ray_tpu.usage import record_library_usage
+
+        record_library_usage("tune")
         stop = None
         max_failures = 0
         checkpoint_freq = 1

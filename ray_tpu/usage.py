@@ -1,0 +1,54 @@
+"""Usage stats: local-only feature-usage recording, off by default.
+
+Capability parity: reference python/ray/_private/usage/ (opt-out usage stats
+ping). This build NEVER phones home — there is no egress in the target
+environment and none is wanted; instead, when enabled via RAY_TPU_USAGE_STATS=1
+a feature-usage summary accumulates in the session dir for operators to inspect
+(`ray_tpu.usage.usage_report()`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import Counter
+from typing import Dict
+
+_ENV = "RAY_TPU_USAGE_STATS"
+_lock = threading.Lock()
+_features: Counter = Counter()
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get(_ENV, "0") == "1"
+
+
+def record_library_usage(feature: str) -> None:
+    """Called by subsystem entry points: serve.run, Dataset reads, Trainer.fit,
+    Tuner.fit, Algorithm.setup, JaxLLMEngine.start."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _features[feature] += 1
+
+
+def usage_report() -> Dict[str, int]:
+    with _lock:
+        return dict(_features)
+
+
+def reset() -> None:
+    """Clear recorded usage (tests, session boundaries)."""
+    with _lock:
+        _features.clear()
+
+
+def flush_to_session_dir() -> str:
+    from ray_tpu.job.manager import default_session_dir
+
+    path = os.path.join(default_session_dir(), "usage_stats.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"recorded_at": time.time(), "features": usage_report()}, f)
+    return path

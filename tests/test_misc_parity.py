@@ -1,0 +1,72 @@
+"""tqdm_ray + usage stats tests (reference experimental/tqdm_ray.py, _private/usage)."""
+import sys
+
+import pytest
+
+
+def test_tqdm_local_render(capsys):
+    from ray_tpu.experimental.tqdm_ray import tqdm
+
+    out = list(tqdm(range(5), desc="work", total=5))
+    assert out == [0, 1, 2, 3, 4]
+    err = capsys.readouterr().err
+    assert "work" in err and "5/5" in err
+
+
+def test_tqdm_from_worker_relays(rt, capsys):
+    @rt.remote
+    def work():
+        from ray_tpu.experimental.tqdm_ray import tqdm
+
+        bar = tqdm(desc="remote-bar", total=3)
+        for _ in range(3):
+            bar.update(1)
+        bar.close()
+        return True
+
+    assert rt.get(work.remote())
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        err = capsys.readouterr().err
+        if "remote-bar" in err:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("worker bar never rendered on the driver")
+
+
+@pytest.fixture(autouse=True)
+def _reset_usage():
+    from ray_tpu import usage
+
+    usage.reset()
+    yield
+    usage.reset()
+
+
+def test_usage_stats_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_USAGE_STATS", raising=False)
+    from ray_tpu import usage
+
+    usage.record_library_usage("train")
+    assert usage.usage_report() == {}
+
+
+def test_usage_stats_record_and_flush(monkeypatch, tmp_path):
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS", "1")
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    from ray_tpu import usage
+
+    usage.record_library_usage("serve")
+    usage.record_library_usage("serve")
+    usage.record_library_usage("data")
+    report = usage.usage_report()
+    assert report["serve"] == 2 and report["data"] == 1
+    path = usage.flush_to_session_dir()
+    import json
+
+    with open(path) as f:
+        saved = json.load(f)
+    assert saved["features"]["serve"] == 2
