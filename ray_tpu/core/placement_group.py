@@ -31,19 +31,64 @@ class PlacementGroup:
 
     def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]], strategy: str, name: str = ""):
         self.id = pg_id
-        self.bundle_specs = bundles
-        self.strategy = strategy
-        self.name = name
+        self._bundle_specs = bundles
+        self._strategy = strategy
+        self._name = name
         self._ready_event = threading.Event()
         self._failed: Optional[str] = None
+
+    def _maybe_hydrate(self) -> None:
+        """Deferred worker-side hydration (set up by _restore_pg): fetch
+        bundle_specs/strategy/name from the node service on first use — NEVER
+        during unpickle (recv-thread deadlock, see _restore_pg). Transient poll
+        failures keep the flag set so a later access retries."""
+        if not getattr(self, "_needs_hydration", False):
+            return
+        data = self._remote_poll(self.id)
+        if data is not None:
+            self._needs_hydration = False
+            self._bundle_specs, self._strategy, self._name = data[0], data[1], data[2]
+            if data[3] and not data[4]:
+                self._ready_event.set()
+
+    # hydrating attribute views: plain reads on a worker-side replica handle must
+    # see real values, not placeholder defaults
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        self._maybe_hydrate()
+        return self._bundle_specs
+
+    @bundle_specs.setter
+    def bundle_specs(self, v):
+        self._bundle_specs = v
+
+    @property
+    def strategy(self) -> str:
+        self._maybe_hydrate()
+        return self._strategy
+
+    @strategy.setter
+    def strategy(self, v):
+        self._strategy = v
+
+    @property
+    def name(self) -> str:
+        self._maybe_hydrate()
+        return self._name
+
+    @name.setter
+    def name(self, v):
+        self._name = v
 
     def ready(self):
         """Returns an ObjectRef resolving when the group is placed (reference API shape)."""
         from . import global_state
 
+        self._maybe_hydrate()
         return global_state.worker().pg_ready_ref(self)
 
     def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        self._maybe_hydrate()
         poll = getattr(self, "_remote_poll", None)
         if poll is not None:
             # Worker-side replica handle: poll the node service.
@@ -67,6 +112,7 @@ class PlacementGroup:
 
     @property
     def is_ready(self) -> bool:
+        self._maybe_hydrate()
         poll = getattr(self, "_remote_poll", None)
         if poll is not None:
             data = poll(self.id)
@@ -92,20 +138,19 @@ def _restore_pg(pg_id):
                     return p
     pg = PlacementGroup.__new__(PlacementGroup)
     pg.id = pg_id
-    pg.bundle_specs = []
-    pg.strategy = "PACK"
-    pg.name = ""
+    pg._bundle_specs = []
+    pg._strategy = "PACK"
+    pg._name = ""
     pg._ready_event = threading.Event()
     pg._failed = None
     w = global_state.try_worker()
     if w is not None and cluster is None:
-        # Worker process: hydrate from the node service and poll through it.
-        data = w.lookup_placement_group(pg_id)
-        if data is not None:
-            pg.bundle_specs, pg.strategy, pg.name = data[0], data[1], data[2]
-            if data[3] and not data[4]:
-                pg._ready_event.set()
+        # Worker process. CRITICAL: no runtime calls here — unpickling happens on
+        # the worker's recv/demux thread, and a _request() from that thread
+        # deadlocks (it is the only thread that can deliver the reply). Hydration
+        # from the node service is deferred to first use (_maybe_hydrate).
         pg._remote_poll = lambda pid: w.lookup_placement_group(pid)
+        pg._needs_hydration = True
     return pg
 
 

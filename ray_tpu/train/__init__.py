@@ -15,8 +15,15 @@ from ..air.config import (  # noqa: F401
 )
 from .backend import Backend, BackendConfig  # noqa: F401
 from .checkpoint import Checkpoint  # noqa: F401
-from .data_parallel_trainer import DataParallelTrainer, JaxTrainer  # noqa: F401
+from .data_parallel_trainer import DataParallelTrainer, JaxTrainer, TorchTrainer  # noqa: F401
 from .jax_backend import JaxBackend, JaxConfig  # noqa: F401
+from .torch_backend import TorchBackend, TorchConfig  # noqa: F401
+from . import torch_backend as torch  # noqa: F401  (ray_tpu.train.torch.prepare_model)
+
+# reference import shape: `from ray_tpu.train.torch import prepare_model`
+import sys as _sys
+
+_sys.modules[__name__ + ".torch"] = torch
 from .result import Result  # noqa: F401
 from .session import (  # noqa: F401
     TrainContext,

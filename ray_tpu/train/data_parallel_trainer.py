@@ -19,6 +19,7 @@ from ..air.config import RunConfig, ScalingConfig
 from .backend import BackendConfig
 from .backend_executor import BackendExecutor
 from .checkpoint import Checkpoint
+from .torch_backend import TorchConfig
 from .checkpoint_manager import CheckpointManager
 from .jax_backend import JaxConfig
 from .result import Result
@@ -96,3 +97,11 @@ class JaxTrainer(DataParallelTrainer):
     """Train-shaped JAX trainer (north star: SURVEY.md §7 phase 3)."""
 
     _default_backend_config = JaxConfig
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Torch trainer over a gloo process group (reference TorchTrainer,
+    python/ray/train/torch/torch_trainer.py; CPU torch — the TPU path is
+    JaxTrainer). DDP wrap via ray_tpu.train.torch.prepare_model."""
+
+    _default_backend_config = TorchConfig

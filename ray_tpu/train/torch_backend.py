@@ -1,0 +1,147 @@
+"""TorchConfig / TorchBackend: torch.distributed process-group bootstrap.
+
+Capability parity: reference python/ray/train/torch/config.py — TorchConfig
+(:36), _TorchBackend (:153), _setup_torch_process_group (:66, dist.init_process_
+group :115 with a TCP store on the rank-0 worker). CPU-torch is the supported
+device here (the TPU compute path is JaxTrainer); the gloo group gives reference-
+faithful DDP semantics for torch user code.
+"""
+from __future__ import annotations
+
+import datetime
+import socket
+from dataclasses import dataclass
+from typing import Dict, Optional, Type
+
+from .backend import Backend, BackendConfig
+from .worker_group import WorkerGroup
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"  # NCCL has no place on TPU hosts (SURVEY.md §2.3)
+    timeout_s: int = 1800
+    env: Optional[Dict[str, str]] = None
+
+    @property
+    def backend_cls(self) -> Type["TorchBackend"]:
+        return TorchBackend
+
+
+from .jax_backend import _pick_port  # same rank-0 port-pick as the jax backend
+
+
+def _setup_torch_process_group(backend: str, init_method: str, world_size: int,
+                               rank: int, timeout_s: int) -> None:
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return
+    dist.init_process_group(
+        backend=backend,
+        init_method=init_method,
+        world_size=world_size,
+        rank=rank,
+        timeout=datetime.timedelta(seconds=timeout_s),
+    )
+
+
+def _teardown_torch_process_group() -> None:
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class TorchBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, backend_config: TorchConfig) -> None:
+        envs = []
+        for rank in range(len(worker_group)):
+            env = {
+                "RAY_TPU_TRAIN_WORLD_SIZE": str(len(worker_group)),
+                "RAY_TPU_TRAIN_RANK": str(rank),
+                "GLOO_SOCKET_IFNAME": "lo",
+            }
+            if backend_config.env:
+                env.update(backend_config.env)
+            envs.append(env)
+        worker_group.set_env(envs)
+
+        # TCP rendezvous on the rank-0 worker's host (reference: TCP store there).
+        # Single-host deployment: loopback avoids gloo interface-selection hangs in
+        # sandboxed/multi-homed environments; GLOO_SOCKET_IFNAME pins the transport.
+        port = worker_group.execute_single(0, _pick_port)
+        url = f"tcp://127.0.0.1:{port}"
+        import ray_tpu
+
+        refs = [
+            w.run_fn.remote(_setup_torch_process_group, backend_config.backend, url,
+                            len(worker_group), rank, backend_config.timeout_s)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        ray_tpu.get(refs)
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: TorchConfig) -> None:
+        try:
+            worker_group.execute(_teardown_torch_process_group)
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------------ user-loop API
+
+def get_device():
+    """Reference ray.train.torch.get_device — CPU on TPU hosts."""
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model, *, wrap_ddp: Optional[bool] = None):
+    """Wrap the model in DDP when the process group spans >1 worker
+    (reference ray.train.torch.prepare_model)."""
+    import torch.distributed as dist
+
+    if wrap_ddp is None:
+        wrap_ddp = dist.is_initialized() and dist.get_world_size() > 1
+    if not wrap_ddp:
+        return model
+    from torch.nn.parallel import DistributedDataParallel
+
+    return DistributedDataParallel(model)
+
+
+def prepare_data_loader(data_loader):
+    """Re-build the DataLoader with a DistributedSampler so each worker sees its
+    shard (reference ray.train.torch.prepare_data_loader)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, SequentialSampler, RandomSampler
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not dist.is_initialized() or dist.get_world_size() <= 1:
+        return data_loader
+    if data_loader.batch_size is None:
+        # custom batch_sampler: we cannot infer how to re-shard batched sampling
+        raise NotImplementedError(
+            "prepare_data_loader does not support DataLoaders built with "
+            "batch_sampler; construct the DistributedSampler yourself")
+    if not isinstance(data_loader.sampler, (SequentialSampler, RandomSampler,
+                                            DistributedSampler)):
+        raise NotImplementedError(
+            "prepare_data_loader would discard the DataLoader's custom sampler "
+            f"({type(data_loader.sampler).__name__}); shard it explicitly instead")
+    sampler = DistributedSampler(
+        data_loader.dataset,
+        shuffle=isinstance(data_loader.sampler, RandomSampler),
+    )
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last,
+        timeout=data_loader.timeout,
+        worker_init_fn=data_loader.worker_init_fn,
+    )
