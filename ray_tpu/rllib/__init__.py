@@ -7,6 +7,7 @@ from .algorithms.algorithm import Algorithm  # noqa: F401
 from .algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
 from .algorithms.dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
 from .algorithms.ppo import PPO, PPOConfig, PPOLearner  # noqa: F401
+from .algorithms.sac import SAC, SACConfig, SACLearner  # noqa: F401
 from .connectors import ConnectorPipelineV2, ConnectorV2, GeneralAdvantageEstimation  # noqa: F401
 from .core.learner import Learner  # noqa: F401
 from .core.learner_group import LearnerGroup  # noqa: F401

@@ -1,0 +1,197 @@
+"""SAC: soft actor-critic for continuous control.
+
+Capability parity: reference rllib/algorithms/sac/ (sac.py + sac_torch_learner's
+twin-Q critic loss, reparameterized actor loss, auto-tuned temperature). One
+jitted update computes all three losses; per-branch stop-gradients on parameter
+leaves (not activations) keep each loss updating only its own network while the
+reparameterized action gradient still flows through the critics into the policy.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..core.learner import Learner
+from ..core.rl_module import SACModule
+from ..utils.replay_buffer import ReplayBuffer
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class: type = None):
+        super().__init__(algo_class or SAC)
+        self.rl_module_class = SACModule
+        self.replay_buffer_capacity: int = 100_000
+        self.num_steps_sampled_before_learning_starts: int = 500
+        self.tau: float = 0.005  # polyak target update
+        self.n_step: int = 1
+        self.initial_alpha: float = 1.0
+        self.target_entropy: str | float = "auto"  # auto = -act_dim
+        # SAC wants ~1 gradient update per env step (reference training_intensity)
+        self.num_updates_per_iteration: int = 256
+        self.sample_timesteps_per_iteration: int = 256
+        self.train_batch_size = 256
+        self.lr = 3e-4
+        self.num_epochs = 1
+
+    def training(self, *, replay_buffer_capacity=None,
+                 num_steps_sampled_before_learning_starts=None, tau=None,
+                 n_step=None, initial_alpha=None, target_entropy=None,
+                 num_updates_per_iteration=None,
+                 sample_timesteps_per_iteration=None, **kwargs) -> "SACConfig":
+        for k, v in dict(
+            replay_buffer_capacity=replay_buffer_capacity,
+            num_steps_sampled_before_learning_starts=num_steps_sampled_before_learning_starts,
+            tau=tau, n_step=n_step, initial_alpha=initial_alpha,
+            target_entropy=target_entropy,
+            num_updates_per_iteration=num_updates_per_iteration,
+            sample_timesteps_per_iteration=sample_timesteps_per_iteration,
+        ).items():
+            if v is not None:
+                setattr(self, k, v)
+        super().training(**kwargs)
+        return self
+
+
+class SACLearner(Learner):
+    def build(self) -> None:
+        import jax
+
+        super().build()
+        self.params["log_alpha"] = np.float32(np.log(self.config.initial_alpha))
+        self.opt_state = self.optimizer.init(self.params)  # re-init with alpha set
+        self.target_params = {
+            "q1": jax.tree_util.tree_map(np.array, self.params["q1"]),
+            "q2": jax.tree_util.tree_map(np.array, self.params["q2"]),
+        }
+        te = self.config.target_entropy
+        self._target_entropy = float(-self.module.act_dim if te == "auto" else te)
+        self._rng = jax.random.PRNGKey(self.config.seed or 0)
+
+    def _build_update_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        module = self.module
+
+        def loss_fn(params, target_params, batch, rng, target_ent):
+            sg = jax.lax.stop_gradient
+            sg_tree = lambda t: jax.tree_util.tree_map(sg, t)  # noqa: E731
+            r1, r2 = jax.random.split(rng)
+            alpha = jnp.exp(params["log_alpha"])
+
+            # critic loss: targets from target nets + current policy at s'
+            next_a, next_logp = module.sample_action_jax(sg_tree(params), batch["next_obs"], r1)
+            tq1 = module.q_jax(target_params, "q1", batch["next_obs"], next_a)
+            tq2 = module.q_jax(target_params, "q2", batch["next_obs"], next_a)
+            target_v = jnp.minimum(tq1, tq2) - sg(alpha) * next_logp
+            target = sg(batch["rewards"]
+                        + (cfg.gamma ** cfg.n_step) * (1.0 - batch["dones"]) * target_v)
+            q1 = module.q_jax(params, "q1", batch["obs"], batch["actions"])
+            q2 = module.q_jax(params, "q2", batch["obs"], batch["actions"])
+            critic_loss = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+            # actor loss: reparameterized action through FROZEN critics
+            frozen = {**params, "q1": sg_tree(params["q1"]), "q2": sg_tree(params["q2"])}
+            a_new, logp = module.sample_action_jax(params, batch["obs"], r2)
+            q_pi = jnp.minimum(module.q_jax(frozen, "q1", batch["obs"], a_new),
+                               module.q_jax(frozen, "q2", batch["obs"], a_new))
+            actor_loss = jnp.mean(sg(alpha) * logp - q_pi)
+
+            # temperature: drive policy entropy toward the target
+            alpha_loss = -jnp.mean(
+                params["log_alpha"] * sg(logp + target_ent))
+
+            total = critic_loss + actor_loss + alpha_loss
+            aux = {
+                "critic_loss": critic_loss,
+                "actor_loss": actor_loss,
+                "alpha_loss": alpha_loss,
+                "alpha": alpha,
+                "mean_q": jnp.mean(q1),
+                "mean_logp": jnp.mean(logp),
+            }
+            return total, aux
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        @jax.jit
+        def update(params, target_params, batch, rng, target_ent):
+            (loss, aux), grads = grad_fn(params, target_params, batch, rng, target_ent)
+            return loss, aux, grads
+
+        return update
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+        import optax
+
+        self._rng, sub = jax.random.split(self._rng)
+        loss, aux, grads = self._update_fn(self.params, self.target_params, batch,
+                                           sub, self._target_entropy)
+        grads = self._sync_grads(grads)
+        updates, self.opt_state = self.optimizer.update(grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        self.params = jax.tree_util.tree_map(np.asarray, self.params)
+        # polyak target update
+        tau = self.config.tau
+        for which in ("q1", "q2"):
+            self.target_params[which] = jax.tree_util.tree_map(
+                lambda t, p: np.asarray((1 - tau) * t + tau * p),
+                self.target_params[which], self.params[which])
+        self.metrics = {"total_loss": float(loss),
+                        **{k: float(v) for k, v in aux.items()}}
+        return self.metrics
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.params, "opt_state": self.opt_state,
+                "target_params": self.target_params}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        if state.get("target_params") is not None:
+            self.target_params = state["target_params"]
+
+
+class SAC(Algorithm):
+    learner_class = SACLearner
+
+    @classmethod
+    def get_default_config(cls) -> SACConfig:
+        return SACConfig(cls)
+
+    def setup(self, _config) -> None:
+        super().setup(_config)
+        cfg = self._algo_config
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, n_step=cfg.n_step,
+                                   gamma=cfg.gamma)
+        self._rng = np.random.default_rng(cfg.seed or 0)
+        self._env_steps = 0
+
+    def save_checkpoint(self) -> Any:
+        state = super().save_checkpoint()
+        state["env_steps"] = self._env_steps
+        return state
+
+    def load_checkpoint(self, state: Any) -> None:
+        super().load_checkpoint(state)
+        self._env_steps = int(state.get("env_steps", 0))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._algo_config
+        episodes = self.env_runner_group.sample(cfg.sample_timesteps_per_iteration)
+        self._env_steps += self.buffer.add_episodes(episodes)
+        for m in self.env_runner_group.get_metrics():
+            self.metrics.log_dict({k: v for k, v in m.items() if v is not None}, window=20)
+        if len(self.buffer) >= cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size, self._rng)
+                for lm in self.learner_group.update(batch):
+                    self.metrics.log_dict(lm)
+            self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        result = self.metrics.reduce()
+        result["num_env_steps_sampled_lifetime"] = self._env_steps
+        return result

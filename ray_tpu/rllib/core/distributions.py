@@ -68,6 +68,92 @@ class Categorical(Distribution):
         return logits.argmax(axis=-1)
 
 
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+# numeric guards for the tanh change-of-variables (shared by every squashed-logp path)
+TANH_CLIP = 0.999999
+SQUASH_EPS = 1e-9
+
+
+def squashed_logp_from_u_jax(u, t, mu, log_std, low, high):
+    """log p(a) for a = low + (tanh(u)+1)/2*(high-low), u ~ N(mu, exp(log_std)).
+
+    THE single jax implementation of the tanh-Gaussian change of variables —
+    used by SquashedGaussian.logp_jax and SACModule.sample_action_jax so the
+    env-runner, learner, and reparameterized-actor log-probs cannot drift.
+    """
+    import jax.numpy as jnp
+
+    std = jnp.exp(log_std)
+    span = (high - low) * 0.5
+    base = -0.5 * (((u - mu) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+    corr = jnp.log(jnp.maximum(span * (1 - t**2), SQUASH_EPS))
+    return (base - corr).sum(-1)
+
+
+class SquashedGaussian(Distribution):
+    """tanh-squashed diagonal Gaussian scaled to the action bounds (SAC).
+
+    dist_inputs: [B, 4A] — mu, log_std, low, high (bounds ride the inputs the
+    same way EpsilonGreedyQ carries epsilon, keeping the distribution stateless).
+    """
+
+    @staticmethod
+    def _split(x):
+        a = x.shape[-1] // 4
+        return x[..., :a], np.clip(x[..., a:2 * a], LOG_STD_MIN, LOG_STD_MAX), \
+            x[..., 2 * a:3 * a], x[..., 3 * a:]
+
+    @staticmethod
+    def _scale(u, low, high):
+        return low + (np.tanh(u) + 1.0) * 0.5 * (high - low)
+
+    @staticmethod
+    def sample_np(inputs: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        mu, log_std, low, high = SquashedGaussian._split(inputs)
+        u = mu + np.exp(log_std) * rng.standard_normal(mu.shape)
+        return SquashedGaussian._scale(u, low, high).astype(np.float32)
+
+    @staticmethod
+    def greedy_np(inputs: np.ndarray) -> np.ndarray:
+        mu, _, low, high = SquashedGaussian._split(inputs)
+        return SquashedGaussian._scale(mu, low, high).astype(np.float32)
+
+    @staticmethod
+    def logp_np(inputs: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        mu, log_std, low, high = SquashedGaussian._split(inputs)
+        span = (high - low) * 0.5
+        t = np.clip((actions - low) / np.maximum(high - low, 1e-9) * 2 - 1,
+                    -0.999999, 0.999999)
+        u = np.arctanh(t)
+        std = np.exp(log_std)
+        base = -0.5 * (((u - mu) / std) ** 2 + 2 * log_std + np.log(2 * np.pi))
+        # change of variables: da = span * (1 - tanh(u)^2) du
+        corr = np.log(np.maximum(span * (1 - t**2), 1e-9))
+        return (base - corr).sum(-1).astype(np.float32)
+
+    @staticmethod
+    def logp_jax(inputs, actions):
+        import jax.numpy as jnp
+
+        a = inputs.shape[-1] // 4
+        mu, log_std = inputs[..., :a], jnp.clip(inputs[..., a:2 * a],
+                                                LOG_STD_MIN, LOG_STD_MAX)
+        low, high = inputs[..., 2 * a:3 * a], inputs[..., 3 * a:]
+        t = jnp.clip((actions - low) / jnp.maximum(high - low, SQUASH_EPS) * 2 - 1,
+                     -TANH_CLIP, TANH_CLIP)
+        u = jnp.arctanh(t)
+        return squashed_logp_from_u_jax(u, t, mu, log_std, low, high)
+
+    @staticmethod
+    def entropy_jax(inputs):
+        import jax.numpy as jnp
+
+        a = inputs.shape[-1] // 4
+        log_std = jnp.clip(inputs[..., a:2 * a], LOG_STD_MIN, LOG_STD_MAX)
+        # pre-squash gaussian entropy (the squash correction has no closed form)
+        return (log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e)).sum(-1)
+
+
 class EpsilonGreedyQ(Distribution):
     """Epsilon-greedy over Q-values (DQN exploration).
 

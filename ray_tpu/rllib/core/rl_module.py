@@ -149,6 +149,103 @@ class MLPModule(RLModule):
         return {Columns.ACTION_DIST_INPUTS: logits, Columns.VF_PREDS: vf}
 
 
+class SACModule(RLModule):
+    """Squashed-Gaussian policy + twin Q critics for continuous control (SAC).
+
+    Params: {"pi": mlp(obs -> 2A), "q1"/"q2": mlp([obs, act] -> 1),
+    "log_alpha": scalar temperature (auto-tuned by the learner)}.
+    """
+
+    def __init__(self, observation_space, action_space, model_config):
+        super().__init__(observation_space, action_space, model_config)
+        import gymnasium as gym
+
+        if not isinstance(action_space, gym.spaces.Box):
+            raise ValueError("SACModule requires a Box action space")
+        self.hiddens = tuple(model_config.get("fcnet_hiddens", (64, 64)))
+        self.obs_dim = int(np.prod(observation_space.shape))
+        self.act_dim = int(np.prod(action_space.shape))
+        self.low = np.asarray(action_space.low, np.float32).reshape(-1)
+        self.high = np.asarray(action_space.high, np.float32).reshape(-1)
+        if not (np.isfinite(self.low).all() and np.isfinite(self.high).all()):
+            raise ValueError(
+                "SACModule requires finite action bounds (tanh squashing scales to "
+                "[low, high]); wrap the env with a bounded Box action space")
+
+    @property
+    def action_dist_cls(self):
+        from .distributions import SquashedGaussian
+
+        return SquashedGaussian
+
+    def init_params(self, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        return {
+            "pi": _mlp_init(rng, (self.obs_dim, *self.hiddens, 2 * self.act_dim)),
+            "q1": _mlp_init(rng, (self.obs_dim + self.act_dim, *self.hiddens, 1)),
+            "q2": _mlp_init(rng, (self.obs_dim + self.act_dim, *self.hiddens, 1)),
+            "log_alpha": np.float32(0.0),
+        }
+
+    def _bounds_np(self, b):
+        return (np.broadcast_to(self.low, (b, self.act_dim)),
+                np.broadcast_to(self.high, (b, self.act_dim)))
+
+    def apply_np(self, params, obs):
+        obs = obs.reshape(len(obs), -1).astype(np.float32)
+        out = _mlp_apply_np(params["pi"], obs)
+        low, high = self._bounds_np(len(obs))
+        return {
+            Columns.ACTION_DIST_INPUTS: np.concatenate([out, low, high], axis=1),
+            Columns.VF_PREDS: np.zeros(len(obs), np.float32),
+        }
+
+    def apply_jax(self, params, obs):
+        import jax.numpy as jnp
+
+        obs = obs.reshape(len(obs), -1)
+        out = _mlp_apply_jax(params["pi"], obs)
+        low = jnp.broadcast_to(jnp.asarray(self.low), (obs.shape[0], self.act_dim))
+        high = jnp.broadcast_to(jnp.asarray(self.high), (obs.shape[0], self.act_dim))
+        return {
+            Columns.ACTION_DIST_INPUTS: jnp.concatenate([out, low, high], axis=1),
+            Columns.VF_PREDS: jnp.zeros(obs.shape[0], jnp.float32),
+        }
+
+    # -- learner-side pieces -----------------------------------------------------
+    def pi_jax(self, params, obs):
+        """(mu, log_std) of the pre-squash Gaussian."""
+        import jax.numpy as jnp
+
+        from .distributions import LOG_STD_MAX, LOG_STD_MIN
+
+        out = _mlp_apply_jax(params["pi"], obs.reshape(len(obs), -1))
+        mu, log_std = out[..., : self.act_dim], out[..., self.act_dim:]
+        return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_action_jax(self, params, obs, rng):
+        """Reparameterized squashed sample + its log-prob (for actor/critic losses)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .distributions import squashed_logp_from_u_jax
+
+        mu, log_std = self.pi_jax(params, obs)
+        std = jnp.exp(log_std)
+        u = mu + std * jax.random.normal(rng, mu.shape)
+        t = jnp.tanh(u)
+        low, high = jnp.asarray(self.low), jnp.asarray(self.high)
+        action = low + (t + 1.0) * 0.5 * (high - low)
+        logp = squashed_logp_from_u_jax(u, t, mu, log_std, low, high)
+        return action, logp
+
+    def q_jax(self, params, which, obs, actions):
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([obs.reshape(len(obs), -1), actions], axis=-1)
+        return _mlp_apply_jax(params[which], x)[..., 0]
+
+
 class DQNModule(RLModule):
     """Q-network for discrete actions (reference dqn_rainbow_rl_module).
 

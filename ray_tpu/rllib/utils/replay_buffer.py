@@ -29,14 +29,15 @@ class ReplayBuffer:
     def __len__(self) -> int:
         return self._size
 
-    def _ensure_storage(self, obs: np.ndarray) -> None:
+    def _ensure_storage(self, obs: np.ndarray, actions: np.ndarray) -> None:
         if self._storage is not None:
             return
         obs_shape = obs.shape[1:]
+        # action dtype/shape follow the env: int64 scalars (DQN) or float vectors (SAC)
         self._storage = {
             "obs": np.zeros((self.capacity, *obs_shape), obs.dtype),
             "next_obs": np.zeros((self.capacity, *obs_shape), obs.dtype),
-            "actions": np.zeros((self.capacity,), np.int64),
+            "actions": np.zeros((self.capacity, *actions.shape[1:]), actions.dtype),
             "rewards": np.zeros((self.capacity,), np.float32),
             "dones": np.zeros((self.capacity,), np.float32),
         }
@@ -73,14 +74,17 @@ class ReplayBuffer:
             dones = np.zeros(t, np.float32)
             if terminal:
                 dones[max(0, t - n):] = 1.0
+            actions = np.asarray(ep["actions"])
+            if actions.dtype.kind in "iu":
+                actions = actions.astype(np.int64)
             rows = {
                 "obs": obs,
                 "next_obs": all_obs[next_idx],
-                "actions": np.asarray(ep["actions"], np.int64),
+                "actions": actions,
                 "rewards": nr,
                 "dones": dones,
             }
-            self._ensure_storage(obs)
+            self._ensure_storage(obs, actions)
             if t > self.capacity:  # only the last `capacity` rows can survive anyway
                 rows = {k: v[t - self.capacity:] for k, v in rows.items()}
                 t = self.capacity

@@ -71,13 +71,13 @@ def test_state_api_lists(rt):
 
 def test_task_timeline(rt):
     @rt.remote
-    def work(x):
+    def timeline_probe_task(x):
         time.sleep(0.05)
         return x
 
-    rt.get([work.remote(i) for i in range(3)])
+    rt.get([timeline_probe_task.remote(i) for i in range(3)])
     events = rs.timeline()
-    mine = [e for e in events if e["name"] == "work"]
+    mine = [e for e in events if e["name"] == "timeline_probe_task"]
     assert len(mine) >= 3
     for e in mine:
         assert e["ph"] == "X" and e["dur"] >= 0.04e6
