@@ -1,0 +1,105 @@
+"""Accelerator managers: TPU topology detection + visibility control.
+
+Capability parity: reference python/ray/_private/accelerators/ — the
+`AcceleratorManager` ABC (accelerator.py) and `TPUAcceleratorManager` (tpu.py:110):
+chip detection, `TPU_VISIBLE_CHIPS` (tpu.py:118-122), pod-type resources like
+"TPU-v5e-8-head" (tpu.py:376) so slice-spanning placement groups can reserve a
+whole pod slice atomically. GPU managers are intentionally absent: no GPU
+anywhere in the loop (BASELINE.md).
+
+Detection sources, in order: explicit env overrides (TPU_ACCELERATOR_TYPE /
+TPU_CHIPS_PER_HOST), the TPU runtime's env (set on GCE TPU-VMs), and finally a
+live jax backend query when jax is already imported and bound to TPU.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+
+
+@dataclass
+class TPUInfo:
+    chips_per_host: int
+    accelerator_type: str  # e.g. "v5e-8" (slice), "" if unknown
+    worker_id: int  # host index within the slice
+    num_hosts: int
+
+    @property
+    def pod_head_resource(self) -> Optional[str]:
+        """The reference's `TPU-{pod}-head` trick: worker 0 of a slice carries one
+        unit so a slice-wide placement group anchors atomically (tpu.py:376)."""
+        if self.accelerator_type and self.worker_id == 0:
+            return f"TPU-{self.accelerator_type}-head"
+        return None
+
+
+class TPUAcceleratorManager:
+    """TPU detection + resource shaping (reference tpu.py:110)."""
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        visible = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+        if visible is not None:
+            return len([c for c in visible.split(",") if c.strip() != ""])
+        env_chips = os.environ.get("TPU_CHIPS_PER_HOST")
+        if env_chips:
+            return int(env_chips)
+        # TPU-VM runtime convention: bounds like "2,2,1" = 4 chips on this host
+        bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+        if bounds:
+            n = 1
+            for part in bounds.split(","):
+                n *= int(part)
+            return n
+        # live jax query, only if jax is already imported and on TPU (importing jax
+        # here would grab the TPU runtime as a side effect of mere detection)
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                if jax.default_backend() == "tpu":
+                    return len(jax.local_devices())
+            except Exception:
+                pass
+        return 0
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> str:
+        t = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        return t
+
+    @staticmethod
+    def detect() -> Optional[TPUInfo]:
+        chips = TPUAcceleratorManager.get_current_node_num_accelerators()
+        if chips <= 0:
+            return None
+        return TPUInfo(
+            chips_per_host=chips,
+            accelerator_type=TPUAcceleratorManager.get_current_node_accelerator_type(),
+            worker_id=int(os.environ.get("TPU_WORKER_ID", "0")),
+            num_hosts=int(os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") + 1
+                          if os.environ.get("TPU_WORKER_HOSTNAMES") else 1),
+        )
+
+    @staticmethod
+    def set_visible_chips(chip_ids) -> None:
+        """Restrict this process to specific chips (reference TPU_VISIBLE_CHIPS)."""
+        os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(c) for c in chip_ids)
+
+    @staticmethod
+    def node_resources() -> Dict[str, float]:
+        """Resources this node should advertise for its TPUs."""
+        info = TPUAcceleratorManager.detect()
+        if info is None:
+            return {}
+        out: Dict[str, float] = {"TPU": float(info.chips_per_host)}
+        head = info.pod_head_resource
+        if head:
+            out[head] = 1.0
+        if info.accelerator_type:
+            out[f"accelerator_type:TPU-{info.accelerator_type.split('-')[0].upper()}"] = 1.0
+        return out

@@ -38,9 +38,21 @@ def init(
         raise RuntimeError("ray_tpu.init() called twice")
     if num_cpus is None:
         num_cpus = float(os.environ.get("RAY_TPU_NUM_CPUS", os.cpu_count() or 1))
+    detected: Dict[str, float] = {}
     if num_tpus is None:
-        num_tpus = float(os.environ.get("RAY_TPU_NUM_TPUS", "0"))
+        env_tpus = os.environ.get("RAY_TPU_NUM_TPUS")
+        if env_tpus is not None:
+            num_tpus = float(env_tpus)
+        else:
+            # auto-detect TPU chips + pod-slice head resources (reference
+            # TPUAcceleratorManager; core/accelerators.py)
+            from .accelerators import TPUAcceleratorManager
+
+            detected = TPUAcceleratorManager.node_resources()
+            num_tpus = detected.pop("TPU", 0.0)
     total = normalize_resources(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+    for k, v in detected.items():
+        total.setdefault(k, v)
     kwargs: Dict[str, Any] = {}
     if max_workers_per_node is not None:
         kwargs["max_workers_per_node"] = max_workers_per_node

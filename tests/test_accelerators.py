@@ -1,0 +1,62 @@
+"""TPU accelerator manager tests (reference _private/accelerators/tpu.py)."""
+import pytest
+
+from ray_tpu.core.accelerators import TPUAcceleratorManager, TPUInfo
+
+
+def test_detect_none_without_env(monkeypatch):
+    for var in ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_HOST",
+                "TPU_CHIPS_PER_HOST_BOUNDS", "TPU_ACCELERATOR_TYPE"):
+        monkeypatch.delenv(var, raising=False)
+    assert TPUAcceleratorManager.detect() is None
+    assert TPUAcceleratorManager.node_resources() == {}
+
+
+def test_detect_from_env(monkeypatch):
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    info = TPUAcceleratorManager.detect()
+    assert info.chips_per_host == 4
+    assert info.accelerator_type == "v5e-8"
+    assert info.pod_head_resource == "TPU-v5e-8-head"
+    res = TPUAcceleratorManager.node_resources()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v5e-8-head"] == 1.0
+
+
+def test_non_head_worker_has_no_head_resource(monkeypatch):
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST", "4")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-16")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    res = TPUAcceleratorManager.node_resources()
+    assert res["TPU"] == 4.0
+    assert "TPU-v5e-16-head" not in res  # only worker 0 anchors the slice
+
+
+def test_visible_chips_override(monkeypatch):
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST", "8")
+    TPUAcceleratorManager.set_visible_chips([0, 1])
+    try:
+        assert TPUAcceleratorManager.get_current_node_num_accelerators() == 2
+    finally:
+        monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+
+
+def test_slice_spanning_placement_group(rt):
+    """The TPU-{pod}-head trick: a PG anchored on the head resource reserves the
+    slice atomically (reference tpu.py:376 + SURVEY.md §7 phase 2)."""
+    from ray_tpu.core import global_state
+    from ray_tpu.util import placement_group_api as pg_api
+
+    cluster = global_state.try_cluster()
+    node = cluster.add_node({"CPU": 4.0, "TPU": 8.0, "TPU-v5e-8-head": 1.0})
+    try:
+        pg = pg_api.placement_group(
+            [{"TPU-v5e-8-head": 1.0, "TPU": 4.0}, {"TPU": 4.0}], strategy="STRICT_PACK")
+        assert pg.wait(timeout_seconds=30)
+        bundles = cluster.pg_manager.bundles(pg.id)
+        assert all(b.node_id == node.node_id for b in bundles)  # whole slice, one host
+        pg_api.remove_placement_group(pg)
+    finally:
+        cluster.remove_node(node.node_id)
