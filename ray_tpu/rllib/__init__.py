@@ -5,7 +5,11 @@ Learner/LearnerGroup, RLModule, EnvRunner(Group), ConnectorV2, PPO.
 """
 from .algorithms.algorithm import Algorithm  # noqa: F401
 from .algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from .algorithms.appo import APPO, APPOConfig, APPOLearner  # noqa: F401
+from .algorithms.cql import CQL, CQLConfig, CQLLearner  # noqa: F401
 from .algorithms.dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
+from .algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner  # noqa: F401
+from .algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig, MARWILLearner  # noqa: F401
 from .algorithms.ppo import PPO, PPOConfig, PPOLearner  # noqa: F401
 from .algorithms.sac import SAC, SACConfig, SACLearner  # noqa: F401
 from .connectors import ConnectorPipelineV2, ConnectorV2, GeneralAdvantageEstimation  # noqa: F401

@@ -43,21 +43,30 @@ class Algorithm(tune.Trainable):
         record_library_usage("rllib")
         cfg = self._algo_config
         self.metrics = MetricsLogger()
-        self.env_runner_group = EnvRunnerGroup(cfg)
-        import gymnasium as gym
-
-        probe = cfg.env_maker()()
+        if cfg.env is not None:
+            self.env_runner_group = EnvRunnerGroup(cfg)
+            probe = cfg.env_maker()()
+            obs_space, act_space = probe.observation_space, probe.action_space
+            probe.close()
+        else:
+            # offline mode (reference offline algos): spaces come from the config
+            self.env_runner_group = None
+            obs_space, act_space = cfg.observation_space, cfg.action_space
+            if obs_space is None or act_space is None:
+                raise ValueError(
+                    "offline algorithms need .environment(observation_space=..., action_space=...)"
+                )
         self.module_spec = RLModuleSpec(
             module_class=cfg.rl_module_class,
-            observation_space=probe.observation_space,
-            action_space=probe.action_space,
+            observation_space=obs_space,
+            action_space=act_space,
             model_config=cfg.model_config,
         )
-        probe.close()
         self.learner_group = LearnerGroup(cfg, self.module_spec, self.learner_class)
         # host-side module copy for connectors (GAE bootstrap values)
         self._module = self.module_spec.build()
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        if self.env_runner_group is not None:
+            self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
     def step(self) -> Dict[str, Any]:
         return self.training_step()
@@ -70,11 +79,13 @@ class Algorithm(tune.Trainable):
 
     def load_checkpoint(self, state: Any) -> None:
         self.learner_group.set_state(state["learner"])
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        if self.env_runner_group is not None:
+            self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
     def cleanup(self) -> None:
         try:
-            self.env_runner_group.stop()
+            if self.env_runner_group is not None:
+                self.env_runner_group.stop()
         finally:
             self.learner_group.shutdown()
 
@@ -85,6 +96,8 @@ class Algorithm(tune.Trainable):
         return self.learner_group.get_weights()
 
     def evaluate(self, num_timesteps: int = 1000) -> Dict[str, Any]:
+        if self.env_runner_group is None:
+            return {"evaluation": {"episode_return_mean": None}}
         eps = self.env_runner_group.sample(num_timesteps, explore=False)
         rets = [float(e["rewards"].sum()) for e in eps if e["terminated"] or e["truncated"]]
         return {"evaluation": {"episode_return_mean": float(np.mean(rets)) if rets else None}}

@@ -33,16 +33,34 @@ class AlgorithmConfig:
         # module
         self.model_config: Dict[str, Any] = {}
         self.rl_module_class: Optional[type] = None
+        # offline (reference offline_data.py)
+        self.input_: Any = None  # parquet/json path(s)
+        self.input_dataset: Any = None  # pre-built ray_tpu.data Dataset
+        self.observation_space: Any = None  # offline mode: spaces given, no env probe
+        self.action_space: Any = None
         # misc
         self.seed: Optional[int] = 0
         self.explore: bool = True
 
     # -- fluent sections (reference algorithm_config.py) -----------------------
-    def environment(self, env=None, *, env_config: Optional[Dict] = None) -> "AlgorithmConfig":
+    def environment(self, env=None, *, env_config: Optional[Dict] = None,
+                    observation_space=None, action_space=None) -> "AlgorithmConfig":
         if env is not None:
             self.env = env
         if env_config is not None:
             self.env_config = dict(env_config)
+        if observation_space is not None:
+            self.observation_space = observation_space
+        if action_space is not None:
+            self.action_space = action_space
+        return self
+
+    def offline_data(self, *, input_=None, dataset=None, **_compat) -> "AlgorithmConfig":
+        """Offline-RL input (reference AlgorithmConfig.offline_data / offline_data.py:30)."""
+        if input_ is not None:
+            self.input_ = input_
+        if dataset is not None:
+            self.input_dataset = dataset
         return self
 
     def env_runners(

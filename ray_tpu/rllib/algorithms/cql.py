@@ -1,0 +1,188 @@
+"""CQL: conservative Q-learning for offline continuous control.
+
+Capability parity: reference rllib/algorithms/cql/ — SAC's twin-Q losses plus the
+CQL(H) conservative regularizer (importance-sampled logsumexp of Q over random +
+policy actions minus Q on dataset actions, Kumar et al. 2020) and `bc_iters`
+warm-start (actor imitates the dataset before switching to the Q-maximizing loss).
+Offline input via OfflineData; no env runners.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..offline import OfflineData
+from .sac import SAC, SACConfig, SACLearner
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class: type = None):
+        super().__init__(algo_class or CQL)
+        self.min_q_weight: float = 5.0
+        self.num_cql_actions: int = 4  # sampled actions per logsumexp branch
+        self.bc_iters: int = 200
+        self.num_updates_per_iteration = 64
+
+    def training(self, *, min_q_weight=None, num_cql_actions=None, bc_iters=None, **kwargs):
+        for k, v in dict(min_q_weight=min_q_weight, num_cql_actions=num_cql_actions,
+                         bc_iters=bc_iters).items():
+            if v is not None:
+                setattr(self, k, v)
+        super().training(**kwargs)
+        return self
+
+
+class CQLLearner(SACLearner):
+    def build(self) -> None:
+        super().build()
+        self._num_updates = 0
+
+    def _build_update_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        module = self.module
+
+        def q_many(params, which, obs, actions_n):
+            """Q over N actions per state: obs [B,D], actions_n [N,B,A] -> [N,B]."""
+            N = actions_n.shape[0]
+            B = obs.shape[0]
+            obs_rep = jnp.broadcast_to(obs[None], (N,) + obs.shape).reshape(N * B, -1)
+            q = module.q_jax(params, which, obs_rep, actions_n.reshape(N * B, -1))
+            return q.reshape(N, B)
+
+        def loss_fn(params, target_params, batch, rng, target_ent, use_bc):
+            sg = jax.lax.stop_gradient
+            sg_tree = lambda t: jax.tree_util.tree_map(sg, t)  # noqa: E731
+            r1, r2, r3, r4, r5 = jax.random.split(rng, 5)
+            alpha = jnp.exp(params["log_alpha"])
+            B = batch["obs"].shape[0]
+            N = cfg.num_cql_actions
+            A = module.act_dim
+
+            # --- standard SAC critic targets ---
+            next_a, next_logp = module.sample_action_jax(sg_tree(params), batch["next_obs"], r1)
+            tq1 = module.q_jax(target_params, "q1", batch["next_obs"], next_a)
+            tq2 = module.q_jax(target_params, "q2", batch["next_obs"], next_a)
+            target_v = jnp.minimum(tq1, tq2) - sg(alpha) * next_logp
+            target = sg(batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * target_v)
+            q1 = module.q_jax(params, "q1", batch["obs"], batch["actions"])
+            q2 = module.q_jax(params, "q2", batch["obs"], batch["actions"])
+            bellman = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+            # --- CQL(H) conservative regularizer ---
+            low, high = jnp.asarray(module.low), jnp.asarray(module.high)
+            rand_a = jax.random.uniform(r2, (N, B, A), minval=low, maxval=high)
+            rand_logp = -jnp.sum(jnp.log(high - low))  # uniform density over the box
+
+            def pi_actions(rng_, obs):
+                obs_rep = jnp.broadcast_to(obs[None], (N,) + obs.shape).reshape(N * B, -1)
+                a, lp = module.sample_action_jax(sg_tree(params), obs_rep, rng_)
+                return a.reshape(N, B, A), lp.reshape(N, B)
+
+            cur_a, cur_lp = pi_actions(r3, batch["obs"])
+            nxt_a, nxt_lp = pi_actions(r4, batch["next_obs"])
+
+            def conservative(which, q_data):
+                q_rand = q_many(params, which, batch["obs"], rand_a) - rand_logp
+                q_cur = q_many(params, which, batch["obs"], cur_a) - sg(cur_lp)
+                q_nxt = q_many(params, which, batch["obs"], nxt_a) - sg(nxt_lp)
+                stacked = jnp.concatenate([q_rand, q_cur, q_nxt], axis=0)  # [3N, B]
+                return jnp.mean(jax.scipy.special.logsumexp(stacked, axis=0) - q_data)
+
+            cql_term = conservative("q1", q1) + conservative("q2", q2)
+            critic_loss = bellman + cfg.min_q_weight * cql_term
+
+            # --- actor: BC warm-start, then SAC objective ---
+            frozen = {**params, "q1": sg_tree(params["q1"]), "q2": sg_tree(params["q2"])}
+            a_new, logp = module.sample_action_jax(params, batch["obs"], r5)
+            q_pi = jnp.minimum(module.q_jax(frozen, "q1", batch["obs"], a_new),
+                               module.q_jax(frozen, "q2", batch["obs"], a_new))
+            sac_actor = jnp.mean(sg(alpha) * logp - q_pi)
+            # BC: maximize logp of the dataset action under the squashed gaussian
+            mu, log_std = module.pi_jax(params, batch["obs"])
+            # invert the squash to score dataset actions (clip to the open interval)
+            t = jnp.clip((batch["actions"] - low) / (high - low) * 2.0 - 1.0, -0.999, 0.999)
+            u = jnp.arctanh(t)
+            from ..core.distributions import squashed_logp_from_u_jax
+
+            data_logp = squashed_logp_from_u_jax(u, t, mu, log_std, low, high)
+            bc_actor = jnp.mean(sg(alpha) * logp - data_logp)
+            actor_loss = jnp.where(use_bc, bc_actor, sac_actor)
+
+            alpha_loss = -jnp.mean(params["log_alpha"] * sg(logp + target_ent))
+            total = critic_loss + actor_loss + alpha_loss
+            aux = {"critic_loss": critic_loss, "actor_loss": actor_loss,
+                   "cql_loss": cql_term, "alpha": alpha, "mean_q": jnp.mean(q1)}
+            return total, aux
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        @jax.jit
+        def update(params, target_params, batch, rng, target_ent, use_bc):
+            (loss, aux), grads = grad_fn(params, target_params, batch, rng, target_ent, use_bc)
+            return loss, aux, grads
+
+        return update
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+        import optax
+
+        self._rng, sub = jax.random.split(self._rng)
+        use_bc = np.bool_(self._num_updates < self.config.bc_iters)
+        loss, aux, grads = self._update_fn(self.params, self.target_params, batch,
+                                           sub, self._target_entropy, use_bc)
+        grads = self._sync_grads(grads)
+        updates, self.opt_state = self.optimizer.update(grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        self.params = jax.tree_util.tree_map(np.asarray, self.params)
+        tau = self.config.tau
+        for which in ("q1", "q2"):
+            self.target_params[which] = jax.tree_util.tree_map(
+                lambda t, p: np.asarray((1 - tau) * t + tau * p),
+                self.target_params[which], self.params[which])
+        self._num_updates += 1
+        self.metrics = {"total_loss": float(loss),
+                        **{k: float(v) for k, v in aux.items()}}
+        return self.metrics
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["num_updates"] = self._num_updates
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        # restore the update counter so a resumed run doesn't redo BC warm-start
+        self._num_updates = int(state.get("num_updates", self.config.bc_iters))
+
+
+class CQL(SAC):
+    learner_class = CQLLearner
+
+    @classmethod
+    def get_default_config(cls) -> CQLConfig:
+        return CQLConfig(cls)
+
+    def setup(self, _config) -> None:
+        from .algorithm import Algorithm
+
+        cfg = self._algo_config
+        # keep the materialized dataset off the config so actors don't get copies
+        ds, cfg.input_dataset = cfg.input_dataset, None
+        # skip SAC.setup: offline CQL has no replay buffer or env-step accounting
+        Algorithm.setup(self, _config)
+        self._rng = np.random.default_rng(cfg.seed or 0)
+        self._env_steps = 0  # SAC.save_checkpoint expects it
+        self.offline_data = OfflineData(cfg, dataset=ds)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._algo_config
+        for _ in range(cfg.num_updates_per_iteration):
+            batch = self.offline_data.sample(cfg.train_batch_size, self._rng)
+            for lm in self.learner_group.update(batch):
+                self.metrics.log_dict(lm)
+        return self.metrics.reduce()

@@ -1,0 +1,304 @@
+"""IMPALA: importance-weighted async actor-learner architecture with V-trace.
+
+Capability parity: reference rllib/algorithms/impala/impala.py:142 — async sampling
+from env-runner actors (in-flight sample() refs collected with wait()), optional
+aggregator actors (`num_aggregator_actors_per_learner`, impala.py:507,635) that pad
+episode chunks into fixed-shape time-major batches, V-trace off-policy correction
+(impala loss; Espeholt et al. 2018), and periodic (not per-update) weight broadcast
+(`broadcast_interval`). TPU-first: the V-trace correction + policy/value/entropy loss
+is one jitted program — the reverse-time recursion is a `lax.scan`, shapes are padded
+to (bucketed B, max_seq_len) so XLA compile caches stay warm.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from ..core.learner import Learner
+from ..core.rl_module import Columns
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class: type = None):
+        super().__init__(algo_class or IMPALA)
+        self.vtrace_clip_rho_threshold: float = 1.0
+        self.vtrace_clip_pg_rho_threshold: float = 1.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.005
+        self.broadcast_interval: int = 1  # learner updates between weight broadcasts
+        self.num_aggregator_actors_per_learner: int = 0
+        self.max_seq_len: int = 64  # pad/split episode chunks to this length
+        self.num_epochs = 1  # IMPALA is single-pass
+        self.minibatch_size = None
+
+    def training(self, *, vtrace_clip_rho_threshold=None, vtrace_clip_pg_rho_threshold=None,
+                 vf_loss_coeff=None, entropy_coeff=None, broadcast_interval=None,
+                 num_aggregator_actors_per_learner=None, max_seq_len=None, **kwargs):
+        for k, v in dict(
+            vtrace_clip_rho_threshold=vtrace_clip_rho_threshold,
+            vtrace_clip_pg_rho_threshold=vtrace_clip_pg_rho_threshold,
+            vf_loss_coeff=vf_loss_coeff, entropy_coeff=entropy_coeff,
+            broadcast_interval=broadcast_interval,
+            num_aggregator_actors_per_learner=num_aggregator_actors_per_learner,
+            max_seq_len=max_seq_len,
+        ).items():
+            if v is not None:
+                setattr(self, k, v)
+        super().training(**kwargs)
+        return self
+
+
+def _split_episode(ep: Dict[str, np.ndarray], max_T: int) -> List[Dict[str, np.ndarray]]:
+    """Split an episode chunk into <=max_T pieces; interior pieces bootstrap."""
+    T = len(ep["rewards"])
+    if T <= max_T:
+        return [ep]
+    out = []
+    for s in range(0, T, max_T):
+        e = s + min(max_T, T - s)
+        last = e == T
+        piece = {
+            "obs": ep["obs"][s:e],
+            # boundary obs: next chunk's first obs doubles as this chunk's bootstrap obs
+            "next_obs_last": ep["next_obs_last"] if last else ep["obs"][e],
+            "actions": ep["actions"][s:e],
+            "rewards": ep["rewards"][s:e],
+            "terminated": ep["terminated"] and last,
+            "truncated": ep["truncated"] and last,
+        }
+        for k in (Columns.ACTION_LOGP, Columns.VF_PREDS):
+            if k in ep:
+                piece[k] = ep[k][s:e]
+        out.append(piece)
+    return out
+
+
+def pad_time_major(episodes: List[Dict[str, np.ndarray]], max_T: int, b_bucket: int = 8) -> Dict[str, np.ndarray]:
+    """Pad episode chunks into fixed-shape arrays (the aggregator's job).
+
+    Returns batch-major arrays: obs_ext [B, T+1, D] (row `lens[b]` holds the bootstrap
+    obs), actions [B, T], behaviour logp / rewards / mask [B, T], lens + terminated [B].
+    B is rounded up to a multiple of `b_bucket` (mask-zero rows) so XLA sees few
+    distinct shapes.
+    """
+    pieces: List[Dict[str, np.ndarray]] = []
+    for ep in episodes:
+        pieces.extend(_split_episode(ep, max_T))
+    B = len(pieces)
+    Bp = ((B + b_bucket - 1) // b_bucket) * b_bucket
+    obs_dim = int(np.prod(pieces[0]["obs"].shape[1:]))
+    act_shape = pieces[0]["actions"].shape[1:]
+    obs_ext = np.zeros((Bp, max_T + 1, obs_dim), np.float32)
+    actions = np.zeros((Bp, max_T) + act_shape, pieces[0]["actions"].dtype)
+    logp = np.zeros((Bp, max_T), np.float32)
+    rewards = np.zeros((Bp, max_T), np.float32)
+    mask = np.zeros((Bp, max_T), np.float32)
+    lens = np.zeros(Bp, np.int32)
+    terminated = np.zeros(Bp, np.float32)
+    for b, p in enumerate(pieces):
+        T = len(p["rewards"])
+        obs_ext[b, :T] = p["obs"].reshape(T, -1)
+        obs_ext[b, T] = np.asarray(p["next_obs_last"]).reshape(-1)
+        actions[b, :T] = p["actions"]
+        logp[b, :T] = np.asarray(p[Columns.ACTION_LOGP], np.float32)
+        rewards[b, :T] = p["rewards"]
+        mask[b, :T] = 1.0
+        lens[b] = T
+        terminated[b] = float(bool(p["terminated"]))
+    return {
+        "obs_ext": obs_ext, "actions": actions, "behaviour_logp": logp,
+        "rewards": rewards, "mask": mask, "lens": lens, "terminated": terminated,
+    }
+
+
+class Aggregator:
+    """Batching actor (reference impala.py num_aggregator_actors_per_learner)."""
+
+    def __init__(self, max_T: int):
+        self.max_T = max_T
+
+    def aggregate(self, episodes: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        return pad_time_major(episodes, self.max_T)
+
+    def ping(self) -> bool:
+        return True
+
+
+class IMPALALearner(Learner):
+    """V-trace actor-critic loss, one jitted step per padded batch."""
+
+    def compute_losses(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        B, Tp1, D = batch["obs_ext"].shape
+        T = Tp1 - 1
+        flat = batch["obs_ext"].reshape(B * Tp1, D)
+        out = self.module.forward_train(params, {Columns.OBS: flat})
+        dist = self.module.action_dist_cls
+        logits = out[Columns.ACTION_DIST_INPUTS].reshape(B, Tp1, -1)
+        values_ext = out[Columns.VF_PREDS].reshape(B, Tp1)
+        mask = batch["mask"]
+        lens = batch["lens"]
+        # bootstrap value lives at row lens[b] of the extended sequence
+        bootstrap = jnp.take_along_axis(values_ext, lens[:, None], axis=1)[:, 0]
+        bootstrap = bootstrap * (1.0 - batch["terminated"])
+        values = values_ext[:, :T] * mask
+
+        step_logits = logits[:, :T].reshape(B * T, -1)
+        step_actions = batch["actions"].reshape((B * T,) + batch["actions"].shape[2:])
+        target_logp = dist.logp_jax(step_logits, step_actions).reshape(B, T) * mask
+        entropy = dist.entropy_jax(step_logits).reshape(B, T)
+
+        rhos = jnp.exp(target_logp - batch["behaviour_logp"] * mask)
+        clipped_rho = jnp.minimum(cfg.vtrace_clip_rho_threshold, rhos) * mask
+        cs = jnp.minimum(1.0, rhos) * mask
+        # terminal step gets discount 0; padded steps contribute nothing
+        is_last = (jnp.arange(T)[None, :] == (lens - 1)[:, None]).astype(jnp.float32)
+        discounts = cfg.gamma * (1.0 - is_last * batch["terminated"][:, None]) * mask
+        v_tp1 = jnp.concatenate([values[:, 1:], jnp.zeros((B, 1))], axis=1)
+        # at t = len-1 the next value is the bootstrap, not values[t+1] (which is padding)
+        v_tp1 = v_tp1 + is_last * bootstrap[:, None]
+        deltas = clipped_rho * (batch["rewards"] + discounts * v_tp1 - values)
+
+        def backward(acc, xs):
+            delta_t, disc_t, c_t = xs
+            acc = delta_t + disc_t * c_t * acc
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            backward, jnp.zeros(B),
+            (deltas.T, discounts.T, cs.T), reverse=True,
+        )
+        vs_minus_v = vs_minus_v.T  # [B, T]
+        vs = values + vs_minus_v
+        vs_tp1 = jnp.concatenate([vs[:, 1:], jnp.zeros((B, 1))], axis=1) + is_last * bootstrap[:, None]
+        clipped_pg_rho = jnp.minimum(cfg.vtrace_clip_pg_rho_threshold, rhos) * mask
+        pg_adv = jax.lax.stop_gradient(
+            clipped_pg_rho * (batch["rewards"] + discounts * vs_tp1 - values)
+        )
+
+        n = jnp.maximum(mask.sum(), 1.0)
+        mean_kl = ((batch["behaviour_logp"] * mask - target_logp) * mask).sum() / n
+        pg_loss = self._pg_loss(target_logp, batch["behaviour_logp"] * mask, pg_adv, mask, n,
+                                batch.get("kl_coeff", 0.0))
+        vf_loss = 0.5 * (jnp.square(jax.lax.stop_gradient(vs) - values) * mask).sum() / n
+        ent = (entropy * mask).sum() / n
+        total = pg_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * ent
+        return total, {
+            "policy_loss": pg_loss, "vf_loss": vf_loss, "entropy": ent,
+            "mean_rho": (rhos * mask).sum() / n, "mean_kl": mean_kl,
+        }
+
+    def _pg_loss(self, target_logp, behaviour_logp, pg_adv, mask, n, kl_coeff):
+        """Vanilla importance-weighted policy gradient (APPO overrides with a clip)."""
+        return -(target_logp * pg_adv).sum() / n
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Whole-batch updates on one padded batch; num_epochs extra passes are
+        off-policy-corrected by V-trace (rhos grow as the policy drifts)."""
+        import jax
+        import optax
+
+        for _ in range(max(1, self.config.num_epochs)):
+            loss, aux, grads = self._update_fn(self.params, batch)
+            grads = self._sync_grads(grads)
+            updates, self.opt_state = self.optimizer.update(grads, self.opt_state, self.params)
+            self.params = optax.apply_updates(self.params, updates)
+        self.params = jax.tree_util.tree_map(lambda a: np.asarray(a), self.params)
+        self.metrics = {"total_loss": float(loss), **{k: float(v) for k, v in aux.items()}}
+        return self.metrics
+
+
+class IMPALA(Algorithm):
+    learner_class = IMPALALearner
+
+    @classmethod
+    def get_default_config(cls) -> IMPALAConfig:
+        return IMPALAConfig(cls)
+
+    def setup(self, _config) -> None:
+        super().setup(_config)
+        cfg = self._algo_config
+        self._inflight: Dict[Any, int] = {}  # sample ref -> runner index
+        self._updates_since_broadcast = 0
+        self._aggregators = []
+        n_agg = cfg.num_aggregator_actors_per_learner * max(1, cfg.num_learners)
+        if n_agg:
+            agg_cls = ray_tpu.remote(num_cpus=1)(Aggregator)
+            self._aggregators = [agg_cls.remote(cfg.max_seq_len) for _ in range(n_agg)]
+        self._agg_rr = 0
+
+    def _issue(self, idx: int) -> None:
+        per = max(1, self._algo_config.train_batch_size // self.env_runner_group.n)
+        ref = self.env_runner_group.runners[idx].sample.remote(per, True)
+        self._inflight[ref] = idx
+
+    def _aggregate(self, episodes: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        if self._aggregators:
+            agg = self._aggregators[self._agg_rr % len(self._aggregators)]
+            self._agg_rr += 1
+            return ray_tpu.get(agg.aggregate.remote(episodes))
+        return pad_time_major(episodes, self._algo_config.max_seq_len)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._algo_config
+        group = self.env_runner_group
+        if not self._inflight:
+            for i in range(group.n):
+                self._issue(i)
+        # async collect: take whatever finished first, keep the rest in flight
+        episodes: List[Dict[str, np.ndarray]] = []
+        steps = 0
+        while steps < cfg.train_batch_size:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1, timeout=30.0)
+            if not ready:
+                break
+            for ref in ready:
+                idx = self._inflight.pop(ref)
+                try:
+                    eps = ray_tpu.get(ref)
+                except Exception:
+                    group.restart_runner(idx)
+                    self._issue(idx)
+                    continue
+                episodes.extend(eps)
+                steps += sum(len(e["rewards"]) for e in eps)
+                self._issue(idx)
+        if not episodes:
+            return self.metrics.reduce()
+        for m in group.get_metrics():
+            self.metrics.log_dict({k: v for k, v in m.items() if v is not None}, window=20)
+        batch = self._aggregate(episodes)
+        learner_metrics = self.learner_group.update(batch)
+        for lm in learner_metrics:
+            self.metrics.log_dict(lm)
+        self._updates_since_broadcast += 1
+        if self._updates_since_broadcast >= cfg.broadcast_interval:
+            group.sync_weights(self.learner_group.get_weights())
+            self._updates_since_broadcast = 0
+        result = self.metrics.reduce()
+        result["num_env_steps_trained"] = steps
+        return result
+
+    def cleanup(self) -> None:
+        for ref in list(self._inflight):
+            try:
+                ray_tpu.cancel(ref)
+            except Exception:
+                pass
+        self._inflight.clear()
+        for a in self._aggregators:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        super().cleanup()
+
+    stop = cleanup

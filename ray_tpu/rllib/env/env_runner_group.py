@@ -32,11 +32,14 @@ class EnvRunnerGroup:
             try:
                 episodes.extend(ray_tpu.get(ref))
             except Exception:
-                # runner died: restart it (reference EnvRunnerGroup FT path)
-                self.runners[i] = self._actor_cls.remote(self.config, i)
-                if self._last_weights_ref is not None:
-                    self.runners[i].set_weights.remote(self._last_weights_ref)
+                self.restart_runner(i)
         return episodes
+
+    def restart_runner(self, i: int) -> None:
+        """Replace a dead runner and replay the last weights (reference FT path)."""
+        self.runners[i] = self._actor_cls.remote(self.config, i)
+        if self._last_weights_ref is not None:
+            self.runners[i].set_weights.remote(self._last_weights_ref)
 
     def sync_weights(self, weights) -> None:
         """Push inference weights to all runners (reference sync_weights)."""

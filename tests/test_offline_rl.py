@@ -1,0 +1,161 @@
+"""Offline RL: OfflineData, BC/MARWIL, CQL (reference rllib/offline/ + algorithms)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.offline import OfflineData, OfflinePreLearner, episodes_to_rows
+
+
+@pytest.fixture(autouse=True)
+def _cluster(rt):
+    yield
+
+
+def _expert_cartpole_rows(n_eps=40, seed=0):
+    """Record a decent heuristic policy (push toward the pole's lean)."""
+    import gymnasium as gym
+
+    rng = np.random.default_rng(seed)
+    env = gym.make("CartPole-v1")
+    rows, eid = [], 0
+    for _ in range(n_eps):
+        obs, _ = env.reset(seed=int(rng.integers(1 << 30)))
+        t = 0
+        while True:
+            action = int(obs[2] + 0.4 * obs[3] > 0)  # angle + angular velocity
+            nxt, r, term, trunc, _ = env.step(action)
+            rows.append({"obs": obs.tolist(), "actions": action, "rewards": float(r),
+                         "next_obs": nxt.tolist(), "dones": bool(term), "eps_id": eid, "t": t})
+            obs, t = nxt, t + 1
+            if term or trunc or t >= 200:
+                break
+        eid += 1
+    env.close()
+    return rows
+
+
+def test_prelearner_returns_to_go():
+    rows = [
+        {"obs": [0.0], "actions": 0, "rewards": 1.0, "next_obs": [1.0], "dones": False, "eps_id": 0, "t": 0},
+        {"obs": [1.0], "actions": 1, "rewards": 2.0, "next_obs": [2.0], "dones": True, "eps_id": 0, "t": 1},
+    ]
+    batch = OfflinePreLearner(gamma=0.5)(rows)
+    np.testing.assert_allclose(batch["returns_to_go"], [1.0 + 0.5 * 2.0, 2.0])
+
+
+def test_episodes_to_rows_roundtrip():
+    ep = {
+        "obs": np.arange(6, dtype=np.float32).reshape(3, 2),
+        "next_obs_last": np.array([9.0, 9.0], np.float32),
+        "actions": np.array([0, 1, 0]),
+        "rewards": np.ones(3, np.float32),
+        "terminated": True,
+        "truncated": False,
+    }
+    rows = episodes_to_rows([ep])
+    assert len(rows) == 3
+    assert rows[-1]["dones"] is True and rows[0]["dones"] is False
+    np.testing.assert_allclose(rows[1]["next_obs"], ep["obs"][2])
+    np.testing.assert_allclose(rows[2]["next_obs"], [9.0, 9.0])
+
+
+def test_bc_learns_cartpole_from_offline_data(rt, tmp_path):
+    import gymnasium as gym
+
+    from ray_tpu import data as rtd
+    from ray_tpu.rllib.algorithms.marwil import BCConfig
+
+    rows = _expert_cartpole_rows()
+    ds = rtd.from_items(rows)
+    env = gym.make("CartPole-v1")
+    config = (
+        BCConfig()
+        .environment(observation_space=env.observation_space, action_space=env.action_space)
+        .offline_data(dataset=ds)
+        .training(lr=1e-3, train_batch_size=512, num_updates_per_iteration=40)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        for _ in range(4):
+            result = algo.train()
+        assert result["mean_logp"] > -0.35, result  # near-deterministic imitation
+        # cloned policy actually holds the pole up
+        module, params = algo._module, algo.get_weights()
+        obs, _ = env.reset(seed=3)
+        steps = 0
+        for _ in range(300):
+            out = module.apply_np(params, obs[None].astype(np.float32))
+            action = int(np.argmax(out["action_dist_inputs"][0]))
+            obs, _, term, trunc, _ = env.step(action)
+            steps += 1
+            if term or trunc:
+                break
+        assert steps > 100, steps
+    finally:
+        algo.cleanup()
+        env.close()
+
+
+def test_marwil_parquet_input(rt, tmp_path):
+    import gymnasium as gym
+
+    from ray_tpu import data as rtd
+    from ray_tpu.rllib.algorithms.marwil import MARWILConfig
+
+    rows = _expert_cartpole_rows(n_eps=10)
+    rtd.from_items(rows).write_parquet(str(tmp_path / "offline"))
+    env = gym.make("CartPole-v1")
+    config = (
+        MARWILConfig()
+        .environment(observation_space=env.observation_space, action_space=env.action_space)
+        .offline_data(input_=str(tmp_path / "offline"))
+        .training(beta=1.0, train_batch_size=256, num_updates_per_iteration=5)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        result = algo.train()
+        assert np.isfinite(result["policy_loss"])
+        assert np.isfinite(result["vf_loss"])
+    finally:
+        algo.cleanup()
+        env.close()
+
+
+def test_cql_offline_pendulum(rt):
+    import gymnasium as gym
+
+    from ray_tpu import data as rtd
+    from ray_tpu.rllib.algorithms.cql import CQLConfig
+
+    # random-policy pendulum transitions
+    env = gym.make("Pendulum-v1")
+    rng = np.random.default_rng(0)
+    rows, eid = [], 0
+    for _ in range(8):
+        obs, _ = env.reset(seed=int(rng.integers(1 << 30)))
+        for t in range(50):
+            a = rng.uniform(-2, 2, size=(1,)).astype(np.float32)
+            nxt, r, term, trunc, _ = env.step(a)
+            rows.append({"obs": obs.tolist(), "actions": a.tolist(), "rewards": float(r),
+                         "next_obs": nxt.tolist(), "dones": False, "eps_id": eid, "t": t})
+            obs = nxt
+        eid += 1
+    config = (
+        CQLConfig()
+        .environment(observation_space=env.observation_space, action_space=env.action_space)
+        .offline_data(dataset=rtd.from_items(rows))
+        .training(train_batch_size=64, num_updates_per_iteration=6, bc_iters=3,
+                  min_q_weight=1.0, num_cql_actions=2)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        r1 = algo.train()  # covers bc_iters warm-start then Q-based actor
+        assert np.isfinite(r1["critic_loss"]) and np.isfinite(r1["cql_loss"])
+        state = algo.save_checkpoint()
+        algo.load_checkpoint(state)
+    finally:
+        algo.cleanup()
+        env.close()
