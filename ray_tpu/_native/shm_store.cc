@@ -16,6 +16,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 #include <fcntl.h>
@@ -92,11 +93,16 @@ uint64_t hash_id(const uint8_t* id) {
   return x;
 }
 
+void heap_rebuild(Handle* h);
+
 int lock(Handle* h) {
   int rc = pthread_mutex_lock(&H(h)->mutex);
   if (rc == EOWNERDEAD) {
-    // Previous holder died mid-critical-section; state is still consistent for our
-    // coarse-grained usage (each op completes table+heap updates under the lock).
+    // Previous holder was killed mid-critical-section: the free list may be
+    // half-spliced. The object table is the authoritative record (entry state is
+    // committed last), so rebuild the heap's free list from the table before
+    // anyone walks it.
+    heap_rebuild(h);
     pthread_mutex_consistent(&H(h)->mutex);
     rc = 0;
   }
@@ -199,6 +205,82 @@ void heap_free(Handle* h, uint64_t off, uint64_t size) {
   hd->used_bytes -= size;
 }
 
+// Reconstruct the free list from the object table after a lock owner died
+// mid-heap-op. Live extents = entries in Allocated/Sealed/Condemned state with
+// in-bounds offsets; everything else in [heap_offset, total_size) becomes free.
+// Entries with corrupt extents (half-written before the state commit) are dropped.
+void heap_rebuild(Handle* h) {
+  Header* hd = H(h);
+  Entry* t = table(h);
+  char* base = static_cast<char*>(h->base);
+  uint64_t heap_lo = hd->heap_offset, heap_hi = hd->total_size;
+
+  // collect + validate live extents
+  uint64_t n_live = 0;
+  for (uint64_t i = 0; i < hd->table_cap; i++) {
+    Entry* e = &t[i];
+    if (e->state != kAllocated && e->state != kSealed && e->state != kCondemned) continue;
+    uint64_t sz = align_up(e->size ? e->size : 1, kAlign);
+    // overflow-safe: align_up can wrap to 0, offset+sz can wrap past heap_hi
+    if (sz == 0 || e->offset < heap_lo || e->offset > heap_hi - sz ||
+        (e->offset & (kAlign - 1))) {
+      e->state = kTombstone;  // half-written entry from the dead owner
+      if (hd->num_objects) hd->num_objects--;
+      continue;
+    }
+    n_live++;
+  }
+  // sort extent starts (insertion sort into a malloc'd array; tables are <=1M)
+  uint64_t* starts = static_cast<uint64_t*>(malloc((n_live ? n_live : 1) * 2 * sizeof(uint64_t)));
+  if (!starts) {
+    // can't rebuild without scratch: drop the (possibly corrupt) free list
+    // entirely — allocations fail OOM-style until restart, but nothing walks
+    // a half-spliced list
+    hd->free_head = 0;
+    return;
+  }
+  uint64_t m = 0;
+  for (uint64_t i = 0; i < hd->table_cap; i++) {
+    Entry* e = &t[i];
+    if (e->state != kAllocated && e->state != kSealed && e->state != kCondemned) continue;
+    uint64_t sz = align_up(e->size ? e->size : 1, kAlign);
+    uint64_t j = m++;
+    while (j > 0 && starts[(j - 1) * 2] > e->offset) {
+      starts[j * 2] = starts[(j - 1) * 2];
+      starts[j * 2 + 1] = starts[(j - 1) * 2 + 1];
+      j--;
+    }
+    starts[j * 2] = e->offset;
+    starts[j * 2 + 1] = sz;
+  }
+  // rebuild address-ordered free list from the gaps
+  uint64_t used = 0;
+  uint64_t cursor = heap_lo;
+  uint64_t prev_free = 0;
+  hd->free_head = 0;
+  for (uint64_t k = 0; k <= m; k++) {
+    uint64_t gap_end = (k < m) ? starts[k * 2] : heap_hi;
+    if (gap_end > cursor && gap_end - cursor >= kAlign) {
+      FreeBlock* fb = reinterpret_cast<FreeBlock*>(base + cursor);
+      fb->size = gap_end - cursor;
+      fb->next = 0;
+      if (prev_free) {
+        reinterpret_cast<FreeBlock*>(base + prev_free)->next = cursor;
+      } else {
+        hd->free_head = cursor;
+      }
+      prev_free = cursor;
+    }
+    if (k < m) {
+      uint64_t ext_end = starts[k * 2] + starts[k * 2 + 1];
+      used += starts[k * 2 + 1];
+      if (ext_end > cursor) cursor = ext_end;
+    }
+  }
+  hd->used_bytes = used;
+  free(starts);
+}
+
 }  // namespace
 
 extern "C" {
@@ -299,10 +381,11 @@ uint64_t rt_alloc(void* hv, const uint8_t* id, uint64_t size) {
       off = 0;
     } else {
       memcpy(slot->id, id, kIdLen);
-      slot->state = kAllocated;
       slot->owner_pid = static_cast<uint32_t>(getpid());
       slot->offset = off;
       slot->size = size;
+      slot->state = kAllocated;  // commit point last: a crash here leaks only the
+                                 // extent, which heap_rebuild/sweep reclaims
       H(h)->num_objects++;
     }
   }

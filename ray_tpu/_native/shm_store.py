@@ -45,10 +45,15 @@ class Arena:
     """One node-wide shared-memory object arena."""
 
     def __init__(self, name: str, handle, size: int, owner: bool):
+        import threading
+
         self.name = name
         self._h = handle
         self._lib = _lib()
         self.owner = owner
+        # serializes close() against the background maintenance calls (sweep /
+        # gc_dead_owners) that walk the mapping — closing mid-walk segfaults
+        self._maint_lock = threading.Lock()
         fd = os.open(f"/dev/shm{name}", os.O_RDWR)
         try:
             self._map = mmap.mmap(fd, size)
@@ -76,7 +81,9 @@ class Arena:
         return cls(name, h, size, owner=False)
 
     def close(self) -> None:
-        if self._h:
+        with self._maint_lock:
+            if not self._h:
+                return
             self._lib.rt_store_close(self._h)
             self._h = None
             try:
@@ -129,13 +136,19 @@ class Arena:
 
     def sweep(self) -> int:
         """GC unsealed objects from dead writers; returns number collected."""
-        return self._lib.rt_sweep(self._h)
+        with self._maint_lock:
+            if not self._h:
+                return 0
+            return self._lib.rt_sweep(self._h)
 
     def gc_dead_owners(self, keep_ids) -> int:
         """GC all objects whose creator process died, except ids in keep_ids
         (the coordinator's live object directory)."""
         blob = b"".join(self._id(i) for i in keep_ids)
-        return self._lib.rt_gc_dead_owners(self._h, blob, len(keep_ids))
+        with self._maint_lock:
+            if not self._h:
+                return 0
+            return self._lib.rt_gc_dead_owners(self._h, blob, len(keep_ids))
 
     def stats(self) -> Tuple[int, int, int, int]:
         used = ctypes.c_uint64()

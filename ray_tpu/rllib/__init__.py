@@ -10,13 +10,18 @@ from .algorithms.cql import CQL, CQLConfig, CQLLearner  # noqa: F401
 from .algorithms.dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
 from .algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner  # noqa: F401
 from .algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig, MARWILLearner  # noqa: F401
+from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig  # noqa: F401
 from .algorithms.ppo import PPO, PPOConfig, PPOLearner  # noqa: F401
 from .algorithms.sac import SAC, SACConfig, SACLearner  # noqa: F401
 from .connectors import ConnectorPipelineV2, ConnectorV2, GeneralAdvantageEstimation  # noqa: F401
 from .core.learner import Learner  # noqa: F401
 from .core.learner_group import LearnerGroup  # noqa: F401
 from .core.rl_module import Columns, MLPModule, RLModule, RLModuleSpec  # noqa: F401
+from .core.multi_learner import MultiAgentLearner  # noqa: F401
 from .env.env_runner import SingleAgentEnvRunner  # noqa: F401
 from .env.env_runner_group import EnvRunnerGroup  # noqa: F401
 from .env.episode import SingleAgentEpisode  # noqa: F401
+from .env.multi_agent_env import MultiAgentEnv, make_multi_agent  # noqa: F401
+from .env.multi_agent_env_runner import MultiAgentEnvRunner, MultiAgentEpisode  # noqa: F401
+from .offline import OfflineData, OfflinePreLearner  # noqa: F401
 from .utils.metrics_logger import MetricsLogger  # noqa: F401

@@ -39,15 +39,20 @@ class LearnerGroup:
         else:
             self._group = None
 
-    def update(self, batch: Dict[str, np.ndarray]) -> List[Dict[str, Any]]:
-        """Shard the batch across learners; each updates with allreduced grads."""
-        n_rows = len(next(iter(batch.values())))
-        per = n_rows // self.n
+    def update(self, batch: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Shard the batch across learners; each updates with allreduced grads.
+        Accepts a flat column batch or (multi-agent) a module_id -> batch dict."""
         refs = []
         for i, learner in enumerate(self.learners):
-            shard = {k: v[i * per : (i + 1) * per] for k, v in batch.items() if isinstance(v, np.ndarray)}
-            refs.append(learner.update.remote(shard))
+            refs.append(learner.update.remote(self._shard(batch, i)))
         return ray_tpu.get(refs)
+
+    def _shard(self, batch: Dict[str, Any], i: int) -> Dict[str, Any]:
+        if batch and all(isinstance(v, dict) for v in batch.values()):
+            return {mid: self._shard(sub, i) for mid, sub in batch.items()}
+        n_rows = len(next(iter(batch.values())))
+        per = n_rows // self.n
+        return {k: v[i * per : (i + 1) * per] for k, v in batch.items() if isinstance(v, np.ndarray)}
 
     def get_weights(self):
         return ray_tpu.get(self.learners[0].get_weights.remote())

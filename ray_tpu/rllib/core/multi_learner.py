@@ -1,0 +1,48 @@
+"""MultiAgentLearner: one learner actor owning a module per policy id.
+
+Capability parity: reference rllib/core/rl_module/multi_rl_module.py +
+learner.py's per-module loss loop — here each policy id gets an independent
+sub-learner (own params/optimizer/jitted update); updates run module-by-module
+in deterministic dict order so multi-learner collective grad syncs stay aligned
+across actors.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .learner import Learner
+
+
+class MultiAgentLearner(Learner):
+    def __init__(self, config: "AlgorithmConfig", module_specs: Dict[str, Any]):  # noqa: F821
+        self.config = config
+        self.module_specs = module_specs
+        base = config.base_learner_class
+        self._subs: Dict[str, Learner] = {
+            mid: base(config, spec) for mid, spec in sorted(module_specs.items())
+        }
+
+    def build(self) -> None:
+        for sub in self._subs.values():
+            sub.build()
+
+    def setup_collective(self, group_name: str) -> None:
+        for sub in self._subs.values():
+            sub.setup_collective(group_name)
+
+    def update(self, batches: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+        return {mid: self._subs[mid].update(b) for mid, b in sorted(batches.items())}
+
+    def get_weights(self):
+        return {mid: sub.get_weights() for mid, sub in self._subs.items()}
+
+    def get_state(self) -> Dict[str, Any]:
+        return {mid: sub.get_state() for mid, sub in self._subs.items()}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        for mid, s in state.items():
+            if mid in self._subs:
+                self._subs[mid].set_state(s)
+
+    def ping(self) -> bool:
+        return True

@@ -15,25 +15,36 @@ from .env_runner import SingleAgentEnvRunner
 
 
 class EnvRunnerGroup:
-    def __init__(self, config: "AlgorithmConfig"):  # noqa: F821
+    def __init__(self, config: "AlgorithmConfig", runner_cls: type = SingleAgentEnvRunner):  # noqa: F821
         self.config = config
         self.n = max(1, config.num_env_runners)
-        self._actor_cls = ray_tpu.remote(num_cpus=1)(SingleAgentEnvRunner)
+        self._actor_cls = ray_tpu.remote(num_cpus=1)(runner_cls)
         self.runners = [self._actor_cls.remote(config, i) for i in range(self.n)]
         self._last_weights_ref = None
 
-    def sample(self, num_timesteps_total: Optional[int] = None, explore: bool = True) -> List[Dict[str, np.ndarray]]:
+    def sample(self, num_timesteps_total: Optional[int] = None, explore: bool = True):
+        """Parallel sample; returns a merged episode list (single-agent) or a
+        module_id -> episode-list dict (multi-agent runners)."""
         per = None
         if num_timesteps_total:
             per = max(1, num_timesteps_total // self.n)
         refs = [r.sample.remote(per, explore) for r in self.runners]
         episodes: List[Dict[str, np.ndarray]] = []
+        by_module: Dict[str, List] = {}
+        saw_dict = False
         for i, ref in enumerate(refs):
             try:
-                episodes.extend(ray_tpu.get(ref))
+                res = ray_tpu.get(ref)
             except Exception:
                 self.restart_runner(i)
-        return episodes
+                continue
+            if isinstance(res, dict):
+                saw_dict = True
+                for mid, eps in res.items():
+                    by_module.setdefault(mid, []).extend(eps)
+            else:
+                episodes.extend(res)
+        return by_module if saw_dict else episodes
 
     def restart_runner(self, i: int) -> None:
         """Replace a dead runner and replay the last weights (reference FT path)."""

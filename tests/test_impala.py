@@ -38,11 +38,10 @@ def test_pad_time_major_shapes_and_split():
 
 
 def test_vtrace_matches_one_step_td():
-    """With on-policy logp (rho=c=1) and T=1, vs = r + gamma*bootstrap."""
-    import jax.numpy as jnp
-
-    from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner
-    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    """With on-policy logp (rho=c=1) and T=1, the V-trace target is exactly
+    r + gamma*V(next): vf_loss == 0.5*(r + gamma*V(next) - V(s))^2."""
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig, IMPALALearner
+    from ray_tpu.rllib.core.rl_module import Columns, RLModuleSpec
     import gymnasium as gym
 
     env = gym.make("CartPole-v1")
@@ -51,11 +50,21 @@ def test_vtrace_matches_one_step_td():
     cfg = IMPALAConfig().environment("CartPole-v1")
     learner = IMPALALearner(cfg, spec)
     learner.build()
-    ep = _fake_episode(3)
-    batch = pad_time_major([ep], max_T=8, b_bucket=1)
+    ep = _fake_episode(1, terminated=False)
+    # make the behaviour logp exactly on-policy so rho = c = 1
+    out = learner.module.apply_np(
+        learner.params, ep["obs"].reshape(1, -1).astype(np.float32))
+    dist = learner.module.action_dist_cls
+    ep["action_logp"] = dist.logp_np(out["action_dist_inputs"], ep["actions"])
+    batch = pad_time_major([ep], max_T=1, b_bucket=1)
     loss, aux = learner.compute_losses(learner.params, batch)
-    assert np.isfinite(float(loss))
-    assert np.isfinite(float(aux["vf_loss"]))
+    v_s = float(out[Columns.VF_PREDS][0])
+    out_next = learner.module.apply_np(
+        learner.params, ep["next_obs_last"].reshape(1, -1).astype(np.float32))
+    v_next = float(out_next[Columns.VF_PREDS][0])
+    expected_vf_loss = 0.5 * (1.0 + cfg.gamma * v_next - v_s) ** 2
+    np.testing.assert_allclose(float(aux["vf_loss"]), expected_vf_loss, rtol=1e-4)
+    np.testing.assert_allclose(float(aux["mean_rho"]), 1.0, rtol=1e-5)
     env.close()
 
 

@@ -1,0 +1,90 @@
+"""Multi-agent RL tests (reference rllib multi-agent stack on CartPole copies)."""
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import MultiAgentPPOConfig, make_multi_agent
+from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+
+
+@pytest.fixture(autouse=True)
+def _cluster(rt):
+    yield
+
+
+def test_make_multi_agent_env_dict_api():
+    env = make_multi_agent("CartPole-v1")({"num_agents": 3})
+    obs, infos = env.reset(seed=0)
+    assert set(obs) == {0, 1, 2}
+    obs, rewards, terms, truncs, _ = env.step({i: 0 for i in range(3)})
+    assert set(rewards) == {0, 1, 2}
+    assert terms["__all__"] in (False, True)
+    env.close()
+
+
+def test_multi_agent_env_runner_groups_by_module(rt):
+    cfg = (
+        MultiAgentPPOConfig()
+        .environment(make_multi_agent("CartPole-v1"), env_config={"num_agents": 2})
+        .multi_agent(
+            policies=["left", "right"],
+            policy_mapping_fn=lambda aid: "left" if aid == 0 else "right",
+        )
+        .env_runners(rollout_fragment_length=40)
+    )
+    runner = MultiAgentEnvRunner(cfg, 0)
+    out = runner.sample(80)
+    assert set(out) == {"left", "right"}
+    total = sum(len(e["rewards"]) for eps in out.values() for e in eps)
+    assert total >= 80
+    for eps in out.values():
+        for e in eps:
+            assert "action_logp" in e and "vf_preds" in e
+    runner.stop()
+
+
+def test_multi_agent_ppo_shared_policy_improves(rt):
+    config = (
+        MultiAgentPPOConfig()
+        .environment(make_multi_agent("CartPole-v1"), env_config={"num_agents": 2})
+        .multi_agent(policies=["shared"], policy_mapping_fn=lambda aid: "shared")
+        .env_runners(num_env_runners=2, rollout_fragment_length=64)
+        .training(lr=3e-4, train_batch_size=1024, minibatch_size=256, num_epochs=6,
+                  entropy_coeff=0.01)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        returns = []
+        for _ in range(8):
+            result = algo.train()
+            returns.append(result.get("episode_return_mean") or 0.0)
+        # 2 agents => random-policy return ~40 total; must clearly improve
+        assert max(returns[2:]) > returns[0] + 20, returns
+    finally:
+        algo.cleanup()
+
+
+def test_multi_agent_ppo_separate_policies_checkpoint(rt):
+    config = (
+        MultiAgentPPOConfig()
+        .environment(make_multi_agent("CartPole-v1"), env_config={"num_agents": 2})
+        .multi_agent(policies=["p0", "p1"], policy_mapping_fn=lambda aid: f"p{aid}")
+        .env_runners(num_env_runners=1, rollout_fragment_length=32)
+        .training(train_batch_size=256, minibatch_size=64, num_epochs=1)
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    try:
+        result = algo.train()
+        assert any(k.startswith("p0/") for k in result)
+        assert any(k.startswith("p1/") for k in result)
+        state = algo.save_checkpoint()
+        w_before = algo.get_weights()
+        algo.train()
+        algo.load_checkpoint(state)
+        w_after = algo.get_weights()
+        np.testing.assert_allclose(w_before["p0"]["pi"][0]["w"], w_after["p0"]["pi"][0]["w"])
+        # p0 and p1 trained independently -> different params
+        assert not np.allclose(w_after["p0"]["pi"][0]["w"], w_after["p1"]["pi"][0]["w"])
+    finally:
+        algo.cleanup()
