@@ -29,6 +29,7 @@ from .core.exceptions import (  # noqa: F401
     ActorError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     RayTpuError,
     TaskCancelledError,
     TaskError,
@@ -69,6 +70,7 @@ __all__ = [
     "GetTimeoutError",
     "TaskCancelledError",
     "ObjectLostError",
+    "OutOfMemoryError",
     "RayTpuError",
     "NodeAffinitySchedulingStrategy",
     "PlacementGroupSchedulingStrategy",

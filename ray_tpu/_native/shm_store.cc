@@ -221,16 +221,18 @@ void heap_rebuild(Handle* h) {
     Entry* e = &t[i];
     if (e->state != kAllocated && e->state != kSealed && e->state != kCondemned) continue;
     uint64_t sz = align_up(e->size ? e->size : 1, kAlign);
-    // overflow-safe: align_up can wrap to 0, offset+sz can wrap past heap_hi
-    if (sz == 0 || e->offset < heap_lo || e->offset > heap_hi - sz ||
-        (e->offset & (kAlign - 1))) {
+    // overflow-safe: align_up can wrap to 0, a garbage size can exceed the heap
+    // (making heap_hi - sz underflow), offset+sz can wrap past heap_hi
+    if (sz == 0 || sz > heap_hi - heap_lo || e->offset < heap_lo ||
+        e->offset > heap_hi - sz || (e->offset & (kAlign - 1))) {
       e->state = kTombstone;  // half-written entry from the dead owner
       if (hd->num_objects) hd->num_objects--;
       continue;
     }
     n_live++;
   }
-  // sort extent starts (insertion sort into a malloc'd array; tables are <=1M)
+  // collect extent (start, size) pairs, then qsort — this runs under the
+  // cross-process mutex, so it must stay O(n log n) even for ~1M-entry tables
   uint64_t* starts = static_cast<uint64_t*>(malloc((n_live ? n_live : 1) * 2 * sizeof(uint64_t)));
   if (!starts) {
     // can't rebuild without scratch: drop the (possibly corrupt) free list
@@ -243,16 +245,15 @@ void heap_rebuild(Handle* h) {
   for (uint64_t i = 0; i < hd->table_cap; i++) {
     Entry* e = &t[i];
     if (e->state != kAllocated && e->state != kSealed && e->state != kCondemned) continue;
-    uint64_t sz = align_up(e->size ? e->size : 1, kAlign);
-    uint64_t j = m++;
-    while (j > 0 && starts[(j - 1) * 2] > e->offset) {
-      starts[j * 2] = starts[(j - 1) * 2];
-      starts[j * 2 + 1] = starts[(j - 1) * 2 + 1];
-      j--;
-    }
-    starts[j * 2] = e->offset;
-    starts[j * 2 + 1] = sz;
+    starts[m * 2] = e->offset;
+    starts[m * 2 + 1] = align_up(e->size ? e->size : 1, kAlign);
+    m++;
   }
+  qsort(starts, m, 2 * sizeof(uint64_t), [](const void* a, const void* b) {
+    uint64_t x = *static_cast<const uint64_t*>(a);
+    uint64_t y = *static_cast<const uint64_t*>(b);
+    return x < y ? -1 : (x > y ? 1 : 0);
+  });
   // rebuild address-ordered free list from the gaps
   uint64_t used = 0;
   uint64_t cursor = heap_lo;

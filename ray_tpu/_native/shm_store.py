@@ -106,14 +106,18 @@ class Arena:
 
     def create_object(self, oid: bytes, size: int) -> Optional[memoryview]:
         """Allocate; returns a writable view or None (OOM / already exists)."""
-        off = self._lib.rt_alloc(self._h, self._id(oid), size)
+        with self._maint_lock:
+            if not self._h:
+                return None
+            off = self._lib.rt_alloc(self._h, self._id(oid), size)
         if off in (0, 0xFFFFFFFFFFFFFFFF):
             return None
         return self._view[off:off + size]
 
     def seal(self, oid: bytes) -> None:
-        if self._lib.rt_seal(self._h, self._id(oid)) != 0:
-            raise KeyError(f"seal failed for {oid.hex()}")
+        with self._maint_lock:
+            if not self._h or self._lib.rt_seal(self._h, self._id(oid)) != 0:
+                raise KeyError(f"seal failed for {oid.hex()}")
 
     def get(self, oid: bytes) -> Optional[memoryview]:
         """Read-side lookup; returns a view of the sealed object or None.
@@ -122,17 +126,24 @@ class Arena:
         matching unpin() once no zero-copy views of this object remain. A
         delete() while pinned defers the free until the last unpin."""
         off, size = ctypes.c_uint64(), ctypes.c_uint64()
-        rc = self._lib.rt_get(self._h, self._id(oid), ctypes.byref(off), ctypes.byref(size))
+        with self._maint_lock:
+            if not self._h:
+                return None
+            rc = self._lib.rt_get(self._h, self._id(oid), ctypes.byref(off), ctypes.byref(size))
         if rc != 0:
             return None
         return self._view[off.value:off.value + size.value]
 
     def unpin(self, oid: bytes) -> None:
-        if self._h:  # no-op after close (late weakref finalizers at shutdown)
-            self._lib.rt_unpin(self._h, self._id(oid))
+        with self._maint_lock:
+            if self._h:  # no-op after close (late weakref finalizers at shutdown)
+                self._lib.rt_unpin(self._h, self._id(oid))
 
     def delete(self, oid: bytes) -> bool:
-        return self._lib.rt_delete(self._h, self._id(oid)) == 0
+        with self._maint_lock:
+            if not self._h:
+                return False
+            return self._lib.rt_delete(self._h, self._id(oid)) == 0
 
     def sweep(self) -> int:
         """GC unsealed objects from dead writers; returns number collected."""
@@ -155,6 +166,9 @@ class Arena:
         cap = ctypes.c_uint64()
         n = ctypes.c_uint64()
         peak = ctypes.c_uint64()
-        self._lib.rt_stats(self._h, ctypes.byref(used), ctypes.byref(cap),
-                           ctypes.byref(n), ctypes.byref(peak))
+        with self._maint_lock:
+            if not self._h:
+                return 0, 0, 0, 0
+            self._lib.rt_stats(self._h, ctypes.byref(used), ctypes.byref(cap),
+                               ctypes.byref(n), ctypes.byref(peak))
         return used.value, cap.value, n.value, peak.value

@@ -44,6 +44,11 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """Worker killed by the memory monitor (reference ray.exceptions.OutOfMemoryError
+    raised by MemoryMonitor-driven worker killing, src/ray/common/memory_monitor.h:52)."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

@@ -11,6 +11,7 @@ testable on one host.
 from __future__ import annotations
 
 import atexit
+import copy
 import itertools
 import multiprocessing
 import multiprocessing.connection
@@ -25,6 +26,7 @@ import cloudpickle
 from . import global_state, object_store
 from .exceptions import (
     ActorDiedError,
+    OutOfMemoryError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
@@ -45,6 +47,25 @@ _mp = multiprocessing.get_context("spawn")
 
 DEFAULT_MAX_WORKERS_PER_NODE = int(os.environ.get("RAY_TPU_MAX_WORKERS_PER_NODE", "16"))
 WORKER_START_TIMEOUT_S = 60.0
+
+
+def _system_memory_fraction() -> Optional[float]:
+    """Used-memory fraction from /proc/meminfo (reference MemoryMonitor reads
+    cgroup/system usage the same way). None if unreadable."""
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    info[parts[0].rstrip(":")] = int(parts[1])
+        total = info.get("MemTotal")
+        avail = info.get("MemAvailable")
+        if not total or avail is None:
+            return None
+        return 1.0 - avail / total
+    except OSError:
+        return None
 
 
 class WorkerHandle:
@@ -185,9 +206,33 @@ class Cluster:
         self._conns: Dict[Any, WorkerHandle] = {}
         self._wakeup_r, self._wakeup_w = _mp.Pipe(duplex=False)
         self._shutdown = False
+        # lineage for reconstruction: return oid -> creating TaskSpec while the
+        # object is in scope and the task is retryable (reference
+        # object_recovery_manager.h:43 + task_manager lineage pinning)
+        self.lineage: Dict[ObjectID, TaskSpec] = {}
+        self._recovering: set = set()  # oids with an in-flight reconstruction
+        self.store.on_free = self._on_object_freed
+        self._object_store_capacity = object_store_memory
+        self.spill_dir = os.path.join(
+            os.environ.get("RAY_TPU_SPILL_DIR", "/tmp"),
+            f"ray_tpu_spill_{os.getpid()}_{os.urandom(2).hex()}")
+        # spill watermarks (reference: object_spilling_threshold / local_object_manager)
+        self.spill_threshold = float(os.environ.get("RAY_TPU_SPILL_THRESHOLD", 0.8))
+        self.spill_target = float(os.environ.get("RAY_TPU_SPILL_TARGET", 0.5))
+        # memory monitor (reference memory_monitor.h:52 + worker_killing_policy)
+        self.memory_usage_threshold = float(
+            os.environ.get("RAY_TPU_MEMORY_USAGE_THRESHOLD", 0.95))
+        self.memory_monitor_refresh_ms = int(
+            os.environ.get("RAY_TPU_MEMORY_MONITOR_REFRESH_MS", 250))
+        self._memory_sampler = _system_memory_fraction  # test seam
+        self.num_oom_kills = 0
         self._router_thread = threading.Thread(target=self._router, daemon=True, name="rt-router")
         self.head_node = self.add_node(resources)
         self._router_thread.start()
+        self._maint_wakeup = threading.Event()
+        self._maint_thread = threading.Thread(
+            target=self._maintenance_loop, daemon=True, name="rt-maintenance")
+        self._maint_thread.start()
 
     # -- topology --------------------------------------------------------------------
     def add_node(self, resources: Dict[str, float], labels: Optional[Dict[str, str]] = None,
@@ -284,6 +329,9 @@ class Cluster:
             self._schedule()
         elif kind == "decref":
             self.store.decref(msg[1])
+        elif kind == "recover":
+            _, req_id, oid = msg
+            self._async_reply(w, req_id, lambda: self._recover_object(oid), blocking=True)
         elif kind == "metrics":
             # periodic per-worker metric snapshot (util/metrics.py push thread)
             self.metrics_by_worker[w.worker_id] = msg[1]
@@ -404,6 +452,19 @@ class Cluster:
             self.store.incref(oid)
         if spec.fn_bytes is not None and spec.fn_id not in self.fn_table:
             self.fn_table[spec.fn_id] = spec.fn_bytes
+        if spec.kind == "task" and spec.max_retries > 0:
+            # lineage for reconstruction: snapshot arg_refs now (the live spec's
+            # list is cleared when args are unpinned after completion) and pin
+            # them for as long as any downstream return oid is in scope, so
+            # re-execution always finds its inputs (reference lineage pinning)
+            lineage_spec = copy.copy(spec)
+            lineage_spec.arg_refs = list(spec.arg_refs)
+            for oid in spec.return_ids:
+                if oid in self.lineage:
+                    continue  # resubmission: original entry already holds the pins
+                self.lineage[oid] = lineage_spec
+                for arg in lineage_spec.arg_refs:
+                    self.store.incref(arg)
         with self._lock:
             self.tasks[spec.task_id] = TaskState(spec)
             if spec.kind == "actor_creation":
@@ -652,6 +713,129 @@ class Cluster:
                     self._unpin_args(spec)
         self._schedule()
 
+    # -- maintenance: spilling + memory monitor ----------------------------------------
+    def _maintenance_loop(self) -> None:
+        interval = max(0.05, self.memory_monitor_refresh_ms / 1000.0)
+        while not self._shutdown:
+            if self._maint_wakeup.wait(interval):
+                break  # shutdown
+            try:
+                self._check_spill()
+            except Exception:
+                pass
+            try:
+                self._check_memory_pressure()
+            except Exception:
+                pass
+
+    def _check_spill(self) -> None:
+        """Spill LRU objects to disk when shared memory passes the high watermark
+        (reference LocalObjectManager + plasma eviction pressure)."""
+        cap = self._object_store_capacity
+        if not cap:
+            return
+        used = self.store.memory_bytes()
+        if used > self.spill_threshold * cap:
+            target = int(self.spill_target * cap)
+            self.store.spill_lru(used - target, self.spill_dir)
+
+    def _check_memory_pressure(self) -> None:
+        """OOM guard: above the usage threshold, kill the most recently started
+        retriable task's worker (reference worker_killing_policy_retriable_fifo.h)."""
+        if self.memory_usage_threshold >= 1.0:
+            return
+        frac = self._memory_sampler()
+        if frac is None or frac < self.memory_usage_threshold:
+            return
+        victim = None
+        with self._lock:
+            running = []
+            for n in self._nodes.values():
+                for w in n.workers.values():
+                    if w.state != "busy" or not w.inflight or w.actor_id is not None:
+                        continue
+                    ts = self.tasks.get(w.inflight[0])
+                    if ts is None or ts.spec.kind != "task":
+                        continue
+                    running.append((ts.dispatched_at or 0.0, ts.spec, w))
+            # prefer retriable tasks, newest first (retriable-FIFO policy)
+            retriable = [r for r in running if r[1].attempt < r[1].max_retries]
+            pool = retriable or running
+            if pool:
+                victim = max(pool, key=lambda p: p[0])[2]
+        if victim is not None:
+            self.num_oom_kills += 1
+            self._kill_worker(victim, OutOfMemoryError(
+                f"worker killed by memory monitor (usage {frac:.0%} >= "
+                f"{self.memory_usage_threshold:.0%})"))
+
+    # -- lineage reconstruction --------------------------------------------------------
+    def _on_object_freed(self, oid: ObjectID) -> None:
+        """Drop the lineage entry and release its argument pins."""
+        spec = self.lineage.pop(oid, None)
+        if spec is not None:
+            for arg in spec.arg_refs:
+                self.store.decref(arg)
+
+    def _recover_object(self, oid: ObjectID):
+        """Return a (possibly re-created) location for oid. If the stored location
+        is gone, resubmit the creating task from lineage (reference
+        ObjectRecoveryManager::RecoverObject). Concurrent recoveries of the same
+        object dedup onto one resubmission."""
+        loc = self.store.try_location(oid)
+        if loc is not None and self._location_alive(loc):
+            return loc
+        spec = self.lineage.get(oid)
+        if spec is None:
+            raise object_store.ObjectLost(
+                f"object {oid.hex()[:12]} is lost and has no lineage to reconstruct")
+        with self._lock:
+            running = any(t.spec.task_id == spec.task_id for t in self.tasks.values())
+            resubmit = not running and not (set(spec.return_ids) & self._recovering)
+            if resubmit:
+                self._recovering.update(spec.return_ids)
+        try:
+            if resubmit:
+                for out_oid in spec.return_ids:
+                    self.store.drop_location(out_oid)
+                respec = copy.copy(spec)
+                respec.attempt = 0
+                respec.task_id = TaskID.generate()
+                respec.arg_refs = list(spec.arg_refs)
+                self.submit(respec)
+                # rebalance submit's extra incref: existing ObjectRefs already hold one
+                for out_oid in respec.return_ids:
+                    self.store.decref(out_oid)
+            return self.store.location(oid, timeout=60.0)
+        finally:
+            if resubmit:
+                with self._lock:
+                    self._recovering.difference_update(spec.return_ids)
+
+    @staticmethod
+    def _location_alive(loc) -> bool:
+        kind = loc[0]
+        try:
+            if kind == "arena":
+                arena = object_store._open_arena(loc[1])
+                view = arena.get(loc[2])
+                if view is None:
+                    return False
+                view.release()
+                arena.unpin(loc[2])
+                return True
+            if kind == "shm":
+                from multiprocessing import shared_memory
+
+                seg = shared_memory.SharedMemory(name=loc[1])
+                seg.close()
+                return True
+            if kind == "disk":
+                return os.path.exists(loc[1])
+        except Exception:
+            return False
+        return True  # inline is always alive
+
     def _gc_arena_after_death(self) -> None:
         """Reclaim arena space from a dead worker: unsealed half-writes and sealed
         outputs whose result message never reached us (reference analog: plasma
@@ -693,7 +877,7 @@ class Cluster:
             self.store.decref(oid)
         spec.arg_refs = []
 
-    def _on_worker_death(self, w: WorkerHandle) -> None:
+    def _on_worker_death(self, w: WorkerHandle, err: Optional[Exception] = None) -> None:
         with self._lock:
             if w.state == "dead":
                 return
@@ -710,7 +894,8 @@ class Cluster:
                 w.resources_held = {}
             self.metrics_by_worker.pop(w.worker_id, None)
         self._gc_arena_after_death()
-        err = WorkerCrashedError(f"worker {w.worker_id.hex()[:8]} died unexpectedly")
+        if err is None:
+            err = WorkerCrashedError(f"worker {w.worker_id.hex()[:8]} died unexpectedly")
         for task_id in inflight:
             ts = self.tasks.get(task_id)
             if ts is None:
@@ -785,7 +970,7 @@ class Cluster:
             w.process.terminate()
         except Exception:
             pass
-        self._on_worker_death(w)
+        self._on_worker_death(w, err)
 
     def get_named_actor_handle(self, name: str, namespace: str = ""):
         actor_id = self.gcs.get_named_actor(name, namespace)
@@ -854,8 +1039,14 @@ class Cluster:
         except Exception:
             pass
         self._router_thread.join(timeout=2.0)
+        # the maintenance thread must not be mid-spill when the arena unmaps
+        self._maint_wakeup.set()
+        self._maint_thread.join(timeout=5.0)
         self.store.free_all()
         object_store.destroy_arena()
+        import shutil
+
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
         # stale spans must not leak into a future cluster's trace (util/tracing.py)
         from ray_tpu.util import tracing
 
@@ -883,7 +1074,12 @@ class DriverContext:
         for r in ref_list:
             t = None if deadline is None else max(0.0, deadline - time.monotonic())
             loc = self.cluster.store.location(r.id, t)
-            values.append(object_store.resolve(loc, oid=r.id))
+            try:
+                values.append(object_store.resolve(loc, oid=r.id))
+            except object_store.ObjectLost:
+                # lineage reconstruction (reference ObjectRecoveryManager)
+                loc = self.cluster._recover_object(r.id)
+                values.append(object_store.resolve(loc, oid=r.id))
         return values[0] if single else values
 
     def put(self, value) -> ObjectRef:

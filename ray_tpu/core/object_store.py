@@ -27,6 +27,7 @@ INLINE_THRESHOLD = 100 * 1024
 #   ("inline", frame_bytes, is_error)
 #   ("arena", arena_name, oid_bytes, nbytes, is_error)
 #   ("shm", name, nbytes, is_error)
+#   ("disk", path, nbytes, is_error)    <- spilled (reference local_object_manager.h:43)
 Location = Tuple
 
 # ------------------------------------------------------------------- arena plumbing
@@ -95,7 +96,10 @@ def _default_arena():
     return _arena_default
 
 
-class ObjectLost(Exception):
+from .exceptions import ObjectLostError
+
+
+class ObjectLost(ObjectLostError):
     pass
 
 
@@ -155,11 +159,16 @@ class _SegmentCache:
         if seg is not None:
             try:
                 seg.close()
+            except BufferError:
+                _unclosable_segments.append(seg)
             except Exception:
                 pass
 
 
 _segment_cache = _SegmentCache()
+# segments whose mappings are pinned by live zero-copy views; kept referenced so
+# SharedMemory.__del__ doesn't emit BufferError warnings during gc
+_unclosable_segments: List[Any] = []
 
 
 def resolve(loc: Location, oid: Optional[ObjectID] = None) -> Any:
@@ -198,8 +207,23 @@ def resolve(loc: Location, oid: Optional[ObjectID] = None) -> Any:
             arena.unpin(oid_bytes)
     elif kind == "shm":
         _, name, size, is_error = loc
-        seg = _segment_cache.open(name)
+        try:
+            seg = _segment_cache.open(name)
+        except FileNotFoundError:
+            raise ObjectLost(f"shm segment {name} was freed or lost") from None
         value = serialization.deserialize_frame(memoryview(seg.buf)[:size])
+    elif kind == "disk":
+        _, path, size, is_error = loc
+        import mmap as _mmap
+
+        try:
+            with open(path, "rb") as f:
+                m = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        except (FileNotFoundError, ValueError, OSError):
+            raise ObjectLost(f"spilled object file {path} was lost") from None
+        # zero-copy: deserialized arrays are views over the file mapping; the
+        # exported buffer keeps the mmap alive until the views are collected
+        value = serialization.deserialize_frame(memoryview(m)[:size])
     else:
         raise ValueError(f"unknown location kind {kind!r}")
     if is_error:
@@ -207,15 +231,60 @@ def resolve(loc: Location, oid: Optional[ObjectID] = None) -> Any:
     return value
 
 
+def spill_location(loc: Location, spill_dir: str) -> Optional[Location]:
+    """Move a sealed arena/shm object's bytes to a disk file, freeing the memory
+    (reference LocalObjectManager::SpillObjects). Returns the new location, or
+    None if the object cannot be spilled (inline/already-disk/lost)."""
+    kind = loc[0]
+    os.makedirs(spill_dir, exist_ok=True)
+    if kind == "arena":
+        _, name, oid_bytes, size, is_error = loc
+        arena = _open_arena(name)
+        view = arena.get(oid_bytes)  # reader pin
+        if view is None:
+            return None
+        path = os.path.join(spill_dir, oid_bytes.hex())
+        try:
+            with open(path, "wb") as f:
+                f.write(view[:size])
+        finally:
+            view.release()
+            arena.unpin(oid_bytes)
+        arena.delete(oid_bytes)
+        return ("disk", path, size, is_error)
+    if kind == "shm":
+        _, name, size, is_error = loc
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+        except FileNotFoundError:
+            return None
+        path = os.path.join(spill_dir, name)
+        try:
+            with open(path, "wb") as f:
+                f.write(bytes(seg.buf[:size]))
+            seg.unlink()  # removes the name; live mappings elsewhere stay valid
+        finally:
+            try:
+                seg.close()
+            except BufferError:
+                # zero-copy views in this process keep the mapping alive; park the
+                # handle so its __del__ doesn't warn at gc time
+                _unclosable_segments.append(seg)
+        _segment_cache.drop(name)
+        return ("disk", path, size, is_error)
+    return None
+
+
 class ObjectStore:
     """Node-side coordinator: object directory, pending waits, refcounts, eviction."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._locations: Dict[ObjectID, Location] = {}
+        self._locations: Dict[ObjectID, Location] = {}  # insertion/touch order = LRU
         self._events: Dict[ObjectID, threading.Event] = {}
         self._refcounts: Dict[ObjectID, int] = {}
         self._failed: Dict[ObjectID, Exception] = {}
+        self.on_free = None  # callback(oid) — cluster drops lineage entries
 
     # -- directory -----------------------------------------------------------------
     def add(self, oid: ObjectID, loc: Location) -> None:
@@ -224,6 +293,17 @@ class ObjectStore:
             ev = self._events.pop(oid, None)
         if ev is not None:
             ev.set()
+
+    def replace_location(self, oid: ObjectID, loc: Location) -> None:
+        """Swap an object's storage location (spill/restore) without waking waiters."""
+        with self._lock:
+            if oid in self._locations:
+                self._locations[oid] = loc
+
+    def drop_location(self, oid: ObjectID) -> None:
+        """Forget a lost location so lineage reconstruction can re-add it."""
+        with self._lock:
+            self._locations.pop(oid, None)
 
     def mark_failed(self, oid: ObjectID, err: Exception) -> None:
         with self._lock:
@@ -241,6 +321,8 @@ class ObjectStore:
         with self._lock:
             loc = self._locations.get(oid)
             if loc is not None:
+                self._locations.pop(oid)  # LRU touch
+                self._locations[oid] = loc
                 return loc
             if oid in self._failed:
                 raise self._failed[oid]
@@ -253,7 +335,11 @@ class ObjectStore:
         with self._lock:
             if oid in self._failed:
                 raise self._failed[oid]
-            return self._locations[oid]
+            loc = self._locations[oid]
+            # LRU touch for the spill policy
+            self._locations.pop(oid)
+            self._locations[oid] = loc
+            return loc
 
     def try_location(self, oid: ObjectID) -> Optional[Location]:
         with self._lock:
@@ -308,6 +394,11 @@ class ObjectStore:
         with self._lock:
             loc = self._locations.pop(oid, None)
             self._failed.pop(oid, None)
+        if self.on_free is not None:
+            try:
+                self.on_free(oid)
+            except Exception:
+                pass
         if loc is None:
             return
         if loc[0] == "arena":
@@ -326,6 +417,42 @@ class ObjectStore:
                 pass
             except Exception:
                 pass
+        elif loc[0] == "disk":
+            try:
+                os.remove(loc[1])
+            except OSError:
+                pass
+
+    def spill_lru(self, bytes_to_free: int, spill_dir: str) -> int:
+        """Spill least-recently-used arena/shm objects until bytes_to_free memory
+        bytes are on disk (reference LocalObjectManager::SpillObjectsOfSize).
+        Returns bytes actually spilled."""
+        with self._lock:
+            candidates = [
+                (oid, loc) for oid, loc in self._locations.items()
+                if loc[0] in ("arena", "shm")
+            ]
+        spilled = 0
+        for oid, loc in candidates:  # dict order = LRU (oldest first)
+            if spilled >= bytes_to_free:
+                break
+            try:
+                new_loc = spill_location(loc, spill_dir)
+            except Exception:
+                continue  # skip unspillable objects, keep relieving pressure
+            if new_loc is None:
+                continue
+            self.replace_location(oid, new_loc)
+            spilled += new_loc[2]
+        return spilled
+
+    def memory_bytes(self) -> int:
+        """Bytes resident in shared memory (arena + segments), i.e. spillable."""
+        with self._lock:
+            return sum(
+                l[3] if l[0] == "arena" else l[2]
+                for l in self._locations.values() if l[0] in ("arena", "shm")
+            )
 
     def free_all(self) -> None:
         with self._lock:

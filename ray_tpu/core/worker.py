@@ -141,8 +141,16 @@ class WorkerContext:
         ref_list = [refs] if single else list(refs)
         oids = [r.id for r in ref_list]
         locs = self._request("get", oids, timeout)
-        values = [object_store.resolve(loc, oid=o) for o, loc in zip(oids, locs)]
+        values = [self._resolve_recovering(o, loc) for o, loc in zip(oids, locs)]
         return values[0] if single else values
+
+    def _resolve_recovering(self, oid: ObjectID, loc):
+        """resolve with lineage reconstruction on loss (reference ObjectRecoveryManager)."""
+        try:
+            return object_store.resolve(loc, oid=oid)
+        except object_store.ObjectLost:
+            new_loc = self._request("recover", oid)
+            return object_store.resolve(new_loc, oid=oid)
 
     def put(self, value) -> ObjectRef:
         oid = ObjectID.generate()
@@ -247,7 +255,7 @@ class WorkerContext:
 
     def _resolve_args(self, spec: TaskSpec, resolved_locs: List) -> Tuple[list, dict]:
         args, kwargs = cloudpickle.loads(spec.args_meta)
-        values = [object_store.resolve(loc, oid=o)
+        values = [self._resolve_recovering(o, loc)
                   for o, loc in zip(spec.arg_refs, resolved_locs)]
 
         def sub(x):

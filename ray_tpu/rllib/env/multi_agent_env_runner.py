@@ -111,6 +111,14 @@ class MultiAgentEnvRunner:
                 self._reset()
             else:
                 self._obs = {a: o for a, o in obs.items()}
+                # agents may join mid-episode (turn-based / spawn envs), or a
+                # consumed (done) agent id may re-spawn with a fresh episode
+                for aid, o in self._obs.items():
+                    ep = self._ma_episode.agent_episodes.get(aid)
+                    if ep is None or not ep.observations:
+                        ep = SingleAgentEpisode()
+                        ep.add_env_reset(o)
+                        self._ma_episode.agent_episodes[aid] = ep
         # flush in-progress agent chunks (bootstrap from their last obs)
         for aid, ep in self._ma_episode.agent_episodes.items():
             if len(ep):
