@@ -52,8 +52,10 @@ class Arena:
         self._lib = _lib()
         self.owner = owner
         # serializes close() against the background maintenance calls (sweep /
-        # gc_dead_owners) that walk the mapping — closing mid-walk segfaults
-        self._maint_lock = threading.Lock()
+        # gc_dead_owners) that walk the mapping — closing mid-walk segfaults.
+        # RLock, not Lock: unpin runs from weakref.finalize GC callbacks, which can
+        # fire on the same thread while it already holds the lock inside get/seal.
+        self._maint_lock = threading.RLock()
         fd = os.open(f"/dev/shm{name}", os.O_RDWR)
         try:
             self._map = mmap.mmap(fd, size)

@@ -54,9 +54,13 @@ def run(
     name: str = "default",
     route_prefix: str = "/",
     blocking: bool = False,
+    _local_testing_mode: bool = False,
     **_compat,
 ) -> DeploymentHandle:
-    """Deploy an application graph; returns the ingress handle (reference api.py:691)."""
+    """Deploy an application graph; returns the ingress handle (reference api.py:691).
+
+    _local_testing_mode=True runs the whole graph in-process with no cluster
+    (reference _private/local_testing_mode.py)."""
     from ray_tpu.usage import record_library_usage
 
     record_library_usage("serve")
@@ -64,6 +68,10 @@ def run(
         target = target.bind()
     if not isinstance(target, Application):
         raise TypeError("serve.run expects an Application (deployment.bind(...))")
+    if _local_testing_mode:
+        from .local_testing import run_local
+
+        return run_local(target)
     controller = _get_or_create_controller()
 
     apps: list = []

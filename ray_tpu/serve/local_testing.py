@@ -1,0 +1,92 @@
+"""Local testing mode: run a Serve application graph in-process, no cluster.
+
+Capability parity: reference python/ray/serve/_private/local_testing_mode.py —
+`serve.run(app, _local_testing_mode=True)` instantiates every deployment in the
+driver process and returns a handle whose .remote() executes the user callable
+synchronously on a thread, so unit tests need no controller/proxy/replica actors.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Any, Dict
+
+from .deployment import Application
+
+
+class LocalDeploymentResponse:
+    """Mirrors DeploymentResponse: .result(timeout_s) on an in-process future."""
+
+    def __init__(self, future: concurrent.futures.Future):
+        self._future = future
+
+    def result(self, timeout_s: float = None) -> Any:
+        return self._future.result(timeout=timeout_s)
+
+
+class LocalDeploymentHandle:
+    """Mirrors DeploymentHandle for one in-process deployment instance."""
+
+    def __init__(self, instance: Any, method_name: str = "__call__"):
+        self._instance = instance
+        self._method_name = method_name
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+
+    def options(self, method_name: str = None, **_compat) -> "LocalDeploymentHandle":
+        h = LocalDeploymentHandle(self._instance, method_name or self._method_name)
+        h._pool = self._pool
+        return h
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
+        target = self._instance
+        if self._method_name != "__call__":
+            fn = getattr(target, self._method_name)
+        elif callable(target) and not isinstance(target, type):
+            fn = target
+        else:
+            fn = target.__call__
+
+        def call():
+            import asyncio
+            import inspect
+
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                return asyncio.run(out)
+            return out
+
+        return LocalDeploymentResponse(self._pool.submit(call))
+
+
+def run_local(target: Application) -> LocalDeploymentHandle:
+    """Instantiate the bound graph bottom-up in this process (local testing mode)."""
+    instances: Dict[str, Any] = {}
+    handles: Dict[str, LocalDeploymentHandle] = {}
+    lock = threading.Lock()
+
+    def build(app: Application) -> LocalDeploymentHandle:
+        name = app.deployment.name
+        with lock:
+            if name in handles:
+                return handles[name]
+        args = tuple(build(a) if isinstance(a, Application) else a for a in app.args)
+        kwargs = {k: build(v) if isinstance(v, Application) else v for k, v in app.kwargs.items()}
+        tgt = app.deployment._target
+        instance = tgt(*args, **kwargs) if isinstance(tgt, type) else tgt
+        if not isinstance(tgt, type) and (args or kwargs):
+            # function deployment bound with args: partially apply them
+            import functools
+
+            instance = functools.partial(tgt, *args, **kwargs)
+        h = LocalDeploymentHandle(instance)
+        with lock:
+            instances[name] = instance
+            handles[name] = h
+        return h
+
+    return build(target)

@@ -8,13 +8,17 @@ from .result_grid import Result, ResultGrid  # noqa: F401
 from .schedulers import (  # noqa: F401
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from .search import (  # noqa: F401
     BasicVariantGenerator,
     Searcher,
+    TPESearcher,
+    TuneBOHB,
     choice,
     grid_search,
     loguniform,

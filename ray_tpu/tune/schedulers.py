@@ -168,3 +168,163 @@ class PopulationBasedTraining(TrialScheduler):
             donor = self.rng.choice(top)
             trial._pbt_exploit = {"donor": donor, "perturb": self._perturb}
         return CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference hyperband.py): bracketed successive halving.
+
+    Trials are assigned round-robin to brackets; each bracket halves at
+    milestones r, r*eta, r*eta^2, ... keeping the top 1/eta of its members.
+    Unlike ASHA the cutoff waits for the whole rung (bracket cohort) to report.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration", max_t: int = 81,
+                 reduction_factor: float = 3.0):
+        assert mode in ("min", "max")
+        self.metric, self.mode, self.time_attr = metric, mode, time_attr
+        self.max_t, self.eta = max_t, reduction_factor
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        # bracket s starts halving at r = max_t * eta^-s
+        self._brackets: List[Dict[str, Any]] = [
+            {"r0": max(1, int(max_t * reduction_factor ** -s)), "members": {}, "rungs": {}}
+            for s in range(s_max, -1, -1)
+        ]
+        self._next_bracket = 0
+        self._assignment: Dict[str, int] = {}
+        self._to_stop: set = set()  # below-cutoff trials from completed rungs
+
+    def _sign(self, v: float) -> float:
+        return -v if self.mode == "min" else v
+
+    def _milestones(self, bracket) -> List[int]:
+        out, t = [], bracket["r0"]
+        while t < self.max_t:
+            out.append(int(t))
+            t *= self.eta
+        return out
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        tid = trial.trial_id
+        if tid in self._to_stop:
+            self._to_stop.discard(tid)
+            return STOP
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        v = result.get(self.metric)
+        if v is None:
+            return CONTINUE
+        bi = self._assignment.get(tid)
+        if bi is None:
+            bi = self._next_bracket
+            self._assignment[tid] = bi
+            self._next_bracket = (self._next_bracket + 1) % len(self._brackets)
+        bracket = self._brackets[bi]
+        bracket["members"][tid] = self._sign(float(v))
+        for milestone in self._milestones(bracket):
+            rung = bracket["rungs"].setdefault(milestone, {})
+            if t >= milestone and tid not in rung:
+                rung[tid] = self._sign(float(v))
+                # synchronous halving: once every live bracket member reached the
+                # rung, stop the whole bottom (1 - 1/eta) fraction
+                live = set(bracket["members"])
+                if set(rung) >= live and len(rung) > 1:
+                    k = max(1, int(len(rung) / self.eta))
+                    cutoff = sorted(rung.values(), reverse=True)[k - 1]
+                    losers = {r for r, s in rung.items() if s < cutoff and r in live}
+                    for loser in losers:
+                        bracket["members"].pop(loser, None)
+                    self._to_stop |= losers
+                    if tid in self._to_stop:
+                        self._to_stop.discard(tid)
+                        return STOP
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result) -> None:
+        bi = self._assignment.get(trial.trial_id)
+        if bi is not None:
+            self._brackets[bi]["members"].pop(trial.trial_id, None)
+        self._to_stop.discard(trial.trial_id)
+
+
+class PB2(PopulationBasedTraining):
+    """PB2 (reference pb2.py): PBT where the perturbation is replaced by a
+    GP-bandit suggestion (Parker-Holder et al. 2020). A small numpy GP with an
+    RBF kernel is fit on (hyperparam vector -> reward improvement) pairs and the
+    exploit picks the UCB argmax inside `hyperparam_bounds` — no sklearn/GPy
+    dependency (the reference requires GPy here).
+    """
+
+    def __init__(self, metric: str = "reward", mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, List[float]]] = None,
+                 quantile_fraction: float = 0.25, seed: Optional[int] = None,
+                 ucb_kappa: float = 2.0):
+        super().__init__(metric=metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=None,
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = {k: (float(lo), float(hi)) for k, (lo, hi) in (hyperparam_bounds or {}).items()}
+        self.kappa = ucb_kappa
+        self._last_metric: Dict[str, float] = {}
+        self._X: List[List[float]] = []  # normalized hyperparam vectors
+        self._y: List[float] = []  # reward deltas over the interval
+
+    def _vec(self, config: Dict[str, Any]) -> List[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / max(hi - lo, 1e-12))
+        return out
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        v = result.get(self.metric)
+        if v is not None:
+            signed = self._sign(float(v))
+            prev = self._last_metric.get(trial.trial_id)
+            if prev is not None:
+                self._X.append(self._vec(trial.config))
+                self._y.append(signed - prev)
+            self._last_metric[trial.trial_id] = signed
+        return super().on_trial_result(trial, result)
+
+    def _gp_ucb(self) -> Optional[Dict[str, float]]:
+        import numpy as np
+
+        if len(self._y) < 2 or not self.bounds:
+            return None
+        X = np.asarray(self._X[-64:], dtype=np.float64)
+        y = np.asarray(self._y[-64:], dtype=np.float64)
+        y = (y - y.mean()) / (y.std() + 1e-9)
+        ls, noise = 0.3, 1e-2
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-d2 / (2 * ls * ls)) + noise * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            return None
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+        # UCB argmax over random candidates in the unit box
+        cand = np.asarray([[self.rng.random() for _ in self.bounds] for _ in range(256)])
+        d2c = ((cand[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        Kc = np.exp(-d2c / (2 * ls * ls))
+        mu = Kc @ alpha
+        v = np.linalg.solve(L, Kc.T)
+        var = np.clip(1.0 - (v * v).sum(0), 1e-9, None)
+        best = cand[int(np.argmax(mu + self.kappa * np.sqrt(var)))]
+        out = {}
+        for (k, (lo, hi)), u in zip(self.bounds.items(), best):
+            out[k] = lo + float(u) * (hi - lo)
+        return out
+
+    def _perturb(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        suggestion = self._gp_ucb()
+        if suggestion is None:
+            # cold start: uniform resample inside bounds
+            suggestion = {k: lo + self.rng.random() * (hi - lo)
+                          for k, (lo, hi) in self.bounds.items()}
+        out.update(suggestion)
+        return out

@@ -28,6 +28,7 @@ class LogUniform(Domain):
     def __init__(self, low: float, high: float):
         import math
 
+        self.low, self.high = low, high
         self.lo, self.hi = math.log(low), math.log(high)
 
     def sample(self, rng):
@@ -135,3 +136,117 @@ class BasicVariantGenerator(Searcher):
         cfg = self._variants[self._idx]
         self._idx += 1
         return cfg
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator searcher (the model behind the reference's
+    TuneBOHB/HyperOptSearch integrations — python/ray/tune/search/bohb/, hyperopt/ —
+    implemented natively on numpy so no ConfigSpace/hyperopt dependency is needed).
+
+    Observations are split at the gamma-quantile; per-dimension KDEs l(x) (good) and
+    g(x) (bad) are fit and candidates sampled from l are ranked by l(x)/g(x).
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str = "loss",
+                 mode: str = "min", n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        self.space = dict(param_space)
+        self.metric, self.mode = metric, mode
+        self.n_startup, self.gamma, self.n_candidates = n_startup, gamma, n_candidates
+        self.rng = random.Random(seed)
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[tuple] = []  # (config, signed_score)
+
+    def _random_config(self) -> Dict[str, Any]:
+        out = {}
+        for k, dom in self.space.items():
+            out[k] = dom.sample(self.rng) if isinstance(dom, Domain) else dom
+        return out
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._obs) < self.n_startup:
+            cfg = self._random_config()
+        else:
+            cfg = self._tpe_suggest()
+        self._configs[trial_id] = cfg
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None) -> None:
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is None or not result or result.get(self.metric) is None:
+            return
+        v = float(result[self.metric])
+        self._obs.append((cfg, -v if self.mode == "min" else v))
+
+    # -- TPE internals ---------------------------------------------------------
+    def _tpe_suggest(self) -> Dict[str, Any]:
+        import math as _m
+
+        obs = sorted(self._obs, key=lambda o: o[1], reverse=True)
+        n_good = max(2, int(self.gamma * len(obs)))
+        good, bad = [o[0] for o in obs[:n_good]], [o[0] for o in obs[n_good:]] or [o[0] for o in obs]
+
+        def kde_logp(x: float, pts: List[float], lo: float, hi: float) -> float:
+            bw = max((hi - lo) / max(len(pts), 1) * 1.5, 1e-9)
+            s = sum(_m.exp(-0.5 * ((x - p) / bw) ** 2) for p in pts)
+            return _m.log(max(s, 1e-12))
+
+        best_cfg, best_score = None, -_m.inf
+        for _ in range(self.n_candidates):
+            cand: Dict[str, Any] = {}
+            score = 0.0
+            for k, dom in self.space.items():
+                if isinstance(dom, Choice):
+                    # categorical TPE: sample by good-frequency, score by ratio
+                    counts_g = {c: 1.0 for c in dom.categories}
+                    for g in good:
+                        counts_g[g[k]] = counts_g.get(g[k], 1.0) + 1.0
+                    total = sum(counts_g.values())
+                    r = self.rng.random() * total
+                    acc = 0.0
+                    pick = dom.categories[-1]
+                    for c, w in counts_g.items():
+                        acc += w
+                        if r <= acc:
+                            pick = c
+                            break
+                    counts_b = {c: 1.0 for c in dom.categories}
+                    for b in bad:
+                        counts_b[b[k]] = counts_b.get(b[k], 1.0) + 1.0
+                    cand[k] = pick
+                    score += _m.log(counts_g[pick] / sum(counts_g.values())) - _m.log(
+                        counts_b.get(pick, 1.0) / sum(counts_b.values()))
+                    continue
+                if isinstance(dom, (Uniform, RandInt)):
+                    lo, hi = float(dom.low), float(dom.high)
+                    to_x = lambda v: float(v)  # noqa: E731
+                    if isinstance(dom, RandInt):
+                        # randrange upper bound is exclusive; never round onto it
+                        from_x = lambda v, d=dom: min(int(round(v)), d.high - 1)  # noqa: E731
+                    else:
+                        from_x = lambda v: v  # noqa: E731
+                elif isinstance(dom, LogUniform):
+                    lo, hi = dom.lo, dom.hi
+                    to_x = lambda v: _m.log(v)  # noqa: E731
+                    # clamp the exp against float error (exp(log(b)) can undershoot b)
+                    from_x = lambda v, d=dom: min(max(_m.exp(v), d.low), d.high)  # noqa: E731
+                else:
+                    cand[k] = dom.sample(self.rng) if isinstance(dom, Domain) else dom
+                    continue
+                pts_g = [to_x(g[k]) for g in good if k in g]
+                pts_b = [to_x(b[k]) for b in bad if k in b]
+                # sample from the good KDE: pick a center, jitter by bandwidth
+                bw = max((hi - lo) / max(len(pts_g), 1) * 1.5, 1e-9)
+                center = self.rng.choice(pts_g) if pts_g else self.rng.uniform(lo, hi)
+                x = min(max(self.rng.gauss(center, bw), lo), hi)
+                cand[k] = from_x(x)
+                score += kde_logp(x, pts_g, lo, hi) - kde_logp(x, pts_b, lo, hi)
+            if score > best_score:
+                best_cfg, best_score = cand, score
+        return best_cfg or self._random_config()
+
+
+# BOHB pairs this model with HyperBand brackets (reference search/bohb/bohb_search.py);
+# use TPESearcher + schedulers.HyperBandScheduler together for the same behavior.
+TuneBOHB = TPESearcher

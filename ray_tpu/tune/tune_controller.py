@@ -71,6 +71,9 @@ class TuneController:
         seed: Optional[int] = None,
     ):
         self.trainable_cls = wrap_trainable(trainable)
+        # model-based searchers (TPE, ...) suggest forever; num_samples is the cap
+        # (BasicVariantGenerator self-limits via its grid x num_samples expansion)
+        self._suggest_cap = None if searcher is None else max(1, num_samples)
         self.searcher = searcher or BasicVariantGenerator(param_space or {}, num_samples, seed)
         self.scheduler = scheduler or FIFOScheduler()
         self.max_concurrent = max_concurrent_trials
@@ -85,6 +88,8 @@ class TuneController:
 
     # -- lifecycle -------------------------------------------------------------
     def _next_trial(self) -> Optional[Trial]:
+        if self._suggest_cap is not None and len(self.trials) >= self._suggest_cap:
+            return None
         tid = uuid.uuid4().hex[:8]
         cfg = self.searcher.suggest(tid)
         if cfg is None:

@@ -1,0 +1,55 @@
+"""serve local testing mode (reference _private/local_testing_mode.py): no cluster."""
+from ray_tpu import serve
+
+
+def test_local_testing_class_deployment_no_cluster():
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def triple(self, x):
+            return x * 3
+
+    h = serve.run(Doubler.bind(), _local_testing_mode=True)
+    assert h.remote(21).result() == 42
+    assert h.options(method_name="triple").remote(5).result() == 15
+    assert h.triple.remote(4).result() == 12
+
+
+def test_local_testing_composed_graph():
+    @serve.deployment
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, adder):
+            self.adder = adder
+
+        def __call__(self, x):
+            return self.adder.remote(x).result() * 10
+
+    h = serve.run(Ingress.bind(Adder.bind(5)), _local_testing_mode=True)
+    assert h.remote(1).result() == 60
+
+
+def test_local_testing_function_deployment_and_async():
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    h = serve.run(double.bind(), _local_testing_mode=True)
+    assert h.remote(3).result() == 6
+
+    @serve.deployment
+    class AsyncD:
+        async def __call__(self, x):
+            return x + 1
+
+    h2 = serve.run(AsyncD.bind(), _local_testing_mode=True)
+    assert h2.remote(41).result() == 42

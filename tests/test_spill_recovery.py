@@ -13,12 +13,17 @@ from ray_tpu.core import global_state, object_store
 
 @pytest.fixture()
 def small_store_cluster():
-    """Own cluster with a tiny arena so spilling kicks in fast."""
+    """Own cluster with a tiny arena so spilling kicks in fast. Restores the
+    session-wide cluster afterwards (conftest rt) so later rt tests keep working."""
+    was_up = global_state.is_initialized()
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=4, object_store_memory=8 * 1024 * 1024,
                  worker_env={"JAX_PLATFORMS": "cpu"})
     yield global_state.worker().cluster
     ray_tpu.shutdown()
+    if was_up:
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)
 
 
 def test_spill_location_roundtrip(tmp_path):

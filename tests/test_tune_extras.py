@@ -1,0 +1,111 @@
+"""HyperBand / PB2 / TPE searcher tests (reference tune schedulers + search)."""
+import random
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler, PB2
+
+
+class _T:
+    def __init__(self, tid, config=None):
+        self.trial_id = tid
+        self.config = config or {}
+        self._pbt_exploit = None
+
+
+def test_hyperband_halves_synchronously():
+    sched = HyperBandScheduler(metric="loss", mode="min", max_t=27, reduction_factor=3.0)
+    # force a single bracket so the whole cohort shares rungs
+    sched._brackets = sched._brackets[:1]
+    sched._next_bracket = 0
+    trials = [_T(f"t{i}") for i in range(9)]
+    decisions = {}
+    for it in range(1, 27):
+        for i, t in enumerate(trials):
+            if decisions.get(t.trial_id) == STOP:
+                continue
+            d = sched.on_trial_result(t, {"training_iteration": it, "loss": float(i)})
+            decisions[t.trial_id] = d
+    stopped = {tid for tid, d in decisions.items() if d == STOP}
+    # successive halving with eta=3 must stop the bottom ~2/3 of the cohort
+    assert len(stopped) >= 5, decisions
+    # the best trial survives, the worst is stopped
+    assert "t0" not in stopped
+    assert "t8" in stopped
+
+
+def test_hyperband_round_robin_brackets_balanced():
+    sched = HyperBandScheduler(metric="loss", mode="min", max_t=9, reduction_factor=3.0)
+    n_brackets = len(sched._brackets)
+    trials = [_T(f"t{i}") for i in range(2 * n_brackets)]
+    for rep in range(3):  # repeated reports must not skew assignment
+        for i, t in enumerate(trials[: n_brackets]):
+            sched.on_trial_result(t, {"training_iteration": rep + 1, "loss": float(i)})
+    for t in trials[n_brackets:]:
+        sched.on_trial_result(t, {"training_iteration": 1, "loss": 0.5})
+    from collections import Counter
+
+    counts = Counter(sched._assignment.values())
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+
+def test_pb2_gp_suggestion_within_bounds():
+    sched = PB2(metric="reward", mode="max", perturbation_interval=1,
+                hyperparam_bounds={"lr": [1e-4, 1e-1]}, seed=0)
+    rng = random.Random(0)
+    trials = [_T(f"t{i}", {"lr": 10 ** rng.uniform(-4, -1)}) for i in range(4)]
+    # feed results: reward correlates with lr (higher better in this fake)
+    for step in range(1, 6):
+        for t in trials:
+            sched.on_trial_result(t, {"training_iteration": step,
+                                      "reward": t.config["lr"] * 100})
+    exploited = [t for t in trials if t._pbt_exploit]
+    assert exploited, "bottom-quantile trials should receive an exploit"
+    new_cfg = exploited[0]._pbt_exploit["perturb"](exploited[0].config)
+    assert 1e-4 <= new_cfg["lr"] <= 1e-1
+    # GP has data -> suggestion should not be degenerate
+    assert isinstance(new_cfg["lr"], float)
+
+
+def test_tpe_searcher_converges_toward_good_region():
+    space = {"x": tune.uniform(0.0, 1.0)}
+    s = tune.TPESearcher(space, metric="loss", mode="min", n_startup=6, seed=1)
+    # loss = (x - 0.8)^2: good region near 0.8
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        assert 0.0 <= cfg["x"] <= 1.0
+        s.on_trial_complete(f"t{i}", {"loss": (cfg["x"] - 0.8) ** 2})
+    late = [s.suggest(f"late{i}")["x"] for i in range(10)]
+    assert sum(abs(x - 0.8) < 0.25 for x in late) >= 6, late
+
+
+def test_tpe_handles_choice_and_loguniform():
+    space = {"opt": tune.choice(["adam", "sgd"]), "lr": tune.loguniform(1e-5, 1e-1)}
+    s = tune.TPESearcher(space, metric="loss", mode="min", n_startup=4, seed=2)
+    for i in range(20):
+        cfg = s.suggest(f"t{i}")
+        assert cfg["opt"] in ("adam", "sgd")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        loss = (0.0 if cfg["opt"] == "adam" else 1.0) + abs(cfg["lr"] - 1e-3)
+        s.on_trial_complete(f"t{i}", {"loss": loss})
+    picks = [s.suggest(f"late{i}")["opt"] for i in range(10)]
+    assert picks.count("adam") >= 6, picks
+
+
+def test_tuner_with_tpe_searcher_end_to_end(rt):
+    def objective(config):
+        tune.report({"loss": (config["x"] - 0.5) ** 2})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(
+            num_samples=8, metric="loss", mode="min",
+            search_alg=tune.TPESearcher({"x": tune.uniform(0, 1)}, metric="loss",
+                                        mode="min", n_startup=4, seed=0),
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 0.2
