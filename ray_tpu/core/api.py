@@ -25,17 +25,33 @@ def init(
     num_tpus: Optional[float] = None,
     resources: Optional[Dict[str, float]] = None,
     *,
+    address: Optional[str] = None,
+    client_server_port: Optional[int] = None,
     worker_env: Optional[Dict[str, str]] = None,
     max_workers_per_node: Optional[int] = None,
     object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = True,
     **_compat,
 ) -> None:
-    """Start the in-process cluster (head node) and connect the driver."""
+    """Start the in-process cluster (head node) and connect the driver.
+
+    address="ray-tpu://host:port" connects this process as a remote client
+    driver instead (reference ray.init("ray://...") via python/ray/util/client/).
+    client_server_port starts the head-side client server on that port."""
     if global_state.is_initialized():
         if ignore_reinit_error:
             return
         raise RuntimeError("ray_tpu.init() called twice")
+    if address is not None:
+        if not address.startswith(("ray-tpu://", "ray://")):
+            raise ValueError(
+                f"unsupported address {address!r}: use 'ray-tpu://host:port' to "
+                "connect as a remote client driver, or omit address to start locally")
+        from ray_tpu.util.client import connect
+
+        connect(address.split("://", 1)[1])
+        atexit.register(shutdown)
+        return
     if num_cpus is None:
         num_cpus = float(os.environ.get("RAY_TPU_NUM_CPUS", os.cpu_count() or 1))
     detected: Dict[str, float] = {}
@@ -61,10 +77,22 @@ def init(
     cluster = Cluster(total, worker_env=worker_env, **kwargs)
     global_state.set_cluster(cluster)
     global_state.set_worker(DriverContext(cluster))
+    if client_server_port is not None:
+        from ray_tpu.util.client.server import start_client_server
+
+        start_client_server(port=client_server_port)
     atexit.register(shutdown)
 
 
 def shutdown() -> None:
+    from ray_tpu.util.client.client import ClientContext
+
+    w = global_state.try_worker()
+    if isinstance(w, ClientContext):
+        w.close()
+    from ray_tpu.util.client.server import stop_client_server
+
+    stop_client_server()
     cluster = global_state.try_cluster()
     if cluster is not None:
         cluster.shutdown()

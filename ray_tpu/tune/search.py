@@ -151,6 +151,11 @@ class TPESearcher(Searcher):
                  mode: str = "min", n_startup: int = 8, gamma: float = 0.25,
                  n_candidates: int = 24, seed: Optional[int] = None):
         assert mode in ("min", "max")
+        for k, dom in param_space.items():
+            if isinstance(dom, GridSearch):
+                raise ValueError(
+                    f"TPESearcher does not support grid_search (key {k!r}); "
+                    "use tune.choice(...) or BasicVariantGenerator for grids")
         self.space = dict(param_space)
         self.metric, self.mode = metric, mode
         self.n_startup, self.gamma, self.n_candidates = n_startup, gamma, n_candidates

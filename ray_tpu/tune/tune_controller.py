@@ -71,9 +71,13 @@ class TuneController:
         seed: Optional[int] = None,
     ):
         self.trainable_cls = wrap_trainable(trainable)
-        # model-based searchers (TPE, ...) suggest forever; num_samples is the cap
-        # (BasicVariantGenerator self-limits via its grid x num_samples expansion)
-        self._suggest_cap = None if searcher is None else max(1, num_samples)
+        # model-based searchers (TPE, ...) suggest forever; num_samples is the cap.
+        # Self-limiting searchers (BasicVariantGenerator's grid x num_samples
+        # expansion) are exempt — they return None from suggest when exhausted.
+        self._suggest_cap = (
+            None if searcher is None or isinstance(searcher, BasicVariantGenerator)
+            else max(1, num_samples)
+        )
         self.searcher = searcher or BasicVariantGenerator(param_space or {}, num_samples, seed)
         self.scheduler = scheduler or FIFOScheduler()
         self.max_concurrent = max_concurrent_trials

@@ -1,0 +1,13 @@
+"""Ray Client equivalent: drive a running cluster from a remote process.
+
+Capability parity: reference python/ray/util/client/ (gRPC proxy for remote
+drivers; ARCHITECTURE.md). TPU-native design: instead of a gRPC schema, the
+runtime-API surface (submit/get/put/wait/actors/PGs — the same methods
+DriverContext exposes) is forwarded over an authenticated
+multiprocessing.connection channel; ObjectRefs/ActorHandles pickle by id and
+re-bind to the client context on arrival, so `ray_tpu.remote/get/put` work
+unchanged in the remote driver. Connect with
+`ray_tpu.init(address="ray-tpu://host:port")` or `client.connect(...)`.
+"""
+from .client import ClientContext, connect, disconnect  # noqa: F401
+from .server import ClientServer  # noqa: F401

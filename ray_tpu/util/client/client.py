@@ -1,0 +1,163 @@
+"""Client context: the remote driver's runtime API, forwarded over one channel.
+
+Every runtime-API method (submit/get/put/wait/kill_actor/...) is forwarded as
+(req_id, method, args, kwargs); a demux thread matches responses. ObjectRefs and
+ActorHandles arriving in results re-bind to this context automatically because
+they resolve the process-global worker at call time.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .server import DEFAULT_AUTHKEY
+
+# methods forwarded with a response
+_FORWARDED = {
+    "submit", "get", "put", "wait", "cancel",
+    "get_named_actor", "register_fn", "fn_known", "lookup_placement_group",
+    "pg_ready_ref", "create_placement_group", "remove_placement_group",
+    "kv_request",
+}
+# fire-and-forget: callable from __del__/GC finalizers (possibly ON the recv
+# thread), so they must never wait for a response or touch the socket directly
+_NO_REPLY = {"decref", "kill_actor", "push_metrics", "push_spans", "push_tqdm"}
+# replies carrying ObjectRefs whose ownership transfers to this client (the
+# server marks its temporaries un-owned after the reply; see set_ref_ownership)
+_REF_RETURNING = {"submit", "put", "pg_ready_ref"}
+
+
+class ClientContext:
+    def __init__(self, address: str, authkey: bytes = DEFAULT_AUTHKEY,
+                 timeout: Optional[float] = None):
+        from multiprocessing.connection import Client
+
+        import queue
+
+        host, _, port = address.rpartition(":")
+        self._conn = Client((host or "127.0.0.1", int(port)), authkey=authkey)
+        self._req_counter = itertools.count()
+        self._pending: Dict[int, Tuple[threading.Event, list]] = {}
+        self._pending_lock = threading.Lock()
+        self._closed = False
+        # all sends go through the outbox: SimpleQueue.put is reentrant, so GC
+        # finalizers (ObjectRef.__del__ -> decref) can enqueue from any thread —
+        # including mid-send or on the recv thread — without deadlock/corruption
+        self._outbox: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._send_thread = threading.Thread(
+            target=self._send_loop, daemon=True, name="ray-tpu-client-send")
+        self._send_thread.start()
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name="ray-tpu-client-recv")
+        self._recv_thread.start()
+        assert self._call("_ping") == "pong"
+        info = self._call("runtime_context")
+        self.node_id_hex = info["node_id"]
+        self.accel = "client-driver"
+
+    # -- transport -------------------------------------------------------------
+    def _send_loop(self) -> None:
+        while not self._closed:
+            msg = self._outbox.get()
+            if msg is None:
+                break
+            try:
+                self._conn.send(msg)
+            except (OSError, ValueError):
+                break
+
+    def _recv_loop(self) -> None:
+        while not self._closed:
+            try:
+                req_id, ok, value = self._conn.recv()
+            except Exception:
+                # EOF, OSError, or an unpicklable reply (missing class client-side):
+                # the stream position is unrecoverable — fail all pending calls
+                break
+            with self._pending_lock:
+                slot = self._pending.pop(req_id, None)
+            if slot is not None:
+                ev, out = slot
+                out.extend((ok, value))
+                ev.set()
+        # fail everything still in flight
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for ev, out in pending.values():
+            out.extend((False, ConnectionError("client connection closed")))
+            ev.set()
+
+    def _call(self, method: str, *args, **kwargs):
+        req_id = next(self._req_counter)
+        ev: threading.Event = threading.Event()
+        out: list = []
+        with self._pending_lock:
+            self._pending[req_id] = (ev, out)
+        self._outbox.put((req_id, method, args, kwargs))
+        ev.wait()
+        ok, value = out
+        if not ok:
+            raise value
+        if method in _REF_RETURNING:
+            from .server import set_ref_ownership
+
+            set_ref_ownership(value, True)
+        return value
+
+    def _cast(self, method: str, *args, **kwargs) -> None:
+        """Fire-and-forget (no response; safe from GC finalizers)."""
+        self._outbox.put((None, method, args, kwargs))
+
+    # -- runtime API -----------------------------------------------------------
+    def __getattr__(self, name: str):
+        if name in _FORWARDED:
+            return lambda *a, **k: self._call(name, *a, **k)
+        if name in _NO_REPLY:
+            return lambda *a, **k: self._cast(name, *a, **k)
+        raise AttributeError(name)
+
+    def runtime_context(self) -> Dict[str, Any]:
+        ctx = self._call("runtime_context")
+        ctx["worker_id"] = "client-driver"
+        return ctx
+
+    def as_future(self, ref):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def close(self) -> None:
+        self._closed = True
+        self._outbox.put(None)  # unblock the sender
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+def connect(address: str, authkey: bytes = DEFAULT_AUTHKEY) -> ClientContext:
+    """Connect this process as a remote driver (reference ray.init('ray://...'))."""
+    from ray_tpu.core import global_state
+
+    ctx = ClientContext(address, authkey)
+    global_state.set_worker(ctx)
+    return ctx
+
+
+def disconnect() -> None:
+    from ray_tpu.core import global_state
+
+    w = global_state.try_worker()
+    if isinstance(w, ClientContext):
+        w.close()
+        global_state.set_worker(None)

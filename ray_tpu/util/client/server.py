@@ -1,0 +1,175 @@
+"""Client server: listens on the head and forwards calls to the local runtime.
+
+Capability parity: reference python/ray/util/client/server/ — one server process
+on the head node, N remote clients. Each accepted connection gets a demux thread;
+each request runs on its own dispatch thread so a blocking get() from one client
+doesn't starve others on the same connection.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+DEFAULT_AUTHKEY = b"ray-tpu-client"
+
+
+def set_ref_ownership(value, owned: bool) -> list:
+    """Walk a reply value and flip ObjectRef ownership; returns the ids touched.
+
+    Server side (owned=False): the pickled copies on the client take over the
+    refcount (client __del__ forwards decref), so the server-side temporaries
+    must NOT decref when the dispatch thread drops them — otherwise a fast task
+    result can be freed before the client's get arrives. Client side
+    (owned=True): the unpickled borrows become the owning copies."""
+    from ray_tpu.core.object_ref import ObjectRef
+
+    touched = []
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, ObjectRef):
+            v._owned = owned
+            touched.append(v.id)
+        elif isinstance(v, (list, tuple, set)):
+            stack.extend(v)
+        elif isinstance(v, dict):
+            stack.extend(v.values())
+    return touched
+
+
+class ClientServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 10001,
+                 authkey: bytes = DEFAULT_AUTHKEY):
+        from multiprocessing.connection import Listener
+
+        self._listener = Listener((host, port), authkey=authkey)  # port 0 = ephemeral
+        self.address = self._listener.address
+        self.port = self.address[1]
+        self._shutdown = False
+        self._conns: list = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="client-server-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="client-server-conn").start()
+
+    def _serve_conn(self, conn) -> None:
+        from ray_tpu.core import global_state
+
+        send_lock = threading.Lock()
+        # ownership leased to this client: reclaimed if it disconnects uncleanly
+        leak_lock = threading.Lock()
+        leased_refs: set = set()
+        leased_actors: set = set()
+
+        def dispatch(req_id, method, args, kwargs):
+            try:
+                if method == "_ping":
+                    ok, value = True, "pong"
+                else:
+                    ctx = global_state.worker()
+                    ok = True
+                    value = getattr(ctx, method)(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                ok, value = False, e
+            if req_id is None:
+                if method == "decref" and args:
+                    with leak_lock:
+                        leased_refs.discard(args[0])
+                elif method == "kill_actor" and args:
+                    with leak_lock:
+                        leased_actors.discard(args[0])
+                return
+            try:
+                with send_lock:
+                    conn.send((req_id, ok, value))
+            except Exception:
+                # reply unpicklable: send a describable error instead of leaving
+                # the client's _call waiting forever
+                try:
+                    with send_lock:
+                        conn.send((req_id, False,
+                                   RuntimeError(f"client-server reply failed to serialize: {value!r:.500}")))
+                except Exception:
+                    pass
+                return
+            if ok:
+                touched = set_ref_ownership(value, False)
+                if touched:
+                    with leak_lock:
+                        leased_refs.update(touched)
+                if method == "submit" and args and getattr(args[0], "kind", "") == "actor_creation":
+                    with leak_lock:
+                        leased_actors.add(args[0].actor_id)
+
+        while not self._shutdown:
+            try:
+                req_id, method, args, kwargs = conn.recv()
+            except Exception:  # EOF/OSError/malformed frame all end the session
+                break
+            if req_id is None:
+                dispatch(req_id, method, args, kwargs)  # casts are quick: run inline
+            else:
+                threading.Thread(target=dispatch, args=(req_id, method, args, kwargs),
+                                 daemon=True).start()
+        try:
+            conn.close()
+        except Exception:
+            pass
+        # reclaim whatever the client still owned (crash / dropped connection)
+        ctx = global_state.try_worker()
+        if ctx is None:
+            return
+        with leak_lock:
+            refs, actors = list(leased_refs), list(leased_actors)
+            leased_refs.clear()
+            leased_actors.clear()
+        for oid in refs:
+            try:
+                ctx.decref(oid)
+            except Exception:
+                pass
+        for aid in actors:
+            try:
+                ctx.kill_actor(aid, no_restart=True, from_gc=True)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+_server: Optional[ClientServer] = None
+
+
+def start_client_server(host: str = "127.0.0.1", port: int = 10001,
+                        authkey: bytes = DEFAULT_AUTHKEY) -> ClientServer:
+    """Start (or return) the head-side client server (driver process)."""
+    global _server
+    if _server is None:
+        _server = ClientServer(host, port, authkey)
+    return _server
+
+
+def stop_client_server() -> None:
+    global _server
+    if _server is not None:
+        _server.close()
+        _server = None
