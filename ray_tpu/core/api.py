@@ -27,6 +27,7 @@ def init(
     *,
     address: Optional[str] = None,
     client_server_port: Optional[int] = None,
+    client_server_host: str = "127.0.0.1",  # "0.0.0.0" to accept remote drivers
     worker_env: Optional[Dict[str, str]] = None,
     max_workers_per_node: Optional[int] = None,
     object_store_memory: Optional[int] = None,
@@ -80,7 +81,7 @@ def init(
     if client_server_port is not None:
         from ray_tpu.util.client.server import start_client_server
 
-        start_client_server(port=client_server_port)
+        start_client_server(host=client_server_host, port=client_server_port)
     atexit.register(shutdown)
 
 

@@ -1107,6 +1107,23 @@ class DriverContext:
     def get_named_actor(self, name: str, namespace: str = ""):
         return self.cluster.get_named_actor_handle(name, namespace)
 
+    def kv_request(self, op: str, *args):
+        """Internal-KV access (workers go through the pipe; drivers and the
+        client server hit the GCS KV directly)."""
+        return getattr(self.cluster.gcs.kv, op)(*args)
+
+    def push_metrics(self, snapshot: list) -> None:
+        self.cluster.metrics_by_worker["driver"] = snapshot
+
+    def push_spans(self, spans: list) -> None:
+        with self.cluster._lock:
+            self.cluster.trace_spans.extend(spans)
+
+    def push_tqdm(self, state: dict) -> None:
+        from ray_tpu.experimental.tqdm_ray import _render_local
+
+        _render_local(state)
+
     def register_fn(self, fn_id: bytes, fn_bytes: bytes) -> None:
         self.cluster.fn_table[fn_id] = fn_bytes
 

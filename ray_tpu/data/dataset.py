@@ -106,6 +106,13 @@ class Dataset:
     def union(self, *others: "Dataset") -> "Dataset":
         return self._with(L.Union(self._input_op(), [o._input_op() for o in others]))
 
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             *, num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join on a key column (reference dataset.py join /
+        operators/join.py): both sides hash-partition on `on`, partitions join
+        in parallel tasks. how: inner | left_outer | right_outer | full_outer."""
+        return self._with(L.Join(self._input_op(), other._input_op(), on, how, num_partitions))
+
     def zip(self, other: "Dataset") -> "Dataset":
         return self._with(L.Zip(self._input_op(), other._input_op()))
 

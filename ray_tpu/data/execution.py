@@ -178,6 +178,78 @@ def _zip_blocks(left: Block, right: Block) -> Tuple[Block, BlockMetadata]:
     return left, BlockAccessor.for_block(left).get_metadata()
 
 
+def _join_partition(on: str, how: str, n_left: int, *parts: Block) -> Tuple[Block, BlockMetadata]:
+    """Hash-join one co-partition: first n_left blocks are the left side.
+
+    Arrow take() with null indices materializes the outer-join null rows, so
+    nullability is real Arrow nulls, not sentinel values."""
+    import pyarrow as pa
+
+    def concat_keep_schema(blocks):
+        """concat() drops 0-row blocks (and with them the schema outer joins
+        need for null columns); fall back to the first block's schema."""
+        if not blocks:
+            return None
+        merged = BlockAccessor.concat(list(blocks))
+        if merged.num_rows == 0:
+            merged = blocks[0].slice(0, 0)
+        return BlockAccessor.for_block(merged).to_arrow()
+
+    lt = concat_keep_schema(parts[:n_left])
+    rt = concat_keep_schema(parts[n_left:])
+    if lt is None and rt is None:
+        out = BlockAccessor.empty()
+        return out, BlockAccessor.for_block(out).get_metadata()
+    if lt is None:
+        out = rt if how in ("right_outer", "full_outer") else rt.slice(0, 0)
+        return out, BlockAccessor.for_block(out).get_metadata()
+    if rt is None:
+        out = lt if how in ("left_outer", "full_outer") else lt.slice(0, 0)
+        return out, BlockAccessor.for_block(out).get_metadata()
+
+    from collections import defaultdict
+
+    right_index = defaultdict(list)
+    for j, v in enumerate(rt.column(on).to_pylist()):
+        right_index[v].append(j)
+    li: List[Optional[int]] = []
+    ri: List[Optional[int]] = []
+    matched = set()
+    for i, v in enumerate(lt.column(on).to_pylist()):
+        js = right_index.get(v)
+        if js:
+            for j in js:
+                li.append(i)
+                ri.append(j)
+                matched.add(j)
+        elif how in ("left_outer", "full_outer"):
+            li.append(i)
+            ri.append(None)
+    if how in ("right_outer", "full_outer"):
+        for j in range(rt.num_rows):
+            if j not in matched:
+                li.append(None)
+                ri.append(j)
+    li_arr = pa.array(li, type=pa.int64())
+    ri_arr = pa.array(ri, type=pa.int64())
+    ltak = lt.take(li_arr)
+    rtak = rt.take(ri_arr)
+    import pyarrow.compute as pc
+
+    names, cols = [on], [pc.coalesce(ltak.column(on).combine_chunks(),
+                                     rtak.column(on).combine_chunks())]
+    for name in lt.column_names:
+        if name != on:
+            names.append(name)
+            cols.append(ltak.column(name))
+    for name in rt.column_names:
+        if name != on:
+            names.append(name if name not in lt.column_names else f"{name}_1")
+            cols.append(rtak.column(name))
+    out = pa.table(dict(zip(names, cols)))
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
 def _agg_partition(key: Optional[str], aggs, *parts: Block) -> Tuple[Block, BlockMetadata]:
     from .aggregate import aggregate_block
 
@@ -244,6 +316,8 @@ class StreamingExecutor:
             out = list(inputs)
             for other in op.others:
                 out.extend(StreamingExecutor(self.ctx).execute(other))
+        elif isinstance(op, L.Join):
+            out = self._run_join(op, inputs)
         elif isinstance(op, L.Zip):
             out = self._run_zip(op, inputs)
         elif isinstance(op, L.Write):
@@ -473,6 +547,29 @@ class StreamingExecutor:
             return [(block_ref, ray_tpu.get(meta_ref))]
         n_parts = min(len(inputs), 8)
         return self._two_phase(inputs, _hash_partition, (op.key, n_parts), _agg_partition, (op.key, op.aggs), n_parts)
+
+    def _run_join(self, op: L.Join, inputs: List[RefBundle]) -> List[RefBundle]:
+        """Hash-shuffle both sides on the key, then join co-partitions in tasks
+        (reference operators/join.py over hash_shuffle.py)."""
+        right = StreamingExecutor(self.ctx).execute(op.other)
+        if not inputs and not right:
+            return []
+        n_parts = op.num_partitions or max(len(inputs), len(right), 1)
+        rjoin = _remote(_join_partition).options(num_returns=2)
+        if n_parts == 1:
+            block_ref, meta_ref = rjoin.remote(
+                op.on, op.how, len(inputs), *[b for b, _ in inputs], *[b for b, _ in right])
+            return [(block_ref, ray_tpu.get(meta_ref))]
+        rmap = _remote(_hash_partition).options(num_returns=n_parts)
+        left_parts = [rmap.remote(b, op.on, n_parts) for b, _ in inputs]
+        right_parts = [rmap.remote(b, op.on, n_parts) for b, _ in right]
+        # submit every partition's join before touching metadata so they run in parallel
+        pairs = []
+        for p in range(n_parts):
+            lrefs = [pl[p] for pl in left_parts]
+            rrefs = [pl[p] for pl in right_parts]
+            pairs.append(rjoin.remote(op.on, op.how, len(lrefs), *lrefs, *rrefs))
+        return [(block_ref, ray_tpu.get(meta_ref)) for block_ref, meta_ref in pairs]
 
     def _run_zip(self, op: L.Zip, inputs: List[RefBundle]) -> List[RefBundle]:
         other = StreamingExecutor(self.ctx).execute(op.other)

@@ -147,6 +147,21 @@ class Aggregate(LogicalOperator):
         self.aggs = aggs
 
 
+class Join(LogicalOperator):
+    """Hash join (reference _internal/execution/operators/join.py + hash_shuffle.py)."""
+
+    def __init__(self, input_op, other: LogicalOperator, on: str, how: str = "inner",
+                 num_partitions: Optional[int] = None):
+        super().__init__(input_op)
+        if how not in ("inner", "left_outer", "right_outer", "full_outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        self.other = other
+        self.on = on
+        self.how = how
+        self.num_partitions = num_partitions
+        self.name = f"Join({how})"
+
+
 class Union(LogicalOperator):
     name = "Union"
 

@@ -33,3 +33,14 @@ from .session import (  # noqa: F401
     report,
 )
 from .step import TrainState, init_state, make_optimizer, make_train_step  # noqa: F401
+from .v2 import (  # noqa: F401  (Train v2: controller + policies, SURVEY §2.4)
+    DefaultFailurePolicy,
+    ElasticScalingPolicy,
+    FailureDecision,
+    FailurePolicy,
+    FixedScalingPolicy,
+    ResizeDecision,
+    ScalingPolicy,
+    TrainController,
+    TrainControllerState,
+)

@@ -63,6 +63,25 @@ class DataParallelTrainer:
         storage = self.run_config.storage_path or _default_storage_path()
         run_dir = os.path.join(storage, name)
         ckpt_manager = CheckpointManager(run_dir, self.run_config.checkpoint_config)
+        train_fn = _normalize_train_fn(self.train_loop_per_worker)
+        if os.environ.get("RAY_TPU_TRAIN_V2_ENABLED", "0") in ("1", "true"):
+            # v2 controller path (reference RAY_TRAIN_V2_ENABLED gate)
+            from .v2 import TrainController
+
+            controller = TrainController(
+                train_fn,
+                backend_config=self.backend_config,
+                scaling_config=self.scaling_config,
+                run_config=self.run_config,
+                checkpoint_manager=ckpt_manager,
+                train_loop_config=self.train_loop_config,
+                datasets=self.datasets,
+                experiment_name=name,
+                resume_checkpoint=self.resume_from_checkpoint,
+            )
+            result = controller.run()
+            result.path = run_dir
+            return result
         executor = BackendExecutor(
             backend_config=self.backend_config,
             scaling_config=self.scaling_config,
@@ -70,7 +89,6 @@ class DataParallelTrainer:
             failure_config=self.run_config.failure_config,
             experiment_name=name,
         )
-        train_fn = _normalize_train_fn(self.train_loop_per_worker)
         try:
             result = executor.run_until_complete(
                 train_fn,

@@ -1,0 +1,248 @@
+"""Train v2: controller-actor architecture with pluggable failure/scaling policies.
+
+Capability parity: reference python/ray/train/v2/ (gated by RAY_TRAIN_V2_ENABLED) —
+TrainController state machine (v2/_internal/execution/controller/controller.py:94),
+FailurePolicy (failure_handling/failure_policy.py:14), ScalingPolicy /
+FixedScalingPolicy (scaling_policy/scaling_policy.py:29, fixed.py:13). The
+controller drives the existing BackendExecutor through explicit state
+transitions, consulting the failure policy on worker-group failure and the
+scaling policy before each (re)start — the seam elastic training plugs into.
+Enable through a trainer with RAY_TPU_TRAIN_V2_ENABLED=1 or use TrainController
+directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import time
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorError, RayTpuError
+
+from .backend_executor import BackendExecutor, TrainingFailedError
+from .checkpoint import Checkpoint
+from .result import Result
+
+logger = logging.getLogger(__name__)
+
+
+class TrainControllerState(enum.Enum):
+    INITIALIZING = "INITIALIZING"
+    SCHEDULING = "SCHEDULING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    RESIZING = "RESIZING"
+    ERRORED = "ERRORED"
+    FINISHED = "FINISHED"
+
+
+class FailureDecision(enum.Enum):
+    RETRY = "RETRY"
+    RAISE = "RAISE"
+
+
+class FailurePolicy:
+    """Decides what to do when the worker group fails (reference failure_policy.py:14)."""
+
+    def make_decision(self, error: Exception, failure_count: int) -> FailureDecision:
+        raise NotImplementedError
+
+
+class DefaultFailurePolicy(FailurePolicy):
+    """Retry up to max_failures times (-1 = unlimited), then raise."""
+
+    def __init__(self, max_failures: int = 0):
+        self.max_failures = max_failures
+
+    def make_decision(self, error: Exception, failure_count: int) -> FailureDecision:
+        if self.max_failures < 0 or failure_count <= self.max_failures:
+            return FailureDecision.RETRY
+        return FailureDecision.RAISE
+
+
+@dataclasses.dataclass
+class ResizeDecision:
+    num_workers: int
+
+
+class NoopDecision:
+    pass
+
+
+class ScalingPolicy:
+    """Sizes the worker group (reference scaling_policy.py:29)."""
+
+    def make_decision_for_non_running_worker_group(self) -> ResizeDecision:
+        raise NotImplementedError
+
+    def monitor(self, executor: BackendExecutor):
+        """Called each poll while RUNNING; return ResizeDecision to trigger a resize."""
+        return NoopDecision()
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    """Always the configured size (reference fixed.py:13)."""
+
+    def __init__(self, scaling_config):
+        self.scaling_config = scaling_config
+
+    def make_decision_for_non_running_worker_group(self) -> ResizeDecision:
+        return ResizeDecision(self.scaling_config.num_workers)
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Size to available cluster resources inside [min_workers, max_workers].
+
+    A minimal elastic policy: before each (re)start, fit the group to the CPUs
+    (or TPUs) the cluster can currently grant — the shape the reference's v2
+    elastic design targets."""
+
+    def __init__(self, min_workers: int, max_workers: int, scaling_config=None):
+        assert 1 <= min_workers <= max_workers
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.scaling_config = scaling_config
+
+    def make_decision_for_non_running_worker_group(self) -> ResizeDecision:
+        res = ray_tpu.available_resources()
+        sc = self.scaling_config
+        use_tpu = bool(sc and getattr(sc, "use_tpu", False))
+        if use_tpu:
+            per = float(getattr(sc, "chips_per_worker", 1.0)) or 1.0
+        else:
+            per = float(getattr(sc, "cpus_per_worker", 1.0)) or 1.0
+        avail = res.get("TPU" if use_tpu else "CPU", 0.0)
+        fit = int(avail // per)
+        return ResizeDecision(max(self.min_workers, min(self.max_workers, fit)))
+
+
+class TrainController:
+    """Poll-driven state machine around a BackendExecutor (reference controller.py:94)."""
+
+    def __init__(
+        self,
+        train_fn: Callable[[Dict[str, Any]], None],
+        *,
+        backend_config,
+        scaling_config,
+        run_config,
+        checkpoint_manager=None,
+        failure_policy: Optional[FailurePolicy] = None,
+        scaling_policy: Optional[ScalingPolicy] = None,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        experiment_name: str = "train_v2",
+        resume_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_fn = train_fn
+        self.train_loop_config = train_loop_config or {}
+        self.datasets = datasets
+        self.resume_checkpoint = resume_checkpoint
+        self.backend_config = backend_config
+        self.scaling_config = scaling_config
+        self.run_config = run_config
+        self.checkpoint_manager = checkpoint_manager
+        self.failure_policy = failure_policy or DefaultFailurePolicy(
+            run_config.failure_config.max_failures if run_config.failure_config else 0)
+        self.scaling_policy = scaling_policy or FixedScalingPolicy(scaling_config)
+        self.experiment_name = experiment_name
+        self.state = TrainControllerState.INITIALIZING
+        self.failure_count = 0
+        self.executor: Optional[BackendExecutor] = None
+        self._state_log: list = [self.state]
+        # metric history survives executor replacement across restarts/resizes
+        self._merged_history: list = []
+        self._latest_metrics: Optional[Dict[str, Any]] = None
+
+    def _transition(self, state: TrainControllerState) -> None:
+        logger.info("TrainController: %s -> %s", self.state.value, state.value)
+        self.state = state
+        self._state_log.append(state)
+
+    def _build_executor(self, num_workers: int) -> BackendExecutor:
+        import copy as _copy
+
+        sc = _copy.copy(self.scaling_config)
+        sc.num_workers = num_workers
+        return BackendExecutor(
+            backend_config=self.backend_config,
+            scaling_config=sc,
+            checkpoint_manager=self.checkpoint_manager,
+            failure_config=None,  # the controller owns failure handling in v2
+            experiment_name=self.experiment_name,
+        )
+
+    def _retire_executor(self, graceful: bool) -> None:
+        """Absorb the executor's metric history before replacing/dropping it."""
+        if self.executor is None:
+            return
+        self._merged_history.extend(self.executor._history)
+        if self.executor._latest_metrics is not None:
+            self._latest_metrics = self.executor._latest_metrics
+        self.executor.shutdown(graceful=graceful)
+        self.executor = None
+
+    def run(self) -> Result:
+        error: Optional[str] = None
+        checkpoint: Optional[Checkpoint] = self.resume_checkpoint
+        if self.checkpoint_manager is not None:
+            checkpoint = self.checkpoint_manager.latest_checkpoint or checkpoint
+        while self.state not in (TrainControllerState.ERRORED, TrainControllerState.FINISHED):
+            if self.state in (TrainControllerState.INITIALIZING,
+                              TrainControllerState.RESTARTING,
+                              TrainControllerState.RESIZING):
+                decision = self.scaling_policy.make_decision_for_non_running_worker_group()
+                self._transition(TrainControllerState.SCHEDULING)
+                self.executor = self._build_executor(decision.num_workers)
+                try:
+                    self.executor.start()
+                    self.executor.start_training(
+                        self.train_fn, self.train_loop_config, self.datasets, checkpoint)
+                except (TrainingFailedError, ActorError, RayTpuError) as e:
+                    if not self._on_failure(e):
+                        error = str(e)
+                    continue
+                self._transition(TrainControllerState.RUNNING)
+            elif self.state == TrainControllerState.RUNNING:
+                try:
+                    poll = self.executor.poll()
+                except (TrainingFailedError, ActorError, RayTpuError) as e:
+                    if self.checkpoint_manager is not None:
+                        checkpoint = self.checkpoint_manager.latest_checkpoint or checkpoint
+                    if not self._on_failure(e):
+                        error = str(e)
+                    continue
+                if poll["finished"]:
+                    self._transition(TrainControllerState.FINISHED)
+                    continue
+                resize = self.scaling_policy.monitor(self.executor)
+                if isinstance(resize, ResizeDecision) and (
+                        resize.num_workers != self.executor.scaling_config.num_workers):
+                    if self.checkpoint_manager is not None:
+                        checkpoint = self.checkpoint_manager.latest_checkpoint or checkpoint
+                    self._retire_executor(graceful=True)
+                    self._transition(TrainControllerState.RESIZING)
+                    continue
+                time.sleep(self.executor.poll_interval_s)
+            else:  # SCHEDULING handled inline above
+                break
+        latest = self.checkpoint_manager.latest_checkpoint if self.checkpoint_manager else None
+        best = self.checkpoint_manager.best_checkpoint if self.checkpoint_manager else None
+        self._retire_executor(graceful=True)
+        return Result(metrics=self._latest_metrics, checkpoint=latest, best_checkpoint=best,
+                      error=error, metrics_dataframe=list(self._merged_history))
+
+    def _on_failure(self, e: Exception) -> bool:
+        """Returns True if retrying. Shuts the group down either way."""
+        self.failure_count += 1
+        decision = self.failure_policy.make_decision(e, self.failure_count)
+        logger.warning("TrainController failure #%d (%s): %s",
+                       self.failure_count, decision.value, e)
+        self._retire_executor(graceful=False)
+        if decision == FailureDecision.RETRY:
+            self._transition(TrainControllerState.RESTARTING)
+            return True
+        self._transition(TrainControllerState.ERRORED)
+        return False

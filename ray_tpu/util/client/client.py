@@ -12,6 +12,7 @@ import threading
 from typing import Any, Dict, Optional, Tuple
 
 from .server import DEFAULT_AUTHKEY
+from .server import REF_RETURNING as _REF_RETURNING  # shared with the server's leasing
 
 # methods forwarded with a response
 _FORWARDED = {
@@ -23,9 +24,6 @@ _FORWARDED = {
 # fire-and-forget: callable from __del__/GC finalizers (possibly ON the recv
 # thread), so they must never wait for a response or touch the socket directly
 _NO_REPLY = {"decref", "kill_actor", "push_metrics", "push_spans", "push_tqdm"}
-# replies carrying ObjectRefs whose ownership transfers to this client (the
-# server marks its temporaries un-owned after the reply; see set_ref_ownership)
-_REF_RETURNING = {"submit", "put", "pg_ready_ref"}
 
 
 class ClientContext:
@@ -57,6 +55,13 @@ class ClientContext:
         self.accel = "client-driver"
 
     # -- transport -------------------------------------------------------------
+    def _fail_all_pending(self, reason: str) -> None:
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for ev, out in pending.values():
+            out.extend((False, ConnectionError(reason)))
+            ev.set()
+
     def _send_loop(self) -> None:
         while not self._closed:
             msg = self._outbox.get()
@@ -64,8 +69,21 @@ class ClientContext:
                 break
             try:
                 self._conn.send(msg)
-            except (OSError, ValueError):
-                break
+            except BaseException as e:  # noqa: BLE001
+                if msg[0] is not None:
+                    # a request failed to serialize/send: fail just that call,
+                    # the channel itself may still be fine for picklable traffic
+                    with self._pending_lock:
+                        slot = self._pending.pop(msg[0], None)
+                    if slot is not None:
+                        ev, out = slot
+                        out.extend((False, e))
+                        ev.set()
+                if isinstance(e, (OSError, EOFError, BrokenPipeError)):
+                    # transport is dead: nothing sent after this can complete
+                    self._closed = True
+                    self._fail_all_pending("client connection lost (send failed)")
+                    break
 
     def _recv_loop(self) -> None:
         while not self._closed:
@@ -81,14 +99,12 @@ class ClientContext:
                 ev, out = slot
                 out.extend((ok, value))
                 ev.set()
-        # fail everything still in flight
-        with self._pending_lock:
-            pending, self._pending = self._pending, {}
-        for ev, out in pending.values():
-            out.extend((False, ConnectionError("client connection closed")))
-            ev.set()
+        self._closed = True
+        self._fail_all_pending("client connection closed")
 
     def _call(self, method: str, *args, **kwargs):
+        if self._closed:
+            raise ConnectionError("client connection is closed")
         req_id = next(self._req_counter)
         ev: threading.Event = threading.Event()
         out: list = []

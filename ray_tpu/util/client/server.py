@@ -12,6 +12,11 @@ from typing import Optional
 
 DEFAULT_AUTHKEY = b"ray-tpu-client"
 
+# methods whose replies carry NEW ObjectRefs with ownership transferring to the
+# client; replies from other methods (get/wait/...) contain only borrows and
+# must NOT be leased — leasing them would reclaim objects the head still owns
+REF_RETURNING = frozenset({"submit", "put", "pg_ready_ref"})
+
 
 def set_ref_ownership(value, owned: bool) -> list:
     """Walk a reply value and flip ObjectRef ownership; returns the ids touched.
@@ -88,20 +93,9 @@ class ClientServer:
                     with leak_lock:
                         leased_actors.discard(args[0])
                 return
-            try:
-                with send_lock:
-                    conn.send((req_id, ok, value))
-            except Exception:
-                # reply unpicklable: send a describable error instead of leaving
-                # the client's _call waiting forever
-                try:
-                    with send_lock:
-                        conn.send((req_id, False,
-                                   RuntimeError(f"client-server reply failed to serialize: {value!r:.500}")))
-                except Exception:
-                    pass
-                return
-            if ok:
+            if ok and method in REF_RETURNING:
+                # lease BEFORE the reply goes out so a fast client decref can
+                # never race ahead of the lease record
                 touched = set_ref_ownership(value, False)
                 if touched:
                     with leak_lock:
@@ -109,6 +103,19 @@ class ClientServer:
                 if method == "submit" and args and getattr(args[0], "kind", "") == "actor_creation":
                     with leak_lock:
                         leased_actors.add(args[0].actor_id)
+            try:
+                with send_lock:
+                    conn.send((req_id, ok, value))
+            except Exception:
+                # reply unpicklable: send a describable error instead of leaving
+                # the client's _call waiting forever (leases stay recorded and
+                # are reclaimed on disconnect)
+                try:
+                    with send_lock:
+                        conn.send((req_id, False,
+                                   RuntimeError(f"client-server reply failed to serialize: {value!r:.500}")))
+                except Exception:
+                    pass
 
         while not self._shutdown:
             try:

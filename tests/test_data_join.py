@@ -1,0 +1,78 @@
+"""Dataset.join tests (reference operators/join.py over hash_shuffle.py)."""
+import numpy as np
+import pytest
+
+from ray_tpu import data as rtd
+
+
+@pytest.fixture(autouse=True)
+def _cluster(rt):
+    yield
+
+
+def _left():
+    return rtd.from_items([
+        {"id": 1, "x": 10}, {"id": 2, "x": 20}, {"id": 3, "x": 30}, {"id": 2, "x": 21},
+    ])
+
+
+def _right():
+    return rtd.from_items([
+        {"id": 2, "y": "b"}, {"id": 3, "y": "c"}, {"id": 4, "y": "d"},
+    ])
+
+
+def test_inner_join():
+    rows = _left().join(_right(), on="id").take_all()
+    got = sorted((r["id"], r["x"], r["y"]) for r in rows)
+    assert got == [(2, 20, "b"), (2, 21, "b"), (3, 30, "c")]
+
+
+def test_left_outer_join():
+    rows = _left().join(_right(), on="id", how="left_outer").take_all()
+    by_id = sorted((r["id"], r["x"], r["y"]) for r in rows)
+    assert (1, 10, None) in by_id
+    assert len(by_id) == 4
+
+
+def test_right_outer_join():
+    rows = _left().join(_right(), on="id", how="right_outer").take_all()
+    ids = sorted(r["id"] for r in rows)
+    assert ids == [2, 2, 3, 4]
+    d4 = next(r for r in rows if r["id"] == 4)
+    # numeric nulls surface as None (arrow rows) or NaN (numpy batch path)
+    assert d4["x"] is None or np.isnan(d4["x"])
+    assert d4["y"] == "d"
+
+
+def test_full_outer_join():
+    rows = _left().join(_right(), on="id", how="full_outer").take_all()
+    ids = sorted(r["id"] for r in rows)
+    assert ids == [1, 2, 2, 3, 4]
+
+
+def test_join_column_name_collision_and_partitions():
+    left = rtd.from_items([{"k": i, "v": i * 2} for i in range(50)])
+    right = rtd.from_items([{"k": i, "v": i * 3} for i in range(0, 50, 2)])
+    rows = left.join(right, on="k", num_partitions=4).take_all()
+    assert len(rows) == 25
+    for r in rows:
+        assert r["v"] == r["k"] * 2
+        assert r["v_1"] == r["k"] * 3
+
+
+def test_join_then_map_batches_composes():
+    left = rtd.from_items([{"k": i, "a": float(i)} for i in range(20)])
+    right = rtd.from_items([{"k": i, "b": float(i * i)} for i in range(20)])
+    out = (
+        left.join(right, on="k")
+        .map_batches(lambda b: {"s": b["a"] + b["b"]}, batch_format="numpy")
+        .take_all()
+    )
+    assert len(out) == 20
+    assert sorted(r["s"] for r in out) == sorted(float(i + i * i) for i in range(20))
+
+
+def test_bad_join_type_raises():
+    with pytest.raises(ValueError):
+        _left().join(_right(), on="id", how="cross")

@@ -1,0 +1,126 @@
+"""Train v2 controller tests (reference python/ray/train/v2/)."""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    DefaultFailurePolicy,
+    ElasticScalingPolicy,
+    FailureDecision,
+    FixedScalingPolicy,
+    TrainController,
+    TrainControllerState,
+)
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+
+
+@pytest.fixture(autouse=True)
+def _cluster(rt):
+    yield
+
+
+def test_default_failure_policy_decisions():
+    p = DefaultFailurePolicy(max_failures=2)
+    assert p.make_decision(RuntimeError(), 1) == FailureDecision.RETRY
+    assert p.make_decision(RuntimeError(), 2) == FailureDecision.RETRY
+    assert p.make_decision(RuntimeError(), 3) == FailureDecision.RAISE
+    unlimited = DefaultFailurePolicy(max_failures=-1)
+    assert unlimited.make_decision(RuntimeError(), 99) == FailureDecision.RETRY
+
+
+def test_elastic_policy_fits_available_cpus(rt):
+    sc = ScalingConfig(num_workers=1, cpus_per_worker=1.0)
+    pol = ElasticScalingPolicy(min_workers=1, max_workers=64, scaling_config=sc)
+    d = pol.make_decision_for_non_running_worker_group()
+    total = ray_tpu.cluster_resources().get("CPU", 0)
+    assert 1 <= d.num_workers <= min(64, int(total))
+
+
+def test_controller_runs_to_finished(rt, tmp_path):
+    def loop(config):
+        from ray_tpu import train as t
+
+        for i in range(3):
+            t.report({"it": i})
+
+    ctl = TrainController(
+        loop,
+        backend_config=train.BackendConfig(),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+        train_loop_config={},
+    )
+    result = ctl.run()
+    assert result.error is None
+    assert ctl.state == TrainControllerState.FINISHED
+    assert result.metrics["it"] == 2
+    assert TrainControllerState.SCHEDULING in ctl._state_log
+    assert TrainControllerState.RUNNING in ctl._state_log
+
+
+def test_controller_retries_worker_failure(rt, tmp_path):
+    marker = tmp_path / "failed_once"
+
+    def loop(config):
+        import os
+
+        from ray_tpu import train as t
+
+        ctx = t.get_context()
+        if ctx.get_world_rank() == 0 and not os.path.exists(config["marker"]):
+            open(config["marker"], "w").write("1")
+            os._exit(1)  # hard crash
+        t.report({"done": 1})
+
+    ctl = TrainController(
+        loop,
+        backend_config=train.BackendConfig(),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)),
+        train_loop_config={"marker": str(marker)},
+    )
+    result = ctl.run()
+    assert result.error is None, result.error
+    assert ctl.failure_count == 1
+    assert TrainControllerState.RESTARTING in ctl._state_log
+    assert ctl.state == TrainControllerState.FINISHED
+
+
+def test_controller_errors_when_policy_exhausted(rt, tmp_path):
+    def loop(config):
+        import os
+
+        os._exit(1)
+
+    ctl = TrainController(
+        loop,
+        backend_config=train.BackendConfig(),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    )
+    result = ctl.run()
+    assert result.error is not None
+    assert ctl.state == TrainControllerState.ERRORED
+    assert ctl.failure_count == 2  # initial + one retry
+
+
+def test_v2_env_gate_via_trainer(rt, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRAIN_V2_ENABLED", "1")
+
+    def loop(config):
+        from ray_tpu import train as t
+
+        t.report({"v2": 1})
+
+    trainer = train.JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["v2"] == 1
