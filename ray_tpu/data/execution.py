@@ -211,12 +211,13 @@ def _join_partition(on: str, how: str, n_left: int, *parts: Block) -> Tuple[Bloc
 
     right_index = defaultdict(list)
     for j, v in enumerate(rt.column(on).to_pylist()):
-        right_index[v].append(j)
+        if v is not None:  # SQL semantics: null keys never match
+            right_index[v].append(j)
     li: List[Optional[int]] = []
     ri: List[Optional[int]] = []
     matched = set()
     for i, v in enumerate(lt.column(on).to_pylist()):
-        js = right_index.get(v)
+        js = right_index.get(v) if v is not None else None
         if js:
             for j in js:
                 li.append(i)

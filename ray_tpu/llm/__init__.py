@@ -9,7 +9,15 @@ router (``build_openai_app``), and a Ray-Data batch-inference ``Processor``.
 from .config import LLMConfig, SamplingParams
 from .engine import JaxLLMEngine, LLMEngine, RequestOutput
 from .server import LLMServer, PDRouter, build_openai_app, build_pd_openai_app
-from .batch import Processor, build_llm_processor
+from .batch import (
+    ChatTemplateStage,
+    DetokenizeStage,
+    HttpRequestStage,
+    LLMEngineStage,
+    Processor,
+    TokenizeStage,
+    build_llm_processor,
+)
 
 __all__ = [
     "LLMConfig",
@@ -23,4 +31,9 @@ __all__ = [
     "build_pd_openai_app",
     "Processor",
     "build_llm_processor",
+    "ChatTemplateStage",
+    "TokenizeStage",
+    "DetokenizeStage",
+    "HttpRequestStage",
+    "LLMEngineStage",
 ]

@@ -26,6 +26,91 @@ class ChatTemplateStage:
         return out
 
 
+class TokenizeStage:
+    """prompt -> input token ids (reference tokenize_stage.py). Stateful actor
+    UDF so the tokenizer loads once per actor."""
+
+    def __init__(self, tokenizer_spec: str):
+        from .tokenizer import get_tokenizer
+
+        self.tokenizer = get_tokenizer(tokenizer_spec)
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = dict(batch)
+        ids = [self.tokenizer.encode(str(p)) for p in batch["prompt"]]
+        out["tokenized_prompt"] = np.array([np.asarray(i, np.int32) for i in ids], dtype=object)
+        out["num_prompt_tokens"] = np.array([len(i) for i in ids], np.int64)
+        return out
+
+
+class DetokenizeStage:
+    """generated token ids -> text (reference detokenize_stage.py)."""
+
+    def __init__(self, tokenizer_spec: str):
+        from .tokenizer import get_tokenizer
+
+        self.tokenizer = get_tokenizer(tokenizer_spec)
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = dict(batch)
+        out["generated_text"] = np.array(
+            [self.tokenizer.decode(list(ids)) for ids in batch["generated_tokens"]],
+            dtype=object,
+        )
+        return out
+
+
+class HttpRequestStage:
+    """POST each row to an OpenAI-compatible endpoint (reference
+    http_request_stage.py) — batch inference against an already-running
+    server (e.g. a serve.run(build_openai_app(...)) deployment) instead of an
+    in-actor engine."""
+
+    def __init__(self, url: str, *, model: str = "", sampling_params: Optional[Dict[str, Any]] = None,
+                 headers: Optional[Dict[str, str]] = None, timeout_s: float = 120.0,
+                 concurrency: int = 8, max_retries: int = 2):
+        self.url = url
+        self.model = model
+        self.sampling_params = dict(sampling_params or {})
+        self.headers = {"Content-Type": "application/json", **(headers or {})}
+        self.timeout_s = timeout_s
+        self.concurrency = max(1, concurrency)
+        self.max_retries = max_retries
+
+    def _post(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        import json
+        import time
+        import urllib.error
+        import urllib.request
+
+        for attempt in range(self.max_retries + 1):
+            try:
+                req = urllib.request.Request(
+                    self.url, data=json.dumps(payload).encode(), headers=self.headers)
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                    return json.loads(resp.read())
+            except (urllib.error.URLError, OSError):
+                if attempt == self.max_retries:
+                    raise
+                time.sleep(0.5 * 2**attempt)
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        import concurrent.futures
+
+        def one(prompt) -> str:
+            payload = {"model": self.model, "prompt": str(prompt), **self.sampling_params}
+            resp = self._post(payload)
+            choice = resp["choices"][0]
+            return choice.get("text") or choice.get("message", {}).get("content", "")
+
+        # I/O-bound: the serving side batches concurrent requests, so fan out
+        with concurrent.futures.ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+            texts = list(pool.map(one, batch["prompt"]))
+        out = dict(batch)
+        out["generated_text"] = np.array(texts, dtype=object)
+        return out
+
+
 class LLMEngineStage:
     """Stateful actor UDF running generation (reference vllm_engine_stage.py)."""
 

@@ -146,9 +146,66 @@ def cmd_job(args) -> int:
     return 2
 
 
+def cmd_up(args) -> int:
+    """`ray-tpu up cluster.yaml` (reference `ray up`)."""
+    import ray_tpu
+    from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher
+
+    config = ClusterConfig.from_yaml(args.config)
+    ray_tpu.init()
+    launcher = ClusterLauncher(config)
+    head = launcher.up(start_autoscaler=not args.no_autoscaler)
+    print(f"cluster {config.cluster_name!r} up: head={head.instance_id}, "
+          f"{len(launcher.provider.non_terminated_nodes())} node(s)")
+    state = {"config": args.config, "cluster_name": config.cluster_name}
+    os.makedirs(default_session_dir(), exist_ok=True)
+    with open(os.path.join(default_session_dir(), "cluster.json"), "w") as f:
+        json.dump(state, f)
+    if args.block:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            launcher.down()
+    return 0
+
+
+def cmd_down(args) -> int:
+    """`ray-tpu down [cluster.yaml]` (reference `ray down`)."""
+    from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher
+
+    path = args.config
+    state_file = os.path.join(default_session_dir(), "cluster.json")
+    if path is None and os.path.exists(state_file):
+        with open(state_file) as f:
+            path = json.load(f)["config"]
+    if path is None:
+        print("no cluster config given and no recorded cluster")
+        return 1
+    config = ClusterConfig.from_yaml(path)
+    launcher = ClusterLauncher(config)
+    n = launcher.down()
+    try:
+        os.remove(state_file)
+    except OSError:
+        pass
+    print(f"cluster {config.cluster_name!r} down ({n} node(s) terminated)")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML config")
+    sp.add_argument("config")
+    sp.add_argument("--no-autoscaler", action="store_true")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster")
+    sp.add_argument("config", nargs="?", default=None)
+    sp.set_defaults(fn=cmd_down)
 
     sp = sub.add_parser("start", help="record head session (optionally --block with dashboard)")
     sp.add_argument("--num-cpus", type=float, default=None)

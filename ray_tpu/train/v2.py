@@ -154,7 +154,7 @@ class TrainController:
         self._state_log: list = [self.state]
         # metric history survives executor replacement across restarts/resizes
         self._merged_history: list = []
-        self._latest_metrics: Optional[Dict[str, Any]] = None
+        self._latest_metrics: Dict[str, Any] = {}  # {} matches the v1 no-reports shape
 
     def _transition(self, state: TrainControllerState) -> None:
         logger.info("TrainController: %s -> %s", self.state.value, state.value)
@@ -179,7 +179,7 @@ class TrainController:
         if self.executor is None:
             return
         self._merged_history.extend(self.executor._history)
-        if self.executor._latest_metrics is not None:
+        if self.executor._latest_metrics:  # a crashed-before-report executor holds {}
             self._latest_metrics = self.executor._latest_metrics
         self.executor.shutdown(graceful=graceful)
         self.executor = None

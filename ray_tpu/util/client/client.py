@@ -57,6 +57,9 @@ class ClientContext:
     # -- transport -------------------------------------------------------------
     def _fail_all_pending(self, reason: str) -> None:
         with self._pending_lock:
+            # _closed flips under the same lock _call registers under, so a call
+            # either sees closed and raises, or registers in time to be failed here
+            self._closed = True
             pending, self._pending = self._pending, {}
         for ev, out in pending.values():
             out.extend((False, ConnectionError(reason)))
@@ -103,12 +106,12 @@ class ClientContext:
         self._fail_all_pending("client connection closed")
 
     def _call(self, method: str, *args, **kwargs):
-        if self._closed:
-            raise ConnectionError("client connection is closed")
         req_id = next(self._req_counter)
         ev: threading.Event = threading.Event()
         out: list = []
         with self._pending_lock:
+            if self._closed:
+                raise ConnectionError("client connection is closed")
             self._pending[req_id] = (ev, out)
         self._outbox.put((req_id, method, args, kwargs))
         ev.wait()

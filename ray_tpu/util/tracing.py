@@ -98,12 +98,14 @@ def drain_local_spans() -> List[dict]:
 
 
 def _maybe_flush() -> None:
-    """Workers push spans to the coordinator; the driver keeps them local
-    (util/state.get_trace collects both)."""
+    """Workers and remote client drivers push spans to the coordinator; the
+    in-process driver keeps them local (util/state.get_trace collects both) —
+    keyed on holding the cluster, since DriverContext also has push_spans for
+    the client server's benefit."""
     from ray_tpu.core import global_state
 
     w = global_state.try_worker()
-    if w is None or not hasattr(w, "push_spans"):
+    if w is None or not hasattr(w, "push_spans") or global_state.try_cluster() is not None:
         return
     spans = drain_local_spans()
     if spans:

@@ -76,3 +76,13 @@ def test_join_then_map_batches_composes():
 def test_bad_join_type_raises():
     with pytest.raises(ValueError):
         _left().join(_right(), on="id", how="cross")
+
+
+def test_null_keys_never_match():
+    left = rtd.from_items([{"id": 1, "x": 1}, {"id": None, "x": 2}])
+    right = rtd.from_items([{"id": 1, "y": 1}, {"id": None, "y": 2}])
+    inner = left.join(right, on="id").take_all()
+    assert [r["id"] for r in inner] == [1]  # null keys drop from inner joins
+    full = left.join(right, on="id", how="full_outer").take_all()
+    # null-keyed rows appear null-extended on each side, never matched
+    assert len(full) == 3

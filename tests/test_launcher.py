@@ -1,0 +1,109 @@
+"""Cluster launcher tests (reference `ray up/down` + fake_multi_node provider)."""
+import json
+import os
+
+import pytest
+
+from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher, TPUPodProvider, make_provider
+from ray_tpu.autoscaler.node_provider import NodeType
+
+
+@pytest.fixture(autouse=True)
+def _cluster(rt):
+    yield
+
+
+def _config_dict(tmp_path=None, provider=None):
+    return {
+        "cluster_name": "test-cluster",
+        "provider": provider or {"type": "fake"},
+        "head_node_type": "head",
+        "max_workers": 4,
+        "available_node_types": {
+            "head": {"resources": {"CPU": 4}, "min_workers": 0, "max_workers": 1},
+            "worker": {"resources": {"CPU": 2}, "min_workers": 2, "max_workers": 4},
+        },
+    }
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="missing required"):
+        ClusterConfig.from_dict({"cluster_name": "x"})
+    bad = _config_dict()
+    bad["head_node_type"] = "nope"
+    with pytest.raises(ValueError, match="head_node_type"):
+        ClusterConfig.from_dict(bad)
+
+
+def test_yaml_roundtrip(tmp_path):
+    import yaml
+
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(_config_dict()))
+    cfg = ClusterConfig.from_yaml(str(path))
+    assert cfg.cluster_name == "test-cluster"
+    assert {nt.name for nt in cfg.node_types()} == {"head", "worker"}
+
+
+def test_up_brings_head_and_min_workers_down_tears_all(rt):
+    cfg = ClusterConfig.from_dict(_config_dict())
+    launcher = ClusterLauncher(cfg)
+    head = launcher.up(start_autoscaler=False)
+    nodes = launcher.provider.non_terminated_nodes()
+    assert head.node_type == "head"
+    by_type = {}
+    for n in nodes:
+        by_type[n.node_type] = by_type.get(n.node_type, 0) + 1
+    assert by_type == {"head": 1, "worker": 2}  # min_workers honored
+    assert launcher.down() == 3
+    assert launcher.provider.non_terminated_nodes() == []
+
+
+def test_up_with_autoscaler_loop(rt):
+    cfg = ClusterConfig.from_dict(_config_dict())
+    launcher = ClusterLauncher(cfg)
+    try:
+        launcher.up(start_autoscaler=True)
+        assert launcher.autoscaler is not None
+    finally:
+        launcher.down()
+        assert launcher.autoscaler is None
+
+
+def test_tpu_pod_provider_shells_out(tmp_path):
+    log = tmp_path / "calls.log"
+    provider = TPUPodProvider(
+        [NodeType(name="v5e-host", resources={"TPU": 4}, min_nodes=0, max_nodes=2)],
+        {
+            "create_command": f"echo create {{node_type}} {{instance_id}} >> {log}",
+            "terminate_command": f"echo terminate {{instance_id}} >> {log}",
+            "terminate_all_command": f"echo terminate-all >> {log}",
+        },
+    )
+    inst = provider.create_node("v5e-host")
+    assert len(provider.non_terminated_nodes()) == 1
+    provider.terminate_node(inst.instance_id)
+    assert provider.non_terminated_nodes() == []
+    provider.terminate_all()
+    lines = log.read_text().splitlines()
+    assert lines[0].startswith("create v5e-host")
+    assert lines[1].startswith("terminate v5e-host-1")
+    assert lines[2] == "terminate-all"
+
+
+def test_cli_up_down(rt, tmp_path, monkeypatch):
+    import yaml
+
+    from ray_tpu.scripts import cli
+
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(_config_dict()))
+    assert cli.main(["up", str(path), "--no-autoscaler"]) == 0
+    assert cli.main(["down", str(path)]) == 0
+
+
+def test_unknown_provider_raises():
+    cfg = ClusterConfig.from_dict(_config_dict(provider={"type": "aws"}))
+    with pytest.raises(ValueError, match="unknown provider"):
+        make_provider(cfg)

@@ -1,0 +1,78 @@
+"""LLM batch-stage tests (reference batch/stages/: tokenize, detokenize, http)."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.llm import DetokenizeStage, HttpRequestStage, TokenizeStage
+
+
+def test_tokenize_detokenize_roundtrip():
+    tok = TokenizeStage("byte")
+    batch = {"prompt": np.array(["hello", "wørld"], dtype=object)}
+    out = tok(batch)
+    assert out["num_prompt_tokens"][0] == 6  # BOS + 5 bytes
+    assert out["num_prompt_tokens"][1] > out["num_prompt_tokens"][0]  # multi-byte chars
+    detok = DetokenizeStage("byte")
+    back = detok({"generated_tokens": out["tokenized_prompt"]})
+    assert list(back["generated_text"]) == ["hello", "wørld"]
+
+
+def test_http_request_stage_hits_openai_endpoint():
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    seen = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+            seen.append(body)
+            resp = {"choices": [{"text": body["prompt"].upper()}]}
+            data = json.dumps(resp).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        stage = HttpRequestStage(
+            f"http://127.0.0.1:{srv.server_port}/v1/completions",
+            model="m", sampling_params={"max_tokens": 8})
+        out = stage({"prompt": np.array(["abc", "def"], dtype=object)})
+        assert list(out["generated_text"]) == ["ABC", "DEF"]
+        assert seen[0]["model"] == "m" and seen[0]["max_tokens"] == 8
+    finally:
+        srv.shutdown()
+
+
+def test_http_request_stage_chat_response_shape():
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            resp = {"choices": [{"message": {"content": "hi there"}}]}
+            data = json.dumps(resp).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        stage = HttpRequestStage(f"http://127.0.0.1:{srv.server_port}/v1/chat/completions")
+        out = stage({"prompt": np.array(["x"], dtype=object)})
+        assert list(out["generated_text"]) == ["hi there"]
+    finally:
+        srv.shutdown()
