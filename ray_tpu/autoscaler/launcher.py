@@ -162,6 +162,17 @@ class ClusterLauncher:
             self.autoscaler.start()
         return self.head
 
+    def adopt(self, instances: List[Dict[str, str]]) -> None:
+        """Re-learn nodes created by a previous process (reference `ray down`
+        re-discovers nodes by tag; here the CLI persists instance ids)."""
+        nodes = getattr(self.provider, "_nodes", None)
+        if nodes is None:
+            return
+        for inst in instances:
+            nodes.setdefault(inst["instance_id"], NodeInstance(
+                instance_id=inst["instance_id"], node_type=inst["node_type"],
+                status="running"))
+
     def down(self) -> int:
         """Terminate all nodes; returns how many were torn down. If the provider
         tracks nothing (down from a fresh process), fall back to its

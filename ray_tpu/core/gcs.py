@@ -26,11 +26,63 @@ class NodeInfo:
 
 
 class KVStore:
-    """Namespaced key-value store (reference: GcsInternalKVManager, gcs_kv_manager.h:104)."""
+    """Namespaced key-value store (reference: GcsInternalKVManager, gcs_kv_manager.h:104).
 
-    def __init__(self):
+    With a persistence path (reference: RedisStoreClient behind GcsTableStorage),
+    mutations append to a journal; a fresh KVStore replays it at startup, so
+    cluster-level state (serve app configs, job table, user KV) survives a
+    coordinator restart the way GCS state survives via Redis."""
+
+    def __init__(self, persistence_path: Optional[str] = None):
         self._lock = threading.Lock()
         self._data: Dict[Tuple[str, bytes], bytes] = {}
+        self._journal = None
+        if persistence_path:
+            import os
+
+            os.makedirs(os.path.dirname(persistence_path) or ".", exist_ok=True)
+            self._replay(persistence_path)
+            # compact: rewrite the journal as the current snapshot so replay cost
+            # and file size track live keys, not historical mutation count
+            tmp = persistence_path + ".compact"
+            with open(tmp, "wb") as f:
+                self._journal = f
+                for (ns, k), v in self._data.items():
+                    self._log("put", ns, k, v)
+                self._journal = None
+            os.replace(tmp, persistence_path)
+            self._journal = open(persistence_path, "ab")
+
+    def _replay(self, path: str) -> None:
+        import base64
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                    k = (rec["ns"], base64.b64decode(rec["k"]))
+                    if rec["op"] == "put":
+                        self._data[k] = base64.b64decode(rec["v"])
+                    else:
+                        self._data.pop(k, None)
+                except (ValueError, KeyError):
+                    continue  # torn tail write from a crash: ignore
+
+    def _log(self, op: str, namespace: str, key: bytes, value: Optional[bytes]) -> None:
+        if self._journal is None:
+            return
+        import base64
+        import json
+
+        rec = {"op": op, "ns": namespace, "k": base64.b64encode(key).decode()}
+        if value is not None:
+            rec["v"] = base64.b64encode(value).decode()
+        self._journal.write(json.dumps(rec).encode() + b"\n")
+        self._journal.flush()
 
     def put(self, key: bytes, value: bytes, namespace: str = "", overwrite: bool = True) -> bool:
         with self._lock:
@@ -38,6 +90,7 @@ class KVStore:
             if not overwrite and k in self._data:
                 return False
             self._data[k] = value
+            self._log("put", namespace, key, value)
             return True
 
     def get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
@@ -46,7 +99,10 @@ class KVStore:
 
     def delete(self, key: bytes, namespace: str = "") -> bool:
         with self._lock:
-            return self._data.pop((namespace, key), None) is not None
+            existed = self._data.pop((namespace, key), None) is not None
+            if existed:
+                self._log("del", namespace, key, None)
+            return existed
 
     def exists(self, key: bytes, namespace: str = "") -> bool:
         with self._lock:
@@ -55,6 +111,15 @@ class KVStore:
     def keys(self, prefix: bytes = b"", namespace: str = "") -> List[bytes]:
         with self._lock:
             return [k for (ns, k) in self._data if ns == namespace and k.startswith(prefix)]
+
+    def close(self) -> None:
+        with self._lock:  # serialize against in-flight put/delete journal writes
+            if self._journal is not None:
+                try:
+                    self._journal.close()
+                except OSError:
+                    pass
+                self._journal = None
 
 
 class PubSub:
@@ -92,8 +157,11 @@ class PubSub:
 
 
 class GCS:
-    def __init__(self):
-        self.kv = KVStore()
+    def __init__(self, persistence_path: Optional[str] = None):
+        import os
+
+        persistence_path = persistence_path or os.environ.get("RAY_TPU_GCS_PERSISTENCE_PATH")
+        self.kv = KVStore(persistence_path)
         self.pubsub = PubSub()
         self._lock = threading.Lock()
         self._nodes: Dict[NodeID, NodeInfo] = {}

@@ -1044,6 +1044,7 @@ class Cluster:
         self._maint_thread.join(timeout=5.0)
         self.store.free_all()
         object_store.destroy_arena()
+        self.gcs.kv.close()  # flush the persistence journal
         import shutil
 
         shutil.rmtree(self.spill_dir, ignore_errors=True)

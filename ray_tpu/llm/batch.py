@@ -38,7 +38,13 @@ class TokenizeStage:
     def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         out = dict(batch)
         ids = [self.tokenizer.encode(str(p)) for p in batch["prompt"]]
-        out["tokenized_prompt"] = np.array([np.asarray(i, np.int32) for i in ids], dtype=object)
+        # np.array(list, dtype=object) silently coerces equal-length lists to 2-D,
+        # which would emit fixed_size_list arrow columns that can't concat with
+        # ragged batches — fill an object array per element instead
+        col = np.empty(len(ids), dtype=object)
+        for i, t in enumerate(ids):
+            col[i] = np.asarray(t, np.int32)
+        out["tokenized_prompt"] = col
         out["num_prompt_tokens"] = np.array([len(i) for i in ids], np.int64)
         return out
 

@@ -3,19 +3,25 @@
 Capability parity: reference python/ray/runtime_env/runtime_env.py:157 (RuntimeEnv)
 + _private/runtime_env/ plugins. Supported here: ``env_vars`` (applied around task
 execution; kept for an actor's lifetime), ``py_modules`` (local paths prepended to
-sys.path), ``working_dir`` (chdir for the duration). Cloud plugins (pip/conda/
-container) are out of scope on a hermetic single image — validated and rejected
-explicitly rather than silently ignored.
+sys.path), ``working_dir`` (chdir for the duration), ``pip`` (per-env venv with
+system site-packages, content-hash cached in the session dir — reference
+_private/runtime_env/pip.py + uri_cache.py; works offline with local package
+paths / --find-links). Network-or-image plugins (conda/container/uv/image_uri)
+are validated and rejected explicitly rather than silently ignored.
 """
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
 import os
+import subprocess
 import sys
+import time
 from typing import Any, Dict, List, Optional
 
-_SUPPORTED = {"env_vars", "py_modules", "working_dir"}
-_UNSUPPORTED = {"pip", "conda", "container", "uv", "image_uri"}
+_SUPPORTED = {"env_vars", "py_modules", "working_dir", "pip"}
+_UNSUPPORTED = {"conda", "container", "uv", "image_uri"}
 
 
 class RuntimeEnv(dict):
@@ -23,13 +29,14 @@ class RuntimeEnv(dict):
 
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  py_modules: Optional[List[str]] = None,
-                 working_dir: Optional[str] = None, **kwargs):
+                 working_dir: Optional[str] = None,
+                 pip: Optional[Any] = None, **kwargs):
         super().__init__()
         bad = set(kwargs) & _UNSUPPORTED
         if bad:
             raise ValueError(
-                f"runtime_env fields {sorted(bad)} require package installation, "
-                f"which is unavailable in this environment")
+                f"runtime_env fields {sorted(bad)} require package-manager or image "
+                f"infrastructure that is unavailable in this environment")
         unknown = set(kwargs) - _SUPPORTED
         if unknown:
             raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
@@ -42,7 +49,86 @@ class RuntimeEnv(dict):
             self["py_modules"] = [str(p) for p in py_modules]
         if working_dir:
             self["working_dir"] = str(working_dir)
+        if pip:
+            # list of specs, or {"packages": [...], "no_index": bool, "find_links": [...]}
+            if isinstance(pip, (list, tuple)):
+                pip = {"packages": [str(p) for p in pip]}
+            if not isinstance(pip, dict) or not pip.get("packages"):
+                raise TypeError('pip must be a list of specs or {"packages": [...], ...}')
+            self["pip"] = pip
         self.update(kwargs)
+
+
+# ---- pip plugin: content-hashed venvs (reference pip.py + uri_cache.py) ----------
+
+def _envs_root() -> str:
+    from ray_tpu.job.manager import default_session_dir
+
+    return os.path.join(default_session_dir(), "runtime_envs")
+
+
+def ensure_pip_env(pip: Dict[str, Any], timeout_s: float = 300.0) -> str:
+    """Install a pip spec into a content-hashed --target dir; returns that dir.
+
+    A --target overlay (not a full venv) layers the requested packages over the
+    base environment: the running interpreter's setuptools/pip do the build, the
+    overlay dir rides sys.path like py_modules, and the base image's jax/numpy
+    stay untouched. Concurrent workers race through a lockdir; losers wait for
+    the .ready marker (reference pip.py builds per-env virtualenvs + URI cache)."""
+    key = hashlib.sha256(json.dumps(pip, sort_keys=True).encode()).hexdigest()[:16]
+    root = os.path.join(_envs_root(), f"pip_{key}")
+    ready = os.path.join(root, ".ready")
+    lockdir = root + ".lock"
+    pidfile = os.path.join(lockdir, "pid")
+    os.makedirs(_envs_root(), exist_ok=True)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if os.path.exists(ready):
+            return root
+        try:
+            os.mkdir(lockdir)
+        except FileExistsError:
+            # another worker is building this env: wait, but reclaim the lock if
+            # its builder died mid-install (SIGKILL/OOM leaves the dir forever)
+            try:
+                builder = int(open(pidfile).read())
+            except (OSError, ValueError):
+                builder = None
+            if builder is not None:
+                try:
+                    os.kill(builder, 0)
+                except ProcessLookupError:
+                    with contextlib.suppress(OSError):
+                        os.remove(pidfile)
+                    with contextlib.suppress(OSError):
+                        os.rmdir(lockdir)
+                    continue  # retry the mkdir ourselves
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"pip runtime_env {key} build timed out")
+            time.sleep(0.25)
+            continue
+        # we hold the lock: build
+        try:
+            with open(pidfile, "w") as f:
+                f.write(str(os.getpid()))
+            cmd = [sys.executable, "-m", "pip", "install", "--target", root,
+                   "--no-build-isolation", "--disable-pip-version-check", "--quiet"]
+            if pip.get("no_index"):
+                cmd.append("--no-index")
+            for fl in pip.get("find_links", []):
+                cmd += ["--find-links", str(fl)]
+            cmd += [str(p) for p in pip["packages"]]
+            proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip runtime_env install failed:\n{proc.stdout}\n{proc.stderr}")
+            open(ready, "w").write(key)
+            return root
+        finally:
+            with contextlib.suppress(OSError):
+                os.remove(pidfile)
+            with contextlib.suppress(OSError):
+                os.rmdir(lockdir)
 
 
 @contextlib.contextmanager
@@ -53,8 +139,11 @@ def applied(runtime_env: Optional[Dict[str, Any]], permanent: bool = False):
         yield
         return
     env_vars = runtime_env.get("env_vars") or {}
-    py_modules = runtime_env.get("py_modules") or []
+    py_modules = list(runtime_env.get("py_modules") or [])
     working_dir = runtime_env.get("working_dir")
+    if runtime_env.get("pip"):
+        # venv site-packages rides the same sys.path mechanism as py_modules
+        py_modules.insert(0, ensure_pip_env(runtime_env["pip"]))
 
     saved_env = {k: os.environ.get(k) for k in env_vars}
     saved_cwd = os.getcwd() if working_dir else None

@@ -157,7 +157,16 @@ def cmd_up(args) -> int:
     head = launcher.up(start_autoscaler=not args.no_autoscaler)
     print(f"cluster {config.cluster_name!r} up: head={head.instance_id}, "
           f"{len(launcher.provider.non_terminated_nodes())} node(s)")
-    state = {"config": args.config, "cluster_name": config.cluster_name}
+    state = {
+        "config": args.config,
+        "cluster_name": config.cluster_name,
+        # instance ids let a later `ray-tpu down` (fresh process) terminate
+        # nodes whose provider tracks them only in memory (tpu-pod)
+        "instances": [
+            {"instance_id": n.instance_id, "node_type": n.node_type}
+            for n in launcher.provider.non_terminated_nodes()
+        ],
+    }
     os.makedirs(default_session_dir(), exist_ok=True)
     with open(os.path.join(default_session_dir(), "cluster.json"), "w") as f:
         json.dump(state, f)
@@ -176,14 +185,17 @@ def cmd_down(args) -> int:
 
     path = args.config
     state_file = os.path.join(default_session_dir(), "cluster.json")
-    if path is None and os.path.exists(state_file):
+    recorded = {}
+    if os.path.exists(state_file):
         with open(state_file) as f:
-            path = json.load(f)["config"]
+            recorded = json.load(f)
+    path = path or recorded.get("config")
     if path is None:
         print("no cluster config given and no recorded cluster")
         return 1
     config = ClusterConfig.from_yaml(path)
     launcher = ClusterLauncher(config)
+    launcher.adopt(recorded.get("instances", []))
     n = launcher.down()
     try:
         os.remove(state_file)

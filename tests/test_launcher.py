@@ -107,3 +107,34 @@ def test_unknown_provider_raises():
     cfg = ClusterConfig.from_dict(_config_dict(provider={"type": "aws"}))
     with pytest.raises(ValueError, match="unknown provider"):
         make_provider(cfg)
+
+
+def test_cli_down_adopts_recorded_instances(tmp_path, monkeypatch):
+    """A fresh-process down must terminate nodes recorded by up (tpu-pod leak fix)."""
+    import yaml
+
+    from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher
+
+    log = tmp_path / "calls.log"
+    cfg = _config_dict(provider={
+        "type": "tpu-pod",
+        "create_command": f"echo create {{instance_id}} >> {log}",
+        "terminate_command": f"echo terminate {{instance_id}} >> {log}",
+    })
+    cfg["available_node_types"]["worker"]["min_workers"] = 1
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    import json
+
+    from ray_tpu.scripts import cli
+
+    assert cli.main(["up", str(path), "--no-autoscaler"]) == 0
+    state = json.loads((tmp_path / "cluster.json").read_text())
+    assert len(state["instances"]) == 2  # head + 1 min worker
+    # "new process": fresh launcher adopts the recorded instances
+    launcher = ClusterLauncher(ClusterConfig.from_dict(cfg))
+    launcher.adopt(state["instances"])
+    assert launcher.down() == 2
+    terminated = [l for l in log.read_text().splitlines() if l.startswith("terminate")]
+    assert len(terminated) == 2
