@@ -112,6 +112,13 @@ class TPUPodProvider(NodeProvider):
     def non_terminated_nodes(self) -> List[NodeInstance]:
         return list(self._nodes.values())
 
+    def adopt_node(self, instance: NodeInstance) -> None:
+        self._nodes.setdefault(instance.instance_id, instance)
+        # keep the id counter ahead of adopted ids so new nodes never collide
+        tail = instance.instance_id.rsplit("-", 1)[-1]
+        if tail.isdigit():
+            self._counter = max(self._counter, int(tail))
+
     def terminate_all(self) -> None:
         """Tear down nodes launched by a previous process: in-memory tracking is
         gone, so run the provider's terminate_all_command (tag/name-scoped)."""
@@ -165,11 +172,8 @@ class ClusterLauncher:
     def adopt(self, instances: List[Dict[str, str]]) -> None:
         """Re-learn nodes created by a previous process (reference `ray down`
         re-discovers nodes by tag; here the CLI persists instance ids)."""
-        nodes = getattr(self.provider, "_nodes", None)
-        if nodes is None:
-            return
         for inst in instances:
-            nodes.setdefault(inst["instance_id"], NodeInstance(
+            self.provider.adopt_node(NodeInstance(
                 instance_id=inst["instance_id"], node_type=inst["node_type"],
                 status="running"))
 

@@ -48,6 +48,11 @@ class NodeProvider(abc.ABC):
     @abc.abstractmethod
     def non_terminated_nodes(self) -> List[NodeInstance]: ...
 
+    def adopt_node(self, instance: NodeInstance) -> None:
+        """Re-learn a node created by a previous process so terminate_node works
+        on it (the launcher records instance ids across process boundaries).
+        Providers without cross-process state can leave this a no-op."""
+
 
 class FakeNodeProvider(NodeProvider):
     """Adds/removes nodes on the in-process Cluster — the fake_multi_node analogue.
@@ -91,6 +96,10 @@ class FakeNodeProvider(NodeProvider):
     def non_terminated_nodes(self) -> List[NodeInstance]:
         with self._lock:
             return [i for i in self._instances.values() if i.status != "terminated"]
+
+    def adopt_node(self, instance: NodeInstance) -> None:
+        with self._lock:
+            self._instances.setdefault(instance.instance_id, instance)
 
     def poll(self) -> None:
         """Advance simulated provisioning; 'requested' nodes join the cluster."""

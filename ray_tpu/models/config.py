@@ -26,6 +26,10 @@ class ModelConfig:
     tie_embeddings: bool = False
     dtype: str = "bfloat16"  # activation dtype; params kept f32, cast in forward
     remat: bool = True  # jax.checkpoint each layer (HBM <-> FLOPs trade)
+    # full: recompute everything in backward (min HBM). dots: save matmul outputs
+    # and recompute only cheap elementwise ops (more HBM, fewer recomputed FLOPs —
+    # higher MFU when activations fit). none == remat=False.
+    remat_policy: str = "full"  # full | dots | dots_no_batch | none
     scan_layers: bool = True  # stack layer params + lax.scan (fast compile)
     # Attention backend: auto|pallas|reference|ring|ulysses. ring/ulysses are the
     # sequence-parallel collectives (ops/ring_attention.py) — use with an sp>1 mesh.

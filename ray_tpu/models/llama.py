@@ -111,6 +111,24 @@ def init(rng: jax.Array, cfg: ModelConfig) -> Params:
 
 # ------------------------------------------------------------------------- kernels
 
+def _maybe_remat(body, cfg: ModelConfig):
+    """Per-layer rematerialization with a selectable policy (cfg.remat_policy):
+    'full' recomputes everything; 'dots' saves matmul outputs so only cheap
+    elementwise ops replay in the backward pass (XLA's usual MFU sweet spot)."""
+    policy = getattr(cfg, "remat_policy", "full")
+    if not cfg.remat or policy == "none":
+        return body
+    if policy == "dots":
+        return jax.checkpoint(body, policy=jax.checkpoint_policies.checkpoint_dots)
+    if policy == "dots_no_batch":
+        return jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if policy != "full":
+        raise ValueError(
+            f"unknown remat_policy {policy!r} (expected full | dots | dots_no_batch | none)")
+    return jax.checkpoint(body)
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     dtype = x.dtype
     x = x.astype(jnp.float32)
@@ -266,7 +284,7 @@ def _pipeline_layers(
             h, _, _ = _block(carry, lp, cfg, pos, None)  # aux loss unsupported w/ pp
             return h, None
 
-        fn = jax.checkpoint(body) if cfg.remat else body
+        fn = _maybe_remat(body, cfg)
         out, _ = jax.lax.scan(fn, xm, stage_params)
         return out
 
@@ -319,7 +337,7 @@ def forward(
                                         cache.length, token_mask)
                 return h, (new_kv, aux)
 
-            fn = jax.checkpoint(body) if cfg.remat else body
+            fn = _maybe_remat(body, cfg)
             x, ((nk, nv), auxs) = jax.lax.scan(fn, x, (params["layers"], cache.k, cache.v))
             new_cache = KVCache(k=nk, v=nv, length=cache.length + s)
             aux_total = auxs.sum()
@@ -330,7 +348,7 @@ def forward(
                                    token_mask=token_mask)
                 return h, aux
 
-            fn = jax.checkpoint(body) if cfg.remat else body
+            fn = _maybe_remat(body, cfg)
             x, auxs = jax.lax.scan(fn, x, params["layers"])
             new_cache = None
             aux_total = auxs.sum()

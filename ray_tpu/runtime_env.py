@@ -75,60 +75,47 @@ def ensure_pip_env(pip: Dict[str, Any], timeout_s: float = 300.0) -> str:
     overlay dir rides sys.path like py_modules, and the base image's jax/numpy
     stay untouched. Concurrent workers race through a lockdir; losers wait for
     the .ready marker (reference pip.py builds per-env virtualenvs + URI cache)."""
+    if isinstance(pip, (list, tuple)):
+        # Ray's list shorthand: plain runtime_env dicts reach here un-normalized
+        pip = {"packages": [str(p) for p in pip]}
     key = hashlib.sha256(json.dumps(pip, sort_keys=True).encode()).hexdigest()[:16]
     root = os.path.join(_envs_root(), f"pip_{key}")
     ready = os.path.join(root, ".ready")
-    lockdir = root + ".lock"
-    pidfile = os.path.join(lockdir, "pid")
+    if os.path.exists(ready):
+        return root
     os.makedirs(_envs_root(), exist_ok=True)
+    # flock, not a lockdir: the kernel releases it when the holder dies (even
+    # SIGKILL mid-install), so there are no stale locks and no reclaim races
+    import fcntl
+
+    fd = os.open(root + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
     deadline = time.monotonic() + timeout_s
-    while True:
-        if os.path.exists(ready):
-            return root
-        try:
-            os.mkdir(lockdir)
-        except FileExistsError:
-            # another worker is building this env: wait, but reclaim the lock if
-            # its builder died mid-install (SIGKILL/OOM leaves the dir forever)
+    try:
+        while True:
             try:
-                builder = int(open(pidfile).read())
-            except (OSError, ValueError):
-                builder = None
-            if builder is not None:
-                try:
-                    os.kill(builder, 0)
-                except ProcessLookupError:
-                    with contextlib.suppress(OSError):
-                        os.remove(pidfile)
-                    with contextlib.suppress(OSError):
-                        os.rmdir(lockdir)
-                    continue  # retry the mkdir ourselves
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"pip runtime_env {key} build timed out")
-            time.sleep(0.25)
-            continue
-        # we hold the lock: build
-        try:
-            with open(pidfile, "w") as f:
-                f.write(str(os.getpid()))
-            cmd = [sys.executable, "-m", "pip", "install", "--target", root,
-                   "--no-build-isolation", "--disable-pip-version-check", "--quiet"]
-            if pip.get("no_index"):
-                cmd.append("--no-index")
-            for fl in pip.get("find_links", []):
-                cmd += ["--find-links", str(fl)]
-            cmd += [str(p) for p in pip["packages"]]
-            proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"pip runtime_env install failed:\n{proc.stdout}\n{proc.stderr}")
-            open(ready, "w").write(key)
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"pip runtime_env {key} build timed out") from None
+                time.sleep(0.25)
+        if os.path.exists(ready):  # built while we waited
             return root
-        finally:
-            with contextlib.suppress(OSError):
-                os.remove(pidfile)
-            with contextlib.suppress(OSError):
-                os.rmdir(lockdir)
+        cmd = [sys.executable, "-m", "pip", "install", "--target", root,
+               "--no-build-isolation", "--disable-pip-version-check", "--quiet"]
+        if pip.get("no_index"):
+            cmd.append("--no-index")
+        for fl in pip.get("find_links", []):
+            cmd += ["--find-links", str(fl)]
+        cmd += [str(p) for p in pip["packages"]]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pip runtime_env install failed:\n{proc.stdout}\n{proc.stderr}")
+        open(ready, "w").write(key)
+        return root
+    finally:
+        os.close(fd)  # releases the flock if held
 
 
 @contextlib.contextmanager

@@ -195,12 +195,17 @@ def cmd_down(args) -> int:
         return 1
     config = ClusterConfig.from_yaml(path)
     launcher = ClusterLauncher(config)
-    launcher.adopt(recorded.get("instances", []))
+    # only adopt (and clear) the recorded state if it belongs to THIS cluster —
+    # `ray-tpu down other.yaml` must not terminate or forget another cluster's nodes
+    same_cluster = recorded.get("cluster_name") == config.cluster_name
+    if same_cluster:
+        launcher.adopt(recorded.get("instances", []))
     n = launcher.down()
-    try:
-        os.remove(state_file)
-    except OSError:
-        pass
+    if same_cluster:
+        try:
+            os.remove(state_file)
+        except OSError:
+            pass
     print(f"cluster {config.cluster_name!r} down ({n} node(s) terminated)")
     return 0
 

@@ -94,3 +94,22 @@ def test_sharded_train_step():
 def test_n_params_reasonable():
     cfg8b = get_config("llama3-8b")
     assert 7.5e9 < cfg8b.n_params < 8.6e9
+
+
+def test_remat_policies_agree():
+    """remat_policy changes scheduling, never math: losses must match exactly."""
+    import dataclasses
+
+    import jax
+
+    from ray_tpu.models import get_config
+    from ray_tpu.models import llama as ll
+
+    losses = {}
+    for pol in ("full", "dots", "dots_no_batch"):
+        cfg = dataclasses.replace(get_config("test-tiny"), remat_policy=pol)
+        params = ll.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, cfg.vocab_size)
+        loss, _ = ll.loss_fn(params, {"tokens": tokens}, cfg)
+        losses[pol] = float(loss)
+    assert losses["full"] == losses["dots"] == losses["dots_no_batch"], losses

@@ -97,13 +97,16 @@ def test_cluster_kv_persistence_end_to_end(tmp_path, monkeypatch):
     path = str(tmp_path / "cluster_kv.journal")
     monkeypatch.setenv("RAY_TPU_GCS_PERSISTENCE_PATH", path)
     ray_tpu.shutdown()
-    ray_tpu.init(num_cpus=2, worker_env={"JAX_PLATFORMS": "cpu"})
-    internal_kv._internal_kv_put(b"persisted-key", b"persisted-value")
-    ray_tpu.shutdown()
-    # a new cluster (same persistence path) restores the KV table
-    ray_tpu.init(num_cpus=2, worker_env={"JAX_PLATFORMS": "cpu"})
-    assert internal_kv._internal_kv_get(b"persisted-key") == b"persisted-value"
-    ray_tpu.shutdown()
-    monkeypatch.delenv("RAY_TPU_GCS_PERSISTENCE_PATH")
-    ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
-                 max_workers_per_node=8)  # restore session cluster for later tests
+    try:
+        ray_tpu.init(num_cpus=2, worker_env={"JAX_PLATFORMS": "cpu"})
+        internal_kv._internal_kv_put(b"persisted-key", b"persisted-value")
+        ray_tpu.shutdown()
+        # a new cluster (same persistence path) restores the KV table
+        ray_tpu.init(num_cpus=2, worker_env={"JAX_PLATFORMS": "cpu"})
+        assert internal_kv._internal_kv_get(b"persisted-key") == b"persisted-value"
+    finally:
+        # always restore the session cluster, or later rt tests cascade-fail
+        ray_tpu.shutdown()
+        monkeypatch.delenv("RAY_TPU_GCS_PERSISTENCE_PATH")
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)
