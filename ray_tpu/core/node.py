@@ -332,6 +332,15 @@ class Cluster:
         elif kind == "recover":
             _, req_id, oid = msg
             self._async_reply(w, req_id, lambda: self._recover_object(oid), blocking=True)
+        elif kind == "state":
+            _, req_id, fn_name, fargs, fkwargs = msg
+
+            def run_state(fn_name=fn_name, fargs=fargs, fkwargs=fkwargs):
+                from ray_tpu.util.state import dispatch_state_request
+
+                return dispatch_state_request(fn_name, fargs, fkwargs)
+
+            self._async_reply(w, req_id, run_state)
         elif kind == "metrics":
             # periodic per-worker metric snapshot (util/metrics.py push thread)
             self.metrics_by_worker[w.worker_id] = msg[1]
@@ -1112,6 +1121,12 @@ class DriverContext:
         """Internal-KV access (workers go through the pipe; drivers and the
         client server hit the GCS KV directly)."""
         return getattr(self.cluster.gcs.kv, op)(*args)
+
+    def state_request(self, fn_name: str, *args, **kwargs):
+        """State-API aggregation for remote client drivers (util/state.py)."""
+        from ray_tpu.util.state import dispatch_state_request
+
+        return dispatch_state_request(fn_name, args, kwargs)
 
     def push_metrics(self, snapshot: list) -> None:
         self.cluster.metrics_by_worker["driver"] = snapshot

@@ -174,6 +174,10 @@ class WorkerContext:
         """One-way metric snapshot to the coordinator (util/metrics.py)."""
         self._send(("metrics", snapshot))
 
+    def state_request(self, fn_name: str, *args, **kwargs):
+        """State-API aggregation runs on the coordinator (util/state.py)."""
+        return self._request("state", fn_name, args, kwargs)
+
     def kv_request(self, op: str, *args):
         """Cluster KV access from a worker (reference: GCS KV over the core worker)."""
         return self._request("kv", op, *args)

@@ -146,6 +146,33 @@ def cmd_job(args) -> int:
     return 2
 
 
+def cmd_list(args) -> int:
+    """`ray-tpu list nodes|workers|tasks|actors|objects|placement-groups`
+    (reference `ray list ...`, python/ray/util/state/state_cli.py). Runs against
+    the in-process cluster, or a remote head via --address."""
+    import ray_tpu
+
+    if args.address:
+        ray_tpu.init(address=args.address)
+    elif not ray_tpu.is_initialized():
+        print("no cluster: pass --address ray-tpu://host:port (or run inside a driver)")
+        return 1
+    from ray_tpu.util import state as rs
+
+    fns = {
+        "nodes": rs.list_nodes,
+        "workers": rs.list_workers,
+        "tasks": rs.list_tasks,
+        "actors": rs.list_actors,
+        "objects": rs.list_objects,
+        "placement-groups": rs.list_placement_groups,
+        "summary": rs.summarize_cluster,
+    }
+    out = fns[args.resource]()
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
 def cmd_up(args) -> int:
     """`ray-tpu up cluster.yaml` (reference `ray up`)."""
     import ray_tpu
@@ -223,6 +250,13 @@ def main(argv=None) -> int:
     sp = sub.add_parser("down", help="tear down a launched cluster")
     sp.add_argument("config", nargs="?", default=None)
     sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("list", help="state API listings (reference `ray list`)")
+    sp.add_argument("resource", choices=["nodes", "workers", "tasks", "actors",
+                                         "objects", "placement-groups", "summary"])
+    sp.add_argument("--address", default=None,
+                    help="connect as a client driver, e.g. ray-tpu://127.0.0.1:10001")
+    sp.set_defaults(fn=cmd_list)
 
     sp = sub.add_parser("start", help="record head session (optionally --block with dashboard)")
     sp.add_argument("--num-cpus", type=float, default=None)

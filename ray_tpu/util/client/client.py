@@ -19,7 +19,7 @@ _FORWARDED = {
     "submit", "get", "put", "wait", "cancel",
     "get_named_actor", "register_fn", "fn_known", "lookup_placement_group",
     "pg_ready_ref", "create_placement_group", "remove_placement_group",
-    "kv_request",
+    "kv_request", "state_request",
 }
 # fire-and-forget: callable from __del__/GC finalizers (possibly ON the recv
 # thread), so they must never wait for a response or touch the socket directly

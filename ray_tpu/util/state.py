@@ -23,6 +23,40 @@ def _cluster():
     return c
 
 
+# names callable through state_request (client server + worker pipe); populated
+# by the decorator so the dispatch gate and the decorated surface stay in lockstep
+_REMOTEABLE_FNS: set = set()
+
+
+def _remoteable(fn):
+    """Run on the head when this process is a remote client driver (the state
+    aggregator reads Cluster structures, which only exist head-side)."""
+    import functools
+
+    _REMOTEABLE_FNS.add(fn.__name__)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if global_state.try_cluster() is None:
+            w = global_state.try_worker()
+            if w is not None and hasattr(w, "state_request"):
+                return w.state_request(fn.__name__, *args, **kwargs)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def dispatch_state_request(fn_name: str, args=(), kwargs=None):
+    """THE gate for remote state calls (client server + coordinator pipe):
+    only @_remoteable functions are reachable."""
+    if fn_name not in _REMOTEABLE_FNS:
+        raise ValueError(f"unknown state function {fn_name!r}")
+    import sys
+
+    return getattr(sys.modules[__name__], fn_name)(*args, **(kwargs or {}))
+
+
+@_remoteable
 def list_nodes() -> List[Dict[str, Any]]:
     c = _cluster()
     out = []
@@ -37,6 +71,7 @@ def list_nodes() -> List[Dict[str, Any]]:
     return out
 
 
+@_remoteable
 def list_workers() -> List[Dict[str, Any]]:
     c = _cluster()
     out = []
@@ -54,6 +89,7 @@ def list_workers() -> List[Dict[str, Any]]:
     return out
 
 
+@_remoteable
 def list_tasks(filters: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
     """Pending/running tasks plus recent finished ones (bounded ring)."""
     c = _cluster()
@@ -81,6 +117,7 @@ def list_tasks(filters: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]
     return out
 
 
+@_remoteable
 def list_actors() -> List[Dict[str, Any]]:
     c = _cluster()
     out = []
@@ -99,6 +136,7 @@ def list_actors() -> List[Dict[str, Any]]:
     return out
 
 
+@_remoteable
 def list_objects() -> List[Dict[str, Any]]:
     c = _cluster()
     store = c.store
@@ -117,6 +155,7 @@ def list_objects() -> List[Dict[str, Any]]:
     return out
 
 
+@_remoteable
 def list_placement_groups() -> List[Dict[str, Any]]:
     c = _cluster()
     out = []
@@ -133,6 +172,7 @@ def list_placement_groups() -> List[Dict[str, Any]]:
     return out
 
 
+@_remoteable
 def summarize_cluster() -> Dict[str, Any]:
     c = _cluster()
     return {
@@ -164,6 +204,7 @@ def prometheus_metrics() -> str:
 
 # -------------------------------------------------------------------- tracing
 
+@_remoteable
 def get_trace() -> List[Dict[str, Any]]:
     """All collected spans: worker-pushed + driver-local (util/tracing.py).
 
@@ -180,9 +221,9 @@ def get_trace() -> List[Dict[str, Any]]:
 
 # -------------------------------------------------------------------- timeline
 
-def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Chrome-trace events for finished tasks (reference ray.timeline,
-    python/ray/_private/state.py:986 + profiling.py chrome_tracing_dump)."""
+@_remoteable
+def timeline_events() -> List[Dict[str, Any]]:
+    """Chrome-trace events for finished tasks (no file IO — remotely callable)."""
     c = _cluster()
     events = []
     with c._lock:
@@ -200,6 +241,14 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
             "dur": (ev["finished_at"] - ev["dispatched_at"]) * 1e6,
             "args": {"task_id": ev["task_id"], "error": ev["error"]},
         })
+    return events
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace export (reference ray.timeline, python/ray/_private/
+    state.py:986). The file, if requested, is written by THIS process — a remote
+    client's filename never touches the head's filesystem."""
+    events = timeline_events()
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

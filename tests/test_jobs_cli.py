@@ -82,8 +82,10 @@ def test_cli_job_flow(tmp_path, monkeypatch, capsys):
 def test_runtime_env_validation():
     import ray_tpu
 
-    with pytest.raises(ValueError, match="require package installation"):
-        ray_tpu.RuntimeEnv(pip=["requests"])
+    # pip is a supported plugin now (per-env --target overlays); conda is not
+    assert ray_tpu.RuntimeEnv(pip=["requests"])["pip"] == {"packages": ["requests"]}
+    with pytest.raises(ValueError, match="package-manager or image"):
+        ray_tpu.RuntimeEnv(conda={"dependencies": []})
     with pytest.raises(ValueError, match="unknown"):
         ray_tpu.RuntimeEnv(bogus_field=1)
     env = ray_tpu.RuntimeEnv(env_vars={"A": "1"}, working_dir="/tmp")

@@ -111,3 +111,44 @@ def test_client_sees_named_actors_from_head(rt, client_cluster):
     p.join(timeout=30)
     assert status == "ok", val
     assert val == 2
+
+
+def _state_probe(port, q):
+    import ray_tpu
+
+    try:
+        ray_tpu.init(address=f"ray-tpu://127.0.0.1:{port}")
+        from ray_tpu.util import state as rs
+
+        q.put(("ok", (rs.summarize_cluster(), len(rs.list_nodes()))))
+    except BaseException:  # noqa: BLE001
+        import traceback
+
+        q.put(("err", traceback.format_exc()))
+
+
+def test_state_api_from_remote_client(rt, client_cluster):
+    port = client_cluster
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_state_probe, args=(port, q))
+    p.start()
+    status, val = q.get(timeout=120)
+    p.join(timeout=30)
+    assert status == "ok", val
+    summary, n_nodes = val
+    assert n_nodes >= 1
+    assert summary["nodes"] >= 1
+
+
+def test_cli_list_requires_cluster_or_address():
+    import subprocess
+    import sys
+
+    # fresh process: no cluster, no address -> exit 1 with guidance
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from ray_tpu.scripts import cli; import sys; sys.exit(cli.main(['list', 'nodes']))"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "no cluster" in proc.stdout
