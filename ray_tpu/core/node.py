@@ -591,7 +591,19 @@ class Cluster:
         ts = self.tasks.get(spec.task_id)
         if ts is not None:
             ts.dispatched_at = time.time()
-        worker.send(("task", spec, locs))
+        try:
+            worker.send(("task", spec, locs))
+        except (OSError, BrokenPipeError, EOFError):
+            # dying pipe: the spec is already in w.inflight, so the worker-death
+            # handler will fail or retry it — losing the exception here would
+            # otherwise strand the task's returns forever
+            pass
+        except Exception as e:  # e.g. unpicklable args: worker is healthy, fail visibly
+            try:
+                worker.inflight.remove(spec.task_id)
+            except ValueError:
+                pass
+            self._fail_returns(spec, e)
 
     def _choose_placement(self, spec: TaskSpec):
         """Pick (node, ledger, resources) honoring the scheduling strategy; None = wait."""
@@ -891,6 +903,15 @@ class Cluster:
             if w.state == "dead":
                 return
             w.state = "dead"
+            if w.actor_id is not None:
+                # close the dispatch window NOW, under the same lock: a submit
+                # racing this death must queue (state != alive), not send into
+                # the dying pipe and hang forever. _on_actor_worker_death below
+                # settles the final state (restarting or dead).
+                st = self.actors.get(w.actor_id)
+                if st is not None and st.state == "alive":
+                    st.state = "restarting"
+                    st.worker = None
             self._conns.pop(w.conn, None)
             w.node.workers.pop(w.worker_id, None)
             pool = w.node.idle.get(w.accel)

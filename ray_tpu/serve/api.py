@@ -28,7 +28,10 @@ def _get_or_create_controller():
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
-        cls = ray_tpu.remote(num_cpus=0.1, name=CONTROLLER_NAME, lifetime="detached")(ServeController)
+        # max_concurrency: long-poll listeners block controller threads
+        # (controller.listen_for_change) and must not stall deploy/reconcile
+        cls = ray_tpu.remote(num_cpus=0.1, name=CONTROLLER_NAME, lifetime="detached",
+                             max_concurrency=16)(ServeController)
         handle = cls.remote()
         ray_tpu.get(handle.ping.remote())
         return handle
@@ -137,6 +140,8 @@ def get_deployment_handle(deployment_name: str, app_name: str = "default") -> De
 
 
 def shutdown() -> None:
+    from .handle import _reset_long_poll
+
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.shutdown.remote())
@@ -148,3 +153,4 @@ def shutdown() -> None:
         ray_tpu.kill(proxy)
     except Exception:
         pass
+    _reset_long_poll()  # watches reference the controller we just killed

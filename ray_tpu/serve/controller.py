@@ -16,10 +16,16 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 STARTING, RUNNING, STOPPING = "STARTING", "RUNNING", "STOPPING"
 
 
+import itertools as _it
+
+_replica_uid = _it.count(1)
+
+
 class _ReplicaState:
     def __init__(self, actor, version):
         self.actor = actor
         self.version = version
+        self.uid = next(_replica_uid)  # stable identity (id() can be reused by GC)
         self.state = STARTING
         self.health_ref = None
         self.last_health_ok = time.time()
@@ -50,6 +56,11 @@ class ServeController:
         self.apps: Dict[str, Dict[str, Any]] = {}  # app -> {route_prefix, ingress, deployments}
         self._lock = threading.RLock()
         self._shutdown = False
+        # long-poll host state (reference _private/long_poll.py LongPollHost):
+        # versioned keys; listeners block until a key they watch moves
+        self._lp_versions: Dict[str, int] = {}
+        self._lp_cond = threading.Condition()
+        self._lp_last_running: Dict[str, tuple] = {}
         self._reconcile_thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._reconcile_thread.start()
 
@@ -95,6 +106,8 @@ class ServeController:
             for app in list(self.apps):
                 self.delete_application(app)
             self._shutdown = True
+        with self._lp_cond:  # wake parked listeners so they return promptly
+            self._lp_cond.notify_all()
 
     # -- read APIs (handles/proxies poll these; reference LongPollHost) ---------
     def get_routing_table(self) -> Dict[str, Any]:
@@ -254,4 +267,68 @@ class ServeController:
                 self._reconcile_once()
             except Exception:
                 pass
+            try:
+                # never skipped: a throwing reconcile pass (e.g. one poisoned
+                # deployment) must not silence membership publishing for the rest
+                self._publish_changes()
+            except Exception:
+                pass
             time.sleep(0.2)
+
+    # -- long-poll host (reference LongPollHost) --------------------------------
+    def _publish_changes(self) -> None:
+        """Bump versions for deployments whose running replica set changed."""
+        with self._lock:
+            snapshots = {
+                key: tuple(r.uid for r in ds.running())
+                for key, ds in self.deployments.items()
+            }
+        changed = [k for k, snap in snapshots.items() if self._lp_last_running.get(k) != snap]
+        gone = [k for k in self._lp_last_running if k not in snapshots]
+        if not changed and not gone:
+            return
+        with self._lp_cond:
+            for k in changed:
+                self._lp_last_running[k] = snapshots[k]
+                self._lp_versions[f"replicas::{k}"] = self._lp_versions.get(f"replicas::{k}", 0) + 1
+            for k in gone:
+                self._lp_last_running.pop(k, None)
+                self._lp_versions[f"replicas::{k}"] = self._lp_versions.get(f"replicas::{k}", 0) + 1
+            self._lp_versions["routes"] = self._lp_versions.get("routes", 0) + 1
+            self._lp_cond.notify_all()
+
+    def listen_for_change(self, keys_to_versions: Dict[str, int],
+                          timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Block until any watched key's version differs from the caller's view;
+        returns {key: (new_version, snapshot)} ({} on timeout). The controller
+        actor runs with max_concurrency so waiting listeners don't stall the
+        deploy/reconcile APIs."""
+        deadline = time.monotonic() + timeout_s
+        with self._lp_cond:
+            while not self._shutdown:
+                changed = {
+                    k: self._lp_versions.get(k, 0)
+                    for k, v in keys_to_versions.items()
+                    if self._lp_versions.get(k, 0) != v
+                }
+                if changed:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                self._lp_cond.wait(remaining)
+            else:
+                return {}
+        return {k: (ver, self._lp_snapshot(k)) for k, ver in changed.items()}
+
+    def _lp_snapshot(self, key: str) -> Any:
+        kind, _, ident = key.partition("::")
+        if kind == "replicas":
+            app, _, dep = ident.partition("/")
+            with self._lock:
+                if f"{app}/{dep}" not in self.deployments:
+                    return None  # deleted: listeners stop watching this key
+            return self.get_replicas(app, dep)
+        if key == "routes":
+            return self.get_routing_table()
+        return None

@@ -85,6 +85,109 @@ class _Router:
             return sum(self.inflight.values())
 
 
+class _LongPollEntry:
+    """Shared push-updated replica view for one deployment in this process."""
+
+    def __init__(self):
+        self.replicas: Optional[List[Any]] = None
+
+
+class _LongPollClient:
+    """ONE parked listen_for_change per process, multiplexing every watched
+    deployment (reference _private/long_poll.py LongPollClient): however many
+    handles and apps exist, each client process costs the controller a single
+    concurrency slot."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.entries: Dict[tuple, _LongPollEntry] = {}
+        self.versions: Dict[str, int] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _lp_key(key: tuple) -> str:
+        return f"replicas::{key[0]}/{key[1]}"
+
+    def watch(self, app_name: str, deployment_name: str) -> _LongPollEntry:
+        key = (app_name, deployment_name)
+        with self.lock:
+            entry = self.entries.get(key)
+            if entry is None:
+                entry = _LongPollEntry()
+                self.entries[key] = entry
+                self.versions.setdefault(self._lp_key(key), -1)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="serve-longpoll")
+                self._thread.start()
+            return entry
+
+    def _loop(self) -> None:
+        import os as _os
+
+        _dbg = _os.environ.get("RAY_TPU_LP_DEBUG")
+        errors = 0
+        while True:
+            with self.lock:
+                watched = {self._lp_key(k): self.versions.get(self._lp_key(k), -1)
+                           for k in self.entries}
+                if _dbg:
+                    print(f"[lp] watched={watched}", flush=True)
+                if not watched:
+                    # retire ATOMICALLY with the empty check: a concurrent watch()
+                    # either sees entries (we keep looping) or sees _thread=None
+                    # and respawns — never a live-looking thread about to exit
+                    self._thread = None
+                    return
+            try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                res = ray_tpu.get(controller.listen_for_change.remote(watched, 10.0))
+                errors = 0
+            except Exception:
+                with self.lock:
+                    for e in self.entries.values():
+                        e.replicas = None  # fall back to interval polling
+                errors += 1
+                if errors > 30:
+                    # controller gone for ~30s: retire; a later watch() respawns
+                    with self.lock:
+                        self._thread = None
+                    return
+                time.sleep(1.0)
+                continue
+            if _dbg:
+                print(f"[lp] res={ {k: (v, s if s is None else len(s)) for k, (v, s) in res.items()} }", flush=True)
+            with self.lock:
+                for lp_key, (version, snapshot) in res.items():
+                    self.versions[lp_key] = version
+                    tup = tuple(lp_key.split("::", 1)[1].split("/", 1))
+                    entry = self.entries.get(tup)
+                    if entry is None:
+                        continue
+                    if snapshot is None:  # deployment deleted: stop watching it
+                        entry.replicas = None
+                        del self.entries[tup]
+                        self.versions.pop(lp_key, None)
+                    else:
+                        entry.replicas = snapshot
+
+
+_long_poll_client = _LongPollClient()
+_lp_registry = _long_poll_client.entries  # introspection/tests
+
+
+def _ensure_long_poll(app_name: str, deployment_name: str) -> _LongPollEntry:
+    return _long_poll_client.watch(app_name, deployment_name)
+
+
+def _reset_long_poll() -> None:
+    """Forget all watches (serve.shutdown): they reference a dead controller,
+    and a fresh controller restarts its version counters from zero."""
+    with _long_poll_client.lock:
+        _long_poll_client.entries.clear()
+        _long_poll_client.versions.clear()
+
+
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str, method_name: str = "__call__",
                  multiplexed_model_id: str = ""):
@@ -96,7 +199,6 @@ class DeploymentHandle:
         self._replicas: List[Any] = []
         self._last_refresh = 0.0
         self._refresh_interval = 1.0
-        self._metrics_thread: Optional[threading.Thread] = None
         self._closed = False
 
     # -- plumbing --------------------------------------------------------------
@@ -104,6 +206,11 @@ class DeploymentHandle:
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
     def _refresh(self, force: bool = False) -> None:
+        # push path: the shared long-poll listener keeps this view current
+        entry = _lp_registry.get((self.app_name, self.deployment_name))
+        if entry is not None and entry.replicas is not None and not force:
+            self._replicas = entry.replicas
+            return
         now = time.time()
         if not force and now - self._last_refresh < self._refresh_interval and self._replicas:
             return
@@ -114,21 +221,27 @@ class DeploymentHandle:
         self._last_refresh = now
 
     def _ensure_metrics_push(self) -> None:
-        if self._metrics_thread is not None:
-            return
+        # anchored on the shared router under its lock: options() clones and
+        # concurrent first-callers reuse one pusher
+        with self._router.lock:
+            t = getattr(self._router, "_metrics_thread", None)
+            if t is not None and t.is_alive():
+                return
+            router = self._router
+            app, dep = self.app_name, self.deployment_name
 
-        def push():
-            while not self._closed:
-                try:
-                    self._controller().record_handle_metrics.remote(
-                        self.app_name, self.deployment_name, float(self._router.total_inflight())
-                    )
-                except Exception:
-                    pass
-                time.sleep(1.0)
+            def push():
+                # daemon thread keyed to the router's lifetime, not any one handle
+                while True:
+                    try:
+                        ray_tpu.get_actor(CONTROLLER_NAME).record_handle_metrics.remote(
+                            app, dep, float(router.total_inflight()))
+                    except Exception:
+                        pass
+                    time.sleep(1.0)
 
-        self._metrics_thread = threading.Thread(target=push, daemon=True)
-        self._metrics_thread.start()
+            router._metrics_thread = threading.Thread(target=push, daemon=True)
+            router._metrics_thread.start()
 
     # -- public ----------------------------------------------------------------
     def options(self, method_name: Optional[str] = None,
@@ -150,6 +263,7 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         self._ensure_metrics_push()
+        _ensure_long_poll(self.app_name, self.deployment_name)
         deadline = time.time() + 30.0
         while True:
             self._refresh()

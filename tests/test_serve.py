@@ -172,3 +172,68 @@ def test_autoscaling_scales_up(rt):
             scaled = True
             break
     assert scaled, "autoscaler never scaled up under sustained load"
+
+
+def test_long_poll_listen_for_change(rt):
+    """Reference LongPollHost: listeners block until a watched key's version moves."""
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class D:
+        def __call__(self, x):
+            return x
+
+    serve.run(D.bind(), name="lp-app")
+    try:
+        controller = serve.api._get_or_create_controller()
+        key = "replicas::lp-app/D"
+        # initial listen from version -1 returns immediately with the snapshot
+        res = ray_tpu.get(controller.listen_for_change.remote({key: -1}, 5.0))
+        assert key in res
+        version, replicas = res[key]
+        assert version >= 1 and len(replicas) == 1
+        # same version: no change -> timeout -> {}
+        t0 = time.time()
+        res2 = ray_tpu.get(controller.listen_for_change.remote({key: version}, 1.0))
+        assert res2 == {} and time.time() - t0 >= 0.9
+        # scale up -> the parked listener is woken with the new set
+        ref = controller.listen_for_change.remote({key: version}, 30.0)
+        serve.run(D.options(num_replicas=2).bind(), name="lp-app")
+        res3 = ray_tpu.get(ref)
+        assert key in res3
+        v3, replicas3 = res3[key]
+        assert v3 > version and len(replicas3) == 2
+    finally:
+        serve.delete("lp-app")
+
+
+def test_handle_sees_scale_up_via_push(rt):
+    import time
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class E:
+        def __call__(self, x):
+            return x * 2
+
+    h = serve.run(E.bind(), name="push-app")
+    try:
+        assert h.remote(2).result() == 4  # starts the long-poll listener
+        from ray_tpu.serve.handle import _lp_registry
+
+        serve.run(E.options(num_replicas=3).bind(), name="push-app")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            entry = _lp_registry.get(("push-app", "E"))
+            if entry is not None and entry.replicas is not None and len(entry.replicas) == 3:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("push update never arrived")
+        assert h.remote(3).result() == 6
+    finally:
+        serve.delete("push-app")
