@@ -107,13 +107,13 @@ class ActorHandle:
     def __del__(self):
         # Reference semantics: a non-detached actor dies when its original handle goes
         # out of scope (python/ray/actor.py handle GC). Serialized copies are borrows.
+        # Queued, never direct: GC can run this on a thread holding runtime locks.
         if getattr(self, "_owned", False):
             try:
                 from . import global_state
 
-                w = global_state.try_worker()
-                if w is not None:
-                    w.kill_actor(self._actor_id, no_restart=True, from_gc=True)
+                if global_state.try_worker() is not None:
+                    global_state.enqueue_gc_action("kill_actor", self._actor_id)
             except Exception:
                 pass
 

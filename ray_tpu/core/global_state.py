@@ -5,15 +5,49 @@ Capability parity: reference python/ray/_private/worker.py global_worker singlet
 """
 from __future__ import annotations
 
+import queue as _queue
+import threading as _threading
 from typing import Any, Optional
 
 _worker: Optional[Any] = None  # DriverContext or WorkerContext
 _cluster: Optional[Any] = None  # Cluster (driver process only)
 
+# GC-action plumbing: __del__ finalizers (ObjectRef decref, ActorHandle kill) can
+# fire during garbage collection on ANY thread — including one already holding the
+# store lock or mid-pipe-send — so they must never call the runtime directly.
+# SimpleQueue.put is reentrant; a daemon drains it (reference: Ray's CoreWorker
+# queues ref-removals off the destructor path for the same reason).
+_gc_actions: "_queue.SimpleQueue" = _queue.SimpleQueue()
+_gc_drainer: Optional[_threading.Thread] = None
+
+
+def enqueue_gc_action(kind: str, ident: Any) -> None:
+    """Safe to call from __del__/weakref finalizers in any thread state."""
+    _gc_actions.put((kind, ident))
+
+
+def _drain_gc_actions() -> None:
+    while True:
+        kind, ident = _gc_actions.get()
+        w = _worker
+        if w is None:
+            continue
+        try:
+            if kind == "decref":
+                w.decref(ident)
+            elif kind == "kill_actor":
+                w.kill_actor(ident, no_restart=True, from_gc=True)
+        except Exception:
+            pass
+
 
 def set_worker(w) -> None:
-    global _worker
+    global _worker, _gc_drainer
     _worker = w
+    if w is not None and (_gc_drainer is None or not _gc_drainer.is_alive()):
+        _gc_drainer = _threading.Thread(
+            target=_drain_gc_actions, daemon=True, name="gc-action-drainer")
+        _gc_drainer.start()
 
 
 def worker():

@@ -552,7 +552,14 @@ class Cluster:
         worker.resources_held = resources
         worker.bundle_ledger = ledger if ledger is not node.ledger else None
         self._send_task(worker, spec, locs)
-        ts = self.tasks[spec.task_id]
+        ts = self.tasks.get(spec.task_id)
+        if ts is None:
+            # send failed with the task marked failed: free the reserved worker
+            ledger.release(resources)
+            worker.resources_held = {}
+            worker.bundle_ledger = None
+            node.push_idle(worker)
+            return True
         ts.worker = worker
         ts.resources_node = node
         ts.resources = resources
@@ -577,7 +584,9 @@ class Cluster:
         if status == "pending":
             return False
         self._send_task(st.worker, spec, locs)
-        ts = self.tasks[spec.task_id]
+        ts = self.tasks.get(spec.task_id)
+        if ts is None:
+            return True  # send failed; returns were failed, actor stays pinned
         ts.worker = st.worker
         return True
 
@@ -603,7 +612,9 @@ class Cluster:
                 worker.inflight.remove(spec.task_id)
             except ValueError:
                 pass
-            self._fail_returns(spec, e)
+            # the worker never received the fn bytes
+            worker.known_fns.discard(spec.fn_id)
+            self._fail_returns(spec, e)  # pops self.tasks — callers must re-check
 
     def _choose_placement(self, spec: TaskSpec):
         """Pick (node, ledger, resources) honoring the scheduling strategy; None = wait."""

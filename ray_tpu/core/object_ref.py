@@ -50,9 +50,11 @@ class ObjectRef:
             from . import global_state
 
             try:
-                w = global_state.try_worker()
-                if w is not None:
-                    w.decref(self.id)
+                # NEVER call the runtime here: __del__ can run via GC on a thread
+                # that already holds the store lock or is mid-pipe-send — the
+                # decref is queued and applied by the gc-action drainer
+                if global_state.try_worker() is not None:
+                    global_state.enqueue_gc_action("decref", self.id)
             except Exception:
                 pass
 

@@ -61,13 +61,63 @@ class ServeController:
         self._lp_versions: Dict[str, int] = {}
         self._lp_cond = threading.Condition()
         self._lp_last_running: Dict[str, tuple] = {}
+        # recover target state checkpointed in the GCS KV (reference: serve app
+        # state persisted in GCS KV; with RAY_TPU_GCS_PERSISTENCE_PATH it even
+        # survives full cluster restarts)
+        try:
+            self._restore_from_kv()
+        except Exception:
+            pass
         self._reconcile_thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._reconcile_thread.start()
 
+    # -- target-state checkpointing (reference: GCS KV-backed serve state) -------
+    _KV_NS = "serve"
+
+    def _checkpoint_app(self, app_name: str, route_prefix: str,
+                        deployments: List[Dict[str, Any]]) -> None:
+        import cloudpickle
+
+        from ray_tpu.experimental import internal_kv
+
+        blob = cloudpickle.dumps({"route_prefix": route_prefix, "deployments": deployments})
+        internal_kv._internal_kv_put(b"app::" + app_name.encode(), blob,
+                                     namespace=self._KV_NS)
+
+    def _drop_checkpoint(self, app_name: str) -> None:
+        from ray_tpu.experimental import internal_kv
+
+        internal_kv._internal_kv_del(b"app::" + app_name.encode(), namespace=self._KV_NS)
+
+    def _restore_from_kv(self) -> None:
+        import cloudpickle
+
+        from ray_tpu.experimental import internal_kv
+
+        for key in internal_kv._internal_kv_list(b"app::", namespace=self._KV_NS):
+            blob = internal_kv._internal_kv_get(key, namespace=self._KV_NS)
+            if not blob:
+                continue
+            try:
+                spec = cloudpickle.loads(blob)
+                self.deploy_application(key[len(b"app::"):].decode(),
+                                        spec["route_prefix"], spec["deployments"],
+                                        _checkpoint=False)
+            except Exception:
+                continue  # a stale/unloadable app must not block the rest
+
     # -- deploy API ------------------------------------------------------------
-    def deploy_application(self, app_name: str, route_prefix: str, deployments: List[Dict[str, Any]]) -> None:
+    def deploy_application(self, app_name: str, route_prefix: str,
+                           deployments: List[Dict[str, Any]], _checkpoint: bool = True) -> None:
         """deployments: [{name, serialized_init, config, is_ingress}]"""
         with self._lock:
+            # checkpoint under the lock: a concurrent delete must not interleave
+            # between the KV write and the in-memory update (resurrection risk)
+            if _checkpoint:
+                try:
+                    self._checkpoint_app(app_name, route_prefix, deployments)
+                except Exception:
+                    pass  # checkpointing is best-effort; serving must not depend on it
             self.apps[app_name] = {
                 "route_prefix": route_prefix,
                 "ingress": next(d["name"] for d in deployments if d["is_ingress"]),
@@ -92,6 +142,10 @@ class ServeController:
 
     def delete_application(self, app_name: str) -> None:
         with self._lock:
+            try:
+                self._drop_checkpoint(app_name)
+            except Exception:
+                pass
             app = self.apps.pop(app_name, None)
             if not app:
                 return

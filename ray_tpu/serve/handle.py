@@ -199,7 +199,6 @@ class DeploymentHandle:
         self._replicas: List[Any] = []
         self._last_refresh = 0.0
         self._refresh_interval = 1.0
-        self._closed = False
 
     # -- plumbing --------------------------------------------------------------
     def _controller(self):
@@ -231,13 +230,17 @@ class DeploymentHandle:
             app, dep = self.app_name, self.deployment_name
 
             def push():
-                # daemon thread keyed to the router's lifetime, not any one handle
-                while True:
+                # daemon thread keyed to the router's lifetime; exits once the
+                # controller has been gone for a while (serve.shutdown) so
+                # repeated run/shutdown cycles don't accumulate immortal threads
+                errors = 0
+                while errors < 30:
                     try:
                         ray_tpu.get_actor(CONTROLLER_NAME).record_handle_metrics.remote(
                             app, dep, float(router.total_inflight()))
+                        errors = 0
                     except Exception:
-                        pass
+                        errors += 1
                     time.sleep(1.0)
 
             router._metrics_thread = threading.Thread(target=push, daemon=True)

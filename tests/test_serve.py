@@ -237,3 +237,36 @@ def test_handle_sees_scale_up_via_push(rt):
         assert h.remote(3).result() == 6
     finally:
         serve.delete("push-app")
+
+
+def test_controller_crash_recovers_apps_from_kv(rt):
+    """Reference: serve app target state persists in the GCS KV, so a crashed
+    controller restores every app instead of forgetting the cluster's serving."""
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Persisted:
+        def __call__(self, x):
+            return x + 100
+
+    h = serve.run(Persisted.bind(), name="crash-app")
+    assert h.remote(1).result() == 101
+    # crash the controller (NOT serve.shutdown — that's intentional teardown)
+    ctrl = ray_tpu.get_actor(serve.api.CONTROLLER_NAME)
+    ray_tpu.kill(ctrl)
+    time.sleep(0.5)
+    # a fresh controller must restore the app from the KV checkpoint
+    ctrl2 = serve.api._get_or_create_controller()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        info = ray_tpu.get(ctrl2.get_deployment_info.remote("crash-app", "Persisted"))
+        if info and info["num_running"] >= 1:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"app not restored: {info}")
+    h2 = serve.get_app_handle("crash-app")
+    assert h2.remote(5).result() == 105
