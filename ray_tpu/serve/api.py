@@ -37,8 +37,11 @@ def _get_or_create_controller():
         return handle
 
 
-def start(http_options: Optional[Dict[str, Any]] = None, **_compat) -> None:
-    """Bring up controller + HTTP proxy (reference serve.start)."""
+def start(http_options: Optional[Dict[str, Any]] = None,
+          grpc_options: Optional[Dict[str, Any]] = None, **_compat) -> Optional[Dict[str, Any]]:
+    """Bring up controller + ingress proxies (reference serve.start): HTTP
+    always, gRPC when grpc_options is given (reference gRPCProxy). Returns
+    {"grpc_port": N} when the gRPC ingress is up (port 0 = ephemeral bind)."""
     _get_or_create_controller()
     http_options = http_options or {}
     try:
@@ -49,6 +52,13 @@ def start(http_options: Optional[Dict[str, Any]] = None, **_compat) -> None:
         cls = ray_tpu.remote(num_cpus=0.1, name=_PROXY_NAME, lifetime="detached")(ProxyActor)
         proxy = cls.remote(http_options.get("host", "127.0.0.1"), http_options.get("port", 8000))
         ray_tpu.get(proxy.ready.remote())
+    if grpc_options is not None:
+        from .grpc_proxy import start_grpc_proxy
+
+        _, port = start_grpc_proxy(grpc_options.get("host", "127.0.0.1"),
+                                   grpc_options.get("port", 9000))
+        return {"grpc_port": port}
+    return None
 
 
 def run(
@@ -151,6 +161,14 @@ def shutdown() -> None:
     try:
         proxy = ray_tpu.get_actor(_PROXY_NAME)
         ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        from .grpc_proxy import _GRPC_PROXY_NAME
+
+        gproxy = ray_tpu.get_actor(_GRPC_PROXY_NAME)
+        ray_tpu.get(gproxy.stop.remote())
+        ray_tpu.kill(gproxy)
     except Exception:
         pass
     _reset_long_poll()  # watches reference the controller we just killed

@@ -1,0 +1,116 @@
+"""gRPC ingress proxy.
+
+Capability parity: reference python/ray/serve/_private/proxy.py:523 (gRPCProxy —
+per-node grpc.aio ingress routing to deployment handles). Design difference: the
+reference requires user-compiled protos; here one generic unary-unary service
+(`rayserve.Generic/Call`) carries a JSON envelope {app, method, args, kwargs},
+so any client with grpcio can call any deployment without codegen. JSON (not
+pickle) is deliberate: the ingress deserializes untrusted network bytes.
+`serve.start(grpc_options={"port": N})` brings it up; `grpc_call(address, app,
+...)` is the matching client helper.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+SERVICE = "rayserve.Generic"
+METHOD = "Call"
+
+
+class GrpcProxyActor:
+    """Per-node gRPC ingress (reference gRPCProxy)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        from concurrent.futures import ThreadPoolExecutor
+
+        import grpc
+
+        self.host = host
+        self._handles: Dict[tuple, Any] = {}
+        self._handles_lock = threading.Lock()
+
+        def route(app: str, method: str, args, kwargs):
+            key = (app, method)
+            with self._handles_lock:
+                handle = self._handles.get(key)
+            if handle is None:
+                from . import api
+
+                handle = api.get_app_handle(app).options(method_name=method)
+                with self._handles_lock:
+                    self._handles[key] = handle
+            return handle.remote(*args, **kwargs).result()
+
+        def call(request: bytes, context) -> bytes:
+            try:
+                req = json.loads(request)
+                app = req["app"]
+                method = req.get("method") or "__call__"
+                args = req.get("args") or []
+                kwargs = req.get("kwargs") or {}
+                try:
+                    result = route(app, method, args, kwargs)
+                except Exception:
+                    # the cached handle may be stale (app deleted/redeployed):
+                    # drop it and retry once against a freshly resolved handle
+                    with self._handles_lock:
+                        self._handles.pop((app, method), None)
+                    result = route(app, method, args, kwargs)
+                return json.dumps({"ok": True, "result": result}).encode()
+            except Exception as e:  # noqa: BLE001
+                return json.dumps({"ok": False, "error": repr(e)}).encode()
+
+        rpc = grpc.unary_unary_rpc_method_handler(
+            call, request_deserializer=None, response_serializer=None)
+        handler = grpc.method_handlers_generic_handler(SERVICE, {METHOD: rpc})
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise OSError(f"gRPC proxy failed to bind {host}:{port}")
+        self._server.start()
+
+    def ready(self) -> int:
+        return self.port
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+def grpc_call(address: str, app: str, *args, method: Optional[str] = None, **kwargs) -> Any:
+    """Client helper: one unary call to a serve deployment over the gRPC proxy.
+
+    Payloads are JSON — args/kwargs/results must be JSON-serializable (the
+    ingress will not unpickle untrusted bytes)."""
+    import grpc
+
+    with grpc.insecure_channel(address) as channel:
+        fn = channel.unary_unary(f"/{SERVICE}/{METHOD}")
+        payload = json.dumps(
+            {"app": app, "method": method, "args": list(args), "kwargs": kwargs}).encode()
+        resp = json.loads(fn(payload, timeout=60.0))
+    if not resp["ok"]:
+        raise RuntimeError(f"serve grpc call failed: {resp['error']}")
+    return resp["result"]
+
+
+_GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 9000):
+    """Get-or-create the gRPC ingress actor; returns (handle, bound_port).
+
+    If a proxy already exists, its existing bound port is returned and the
+    host/port arguments are ignored (one ingress per cluster, like the HTTP
+    proxy's get-or-create)."""
+    try:
+        proxy = ray_tpu.get_actor(_GRPC_PROXY_NAME)
+    except ValueError:
+        cls = ray_tpu.remote(num_cpus=0.1, name=_GRPC_PROXY_NAME,
+                             lifetime="detached")(GrpcProxyActor)
+        proxy = cls.remote(host, port)
+    return proxy, ray_tpu.get(proxy.ready.remote())

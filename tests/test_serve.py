@@ -270,3 +270,39 @@ def test_controller_crash_recovers_apps_from_kv(rt):
         raise AssertionError(f"app not restored: {info}")
     h2 = serve.get_app_handle("crash-app")
     assert h2.remote(5).result() == 105
+
+
+def test_grpc_proxy_ingress(rt):
+    """Reference gRPCProxy (proxy.py:523): gRPC ingress routed to handles."""
+    from ray_tpu.serve.grpc_proxy import grpc_call, start_grpc_proxy
+
+    @serve.deployment(num_replicas=1)
+    class Calc:
+        def __call__(self, x):
+            return x + 1
+
+        def mul(self, a, b):
+            return a * b
+
+    info = serve.start(grpc_options={"port": 0})
+    port = info["grpc_port"]
+    assert port > 0  # ephemeral bind reported back
+    serve.run(Calc.bind(), name="calc")
+    addr = f"127.0.0.1:{port}"
+    assert grpc_call(addr, "calc", 41) == 42
+    assert grpc_call(addr, "calc", 6, 7, method="mul") == 42
+    # errors surface, not hang
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="serve grpc call failed"):
+        grpc_call(addr, "no-such-app", 1)
+    # redeploy with a different ingress class: the stale handle cache must heal
+    @serve.deployment(num_replicas=1)
+    class Calc2:
+        def __call__(self, x):
+            return x + 2
+
+    serve.delete("calc")
+    serve.run(Calc2.bind(), name="calc")
+    assert grpc_call(addr, "calc", 40) == 42
+    assert start_grpc_proxy(port=0)[1] == port  # get-or-create returns the live port
