@@ -211,6 +211,7 @@ class Cluster:
         # object_recovery_manager.h:43 + task_manager lineage pinning)
         self.lineage: Dict[ObjectID, TaskSpec] = {}
         self._recovering: set = set()  # oids with an in-flight reconstruction
+        self._stack_dumps: Dict[str, Dict[str, str]] = {}  # token -> worker -> text
         self.store.on_free = self._on_object_freed
         self._object_store_capacity = object_store_memory
         self.spill_dir = os.path.join(
@@ -341,6 +342,11 @@ class Cluster:
                 return dispatch_state_request(fn_name, fargs, fkwargs)
 
             self._async_reply(w, req_id, run_state)
+        elif kind == "stacks":
+            _, token, worker_id_hex, text = msg
+            with self._lock:
+                if token in self._stack_dumps:  # late replies after timeout are dropped
+                    self._stack_dumps[token][worker_id_hex] = text
         elif kind == "metrics":
             # periodic per-worker metric snapshot (util/metrics.py push thread)
             self.metrics_by_worker[w.worker_id] = msg[1]
@@ -867,6 +873,34 @@ class Cluster:
         except Exception:
             return False
         return True  # inline is always alive
+
+    def dump_worker_stacks(self, timeout_s: float = 5.0) -> Dict[str, str]:
+        """Thread stacks of every live worker + this coordinator process
+        (reference: py-spy dumps via the dashboard reporter module)."""
+        from .worker import _format_thread_stacks
+
+        token = os.urandom(8).hex()
+        with self._lock:
+            workers = [w for n in self._nodes.values() for w in n.workers.values()
+                       if w.state not in ("dead", "starting")]
+            self._stack_dumps[token] = {}
+        sent = 0
+        for w in workers:
+            try:
+                w.send(("dump_stacks", token))
+                sent += 1
+            except Exception:
+                pass  # dead pipe: don't wait on a reply that can never come
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._stack_dumps.get(token, {})) >= sent:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            out = dict(self._stack_dumps.pop(token, {}))
+        out["driver"] = _format_thread_stacks()
+        return out
 
     def _gc_arena_after_death(self) -> None:
         """Reclaim arena space from a dead worker: unsealed half-writes and sealed

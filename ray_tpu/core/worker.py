@@ -108,6 +108,14 @@ class WorkerContext:
                     # Unmatched replies (cancelled requests) are dropped.
                 elif kind == "free":
                     object_store._segment_cache.drop(msg[1])
+                elif kind == "dump_stacks":
+                    # one-way reply straight from the recv thread (no _request):
+                    # py-spy-style introspection of a possibly-busy worker
+                    try:
+                        self._send(("stacks", msg[1], self.worker_id_hex,
+                                    _format_thread_stacks()))
+                    except Exception:
+                        pass
                 elif kind == "exit":
                     self._exit = True
                     self._task_queue.put(("exit",))
@@ -424,3 +432,17 @@ def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dic
         except Exception:
             pass
         sys.exit(0)
+
+
+def _format_thread_stacks() -> str:
+    """All thread stacks of this process (reference: py-spy dump via the
+    dashboard reporter; this is the dependency-free in-process equivalent)."""
+    import traceback
+
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(l.rstrip() for l in traceback.format_stack(frame))
+    return "\n".join(out)

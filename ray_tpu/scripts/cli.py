@@ -160,6 +160,7 @@ def cmd_list(args) -> int:
     from ray_tpu.util import state as rs
 
     fns = {
+        "stacks": rs.get_worker_stacks,
         "nodes": rs.list_nodes,
         "workers": rs.list_workers,
         "tasks": rs.list_tasks,
@@ -253,7 +254,8 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("list", help="state API listings (reference `ray list`)")
     sp.add_argument("resource", choices=["nodes", "workers", "tasks", "actors",
-                                         "objects", "placement-groups", "summary"])
+                                         "objects", "placement-groups", "summary",
+                                         "stacks"])
     sp.add_argument("--address", default=None,
                     help="connect as a client driver, e.g. ray-tpu://127.0.0.1:10001")
     sp.set_defaults(fn=cmd_list)

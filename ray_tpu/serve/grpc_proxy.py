@@ -37,13 +37,14 @@ class GrpcProxyActor:
             key = (app, method)
             with self._handles_lock:
                 handle = self._handles.get(key)
+            from_cache = handle is not None
             if handle is None:
                 from . import api
 
                 handle = api.get_app_handle(app).options(method_name=method)
                 with self._handles_lock:
                     self._handles[key] = handle
-            return handle.remote(*args, **kwargs).result()
+            return handle.remote(*args, **kwargs).result(), from_cache
 
         def call(request: bytes, context) -> bytes:
             try:
@@ -53,13 +54,17 @@ class GrpcProxyActor:
                 args = req.get("args") or []
                 kwargs = req.get("kwargs") or {}
                 try:
-                    result = route(app, method, args, kwargs)
+                    result, _ = route(app, method, args, kwargs)
                 except Exception:
-                    # the cached handle may be stale (app deleted/redeployed):
-                    # drop it and retry once against a freshly resolved handle
                     with self._handles_lock:
-                        self._handles.pop((app, method), None)
-                    result = route(app, method, args, kwargs)
+                        was_cached = self._handles.pop((app, method), None) is not None
+                    if not was_cached:
+                        raise  # fresh handle: a user-code error, never retried
+                    # the CACHED handle may be stale (app deleted/redeployed):
+                    # retry once against a freshly resolved one. User methods may
+                    # run twice only in the stale-cache window — same contract as
+                    # the reference proxy's retry-on-unavailable-replica.
+                    result, _ = route(app, method, args, kwargs)
                 return json.dumps({"ok": True, "result": result}).encode()
             except Exception as e:  # noqa: BLE001
                 return json.dumps({"ok": False, "error": repr(e)}).encode()

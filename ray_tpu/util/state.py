@@ -253,3 +253,11 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         with open(filename, "w") as f:
             json.dump(events, f)
     return events
+
+
+@_remoteable
+def get_worker_stacks(timeout_s: float = 5.0) -> Dict[str, str]:
+    """Per-process thread stack dumps (reference: py-spy via the dashboard
+    reporter module, python/ray/dashboard/modules/reporter/) — dependency-free:
+    workers introspect sys._current_frames() on their recv thread."""
+    return _cluster().dump_worker_stacks(timeout_s)

@@ -128,3 +128,23 @@ def test_dashboard_http(rt):
         assert "# TYPE" in text or text.strip() == ""
     finally:
         dash.stop()
+
+
+def test_worker_stack_dumps(rt):
+    """py-spy-analog stack introspection of busy workers."""
+    import time
+
+    from ray_tpu.util import state as rs
+
+    @rt.remote
+    def busy():
+        time.sleep(4.0)
+        return 1
+
+    ref = busy.remote()
+    time.sleep(0.8)  # let it dispatch and enter the sleep
+    stacks = rs.get_worker_stacks(timeout_s=10.0)
+    assert "driver" in stacks
+    joined = "\n".join(stacks.values())
+    assert "_execute_body" in joined or "busy" in joined, list(stacks)[:3]
+    assert rt.get(ref) == 1
