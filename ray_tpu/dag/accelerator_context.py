@@ -1,0 +1,126 @@
+"""Pluggable communicator registry for compiled-DAG channels.
+
+Capability parity: reference python/ray/experimental/channel/accelerator_context.py
+(:18 AcceleratorContext, :221 register_accelerator_context) + communicator.py:18
+(Communicator ABC) — the reference's own extension point for mapping a device
+type to the transport its compiled graphs use (NCCL for CUDA there). Here the
+registered transports are:
+- "cpu"/"shm": the seqlock shared-memory channel (default)
+- "tpu"/"device": jax.Array-aware channel — a same-process reader receives THE
+  original device array (zero-copy via experimental.device_objects); across
+  processes the host copy embedded in the message is used. True device-to-device
+  between jitted stages should be fused into one pjit program or ride
+  jax.device_put, per the dag module docstring.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Type
+
+from .channel import ShmChannel
+
+
+class Communicator:
+    """Creates channels for compiled-DAG edges (reference communicator.py:18)."""
+
+    def create_channel(self, name: str, capacity: int, create: bool = False):
+        raise NotImplementedError
+
+
+class SharedMemoryCommunicator(Communicator):
+    def create_channel(self, name: str, capacity: int, create: bool = False):
+        return ShmChannel(name, capacity, create=create)
+
+
+class DeviceChannel:
+    """ShmChannel wrapper that keeps device arrays resident for local readers."""
+
+    def __init__(self, name: str, capacity: int, create: bool = False):
+        self._inner = ShmChannel(name, capacity, create=create)
+
+    @property
+    def name(self) -> str:
+        return self._inner.name
+
+    @property
+    def capacity(self) -> int:
+        return self._inner.capacity
+
+    @staticmethod
+    def _device_payload(value: Any):
+        """The device array inside a payload: bare, or one level deep in the
+        (status, value) tuples compiled-DAG exec loops wrap everything in."""
+        from ray_tpu.experimental import device_objects
+
+        if device_objects.is_device_array(value):
+            return value, "bare"
+        if (isinstance(value, tuple) and len(value) == 2
+                and device_objects.is_device_array(value[1])):
+            return value[1], "pair"
+        return None, None
+
+    def write(self, value: Any, timeout: float = None) -> None:
+        from ray_tpu.experimental import device_objects
+
+        arr, shape = self._device_payload(value)
+        if arr is not None:
+            key = os.urandom(20)
+            device_objects.stash(key, arr)  # same-process readers skip the copy
+            self._inner.write(("__device__", key, shape, value), timeout)
+        else:
+            self._inner.write(("__host__", None, None, value), timeout)
+
+    def read(self, timeout: float = None) -> Any:
+        from ray_tpu.experimental import device_objects
+
+        kind, key, shape, value = self._inner.read(timeout)
+        if kind == "__device__":
+            hit = device_objects.lookup(key)
+            if hit is not None:  # zero-copy: splice THE original jax.Array back in
+                return hit if shape == "bare" else (value[0], hit)
+        return value
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def destroy(self) -> None:
+        self._inner.destroy()
+
+    def __reduce__(self):
+        inner = self._inner.__reduce__()
+        return (_rebuild_device_channel, inner[1])
+
+
+def _rebuild_device_channel(*args):
+    ch = DeviceChannel.__new__(DeviceChannel)
+    ch._inner = ShmChannel(*args)
+    return ch
+
+
+class DeviceCommunicator(Communicator):
+    def create_channel(self, name: str, capacity: int, create: bool = False):
+        return DeviceChannel(name, capacity, create=create)
+
+
+_registry: Dict[str, Type[Communicator]] = {
+    "cpu": SharedMemoryCommunicator,
+    "shm": SharedMemoryCommunicator,
+    "tpu": DeviceCommunicator,
+    "device": DeviceCommunicator,
+}
+
+
+def register_accelerator_context(device_type: str, communicator_cls: Type[Communicator]) -> None:
+    """Reference accelerator_context.py:221 — plug a custom transport in."""
+    if not issubclass(communicator_cls, Communicator):
+        raise TypeError("communicator_cls must subclass Communicator")
+    _registry[device_type] = communicator_cls
+
+
+def get_accelerator_context(device_type: str = "cpu") -> Communicator:
+    try:
+        return _registry[device_type]()
+    except KeyError:
+        raise ValueError(
+            f"no communicator registered for {device_type!r} "
+            f"(known: {sorted(_registry)})") from None

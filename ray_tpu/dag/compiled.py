@@ -32,9 +32,13 @@ class DAGNode:
 
     def experimental_compile(self, *, buffer_size_bytes: int = _DEFAULT_BUFFER,
                              submit_timeout: float = 30.0,
-                             max_inflight_executions: int = 2) -> "CompiledDAG":
+                             max_inflight_executions: int = 2,
+                             channel_type: str = "shm") -> "CompiledDAG":
+        """channel_type selects the registered Communicator ("shm" default;
+        "device" keeps jax.Arrays resident for same-process readers — reference
+        accelerator_context.py registry)."""
         return CompiledDAG(self, buffer_size_bytes, submit_timeout,
-                           max_inflight_executions)
+                           max_inflight_executions, channel_type)
 
 
 class InputNode(DAGNode):
@@ -87,16 +91,23 @@ def bind(actor_method, *args, **kwargs) -> ClassMethodNode:
 
 # ------------------------------------------------------------------ exec loop
 
-def _actor_exec_loop(instance, tasks: List[Dict], stop_name: str):
+def _actor_exec_loop(instance, tasks: List[Dict], stop_name: str,
+                     communicator_cls=None):
     """Runs inside the actor (via __ray_call__): read inputs, call methods, write
-    outputs, until the stop channel fires. tasks are in topological order."""
+    outputs, until the stop channel fires. tasks are in topological order.
+
+    The communicator CLASS travels with this call (cloudpickled), so custom
+    transports registered only in the driver still work in the worker."""
+    from .accelerator_context import SharedMemoryCommunicator
+
+    comm = (communicator_cls or SharedMemoryCommunicator)()
     stop = ShmChannel(stop_name, 256)
-    chans: Dict[str, ShmChannel] = {}
+    chans: Dict[str, Any] = {}
 
     def ch(name_cap):
         name, cap = name_cap
         if name not in chans:
-            chans[name] = ShmChannel(name, cap)
+            chans[name] = comm.create_channel(name, cap)
         return chans[name]
 
     while True:
@@ -165,8 +176,12 @@ class CompiledDAGRef:
 
 class CompiledDAG:
     def __init__(self, root: DAGNode, buffer_size: int, submit_timeout: float,
-                 max_inflight_executions: int = 2):
+                 max_inflight_executions: int = 2, channel_type: str = "shm"):
+        from .accelerator_context import get_accelerator_context
+
         self._buffer = buffer_size
+        self._channel_type = channel_type
+        self._comm = get_accelerator_context(channel_type)
         self._timeout = submit_timeout
         # Single-slot channels bound the safe pipeline depth (reference analog:
         # max_inflight_executions on compiled_dag_node.py; exceeding it raises
@@ -211,7 +226,7 @@ class CompiledDAG:
         self._all_channels: List[ShmChannel] = [self._stop]
 
         def new_chan(tag):
-            c = ShmChannel(f"{prefix}_{tag}", self._buffer, create=True)
+            c = self._comm.create_channel(f"{prefix}_{tag}", self._buffer, create=True)
             self._all_channels.append(c)
             return c
 
@@ -265,7 +280,8 @@ class CompiledDAG:
         self._loop_refs = []
         for actor, tasks in per_actor.items():
             self._loop_refs.append(
-                actor.__ray_call__.remote(_actor_exec_loop, tasks, self._stop_name)
+                actor.__ray_call__.remote(_actor_exec_loop, tasks, self._stop_name,
+                                          type(self._comm))
             )
 
     # -- execution -----------------------------------------------------------------

@@ -42,9 +42,13 @@ class GrpcProxyActor:
                 from . import api
 
                 handle = api.get_app_handle(app).options(method_name=method)
+            result = handle.remote(*args, **kwargs).result()
+            if not from_cache:
+                # cache only after a successful call: a failing fresh handle must
+                # not masquerade as a stale-cache entry in the retry logic below
                 with self._handles_lock:
                     self._handles[key] = handle
-            return handle.remote(*args, **kwargs).result(), from_cache
+            return result
 
         def call(request: bytes, context) -> bytes:
             try:
@@ -54,7 +58,7 @@ class GrpcProxyActor:
                 args = req.get("args") or []
                 kwargs = req.get("kwargs") or {}
                 try:
-                    result, _ = route(app, method, args, kwargs)
+                    result = route(app, method, args, kwargs)
                 except Exception:
                     with self._handles_lock:
                         was_cached = self._handles.pop((app, method), None) is not None
@@ -64,7 +68,7 @@ class GrpcProxyActor:
                     # retry once against a freshly resolved one. User methods may
                     # run twice only in the stale-cache window — same contract as
                     # the reference proxy's retry-on-unavailable-replica.
-                    result, _ = route(app, method, args, kwargs)
+                    result = route(app, method, args, kwargs)
                 return json.dumps({"ok": True, "result": result}).encode()
             except Exception as e:  # noqa: BLE001
                 return json.dumps({"ok": False, "error": repr(e)}).encode()

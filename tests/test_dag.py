@@ -1,4 +1,5 @@
 """Compiled Graph (aDAG) tests (reference: python/ray/dag/tests; SURVEY.md §2.3)."""
+import os
 import time
 
 import pytest
@@ -171,3 +172,85 @@ def test_dag_throughput_beats_task_path(rt, actors):
     finally:
         dag.teardown()
     assert dag_path < task_path, (dag_path, task_path)
+
+
+def test_accelerator_context_registry():
+    from ray_tpu.dag.accelerator_context import (
+        Communicator,
+        DeviceCommunicator,
+        SharedMemoryCommunicator,
+        get_accelerator_context,
+        register_accelerator_context,
+    )
+
+    assert isinstance(get_accelerator_context("cpu"), SharedMemoryCommunicator)
+    assert isinstance(get_accelerator_context("tpu"), DeviceCommunicator)
+    with pytest.raises(ValueError, match="no communicator"):
+        get_accelerator_context("npu")
+
+    class Custom(SharedMemoryCommunicator):
+        pass
+
+    register_accelerator_context("npu", Custom)
+    assert isinstance(get_accelerator_context("npu"), Custom)
+    with pytest.raises(TypeError):
+        register_accelerator_context("bad", int)
+
+
+def test_device_channel_zero_copy_same_process():
+    import jax.numpy as jnp
+
+    from ray_tpu.dag.accelerator_context import DeviceCommunicator
+
+    comm = DeviceCommunicator()
+    ch = comm.create_channel(f"rtdag_test_{os.getpid()}", 1 << 16, create=True)
+    try:
+        arr = jnp.arange(8.0)
+        ch.write(arr)
+        out = ch.read()
+        assert out is arr  # same-process fast path returns THE device array
+        ch.write({"plain": 1})
+        assert ch.read() == {"plain": 1}
+    finally:
+        ch.destroy()
+
+
+def test_compiled_dag_with_device_channels(rt):
+    @rt.remote
+    class Scaler:
+        def scale(self, x):
+            import jax.numpy as jnp
+
+            return jnp.asarray(x) * 2.0
+
+    a = Scaler.remote()
+    with InputNode() as inp:
+        node = a.scale.bind(inp)
+    dag = node.experimental_compile(channel_type="device")
+    try:
+        import numpy as np
+
+        out = dag.execute(np.ones(4)).get()
+        np.testing.assert_allclose(np.asarray(out), 2 * np.ones(4))
+        out2 = dag.execute(np.full(4, 3.0)).get()
+        np.testing.assert_allclose(np.asarray(out2), np.full(4, 6.0))
+    finally:
+        dag.teardown()
+
+
+def test_device_channel_unwraps_status_pairs():
+    """Exec loops wrap payloads as (status, value); the device fast path must
+    still splice the resident array back in."""
+    import jax.numpy as jnp
+
+    from ray_tpu.dag.accelerator_context import DeviceCommunicator
+
+    comm = DeviceCommunicator()
+    ch = comm.create_channel(f"rtdag_pair_{os.getpid()}", 1 << 16, create=True)
+    try:
+        arr = jnp.arange(4.0)
+        ch.write(("ok", arr))
+        status, out = ch.read()
+        assert status == "ok" and out is arr  # THE array, through the pair wrapper
+    finally:
+        ch.destroy()
