@@ -29,6 +29,7 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     scheduling_strategy="DEFAULT",
     runtime_env=None,
     max_concurrency=1,
+    concurrency_groups=None,  # {"group": n_threads}; 0 = thread-per-call
 )
 
 
@@ -37,32 +38,40 @@ def extract_method_meta(cls) -> Dict[str, Dict[str, Any]]:
     for name, member in inspect.getmembers(cls, predicate=callable):
         if name.startswith("__") and name != "__call__":
             continue
-        num_returns = getattr(member, "_num_returns", 1)
-        meta[name] = {"num_returns": num_returns}
+        meta[name] = {
+            "num_returns": getattr(member, "_num_returns", 1),
+            "concurrency_group": getattr(member, "_concurrency_group", ""),
+        }
     return meta
 
 
-def method(*, num_returns: int = 1):
-    """Decorator matching reference @ray.method(num_returns=...)."""
+def method(*, num_returns: int = 1, concurrency_group: str = ""):
+    """Decorator matching reference @ray.method(num_returns=..., concurrency_group=...)."""
 
     def deco(fn):
         fn._num_returns = num_returns
+        fn._concurrency_group = concurrency_group
         return fn
 
     return deco
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 concurrency_group: str = ""):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, num_returns=self._num_returns)
 
-    def options(self, num_returns: Optional[int] = None, **_ignored):
-        m = ActorMethod(self._handle, self._name, num_returns or self._num_returns)
+    def options(self, num_returns: Optional[int] = None,
+                concurrency_group: Optional[str] = None, **_ignored):
+        m = ActorMethod(self._handle, self._name, num_returns or self._num_returns,
+                        concurrency_group if concurrency_group is not None
+                        else self._concurrency_group)
         return m
 
     def _remote(self, args, kwargs, num_returns: int = 1):
@@ -83,6 +92,7 @@ class ActorMethod:
             return_ids=[ObjectID.generate() for _ in range(num_returns)],
             actor_id=self._handle._actor_id,
             method_name=self._name,
+            concurrency_group=self._concurrency_group,
         )
         refs = ctx.submit(spec)
         del pins  # safe to release: submit() pinned the args
@@ -120,7 +130,8 @@ class ActorHandle:
     def __getattr__(self, name: str):
         meta = object.__getattribute__(self, "_method_meta")
         if name in meta:
-            return ActorMethod(self, name, meta[name].get("num_returns", 1))
+            return ActorMethod(self, name, meta[name].get("num_returns", 1),
+                               meta[name].get("concurrency_group", ""))
         if name == "__ray_call__":
             # run an arbitrary fn(instance, *args) on the actor (reference actor.py)
             return ActorMethod(self, "__ray_call__", 1)
@@ -170,6 +181,13 @@ class ActorClass:
         meta, arg_refs, pins = encode_args(ctx, args, kwargs)
         actor_id = ActorID.generate()
         method_meta = extract_method_meta(self._cls)
+        declared = set((opts.get("concurrency_groups") or {}))
+        for mname, m in method_meta.items():
+            g = m.get("concurrency_group")
+            if g and g not in declared:
+                raise ValueError(
+                    f"method {self.__name__}.{mname} uses concurrency group {g!r}, "
+                    f"which is not declared in concurrency_groups ({sorted(declared)})")
         runtime_env = dict(opts.get("runtime_env") or {}) or None
         spec = TaskSpec(
             task_id=TaskID.generate(),
@@ -192,6 +210,8 @@ class ActorClass:
             method_meta=method_meta,
             detached=opts.get("lifetime") == "detached",
             max_concurrency=max(1, int(opts.get("max_concurrency") or 1)),
+            concurrency_groups=dict(opts["concurrency_groups"])
+            if opts.get("concurrency_groups") else None,
             trace_ctx=get_trace_context(),
         )
         ctx.submit(spec)

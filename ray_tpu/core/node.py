@@ -832,10 +832,14 @@ class Cluster:
             resubmit = not running and not (set(spec.return_ids) & self._recovering)
             if resubmit:
                 self._recovering.update(spec.return_ids)
-        try:
-            if resubmit:
+                # drop the dead locations under the SAME lock: a concurrent
+                # recoverer that loses the resubmit race must block in
+                # store.location() below until reconstruction re-adds a live
+                # location — never read the stale dead entry and return it
                 for out_oid in spec.return_ids:
                     self.store.drop_location(out_oid)
+        try:
+            if resubmit:
                 respec = copy.copy(spec)
                 respec.attempt = 0
                 respec.task_id = TaskID.generate()

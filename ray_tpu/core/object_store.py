@@ -294,12 +294,6 @@ class ObjectStore:
         if ev is not None:
             ev.set()
 
-    def replace_location(self, oid: ObjectID, loc: Location) -> None:
-        """Swap an object's storage location (spill/restore) without waking waiters."""
-        with self._lock:
-            if oid in self._locations:
-                self._locations[oid] = loc
-
     def drop_location(self, oid: ObjectID) -> None:
         """Forget a lost location so lineage reconstruction can re-add it."""
         with self._lock:
@@ -442,7 +436,19 @@ class ObjectStore:
                 continue  # skip unspillable objects, keep relieving pressure
             if new_loc is None:
                 continue
-            self.replace_location(oid, new_loc)
+            # swap only if the object still lives at the snapshotted location:
+            # a free() (refcount hit zero) or concurrent spill mid-write must not
+            # leave an orphaned disk file counted as relieved memory
+            with self._lock:
+                swapped = self._locations.get(oid) == loc
+                if swapped:
+                    self._locations[oid] = new_loc
+            if not swapped:
+                try:
+                    os.remove(new_loc[1])
+                except OSError:
+                    pass
+                continue
             spilled += new_loc[2]
         return spilled
 

@@ -58,6 +58,12 @@ class TaskSpec:
     method_meta: Dict[str, Any] = field(default_factory=dict)
     detached: bool = False
     max_concurrency: int = 1
+    # named concurrency groups (reference ConcurrencyGroupManager,
+    # src/ray/core_worker/transport/concurrency_group_manager.h): group name ->
+    # thread count (0 = thread-per-call). actor_creation carries the table;
+    # actor_method may override its group per-call.
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: str = ""
     # tracing context propagation (util/tracing.py; reference: TaskSpec-embedded
     # otel context in tracing_helper.py)
     trace_ctx: Optional[Dict[str, str]] = None

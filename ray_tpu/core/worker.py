@@ -29,6 +29,18 @@ from .object_ref import ObjectRef
 from .task_spec import TaskSpec, _RefMarker
 
 
+class _ThreadPerCallExecutor:
+    """Unbounded concurrency group (size 0): one daemon thread per call, so
+    arbitrarily many parked calls (long-poll listeners) never exhaust a pool."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def submit(self, fn, *args):
+        threading.Thread(target=fn, args=args, daemon=True,
+                         name=f"cg-{self._name}").start()
+
+
 class WorkerContext:
     """The worker-side implementation of the runtime API (get/put/submit/...)."""
 
@@ -48,6 +60,8 @@ class WorkerContext:
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
         self._method_pool = None
+        self._group_pools: Dict[str, Any] = {}  # concurrency group -> executor
+        self._method_groups: Dict[str, str] = {}  # method name -> default group
         # per-thread: concurrent methods of a threaded actor each track their own task
         self._task_ctx = threading.local()
         self._exit = False
@@ -280,12 +294,28 @@ class WorkerContext:
     def execute(self, spec: TaskSpec, resolved_locs: List) -> None:
         # Threaded actors (reference max_concurrency): methods run on a pool so a
         # replica can serve requests concurrently (serve batching/long polls).
-        if (
-            spec.kind == "actor_method"
-            and self._method_pool is not None
-        ):
-            self._method_pool.submit(self._execute_inner, spec, resolved_locs)
-            return
+        # Named concurrency groups (reference concurrency_group_manager.h) get
+        # their own pools so e.g. parked long-poll listeners can never exhaust
+        # the default pool and starve control RPCs.
+        if spec.kind == "actor_method":
+            group = spec.concurrency_group or self._method_groups.get(
+                spec.method_name or "", "")
+            if group:
+                pool = self._group_pools.get(group)
+                if pool is None:
+                    # never fall back silently: a typo'd group would land parked
+                    # calls on the bounded default pool and reintroduce the
+                    # starvation the groups exist to prevent
+                    self._send_error(spec, ValueError(
+                        f"concurrency group {group!r} was not declared in this "
+                        f"actor's concurrency_groups "
+                        f"(declared: {sorted(self._group_pools)})"))
+                    return
+                pool.submit(self._execute_inner, spec, resolved_locs)
+                return
+            if self._method_pool is not None:
+                self._method_pool.submit(self._execute_inner, spec, resolved_locs)
+                return
         self._execute_inner(spec, resolved_locs)
 
     def _execute_inner(self, spec: TaskSpec, resolved_locs: List) -> None:
@@ -345,12 +375,25 @@ class WorkerContext:
                 self.actor_instance = cls(*args, **kwargs)
                 self.actor_id = spec.actor_id
                 mc = spec.max_concurrency
-                if mc > 1:
+                if mc > 1 or spec.concurrency_groups:
                     from concurrent.futures import ThreadPoolExecutor
 
                     self._method_pool = ThreadPoolExecutor(
                         max_workers=mc, thread_name_prefix="actor-method"
                     )
+                for gname, size in (spec.concurrency_groups or {}).items():
+                    if size and size > 0:
+                        from concurrent.futures import ThreadPoolExecutor
+
+                        self._group_pools[gname] = ThreadPoolExecutor(
+                            max_workers=size, thread_name_prefix=f"cg-{gname}")
+                    else:
+                        self._group_pools[gname] = _ThreadPerCallExecutor(gname)
+                self._method_groups = {
+                    name: m.get("concurrency_group", "")
+                    for name, m in (spec.method_meta or {}).items()
+                    if m.get("concurrency_group")
+                }
                 results = [None]
             elif spec.kind == "actor_method":
                 if spec.method_name == "__ray_call__":

@@ -245,7 +245,15 @@ def _join_partition(on: str, how: str, n_left: int, *parts: Block) -> Tuple[Bloc
             cols.append(ltak.column(name))
     for name in rt.column_names:
         if name != on:
-            names.append(name if name not in lt.column_names else f"{name}_1")
+            # uniquify collisions: "_1" alone can itself collide with an existing
+            # left column (e.g. left has v and v_1), and the dict() below would
+            # silently drop one of them
+            unique = name
+            suffix = 1
+            while unique in names:
+                unique = f"{name}_{suffix}"
+                suffix += 1
+            names.append(unique)
             cols.append(rtak.column(name))
     out = pa.table(dict(zip(names, cols)))
     return out, BlockAccessor.for_block(out).get_metadata()

@@ -28,10 +28,13 @@ def _get_or_create_controller():
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
-        # max_concurrency: long-poll listeners block controller threads
-        # (controller.listen_for_change) and must not stall deploy/reconcile
+        # listen_for_change parks one call per connected handle/proxy process for
+        # up to 10s; an unbounded "listen" concurrency group keeps any number of
+        # parked listeners from starving deploy/reconcile/health RPCs, which run
+        # on the default pool
         cls = ray_tpu.remote(num_cpus=0.1, name=CONTROLLER_NAME, lifetime="detached",
-                             max_concurrency=16)(ServeController)
+                             max_concurrency=16,
+                             concurrency_groups={"listen": 0})(ServeController)
         handle = cls.remote()
         ray_tpu.get(handle.ping.remote())
         return handle

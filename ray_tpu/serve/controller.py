@@ -11,6 +11,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.core.actor import method as _actor_method
+
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 STARTING, RUNNING, STOPPING = "STARTING", "RUNNING", "STOPPING"
@@ -351,12 +353,13 @@ class ServeController:
             self._lp_versions["routes"] = self._lp_versions.get("routes", 0) + 1
             self._lp_cond.notify_all()
 
+    @_actor_method(concurrency_group="listen")
     def listen_for_change(self, keys_to_versions: Dict[str, int],
                           timeout_s: float = 10.0) -> Dict[str, Any]:
         """Block until any watched key's version differs from the caller's view;
-        returns {key: (new_version, snapshot)} ({} on timeout). The controller
-        actor runs with max_concurrency so waiting listeners don't stall the
-        deploy/reconcile APIs."""
+        returns {key: (new_version, snapshot)} ({} on timeout). Runs on the
+        unbounded "listen" concurrency group (see serve/api.py) so parked
+        listeners never starve deploy/reconcile APIs on the default pool."""
         deadline = time.monotonic() + timeout_s
         with self._lp_cond:
             while not self._shutdown:

@@ -11,7 +11,7 @@ import itertools
 import threading
 from typing import Any, Dict, Optional, Tuple
 
-from .server import DEFAULT_AUTHKEY
+from .server import DEFAULT_AUTHKEY, load_authkey
 from .server import REF_RETURNING as _REF_RETURNING  # shared with the server's leasing
 
 # methods forwarded with a response
@@ -27,12 +27,17 @@ _NO_REPLY = {"decref", "kill_actor", "push_metrics", "push_spans", "push_tqdm"}
 
 
 class ClientContext:
-    def __init__(self, address: str, authkey: bytes = DEFAULT_AUTHKEY,
+    def __init__(self, address: str, authkey: Optional[bytes] = None,
                  timeout: Optional[float] = None):
         from multiprocessing.connection import Client
 
         import queue
 
+        if authkey is None:
+            # RAY_TPU_CLIENT_AUTHKEY env, then the head's session-dir file
+            # (same-host drivers); the legacy fixed key only as a last resort
+            # for loopback servers started with an explicit DEFAULT_AUTHKEY
+            authkey = load_authkey() or DEFAULT_AUTHKEY
         host, _, port = address.rpartition(":")
         self._conn = Client((host or "127.0.0.1", int(port)), authkey=authkey)
         self._req_counter = itertools.count()
@@ -164,7 +169,7 @@ class ClientContext:
             pass
 
 
-def connect(address: str, authkey: bytes = DEFAULT_AUTHKEY) -> ClientContext:
+def connect(address: str, authkey: Optional[bytes] = None) -> ClientContext:
     """Connect this process as a remote driver (reference ray.init('ray://...'))."""
     from ray_tpu.core import global_state
 

@@ -7,10 +7,52 @@ doesn't starve others on the same connection.
 """
 from __future__ import annotations
 
+import os
+import secrets
 import threading
 from typing import Optional
 
+# legacy well-known key: acceptable only on loopback (anyone reaching the port
+# speaks a pickle protocol with driver-level privileges, so a fixed key on a
+# routable interface is remote code execution for the whole network)
 DEFAULT_AUTHKEY = b"ray-tpu-client"
+
+_LOOPBACK_HOSTS = frozenset({"127.0.0.1", "localhost", "::1"})
+
+
+def _authkey_file() -> str:
+    from ray_tpu.job.manager import default_session_dir
+
+    return os.path.join(default_session_dir(), "client_authkey")
+
+
+def _persist_authkey(key: bytes) -> None:
+    """Write the cluster authkey to the session dir (mode 0600) so same-host
+    clients and `ray-tpu` CLI tooling pick it up; always written, so a restart
+    with a different (e.g. explicit) key never leaves a stale file behind."""
+    path = _authkey_file()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(key)
+
+
+def generate_authkey() -> bytes:
+    key = secrets.token_hex(32).encode()
+    _persist_authkey(key)
+    return key
+
+
+def load_authkey() -> Optional[bytes]:
+    """Resolve the cluster authkey: RAY_TPU_CLIENT_AUTHKEY env, then session dir."""
+    env = os.environ.get("RAY_TPU_CLIENT_AUTHKEY")
+    if env:
+        return env.encode()
+    try:
+        with open(_authkey_file(), "rb") as f:
+            return f.read().strip()
+    except OSError:
+        return None
 
 # methods whose replies carry NEW ObjectRefs with ownership transferring to the
 # client; replies from other methods (get/wait/...) contain only borrows and
@@ -44,9 +86,20 @@ def set_ref_ownership(value, owned: bool) -> list:
 
 class ClientServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 10001,
-                 authkey: bytes = DEFAULT_AUTHKEY):
+                 authkey: Optional[bytes] = None):
         from multiprocessing.connection import Listener
 
+        if authkey is None:
+            authkey = generate_authkey()
+        else:
+            if authkey == DEFAULT_AUTHKEY and host not in _LOOPBACK_HOSTS:
+                raise ValueError(
+                    f"refusing to bind the client server on {host!r} with the default "
+                    "authkey: the wire protocol is pickle (driver-level code execution). "
+                    "Omit authkey to generate a per-cluster random key (written to the "
+                    "session dir; share via RAY_TPU_CLIENT_AUTHKEY on remote drivers).")
+            _persist_authkey(authkey)  # keep session-dir discovery in sync
+        self.authkey = authkey
         self._listener = Listener((host, port), authkey=authkey)  # port 0 = ephemeral
         self.address = self._listener.address
         self.port = self.address[1]
@@ -167,7 +220,7 @@ _server: Optional[ClientServer] = None
 
 
 def start_client_server(host: str = "127.0.0.1", port: int = 10001,
-                        authkey: bytes = DEFAULT_AUTHKEY) -> ClientServer:
+                        authkey: Optional[bytes] = None) -> ClientServer:
     """Start (or return) the head-side client server (driver process)."""
     global _server
     if _server is None:

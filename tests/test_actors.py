@@ -176,3 +176,56 @@ def test_worker_crash_retry(rt):
 
     key = str(time.time()).replace(".", "")
     assert rt.get(crash_once.remote(key), timeout=60) == "survived"
+
+
+def test_concurrency_groups(rt):
+    """Named concurrency groups (reference concurrency_group_manager.h): parked
+    calls in one group must not starve methods on the default pool."""
+    import threading
+
+    @rt.remote(max_concurrency=2, concurrency_groups={"listen": 0})
+    class Host:
+        def __init__(self):
+            self.ev = threading.Event()
+
+        @rt.method(concurrency_group="listen")
+        def park(self):
+            self.ev.wait(30)
+            return "woke"
+
+        def ping(self):
+            return "pong"
+
+        def wake(self):
+            self.ev.set()
+            return True
+
+    h = Host.remote()
+    # park more listeners than max_concurrency: default-pool RPCs must still run
+    parked = [h.park.remote() for _ in range(6)]
+    assert rt.get(h.ping.remote(), timeout=10) == "pong"
+    assert rt.get(h.wake.remote(), timeout=10) is True
+    assert rt.get(parked, timeout=30) == ["woke"] * 6
+
+
+def test_concurrency_group_call_time_override(rt):
+    import threading
+
+    @rt.remote(max_concurrency=1, concurrency_groups={"io": 1})
+    class A:
+        def __init__(self):
+            self.ev = threading.Event()
+
+        def block(self):
+            self.ev.wait(30)
+            return 1
+
+        def unblock(self):
+            self.ev.set()
+            return 2
+
+    a = A.remote()
+    blocked = a.block.remote()  # occupies the single default thread
+    # route around it via the io group at call time
+    assert rt.get(a.unblock.options(concurrency_group="io").remote(), timeout=10) == 2
+    assert rt.get(blocked, timeout=10) == 1

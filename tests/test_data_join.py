@@ -86,3 +86,15 @@ def test_null_keys_never_match():
     full = left.join(right, on="id", how="full_outer").take_all()
     # null-keyed rows appear null-extended on each side, never matched
     assert len(full) == 3
+
+
+def test_join_rename_collision_uniquified():
+    """Left already has v and v_1; right's v must rename to v_2, not silently
+    drop a column via a duplicate dict key."""
+    left = rtd.from_items([{"k": 1, "v": 10, "v_1": 11}])
+    right = rtd.from_items([{"k": 1, "v": 20}])
+    out = left.join(right, on="k").take_all()
+    assert len(out) == 1
+    row = out[0]
+    assert set(row.keys()) == {"k", "v", "v_1", "v_2"}
+    assert row["v"] == 10 and row["v_1"] == 11 and row["v_2"] == 20

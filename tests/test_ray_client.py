@@ -152,3 +152,25 @@ def test_cli_list_requires_cluster_or_address():
         capture_output=True, text=True, timeout=60)
     assert proc.returncode == 1
     assert "no cluster" in proc.stdout
+
+
+def test_default_authkey_refused_on_public_host():
+    """Binding a routable interface with the well-known key is driver-level RCE
+    for the whole network: the server must refuse (random keys only)."""
+    from ray_tpu.util.client.server import DEFAULT_AUTHKEY, ClientServer
+
+    with pytest.raises(ValueError, match="default"):
+        ClientServer("0.0.0.0", 0, authkey=DEFAULT_AUTHKEY)
+
+
+def test_random_authkey_persisted_and_loaded(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    monkeypatch.delenv("RAY_TPU_CLIENT_AUTHKEY", raising=False)
+    from ray_tpu.util.client.server import ClientServer, load_authkey
+
+    srv = ClientServer("127.0.0.1", 0)  # no key passed -> generated
+    try:
+        assert srv.authkey and srv.authkey != b"ray-tpu-client"
+        assert load_authkey() == srv.authkey
+    finally:
+        srv.close()
