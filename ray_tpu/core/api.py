@@ -28,6 +28,8 @@ def init(
     address: Optional[str] = None,
     client_server_port: Optional[int] = None,
     client_server_host: str = "127.0.0.1",  # "0.0.0.0" to accept remote drivers
+    node_server_port: Optional[int] = None,  # accept node agents (multi-host head)
+    node_server_host: str = "127.0.0.1",
     worker_env: Optional[Dict[str, str]] = None,
     max_workers_per_node: Optional[int] = None,
     object_store_memory: Optional[int] = None,
@@ -78,6 +80,10 @@ def init(
     cluster = Cluster(total, worker_env=worker_env, **kwargs)
     global_state.set_cluster(cluster)
     global_state.set_worker(DriverContext(cluster))
+    if node_server_port is not None:
+        # this process becomes a multi-host head: remote hosts join with
+        # `ray-tpu start --address=<host>:<port>` (core/node_agent.py)
+        cluster.start_node_server(host=node_server_host, port=node_server_port)
     if client_server_port is not None:
         from ray_tpu.util.client.server import start_client_server
 

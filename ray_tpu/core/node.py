@@ -103,6 +103,9 @@ class NodeRuntime:
         self.idle: Dict[str, List[WorkerHandle]] = {}
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self.alive = True
+        # which host this node's workers (and their object storage) live on:
+        # "local" = the head process's host; remote nodes use their agent's key
+        self.host_key = "local"
 
     def num_workers(self) -> int:
         return len(self.workers)
@@ -137,6 +140,133 @@ class NodeRuntime:
         w = WorkerHandle(worker_id, proc, parent_conn, self, accel)
         self.workers[worker_id] = w
         self.cluster._register_conn(w)
+        return w
+
+
+class _RemoteProc:
+    """Stand-in for a remote worker's Process handle: liveness is what the agent
+    reports; terminate() asks the agent to kill the OS process."""
+
+    def __init__(self, agent: "AgentHandle", wid_hex: str):
+        self._agent = agent
+        self._wid_hex = wid_hex
+        self.dead = False
+
+    def is_alive(self) -> bool:
+        return not self.dead and self._agent.alive
+
+    def terminate(self) -> None:
+        self.dead = True
+        try:
+            self._agent.send(("kill_worker", self._wid_hex))
+        except Exception:
+            pass
+
+    kill = terminate
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        pass  # the agent reaps its own children
+
+
+class RemoteWorkerHandle(WorkerHandle):
+    """A worker process living on a remote host, reached through its node agent.
+
+    Same state machine as WorkerHandle; send() relays the already-pickled worker
+    message through the agent's TCP connection (reference analog: CoreWorker
+    task push over gRPC to a worker on another node)."""
+
+    def __init__(self, worker_id: WorkerID, agent: "AgentHandle",
+                 node: "NodeRuntime", accel: str):
+        super().__init__(worker_id, _RemoteProc(agent, worker_id.hex()), None, node, accel)
+        self.agent = agent
+
+    def send(self, msg) -> None:
+        # the agent handle's own lock serializes the socket write
+        self.agent.send(("to_worker", self.worker_id.hex(), cloudpickle.dumps(msg)))
+
+
+class AgentHandle:
+    """Head-side view of one connected node agent (reference: a registered
+    raylet in GcsNodeManager, gcs_node_manager.h:49)."""
+
+    def __init__(self, cluster: "Cluster", conn, node: "NodeRuntime"):
+        self.cluster = cluster
+        self.conn = conn
+        self.node = node
+        self.host_key = node.node_id.hex()
+        self.alive = True
+        self.last_heartbeat = time.time()
+        self.workers: Dict[str, RemoteWorkerHandle] = {}  # wid_hex -> handle
+        self._send_lock = threading.Lock()
+        self._req_counter = itertools.count()
+        self._pending: Dict[int, list] = {}  # req_id -> [Event, ok, value]
+        self._pending_lock = threading.Lock()
+
+    def send(self, msg) -> None:
+        if not self.alive:
+            raise OSError(f"node agent {self.host_key[:8]} is dead")
+        with self._send_lock:
+            self.conn.send_bytes(cloudpickle.dumps(msg))
+
+    def call(self, op: str, *args, timeout: float = 60.0):
+        """Blocking RPC to the agent (object fetch/store); replies are matched
+        by the router thread — never call from the router thread itself."""
+        req_id = next(self._req_counter)
+        slot = [threading.Event(), False, None]
+        with self._pending_lock:
+            if not self.alive:
+                raise OSError(f"node agent {self.host_key[:8]} is dead")
+            self._pending[req_id] = slot
+        try:
+            self.send(("req", req_id, op, args))
+        except Exception:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise
+        if not slot[0].wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(f"agent {self.host_key[:8]} {op} timed out")
+        if not slot[1]:
+            raise slot[2]
+        return slot[2]
+
+    def on_reply(self, req_id: int, ok: bool, value) -> None:
+        with self._pending_lock:
+            slot = self._pending.pop(req_id, None)
+        if slot is not None:
+            slot[1], slot[2] = ok, value
+            slot[0].set()
+
+    def fail_all_pending(self, reason: str) -> None:
+        with self._pending_lock:
+            self.alive = False
+            pending, self._pending = self._pending, {}
+        for slot in pending.values():
+            slot[1], slot[2] = False, OSError(reason)
+            slot[0].set()
+
+
+class RemoteNodeRuntime(NodeRuntime):
+    """A node whose worker pool lives on another host, managed by its agent."""
+
+    def __init__(self, cluster: "Cluster", node_id: NodeID, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]], max_workers: int):
+        super().__init__(cluster, node_id, resources, labels, max_workers)
+        self.agent: Optional[AgentHandle] = None  # set right after construction
+        self.host_key = node_id.hex()
+
+    def spawn_worker(self, accel: str) -> Optional[WorkerHandle]:
+        if len(self.workers) >= self.max_workers or not self.agent.alive:
+            return None
+        worker_id = WorkerID.generate()
+        w = RemoteWorkerHandle(worker_id, self.agent, self, accel)
+        try:
+            self.agent.send(("spawn_worker", worker_id.hex(), accel))
+        except Exception:
+            return None
+        self.workers[worker_id] = w
+        self.agent.workers[worker_id.hex()] = w
         return w
 
 
@@ -206,6 +336,16 @@ class Cluster:
         self._conns: Dict[Any, WorkerHandle] = {}
         self._wakeup_r, self._wakeup_w = _mp.Pipe(duplex=False)
         self._shutdown = False
+        # multi-host plane (reference: GcsNodeManager + ObjectManager):
+        self._agent_conns: Dict[Any, AgentHandle] = {}   # agent TCP conn -> handle
+        self._agents_by_key: Dict[str, AgentHandle] = {}  # node_id hex -> handle
+        self._node_listener = None
+        self.node_server_port: Optional[int] = None
+        # cross-host replica directory: (oid, host_key) -> local (unwrapped) loc
+        self._replicas: Dict[Tuple[ObjectID, str], Tuple] = {}
+        self._transfers: Dict[Tuple[ObjectID, str], threading.Event] = {}
+        self._transfer_lock = threading.Lock()
+        self._localizing: set = set()  # task_ids with an in-flight arg pull
         # lineage for reconstruction: return oid -> creating TaskSpec while the
         # object is in scope and the task is retryable (reference
         # object_recovery_manager.h:43 + task_manager lineage pinning)
@@ -227,6 +367,7 @@ class Cluster:
             os.environ.get("RAY_TPU_MEMORY_MONITOR_REFRESH_MS", 250))
         self._memory_sampler = _system_memory_fraction  # test seam
         self.num_oom_kills = 0
+        self.store.on_remote_free = self._on_remote_free
         self._router_thread = threading.Thread(target=self._router, daemon=True, name="rt-router")
         self.head_node = self.add_node(resources)
         self._router_thread.start()
@@ -266,6 +407,266 @@ class Cluster:
         with self._lock:
             return [self._nodes[nid] for nid in self._node_order if self._nodes[nid].alive]
 
+    # -- multi-host: node server + agents ----------------------------------------------
+    def start_node_server(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Listen for node agents joining over TCP (reference: GCS server accepting
+        raylet registrations, gcs_node_manager.h:49). Returns the bound port.
+        Auth: the per-cluster session authkey (same trust domain as the head)."""
+        from multiprocessing.connection import Listener
+
+        from ray_tpu.util.client.server import generate_authkey, load_authkey
+
+        if self._node_listener is not None:
+            return self.node_server_port
+        authkey = load_authkey() or generate_authkey()
+        self._node_listener = Listener((host, port), authkey=authkey)
+        self.node_server_port = self._node_listener.address[1]
+        threading.Thread(target=self._accept_agents, daemon=True,
+                         name="rt-node-server").start()
+        return self.node_server_port
+
+    def _accept_agents(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._node_listener.accept()
+            except (OSError, EOFError):
+                return
+            threading.Thread(target=self._register_agent, args=(conn,),
+                             daemon=True, name="rt-agent-register").start()
+
+    def _register_agent(self, conn) -> None:
+        try:
+            kind, resources, labels, max_workers = cloudpickle.loads(conn.recv_bytes())
+            assert kind == "register", kind
+        except Exception:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            return
+        node_id = NodeID.generate()
+        node = RemoteNodeRuntime(self, node_id, resources, labels, max_workers)
+        agent = AgentHandle(self, conn, node)
+        node.agent = agent
+        welcome = {
+            "node_id": node_id.hex(),
+            "worker_env": dict(self.worker_env),
+            "object_store_memory": self._object_store_capacity,
+        }
+        try:
+            conn.send_bytes(cloudpickle.dumps(("welcome", welcome)))
+        except Exception:
+            return
+        with self._lock:
+            self._nodes[node_id] = node
+            self._node_order.append(node_id)
+            self._agent_conns[conn] = agent
+            self._agents_by_key[agent.host_key] = agent
+        self.gcs.register_node(NodeInfo(node_id=node_id, resources=dict(resources),
+                                        labels={**(labels or {}), "agent": "remote"}))
+        try:
+            self._wakeup_w.send_bytes(b"x")  # router picks up the new conn
+        except Exception:
+            pass
+        self._schedule()
+
+    def _handle_agent_message(self, agent: AgentHandle, msg: Tuple) -> None:
+        kind = msg[0]
+        if kind == "from_worker":
+            _, wid_hex, raw = msg
+            w = agent.workers.get(wid_hex)
+            if w is None:
+                return
+            self._handle_message(w, cloudpickle.loads(raw))
+        elif kind == "worker_death":
+            w = agent.workers.pop(msg[1], None)
+            if w is not None:
+                w.process.dead = True
+                self._on_worker_death(w)
+        elif kind == "heartbeat":
+            agent.last_heartbeat = time.time()
+        elif kind == "reply":
+            agent.on_reply(msg[1], msg[2], msg[3])
+
+    def _on_agent_death(self, agent: AgentHandle) -> None:
+        """A node agent's connection dropped: fail its workers, drop its objects
+        (promoting replicas / reconstructing from lineage), remove the node
+        (reference: GcsNodeManager node-death path + ObjectRecoveryManager)."""
+        with self._lock:
+            if not agent.alive and agent.conn not in self._agent_conns:
+                return
+            self._agent_conns.pop(agent.conn, None)
+            self._agents_by_key.pop(agent.host_key, None)
+            workers = list(agent.workers.values())
+            agent.workers.clear()
+        agent.fail_all_pending(f"node agent {agent.host_key[:8]} died")
+        err = WorkerCrashedError(f"node {agent.host_key[:8]} died")
+        for w in workers:
+            w.process.dead = True
+            self._on_worker_death(w, err)
+        self._drop_host_objects(agent.host_key)
+        with self._lock:
+            node = self._nodes.get(agent.node.node_id)
+            if node is not None:
+                node.alive = False
+        self.gcs.remove_node(agent.node.node_id)
+        self._schedule()
+
+    def _drop_host_objects(self, host_key: str) -> None:
+        """Objects whose primary location lived on a dead host: promote a replica
+        from a live host if one exists, else reconstruct from lineage, else fail."""
+        with self.store._lock:
+            dead = [(oid, loc) for oid, loc in self.store._locations.items()
+                    if loc[0] == "remote" and loc[1] == host_key]
+        with self._transfer_lock:
+            for (oid, host), _ in list(self._replicas.items()):
+                if host == host_key:
+                    self._replicas.pop((oid, host), None)
+        for oid, loc in dead:
+            promoted = None
+            with self._transfer_lock:
+                for (o, host), rloc in self._replicas.items():
+                    if o == oid and (host == "local" or host in self._agents_by_key):
+                        promoted = rloc if host == "local" else ("remote", host, rloc)
+                        break
+            if promoted is not None:
+                self.store.add(oid, promoted)
+                continue
+            self.store.drop_location(oid)
+            if oid in self.lineage:
+                # eager reconstruction: location() waiters block until the
+                # resubmitted task re-adds a live location
+                threading.Thread(target=self._recover_safely, args=(oid,),
+                                 daemon=True, name="rt-recover").start()
+            else:
+                self.store.mark_failed(oid, object_store.ObjectLost(
+                    f"object {oid.hex()[:12]} was lost with node {host_key[:8]} "
+                    "and has no lineage to reconstruct"))
+
+    def _recover_safely(self, oid: ObjectID) -> None:
+        try:
+            self._recover_object(oid)
+        except Exception as e:  # noqa: BLE001
+            self.store.mark_failed(oid, e if isinstance(e, object_store.ObjectLost)
+                                   else object_store.ObjectLost(str(e)))
+
+    def _on_remote_free(self, loc) -> None:
+        """store._free hook for ("remote", host, inner) primaries."""
+        agent = self._agents_by_key.get(loc[1])
+        if agent is not None:
+            try:
+                agent.send(("free_object", loc[2]))
+            except Exception:
+                pass
+
+    # -- cross-host object localization (reference object_manager.h:119) ---------------
+    @staticmethod
+    def _loc_host(loc) -> str:
+        return loc[1] if loc[0] == "remote" else "local"
+
+    @staticmethod
+    def _worker_host(w: Optional[WorkerHandle]) -> str:
+        return w.node.host_key if w is not None else "local"
+
+    def _wrap_loc(self, w: WorkerHandle, loc) -> Tuple:
+        """Locations registered by a remote host's worker are tagged with that
+        host so the directory knows where the bytes physically live."""
+        if loc[0] == "inline" or not isinstance(w, RemoteWorkerHandle):
+            return loc
+        return ("remote", w.node.host_key, loc)
+
+    def _localize(self, oid: ObjectID, dest_host: str, timeout: Optional[float] = None):
+        """Return a location readable on dest_host, transferring bytes if the
+        object lives elsewhere (head-mediated fetch/store; reference PullManager
+        + ObjectManager push). Concurrent requests for the same (oid, host)
+        dedup onto one transfer. A fetch from a dead host drops the stale
+        primary and reconstructs from lineage before retrying (reference
+        ObjectRecoveryManager)."""
+        last_err: Optional[BaseException] = None
+        for _ in range(3):
+            loc = self.store.location(oid, timeout)
+            if loc[0] == "inline" or self._loc_host(loc) == dest_host:
+                return loc[2] if loc[0] == "remote" else loc
+            try:
+                return self._transfer_dedup(oid, loc, dest_host)
+            except object_store.ObjectLost as e:
+                last_err = e
+                # the primary's host died under us: forget it (CAS — a parallel
+                # recovery may already have re-added a fresh one) and reconstruct
+                with self.store._lock:
+                    if self.store._locations.get(oid) == loc:
+                        self.store._locations.pop(oid)
+                self._recover_object(oid)  # raises ObjectLost when no lineage
+        raise last_err
+
+    def _localize_many(self, oids: List[ObjectID], dest_host: str,
+                       timeout: Optional[float] = None) -> List:
+        """_localize for a batch, overlapping the cross-host transfers."""
+        locs = [self.store.location(oid, timeout) for oid in oids]
+        needs = [oid for oid, loc in zip(oids, locs)
+                 if loc[0] == "remote" and loc[1] != dest_host]
+        if len(needs) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # warm the replica cache concurrently; the serial pass below then
+            # returns each replica instantly
+            with ThreadPoolExecutor(max_workers=min(8, len(needs))) as ex:
+                list(ex.map(lambda o: self._localize(o, dest_host, timeout), needs))
+        return [self._localize(oid, dest_host, timeout) for oid in oids]
+
+    def _transfer_dedup(self, oid: ObjectID, loc, dest_host: str):
+        while True:
+            with self._transfer_lock:
+                replica = self._replicas.get((oid, dest_host))
+                if replica is not None:
+                    return replica
+                ev = self._transfers.get((oid, dest_host))
+                mine = ev is None
+                if mine:
+                    ev = threading.Event()
+                    self._transfers[(oid, dest_host)] = ev
+            if not mine:
+                if not ev.wait(timeout=120.0):
+                    raise TimeoutError(
+                        f"transfer of {oid.hex()[:12]} to {dest_host[:8]} timed out")
+                continue  # re-check: winner registered a replica, or failed and we retry
+            try:
+                new_loc = self._do_transfer(oid, loc, dest_host)
+            except BaseException:
+                with self._transfer_lock:
+                    self._transfers.pop((oid, dest_host), None)
+                ev.set()
+                raise
+            with self._transfer_lock:
+                self._replicas[(oid, dest_host)] = new_loc
+                self._transfers.pop((oid, dest_host), None)
+            ev.set()
+            return new_loc
+
+    def _do_transfer(self, oid: ObjectID, loc, dest_host: str):
+        src_host = self._loc_host(loc)
+        if src_host == "local":
+            data, is_error = object_store.read_raw(loc)
+        else:
+            src_agent = self._agents_by_key.get(src_host)
+            if src_agent is None:
+                raise object_store.ObjectLost(
+                    f"object {oid.hex()[:12]} lives on dead node {src_host[:8]}")
+            try:
+                data, is_error = src_agent.call("fetch_object", loc[2])
+            except (OSError, EOFError, TimeoutError) as e:
+                # fetch-side failure == the bytes are unreachable: let the
+                # caller's recovery path reconstruct from lineage
+                raise object_store.ObjectLost(
+                    f"fetching {oid.hex()[:12]} from node {src_host[:8]} "
+                    f"failed: {e}") from e
+        if dest_host == "local":
+            return object_store.write_raw(data, oid, is_error)
+        dest_agent = self._agents_by_key.get(dest_host)
+        if dest_agent is None:
+            raise OSError(f"destination node {dest_host[:8]} is gone")
+        return dest_agent.call("store_object", oid, data, is_error)
+
     # -- router (multiplexes all worker pipes) ----------------------------------------
     def _register_conn(self, w: WorkerHandle) -> None:
         with self._lock:
@@ -278,7 +679,7 @@ class Cluster:
     def _router(self) -> None:
         while not self._shutdown:
             with self._lock:
-                conns = list(self._conns.keys())
+                conns = list(self._conns.keys()) + list(self._agent_conns.keys())
             ready = multiprocessing.connection.wait([self._wakeup_r] + conns, timeout=1.0)
             for conn in ready:
                 if conn is self._wakeup_r:
@@ -286,6 +687,21 @@ class Cluster:
                         self._wakeup_r.recv_bytes()
                     except Exception:
                         pass
+                    continue
+                with self._lock:
+                    agent = self._agent_conns.get(conn)
+                if agent is not None:
+                    try:
+                        raw = conn.recv_bytes()
+                    except (EOFError, OSError):
+                        self._on_agent_death(agent)
+                        continue
+                    try:
+                        self._handle_agent_message(agent, cloudpickle.loads(raw))
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
                     continue
                 with self._lock:
                     w = self._conns.get(conn)
@@ -317,7 +733,9 @@ class Cluster:
             self.submit(msg[1])
         elif kind == "get":
             _, req_id, oids, timeout = msg
-            self._async_reply(w, req_id, lambda: [self.store.location(oid, timeout) for oid in oids],
+            host = self._worker_host(w)
+            self._async_reply(w, req_id,
+                              lambda: self._localize_many(oids, host, timeout),
                               blocking=True)
         elif kind == "wait":
             _, req_id, oids, num_returns, timeout = msg
@@ -325,14 +743,18 @@ class Cluster:
                               blocking=True)
         elif kind == "put":
             _, oid, loc = msg
-            self.store.add(oid, loc)
+            self.store.add(oid, self._wrap_loc(w, loc))
             self.store.incref(oid)
             self._schedule()
         elif kind == "decref":
             self.store.decref(msg[1])
         elif kind == "recover":
             _, req_id, oid = msg
-            self._async_reply(w, req_id, lambda: self._recover_object(oid), blocking=True)
+            host = self._worker_host(w)
+            self._async_reply(
+                w, req_id,
+                lambda: (self._recover_object(oid), self._localize(oid, host, 60.0))[1],
+                blocking=True)
         elif kind == "state":
             _, req_id, fn_name, fargs, fkwargs = msg
 
@@ -510,7 +932,8 @@ class Cluster:
             while self.pending:
                 spec = self.pending.popleft()
                 ts = self.tasks.get(spec.task_id)
-                if ts is not None and ts.cancelled:
+                if ts is None or ts.cancelled:
+                    # terminal (failed during arg localization) or cancelled
                     continue
                 if not self._try_dispatch(spec):
                     remaining.append(spec)
@@ -545,6 +968,10 @@ class Cluster:
         if placement is None:
             return False
         node, ledger, resources = placement
+        locs = self._localize_args_or_defer(spec, locs, node.host_key)
+        if locs is None:
+            ledger.release(resources)
+            return False  # transfer in flight; rescheduled when it lands
         accel = "tpu" if resources.get("TPU", 0) > 0 else "cpu"
         worker = node.pop_idle(accel)
         if worker is None:
@@ -589,12 +1016,58 @@ class Cluster:
             return True
         if status == "pending":
             return False
+        locs = self._localize_args_or_defer(spec, locs, st.worker.node.host_key)
+        if locs is None:
+            return False  # transfer in flight; rescheduled when it lands
         self._send_task(st.worker, spec, locs)
         ts = self.tasks.get(spec.task_id)
         if ts is None:
             return True  # send failed; returns were failed, actor stays pinned
         ts.worker = st.worker
         return True
+
+    def _localize_args_or_defer(self, spec: TaskSpec, locs: List, host: str) -> Optional[List]:
+        """Host-local locations for every arg, or None after kicking off the
+        needed transfers in the background (the scheduler must never block on a
+        cross-host copy — reference: DependencyManager pulls args asynchronously
+        before a lease is granted, raylet/dependency_manager.h)."""
+        out = []
+        missing = []
+        for oid, loc in zip(spec.arg_refs, locs):
+            if loc[0] == "inline" or self._loc_host(loc) == host:
+                out.append(loc[2] if loc[0] == "remote" else loc)
+                continue
+            with self._transfer_lock:
+                replica = self._replicas.get((oid, host))
+            if replica is not None:
+                out.append(replica)
+            else:
+                missing.append(oid)
+        if not missing:
+            return out
+        if spec.task_id not in self._localizing:
+            self._localizing.add(spec.task_id)
+
+            def pull(missing=missing, spec=spec, host=host):
+                try:
+                    if len(missing) == 1:
+                        self._localize(missing[0], host, timeout=120.0)
+                    else:
+                        from concurrent.futures import ThreadPoolExecutor
+
+                        with ThreadPoolExecutor(max_workers=min(8, len(missing))) as ex:
+                            list(ex.map(
+                                lambda oid: self._localize(oid, host, timeout=120.0),
+                                missing))
+                except BaseException as e:  # noqa: BLE001
+                    self._fail_returns(spec, e if isinstance(e, Exception)
+                                       else RuntimeError(str(e)))
+                finally:
+                    self._localizing.discard(spec.task_id)
+                    self._schedule()
+
+            threading.Thread(target=pull, daemon=True, name="rt-arg-pull").start()
+        return None
 
     def _send_task(self, worker: WorkerHandle, spec: TaskSpec, locs: List) -> None:
         if spec.fn_id in worker.known_fns:
@@ -667,6 +1140,7 @@ class Cluster:
 
     # -- results & failure -------------------------------------------------------------
     def _on_result(self, w: WorkerHandle, task_id: TaskID, payload, err_info) -> None:
+        payload = [(oid, self._wrap_loc(w, loc)) for oid, loc in payload]
         with self._lock:
             ts = self.tasks.get(task_id)
             if w.inflight and w.inflight[0] == task_id:
@@ -686,20 +1160,10 @@ class Cluster:
         )
         if retry:
             for oid, loc in payload:
-                if loc[0] == "arena":
-                    try:
-                        object_store._open_arena(loc[1]).delete(loc[2])
-                    except Exception:
-                        pass
-                elif loc[0] == "shm":
-                    try:
-                        from multiprocessing import shared_memory
-
-                        seg = shared_memory.SharedMemory(name=loc[1])
-                        seg.close()
-                        seg.unlink()
-                    except Exception:
-                        pass
+                if loc[0] == "remote":
+                    self._on_remote_free(loc)
+                else:
+                    object_store.free_local(loc)
             spec.attempt += 1
             with self._lock:
                 self.pending.append(spec)
@@ -765,6 +1229,26 @@ class Cluster:
                 self._check_memory_pressure()
             except Exception:
                 pass
+            try:
+                self._check_agent_health()
+            except Exception:
+                pass
+
+    def _check_agent_health(self) -> None:
+        """Heartbeat-based agent failure detection (reference
+        GcsHealthCheckManager, gcs_health_check_manager.h:45). Connection EOF is
+        the fast path; this catches hosts that hang without closing the socket."""
+        timeout = float(os.environ.get("RAY_TPU_AGENT_HEARTBEAT_TIMEOUT_S", "10"))
+        now = time.time()
+        with self._lock:
+            stale = [a for a in self._agent_conns.values()
+                     if now - a.last_heartbeat > timeout]
+        for agent in stale:
+            try:
+                agent.conn.close()  # router sees EOF and runs _on_agent_death
+            except Exception:
+                pass
+            self._on_agent_death(agent)
 
     def _check_spill(self) -> None:
         """Spill LRU objects to disk when shared memory passes the high watermark
@@ -809,11 +1293,25 @@ class Cluster:
 
     # -- lineage reconstruction --------------------------------------------------------
     def _on_object_freed(self, oid: ObjectID) -> None:
-        """Drop the lineage entry and release its argument pins."""
+        """Drop the lineage entry, release its argument pins, free replicas."""
         spec = self.lineage.pop(oid, None)
         if spec is not None:
             for arg in spec.arg_refs:
                 self.store.decref(arg)
+        with self._transfer_lock:
+            replicas = [(host, self._replicas.pop((o, host)))
+                        for (o, host) in list(self._replicas)
+                        if o == oid]
+        for host, loc in replicas:
+            if host == "local":
+                object_store.free_local(loc)
+            else:
+                agent = self._agents_by_key.get(host)
+                if agent is not None:
+                    try:
+                        agent.send(("free_object", loc))
+                    except Exception:
+                        pass
 
     def _recover_object(self, oid: ObjectID):
         """Return a (possibly re-created) location for oid. If the stored location
@@ -854,9 +1352,11 @@ class Cluster:
                 with self._lock:
                     self._recovering.difference_update(spec.return_ids)
 
-    @staticmethod
-    def _location_alive(loc) -> bool:
+    def _location_alive(self, loc) -> bool:
         kind = loc[0]
+        if kind == "remote":
+            agent = self._agents_by_key.get(loc[1])
+            return agent is not None and agent.alive
         try:
             if kind == "arena":
                 arena = object_store._open_arena(loc[1])
@@ -906,15 +1406,34 @@ class Cluster:
         out["driver"] = _format_thread_stacks()
         return out
 
-    def _gc_arena_after_death(self) -> None:
+    def _gc_arena_after_death(self, w: Optional[WorkerHandle] = None) -> None:
         """Reclaim arena space from a dead worker: unsealed half-writes and sealed
         outputs whose result message never reached us (reference analog: plasma
-        disconnect cleanup + ObjectLifecycleManager)."""
+        disconnect cleanup + ObjectLifecycleManager). For a remote worker the GC
+        runs on its host's agent against that host's arena."""
+        host = self._worker_host(w)
+        with self.store._lock:
+            keep = [oid.binary() for oid, loc in self.store._locations.items()
+                    if self._loc_host(loc) == host]
+        with self._transfer_lock:
+            keep += [oid.binary() for (oid, h) in self._replicas if h == host]
+
+        if host != "local":
+            agent = self._agents_by_key.get(host)
+            if agent is None or not agent.alive:
+                return
+
+            def gc_remote():
+                try:
+                    agent.call("gc_dead_owners", keep, timeout=30.0)
+                except Exception:
+                    pass
+
+            threading.Thread(target=gc_remote, daemon=True, name="arena-gc").start()
+            return
         arena = object_store._default_arena()
         if arena is None:
             return
-        with self.store._lock:
-            keep = [oid.binary() for oid in self.store._locations]
 
         def gc():
             try:
@@ -962,6 +1481,8 @@ class Cluster:
                     st.state = "restarting"
                     st.worker = None
             self._conns.pop(w.conn, None)
+            if isinstance(w, RemoteWorkerHandle):
+                w.agent.workers.pop(w.worker_id.hex(), None)
             w.node.workers.pop(w.worker_id, None)
             pool = w.node.idle.get(w.accel)
             if pool and w in pool:
@@ -972,7 +1493,7 @@ class Cluster:
                 (w.bundle_ledger or w.node.ledger).release(w.resources_held)
                 w.resources_held = {}
             self.metrics_by_worker.pop(w.worker_id, None)
-        self._gc_arena_after_death()
+        self._gc_arena_after_death(w)
         if err is None:
             err = WorkerCrashedError(f"worker {w.worker_id.hex()[:8]} died unexpectedly")
         for task_id in inflight:
@@ -1101,6 +1622,19 @@ class Cluster:
     def shutdown(self) -> None:
         self._shutdown = True
         with self._lock:
+            agents = list(self._agent_conns.values())
+        for a in agents:
+            try:
+                a.send(("shutdown",))
+            except Exception:
+                pass
+            a.fail_all_pending("cluster shutting down")
+        if self._node_listener is not None:
+            try:
+                self._node_listener.close()
+            except Exception:
+                pass
+        with self._lock:
             workers = [w for n in self._nodes.values() for w in list(n.workers.values())]
         for w in workers:
             try:
@@ -1113,6 +1647,11 @@ class Cluster:
             w.process.join(timeout=t)
             if w.process.is_alive():
                 w.process.terminate()
+        for a in agents:
+            try:
+                a.conn.close()
+            except Exception:
+                pass
         try:
             self._wakeup_w.send_bytes(b"x")
         except Exception:
@@ -1150,15 +1689,40 @@ class DriverContext:
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
+
+        def remaining():
+            return None if deadline is None else max(0.0, deadline - time.monotonic())
+
+        # Wait for readiness sequentially (later objects are usually ready by the
+        # time earlier waits finish), but pull remote-hosted bytes CONCURRENTLY —
+        # N serial head-mediated transfers would cost N round-trips (reference
+        # PullManager overlaps pulls the same way).
+        locs: Dict[ObjectID, Tuple] = {}
+        needs: List[ObjectRef] = []
+        for r in ref_list:
+            loc = self.cluster.store.location(r.id, remaining())
+            if loc[0] == "remote":
+                needs.append(r)
+            else:
+                locs[r.id] = loc
+        if len(needs) == 1:
+            locs[needs[0].id] = self.cluster._localize(needs[0].id, "local", remaining())
+        elif needs:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(needs))) as ex:
+                fetched = list(ex.map(
+                    lambda r: self.cluster._localize(r.id, "local", remaining()), needs))
+            for r, loc in zip(needs, fetched):
+                locs[r.id] = loc
         values = []
         for r in ref_list:
-            t = None if deadline is None else max(0.0, deadline - time.monotonic())
-            loc = self.cluster.store.location(r.id, t)
             try:
-                values.append(object_store.resolve(loc, oid=r.id))
+                values.append(object_store.resolve(locs[r.id], oid=r.id))
             except object_store.ObjectLost:
                 # lineage reconstruction (reference ObjectRecoveryManager)
-                loc = self.cluster._recover_object(r.id)
+                self.cluster._recover_object(r.id)
+                loc = self.cluster._localize(r.id, "local", 60.0)
                 values.append(object_store.resolve(loc, oid=r.id))
         return values[0] if single else values
 

@@ -1,0 +1,306 @@
+"""Per-host node agent: joins a remote head over TCP and hosts workers locally.
+
+Capability parity: reference raylet daemon (src/ray/raylet/node_manager.h:124 —
+worker pool + local object management on each host, registered with the GCS,
+src/ray/gcs/gcs_server/gcs_node_manager.h:49). The head (core/node.py Cluster)
+keeps all scheduling/ownership state; this agent is deliberately thin:
+
+- registers its resources with the head and heartbeats;
+- spawns/kills local worker processes on request, relaying every worker pipe
+  message to/from the head verbatim (workers are unchanged — their pipe simply
+  terminates at the agent, which forwards over one TCP connection);
+- owns this host's shared-memory arena and serves raw object fetch/store/free
+  requests for the cross-host transfer path (reference object_manager.h:119).
+
+Transport is the same authenticated length-prefixed-pickle channel used by the
+Ray-Client equivalent (multiprocessing.connection with the per-cluster session
+authkey) — the round-2 stand-in for the reference's gRPC planes.
+
+Run with `ray-tpu start --address=HOST:PORT` (scripts/cli.py) or spawn
+`python -m ray_tpu.core.node_agent --address HOST:PORT` directly.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import multiprocessing.connection
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+_mp = multiprocessing.get_context("spawn")
+
+HEARTBEAT_INTERVAL_S = float(os.environ.get("RAY_TPU_AGENT_HEARTBEAT_S", "2.0"))
+
+
+class NodeAgent:
+    def __init__(self, head_host: str, head_port: int, authkey: bytes,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 max_workers: Optional[int] = None):
+        from .resources import normalize_resources
+
+        if resources is None:
+            num_cpus = float(os.environ.get("RAY_TPU_NUM_CPUS", os.cpu_count() or 1))
+            detected: Dict[str, float] = {}
+            env_tpus = os.environ.get("RAY_TPU_NUM_TPUS")
+            if env_tpus is not None:
+                num_tpus = float(env_tpus)
+            else:
+                from .accelerators import TPUAcceleratorManager
+
+                detected = TPUAcceleratorManager.node_resources()
+                num_tpus = detected.pop("TPU", 0.0)
+            resources = normalize_resources(num_cpus=num_cpus, num_tpus=num_tpus,
+                                            resources=None)
+            for k, v in detected.items():
+                resources.setdefault(k, v)
+        self.resources = resources
+        self.labels = labels or {}
+        self.max_workers = max_workers or int(
+            os.environ.get("RAY_TPU_MAX_WORKERS_PER_NODE", "16"))
+        self.conn = multiprocessing.connection.Client(
+            (head_host, head_port), authkey=authkey)
+        self._send_lock = threading.Lock()
+        self._workers: Dict[str, Any] = {}   # wid_hex -> (proc, pipe)
+        self._pipe_to_wid: Dict[Any, str] = {}
+        self._shutdown = False
+        self._wakeup_r, self._wakeup_w = _mp.Pipe(duplex=False)
+        self.worker_env: Dict[str, str] = {}
+        self.node_id_hex: Optional[str] = None
+
+    # -- transport ----------------------------------------------------------------
+    def _send(self, msg) -> None:
+        with self._send_lock:
+            self.conn.send_bytes(cloudpickle.dumps(msg))
+
+    # -- lifecycle ----------------------------------------------------------------
+    def register(self) -> None:
+        self._send(("register", self.resources, self.labels, self.max_workers))
+        kind, payload = cloudpickle.loads(self.conn.recv_bytes())
+        assert kind == "welcome", kind
+        self.node_id_hex = payload["node_id"]
+        self.worker_env = dict(payload.get("worker_env") or {})
+        store_bytes = int(payload.get("object_store_memory") or 0)
+        from . import object_store
+
+        # this host's own arena: never share arena names across hosts — the
+        # head wraps this host's locations as ("remote", node_id, inner)
+        self.worker_env.pop(object_store._ARENA_ENV, None)
+        os.environ.pop(object_store._ARENA_ENV, None)
+        if store_bytes > 0:
+            arena_name = object_store.init_arena(store_bytes)
+            if arena_name:
+                self.worker_env[object_store._ARENA_ENV] = arena_name
+
+    def serve_forever(self) -> None:
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                              name="agent-heartbeat")
+        hb.start()
+        try:
+            self._serve_loop()
+        finally:
+            self._shutdown = True
+            self._kill_all_workers()
+            from . import object_store
+
+            object_store.destroy_arena()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                self._send(("heartbeat", time.time()))
+            except Exception:
+                return
+            time.sleep(HEARTBEAT_INTERVAL_S)
+
+    def _serve_loop(self) -> None:
+        while not self._shutdown:
+            pipes = list(self._pipe_to_wid.keys())
+            ready = multiprocessing.connection.wait(
+                [self.conn, self._wakeup_r] + pipes, timeout=1.0)
+            for c in ready:
+                if c is self._wakeup_r:
+                    try:
+                        self._wakeup_r.recv_bytes()
+                    except Exception:
+                        pass
+                    continue
+                if c is self.conn:
+                    try:
+                        raw = self.conn.recv_bytes()
+                    except (EOFError, OSError):
+                        return  # head is gone: exit (workers die with us)
+                    try:
+                        self._handle_head_message(cloudpickle.loads(raw))
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                    continue
+                wid = self._pipe_to_wid.get(c)
+                if wid is None:
+                    continue
+                try:
+                    raw = c.recv_bytes()
+                except (EOFError, OSError):
+                    self._on_local_worker_death(wid)
+                    continue
+                try:
+                    self._send(("from_worker", wid, raw))
+                except Exception:
+                    return
+
+    # -- head messages --------------------------------------------------------------
+    def _handle_head_message(self, msg) -> None:
+        kind = msg[0]
+        if kind == "spawn_worker":
+            _, wid_hex, accel = msg
+            self._spawn_worker(wid_hex, accel)
+        elif kind == "to_worker":
+            _, wid_hex, raw = msg
+            entry = self._workers.get(wid_hex)
+            if entry is not None:
+                try:
+                    entry[1].send_bytes(raw)
+                except (OSError, BrokenPipeError):
+                    self._on_local_worker_death(wid_hex)
+        elif kind == "kill_worker":
+            _, wid_hex = msg
+            entry = self._workers.get(wid_hex)
+            if entry is not None:
+                try:
+                    entry[0].terminate()
+                except Exception:
+                    pass
+        elif kind == "req":
+            _, req_id, op, args = msg
+            # object-plane requests run on their own thread: an arena read must
+            # never stall worker-pipe relaying
+            threading.Thread(target=self._serve_req, args=(req_id, op, args),
+                             daemon=True, name=f"agent-{op}").start()
+        elif kind == "free_object":
+            from . import object_store
+
+            object_store.free_local(msg[1])
+        elif kind == "shutdown":
+            self._shutdown = True
+
+    def _serve_req(self, req_id: int, op: str, args: tuple) -> None:
+        from . import object_store
+
+        try:
+            if op == "fetch_object":
+                (loc,) = args
+                value = object_store.read_raw(loc)
+            elif op == "store_object":
+                oid, data, is_error = args
+                value = object_store.write_raw(data, oid, is_error)
+            elif op == "gc_dead_owners":
+                (keep,) = args
+                arena = object_store._default_arena()
+                if arena is not None:
+                    arena.gc_dead_owners(keep)
+                value = True
+            else:
+                raise ValueError(f"unknown agent op {op!r}")
+            ok = True
+        except BaseException as e:  # noqa: BLE001
+            ok, value = False, e
+        try:
+            self._send(("reply", req_id, ok, value))
+        except Exception:
+            pass
+
+    # -- worker pool -----------------------------------------------------------------
+    def _spawn_worker(self, wid_hex: str, accel: str) -> None:
+        from .worker import worker_main
+
+        parent_conn, child_conn = _mp.Pipe(duplex=True)
+        env = dict(self.worker_env)
+        proc = _mp.Process(
+            target=worker_main,
+            args=(child_conn, self.node_id_hex, wid_hex, accel, env),
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        self._workers[wid_hex] = (proc, parent_conn)
+        self._pipe_to_wid[parent_conn] = wid_hex
+        try:
+            self._wakeup_w.send_bytes(b"x")
+        except Exception:
+            pass
+
+    def _on_local_worker_death(self, wid_hex: str) -> None:
+        entry = self._workers.pop(wid_hex, None)
+        if entry is not None:
+            self._pipe_to_wid.pop(entry[1], None)
+            try:
+                entry[1].close()
+            except Exception:
+                pass
+        try:
+            self._send(("worker_death", wid_hex))
+        except Exception:
+            pass
+
+    def _kill_all_workers(self) -> None:
+        for proc, pipe in list(self._workers.values()):
+            try:
+                pipe.send_bytes(cloudpickle.dumps(("exit",)))
+            except Exception:
+                pass
+        deadline = time.monotonic() + 2.0
+        for proc, _ in list(self._workers.values()):
+            proc.join(timeout=max(0.05, deadline - time.monotonic()))
+            if proc.is_alive():
+                proc.terminate()
+        self._workers.clear()
+        self._pipe_to_wid.clear()
+
+
+def agent_main(address: str, authkey: Optional[bytes] = None,
+               resources: Optional[Dict[str, float]] = None,
+               labels: Optional[Dict[str, str]] = None,
+               max_workers: Optional[int] = None) -> None:
+    """Blocking entry point: join the head at address ("host:port") and serve."""
+    if authkey is None:
+        from ray_tpu.util.client.server import load_authkey
+
+        authkey = load_authkey()
+        if authkey is None:
+            raise RuntimeError(
+                "no cluster authkey: set RAY_TPU_CLIENT_AUTHKEY or run on a host "
+                "with the head's session dir")
+    host, _, port = address.rpartition(":")
+    agent = NodeAgent(host or "127.0.0.1", int(port), authkey,
+                      resources=resources, labels=labels, max_workers=max_workers)
+    agent.register()
+    agent.serve_forever()
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="ray_tpu node agent")
+    p.add_argument("--address", required=True, help="head node-server host:port")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--max-workers", type=int, default=None)
+    args = p.parse_args(argv)
+    resources = None
+    if args.num_cpus is not None or args.num_tpus is not None:
+        from .resources import normalize_resources
+
+        resources = normalize_resources(
+            num_cpus=args.num_cpus if args.num_cpus is not None else
+            float(os.cpu_count() or 1),
+            num_tpus=args.num_tpus or 0.0, resources=None)
+    agent_main(args.address, resources=resources, max_workers=args.max_workers)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,10 @@ INLINE_THRESHOLD = 100 * 1024
 #   ("arena", arena_name, oid_bytes, nbytes, is_error)
 #   ("shm", name, nbytes, is_error)
 #   ("disk", path, nbytes, is_error)    <- spilled (reference local_object_manager.h:43)
+#   ("remote", host_key, inner_loc)     <- lives on another host's node agent; only
+#       the head's directory holds these (multi-host plane, reference
+#       object_manager.h:119 cross-node transfer); workers always receive a
+#       host-local location after the head localizes it
 Location = Tuple
 
 # ------------------------------------------------------------------- arena plumbing
@@ -132,6 +136,96 @@ def materialize(obj: Any, oid: ObjectID, is_error: bool = False) -> Location:
     finally:
         seg.close()
     return ("shm", name, size, is_error)
+
+
+def read_raw(loc: Location) -> Tuple[bytes, bool]:
+    """Read an object's serialized frame bytes at a local location.
+
+    Used by the cross-host object transfer path (reference ObjectManager chunked
+    push/pull, src/ray/object_manager/object_manager.h:119): the holding host
+    reads raw bytes, the requesting host writes them with write_raw. Returns
+    (frame_bytes, is_error)."""
+    kind = loc[0]
+    if kind == "inline":
+        return loc[1], loc[2]
+    if kind == "arena":
+        _, name, oid_bytes, size, is_error = loc
+        arena = _open_arena(name)
+        view = arena.get(oid_bytes)
+        if view is None:
+            raise ObjectLost(f"arena object {oid_bytes.hex()} was freed or lost")
+        try:
+            return bytes(view[:size]), is_error
+        finally:
+            view.release()
+            arena.unpin(oid_bytes)
+    if kind == "shm":
+        _, name, size, is_error = loc
+        try:
+            seg = _segment_cache.open(name)
+        except FileNotFoundError:
+            raise ObjectLost(f"shm segment {name} was freed or lost") from None
+        return bytes(memoryview(seg.buf)[:size]), is_error
+    if kind == "disk":
+        _, path, size, is_error = loc
+        try:
+            with open(path, "rb") as f:
+                return f.read(size), is_error
+        except OSError:
+            raise ObjectLost(f"spilled object file {path} was lost") from None
+    raise ValueError(f"unknown location kind {kind!r}")
+
+
+def write_raw(data: bytes, oid: ObjectID, is_error: bool = False) -> Location:
+    """Place already-serialized frame bytes locally (receiving side of a
+    cross-host transfer): arena first, per-object segment fallback."""
+    size = len(data)
+    if size < INLINE_THRESHOLD:
+        return ("inline", bytes(data), is_error)
+    arena = _default_arena()
+    if arena is not None:
+        buf = arena.create_object(oid.binary(), size)
+        if buf is not None:
+            try:
+                buf[:size] = data
+            finally:
+                buf.release()
+            arena.seal(oid.binary())
+            return ("arena", arena.name, oid.binary(), size, is_error)
+    name = "rt_" + oid.hex()[:24]
+    seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+    try:
+        seg.buf[:size] = data
+    finally:
+        seg.close()
+    return ("shm", name, size, is_error)
+
+
+def free_local(loc: Location) -> None:
+    """Physically delete a local (unwrapped) location's backing storage.
+
+    Used by node agents when the head broadcasts a free for an object hosted
+    on this agent's node."""
+    kind = loc[0]
+    if kind == "arena":
+        try:
+            _open_arena(loc[1]).delete(loc[2])
+        except Exception:
+            pass
+    elif kind == "shm":
+        name = loc[1]
+        _segment_cache.drop(name)
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except Exception:
+            pass
+    elif kind == "disk":
+        try:
+            os.remove(loc[1])
+        except OSError:
+            pass
 
 
 class _SegmentCache:
@@ -285,6 +379,9 @@ class ObjectStore:
         self._refcounts: Dict[ObjectID, int] = {}
         self._failed: Dict[ObjectID, Exception] = {}
         self.on_free = None  # callback(oid) — cluster drops lineage entries
+        # callback(loc) for ("remote", host, inner) locations — the cluster
+        # forwards the free to the hosting node agent (multi-host plane)
+        self.on_remote_free = None
 
     # -- directory -----------------------------------------------------------------
     def add(self, oid: ObjectID, loc: Location) -> None:
@@ -395,27 +492,14 @@ class ObjectStore:
                 pass
         if loc is None:
             return
-        if loc[0] == "arena":
-            try:
-                _open_arena(loc[1]).delete(loc[2])
-            except Exception:
-                pass
-        elif loc[0] == "shm":
-            name = loc[1]
-            _segment_cache.drop(name)
-            try:
-                seg = shared_memory.SharedMemory(name=name)
-                seg.close()
-                seg.unlink()
-            except FileNotFoundError:
-                pass
-            except Exception:
-                pass
-        elif loc[0] == "disk":
-            try:
-                os.remove(loc[1])
-            except OSError:
-                pass
+        if loc[0] == "remote":
+            if self.on_remote_free is not None:
+                try:
+                    self.on_remote_free(loc)
+                except Exception:
+                    pass
+        else:
+            free_local(loc)
 
     def spill_lru(self, bytes_to_free: int, spill_dir: str) -> int:
         """Spill least-recently-used arena/shm objects until bytes_to_free memory

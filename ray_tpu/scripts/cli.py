@@ -23,6 +23,23 @@ def _session_file() -> str:
 
 
 def cmd_start(args) -> int:
+    if args.address:
+        # join an existing head as this host's node agent (reference:
+        # `ray start --address=...` bringing up a worker-node raylet)
+        from ray_tpu.core.node_agent import agent_main
+
+        resources = None
+        if args.num_cpus is not None:
+            from ray_tpu.core.resources import normalize_resources
+
+            resources = normalize_resources(num_cpus=args.num_cpus, num_tpus=0.0,
+                                            resources=None)
+        print(f"joining head at {args.address} as a node agent (ctrl-c to leave)")
+        try:
+            agent_main(args.address, resources=resources)
+        except KeyboardInterrupt:
+            pass
+        return 0
     os.makedirs(default_session_dir(), exist_ok=True)
     info = {
         "started_at": time.time(),
@@ -30,6 +47,8 @@ def cmd_start(args) -> int:
         "num_cpus": args.num_cpus,
         "dashboard_port": args.dashboard_port,
     }
+    if args.node_server_port is not None:
+        info["node_server_port"] = args.node_server_port
     with open(_session_file(), "w") as f:
         json.dump(info, f)
     print(f"ray_tpu head session recorded at {_session_file()}")
@@ -37,7 +56,15 @@ def cmd_start(args) -> int:
         import ray_tpu
         from ray_tpu.dashboard import Dashboard
 
-        ray_tpu.init(num_cpus=args.num_cpus)
+        ray_tpu.init(num_cpus=args.num_cpus,
+                     node_server_port=args.node_server_port,
+                     node_server_host=args.node_server_host)
+        if args.node_server_port is not None:
+            from ray_tpu.core import global_state
+
+            port = global_state.cluster().node_server_port
+            print(f"node server: {args.node_server_host}:{port} "
+                  "(join with `ray-tpu start --address=HOST:PORT`)")
         dash = Dashboard(port=args.dashboard_port)
         print(f"dashboard: http://127.0.0.1:{args.dashboard_port}/api/summary")
         try:
@@ -260,9 +287,15 @@ def main(argv=None) -> int:
                     help="connect as a client driver, e.g. ray-tpu://127.0.0.1:10001")
     sp.set_defaults(fn=cmd_list)
 
-    sp = sub.add_parser("start", help="record head session (optionally --block with dashboard)")
+    sp = sub.add_parser("start", help="record head session (optionally --block with dashboard), "
+                                      "or --address=HOST:PORT to join a head as a node agent")
+    sp.add_argument("--address", default=None,
+                    help="join an existing head's node server as this host's agent")
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--node-server-port", type=int, default=None,
+                    help="accept node agents on this port (0 = ephemeral; head only)")
+    sp.add_argument("--node-server-host", default="127.0.0.1")
     sp.add_argument("--block", action="store_true")
     sp.set_defaults(fn=cmd_start)
 

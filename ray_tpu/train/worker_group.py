@@ -113,14 +113,21 @@ class WorkerGroup:
             )
             ray_tpu.get(self._pg.ready())
         self.workers = []
-        for i in range(num_workers):
-            o = dict(opts)
-            if self._pg is not None:
-                o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
-                    placement_group=self._pg, placement_group_bundle_index=i
-                )
-            self.workers.append(actor_cls.options(**o).remote())
-        metas = ray_tpu.get([w.get_metadata.remote() for w in self.workers])
+        try:
+            for i in range(num_workers):
+                o = dict(opts)
+                if self._pg is not None:
+                    o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                        placement_group=self._pg, placement_group_bundle_index=i
+                    )
+                self.workers.append(actor_cls.options(**o).remote())
+            metas = ray_tpu.get([w.get_metadata.remote() for w in self.workers])
+        except BaseException:
+            # a node dying mid-construction must not leak the PG/actors created
+            # so far: the caller retries with a fresh group, and an orphaned PG
+            # would pin resources forever (deadlocking the retry's placement)
+            self.shutdown()
+            raise
         self.metadata: List[WorkerMetadata] = [WorkerMetadata(**m) for m in metas]
 
     def __len__(self) -> int:

@@ -90,7 +90,10 @@ class ClientServer:
         from multiprocessing.connection import Listener
 
         if authkey is None:
-            authkey = generate_authkey()
+            # reuse the cluster's existing session key when one is already
+            # persisted (e.g. the node server bound it first) — generating a
+            # fresh key here would overwrite the file and lock out node agents
+            authkey = load_authkey() or generate_authkey()
         else:
             if authkey == DEFAULT_AUTHKEY and host not in _LOOPBACK_HOSTS:
                 raise ValueError(

