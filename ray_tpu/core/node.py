@@ -605,14 +605,24 @@ class Cluster:
         locs = [self.store.location(oid, timeout) for oid in oids]
         needs = [oid for oid, loc in zip(oids, locs)
                  if loc[0] == "remote" and loc[1] != dest_host]
-        if len(needs) > 1:
-            from concurrent.futures import ThreadPoolExecutor
-
-            # warm the replica cache concurrently; the serial pass below then
-            # returns each replica instantly
-            with ThreadPoolExecutor(max_workers=min(8, len(needs))) as ex:
-                list(ex.map(lambda o: self._localize(o, dest_host, timeout), needs))
+        # warm the replica cache concurrently; the serial pass below then
+        # returns each replica instantly
+        self._pull_batch(needs, dest_host, timeout)
         return [self._localize(oid, dest_host, timeout) for oid in oids]
+
+    def _pull_batch(self, oids: List[ObjectID], dest_host: str,
+                    timeout: Optional[float]) -> None:
+        """Transfer a set of objects to dest_host, overlapping the pulls
+        (reference PullManager issues pulls concurrently)."""
+        if not oids:
+            return
+        if len(oids) == 1:
+            self._localize(oids[0], dest_host, timeout)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(oids))) as ex:
+            list(ex.map(lambda o: self._localize(o, dest_host, timeout), oids))
 
     def _transfer_dedup(self, oid: ObjectID, loc, dest_host: str):
         while True:
@@ -1045,25 +1055,25 @@ class Cluster:
                 missing.append(oid)
         if not missing:
             return out
-        if spec.task_id not in self._localizing:
-            self._localizing.add(spec.task_id)
+        # keyed by (task, host): if the destination dies mid-pull the next
+        # placement (a different host) must be able to start its own pull
+        pull_key = (spec.task_id, host)
+        if pull_key not in self._localizing:
+            self._localizing.add(pull_key)
 
             def pull(missing=missing, spec=spec, host=host):
                 try:
-                    if len(missing) == 1:
-                        self._localize(missing[0], host, timeout=120.0)
-                    else:
-                        from concurrent.futures import ThreadPoolExecutor
-
-                        with ThreadPoolExecutor(max_workers=min(8, len(missing))) as ex:
-                            list(ex.map(
-                                lambda oid: self._localize(oid, host, timeout=120.0),
-                                missing))
-                except BaseException as e:  # noqa: BLE001
-                    self._fail_returns(spec, e if isinstance(e, Exception)
-                                       else RuntimeError(str(e)))
+                    self._pull_batch(missing, host, timeout=120.0)
+                except object_store.ObjectLost as e:
+                    # unreconstructible (no lineage): the task can never run
+                    self._fail_returns(spec, e)
+                except BaseException:  # noqa: BLE001
+                    # transient (dest host died, transfer timeout): leave the
+                    # task pending — the reschedule below re-places it and
+                    # starts a fresh pull for the new destination
+                    pass
                 finally:
-                    self._localizing.discard(spec.task_id)
+                    self._localizing.discard(pull_key)
                     self._schedule()
 
             threading.Thread(target=pull, daemon=True, name="rt-arg-pull").start()
@@ -1705,16 +1715,10 @@ class DriverContext:
                 needs.append(r)
             else:
                 locs[r.id] = loc
-        if len(needs) == 1:
-            locs[needs[0].id] = self.cluster._localize(needs[0].id, "local", remaining())
-        elif needs:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=min(8, len(needs))) as ex:
-                fetched = list(ex.map(
-                    lambda r: self.cluster._localize(r.id, "local", remaining()), needs))
-            for r, loc in zip(needs, fetched):
-                locs[r.id] = loc
+        if needs:
+            self.cluster._pull_batch([r.id for r in needs], "local", remaining())
+            for r in needs:  # replica cache is warm: these return instantly
+                locs[r.id] = self.cluster._localize(r.id, "local", remaining())
         values = []
         for r in ref_list:
             try:

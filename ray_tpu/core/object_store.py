@@ -192,7 +192,10 @@ def write_raw(data: bytes, oid: ObjectID, is_error: bool = False) -> Location:
                 buf.release()
             arena.seal(oid.binary())
             return ("arena", arena.name, oid.binary(), size, is_error)
-    name = "rt_" + oid.hex()[:24]
+    # randomized suffix: the source side's materialize() segment for this oid may
+    # share this machine's /dev/shm namespace (same-host "multi-host" test
+    # topology), so the deterministic name would collide
+    name = "rt_" + oid.hex()[:16] + os.urandom(4).hex()
     seg = shared_memory.SharedMemory(name=name, create=True, size=size)
     try:
         seg.buf[:size] = data

@@ -60,7 +60,29 @@ class LLMConfig:
 
         if isinstance(self.model_source, ModelConfig):
             return self.model_source
+        from ray_tpu.models import checkpoint as ckpt_io
+
+        if ckpt_io.looks_like_checkpoint_dir(self.model_source):
+            # a local HF-layout checkpoint dir: architecture from its config.json,
+            # weights loaded by the engine at start() (vllm_engine.py:180 contract)
+            return ckpt_io.config_from_hf(self.model_source, **self.engine_kwargs)
         return get_config(self.model_source, **self.engine_kwargs)
+
+    def resolve_tokenizer_name(self) -> str:
+        """Default the tokenizer to the checkpoint's own HF tokenizer when the
+        model is a checkpoint dir that ships one."""
+        if self.tokenizer != "byte":
+            return self.tokenizer
+        import os
+
+        from ray_tpu.models import checkpoint as ckpt_io
+
+        if ckpt_io.looks_like_checkpoint_dir(self.model_source) and any(
+            os.path.exists(os.path.join(self.model_source, f))
+            for f in ("tokenizer.json", "tokenizer_config.json")
+        ):
+            return f"hf:{self.model_source}"
+        return self.tokenizer
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:

@@ -74,7 +74,7 @@ class JaxLLMEngine(LLMEngine):
     def __init__(self, config: LLMConfig, params=None, mesh=None):
         self.config = config
         self.model_config = config.resolve_model_config()
-        self.tokenizer = get_tokenizer(config.tokenizer)
+        self.tokenizer = get_tokenizer(config.resolve_tokenizer_name())
         self._mesh = mesh
         self._params_in = params
         self._started = False
@@ -123,9 +123,21 @@ class JaxLLMEngine(LLMEngine):
                 )
             if c.max_num_seqs % c.data_parallel_size:
                 raise ValueError("max_num_seqs must be divisible by data_parallel_size")
-            if self._params_in is None:
-                self._params_in = llama_init_cached(cfg)
-            self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
+            if self._params_in is not None:
+                self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
+            else:
+                from ray_tpu.models import checkpoint as ckpt_io
+
+                if ckpt_io.looks_like_checkpoint_dir(c.model_source):
+                    # real weights: stream safetensors straight into the sharded
+                    # pytree (reference vllm_engine.py:180 — an engine that can't
+                    # load a model is a demo)
+                    self.params = ckpt_io.load_llama_params(
+                        c.model_source, cfg, self._mesh,
+                        param_dtype=jnp.dtype(c.dtype))
+                else:
+                    self.params = model_runner.shard_params(
+                        llama_init_cached(cfg), cfg, self._mesh)
             self._params_in = None
             self._active = {s: None for s in range(c.max_num_seqs)}
             self._rng = jax.random.PRNGKey(0)

@@ -48,10 +48,26 @@ def init_state(
     tx: optax.GradientTransformation,
     mesh=None,
     rules: AxisRules = TRAIN_RULES,
+    checkpoint_dir: Optional[str] = None,
+    param_dtype=None,
 ) -> TrainState:
-    params = llama.init(rng, cfg)
+    """Fresh (or checkpoint-warm-started) sharded TrainState.
+
+    checkpoint_dir: HF-layout safetensors dir (models/checkpoint.py) — streams
+    real weights into the sharded pytree instead of random init, so fine-tuning
+    starts from a released model (reference: model loading is the engine/trainer
+    contract, vllm_engine.py:180)."""
+    if checkpoint_dir is not None:
+        from ray_tpu.models import checkpoint as ckpt_io
+
+        params = ckpt_io.load_llama_params(
+            checkpoint_dir, cfg, mesh, rules=rules,
+            param_dtype=param_dtype or jnp.float32)
+    else:
+        params = llama.init(rng, cfg)
+        if mesh is not None:
+            params = shard_pytree(params, llama.param_axes(cfg), mesh, rules)
     if mesh is not None:
-        params = shard_pytree(params, llama.param_axes(cfg), mesh, rules)
         with use_mesh(mesh):
             opt_state = jax.jit(tx.init)(params)
     else:

@@ -1,0 +1,168 @@
+"""HF safetensors checkpoint IO (models/checkpoint.py).
+
+The transformers cross-check is the load-bearing test: it proves the weight
+mapping matches the real HF Llama convention (not just our own round-trip).
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import checkpoint as ckpt_io
+from ray_tpu.models import llama
+from ray_tpu.models.config import ModelConfig
+
+TINY = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=48, max_seq_len=128, remat=False, dtype="float32")
+
+
+def _cfg(**kw):
+    return ModelConfig(name="tiny-ckpt", **{**TINY, **kw})
+
+
+def test_roundtrip_exact(tmp_path):
+    cfg = _cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    ckpt_io.save_llama_params(params, cfg, str(tmp_path / "ckpt"))
+    # cfg comes from the written config.json, not passed in
+    loaded = ckpt_io.load_llama_params(str(tmp_path / "ckpt"), param_dtype=jnp.float32)
+    flat_a = jax.tree.leaves(params)
+    flat_b = jax.tree.leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_roundtrip_unscanned_layers(tmp_path):
+    cfg = _cfg(scan_layers=False)
+    params = llama.init(jax.random.PRNGKey(1), cfg)
+    ckpt_io.save_llama_params(params, cfg, str(tmp_path / "ckpt"))
+    loaded = ckpt_io.load_llama_params(
+        str(tmp_path / "ckpt"), cfg=cfg, param_dtype=jnp.float32)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_sharded_tp2(tmp_path):
+    from jax.sharding import Mesh
+
+    cfg = _cfg()
+    params = llama.init(jax.random.PRNGKey(2), cfg)
+    ckpt_io.save_llama_params(params, cfg, str(tmp_path / "ckpt"))
+    devs = np.asarray(jax.devices()[:2]).reshape(1, 1, 2)
+    mesh = Mesh(devs, ("dp", "ep", "tp"))
+    loaded = ckpt_io.load_llama_params(
+        str(tmp_path / "ckpt"), mesh=mesh, param_dtype=jnp.float32)
+    # wq is sharded over tp on the heads axis
+    wq = loaded["layers"]["wq"]
+    assert len(wq.sharding.device_set) == 2
+    tokens = jnp.asarray([[1, 5, 9, 3]], jnp.int32)
+    ref_logits, _ = llama.forward(params, tokens, cfg)
+    got_logits, _ = llama.forward(loaded, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(got_logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hf_transformers_parity(tmp_path):
+    """Weights exported by the REAL transformers LlamaForCausalLM load into our
+    pytree and reproduce its logits — proves the mapping, not just a roundtrip."""
+    torch = pytest.importorskip("torch")
+    tr = pytest.importorskip("transformers")
+
+    hf_cfg = tr.LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=48,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = tr.LlamaForCausalLM(hf_cfg).eval()
+    src = str(tmp_path / "hf")
+    model.save_pretrained(src, safe_serialization=True)
+
+    cfg = ckpt_io.config_from_hf(src, remat=False, dtype="float32")
+    assert cfg.n_layers == 2 and cfg.n_kv_heads == 2 and cfg.rope_theta == 10000.0
+    params = ckpt_io.load_llama_params(src, cfg, param_dtype=jnp.float32)
+
+    ids = [[1, 7, 23, 40, 5, 61]]
+    with torch.no_grad():
+        ref = model(torch.tensor(ids)).logits.numpy()
+    got, _ = llama.forward(params, jnp.asarray(ids, jnp.int32), cfg)
+    np.testing.assert_allclose(np.asarray(got, np.float32), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_engine_loads_checkpoint_deterministic_tokens(tmp_path, rt):
+    """End-to-end VERDICT bar: tiny real safetensors checkpoint -> tp=2 mesh ->
+    deterministic greedy tokens, identical to an engine fed the params directly."""
+    from ray_tpu.llm import JaxLLMEngine, LLMConfig, SamplingParams
+
+    cfg = _cfg(dtype="float32")
+    params = llama.init(jax.random.PRNGKey(3), cfg)
+    src = str(tmp_path / "ckpt")
+    ckpt_io.save_llama_params(params, cfg, src)
+
+    def greedy(engine):
+        engine.start()
+        out = engine.generate_sync(
+            "hello tpu", SamplingParams(max_tokens=8, temperature=0.0,
+                                        stop_token_ids=[-1]))
+        return out.token_ids
+
+    common = dict(max_num_seqs=2, max_model_len=64, dtype="float32",
+                  tensor_parallel_size=2)
+    from_ckpt = greedy(JaxLLMEngine(LLMConfig(model_source=src, **common)))
+    from_params = greedy(JaxLLMEngine(
+        LLMConfig(model_source=cfg, **common), params=params))
+    assert from_ckpt == from_params
+    assert len(from_ckpt) == 8
+    # determinism across a fresh engine on the same checkpoint
+    again = greedy(JaxLLMEngine(LLMConfig(model_source=src, **common)))
+    assert again == from_ckpt
+
+
+def test_sharded_index_file(tmp_path):
+    """Checkpoints split across N safetensors files load via the index."""
+    from safetensors.numpy import save_file
+
+    cfg = _cfg()
+    params = llama.init(jax.random.PRNGKey(4), cfg)
+    src = str(tmp_path / "one")
+    ckpt_io.save_llama_params(params, cfg, src)
+    # re-split the single file into two + an index
+    from safetensors import safe_open
+
+    dst = str(tmp_path / "split")
+    os.makedirs(dst)
+    with safe_open(os.path.join(src, "model.safetensors"), framework="numpy") as h:
+        keys = sorted(h.keys())
+        half = len(keys) // 2
+        parts = [keys[:half], keys[half:]]
+        weight_map = {}
+        for n, part in enumerate(parts, start=1):
+            fname = f"model-{n:05d}-of-00002.safetensors"
+            save_file({k: h.get_tensor(k) for k in part}, os.path.join(dst, fname))
+            weight_map.update({k: fname for k in part})
+    with open(os.path.join(dst, "model.safetensors.index.json"), "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+    with open(os.path.join(dst, "config.json"), "w") as f:
+        json.dump(ckpt_io.config_to_hf(cfg), f)
+    loaded = ckpt_io.load_llama_params(dst, param_dtype=jnp.float32)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_init_state_from_checkpoint(tmp_path):
+    from ray_tpu.train.step import init_state, make_optimizer
+
+    cfg = _cfg()
+    params = llama.init(jax.random.PRNGKey(5), cfg)
+    src = str(tmp_path / "ckpt")
+    ckpt_io.save_llama_params(params, cfg, src)
+    state = init_state(jax.random.PRNGKey(0), cfg, make_optimizer(),
+                       checkpoint_dir=src)
+    np.testing.assert_array_equal(np.asarray(state.params["embed"]),
+                                  np.asarray(params["embed"]))
+    assert state.opt_state is not None
