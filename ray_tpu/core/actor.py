@@ -74,13 +74,16 @@ class ActorMethod:
                         else self._concurrency_group)
         return m
 
-    def _remote(self, args, kwargs, num_returns: int = 1):
+    def _remote(self, args, kwargs, num_returns=1):
         from ray_tpu.util.tracing import get_trace_context
 
         ctx = global_state.worker()
         meta, arg_refs, pins = encode_args(ctx, args, kwargs)
+        streaming = num_returns == "streaming"
+        n_rets = 1 if streaming else num_returns
+        task_id = TaskID.generate()
         spec = TaskSpec(
-            task_id=TaskID.generate(),
+            task_id=task_id,
             kind="actor_method",
             trace_ctx=get_trace_context(),
             fn_id=b"\x00" * 16,
@@ -88,14 +91,18 @@ class ActorMethod:
             name=f"{self._name}",
             args_meta=meta,
             arg_refs=arg_refs,
-            num_returns=num_returns,
-            return_ids=[ObjectID.generate() for _ in range(num_returns)],
+            num_returns=-1 if streaming else n_rets,
+            return_ids=[ObjectID.generate() for _ in range(n_rets)],
             actor_id=self._handle._actor_id,
             method_name=self._name,
             concurrency_group=self._concurrency_group,
         )
         refs = ctx.submit(spec)
         del pins  # safe to release: submit() pinned the args
+        if streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0], task_id)
         return refs[0] if num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):

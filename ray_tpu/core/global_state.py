@@ -37,6 +37,8 @@ def _drain_gc_actions() -> None:
                 w.decref(ident)
             elif kind == "kill_actor":
                 w.kill_actor(ident, no_restart=True, from_gc=True)
+            elif kind == "drop_stream":
+                w.drop_stream(*ident)
         except Exception:
             pass
 

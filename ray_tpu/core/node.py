@@ -345,7 +345,13 @@ class Cluster:
         self._replicas: Dict[Tuple[ObjectID, str], Tuple] = {}
         self._transfers: Dict[Tuple[ObjectID, str], threading.Event] = {}
         self._transfer_lock = threading.Lock()
-        self._localizing: set = set()  # task_ids with an in-flight arg pull
+        self._localizing: set = set()  # (task_id, host) with an in-flight arg pull
+        self._pull_failures: Dict[TaskID, int] = {}  # consecutive arg-pull failures
+        # streaming generator bookkeeping: items produced so far per task, and
+        # the cutoff index past which an abandoned stream's items are dropped
+        self._stream_counts: Dict[TaskID, int] = {}
+        self._stream_abandoned: Dict[TaskID, int] = {}
+        self._stream_completion: Dict[ObjectID, TaskID] = {}  # completion oid -> task
         # lineage for reconstruction: return oid -> creating TaskSpec while the
         # object is in scope and the task is retryable (reference
         # object_recovery_manager.h:43 + task_manager lineage pinning)
@@ -756,6 +762,20 @@ class Cluster:
             self.store.add(oid, self._wrap_loc(w, loc))
             self.store.incref(oid)
             self._schedule()
+        elif kind == "stream":
+            # one yielded item of a streaming generator task; owned by the
+            # consumer-side ObjectRefGenerator (decref on its ref's GC)
+            _, task_id, index, oid, loc = msg
+            self.store.add(oid, self._wrap_loc(w, loc))
+            self.store.incref(oid)
+            with self._lock:
+                self._stream_counts[task_id] = index + 1
+                abandoned = self._stream_abandoned.get(task_id)
+            if abandoned is not None and index >= abandoned:
+                self.store.decref(oid)  # consumer is gone: don't pin the item
+            self._schedule()  # tasks may be waiting on this item ref as an arg
+        elif kind == "drop_stream":
+            self.drop_stream(msg[1], msg[2])
         elif kind == "decref":
             self.store.decref(msg[1])
         elif kind == "recover":
@@ -893,6 +913,10 @@ class Cluster:
     def submit(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids:
             self.store.incref(oid)
+        if spec.num_returns == -1:
+            # streaming: stream bookkeeping lives until the completion object dies
+            with self._lock:
+                self._stream_completion[spec.return_ids[0]] = spec.task_id
         # Pin args until the task reaches a terminal state (reference: TaskManager holds
         # dependencies for retryable tasks, task_manager.cc).
         for oid in spec.arg_refs:
@@ -1064,14 +1088,21 @@ class Cluster:
             def pull(missing=missing, spec=spec, host=host):
                 try:
                     self._pull_batch(missing, host, timeout=120.0)
+                    self._pull_failures.pop(spec.task_id, None)
                 except object_store.ObjectLost as e:
                     # unreconstructible (no lineage): the task can never run
                     self._fail_returns(spec, e)
-                except BaseException:  # noqa: BLE001
-                    # transient (dest host died, transfer timeout): leave the
-                    # task pending — the reschedule below re-places it and
-                    # starts a fresh pull for the new destination
-                    pass
+                except BaseException as e:  # noqa: BLE001
+                    # usually transient (dest host died, transfer timeout): the
+                    # reschedule below re-places the task and pulls afresh — but
+                    # bounded, so a persistently failing transfer surfaces to
+                    # the caller instead of hanging its get() forever
+                    n = self._pull_failures.get(spec.task_id, 0) + 1
+                    self._pull_failures[spec.task_id] = n
+                    if n >= 3:
+                        self._pull_failures.pop(spec.task_id, None)
+                        self._fail_returns(spec, e if isinstance(e, Exception)
+                                           else RuntimeError(str(e)))
                 finally:
                     self._localizing.discard(pull_key)
                     self._schedule()
@@ -1223,6 +1254,12 @@ class Cluster:
                     # Actor-creation args stay pinned while restarts remain (the
                     # creation spec is resubmitted with the same arg refs).
                     self._unpin_args(spec)
+            if (not retry and spec is not None and spec.num_returns == -1
+                    and spec.return_ids[0] not in self._stream_completion):
+                # completion object already freed and the producer just finished:
+                # last chance to drop the stream bookkeeping
+                self._stream_counts.pop(spec.task_id, None)
+                self._stream_abandoned.pop(spec.task_id, None)
         self._schedule()
 
     # -- maintenance: spilling + memory monitor ----------------------------------------
@@ -1304,6 +1341,17 @@ class Cluster:
     # -- lineage reconstruction --------------------------------------------------------
     def _on_object_freed(self, oid: ObjectID) -> None:
         """Drop the lineage entry, release its argument pins, free replicas."""
+        with self._lock:
+            task_id = self._stream_completion.pop(oid, None)
+            if task_id is not None:
+                if task_id in self.tasks:
+                    # producer still running with no possible consumer left:
+                    # drop every item it yields from here on (already-yielded
+                    # refs own their items and decref themselves)
+                    self._stream_abandoned[task_id] = self._stream_counts.get(task_id, 0)
+                else:
+                    self._stream_counts.pop(task_id, None)
+                    self._stream_abandoned.pop(task_id, None)
         spec = self.lineage.pop(oid, None)
         if spec is not None:
             for arg in spec.arg_refs:
@@ -1550,6 +1598,23 @@ class Cluster:
                 if spec.max_restarts != 0:
                     self._unpin_args(spec)
 
+    # -- streaming generators ------------------------------------------------------------
+    def drop_stream(self, task_id: TaskID, start_index: int) -> None:
+        """Consumer abandoned a streaming generator at start_index: release the
+        unconsumed items (already-yielded refs own their items and decref via
+        their own GC). Items the producer yields after this are dropped on
+        registration (reference: generator ref GC releases dynamic returns)."""
+        from .object_ref import stream_item_id
+
+        with self._lock:
+            prev = self._stream_abandoned.get(task_id)
+            if prev is not None and prev <= start_index:
+                return
+            self._stream_abandoned[task_id] = start_index
+            count = self._stream_counts.get(task_id, 0)
+        for i in range(start_index, count):
+            self.store.decref(stream_item_id(task_id, i))
+
     # -- actor management ----------------------------------------------------------------
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
         with self._lock:
@@ -1745,6 +1810,9 @@ class DriverContext:
 
     def decref(self, oid: ObjectID) -> None:
         self.cluster.store.decref(oid)
+
+    def drop_stream(self, task_id: TaskID, start_index: int) -> None:
+        self.cluster.drop_stream(task_id, start_index)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True, from_gc: bool = False) -> None:
         self.cluster.kill_actor(actor_id, no_restart, from_gc)

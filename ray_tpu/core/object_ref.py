@@ -62,3 +62,73 @@ class ObjectRef:
         import asyncio
 
         return asyncio.wrap_future(self.future()).__await__()
+
+
+def stream_item_id(task_id, index: int) -> ObjectID:
+    """Deterministic ObjectID of a streaming task's index-th yielded item.
+
+    Derived from the task id so producer and consumer agree without a round
+    trip (reference: dynamically-created return ids of streaming generators,
+    python/ray/_raylet.pyx:1138)."""
+    import hashlib
+
+    digest = hashlib.sha256(task_id.binary() + b"stream" +
+                            index.to_bytes(8, "little")).digest()
+    return ObjectID(digest[: ObjectID.SIZE])
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded items (num_returns="streaming").
+
+    Yields ObjectRefs as the producer materializes them; the completion object
+    (the task's ordinary return) carries the final item count — or the error,
+    which this generator re-raises at the failure point. Reference:
+    ObjectRefGenerator over dynamic returns (python/ray/_raylet.pyx:1138)."""
+
+    def __init__(self, completion_ref: ObjectRef, task_id):
+        self._completion = completion_ref
+        self._task_id = task_id
+        self._i = 0
+        self._count: Optional[int] = None
+
+    @property
+    def completed(self) -> ObjectRef:
+        """The completion ref (resolves to the item count; raises task errors)."""
+        return self._completion
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from . import global_state
+
+        ctx = global_state.worker()
+        while True:
+            if self._count is not None:
+                if self._i >= self._count:
+                    raise StopIteration
+                ref = ObjectRef(stream_item_id(self._task_id, self._i), owned=True)
+                self._i += 1
+                return ref
+            item = ObjectRef(stream_item_id(self._task_id, self._i))
+            ready, _ = ctx.wait([item, self._completion], 1, None)
+            if any(r.id == item.id for r in ready):
+                self._i += 1
+                return ObjectRef(item.id, owned=True)
+            # completion landed first: learn the count (or raise the task error)
+            self._count = int(ctx.get(self._completion))
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._completion, self._task_id))
+
+    def __del__(self):
+        # release unconsumed items (and anything the producer yields later);
+        # queued, never direct — GC may run on a thread holding runtime locks
+        try:
+            from . import global_state
+
+            if global_state.try_worker() is not None:
+                global_state.enqueue_gc_action(
+                    "drop_stream", (self._task_id, self._i))
+        except Exception:
+            pass

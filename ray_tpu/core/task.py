@@ -137,25 +137,36 @@ class RemoteFunction:
         register_function(ctx, fn_id, fn_bytes)
         meta, arg_refs, pins = encode_args(ctx, args, kwargs)
         num_returns = opts["num_returns"]
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 1  # the completion object (item count / error)
+        task_id = TaskID.generate()
         spec = TaskSpec(
-            task_id=TaskID.generate(),
+            task_id=task_id,
             kind="task",
             fn_id=fn_id,
             fn_bytes=None,
             name=opts.get("name") or self.__name__,
             args_meta=meta,
             arg_refs=arg_refs,
-            num_returns=num_returns,
+            num_returns=-1 if streaming else num_returns,
             return_ids=[ObjectID.generate() for _ in range(num_returns)],
             resources=build_resources(opts),
             scheduling_strategy=opts["scheduling_strategy"],
-            max_retries=opts["max_retries"],
+            # a replayed generator would re-register already-consumed item ids;
+            # streaming tasks surface the crash instead (reference restriction
+            # lifted only with generator checkpointing, which we don't do)
+            max_retries=0 if streaming else opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
             runtime_env=opts.get("runtime_env"),
             trace_ctx=_trace_ctx(),
         )
         refs = ctx.submit(spec)
         del pins  # safe to release: submit() pinned the args
+        if streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0], task_id)
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -192,6 +192,12 @@ class WorkerContext:
         except Exception:
             pass
 
+    def drop_stream(self, task_id: TaskID, start_index: int) -> None:
+        try:
+            self._send(("drop_stream", task_id, start_index))
+        except Exception:
+            pass
+
     def push_metrics(self, snapshot: list) -> None:
         """One-way metric snapshot to the coordinator (util/metrics.py)."""
         self._send(("metrics", snapshot))
@@ -370,6 +376,12 @@ class WorkerContext:
 
     def _execute_body(self, spec: TaskSpec, args, kwargs) -> None:
         try:
+            if spec.num_returns == -1 and spec.kind in ("task", "actor_method"):
+                # streaming generator task (reference _raylet.pyx:1138): each
+                # yielded item becomes its own object under a derived id; the
+                # ordinary return carries the final item count
+                self._execute_streaming(spec, args, kwargs)
+                return
             if spec.kind == "actor_creation":
                 cls = self._load_fn(spec)
                 self.actor_instance = cls(*args, **kwargs)
@@ -417,6 +429,30 @@ class WorkerContext:
             self._send_error(spec, e)
         finally:
             self.current_task_id = None
+
+    def _execute_streaming(self, spec: TaskSpec, args, kwargs) -> None:
+        from .object_ref import stream_item_id
+
+        if spec.kind == "actor_method":
+            if spec.method_name == "__ray_call__":
+                out = args[0](self.actor_instance, *args[1:], **kwargs)
+            else:
+                out = getattr(self.actor_instance, spec.method_name)(*args, **kwargs)
+        else:
+            out = self._load_fn(spec)(*args, **kwargs)
+        count = 0
+        if not hasattr(out, "__next__"):
+            # non-iterator return under a streaming call: a one-item stream
+            # (lists/dicts must not be exploded into their elements)
+            out = iter((out,))
+        for item in out:
+            oid = stream_item_id(spec.task_id, count)
+            loc = object_store.materialize(item, oid)
+            self._send(("stream", spec.task_id, count, oid, loc))
+            count += 1
+        payload = [(spec.return_ids[0],
+                    object_store.materialize(count, spec.return_ids[0]))]
+        self._send(("result", spec.task_id, payload, None))
 
     @staticmethod
     def _split_returns(out, num_returns: int):

@@ -87,18 +87,77 @@ class LLMServer:
         self.engine.start()
 
     # -- OpenAI endpoints --------------------------------------------------------
-    def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+    def chat(self, body: Dict[str, Any]):
         prompt = render_chat_template(body.get("messages", []))
+        if body.get("stream"):
+            return self._sse_stream(prompt, body, chat=True)
         out = self.engine.generate_sync(prompt, _sampling_from_body(body))
         return _chat_envelope(
             body.get("model", self.llm_config.model_id), out.text, out.finish_reason,
             _usage(out.num_prompt_tokens, out.num_generated_tokens))
 
-    def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+    def completions(self, body: Dict[str, Any]):
+        if body.get("stream"):
+            return self._sse_stream(body.get("prompt", ""), body, chat=False)
         out = self.engine.generate_sync(body.get("prompt", ""), _sampling_from_body(body))
         return _completion_envelope(
             body.get("model", self.llm_config.model_id), out.text, out.finish_reason,
             _usage(out.num_prompt_tokens, out.num_generated_tokens))
+
+    def _sse_stream(self, prompt: str, body: Dict[str, Any], chat: bool):
+        """OpenAI ``stream: true``: yield SSE frames ("data: {chunk}\\n\\n" ...
+        "data: [DONE]\\n\\n") as the engine produces tokens. Runs as a streaming
+        actor method through Serve (reference proxy.py:699 ASGI streaming)."""
+        import json as _json
+
+        model = body.get("model", self.llm_config.model_id)
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        created = int(time.time())
+
+        def frame(payload: Dict[str, Any]) -> str:
+            return f"data: {_json.dumps(payload)}\n\n"
+
+        def choices(delta_or_text, finish_reason):
+            if chat:
+                return [{"index": 0, "delta": delta_or_text,
+                         "finish_reason": finish_reason}]
+            return [{"index": 0, "text": delta_or_text,
+                     "finish_reason": finish_reason}]
+
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        tokenizer = self.engine.tokenizer
+
+        def gen():
+            if chat:
+                yield frame({"id": rid, "object": obj, "created": created,
+                             "model": model,
+                             "choices": choices({"role": "assistant"}, None)})
+            finish = None
+            # deltas come from re-decoding the FULL id sequence: per-chunk
+            # decode drops BPE leading-space markers and splits multi-byte
+            # UTF-8, diverging from the non-streaming response text
+            all_ids: List[int] = []
+            emitted = ""
+            for out in self.engine.generate(prompt, _sampling_from_body(body)):
+                finish = out.finish_reason
+                all_ids.extend(out.token_ids)
+                full = tokenizer.decode(all_ids)
+                if full.endswith("�"):
+                    continue  # mid-codepoint: wait for the next chunk
+                delta_text = full[len(emitted):]
+                emitted = full
+                if delta_text:
+                    delta = {"content": delta_text} if chat else delta_text
+                    yield frame({"id": rid, "object": obj, "created": created,
+                                 "model": model, "choices": choices(delta, None)})
+            yield frame({"id": rid, "object": obj, "created": created,
+                         "model": model,
+                         "choices": choices({} if chat else "", finish or "stop")})
+            yield "data: [DONE]\n\n"
+
+        return gen()
 
     # -- P/D disaggregation endpoints (reference prefill_decode_disagg/) ---------
     def prefill(self, prompt: str, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -148,17 +207,23 @@ class OpenAIRouter:
             return next(iter(self.handles.values()))
         raise ValueError(f"unknown model {model!r}; served: {sorted(self.handles)}")
 
-    def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def handle_http(self, request: Dict[str, Any]):
         path, body = request["path"], request.get("body") or {}
         if path.endswith("/models"):
             return _models_list(self.handles)
         model = body.get("model") if isinstance(body, dict) else None
         handle = self._pick(model)
+        stream = bool(isinstance(body, dict) and body.get("stream"))
         if path.endswith("/chat/completions"):
-            return handle.options(method_name="chat").remote(body).result()
-        if path.endswith("/completions"):
-            return handle.options(method_name="completions").remote(body).result()
-        raise ValueError(f"unsupported path {path!r}")
+            h = handle.options(method_name="chat", stream=stream)
+        elif path.endswith("/completions"):
+            h = handle.options(method_name="completions", stream=stream)
+        else:
+            raise ValueError(f"unsupported path {path!r}")
+        resp = h.remote(body)
+        # streaming: return the response generator itself — the router is called
+        # with a streaming method too, so each SSE frame re-streams through it
+        return resp if stream else resp.result()
 
     # direct-handle convenience (tests, in-cluster clients)
     def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -201,6 +266,11 @@ class PDRouter:
         path, body = request["path"], request.get("body") or {}
         if path.endswith("/models"):
             return _models_list([self.model_id])
+        if isinstance(body, dict) and body.get("stream"):
+            # explicit refusal beats one mislabeled SSE blob: P/D decode
+            # streaming lands with transferable-KV streaming support
+            raise ValueError(
+                "stream=true is not supported by the P/D-disaggregated router yet")
         if path.endswith("/chat/completions"):
             return self.chat(body)
         if path.endswith("/completions"):

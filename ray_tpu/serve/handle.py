@@ -31,6 +31,25 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming handle call: iterate replica-yielded values as they arrive
+    (reference handle.py DeploymentResponseGenerator over a streaming ObjectRef
+    generator)."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        return ray_tpu.get(next(self._gen))
+
+    @property
+    def completed(self):
+        return self._gen.completed
+
+
 class _Router:
     """Power-of-two-choices over locally tracked in-flight counts, with
     model-affinity for multiplexed requests (reference: multiplexed replica
@@ -190,11 +209,12 @@ def _reset_long_poll() -> None:
 
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str, method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._method = method_name
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
         self._router = _Router()
         self._replicas: List[Any] = []
         self._last_refresh = 0.0
@@ -248,11 +268,13 @@ class DeploymentHandle:
 
     # -- public ----------------------------------------------------------------
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None, **_compat) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None, **_compat) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.app_name, self.deployment_name, method_name or self._method,
             multiplexed_model_id if multiplexed_model_id is not None
             else self._multiplexed_model_id,
+            self._stream if stream is None else stream,
         )
         h._router = self._router  # share in-flight + model-affinity view
         h._replicas = self._replicas
@@ -285,16 +307,23 @@ class DeploymentHandle:
 
             kwargs = {**kwargs, MULTIPLEX_KWARG: self._multiplexed_model_id}
         try:
-            ref = replica.handle_request.remote(self._method, args, kwargs)
+            method = replica.handle_request
+            if self._stream:
+                # replica yields; items stream through the object store as they
+                # are produced (core num_returns="streaming" generators)
+                method = method.options(num_returns="streaming")
+            ref = method.remote(self._method, args, kwargs)
         except Exception:
             self._router.on_done(replica)
             raise
 
-        resp = DeploymentResponse(ref)
+        done_ref = ref.completed if self._stream else ref
+        resp = (DeploymentResponseGenerator(ref) if self._stream
+                else DeploymentResponse(ref))
 
         def _done_watcher():
             try:
-                ray_tpu.wait([ref], num_returns=1, timeout=None)
+                ray_tpu.wait([done_ref], num_returns=1, timeout=None)
             except Exception:
                 pass
             finally:

@@ -75,6 +75,47 @@ class ProxyActor:
                 "body": payload,
             }
 
+            # streaming (reference proxy.py:699 ASGI streaming): OpenAI-style
+            # {"stream": true} bodies or ?stream=1 run a streaming handle call
+            # and forward chunks as they arrive (SSE-compatible)
+            # truthiness, matching OpenAIRouter's gate — {"stream": 1} must not
+            # desynchronize the proxy (non-stream) from the router (stream)
+            wants_stream = (
+                (isinstance(payload, dict) and bool(payload.get("stream")))
+                or request.query.get("stream") in ("1", "true")
+            )
+            if wants_stream:
+                gen = handle.options(method_name="__http__", stream=True).remote(
+                    request_dict)
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream",
+                             "Cache-Control": "no-cache"})
+                await resp.prepare(request)
+
+                _end = object()
+
+                def pull():
+                    try:
+                        return next(gen)
+                    except StopIteration:
+                        return _end
+
+                try:
+                    while True:
+                        chunk = await loop.run_in_executor(None, pull)
+                        if chunk is _end:
+                            break
+                        if isinstance(chunk, bytes):
+                            await resp.write(chunk)
+                        elif isinstance(chunk, str):
+                            await resp.write(chunk.encode())
+                        else:
+                            await resp.write(json.dumps(chunk).encode() + b"\n")
+                except Exception as e:  # noqa: BLE001 — mid-stream: terminate body
+                    await resp.write(f"\nerror: {e!r}\n".encode())
+                await resp.write_eof()
+                return resp
+
             def call():
                 return handle.options(method_name="__http__").remote(request_dict).result()
 
