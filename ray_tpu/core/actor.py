@@ -41,6 +41,9 @@ def extract_method_meta(cls) -> Dict[str, Dict[str, Any]]:
         meta[name] = {
             "num_returns": getattr(member, "_num_returns", 1),
             "concurrency_group": getattr(member, "_concurrency_group", ""),
+            # async def methods run interleaved on the actor's event loop
+            # (reference python/ray/actor.py:2352 async actors)
+            "is_async": inspect.iscoroutinefunction(member),
         }
     return meta
 

@@ -29,6 +29,12 @@ from .object_ref import ObjectRef
 from .task_spec import TaskSpec, _RefMarker
 
 
+import contextvars
+
+_ASYNC_TASK_ID: "contextvars.ContextVar[Optional[TaskID]]" = contextvars.ContextVar(
+    "rt_async_task_id", default=None)
+
+
 class _ThreadPerCallExecutor:
     """Unbounded concurrency group (size 0): one daemon thread per call, so
     arbitrarily many parked calls (long-poll listeners) never exhaust a pool."""
@@ -62,12 +68,21 @@ class WorkerContext:
         self._method_pool = None
         self._group_pools: Dict[str, Any] = {}  # concurrency group -> executor
         self._method_groups: Dict[str, str] = {}  # method name -> default group
+        self._async_methods: set = set()  # async def methods (per-actor event loop)
+        self._actor_loop = None  # asyncio loop thread, created on demand
         # per-thread: concurrent methods of a threaded actor each track their own task
         self._task_ctx = threading.local()
+        self._loop_lock = threading.Lock()  # guards _actor_loop creation
         self._exit = False
 
     @property
     def current_task_id(self) -> Optional[TaskID]:
+        # async actor methods interleave on one loop thread, so their identity
+        # is context-local (each asyncio.Task owns a contextvars copy); sync
+        # paths fall back to the thread-local
+        async_id = _ASYNC_TASK_ID.get()
+        if async_id is not None:
+            return async_id
         return getattr(self._task_ctx, "task_id", None)
 
     @current_task_id.setter
@@ -304,6 +319,17 @@ class WorkerContext:
         # their own pools so e.g. parked long-poll listeners can never exhaust
         # the default pool and starve control RPCs.
         if spec.kind == "actor_method":
+            if (spec.method_name in self._async_methods
+                    and spec.num_returns != -1):
+                # async actor method: schedule on the per-actor event loop so
+                # any number of in-flight calls interleave at awaits
+                # (reference actor.py:2352); streaming calls keep the thread
+                # path (sync-generator protocol)
+                import asyncio
+
+                asyncio.run_coroutine_threadsafe(
+                    self._execute_async(spec, resolved_locs), self._ensure_actor_loop())
+                return
             group = spec.concurrency_group or self._method_groups.get(
                 spec.method_name or "", "")
             if group:
@@ -406,6 +432,10 @@ class WorkerContext:
                     for name, m in (spec.method_meta or {}).items()
                     if m.get("concurrency_group")
                 }
+                self._async_methods = {
+                    name for name, m in (spec.method_meta or {}).items()
+                    if m.get("is_async")
+                }
                 results = [None]
             elif spec.kind == "actor_method":
                 if spec.method_name == "__ray_call__":
@@ -430,6 +460,46 @@ class WorkerContext:
         finally:
             self.current_task_id = None
 
+    def _ensure_actor_loop(self):
+        """The actor's asyncio loop, running on its own daemon thread. ONE loop
+        per actor: dispatch and method-pool threads may race to create it, and
+        asyncio primitives bind to the loop they were created on."""
+        with self._loop_lock:
+            if self._actor_loop is None:
+                import asyncio
+
+                loop = asyncio.new_event_loop()
+                threading.Thread(target=loop.run_forever, daemon=True,
+                                 name="actor-asyncio").start()
+                self._actor_loop = loop
+            return self._actor_loop
+
+    async def _execute_async(self, spec: TaskSpec, resolved_locs: List) -> None:
+        """Async actor method body: resolve args, await the coroutine, report.
+        Runs ON the actor loop; blocking work inside belongs in executors."""
+        import contextlib
+
+        _ASYNC_TASK_ID.set(spec.task_id)  # task-scoped (per-asyncio.Task context)
+        try:
+            if spec.trace_ctx is not None:
+                from ray_tpu.util import tracing
+
+                tracing.enable_tracing()
+                tracing.set_trace_context(spec.trace_ctx)
+                span_cm = tracing.span(f"task::{spec.name}", {"kind": spec.kind})
+            else:
+                span_cm = contextlib.nullcontext()
+            with span_cm:
+                args, kwargs = self._resolve_args(spec, resolved_locs)
+                method = getattr(self.actor_instance, spec.method_name)
+                out = await method(*args, **kwargs)
+                results = self._split_returns(out, spec.num_returns)
+                payload = [(oid, object_store.materialize(value, oid))
+                           for oid, value in zip(spec.return_ids, results)]
+                self._send(("result", spec.task_id, payload, None))
+        except BaseException as e:  # noqa: BLE001
+            self._send_error(spec, e)
+
     def _execute_streaming(self, spec: TaskSpec, args, kwargs) -> None:
         from .object_ref import stream_item_id
 
@@ -441,7 +511,32 @@ class WorkerContext:
         else:
             out = self._load_fn(spec)(*args, **kwargs)
         count = 0
-        if not hasattr(out, "__next__"):
+        import inspect as _inspect
+
+        if _inspect.iscoroutine(out):
+            # plain async def under a streaming call: await it, then stream the
+            # result as one item (mirrors the sync non-iterator case below)
+            import asyncio
+
+            out = iter((asyncio.run_coroutine_threadsafe(
+                out, self._ensure_actor_loop()).result(),))
+        if hasattr(out, "__anext__"):
+            # async generator (async def + yield): drive it on the actor loop,
+            # itemizing from this thread
+            import asyncio
+
+            loop = self._ensure_actor_loop()
+
+            def drain(agen):
+                while True:
+                    try:
+                        yield asyncio.run_coroutine_threadsafe(
+                            agen.__anext__(), loop).result()
+                    except StopAsyncIteration:
+                        return
+
+            out = drain(out)
+        elif not hasattr(out, "__next__"):
             # non-iterator return under a streaming call: a one-item stream
             # (lists/dicts must not be exploded into their elements)
             out = iter((out,))

@@ -140,6 +140,12 @@ class LLMServer:
             # UTF-8, diverging from the non-streaming response text
             all_ids: List[int] = []
             emitted = ""
+
+            def delta_frame(delta_text):
+                delta = {"content": delta_text} if chat else delta_text
+                return frame({"id": rid, "object": obj, "created": created,
+                              "model": model, "choices": choices(delta, None)})
+
             for out in self.engine.generate(prompt, _sampling_from_body(body)):
                 finish = out.finish_reason
                 all_ids.extend(out.token_ids)
@@ -149,9 +155,12 @@ class LLMServer:
                 delta_text = full[len(emitted):]
                 emitted = full
                 if delta_text:
-                    delta = {"content": delta_text} if chat else delta_text
-                    yield frame({"id": rid, "object": obj, "created": created,
-                                 "model": model, "choices": choices(delta, None)})
+                    yield delta_frame(delta_text)
+            # flush a tail withheld by the mid-codepoint guard (generation can
+            # legitimately stop mid-sequence at max_tokens): match generate_sync
+            tail = tokenizer.decode(all_ids)[len(emitted):]
+            if tail:
+                yield delta_frame(tail)
             yield frame({"id": rid, "object": obj, "created": created,
                          "model": model,
                          "choices": choices({} if chat else "", finish or "stop")})

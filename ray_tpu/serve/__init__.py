@@ -21,6 +21,7 @@ from .schema import apply_config, apply_config_file  # noqa: F401
 from .config import AutoscalingConfig, DeploymentConfig  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from .asgi import ingress  # noqa: F401
 
 __all__ = [
     "deployment",
@@ -38,6 +39,7 @@ __all__ = [
     "AutoscalingConfig",
     "DeploymentConfig",
     "batch",
+    "ingress",
     "multiplexed",
     "get_multiplexed_model_id",
     "apply_config",

@@ -1,0 +1,120 @@
+"""ASGI ingress: host any ASGI app (FastAPI, Starlette, raw) in a deployment.
+
+Capability parity: reference python/ray/serve/_private/replica.py:72
+(ASGIAppReplicaWrapper) + serve.ingress (python/ray/serve/api.py) — the proxy's
+request dict is translated into an ASGI HTTP scope, the app is driven on an
+event loop, and the collected status/headers/body travel back through the
+handle as a raw-response marker the proxy unwraps verbatim.
+
+The image ships no FastAPI; anything speaking the ASGI 3.0 callable protocol
+(`await app(scope, receive, send)`) works, which is exactly what FastAPI/
+Starlette produce.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List
+
+RAW_RESPONSE_KEY = "__serve_raw_http__"
+
+
+def make_raw_response(status: int, headers: List, body: bytes) -> Dict[str, Any]:
+    return {RAW_RESPONSE_KEY: True, "status": status,
+            "headers": [(k.decode() if isinstance(k, bytes) else k,
+                         v.decode() if isinstance(v, bytes) else v)
+                        for k, v in headers],
+            "body": body}
+
+
+def _scope_from_request(request: Dict[str, Any]) -> Dict[str, Any]:
+    query = "&".join(f"{k}={v}" for k, v in (request.get("query") or {}).items())
+    return {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.get("method", "GET"),
+        "scheme": "http",
+        "path": request.get("path", "/"),
+        "raw_path": request.get("path", "/").encode(),
+        "query_string": query.encode(),
+        "root_path": "",
+        "headers": [(k.lower().encode(), v.encode())
+                    for k, v in (request.get("headers") or {}).items()],
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 80),
+    }
+
+
+def _body_bytes(request: Dict[str, Any]) -> bytes:
+    body = request.get("body")
+    if body is None:
+        return b""
+    if isinstance(body, bytes):
+        return body
+    if isinstance(body, str):
+        return body.encode()
+    return json.dumps(body).encode()
+
+
+async def _run_asgi(app, scope: Dict[str, Any], body: bytes) -> Dict[str, Any]:
+    received = False
+    messages: List[Dict[str, Any]] = []
+
+    async def receive():
+        nonlocal received
+        if received:
+            await asyncio.sleep(3600)  # app awaiting disconnect; never resolves
+        received = True
+        return {"type": "http.request", "body": body, "more_body": False}
+
+    async def send(message):
+        messages.append(message)
+
+    await app(scope, receive, send)
+    status, headers, out = 500, [], b""
+    for m in messages:
+        if m["type"] == "http.response.start":
+            status = m["status"]
+            headers = list(m.get("headers") or [])
+        elif m["type"] == "http.response.body":
+            out += m.get("body", b"")
+    return make_raw_response(status, headers, out)
+
+
+class ASGIAppWrapper:
+    """Mixes an ASGI app into a deployment class (reference
+    ASGIAppReplicaWrapper): Serve's __http__ path drives the app."""
+
+    _asgi_app = None  # set by ingress()
+
+    def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        scope = _scope_from_request(request)
+        return asyncio.run(_run_asgi(self._asgi_app, scope, _body_bytes(request)))
+
+
+def ingress(app):
+    """Class decorator: serve requests for this deployment through an ASGI app.
+
+        app = FastAPI()
+
+        @serve.deployment
+        @serve.ingress(app)
+        class Ingress:
+            @app.get("/hello")
+            def hello(self):
+                return "hi"
+
+    The decorated class gains handle_http (driving the app); FastAPI-style
+    bound routes keep working because FastAPI resolves `self` through its own
+    dependency injection when routes are defined on the class. Raw ASGI apps
+    ignore the instance entirely.
+    """
+
+    def deco(cls):
+        # staticmethod: a plain-function app must not be bound as a method when
+        # accessed through the instance (FastAPI apps are instances; unaffected)
+        return type(cls.__name__, (cls, ASGIAppWrapper),
+                    {"_asgi_app": staticmethod(app)})
+
+    return deco

@@ -72,6 +72,7 @@ class ProxyActor:
                 "path": request.path[len(prefix.rstrip("/")):] or "/",
                 "method": request.method,
                 "query": dict(request.query),
+                "headers": dict(request.headers),
                 "body": payload,
             }
 
@@ -85,32 +86,59 @@ class ProxyActor:
                 or request.query.get("stream") in ("1", "true")
             )
             if wants_stream:
-                gen = handle.options(method_name="__http__", stream=True).remote(
-                    request_dict)
+                # handle.remote() blocks on replica discovery (up to 30s):
+                # executor, never the event loop
+                def start_stream():
+                    return handle.options(method_name="__http__",
+                                          stream=True).remote(request_dict)
+
+                _end = object()
+
+                def make_pull(g):
+                    def pull():
+                        try:
+                            return next(g)
+                        except StopIteration:
+                            return _end
+                    return pull
+
+                try:
+                    gen = await loop.run_in_executor(None, start_stream)
+                    pull = make_pull(gen)
+                    first = await loop.run_in_executor(None, pull)
+                    # "stream": true is an OpenAI convention; a deployment that
+                    # returned one plain JSON value was not actually streaming —
+                    # answer with ordinary JSON instead of a one-blob SSE body
+                    if isinstance(first, (dict, list)):
+                        second = await loop.run_in_executor(None, pull)
+                        if second is _end:
+                            return web.json_response(first)
+                        pending = [first, second]
+                    else:
+                        pending = [] if first is _end else [first]
+                except Exception as e:  # noqa: BLE001 - surface as 500
+                    return web.Response(status=500, text=repr(e))
                 resp = web.StreamResponse(
                     headers={"Content-Type": "text/event-stream",
                              "Cache-Control": "no-cache"})
                 await resp.prepare(request)
 
-                _end = object()
-
-                def pull():
-                    try:
-                        return next(gen)
-                    except StopIteration:
-                        return _end
+                async def write_chunk(chunk):
+                    if isinstance(chunk, bytes):
+                        await resp.write(chunk)
+                    elif isinstance(chunk, str):
+                        await resp.write(chunk.encode())
+                    else:
+                        await resp.write(json.dumps(chunk).encode() + b"\n")
 
                 try:
+                    for chunk in pending:
+                        await write_chunk(chunk)
                     while True:
                         chunk = await loop.run_in_executor(None, pull)
                         if chunk is _end:
                             break
-                        if isinstance(chunk, bytes):
-                            await resp.write(chunk)
-                        elif isinstance(chunk, str):
-                            await resp.write(chunk.encode())
-                        else:
-                            await resp.write(json.dumps(chunk).encode() + b"\n")
+                        await write_chunk(chunk)
                 except Exception as e:  # noqa: BLE001 — mid-stream: terminate body
                     await resp.write(f"\nerror: {e!r}\n".encode())
                 await resp.write_eof()
@@ -123,6 +151,20 @@ class ProxyActor:
                 result = await loop.run_in_executor(None, call)
             except Exception as e:  # noqa: BLE001 - surface as 500
                 return web.Response(status=500, text=repr(e))
+            from .asgi import RAW_RESPONSE_KEY
+
+            if isinstance(result, dict) and result.get(RAW_RESPONSE_KEY):
+                # ASGI deployments return verbatim status/headers/body; repeated
+                # header names (multiple Set-Cookie) must survive, so build a
+                # multidict rather than a plain dict
+                from multidict import CIMultiDict
+
+                hdrs = CIMultiDict()
+                for k, v in result["headers"]:
+                    if k.lower() != "content-length":
+                        hdrs.add(k, v)
+                return web.Response(status=result["status"], body=result["body"],
+                                    headers=hdrs)
             if isinstance(result, (dict, list)):
                 return web.json_response(result)
             if isinstance(result, bytes):

@@ -61,8 +61,20 @@ class Replica:
             target = self.callable if callable(self.callable) else None
             if target is None:
                 raise AttributeError(f"deployment {self.deployment_name} is not callable")
-            return target(*args, **kwargs)
-        return getattr(self.callable, method_name)(*args, **kwargs)
+            return self._maybe_await(target(*args, **kwargs))
+        return self._maybe_await(getattr(self.callable, method_name)(*args, **kwargs))
+
+    @staticmethod
+    def _maybe_await(out: Any) -> Any:
+        """async def deployment methods: run the coroutine to completion on this
+        request's thread (replicas are threaded actors, so concurrent requests
+        still overlap; reference replica.py async user callables). Async
+        generators pass through — the streaming path drives them."""
+        if inspect.iscoroutine(out):
+            import asyncio
+
+            return asyncio.run(out)
+        return out
 
     # -- control plane ---------------------------------------------------------
     def check_health(self) -> bool:
