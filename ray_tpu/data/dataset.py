@@ -137,7 +137,13 @@ class Dataset:
 
     # -- consumption ----------------------------------------------------------
     def iterator(self) -> DataIterator:
-        return DataIterator(self._bundles())
+        if self._materialized is not None:
+            return DataIterator(self._materialized)
+        # not yet materialized: stream — batches yield while upstream reads/maps
+        # are still producing, and early stops (take/limit) halt upstream work
+        ex = StreamingExecutor(self._ctx)
+        self._stats = ex.stats
+        return DataIterator(ex.execute_iter(self._plan))
 
     def iter_batches(self, **kw) -> Iterator[Any]:
         return self.iterator().iter_batches(**kw)

@@ -8,12 +8,13 @@ as in the reference.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import time
 import types
 import zlib
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -133,6 +134,10 @@ def _partition_by_boundaries(block: Block, key: str, boundaries: List[Any]) -> L
 
 def _merge_sorted(key: str, descending: bool, *parts: Block) -> Tuple[Block, BlockMetadata]:
     merged = BlockAccessor.concat(list(parts))
+    if BlockAccessor.for_block(merged).num_rows() == 0 and parts:
+        # concat() drops 0-row blocks; an all-empty partition (few distinct sort
+        # keys across many blocks) must keep its schema so sort_by still resolves
+        merged = BlockAccessor.for_block(parts[0]).slice(0, 0)
     merged = BlockAccessor.for_block(merged).sort(key, descending)
     return merged, BlockAccessor.for_block(merged).get_metadata()
 
@@ -283,110 +288,149 @@ RefBundle = Tuple[Any, BlockMetadata]  # (ObjectRef[Block] | Block, metadata)
 
 
 class StreamingExecutor:
-    """Lower an optimized logical plan and run it (reference streaming_executor.py:52).
+    """Lower an optimized logical plan and run it as a pull-based operator
+    topology (reference streaming_executor.py:52 + streaming_executor_state.py).
 
-    Map/read/write stages stream with at most ctx.max_inflight_tasks_per_op concurrent
-    tasks per stage (backpressure); all-to-all stages barrier.
+    Every operator is a generator over RefBundles consuming its upstream
+    generator: downstream tasks start as soon as ANY upstream bundle lands —
+    no barrier between stages. Read/map/write stages keep at most
+    ctx.max_inflight_tasks_per_op tasks in flight (per-op backpressure);
+    all-to-all stages (sort/shuffle/join/...) inherently consume their whole
+    input before producing.
     """
 
     def __init__(self, ctx: Optional[DataContext] = None):
         self.ctx = ctx or DataContext.get_current()
         self.stats = DatasetStats()
+        # nesting ledger for exclusive per-op wall time: pulling a downstream
+        # op transitively produces upstream, so inclusive timing would charge
+        # the read's seconds to every later stage too
+        self._time_stack: List[float] = []
 
     # -- public ---------------------------------------------------------------
     def execute(self, plan: L.LogicalOperator) -> List[RefBundle]:
+        return list(self.execute_iter(plan))
+
+    def execute_iter(self, plan: L.LogicalOperator) -> Iterator[RefBundle]:
+        """Lazily yield output bundles while upstream operators keep running."""
         plan = L.optimize(plan)
-        bundles: List[RefBundle] = []
+        stream: Iterator[RefBundle] = iter(())
         for op in plan.chain():
-            bundles = self._execute_op(op, bundles)
-        return bundles
+            stream = self._op_iter(op, stream)
+        return stream
 
     # -- per-op dispatch ------------------------------------------------------
-    def _execute_op(self, op: L.LogicalOperator, inputs: List[RefBundle]) -> List[RefBundle]:
-        t0 = time.perf_counter()
-        name = op.name
+    def _op_iter(self, op: L.LogicalOperator, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
         if isinstance(op, L.InputData):
-            out = [(b, m) for b, m in zip(op.blocks, op.metadata)]
+            gen: Iterator[RefBundle] = iter(list(zip(op.blocks, op.metadata)))
         elif isinstance(op, L.Read):
-            out = self._run_read(op)
+            gen = self._read_iter(op)
         elif isinstance(op, L.AbstractMap):
-            out = self._run_map(op, inputs)
+            gen = self._map_iter(op, upstream)
         elif isinstance(op, L.Limit):
-            out = self._run_limit(op, inputs)
-        elif isinstance(op, L.Sort):
-            out = self._run_sort(op, inputs)
-        elif isinstance(op, L.RandomShuffle):
-            out = self._run_shuffle(op, inputs)
-        elif isinstance(op, L.Repartition):
-            out = self._run_repartition(op, inputs)
-        elif isinstance(op, L.Aggregate):
-            out = self._run_aggregate(op, inputs)
+            gen = self._limit_iter(op, upstream)
         elif isinstance(op, L.Union):
-            out = list(inputs)
-            for other in op.others:
-                out.extend(StreamingExecutor(self.ctx).execute(other))
-        elif isinstance(op, L.Join):
-            out = self._run_join(op, inputs)
-        elif isinstance(op, L.Zip):
-            out = self._run_zip(op, inputs)
+            gen = self._union_iter(op, upstream)
         elif isinstance(op, L.Write):
-            out = self._run_write(op, inputs)
+            gen = self._write_iter(op, upstream)
         else:
-            raise NotImplementedError(f"op {op}")
-        self.stats.ops.append(
-            OpStats(name=name, wall_s=time.perf_counter() - t0, num_outputs=len(out),
-                    output_rows=sum(m.num_rows for _, m in out if m.num_rows >= 0))
-        )
-        return out
+            gen = self._all_to_all_iter(op, upstream)
+        return self._with_stats(op.name, gen)
 
-    # -- streaming map machinery ----------------------------------------------
-    def _stream_tasks(self, submits: List[Any]) -> List[RefBundle]:
-        """Run thunks with bounded in-flight tasks; preserve input order.
+    def _with_stats(self, name: str, gen: Iterator[RefBundle]) -> Iterator[RefBundle]:
+        """Track per-op EXCLUSIVE wall time (producing, minus time spent inside
+        upstream wrappers) + output counts; records stats even when the consumer
+        stops early (take/limit)."""
+        wall = 0.0
+        n = 0
+        rows = 0
 
-        Each thunk submits a num_returns=2 task -> (block_ref, meta_ref). Only metadata
-        is fetched to the driver; blocks stay in the object store (no driver funnel).
+        def timed_next():
+            nonlocal wall
+            t0 = time.perf_counter()
+            self._time_stack.append(0.0)
+            try:
+                return next(gen)
+            finally:
+                dt = time.perf_counter() - t0
+                upstream_dt = self._time_stack.pop()
+                wall += dt - upstream_dt
+                if self._time_stack:
+                    self._time_stack[-1] += dt
+
+        try:
+            while True:
+                try:
+                    bundle = timed_next()
+                except StopIteration:
+                    return
+                n += 1
+                if bundle[1].num_rows >= 0:
+                    rows += bundle[1].num_rows
+                yield bundle
+        finally:
+            self.stats.ops.append(
+                OpStats(name=name, wall_s=wall, num_outputs=n, output_rows=rows))
+
+    # -- streaming stages ------------------------------------------------------
+    def _stream_tasks_iter(self, thunks: Iterator[Any]) -> Iterator[RefBundle]:
+        """Bounded-in-flight task pump: pull a thunk (which may lazily pull the
+        upstream stage), submit, and yield completed bundles in input order.
+        Pulling thunks only while under the cap IS the backpressure — a slow
+        downstream stops draining, this op stops submitting, and its upstream
+        stops being pulled (reference backpressure_policy/).
+
+        Each thunk submits a num_returns=2 task -> (block_ref, meta_ref). Only
+        metadata is fetched to the driver; blocks stay in the object store.
         """
         cap = self.ctx.max_inflight_tasks_per_op
         results: Dict[int, RefBundle] = {}
         inflight: Dict[Any, Tuple[int, Any]] = {}
-        it = iter(enumerate(submits))
-        pending = True
-        while pending or inflight:
-            while pending and len(inflight) < cap:
+        next_submit = 0
+        next_yield = 0
+        exhausted = False
+        while True:
+            while not exhausted and len(inflight) < cap:
                 try:
-                    i, thunk = next(it)
+                    thunk = next(thunks)
                 except StopIteration:
-                    pending = False
+                    exhausted = True
                     break
                 block_ref, meta_ref = thunk()
-                inflight[meta_ref] = (i, block_ref)
+                inflight[meta_ref] = (next_submit, block_ref)
+                next_submit += 1
+            while next_yield in results:
+                yield results.pop(next_yield)
+                next_yield += 1
             if not inflight:
+                if exhausted and next_yield >= next_submit:
+                    return
                 continue
             done, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=10.0)
             for meta_ref in done:
                 i, block_ref = inflight.pop(meta_ref)
                 results[i] = (block_ref, ray_tpu.get(meta_ref))
-        return [results[i] for i in sorted(results)]
 
-    def _run_read(self, op: L.Read) -> List[RefBundle]:
+    def _read_iter(self, op: L.Read) -> Iterator[RefBundle]:
         parallelism = op.parallelism if op.parallelism > 0 else self.ctx.read_op_min_num_blocks
         read_tasks = op.datasource.get_read_tasks(parallelism)
         fused_specs = getattr(op, "_fused_specs", [])
         remote_read = _remote(_read_task_fn).options(num_returns=2)
-        return self._stream_tasks([
+        return self._stream_tasks_iter(
             (lambda rt=rt: remote_read.remote(rt.fn, fused_specs)) for rt in read_tasks
-        ])
+        )
 
-    def _run_map(self, op: L.AbstractMap, inputs: List[RefBundle]) -> List[RefBundle]:
+    def _map_iter(self, op: L.AbstractMap, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
         opts = {k: v for k, v in op.ray_remote_args.items() if k in ("num_cpus", "num_tpus", "resources")}
         if op.compute == "actors":
-            return self._run_actor_pool_map(op, inputs, opts)
+            return self._actor_pool_map_iter(op, upstream, opts)
         remote_map = _remote(_map_block, **opts).options(num_returns=2)
-        return self._stream_tasks([
-            (lambda b=b: remote_map.remote(op.specs, b)) for b, _ in inputs
-        ])
+        return self._stream_tasks_iter(
+            (lambda b=b: remote_map.remote(op.specs, b)) for b, _ in upstream
+        )
 
-    def _run_actor_pool_map(self, op: L.AbstractMap, inputs: List[RefBundle], opts) -> List[RefBundle]:
+    def _actor_pool_map_iter(self, op: L.AbstractMap, upstream: Iterator[RefBundle],
+                             opts) -> Iterator[RefBundle]:
         conc = op.concurrency
         if isinstance(conc, tuple):
             pool_size = conc[1]
@@ -394,7 +438,11 @@ class StreamingExecutor:
             pool_size = conc
         else:
             pool_size = self.ctx.actor_pool_max_size
-        pool_size = max(1, min(pool_size, len(inputs) or 1))
+        # the input length is unknown in the pull model, but the pool must fit
+        # the cluster: an all-actors ready() barrier over more actors than free
+        # CPUs would deadlock the pipeline
+        total_cpus = ray_tpu.cluster_resources().get("CPU", 1.0)
+        pool_size = max(1, min(pool_size, int(total_cpus) or 1))
         Worker = ray_tpu.remote(**({"num_cpus": 1} | opts))(_MapWorker)
         actors = [Worker.remote(op.specs) for _ in range(pool_size)]
         ray_tpu.get([a.ready.remote() for a in actors])
@@ -402,48 +450,95 @@ class StreamingExecutor:
             results: Dict[int, RefBundle] = {}
             idle = deque(actors)
             inflight: Dict[Any, Tuple[int, Any, Any]] = {}
-            queue = deque(enumerate(inputs))
-            while queue or inflight:
-                while queue and idle:
-                    i, (b, _) = queue.popleft()
+            next_submit = 0
+            next_yield = 0
+            exhausted = False
+            while True:
+                while not exhausted and idle:
+                    try:
+                        b, _ = next(upstream)
+                    except StopIteration:
+                        exhausted = True
+                        break
                     actor = idle.popleft()
                     block_ref, meta_ref = actor.map_block.options(num_returns=2).remote(b)
-                    inflight[meta_ref] = (i, actor, block_ref)
+                    inflight[meta_ref] = (next_submit, actor, block_ref)
+                    next_submit += 1
+                while next_yield in results:
+                    yield results.pop(next_yield)
+                    next_yield += 1
+                if not inflight:
+                    if exhausted and next_yield >= next_submit:
+                        return
+                    continue
                 done, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=10.0)
                 for meta_ref in done:
                     i, actor, block_ref = inflight.pop(meta_ref)
                     idle.append(actor)
                     results[i] = (block_ref, ray_tpu.get(meta_ref))
-            return [results[i] for i in sorted(results)]
         finally:
             for a in actors:
                 ray_tpu.kill(a)
 
-    def _run_write(self, op: L.Write, inputs: List[RefBundle]) -> List[RefBundle]:
-        remote_write = _remote(_write_block)
-        refs = [remote_write.remote(op.datasink, b, i) for i, (b, _) in enumerate(inputs)]
-        out = []
-        for r in refs:
-            path, rows = ray_tpu.get(r)
-            out.append((ray_tpu.put(pa.table({"path": [path], "num_rows": [rows]})), BlockMetadata(1, 0)))
-        return out
-
-    # -- all-to-all ------------------------------------------------------------
-    def _run_limit(self, op: L.Limit, inputs: List[RefBundle]) -> List[RefBundle]:
-        out, remaining = [], op.limit
-        for b, m in inputs:
-            if remaining <= 0:
-                break
+    def _limit_iter(self, op: L.Limit, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+        remaining = op.limit
+        if remaining <= 0:
+            return
+        for b, m in upstream:
             n = m.num_rows if m.num_rows >= 0 else BlockAccessor.for_block(ray_tpu.get(b)).num_rows()
             if n <= remaining:
-                out.append((b, m))
+                yield (b, m)
                 remaining -= n
             else:
                 block = BlockAccessor.for_block(ray_tpu.get(b)).slice(0, remaining)
-                out.append((ray_tpu.put(block), BlockAccessor.for_block(block).get_metadata()))
+                yield (ray_tpu.put(block), BlockAccessor.for_block(block).get_metadata())
                 remaining = 0
-        return out
+            if remaining <= 0:
+                # return BEFORE pulling again: one more next(upstream) would
+                # submit (and block on) a full window of unneeded upstream tasks
+                return
 
+    def _union_iter(self, op: L.Union, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+        yield from upstream
+        for other in op.others:
+            yield from StreamingExecutor(self.ctx).execute_iter(other)
+
+    def _write_iter(self, op: L.Write, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+        remote_write = _remote(_write_block)
+        counter = itertools.count()
+        cap = self.ctx.max_inflight_tasks_per_op
+        inflight: deque = deque()
+        for b, _ in upstream:
+            inflight.append(remote_write.remote(op.datasink, b, next(counter)))
+            if len(inflight) >= cap:
+                path, rows = ray_tpu.get(inflight.popleft())
+                yield (ray_tpu.put(pa.table({"path": [path], "num_rows": [rows]})),
+                       BlockMetadata(1, 0))
+        while inflight:
+            path, rows = ray_tpu.get(inflight.popleft())
+            yield (ray_tpu.put(pa.table({"path": [path], "num_rows": [rows]})),
+                   BlockMetadata(1, 0))
+
+    # -- all-to-all (inherent barrier on input) --------------------------------
+    def _all_to_all_iter(self, op: L.LogicalOperator, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+        inputs = list(upstream)
+        if isinstance(op, L.Sort):
+            out = self._run_sort(op, inputs)
+        elif isinstance(op, L.RandomShuffle):
+            out = self._run_shuffle(op, inputs)
+        elif isinstance(op, L.Repartition):
+            out = self._run_repartition(op, inputs)
+        elif isinstance(op, L.Aggregate):
+            out = self._run_aggregate(op, inputs)
+        elif isinstance(op, L.Join):
+            out = self._run_join(op, inputs)
+        elif isinstance(op, L.Zip):
+            out = self._run_zip(op, inputs)
+        else:
+            raise NotImplementedError(f"op {op}")
+        yield from out
+
+    # -- all-to-all ------------------------------------------------------------
     def _sample_boundaries(self, inputs: List[RefBundle], key: str, n_parts: int) -> List[Any]:
         samples = []
         for b, _ in inputs[: max(n_parts * 2, 8)]:

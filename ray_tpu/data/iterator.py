@@ -18,35 +18,72 @@ from .block import BlockAccessor
 
 
 class DataIterator:
-    """Iterates batches over a materialized list of (block_ref, metadata) bundles."""
+    """Iterates batches over (block_ref, metadata) bundles — a materialized list
+    OR a live execute_iter() generator, in which case batches yield while
+    upstream operators are still producing (reference iter_batches streaming)."""
 
-    def __init__(self, bundles: List[Any]):
+    def __init__(self, bundles: Any):
         self._bundles = bundles
+        self._consumed = False
 
     def _iter_blocks(self, prefetch_blocks: int = 1):
-        refs = [b for b, _ in self._bundles]
-        if not refs:
-            return
+        if self._consumed and not isinstance(self._bundles, (list, tuple)):
+            raise RuntimeError(
+                "this DataIterator streams a live execution and was already "
+                "consumed; call Dataset.iterator() again (re-executes) or "
+                "Dataset.materialize() first for multi-epoch iteration")
+        self._consumed = True
         q: _queue.Queue = _queue.Queue(maxsize=max(1, prefetch_blocks))
         SENTINEL = object()
+        stop = threading.Event()
+
+        def offer(item) -> bool:
+            """put() that gives up when the consumer abandoned us."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
-                for r in refs:
-                    q.put(ray_tpu.get(r))
-                q.put(SENTINEL)
+                for r, _ in self._bundles:
+                    if not offer(ray_tpu.get(r)):
+                        break
+                else:
+                    offer(SENTINEL)
             except BaseException as e:  # noqa: BLE001 - re-raised in the consumer
-                q.put(e)
+                offer(e)
+            finally:
+                if stop.is_set():
+                    # consumer stopped early: close the live execution generator
+                    # HERE (this thread is its only driver) so every stage's
+                    # finally runs — actor pools killed, stats recorded
+                    close = getattr(self._bundles, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:
+                            pass
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is SENTINEL:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            try:
+                q.get_nowait()  # wake a producer blocked mid-put
+            except _queue.Empty:
+                pass
 
     def iter_batches(
         self,

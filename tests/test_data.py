@@ -146,3 +146,12 @@ def test_random_sample_and_train_test_split(rt):
     assert train.count() == 80 and test.count() == 20
     s = ds.random_sample(0.5, seed=0)
     assert 20 < s.count() < 80
+
+
+def test_sort_few_distinct_values_empty_partitions(rt):
+    """Sorting few rows across many single-row blocks creates all-empty merge
+    partitions; they must keep their schema (regression: ArrowInvalid)."""
+    import ray_tpu.data as rtd
+
+    ds = rtd.from_items([{"x": v} for v in [5, 3, 9, 1]]).sort("x")
+    assert [r["x"] for r in ds.take_all()] == [1, 3, 5, 9]
