@@ -1,0 +1,102 @@
+"""Core-plane microbenchmark (reference python/ray/_private/ray_perf.py:95-317).
+
+Measures the task/actor/object hot paths; writes CORE_BENCH.json. Run:
+    JAX_PLATFORMS=cpu python core_bench.py
+"""
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def timed(fn, n):
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                 max_workers_per_node=8)
+    results = {}
+
+    @ray_tpu.remote(num_cpus=0.1, max_retries=0)
+    def nop():
+        return None
+
+    @ray_tpu.remote(num_cpus=0.1)
+    class Counter:
+        def nop(self):
+            return None
+
+        async def anop(self):
+            return None
+
+    # warm-up: spawn workers + import paths
+    ray_tpu.get([nop.remote() for _ in range(20)])
+
+    N = 2000
+    results["tasks_per_s"] = timed(
+        lambda: ray_tpu.get([nop.remote() for _ in range(N)]), N)
+
+    a = Counter.remote()
+    ray_tpu.get(a.nop.remote())
+    results["actor_calls_per_s"] = timed(
+        lambda: ray_tpu.get([a.nop.remote() for _ in range(N)]), N)
+
+    results["actor_calls_sync_per_s"] = timed(
+        lambda: [ray_tpu.get(a.nop.remote()) for _ in range(500)], 500)
+
+    results["async_actor_calls_per_s"] = timed(
+        lambda: ray_tpu.get([a.anop.remote() for _ in range(N)]), N)
+
+    small = b"x" * 100
+    results["put_small_per_s"] = timed(
+        lambda: [ray_tpu.put(small) for _ in range(N)], N)
+
+    refs = [ray_tpu.put(small) for _ in range(N)]
+    results["get_small_per_s"] = timed(lambda: ray_tpu.get(refs), N)
+
+    big = np.zeros(1_250_000, dtype=np.float64)  # 10 MB
+    ray_tpu.put(big)  # warm the arena growth path
+    put_times = []
+    big_refs = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        big_refs.append(ray_tpu.put(big))
+        put_times.append(time.perf_counter() - t0)
+    # best-of-N: byte throughput measures the copy path's capability; on a
+    # loaded/few-core machine the median mostly measures scheduler contention
+    # from the benchmark's own idle workers
+    results["put_10mb_gbps"] = big.nbytes / min(put_times) / 1e9
+    get_times = []
+    for r in big_refs:
+        t0 = time.perf_counter()
+        ray_tpu.get(r)
+        get_times.append(time.perf_counter() - t0)
+    results["get_10mb_gbps"] = big.nbytes / min(get_times) / 1e9
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def consume(x):
+        return None
+
+    arg_ref = ray_tpu.put(small)
+    results["tasks_with_arg_per_s"] = timed(
+        lambda: ray_tpu.get([consume.remote(arg_ref) for _ in range(N)]), N)
+
+    ray_tpu.shutdown()
+    for k, v in results.items():
+        print(f"{k}: {v:,.0f}" if v > 100 else f"{k}: {v:.2f}")
+    with open(os.path.join(os.path.dirname(__file__) or ".", "CORE_BENCH.json"), "w") as f:
+        json.dump({k: round(v, 2) for k, v in results.items()}, f, indent=2)
+    print("wrote CORE_BENCH.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -328,6 +328,11 @@ class Cluster:
         self.actors: Dict[ActorID, ActorState] = {}
         self.tasks: Dict[TaskID, TaskState] = {}
         self.pending: deque = deque()  # TaskSpecs waiting for dispatch
+        # waiting-task count per placement shape: lets submit() try an immediate
+        # dispatch ONLY when no same-shape task is queued ahead (per-shape FIFO —
+        # actor-method call order depends on it), and lets the dispatch pass stop
+        # as soon as every waiting shape is known blocked
+        self._pending_shape_counts: Dict[Any, int] = {}
         self.pending_pgs: List[PlacementGroup] = []
         self._lock = threading.RLock()
         self._nodes: Dict[NodeID, NodeRuntime] = {}
@@ -346,6 +351,7 @@ class Cluster:
         self._transfers: Dict[Tuple[ObjectID, str], threading.Event] = {}
         self._transfer_lock = threading.Lock()
         self._localizing: set = set()  # (task_id, host) with an in-flight arg pull
+        self._dispatch_blocked_on_args = False  # set by _try_dispatch (under _lock)
         self._pull_failures: Dict[TaskID, int] = {}  # consecutive arg-pull failures
         # streaming generator bookkeeping: items produced so far per task, and
         # the cutoff index past which an abandoned stream's items are dropped
@@ -946,8 +952,35 @@ class Cluster:
                     if not ok:
                         self._fail_returns(spec, ValueError(f"actor name {spec.actor_name!r} already taken"))
                         return
-            self.pending.append(spec)
-        self._schedule()
+            # fast path (reference: lease request straight to the local raylet):
+            # with no same-shape task queued ahead, dispatch NOW — the common
+            # uncongested case never pays a full scheduling pass
+            if not self._pending_shape_counts.get(self._shape_key(spec)):
+                if self._try_dispatch(spec):
+                    return
+            self._pending_append(spec)
+        if spec.kind == "actor_creation":
+            self._schedule()  # creations may need PG placement to run first
+
+    def _shape_key(self, spec: TaskSpec):
+        """THE key for _pending_shape_counts — every site must use this one
+        derivation or the waiting-count invariant silently breaks."""
+        shape = self._placement_shape(spec)
+        return shape if shape is not None else ("pg-task", spec.task_id)
+
+    def _pending_append(self, spec: TaskSpec) -> None:
+        """Caller holds the lock."""
+        key = self._shape_key(spec)
+        self._pending_shape_counts[key] = self._pending_shape_counts.get(key, 0) + 1
+        self.pending.append(spec)
+
+    def _rebuild_shape_counts(self) -> None:
+        """Caller holds the lock; used by rare bulk-mutation paths (drain)."""
+        counts: Dict[Any, int] = {}
+        for spec in self.pending:
+            key = self._shape_key(spec)
+            counts[key] = counts.get(key, 0) + 1
+        self._pending_shape_counts = counts
 
     # -- scheduling --------------------------------------------------------------------
     def _schedule(self) -> None:
@@ -962,16 +995,67 @@ class Cluster:
                     still_pgs.append(pg)
             self.pending_pgs = still_pgs
 
+            # Shape-based skip (reference: per-scheduling-class queues in
+            # cluster_task_manager): once a resource shape fails to place, every
+            # later task with the same shape is skipped without re-running
+            # placement — a 10k-deep homogeneous queue costs one failed attempt
+            # per pass instead of 10k.
+            # hopeful = waiting shapes not yet known blocked this pass; when it
+            # hits zero, splice the rest over at C speed instead of rotating
+            # task by task — a 10k-deep homogeneous backlog costs one placement
+            # attempt. Tracked incrementally: rebuilding the waiting set per
+            # popped task would make the pass O(pending x shapes).
+            blocked_shapes: set = set()
+            hopeful = len(self._pending_shape_counts)
             remaining = deque()
             while self.pending:
+                if hopeful <= 0:
+                    remaining.extend(self.pending)
+                    self.pending.clear()
+                    break
                 spec = self.pending.popleft()
                 ts = self.tasks.get(spec.task_id)
+                key = self._shape_key(spec)
                 if ts is None or ts.cancelled:
                     # terminal (failed during arg localization) or cancelled
+                    hopeful -= self._dec_shape(key, blocked_shapes)
+                    continue
+                if key in blocked_shapes:
+                    remaining.append(spec)
                     continue
                 if not self._try_dispatch(spec):
                     remaining.append(spec)
+                    if not self._dispatch_blocked_on_args:
+                        blocked_shapes.add(key)
+                        hopeful -= 1
+                else:
+                    hopeful -= self._dec_shape(key, blocked_shapes)
             self.pending = remaining
+
+    def _dec_shape(self, key, blocked_shapes: set) -> int:
+        """Decrement a shape's waiting count; returns 1 when the shape just
+        emptied while still hopeful (caller shrinks its hopeful counter)."""
+        c = self._pending_shape_counts.get(key, 0) - 1
+        if c > 0:
+            self._pending_shape_counts[key] = c
+            return 0
+        self._pending_shape_counts.pop(key, None)
+        return 0 if key in blocked_shapes else 1
+
+    @staticmethod
+    def _placement_shape(spec: TaskSpec):
+        """Hashable key for 'tasks that compete for identical placement'; None
+        when feasibility is task-specific (PG bundles)."""
+        if spec.kind == "actor_method":
+            return ("actor", spec.actor_id)
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, PlacementGroupSchedulingStrategy) or spec.pg_id is not None:
+            return None
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            skey = ("affinity", strategy.node_id, strategy.soft)
+        else:
+            skey = (strategy,)
+        return (spec.kind, skey, tuple(sorted(spec.resources.items())))
 
     def _args_ready(self, spec: TaskSpec) -> Tuple[str, Optional[List]]:
         """Returns ("ready", locs) | ("pending", None) | ("failed", None)."""
@@ -988,7 +1072,10 @@ class Cluster:
         return "ready", locs
 
     def _try_dispatch(self, spec: TaskSpec) -> bool:
-        """Returns True if the task left the pending queue (dispatched or failed)."""
+        """Returns True if the task left the pending queue (dispatched or failed).
+        Sets _dispatch_blocked_on_args when False is task-specific (args/
+        transfer pending) rather than a resource-shape failure."""
+        self._dispatch_blocked_on_args = False
         if spec.kind == "actor_method":
             return self._try_dispatch_actor_method(spec)
 
@@ -996,6 +1083,7 @@ class Cluster:
         if status == "failed":
             return True
         if status == "pending":
+            self._dispatch_blocked_on_args = True
             return False
 
         placement = self._choose_placement(spec)
@@ -1005,6 +1093,7 @@ class Cluster:
         locs = self._localize_args_or_defer(spec, locs, node.host_key)
         if locs is None:
             ledger.release(resources)
+            self._dispatch_blocked_on_args = True
             return False  # transfer in flight; rescheduled when it lands
         accel = "tpu" if resources.get("TPU", 0) > 0 else "cpu"
         worker = node.pop_idle(accel)
@@ -1049,9 +1138,11 @@ class Cluster:
         if status == "failed":
             return True
         if status == "pending":
+            self._dispatch_blocked_on_args = True
             return False
         locs = self._localize_args_or_defer(spec, locs, st.worker.node.host_key)
         if locs is None:
+            self._dispatch_blocked_on_args = True
             return False  # transfer in flight; rescheduled when it lands
         self._send_task(st.worker, spec, locs)
         ts = self.tasks.get(spec.task_id)
@@ -1207,7 +1298,7 @@ class Cluster:
                     object_store.free_local(loc)
             spec.attempt += 1
             with self._lock:
-                self.pending.append(spec)
+                self._pending_append(spec)
         else:
             for oid, loc in payload:
                 self.store.add(oid, loc)
@@ -1511,6 +1602,7 @@ class Cluster:
             else:
                 remaining.append(spec)
         self.pending = remaining
+        self._rebuild_shape_counts()
 
     def _fail_returns(self, spec: TaskSpec, err: Exception) -> None:
         wrapped = err if isinstance(err, (TaskError, ActorDiedError, WorkerCrashedError, TaskCancelledError)) else TaskError(err, spec.name)
@@ -1564,7 +1656,7 @@ class Cluster:
             elif spec.attempt < spec.max_retries and spec.kind == "task":
                 spec.attempt += 1
                 with self._lock:
-                    self.pending.append(spec)
+                    self._pending_append(spec)
             else:
                 self._fail_returns(spec, err)
         if w.actor_id is not None:
@@ -1588,7 +1680,7 @@ class Cluster:
                 st.creation_spec = respawn
                 self.tasks[respawn.task_id] = TaskState(respawn)
                 self.store.incref(respawn.return_ids[0])
-                self.pending.append(respawn)
+                self._pending_append(respawn)
             else:
                 st.state = "dead"
                 st.death_cause = err
@@ -1800,6 +1892,10 @@ class DriverContext:
         loc = object_store.materialize(value, oid)
         self.cluster.store.add(oid, loc)
         self.cluster.store.incref(oid)
+        if self.cluster.pending:
+            # a queued task may have been waiting on exactly this object
+            # (submits no longer run a full scheduling pass themselves)
+            self.cluster._schedule()
         return ObjectRef(oid, owned=True)
 
     def wait(self, refs, num_returns=1, timeout=None):

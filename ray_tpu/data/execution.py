@@ -439,10 +439,12 @@ class StreamingExecutor:
         else:
             pool_size = self.ctx.actor_pool_max_size
         # the input length is unknown in the pull model, but the pool must fit
-        # the cluster: an all-actors ready() barrier over more actors than free
-        # CPUs would deadlock the pipeline
-        total_cpus = ray_tpu.cluster_resources().get("CPU", 1.0)
-        pool_size = max(1, min(pool_size, int(total_cpus) or 1))
+        # what's actually FREE: a downstream stage's pool is created before its
+        # upstream's (pull order), so capping by total CPUs could leave the
+        # upstream pool's ready() barrier waiting on CPUs the downstream pool
+        # already holds — a permanent inter-stage deadlock
+        free_cpus = ray_tpu.available_resources().get("CPU", 1.0)
+        pool_size = max(1, min(pool_size, int(free_cpus) or 1))
         Worker = ray_tpu.remote(**({"num_cpus": 1} | opts))(_MapWorker)
         actors = [Worker.remote(op.specs) for _ in range(pool_size)]
         ray_tpu.get([a.ready.remote() for a in actors])

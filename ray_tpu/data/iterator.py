@@ -57,16 +57,16 @@ class DataIterator:
             except BaseException as e:  # noqa: BLE001 - re-raised in the consumer
                 offer(e)
             finally:
-                if stop.is_set():
-                    # consumer stopped early: close the live execution generator
-                    # HERE (this thread is its only driver) so every stage's
-                    # finally runs — actor pools killed, stats recorded
-                    close = getattr(self._bundles, "close", None)
-                    if close is not None:
-                        try:
-                            close()
-                        except Exception:
-                            pass
+                # ALWAYS close the live execution generator HERE (this thread is
+                # its only driver) so every stage's finally runs — actor pools
+                # killed, stats recorded. Covers early consumer abandonment AND
+                # a mid-stream task failure; a no-op on exhausted generators.
+                close = getattr(self._bundles, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
