@@ -45,10 +45,24 @@ class LLMConfig:
     max_model_len: int = 1024  # KV capacity per slot
     prefill_buckets: Optional[List[int]] = None  # pad-to lengths; default powers of 2
     dtype: str = "bfloat16"
+    # KV layout (reference: vLLM PagedAttention block tables):
+    #   "slot"  — max_model_len tokens reserved per slot up front
+    #   "paged" — one shared block pool; per-slot block tables; allocation per
+    #             kv_block_size tokens, so HBM caps TOTAL tokens, not slots
+    kv_layout: str = "slot"
+    kv_block_size: int = 16
+    # total pool blocks; None = same token capacity as the slot layout
+    num_kv_blocks: Optional[int] = None
+    # prompts longer than this prefill in chunks of this many tokens (peak
+    # activation memory = one chunk); None = whole-prompt prefill
+    prefill_chunk: Optional[int] = None
     # parallelism: mesh axes for the in-process device mesh
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     expert_parallel_size: int = 1  # MoE models: experts shard over "ep"
+    # layer stack split across pp stages with microbatched decode (reference
+    # passes pipeline_parallel_size to vLLM, vllm_models.py:125-139)
+    pipeline_parallel_size: int = 1
     # serving
     tokenizer: str = "byte"  # "byte" | "hf:<name-or-path>"
     accelerator_type: Optional[str] = None

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import itertools
 import queue
 import threading
 import time
@@ -66,6 +67,10 @@ class _Request:
         self.slot = -1
         self.prefill_kv = prefill_kv  # (k, v, first_token): P/D-disagg transfer-in
         self.pending_text: List[int] = []  # undecoded ids (byte tokenizer is stateless)
+        # prompt + every sampled token: recompute-preemption (paged pool
+        # exhausted) re-prefills from this history so decoding continues exactly
+        self.token_history: List[int] = list(prompt_ids)
+        self.admitted_at = 0  # admission sequence number (preemption picks youngest)
 
 
 class JaxLLMEngine(LLMEngine):
@@ -106,23 +111,61 @@ class JaxLLMEngine(LLMEngine):
             cfg = self.model_config
             c = self.config
             if self._mesh is None:
-                # dp*ep*tp devices out of the local set (an engine may intentionally
-                # use a subset, e.g. one replica per chip on a multi-chip host).
+                # pp*dp*ep*tp devices out of the local set (an engine may
+                # intentionally use a subset, e.g. one replica per chip).
                 from jax.sharding import Mesh
 
-                n = c.data_parallel_size * c.expert_parallel_size * c.tensor_parallel_size
+                pp = c.pipeline_parallel_size
+                n = (pp * c.data_parallel_size * c.expert_parallel_size
+                     * c.tensor_parallel_size)
                 devs = jax.devices()
                 if len(devs) < n:
-                    raise ValueError(f"need {n} devices for dp×ep×tp, have {len(devs)}")
-                self._mesh = Mesh(
-                    np.asarray(devs[:n]).reshape(
-                        c.data_parallel_size, c.expert_parallel_size,
-                        c.tensor_parallel_size
-                    ),
-                    ("dp", "ep", "tp"),
-                )
+                    raise ValueError(f"need {n} devices for pp×dp×ep×tp, have {len(devs)}")
+                if pp > 1:
+                    self._mesh = Mesh(
+                        np.asarray(devs[:n]).reshape(
+                            pp, c.data_parallel_size, c.expert_parallel_size,
+                            c.tensor_parallel_size),
+                        ("pp", "dp", "ep", "tp"),
+                    )
+                else:
+                    self._mesh = Mesh(
+                        np.asarray(devs[:n]).reshape(
+                            c.data_parallel_size, c.expert_parallel_size,
+                            c.tensor_parallel_size
+                        ),
+                        ("dp", "ep", "tp"),
+                    )
+            if c.pipeline_parallel_size > 1:
+                if (c.data_parallel_size > 1 or c.expert_parallel_size > 1
+                        or c.kv_layout == "paged"):
+                    raise NotImplementedError(
+                        "pipeline_parallel_size > 1 composes with tp only "
+                        "(dp/ep/paged-KV pipelining not implemented yet)")
+                if cfg.n_layers % c.pipeline_parallel_size:
+                    raise ValueError("n_layers must divide by pipeline_parallel_size")
+                if c.max_num_seqs % c.pipeline_parallel_size:
+                    raise ValueError("max_num_seqs must divide by pipeline_parallel_size")
+                if not cfg.scan_layers:
+                    raise ValueError("pipeline_parallel_size > 1 requires scan_layers")
             if c.max_num_seqs % c.data_parallel_size:
                 raise ValueError("max_num_seqs must be divisible by data_parallel_size")
+            if c.kv_layout == "paged":
+                if c.data_parallel_size > 1:
+                    raise NotImplementedError(
+                        "kv_layout='paged' requires data_parallel_size=1 (the "
+                        "shared pool does not shard over dp yet)")
+                if c.max_model_len % c.kv_block_size:
+                    raise ValueError("max_model_len must be a multiple of kv_block_size")
+                if any(b % c.kv_block_size for b in c.buckets()):
+                    raise ValueError(
+                        "every prefill bucket must be a multiple of kv_block_size")
+                if c.prefill_chunk and c.prefill_chunk % c.kv_block_size:
+                    raise ValueError(
+                        "prefill_chunk must be a multiple of kv_block_size "
+                        "(chunked KV installs block-by-block)")
+            elif c.kv_layout != "slot":
+                raise ValueError(f"unknown kv_layout {c.kv_layout!r}")
             if self._params_in is not None:
                 self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
             else:
@@ -134,12 +177,21 @@ class JaxLLMEngine(LLMEngine):
                     # load a model is a demo)
                     self.params = ckpt_io.load_llama_params(
                         c.model_source, cfg, self._mesh,
+                        rules=model_runner.infer_rules_for_mesh(self._mesh),
                         param_dtype=jnp.dtype(c.dtype))
                 else:
                     self.params = model_runner.shard_params(
                         llama_init_cached(cfg), cfg, self._mesh)
             self._params_in = None
             self._active = {s: None for s in range(c.max_num_seqs)}
+            self._admission_counter = itertools.count(1)
+            if c.pipeline_parallel_size > 1:
+                import functools
+
+                self._decode_pp_jit = jax.jit(
+                    functools.partial(model_runner.decode_step_pp,
+                                      cfg=cfg, mesh=self._mesh),
+                    donate_argnames=("state",))
             self._rng = jax.random.PRNGKey(0)
             # host mirrors of per-slot sampling params
             n = c.max_num_seqs
@@ -154,10 +206,22 @@ class JaxLLMEngine(LLMEngine):
         with self._start_lock:
             if self._loop_thread is not None:
                 return
+            c = self.config
             if self.state is None:
-                self.state = model_runner.init_state(
-                    self.model_config, self.config.max_num_seqs,
-                    self.config.max_model_len, self._mesh)
+                if c.kv_layout == "paged":
+                    from . import paged
+
+                    num_blocks = c.num_kv_blocks or (
+                        c.max_num_seqs * c.max_model_len // c.kv_block_size)
+                    self._blocks = paged._BlockManager(
+                        num_blocks, c.kv_block_size,
+                        c.max_model_len // c.kv_block_size, c.max_num_seqs)
+                    self.state = paged.init_paged_state(
+                        self.model_config, c.max_num_seqs, c.max_model_len,
+                        num_blocks, c.kv_block_size, self._mesh)
+                else:
+                    self.state = model_runner.init_state(
+                        self.model_config, c.max_num_seqs, c.max_model_len, self._mesh)
             self._loop_thread = threading.Thread(target=self._loop, daemon=True,
                                                  name="llm-engine")
             self._loop_thread.start()
@@ -289,24 +353,28 @@ class JaxLLMEngine(LLMEngine):
                 # P/D disaggregation: KV computed by a prefill replica; install it
                 # and emit the first token the prefill side already sampled.
                 k, v, tok = req.prefill_kv
+                if c.kv_layout == "paged":
+                    if not self._admit_paged_kv(req, slot, jnp.asarray(k), jnp.asarray(v)):
+                        return  # pool full: req (prefill_kv intact) requeued
+                else:
+                    self.state = model_runner.install_kv(
+                        self.state, jnp.asarray(k), jnp.asarray(v),
+                        jnp.int32(len(req.prompt_ids)), jnp.int32(slot),
+                    )
                 req.prefill_kv = None
-                self.state = model_runner.install_kv(
-                    self.state, jnp.asarray(k), jnp.asarray(v),
-                    jnp.int32(len(req.prompt_ids)), jnp.int32(slot),
-                )
+            elif c.kv_layout == "paged":
+                tok = self._prefill_paged(req, slot)
+                if tok is None:
+                    return  # pool full: requeued, stop admitting
             else:
                 tokens = self._pad_to_bucket(req.prompt_ids)
                 self.state, last_logits = model_runner.prefill(
                     self.params, self.state, jnp.asarray(tokens),
                     jnp.int32(len(req.prompt_ids)), jnp.int32(slot), cfg,
                 )
-                tok = int(model_runner.sample_tokens(
-                    self._next_rng(), last_logits[None, :],
-                    jnp.asarray([p.temperature], jnp.float32),
-                    jnp.asarray([p.top_p], jnp.float32),
-                    jnp.asarray([p.top_k], jnp.int32),
-                )[0])
+                tok = self._sample_one(last_logits, p)
             req.slot = slot
+            req.admitted_at = next(self._admission_counter)
             self._active[slot] = req
             self._temp[slot], self._top_p[slot], self._top_k[slot] = (
                 p.temperature, p.top_p, p.top_k)
@@ -316,8 +384,133 @@ class JaxLLMEngine(LLMEngine):
                 self.num_active += 1
             self._emit(req, tok)
 
+    def _sample_one(self, last_logits, p: SamplingParams) -> int:
+        return int(model_runner.sample_tokens(
+            self._next_rng(), last_logits[None, :],
+            jnp.asarray([p.temperature], jnp.float32),
+            jnp.asarray([p.top_p], jnp.float32),
+            jnp.asarray([p.top_k], jnp.int32),
+        )[0])
+
+    # -- paged KV (reference: vLLM PagedAttention block tables) --------------------
+    def _prefill_paged(self, req: _Request, slot: int) -> Optional[int]:
+        """Prefill into allocated blocks; None = pool full (req requeued)."""
+        from . import paged
+
+        cfg, c = self.model_config, self.config
+        prompt = req.token_history if req.generated else req.prompt_ids
+        n = len(prompt)
+        chunk = c.prefill_chunk
+        chunked = bool(chunk and n > chunk)
+        # the padded length (and so the block need) depends on the path: buckets
+        # for whole-prompt prefill, chunk multiples for chunked — checking the
+        # bucket size for a to-be-chunked prompt would fail requests that fit
+        s_pad = (-(-n // chunk) * chunk if chunked
+                 else next(b for b in c.buckets() if b >= n))
+        needed = self._blocks.blocks_needed(max(n + 1, s_pad))
+        if needed > self._blocks.total_blocks:
+            # can never fit even an empty pool (would requeue forever)
+            req.out_queue.put(RequestOutput(
+                request_id=req.id, token_ids=[], finished=True,
+                finish_reason="length", num_prompt_tokens=n,
+                num_generated_tokens=req.generated))
+            with self._lock:
+                self.num_pending -= 1
+            return None
+        if not self._blocks.can_allocate(needed):
+            self._waiting.put(req)  # stays pending; retried next cycle
+            return None
+        if chunked:
+            k, v, last_logits = paged.chunked_prefill(self.params, prompt, cfg, chunk)
+        else:
+            tokens = np.zeros((1, s_pad), np.int32)
+            tokens[0, :n] = prompt
+            k, v, last_logits = model_runner.prefill_detached(
+                self.params, jnp.asarray(tokens), jnp.int32(n), cfg)
+        block_ids = self._blocks.allocate(slot, needed)
+        pad_blocks = s_pad // c.kv_block_size
+        if pad_blocks < needed:
+            extra = (needed - pad_blocks) * c.kv_block_size
+            k = jnp.pad(k, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
+        self.state = paged.install_prefill(
+            self.state, k, v, jnp.asarray(block_ids, jnp.int32), jnp.int32(n),
+            jnp.int32(slot), n_blocks=needed)
+        return self._sample_one(last_logits, req.params)
+
+    def _admit_paged_kv(self, req: _Request, slot: int, k, v) -> bool:
+        """Install P/D-transferred KV into blocks; False = pool full (requeued)."""
+        from . import paged
+
+        c = self.config
+        n = len(req.prompt_ids)
+        s_pad = k.shape[2]
+        needed = self._blocks.blocks_needed(max(n + 1, s_pad))
+        if needed > self._blocks.total_blocks:
+            # an oversized transfer can never fit: fail rather than requeue forever
+            req.out_queue.put(RequestOutput(
+                request_id=req.id, token_ids=[], finished=True,
+                finish_reason="length", num_prompt_tokens=n,
+                num_generated_tokens=req.generated))
+            with self._lock:
+                self.num_pending -= 1
+            return False
+        if not self._blocks.can_allocate(needed):
+            self._waiting.put(req)  # prefill_kv still set; stays pending
+            return False
+        block_ids = self._blocks.allocate(slot, needed)
+        if s_pad < needed * c.kv_block_size:
+            extra = needed * c.kv_block_size - s_pad
+            k = jnp.pad(k, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
+        self.state = paged.install_prefill(
+            self.state, k, v, jnp.asarray(block_ids, jnp.int32), jnp.int32(n),
+            jnp.int32(slot), n_blocks=needed)
+        return True
+
+    def _grow_or_preempt(self) -> None:
+        """Before a decode step: every active slot whose next write crosses into
+        an unallocated block gets one; when the pool is dry, preempt the
+        YOUNGEST request (recompute preemption: blocks freed, request re-queued
+        and later re-prefilled from its token history)."""
+        from . import paged
+
+        lengths = np.asarray(self.state.lengths)
+        for slot in list(self._active):
+            req = self._active[slot]
+            if req is None:
+                continue
+            # re-check liveness each round: an earlier iteration (or this one)
+            # may have preempted this very request — growing a preempted slot
+            # would leak blocks into it and corrupt a later occupant's table
+            while (self._active[slot] is req
+                   and lengths[slot] >= self._blocks.slot_capacity(slot)):
+                if self._blocks.num_free > 0:
+                    (bid,) = self._blocks.allocate(slot, 1)
+                    index = self._blocks.slot_capacity(slot) // self.config.kv_block_size - 1
+                    self.state = paged.append_block(
+                        self.state, jnp.int32(slot), jnp.int32(index), jnp.int32(bid))
+                    continue
+                victim = max(
+                    (r for r in self._active.values() if r is not None),
+                    key=lambda r: r.admitted_at)
+                self._preempt(victim)
+                if victim is req:
+                    break  # this slot's request was the victim; nothing to grow
+
+    def _preempt(self, req: _Request) -> None:
+        slot = req.slot
+        self._blocks.release(slot)
+        self._active[slot] = None
+        req.slot = -1
+        with self._lock:
+            self.num_active -= 1
+            self.num_pending += 1
+        self._waiting.put(req)
+
     def _emit(self, req: _Request, tok: int) -> None:
         req.generated += 1
+        req.token_history.append(tok)
         self.total_generated += 1
         stops = req.params.stop_token_ids or [self.tokenizer.eos_token_id]
         finished, reason = False, None
@@ -337,6 +530,8 @@ class JaxLLMEngine(LLMEngine):
 
     def _release(self, req: _Request) -> None:
         if req.slot >= 0:
+            if self.config.kv_layout == "paged":
+                self._blocks.release(req.slot)
             self._active[req.slot] = None
             req.slot = -1
             with self._lock:
@@ -344,12 +539,29 @@ class JaxLLMEngine(LLMEngine):
 
     def _step_decode(self) -> None:
         cfg = self.model_config
+        if self.config.kv_layout == "paged":
+            from . import paged
+
+            self._grow_or_preempt()
         active_mask = np.array([r is not None for r in self._active.values()], bool)
+        if not active_mask.any():
+            return  # preemption may have drained every slot this cycle
         # Also stop slots that hit cache capacity.
-        self.state, logits = model_runner.decode_step(
-            self.params, self.state, jnp.asarray(self._last_tokens),
-            jnp.asarray(active_mask), cfg,
-        )
+        if self.config.kv_layout == "paged":
+            self.state, logits = paged.decode_step_paged(
+                self.params, self.state, jnp.asarray(self._last_tokens),
+                jnp.asarray(active_mask), cfg,
+            )
+        elif self.config.pipeline_parallel_size > 1:
+            self.state, logits = self._decode_pp_jit(
+                self.params, self.state, jnp.asarray(self._last_tokens),
+                jnp.asarray(active_mask),
+            )
+        else:
+            self.state, logits = model_runner.decode_step(
+                self.params, self.state, jnp.asarray(self._last_tokens),
+                jnp.asarray(active_mask), cfg,
+            )
         toks = np.asarray(model_runner.sample_tokens(
             self._next_rng(), logits, jnp.asarray(self._temp), jnp.asarray(self._top_p),
             jnp.asarray(self._top_k)))

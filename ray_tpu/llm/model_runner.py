@@ -36,14 +36,17 @@ class DecodeState(NamedTuple):
 
 
 CACHE_SPEC = P(None, "dp", None, "tp", None)
+# pipelined engines: each pp stage holds its layers' cache slice
+CACHE_SPEC_PP = P("pp", None, None, "tp", None)
 LENGTHS_SPEC = P("dp")
 
 
 def init_state(cfg: ModelConfig, slots: int, max_len: int, mesh: Mesh) -> DecodeState:
     shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
     dtype = cfg.activation_dtype
-    kv_sh = NamedSharding(mesh, CACHE_SPEC)
-    len_sh = NamedSharding(mesh, LENGTHS_SPEC)
+    pp = "pp" in mesh.shape and mesh.shape["pp"] > 1
+    kv_sh = NamedSharding(mesh, CACHE_SPEC_PP if pp else CACHE_SPEC)
+    len_sh = NamedSharding(mesh, P() if pp else LENGTHS_SPEC)
     return DecodeState(
         k=jax.device_put(jnp.zeros(shape, dtype), kv_sh),
         v=jax.device_put(jnp.zeros(shape, dtype), kv_sh),
@@ -51,8 +54,17 @@ def init_state(cfg: ModelConfig, slots: int, max_len: int, mesh: Mesh) -> Decode
     )
 
 
+def infer_rules_for_mesh(mesh: Mesh):
+    """INFER_RULES, plus the scanned layer axis over "pp" when the mesh has it."""
+    from ray_tpu.parallel.sharding import AxisRules
+
+    if "pp" in mesh.shape and mesh.shape["pp"] > 1:
+        return AxisRules({**INFER_RULES.rules, "layer": "pp"})
+    return INFER_RULES
+
+
 def shard_params(params, cfg: ModelConfig, mesh: Mesh):
-    return shard_pytree(params, llama.param_axes(cfg), mesh, INFER_RULES)
+    return shard_pytree(params, llama.param_axes(cfg), mesh, infer_rules_for_mesh(mesh))
 
 
 # ------------------------------------------------------------------------- prefill
@@ -121,12 +133,15 @@ def install_kv(
 
 # -------------------------------------------------------------------------- decode
 
-def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
-    """One layer's decode for all slots. x [S,1,D]; ck/cv [S,max_len,KV,HD];
-    returns (x, ck, cv) with this step's K/V scattered in at position lengths[s].
-    `active` [S] keeps inactive slots out of MoE expert capacity."""
+def _decode_core(x, lp, cfg: ModelConfig, lengths, active, cache_rw):
+    """One layer's single-token decode math, shared by every cache layout.
+
+    cache_rw(k_new [S,KV,HD], v_new) -> (ck_view [S,max_len,KV,HD], cv_view,
+    storage) — the adapter writes this step's K/V into its layout and returns
+    per-slot full-history views for attention plus the updated storage, which
+    is threaded back to the caller untouched."""
     dt = x.dtype
-    s, max_len = ck.shape[0], ck.shape[1]
+    s = x.shape[0]
     kvh, hd = cfg.n_kv_heads, cfg.head_dim
     g = cfg.n_heads // kvh
     pos = lengths[:, None]  # [S,1] — the new token's position
@@ -138,9 +153,8 @@ def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
     q = llama.rope(q, pos, cfg.rope_theta)
     k = llama.rope(k, pos, cfg.rope_theta)
 
-    rows = jnp.arange(s)
-    ck = ck.at[rows, lengths].set(k[:, 0].astype(ck.dtype))
-    cv = cv.at[rows, lengths].set(vv[:, 0].astype(cv.dtype))
+    ck, cv, storage = cache_rw(k[:, 0], vv[:, 0])
+    max_len = ck.shape[1]
 
     qg = q[:, 0].reshape(s, kvh, g, hd) * (hd**-0.5)
     scores = jnp.einsum("skgd,stkd->skgt", qg.astype(jnp.float32), ck.astype(jnp.float32))
@@ -162,7 +176,21 @@ def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
         gate = jnp.einsum("sld,df->slf", h, lp["w_gate"].astype(dt))
         up = jnp.einsum("sld,df->slf", h, lp["w_up"].astype(dt))
         down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt))
-    return x + down, ck, cv
+    return x + down, storage
+
+
+def _decode_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
+    """One layer's decode for all slots against the slot cache. x [S,1,D];
+    ck/cv [S,max_len,KV,HD]; K/V scattered in at position lengths[s]."""
+
+    def cache_rw(k_new, v_new):
+        rows = jnp.arange(ck.shape[0])
+        nk = ck.at[rows, lengths].set(k_new.astype(ck.dtype))
+        nv = cv.at[rows, lengths].set(v_new.astype(cv.dtype))
+        return nk, nv, (nk, nv)
+
+    x, (nk, nv) = _decode_core(x, lp, cfg, lengths, active, cache_rw)
+    return x, nk, nv
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
@@ -201,6 +229,112 @@ def decode_step(
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))[:, 0]
+    lengths = jnp.where(active, state.lengths + 1, state.lengths)
+    return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
+
+
+# ------------------------------------------------------- pipeline-parallel decode
+
+def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Array,
+                   cfg: ModelConfig, mesh: Mesh):
+    """Decode with the layer stack split across the "pp" mesh axis, microbatched
+    over slots (reference: the reference passes pipeline_parallel_size to vLLM,
+    vllm_models.py:125-139; here the schedule is native).
+
+    Layout: params["layers"] leaves and the KV cache are sharded P("pp") on the
+    layer axis, so each stage holds L/pp layers and THEIR cache — the point of
+    inference PP is fitting a model + cache that one device group can't. Slots
+    split into pp microbatches; activations hop stage→stage via ppermute while
+    stages work different microbatches (GPipe-style fill/drain per step). tp
+    stays a GSPMD auto axis inside the stage. Embedding/head run outside in
+    auto mode. Not yet composed with dp/ep or the paged layout.
+    """
+    from functools import partial
+
+    from ray_tpu.parallel.sharding import manual_axes
+
+    pp = mesh.shape["pp"]
+    s = tokens.shape[0]
+    if s % pp:
+        raise ValueError(f"max_num_seqs {s} must be divisible by pp {pp}")
+    smb = s // pp
+    m = pp  # microbatch count = stages (fills the pipe)
+
+    x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
+    x_mb = x.reshape(m, smb, 1, x.shape[-1])
+
+    def inner(layers_local, k_local, v_local, x_mb, lengths, active_i):
+        pp_size = jax.lax.psum(1, "pp")
+        stage = jax.lax.axis_index("pp")
+        ticks = m + pp_size - 1
+        fwd = [(i, i + 1) for i in range(pp_size - 1)]
+
+        def tick(carry, t):
+            x_recv, k, v, outs = carry
+            j = t - stage
+            jc = jnp.clip(j, 0, m - 1)
+            valid = (j >= 0) & (j < m)
+            x_in = jnp.where(stage == 0, x_mb[jc], x_recv)
+            mb_lengths = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
+            mb_active = jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0
+            k_mb = jax.lax.dynamic_slice_in_dim(k, jc * smb, smb, axis=1)
+            v_mb = jax.lax.dynamic_slice_in_dim(v, jc * smb, smb, axis=1)
+
+            def lbody(c, xs):
+                lp, ck, cv = xs
+                h, ck, cv = _decode_block(c, lp, cfg, ck, cv, mb_lengths, mb_active)
+                return h, (ck, cv)
+
+            h, (nk_mb, nv_mb) = jax.lax.scan(lbody, x_in, (layers_local, k_mb, v_mb))
+            k_new = jax.lax.dynamic_update_slice_in_dim(k, nk_mb, jc * smb, axis=1)
+            v_new = jax.lax.dynamic_update_slice_in_dim(v, nv_mb, jc * smb, axis=1)
+            k = jnp.where(valid, k_new, k)
+            v = jnp.where(valid, v_new, v)
+            out_j = t - (pp_size - 1)
+            outs_new = jax.lax.dynamic_update_index_in_dim(
+                outs, h, jnp.clip(out_j, 0, m - 1), 0)
+            outs = jnp.where((stage == pp_size - 1) & (out_j >= 0), outs_new, outs)
+            x_send = jax.lax.ppermute(h, "pp", fwd) if pp_size > 1 else h
+            return (x_send, k, v, outs), None
+
+        def _vary(z):
+            try:
+                want = set(jax.typeof(x_mb).vma) | {"pp"}
+                have = set(jax.typeof(z).vma)
+            except Exception:
+                want, have = {"pp"}, set()
+            need = tuple(want - have)
+            if not need:
+                return z
+            if hasattr(jax.lax, "pcast"):
+                return jax.lax.pcast(z, need, to="varying")
+            return jax.lax.pvary(z, need)
+
+        buf0 = _vary(jnp.zeros_like(x_mb[0]))
+        outs0 = _vary(jnp.zeros_like(x_mb))
+        (_, k, v, outs), _ = jax.lax.scan(
+            tick, (buf0, k_local, v_local, outs0), jnp.arange(ticks))
+        # last stage holds the real outputs; broadcast to every stage
+        outs = jax.lax.psum(
+            jnp.where(jax.lax.axis_index("pp") == pp_size - 1, outs,
+                      jnp.zeros_like(outs)), "pp")
+        return outs.reshape(s, 1, outs.shape[-1]), k, v
+
+    layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params["layers"])
+    mapped = jax.shard_map(
+        lambda ly, k, v, xm, ln, ac: inner(ly, k, v, xm, ln, ac),
+        mesh=mesh,
+        in_specs=(layer_specs, P("pp"), P("pp"), P(), P(), P()),
+        out_specs=(P(), P("pp"), P("pp")),
+        axis_names={"pp"},
+    )
+    with manual_axes("pp"):
+        h, nk, nv = mapped(params["layers"], state.k, state.v, x_mb,
+                           state.lengths, active.astype(jnp.int32))
+
+    h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("sld,dv->slv", h, head.astype(cfg.activation_dtype))[:, 0]
     lengths = jnp.where(active, state.lengths + 1, state.lengths)
     return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
 

@@ -1,0 +1,246 @@
+"""Paged KV cache: block-granular memory for continuous batching.
+
+Reference capability: vLLM's PagedAttention block tables (the engine the
+reference wraps, vllm_models.py:125-139) — the slot cache reserves
+max_model_len tokens per slot up front, so HBM caps max_num_seqs at
+slots x max_model_len x layers; paging shares one block pool across slots and
+allocates per BLOCK_SIZE tokens, so many short sequences (or few long ones) fit
+the same memory. All shapes stay static for XLA: the pool is
+[L, num_blocks, block, kv_heads, head_dim], each slot owns a fixed-width block
+table [max_blocks] of pool indices, and reads gather / writes scatter through
+the table.
+
+Host-side: _BlockManager hands out pool indices; when the pool is exhausted the
+engine preempts the youngest request and re-prefills it later (vLLM's
+recompute preemption).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.models.config import ModelConfig
+
+from . import sampling
+
+
+class PagedState(NamedTuple):
+    """Device-resident paged serving state.
+
+    k/v: [L, num_blocks, block_size, kv_heads, head_dim] — the shared pool.
+    block_tables: [slots, max_blocks] int32 pool indices (junk entries are
+        masked by lengths at read time).
+    lengths: [slots] int32 tokens cached per slot.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    block_tables: jax.Array
+    lengths: jax.Array
+
+
+POOL_SPEC = P(None, None, None, "tp", None)
+
+
+def init_paged_state(cfg: ModelConfig, slots: int, max_len: int, num_blocks: int,
+                     block_size: int, mesh: Optional[Mesh] = None) -> PagedState:
+    """The pool gets ONE extra physical block (index num_blocks): inactive slots'
+    decode writes are redirected there — their block-table entries may reference
+    blocks already released and re-owned by other requests."""
+    max_blocks = max_len // block_size
+    shape = (cfg.n_layers, num_blocks + 1, block_size, cfg.n_kv_heads, cfg.head_dim)
+    dtype = cfg.activation_dtype
+    k = jnp.zeros(shape, dtype)
+    v = jnp.zeros(shape, dtype)
+    bt = jnp.zeros((slots, max_blocks), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    if mesh is not None:
+        k = jax.device_put(k, NamedSharding(mesh, POOL_SPEC))
+        v = jax.device_put(v, NamedSharding(mesh, POOL_SPEC))
+        bt = jax.device_put(bt, NamedSharding(mesh, P()))
+        lengths = jax.device_put(lengths, NamedSharding(mesh, P()))
+    return PagedState(k=k, v=v, block_tables=bt, lengths=lengths)
+
+
+class _BlockManager:
+    """Host-side free list + per-slot allocation bookkeeping."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_slot: int,
+                 slots: int):
+        self.block_size = block_size
+        self.max_blocks = max_blocks_per_slot
+        self.total_blocks = num_blocks
+        self.free: List[int] = list(range(num_blocks))
+        self.owned: List[List[int]] = [[] for _ in range(slots)]
+
+    @property
+    def num_free(self) -> int:
+        return len(self.free)
+
+    def blocks_needed(self, tokens: int) -> int:
+        return -(-tokens // self.block_size)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self.free) >= n
+
+    def allocate(self, slot: int, n: int) -> List[int]:
+        assert len(self.free) >= n, "pool exhausted (caller must check/preempt)"
+        got = [self.free.pop() for _ in range(n)]
+        self.owned[slot].extend(got)
+        return got
+
+    def release(self, slot: int) -> None:
+        self.free.extend(self.owned[slot])
+        self.owned[slot] = []
+
+    def slot_capacity(self, slot: int) -> int:
+        return len(self.owned[slot]) * self.block_size
+
+
+# ----------------------------------------------------------------- prefill install
+
+@functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("n_blocks",))
+def install_prefill(
+    state: PagedState,
+    k: jax.Array,  # [L, 1, S_pad, KV, HD] from prefill_detached
+    v: jax.Array,
+    block_ids: jax.Array,  # [n_blocks] int32 pool indices (S_pad = n_blocks*bs)
+    true_len: jax.Array,  # scalar int32
+    slot: jax.Array,  # scalar int32
+    n_blocks: int,
+) -> PagedState:
+    """Scatter a prompt's KV into its allocated blocks and fill the block table."""
+    L = state.k.shape[0]
+    bs = state.k.shape[2]
+    kb = k[:, 0].reshape(L, n_blocks, bs, *k.shape[3:]).astype(state.k.dtype)
+    vb = v[:, 0].reshape(L, n_blocks, bs, *v.shape[3:]).astype(state.v.dtype)
+    nk = state.k.at[:, block_ids].set(kb)
+    nv = state.v.at[:, block_ids].set(vb)
+    table_row = jnp.zeros((state.block_tables.shape[1],), jnp.int32)
+    table_row = jax.lax.dynamic_update_slice(table_row, block_ids, (0,))
+    bt = state.block_tables.at[slot].set(table_row)
+    lengths = state.lengths.at[slot].set(true_len)
+    return PagedState(k=nk, v=nv, block_tables=bt, lengths=lengths)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def append_block(state: PagedState, slot: jax.Array, index: jax.Array,
+                 block_id: jax.Array) -> PagedState:
+    """Record a newly allocated decode block in a slot's table."""
+    bt = state.block_tables.at[slot, index].set(block_id)
+    return state._replace(block_tables=bt)
+
+
+# ------------------------------------------------------------------------- decode
+
+def _decode_block_paged(x, lp, cfg: ModelConfig, pk, pv, block_tables, lengths,
+                        active):
+    """One layer's paged decode for all slots: the shared layer math
+    (model_runner._decode_core) with a block-table cache adapter.
+
+    x [S,1,D]; pk/pv [NB, bs, KV, HD] (this layer's pool); reads gather each
+    slot's blocks into [S, max_len, KV, HD] (activation-only — the POOL is what
+    lives in HBM persistently), writes scatter the new token through the table.
+    """
+    from .model_runner import _decode_core
+
+    s = x.shape[0]
+    nb_slot = block_tables.shape[1]
+    bs = pk.shape[1]
+    max_len = nb_slot * bs
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+
+    def cache_rw(k_new, v_new):
+        # scatter through the block table (distinct active slots own distinct
+        # blocks, so writes never collide); INACTIVE slots' tables may point at
+        # freed/re-owned blocks, so their writes land in the scratch block (the
+        # pool's last physical block, never allocated)
+        scratch = pk.shape[0] - 1
+        safe_idx = jnp.minimum(lengths // bs, nb_slot - 1)
+        write_block = jnp.where(active, block_tables[jnp.arange(s), safe_idx], scratch)
+        write_off = lengths % bs
+        nk = pk.at[write_block, write_off].set(k_new.astype(pk.dtype))
+        nv = pv.at[write_block, write_off].set(v_new.astype(pv.dtype))
+        ck = nk[block_tables].reshape(s, max_len, kvh, hd)
+        cv = nv[block_tables].reshape(s, max_len, kvh, hd)
+        return ck, cv, (nk, nv)
+
+    x, (nk, nv) = _decode_core(x, lp, cfg, lengths, active, cache_rw)
+    return x, nk, nv
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
+def decode_step_paged(
+    params,
+    state: PagedState,
+    tokens: jax.Array,  # [slots] int32
+    active: jax.Array,  # [slots] bool
+    cfg: ModelConfig,
+) -> Tuple[PagedState, jax.Array]:
+    """One decode step for every slot against the paged pool."""
+    x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]
+
+    if cfg.scan_layers:
+        def body(carry, xs):
+            h = carry
+            lp, pk, pv = xs
+            h, pk, pv = _decode_block_paged(h, lp, cfg, pk, pv,
+                                            state.block_tables, state.lengths, active)
+            return h, (pk, pv)
+
+        x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], state.k, state.v))
+    else:
+        nk, nv = [], []
+        for i, lp in enumerate(params["layers"]):
+            x, pk, pv = _decode_block_paged(x, lp, cfg, state.k[i], state.v[i],
+                                            state.block_tables, state.lengths, active)
+            nk.append(pk)
+            nv.append(pv)
+        nk, nv = jnp.stack(nk), jnp.stack(nv)
+
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))[:, 0]
+    lengths = jnp.where(active, state.lengths + 1, state.lengths)
+    return PagedState(k=nk, v=nv, block_tables=state.block_tables,
+                      lengths=lengths), logits.astype(jnp.float32)
+
+
+# ------------------------------------------------------------------ chunked prefill
+
+def chunked_prefill(params, prompt_ids: List[int], cfg: ModelConfig,
+                    chunk: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill a long prompt chunk-at-a-time (reference: vLLM chunked prefill).
+
+    Peak activation memory is one chunk's, not the whole prompt's; the temp KV
+    grows to the padded prompt length and is installed into blocks afterwards.
+    Returns (k [L,1,S_pad,KV,HD], v, last_logits [vocab] f32)."""
+    n = len(prompt_ids)
+    s_pad = -(-n // chunk) * chunk
+    cache = llama.init_kv_cache(cfg, batch=1, max_len=s_pad,
+                                dtype=cfg.activation_dtype)
+    last = None
+    for start in range(0, s_pad, chunk):
+        piece = prompt_ids[start:start + chunk]
+        tokens = np.zeros((1, chunk), np.int32)
+        tokens[0, : len(piece)] = piece
+        logits, cache = _prefill_chunk(params, cache, jnp.asarray(tokens),
+                                       jnp.int32(len(piece)), cfg)
+        if start < n <= start + chunk:
+            last = logits[0, (n - 1) - start].astype(jnp.float32)
+    return cache.k, cache.v, last
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _prefill_chunk(params, cache, tokens, true_len, cfg: ModelConfig):
+    # pad positions in the final chunk must not claim MoE expert capacity
+    # (model_runner.prefill passes the same mask for the same reason)
+    mask = (jnp.arange(tokens.shape[1])[None, :] < true_len).astype(jnp.float32)
+    logits, cache = llama.forward(params, tokens, cfg, cache=cache, token_mask=mask)
+    return logits, cache
