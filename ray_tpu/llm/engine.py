@@ -91,6 +91,7 @@ class JaxLLMEngine(LLMEngine):
         self._rng_lock = threading.Lock()
         self._loop_thread: Optional[threading.Thread] = None
         self._wakeup = threading.Event()
+        self._admitting: Optional[_Request] = None  # mid-admission request
         self.state = None  # decode KV state, allocated on first decode admission
         # metrics (scraped by LLMServer / autoscaling)
         self.num_pending = 0
@@ -166,6 +167,10 @@ class JaxLLMEngine(LLMEngine):
                         "(chunked KV installs block-by-block)")
             elif c.kv_layout != "slot":
                 raise ValueError(f"unknown kv_layout {c.kv_layout!r}")
+            if c.prefill_chunk and c.max_model_len % c.prefill_chunk:
+                # guarantees a chunk-padded prompt never exceeds max_model_len
+                # (the block table / slot cache width)
+                raise ValueError("max_model_len must be a multiple of prefill_chunk")
             if self._params_in is not None:
                 self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
             else:
@@ -348,6 +353,9 @@ class JaxLLMEngine(LLMEngine):
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 return
+            # visible to the loop's crash handler: this request is in neither
+            # _waiting nor _active right now, and must still be failed on error
+            self._admitting = req
             p = req.params
             if req.prefill_kv is not None:
                 # P/D disaggregation: KV computed by a prefill replica; install it
@@ -355,6 +363,7 @@ class JaxLLMEngine(LLMEngine):
                 k, v, tok = req.prefill_kv
                 if c.kv_layout == "paged":
                     if not self._admit_paged_kv(req, slot, jnp.asarray(k), jnp.asarray(v)):
+                        self._admitting = None
                         return  # pool full: req (prefill_kv intact) requeued
                 else:
                     self.state = model_runner.install_kv(
@@ -365,7 +374,15 @@ class JaxLLMEngine(LLMEngine):
             elif c.kv_layout == "paged":
                 tok = self._prefill_paged(req, slot)
                 if tok is None:
+                    self._admitting = None
                     return  # pool full: requeued, stop admitting
+            elif c.prefill_chunk and len(req.prompt_ids) > c.prefill_chunk:
+                # chunked prefill works for the slot layout too: bound peak
+                # activation memory, then install the assembled KV at once
+                k, v, last_logits = self._prefill_kv_tensors(req.prompt_ids)
+                self.state = model_runner.install_kv(
+                    self.state, k, v, jnp.int32(len(req.prompt_ids)), jnp.int32(slot))
+                tok = self._sample_one(last_logits, p)
             else:
                 tokens = self._pad_to_bucket(req.prompt_ids)
                 self.state, last_logits = model_runner.prefill(
@@ -382,6 +399,7 @@ class JaxLLMEngine(LLMEngine):
             with self._lock:
                 self.num_pending -= 1
                 self.num_active += 1
+            self._admitting = None
             self._emit(req, tok)
 
     def _sample_one(self, last_logits, p: SamplingParams) -> int:
@@ -393,70 +411,30 @@ class JaxLLMEngine(LLMEngine):
         )[0])
 
     # -- paged KV (reference: vLLM PagedAttention block tables) --------------------
-    def _prefill_paged(self, req: _Request, slot: int) -> Optional[int]:
-        """Prefill into allocated blocks; None = pool full (req requeued)."""
-        from . import paged
+    def _fail_request(self, req: _Request, n: int, reason: str = "length") -> None:
+        req.out_queue.put(RequestOutput(
+            request_id=req.id, token_ids=[], finished=True,
+            finish_reason=reason, num_prompt_tokens=n,
+            num_generated_tokens=req.generated))
+        with self._lock:
+            self.num_pending -= 1
 
-        cfg, c = self.model_config, self.config
-        prompt = req.token_history if req.generated else req.prompt_ids
-        n = len(prompt)
-        chunk = c.prefill_chunk
-        chunked = bool(chunk and n > chunk)
-        # the padded length (and so the block need) depends on the path: buckets
-        # for whole-prompt prefill, chunk multiples for chunked — checking the
-        # bucket size for a to-be-chunked prompt would fail requests that fit
-        s_pad = (-(-n // chunk) * chunk if chunked
-                 else next(b for b in c.buckets() if b >= n))
-        needed = self._blocks.blocks_needed(max(n + 1, s_pad))
-        if needed > self._blocks.total_blocks:
-            # can never fit even an empty pool (would requeue forever)
-            req.out_queue.put(RequestOutput(
-                request_id=req.id, token_ids=[], finished=True,
-                finish_reason="length", num_prompt_tokens=n,
-                num_generated_tokens=req.generated))
-            with self._lock:
-                self.num_pending -= 1
-            return None
-        if not self._blocks.can_allocate(needed):
-            self._waiting.put(req)  # stays pending; retried next cycle
-            return None
-        if chunked:
-            k, v, last_logits = paged.chunked_prefill(self.params, prompt, cfg, chunk)
-        else:
-            tokens = np.zeros((1, s_pad), np.int32)
-            tokens[0, :n] = prompt
-            k, v, last_logits = model_runner.prefill_detached(
-                self.params, jnp.asarray(tokens), jnp.int32(n), cfg)
-        block_ids = self._blocks.allocate(slot, needed)
-        pad_blocks = s_pad // c.kv_block_size
-        if pad_blocks < needed:
-            extra = (needed - pad_blocks) * c.kv_block_size
-            k = jnp.pad(k, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
-            v = jnp.pad(v, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
-        self.state = paged.install_prefill(
-            self.state, k, v, jnp.asarray(block_ids, jnp.int32), jnp.int32(n),
-            jnp.int32(slot), n_blocks=needed)
-        return self._sample_one(last_logits, req.params)
-
-    def _admit_paged_kv(self, req: _Request, slot: int, k, v) -> bool:
-        """Install P/D-transferred KV into blocks; False = pool full (requeued)."""
+    def _install_paged(self, req: _Request, slot: int, k, v, n: int) -> Optional[bool]:
+        """Allocate blocks for [L,1,S_pad,...] prefill KV and install it.
+        True = installed; False = pool busy (req requeued by the CALLER);
+        None = can never fit (request failed here)."""
         from . import paged
 
         c = self.config
-        n = len(req.prompt_ids)
         s_pad = k.shape[2]
         needed = self._blocks.blocks_needed(max(n + 1, s_pad))
-        if needed > self._blocks.total_blocks:
-            # an oversized transfer can never fit: fail rather than requeue forever
-            req.out_queue.put(RequestOutput(
-                request_id=req.id, token_ids=[], finished=True,
-                finish_reason="length", num_prompt_tokens=n,
-                num_generated_tokens=req.generated))
-            with self._lock:
-                self.num_pending -= 1
-            return False
+        if needed > min(self._blocks.total_blocks, self._blocks.max_blocks):
+            # exceeds the pool OR this engine's per-slot table width (e.g. a P/D
+            # transfer padded past the decode engine's max_model_len): can never
+            # fit, so fail instead of requeueing forever
+            self._fail_request(req, n)
+            return None
         if not self._blocks.can_allocate(needed):
-            self._waiting.put(req)  # prefill_kv still set; stays pending
             return False
         block_ids = self._blocks.allocate(slot, needed)
         if s_pad < needed * c.kv_block_size:
@@ -467,6 +445,52 @@ class JaxLLMEngine(LLMEngine):
             self.state, k, v, jnp.asarray(block_ids, jnp.int32), jnp.int32(n),
             jnp.int32(slot), n_blocks=needed)
         return True
+
+    def _prefill_kv_tensors(self, prompt: List[int]):
+        """(k, v, last_logits) for a prompt — whole-bucket or chunked prefill."""
+        from . import paged
+
+        cfg, c = self.model_config, self.config
+        n = len(prompt)
+        chunk = c.prefill_chunk
+        if chunk and n > chunk:
+            return paged.chunked_prefill(self.params, prompt, cfg, chunk)
+        s_pad = next(b for b in c.buckets() if b >= n)
+        tokens = np.zeros((1, s_pad), np.int32)
+        tokens[0, :n] = prompt
+        return model_runner.prefill_detached(
+            self.params, jnp.asarray(tokens), jnp.int32(n), cfg)
+
+    def _prefill_paged(self, req: _Request, slot: int) -> Optional[int]:
+        """Prefill into allocated blocks; None = not admitted (requeued/failed)."""
+        prompt = req.token_history if req.generated else req.prompt_ids
+        n = len(prompt)
+        # cheap pre-check before running the model (the padded length is at most
+        # one bucket/chunk above n, so needed here is exact)
+        chunk = self.config.prefill_chunk
+        s_pad = (-(-n // chunk) * chunk if chunk and n > chunk
+                 else next(b for b in self.config.buckets() if b >= n))
+        needed = self._blocks.blocks_needed(max(n + 1, s_pad))
+        if needed > min(self._blocks.total_blocks, self._blocks.max_blocks):
+            self._fail_request(req, n)
+            return None
+        if not self._blocks.can_allocate(needed):
+            self._waiting.put(req)  # stays pending; retried next cycle
+            return None
+        k, v, last_logits = self._prefill_kv_tensors(prompt)
+        ok = self._install_paged(req, slot, k, v, n)
+        if ok is not True:
+            if ok is False:
+                self._waiting.put(req)
+            return None
+        return self._sample_one(last_logits, req.params)
+
+    def _admit_paged_kv(self, req: _Request, slot: int, k, v) -> bool:
+        """Install P/D-transferred KV into blocks; False = not admitted."""
+        ok = self._install_paged(req, slot, k, v, len(req.prompt_ids))
+        if ok is False:
+            self._waiting.put(req)  # prefill_kv still set; stays pending
+        return ok is True
 
     def _grow_or_preempt(self) -> None:
         """Before a decode step: every active slot whose next write crosses into
@@ -593,7 +617,14 @@ class JaxLLMEngine(LLMEngine):
                 import traceback
 
                 traceback.print_exc()
-                # fail all in-flight requests rather than hanging clients
+                # fail all in-flight requests rather than hanging clients —
+                # including one caught mid-admission (in neither _waiting nor
+                # _active), whose client would otherwise block forever
+                if self._admitting is not None:
+                    self._admitting.out_queue.put(RequestOutput(
+                        request_id=self._admitting.id, token_ids=[], finished=True,
+                        finish_reason="error"))
+                    self._admitting = None
                 for slot, req in list(self._active.items()):
                     if req is not None:
                         req.out_queue.put(RequestOutput(

@@ -168,3 +168,43 @@ def test_pipeline_parallel_with_tp():
     assert len(pptp.state.k.sharding.device_set) == 4
     ref.shutdown()
     pptp.shutdown()
+
+
+def test_chunked_prefill_slot_layout_matches():
+    cfg = _cfg()
+    long_prompt = "tok " * 50
+    whole = JaxLLMEngine(LLMConfig(model_source=cfg, kv_layout="slot",
+                                   max_num_seqs=2, max_model_len=256, dtype="float32"))
+    chunked = JaxLLMEngine(LLMConfig(model_source=cfg, kv_layout="slot",
+                                     max_num_seqs=2, max_model_len=256,
+                                     prefill_chunk=64, dtype="float32"))
+    assert _greedy(whole, long_prompt) == _greedy(chunked, long_prompt)
+    whole.shutdown()
+    chunked.shutdown()
+
+
+def test_oversized_pd_transfer_fails_cleanly():
+    """A P/D transfer padded past the decode engine's table width must finish
+    with 'length', not crash the loop or hang the client."""
+    cfg = _cfg()
+    prefill_engine = JaxLLMEngine(LLMConfig(
+        model_source=cfg, kv_layout="slot", max_num_seqs=2, max_model_len=512,
+        dtype="float32"))
+    decode_engine = JaxLLMEngine(LLMConfig(
+        model_source=cfg, kv_layout="paged", max_num_seqs=2, max_model_len=64,
+        dtype="float32"))
+    params = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=[-1])
+    pre = prefill_engine.prefill_only("x " * 100, params)  # pads past 64
+    outs = list(decode_engine.generate_from_prefill(pre, params))
+    assert outs[-1].finished and outs[-1].finish_reason == "length"
+    prefill_engine.shutdown()
+    decode_engine.shutdown()
+
+
+def test_bad_prefill_chunk_rejected():
+    cfg = _cfg()
+    eng = JaxLLMEngine(LLMConfig(model_source=cfg, kv_layout="paged",
+                                 max_num_seqs=2, max_model_len=128,
+                                 prefill_chunk=96, dtype="float32"))
+    with pytest.raises(ValueError, match="multiple of prefill_chunk"):
+        eng.start()
