@@ -1,0 +1,138 @@
+"""Central flag registry: every RAY_TPU_* knob, typed and documented.
+
+Capability parity: reference src/ray/common/ray_config_def.h (the RAY_CONFIG
+X-macro registry, 219 entries, env-overridable as RAY_<name>) — one place to
+see every flag, its type, default, and where its current value came from.
+`ray-tpu list config` prints the table.
+
+Values are read from the environment AT ACCESS TIME (so tests can monkeypatch
+and long-lived processes can be reconfigured between runs) and fall back to the
+documented default. Worker-plumbing variables the runtime sets for its own
+children (RAY_TPU_ARENA, RAY_TPU_TRAIN_RANK, ...) are internal protocol, not
+operator flags, and are deliberately not listed here.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str  # attribute name on CONFIG
+    env: str  # environment variable that overrides it
+    type: str  # "int" | "float" | "bool" | "str"
+    default: Any  # None = unset/auto
+    doc: str
+
+    def parse(self, raw: str) -> Any:
+        if self.type == "int":
+            return int(raw)
+        if self.type == "float":
+            return float(raw)
+        if self.type == "bool":
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return raw
+
+
+_FLAGS: List[Flag] = [
+    # -- resources / topology
+    Flag("num_cpus", "RAY_TPU_NUM_CPUS", "float", None,
+         "CPU capacity this node advertises (default: os.cpu_count())."),
+    Flag("num_tpus", "RAY_TPU_NUM_TPUS", "float", None,
+         "TPU chip capacity this node advertises (default: auto-detect)."),
+    Flag("max_workers_per_node", "RAY_TPU_MAX_WORKERS_PER_NODE", "int", 16,
+         "Worker-process cap per node (reference: raylet worker pool size)."),
+    # -- object store / memory
+    Flag("object_store_bytes", "RAY_TPU_OBJECT_STORE_BYTES", "int", 512 * 1024 * 1024,
+         "Shared-memory arena capacity per node (plasma-equivalent)."),
+    Flag("spill_dir", "RAY_TPU_SPILL_DIR", "str", "/tmp",
+         "Directory for objects spilled from shared memory to disk."),
+    Flag("spill_threshold", "RAY_TPU_SPILL_THRESHOLD", "float", 0.8,
+         "Arena-usage fraction above which LRU spilling starts."),
+    Flag("spill_target", "RAY_TPU_SPILL_TARGET", "float", 0.5,
+         "Arena-usage fraction spilling drives down to."),
+    Flag("memory_usage_threshold", "RAY_TPU_MEMORY_USAGE_THRESHOLD", "float", 0.95,
+         "System-memory fraction that triggers the OOM worker killer "
+         "(reference memory_monitor.h)."),
+    Flag("memory_monitor_refresh_ms", "RAY_TPU_MEMORY_MONITOR_REFRESH_MS", "int", 250,
+         "Memory monitor / spill check period."),
+    # -- multi-host control plane
+    Flag("agent_heartbeat_s", "RAY_TPU_AGENT_HEARTBEAT_S", "float", 2.0,
+         "Node-agent heartbeat period to the head."),
+    Flag("agent_heartbeat_timeout_s", "RAY_TPU_AGENT_HEARTBEAT_TIMEOUT_S", "float", 10.0,
+         "Head marks an agent dead after this long without a heartbeat "
+         "(reference gcs_health_check_manager.h)."),
+    # -- session / auth
+    Flag("session_dir", "RAY_TPU_SESSION_DIR", "str", "/tmp/ray_tpu_session",
+         "Session directory (head metadata, jobs, authkey, usage report)."),
+    Flag("client_authkey", "RAY_TPU_CLIENT_AUTHKEY", "str", None,
+         "Cluster authkey for remote drivers/agents (default: generated and "
+         "persisted in the session dir)."),
+    Flag("gcs_persistence_path", "RAY_TPU_GCS_PERSISTENCE_PATH", "str", None,
+         "Journal file for GCS KV persistence across restarts (default: off)."),
+    # -- observability
+    Flag("tracing", "RAY_TPU_TRACING", "bool", False,
+         "Enable OpenTelemetry-style span recording at init."),
+    Flag("usage_stats", "RAY_TPU_USAGE_STATS", "bool", False,
+         "Record a local-only feature-usage summary in the session dir "
+         "(never leaves the machine)."),
+    Flag("lp_debug", "RAY_TPU_LP_DEBUG", "bool", False,
+         "Verbose serve long-poll client logging."),
+    # -- train
+    Flag("train_v2_enabled", "RAY_TPU_TRAIN_V2_ENABLED", "bool", False,
+         "Route trainers through the v2 controller (FailurePolicy/"
+         "ScalingPolicy; reference RAY_TRAIN_V2_ENABLED)."),
+    Flag("storage_path", "RAY_TPU_STORAGE_PATH", "str", None,
+         "Default experiment storage path (default: ~/ray_tpu_results)."),
+]
+
+_BY_NAME: Dict[str, Flag] = {f.name: f for f in _FLAGS}
+
+
+class _Config:
+    """Attribute access returns the flag's current (env-overridden) value."""
+
+    def __getattr__(self, name: str) -> Any:
+        flag = _BY_NAME.get(name)
+        if flag is None:
+            raise AttributeError(f"unknown ray_tpu config flag {name!r}")
+        raw = os.environ.get(flag.env)
+        if raw is None or raw == "":
+            return flag.default
+        return flag.parse(raw)
+
+    @staticmethod
+    def flags() -> List[Flag]:
+        return list(_FLAGS)
+
+    @staticmethod
+    def entries() -> List[Dict[str, Any]]:
+        """Current value + provenance for every flag (`ray-tpu list config`)."""
+        out = []
+        for f in _FLAGS:
+            raw = os.environ.get(f.env)
+            overridden = raw is not None and raw != ""
+            out.append({
+                "name": f.name,
+                "env": f.env,
+                "type": f.type,
+                "value": f.parse(raw) if overridden else f.default,
+                "source": "env" if overridden else "default",
+                "doc": f.doc,
+            })
+        return out
+
+    @staticmethod
+    def describe() -> str:
+        rows = _Config.entries()
+        w = max(len(r["env"]) for r in rows)
+        lines = []
+        for r in rows:
+            lines.append(f"{r['env']:<{w}}  {str(r['value']):<12} [{r['source']:<7}] "
+                         f"({r['type']}) {r['doc']}")
+        return "\n".join(lines)
+
+
+CONFIG = _Config()

@@ -55,13 +55,16 @@ def init(
         connect(address.split("://", 1)[1])
         atexit.register(shutdown)
         return
+    from ray_tpu.config import CONFIG
+
     if num_cpus is None:
-        num_cpus = float(os.environ.get("RAY_TPU_NUM_CPUS", os.cpu_count() or 1))
+        num_cpus = (CONFIG.num_cpus if CONFIG.num_cpus is not None
+                    else float(os.cpu_count() or 1))
     detected: Dict[str, float] = {}
     if num_tpus is None:
-        env_tpus = os.environ.get("RAY_TPU_NUM_TPUS")
+        env_tpus = CONFIG.num_tpus
         if env_tpus is not None:
-            num_tpus = float(env_tpus)
+            num_tpus = env_tpus
         else:
             # auto-detect TPU chips + pod-slice head resources (reference
             # TPUAcceleratorManager; core/accelerators.py)

@@ -160,7 +160,9 @@ class GCS:
     def __init__(self, persistence_path: Optional[str] = None):
         import os
 
-        persistence_path = persistence_path or os.environ.get("RAY_TPU_GCS_PERSISTENCE_PATH")
+        from ray_tpu.config import CONFIG
+
+        persistence_path = persistence_path or CONFIG.gcs_persistence_path
         self.kv = KVStore(persistence_path)
         self.pubsub = PubSub()
         self._lock = threading.Lock()

@@ -45,7 +45,11 @@ from .task_spec import (
 
 _mp = multiprocessing.get_context("spawn")
 
-DEFAULT_MAX_WORKERS_PER_NODE = int(os.environ.get("RAY_TPU_MAX_WORKERS_PER_NODE", "16"))
+from ray_tpu.config import CONFIG
+
+
+def _default_max_workers() -> int:
+    return CONFIG.max_workers_per_node  # read at use: env changes apply live
 WORKER_START_TIMEOUT_S = 60.0
 
 
@@ -94,12 +98,13 @@ class WorkerHandle:
 
 class NodeRuntime:
     def __init__(self, cluster: "Cluster", node_id: NodeID, resources: Dict[str, float],
-                 labels: Optional[Dict[str, str]] = None, max_workers: int = DEFAULT_MAX_WORKERS_PER_NODE):
+                 labels: Optional[Dict[str, str]] = None, max_workers: Optional[int] = None):
         self.cluster = cluster
         self.node_id = node_id
         self.ledger = ResourceLedger(resources)
         self.labels = labels or {}
-        self.max_workers = max_workers
+        self.max_workers = (max_workers if max_workers is not None
+                            else _default_max_workers())
         self.idle: Dict[str, List[WorkerHandle]] = {}
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self.alive = True
@@ -303,7 +308,7 @@ class Cluster:
     """The whole single-host deployment: GCS + object store + N virtual nodes + router."""
 
     def __init__(self, resources: Dict[str, float], worker_env: Optional[Dict[str, str]] = None,
-                 max_workers_per_node: int = DEFAULT_MAX_WORKERS_PER_NODE,
+                 max_workers_per_node: Optional[int] = None,
                  object_store_memory: Optional[int] = None):
         self.gcs = GCS()
         self.store = ObjectStore()
@@ -313,9 +318,7 @@ class Cluster:
         # Workers attach via the env var; falls back to per-object segments if the
         # native build or shm creation fails.
         if object_store_memory is None:
-            object_store_memory = int(
-                os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", 512 * 1024 * 1024)
-            )
+            object_store_memory = CONFIG.object_store_bytes
         self.arena_name = (
             object_store.init_arena(object_store_memory) if object_store_memory > 0 else None
         )
@@ -367,21 +370,19 @@ class Cluster:
         self.store.on_free = self._on_object_freed
         self._object_store_capacity = object_store_memory
         self.spill_dir = os.path.join(
-            os.environ.get("RAY_TPU_SPILL_DIR", "/tmp"),
+            CONFIG.spill_dir,
             f"ray_tpu_spill_{os.getpid()}_{os.urandom(2).hex()}")
         # spill watermarks (reference: object_spilling_threshold / local_object_manager)
-        self.spill_threshold = float(os.environ.get("RAY_TPU_SPILL_THRESHOLD", 0.8))
-        self.spill_target = float(os.environ.get("RAY_TPU_SPILL_TARGET", 0.5))
+        self.spill_threshold = CONFIG.spill_threshold
+        self.spill_target = CONFIG.spill_target
         # memory monitor (reference memory_monitor.h:52 + worker_killing_policy)
-        self.memory_usage_threshold = float(
-            os.environ.get("RAY_TPU_MEMORY_USAGE_THRESHOLD", 0.95))
-        self.memory_monitor_refresh_ms = int(
-            os.environ.get("RAY_TPU_MEMORY_MONITOR_REFRESH_MS", 250))
+        self.memory_usage_threshold = CONFIG.memory_usage_threshold
+        self.memory_monitor_refresh_ms = CONFIG.memory_monitor_refresh_ms
         self._memory_sampler = _system_memory_fraction  # test seam
         self.num_oom_kills = 0
         self.store.on_remote_free = self._on_remote_free
         self._router_thread = threading.Thread(target=self._router, daemon=True, name="rt-router")
-        self.head_node = self.add_node(resources)
+        self.head_node = self.add_node(resources, max_workers=max_workers_per_node)
         self._router_thread.start()
         self._maint_wakeup = threading.Event()
         self._maint_thread = threading.Thread(
@@ -390,7 +391,7 @@ class Cluster:
 
     # -- topology --------------------------------------------------------------------
     def add_node(self, resources: Dict[str, float], labels: Optional[Dict[str, str]] = None,
-                 max_workers: int = DEFAULT_MAX_WORKERS_PER_NODE) -> NodeRuntime:
+                 max_workers: Optional[int] = None) -> NodeRuntime:
         node_id = NodeID.generate()
         node = NodeRuntime(self, node_id, resources, labels, max_workers)
         with self._lock:
@@ -1376,7 +1377,7 @@ class Cluster:
         """Heartbeat-based agent failure detection (reference
         GcsHealthCheckManager, gcs_health_check_manager.h:45). Connection EOF is
         the fast path; this catches hosts that hang without closing the socket."""
-        timeout = float(os.environ.get("RAY_TPU_AGENT_HEARTBEAT_TIMEOUT_S", "10"))
+        timeout = CONFIG.agent_heartbeat_timeout_s
         now = time.time()
         with self._lock:
             stale = [a for a in self._agent_conns.values()

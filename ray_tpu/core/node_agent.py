@@ -32,7 +32,7 @@ import cloudpickle
 
 _mp = multiprocessing.get_context("spawn")
 
-HEARTBEAT_INTERVAL_S = float(os.environ.get("RAY_TPU_AGENT_HEARTBEAT_S", "2.0"))
+from ray_tpu.config import CONFIG
 
 
 class NodeAgent:
@@ -43,11 +43,12 @@ class NodeAgent:
         from .resources import normalize_resources
 
         if resources is None:
-            num_cpus = float(os.environ.get("RAY_TPU_NUM_CPUS", os.cpu_count() or 1))
+            num_cpus = (CONFIG.num_cpus if CONFIG.num_cpus is not None
+                        else float(os.cpu_count() or 1))
             detected: Dict[str, float] = {}
-            env_tpus = os.environ.get("RAY_TPU_NUM_TPUS")
+            env_tpus = CONFIG.num_tpus
             if env_tpus is not None:
-                num_tpus = float(env_tpus)
+                num_tpus = env_tpus
             else:
                 from .accelerators import TPUAcceleratorManager
 
@@ -59,8 +60,7 @@ class NodeAgent:
                 resources.setdefault(k, v)
         self.resources = resources
         self.labels = labels or {}
-        self.max_workers = max_workers or int(
-            os.environ.get("RAY_TPU_MAX_WORKERS_PER_NODE", "16"))
+        self.max_workers = max_workers or CONFIG.max_workers_per_node
         self.conn = multiprocessing.connection.Client(
             (head_host, head_port), authkey=authkey)
         self._send_lock = threading.Lock()
@@ -114,7 +114,7 @@ class NodeAgent:
                 self._send(("heartbeat", time.time()))
             except Exception:
                 return
-            time.sleep(HEARTBEAT_INTERVAL_S)
+            time.sleep(CONFIG.agent_heartbeat_s)
 
     def _serve_loop(self) -> None:
         while not self._shutdown:

@@ -43,8 +43,9 @@ class JobInfo:
 
 
 def default_session_dir() -> str:
-    return os.environ.get("RAY_TPU_SESSION_DIR",
-                          os.path.join("/tmp", "ray_tpu_session"))
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.session_dir
 
 
 class JobManager:

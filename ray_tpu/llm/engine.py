@@ -280,11 +280,9 @@ class JaxLLMEngine(LLMEngine):
         allocate the decode state — prefill replicas stay KV-cache-free."""
         self.start()
         prompt_ids = self._encode_prompt(prompt, params)
-        tokens = self._pad_to_bucket(prompt_ids)
-        k, v, last_logits = model_runner.prefill_detached(
-            self.params, jnp.asarray(tokens), jnp.int32(len(prompt_ids)),
-            self.model_config,
-        )
+        # chunk-aware: a P/D prefill replica is exactly where long-prompt
+        # activation memory must stay bounded
+        k, v, last_logits = self._prefill_kv_tensors(prompt_ids)
         tok = int(model_runner.sample_tokens(
             self._next_rng(), last_logits[None, :],
             jnp.asarray([params.temperature], jnp.float32),
@@ -365,6 +363,12 @@ class JaxLLMEngine(LLMEngine):
                     if not self._admit_paged_kv(req, slot, jnp.asarray(k), jnp.asarray(v)):
                         self._admitting = None
                         return  # pool full: req (prefill_kv intact) requeued
+                elif k.shape[2] > c.max_model_len:
+                    # transfer padded past this engine's slot width: fail just
+                    # this request (install_kv would crash the whole loop)
+                    self._fail_request(req, len(req.prompt_ids))
+                    self._admitting = None
+                    continue
                 else:
                     self.state = model_runner.install_kv(
                         self.state, jnp.asarray(k), jnp.asarray(v),
@@ -625,6 +629,8 @@ class JaxLLMEngine(LLMEngine):
                         request_id=self._admitting.id, token_ids=[], finished=True,
                         finish_reason="error"))
                     self._admitting = None
+                    with self._lock:
+                        self.num_pending -= 1  # it left _waiting but never admitted
                 for slot, req in list(self._active.items()):
                     if req is not None:
                         req.out_queue.put(RequestOutput(

@@ -174,10 +174,17 @@ def cmd_job(args) -> int:
 
 
 def cmd_list(args) -> int:
-    """`ray-tpu list nodes|workers|tasks|actors|objects|placement-groups`
+    """`ray-tpu list nodes|workers|tasks|actors|objects|placement-groups|config`
     (reference `ray list ...`, python/ray/util/state/state_cli.py). Runs against
-    the in-process cluster, or a remote head via --address."""
+    the in-process cluster, or a remote head via --address; `config` prints the
+    central flag registry (reference ray_config_def.h) and needs no cluster."""
     import ray_tpu
+
+    if args.resource == "config":
+        from ray_tpu.config import CONFIG
+
+        print(CONFIG.describe())
+        return 0
 
     if args.address:
         ray_tpu.init(address=args.address)
@@ -282,7 +289,7 @@ def main(argv=None) -> int:
     sp = sub.add_parser("list", help="state API listings (reference `ray list`)")
     sp.add_argument("resource", choices=["nodes", "workers", "tasks", "actors",
                                          "objects", "placement-groups", "summary",
-                                         "stacks"])
+                                         "stacks", "config"])
     sp.add_argument("--address", default=None,
                     help="connect as a client driver, e.g. ray-tpu://127.0.0.1:10001")
     sp.set_defaults(fn=cmd_list)

@@ -144,7 +144,9 @@ class _LongPollClient:
     def _loop(self) -> None:
         import os as _os
 
-        _dbg = _os.environ.get("RAY_TPU_LP_DEBUG")
+        from ray_tpu.config import CONFIG as _cfg
+
+        _dbg = _cfg.lp_debug
         errors = 0
         while True:
             with self.lock:

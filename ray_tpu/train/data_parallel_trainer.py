@@ -28,9 +28,9 @@ TrainLoop = Union[Callable[[], None], Callable[[Dict[str, Any]], None]]
 
 
 def _default_storage_path() -> str:
-    return os.environ.get(
-        "RAY_TPU_STORAGE_PATH", os.path.join(os.path.expanduser("~"), "ray_tpu_results")
-    )
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.storage_path or os.path.join(os.path.expanduser("~"), "ray_tpu_results")
 
 
 class DataParallelTrainer:
@@ -64,7 +64,9 @@ class DataParallelTrainer:
         run_dir = os.path.join(storage, name)
         ckpt_manager = CheckpointManager(run_dir, self.run_config.checkpoint_config)
         train_fn = _normalize_train_fn(self.train_loop_per_worker)
-        if os.environ.get("RAY_TPU_TRAIN_V2_ENABLED", "0") in ("1", "true"):
+        from ray_tpu.config import CONFIG as _cfg
+
+        if _cfg.train_v2_enabled:
             # v2 controller path (reference RAY_TRAIN_V2_ENABLED gate)
             from .v2 import TrainController
 

@@ -21,7 +21,9 @@ _features: Counter = Counter()
 
 
 def usage_stats_enabled() -> bool:
-    return os.environ.get(_ENV, "0") == "1"
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.usage_stats
 
 
 def record_library_usage(feature: str) -> None:

@@ -45,9 +45,11 @@ def generate_authkey() -> bytes:
 
 def load_authkey() -> Optional[bytes]:
     """Resolve the cluster authkey: RAY_TPU_CLIENT_AUTHKEY env, then session dir."""
-    env = os.environ.get("RAY_TPU_CLIENT_AUTHKEY")
-    if env:
-        return env.encode()
+    from ray_tpu.config import CONFIG
+
+    key = CONFIG.client_authkey
+    if key:
+        return key.encode()
     try:
         with open(_authkey_file(), "rb") as f:
             return f.read().strip()

@@ -40,7 +40,9 @@ def enable_tracing() -> None:
 
 
 def is_tracing_enabled() -> bool:
-    return _enabled or os.environ.get(_ENV) == "1"
+    from ray_tpu.config import CONFIG
+
+    return _enabled or CONFIG.tracing
 
 
 def get_trace_context() -> Optional[Dict[str, str]]:

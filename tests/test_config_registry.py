@@ -1,0 +1,42 @@
+"""Central flag registry (ray_tpu/config.py; reference ray_config_def.h)."""
+import subprocess
+import sys
+
+from ray_tpu.config import CONFIG
+
+
+def test_defaults_and_env_override(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_MAX_WORKERS_PER_NODE", raising=False)
+    assert CONFIG.max_workers_per_node == 16
+    monkeypatch.setenv("RAY_TPU_MAX_WORKERS_PER_NODE", "4")
+    assert CONFIG.max_workers_per_node == 4
+    monkeypatch.setenv("RAY_TPU_TRACING", "true")
+    assert CONFIG.tracing is True
+    monkeypatch.setenv("RAY_TPU_SPILL_THRESHOLD", "0.5")
+    assert CONFIG.spill_threshold == 0.5
+
+
+def test_entries_report_source(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SPILL_TARGET", "0.25")
+    rows = {r["name"]: r for r in CONFIG.entries()}
+    assert rows["spill_target"]["source"] == "env"
+    assert rows["spill_target"]["value"] == 0.25
+    assert rows["spill_threshold"]["source"] == "default"
+    assert all(r["doc"] for r in rows.values())
+
+
+def test_unknown_flag_raises():
+    import pytest
+
+    with pytest.raises(AttributeError):
+        CONFIG.not_a_flag
+
+
+def test_cli_list_config():
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "list", "config"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert "RAY_TPU_MAX_WORKERS_PER_NODE" in out.stdout
+    assert "RAY_TPU_OBJECT_STORE_BYTES" in out.stdout
+    assert "[default" in out.stdout
