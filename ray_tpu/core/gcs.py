@@ -158,8 +158,6 @@ class PubSub:
 
 class GCS:
     def __init__(self, persistence_path: Optional[str] = None):
-        import os
-
         from ray_tpu.config import CONFIG
 
         persistence_path = persistence_path or CONFIG.gcs_persistence_path

@@ -642,9 +642,7 @@ class JaxLLMEngine(LLMEngine):
                         req = self._waiting.get_nowait()
                     except queue.Empty:
                         break
-                    req.out_queue.put(RequestOutput(
-                        request_id=req.id, token_ids=[], finished=True,
-                        finish_reason="error"))
+                    self._fail_request(req, len(req.prompt_ids), "error")
                 time.sleep(0.1)
 
 

@@ -8,6 +8,7 @@ from .algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
 from .algorithms.appo import APPO, APPOConfig, APPOLearner  # noqa: F401
 from .algorithms.cql import CQL, CQLConfig, CQLLearner  # noqa: F401
 from .algorithms.dqn import DQN, DQNConfig, DQNLearner  # noqa: F401
+from .algorithms.dreamerv3 import DreamerV3, DreamerV3Config, DreamerV3Learner  # noqa: F401
 from .algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner  # noqa: F401
 from .algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig, MARWILLearner  # noqa: F401
 from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig  # noqa: F401

@@ -22,6 +22,7 @@ from .algorithm_config import AlgorithmConfig
 
 class Algorithm(tune.Trainable):
     learner_class: type = Learner
+    env_runner_cls = None  # custom rollout actor class (None = SingleAgentEnvRunner)
 
     def __init__(self, config):
         if isinstance(config, dict):  # Tune passes plain dicts
@@ -44,7 +45,9 @@ class Algorithm(tune.Trainable):
         cfg = self._algo_config
         self.metrics = MetricsLogger()
         if cfg.env is not None:
-            self.env_runner_group = EnvRunnerGroup(cfg)
+            # subclasses with custom rollout actors (e.g. DreamerV3's recurrent
+            # runner) override env_runner_cls instead of rebuilding the group
+            self.env_runner_group = EnvRunnerGroup(cfg, runner_cls=self.env_runner_cls)
             probe = cfg.env_maker()()
             obs_space, act_space = probe.observation_space, probe.action_space
             probe.close()

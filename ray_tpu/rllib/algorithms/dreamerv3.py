@@ -1,0 +1,705 @@
+"""DreamerV3: world-model RL — RSSM + actor-critic trained in imagination.
+
+Capability parity: reference rllib/algorithms/dreamerv3/ (dreamerv3.py;
+torch world-model/actor/critic in dreamerv3/torch/, custom recurrent env
+runner in dreamerv3/utils/env_runner.py). JAX-first here: the world model
+(encoder → RSSM with categorical latents → decoder/reward/continue heads),
+imagination rollouts, and both actor and critic updates are single jitted
+programs over scanned sequences.
+
+Key mechanisms kept from the paper/reference:
+- RSSM: GRU deterministic path; stochastic state = K categorical distributions
+  of C classes with straight-through sampling and 1% uniform mixing (unimix);
+- KL balancing with free bits: beta_dyn * max(1, KL(sg(post) || prior)) +
+  beta_rep * max(1, KL(post || sg(prior)));
+- symlog regression for reconstruction/reward/value;
+- imagination: H-step rollouts from replayed posterior states, lambda-returns,
+  EMA-regularized critic, REINFORCE actor with return normalization by an EMA
+  of the 5th..95th return percentile range;
+- replay: one contiguous step stream with is_first markers (windows may span
+  episode boundaries; the RSSM resets where is_first=1).
+
+The reference ships its own recurrent env runner because acting needs the
+(h, z) state; DreamerV3EnvRunner mirrors that.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.learner import Learner
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+# ------------------------------------------------------------------ jax helpers
+
+def _symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def _symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def _linear(rng: np.random.Generator, n_in: int, n_out: int) -> Dict[str, np.ndarray]:
+    scale = np.sqrt(2.0 / max(1, n_in))
+    return {"w": (rng.standard_normal((n_in, n_out)) * scale).astype(np.float32),
+            "b": np.zeros((n_out,), np.float32)}
+
+
+def _mlp_params(rng, sizes) -> List[Dict[str, np.ndarray]]:
+    return [_linear(rng, a, b) for a, b in zip(sizes[:-1], sizes[1:])]
+
+
+def _mlp(params, x, final_linear=True):
+    import jax.numpy as jnp
+
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or not final_linear:
+            x = jnp.tanh(x)
+    return x
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self, algo_class: type = None):
+        super().__init__(algo_class or DreamerV3)
+        # model sizes (toy-scale defaults; the 8B-scale knobs are the same names)
+        self.deter_size: int = 128
+        self.stoch_classes: int = 8  # C
+        self.stoch_groups: int = 8   # K -> z is K*C one-hots
+        self.hidden: int = 128
+        self.embed_size: int = 128
+        # replay / training schedule
+        self.replay_capacity: int = 100_000
+        self.batch_size_seqs: int = 16
+        self.seq_len: int = 16
+        self.num_updates_per_iteration: int = 8
+        self.sample_timesteps_per_iteration: int = 400
+        self.num_steps_sampled_before_learning_starts: int = 1000
+        # losses
+        self.beta_pred: float = 1.0
+        self.beta_dyn: float = 0.5
+        self.beta_rep: float = 0.1
+        self.free_bits: float = 1.0
+        self.unimix: float = 0.01
+        # imagination / actor-critic
+        self.imag_horizon: int = 15
+        self.gamma = 0.99
+        self.lambda_: float = 0.95
+        self.entropy_coef: float = 3e-3
+        self.critic_ema_decay: float = 0.98
+        self.retnorm_decay: float = 0.99
+        self.lr_world: float = 4e-4
+        self.lr_actor: float = 1e-4
+        self.lr_critic: float = 1e-4
+        self.grad_clip = 100.0
+
+    def training(self, **kwargs) -> "DreamerV3Config":
+        known = {k: kwargs.pop(k) for k in list(kwargs)
+                 if hasattr(self, k) and k not in AlgorithmConfig.__dict__}
+        for k, v in known.items():
+            if v is not None:
+                setattr(self, k, v)
+        super().training(**kwargs)
+        return self
+
+
+# ----------------------------------------------------------------- model (pure)
+
+class _DreamerNets:
+    """Pure-jax parameter builders + apply fns (no framework Modules)."""
+
+    def __init__(self, obs_dim: int, n_actions: int, cfg: DreamerV3Config):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.cfg = cfg
+        self.z_size = cfg.stoch_groups * cfg.stoch_classes
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        cfg = self.cfg
+        rng = np.random.default_rng(seed)
+        d, h, e, z, a = (cfg.deter_size, cfg.hidden, cfg.embed_size,
+                         self.z_size, self.n_actions)
+        return {
+            "enc": _mlp_params(rng, [self.obs_dim, h, e]),
+            # GRU over x=[z, a_onehot] with state h
+            "gru_r": _linear(rng, z + a + d, d),
+            "gru_u": _linear(rng, z + a + d, d),
+            "gru_c": _linear(rng, z + a + d, d),
+            "prior": _mlp_params(rng, [d, h, z]),
+            "post": _mlp_params(rng, [d + e, h, z]),
+            "dec": _mlp_params(rng, [d + z, h, self.obs_dim]),
+            "rew": _mlp_params(rng, [d + z, h, 1]),
+            "cont": _mlp_params(rng, [d + z, h, 1]),
+            "actor": _mlp_params(rng, [d + z, h, a]),
+            "critic": _mlp_params(rng, [d + z, h, 1]),
+        }
+
+    # -- rssm -------------------------------------------------------------
+    def gru(self, p, hstate, z, a_onehot):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([z, a_onehot], -1)
+        xh = jnp.concatenate([x, hstate], -1)
+        r = jax.nn.sigmoid(xh @ p["gru_r"]["w"] + p["gru_r"]["b"])
+        u = jax.nn.sigmoid(xh @ p["gru_u"]["w"] + p["gru_u"]["b"])
+        xr = jnp.concatenate([x, r * hstate], -1)
+        c = jnp.tanh(xr @ p["gru_c"]["w"] + p["gru_c"]["b"])
+        return u * hstate + (1.0 - u) * c
+
+    def _logits(self, raw):
+        """[..., K*C] -> unimix'd log-probs [..., K, C]."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        lg = raw.reshape(*raw.shape[:-1], cfg.stoch_groups, cfg.stoch_classes)
+        probs = jax.nn.softmax(lg, -1)
+        probs = (1 - cfg.unimix) * probs + cfg.unimix / cfg.stoch_classes
+        return jnp.log(probs)
+
+    def sample_z(self, rng, logp):
+        """Straight-through one-hot sample from [..., K, C] log-probs -> [..., K*C]."""
+        import jax
+        import jax.numpy as jnp
+
+        idx = jax.random.categorical(rng, logp, axis=-1)
+        onehot = jax.nn.one_hot(idx, self.cfg.stoch_classes, dtype=logp.dtype)
+        probs = jnp.exp(logp)
+        st = onehot + probs - jax.lax.stop_gradient(probs)
+        return st.reshape(*st.shape[:-2], self.z_size)
+
+    def kl(self, logp_a, logp_b):
+        """KL(a || b) over [..., K, C] log-probs, summed over groups."""
+        import jax.numpy as jnp
+
+        return (jnp.exp(logp_a) * (logp_a - logp_b)).sum(-1).sum(-1)
+
+    # -- heads ------------------------------------------------------------
+    def feat(self, hstate, z):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([hstate, z], -1)
+
+    def decode(self, p, f):
+        return _mlp(p["dec"], f)
+
+    def reward(self, p, f):
+        return _mlp(p["rew"], f)[..., 0]  # symlog space
+
+    def cont(self, p, f):
+        return _mlp(p["cont"], f)[..., 0]  # logit
+
+    def actor_logits(self, p, f):
+        return _mlp(p["actor"], f)
+
+    def value(self, p, f):
+        return _mlp(p["critic"], f)[..., 0]  # symlog space
+
+
+# ------------------------------------------------------------------- replay
+
+class _StreamBuffer:
+    """Contiguous STATE stream with is_first markers (reference: Dreamer's
+    episodic replay sampled as fixed-length windows).
+
+    Row t holds: obs_t, the action taken AT t, the reward received ENTERING t,
+    and whether t is terminal. Terminal observations get their own row (with a
+    dummy action that the next row's is_first masking neutralizes) — without
+    them the continue head would never see a cont=0 target and imagination
+    would never terminate."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int64)
+        self.rew_in = np.zeros((capacity,), np.float32)
+        self.terms = np.zeros((capacity,), np.float32)
+        self.is_first = np.zeros((capacity,), np.float32)
+        self.ptr = 0
+        self.full = False
+
+    def __len__(self):
+        return self.capacity if self.full else self.ptr
+
+    def _push(self, obs, action, rew_in, term, first) -> None:
+        i = self.ptr
+        self.obs[i] = obs
+        self.actions[i] = action
+        self.rew_in[i] = rew_in
+        self.terms[i] = term
+        self.is_first[i] = first
+        self.ptr = (self.ptr + 1) % self.capacity
+        if self.ptr == 0:
+            self.full = True
+
+    def add_episodes(self, episodes: List[Dict[str, np.ndarray]]) -> int:
+        added = 0
+        for ep in episodes:
+            n = len(ep["actions"])
+            for t in range(n):
+                self._push(ep["obs"][t], ep["actions"][t],
+                           ep["rewards"][t - 1] if t > 0 else 0.0,
+                           0.0, 1.0 if t == 0 else 0.0)
+                added += 1
+            # ALWAYS write the final-state row (its dummy action is masked by
+            # the next row's is_first): it carries the episode's LAST reward,
+            # which would otherwise be censored for truncated/chunked episodes,
+            # and the cont=0 target when the episode truly terminated
+            self._push(ep["next_obs_last"], 0, ep["rewards"][n - 1],
+                       1.0 if ep["terminated"] else 0.0, 0.0)
+            added += 1
+        return added
+
+    def sample(self, batch: int, length: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        hi = len(self) - length
+        starts = rng.integers(0, max(1, hi), size=batch)
+        # logical index 0 = OLDEST row (= ptr once the ring wrapped): windows
+        # over logical positions are always time-contiguous, never splicing the
+        # newest data onto the oldest across the write pointer
+        base = self.ptr if self.full else 0
+        idx = (base + starts[:, None] + np.arange(length)[None, :]) % self.capacity
+        out = {
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rew_in": self.rew_in[idx],
+            "terms": self.terms[idx],
+            "is_first": self.is_first[idx].copy(),
+        }
+        out["is_first"][:, 0] = 1.0  # window start = state reset (no context)
+        out["rew_in"] = out["rew_in"].copy()
+        out["rew_in"][:, 0] = 0.0  # fresh context: no entering reward
+        return out
+
+
+# ------------------------------------------------------------------- learner
+
+class DreamerV3Learner(Learner):
+    """World model + actor + critic, each with its own optimizer; both phases
+    are single jitted programs (reference dreamerv3 torch_learner)."""
+
+    def build(self) -> None:
+        import jax
+        import optax
+
+        cfg = self.config
+        obs_dim = int(np.prod(self.module.observation_space.shape))
+        n_actions = int(self.module.action_space.n)
+        self.nets = _DreamerNets(obs_dim, n_actions, cfg)
+        self.params = self.nets.init_params(seed=cfg.seed or 0)
+        self.params = jax.tree_util.tree_map(np.asarray, self.params)
+        self.critic_ema = jax.tree_util.tree_map(np.array, self.params["critic"])
+
+        def chain(lr):
+            return optax.chain(optax.clip_by_global_norm(cfg.grad_clip or 100.0),
+                               optax.adam(lr))
+
+        self._wm_keys = ("enc", "gru_r", "gru_u", "gru_c", "prior", "post",
+                         "dec", "rew", "cont")
+        self.opt_world = chain(cfg.lr_world)
+        self.opt_actor = chain(cfg.lr_actor)
+        self.opt_critic = chain(cfg.lr_critic)
+        self.st_world = self.opt_world.init({k: self.params[k] for k in self._wm_keys})
+        self.st_actor = self.opt_actor.init(self.params["actor"])
+        self.st_critic = self.opt_critic.init(self.params["critic"])
+        # EMA of the 5th..95th percentile return range (actor normalization)
+        self.ret_range = 1.0
+        self._rng = jax.random.PRNGKey((self.config.seed or 0) + 7)
+        self._wm_fn = self._build_wm_fn()
+        self._ac_fn = self._build_ac_fn()
+        self.metrics: Dict[str, Any] = {}
+
+    # -- world model phase ------------------------------------------------
+    def _build_wm_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        nets, cfg = self.nets, self.config
+
+        def wm_loss(params, batch, rng):
+            b, length = batch["actions"].shape
+            obs = _symlog(batch["obs"])
+            embed = _mlp(params["enc"], obs)  # [B, L, E]
+            a_onehot = jax.nn.one_hot(batch["actions"], nets.n_actions, dtype=obs.dtype)
+            # prev action for the sequence model, zeroed where an episode starts
+            keep = (1.0 - batch["is_first"])[..., None]
+            prev_a = jnp.roll(a_onehot, 1, axis=1) * keep
+            # per-state targets stored directly in the stream: reward entering
+            # the state, and whether the state is terminal (cont = 1 - term)
+            tgt_r = batch["rew_in"]
+            tgt_cont = 1.0 - batch["terms"]
+
+            h0 = jnp.zeros((b, cfg.deter_size), obs.dtype)
+            z0 = jnp.zeros((b, nets.z_size), obs.dtype)
+            keys = jax.random.split(rng, length)
+
+            def step(carry, xs):
+                hstate, z = carry
+                emb_t, a_t, first_t, key = xs
+                mask = (1.0 - first_t)[:, None]
+                hstate = hstate * mask
+                z = z * mask
+                hstate = nets.gru(params, hstate, z, a_t * mask)
+                post_lp = nets._logits(_mlp(params["post"],
+                                            jnp.concatenate([hstate, emb_t], -1)))
+                prior_lp = nets._logits(_mlp(params["prior"], hstate))
+                z = nets.sample_z(key, post_lp)
+                return (hstate, z), (hstate, z, post_lp, prior_lp)
+
+            (_, _), (hs, zs, post_lp, prior_lp) = jax.lax.scan(
+                step, (h0, z0),
+                (embed.transpose(1, 0, 2), prev_a.transpose(1, 0, 2),
+                 batch["is_first"].T, keys))
+            hs = hs.transpose(1, 0, 2)  # [B, L, D]
+            zs = zs.transpose(1, 0, 2)
+            post_lp = post_lp.transpose(1, 0, 2, 3)
+            prior_lp = prior_lp.transpose(1, 0, 2, 3)
+            f = nets.feat(hs, zs)
+
+            recon = nets.decode(params, f)
+            loss_rec = ((recon - obs) ** 2).sum(-1).mean()
+            loss_rew = ((nets.reward(params, f) - _symlog(tgt_r)) ** 2).mean()
+            cont_logit = nets.cont(params, f)
+            loss_cont = jnp.mean(
+                jnp.maximum(cont_logit, 0) - cont_logit * tgt_cont
+                + jnp.log1p(jnp.exp(-jnp.abs(cont_logit))))
+            sg = jax.lax.stop_gradient
+            kl_dyn = jnp.maximum(cfg.free_bits,
+                                 nets.kl(sg(post_lp), prior_lp)).mean()
+            kl_rep = jnp.maximum(cfg.free_bits,
+                                 nets.kl(post_lp, sg(prior_lp))).mean()
+            loss = (cfg.beta_pred * (loss_rec + loss_rew + loss_cont)
+                    + cfg.beta_dyn * kl_dyn + cfg.beta_rep * kl_rep)
+            aux = {"wm_loss": loss, "recon_loss": loss_rec, "reward_loss": loss_rew,
+                   "cont_loss": loss_cont, "kl_dyn": kl_dyn, "kl_rep": kl_rep,
+                   "starts_h": sg(hs.reshape(-1, cfg.deter_size)),
+                   "starts_z": sg(zs.reshape(-1, nets.z_size))}
+            return loss, aux
+
+        grad_fn = jax.value_and_grad(
+            lambda wm, rest, batch, rng: wm_loss({**wm, **rest}, batch, rng),
+            has_aux=True)
+
+        @jax.jit
+        def update(params, batch, rng):
+            wm = {k: params[k] for k in self._wm_keys}
+            rest = {k: params[k] for k in params if k not in self._wm_keys}
+            (loss, aux), grads = grad_fn(wm, rest, batch, rng)
+            return loss, aux, grads
+
+        return update
+
+    # -- imagination + actor-critic phase ---------------------------------
+    def _build_ac_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        nets, cfg = self.nets, self.config
+        sg = jax.lax.stop_gradient
+
+        def imagine(params, actor_p, h0, z0, rng):
+            def step(carry, key):
+                hstate, z = carry
+                f = nets.feat(hstate, z)
+                alogits = _mlp(actor_p, f)
+                a = jax.random.categorical(key, alogits, axis=-1)
+                a1 = jax.nn.one_hot(a, nets.n_actions, dtype=f.dtype)
+                h2 = nets.gru(params, hstate, z, a1)
+                z2 = nets.sample_z(jax.random.fold_in(key, 1),
+                                   nets._logits(_mlp(params["prior"], h2)))
+                return (h2, z2), (hstate, z, a, h2, z2)
+
+            keys = jax.random.split(rng, cfg.imag_horizon)
+            _, (hs, zs, acts, h2s, z2s) = jax.lax.scan(step, (h0, z0), keys)
+            return hs, zs, acts, h2s, z2s  # [H, N, ...]
+
+        def losses(actor_p, critic_p, params, critic_ema, h0, z0, rng, ret_range):
+            hs, zs, acts, h2s, z2s = imagine(params, actor_p, h0, z0, rng)
+            f_next = nets.feat(h2s, z2s)  # state entered by each imagined action
+            rew = _symexp(nets.reward(params, f_next))  # [H, N]
+            cont = jax.nn.sigmoid(nets.cont(params, f_next))
+            v_next = _symexp(nets.value({"critic": critic_p}, f_next))
+            # lambda-returns backwards over the horizon
+            def lam_step(nxt, xs):
+                r, c, v = xs
+                ret = r + cfg.gamma * c * ((1 - cfg.lambda_) * v + cfg.lambda_ * nxt)
+                return ret, ret
+
+            last = v_next[-1]
+            _, rets = jax.lax.scan(lam_step, last, (rew, cont, v_next), reverse=True)
+            rets = sg(rets)  # [H, N]
+            f_cur = nets.feat(hs, zs)
+            # discounted trajectory weights (stop after predicted termination)
+            w = sg(jnp.cumprod(jnp.concatenate(
+                [jnp.ones_like(cont[:1]), cfg.gamma * cont[:-1]], 0), 0))
+            # actor: REINFORCE with normalized advantage + entropy
+            alogits = _mlp(actor_p, f_cur)
+            logp_all = jax.nn.log_softmax(alogits)
+            logp_a = jnp.take_along_axis(logp_all, acts[..., None], -1)[..., 0]
+            v_cur = sg(_symexp(nets.value({"critic": critic_p}, f_cur)))
+            adv = (rets - v_cur) / jnp.maximum(1.0, ret_range)
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1)
+            actor_loss = -(w * (logp_a * sg(adv) + cfg.entropy_coef * entropy)).mean()
+            # critic: symlog regression to lambda-returns + EMA regularizer
+            v_pred = nets.value({"critic": critic_p}, sg(f_cur))
+            v_ema = sg(nets.value({"critic": critic_ema}, sg(f_cur)))
+            critic_loss = (w * ((v_pred - _symlog(rets)) ** 2
+                                + 0.3 * (v_pred - v_ema) ** 2)).mean()
+            aux = {"actor_loss": actor_loss, "critic_loss": critic_loss,
+                   "imag_return": rets.mean(), "actor_entropy": entropy.mean(),
+                   "ret_p95": jnp.percentile(rets, 95),
+                   "ret_p5": jnp.percentile(rets, 5)}
+            return actor_loss + critic_loss, aux
+
+        grad_fn = jax.value_and_grad(losses, argnums=(0, 1), has_aux=True)
+
+        @jax.jit
+        def update(params, critic_ema, h0, z0, rng, ret_range):
+            (loss, aux), (g_actor, g_critic) = grad_fn(
+                params["actor"], params["critic"], params, critic_ema,
+                h0, z0, rng, ret_range)
+            return loss, aux, g_actor, g_critic
+
+        return update
+
+    # -- the composite update ---------------------------------------------
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+        import optax
+
+        cfg = self.config
+        self._rng, k1, k2 = jax.random.split(self._rng, 3)
+        _, aux, wm_grads = self._wm_fn(self.params, batch, k1)
+        wm_grads = self._sync_grads(wm_grads)
+        wm_params = {k: self.params[k] for k in self._wm_keys}
+        upd, self.st_world = self.opt_world.update(wm_grads, self.st_world, wm_params)
+        wm_params = optax.apply_updates(wm_params, upd)
+        self.params.update(jax.tree_util.tree_map(np.asarray, wm_params))
+
+        h0, z0 = aux.pop("starts_h"), aux.pop("starts_z")
+        _, ac_aux, g_actor, g_critic = self._ac_fn(
+            self.params, self.critic_ema, h0, z0, k2, float(self.ret_range))
+        g_actor = self._sync_grads(g_actor)
+        g_critic = self._sync_grads(g_critic)
+        upd_a, self.st_actor = self.opt_actor.update(
+            g_actor, self.st_actor, self.params["actor"])
+        self.params["actor"] = jax.tree_util.tree_map(
+            np.asarray, optax.apply_updates(self.params["actor"], upd_a))
+        upd_c, self.st_critic = self.opt_critic.update(
+            g_critic, self.st_critic, self.params["critic"])
+        self.params["critic"] = jax.tree_util.tree_map(
+            np.asarray, optax.apply_updates(self.params["critic"], upd_c))
+        d = cfg.critic_ema_decay
+        self.critic_ema = jax.tree_util.tree_map(
+            lambda e, p: np.asarray(d * e + (1 - d) * p),
+            self.critic_ema, self.params["critic"])
+        rng_now = float(ac_aux.pop("ret_p95")) - float(ac_aux.pop("ret_p5"))
+        self.ret_range = (cfg.retnorm_decay * self.ret_range
+                          + (1 - cfg.retnorm_decay) * rng_now)
+        self.metrics = {k: float(v) for k, v in {**aux, **ac_aux}.items()}
+        self.metrics["total_loss"] = self.metrics["wm_loss"]
+        self.metrics["ret_range"] = float(self.ret_range)
+        return self.metrics
+
+    def get_weights(self):
+        return self.params
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.params, "critic_ema": self.critic_ema,
+                "st_world": self.st_world, "st_actor": self.st_actor,
+                "st_critic": self.st_critic, "ret_range": self.ret_range}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.critic_ema = state.get("critic_ema", self.critic_ema)
+        for k in ("st_world", "st_actor", "st_critic"):
+            if state.get(k) is not None:
+                setattr(self, k, state[k])
+        self.ret_range = state.get("ret_range", self.ret_range)
+
+
+# ------------------------------------------------------------------- env runner
+
+class DreamerV3EnvRunner:
+    """Recurrent rollout actor: carries (h, z, prev_action) per env and resets
+    them on episode boundaries (reference dreamerv3/utils/env_runner.py)."""
+
+    def __init__(self, config: DreamerV3Config, worker_index: int = 0):
+        import gymnasium as gym
+        import jax
+
+        self.config = config
+        self.worker_index = worker_index
+        self.num_envs = config.num_envs_per_env_runner
+        maker = config.env_maker()
+        self.env = gym.vector.SyncVectorEnv([maker for _ in range(self.num_envs)])
+        single = maker()
+        obs_dim = int(np.prod(single.observation_space.shape))
+        self.nets = _DreamerNets(obs_dim, int(single.action_space.n), config)
+        single.close()
+        self.params = self.nets.init_params(seed=config.seed or 0)
+        self._jrng = jax.random.PRNGKey((config.seed or 0) + 100 + worker_index)
+        self.rng = np.random.default_rng((config.seed or 0) + worker_index + 1)
+        self._obs = None
+        self.metrics: Dict[str, Any] = {}
+        self._act = self._build_act_fn()
+
+    def _build_act_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        nets = self.nets
+
+        @jax.jit
+        def act(params, hstate, z, prev_a, obs, first, rng):
+            mask = (1.0 - first)[:, None]
+            hstate = hstate * mask
+            z = z * mask
+            prev_a = prev_a * mask
+            hstate = nets.gru(params, hstate, z, prev_a)
+            embed = _mlp(params["enc"], _symlog(obs))
+            post_lp = nets._logits(_mlp(params["post"],
+                                        jnp.concatenate([hstate, embed], -1)))
+            k1, k2 = jax.random.split(rng)
+            z = nets.sample_z(k1, post_lp)
+            logits = nets.actor_logits(params, nets.feat(hstate, z))
+            a = jax.random.categorical(k2, logits, axis=-1)
+            return hstate, z, a
+
+        return act
+
+    # -- weights / control --------------------------------------------------
+    def get_state(self):
+        return {"params": self.params}
+
+    def set_state(self, state):
+        self.params = state["params"]
+
+    def set_weights(self, params):
+        self.params = params
+
+    def get_metrics(self):
+        return self.metrics
+
+    def ping(self) -> bool:
+        return True
+
+    def stop(self) -> None:
+        try:
+            self.env.close()
+        except Exception:
+            pass
+
+    # -- sampling -----------------------------------------------------------
+    def _reset_if_needed(self):
+        from ..env.episode import SingleAgentEpisode
+
+        if self._obs is None:
+            obs, _ = self.env.reset(seed=(self.config.seed or 0) + self.worker_index)
+            self._obs = obs
+            n = self.num_envs
+            self._episodes = [SingleAgentEpisode() for _ in range(n)]
+            for i in range(n):
+                self._episodes[i].add_env_reset(obs[i])
+            self._prev_done = np.zeros(n, dtype=bool)
+            self._first = np.ones(n, np.float32)
+            self._h = np.zeros((n, self.config.deter_size), np.float32)
+            self._z = np.zeros((n, self.nets.z_size), np.float32)
+            self._pa = np.zeros((n, self.nets.n_actions), np.float32)
+
+    def sample(self, num_timesteps: Optional[int] = None, explore: bool = True):
+        import jax
+
+        from ..env.episode import SingleAgentEpisode
+
+        cfg = self.config
+        num_timesteps = num_timesteps or cfg.rollout_fragment_length * self.num_envs
+        self._reset_if_needed()
+        done_eps = []
+        returns: List[float] = []
+        steps = 0
+        while steps < num_timesteps:
+            self._jrng, key = jax.random.split(self._jrng)
+            h2, z2, a = self._act(self.params, self._h, self._z, self._pa,
+                                  np.asarray(self._obs, np.float32),
+                                  self._first, key)
+            self._h, self._z = np.asarray(h2), np.asarray(z2)
+            actions = np.asarray(a)
+            self._pa = np.eye(self.nets.n_actions, dtype=np.float32)[actions]
+            self._first = np.zeros(self.num_envs, np.float32)
+            obs, rewards, terms, truncs, _ = self.env.step(actions)
+            for i in range(self.num_envs):
+                if self._prev_done[i]:
+                    self._episodes[i] = SingleAgentEpisode()
+                    self._episodes[i].add_env_reset(obs[i])
+                    self._prev_done[i] = False
+                    self._first[i] = 1.0
+                    continue
+                ep = self._episodes[i]
+                ep.add_env_step(obs[i], actions[i], rewards[i], terms[i], truncs[i])
+                steps += 1
+                if terms[i] or truncs[i]:
+                    returns.append(ep.get_return())
+                    done_eps.append(ep)
+                    self._prev_done[i] = True
+                    self._first[i] = 1.0
+            self._obs = obs
+        for i in range(self.num_envs):
+            if not self._prev_done[i] and len(self._episodes[i]):
+                done_eps.append(self._episodes[i])
+                self._episodes[i] = SingleAgentEpisode()
+                self._episodes[i].add_env_reset(self._obs[i])
+        self.metrics = {
+            "num_env_steps_sampled": steps,
+            "episode_return_mean": float(np.mean(returns)) if returns else None,
+            "num_episodes": len(returns),
+        }
+        return [ep.to_numpy() for ep in done_eps]
+
+
+# ------------------------------------------------------------------- algorithm
+
+class DreamerV3(Algorithm):
+    learner_class = DreamerV3Learner
+    env_runner_cls = DreamerV3EnvRunner  # recurrent rollout actors
+
+    @classmethod
+    def get_default_config(cls) -> DreamerV3Config:
+        return DreamerV3Config(cls)
+
+    def setup(self, _config) -> None:
+        super().setup(_config)
+        cfg = self._algo_config
+        obs_dim = int(np.prod(self.module_spec.observation_space.shape))
+        self.buffer = _StreamBuffer(cfg.replay_capacity, obs_dim)
+        self._np_rng = np.random.default_rng(cfg.seed or 0)
+        self._env_steps = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._algo_config
+        episodes = self.env_runner_group.sample(cfg.sample_timesteps_per_iteration)
+        self._env_steps += self.buffer.add_episodes(episodes)
+        for m in self.env_runner_group.get_metrics():
+            self.metrics.log_dict({k: v for k, v in m.items() if v is not None},
+                                  window=20)
+        warm = (len(self.buffer)
+                >= max(cfg.num_steps_sampled_before_learning_starts,
+                       cfg.seq_len * 2))
+        if warm:
+            for _ in range(cfg.num_updates_per_iteration):
+                batch = self.buffer.sample(cfg.batch_size_seqs, cfg.seq_len,
+                                           self._np_rng)
+                for lm in self.learner_group.update(batch):
+                    self.metrics.log_dict(lm)
+            self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        result = self.metrics.reduce()
+        result["num_env_steps_sampled_lifetime"] = self._env_steps
+        return result

@@ -15,7 +15,8 @@ from .env_runner import SingleAgentEnvRunner
 
 
 class EnvRunnerGroup:
-    def __init__(self, config: "AlgorithmConfig", runner_cls: type = SingleAgentEnvRunner):  # noqa: F821
+    def __init__(self, config: "AlgorithmConfig", runner_cls: type = None):  # noqa: F821
+        runner_cls = runner_cls or SingleAgentEnvRunner
         self.config = config
         self.n = max(1, config.num_env_runners)
         self._actor_cls = ray_tpu.remote(num_cpus=1)(runner_cls)

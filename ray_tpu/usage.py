@@ -15,7 +15,6 @@ import time
 from collections import Counter
 from typing import Dict
 
-_ENV = "RAY_TPU_USAGE_STATS"
 _lock = threading.Lock()
 _features: Counter = Counter()
 
