@@ -261,7 +261,7 @@ class _StreamBuffer:
 
     def sample(self, batch: int, length: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
         hi = len(self) - length
-        starts = rng.integers(0, max(1, hi), size=batch)
+        starts = rng.integers(0, max(1, hi + 1), size=batch)
         # logical index 0 = OLDEST row (= ptr once the ring wrapped): windows
         # over logical positions are always time-contiguous, never splicing the
         # newest data onto the oldest across the write pointer
@@ -272,10 +272,9 @@ class _StreamBuffer:
             "actions": self.actions[idx],
             "rew_in": self.rew_in[idx],
             "terms": self.terms[idx],
-            "is_first": self.is_first[idx].copy(),
+            "is_first": self.is_first[idx],  # fancy indexing already copies
         }
         out["is_first"][:, 0] = 1.0  # window start = state reset (no context)
-        out["rew_in"] = out["rew_in"].copy()
         out["rew_in"][:, 0] = 0.0  # fresh context: no entering reward
         return out
 
@@ -559,7 +558,7 @@ class DreamerV3EnvRunner:
         nets = self.nets
 
         @jax.jit
-        def act(params, hstate, z, prev_a, obs, first, rng):
+        def act(params, hstate, z, prev_a, obs, first, rng, explore):
             mask = (1.0 - first)[:, None]
             hstate = hstate * mask
             z = z * mask
@@ -571,7 +570,9 @@ class DreamerV3EnvRunner:
             k1, k2 = jax.random.split(rng)
             z = nets.sample_z(k1, post_lp)
             logits = nets.actor_logits(params, nets.feat(hstate, z))
-            a = jax.random.categorical(k2, logits, axis=-1)
+            a = jnp.where(explore,
+                          jax.random.categorical(k2, logits, axis=-1),
+                          jnp.argmax(logits, axis=-1))
             return hstate, z, a
 
         return act
@@ -630,7 +631,7 @@ class DreamerV3EnvRunner:
             self._jrng, key = jax.random.split(self._jrng)
             h2, z2, a = self._act(self.params, self._h, self._z, self._pa,
                                   np.asarray(self._obs, np.float32),
-                                  self._first, key)
+                                  self._first, key, explore)
             self._h, self._z = np.asarray(h2), np.asarray(z2)
             actions = np.asarray(a)
             self._pa = np.eye(self.nets.n_actions, dtype=np.float32)[actions]
@@ -686,7 +687,8 @@ class DreamerV3(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg = self._algo_config
         episodes = self.env_runner_group.sample(cfg.sample_timesteps_per_iteration)
-        self._env_steps += self.buffer.add_episodes(episodes)
+        self.buffer.add_episodes(episodes)
+        self._env_steps += sum(len(ep["actions"]) for ep in episodes)
         for m in self.env_runner_group.get_metrics():
             self.metrics.log_dict({k: v for k, v in m.items() if v is not None},
                                   window=20)

@@ -28,3 +28,7 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     user_config: Optional[Dict[str, Any]] = None
     version: Optional[str] = None
+    # replica->node packing (reference _private/deployment_scheduler.py):
+    # "PACK" fills nodes in turn (compact, frees whole nodes for downscaling);
+    # "SPREAD" balances replicas across nodes (availability)
+    placement_strategy: str = "PACK"

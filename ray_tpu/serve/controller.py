@@ -31,6 +31,7 @@ class _ReplicaState:
         self.state = STARTING
         self.health_ref = None
         self.last_health_ok = time.time()
+        self.node_id: Optional[str] = None  # packing assignment (soft affinity)
 
 
 class _DeploymentState:
@@ -219,6 +220,35 @@ class ServeController:
                 ds.autoscale_metric = 0.6 * ds.autoscale_metric + 0.4 * ongoing
 
     # -- reconciliation --------------------------------------------------------
+    def _choose_replica_node(self, ds: _DeploymentState,
+                             num_cpus: float) -> Optional[str]:
+        """Replica->node packing (reference _private/deployment_scheduler.py):
+        PACK fills the node already hosting the most of this deployment's
+        replicas (compact; whole nodes free up for downscaling), SPREAD picks
+        the one hosting the fewest. Returns a node id hex, or None to let the
+        default scheduler place."""
+        try:
+            from ray_tpu.util.state import list_nodes
+
+            nodes = [n for n in list_nodes() if n["alive"]]
+        except Exception:
+            return None
+        if len(nodes) <= 1:
+            return None
+        counts = {n["node_id"]: 0 for n in nodes}
+        for r in ds.replicas:
+            if r.node_id in counts:
+                counts[r.node_id] += 1
+        fits = [n for n in nodes
+                if n["resources_available"].get("CPU", 0.0) >= num_cpus]
+        if not fits:
+            return None
+        # pre-upgrade KV checkpoints may lack the field (unpickle skips defaults)
+        spread = getattr(ds.info["config"], "placement_strategy", "PACK") == "SPREAD"
+        best = min(fits, key=lambda n: counts[n["node_id"]]) if spread else \
+            max(fits, key=lambda n: counts[n["node_id"]])
+        return best["node_id"]
+
     def _start_replica(self, ds: _DeploymentState) -> None:
         import ray_tpu
 
@@ -230,11 +260,20 @@ class ServeController:
         moq = ds.info["config"].max_ongoing_requests
         if moq and moq > 1:
             actor_opts["max_concurrency"] = moq
+        node_id = self._choose_replica_node(ds, actor_opts["num_cpus"])
+        if node_id is not None:
+            from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+            # soft: if the chosen node fills up meanwhile, fall through rather
+            # than wedging the deployment
+            actor_opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                node_id=node_id, soft=True)
         from .replica import Replica
 
         cls = ray_tpu.remote(**actor_opts)(Replica)
         actor = cls.remote(ds.name, ds.info["serialized_init"], ds.info["config"].user_config)
         r = _ReplicaState(actor, ds.info["config"].version)
+        r.node_id = node_id
         r.health_ref = actor.check_health.remote()
         ds.replicas.append(r)
 

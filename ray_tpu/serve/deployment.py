@@ -45,6 +45,7 @@ class Deployment:
         user_config: Optional[Dict[str, Any]] = None,
         version: Optional[str] = None,
         health_check_period_s: Optional[float] = None,
+        placement_strategy: Optional[str] = None,
         **_compat,
     ) -> "Deployment":
         cfg = dataclasses.replace(self.config)
@@ -68,6 +69,10 @@ class Deployment:
             cfg.version = version
         if health_check_period_s is not None:
             cfg.health_check_period_s = health_check_period_s
+        if placement_strategy is not None:
+            if placement_strategy not in ("PACK", "SPREAD"):
+                raise ValueError("placement_strategy must be PACK or SPREAD")
+            cfg.placement_strategy = placement_strategy
         return Deployment(self._target, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -88,11 +93,14 @@ def deployment(
     user_config: Optional[Dict[str, Any]] = None,
     version: Optional[str] = None,
     health_check_period_s: float = 5.0,
+    placement_strategy: str = "PACK",
     **_compat,
 ):
     """@serve.deployment (reference api.py:322)."""
 
     def wrap(target):
+        if placement_strategy not in ("PACK", "SPREAD"):
+            raise ValueError("placement_strategy must be PACK or SPREAD")
         cfg = DeploymentConfig(
             num_replicas=1,
             max_ongoing_requests=max_ongoing_requests,
@@ -100,6 +108,7 @@ def deployment(
             user_config=user_config,
             version=version,
             health_check_period_s=health_check_period_s,
+            placement_strategy=placement_strategy,
         )
         d = Deployment(target, name or getattr(target, "__name__", "deployment"), cfg)
         if num_replicas is not None or autoscaling_config is not None:

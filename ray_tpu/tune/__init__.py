@@ -18,7 +18,6 @@ from .search import (  # noqa: F401
     BasicVariantGenerator,
     Searcher,
     TPESearcher,
-    TuneBOHB,
     choice,
     grid_search,
     loguniform,

@@ -252,6 +252,7 @@ class TPESearcher(Searcher):
         return best_cfg or self._random_config()
 
 
-# BOHB pairs this model with HyperBand brackets (reference search/bohb/bohb_search.py);
-# use TPESearcher + schedulers.HyperBandScheduler together for the same behavior.
-TuneBOHB = TPESearcher
+# BOHB = a TPE-style model paired with HyperBand brackets (reference
+# search/bohb/bohb_search.py): compose TPESearcher with
+# schedulers.HyperBandScheduler for that behavior. There is deliberately no
+# TuneBOHB name here — an alias would promise an algorithm that isn't one.

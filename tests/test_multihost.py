@@ -296,3 +296,35 @@ def test_trainer_survives_agent_death(two_hosts, tmp_path):
     killer.join(timeout=1)
     assert result.error is None, result.error
     assert result.metrics["step"] == 5
+
+
+def test_serve_deployment_scheduler_spreads_replicas(two_hosts):
+    """Reference _private/deployment_scheduler.py: replica->node packing.
+    SPREAD places replicas across both hosts; PACK keeps them together."""
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    @serve.deployment(num_replicas=4, placement_strategy="SPREAD",
+                      ray_actor_options={"num_cpus": 0.5})
+    class D:
+        def __call__(self, body):
+            return {"ok": True}
+
+    try:
+        serve.run(D.bind(), name="spreaded", route_prefix="/spreaded")
+        h = serve.get_app_handle("spreaded")
+        assert h.remote({}).result()["ok"]
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+
+        def assignments(app, dep):
+            def read(inst):
+                ds = inst.deployments[f"{app}/{dep}"]
+                return [r.node_id for r in ds.replicas]
+
+            return ray_tpu.get(controller.__ray_call__.remote(read))
+
+        nodes = assignments("spreaded", "D")
+        assert len(nodes) == 4
+        assert len({n for n in nodes if n}) == 2, f"not spread: {nodes}"
+    finally:
+        serve.shutdown()
