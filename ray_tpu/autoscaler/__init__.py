@@ -7,12 +7,13 @@ the v1 NodeProvider ABC (node_provider.py) and the fake provider used for tests
 (a v5e-8 slice is one schedulable node with 8 TPU resources + a slice-head
 resource), and the provider contract is "provision a slice", not "launch a VM".
 """
-from .node_provider import FakeNodeProvider, NodeProvider, NodeType
+from .node_provider import FakeNodeProvider, NodeAgentProvider, NodeProvider, NodeType
 from .autoscaler import Autoscaler, AutoscalingConfig
 
 __all__ = [
     "NodeProvider",
     "FakeNodeProvider",
+    "NodeAgentProvider",
     "NodeType",
     "Autoscaler",
     "AutoscalingConfig",

@@ -54,6 +54,105 @@ class NodeProvider(abc.ABC):
         Providers without cross-process state can leave this a no-op."""
 
 
+class NodeAgentProvider(NodeProvider):
+    """Scales REAL capacity: every created node is a node-agent OS process
+    (core/node_agent.py) joining this head's node server over TCP — the local
+    form of what a cloud provider does with fresh VMs; a TPU pod provider runs
+    the same agent binary on newly provisioned slice hosts. Termination kills
+    the agent process; the head's agent-death path drains the node."""
+
+    def __init__(self, node_types: List[NodeType], address: Optional[str] = None,
+                 host: str = "127.0.0.1"):
+        super().__init__(node_types)
+        self._lock = threading.Lock()
+        self._instances: Dict[str, NodeInstance] = {}
+        self._node_ids: Dict[str, object] = {}  # instance -> core NodeID
+        self._procs: Dict[str, object] = {}
+        self._host = host
+        self._address = address  # None = lazily bind this cluster's node server
+
+    def _resolve_address(self) -> str:
+        if self._address is None:
+            from ray_tpu.core import global_state
+
+            cluster = global_state.try_cluster()
+            if cluster is None:
+                raise RuntimeError("NodeAgentProvider needs a running cluster "
+                                   "or an explicit head address")
+            port = cluster.start_node_server(host=self._host)
+            self._address = f"{self._host}:{port}"
+        return self._address
+
+    def create_node(self, node_type: str) -> NodeInstance:
+        import subprocess
+        import sys
+
+        t = self.node_types[node_type]
+        inst = NodeInstance(instance_id=f"agent-{uuid.uuid4().hex[:8]}",
+                            node_type=t.name, status="requested")
+        argv = [sys.executable, "-m", "ray_tpu.core.node_agent",
+                "--address", self._resolve_address(),
+                "--label", f"instance_id={inst.instance_id}",
+                "--label", f"node_type={t.name}"]
+        if t.resources.get("CPU") is not None:
+            argv += ["--num-cpus", str(t.resources["CPU"])]
+        if t.resources.get("TPU"):
+            argv += ["--num-tpus", str(t.resources["TPU"])]
+        proc = subprocess.Popen(argv)
+        with self._lock:
+            self._instances[inst.instance_id] = inst
+            self._procs[inst.instance_id] = proc
+        return inst
+
+    def terminate_node(self, instance_id: str) -> None:
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None or inst.status == "terminated":
+                return
+            inst.status = "terminated"
+            proc = self._procs.pop(instance_id, None)
+            self._node_ids.pop(instance_id, None)
+        if proc is not None:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        with self._lock:
+            return [i for i in self._instances.values() if i.status != "terminated"]
+
+    def poll(self) -> None:
+        """Correlate registered agents with instances (via the instance_id
+        label they carry) and reap agent processes that died on their own."""
+        from ray_tpu.core import global_state
+
+        cluster = global_state.try_cluster()
+        by_label: Dict[str, object] = {}
+        if cluster is not None:
+            for info in cluster.gcs.nodes(alive_only=True):
+                iid = (info.labels or {}).get("instance_id")
+                if iid:
+                    by_label[iid] = info.node_id
+        with self._lock:
+            for iid, inst in self._instances.items():
+                if inst.status == "terminated":
+                    continue
+                proc = self._procs.get(iid)
+                if proc is not None and proc.poll() is not None:
+                    inst.status = "terminated"  # the agent process died
+                    self._procs.pop(iid, None)
+                    self._node_ids.pop(iid, None)
+                    continue
+                if inst.status == "requested" and iid in by_label:
+                    inst.status = "running"
+                    self._node_ids[iid] = by_label[iid]
+
+    def shutdown(self) -> None:
+        for iid in list(self._instances):
+            self.terminate_node(iid)
+
+
 class FakeNodeProvider(NodeProvider):
     """Adds/removes nodes on the in-process Cluster — the fake_multi_node analogue.
 

@@ -267,6 +267,14 @@ def agent_main(address: str, authkey: Optional[bytes] = None,
                labels: Optional[Dict[str, str]] = None,
                max_workers: Optional[int] = None) -> None:
     """Blocking entry point: join the head at address ("host:port") and serve."""
+    import signal
+
+    # SIGTERM (autoscaler scale-down, ray-tpu stop) must unwind serve_forever's
+    # finally: otherwise worker children orphan and the shm arena never unlinks
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: (_ for _ in ()).throw(SystemExit(0)))
+    except ValueError:
+        pass  # not the main thread (embedded use): caller owns signals
     if authkey is None:
         from ray_tpu.util.client.server import load_authkey
 
@@ -290,7 +298,12 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--max-workers", type=int, default=None)
+    p.add_argument("--label", action="append", default=[],
+                   help="k=v node label (repeatable; e.g. autoscaler instance ids)")
     args = p.parse_args(argv)
+    if any("=" not in kv for kv in args.label):
+        p.error("--label must be k=v")
+    labels = dict(kv.split("=", 1) for kv in args.label)
     resources = None
     if args.num_cpus is not None or args.num_tpus is not None:
         from .resources import normalize_resources
@@ -299,7 +312,8 @@ def main(argv: Optional[list] = None) -> None:
             num_cpus=args.num_cpus if args.num_cpus is not None else
             float(os.cpu_count() or 1),
             num_tpus=args.num_tpus or 0.0, resources=None)
-    agent_main(args.address, resources=resources, max_workers=args.max_workers)
+    agent_main(args.address, resources=resources, labels=labels or None,
+               max_workers=args.max_workers)
 
 
 if __name__ == "__main__":

@@ -1,11 +1,13 @@
-"""Dashboard-lite: HTTP endpoints for cluster state + Prometheus metrics.
+"""Dashboard: HTTP endpoints for cluster state + Prometheus metrics + web UI.
 
 Capability parity: reference python/ray/dashboard/ (DashboardHead head.py:48 +
-per-node agent; modules: state, metrics, reporter). The React UI is out of scope;
-the data plane — JSON state endpoints and a Prometheus scrape target — is here,
-served from the driver process (our GCS-equivalent lives in-process).
+per-node agent; modules: state, metrics, reporter; React client). The UI here
+is a single dependency-free page (vanilla JS polling the JSON endpoints) rather
+than the reference's React app — something a human can actually look at without
+a node toolchain in the image.
 
 Endpoints:
+    GET /                   human-facing dashboard (auto-refreshing tables)
     GET /api/summary        cluster summary
     GET /api/nodes|workers|actors|tasks|objects|placement_groups
     GET /api/timeline       chrome-trace JSON
@@ -17,6 +19,70 @@ import asyncio
 import json
 import threading
 from typing import Optional
+
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+  body { font-family: ui-monospace, monospace; margin: 1.5rem; background: #101418;
+         color: #d7dde3; }
+  h1 { font-size: 1.1rem; } h2 { font-size: .95rem; margin: 1.2rem 0 .4rem; }
+  table { border-collapse: collapse; width: 100%; font-size: .8rem; }
+  th, td { border: 1px solid #2a3340; padding: .25rem .5rem; text-align: left; }
+  th { background: #1a2129; position: sticky; top: 0; }
+  tr:nth-child(even) { background: #161c23; }
+  .pill { padding: 0 .45rem; border-radius: .6rem; background: #1f5c2d; }
+  .pill.bad { background: #6b2020; }
+  #summary { display: flex; gap: 1.5rem; flex-wrap: wrap; margin: .6rem 0 1rem; }
+  .stat { background: #1a2129; padding: .5rem .9rem; border-radius: .4rem; }
+  .stat b { display: block; font-size: 1.2rem; }
+  small { color: #7b8794; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard <small id="ts"></small></h1>
+<div id="summary"></div>
+<div id="tables"></div>
+<script>
+const TABLES = ["nodes", "workers", "actors", "tasks", "placement_groups"];
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({"&": "&amp;", "<": "&lt;",
+    ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
+}
+function cell(v) {
+  if (v === true) return '<span class="pill">yes</span>';
+  if (v === false) return '<span class="pill bad">no</span>';
+  if (v !== null && typeof v === "object") return esc(JSON.stringify(v));
+  return v === null || v === undefined ? "" : esc(v);
+}
+function table(rows) {
+  if (!rows.length) return "<small>(empty)</small>";
+  const cols = Object.keys(rows[0]);
+  return "<table><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>" +
+    rows.slice(0, 200).map(r =>
+      "<tr>" + cols.map(c => `<td>${cell(r[c])}</td>`).join("") + "</tr>").join("") +
+    "</table>" + (rows.length > 200 ? `<small>showing 200 of ${rows.length}</small>` : "");
+}
+async function refresh() {
+  try {
+    const s = await (await fetch("/api/summary")).json();
+    document.getElementById("summary").innerHTML = Object.entries(s)
+      .filter(([k, v]) => typeof v !== "object")
+      .map(([k, v]) => `<div class="stat"><b>${cell(v)}</b>${esc(k)}</div>`).join("");
+    const parts = [];
+    for (const t of TABLES) {
+      const rows = await (await fetch("/api/" + t)).json();
+      parts.push(`<h2>${t} (${rows.length})</h2>` + table(rows));
+    }
+    document.getElementById("tables").innerHTML = parts.join("");
+    document.getElementById("ts").textContent = new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("ts").textContent = "refresh failed: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 3000);
+</script>
+</body></html>
+"""
 
 
 class Dashboard:
@@ -64,7 +130,11 @@ class Dashboard:
             return web.Response(text=st.prometheus_metrics(),
                                 content_type="text/plain")
 
+        async def index(request: "web.Request") -> "web.Response":
+            return web.Response(text=_INDEX_HTML, content_type="text/html")
+
         app = web.Application()
+        app.router.add_get("/", index)
         app.router.add_get("/api/{name}", api)
         app.router.add_get("/metrics", metrics)
         runner = web.AppRunner(app)

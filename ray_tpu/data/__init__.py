@@ -20,6 +20,7 @@ from .datasource import (  # noqa: F401
     CSVDatasource,
     Datasink,
     Datasource,
+    ImageDatasource,
     ItemsDatasource,
     JSONDatasource,
     NumpyDatasource,
@@ -77,6 +78,13 @@ def read_json(paths, *, parallelism: int = -1, **kw) -> Dataset:
 
 def read_binary_files(paths, *, parallelism: int = -1, **kw) -> Dataset:
     return _read(BinaryDatasource(paths, **kw), parallelism)
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                parallelism: int = -1) -> Dataset:
+    """Image files -> {image, path, height, width} rows (reference
+    read_images / image_datasource.py); size=(h, w) resizes on read."""
+    return _read(ImageDatasource(paths, size=size, mode=mode), parallelism)
 
 
 def read_text(paths, *, parallelism: int = -1, **kw) -> Dataset:

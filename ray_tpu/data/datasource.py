@@ -168,6 +168,35 @@ class TextDatasource(_FileDatasource):
         return pa.table({"text": lines})
 
 
+class ImageDatasource(_FileDatasource):
+    """Image files -> rows of {image: HxWxC uint8 tensor, path, height, width}
+    (reference _internal/datasource/image_datasource.py). Optional size=(h, w)
+    resizes on read; mode forces a PIL conversion (e.g. "RGB", "L")."""
+
+    def __init__(self, paths, size=None, mode: str = "RGB"):
+        super().__init__(paths)
+        self.size = size
+        self.mode = mode
+
+    def _read_file(self, path: str) -> Block:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            if self.mode:
+                im = im.convert(self.mode)
+            if self.size is not None:
+                im = im.resize((self.size[1], self.size[0]))
+            arr = np.asarray(im)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return BlockAccessor.batch_to_block({
+            "image": arr[None],  # [1, H, W, C] tensor column
+            "path": np.asarray([path]),
+            "height": np.asarray([arr.shape[0]]),
+            "width": np.asarray([arr.shape[1]]),
+        })
+
+
 class NumpyDatasource(Datasource):
     def __init__(self, arrays: Dict[str, np.ndarray]):
         self.arrays = arrays

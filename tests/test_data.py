@@ -155,3 +155,22 @@ def test_sort_few_distinct_values_empty_partitions(rt):
 
     ds = rtd.from_items([{"x": v} for v in [5, 3, 9, 1]]).sort("x")
     assert [r["x"] for r in ds.take_all()] == [1, 3, 5, 9]
+
+
+def test_read_images(rt, tmp_path):
+    """reference read_images: image files -> tensor-column rows."""
+    from PIL import Image
+
+    import ray_tpu.data as rtd
+
+    for i in range(3):
+        arr = np.full((8 + i, 6, 3), i * 40, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img_{i}.png")
+    ds = rtd.read_images(str(tmp_path), size=(4, 4))
+    rows = ds.take_all()
+    assert len(rows) == 3
+    for r in sorted(rows, key=lambda r: r["path"]):
+        assert np.asarray(r["image"]).shape == (4, 4, 3)
+    # without resize, original sizes survive through the tensor column
+    sizes = {r["height"] for r in rtd.read_images(str(tmp_path)).take_all()}
+    assert sizes == {8, 9, 10}

@@ -328,3 +328,45 @@ def test_serve_deployment_scheduler_spreads_replicas(two_hosts):
         assert len({n for n in nodes if n}) == 2, f"not spread: {nodes}"
     finally:
         serve.shutdown()
+
+
+def test_autoscaler_scales_real_node_agents(two_hosts):
+    """Autoscaler + NodeAgentProvider: pending demand spawns a REAL node-agent
+    process; idle timeout terminates it (reference autoscaler v2 over the
+    fake_multi_node provider — but with actual capacity)."""
+    from ray_tpu.autoscaler import Autoscaler, NodeAgentProvider, NodeType
+    from ray_tpu.autoscaler.autoscaler import AutoscalingConfig
+
+    cluster, _ = two_hosts
+    provider = NodeAgentProvider(
+        [NodeType(name="cpu-agent", resources={"CPU": 2.0}, max_nodes=2)],
+        address=f"127.0.0.1:{cluster.node_server_port}")
+    scaler = Autoscaler(provider, AutoscalingConfig(idle_timeout_s=3.0))
+    try:
+        # saturate the existing 2 hosts (4 CPUs) and queue more work
+        @ray_tpu.remote(num_cpus=2)
+        def hold(sec):
+            time.sleep(sec)
+            return ray_tpu.get_runtime_context().node_id
+
+        refs = [hold.remote(8.0) for _ in range(3)]  # 6 CPU demand > 4 available
+        time.sleep(0.5)
+        deadline = time.time() + 60
+        while len([n for n in ray_tpu.nodes() if n["Alive"]]) < 3:
+            scaler.step()
+            provider.poll()
+            assert time.time() < deadline, "autoscaler never added an agent node"
+            time.sleep(0.5)
+        nodes = {ray_tpu.get(r, timeout=120) for r in refs}
+        # scale-up is the guarantee; WHERE the queued task lands races agent
+        # startup against task completion on a loaded machine
+        assert len(nodes) >= 2
+        # drain -> idle timeout terminates the scaled node
+        deadline = time.time() + 60
+        while provider.non_terminated_nodes():
+            scaler.step()
+            provider.poll()
+            assert time.time() < deadline, "idle agent never terminated"
+            time.sleep(0.5)
+    finally:
+        provider.shutdown()

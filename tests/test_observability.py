@@ -126,6 +126,11 @@ def test_dashboard_http(rt):
         with urllib.request.urlopen("http://127.0.0.1:18265/metrics", timeout=5) as r:
             text = r.read().decode()
         assert "# TYPE" in text or text.strip() == ""
+        # human-facing web UI (reference: dashboard React client)
+        with urllib.request.urlopen("http://127.0.0.1:18265/", timeout=5) as r:
+            assert r.headers.get_content_type() == "text/html"
+            html = r.read().decode()
+        assert "ray_tpu dashboard" in html and "/api/summary" in html
     finally:
         dash.stop()
 
