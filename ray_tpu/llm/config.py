@@ -53,6 +53,8 @@ class LLMConfig:
     kv_block_size: int = 16
     # total pool blocks; None = same token capacity as the slot layout
     num_kv_blocks: Optional[int] = None
+    # share full prompt blocks across requests (vLLM automatic prefix caching)
+    enable_prefix_caching: bool = True
     # prompts longer than this prefill in chunks of this many tokens (peak
     # activation memory = one chunk); None = whole-prompt prefill
     prefill_chunk: Optional[int] = None

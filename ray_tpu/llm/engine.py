@@ -220,7 +220,8 @@ class JaxLLMEngine(LLMEngine):
                         c.max_num_seqs * c.max_model_len // c.kv_block_size)
                     self._blocks = paged._BlockManager(
                         num_blocks, c.kv_block_size,
-                        c.max_model_len // c.kv_block_size, c.max_num_seqs)
+                        c.max_model_len // c.kv_block_size, c.max_num_seqs,
+                        enable_prefix_caching=c.enable_prefix_caching)
                     self.state = paged.init_paged_state(
                         self.model_config, c.max_num_seqs, c.max_model_len,
                         num_blocks, c.kv_block_size, self._mesh)
@@ -466,13 +467,28 @@ class JaxLLMEngine(LLMEngine):
             self.params, jnp.asarray(tokens), jnp.int32(n), cfg)
 
     def _prefill_paged(self, req: _Request, slot: int) -> Optional[int]:
-        """Prefill into allocated blocks; None = not admitted (requeued/failed)."""
+        """Prefill into allocated blocks; None = not admitted (requeued/failed).
+        With prefix caching (reference: vLLM automatic prefix caching) a prompt
+        sharing full leading blocks with an earlier one skips their
+        recomputation: cached blocks join the slot's table by reference and the
+        model runs only over the uncached suffix."""
         prompt = req.token_history if req.generated else req.prompt_ids
         n = len(prompt)
+        chunk = self.config.prefill_chunk
+        chunked = bool(chunk and n > chunk)
+        cached_ids = self._blocks.match_prefix(slot, prompt)
+        if cached_ids:
+            suffix_len = n - len(cached_ids) * self.config.kv_block_size
+            if not chunk or suffix_len <= chunk:
+                # cached context + one whole-bucket suffix prefill
+                return self._prefill_with_prefix(req, slot, prompt, cached_ids)
+            # suffix still too long for one pass: fall back to chunked prefill
+            # (no context support there yet) but release the attached prefix
+            self._blocks.release(slot)
+        chunked = bool(chunk and n > chunk)
         # cheap pre-check before running the model (the padded length is at most
         # one bucket/chunk above n, so needed here is exact)
-        chunk = self.config.prefill_chunk
-        s_pad = (-(-n // chunk) * chunk if chunk and n > chunk
+        s_pad = (-(-n // chunk) * chunk if chunked
                  else next(b for b in self.config.buckets() if b >= n))
         needed = self._blocks.blocks_needed(max(n + 1, s_pad))
         if needed > min(self._blocks.total_blocks, self._blocks.max_blocks):
@@ -487,6 +503,53 @@ class JaxLLMEngine(LLMEngine):
             if ok is False:
                 self._waiting.put(req)
             return None
+        # publish this prompt's full blocks for future prefix hits (chunked
+        # long prompts seed the cache for their shorter siblings too)
+        self._blocks.register_blocks(slot, prompt,
+                                     self._blocks.owned[slot], skip_blocks=0)
+        return self._sample_one(last_logits, req.params)
+
+    def _prefill_with_prefix(self, req: _Request, slot: int, prompt: List[int],
+                             cached_ids: List[int]) -> Optional[int]:
+        from . import paged
+
+        cfg, c = self.model_config, self.config
+        n = len(prompt)
+        cached_tokens = len(cached_ids) * c.kv_block_size
+        suffix = prompt[cached_tokens:]
+        s_pad = next(b for b in c.buckets() if b >= len(suffix))
+        needed_new = self._blocks.blocks_needed(
+            max(n + 1 - cached_tokens, s_pad))
+        total_blocks = len(cached_ids) + needed_new
+        if total_blocks > min(self._blocks.total_blocks, self._blocks.max_blocks):
+            self._blocks.release(slot)  # undo the attached prefix refs
+            self._fail_request(req, n)
+            return None
+        if not self._blocks.can_allocate(needed_new):
+            self._blocks.release(slot)
+            self._waiting.put(req)
+            return None
+        ctx_k, ctx_v = paged.gather_blocks(
+            self.state, jnp.asarray(cached_ids, jnp.int32), n_blocks=len(cached_ids))
+        tokens = np.zeros((1, s_pad), np.int32)
+        tokens[0, : len(suffix)] = suffix
+        k_suf, v_suf, last_logits = paged.prefill_suffix(
+            self.params, ctx_k, ctx_v, jnp.asarray(tokens),
+            jnp.int32(len(suffix)), cfg)
+        new_ids = self._blocks.allocate(slot, needed_new)
+        pad_blocks = s_pad // c.kv_block_size
+        if pad_blocks < needed_new:
+            extra = (needed_new - pad_blocks) * c.kv_block_size
+            k_suf = jnp.pad(k_suf, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
+            v_suf = jnp.pad(v_suf, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
+        row = np.zeros((self._blocks.max_blocks,), np.int32)
+        row[: total_blocks] = cached_ids + new_ids
+        self.state = paged.install_with_prefix(
+            self.state, k_suf, v_suf, jnp.asarray(new_ids, jnp.int32),
+            jnp.asarray(row), jnp.int32(n), jnp.int32(slot), n_new=needed_new)
+        self._blocks.register_blocks(slot, prompt, cached_ids + new_ids,
+                                     skip_blocks=len(cached_ids))
+        self._blocks.hit_tokens += cached_tokens  # counted only on success
         return self._sample_one(last_logits, req.params)
 
     def _admit_paged_kv(self, req: _Request, slot: int, k, v) -> bool:

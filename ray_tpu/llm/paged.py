@@ -17,7 +17,8 @@ recompute preemption).
 from __future__ import annotations
 
 import functools
-from typing import List, NamedTuple, Optional, Tuple
+import hashlib
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -69,35 +70,130 @@ def init_paged_state(cfg: ModelConfig, slots: int, max_len: int, num_blocks: int
 
 
 class _BlockManager:
-    """Host-side free list + per-slot allocation bookkeeping."""
+    """Host-side free list + per-slot allocation bookkeeping, with a
+    prefix cache (reference: vLLM automatic prefix caching): full prompt
+    blocks are content-addressed by a hash CHAIN (block key = H(parent key,
+    block tokens)), shared across slots via refcounts, and kept around at
+    refcount 0 until the pool needs the space (LRU eviction)."""
 
     def __init__(self, num_blocks: int, block_size: int, max_blocks_per_slot: int,
-                 slots: int):
+                 slots: int, enable_prefix_caching: bool = True):
         self.block_size = block_size
         self.max_blocks = max_blocks_per_slot
         self.total_blocks = num_blocks
         self.free: List[int] = list(range(num_blocks))
-        self.owned: List[List[int]] = [[] for _ in range(slots)]
+        self.owned: List[List[int]] = [[] for _ in range(slots)]  # includes shared
+        self.shared: List[List[int]] = [[] for _ in range(slots)]  # shared subset
+        self.enable_prefix_caching = enable_prefix_caching
+        self.cached: Dict[bytes, int] = {}  # chain key -> block id
+        self.block_key: Dict[int, bytes] = {}
+        self.refs: Dict[int, int] = {}  # cached block id -> live references
+        self._lru: Dict[int, int] = {}  # ref-0 cached block -> last-use tick
+        self._tick = 0
+        self.hit_tokens = 0  # metrics: prompt tokens served from the cache
+
+    @staticmethod
+    def chain_keys(prompt: List[int], block_size: int) -> List[bytes]:
+        """Hash-chain keys for each FULL block of the prompt."""
+        keys = []
+        parent = b""
+        for start in range(0, (len(prompt) // block_size) * block_size, block_size):
+            h = hashlib.sha256(parent)
+            h.update(np.asarray(prompt[start:start + block_size], np.int64).tobytes())
+            parent = h.digest()
+            keys.append(parent)
+        return keys
 
     @property
     def num_free(self) -> int:
-        return len(self.free)
+        # ref-0 cached blocks are reclaimable on demand
+        return len(self.free) + len(self._lru)
 
     def blocks_needed(self, tokens: int) -> int:
         return -(-tokens // self.block_size)
 
     def can_allocate(self, n: int) -> bool:
-        return len(self.free) >= n
+        return self.num_free >= n
+
+    def _take_free(self) -> int:
+        if self.free:
+            return self.free.pop()
+        # evict the least-recently-used unreferenced cached block
+        victim = min(self._lru, key=self._lru.get)
+        self._lru.pop(victim)
+        key = self.block_key.pop(victim)
+        self.cached.pop(key, None)
+        self.refs.pop(victim, None)
+        return victim
 
     def allocate(self, slot: int, n: int) -> List[int]:
-        assert len(self.free) >= n, "pool exhausted (caller must check/preempt)"
-        got = [self.free.pop() for _ in range(n)]
+        assert self.num_free >= n, "pool exhausted (caller must check/preempt)"
+        got = [self._take_free() for _ in range(n)]
         self.owned[slot].extend(got)
         return got
 
+    def match_prefix(self, slot: int, prompt: List[int]) -> List[int]:
+        """Attach the longest cached block chain for this prompt to the slot
+        (bumping refcounts); returns the matched block ids in order. Always
+        leaves >= 1 prompt token uncached so prefill still produces the
+        last-token logits."""
+        if not self.enable_prefix_caching:
+            return []
+        usable = len(prompt) - 1  # the final token must be computed
+        matched: List[int] = []
+        for key in self.chain_keys(prompt[:usable] if usable > 0 else [],
+                                   self.block_size):
+            bid = self.cached.get(key)
+            if bid is None:
+                break
+            matched.append(bid)
+        if matched:
+            # round DOWN to a power of two of blocks: every distinct attached
+            # count is a fresh XLA specialization of the gather/suffix-prefill
+            # programs, so bound them like the prefill buckets do
+            matched = matched[: 1 << (len(matched).bit_length() - 1)]
+        for bid in matched:
+            if self.refs.get(bid, 0) == 0:
+                self._lru.pop(bid, None)
+            self.refs[bid] = self.refs.get(bid, 0) + 1
+        self.owned[slot].extend(matched)
+        self.shared[slot].extend(matched)
+        return matched
+
+    def register_blocks(self, slot: int, prompt: List[int],
+                        block_ids: List[int], skip_blocks: int) -> None:
+        """Publish a slot's freshly filled FULL prompt blocks into the cache
+        (the slot keeps them as shared from now on)."""
+        if not self.enable_prefix_caching:
+            return
+        keys = self.chain_keys(prompt, self.block_size)
+        for i, key in enumerate(keys):
+            if i < skip_blocks:
+                continue  # already cached (matched prefix)
+            if i >= len(block_ids):
+                break
+            bid = block_ids[i]
+            if key in self.cached:
+                continue  # raced by an identical prompt; keep ours private
+            self.cached[key] = bid
+            self.block_key[bid] = key
+            self.refs[bid] = self.refs.get(bid, 0) + 1
+            if bid in self.owned[slot] and bid not in self.shared[slot]:
+                self.shared[slot].append(bid)
+
     def release(self, slot: int) -> None:
-        self.free.extend(self.owned[slot])
+        shared = set(self.shared[slot])
+        self._tick += 1
+        for bid in self.owned[slot]:
+            if bid in shared:
+                self.refs[bid] = self.refs.get(bid, 1) - 1
+                if self.refs[bid] <= 0:
+                    self.refs[bid] = 0
+                    self._lru[bid] = self._tick  # reclaimable, still cached
+            else:
+                self.free.append(bid)
         self.owned[slot] = []
+        self.shared[slot] = []
 
     def slot_capacity(self, slot: int) -> int:
         return len(self.owned[slot]) * self.block_size
@@ -135,6 +231,61 @@ def append_block(state: PagedState, slot: jax.Array, index: jax.Array,
     """Record a newly allocated decode block in a slot's table."""
     bt = state.block_tables.at[slot, index].set(block_id)
     return state._replace(block_tables=bt)
+
+
+# ----------------------------------------------------------------- prefix cache
+
+@functools.partial(jax.jit, static_argnames=("n_blocks",))
+def gather_blocks(state: PagedState, block_ids: jax.Array, n_blocks: int):
+    """Cached prefix blocks -> contiguous KV context [L, 1, n*bs, KV, HD]."""
+    kb = state.k[:, block_ids]  # [L, n, bs, KV, HD]
+    vb = state.v[:, block_ids]
+    L, _, bs = kb.shape[0], kb.shape[1], kb.shape[2]
+    shape = (L, 1, n_blocks * bs) + kb.shape[3:]
+    return kb.reshape(shape), vb.reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill_suffix(params, ctx_k, ctx_v, tokens, true_suffix_len, cfg: ModelConfig):
+    """Prefill ONLY the uncached suffix, attending over the cached-prefix KV
+    context (reference: vLLM prefix caching skips recomputation of shared
+    prompt prefixes). ctx_k/ctx_v: [L, 1, C, KV, HD]; tokens [1, S_pad].
+    Returns (k_suffix [L, 1, S_pad, KV, HD], v_suffix, last_logits)."""
+    cached_len = ctx_k.shape[2]
+    s_pad = tokens.shape[1]
+    dtype = cfg.activation_dtype
+    pad = ((0, 0), (0, 0), (0, s_pad), (0, 0), (0, 0))
+    cache = llama.KVCache(
+        k=jnp.pad(ctx_k.astype(dtype), pad), v=jnp.pad(ctx_v.astype(dtype), pad),
+        length=jnp.int32(cached_len))
+    mask = (jnp.arange(s_pad)[None, :] < true_suffix_len).astype(jnp.float32)
+    logits, cache = llama.forward(params, tokens, cfg, cache=cache, token_mask=mask)
+    last = logits[0, true_suffix_len - 1].astype(jnp.float32)
+    return (cache.k[:, :, cached_len:], cache.v[:, :, cached_len:], last)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("n_new",))
+def install_with_prefix(
+    state: PagedState,
+    k_suf: jax.Array,  # [L, 1, S_pad, KV, HD] — suffix KV only
+    v_suf: jax.Array,
+    new_ids: jax.Array,  # [n_new] pool indices for the suffix
+    table_row: jax.Array,  # [max_blocks] full table (cached + new ids, padded)
+    true_len: jax.Array,
+    slot: jax.Array,
+    n_new: int,
+) -> PagedState:
+    """Install suffix KV into fresh blocks; cached-prefix blocks are already in
+    the pool and only need table entries."""
+    L = state.k.shape[0]
+    bs = state.k.shape[2]
+    kb = k_suf[:, 0].reshape(L, n_new, bs, *k_suf.shape[3:]).astype(state.k.dtype)
+    vb = v_suf[:, 0].reshape(L, n_new, bs, *v_suf.shape[3:]).astype(state.v.dtype)
+    nk = state.k.at[:, new_ids].set(kb)
+    nv = state.v.at[:, new_ids].set(vb)
+    bt = state.block_tables.at[slot].set(table_row)
+    lengths = state.lengths.at[slot].set(true_len)
+    return PagedState(k=nk, v=nv, block_tables=bt, lengths=lengths)
 
 
 # ------------------------------------------------------------------------- decode
