@@ -61,6 +61,21 @@ _FLAGS: List[Flag] = [
     # -- multi-host control plane
     Flag("agent_heartbeat_s", "RAY_TPU_AGENT_HEARTBEAT_S", "float", 2.0,
          "Node-agent heartbeat period to the head."),
+    # -- data plane (direct node-to-node object transfer)
+    Flag("transfer_chunk_bytes", "RAY_TPU_TRANSFER_CHUNK_BYTES", "int", 4 * 1024 * 1024,
+         "Chunk size for direct node-to-node object transfers "
+         "(reference push_manager.h chunked push)."),
+    Flag("transfer_inflight_bytes", "RAY_TPU_TRANSFER_INFLIGHT_BYTES", "int",
+         256 * 1024 * 1024,
+         "Per-node byte budget for concurrent incoming object pulls "
+         "(reference pull_manager.h admission control)."),
+    Flag("transfer_max_pulls", "RAY_TPU_TRANSFER_MAX_PULLS", "int", 8,
+         "Max concurrent pulls a node issues (and streams it serves)."),
+    Flag("transfer_timeout_s", "RAY_TPU_TRANSFER_TIMEOUT_S", "float", 300.0,
+         "Deadline for one direct object transfer before head-relay fallback."),
+    Flag("transfer_stall_timeout_s", "RAY_TPU_TRANSFER_STALL_TIMEOUT_S", "float", 60.0,
+         "Per-socket-op stall bound on data-plane transfers (a half-dead peer "
+         "must not pin admission slots / puller threads forever)."),
     Flag("agent_heartbeat_timeout_s", "RAY_TPU_AGENT_HEARTBEAT_TIMEOUT_S", "float", 10.0,
          "Head marks an agent dead after this long without a heartbeat "
          "(reference gcs_health_check_manager.h)."),

@@ -1,0 +1,298 @@
+"""Direct node-to-node bulk object transfer (the "data plane").
+
+Capability parity: reference ObjectManager direct transfers between nodes
+(src/ray/object_manager/object_manager.h:119), chunked pushes
+(push_manager.h:27) and pull admission control (pull_manager.h:49). The head
+process is a METADATA broker only: it tells the destination where the bytes
+live and the destination pulls them straight from the source's data server in
+fixed-size chunks — cross-host object bytes never transit the head, so head
+NIC/RAM no longer bound object size or shuffle throughput.
+
+Every node (the head included) runs a DataServer next to its object store and
+keeps a DataClient with pooled connections per peer. Transport is the same
+authkey-authenticated length-prefixed framing as the control plane
+(multiprocessing.connection), but on a dedicated listener so bulk bytes never
+queue behind control traffic.
+
+Protocol (one pull per connection at a time; connections are reused):
+  client -> ("pull", loc)
+  server -> ("ok", total_len, is_error) | ("err", message)
+  client -> ("go",)          # sent after ADMISSION: total_len bytes of budget
+  server -> ceil(total_len / chunk) raw chunk frames
+The admission handshake is what bounds destination memory: a node admits at
+most transfer_inflight_bytes of concurrent incoming object bytes (an object
+larger than the whole budget is admitted alone), matching the reference
+PullManager's byte-budgeted activation of pull requests.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from multiprocessing.connection import Connection, Listener, answer_challenge, \
+    deliver_challenge
+from typing import Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.config import CONFIG
+
+
+def _set_fd_timeouts(fd: int, seconds: float, send_only: bool = False) -> None:
+    """SO_RCVTIMEO/SO_SNDTIMEO at the fd level: recv/send syscalls fail with
+    EAGAIN after `seconds` of stall, so a half-dead peer cannot pin a puller
+    thread (and its admission budget) forever. fd-level because
+    multiprocessing.Connection bypasses Python socket timeouts."""
+    s = socket.socket(fileno=os.dup(fd))
+    try:
+        tv = struct.pack("ll", int(seconds), int((seconds % 1) * 1_000_000))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+        if not send_only:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+    finally:
+        s.close()
+
+
+class Admission:
+    """Byte-budget + concurrency gate for in-flight pulls (pull_manager.h:49)."""
+
+    def __init__(self, max_bytes: int, max_pulls: int):
+        self.max_bytes = max(1, max_bytes)
+        self._bytes = self.max_bytes
+        self._pulls = max(1, max_pulls)
+        self._cond = threading.Condition()
+
+    def acquire(self, n: int) -> int:
+        """Block until n bytes (clamped to the whole budget) + one pull slot are
+        admitted; returns the admitted byte count for the matching release()."""
+        n = min(max(n, 1), self.max_bytes)
+        with self._cond:
+            while self._pulls <= 0 or self._bytes < n:
+                self._cond.wait(timeout=1.0)
+            self._pulls -= 1
+            self._bytes -= n
+        return n
+
+    def release(self, n: int) -> None:
+        with self._cond:
+            self._pulls += 1
+            self._bytes += n
+            self._cond.notify_all()
+
+
+class DataServer:
+    """Serves chunked object reads from this node's local store."""
+
+    def __init__(self, authkey: bytes,
+                 read_fn: Callable[[Tuple], Tuple[bytes, bool]],
+                 host: str = "0.0.0.0", port: int = 0):
+        self._read_fn = read_fn
+        self._authkey = authkey
+        # no authkey on the Listener: accept() would then run the auth
+        # handshake INLINE, serializing all dials behind one slow/dead peer.
+        # Each connection authenticates on its own thread instead, with
+        # fd-level stall bounds.
+        self._listener = Listener((host, port), backlog=128)
+        self.port: int = self._listener.address[1]
+        self._shutdown = False
+        # source-side cap: a broadcast to N nodes serves at most this many
+        # concurrent outbound streams (push_manager.h chunked-push pacing)
+        self._slots = threading.Semaphore(CONFIG.transfer_max_pulls)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="rt-data-server").start()
+
+    def _accept_loop(self) -> None:
+        errors = 0
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+                errors = 0
+            except EOFError:
+                continue  # one bad/failed dial must not stop the server
+            except OSError:
+                # a peer resetting mid-accept raises OSError too — only a
+                # persistently-failing accept (closed listener) stops the loop
+                errors += 1
+                if self._shutdown or errors > 100:
+                    return
+                time.sleep(0.05)
+                continue
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True,
+                             name="rt-data-serve").start()
+
+    def _serve_conn(self, conn: Connection) -> None:
+        chunk = CONFIG.transfer_chunk_bytes
+        try:
+            # bounded per-connection auth + stall limits: a dead peer can pin
+            # neither the accept loop nor this thread. RCVTIMEO is safe for
+            # pooled idle connections because the request wait below polls
+            # (select) and only recv's once bytes are ready.
+            _set_fd_timeouts(conn.fileno(), CONFIG.transfer_stall_timeout_s)
+            deliver_challenge(conn, self._authkey)
+            answer_challenge(conn, self._authkey)
+        except BaseException:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            return
+        try:
+            while not self._shutdown:
+                # idle-tolerant request wait: pooled client connections sit
+                # here between pulls, so no timeout — but poll in slices so
+                # shutdown is responsive
+                while not conn.poll(1.0):
+                    if self._shutdown:
+                        return
+                req = cloudpickle.loads(conn.recv_bytes())
+                if req[0] != "pull":
+                    conn.send_bytes(cloudpickle.dumps(("err", f"bad op {req[0]!r}")))
+                    continue
+                try:
+                    data, is_error = self._read_fn(req[1])
+                except BaseException as e:  # noqa: BLE001 — report, keep serving
+                    conn.send_bytes(cloudpickle.dumps(("err", repr(e))))
+                    continue
+                conn.send_bytes(cloudpickle.dumps(("ok", len(data), is_error)))
+                # the puller acquires admission between "ok" and "go", and under
+                # contention that wait is legitimate (budget pinned by other
+                # transfers) — so allow the full transfer deadline, not just the
+                # stall bound, before declaring the puller dead
+                if not conn.poll(CONFIG.transfer_timeout_s):
+                    break  # puller gone (or starved past the deadline): drop it
+                go = cloudpickle.loads(conn.recv_bytes())
+                if go[0] != "go":
+                    break  # protocol desync: drop the connection
+                with self._slots:
+                    view = memoryview(data)
+                    for off in range(0, len(data), chunk):
+                        conn.send_bytes(view[off:off + chunk])
+                    if not data:
+                        conn.send_bytes(b"")  # zero-length objects: one empty frame
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
+class DataClient:
+    """Pulls objects from peer DataServers; one pooled connection set per peer."""
+
+    def __init__(self, authkey: bytes):
+        self._authkey = authkey
+        self._pool: Dict[Tuple[str, int], List[Connection]] = {}
+        self._lock = threading.Lock()
+        self._admission = Admission(CONFIG.transfer_inflight_bytes,
+                                    CONFIG.transfer_max_pulls)
+
+    def _dial(self, addr: Tuple[str, int]) -> Connection:
+        """Connect with a bounded handshake: fd-level stall timeouts apply to
+        the auth exchange AND every later recv, so a half-dead server can never
+        pin a puller thread (multiprocessing's Client() would block forever)."""
+        stall = CONFIG.transfer_stall_timeout_s
+        s = socket.create_connection(addr, timeout=min(10.0, stall))
+        s.settimeout(None)  # hand a blocking fd over; SO_*TIMEO bounds the ops
+        conn = Connection(s.detach())
+        try:
+            _set_fd_timeouts(conn.fileno(), stall)
+            answer_challenge(conn, self._authkey)
+            deliver_challenge(conn, self._authkey)
+        except BaseException:
+            conn.close()
+            raise
+        return conn
+
+    def _checkout(self, addr: Tuple[str, int]) -> Connection:
+        with self._lock:
+            free = self._pool.get(addr)
+            if free:
+                return free.pop()
+        return self._dial(addr)
+
+    def _checkin(self, addr: Tuple[str, int], conn: Connection) -> None:
+        with self._lock:
+            self._pool.setdefault(addr, []).append(conn)
+
+    def pull(self, addr: Tuple[str, int], loc: Tuple) -> Tuple[bytes, bool]:
+        """Fetch the object at loc from the peer's data server, chunked and
+        admission-gated. Raises OSError/EOFError on transport failure (the
+        caller decides whether to fall back to head relay or reconstruct)."""
+        addr = (addr[0], int(addr[1]))
+        conn = self._checkout(addr)
+        admitted = 0
+
+        def recv(timeout: float) -> bytes:
+            # poll-then-recv: legitimate queueing on the server (its outbound
+            # slot semaphore, a busy NIC) must not trip the per-syscall stall
+            # bound — only a peer that stops mid-frame should
+            if not conn.poll(timeout):
+                raise TimeoutError(f"data server {addr} stalled")
+            return conn.recv_bytes()
+
+        try:
+            conn.send_bytes(cloudpickle.dumps(("pull", loc)))
+            hdr = cloudpickle.loads(recv(CONFIG.transfer_timeout_s))
+            if hdr[0] != "ok":
+                raise OSError(f"data server {addr}: {hdr[1]}")
+            total, is_error = int(hdr[1]), bool(hdr[2])
+            admitted = self._admission.acquire(total)
+            conn.send_bytes(cloudpickle.dumps(("go",)))
+            buf = bytearray(total)
+            got = 0
+            first = True
+            while got < total or total == 0:
+                # first chunk may wait behind the server's slot queue; later
+                # chunks stream continuously, so a long gap means a dead peer
+                frame = recv(CONFIG.transfer_timeout_s if first
+                             else CONFIG.transfer_stall_timeout_s)
+                first = False
+                if total == 0:
+                    break
+                buf[got:got + len(frame)] = frame
+                got += len(frame)
+            self._checkin(addr, conn)
+            conn = None
+            return bytes(buf), is_error
+        finally:
+            if admitted:
+                self._admission.release(admitted)
+            if conn is not None:  # failed mid-protocol: never reuse this conn
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            pools, self._pool = self._pool, {}
+        for conns in pools.values():
+            for c in conns:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+
+
+def peer_ip(conn: Connection) -> Optional[str]:
+    """The remote IP of an accepted control connection (the head combines this
+    with the agent-advertised data port to form the agent's data address)."""
+    try:
+        s = socket.socket(fileno=os.dup(conn.fileno()))
+        try:
+            return s.getpeername()[0]
+        finally:
+            s.close()
+    except Exception:
+        return None

@@ -201,6 +201,8 @@ class AgentHandle:
         self.host_key = node.node_id.hex()
         self.alive = True
         self.last_heartbeat = time.time()
+        # (ip, port) of the agent's DataServer; None = old agent, relay only
+        self.data_addr: Optional[Tuple[str, int]] = None
         self.workers: Dict[str, RemoteWorkerHandle] = {}  # wid_hex -> handle
         self._send_lock = threading.Lock()
         self._req_counter = itertools.count()
@@ -349,6 +351,8 @@ class Cluster:
         self._agents_by_key: Dict[str, AgentHandle] = {}  # node_id hex -> handle
         self._node_listener = None
         self.node_server_port: Optional[int] = None
+        self._data_server = None   # head-side data plane (started with the
+        self._data_client = None   # node server; data_plane.DataServer/Client)
         # cross-host replica directory: (oid, host_key) -> local (unwrapped) loc
         self._replicas: Dict[Tuple[ObjectID, str], Tuple] = {}
         self._transfers: Dict[Tuple[ObjectID, str], threading.Event] = {}
@@ -360,6 +364,7 @@ class Cluster:
         # the cutoff index past which an abandoned stream's items are dropped
         self._stream_counts: Dict[TaskID, int] = {}
         self._stream_abandoned: Dict[TaskID, int] = {}
+        self._stream_cancel_sent: set = set()  # producers already told to stop
         self._stream_completion: Dict[ObjectID, TaskID] = {}  # completion oid -> task
         # lineage for reconstruction: return oid -> creating TaskSpec while the
         # object is in scope and the task is retryable (reference
@@ -434,6 +439,13 @@ class Cluster:
         authkey = load_authkey() or generate_authkey()
         self._node_listener = Listener((host, port), authkey=authkey)
         self.node_server_port = self._node_listener.address[1]
+        # the head's own data plane: agents pull head-resident objects (and the
+        # head pulls agent-resident ones) chunked, off the control channel
+        from . import data_plane
+
+        if self._data_server is None:
+            self._data_server = data_plane.DataServer(authkey, object_store.read_raw)
+            self._data_client = data_plane.DataClient(authkey)
         threading.Thread(target=self._accept_agents, daemon=True,
                          name="rt-node-server").start()
         return self.node_server_port
@@ -449,7 +461,9 @@ class Cluster:
 
     def _register_agent(self, conn) -> None:
         try:
-            kind, resources, labels, max_workers = cloudpickle.loads(conn.recv_bytes())
+            msg = cloudpickle.loads(conn.recv_bytes())
+            kind, resources, labels, max_workers = msg[:4]
+            extras = msg[4] if len(msg) > 4 else {}
             assert kind == "register", kind
         except Exception:
             try:
@@ -461,6 +475,13 @@ class Cluster:
         node = RemoteNodeRuntime(self, node_id, resources, labels, max_workers)
         agent = AgentHandle(self, conn, node)
         node.agent = agent
+        data_port = extras.get("data_port")
+        if data_port:
+            from . import data_plane
+
+            ip = data_plane.peer_ip(conn)
+            if ip is not None:
+                agent.data_addr = (ip, int(data_port))
         welcome = {
             "node_id": node_id.hex(),
             "worker_env": dict(self.worker_env),
@@ -649,7 +670,9 @@ class Cluster:
                     ev = threading.Event()
                     self._transfers[(oid, dest_host)] = ev
             if not mine:
-                if not ev.wait(timeout=120.0):
+                # must outlast the winner's own transfer deadline (the direct
+                # pull is bounded by transfer_timeout_s before relay fallback)
+                if not ev.wait(timeout=CONFIG.transfer_timeout_s + 30.0):
                     raise TimeoutError(
                         f"transfer of {oid.hex()[:12]} to {dest_host[:8]} timed out")
                 continue  # re-check: winner registered a replica, or failed and we retry
@@ -667,28 +690,65 @@ class Cluster:
             return new_loc
 
     def _do_transfer(self, oid: ObjectID, loc, dest_host: str):
+        """Move one object's bytes to dest_host. Preferred path: the DESTINATION
+        pulls chunked straight from the source's data server — the head only
+        brokers (src ip, port, location) and the bytes never transit this
+        process (reference object_manager.h:119 direct transfers). Head relay
+        over the control channel remains the fallback for agents without a data
+        plane or when the direct pull fails."""
         src_host = self._loc_host(loc)
-        if src_host == "local":
-            data, is_error = object_store.read_raw(loc)
-        else:
+        inner = loc[2] if loc[0] == "remote" else loc
+        src_agent = None
+        if src_host != "local":
             src_agent = self._agents_by_key.get(src_host)
             if src_agent is None:
                 raise object_store.ObjectLost(
                     f"object {oid.hex()[:12]} lives on dead node {src_host[:8]}")
-            try:
-                data, is_error = src_agent.call("fetch_object", loc[2])
-            except (OSError, EOFError, TimeoutError) as e:
-                # fetch-side failure == the bytes are unreachable: let the
-                # caller's recovery path reconstruct from lineage
-                raise object_store.ObjectLost(
-                    f"fetching {oid.hex()[:12]} from node {src_host[:8]} "
-                    f"failed: {e}") from e
         if dest_host == "local":
+            # the head itself needs the bytes: pull chunked from the source
+            if src_agent.data_addr is not None and self._data_client is not None:
+                try:
+                    data, is_error = self._data_client.pull(src_agent.data_addr, inner)
+                    return object_store.write_raw(data, oid, is_error)
+                except (OSError, EOFError, TimeoutError):
+                    pass  # relay fallback below keeps the old error semantics
+            data, is_error = self._relay_fetch(src_agent, inner, oid, src_host)
             return object_store.write_raw(data, oid, is_error)
         dest_agent = self._agents_by_key.get(dest_host)
         if dest_agent is None:
             raise OSError(f"destination node {dest_host[:8]} is gone")
+        # direct agent->agent (or head->agent) pull
+        if dest_agent.data_addr is not None:
+            if src_host == "local" and self._data_server is not None:
+                # src is this head process; the agent substitutes the head IP
+                # it already dials for control traffic
+                src_addr = (None, self._data_server.port)
+            else:
+                src_addr = src_agent.data_addr if src_agent is not None else None
+            if src_addr is not None:
+                try:
+                    return dest_agent.call("pull_object", oid, inner, src_addr,
+                                           timeout=CONFIG.transfer_timeout_s)
+                except (OSError, EOFError, TimeoutError):
+                    pass  # relay fallback
+        # head-relay fallback: whole object through this process
+        if src_host == "local":
+            data, is_error = object_store.read_raw(loc)
+        else:
+            data, is_error = self._relay_fetch(src_agent, inner, oid, src_host)
         return dest_agent.call("store_object", oid, data, is_error)
+
+    @staticmethod
+    def _relay_fetch(src_agent: AgentHandle, inner, oid: ObjectID, src_host: str):
+        """Whole-object fetch over the source agent's control channel. A
+        fetch-side failure means the bytes are unreachable: raise ObjectLost so
+        the caller's recovery path reconstructs from lineage."""
+        try:
+            return src_agent.call("fetch_object", inner)
+        except (OSError, EOFError, TimeoutError) as e:
+            raise object_store.ObjectLost(
+                f"fetching {oid.hex()[:12]} from node {src_host[:8]} "
+                f"failed: {e}") from e
 
     # -- router (multiplexes all worker pipes) ----------------------------------------
     def _register_conn(self, w: WorkerHandle) -> None:
@@ -780,6 +840,20 @@ class Cluster:
                 abandoned = self._stream_abandoned.get(task_id)
             if abandoned is not None and index >= abandoned:
                 self.store.decref(oid)  # consumer is gone: don't pin the item
+                # ... and stop the producer (once): without this, an abandoned
+                # stream (disconnected SSE client) keeps generating to
+                # max_tokens, holding engine resources the whole time. Once-only
+                # so a cancel landing after the producer finished can't leak a
+                # stale id into the worker's cancelled set per late item.
+                with self._lock:
+                    send_cancel = task_id not in self._stream_cancel_sent
+                    if send_cancel:
+                        self._stream_cancel_sent.add(task_id)
+                if send_cancel:
+                    try:
+                        w.send(("cancel_stream", task_id))
+                    except Exception:
+                        pass
             self._schedule()  # tasks may be waiting on this item ref as an arg
         elif kind == "drop_stream":
             self.drop_stream(msg[1], msg[2])
@@ -1352,6 +1426,7 @@ class Cluster:
                 # last chance to drop the stream bookkeeping
                 self._stream_counts.pop(spec.task_id, None)
                 self._stream_abandoned.pop(spec.task_id, None)
+                self._stream_cancel_sent.discard(spec.task_id)
         self._schedule()
 
     # -- maintenance: spilling + memory monitor ----------------------------------------
@@ -1444,6 +1519,7 @@ class Cluster:
                 else:
                     self._stream_counts.pop(task_id, None)
                     self._stream_abandoned.pop(task_id, None)
+                    self._stream_cancel_sent.discard(task_id)
         spec = self.lineage.pop(oid, None)
         if spec is not None:
             for arg in spec.arg_refs:
@@ -1802,6 +1878,10 @@ class Cluster:
                 self._node_listener.close()
             except Exception:
                 pass
+        if self._data_server is not None:
+            self._data_server.close()
+            self._data_client.close()
+            self._data_server = self._data_client = None
         with self._lock:
             workers = [w for n in self._nodes.values() for w in list(n.workers.values())]
         for w in workers:

@@ -61,8 +61,16 @@ class NodeAgent:
         self.resources = resources
         self.labels = labels or {}
         self.max_workers = max_workers or CONFIG.max_workers_per_node
+        self._head_host = head_host
         self.conn = multiprocessing.connection.Client(
             (head_host, head_port), authkey=authkey)
+        # bulk-object plane: a dedicated listener (chunked pulls from peers /
+        # the head) + a pooled puller, so object bytes never ride the control
+        # connection (reference object_manager.h:119)
+        from . import data_plane, object_store
+
+        self._data_server = data_plane.DataServer(authkey, object_store.read_raw)
+        self._data_client = data_plane.DataClient(authkey)
         self._send_lock = threading.Lock()
         self._workers: Dict[str, Any] = {}   # wid_hex -> (proc, pipe)
         self._pipe_to_wid: Dict[Any, str] = {}
@@ -78,7 +86,8 @@ class NodeAgent:
 
     # -- lifecycle ----------------------------------------------------------------
     def register(self) -> None:
-        self._send(("register", self.resources, self.labels, self.max_workers))
+        self._send(("register", self.resources, self.labels, self.max_workers,
+                    {"data_port": self._data_server.port}))
         kind, payload = cloudpickle.loads(self.conn.recv_bytes())
         assert kind == "welcome", kind
         self.node_id_hex = payload["node_id"]
@@ -104,6 +113,8 @@ class NodeAgent:
         finally:
             self._shutdown = True
             self._kill_all_workers()
+            self._data_server.close()
+            self._data_client.close()
             from . import object_store
 
             object_store.destroy_arena()
@@ -197,6 +208,16 @@ class NodeAgent:
                 value = object_store.read_raw(loc)
             elif op == "store_object":
                 oid, data, is_error = args
+                value = object_store.write_raw(data, oid, is_error)
+            elif op == "pull_object":
+                # direct transfer: fetch straight from the source node's data
+                # server (the head only brokered the location), store locally.
+                # A None host means "the head itself" — substitute the address
+                # this agent already dials for control traffic.
+                oid, src_loc, src_addr = args
+                if src_addr[0] is None:
+                    src_addr = (self._head_host, src_addr[1])
+                data, is_error = self._data_client.pull(src_addr, src_loc)
                 value = object_store.write_raw(data, oid, is_error)
             elif op == "gc_dead_owners":
                 (keep,) = args

@@ -85,11 +85,16 @@ class ObjectRefGenerator:
     which this generator re-raises at the failure point. Reference:
     ObjectRefGenerator over dynamic returns (python/ray/_raylet.pyx:1138)."""
 
-    def __init__(self, completion_ref: ObjectRef, task_id):
+    def __init__(self, completion_ref: ObjectRef, task_id, _owner: bool = True):
         self._completion = completion_ref
         self._task_id = task_id
         self._i = 0
         self._count: Optional[int] = None
+        # Only the ORIGINAL generator owns the stream: a deserialized copy
+        # yields borrowed refs and never drop_stream's on GC — each item carries
+        # exactly one registration incref, so a second owning consumer would
+        # double-decref items the first consumer's refs still pin.
+        self._owner = _owner
 
     @property
     def completed(self) -> ObjectRef:
@@ -107,23 +112,24 @@ class ObjectRefGenerator:
             if self._count is not None:
                 if self._i >= self._count:
                     raise StopIteration
-                ref = ObjectRef(stream_item_id(self._task_id, self._i), owned=True)
+                ref = ObjectRef(stream_item_id(self._task_id, self._i),
+                                owned=self._owner)
                 self._i += 1
                 return ref
             item = ObjectRef(stream_item_id(self._task_id, self._i))
             ready, _ = ctx.wait([item, self._completion], 1, None)
             if any(r.id == item.id for r in ready):
                 self._i += 1
-                return ObjectRef(item.id, owned=True)
+                return ObjectRef(item.id, owned=self._owner)
             # completion landed first: learn the count (or raise the task error)
             self._count = int(ctx.get(self._completion))
 
-    def __reduce__(self):
-        return (ObjectRefGenerator, (self._completion, self._task_id))
-
-    def __del__(self):
-        # release unconsumed items (and anything the producer yields later);
-        # queued, never direct — GC may run on a thread holding runtime locks
+    def close(self) -> None:
+        """Release unconsumed items NOW (same effect as GC'ing the generator):
+        the producer is cancelled at its next yield boundary."""
+        if not self._owner:
+            return
+        self._owner = False  # __del__ becomes a no-op; later __next__ borrows
         try:
             from . import global_state
 
@@ -132,3 +138,29 @@ class ObjectRefGenerator:
                     "drop_stream", (self._task_id, self._i))
         except Exception:
             pass
+
+    def __reduce__(self):
+        return (_rebuild_ref_generator,
+                (self._completion, self._task_id, self._i, self._count))
+
+    def __del__(self):
+        # release unconsumed items (and anything the producer yields later);
+        # queued, never direct — GC may run on a thread holding runtime locks.
+        # Borrowed (deserialized) copies never drop: ownership stays with the
+        # first consumer.
+        if not self._owner:
+            return
+        try:
+            from . import global_state
+
+            if global_state.try_worker() is not None:
+                global_state.enqueue_gc_action(
+                    "drop_stream", (self._task_id, self._i))
+        except Exception:
+            pass
+
+
+def _rebuild_ref_generator(completion, task_id, i, count):
+    g = ObjectRefGenerator(completion, task_id, _owner=False)
+    g._i, g._count = i, count
+    return g

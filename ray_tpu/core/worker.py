@@ -73,6 +73,7 @@ class WorkerContext:
         # per-thread: concurrent methods of a threaded actor each track their own task
         self._task_ctx = threading.local()
         self._loop_lock = threading.Lock()  # guards _actor_loop creation
+        self._cancelled_streams: set = set()  # TaskIDs whose consumer dropped the stream
         self._exit = False
 
     @property
@@ -145,6 +146,10 @@ class WorkerContext:
                                     _format_thread_stacks()))
                     except Exception:
                         pass
+                elif kind == "cancel_stream":
+                    # consumer abandoned a streaming generator: the producing
+                    # thread checks this set at every yield boundary
+                    self._cancelled_streams.add(msg[1])
                 elif kind == "exit":
                     self._exit = True
                     self._task_queue.put(("exit",))
@@ -503,6 +508,9 @@ class WorkerContext:
     def _execute_streaming(self, spec: TaskSpec, args, kwargs) -> None:
         from .object_ref import stream_item_id
 
+        # a retried / lineage-reconstructed execution reuses the task id: a
+        # stale cancel from the previous attempt must not kill it at item 0
+        self._cancelled_streams.discard(spec.task_id)
         if spec.kind == "actor_method":
             if spec.method_name == "__ray_call__":
                 out = args[0](self.actor_instance, *args[1:], **kwargs)
@@ -528,23 +536,47 @@ class WorkerContext:
             loop = self._ensure_actor_loop()
 
             def drain(agen):
-                while True:
+                try:
+                    while True:
+                        try:
+                            yield asyncio.run_coroutine_threadsafe(
+                                agen.__anext__(), loop).result()
+                        except StopAsyncIteration:
+                            return
+                finally:
+                    # close() on this wrapper (stream cancellation) must reach
+                    # the async generator's finally blocks too
                     try:
-                        yield asyncio.run_coroutine_threadsafe(
-                            agen.__anext__(), loop).result()
-                    except StopAsyncIteration:
-                        return
+                        asyncio.run_coroutine_threadsafe(
+                            agen.aclose(), loop).result(timeout=10)
+                    except Exception:
+                        pass
 
             out = drain(out)
         elif not hasattr(out, "__next__"):
             # non-iterator return under a streaming call: a one-item stream
             # (lists/dicts must not be exploded into their elements)
             out = iter((out,))
-        for item in out:
-            oid = stream_item_id(spec.task_id, count)
-            loc = object_store.materialize(item, oid)
-            self._send(("stream", spec.task_id, count, oid, loc))
-            count += 1
+        try:
+            while spec.task_id not in self._cancelled_streams:
+                try:
+                    item = next(out)
+                except StopIteration:
+                    break
+                oid = stream_item_id(spec.task_id, count)
+                loc = object_store.materialize(item, oid)
+                self._send(("stream", spec.task_id, count, oid, loc))
+                count += 1
+        finally:
+            # cancelled (or errored) mid-stream: GeneratorExit into the user
+            # generator so its finally blocks run (e.g. engine request abort)
+            close = getattr(out, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+            self._cancelled_streams.discard(spec.task_id)
         payload = [(spec.return_ids[0],
                     object_store.materialize(count, spec.return_ids[0]))]
         self._send(("result", spec.task_id, payload, None))

@@ -314,10 +314,64 @@ class StreamingExecutor:
     def execute_iter(self, plan: L.LogicalOperator) -> Iterator[RefBundle]:
         """Lazily yield output bundles while upstream operators keep running."""
         plan = L.optimize(plan)
+        chain = plan.chain()
+        self._budget_actor_pools(chain)
         stream: Iterator[RefBundle] = iter(())
-        for op in plan.chain():
+        for op in chain:
             stream = self._op_iter(op, stream)
         return stream
+
+    def _requested_pool_size(self, op: L.AbstractMap) -> int:
+        conc = op.concurrency
+        if isinstance(conc, tuple):
+            return max(1, int(conc[1]))
+        if isinstance(conc, int):
+            return max(1, conc)
+        return max(1, self.ctx.actor_pool_max_size)
+
+    def _budget_actor_pools(self, chain: List[L.LogicalOperator]) -> None:
+        """Apportion cluster CPUs across ALL actor-pool stages before any pool
+        exists. Pools are created in pull order (downstream first) and their
+        idle actors hold CPUs until the pipeline ends, so sizing each pool
+        against free-at-creation CPUs can leave an upstream pool's ready()
+        barrier waiting forever on a downstream pool's idle actors. Budgeting
+        top-down guarantees the sum of pool sizes fits the cluster; if even one
+        1-CPU actor per pool can't fit, pools fall back to 0-CPU actors
+        (oversubscribe rather than deadlock)."""
+        pools = [op for op in chain
+                 if isinstance(op, L.AbstractMap) and op.compute == "actors"]
+        if not pools:
+            return
+        # capacity = CPUs actually free right now: CPUs pinned by actors
+        # OUTSIDE this pipeline (serve replicas, user actors) are never coming
+        # back, and a pool sized past what can schedule would stall
+        total = int(ray_tpu.available_resources().get("CPU", 0.0))
+        # task-compute stages (reads, task maps, shuffles) submit 1-CPU tasks
+        # that must stay schedulable while every pool actor idles
+        has_task_stage = any(not (isinstance(op, L.AbstractMap)
+                                  and op.compute == "actors")
+                             and not isinstance(op, L.InputData)
+                             for op in chain)
+        budget_total = total - (1 if has_task_stage else 0)
+        reqs = [self._requested_pool_size(op) for op in pools]
+        # per-actor CPU request (user num_cpus overrides the 1 default)
+        pers = [max(0.0, float(op.ray_remote_args.get("num_cpus", 1)))
+                for op in pools]
+        if sum(pers) > budget_total:
+            # even one actor per pool can't be co-scheduled: fall back to ONE
+            # 0-CPU actor per pool — schedulable regardless of CPU pressure and
+            # bounded so the worker-process cap (max_workers_per_node) still
+            # leaves room for task-stage workers
+            for op in pools:
+                op._pool_budget, op._pool_cpus = 1, 0
+            return
+        remaining = float(budget_total)
+        for i, (op, r, per) in enumerate(zip(pools, reqs, pers)):
+            later_min = sum(pers[i + 1:])  # later pools each need >= 1 actor
+            max_actors = int((remaining - later_min) / per) if per > 0 else r
+            give = max(1, min(r, max_actors))
+            op._pool_budget, op._pool_cpus = give, per
+            remaining -= give * per
 
     # -- per-op dispatch ------------------------------------------------------
     def _op_iter(self, op: L.LogicalOperator, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
@@ -431,31 +485,36 @@ class StreamingExecutor:
 
     def _actor_pool_map_iter(self, op: L.AbstractMap, upstream: Iterator[RefBundle],
                              opts) -> Iterator[RefBundle]:
-        conc = op.concurrency
-        if isinstance(conc, tuple):
-            pool_size = conc[1]
-        elif isinstance(conc, int):
-            pool_size = conc
-        else:
-            pool_size = self.ctx.actor_pool_max_size
-        # the input length is unknown in the pull model, but the pool must fit
-        # what's actually FREE: a downstream stage's pool is created before its
-        # upstream's (pull order), so capping by total CPUs could leave the
-        # upstream pool's ready() barrier waiting on CPUs the downstream pool
-        # already holds — a permanent inter-stage deadlock
-        free_cpus = ray_tpu.available_resources().get("CPU", 1.0)
-        pool_size = max(1, min(pool_size, int(free_cpus) or 1))
-        Worker = ray_tpu.remote(**({"num_cpus": 1} | opts))(_MapWorker)
+        pool_size = getattr(op, "_pool_budget", None)
+        pool_cpus = getattr(op, "_pool_cpus", 1)
+        if pool_size is None:  # op ran outside execute_iter's budgeting pass
+            total = int(ray_tpu.cluster_resources().get("CPU", 1.0))
+            pool_size = max(1, min(self._requested_pool_size(op), total))
+        worker_opts = {"num_cpus": pool_cpus} | opts
+        if pool_cpus == 0:
+            worker_opts["num_cpus"] = 0  # overflow pools must stay schedulable
+        Worker = ray_tpu.remote(**worker_opts)(_MapWorker)
         actors = [Worker.remote(op.specs) for _ in range(pool_size)]
-        ray_tpu.get([a.ready.remote() for a in actors])
+        # NO all-ready barrier: actors join the idle set as they come up, so a
+        # pool partially starved by external CPU pressure still makes progress
+        # with whatever subset schedules (the budget makes >=1 the common case)
+        pending_ready = {a.ready.remote(): a for a in actors}
         try:
             results: Dict[int, RefBundle] = {}
-            idle = deque(actors)
+            idle: deque = deque()
             inflight: Dict[Any, Tuple[int, Any, Any]] = {}
             next_submit = 0
             next_yield = 0
             exhausted = False
             while True:
+                if pending_ready:
+                    # block only when there is work to do and nothing to do it
+                    # with; 0 = opportunistic drain of newly-up actors
+                    timeout = None if not (idle or inflight or exhausted) else 0
+                    done, _ = ray_tpu.wait(list(pending_ready),
+                                           num_returns=1, timeout=timeout)
+                    for r in done:
+                        idle.append(pending_ready.pop(r))
                 while not exhausted and idle:
                     try:
                         b, _ = next(upstream)

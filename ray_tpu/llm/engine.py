@@ -92,6 +92,12 @@ class JaxLLMEngine(LLMEngine):
         self._loop_thread: Optional[threading.Thread] = None
         self._wakeup = threading.Event()
         self._admitting: Optional[_Request] = None  # mid-admission request
+        # live requests by id (waiting or active); abort() only marks ids found
+        # here, so a stale abort can never poison a later request reusing the id
+        self._requests: Dict[str, "_Request"] = {}
+        # request ids cancelled via abort(); acted on at admission (waiting) or
+        # the next loop tick (active), cleared on request release
+        self._aborted: set = set()
         self.state = None  # decode KV state, allocated on first decode admission
         # metrics (scraped by LLMServer / autoscaling)
         self.num_pending = 0
@@ -264,6 +270,7 @@ class JaxLLMEngine(LLMEngine):
         req = _Request(request_id or uuid.uuid4().hex, prompt_ids, params)
         with self._lock:
             self.num_pending += 1
+            self._requests[req.id] = req
         self._waiting.put(req)
         self._wakeup.set()
 
@@ -272,6 +279,33 @@ class JaxLLMEngine(LLMEngine):
             yield out
             if out.finished:
                 return
+
+    def abort(self, request_id: str) -> None:
+        """Cancel a request (e.g. its SSE client disconnected): a waiting
+        request is failed at admission; an active one frees its slot/KV blocks
+        at the next scheduler tick instead of decoding to max_tokens.
+        Reference: vllm engine abort_request semantics."""
+        with self._lock:
+            if request_id not in self._requests:
+                return  # already finished (or unknown): nothing to cancel
+            self._aborted.add(request_id)
+        self._wakeup.set()
+
+    def _process_aborts(self) -> None:
+        """Release active slots whose request was aborted (called every tick)."""
+        with self._lock:
+            if not self._aborted:
+                return
+            aborted = set(self._aborted)
+        for slot, req in list(self._active.items()):
+            if req is not None and req.id in aborted:
+                req.out_queue.put(RequestOutput(
+                    request_id=req.id, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(req.prompt_ids),
+                    num_generated_tokens=req.generated))
+                self._release(req)
+                with self._lock:
+                    self._aborted.discard(req.id)
 
     # -- P/D disaggregation (reference: prefill_decode_disagg deployments) ---------
     def prefill_only(self, prompt, params: SamplingParams) -> Dict[str, Any]:
@@ -309,6 +343,7 @@ class JaxLLMEngine(LLMEngine):
         )
         with self._lock:
             self.num_pending += 1
+            self._requests[req.id] = req
         self._waiting.put(req)
         self._wakeup.set()
         while True:
@@ -352,6 +387,12 @@ class JaxLLMEngine(LLMEngine):
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 return
+            with self._lock:
+                was_aborted = req.id in self._aborted
+                self._aborted.discard(req.id)
+            if was_aborted:
+                self._fail_request(req, len(req.prompt_ids), "abort")
+                continue
             # visible to the loop's crash handler: this request is in neither
             # _waiting nor _active right now, and must still be failed on error
             self._admitting = req
@@ -423,6 +464,8 @@ class JaxLLMEngine(LLMEngine):
             num_generated_tokens=req.generated))
         with self._lock:
             self.num_pending -= 1
+            self._requests.pop(req.id, None)
+            self._aborted.discard(req.id)
 
     def _install_paged(self, req: _Request, slot: int, k, v, n: int) -> Optional[bool]:
         """Allocate blocks for [L,1,S_pad,...] prefill KV and install it.
@@ -627,6 +670,8 @@ class JaxLLMEngine(LLMEngine):
             req.slot = -1
             with self._lock:
                 self.num_active -= 1
+                self._requests.pop(req.id, None)
+                self._aborted.discard(req.id)
 
     def _step_decode(self) -> None:
         cfg = self.model_config
@@ -675,6 +720,7 @@ class JaxLLMEngine(LLMEngine):
         while not self._shutdown:
             try:
                 self._admit()
+                self._process_aborts()
                 if any(r is not None for r in self._active.values()):
                     self._step_decode()
                 else:
@@ -691,9 +737,11 @@ class JaxLLMEngine(LLMEngine):
                     self._admitting.out_queue.put(RequestOutput(
                         request_id=self._admitting.id, token_ids=[], finished=True,
                         finish_reason="error"))
-                    self._admitting = None
                     with self._lock:
                         self.num_pending -= 1  # it left _waiting but never admitted
+                        self._requests.pop(self._admitting.id, None)
+                        self._aborted.discard(self._admitting.id)
+                    self._admitting = None
                 for slot, req in list(self._active.items()):
                     if req is not None:
                         req.out_queue.put(RequestOutput(

@@ -146,16 +146,24 @@ class LLMServer:
                 return frame({"id": rid, "object": obj, "created": created,
                               "model": model, "choices": choices(delta, None)})
 
-            for out in self.engine.generate(prompt, _sampling_from_body(body)):
-                finish = out.finish_reason
-                all_ids.extend(out.token_ids)
-                full = tokenizer.decode(all_ids)
-                if full.endswith("�"):
-                    continue  # mid-codepoint: wait for the next chunk
-                delta_text = full[len(emitted):]
-                emitted = full
-                if delta_text:
-                    yield delta_frame(delta_text)
+            eng_rid = uuid.uuid4().hex
+            try:
+                for out in self.engine.generate(prompt, _sampling_from_body(body),
+                                                request_id=eng_rid):
+                    finish = out.finish_reason
+                    all_ids.extend(out.token_ids)
+                    full = tokenizer.decode(all_ids)
+                    if full.endswith("�"):
+                        continue  # mid-codepoint: wait for the next chunk
+                    delta_text = full[len(emitted):]
+                    emitted = full
+                    if delta_text:
+                        yield delta_frame(delta_text)
+            except GeneratorExit:
+                # consumer abandoned the stream (client disconnect): stop the
+                # engine request so its KV slot/blocks free now, not at max_tokens
+                self.engine.abort(eng_rid)
+                raise
             # flush a tail withheld by the mid-codepoint guard (generation can
             # legitimately stop mid-sequence at max_tokens): match generate_sync
             tail = tokenizer.decode(all_ids)[len(emitted):]

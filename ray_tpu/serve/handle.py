@@ -45,6 +45,13 @@ class DeploymentResponseGenerator:
     def __next__(self) -> Any:
         return ray_tpu.get(next(self._gen))
 
+    def close(self) -> None:
+        """Abandon the stream: unconsumed items are released and the replica's
+        generator is cancelled at its next yield (client-disconnect path)."""
+        close = getattr(self._gen, "close", None)
+        if close is not None:
+            close()
+
     @property
     def completed(self):
         return self._gen.completed

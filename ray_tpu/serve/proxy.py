@@ -8,6 +8,7 @@ an executor thread so the event loop keeps accepting connections.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import json
 import threading
 from typing import Any, Dict, Optional
@@ -86,8 +87,15 @@ class ProxyActor:
                 or request.query.get("stream") in ("1", "true")
             )
             if wants_stream:
-                # handle.remote() blocks on replica discovery (up to 30s):
-                # executor, never the event loop
+                # handle.remote() blocks on replica discovery (up to 30s) and
+                # every next(g) blocks until the replica yields. Each stream
+                # gets its OWN single-thread executor: a handful of slow or
+                # idle streaming clients must not occupy the event loop's
+                # default executor (min(32, cpus+4) threads — ~5 on a small
+                # host), which also serves every non-streaming call.
+                stream_exec = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="serve-sse")
+
                 def start_stream():
                     return handle.options(method_name="__http__",
                                           stream=True).remote(request_dict)
@@ -102,47 +110,62 @@ class ProxyActor:
                             return _end
                     return pull
 
+                gen = None
                 try:
-                    gen = await loop.run_in_executor(None, start_stream)
-                    pull = make_pull(gen)
-                    first = await loop.run_in_executor(None, pull)
-                    # "stream": true is an OpenAI convention; a deployment that
-                    # returned one plain JSON value was not actually streaming —
-                    # answer with ordinary JSON instead of a one-blob SSE body
-                    if isinstance(first, (dict, list)):
-                        second = await loop.run_in_executor(None, pull)
-                        if second is _end:
-                            return web.json_response(first)
-                        pending = [first, second]
-                    else:
-                        pending = [] if first is _end else [first]
-                except Exception as e:  # noqa: BLE001 - surface as 500
-                    return web.Response(status=500, text=repr(e))
-                resp = web.StreamResponse(
-                    headers={"Content-Type": "text/event-stream",
-                             "Cache-Control": "no-cache"})
-                await resp.prepare(request)
+                    try:
+                        gen = await loop.run_in_executor(stream_exec, start_stream)
+                        pull = make_pull(gen)
+                        first = await loop.run_in_executor(stream_exec, pull)
+                        # "stream": true is an OpenAI convention; a deployment
+                        # that returned one plain JSON value was not actually
+                        # streaming — answer with ordinary JSON instead of a
+                        # one-blob SSE body
+                        if isinstance(first, (dict, list)):
+                            second = await loop.run_in_executor(stream_exec, pull)
+                            if second is _end:
+                                return web.json_response(first)
+                            pending = [first, second]
+                        else:
+                            pending = [] if first is _end else [first]
+                    except Exception as e:  # noqa: BLE001 - surface as 500
+                        return web.Response(status=500, text=repr(e))
+                    resp = web.StreamResponse(
+                        headers={"Content-Type": "text/event-stream",
+                                 "Cache-Control": "no-cache"})
+                    await resp.prepare(request)
 
-                async def write_chunk(chunk):
-                    if isinstance(chunk, bytes):
-                        await resp.write(chunk)
-                    elif isinstance(chunk, str):
-                        await resp.write(chunk.encode())
-                    else:
-                        await resp.write(json.dumps(chunk).encode() + b"\n")
+                    async def write_chunk(chunk):
+                        if isinstance(chunk, bytes):
+                            await resp.write(chunk)
+                        elif isinstance(chunk, str):
+                            await resp.write(chunk.encode())
+                        else:
+                            await resp.write(json.dumps(chunk).encode() + b"\n")
 
-                try:
-                    for chunk in pending:
-                        await write_chunk(chunk)
-                    while True:
-                        chunk = await loop.run_in_executor(None, pull)
-                        if chunk is _end:
-                            break
-                        await write_chunk(chunk)
-                except Exception as e:  # noqa: BLE001 — mid-stream: terminate body
-                    await resp.write(f"\nerror: {e!r}\n".encode())
-                await resp.write_eof()
-                return resp
+                    try:
+                        for chunk in pending:
+                            await write_chunk(chunk)
+                        while True:
+                            chunk = await loop.run_in_executor(stream_exec, pull)
+                            if chunk is _end:
+                                break
+                            await write_chunk(chunk)
+                    except Exception as e:  # noqa: BLE001 — mid-stream: terminate body
+                        # client gone or replica error: stop the producer so it
+                        # releases engine resources (KV slots) early
+                        if gen is not None:
+                            stream_exec.submit(gen.close)
+                            gen = None
+                        try:
+                            await resp.write(f"\nerror: {e!r}\n".encode())
+                        except Exception:  # noqa: BLE001 — socket already closed
+                            pass
+                    await resp.write_eof()
+                    return resp
+                finally:
+                    if gen is not None:
+                        stream_exec.submit(gen.close)
+                    stream_exec.shutdown(wait=False)
 
             def call():
                 return handle.options(method_name="__http__").remote(request_dict).result()

@@ -121,3 +121,22 @@ def test_iterator_reuse_raises(rt):
         list(it.iter_batches(batch_size=None))
     # fresh iterators and materialized datasets keep working
     assert len(ds.take_all()) == 4
+
+
+class _AddOneActor:
+    def __call__(self, batch):
+        batch["i"] = batch["i"] + 1
+        return batch
+
+
+def test_actor_pools_oversubscribed_no_deadlock(rt):
+    """Two actor-pool stages whose requested sizes sum past the cluster's CPUs
+    must be budgeted top-down. Pools are created in pull order (downstream
+    first) and idle actors hold their CPUs until the pipeline ends, so sizing
+    each pool against free-at-creation CPUs leaves the upstream pool's ready()
+    barrier waiting forever."""
+    ds = (rtd.from_items([{"i": i} for i in range(8)], parallelism=8)
+          .map_batches(_AddOneActor, concurrency=4)
+          .map_batches(_AddOneActor, concurrency=4))
+    rows = sorted(r["i"] for r in ds.take_all())
+    assert rows == [i + 2 for i in range(8)]

@@ -4,6 +4,7 @@ Engine correctness is checked against the model's full-sequence forward: greedy
 continuous-batched decode must reproduce greedy full-recompute decode.
 """
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -161,3 +162,51 @@ def test_batch_processor(rt):
     rows = proc(ds).take_all()
     assert len(rows) == 6
     assert all("generated_text" in r and r["num_generated_tokens"] <= 3 for r in rows)
+
+
+def test_abort_releases_slot():
+    """abort() mid-generation ends the request with finish_reason="abort" and
+    frees its slot instead of decoding to max_tokens (reference: vllm
+    abort_request)."""
+    cfg = LLMConfig(model_id="tiny-abort", model_source="test-tiny",
+                    max_num_seqs=2, max_model_len=512, tokenizer="byte")
+    eng = JaxLLMEngine(cfg)
+    eng.start()
+    try:
+        rid = "abort-me"
+        gen = eng.generate([1, 2, 3], SamplingParams(
+            max_tokens=400, temperature=0.0, stop_token_ids=[-1]), request_id=rid)
+        first = next(gen)
+        assert not first.finished
+        eng.abort(rid)
+        outs = list(gen)
+        assert outs[-1].finished
+        assert outs[-1].finish_reason == "abort"
+        deadline = time.time() + 10
+        while eng.num_active:
+            assert time.time() < deadline, "aborted request still holds a slot"
+            time.sleep(0.05)
+    finally:
+        eng.shutdown()
+
+
+def test_sse_generator_close_aborts_engine_request():
+    """Closing the SSE generator (client disconnect) must release the engine
+    slot early via the abort path."""
+    from ray_tpu.llm.server import LLMServer
+
+    cfg = LLMConfig(model_id="tiny-abort2", model_source="byte-tiny",
+                    max_num_seqs=2, max_model_len=512)
+    srv = LLMServer(cfg)
+    try:
+        g = srv.chat({"messages": [{"role": "user", "content": "hi"}],
+                      "stream": True, "max_tokens": 400, "temperature": 1.0})
+        next(g)  # role frame
+        next(g)  # first delta
+        g.close()
+        deadline = time.time() + 10
+        while srv.engine.num_active:
+            assert time.time() < deadline, "disconnected stream still holds a slot"
+            time.sleep(0.05)
+    finally:
+        srv.shutdown()

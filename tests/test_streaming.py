@@ -169,3 +169,61 @@ def test_abandoned_stream_releases_items(rt):
     while cluster.store.contains(stream_item_id(task_id, 0)):
         assert time.time() < deadline
         time.sleep(0.1)
+
+
+def test_abandoned_stream_cancels_producer(rt, tmp_path):
+    """Dropping a generator must stop the PRODUCER early (cancel_stream at the
+    next yield boundary), not just free unconsumed items — an abandoned SSE
+    client must release engine resources, not generate to max_tokens."""
+    import gc
+    import os
+
+    marker = str(tmp_path / "stopped_at.txt")
+
+    @rt.remote(num_returns="streaming")
+    def slow_gen(path):
+        i = -1
+        try:
+            for i in range(200):
+                yield i
+                time.sleep(0.05)
+        finally:
+            with open(path, "w") as f:
+                f.write(str(i))
+
+    g = slow_gen.remote(marker)
+    assert rt.get(next(g)) == 0
+    assert rt.get(next(g)) == 1
+    del g
+    gc.collect()
+    deadline = time.time() + 20
+    while not os.path.exists(marker):
+        assert time.time() < deadline, "producer never stopped"
+        time.sleep(0.1)
+    assert int(open(marker).read()) < 100
+
+
+def test_generator_pickle_preserves_position(rt):
+    """A serialized generator resumes at the sender's position as a BORROW:
+    its refs don't own items and its GC never drop_stream's — ownership stays
+    with the first consumer (each item carries exactly one registration
+    incref)."""
+    import pickle
+
+    @rt.remote(num_returns="streaming")
+    def gen():
+        yield from range(5)
+
+    import gc
+
+    g = gen.remote()
+    assert rt.get(next(g)) == 0
+    rt.get(g.completed)  # all items registered
+    g2 = pickle.loads(pickle.dumps(g))
+    assert g2._i == 1 and g2._owner is False
+    assert [rt.get(r) for r in g2] == [1, 2, 3, 4]
+    # the borrowed copy's GC must not free items the owner can still consume
+    del g2
+    gc.collect()
+    time.sleep(0.5)
+    assert [rt.get(r) for r in g] == [1, 2, 3, 4]
