@@ -1,10 +1,16 @@
 """Core-plane microbenchmark (reference python/ray/_private/ray_perf.py:95-317).
 
-Measures the task/actor/object hot paths; writes CORE_BENCH.json. Run:
-    JAX_PLATFORMS=cpu python core_bench.py
+Measures the task/actor/object hot paths; writes CORE_BENCH.json with two
+columns: "local" (in-process workers) and "remote" (everything dispatched
+through a real node agent over TCP — the relay hop a multi-host pod pays).
+Run:
+    JAX_PLATFORMS=cpu python core_bench.py            # both columns
+    JAX_PLATFORMS=cpu python core_bench.py --local    # local only
 """
 import json
 import os
+import subprocess
+import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -17,14 +23,12 @@ def timed(fn, n):
     return n / dt
 
 
-def main():
-    import numpy as np
-
-    import ray_tpu
-
-    ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
-                 max_workers_per_node=8)
+def suite(ray_tpu, np, sched=None, n=2000):
+    """The ray_perf measurement set; sched pins all work to one node."""
     results = {}
+
+    def opt(r):
+        return r.options(scheduling_strategy=sched) if sched is not None else r
 
     @ray_tpu.remote(num_cpus=0.1, max_retries=0)
     def nop():
@@ -39,29 +43,28 @@ def main():
             return None
 
     # warm-up: spawn workers + import paths
-    ray_tpu.get([nop.remote() for _ in range(20)])
+    ray_tpu.get([opt(nop).remote() for _ in range(20)])
 
-    N = 2000
     results["tasks_per_s"] = timed(
-        lambda: ray_tpu.get([nop.remote() for _ in range(N)]), N)
+        lambda: ray_tpu.get([opt(nop).remote() for _ in range(n)]), n)
 
-    a = Counter.remote()
+    a = opt(Counter).remote()
     ray_tpu.get(a.nop.remote())
     results["actor_calls_per_s"] = timed(
-        lambda: ray_tpu.get([a.nop.remote() for _ in range(N)]), N)
+        lambda: ray_tpu.get([a.nop.remote() for _ in range(n)]), n)
 
     results["actor_calls_sync_per_s"] = timed(
         lambda: [ray_tpu.get(a.nop.remote()) for _ in range(500)], 500)
 
     results["async_actor_calls_per_s"] = timed(
-        lambda: ray_tpu.get([a.anop.remote() for _ in range(N)]), N)
+        lambda: ray_tpu.get([a.anop.remote() for _ in range(n)]), n)
 
     small = b"x" * 100
     results["put_small_per_s"] = timed(
-        lambda: [ray_tpu.put(small) for _ in range(N)], N)
+        lambda: [ray_tpu.put(small) for _ in range(n)], n)
 
-    refs = [ray_tpu.put(small) for _ in range(N)]
-    results["get_small_per_s"] = timed(lambda: ray_tpu.get(refs), N)
+    refs = [ray_tpu.put(small) for _ in range(n)]
+    results["get_small_per_s"] = timed(lambda: ray_tpu.get(refs), n)
 
     big = np.zeros(1_250_000, dtype=np.float64)  # 10 MB
     ray_tpu.put(big)  # warm the arena growth path
@@ -88,13 +91,95 @@ def main():
 
     arg_ref = ray_tpu.put(small)
     results["tasks_with_arg_per_s"] = timed(
-        lambda: ray_tpu.get([consume.remote(arg_ref) for _ in range(N)]), N)
+        lambda: ray_tpu.get([opt(consume).remote(arg_ref) for _ in range(n)]), n)
+    return results
 
+
+def transfer_suite(ray_tpu, np, sched):
+    """Cross-host object movement through the DATA plane (direct chunked
+    pulls; reference object_manager.h:119). Fresh objects each round — the
+    replica cache would otherwise short-circuit the transfer."""
+    results = {}
+    mb10 = 10 * 1024 * 1024
+
+    @ray_tpu.remote(num_cpus=0.1, scheduling_strategy=sched)
+    def touch(x):
+        return x.nbytes
+
+    @ray_tpu.remote(num_cpus=0.1, scheduling_strategy=sched)
+    def produce(i):
+        import numpy as _np
+
+        return _np.zeros(1_310_720, dtype=_np.float64)  # 10 MiB
+
+    # driver -> agent: put here, consume there
+    times = []
+    for i in range(8):
+        ref = ray_tpu.put(np.full(1_310_720, float(i)))
+        t0 = time.perf_counter()
+        assert ray_tpu.get(touch.remote(ref), timeout=120) == mb10
+        times.append(time.perf_counter() - t0)
+    results["transfer_10mb_to_agent_gbps"] = mb10 / min(times) / 1e9
+
+    # agent -> driver: produce there, get here
+    refs = [produce.remote(i) for i in range(8)]
+    ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+    times = []
+    for r in refs:
+        t0 = time.perf_counter()
+        ray_tpu.get(r, timeout=120)
+        times.append(time.perf_counter() - t0)
+    results["transfer_10mb_from_agent_gbps"] = mb10 / min(times) / 1e9
+    return results
+
+
+def main():
+    import numpy as np
+
+    import ray_tpu
+
+    mode = sys.argv[1] if len(sys.argv) > 1 else "--all"
+    out = {}
+
+    ray_tpu.init(num_cpus=4, node_server_port=0,
+                 worker_env={"JAX_PLATFORMS": "cpu"}, max_workers_per_node=8)
+    out["local"] = suite(ray_tpu, np)
+
+    if mode != "--local":
+        from ray_tpu.core import global_state
+        from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+        cluster = global_state.try_cluster()
+        agent = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_agent",
+             "--address", f"127.0.0.1:{cluster.node_server_port}",
+             "--num-cpus", "4"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            deadline = time.time() + 30
+            while len([x for x in ray_tpu.nodes() if x["Alive"]]) < 2:
+                assert time.time() < deadline, "agent never registered"
+                time.sleep(0.2)
+            remote_id = next(x["NodeID"] for x in ray_tpu.nodes()
+                             if x["Alive"] and x["Labels"].get("agent") == "remote")
+            sched = NodeAffinitySchedulingStrategy(node_id=remote_id)
+            out["remote"] = suite(ray_tpu, np, sched=sched, n=1000)
+            out["remote"].update(transfer_suite(ray_tpu, np, sched))
+        finally:
+            agent.terminate()
+            try:
+                agent.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                agent.kill()
     ray_tpu.shutdown()
-    for k, v in results.items():
-        print(f"{k}: {v:,.0f}" if v > 100 else f"{k}: {v:.2f}")
+
+    for col, results in out.items():
+        print(f"-- {col}")
+        for k, v in results.items():
+            print(f"  {k}: {v:,.0f}" if v > 100 else f"  {k}: {v:.2f}")
     with open(os.path.join(os.path.dirname(__file__) or ".", "CORE_BENCH.json"), "w") as f:
-        json.dump({k: round(v, 2) for k, v in results.items()}, f, indent=2)
+        json.dump({c: {k: round(v, 2) for k, v in r.items()}
+                   for c, r in out.items()}, f, indent=2)
     print("wrote CORE_BENCH.json")
 
 

@@ -56,23 +56,35 @@ def _set_fd_timeouts(fd: int, seconds: float, send_only: bool = False) -> None:
 
 
 class Admission:
-    """Byte-budget + concurrency gate for in-flight pulls (pull_manager.h:49)."""
+    """Byte-budget + concurrency gate for in-flight pulls (pull_manager.h:49).
+
+    FIFO: requests admit in arrival order, so a full-budget pull (a huge
+    object) cannot be starved indefinitely by a stream of small pulls slicing
+    the budget out from under it — matching the reference PullManager's
+    in-order activation of pull requests."""
 
     def __init__(self, max_bytes: int, max_pulls: int):
+        from collections import deque
+
         self.max_bytes = max(1, max_bytes)
         self._bytes = self.max_bytes
         self._pulls = max(1, max_pulls)
         self._cond = threading.Condition()
+        self._queue: "deque" = deque()
 
     def acquire(self, n: int) -> int:
         """Block until n bytes (clamped to the whole budget) + one pull slot are
         admitted; returns the admitted byte count for the matching release()."""
         n = min(max(n, 1), self.max_bytes)
+        me = object()
         with self._cond:
-            while self._pulls <= 0 or self._bytes < n:
+            self._queue.append(me)
+            while self._queue[0] is not me or self._pulls <= 0 or self._bytes < n:
                 self._cond.wait(timeout=1.0)
+            self._queue.popleft()
             self._pulls -= 1
             self._bytes -= n
+            self._cond.notify_all()  # next-in-line may also fit
         return n
 
     def release(self, n: int) -> None:
@@ -150,22 +162,28 @@ class DataServer:
                 if req[0] != "pull":
                     conn.send_bytes(cloudpickle.dumps(("err", f"bad op {req[0]!r}")))
                     continue
-                try:
-                    data, is_error = self._read_fn(req[1])
-                except BaseException as e:  # noqa: BLE001 — report, keep serving
-                    conn.send_bytes(cloudpickle.dumps(("err", repr(e))))
-                    continue
-                conn.send_bytes(cloudpickle.dumps(("ok", len(data), is_error)))
-                # the puller acquires admission between "ok" and "go", and under
-                # contention that wait is legitimate (budget pinned by other
-                # transfers) — so allow the full transfer deadline, not just the
-                # stall bound, before declaring the puller dead
-                if not conn.poll(CONFIG.transfer_timeout_s):
-                    break  # puller gone (or starved past the deadline): drop it
-                go = cloudpickle.loads(conn.recv_bytes())
-                if go[0] != "go":
-                    break  # protocol desync: drop the connection
+                # slot held from BEFORE the object read: at most
+                # transfer_max_pulls full in-memory copies exist on the source
+                # at once, even when a broadcast fans out to far more peers
+                # (otherwise N waiting-for-go connections = N copies = OOM)
                 with self._slots:
+                    try:
+                        data, is_error = self._read_fn(req[1])
+                    except BaseException as e:  # noqa: BLE001 — report, keep serving
+                        conn.send_bytes(cloudpickle.dumps(("err", repr(e))))
+                        continue
+                    conn.send_bytes(cloudpickle.dumps(("ok", len(data), is_error)))
+                    # the puller acquires admission between "ok" and "go", and
+                    # under contention that wait is legitimate (budget pinned by
+                    # other transfers) — so allow the full transfer deadline,
+                    # not just the stall bound, before declaring the puller
+                    # dead. This timeout is also the breaker for the theoretical
+                    # cross-node slot/admission wait cycle.
+                    if not conn.poll(CONFIG.transfer_timeout_s):
+                        break  # puller gone (or starved past the deadline)
+                    go = cloudpickle.loads(conn.recv_bytes())
+                    if go[0] != "go":
+                        break  # protocol desync: drop the connection
                     view = memoryview(data)
                     for off in range(0, len(data), chunk):
                         conn.send_bytes(view[off:off + chunk])
@@ -227,9 +245,21 @@ class DataClient:
 
     def pull(self, addr: Tuple[str, int], loc: Tuple) -> Tuple[bytes, bool]:
         """Fetch the object at loc from the peer's data server, chunked and
-        admission-gated. Raises OSError/EOFError on transport failure (the
-        caller decides whether to fall back to head relay or reconstruct)."""
+        admission-gated. A stale pooled connection (idle-TCP killed by NAT/
+        conntrack) gets ONE retry on a fresh dial; real failures raise
+        OSError/EOFError/TimeoutError (the caller decides whether to fall back
+        to head relay or reconstruct)."""
         addr = (addr[0], int(addr[1]))
+        with self._lock:
+            had_pooled = bool(self._pool.get(addr))
+        try:
+            return self._pull_once(addr, loc)
+        except (OSError, EOFError, TimeoutError):
+            if not had_pooled:
+                raise
+            return self._pull_once(addr, loc)  # fresh dial (pool was drained)
+
+    def _pull_once(self, addr: Tuple[str, int], loc: Tuple) -> Tuple[bytes, bool]:
         conn = self._checkout(addr)
         admitted = 0
 

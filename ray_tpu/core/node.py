@@ -670,9 +670,10 @@ class Cluster:
                     ev = threading.Event()
                     self._transfers[(oid, dest_host)] = ev
             if not mine:
-                # must outlast the winner's own transfer deadline (the direct
-                # pull is bounded by transfer_timeout_s before relay fallback)
-                if not ev.wait(timeout=CONFIG.transfer_timeout_s + 30.0):
+                # must outlast the winner's WORST case: a full direct-pull
+                # deadline (transfer_timeout_s) plus the relay fallback behind
+                # it (fetch_object + store_object, 60s control-RPC each)
+                if not ev.wait(timeout=CONFIG.transfer_timeout_s + 150.0):
                     raise TimeoutError(
                         f"transfer of {oid.hex()[:12]} to {dest_host[:8]} timed out")
                 continue  # re-check: winner registered a replica, or failed and we retry
@@ -1775,12 +1776,33 @@ class Cluster:
         registration (reference: generator ref GC releases dynamic returns)."""
         from .object_ref import stream_item_id
 
+        w = None
         with self._lock:
             prev = self._stream_abandoned.get(task_id)
             if prev is not None and prev <= start_index:
                 return
             self._stream_abandoned[task_id] = start_index
             count = self._stream_counts.get(task_id, 0)
+            # cancel the producer NOW if it is dispatched somewhere — a
+            # generator blocked between yields (long compute, queued engine
+            # request) would otherwise hold its worker/slot until it happens
+            # to yield again (the stream-item handler is only a fallback for
+            # producers dispatched after this drop)
+            if task_id not in self._stream_cancel_sent:
+                for node in self._nodes.values():
+                    for wh in node.workers.values():
+                        if task_id in wh.inflight:
+                            w = wh
+                            break
+                    if w is not None:
+                        break
+                if w is not None:
+                    self._stream_cancel_sent.add(task_id)
+        if w is not None:
+            try:
+                w.send(("cancel_stream", task_id))
+            except Exception:
+                pass
         for i in range(start_index, count):
             self.store.decref(stream_item_id(task_id, i))
 

@@ -49,6 +49,7 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
         self._latest_metrics: Dict[str, Any] = {}
         self._history: List[Dict[str, Any]] = []
+        self._per_worker: Dict[int, Dict[str, Any]] = {}  # rank -> last metrics + node
 
     # -- lifecycle ---------------------------------------------------------------------
     def start(self) -> None:
@@ -108,10 +109,24 @@ class BackendExecutor:
             ckpt = rep["checkpoint"]
             if ckpt is not None and self.checkpoint_manager is not None:
                 self.checkpoint_manager.register(ckpt, metrics)
+        metas = self.worker_group.metadata
+        for rank, p in enumerate(polls):
+            if p["reports"]:
+                # per-worker visibility (reference: per-worker metrics in
+                # train result) — lets callers assert placement, e.g. one
+                # worker per host under STRICT_SPREAD
+                self._per_worker[rank] = {
+                    **p["reports"][-1]["metrics"],
+                    "rank": rank, "node": metas[rank].node_id}
         for rank, p in enumerate(polls):
             if p["error"]:
                 raise TrainingFailedError(f"worker rank {rank} failed:\n{p['error']}")
         return {"finished": all(p["finished"] for p in polls)}
+
+    def all_metrics(self) -> List[Dict[str, Any]]:
+        """Last reported metrics of every worker rank, each tagged with its
+        node id."""
+        return [self._per_worker[r] for r in sorted(self._per_worker)]
 
     def run_until_complete(
         self,
@@ -158,6 +173,7 @@ class BackendExecutor:
             best_checkpoint=best_ckpt,
             error=error,
             metrics_dataframe=list(self._history),
+            all_metrics=self.all_metrics(),
         )
 
     def shutdown(self, graceful: bool = True) -> None:

@@ -15,6 +15,8 @@ class Result:
     path: Optional[str] = None
     error: Optional[str] = None
     metrics_dataframe: Optional[List[Dict[str, Any]]] = None  # metric history (list of dicts)
+    # last reported metrics per worker rank, tagged with the worker's node id
+    all_metrics: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def best_checkpoints(self) -> List[Checkpoint]:

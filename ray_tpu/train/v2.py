@@ -214,6 +214,7 @@ class TrainController:
                     if not self._on_failure(e):
                         error = str(e)
                     continue
+                self._last_all_metrics = self.executor.all_metrics()
                 if poll["finished"]:
                     self._transition(TrainControllerState.FINISHED)
                     continue
@@ -232,7 +233,8 @@ class TrainController:
         best = self.checkpoint_manager.best_checkpoint if self.checkpoint_manager else None
         self._retire_executor(graceful=True)
         return Result(metrics=self._latest_metrics, checkpoint=latest, best_checkpoint=best,
-                      error=error, metrics_dataframe=list(self._merged_history))
+                      error=error, metrics_dataframe=list(self._merged_history),
+                      all_metrics=list(getattr(self, "_last_all_metrics", [])))
 
     def _on_failure(self, e: Exception) -> bool:
         """Returns True if retrying. Shuts the group down either way."""
