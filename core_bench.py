@@ -1,8 +1,9 @@
 """Core-plane microbenchmark (reference python/ray/_private/ray_perf.py:95-317).
 
 Measures the task/actor/object hot paths; writes CORE_BENCH.json with two
-columns: "local" (in-process workers) and "remote" (everything dispatched
-through a real node agent over TCP — the relay hop a multi-host pod pays).
+columns: "local" (in-process workers) and "remote" (tasks/actors dispatched
+through a real node agent over TCP — the relay hop a multi-host pod pays —
+plus 10MB object transfers in both directions over the data plane).
 Run:
     JAX_PLATFORMS=cpu python core_bench.py            # both columns
     JAX_PLATFORMS=cpu python core_bench.py --local    # local only
@@ -23,8 +24,10 @@ def timed(fn, n):
     return n / dt
 
 
-def suite(ray_tpu, np, sched=None, n=2000):
-    """The ray_perf measurement set; sched pins all work to one node."""
+def suite(ray_tpu, np, sched=None, n=2000, object_ops=True):
+    """The ray_perf measurement set; sched pins tasks/actors to one node.
+    object_ops=False skips the put/get rows — they always run driver-local,
+    so they'd be misleading in a remote (agent-dispatched) column."""
     results = {}
 
     def opt(r):
@@ -60,30 +63,31 @@ def suite(ray_tpu, np, sched=None, n=2000):
         lambda: ray_tpu.get([a.anop.remote() for _ in range(n)]), n)
 
     small = b"x" * 100
-    results["put_small_per_s"] = timed(
-        lambda: [ray_tpu.put(small) for _ in range(n)], n)
+    if object_ops:
+        results["put_small_per_s"] = timed(
+            lambda: [ray_tpu.put(small) for _ in range(n)], n)
 
-    refs = [ray_tpu.put(small) for _ in range(n)]
-    results["get_small_per_s"] = timed(lambda: ray_tpu.get(refs), n)
+        refs = [ray_tpu.put(small) for _ in range(n)]
+        results["get_small_per_s"] = timed(lambda: ray_tpu.get(refs), n)
 
-    big = np.zeros(1_250_000, dtype=np.float64)  # 10 MB
-    ray_tpu.put(big)  # warm the arena growth path
-    put_times = []
-    big_refs = []
-    for _ in range(20):
-        t0 = time.perf_counter()
-        big_refs.append(ray_tpu.put(big))
-        put_times.append(time.perf_counter() - t0)
-    # best-of-N: byte throughput measures the copy path's capability; on a
-    # loaded/few-core machine the median mostly measures scheduler contention
-    # from the benchmark's own idle workers
-    results["put_10mb_gbps"] = big.nbytes / min(put_times) / 1e9
-    get_times = []
-    for r in big_refs:
-        t0 = time.perf_counter()
-        ray_tpu.get(r)
-        get_times.append(time.perf_counter() - t0)
-    results["get_10mb_gbps"] = big.nbytes / min(get_times) / 1e9
+        big = np.zeros(1_250_000, dtype=np.float64)  # 10 MB
+        ray_tpu.put(big)  # warm the arena growth path
+        put_times = []
+        big_refs = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            big_refs.append(ray_tpu.put(big))
+            put_times.append(time.perf_counter() - t0)
+        # best-of-N: byte throughput measures the copy path's capability; on a
+        # loaded/few-core machine the median mostly measures scheduler
+        # contention from the benchmark's own idle workers
+        results["put_10mb_gbps"] = big.nbytes / min(put_times) / 1e9
+        get_times = []
+        for r in big_refs:
+            t0 = time.perf_counter()
+            ray_tpu.get(r)
+            get_times.append(time.perf_counter() - t0)
+        results["get_10mb_gbps"] = big.nbytes / min(get_times) / 1e9
 
     @ray_tpu.remote(num_cpus=0.1)
     def consume(x):
@@ -163,7 +167,8 @@ def main():
             remote_id = next(x["NodeID"] for x in ray_tpu.nodes()
                              if x["Alive"] and x["Labels"].get("agent") == "remote")
             sched = NodeAffinitySchedulingStrategy(node_id=remote_id)
-            out["remote"] = suite(ray_tpu, np, sched=sched, n=1000)
+            out["remote"] = suite(ray_tpu, np, sched=sched, n=1000,
+                                  object_ops=False)
             out["remote"].update(transfer_suite(ray_tpu, np, sched))
         finally:
             agent.terminate()

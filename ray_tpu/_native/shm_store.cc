@@ -48,7 +48,7 @@ struct Entry {
   uint64_t offset;      // data offset from arena base
   uint64_t size;
   uint32_t pin_count;   // readers holding zero-copy views (delete defers on >0)
-  uint32_t _pad;
+  uint32_t flags;       // bit0: is_error frame (survives a head restart)
 };
 
 // Free block header (boundary-tag list threaded through the heap).
@@ -385,6 +385,7 @@ uint64_t rt_alloc(void* hv, const uint8_t* id, uint64_t size) {
       slot->owner_pid = static_cast<uint32_t>(getpid());
       slot->offset = off;
       slot->size = size;
+      slot->flags = 0;  // reused tombstone slots must not leak stale flags
       slot->state = kAllocated;  // commit point last: a crash here leaks only the
                                  // extent, which heap_rebuild/sweep reclaims
       H(h)->num_objects++;
@@ -500,6 +501,40 @@ int rt_gc_dead_owners(void* hv, const uint8_t* keep_blob, uint64_t n_keep) {
   }
   unlock(h);
   return n;
+}
+
+// Set per-object flags (bit0 = is_error). Returns 0 on success.
+int rt_set_flags(void* hv, const uint8_t* id, uint32_t flags) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return -1;
+  Entry* e = find(h, id, 0);
+  if (e) e->flags = flags;
+  unlock(h);
+  return e ? 0 : -1;
+}
+
+// List sealed objects: writes up to max_n records of
+// [id (kIdLen) | size (u64 LE) | flags (u32 LE)] into out. Returns count.
+// Lets a node agent re-report its arena contents to a restarted head
+// (directory reconstruction without journaling every object mutation).
+int rt_list(void* hv, uint8_t* out, uint64_t max_n) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (lock(h) != 0) return -1;
+  Header* hd = H(h);
+  Entry* t = table(h);
+  uint64_t n = 0;
+  const uint64_t rec = kIdLen + 8 + 4;
+  for (uint64_t i = 0; i < hd->table_cap && n < max_n; i++) {
+    Entry* e = &t[i];
+    if (e->state != kSealed) continue;
+    uint8_t* p = out + n * rec;
+    memcpy(p, e->id, kIdLen);
+    memcpy(p + kIdLen, &e->size, 8);
+    memcpy(p + kIdLen + 8, &e->flags, 4);
+    n++;
+  }
+  unlock(h);
+  return static_cast<int>(n);
 }
 
 // GC unsealed objects whose creator died (crash during write). Returns count freed.

@@ -37,6 +37,8 @@ def _lib():
         lib.rt_sweep.argtypes = [ctypes.c_void_p]
         lib.rt_gc_dead_owners.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64]
         lib.rt_stats.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(u64)] * 4
+        lib.rt_set_flags.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.rt_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64]
         lib._rt_configured = True
     return lib
 
@@ -162,6 +164,34 @@ class Arena:
             if not self._h:
                 return 0
             return self._lib.rt_gc_dead_owners(self._h, blob, len(keep_ids))
+
+    def set_flags(self, oid: bytes, flags: int) -> None:
+        """Per-object flag bits (bit0 = is_error frame); survive a head restart."""
+        with self._maint_lock:
+            if self._h:
+                self._lib.rt_set_flags(self._h, self._id(oid), flags)
+
+    def list_sealed(self) -> list:
+        """[(oid_bytes, size, flags)] for every sealed object — a node agent
+        re-reports these to a restarted head so the object directory can be
+        rebuilt without journaling every mutation."""
+        rec = _ID_LEN + 12
+        with self._maint_lock:
+            if not self._h:
+                return []
+            _, _, num, _ = self.stats()
+            cap = max(int(num) + 64, 128)
+            buf = ctypes.create_string_buffer(cap * rec)
+            n = self._lib.rt_list(self._h, buf, cap)
+        out = []
+        raw = buf.raw
+        for i in range(max(n, 0)):
+            p = i * rec
+            oid = raw[p:p + _ID_LEN]
+            size = int.from_bytes(raw[p + _ID_LEN:p + _ID_LEN + 8], "little")
+            flags = int.from_bytes(raw[p + _ID_LEN + 8:p + _ID_LEN + 12], "little")
+            out.append((oid, size, flags))
+        return out
 
     def stats(self) -> Tuple[int, int, int, int]:
         used = ctypes.c_uint64()

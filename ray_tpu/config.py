@@ -79,6 +79,10 @@ _FLAGS: List[Flag] = [
     Flag("agent_heartbeat_timeout_s", "RAY_TPU_AGENT_HEARTBEAT_TIMEOUT_S", "float", 10.0,
          "Head marks an agent dead after this long without a heartbeat "
          "(reference gcs_health_check_manager.h)."),
+    Flag("agent_reconnect_timeout_s", "RAY_TPU_AGENT_RECONNECT_TIMEOUT_S", "float", 60.0,
+         "How long a node agent keeps its workers alive while redialing a "
+         "restarted head before giving up (reference: raylets buffering "
+         "through a GCS restart, NotifyGCSRestart)."),
     # -- session / auth
     Flag("session_dir", "RAY_TPU_SESSION_DIR", "str", "/tmp/ray_tpu_session",
          "Session directory (head metadata, jobs, authkey, usage report)."),

@@ -193,15 +193,8 @@ def available_resources() -> Dict[str, float]:
 
 
 def nodes() -> List[Dict[str, Any]]:
-    cluster = global_state.try_cluster()
-    if cluster is None:
+    if global_state.try_cluster() is None and global_state.try_worker() is None:
         return []
-    return [
-        {
-            "NodeID": info.node_id.hex(),
-            "Alive": info.alive,
-            "Resources": info.resources,
-            "Labels": info.labels,
-        }
-        for info in cluster.gcs.nodes(alive_only=False)
-    ]
+    from ray_tpu.util.state import gcs_nodes
+
+    return gcs_nodes()

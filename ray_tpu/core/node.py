@@ -462,6 +462,9 @@ class Cluster:
     def _register_agent(self, conn) -> None:
         try:
             msg = cloudpickle.loads(conn.recv_bytes())
+            if msg[0] == "reregister":
+                self._reattach_agent(conn, msg)
+                return
             kind, resources, labels, max_workers = msg[:4]
             extras = msg[4] if len(msg) > 4 else {}
             assert kind == "register", kind
@@ -503,6 +506,123 @@ class Cluster:
         except Exception:
             pass
         self._schedule()
+
+    # -- head restart: agent re-attach (reference NotifyGCSRestart re-sync) -----------
+    def _reattach_agent(self, conn, msg) -> None:
+        """An agent that survived a head restart re-joins with its node id,
+        live workers, and arena contents. Rebuild the node, re-add its objects
+        to the directory, and rebind journaled detached/named actors to their
+        still-running worker processes (reference: raylet re-sync after a GCS
+        restart — node_manager.proto NotifyGCSRestart,
+        gcs_redis_failure_detector.h)."""
+        _, node_hex, resources, labels, max_workers, extras = msg
+        node_id = NodeID.from_hex(node_hex)
+        # a handle for the same node may linger (reconnect raced the death
+        # detection): run the full death path first so inflight tasks fail /
+        # retry instead of hanging forever — then rebuild below. (Journal
+        # records deleted by that cleanup won't rebind; a blip on a LIVE head
+        # keeps the pre-existing conn-EOF-is-node-death semantics.)
+        old = self._agents_by_key.get(node_hex)
+        if old is not None:
+            self._on_agent_death(old)
+        node = RemoteNodeRuntime(self, node_id, resources, labels, max_workers)
+        agent = AgentHandle(self, conn, node)
+        node.agent = agent
+        data_port = (extras or {}).get("data_port")
+        if data_port:
+            from . import data_plane
+
+            ip = data_plane.peer_ip(conn)
+            if ip is not None:
+                agent.data_addr = (ip, int(data_port))
+        # journaled actor records for this host, by worker id
+        by_wid: Dict[str, Dict[str, Any]] = {}
+        for key in self.gcs.kv.keys(namespace="@actors"):
+            try:
+                rec = cloudpickle.loads(self.gcs.kv.get(key, namespace="@actors"))
+            except Exception:
+                continue
+            if rec.get("host") == node_hex:
+                by_wid[rec["wid"]] = rec
+        keep: List[str] = []
+        rebound = 0
+        for wid_hex, accel in (extras or {}).get("workers", ()):
+            rec = by_wid.get(wid_hex)
+            if rec is None:
+                continue  # ran plain tasks for the dead head: agent kills it
+            w = RemoteWorkerHandle(WorkerID.from_hex(wid_hex), agent, node, accel)
+            w.state = "idle"
+            node.workers[w.worker_id] = w
+            agent.workers[wid_hex] = w
+            spec = rec["creation_spec"]
+            st = self.actors.get(spec.actor_id)
+            if st is None:
+                st = ActorState(spec.actor_id, spec, rec["method_meta"])
+                self.actors[spec.actor_id] = st
+            st.state = "alive"
+            st.worker = w
+            w.actor_id = spec.actor_id
+            node.ledger.try_acquire(dict(spec.resources))  # actor-lifetime hold
+            w.resources_held = dict(spec.resources)
+            if rec.get("name"):
+                self.gcs.register_named_actor(rec["name"], rec.get("namespace", ""),
+                                              spec.actor_id)
+            keep.append(wid_hex)
+            rebound += 1
+        # the agent's arena contents go back into the directory, pinned (their
+        # owner refs died with the old head's drivers)
+        arena_name = (extras or {}).get("arena")
+        if arena_name:
+            for oid_bytes, size, flags in (extras or {}).get("objects", ()):
+                oid = ObjectID(oid_bytes)
+                self.store.add(oid, ("remote", node_hex,
+                                     ("arena", arena_name, oid_bytes, size,
+                                      bool(flags & 1))))
+                self.store.incref(oid)
+        try:
+            conn.send_bytes(cloudpickle.dumps(("welcome_back",
+                                               {"keep_workers": keep})))
+        except Exception:
+            return
+        with self._lock:
+            self._nodes[node_id] = node
+            if node_id not in self._node_order:
+                self._node_order.append(node_id)
+            self._agent_conns[conn] = agent
+            self._agents_by_key[node_hex] = agent
+        self.gcs.register_node(NodeInfo(node_id=node_id, resources=dict(resources),
+                                        labels={**(labels or {}), "agent": "remote"}))
+        print(f"[ray_tpu] node {node_hex[:8]} re-attached: {rebound} actors "
+              f"rebound, {len((extras or {}).get('objects', ()))} objects re-added")
+        try:
+            self._wakeup_w.send_bytes(b"x")
+        except Exception:
+            pass
+        self._schedule()
+
+    def _journal_actor(self, st: ActorState) -> None:
+        """Persist a named/detached actor's placement so a restarted head can
+        rebind it to its still-running worker (reference: GCS actor table in
+        Redis surviving gcs_server restart)."""
+        w = st.worker
+        if not isinstance(w, RemoteWorkerHandle) or not (st.name or st.detached):
+            return
+        try:
+            rec = cloudpickle.dumps({
+                "name": st.name, "namespace": st.namespace,
+                "detached": st.detached, "host": w.node.host_key,
+                "wid": w.worker_id.hex(), "method_meta": st.method_meta,
+                "creation_spec": st.creation_spec,
+            })
+            self.gcs.kv.put(st.actor_id.binary(), rec, namespace="@actors")
+        except Exception:
+            pass  # an unpicklable spec must not fail the creation itself
+
+    def _unjournal_actor(self, st: ActorState) -> None:
+        try:
+            self.gcs.kv.delete(st.actor_id.binary(), namespace="@actors")
+        except Exception:
+            pass
 
     def _handle_agent_message(self, agent: AgentHandle, msg: Tuple) -> None:
         kind = msg[0]
@@ -670,10 +790,11 @@ class Cluster:
                     ev = threading.Event()
                     self._transfers[(oid, dest_host)] = ev
             if not mine:
-                # must outlast the winner's WORST case: a full direct-pull
-                # deadline (transfer_timeout_s) plus the relay fallback behind
-                # it (fetch_object + store_object, 60s control-RPC each)
-                if not ev.wait(timeout=CONFIG.transfer_timeout_s + 150.0):
+                # must outlast the winner's WORST case: two direct-pull
+                # attempts (DataClient retries once on a stale pooled conn)
+                # plus the relay fallback behind them (fetch_object +
+                # store_object, 60s control-RPC each)
+                if not ev.wait(timeout=2 * CONFIG.transfer_timeout_s + 180.0):
                     raise TimeoutError(
                         f"transfer of {oid.hex()[:12]} to {dest_host[:8]} timed out")
                 continue  # re-check: winner registered a replica, or failed and we retry
@@ -1386,6 +1507,7 @@ class Cluster:
                     if err_info is None:
                         st.state = "alive"
                         st.worker = w
+                        self._journal_actor(st)
                         if st.kill_on_creation:
                             threading.Thread(
                                 target=self.kill_actor, args=(st.actor_id, True), daemon=True
@@ -1393,6 +1515,7 @@ class Cluster:
                     elif not retry:
                         st.state = "dead"
                         st.death_cause = RuntimeError(f"actor creation failed: {err_info[1]}")
+                        self._unjournal_actor(st)
                         self._drain_actor_queue(st)
                 # Actor worker stays busy/pinned; resources held for actor lifetime.
             elif spec is not None and spec.kind == "actor_method":
@@ -1762,6 +1885,7 @@ class Cluster:
             else:
                 st.state = "dead"
                 st.death_cause = err
+                self._unjournal_actor(st)
                 self._drain_actor_queue(st)
                 if st.name:
                     self.gcs.unregister_named_actor(st.name, st.namespace)

@@ -62,6 +62,8 @@ class NodeAgent:
         self.labels = labels or {}
         self.max_workers = max_workers or CONFIG.max_workers_per_node
         self._head_host = head_host
+        self._head_port = head_port
+        self._authkey = authkey
         self.conn = multiprocessing.connection.Client(
             (head_host, head_port), authkey=authkey)
         # bulk-object plane: a dedicated listener (chunked pulls from peers /
@@ -124,7 +126,7 @@ class NodeAgent:
             try:
                 self._send(("heartbeat", time.time()))
             except Exception:
-                return
+                pass  # head restart in progress: resume on the new connection
             time.sleep(CONFIG.agent_heartbeat_s)
 
     def _serve_loop(self) -> None:
@@ -143,7 +145,12 @@ class NodeAgent:
                     try:
                         raw = self.conn.recv_bytes()
                     except (EOFError, OSError):
-                        return  # head is gone: exit (workers die with us)
+                        # head is gone: hold workers alive and try to rejoin a
+                        # restarted head (reference: raylets buffering through a
+                        # GCS restart, NotifyGCSRestart / node_manager.proto:316)
+                        if self._reconnect():
+                            continue
+                        return  # reconnect window passed: workers die with us
                     try:
                         self._handle_head_message(cloudpickle.loads(raw))
                     except Exception:
@@ -162,7 +169,80 @@ class NodeAgent:
                 try:
                     self._send(("from_worker", wid, raw))
                 except Exception:
+                    if self._reconnect():
+                        # the message that failed mid-send is lost; workers
+                        # re-driving requests is the old head's clients'
+                        # problem, not this relay's
+                        continue
                     return
+
+    # -- head-restart recovery ------------------------------------------------------
+    def _reconnect(self) -> bool:
+        """Redial the head with backoff and re-register this node's live state
+        (same node id, workers, arena contents). Workers stay up the whole
+        time — their pipe messages queue in OS buffers until the relay resumes.
+        Returns False when agent_reconnect_timeout_s passes."""
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        deadline = time.monotonic() + CONFIG.agent_reconnect_timeout_s
+        delay = 0.3
+        while not self._shutdown and time.monotonic() < deadline:
+            try:
+                conn = multiprocessing.connection.Client(
+                    (self._head_host, self._head_port), authkey=self._authkey)
+            except Exception:
+                time.sleep(min(delay, max(0.05, deadline - time.monotonic())))
+                delay = min(delay * 2, 3.0)
+                continue
+            try:
+                self._reregister(conn)
+                return True
+            except Exception:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                time.sleep(delay)
+        return False
+
+    def _reregister(self, conn) -> None:
+        from . import object_store
+
+        arena = object_store._default_arena()
+        objects = []
+        arena_name = None
+        if arena is not None:
+            arena_name = arena.name
+            from .ids import ObjectID
+
+            objects = [(oid20[:ObjectID.SIZE], size, flags)
+                       for oid20, size, flags in arena.list_sealed()]
+        workers = [(wid, entry[2]) for wid, entry in self._workers.items()]
+        msg = ("reregister", self.node_id_hex, self.resources, self.labels,
+               self.max_workers,
+               {"data_port": self._data_server.port, "arena": arena_name,
+                "workers": workers, "objects": objects})
+        # swap + first send atomically: the heartbeat thread must not slip a
+        # ("heartbeat", ts) in as the new connection's first message — the
+        # head parses the first frame as the (re)register handshake
+        with self._send_lock:
+            self.conn = conn
+            conn.send_bytes(cloudpickle.dumps(msg))
+        kind, payload = cloudpickle.loads(self.conn.recv_bytes())
+        assert kind == "welcome_back", kind
+        # the restarted head kept only the workers it could rebind (journaled
+        # detached/named actors); the rest ran tasks whose callers died with
+        # the old head — kill them so their results don't relay into a void
+        keep = set(payload.get("keep_workers") or ())
+        for wid in list(self._workers):
+            if wid not in keep:
+                entry = self._workers.get(wid)
+                try:
+                    entry[0].terminate()
+                except Exception:
+                    pass
 
     # -- head messages --------------------------------------------------------------
     def _handle_head_message(self, msg) -> None:
@@ -248,7 +328,7 @@ class NodeAgent:
         )
         proc.start()
         child_conn.close()
-        self._workers[wid_hex] = (proc, parent_conn)
+        self._workers[wid_hex] = (proc, parent_conn, accel)
         self._pipe_to_wid[parent_conn] = wid_hex
         try:
             self._wakeup_w.send_bytes(b"x")
@@ -269,13 +349,14 @@ class NodeAgent:
             pass
 
     def _kill_all_workers(self) -> None:
-        for proc, pipe in list(self._workers.values()):
+        for entry in list(self._workers.values()):
             try:
-                pipe.send_bytes(cloudpickle.dumps(("exit",)))
+                entry[1].send_bytes(cloudpickle.dumps(("exit",)))
             except Exception:
                 pass
         deadline = time.monotonic() + 2.0
-        for proc, _ in list(self._workers.values()):
+        for entry in list(self._workers.values()):
+            proc = entry[0]
             proc.join(timeout=max(0.05, deadline - time.monotonic()))
             if proc.is_alive():
                 proc.terminate()

@@ -128,6 +128,10 @@ def materialize(obj: Any, oid: ObjectID, is_error: bool = False) -> Location:
             finally:
                 buf.release()
             arena.seal(oid.binary())
+            if is_error:
+                # recorded in the arena entry too, so a rebuilt directory
+                # (head restart; agent re-reports contents) keeps raising it
+                arena.set_flags(oid.binary(), 1)
             return ("arena", arena.name, oid.binary(), size, is_error)
     name = "rt_" + oid.hex()[:24]
     seg = shared_memory.SharedMemory(name=name, create=True, size=size)
@@ -191,6 +195,8 @@ def write_raw(data: bytes, oid: ObjectID, is_error: bool = False) -> Location:
             finally:
                 buf.release()
             arena.seal(oid.binary())
+            if is_error:
+                arena.set_flags(oid.binary(), 1)
             return ("arena", arena.name, oid.binary(), size, is_error)
     # randomized suffix: the source side's materialize() segment for this oid may
     # share this machine's /dev/shm namespace (same-host "multi-host" test

@@ -57,6 +57,22 @@ def dispatch_state_request(fn_name: str, args=(), kwargs=None):
 
 
 @_remoteable
+def gcs_nodes() -> List[Dict[str, Any]]:
+    """GCS node-table view backing ray_tpu.nodes() — including for remote
+    client drivers (reference: ray.nodes() reading the GCS from any driver)."""
+    c = _cluster()
+    return [
+        {
+            "NodeID": info.node_id.hex(),
+            "Alive": info.alive,
+            "Resources": info.resources,
+            "Labels": info.labels,
+        }
+        for info in c.gcs.nodes(alive_only=False)
+    ]
+
+
+@_remoteable
 def list_nodes() -> List[Dict[str, Any]]:
     c = _cluster()
     out = []

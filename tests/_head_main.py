@@ -1,0 +1,25 @@
+"""Standalone head process for head-restart tests (tests/test_head_restart.py).
+
+Runs the cluster head with a node server (agents join), a client server
+(drivers join), and GCS journal persistence — all on fixed ports so a restarted
+incarnation is reachable at the same addresses.
+"""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+
+    node_port, client_port = int(sys.argv[1]), int(sys.argv[2])
+    ray_tpu.init(num_cpus=1, node_server_port=node_port,
+                 client_server_port=client_port,
+                 worker_env={"JAX_PLATFORMS": "cpu"})
+    print("HEAD_READY", flush=True)
+    while True:
+        time.sleep(0.5)
