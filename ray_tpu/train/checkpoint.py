@@ -1,8 +1,11 @@
-"""Directory-based checkpoints.
+"""URI- or directory-addressed checkpoints.
 
-Reference capability: python/ray/train/_checkpoint.py:56 (Checkpoint) — a checkpoint is a
-URI/path-addressed directory; frameworks read/write inside it. Orbax handles the jax pytree
-serialization (see train/orbax_utils.py); this class is deliberately format-agnostic.
+Reference capability: python/ray/train/_checkpoint.py:56 (Checkpoint) — a
+checkpoint is a URI/path-addressed directory; frameworks read/write inside it.
+Remote URIs (``gs://``, ``s3://``, ``mock://`` …) resolve through
+train/storage.py (reference _internal/storage.py:358 StorageContext): workers
+upload on report, any host downloads on restore. Orbax handles the jax pytree
+serialization (see train/orbax_utils.py); this class is format-agnostic.
 """
 from __future__ import annotations
 
@@ -14,14 +17,22 @@ import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, Optional
 
+from . import storage
+
 
 class Checkpoint:
-    """A reference to a directory holding a model snapshot."""
+    """A reference to a directory (local path or storage URI) holding a model
+    snapshot."""
 
     _METADATA_FILE = ".metadata.json"
 
     def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+        path = storage.normalize(path)
+        self.path = path if storage.is_remote(path) else os.path.abspath(path)
+
+    @property
+    def is_remote(self) -> bool:
+        return storage.is_remote(self.path)
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
@@ -31,28 +42,46 @@ class Checkpoint:
 
     @contextmanager
     def as_directory(self) -> Iterator[str]:
-        """Yield a local directory with the checkpoint contents (zero-copy: local paths
-        are yielded directly; a remote-fs implementation would download here)."""
-        yield self.path
+        """Yield a local directory with the checkpoint contents. Local paths
+        are yielded zero-copy; remote URIs download to a temp dir (removed
+        afterwards) — the restore path works on ANY host, not just where the
+        checkpoint was written."""
+        if not self.is_remote:
+            yield self.path
+            return
+        tmp = tempfile.mkdtemp(prefix="rt_ckpt_")
+        try:
+            storage.download_dir(self.path, tmp)
+            yield tmp
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
 
     def to_directory(self, dest: Optional[str] = None) -> str:
         dest = dest or os.path.join(tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
-        if os.path.abspath(dest) != self.path:
+        if self.is_remote:
+            storage.download_dir(self.path, dest)
+        elif os.path.abspath(dest) != self.path:
             shutil.copytree(self.path, dest, dirs_exist_ok=True)
         return dest
 
     # -- metadata ----------------------------------------------------------------------
-    def _meta_path(self) -> str:
-        return os.path.join(self.path, self._METADATA_FILE)
+    def _meta_addr(self) -> str:
+        return storage.join_any(self.path, self._METADATA_FILE)
 
     def get_metadata(self) -> Dict[str, Any]:
-        if os.path.exists(self._meta_path()):
-            with open(self._meta_path()) as f:
+        if self.is_remote:
+            raw = storage.read_bytes(self._meta_addr())
+            return json.loads(raw) if raw else {}
+        if os.path.exists(self._meta_addr()):
+            with open(self._meta_addr()) as f:
                 return json.load(f)
         return {}
 
     def set_metadata(self, metadata: Dict[str, Any]) -> None:
-        with open(self._meta_path(), "w") as f:
+        if self.is_remote:
+            storage.write_bytes(self._meta_addr(), json.dumps(metadata).encode())
+            return
+        with open(self._meta_addr(), "w") as f:
             json.dump(metadata, f)
 
     def update_metadata(self, metadata: Dict[str, Any]) -> None:

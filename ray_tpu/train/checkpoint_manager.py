@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from ..air.config import CheckpointConfig
+from . import storage
 from .checkpoint import Checkpoint
 
 
@@ -25,38 +26,46 @@ class _TrackedCheckpoint:
 
 class CheckpointManager:
     def __init__(self, storage_dir: str, config: Optional[CheckpointConfig] = None):
-        self.storage_dir = os.path.abspath(storage_dir)
-        os.makedirs(self.storage_dir, exist_ok=True)
+        storage_dir = storage.normalize(storage_dir)
+        self._remote = storage.is_remote(storage_dir)
+        self.storage_dir = storage_dir if self._remote else os.path.abspath(storage_dir)
+        if not self._remote:
+            os.makedirs(self.storage_dir, exist_ok=True)
         self.config = config or CheckpointConfig()
         self._tracked: List[_TrackedCheckpoint] = []
         self._next_index = 0
         # Rerunning with the same RunConfig.name must continue the index sequence, not
         # collide with (and nest inside) existing checkpoint_NNNNNN directories.
-        for entry in sorted(os.listdir(self.storage_dir)):
-            path = os.path.join(self.storage_dir, entry)
-            if entry.startswith("checkpoint_") and os.path.isdir(path):
-                ckpt = Checkpoint(path)
-                meta = ckpt.get_metadata()
-                idx = meta.get("index", int(entry.split("_")[1]))
-                self._tracked.append(_TrackedCheckpoint(ckpt, idx, meta.get("metrics", {})))
-                self._next_index = max(self._next_index, idx + 1)
+        for entry in sorted(storage.listdir(self.storage_dir) if self._remote
+                            else os.listdir(self.storage_dir)):
+            if not entry.startswith("checkpoint_"):
+                continue
+            path = self._join(entry)
+            if not self._remote and not os.path.isdir(path):
+                continue
+            ckpt = Checkpoint(path)
+            meta = ckpt.get_metadata()
+            idx = meta.get("index", int(entry.split("_")[1]))
+            self._tracked.append(_TrackedCheckpoint(ckpt, idx, meta.get("metrics", {})))
+            self._next_index = max(self._next_index, idx + 1)
+
+    def _join(self, *parts: str) -> str:
+        return storage.join_any(self.storage_dir, *parts)
 
     @property
     def staging_dir(self) -> str:
-        """Where worker sessions stage checkpoints before registration (same fs)."""
-        return os.path.join(self.storage_dir, ".staging")
+        """Where worker sessions stage checkpoints before registration. Local
+        runs: a dir on the run's filesystem (zero-copy move). Remote runs: a
+        URI under the run — workers UPLOAD there (reference storage.py:358
+        persist_to_storage), so no shared disk is ever assumed."""
+        return self._join(".staging")
 
     def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
         """Persist a worker-reported checkpoint into run storage; returns the durable one."""
         idx = self._next_index
         self._next_index += 1
-        dest = os.path.join(self.storage_dir, f"checkpoint_{idx:06d}")
-        if os.path.abspath(checkpoint.path) != dest:
-            # Move when possible (same filesystem) to avoid double disk usage.
-            try:
-                shutil.move(checkpoint.path, dest)
-            except (OSError, shutil.Error):
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        dest = self._join(f"checkpoint_{idx:06d}")
+        storage.persist_dir(checkpoint.path, dest)
         durable = Checkpoint(dest)
         durable.update_metadata({"index": idx, "metrics": {k: _jsonable(v) for k, v in metrics.items()}})
         self._tracked.append(_TrackedCheckpoint(durable, idx, metrics))
@@ -86,6 +95,8 @@ class CheckpointManager:
         for t in self._tracked:
             if id(t) in keep:
                 survivors.append(t)
+            elif t.checkpoint.is_remote:
+                storage.delete(t.checkpoint.path)
             else:
                 shutil.rmtree(t.checkpoint.path, ignore_errors=True)
         self._tracked = survivors

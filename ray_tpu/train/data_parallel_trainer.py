@@ -60,8 +60,10 @@ class DataParallelTrainer:
 
         record_library_usage("train")
         name = self.run_config.name or f"train_{time.strftime('%Y%m%d_%H%M%S')}"
-        storage = self.run_config.storage_path or _default_storage_path()
-        run_dir = os.path.join(storage, name)
+        storage_path = self.run_config.storage_path or _default_storage_path()
+        from . import storage as _storage
+
+        run_dir = _storage.join_any(storage_path, name)
         ckpt_manager = CheckpointManager(run_dir, self.run_config.checkpoint_config)
         train_fn = _normalize_train_fn(self.train_loop_per_worker)
         from ray_tpu.config import CONFIG as _cfg

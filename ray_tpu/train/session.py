@@ -93,12 +93,22 @@ class _TrainSession:
         if checkpoint is not None and self.staging_dir is not None:
             # Stage into run storage now: the caller may delete its snapshot dir the
             # moment report() returns, long before the driver polls.
-            os.makedirs(self.staging_dir, exist_ok=True)
-            dest = os.path.join(self.staging_dir, f"staged_{uuid.uuid4().hex[:12]}")
-            try:
-                shutil.move(checkpoint.path, dest)
-            except (OSError, shutil.Error):
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            from . import storage
+
+            if storage.is_remote(self.staging_dir):
+                # shared-storage run: UPLOAD from this worker's host — the
+                # controller and other hosts only ever see the URI (reference
+                # _internal/storage.py persist_to_storage on the worker)
+                dest = storage.join(self.staging_dir, f"staged_{uuid.uuid4().hex[:12]}")
+                storage.upload_dir(checkpoint.path, dest)
+                shutil.rmtree(checkpoint.path, ignore_errors=True)
+            else:
+                os.makedirs(self.staging_dir, exist_ok=True)
+                dest = os.path.join(self.staging_dir, f"staged_{uuid.uuid4().hex[:12]}")
+                try:
+                    shutil.move(checkpoint.path, dest)
+                except (OSError, shutil.Error):
+                    shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
             checkpoint = Checkpoint(dest)
         self.results.put({"metrics": metrics, "checkpoint": checkpoint})
 

@@ -1,0 +1,167 @@
+"""Pluggable checkpoint storage: URI-addressed run directories over fsspec.
+
+Capability parity: reference python/ray/train/_internal/storage.py:358
+(StorageContext over a pyarrow filesystem — workers UPLOAD checkpoints to
+shared storage, the controller tracks URIs, restore DOWNLOADS on any host).
+Here fsspec is the backend, so ``RunConfig(storage_path="gs://bucket/exp")``
+works wherever an fsspec implementation for the scheme is installed.
+
+A plain path (no ``scheme://``) keeps the zero-copy local behavior: staging
+moves directories on one filesystem and never round-trips bytes.
+
+The ``mock://`` scheme is a deliberately-indirect remote store for tests: it is
+backed by the directory named in ``RAY_TPU_MOCK_FS_ROOT`` but reachable ONLY
+through explicit upload/download calls — code that survives it never relied on
+workers and controller sharing a filesystem.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Tuple
+
+
+def is_remote(path: str) -> bool:
+    return "://" in path and not path.startswith("file://")
+
+
+def normalize(path: str) -> str:
+    """Strip the file:// scheme to a plain local path (remote URIs unchanged):
+    every storage entry point must call this so "file:///mnt/nfs/exp" is never
+    mistaken for a relative path named "file:"."""
+    if path.startswith("file://"):
+        return path[len("file://"):] or "/"
+    return path
+
+
+def join_any(base: str, *parts: str) -> str:
+    """Remote-aware path join (THE helper for run-dir / checkpoint addressing)."""
+    base = normalize(base)
+    if is_remote(base):
+        return join(base, *parts)
+    return os.path.join(base, *parts)
+
+
+def get_fs(uri: str) -> Tuple[object, str]:
+    """(fsspec filesystem, path within it) for a URI."""
+    import fsspec
+
+    scheme, _, rest = uri.partition("://")
+    if scheme == "mock":
+        import tempfile
+
+        root = (os.environ.get("RAY_TPU_MOCK_FS_ROOT")
+                or os.path.join(tempfile.gettempdir(), "ray_tpu_mock_fs"))
+        os.makedirs(root, exist_ok=True)
+        fs = fsspec.filesystem("dir", path=root)
+        return fs, rest
+    fs, path = fsspec.core.url_to_fs(uri)
+    return fs, path
+
+
+def join(uri: str, *parts: str) -> str:
+    return "/".join([uri.rstrip("/"), *parts])
+
+
+def upload_dir(local_dir: str, uri: str) -> None:
+    """Recursively copy a local directory's CONTENTS into uri."""
+    fs, root = get_fs(uri)
+    fs.makedirs(root, exist_ok=True)
+    for dirpath, _, files in os.walk(local_dir):
+        rel = os.path.relpath(dirpath, local_dir)
+        target = root if rel == "." else f"{root}/{rel.replace(os.sep, '/')}"
+        if rel != ".":
+            fs.makedirs(target, exist_ok=True)
+        for fn in files:
+            fs.put_file(os.path.join(dirpath, fn), f"{target}/{fn}")
+
+
+def download_dir(uri: str, local_dir: str) -> None:
+    """Recursively copy uri's contents into a local directory (empty
+    subdirectories included, so a checkpoint round-trips structurally intact)."""
+    fs, root = get_fs(uri)
+    os.makedirs(local_dir, exist_ok=True)
+    base = root.rstrip("/")
+    for f, info in fs.find(base, withdirs=True, detail=True).items():
+        rel = f[len(base):].lstrip("/")
+        if not rel:
+            continue
+        dst = os.path.join(local_dir, *rel.split("/"))
+        if info.get("type") == "directory":
+            os.makedirs(dst, exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(dst) or local_dir, exist_ok=True)
+            fs.get_file(f, dst)
+
+
+def exists(uri: str) -> bool:
+    fs, root = get_fs(uri)
+    return bool(fs.exists(root))
+
+
+def listdir(uri: str) -> List[str]:
+    """Child entry NAMES under uri ([] when absent)."""
+    fs, root = get_fs(uri)
+    if not fs.exists(root):
+        return []
+    return sorted(p.rstrip("/").rsplit("/", 1)[-1] for p in fs.ls(root, detail=False))
+
+
+def delete(uri: str) -> None:
+    """Best-effort recursive delete: pruning a stale checkpoint must never
+    fail a training run (matches the local rmtree(ignore_errors=True))."""
+    fs, root = get_fs(uri)
+    try:
+        fs.rm(root, recursive=True)
+    except Exception:  # noqa: BLE001 — transient object-store errors included
+        pass
+
+
+def move(src_uri: str, dst_uri: str) -> None:
+    """Rename within one filesystem (both URIs must share a scheme/root)."""
+    fs, src = get_fs(src_uri)
+    _, dst = get_fs(dst_uri)
+    fs.makedirs(dst.rsplit("/", 1)[0], exist_ok=True)
+    fs.mv(src, dst, recursive=True)
+
+
+def read_bytes(uri: str):
+    fs, root = get_fs(uri)
+    if not fs.exists(root):
+        return None
+    with fs.open(root, "rb") as f:
+        return f.read()
+
+
+def write_bytes(uri: str, data: bytes) -> None:
+    fs, root = get_fs(uri)
+    parent = root.rsplit("/", 1)[0]
+    if parent:
+        fs.makedirs(parent, exist_ok=True)
+    with fs.open(root, "wb") as f:
+        f.write(data)
+
+
+def persist_dir(local_or_uri: str, dest_uri_or_dir: str) -> str:
+    """Move a (possibly local) checkpoint into its durable location; returns
+    the durable address. Local->local moves; anything else copies through the
+    fs abstraction."""
+    src_remote, dst_remote = is_remote(local_or_uri), is_remote(dest_uri_or_dir)
+    if not src_remote and not dst_remote:
+        if os.path.abspath(local_or_uri) != os.path.abspath(dest_uri_or_dir):
+            try:
+                shutil.move(local_or_uri, dest_uri_or_dir)
+            except (OSError, shutil.Error):
+                shutil.copytree(local_or_uri, dest_uri_or_dir, dirs_exist_ok=True)
+        return dest_uri_or_dir
+    if src_remote and dst_remote:
+        move(local_or_uri, dest_uri_or_dir)
+        return dest_uri_or_dir
+    if not src_remote and dst_remote:
+        upload_dir(local_or_uri, dest_uri_or_dir)
+        shutil.rmtree(local_or_uri, ignore_errors=True)
+        return dest_uri_or_dir
+    # remote -> local
+    download_dir(local_or_uri, dest_uri_or_dir)
+    delete(local_or_uri)
+    return dest_uri_or_dir

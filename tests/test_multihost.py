@@ -455,3 +455,64 @@ def test_broadcast_direct_pulls(three_hosts):
     out = ray_tpu.get(refs, timeout=120)
     assert {nid for _, nid in out} == set(remote_ids)
     assert all(s == 6_000_000.0 for s, _ in out)
+
+
+def test_trainer_chaos_restart_with_remote_storage(two_hosts):
+    """VERDICT r2 #3 'done' bar: the chaos-restart path must not depend on a
+    shared local disk. Checkpoints go to mock:// storage (upload on report,
+    download on restore through train/storage.py); the agent is killed
+    mid-training and the group restarts from the URI checkpoint."""
+    import json
+    import threading
+    import uuid as _uuid
+
+    from ray_tpu.air import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train import Checkpoint, JaxConfig, JaxTrainer
+
+    _, agent = two_hosts
+
+    def loop(config):
+        import tempfile
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            assert ckpt.is_remote  # restore must stream DOWN from storage
+            with ckpt.as_directory() as d:
+                start = json.load(open(os.path.join(d, "state.json")))["step"] + 1
+        for step in range(start, 6):
+            if step == 3 and ckpt is None:
+                time.sleep(8.0)  # window for the chaos kill
+            checkpoint = None
+            if ctx.get_world_rank() == 0:
+                d = tempfile.mkdtemp(prefix="mh_rs_ckpt_")
+                json.dump({"step": step}, open(os.path.join(d, "state.json"), "w"))
+                checkpoint = Checkpoint.from_directory(d)
+            train.report({"step": step}, checkpoint=checkpoint)
+
+    def chaos():
+        time.sleep(4.0)
+        os.kill(agent.pid, signal.SIGKILL)
+
+    killer = threading.Thread(target=chaos, daemon=True)
+    killer.start()
+    trainer = JaxTrainer(
+        loop,
+        backend_config=JaxConfig(collective_group=False),
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1.0,
+                                     placement_strategy="SPREAD"),
+        run_config=RunConfig(
+            name=f"t_mh_rs_{_uuid.uuid4().hex[:8]}",
+            storage_path="mock://chaos",
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    )
+    result = trainer.fit()
+    killer.join(timeout=1)
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 5
+    assert result.checkpoint is not None and result.checkpoint.path.startswith("mock://")
