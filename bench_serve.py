@@ -1,0 +1,198 @@
+"""Serving-path benchmark: JaxLLMEngine on the real chip.
+
+Measures what the paged-KV/continuous-batching design is FOR (reference
+release/llm_tests/ serve benchmarks): prefill throughput, decode tokens/s at
+batch 1/8/32, time-to-first-token, automatic-prefix-cache TTFT speedup, and
+behavior at pool exhaustion (recompute preemption). Writes SERVE_BENCH.json.
+
+Run: python bench_serve.py            (llama-500m geometry, bfloat16, paged KV)
+     python bench_serve.py --tiny     (CI/CPU smoke: test-tiny config)
+
+Timing note (axon TPU tunnel): engine outputs arrive host-side as Python ints
+every step, so wall-clock spans below are naturally device-synchronized.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+TINY = "--tiny" in sys.argv
+
+
+def make_engine(**overrides):
+    from ray_tpu.llm import JaxLLMEngine, LLMConfig
+
+    base = dict(
+        model_id="bench", model_source="test-tiny" if TINY else "llama-500m",
+        tokenizer="byte", kv_layout="paged",
+        max_num_seqs=8 if TINY else 32,
+        max_model_len=256 if TINY else 1024,
+        kv_block_size=16 if TINY else 32,
+        dtype="float32" if TINY else "bfloat16",
+    )
+    if not TINY:
+        base["prefill_buckets"] = [32, 64, 128, 256, 512, 1024]
+    base.update(overrides)
+    eng = JaxLLMEngine(LLMConfig(**base))
+    eng.start()
+    return eng
+
+
+def _prompt(rng, n):
+    return [int(x) for x in rng.integers(1, 200, size=n)]
+
+
+def _params(max_tokens):
+    from ray_tpu.llm import SamplingParams
+
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                          stop_token_ids=[-1])
+
+
+def warmup(engine, rng, prompt_len, batch):
+    """Populate every jit cache (prefill bucket + decode) before timing."""
+    threads = [threading.Thread(target=lambda: engine.generate_sync(
+        _prompt(rng, prompt_len), _params(4))) for _ in range(batch)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+
+
+def bench_ttft_and_prefill(engine, rng, prompt_len):
+    """TTFT for a cold prompt at batch 1 (and implied prefill tokens/s)."""
+    ttfts = []
+    for _ in range(5):
+        p = _prompt(rng, prompt_len)
+        t0 = time.perf_counter()
+        gen = engine.generate(p, _params(2))
+        next(gen)
+        ttfts.append(time.perf_counter() - t0)
+        for _ in gen:
+            pass
+    best = min(ttfts)
+    return {
+        "ttft_ms_b1": round(best * 1e3, 2),
+        "prefill_tokens_per_s": round(prompt_len / best, 1),
+    }
+
+
+def bench_decode(engine, rng, batch, prompt_len, gen_tokens):
+    """Steady-state decode throughput with `batch` concurrent streams."""
+    done = [None] * batch
+    first = [None] * batch
+
+    def run(i):
+        p = _prompt(rng, prompt_len)
+        n = 0
+        for out in engine.generate(p, _params(gen_tokens)):
+            if first[i] is None:
+                first[i] = time.perf_counter()
+            n += len(out.token_ids)
+        done[i] = (n, time.perf_counter())
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(batch)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    total = sum(n for n, _ in done)
+    # decode window: from the last stream's first token to the last completion
+    # (all slots busy the whole span at equal lengths)
+    span = max(t for _, t in done) - max(first)
+    return {
+        f"decode_tokens_per_s_b{batch}": round(total / (time.perf_counter() - t0), 1)
+        if span <= 0 else round(total / span, 1),
+        f"mean_ttft_ms_b{batch}": round(1e3 * np.mean([f - t0 for f in first]), 2),
+    }
+
+
+def bench_prefix_cache(engine, rng, prompt_len):
+    """TTFT speedup for a repeated prompt (hash-chain prefix cache)."""
+    p = _prompt(rng, prompt_len)
+
+    def ttft():
+        t0 = time.perf_counter()
+        gen = engine.generate(p, _params(2))
+        next(gen)
+        dt = time.perf_counter() - t0
+        for _ in gen:
+            pass
+        return dt
+
+    cold = ttft()
+    hits0 = engine.metrics()["prefix_cache_hit_tokens"]
+    warm = min(ttft() for _ in range(3))
+    hits = engine.metrics()["prefix_cache_hit_tokens"] - hits0
+    return {
+        "prefix_cache_ttft_speedup": round(cold / warm, 2),
+        "prefix_cache_hit_tokens": int(hits),
+    }
+
+
+def bench_preemption(rng):
+    """Oversubscribe a deliberately tiny pool: every request must still finish
+    (recompute preemption), and the engine reports how often it preempted."""
+    # pool sized so 4 concurrent requests MUST overflow it mid-decode
+    eng = make_engine(max_num_seqs=4,
+                      num_kv_blocks=24 if TINY else 10,
+                      max_model_len=256 if TINY else 512)
+    try:
+        n_req, gen_tokens = 6, 48
+        errs = []
+
+        def run():
+            try:
+                out = eng.generate_sync(_prompt(rng, 64), _params(gen_tokens))
+                assert out.num_generated_tokens == gen_tokens
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=run) for _ in range(n_req)]
+        t0 = time.perf_counter()
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        dt = time.perf_counter() - t0
+        assert not errs, errs
+        m = eng.metrics()
+        return {
+            "preemption_run_tokens_per_s": round(n_req * gen_tokens / dt, 1),
+            "preemption_count": m["num_preemptions"],
+            "preemption_all_completed": True,
+        }
+    finally:
+        eng.shutdown()
+
+
+def main():
+    import jax
+
+    rng = np.random.default_rng(0)
+    prompt_len = 64 if TINY else 512
+    gen_tokens = 32 if TINY else 128
+    results = {"config": "test-tiny" if TINY else
+               "llama-500m bf16 paged(block=32, blocks=auto) max_len=1024",
+               "platform": jax.devices()[0].platform,
+               "note": ("decode steps fetch one sampled token/slot to host per "
+                        "step; through the axon tunnel that round trip "
+                        "(~100-150ms) dominates decode + TTFT numbers — on "
+                        "local TPU hardware the same loop pays ~1ms/step")}
+    engine = make_engine()
+    try:
+        warmup(engine, rng, prompt_len, 4)
+        results.update(bench_ttft_and_prefill(engine, rng, prompt_len))
+        for batch in (1, 8) + (() if TINY else (32,)):
+            results.update(bench_decode(engine, rng, batch, prompt_len, gen_tokens))
+        results.update(bench_prefix_cache(engine, rng, prompt_len))
+    finally:
+        engine.shutdown()
+    results.update(bench_preemption(rng))
+    for k, v in results.items():
+        print(f"{k}: {v}")
+    with open(os.path.join(os.path.dirname(__file__) or ".", "SERVE_BENCH.json"), "w") as f:
+        json.dump(results, f, indent=2)
+    print("wrote SERVE_BENCH.json")
+
+
+if __name__ == "__main__":
+    main()

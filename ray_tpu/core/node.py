@@ -365,6 +365,9 @@ class Cluster:
         self._stream_counts: Dict[TaskID, int] = {}
         self._stream_abandoned: Dict[TaskID, int] = {}
         self._stream_cancel_sent: set = set()  # producers already told to stop
+        # remote worker log rings: wid_hex -> {"node", "lines": deque[(stream, line)]}
+        self._worker_logs: Dict[str, Dict[str, Any]] = {}
+        self._worker_logs_lock = threading.Lock()
         self._stream_completion: Dict[ObjectID, TaskID] = {}  # completion oid -> task
         # lineage for reconstruction: return oid -> creating TaskSpec while the
         # object is in scope and the task is retryable (reference
@@ -507,6 +510,26 @@ class Cluster:
             pass
         self._schedule()
 
+    def _on_worker_log(self, agent: AgentHandle, wid_hex: str, stream: str,
+                       text: str) -> None:
+        """A remote worker's stdout/stderr lines: re-print on the driver with a
+        (worker, host) prefix and keep a bounded ring for the state API
+        (reference log_monitor.py:105 + `ray logs`)."""
+        import sys as _sys
+
+        lines = text.splitlines()
+        with self._worker_logs_lock:
+            ring = self._worker_logs.setdefault(
+                wid_hex, {"node": agent.host_key, "lines": deque(maxlen=1000)})
+            ring["lines"].extend((stream, ln) for ln in lines)
+            # bounded over worker churn: evict the oldest rings past 200 workers
+            while len(self._worker_logs) > 200:
+                self._worker_logs.pop(next(iter(self._worker_logs)))
+        out = _sys.stdout if stream == "out" else _sys.stderr
+        for line in lines:
+            print(f"({wid_hex[:8]}, node={agent.host_key[:8]}) {line}",
+                  file=out)
+
     # -- head restart: agent re-attach (reference NotifyGCSRestart re-sync) -----------
     def _reattach_agent(self, conn, msg) -> None:
         """An agent that survived a head restart re-joins with its node id,
@@ -639,6 +662,8 @@ class Cluster:
                 self._on_worker_death(w)
         elif kind == "heartbeat":
             agent.last_heartbeat = time.time()
+        elif kind == "worker_log":
+            self._on_worker_log(agent, msg[1], msg[2], msg[3])
         elif kind == "reply":
             agent.on_reply(msg[1], msg[2], msg[3])
 

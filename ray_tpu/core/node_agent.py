@@ -77,6 +77,7 @@ class NodeAgent:
         self._workers: Dict[str, Any] = {}   # wid_hex -> (proc, pipe)
         self._pipe_to_wid: Dict[Any, str] = {}
         self._shutdown = False
+        self._dead_worker_logs: Dict[str, float] = {}  # wid -> death time (log grace)
         self._wakeup_r, self._wakeup_w = _mp.Pipe(duplex=False)
         self.worker_env: Dict[str, str] = {}
         self.node_id_hex: Optional[str] = None
@@ -110,6 +111,8 @@ class NodeAgent:
         hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
                               name="agent-heartbeat")
         hb.start()
+        threading.Thread(target=self._tail_logs_loop, daemon=True,
+                         name="agent-log-tail").start()
         try:
             self._serve_loop()
         finally:
@@ -120,6 +123,52 @@ class NodeAgent:
             from . import object_store
 
             object_store.destroy_arena()
+
+    @property
+    def _log_dir(self) -> str:
+        return os.path.join(CONFIG.session_dir, "logs",
+                            (self.node_id_hex or "node")[:12])
+
+    def _tail_logs_loop(self) -> None:
+        """Stream appended worker stdout/stderr lines to the head (reference
+        log_monitor.py:105 tailing worker logs to the driver). Dead workers
+        keep being tailed for a grace period — a crash's final traceback is
+        exactly the output that must not be dropped."""
+        offsets: Dict[tuple, int] = {}
+        while not self._shutdown:
+            now = time.monotonic()
+            dead = {wid: t for wid, t in self._dead_worker_logs.items()
+                    if now - t < 10.0}
+            self._dead_worker_logs = dead
+            wids = set(self._workers) | set(dead)
+            for key in list(offsets):
+                if key[0] not in wids:
+                    offsets.pop(key, None)  # drained + grace passed
+            for wid in wids:
+                for stream in ("out", "err"):
+                    path = os.path.join(self._log_dir, f"worker-{wid}.{stream}")
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    off = offsets.get((wid, stream), 0)
+                    while off < size:  # drain the whole backlog this pass
+                        try:
+                            with open(path, "rb") as f:
+                                f.seek(off)
+                                data = f.read(min(size - off, 65536))
+                        except OSError:
+                            break
+                        if not data:
+                            break
+                        off += len(data)
+                        offsets[(wid, stream)] = off
+                        try:
+                            self._send(("worker_log", wid, stream,
+                                        data.decode(errors="replace")))
+                        except Exception:
+                            pass  # head restart in progress: this chunk is lost
+            time.sleep(0.5)
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown:
@@ -321,6 +370,7 @@ class NodeAgent:
 
         parent_conn, child_conn = _mp.Pipe(duplex=True)
         env = dict(self.worker_env)
+        env["RAY_TPU_WORKER_LOG_DIR"] = self._log_dir
         proc = _mp.Process(
             target=worker_main,
             args=(child_conn, self.node_id_hex, wid_hex, accel, env),
@@ -336,6 +386,7 @@ class NodeAgent:
             pass
 
     def _on_local_worker_death(self, wid_hex: str) -> None:
+        self._dead_worker_logs[wid_hex] = time.monotonic()
         entry = self._workers.pop(wid_hex, None)
         if entry is not None:
             self._pipe_to_wid.pop(entry[1], None)

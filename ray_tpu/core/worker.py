@@ -608,6 +608,22 @@ def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dic
     """Entry point of a spawned worker process."""
     for k, v in env.items():
         os.environ[k] = v
+    log_dir = os.environ.get("RAY_TPU_WORKER_LOG_DIR")
+    if log_dir:
+        # agent-hosted worker: stdout/stderr go to per-worker files the agent
+        # tails back to the head (reference: worker log redirection +
+        # log_monitor.py:105 re-printing on the driver). Local workers keep the
+        # driver's console (no env set).
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            for stream, fd in (("out", 1), ("err", 2)):
+                f = open(os.path.join(log_dir, f"worker-{worker_id_hex}.{stream}"),
+                         "ab", buffering=0)
+                os.dup2(f.fileno(), fd)
+            sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
+            sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+        except Exception:
+            pass
     if accel == "cpu":
         # Never let a CPU worker initialize the TPU runtime. The env var alone is not
         # enough: the sandbox sitecustomize may have pre-imported jax and registered an

@@ -37,7 +37,10 @@ class BlockMetadata:
 def _numpy_to_arrow_array(arr: np.ndarray) -> pa.Array:
     if arr.ndim == 1:
         if arr.dtype.kind == "U" or arr.dtype == object:
-            return pa.array(arr.tolist())
+            # object elements may be ndarrays (ragged tensor column, e.g. a
+            # per-row stack of images): arrow only takes nested lists
+            return pa.array([x.tolist() if isinstance(x, np.ndarray) else x
+                             for x in arr])
         return pa.array(arr)
     # Multi-dim tensor column -> FixedSizeList so round-trips preserve shape.
     inner_len = int(np.prod(arr.shape[1:]))

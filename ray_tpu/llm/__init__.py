@@ -14,6 +14,7 @@ from .batch import (
     DetokenizeStage,
     HttpRequestStage,
     LLMEngineStage,
+    PrepareImageStage,
     Processor,
     TokenizeStage,
     build_llm_processor,
@@ -36,4 +37,5 @@ __all__ = [
     "DetokenizeStage",
     "HttpRequestStage",
     "LLMEngineStage",
+    "PrepareImageStage",
 ]

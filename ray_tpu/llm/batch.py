@@ -117,6 +117,95 @@ class HttpRequestStage:
         return out
 
 
+class PrepareImageStage:
+    """Resolve image references into fixed-size pixel tensors for a VLM engine
+    (reference batch/stages/prepare_image_stage.py — ImageProcessor resolving
+    http/data-URI/PIL/ndarray image refs out of chat messages).
+
+    Sources handled, per row: an ``image`` column (ndarray / raw encoded bytes
+    / file path / data URI), or OpenAI-vision ``messages`` content parts
+    ({"type": "image_url", "image_url": {"url": ...}}). Every image lands as a
+    float32 [H, W, 3] tensor in [0, 1] at a fixed ``size`` — static shapes so
+    the downstream engine stage jits one program (TPU-shaped batching, unlike
+    the reference's variable-size PIL passthrough)."""
+
+    def __init__(self, size=(224, 224), mode: str = "RGB"):
+        self.size = tuple(size)
+        self.mode = mode
+
+    def _decode(self, ref) -> np.ndarray:
+        import base64
+        import io
+
+        from PIL import Image
+
+        if isinstance(ref, np.ndarray) and ref.ndim >= 2:
+            a = ref
+            if a.dtype.kind == "f":
+                # scale-aware: [0,1] floats (this stage's own output format)
+                # must not truncate to all-black via a blind uint8 cast
+                a = a * 255.0 if float(a.max(initial=0.0)) <= 1.0 else a
+            img = Image.fromarray(np.clip(a, 0, 255).astype(np.uint8))
+        elif isinstance(ref, (bytes, bytearray)):
+            img = Image.open(io.BytesIO(ref))
+        elif isinstance(ref, str) and ref.startswith("data:"):
+            b64 = ref.split(",", 1)[1]
+            img = Image.open(io.BytesIO(base64.b64decode(b64)))
+        elif isinstance(ref, str) and ref.startswith(("http://", "https://")):
+            import urllib.request
+
+            with urllib.request.urlopen(ref, timeout=30) as r:
+                img = Image.open(io.BytesIO(r.read()))
+        elif isinstance(ref, str):
+            img = Image.open(ref)
+        else:
+            raise TypeError(f"unsupported image reference {type(ref)!r}")
+        img = img.convert(self.mode).resize((self.size[1], self.size[0]))
+        return np.asarray(img, np.float32) / 255.0
+
+    @staticmethod
+    def _refs_from_messages(messages) -> List[Any]:
+        refs = []
+        for m in messages:
+            content = m.get("content")
+            if not isinstance(content, (list, tuple)):
+                continue
+            for part in content:
+                if isinstance(part, dict) and part.get("type") == "image_url":
+                    url = part.get("image_url")
+                    refs.append(url.get("url") if isinstance(url, dict) else url)
+        return refs
+
+    @staticmethod
+    def to_tensor(images, size=(224, 224)) -> np.ndarray:
+        """Re-materialize one row's ``images`` value as a dense
+        [n, H, W, 3] float32 tensor — after a block boundary the column
+        round-trips as nested lists (and empty rows as shape (0,))."""
+        return np.asarray(images, np.float32).reshape(-1, *size, 3)
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        n = len(next(iter(batch.values())))
+        col = np.empty(n, dtype=object)
+        counts = np.zeros(n, np.int64)
+        for i in range(n):
+            refs: List[Any] = []
+            if "image" in batch:
+                refs.append(batch["image"][i])
+            if "messages" in batch:
+                refs.extend(self._refs_from_messages(batch["messages"][i]))
+            pixels = [self._decode(r) for r in refs]
+            # NOTE a block boundary stores this ragged tensor column as nested
+            # lists; consumers re-materialize with to_tensor() (zero-image rows
+            # round-trip as shape (0,), hence the reshape there)
+            col[i] = (np.stack(pixels) if pixels
+                      else np.zeros((0, *self.size, 3), np.float32))
+            counts[i] = len(pixels)
+        out = dict(batch)
+        out["images"] = col
+        out["num_images"] = counts
+        return out
+
+
 class LLMEngineStage:
     """Stateful actor UDF running generation (reference vllm_engine_stage.py)."""
 
@@ -172,12 +261,19 @@ def build_llm_processor(
     batch_size: int = 16,
     concurrency: int = 1,
     has_messages: bool = False,
+    prepare_images: bool = False,
+    image_size=(224, 224),
 ) -> Processor:
-    """Build the standard chat->generate processor (reference build_llm_processor)."""
+    """Build the standard chat->generate processor (reference build_llm_processor).
+    prepare_images=True inserts the VLM image stage (pixel tensors resolved
+    from image refs / vision messages) ahead of generation."""
 
     stages: List[Any] = []
     if preprocess is not None:
         stages.append(lambda ds: ds.map(preprocess))
+    if prepare_images:
+        stages.append(lambda ds: ds.map_batches(
+            PrepareImageStage(size=image_size), batch_size=batch_size))
     if has_messages:
         stages.append(lambda ds: ds.map_batches(ChatTemplateStage(), batch_size=batch_size))
     stages.append(

@@ -103,6 +103,8 @@ class JaxLLMEngine(LLMEngine):
         self.num_pending = 0
         self.num_active = 0
         self.total_generated = 0
+        self.num_preemptions = 0
+        self.num_aborted = 0
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> None:
@@ -303,6 +305,7 @@ class JaxLLMEngine(LLMEngine):
                     request_id=req.id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(req.prompt_ids),
                     num_generated_tokens=req.generated))
+                self.num_aborted += 1
                 self._release(req)
                 with self._lock:
                     self._aborted.discard(req.id)
@@ -370,11 +373,28 @@ class JaxLLMEngine(LLMEngine):
         )
 
     def metrics(self) -> Dict[str, Any]:
-        return {
+        """Engine health + paged-KV performance counters (reference: vllm
+        engine stats — pool occupancy, prefix-cache hits, preemptions — the
+        numbers that validate the paged design under load)."""
+        out = {
             "num_pending": self.num_pending,
             "num_active": self.num_active,
             "total_generated": self.total_generated,
+            "num_preemptions": self.num_preemptions,
+            "num_aborted": self.num_aborted,
         }
+        blocks = getattr(self, "_blocks", None)
+        if blocks is not None:
+            total = blocks.total_blocks
+            free = blocks.num_free
+            out.update({
+                "kv_blocks_total": total,
+                "kv_blocks_free": free,
+                "kv_pool_occupancy": (total - free) / total if total else 0.0,
+                "prefix_cache_hit_tokens": blocks.hit_tokens,
+                "prefix_cached_blocks": len(blocks.cached),
+            })
+        return out
 
     # -- scheduler loop ------------------------------------------------------------
     def _free_slots(self) -> List[int]:
@@ -391,6 +411,7 @@ class JaxLLMEngine(LLMEngine):
                 was_aborted = req.id in self._aborted
                 self._aborted.discard(req.id)
             if was_aborted:
+                self.num_aborted += 1
                 self._fail_request(req, len(req.prompt_ids), "abort")
                 continue
             # visible to the loop's crash handler: this request is in neither
@@ -609,16 +630,18 @@ class JaxLLMEngine(LLMEngine):
         and later re-prefilled from its token history)."""
         from . import paged
 
-        lengths = np.asarray(self.state.lengths)
         for slot in list(self._active):
             req = self._active[slot]
             if req is None:
                 continue
+            # host mirror of state.lengths (== prompt + generated - 1, the next
+            # write position): saves a device fetch per decode step
+            next_write = len(req.prompt_ids) + req.generated - 1
             # re-check liveness each round: an earlier iteration (or this one)
             # may have preempted this very request — growing a preempted slot
             # would leak blocks into it and corrupt a later occupant's table
             while (self._active[slot] is req
-                   and lengths[slot] >= self._blocks.slot_capacity(slot)):
+                   and next_write >= self._blocks.slot_capacity(slot)):
                 if self._blocks.num_free > 0:
                     (bid,) = self._blocks.allocate(slot, 1)
                     index = self._blocks.slot_capacity(slot) // self.config.kv_block_size - 1
@@ -633,6 +656,7 @@ class JaxLLMEngine(LLMEngine):
                     break  # this slot's request was the victim; nothing to grow
 
     def _preempt(self, req: _Request) -> None:
+        self.num_preemptions += 1
         slot = req.slot
         self._blocks.release(slot)
         self._active[slot] = None
@@ -701,7 +725,6 @@ class JaxLLMEngine(LLMEngine):
         toks = np.asarray(model_runner.sample_tokens(
             self._next_rng(), logits, jnp.asarray(self._temp), jnp.asarray(self._top_p),
             jnp.asarray(self._top_k)))
-        lengths = np.asarray(self.state.lengths)
         for slot, req in list(self._active.items()):
             if req is None:
                 continue
@@ -709,7 +732,12 @@ class JaxLLMEngine(LLMEngine):
             self._last_tokens[slot] = tok
             self._emit(req, tok)
             r2 = self._active[slot]
-            if r2 is not None and lengths[slot] >= self.config.max_model_len - 1:
+            # host mirror of state.lengths: the last sampled token is not yet
+            # written to KV, so device lengths == prompt + generated - 1.
+            # Mirroring avoids a SECOND device round trip per decode step
+            # (pure overhead; brutal through a network tunnel).
+            if r2 is not None and (len(r2.prompt_ids) + r2.generated - 1
+                                   >= self.config.max_model_len - 1):
                 r2.out_queue.put(RequestOutput(
                     request_id=r2.id, token_ids=[], finished=True, finish_reason="length",
                     num_prompt_tokens=len(r2.prompt_ids), num_generated_tokens=r2.generated,

@@ -108,6 +108,22 @@ class LLMServer:
         """OpenAI ``stream: true``: yield SSE frames ("data: {chunk}\\n\\n" ...
         "data: [DONE]\\n\\n") as the engine produces tokens. Runs as a streaming
         actor method through Serve (reference proxy.py:699 ASGI streaming)."""
+        return self._sse_frames(
+            lambda rid: self.engine.generate(
+                prompt, _sampling_from_body(body), request_id=rid),
+            body, chat)
+
+    def decode_stream(self, prefill_result: Dict[str, Any], body: Dict[str, Any],
+                      chat: bool):
+        """Streaming decode side of P/D disaggregation: continue from a
+        transferred prefill and yield SSE frames (reference
+        prefill_decode_disagg + ASGI streaming)."""
+        return self._sse_frames(
+            lambda rid: self.engine.generate_from_prefill(
+                prefill_result, _sampling_from_body(body), request_id=rid),
+            body, chat)
+
+    def _sse_frames(self, start_gen, body: Dict[str, Any], chat: bool):
         import json as _json
 
         model = body.get("model", self.llm_config.model_id)
@@ -148,8 +164,7 @@ class LLMServer:
 
             eng_rid = uuid.uuid4().hex
             try:
-                for out in self.engine.generate(prompt, _sampling_from_body(body),
-                                                request_id=eng_rid):
+                for out in start_gen(eng_rid):
                     finish = out.finish_reason
                     all_ids.extend(out.token_ids)
                     full = tokenizer.decode(all_ids)
@@ -279,20 +294,24 @@ class PDRouter:
             body.get("model", self.model_id), out["text"], out["finish_reason"],
             _usage(out["num_prompt_tokens"], out["num_generated_tokens"]))
 
-    def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def handle_http(self, request: Dict[str, Any]):
         path, body = request["path"], request.get("body") or {}
         if path.endswith("/models"):
             return _models_list([self.model_id])
+        chat = path.endswith("/chat/completions")
+        if not chat and not path.endswith("/completions"):
+            raise ValueError(f"unsupported path {path!r}")
         if isinstance(body, dict) and body.get("stream"):
-            # explicit refusal beats one mislabeled SSE blob: P/D decode
-            # streaming lands with transferable-KV streaming support
-            raise ValueError(
-                "stream=true is not supported by the P/D-disaggregated router yet")
-        if path.endswith("/chat/completions"):
-            return self.chat(body)
-        if path.endswith("/completions"):
-            return self.completions(body)
-        raise ValueError(f"unsupported path {path!r}")
+            # streaming P/D: prefill synchronously (KV transfers through the
+            # object store), then the decode replica streams SSE frames back
+            # through this router's own streaming call (each frame re-streams)
+            prompt = (render_chat_template(body.get("messages", []))
+                      if chat else body.get("prompt", ""))
+            pre = self.prefill_handle.options(method_name="prefill").remote(
+                prompt, body).result()
+            return self.decode_handle.options(
+                method_name="decode_stream", stream=True).remote(pre, body, chat)
+        return self.chat(body) if chat else self.completions(body)
 
 
 def build_pd_openai_app(llm_config: LLMConfig, *, num_prefill: int = 1,

@@ -202,6 +202,7 @@ def cmd_list(args) -> int:
         "objects": rs.list_objects,
         "placement-groups": rs.list_placement_groups,
         "summary": rs.summarize_cluster,
+        "logs": rs.list_logs,
     }
     out = fns[args.resource]()
     print(json.dumps(out, indent=2, default=str))
@@ -289,7 +290,7 @@ def main(argv=None) -> int:
     sp = sub.add_parser("list", help="state API listings (reference `ray list`)")
     sp.add_argument("resource", choices=["nodes", "workers", "tasks", "actors",
                                          "objects", "placement-groups", "summary",
-                                         "stacks", "config"])
+                                         "stacks", "config", "logs"])
     sp.add_argument("--address", default=None,
                     help="connect as a client driver, e.g. ray-tpu://127.0.0.1:10001")
     sp.set_defaults(fn=cmd_list)

@@ -95,20 +95,14 @@ class _TrainSession:
             # moment report() returns, long before the driver polls.
             from . import storage
 
-            if storage.is_remote(self.staging_dir):
-                # shared-storage run: UPLOAD from this worker's host — the
-                # controller and other hosts only ever see the URI (reference
-                # _internal/storage.py persist_to_storage on the worker)
-                dest = storage.join(self.staging_dir, f"staged_{uuid.uuid4().hex[:12]}")
-                storage.upload_dir(checkpoint.path, dest)
-                shutil.rmtree(checkpoint.path, ignore_errors=True)
-            else:
+            # remote staging UPLOADS from this worker's host (reference
+            # _internal/storage.py persist_to_storage on the worker); local
+            # staging keeps the zero-copy move
+            if not storage.is_remote(self.staging_dir):
                 os.makedirs(self.staging_dir, exist_ok=True)
-                dest = os.path.join(self.staging_dir, f"staged_{uuid.uuid4().hex[:12]}")
-                try:
-                    shutil.move(checkpoint.path, dest)
-                except (OSError, shutil.Error):
-                    shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            dest = storage.join_any(self.staging_dir,
+                                    f"staged_{uuid.uuid4().hex[:12]}")
+            storage.persist_dir(checkpoint.path, dest)
             checkpoint = Checkpoint(dest)
         self.results.put({"metrics": metrics, "checkpoint": checkpoint})
 

