@@ -1,0 +1,420 @@
+"""Typed gRPC control plane for the agent<->head channel.
+
+Capability parity: reference src/ray/rpc/ (GrpcServer/GrpcClient,
+ClientCallManager) + src/ray/protobuf/node_manager.proto — raylet<->GCS
+control traffic rides typed protobuf over gRPC, not pickled Python. One
+long-lived bidirectional stream per agent carries every control message
+(protos/node_agent.proto); worker PIPE payloads remain opaque bytes relayed
+verbatim (they originate and terminate inside the head's own trust domain).
+
+The head never unpickles anything received from a semi-trusted agent. Auth:
+the per-cluster session key rides the stream's initial metadata and is
+compared constant-time. gRPC supplies keepalive, flow control, and per-stream
+multiplexing; app-level request deadlines stay in AgentHandle.call.
+
+Codec design: node.py / node_agent.py keep their tuple-shaped message logic —
+this module converts tuples <-> protobuf at the transport boundary, so the
+message semantics live in one place and the wire format in another.
+"""
+from __future__ import annotations
+
+import hmac
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+from ray_tpu.protos import node_agent_pb2 as pb
+
+_SERVICE = "ray_tpu.rpc.NodeAgentService"
+_METHOD = f"/{_SERVICE}/AgentChannel"
+_AUTH_KEY = "rt-auth-bin"
+
+_ERR_KINDS = {
+    "os": OSError,
+    "timeout": TimeoutError,
+    "key": KeyError,
+}
+
+
+def _err_kind(e: BaseException) -> str:
+    from . import object_store
+
+    if isinstance(e, object_store.ObjectLost):
+        return "object_lost"
+    if isinstance(e, TimeoutError):
+        return "timeout"
+    if isinstance(e, (OSError, EOFError)):
+        return "os"
+    if isinstance(e, KeyError):
+        return "key"
+    return "other"
+
+
+def make_error(kind: str, msg: str) -> Exception:
+    if kind == "object_lost":
+        from . import object_store
+
+        return object_store.ObjectLost(msg)
+    return _ERR_KINDS.get(kind, RuntimeError)(msg)
+
+
+# ---- Scalar / Location codec ---------------------------------------------------
+
+def _scalar(v) -> pb.Scalar:
+    if isinstance(v, bool):
+        return pb.Scalar(flag=v)
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return pb.Scalar(b=bytes(v))
+    if isinstance(v, str):
+        return pb.Scalar(s=v)
+    if isinstance(v, int):
+        return pb.Scalar(i=v)
+    if isinstance(v, float):
+        return pb.Scalar(d=v)
+    raise TypeError(f"non-scalar location element {type(v)!r}")
+
+
+def _unscalar(s: pb.Scalar):
+    return getattr(s, s.WhichOneof("v"))
+
+
+def encode_loc(loc) -> pb.Location:
+    return pb.Location(parts=[_scalar(v) for v in loc])
+
+
+def decode_loc(m: pb.Location) -> Optional[tuple]:
+    if not m.parts:
+        return None
+    return tuple(_unscalar(s) for s in m.parts)
+
+
+# ---- head -> agent -------------------------------------------------------------
+
+def encode_head_msg(msg: tuple) -> pb.HeadMessage:
+    kind = msg[0]
+    if kind == "spawn_worker":
+        return pb.HeadMessage(spawn_worker=pb.SpawnWorker(worker_id=msg[1],
+                                                         accel=msg[2]))
+    if kind == "to_worker":
+        return pb.HeadMessage(to_worker=pb.ToWorker(worker_id=msg[1],
+                                                    payload=msg[2]))
+    if kind == "kill_worker":
+        return pb.HeadMessage(kill_worker=pb.KillWorker(worker_id=msg[1]))
+    if kind == "free_object":
+        return pb.HeadMessage(free_object=pb.FreeObject(loc=encode_loc(msg[1])))
+    if kind == "shutdown":
+        return pb.HeadMessage(shutdown=pb.Shutdown())
+    if kind == "req":
+        _, req_id, op, args = msg
+        r = pb.AgentRequest(req_id=req_id, op=op)
+        if op == "fetch_object":
+            r.loc.CopyFrom(encode_loc(args[0]))
+        elif op == "store_object":
+            oid, data, is_error = args
+            r.oid, r.data, r.is_error = oid.binary(), data, is_error
+        elif op == "pull_object":
+            oid, loc, addr = args
+            r.oid = oid.binary()
+            r.loc.CopyFrom(encode_loc(loc))
+            r.host, r.port = (addr[0] or ""), int(addr[1])
+        elif op == "gc_dead_owners":
+            r.keep.extend(args[0])
+        else:
+            raise ValueError(f"unknown agent op {op!r}")
+        return pb.HeadMessage(request=r)
+    raise ValueError(f"unknown head message kind {kind!r}")
+
+
+def decode_head_msg(m: pb.HeadMessage) -> tuple:
+    kind = m.WhichOneof("msg")
+    if kind == "spawn_worker":
+        return ("spawn_worker", m.spawn_worker.worker_id, m.spawn_worker.accel)
+    if kind == "to_worker":
+        return ("to_worker", m.to_worker.worker_id, m.to_worker.payload)
+    if kind == "kill_worker":
+        return ("kill_worker", m.kill_worker.worker_id)
+    if kind == "free_object":
+        return ("free_object", decode_loc(m.free_object.loc))
+    if kind == "shutdown":
+        return ("shutdown",)
+    if kind == "request":
+        r = m.request
+        if r.op == "fetch_object":
+            args: tuple = (decode_loc(r.loc),)
+        elif r.op == "store_object":
+            from .ids import ObjectID
+
+            args = (ObjectID(r.oid), r.data, r.is_error)
+        elif r.op == "pull_object":
+            from .ids import ObjectID
+
+            args = (ObjectID(r.oid), decode_loc(r.loc),
+                    (r.host or None, r.port))
+        elif r.op == "gc_dead_owners":
+            args = (set(r.keep),)
+        else:
+            args = ()
+        return ("req", r.req_id, r.op, args)
+    if kind == "welcome":
+        return ("welcome", {"node_id": m.welcome.node_id,
+                            "worker_env": dict(m.welcome.worker_env),
+                            "object_store_memory": m.welcome.object_store_memory})
+    if kind == "welcome_back":
+        return ("welcome_back", {"keep_workers": list(m.welcome_back.keep_workers)})
+    raise ValueError(f"unknown head proto {kind!r}")
+
+
+# ---- agent -> head -------------------------------------------------------------
+
+def encode_agent_msg(msg: tuple) -> pb.AgentMessage:
+    kind = msg[0]
+    if kind == "heartbeat":
+        return pb.AgentMessage(heartbeat=pb.Heartbeat(time=msg[1]))
+    if kind == "from_worker":
+        return pb.AgentMessage(from_worker=pb.FromWorker(worker_id=msg[1],
+                                                         payload=msg[2]))
+    if kind == "worker_death":
+        return pb.AgentMessage(worker_death=pb.WorkerDeath(worker_id=msg[1]))
+    if kind == "worker_log":
+        return pb.AgentMessage(worker_log=pb.WorkerLog(worker_id=msg[1],
+                                                       stream=msg[2], text=msg[3]))
+    if kind == "register":
+        _, resources, labels, max_workers, extras = msg
+        return pb.AgentMessage(register=pb.Register(
+            resources=resources, labels=labels or {}, max_workers=max_workers,
+            data_port=int((extras or {}).get("data_port") or 0)))
+    if kind == "reregister":
+        _, node_hex, resources, labels, max_workers, extras = msg
+        rr = pb.Reregister(
+            node_id=node_hex,
+            info=pb.Register(resources=resources, labels=labels or {},
+                             max_workers=max_workers,
+                             data_port=int((extras or {}).get("data_port") or 0)),
+            arena=(extras or {}).get("arena") or "",
+        )
+        for wid, accel in (extras or {}).get("workers", ()):
+            rr.workers.add(worker_id=wid, accel=accel)
+        for oid, size, flags in (extras or {}).get("objects", ()):
+            rr.objects.add(oid=oid, size=size, flags=flags)
+        return pb.AgentMessage(reregister=rr)
+    if kind == "reply":
+        _, req_id, ok, value = msg
+        r = pb.AgentReply(req_id=req_id)
+        if not ok:
+            r.error_kind = _err_kind(value)
+            r.error = str(value) or repr(value)
+        elif isinstance(value, tuple) and len(value) == 2 and isinstance(value[0], (bytes, memoryview, bytearray)):
+            r.data, r.is_error = bytes(value[0]), bool(value[1])  # fetch_object
+        elif isinstance(value, tuple):
+            r.loc.CopyFrom(encode_loc(value))  # store/pull -> local location
+        else:
+            r.ok = bool(value)  # gc_dead_owners
+        return pb.AgentMessage(reply=r)
+    raise ValueError(f"unknown agent message kind {kind!r}")
+
+
+def decode_agent_msg(m: pb.AgentMessage) -> tuple:
+    kind = m.WhichOneof("msg")
+    if kind == "heartbeat":
+        return ("heartbeat", m.heartbeat.time)
+    if kind == "from_worker":
+        return ("from_worker", m.from_worker.worker_id, m.from_worker.payload)
+    if kind == "worker_death":
+        return ("worker_death", m.worker_death.worker_id)
+    if kind == "worker_log":
+        return ("worker_log", m.worker_log.worker_id, m.worker_log.stream,
+                m.worker_log.text)
+    if kind == "register":
+        r = m.register
+        return ("register", dict(r.resources), dict(r.labels), r.max_workers,
+                {"data_port": r.data_port or None})
+    if kind == "reregister":
+        rr = m.reregister
+        return ("reregister", rr.node_id, dict(rr.info.resources),
+                dict(rr.info.labels), rr.info.max_workers,
+                {"data_port": rr.info.data_port or None,
+                 "arena": rr.arena or None,
+                 "workers": [(w.worker_id, w.accel) for w in rr.workers],
+                 "objects": [(o.oid, o.size, o.flags) for o in rr.objects]})
+    if kind == "reply":
+        r = m.reply
+        if r.error_kind:
+            return ("reply", r.req_id, False, make_error(r.error_kind, r.error))
+        loc = decode_loc(r.loc)
+        if loc is not None:
+            return ("reply", r.req_id, True, loc)
+        if r.data or r.is_error or not r.ok:
+            # fetch_object result (data may legitimately be empty bytes)
+            return ("reply", r.req_id, True, (r.data, r.is_error))
+        return ("reply", r.req_id, True, r.ok)
+    raise ValueError(f"unknown agent proto {kind!r}")
+
+
+# ---- transport: head-side gRPC server ------------------------------------------
+
+class AgentStream:
+    """Head-side view of one connected agent stream (Connection-ish: the
+    Cluster hands tuples to send(); incoming tuples flow to its callback)."""
+
+    def __init__(self, peer_ip: Optional[str]):
+        self.peer_ip = peer_ip
+        self._out: "queue.Queue[Optional[pb.HeadMessage]]" = queue.Queue()
+        self.closed = threading.Event()
+        # set by the Cluster during on_connect, before the reader starts
+        self.on_message = None
+        self.on_disconnect = None
+
+    def send(self, msg: tuple) -> None:
+        if self.closed.is_set():
+            raise OSError("agent stream closed")
+        self._out.put(encode_head_msg(msg))
+
+    def send_welcome(self, payload: dict) -> None:
+        self._out.put(pb.HeadMessage(welcome=pb.Welcome(
+            node_id=payload["node_id"], worker_env=payload["worker_env"],
+            object_store_memory=int(payload.get("object_store_memory") or 0))))
+
+    def send_welcome_back(self, payload: dict) -> None:
+        self._out.put(pb.HeadMessage(welcome_back=pb.WelcomeBack(
+            keep_workers=payload.get("keep_workers") or [])))
+
+    def close(self) -> None:
+        self.closed.set()
+        self._out.put(None)
+
+    def _outbound(self) -> Iterator[pb.HeadMessage]:
+        while True:
+            m = self._out.get()
+            if m is None:
+                return
+            yield m
+
+
+class AgentRpcServer:
+    """gRPC server accepting node-agent streams (reference GrpcServer)."""
+
+    def __init__(self, host: str, port: int, authkey: bytes, on_connect):
+        """on_connect(stream, first_msg_tuple) -> bool: the Cluster's
+        registration hook; False rejects the stream."""
+        import grpc
+
+        self._authkey = authkey
+        self._on_connect = on_connect
+        handler = grpc.method_handlers_generic_handler(_SERVICE, {
+            "AgentChannel": grpc.stream_stream_rpc_method_handler(
+                self._channel,
+                request_deserializer=pb.AgentMessage.FromString,
+                response_serializer=pb.HeadMessage.SerializeToString,
+            )})
+        from concurrent.futures import ThreadPoolExecutor
+
+        # 2 threads per agent stream (handler + request reader): cap ~64 agents
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=128, thread_name_prefix="rt-grpc"),
+            options=[("grpc.keepalive_time_ms", 10000),
+                     ("grpc.keepalive_timeout_ms", 10000),
+                     ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 512 * 1024 * 1024)])
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+
+    def _authed(self, context) -> bool:
+        for k, v in context.invocation_metadata():
+            if k == _AUTH_KEY:
+                return hmac.compare_digest(v, self._authkey)
+        return False
+
+    def _channel(self, request_iterator, context):
+        import grpc
+
+        if not self._authed(context):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad cluster authkey")
+        peer = context.peer()  # "ipv4:1.2.3.4:56789"
+        peer_ip = None
+        if peer.startswith(("ipv4:", "ipv6:")):
+            peer_ip = peer.split(":", 1)[1].rsplit(":", 1)[0].strip("[]")
+        stream = AgentStream(peer_ip)
+        try:
+            first = decode_agent_msg(next(request_iterator))
+        except StopIteration:
+            return
+        if not self._on_connect(stream, first):
+            return
+
+        def reader():
+            try:
+                for m in request_iterator:
+                    try:
+                        if stream.on_message is not None:
+                            stream.on_message(decode_agent_msg(m))
+                    except Exception:
+                        # one bad/undecodable message must not silently kill
+                        # the whole node — keep the stream, surface the error
+                        import traceback
+
+                        traceback.print_exc()
+            except Exception:
+                pass  # transport ended: fall through to the death path
+            finally:
+                stream.close()
+                if stream.on_disconnect is not None:
+                    stream.on_disconnect()
+
+        threading.Thread(target=reader, daemon=True,
+                         name="rt-grpc-agent-read").start()
+        yield from stream._outbound()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+# ---- transport: agent-side gRPC client -----------------------------------------
+
+class HeadConnection:
+    """Agent-side stream to the head: send(tuple) out, recv() tuples in."""
+
+    def __init__(self, host: str, port: int, authkey: bytes,
+                 connect_timeout: float = 10.0):
+        import grpc
+
+        self._channel = grpc.insecure_channel(
+            f"{host}:{port}",
+            options=[("grpc.keepalive_time_ms", 10000),
+                     ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 512 * 1024 * 1024)])
+        grpc.channel_ready_future(self._channel).result(timeout=connect_timeout)
+        self._out: "queue.Queue[Optional[pb.AgentMessage]]" = queue.Queue()
+        call = self._channel.stream_stream(
+            _METHOD, request_serializer=pb.AgentMessage.SerializeToString,
+            response_deserializer=pb.HeadMessage.FromString)
+        self._resp = call(iter(self._out.get, None),
+                          metadata=((_AUTH_KEY, authkey),))
+
+    def send(self, msg: tuple) -> None:
+        self._out.put(encode_agent_msg(msg))
+
+    def recv(self) -> tuple:
+        """Next head message; raises EOFError ONLY when the transport ends —
+        a single undecodable message (version skew) is skipped with a
+        traceback rather than tearing down a healthy stream."""
+        while True:
+            try:
+                m = next(self._resp)
+            except StopIteration:
+                raise EOFError("head stream closed")
+            except Exception as e:
+                raise EOFError(f"head stream failed: {e}") from e
+            try:
+                return decode_head_msg(m)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def close(self) -> None:
+        self._out.put(None)
+        try:
+            self._channel.close()
+        except Exception:
+            pass

@@ -315,14 +315,3 @@ class DataClient:
                     pass
 
 
-def peer_ip(conn: Connection) -> Optional[str]:
-    """The remote IP of an accepted control connection (the head combines this
-    with the agent-advertised data port to form the agent's data address)."""
-    try:
-        s = socket.socket(fileno=os.dup(conn.fileno()))
-        try:
-            return s.getpeername()[0]
-        finally:
-            s.close()
-    except Exception:
-        return None

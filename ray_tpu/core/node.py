@@ -212,8 +212,9 @@ class AgentHandle:
     def send(self, msg) -> None:
         if not self.alive:
             raise OSError(f"node agent {self.host_key[:8]} is dead")
-        with self._send_lock:
-            self.conn.send_bytes(cloudpickle.dumps(msg))
+        # typed gRPC stream: tuples encode to protobuf at the transport
+        # boundary (agent_rpc.encode_head_msg); no pickle on agent control
+        self.conn.send(msg)
 
     def call(self, op: str, *args, timeout: float = 60.0):
         """Blocking RPC to the agent (object fetch/store); replies are matched
@@ -430,18 +431,21 @@ class Cluster:
 
     # -- multi-host: node server + agents ----------------------------------------------
     def start_node_server(self, host: str = "127.0.0.1", port: int = 0) -> int:
-        """Listen for node agents joining over TCP (reference: GCS server accepting
-        raylet registrations, gcs_node_manager.h:49). Returns the bound port.
-        Auth: the per-cluster session authkey (same trust domain as the head)."""
-        from multiprocessing.connection import Listener
-
+        """Accept node agents over the TYPED gRPC control plane (reference: GCS
+        server accepting raylet registrations over gRPC, gcs_node_manager.h:49
+        + src/ray/rpc/). Returns the bound port. Auth: the per-cluster session
+        authkey rides the stream metadata; the head never unpickles agent
+        control traffic."""
         from ray_tpu.util.client.server import generate_authkey, load_authkey
 
         if self._node_listener is not None:
             return self.node_server_port
         authkey = load_authkey() or generate_authkey()
-        self._node_listener = Listener((host, port), authkey=authkey)
-        self.node_server_port = self._node_listener.address[1]
+        from . import agent_rpc
+
+        self._node_listener = agent_rpc.AgentRpcServer(
+            host, port, authkey, self._on_agent_stream)
+        self.node_server_port = self._node_listener.port
         # the head's own data plane: agents pull head-resident objects (and the
         # head pulls agent-resident ones) chunked, off the control channel
         from . import data_plane
@@ -449,66 +453,49 @@ class Cluster:
         if self._data_server is None:
             self._data_server = data_plane.DataServer(authkey, object_store.read_raw)
             self._data_client = data_plane.DataClient(authkey)
-        threading.Thread(target=self._accept_agents, daemon=True,
-                         name="rt-node-server").start()
         return self.node_server_port
 
-    def _accept_agents(self) -> None:
-        while not self._shutdown:
-            try:
-                conn = self._node_listener.accept()
-            except (OSError, EOFError):
-                return
-            threading.Thread(target=self._register_agent, args=(conn,),
-                             daemon=True, name="rt-agent-register").start()
-
-    def _register_agent(self, conn) -> None:
+    def _on_agent_stream(self, stream, first: Tuple) -> bool:
+        """A fresh agent stream's first message: register or reregister."""
         try:
-            msg = cloudpickle.loads(conn.recv_bytes())
-            if msg[0] == "reregister":
-                self._reattach_agent(conn, msg)
-                return
-            kind, resources, labels, max_workers = msg[:4]
-            extras = msg[4] if len(msg) > 4 else {}
-            assert kind == "register", kind
+            if first[0] == "register":
+                return self._register_agent(stream, first)
+            if first[0] == "reregister":
+                return self._reattach_agent(stream, first)
         except Exception:
-            try:
-                conn.close()
-            except Exception:
-                pass
-            return
+            import traceback
+
+            traceback.print_exc()
+        return False
+
+    def _register_agent(self, stream, msg) -> bool:
+        _, resources, labels, max_workers, extras = msg
         node_id = NodeID.generate()
         node = RemoteNodeRuntime(self, node_id, resources, labels, max_workers)
-        agent = AgentHandle(self, conn, node)
+        agent = AgentHandle(self, stream, node)
         node.agent = agent
-        data_port = extras.get("data_port")
-        if data_port:
-            from . import data_plane
-
-            ip = data_plane.peer_ip(conn)
-            if ip is not None:
-                agent.data_addr = (ip, int(data_port))
-        welcome = {
-            "node_id": node_id.hex(),
-            "worker_env": dict(self.worker_env),
-            "object_store_memory": self._object_store_capacity,
-        }
+        data_port = (extras or {}).get("data_port")
+        if data_port and stream.peer_ip is not None:
+            agent.data_addr = (stream.peer_ip, int(data_port))
+        stream.on_message = lambda m: self._handle_agent_message(agent, m)
+        stream.on_disconnect = lambda: self._on_agent_death(agent)
         try:
-            conn.send_bytes(cloudpickle.dumps(("welcome", welcome)))
+            stream.send_welcome({
+                "node_id": node_id.hex(),
+                "worker_env": dict(self.worker_env),
+                "object_store_memory": self._object_store_capacity,
+            })
         except Exception:
-            return
+            return False
         with self._lock:
             self._nodes[node_id] = node
             self._node_order.append(node_id)
-            self._agent_conns[conn] = agent
+            self._agent_conns[stream] = agent
             self._agents_by_key[agent.host_key] = agent
         self.gcs.register_node(NodeInfo(node_id=node_id, resources=dict(resources),
                                         labels={**(labels or {}), "agent": "remote"}))
-        try:
-            self._wakeup_w.send_bytes(b"x")  # router picks up the new conn
-        except Exception:
-            pass
         self._schedule()
+        return True
 
     def _on_worker_log(self, agent: AgentHandle, wid_hex: str, stream: str,
                        text: str) -> None:
@@ -531,7 +518,7 @@ class Cluster:
                   file=out)
 
     # -- head restart: agent re-attach (reference NotifyGCSRestart re-sync) -----------
-    def _reattach_agent(self, conn, msg) -> None:
+    def _reattach_agent(self, stream, msg) -> bool:
         """An agent that survived a head restart re-joins with its node id,
         live workers, and arena contents. Rebuild the node, re-add its objects
         to the directory, and rebind journaled detached/named actors to their
@@ -549,15 +536,13 @@ class Cluster:
         if old is not None:
             self._on_agent_death(old)
         node = RemoteNodeRuntime(self, node_id, resources, labels, max_workers)
-        agent = AgentHandle(self, conn, node)
+        agent = AgentHandle(self, stream, node)
         node.agent = agent
         data_port = (extras or {}).get("data_port")
-        if data_port:
-            from . import data_plane
-
-            ip = data_plane.peer_ip(conn)
-            if ip is not None:
-                agent.data_addr = (ip, int(data_port))
+        if data_port and stream.peer_ip is not None:
+            agent.data_addr = (stream.peer_ip, int(data_port))
+        stream.on_message = lambda m: self._handle_agent_message(agent, m)
+        stream.on_disconnect = lambda: self._on_agent_death(agent)
         # journaled actor records for this host, by worker id
         by_wid: Dict[str, Dict[str, Any]] = {}
         for key in self.gcs.kv.keys(namespace="@actors"):
@@ -603,25 +588,21 @@ class Cluster:
                                       bool(flags & 1))))
                 self.store.incref(oid)
         try:
-            conn.send_bytes(cloudpickle.dumps(("welcome_back",
-                                               {"keep_workers": keep})))
+            stream.send_welcome_back({"keep_workers": keep})
         except Exception:
-            return
+            return False
         with self._lock:
             self._nodes[node_id] = node
             if node_id not in self._node_order:
                 self._node_order.append(node_id)
-            self._agent_conns[conn] = agent
+            self._agent_conns[stream] = agent
             self._agents_by_key[node_hex] = agent
         self.gcs.register_node(NodeInfo(node_id=node_id, resources=dict(resources),
                                         labels={**(labels or {}), "agent": "remote"}))
         print(f"[ray_tpu] node {node_hex[:8]} re-attached: {rebound} actors "
               f"rebound, {len((extras or {}).get('objects', ()))} objects re-added")
-        try:
-            self._wakeup_w.send_bytes(b"x")
-        except Exception:
-            pass
         self._schedule()
+        return True
 
     def _journal_actor(self, st: ActorState) -> None:
         """Persist a named/detached actor's placement so a restarted head can
@@ -907,9 +888,11 @@ class Cluster:
             pass
 
     def _router(self) -> None:
+        # local worker pipes only: agent streams are gRPC — their reader
+        # threads call _handle_agent_message / _on_agent_death directly
         while not self._shutdown:
             with self._lock:
-                conns = list(self._conns.keys()) + list(self._agent_conns.keys())
+                conns = list(self._conns.keys())
             ready = multiprocessing.connection.wait([self._wakeup_r] + conns, timeout=1.0)
             for conn in ready:
                 if conn is self._wakeup_r:
@@ -917,21 +900,6 @@ class Cluster:
                         self._wakeup_r.recv_bytes()
                     except Exception:
                         pass
-                    continue
-                with self._lock:
-                    agent = self._agent_conns.get(conn)
-                if agent is not None:
-                    try:
-                        raw = conn.recv_bytes()
-                    except (EOFError, OSError):
-                        self._on_agent_death(agent)
-                        continue
-                    try:
-                        self._handle_agent_message(agent, cloudpickle.loads(raw))
-                    except Exception:
-                        import traceback
-
-                        traceback.print_exc()
                     continue
                 with self._lock:
                     w = self._conns.get(conn)
@@ -1608,7 +1576,7 @@ class Cluster:
                      if now - a.last_heartbeat > timeout]
         for agent in stale:
             try:
-                agent.conn.close()  # router sees EOF and runs _on_agent_death
+                agent.conn.close()  # ends the gRPC stream; reader fires death too
             except Exception:
                 pass
             self._on_agent_death(agent)
@@ -2046,7 +2014,7 @@ class Cluster:
             a.fail_all_pending("cluster shutting down")
         if self._node_listener is not None:
             try:
-                self._node_listener.close()
+                self._node_listener.stop()
             except Exception:
                 pass
         if self._data_server is not None:

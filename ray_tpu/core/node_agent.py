@@ -12,9 +12,11 @@ keeps all scheduling/ownership state; this agent is deliberately thin:
 - owns this host's shared-memory arena and serves raw object fetch/store/free
   requests for the cross-host transfer path (reference object_manager.h:119).
 
-Transport is the same authenticated length-prefixed-pickle channel used by the
-Ray-Client equivalent (multiprocessing.connection with the per-cluster session
-authkey) — the round-2 stand-in for the reference's gRPC planes.
+Transport is a TYPED gRPC bidirectional stream (protos/node_agent.proto;
+reference src/ray/rpc/ + node_manager.proto): the per-cluster session authkey
+rides the stream metadata, control messages are protobuf (the head never
+unpickles agent traffic), and only opaque worker-pipe frames remain pickled —
+they originate and terminate inside the head's own trust domain.
 
 Run with `ray-tpu start --address=HOST:PORT` (scripts/cli.py) or spawn
 `python -m ray_tpu.core.node_agent --address HOST:PORT` directly.
@@ -64,8 +66,11 @@ class NodeAgent:
         self._head_host = head_host
         self._head_port = head_port
         self._authkey = authkey
-        self.conn = multiprocessing.connection.Client(
-            (head_host, head_port), authkey=authkey)
+        # typed gRPC control stream (reference node_manager.proto): tuples
+        # encode to protobuf at the boundary, nothing is pickled on this channel
+        from . import agent_rpc
+
+        self.conn = agent_rpc.HeadConnection(head_host, head_port, authkey)
         # bulk-object plane: a dedicated listener (chunked pulls from peers /
         # the head) + a pooled puller, so object bytes never ride the control
         # connection (reference object_manager.h:119)
@@ -85,13 +90,13 @@ class NodeAgent:
     # -- transport ----------------------------------------------------------------
     def _send(self, msg) -> None:
         with self._send_lock:
-            self.conn.send_bytes(cloudpickle.dumps(msg))
+            self.conn.send(msg)
 
     # -- lifecycle ----------------------------------------------------------------
     def register(self) -> None:
         self._send(("register", self.resources, self.labels, self.max_workers,
                     {"data_port": self._data_server.port}))
-        kind, payload = cloudpickle.loads(self.conn.recv_bytes())
+        kind, payload = self.conn.recv()
         assert kind == "welcome", kind
         self.node_id_hex = payload["node_id"]
         self.worker_env = dict(payload.get("worker_env") or {})
@@ -120,6 +125,10 @@ class NodeAgent:
             self._kill_all_workers()
             self._data_server.close()
             self._data_client.close()
+            try:
+                self.conn.close()
+            except Exception:
+                pass
             from . import object_store
 
             object_store.destroy_arena()
@@ -135,40 +144,60 @@ class NodeAgent:
         keep being tailed for a grace period — a crash's final traceback is
         exactly the output that must not be dropped."""
         offsets: Dict[tuple, int] = {}
+        pending: Dict[tuple, bytes] = {}  # trailing partial line per file
         while not self._shutdown:
             now = time.monotonic()
-            dead = {wid: t for wid, t in self._dead_worker_logs.items()
-                    if now - t < 10.0}
-            self._dead_worker_logs = dead
-            wids = set(self._workers) | set(dead)
+            # mutate in place: a death recorded by the serve-loop thread
+            # between a snapshot and a dict REASSIGNMENT would be lost (and
+            # with it the crash traceback the grace period exists for)
+            for wid, t in list(self._dead_worker_logs.items()):
+                if now - t >= 10.0:
+                    self._dead_worker_logs.pop(wid, None)
+            wids = set(self._workers) | set(self._dead_worker_logs)
             for key in list(offsets):
                 if key[0] not in wids:
                     offsets.pop(key, None)  # drained + grace passed
+                    pending.pop(key, None)
             for wid in wids:
                 for stream in ("out", "err"):
+                    key = (wid, stream)
                     path = os.path.join(self._log_dir, f"worker-{wid}.{stream}")
                     try:
                         size = os.path.getsize(path)
                     except OSError:
                         continue
-                    off = offsets.get((wid, stream), 0)
+                    off = offsets.get(key, 0)
                     while off < size:  # drain the whole backlog this pass
                         try:
                             with open(path, "rb") as f:
                                 f.seek(off)
-                                data = f.read(min(size - off, 65536))
+                                chunk = f.read(min(size - off, 65536))
                         except OSError:
                             break
-                        if not data:
+                        if not chunk:
                             break
-                        off += len(data)
-                        offsets[(wid, stream)] = off
-                        try:
-                            self._send(("worker_log", wid, stream,
-                                        data.decode(errors="replace")))
-                        except Exception:
-                            pass  # head restart in progress: this chunk is lost
+                        off += len(chunk)
+                        offsets[key] = off
+                        # forward COMPLETE lines only: a line (or multi-byte
+                        # codepoint) straddling the read boundary must not be
+                        # split into two messages / mangled to U+FFFD
+                        data = pending.pop(key, b"") + chunk
+                        complete, nl, rest = data.rpartition(b"\n")
+                        if nl:
+                            self._send_log(wid, stream, complete + b"\n")
+                        if rest:
+                            pending[key] = rest
+                    if (pending.get(key) and off >= size
+                            and wid not in self._workers):
+                        # dead worker fully drained: flush its unterminated tail
+                        self._send_log(wid, stream, pending.pop(key))
             time.sleep(0.5)
+
+    def _send_log(self, wid: str, stream: str, data: bytes) -> None:
+        try:
+            self._send(("worker_log", wid, stream, data.decode(errors="replace")))
+        except Exception:
+            pass  # head restart in progress: this chunk is lost
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown:
@@ -179,33 +208,19 @@ class NodeAgent:
             time.sleep(CONFIG.agent_heartbeat_s)
 
     def _serve_loop(self) -> None:
+        """Relay worker pipes; head messages arrive on the gRPC recv thread."""
+        threading.Thread(target=self._head_recv_loop, daemon=True,
+                         name="agent-head-recv").start()
         while not self._shutdown:
             pipes = list(self._pipe_to_wid.keys())
             ready = multiprocessing.connection.wait(
-                [self.conn, self._wakeup_r] + pipes, timeout=1.0)
+                [self._wakeup_r] + pipes, timeout=1.0)
             for c in ready:
                 if c is self._wakeup_r:
                     try:
                         self._wakeup_r.recv_bytes()
                     except Exception:
                         pass
-                    continue
-                if c is self.conn:
-                    try:
-                        raw = self.conn.recv_bytes()
-                    except (EOFError, OSError):
-                        # head is gone: hold workers alive and try to rejoin a
-                        # restarted head (reference: raylets buffering through a
-                        # GCS restart, NotifyGCSRestart / node_manager.proto:316)
-                        if self._reconnect():
-                            continue
-                        return  # reconnect window passed: workers die with us
-                    try:
-                        self._handle_head_message(cloudpickle.loads(raw))
-                    except Exception:
-                        import traceback
-
-                        traceback.print_exc()
                     continue
                 wid = self._pipe_to_wid.get(c)
                 if wid is None:
@@ -218,12 +233,32 @@ class NodeAgent:
                 try:
                     self._send(("from_worker", wid, raw))
                 except Exception:
-                    if self._reconnect():
-                        # the message that failed mid-send is lost; workers
-                        # re-driving requests is the old head's clients'
-                        # problem, not this relay's
-                        continue
+                    pass  # head restart in flight: the recv loop reconnects
+
+    def _head_recv_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                msg = self.conn.recv()
+            except EOFError:
+                # head is gone: hold workers alive and try to rejoin a
+                # restarted head (reference: raylets buffering through a GCS
+                # restart, NotifyGCSRestart / node_manager.proto:316)
+                if self._shutdown:
                     return
+                if self._reconnect():
+                    continue
+                self._shutdown = True  # reconnect window passed: workers die
+                try:
+                    self._wakeup_w.send_bytes(b"x")
+                except Exception:
+                    pass
+                return
+            try:
+                self._handle_head_message(msg)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
 
     # -- head-restart recovery ------------------------------------------------------
     def _reconnect(self) -> bool:
@@ -237,10 +272,13 @@ class NodeAgent:
             pass
         deadline = time.monotonic() + CONFIG.agent_reconnect_timeout_s
         delay = 0.3
+        from . import agent_rpc
+
         while not self._shutdown and time.monotonic() < deadline:
             try:
-                conn = multiprocessing.connection.Client(
-                    (self._head_host, self._head_port), authkey=self._authkey)
+                conn = agent_rpc.HeadConnection(
+                    self._head_host, self._head_port, self._authkey,
+                    connect_timeout=min(5.0, delay * 4))
             except Exception:
                 time.sleep(min(delay, max(0.05, deadline - time.monotonic())))
                 delay = min(delay * 2, 3.0)
@@ -273,14 +311,14 @@ class NodeAgent:
                self.max_workers,
                {"data_port": self._data_server.port, "arena": arena_name,
                 "workers": workers, "objects": objects})
-        # swap + first send atomically: the heartbeat thread must not slip a
-        # ("heartbeat", ts) in as the new connection's first message — the
-        # head parses the first frame as the (re)register handshake
+        # first send BEFORE the swap: the heartbeat thread must not slip a
+        # ("heartbeat", ts) in as the new stream's first message — the head
+        # treats the first frame as the (re)register handshake
+        conn.send(msg)
+        kind, payload = conn.recv()
+        assert kind == "welcome_back", kind
         with self._send_lock:
             self.conn = conn
-            conn.send_bytes(cloudpickle.dumps(msg))
-        kind, payload = cloudpickle.loads(self.conn.recv_bytes())
-        assert kind == "welcome_back", kind
         # the restarted head kept only the workers it could rebind (journaled
         # detached/named actors); the rest ran tasks whose callers died with
         # the old head — kill them so their results don't relay into a void

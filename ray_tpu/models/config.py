@@ -134,6 +134,23 @@ register_config(
     )
 )
 register_config(
+    # llama3-8b LAYER GEOMETRY at single-chip depth: the realistic
+    # arithmetic-intensity regime (d_model 4096, GQA 32/8, d_ff 14336) for
+    # one-chip MFU benchmarking without 8B-scale optimizer state. 2 layers +
+    # the 32k vocab keep f32 Adam + remat activations inside one v5e's HBM.
+    ModelConfig(
+        name="llama8b-geom2",
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=2,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        max_seq_len=2048,
+        rope_theta=500000.0,
+    )
+)
+register_config(
     ModelConfig(
         name="llama3-8b",
         vocab_size=128256,

@@ -102,6 +102,8 @@ def list_logs() -> List[Dict[str, Any]]:
 def get_log(worker_id: str, tail: int = 100) -> List[str]:
     """Last `tail` captured lines of one remote worker ("out|err: line")."""
     c = _cluster()
+    if tail <= 0:
+        return []
     with c._worker_logs_lock:
         ring = c._worker_logs.get(worker_id)
         lines = list(ring["lines"]) if ring is not None else []
