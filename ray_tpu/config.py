@@ -99,6 +99,20 @@ _FLAGS: List[Flag] = [
          "(never leaves the machine)."),
     Flag("lp_debug", "RAY_TPU_LP_DEBUG", "bool", False,
          "Verbose serve long-poll client logging."),
+    # -- data (DataContext defaults; per-driver overrides via DataContext)
+    Flag("data_max_inflight_tasks_per_op", "RAY_TPU_DATA_MAX_INFLIGHT_TASKS_PER_OP",
+         "int", 8,
+         "Streaming-executor backpressure: tasks in flight per operator "
+         "(reference backpressure_policy concurrency caps)."),
+    Flag("data_actor_pool_max_size", "RAY_TPU_DATA_ACTOR_POOL_MAX_SIZE", "int", 4,
+         "Default actor-pool size for map_batches(Class) stages."),
+    Flag("data_read_op_min_num_blocks", "RAY_TPU_DATA_READ_OP_MIN_NUM_BLOCKS",
+         "int", 8,
+         "Default read parallelism when the datasource does not dictate one."),
+    # -- serve
+    Flag("serve_replica_wait_s", "RAY_TPU_SERVE_REPLICA_WAIT_S", "float", 30.0,
+         "How long a handle call waits for a live replica before failing "
+         "(reference handle resolution timeout)."),
     # -- train
     Flag("train_v2_enabled", "RAY_TPU_TRAIN_V2_ENABLED", "bool", False,
          "Route trainers through the v2 controller (FailurePolicy/"

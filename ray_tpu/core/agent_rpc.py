@@ -256,9 +256,15 @@ class AgentStream:
     """Head-side view of one connected agent stream (Connection-ish: the
     Cluster hands tuples to send(); incoming tuples flow to its callback)."""
 
+    # bounded outbound buffers: a stalled/dead peer must exert BACKPRESSURE
+    # (send raises after the grace) instead of accumulating frames in RAM
+    QUEUE_DEPTH = 4096
+    SEND_TIMEOUT_S = 30.0
+
     def __init__(self, peer_ip: Optional[str]):
         self.peer_ip = peer_ip
-        self._out: "queue.Queue[Optional[pb.HeadMessage]]" = queue.Queue()
+        self._out: "queue.Queue[Optional[pb.HeadMessage]]" = queue.Queue(
+            maxsize=self.QUEUE_DEPTH)
         self.closed = threading.Event()
         # set by the Cluster during on_connect, before the reader starts
         self.on_message = None
@@ -267,7 +273,10 @@ class AgentStream:
     def send(self, msg: tuple) -> None:
         if self.closed.is_set():
             raise OSError("agent stream closed")
-        self._out.put(encode_head_msg(msg))
+        try:
+            self._out.put(encode_head_msg(msg), timeout=self.SEND_TIMEOUT_S)
+        except queue.Full:
+            raise OSError("agent stream backed up (peer stalled)")
 
     def send_welcome(self, payload: dict) -> None:
         self._out.put(pb.HeadMessage(welcome=pb.Welcome(
@@ -279,12 +288,16 @@ class AgentStream:
             keep_workers=payload.get("keep_workers") or [])))
 
     def close(self) -> None:
-        self.closed.set()
-        self._out.put(None)
+        self.closed.set()  # _outbound notices within its poll slice
 
     def _outbound(self) -> Iterator[pb.HeadMessage]:
         while True:
-            m = self._out.get()
+            try:
+                m = self._out.get(timeout=0.5)
+            except queue.Empty:
+                if self.closed.is_set():
+                    return
+                continue
             if m is None:
                 return
             yield m
@@ -384,15 +397,36 @@ class HeadConnection:
                      ("grpc.max_receive_message_length", 512 * 1024 * 1024),
                      ("grpc.max_send_message_length", 512 * 1024 * 1024)])
         grpc.channel_ready_future(self._channel).result(timeout=connect_timeout)
-        self._out: "queue.Queue[Optional[pb.AgentMessage]]" = queue.Queue()
+        # bounded for backpressure: a dead/stalled head makes send() RAISE
+        # after the grace instead of buffering frames into a void
+        self._out: "queue.Queue[Optional[pb.AgentMessage]]" = queue.Queue(
+            maxsize=AgentStream.QUEUE_DEPTH)
+        self._closed = threading.Event()
         call = self._channel.stream_stream(
             _METHOD, request_serializer=pb.AgentMessage.SerializeToString,
             response_deserializer=pb.HeadMessage.FromString)
-        self._resp = call(iter(self._out.get, None),
-                          metadata=((_AUTH_KEY, authkey),))
+        self._resp = call(self._requests(), metadata=((_AUTH_KEY, authkey),))
+
+    def _requests(self):
+        while True:
+            try:
+                m = self._out.get(timeout=0.5)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return
+                continue
+            if m is None:
+                return
+            yield m
 
     def send(self, msg: tuple) -> None:
-        self._out.put(encode_agent_msg(msg))
+        if self._closed.is_set():
+            raise OSError("head stream closed")
+        try:
+            self._out.put(encode_agent_msg(msg),
+                          timeout=AgentStream.SEND_TIMEOUT_S)
+        except queue.Full:
+            raise OSError("head stream backed up (head stalled)")
 
     def recv(self) -> tuple:
         """Next head message; raises EOFError ONLY when the transport ends —
@@ -413,7 +447,7 @@ class HeadConnection:
                 traceback.print_exc()
 
     def close(self) -> None:
-        self._out.put(None)
+        self._closed.set()
         try:
             self._channel.close()
         except Exception:

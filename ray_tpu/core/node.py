@@ -39,6 +39,7 @@ from .placement_group import PlacementGroup, PlacementGroupManager
 from .resources import ResourceLedger
 from .task_spec import (
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     TaskSpec,
 )
@@ -204,7 +205,6 @@ class AgentHandle:
         # (ip, port) of the agent's DataServer; None = old agent, relay only
         self.data_addr: Optional[Tuple[str, int]] = None
         self.workers: Dict[str, RemoteWorkerHandle] = {}  # wid_hex -> handle
-        self._send_lock = threading.Lock()
         self._req_counter = itertools.count()
         self._pending: Dict[int, list] = {}  # req_id -> [Event, ok, value]
         self._pending_lock = threading.Lock()
@@ -1243,6 +1243,9 @@ class Cluster:
             return None
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             skey = ("affinity", strategy.node_id, strategy.soft)
+        elif isinstance(strategy, NodeLabelSchedulingStrategy):
+            # dict-bearing dataclass is unhashable; repr is stable per shape
+            skey = ("labels", repr(strategy.hard), repr(strategy.soft))
         else:
             skey = (strategy,)
         return (spec.kind, skey, tuple(sorted(spec.resources.items())))
@@ -1445,6 +1448,18 @@ class Cluster:
                     self._fail_returns(spec, WorkerCrashedError(f"node {strategy.node_id} unavailable"))
                 return None
             # soft: fall through to default
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            # reference scheduling_strategies.py:135: hard terms filter, soft
+            # terms rank; no hard match -> wait (a labeled node may join later)
+            candidates = [n for n in self.nodes() if strategy.hard_match(n.labels)]
+            if not candidates:
+                return None
+            candidates.sort(key=lambda n: (not strategy.soft_match(n.labels),
+                                           n.ledger.utilization()))
+            for node in candidates:
+                if node.ledger.try_acquire(resources):
+                    return node, node.ledger, resources
+            return None
         nodes = self.nodes()
         if not nodes:
             return None

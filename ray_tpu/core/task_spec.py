@@ -29,6 +29,68 @@ class SpreadSchedulingStrategy:
     pass
 
 
+# -- label match expressions (reference python/ray/util/scheduling_strategies.py:135:
+#    NodeLabelSchedulingStrategy with In/NotIn/Exists/DoesNotExist terms) ------------
+
+@dataclass
+class In:
+    values: tuple
+
+    def __init__(self, *values: str):
+        object.__setattr__(self, "values", tuple(values))
+
+    def matches(self, present: bool, value) -> bool:
+        return present and value in self.values
+
+
+@dataclass
+class NotIn:
+    values: tuple
+
+    def __init__(self, *values: str):
+        object.__setattr__(self, "values", tuple(values))
+
+    def matches(self, present: bool, value) -> bool:
+        # an absent label trivially is "not in" the given values
+        return not present or value not in self.values
+
+
+@dataclass
+class Exists:
+    def matches(self, present: bool, value) -> bool:
+        return present
+
+
+@dataclass
+class DoesNotExist:
+    def matches(self, present: bool, value) -> bool:
+        return not present
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes whose labels satisfy every `hard` expression,
+    preferring nodes that also satisfy `soft` (reference
+    scheduling_strategies.py:135). No hard match -> the task waits (a node
+    with the label may join later)."""
+
+    hard: Optional[Dict[str, Any]] = None
+    soft: Optional[Dict[str, Any]] = None
+
+    @staticmethod
+    def _match(exprs: Optional[Dict[str, Any]], labels: Dict[str, str]) -> bool:
+        for key, expr in (exprs or {}).items():
+            if not expr.matches(key in labels, labels.get(key)):
+                return False
+        return True
+
+    def hard_match(self, labels: Dict[str, str]) -> bool:
+        return self._match(self.hard, labels)
+
+    def soft_match(self, labels: Dict[str, str]) -> bool:
+        return self._match(self.soft, labels)
+
+
 # "DEFAULT" | "SPREAD" | NodeAffinitySchedulingStrategy | PlacementGroupSchedulingStrategy
 SchedulingStrategyT = Any
 

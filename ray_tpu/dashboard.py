@@ -7,9 +7,12 @@ than the reference's React app — something a human can actually look at withou
 a node toolchain in the image.
 
 Endpoints:
-    GET /                   human-facing dashboard (auto-refreshing tables)
+    GET /                   human-facing dashboard (auto-refreshing tables,
+                            worker-log browser, task timeline lanes)
     GET /api/summary        cluster summary
     GET /api/nodes|workers|actors|tasks|objects|placement_groups
+    GET /api/logs           remote-worker log index
+    GET /api/log?worker_id=&tail=  one worker's captured lines
     GET /api/timeline       chrome-trace JSON
     GET /metrics            Prometheus exposition text
 """
@@ -36,6 +39,13 @@ _INDEX_HTML = """<!doctype html>
   .stat { background: #1a2129; padding: .5rem .9rem; border-radius: .4rem; }
   .stat b { display: block; font-size: 1.2rem; }
   small { color: #7b8794; }
+  pre { background: #0b0e12; padding: .5rem; font-size: .75rem; overflow-x: auto; }
+  details summary { cursor: pointer; font-size: .85rem; margin: .2rem 0; }
+  .lane { position: relative; height: 14px; margin: 2px 0 2px 0;
+          background: #161c23; }
+  .lane small { position: absolute; left: 2px; z-index: 1; }
+  .bar { position: absolute; top: 2px; height: 10px; background: #2f81f7;
+         border-radius: 2px; }
 </style></head>
 <body>
 <h1>ray_tpu dashboard <small id="ts"></small></h1>
@@ -61,6 +71,37 @@ function table(rows) {
       "<tr>" + cols.map(c => `<td>${cell(r[c])}</td>`).join("") + "</tr>").join("") +
     "</table>" + (rows.length > 200 ? `<small>showing 200 of ${rows.length}</small>` : "");
 }
+async function logsSection() {
+  const idx = await (await fetch("/api/logs")).json();
+  if (!idx.length) return "<h2>worker logs</h2><small>(none captured)</small>";
+  let html = `<h2>worker logs (${idx.length} workers)</h2>`;
+  for (const e of idx.slice(0, 20)) {
+    const lines = await (await fetch(
+      `/api/log?worker_id=${e.worker_id}&tail=30`)).json();
+    html += `<details><summary>${esc(e.worker_id.slice(0, 12))} ` +
+      `on ${esc(e.node_id.slice(0, 12))} (${e.num_lines} lines)</summary>` +
+      `<pre>${lines.map(esc).join("\\n")}</pre></details>`;
+  }
+  return html;
+}
+function timelineSection(events) {
+  // chrome-trace "X" events -> one lane per worker, bars scaled to the span
+  const xs = events.filter(e => e.ph === "X" && e.dur > 0);
+  if (!xs.length) return "<h2>timeline</h2><small>(no finished tasks)</small>";
+  const t0 = Math.min(...xs.map(e => e.ts)), t1 = Math.max(...xs.map(e => e.ts + e.dur));
+  const span = Math.max(t1 - t0, 1);
+  const lanes = {};
+  for (const e of xs.slice(-300)) (lanes[e.tid] = lanes[e.tid] || []).push(e);
+  let html = `<h2>timeline <small>(${xs.length} tasks, ` +
+    `${(span / 1e6).toFixed(2)}s span)</small></h2>`;
+  for (const [tid, evs] of Object.entries(lanes)) {
+    html += `<div class="lane"><small>${esc(String(tid).slice(0, 12))}</small>` +
+      evs.map(e => `<span class="bar" title="${esc(e.name)} ` +
+        `${(e.dur / 1e3).toFixed(1)}ms" style="left:${(e.ts - t0) / span * 100}%;` +
+        `width:${Math.max(e.dur / span * 100, .3)}%"></span>`).join("") + "</div>";
+  }
+  return html;
+}
 async function refresh() {
   try {
     const s = await (await fetch("/api/summary")).json();
@@ -72,6 +113,9 @@ async function refresh() {
       const rows = await (await fetch("/api/" + t)).json();
       parts.push(`<h2>${t} (${rows.length})</h2>` + table(rows));
     }
+    parts.push(await logsSection());
+    const tl = await (await fetch("/api/timeline")).json();
+    parts.push(timelineSection(tl));
     document.getElementById("tables").innerHTML = parts.join("");
     document.getElementById("ts").textContent = new Date().toLocaleTimeString();
   } catch (e) {
@@ -121,6 +165,12 @@ class Dashboard:
                 return web.json_response(st.summarize_cluster())
             if name == "timeline":
                 return web.json_response(st.timeline())
+            if name == "logs":
+                return web.json_response(st.list_logs())
+            if name == "log":
+                wid = request.query.get("worker_id", "")
+                tail = int(request.query.get("tail", "100"))
+                return web.json_response(st.get_log(wid, tail=tail))
             fn = tables.get(name)
             if fn is None:
                 return web.Response(status=404, text=f"unknown table {name}")

@@ -8,17 +8,26 @@ import dataclasses
 from typing import Optional
 
 
+def _flag(name: str):
+    from ray_tpu.config import CONFIG
+
+    return getattr(CONFIG, name)
+
+
 @dataclasses.dataclass
 class DataContext:
     target_max_block_size: int = 128 * 1024 * 1024
     target_min_block_size: int = 1 * 1024 * 1024
     default_batch_size: int = 1024
-    read_op_min_num_blocks: int = 8
+    read_op_min_num_blocks: int = dataclasses.field(
+        default_factory=lambda: _flag("data_read_op_min_num_blocks"))
     # Streaming executor backpressure: max block refs buffered between operators.
-    max_inflight_tasks_per_op: int = 8
+    max_inflight_tasks_per_op: int = dataclasses.field(
+        default_factory=lambda: _flag("data_max_inflight_tasks_per_op"))
     op_output_buffer_limit: int = 16
     actor_pool_min_size: int = 1
-    actor_pool_max_size: int = 4
+    actor_pool_max_size: int = dataclasses.field(
+        default_factory=lambda: _flag("data_actor_pool_max_size"))
     use_push_based_shuffle: bool = False
     enable_progress_bars: bool = False
     seed: Optional[int] = None

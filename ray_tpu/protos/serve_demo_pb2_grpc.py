@@ -1,0 +1,38 @@
+"""gRPC registration glue for serve_demo.proto (what grpc_tools.protoc's
+grpc_python plugin would emit; the build image has no plugin, so this is
+hand-maintained — same shape, nothing more)."""
+import grpc
+
+from ray_tpu.protos import serve_demo_pb2 as pb
+
+
+def add_EchoServiceServicer_to_server(servicer, server):
+    rpc_method_handlers = {
+        "Echo": grpc.unary_unary_rpc_method_handler(
+            servicer.Echo,
+            request_deserializer=pb.EchoRequest.FromString,
+            response_serializer=pb.EchoReply.SerializeToString,
+        ),
+        "Double": grpc.unary_unary_rpc_method_handler(
+            servicer.Double,
+            request_deserializer=pb.EchoRequest.FromString,
+            response_serializer=pb.EchoReply.SerializeToString,
+        ),
+    }
+    handler = grpc.method_handlers_generic_handler(
+        "rt_serve_demo.EchoService", rpc_method_handlers)
+    server.add_generic_rpc_handlers((handler,))
+
+
+class EchoServiceStub:
+    def __init__(self, channel):
+        self.Echo = channel.unary_unary(
+            "/rt_serve_demo.EchoService/Echo",
+            request_serializer=pb.EchoRequest.SerializeToString,
+            response_deserializer=pb.EchoReply.FromString,
+        )
+        self.Double = channel.unary_unary(
+            "/rt_serve_demo.EchoService/Double",
+            request_serializer=pb.EchoRequest.SerializeToString,
+            response_deserializer=pb.EchoReply.FromString,
+        )

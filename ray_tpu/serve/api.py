@@ -58,8 +58,10 @@ def start(http_options: Optional[Dict[str, Any]] = None,
     if grpc_options is not None:
         from .grpc_proxy import start_grpc_proxy
 
-        _, port = start_grpc_proxy(grpc_options.get("host", "127.0.0.1"),
-                                   grpc_options.get("port", 9000))
+        _, port = start_grpc_proxy(
+            grpc_options.get("host", "127.0.0.1"),
+            grpc_options.get("port", 9000),
+            grpc_options.get("grpc_servicer_functions"))
         return {"grpc_port": port}
     return None
 

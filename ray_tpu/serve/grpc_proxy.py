@@ -1,19 +1,28 @@
 """gRPC ingress proxy.
 
 Capability parity: reference python/ray/serve/_private/proxy.py:523 (gRPCProxy —
-per-node grpc.aio ingress routing to deployment handles). Design difference: the
-reference requires user-compiled protos; here one generic unary-unary service
-(`rayserve.Generic/Call`) carries a JSON envelope {app, method, args, kwargs},
-so any client with grpcio can call any deployment without codegen. JSON (not
-pickle) is deliberate: the ingress deserializes untrusted network bytes.
-`serve.start(grpc_options={"port": N})` brings it up; `grpc_call(address, app,
-...)` is the matching client helper.
+per-node ingress serving USER-DEFINED protobuf services next to deployment
+handles). Two surfaces:
+
+1. **User protobuf services** (reference parity): pass the generated
+   ``add_XServicer_to_server`` functions via
+   ``serve.start(grpc_options={"port": N, "grpc_servicer_functions": [...]})``.
+   Each RPC method routes to the deployment method of the SAME name; the target
+   application rides the call metadata key ``application`` (single running app =
+   implicit default). The deployment receives the deserialized request message
+   and returns the response message — typed end to end, no JSON.
+2. A generic unary-unary service (`rayserve.Generic/Call`) carrying a JSON
+   envelope {app, method, args, kwargs}, so any grpcio client can call any
+   deployment without codegen. JSON (not pickle) is deliberate: the ingress
+   deserializes untrusted network bytes.
+
+Unary RPCs only (streaming gRPC ingress is not implemented).
 """
 from __future__ import annotations
 
 import json
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
 
@@ -21,10 +30,29 @@ SERVICE = "rayserve.Generic"
 METHOD = "Call"
 
 
+class _RoutingServicer:
+    """Stands in for a user's Servicer: every RPC method the generated
+    ``add_XServicer_to_server`` looks up resolves to a router that forwards the
+    request message to the deployment method of the same name."""
+
+    def __init__(self, route):
+        self._route = route
+
+    def __getattr__(self, method_name: str):
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+
+        def handler(request, context):
+            return self._route(method_name, request, context)
+
+        return handler
+
+
 class GrpcProxyActor:
     """Per-node gRPC ingress (reference gRPCProxy)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000,
+                 grpc_servicer_functions: Optional[List[Any]] = None):
         from concurrent.futures import ThreadPoolExecutor
 
         import grpc
@@ -50,34 +78,71 @@ class GrpcProxyActor:
                     self._handles[key] = handle
             return result
 
+        def route_with_retry(app: str, method: str, args, kwargs):
+            try:
+                return route(app, method, args, kwargs)
+            except Exception:
+                with self._handles_lock:
+                    was_cached = self._handles.pop((app, method), None) is not None
+                if not was_cached:
+                    raise  # fresh handle: a user-code error, never retried
+                # the CACHED handle may be stale (app deleted/redeployed):
+                # retry once against a freshly resolved one. User methods may
+                # run twice only in the stale-cache window — same contract as
+                # the reference proxy's retry-on-unavailable-replica.
+                return route(app, method, args, kwargs)
+
         def call(request: bytes, context) -> bytes:
             try:
                 req = json.loads(request)
                 app = req["app"]
                 method = req.get("method") or "__call__"
-                args = req.get("args") or []
-                kwargs = req.get("kwargs") or {}
-                try:
-                    result = route(app, method, args, kwargs)
-                except Exception:
-                    with self._handles_lock:
-                        was_cached = self._handles.pop((app, method), None) is not None
-                    if not was_cached:
-                        raise  # fresh handle: a user-code error, never retried
-                    # the CACHED handle may be stale (app deleted/redeployed):
-                    # retry once against a freshly resolved one. User methods may
-                    # run twice only in the stale-cache window — same contract as
-                    # the reference proxy's retry-on-unavailable-replica.
-                    result = route(app, method, args, kwargs)
+                result = route_with_retry(app, method, req.get("args") or [],
+                                          req.get("kwargs") or {})
                 return json.dumps({"ok": True, "result": result}).encode()
             except Exception as e:  # noqa: BLE001
                 return json.dumps({"ok": False, "error": repr(e)}).encode()
+
+        def route_typed(method_name: str, request, context):
+            """User-proto RPC -> deployment method of the same name. The app
+            comes from call metadata ('application'); with exactly one running
+            app it is implicit (reference proxy.py:523 routing)."""
+            import grpc as _grpc
+
+            app = None
+            for k, v in context.invocation_metadata():
+                if k == "application":
+                    app = v
+            if app is None:
+                from . import api
+
+                try:
+                    apps = sorted(api.status())
+                except Exception as e:  # noqa: BLE001
+                    context.abort(_grpc.StatusCode.INTERNAL, repr(e))
+                if len(apps) != 1:
+                    # abort OUTSIDE the routing try: its control-flow exception
+                    # must not be re-wrapped as INTERNAL
+                    context.abort(
+                        _grpc.StatusCode.INVALID_ARGUMENT,
+                        f"metadata 'application' required ({len(apps)} apps "
+                        "running)")
+                app = apps[0]
+            try:
+                return route_with_retry(app, method_name, (request,), {})
+            except Exception as e:  # noqa: BLE001 — surface as gRPC status
+                context.abort(_grpc.StatusCode.INTERNAL, repr(e))
 
         rpc = grpc.unary_unary_rpc_method_handler(
             call, request_deserializer=None, response_serializer=None)
         handler = grpc.method_handlers_generic_handler(SERVICE, {METHOD: rpc})
         self._server = grpc.server(ThreadPoolExecutor(max_workers=16))
         self._server.add_generic_rpc_handlers((handler,))
+        # user-defined protobuf services (reference grpc_servicer_functions):
+        # each generated add_XServicer_to_server registers its method table
+        # against a router that forwards typed messages to deployments
+        for add_fn in grpc_servicer_functions or ():
+            add_fn(_RoutingServicer(route_typed), self._server)
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         if self.port == 0:
             raise OSError(f"gRPC proxy failed to bind {host}:{port}")
@@ -110,16 +175,19 @@ def grpc_call(address: str, app: str, *args, method: Optional[str] = None, **kwa
 _GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 
-def start_grpc_proxy(host: str = "127.0.0.1", port: int = 9000):
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 9000,
+                     grpc_servicer_functions: Optional[List[Any]] = None):
     """Get-or-create the gRPC ingress actor; returns (handle, bound_port).
 
-    If a proxy already exists, its existing bound port is returned and the
-    host/port arguments are ignored (one ingress per cluster, like the HTTP
-    proxy's get-or-create)."""
+    grpc_servicer_functions: generated ``add_XServicer_to_server`` functions
+    for user protobuf services (must be importable by workers — generated
+    modules are). If a proxy already exists, its existing bound port is
+    returned and all arguments are ignored (one ingress per cluster, like the
+    HTTP proxy's get-or-create)."""
     try:
         proxy = ray_tpu.get_actor(_GRPC_PROXY_NAME)
     except ValueError:
         cls = ray_tpu.remote(num_cpus=0.1, name=_GRPC_PROXY_NAME,
                              lifetime="detached")(GrpcProxyActor)
-        proxy = cls.remote(host, port)
+        proxy = cls.remote(host, port, grpc_servicer_functions)
     return proxy, ray_tpu.get(proxy.ready.remote())

@@ -298,7 +298,9 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         self._ensure_metrics_push()
         _ensure_long_poll(self.app_name, self.deployment_name)
-        deadline = time.time() + 30.0
+        from ray_tpu.config import CONFIG
+
+        deadline = time.time() + CONFIG.serve_replica_wait_s
         while True:
             self._refresh()
             if self._replicas:

@@ -1,0 +1,25 @@
+"""Scheduling strategies (reference python/ray/util/scheduling_strategies.py).
+
+Import-path parity: ``from ray_tpu.util.scheduling_strategies import ...``.
+"""
+from ray_tpu.core.task_spec import (  # noqa: F401
+    DoesNotExist,
+    Exists,
+    In,
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    NotIn,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "SpreadSchedulingStrategy",
+    "In",
+    "NotIn",
+    "Exists",
+    "DoesNotExist",
+]

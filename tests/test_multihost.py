@@ -554,3 +554,64 @@ def test_remote_worker_logs_stream_to_driver(two_hosts, capsys):
     captured = capsys.readouterr()
     assert any(marker in ln and f"node={remote_id[:8]}" in ln
                for ln in captured.out.splitlines())
+
+
+def test_node_label_scheduling(rt):
+    """NodeLabelSchedulingStrategy (reference scheduling_strategies.py:135):
+    hard label terms filter nodes, soft terms rank; an unmatched hard term
+    leaves the task pending until a matching node joins."""
+    from ray_tpu.util.scheduling_strategies import (
+        DoesNotExist, In, NodeLabelSchedulingStrategy)
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, node_server_port=0,
+                 worker_env={"JAX_PLATFORMS": "cpu"})
+    cluster = global_state.try_cluster()
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent",
+         "--address", f"127.0.0.1:{cluster.node_server_port}",
+         "--num-cpus", "2", "--label", "zone=eu", "--label", "tier=batch"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        _wait_nodes(2)
+        labeled_id = _remote_node_id()
+
+        @ray_tpu.remote(num_cpus=0.1)
+        def where():
+            return ray_tpu.get_runtime_context().node_id
+
+        on_eu = where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": In("eu", "eu-west")}))
+        assert ray_tpu.get(on_eu.remote(), timeout=60) == labeled_id
+        off_eu = where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": DoesNotExist()}))
+        assert ray_tpu.get(off_eu.remote(), timeout=60) != labeled_id
+        # soft preference ranks the labeled node first but never blocks
+        soft = where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+            soft={"tier": In("batch")}))
+        assert ray_tpu.get(soft.remote(), timeout=60) == labeled_id
+        # unmatched hard term -> pending until a matching node joins
+        mars = where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": In("mars")}))
+        ref = mars.remote()
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=2.0)
+        assert not ready  # still pending, not failed
+        agent2 = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_agent",
+             "--address", f"127.0.0.1:{cluster.node_server_port}",
+             "--num-cpus", "2", "--label", "zone=mars"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            assert ray_tpu.get(ref, timeout=60)  # lands once the node exists
+        finally:
+            agent2.terminate()
+            agent2.wait(timeout=10)
+    finally:
+        agent.terminate()
+        try:
+            agent.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            agent.kill()
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)

@@ -306,3 +306,40 @@ def test_grpc_proxy_ingress(rt):
     serve.run(Calc2.bind(), name="calc")
     assert grpc_call(addr, "calc", 40) == 42
     assert start_grpc_proxy(port=0)[1] == port  # get-or-create returns the live port
+
+
+def test_grpc_user_protobuf_service(rt):
+    """Reference proxy.py:523 parity: a USER-DEFINED protobuf service served by
+    the gRPC ingress — each RPC routes the typed request message to the
+    deployment method of the same name; the app rides call metadata."""
+    import grpc
+
+    from ray_tpu.protos import serve_demo_pb2 as pb
+    from ray_tpu.protos.serve_demo_pb2_grpc import (
+        EchoServiceStub, add_EchoServiceServicer_to_server)
+
+    @serve.deployment(num_replicas=1)
+    class Echoer:
+        def Echo(self, req):
+            return pb.EchoReply(text=f"echo:{req.text}", n=req.n)
+
+        def Double(self, req):
+            return pb.EchoReply(text=req.text, n=req.n * 2)
+
+    info = serve.start(grpc_options={
+        "port": 0,
+        "grpc_servicer_functions": [add_EchoServiceServicer_to_server]})
+    serve.run(Echoer.bind(), name="echoer")
+    with grpc.insecure_channel(f"127.0.0.1:{info['grpc_port']}") as ch:
+        stub = EchoServiceStub(ch)
+        # explicit application metadata
+        reply = stub.Echo(pb.EchoRequest(text="hi", n=3),
+                          metadata=(("application", "echoer"),), timeout=60)
+        assert reply.text == "echo:hi" and reply.n == 3
+        # single running app: metadata optional
+        reply2 = stub.Double(pb.EchoRequest(text="x", n=21), timeout=60)
+        assert reply2.n == 42
+        # unknown app -> gRPC error status, not a hang
+        with pytest.raises(grpc.RpcError):
+            stub.Echo(pb.EchoRequest(text="x"),
+                      metadata=(("application", "nope"),), timeout=60)
