@@ -1,4 +1,17 @@
-"""Lazy g++ build of the native libraries, cached by source mtime."""
+"""Lazy g++ build of the native libraries, cached by source mtime.
+
+Sanitizer seams (reference: ray's BUILD.bazel asan/tsan configs + ci/ sanitizer
+jobs): set RAY_TPU_SANITIZE=address|thread|undefined to rebuild every native
+library under that sanitizer in a separate artifact (lib<stem>.asan.so, ...),
+so an instrumented test run never poisons the cached production .so.
+
+ASan/TSan caveat: dlopen-ing an instrumented .so into an uninstrumented python
+requires the sanitizer runtime loaded FIRST —
+    LD_PRELOAD=$(g++ -print-file-name=libasan.so) RAY_TPU_SANITIZE=address pytest ...
+load_library detects the missing preload and raises with that exact command.
+The primary sanitizer path (and what ci.yml runs) is the standalone stress
+binary shm_store_stress.cc, which needs no preload.
+"""
 from __future__ import annotations
 
 import ctypes
@@ -10,26 +23,55 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
 _CACHE: dict = {}
 
+_SANITIZERS = {
+    "address": ("asan", ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"]),
+    "thread": ("tsan", ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g"]),
+    "undefined": ("ubsan", ["-fsanitize=undefined", "-g"]),
+}
+
 
 class NativeBuildError(RuntimeError):
     pass
 
 
+def sanitizer_mode() -> str:
+    return os.environ.get("RAY_TPU_SANITIZE", "")
+
+
 def load_library(stem: str, extra_flags=()) -> ctypes.CDLL:
     """Compile <stem>.cc to lib<stem>.so if stale, then dlopen it."""
+    sanitize = sanitizer_mode()
+    suffix, san_flags = "", []
+    if sanitize:
+        if sanitize not in _SANITIZERS:
+            raise NativeBuildError(
+                f"RAY_TPU_SANITIZE={sanitize!r}: expected one of {sorted(_SANITIZERS)}")
+        tag, san_flags = _SANITIZERS[sanitize]
+        suffix = f".{tag}"
+    key = stem + suffix
     with _LOCK:
-        if stem in _CACHE:
-            return _CACHE[stem]
+        if key in _CACHE:
+            return _CACHE[key]
         src = os.path.join(_DIR, f"{stem}.cc")
-        so = os.path.join(_DIR, f"lib{stem}.so")
+        so = os.path.join(_DIR, f"lib{stem}{suffix}.so")
         if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
             tmp = so + f".tmp.{os.getpid()}"
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
-                   "-lpthread", "-lrt", *extra_flags]
+                   "-lpthread", "-lrt", *san_flags, *extra_flags]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise NativeBuildError(f"native build failed:\n{proc.stderr}")
             os.replace(tmp, so)  # atomic vs concurrent builders
-        lib = ctypes.CDLL(so)
-        _CACHE[stem] = lib
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            if sanitize in ("address", "thread"):
+                rt_lib = f"lib{'asan' if sanitize == 'address' else 'tsan'}.so"
+                raise NativeBuildError(
+                    f"dlopen of the {sanitize}-instrumented library failed ({e}); "
+                    f"the sanitizer runtime must be loaded first:\n"
+                    f"  LD_PRELOAD=$(g++ -print-file-name={rt_lib}) "
+                    f"RAY_TPU_SANITIZE={sanitize} <your command>") from e
+            raise
+        _CACHE[key] = lib
         return lib

@@ -99,6 +99,8 @@ _FLAGS: List[Flag] = [
          "(never leaves the machine)."),
     Flag("lp_debug", "RAY_TPU_LP_DEBUG", "bool", False,
          "Verbose serve long-poll client logging."),
+    Flag("dashboard_port", "RAY_TPU_DASHBOARD_PORT", "int", 8265,
+         "Dashboard HTTP port (JSON API, /metrics exposition, web UI)."),
     # -- data (DataContext defaults; per-driver overrides via DataContext)
     Flag("data_max_inflight_tasks_per_op", "RAY_TPU_DATA_MAX_INFLIGHT_TASKS_PER_OP",
          "int", 8,

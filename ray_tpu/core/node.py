@@ -1764,6 +1764,39 @@ class Cluster:
         out["driver"] = _format_thread_stacks()
         return out
 
+    def profile_workers(self, duration_s: float = 2.0, hz: float = 100.0,
+                        grace_s: float = 5.0) -> Dict[str, Dict[str, int]]:
+        """Sampling profile of every live worker + the driver: each process
+        samples its own threads for duration_s at hz and returns collapsed
+        stacks (reference: `py-spy record` through the dashboard reporter
+        module; here the workers self-sample over the control pipe)."""
+        from .worker import _sample_collapsed_stacks
+
+        token = os.urandom(8).hex()
+        with self._lock:
+            workers = [w for n in self._nodes.values() for w in n.workers.values()
+                       if w.state not in ("dead", "starting")]
+            self._stack_dumps[token] = {}
+        sent = 0
+        for w in workers:
+            try:
+                w.send(("profile", token, duration_s, hz))
+                sent += 1
+            except Exception:
+                pass
+        # the driver samples itself while the workers sample themselves
+        driver = _sample_collapsed_stacks(duration_s, hz)
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._stack_dumps.get(token, {})) >= sent:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            out = dict(self._stack_dumps.pop(token, {}))
+        out["driver"] = driver
+        return out
+
     def _gc_arena_after_death(self, w: Optional[WorkerHandle] = None) -> None:
         """Reclaim arena space from a dead worker: unsealed half-writes and sealed
         outputs whose result message never reached us (reference analog: plasma

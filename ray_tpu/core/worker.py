@@ -18,6 +18,7 @@ import sys
 import threading
 import traceback
 import queue
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -146,6 +147,21 @@ class WorkerContext:
                                     _format_thread_stacks()))
                     except Exception:
                         pass
+                elif kind == "profile":
+                    # py-spy-style SAMPLING profile: a detached thread samples
+                    # this process for duration_s and sends collapsed stacks
+                    # back (reference: py-spy record via dashboard reporter)
+                    _, token, duration_s, hz = msg
+
+                    def run_profile(token=token, duration_s=duration_s, hz=hz):
+                        counts = _sample_collapsed_stacks(duration_s, hz)
+                        try:
+                            self._send(("stacks", token, self.worker_id_hex, counts))
+                        except Exception:
+                            pass
+
+                    threading.Thread(target=run_profile, daemon=True,
+                                     name="rt-profiler").start()
                 elif kind == "cancel_stream":
                     # consumer abandoned a streaming generator: the producing
                     # thread checks this set at every yield boundary
@@ -654,6 +670,34 @@ def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dic
         except Exception:
             pass
         sys.exit(0)
+
+
+def _sample_collapsed_stacks(duration_s: float, hz: float) -> dict:
+    """Wall-clock stack sampler: every 1/hz, snapshot sys._current_frames()
+    and bump a counter per collapsed stack "thread;func (file:line);..."
+    (root-first — flamegraph.pl / speedscope collapsed format). The
+    dependency-free analogue of `py-spy record` (reference: dashboard
+    reporter module's profiling endpoints)."""
+    interval = 1.0 / max(1.0, float(hz))
+    deadline = time.monotonic() + float(duration_s)
+    me = threading.get_ident()
+    counts: dict = {}
+    while time.monotonic() < deadline:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue  # the sampler observing itself is pure noise
+            parts = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                parts.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}"
+                             f":{f.f_lineno})")
+                f = f.f_back
+            key = names.get(ident, "?") + ";" + ";".join(reversed(parts))
+            counts[key] = counts.get(key, 0) + 1
+        time.sleep(interval)
+    return counts
 
 
 def _format_thread_stacks() -> str:

@@ -130,9 +130,11 @@ setInterval(refresh, 3000);
 
 
 class Dashboard:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+    def __init__(self, host: str = "127.0.0.1", port: Optional[int] = None):
+        from ray_tpu.config import CONFIG
+
         self.host = host
-        self.port = port
+        self.port = port if port is not None else CONFIG.dashboard_port
         self._ready = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread = threading.Thread(target=self._serve, daemon=True,
@@ -171,6 +173,17 @@ class Dashboard:
                 wid = request.query.get("worker_id", "")
                 tail = int(request.query.get("tail", "100"))
                 return web.json_response(st.get_log(wid, tail=tail))
+            if name == "profile":
+                # sampling flamegraph (py-spy-record analogue): blocks for
+                # `duration` seconds, returns a speedscope document
+                duration = min(30.0, float(request.query.get("duration", "2")))
+                hz = min(500.0, float(request.query.get("hz", "100")))
+                loop = asyncio.get_running_loop()
+                profs = await loop.run_in_executor(
+                    None, lambda: st.profile_workers(duration_s=duration, hz=hz))
+                if request.query.get("format") == "collapsed":
+                    return web.json_response(profs)
+                return web.json_response(st.profile_to_speedscope(profs))
             fn = tables.get(name)
             if fn is None:
                 return web.Response(status=404, text=f"unknown table {name}")

@@ -1,0 +1,118 @@
+"""Prometheus + Grafana provisioning files for the cluster's metrics plane.
+
+Capability parity: reference python/ray/dashboard/modules/metrics/ — on head
+start it writes a ready-to-run `prometheus.yml` scraping every node's metrics
+endpoint plus Grafana provisioning configs (datasource + dashboards dir) and
+the default Grafana dashboard JSONs, so `prometheus --config.file=...` and
+`grafana-server --config ...` come up pre-wired. Same contract here: one call
+writes the whole tree under <session_dir>/metrics and returns the root.
+
+    ray-tpu metrics launch-config   # CLI entry; prints the generated paths
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+
+def _panel(panel_id: int, title: str, expr: str, y: int, unit: str = "short") -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "datasource": "ray-tpu-prometheus",
+        "gridPos": {"h": 8, "w": 12, "x": (panel_id % 2) * 12, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}},
+        "targets": [{"expr": expr, "legendFormat": "{{__name__}}"}],
+    }
+
+
+def default_dashboard() -> dict:
+    """The default cluster dashboard (reference: default_grafana_dashboard.json
+    from dashboard/modules/metrics/dashboards) over our exported series."""
+    rows = [
+        ("Nodes", "ray_tpu_cluster_nodes", "short"),
+        ("Workers", "ray_tpu_cluster_workers", "short"),
+        ("Live actors", "ray_tpu_cluster_actors", "short"),
+        ("Pending tasks", "ray_tpu_cluster_pending_tasks", "short"),
+        ("Object store: objects", "ray_tpu_object_store_num_objects", "short"),
+        ("Object store: arena bytes", "ray_tpu_object_store_arena_bytes", "bytes"),
+        ("Object store: shm bytes", "ray_tpu_object_store_shm_bytes", "bytes"),
+        ("User metrics (ray_tpu_*)", '{__name__=~"ray_tpu_.+"}', "short"),
+    ]
+    panels = [
+        _panel(i, title, expr, (i // 2) * 8, unit)
+        for i, (title, expr, unit) in enumerate(rows)
+    ]
+    return {
+        "title": "ray-tpu cluster",
+        "uid": "ray-tpu-default",
+        "timezone": "browser",
+        "refresh": "10s",
+        "schemaVersion": 39,
+        "panels": panels,
+        "time": {"from": "now-30m", "to": "now"},
+    }
+
+
+def provision(session_dir: Optional[str] = None,
+              scrape_targets: Optional[List[str]] = None) -> str:
+    """Write prometheus.yml + Grafana provisioning under <session_dir>/metrics.
+
+    scrape_targets defaults to the local dashboard's /metrics endpoint; a
+    multi-host head passes every agent's exporter address.
+    """
+    from ray_tpu.config import CONFIG
+
+    root = os.path.join(session_dir or CONFIG.session_dir, "metrics")
+    targets = scrape_targets or [f"127.0.0.1:{CONFIG.dashboard_port}"]
+
+    prom_dir = os.path.join(root, "prometheus")
+    os.makedirs(prom_dir, exist_ok=True)
+    prom = {
+        "global": {"scrape_interval": "10s", "evaluation_interval": "10s"},
+        "scrape_configs": [{
+            "job_name": "ray-tpu",
+            "metrics_path": "/metrics",
+            "static_configs": [{"targets": targets}],
+        }],
+    }
+    # prometheus reads YAML; this subset of YAML is exactly JSON
+    with open(os.path.join(prom_dir, "prometheus.yml"), "w") as f:
+        json.dump(prom, f, indent=2)
+
+    graf_dir = os.path.join(root, "grafana")
+    dash_dir = os.path.join(graf_dir, "dashboards")
+    prov_ds = os.path.join(graf_dir, "provisioning", "datasources")
+    prov_db = os.path.join(graf_dir, "provisioning", "dashboards")
+    for d in (dash_dir, prov_ds, prov_db):
+        os.makedirs(d, exist_ok=True)
+
+    with open(os.path.join(prov_ds, "default.yml"), "w") as f:
+        json.dump({
+            "apiVersion": 1,
+            "datasources": [{
+                "name": "ray-tpu-prometheus",
+                "type": "prometheus",
+                "access": "proxy",
+                "isDefault": True,
+                "url": "http://127.0.0.1:9090",
+            }],
+        }, f, indent=2)
+    with open(os.path.join(prov_db, "default.yml"), "w") as f:
+        json.dump({
+            "apiVersion": 1,
+            "providers": [{
+                "name": "ray-tpu",
+                "folder": "",
+                "type": "file",
+                "options": {"path": dash_dir},
+            }],
+        }, f, indent=2)
+    with open(os.path.join(dash_dir, "default_grafana_dashboard.json"), "w") as f:
+        json.dump(default_dashboard(), f, indent=2)
+    with open(os.path.join(graf_dir, "grafana.ini"), "w") as f:
+        f.write("[paths]\nprovisioning = {}\n[server]\nhttp_port = 3000\n".format(
+            os.path.join(graf_dir, "provisioning")))
+    return root

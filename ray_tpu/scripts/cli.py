@@ -41,11 +41,15 @@ def cmd_start(args) -> int:
             pass
         return 0
     os.makedirs(default_session_dir(), exist_ok=True)
+    from ray_tpu.config import CONFIG
+
+    dashboard_port = (args.dashboard_port if args.dashboard_port is not None
+                      else CONFIG.dashboard_port)
     info = {
         "started_at": time.time(),
         "pid": os.getpid(),
         "num_cpus": args.num_cpus,
-        "dashboard_port": args.dashboard_port,
+        "dashboard_port": dashboard_port,
     }
     if args.node_server_port is not None:
         info["node_server_port"] = args.node_server_port
@@ -65,8 +69,8 @@ def cmd_start(args) -> int:
             port = global_state.cluster().node_server_port
             print(f"node server: {args.node_server_host}:{port} "
                   "(join with `ray-tpu start --address=HOST:PORT`)")
-        dash = Dashboard(port=args.dashboard_port)
-        print(f"dashboard: http://127.0.0.1:{args.dashboard_port}/api/summary")
+        dash = Dashboard(port=dashboard_port)
+        print(f"dashboard: http://127.0.0.1:{dashboard_port}/api/summary")
         try:
             while True:
                 time.sleep(3600)
@@ -209,6 +213,42 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """`ray-tpu metrics launch-config`: write prometheus.yml + Grafana
+    provisioning under the session dir (reference `ray metrics launch-prometheus`
+    / dashboard/modules/metrics provisioning)."""
+    from ray_tpu.metrics_provision import provision
+
+    root = provision(session_dir=args.session_dir or None)
+    print(f"metrics configs written under {root}")
+    print(f"  prometheus --config.file={root}/prometheus/prometheus.yml")
+    print(f"  grafana-server --config {root}/grafana/grafana.ini")
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """`ray-tpu profile --duration 5 -o prof.json`: sampling profile of every
+    worker + driver, written as a speedscope document (reference: py-spy via
+    the dashboard reporter)."""
+    import ray_tpu
+
+    if args.address:
+        ray_tpu.init(address=args.address)
+    elif not ray_tpu.is_initialized():
+        print("no cluster: pass --address ray-tpu://host:port (or run inside a driver)")
+        return 1
+    from ray_tpu.util import state as rs
+
+    profs = rs.profile_workers(duration_s=args.duration, hz=args.hz)
+    doc = rs.profile_to_speedscope(profs)
+    with open(args.output, "w") as f:
+        json.dump(doc, f)
+    n = sum(len(v) for v in profs.values())
+    print(f"{len(profs)} processes, {n} unique stacks -> {args.output} "
+          f"(open at https://speedscope.app)")
+    return 0
+
+
 def cmd_up(args) -> int:
     """`ray-tpu up cluster.yaml` (reference `ray up`)."""
     import ray_tpu
@@ -300,7 +340,8 @@ def main(argv=None) -> int:
     sp.add_argument("--address", default=None,
                     help="join an existing head's node server as this host's agent")
     sp.add_argument("--num-cpus", type=float, default=None)
-    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--dashboard-port", type=int, default=None,
+                    help="default: CONFIG.dashboard_port (RAY_TPU_DASHBOARD_PORT)")
     sp.add_argument("--node-server-port", type=int, default=None,
                     help="accept node agents on this port (0 = ephemeral; head only)")
     sp.add_argument("--node-server-host", default="127.0.0.1")
@@ -309,6 +350,18 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("stop", help="clear head session")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("metrics", help="metrics plane provisioning")
+    sp.add_argument("action", choices=["launch-config"])
+    sp.add_argument("--session-dir", default="")
+    sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("profile", help="sampling profile -> speedscope json")
+    sp.add_argument("--address", default="")
+    sp.add_argument("--duration", type=float, default=5.0)
+    sp.add_argument("--hz", type=float, default=100.0)
+    sp.add_argument("-o", "--output", default="ray_tpu_profile.json")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("status", help="show head session")
     sp.set_defaults(fn=cmd_status)

@@ -15,15 +15,34 @@ from ..air.config import (  # noqa: F401
 )
 from .backend import Backend, BackendConfig  # noqa: F401
 from .checkpoint import Checkpoint  # noqa: F401
-from .data_parallel_trainer import DataParallelTrainer, JaxTrainer, TorchTrainer  # noqa: F401
+from .data_parallel_trainer import (  # noqa: F401
+    DataParallelTrainer,
+    JaxTrainer,
+    TensorflowTrainer,
+    TorchTrainer,
+)
 from .jax_backend import JaxBackend, JaxConfig  # noqa: F401
 from .torch_backend import TorchBackend, TorchConfig  # noqa: F401
+from .tensorflow_backend import TensorflowBackend, TensorflowConfig  # noqa: F401
+from .gbdt import (  # noqa: F401  (optional-dep GBDT family)
+    LightGBMConfig,
+    LightGBMTrainer,
+    XGBoostConfig,
+    XGBoostTrainer,
+)
+from . import gbdt as xgboost  # noqa: F401
+from . import gbdt as lightgbm  # noqa: F401
+from . import huggingface  # noqa: F401
+from . import lightning  # noqa: F401
 from . import torch_backend as torch  # noqa: F401  (ray_tpu.train.torch.prepare_model)
 
-# reference import shape: `from ray_tpu.train.torch import prepare_model`
+# reference import shapes: `from ray_tpu.train.torch import prepare_model`,
+# `from ray_tpu.train.xgboost import get_rabit_args`, ...
 import sys as _sys
 
 _sys.modules[__name__ + ".torch"] = torch
+_sys.modules[__name__ + ".xgboost"] = xgboost
+_sys.modules[__name__ + ".lightgbm"] = lightgbm
 from .result import Result  # noqa: F401
 from .session import (  # noqa: F401
     TrainContext,

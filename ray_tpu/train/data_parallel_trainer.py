@@ -19,6 +19,7 @@ from ..air.config import RunConfig, ScalingConfig
 from .backend import BackendConfig
 from .backend_executor import BackendExecutor
 from .checkpoint import Checkpoint
+from .tensorflow_backend import TensorflowConfig
 from .torch_backend import TorchConfig
 from .checkpoint_manager import CheckpointManager
 from .jax_backend import JaxConfig
@@ -127,3 +128,11 @@ class TorchTrainer(DataParallelTrainer):
     JaxTrainer). DDP wrap via ray_tpu.train.torch.prepare_model."""
 
     _default_backend_config = TorchConfig
+
+
+class TensorflowTrainer(DataParallelTrainer):
+    """TF multi-worker trainer: workers get a TF_CONFIG cluster spec so
+    MultiWorkerMirroredStrategy coordinates over the group (reference
+    TensorflowTrainer, python/ray/train/tensorflow/tensorflow_trainer.py)."""
+
+    _default_backend_config = TensorflowConfig

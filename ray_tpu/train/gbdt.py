@@ -1,0 +1,252 @@
+"""XGBoost / LightGBM data-parallel trainers (GBDT family).
+
+Capability parity:
+- reference python/ray/train/xgboost/config.py — XGBoostConfig (:21) starts an
+  ``xgboost.RabitTracker`` on the driver and hands every worker the DMLC env it
+  needs to join the collective; the user's plain ``xgboost.train`` call inside
+  the train loop becomes distributed under ``CommunicatorContext``.
+- reference python/ray/train/lightgbm/config.py — LightGBMConfig (:58) has each
+  worker bind a port, then broadcasts the ``machines`` list so user code merges
+  ``get_network_params()`` into its LightGBM params.
+- reference python/ray/train/xgboost/_xgboost_utils.py RayTrainReportCallback —
+  per-round metric report + periodic Booster checkpointing through the session.
+
+Both libraries are optional in this image: the modules import lazily and raise
+a clear error at ``fit()`` time when absent (same contract as the reference's
+optional integration deps).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Type
+
+from .backend import Backend, BackendConfig
+from .data_parallel_trainer import DataParallelTrainer
+from .tensorflow_backend import _bind_free_port
+from .worker_group import WorkerGroup
+
+
+def _require(module: str, feature: str):
+    import importlib
+
+    try:
+        return importlib.import_module(module)
+    except ImportError as e:
+        raise ImportError(
+            f"{feature} requires the '{module}' package, which is not installed "
+            f"in this environment. Install it to use this trainer."
+        ) from e
+
+
+# ------------------------------------------------------------------- xgboost
+
+_rabit_args: Optional[Dict[str, Any]] = None
+_rabit_lock = threading.Lock()
+
+
+def get_rabit_args() -> Dict[str, Any]:
+    """Args for ``xgboost.collective.CommunicatorContext(**args)`` on this
+    worker (reference config.py _get_xgboost_args). Empty outside an
+    XGBoostTrainer loop."""
+    with _rabit_lock:
+        return dict(_rabit_args) if _rabit_args else {}
+
+
+def _set_rabit_args(args: Dict[str, Any], rank: int) -> None:
+    global _rabit_args
+    with _rabit_lock:
+        # Rank alignment: the tracker sorts workers by task id
+        # (reference config.py sortby="task" + dmlc_task_id).
+        _rabit_args = dict(args, dmlc_task_id=f"[ray_tpu-rank={rank:08}]")
+
+
+def _clear_rabit_args() -> None:
+    global _rabit_args
+    with _rabit_lock:
+        _rabit_args = None
+
+
+@dataclass
+class XGBoostConfig(BackendConfig):
+    """Rabit collective bootstrap (reference xgboost/config.py:21)."""
+
+    xgboost_communicator: str = "rabit"
+
+    @property
+    def backend_cls(self) -> Type["XGBoostBackend"]:
+        if self.xgboost_communicator != "rabit":
+            raise NotImplementedError(
+                f"unsupported xgboost communicator: {self.xgboost_communicator!r}")
+        return XGBoostBackend
+
+
+class XGBoostBackend(Backend):
+    def __init__(self):
+        self._tracker = None
+        self._wait_thread: Optional[threading.Thread] = None
+
+    def on_training_start(self, worker_group: WorkerGroup,
+                          backend_config: XGBoostConfig) -> None:
+        xgb = _require("xgboost", "XGBoostTrainer")
+        # RabitTracker moved between xgboost versions (top level <-> .tracker)
+        tracker_cls = getattr(xgb, "RabitTracker", None)
+        if tracker_cls is None:
+            from xgboost.tracker import RabitTracker as tracker_cls
+        n = len(worker_group)
+        self._tracker = tracker_cls(n_workers=n, host_ip="127.0.0.1",
+                                    sortby="task")
+        self._tracker.start()
+        # wait_for holds the tracker open until every worker disconnects;
+        # park it on a daemon thread like the reference does.
+        self._wait_thread = threading.Thread(
+            target=lambda: self._tracker.wait_for(), daemon=True)
+        self._wait_thread.start()
+        args = dict(self._tracker.worker_args())
+        import ray_tpu
+
+        ray_tpu.get([
+            w.run_fn.remote(_set_rabit_args, args, rank)
+            for rank, w in enumerate(worker_group.workers)
+        ])
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: XGBoostConfig) -> None:
+        try:
+            worker_group.execute(_clear_rabit_args)
+        except Exception:
+            pass
+        if self._wait_thread is not None:
+            self._wait_thread.join(timeout=5)
+            self._wait_thread = None
+        self._tracker = None
+
+
+class XGBoostTrainer(DataParallelTrainer):
+    """Run ``train_loop_per_worker`` on N workers with a live rabit collective;
+    plain ``xgboost.train(...)`` inside the loop (under
+    ``xgboost.collective.CommunicatorContext()``) trains data-parallel
+    (reference xgboost/v2.py:13)."""
+
+    _default_backend_config = XGBoostConfig
+
+
+class RayTrainReportCallback:
+    """xgboost callback: per-iteration session.report of eval metrics, with the
+    Booster checkpointed every ``frequency`` rounds (reference
+    _xgboost_utils.py RayTrainReportCallback).
+
+    Subclasses xgboost.callback.TrainingCallback dynamically so this module
+    imports without xgboost present.
+    """
+
+    CHECKPOINT_NAME = "model.ubj"
+
+    def __new__(cls, *args, **kwargs):
+        xgb = _require("xgboost", "RayTrainReportCallback")
+
+        class _Impl(xgb.callback.TrainingCallback):
+            def __init__(self, metrics=None, frequency=0):
+                self._metrics = metrics
+                self._frequency = frequency
+
+            def _flat_metrics(self, evals_log):
+                out = {}
+                for data_name, metric_log in evals_log.items():
+                    for metric_name, values in metric_log.items():
+                        key = f"{data_name}-{metric_name}"
+                        if self._metrics and key not in self._metrics \
+                                and metric_name not in self._metrics:
+                            continue
+                        out[key] = values[-1]
+                return out
+
+            def after_iteration(self, model, epoch, evals_log):
+                import os
+                import shutil
+                import tempfile
+
+                from . import session
+                from .checkpoint import Checkpoint
+
+                metrics = self._flat_metrics(evals_log)
+                d = None
+                ckpt = None
+                # rank 0 only: the Booster is identical on every rank after
+                # the allreduce; N copies are pure waste
+                if self._frequency and (epoch + 1) % self._frequency == 0 \
+                        and session.get_context().get_world_rank() == 0:
+                    d = tempfile.mkdtemp(prefix="xgb_ckpt_")
+                    model.save_model(os.path.join(d, cls.CHECKPOINT_NAME))
+                    ckpt = Checkpoint.from_directory(d)
+                session.report(metrics, checkpoint=ckpt)
+                if d is not None:
+                    # report() stages the checkpoint before returning
+                    shutil.rmtree(d, ignore_errors=True)
+                return False
+
+        return _Impl(*args, **kwargs)
+
+
+# ------------------------------------------------------------------ lightgbm
+
+_lgbm_network_params: Optional[Dict[str, Any]] = None
+_lgbm_lock = threading.Lock()
+
+
+def get_network_params() -> Dict[str, Any]:
+    """LightGBM network params for this worker's train() call (reference
+    lightgbm/config.py:19). Empty outside a LightGBMTrainer loop."""
+    with _lgbm_lock:
+        return dict(_lgbm_network_params) if _lgbm_network_params else {}
+
+
+def _set_lgbm_params(num_machines: int, local_listen_port: int, machines: str) -> None:
+    global _lgbm_network_params
+    with _lgbm_lock:
+        _lgbm_network_params = {
+            "num_machines": num_machines,
+            "local_listen_port": local_listen_port,
+            "machines": machines,
+        }
+
+
+def _clear_lgbm_params() -> None:
+    global _lgbm_network_params
+    with _lgbm_lock:
+        _lgbm_network_params = None
+
+
+@dataclass
+class LightGBMConfig(BackendConfig):
+    @property
+    def backend_cls(self) -> Type["LightGBMBackend"]:
+        return LightGBMBackend
+
+
+class LightGBMBackend(Backend):
+    def on_training_start(self, worker_group: WorkerGroup,
+                          backend_config: LightGBMConfig) -> None:
+        addrs = worker_group.execute(_bind_free_port)
+        machines = ",".join(f"{ip}:{port}" for ip, port in addrs)
+        import ray_tpu
+
+        ray_tpu.get([
+            w.run_fn.remote(_set_lgbm_params, len(worker_group), addrs[rank][1],
+                            machines)
+            for rank, w in enumerate(worker_group.workers)
+        ])
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: LightGBMConfig) -> None:
+        try:
+            worker_group.execute(_clear_lgbm_params)
+        except Exception:
+            pass
+
+
+class LightGBMTrainer(DataParallelTrainer):
+    """User loop merges ``get_network_params()`` into its lgbm params and calls
+    plain ``lightgbm.train`` (reference lightgbm/v2.py)."""
+
+    _default_backend_config = LightGBMConfig

@@ -1,0 +1,119 @@
+"""HuggingFace Transformers integration for TorchTrainer loops.
+
+Capability parity: reference python/ray/train/huggingface/transformers/
+_transformers_utils.py — RayTrainReportCallback (:30, on_save → aggregate
+``state.log_history`` + wrap the HF checkpoint dir as a Train Checkpoint),
+RayTorchIterableDataset (:92), prepare_trainer (:104, reroute the HF Trainer's
+dataloaders through the worker's Data shard when one was passed).
+
+Usage inside a TorchTrainer loop::
+
+    def loop(config):
+        trainer = transformers.Trainer(..., train_dataset=get_dataset_shard())
+        trainer = ray_tpu.train.huggingface.prepare_trainer(trainer)
+        trainer.add_callback(ray_tpu.train.huggingface.RayTrainReportCallback())
+        trainer.train()
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Iterator, Optional
+
+from ..data.iterator import DataIterator
+from .checkpoint import Checkpoint
+
+
+def _is_shard(ds) -> bool:
+    """A Data shard in either spelling: a DataIterator or a whole Dataset
+    (single-worker groups pass datasets through unsplit)."""
+    return ds is not None and hasattr(ds, "iter_torch_batches")
+
+
+def _transformers():
+    try:
+        import transformers
+        return transformers
+    except ImportError as e:
+        raise ImportError(
+            "ray_tpu.train.huggingface requires the 'transformers' package"
+        ) from e
+
+
+class RayTrainReportCallback:
+    """transformers.TrainerCallback: after each HF checkpoint save, report the
+    aggregated log_history metrics plus the checkpoint to the Train session."""
+
+    CHECKPOINT_NAME = "checkpoint"
+
+    def __new__(cls):
+        transformers = _transformers()
+
+        class _Impl(transformers.TrainerCallback):
+            def on_save(self, args, state, control, **kwargs):
+                from . import session
+
+                metrics = {}
+                for log in state.log_history:
+                    metrics.update(log)
+                ckpt = None
+                tmpdir = None
+                source = transformers.trainer_utils.get_last_checkpoint(args.output_dir)
+                # rank 0 only: with DDP all ranks save identical weights
+                if source is not None and session.get_context().get_world_rank() == 0:
+                    tmpdir = tempfile.mkdtemp(prefix="hf_ckpt_")
+                    shutil.copytree(source,
+                                    os.path.join(tmpdir, cls.CHECKPOINT_NAME))
+                    ckpt = Checkpoint.from_directory(tmpdir)
+                session.report(metrics, checkpoint=ckpt)
+                if tmpdir is not None:
+                    # report() stages the checkpoint before returning
+                    shutil.rmtree(tmpdir, ignore_errors=True)
+
+        return _Impl()
+
+
+class RayTorchIterableDataset:
+    """torch IterableDataset over a Data shard's row iterator."""
+
+    def __new__(cls, data_iterator: DataIterator, batch_size: Optional[int]):
+        from torch.utils.data import IterableDataset
+
+        class _Impl(IterableDataset):
+            def __iter__(self) -> Iterator:
+                if batch_size is None:
+                    return data_iterator.iter_rows()
+                return data_iterator.iter_torch_batches(batch_size=batch_size)
+
+        return _Impl()
+
+
+def prepare_trainer(trainer):
+    """Reroute ``get_train_dataloader`` / ``get_eval_dataloader`` through the
+    Data shard when ``train_dataset`` / ``eval_dataset`` is a DataIterator
+    (reference prepare_trainer :104 — subclass-swap so user Trainer subclasses
+    keep their own overrides)."""
+    from torch.utils.data import DataLoader
+
+    base = trainer.__class__
+
+    def _loader(it: DataIterator, batch_size) -> "DataLoader":
+        ds = RayTorchIterableDataset(it, batch_size)
+        # the shard iterator already batches; DataLoader is a pass-through
+        return DataLoader(ds, batch_size=1, collate_fn=lambda x: x[0])
+
+    class RayTransformersTrainer(base):
+        def get_train_dataloader(self):
+            if _is_shard(self.train_dataset):
+                return _loader(self.train_dataset, self.args.per_device_train_batch_size)
+            return super().get_train_dataloader()
+
+        def get_eval_dataloader(self, eval_dataset=None):
+            ds = eval_dataset if eval_dataset is not None else self.eval_dataset
+            if _is_shard(ds):
+                return _loader(ds, self.args.per_device_eval_batch_size)
+            return super().get_eval_dataloader(eval_dataset)
+
+    trainer.__class__ = RayTransformersTrainer
+    return trainer

@@ -1,0 +1,145 @@
+"""PyTorch Lightning integration for TorchTrainer loops.
+
+Capability parity: reference python/ray/train/lightning/_lightning_utils.py —
+RayDDPStrategy (:57, DDP over the session's torch process group),
+RayLightningEnvironment (:177, rank/world-size answered from the Train
+context instead of SLURM/env detection), RayTrainReportCallback (:239,
+per-epoch-end metric+checkpoint report), prepare_trainer (:209, validate the
+strategy/environment combination).
+
+Lightning is optional in this image; every entry point imports it lazily and
+raises a clear error when absent. CPU torch is the supported device — the TPU
+path is JaxTrainer.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any
+
+
+def _pl():
+    try:
+        import pytorch_lightning as pl
+        return pl
+    except ImportError:
+        try:
+            import lightning.pytorch as pl  # the renamed distribution
+            return pl
+        except ImportError as e:
+            raise ImportError(
+                "ray_tpu.train.lightning requires 'pytorch_lightning' (or "
+                "'lightning'), which is not installed in this environment."
+            ) from e
+
+
+def RayDDPStrategy(**kwargs: Any):
+    """DDPStrategy that trusts the session's already-initialized gloo group
+    (reference RayDDPStrategy :57)."""
+    pl = _pl()
+
+    class _Impl(pl.strategies.DDPStrategy):
+        def __init__(self):
+            super().__init__(**kwargs)
+
+        @property
+        def root_device(self):
+            import torch
+
+            return torch.device("cpu")
+
+        @property
+        def distributed_sampler_kwargs(self):
+            from . import session
+
+            ctx = session.get_context()
+            return dict(num_replicas=ctx.get_world_size(),
+                        rank=ctx.get_world_rank())
+
+    return _Impl()
+
+
+def RayLightningEnvironment():
+    """ClusterEnvironment answering rank/world-size from the Train session
+    (reference RayLightningEnvironment :177)."""
+    pl = _pl()
+    from lightning_fabric.plugins.environments import LightningEnvironment  # type: ignore
+
+    class _Impl(LightningEnvironment):
+        def world_size(self) -> int:
+            from . import session
+
+            return session.get_context().get_world_size()
+
+        def global_rank(self) -> int:
+            from . import session
+
+            return session.get_context().get_world_rank()
+
+        def local_rank(self) -> int:
+            from . import session
+
+            return session.get_context().get_local_rank()
+
+        def node_rank(self) -> int:
+            from . import session
+
+            return session.get_context().get_node_rank()
+
+        def set_world_size(self, size: int) -> None:
+            pass  # the worker group owns this
+
+        def set_global_rank(self, rank: int) -> None:
+            pass
+
+        def teardown(self):
+            pass
+
+    del pl
+    return _Impl()
+
+
+def RayTrainReportCallback():
+    """pl.Callback: on_train_epoch_end → session.report(metrics, checkpoint)
+    (reference RayTrainReportCallback :239)."""
+    pl = _pl()
+
+    class _Impl(pl.callbacks.Callback):
+        CHECKPOINT_NAME = "checkpoint.ckpt"
+
+        def on_train_epoch_end(self, trainer, pl_module):
+            from . import session
+            from .checkpoint import Checkpoint
+
+            metrics = {k: (v.item() if hasattr(v, "item") else v)
+                       for k, v in trainer.callback_metrics.items()}
+            metrics["epoch"] = trainer.current_epoch
+            metrics["step"] = trainer.global_step
+            ckpt = None
+            tmpdir = None
+            # rank 0 only: DDP ranks hold identical weights
+            if session.get_context().get_world_rank() == 0:
+                tmpdir = tempfile.mkdtemp(prefix="pl_ckpt_")
+                trainer.save_checkpoint(
+                    os.path.join(tmpdir, self.CHECKPOINT_NAME), weights_only=False)
+                ckpt = Checkpoint.from_directory(tmpdir)
+            session.report(metrics, checkpoint=ckpt)
+            if tmpdir is not None:
+                # report() stages the checkpoint before returning
+                import shutil
+
+                shutil.rmtree(tmpdir, ignore_errors=True)
+
+    return _Impl()
+
+
+def prepare_trainer(trainer):
+    """Validate that the pl.Trainer uses the Ray strategy/environment pair
+    (reference prepare_trainer :209)."""
+    cls_name = type(trainer.strategy).__name__
+    if cls_name not in ("_Impl", "SingleDeviceStrategy") and "DDP" in cls_name:
+        raise RuntimeError(
+            "pl.Trainer inside a TorchTrainer loop must use "
+            "ray_tpu.train.lightning.RayDDPStrategy (got "
+            f"{cls_name}) so DDP rides the session's process group.")
+    return trainer

@@ -1,0 +1,83 @@
+"""TensorflowConfig / TensorflowBackend: TF_CONFIG multi-worker bootstrap.
+
+Capability parity: reference python/ray/train/tensorflow/config.py —
+_setup_tensorflow_environment (:24) assembles the ``TF_CONFIG`` cluster spec
+(one "worker" URL per rank, task index = rank) that
+``tf.distribute.MultiWorkerMirroredStrategy`` reads at construction time.
+
+On TPU hosts the supported device for TF user code is CPU — the TPU compute
+path is JaxTrainer — so this backend exists for parity with TF data pipelines
+and Keras models users bring along, not as a TPU training path.
+"""
+from __future__ import annotations
+
+import json
+import socket
+from dataclasses import dataclass
+from typing import List, Type
+
+from .backend import Backend, BackendConfig
+from .worker_group import WorkerGroup
+
+
+def _bind_free_port() -> tuple:
+    """Return (ip, port) for this worker; port is free at call time (the same
+    pick-then-release rendezvous the reference's get_address_and_port does)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return "127.0.0.1", port
+
+
+def _apply_tf_config(worker_urls: List[str], index: int) -> None:
+    import os
+
+    tf_config = {
+        "cluster": {"worker": worker_urls},
+        "task": {"type": "worker", "index": index},
+    }
+    os.environ["TF_CONFIG"] = json.dumps(tf_config)
+
+
+def _clear_tf_config() -> None:
+    import os
+
+    os.environ.pop("TF_CONFIG", None)
+
+
+@dataclass
+class TensorflowConfig(BackendConfig):
+    @property
+    def backend_cls(self) -> Type["TensorflowBackend"]:
+        return TensorflowBackend
+
+
+class TensorflowBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, backend_config: TensorflowConfig) -> None:
+        addrs = worker_group.execute(_bind_free_port)
+        urls = [f"{ip}:{port}" for ip, port in addrs]
+        import ray_tpu
+
+        ray_tpu.get([
+            w.run_fn.remote(_apply_tf_config, urls, rank)
+            for rank, w in enumerate(worker_group.workers)
+        ])
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: TensorflowConfig) -> None:
+        try:
+            worker_group.execute(_clear_tf_config)
+        except Exception:
+            pass
+
+
+def prepare_dataset_shard(tf_dataset_shard):
+    """Disable TF autosharding on an already-sharded dataset (reference
+    ray.train.tensorflow.prepare_dataset_shard, train/tensorflow/train_loop_utils.py)."""
+    import tensorflow as tf
+
+    options = tf.data.Options()
+    options.experimental_distribute.auto_shard_policy = (
+        tf.data.experimental.AutoShardPolicy.OFF
+    )
+    return tf_dataset_shard.with_options(options)

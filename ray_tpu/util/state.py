@@ -238,7 +238,27 @@ def get_metrics() -> Dict[str, dict]:
 def prometheus_metrics() -> str:
     from ray_tpu.util import metrics as m
 
-    return m.prometheus_text(get_metrics())
+    user_metrics = get_metrics()
+    text = m.prometheus_text(user_metrics)
+    # system series alongside the user registry (reference: ray_nodes /
+    # ray_actors / ray_object_store_memory exported by the dashboard agent)
+    s = summarize_cluster()
+    lines = [text] if text else []
+    gauges = {
+        "cluster_nodes": s["nodes"],
+        "cluster_workers": s["workers"],
+        "cluster_actors": s["actors"],
+        "cluster_pending_tasks": s["pending_tasks"],
+    }
+    gauges.update({f"object_store_{k}": v for k, v in s["objects"].items()})
+    for name, value in gauges.items():
+        if name in user_metrics:
+            continue  # a user metric claimed this name; duplicate TYPE lines
+                      # would invalidate the whole exposition
+        full = f"ray_tpu_{name}"
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {value}")
+    return "\n".join(lines) + "\n"
 
 
 # -------------------------------------------------------------------- tracing
@@ -300,3 +320,42 @@ def get_worker_stacks(timeout_s: float = 5.0) -> Dict[str, str]:
     reporter module, python/ray/dashboard/modules/reporter/) — dependency-free:
     workers introspect sys._current_frames() on their recv thread."""
     return _cluster().dump_worker_stacks(timeout_s)
+
+
+@_remoteable
+def profile_workers(duration_s: float = 2.0, hz: float = 100.0) -> Dict[str, Dict[str, int]]:
+    """Sampling profile of every live worker + driver: collapsed stacks
+    ("thread;frame;frame" -> sample count, flamegraph.pl format). The
+    `py-spy record` analogue of the reference's reporter profiling endpoints."""
+    return _cluster().profile_workers(duration_s=duration_s, hz=hz)
+
+
+def profile_to_speedscope(profiles: Dict[str, Dict[str, int]]) -> Dict[str, Any]:
+    """Render profile_workers() output as a speedscope-importable document
+    (one 'sampled' profile per process; https://speedscope.app file format)."""
+    frames: List[Dict[str, str]] = []
+    index: Dict[str, int] = {}
+
+    def fid(name: str) -> int:
+        if name not in index:
+            index[name] = len(frames)
+            frames.append({"name": name})
+        return index[name]
+
+    profs = []
+    for proc, counts in sorted(profiles.items()):
+        samples, weights = [], []
+        for collapsed, n in counts.items():
+            stack = [fid(part) for part in collapsed.split(";")]
+            samples.append(stack)
+            weights.append(n)
+        profs.append({
+            "type": "sampled", "name": proc, "unit": "none",
+            "startValue": 0, "endValue": sum(weights) or 1,
+            "samples": samples, "weights": weights,
+        })
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": profs,
+    }
