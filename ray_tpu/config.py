@@ -58,6 +58,15 @@ _FLAGS: List[Flag] = [
          "(reference memory_monitor.h)."),
     Flag("memory_monitor_refresh_ms", "RAY_TPU_MEMORY_MONITOR_REFRESH_MS", "int", 250,
          "Memory monitor / spill check period."),
+    Flag("inline_threshold_bytes", "RAY_TPU_INLINE_THRESHOLD_BYTES", "int", 100 * 1024,
+         "Objects below this travel inline in control messages instead of the "
+         "arena (reference max_direct_call_object_size)."),
+    Flag("worker_start_timeout_s", "RAY_TPU_WORKER_START_TIMEOUT_S", "float", 60.0,
+         "How long the pool waits for a spawned worker's handshake "
+         "(reference worker_register_timeout_seconds)."),
+    Flag("metrics_report_interval_s", "RAY_TPU_METRICS_REPORT_INTERVAL_S", "float", 2.0,
+         "Worker metric-snapshot push period to the head "
+         "(reference metrics_report_interval_ms)."),
     # -- multi-host control plane
     Flag("agent_heartbeat_s", "RAY_TPU_AGENT_HEARTBEAT_S", "float", 2.0,
          "Node-agent heartbeat period to the head."),

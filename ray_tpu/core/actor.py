@@ -198,7 +198,9 @@ class ActorClass:
                 raise ValueError(
                     f"method {self.__name__}.{mname} uses concurrency group {g!r}, "
                     f"which is not declared in concurrency_groups ({sorted(declared)})")
-        runtime_env = dict(opts.get("runtime_env") or {}) or None
+        from ray_tpu.runtime_env import resolved_runtime_env
+
+        runtime_env = resolved_runtime_env(opts.get("runtime_env"))
         spec = TaskSpec(
             task_id=TaskID.generate(),
             kind="actor_creation",

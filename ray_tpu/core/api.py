@@ -31,6 +31,7 @@ def init(
     node_server_port: Optional[int] = None,  # accept node agents (multi-host head)
     node_server_host: str = "127.0.0.1",
     worker_env: Optional[Dict[str, str]] = None,
+    runtime_env: Optional[Dict[str, Any]] = None,
     max_workers_per_node: Optional[int] = None,
     object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = True,
@@ -52,6 +53,13 @@ def init(
                 "connect as a remote client driver, or omit address to start locally")
         from ray_tpu.util.client import connect
 
+        if runtime_env:
+            import warnings
+
+            warnings.warn(
+                "init(address=..., runtime_env=...): job-level runtime_env is "
+                "not forwarded to the remote head (the head owns job defaults); "
+                "pass runtime_env per task/actor instead", stacklevel=2)
         connect(address.split("://", 1)[1])
         atexit.register(shutdown)
         return
@@ -81,6 +89,18 @@ def init(
     if object_store_memory is not None:
         kwargs["object_store_memory"] = object_store_memory
     cluster = Cluster(total, worker_env=worker_env, **kwargs)
+    if runtime_env:
+        # job-level default (reference ray.init(runtime_env=...)): merged under
+        # every task/actor runtime_env at submission; agents pre-warm pip/uv
+        # overlays on join (reference per-node runtime-env agent)
+        from ray_tpu.runtime_env import RuntimeEnv
+
+        cluster.default_runtime_env = dict(RuntimeEnv(**runtime_env))
+        # workers submitting nested tasks resolve the default from their env
+        import json as _json
+
+        cluster.worker_env["RAY_TPU_DEFAULT_RUNTIME_ENV"] = _json.dumps(
+            cluster.default_runtime_env)
     global_state.set_cluster(cluster)
     global_state.set_worker(DriverContext(cluster))
     if node_server_port is not None:

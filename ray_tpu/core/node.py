@@ -51,7 +51,11 @@ from ray_tpu.config import CONFIG
 
 def _default_max_workers() -> int:
     return CONFIG.max_workers_per_node  # read at use: env changes apply live
-WORKER_START_TIMEOUT_S = 60.0
+def _worker_start_timeout() -> float:
+    """Read at use: env changes apply live (config.py contract)."""
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.worker_start_timeout_s
 
 
 def _system_memory_fraction() -> Optional[float]:
@@ -81,6 +85,7 @@ class WorkerHandle:
         self.node = node
         self.accel = accel
         self.state = "starting"  # starting | idle | busy | blocked | dead
+        self.started_at = time.time()  # start-timeout watchdog reference point
         self.known_fns: set = set()
         self.inflight: deque = deque()  # TaskSpecs sent, results pending (FIFO)
         self.resources_held: Dict[str, float] = {}
@@ -317,6 +322,9 @@ class Cluster:
         self.store = ObjectStore()
         self.pg_manager = PlacementGroupManager()
         self.worker_env = worker_env or {}
+        # job-level default runtime env (ray.init(runtime_env=...)): merged
+        # under per-call envs at submission, pre-warmed by agents on join
+        self.default_runtime_env: Optional[Dict[str, Any]] = None
         # Node-wide C++ shared-memory arena for large objects (plasma equivalent).
         # Workers attach via the env var; falls back to per-object segments if the
         # native build or shm creation fails.
@@ -484,6 +492,7 @@ class Cluster:
                 "node_id": node_id.hex(),
                 "worker_env": dict(self.worker_env),
                 "object_store_memory": self._object_store_capacity,
+                "default_runtime_env": self.default_runtime_env,
             })
         except Exception:
             return False
@@ -1577,6 +1586,25 @@ class Cluster:
                 pass
             try:
                 self._check_agent_health()
+            except Exception:
+                pass
+            try:
+                self._check_stuck_starting()
+            except Exception:
+                pass
+
+    def _check_stuck_starting(self) -> None:
+        """Kill workers that never complete the spawn handshake (reference
+        worker_register_timeout_seconds): a wedged interpreter in "starting"
+        would otherwise hold a pool slot forever."""
+        timeout = CONFIG.worker_start_timeout_s
+        now = time.time()
+        with self._lock:
+            stuck = [w for n in self._nodes.values() for w in n.workers.values()
+                     if w.state == "starting" and now - w.started_at > timeout]
+        for w in stuck:
+            try:
+                w.process.kill()  # death-cleanup path handles bookkeeping
             except Exception:
                 pass
 

@@ -100,6 +100,21 @@ class NodeAgent:
         assert kind == "welcome", kind
         self.node_id_hex = payload["node_id"]
         self.worker_env = dict(payload.get("worker_env") or {})
+        default_renv = payload.get("default_runtime_env")
+        if default_renv:
+            # reconcile the job-level runtime env on join: build this host's
+            # pip/uv overlays before the first task needs them (reference:
+            # per-node runtime-env agent materializing envs at job start)
+            def _prewarm():
+                try:
+                    from ray_tpu.runtime_env import prewarm
+
+                    prewarm(default_renv)
+                except Exception as e:
+                    print(f"[agent] runtime-env prewarm failed: {e}", flush=True)
+
+            threading.Thread(target=_prewarm, daemon=True,
+                             name="agent-renv-prewarm").start()
         store_bytes = int(payload.get("object_store_memory") or 0)
         from . import object_store
 

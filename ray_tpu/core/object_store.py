@@ -21,7 +21,11 @@ from . import serialization
 from .ids import ObjectID
 
 # Objects below this many serialized bytes travel inline through control pipes.
-INLINE_THRESHOLD = 100 * 1024
+def _inline_threshold() -> int:
+    """Read at use: env changes apply live (config.py contract)."""
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.inline_threshold_bytes
 
 # Location tuples:
 #   ("inline", frame_bytes, is_error)
@@ -117,7 +121,7 @@ def materialize(obj: Any, oid: ObjectID, is_error: bool = False) -> Location:
         device_objects.stash(oid.binary(), obj)
     ser = serialization.serialize(obj)
     size = ser.frame_bytes
-    if size < INLINE_THRESHOLD:
+    if size < _inline_threshold():
         return ("inline", ser.to_bytes(), is_error)
     arena = _default_arena()
     if arena is not None:
@@ -184,7 +188,7 @@ def write_raw(data: bytes, oid: ObjectID, is_error: bool = False) -> Location:
     """Place already-serialized frame bytes locally (receiving side of a
     cross-host transfer): arena first, per-object segment fallback."""
     size = len(data)
-    if size < INLINE_THRESHOLD:
+    if size < _inline_threshold():
         return ("inline", bytes(data), is_error)
     arena = _default_arena()
     if arena is not None:

@@ -14,7 +14,13 @@ import cloudpickle
 from . import global_state
 from .ids import ObjectID, TaskID
 from .object_ref import ObjectRef
-from .object_store import INLINE_THRESHOLD
+
+
+def _resolved_renv(per_call):
+    from ray_tpu.runtime_env import resolved_runtime_env
+
+    return resolved_runtime_env(per_call)
+from .object_store import _inline_threshold
 from .task_spec import TaskSpec, _RefMarker
 
 _DEFAULT_TASK_OPTIONS = dict(
@@ -63,7 +69,7 @@ def encode_args(ctx, args, kwargs):
     proc_args = [enc(a) for a in args]
     proc_kwargs = {k: enc(v) for k, v in kwargs.items()}
     meta = cloudpickle.dumps((proc_args, proc_kwargs), protocol=5)
-    if len(meta) > INLINE_THRESHOLD:
+    if len(meta) > _inline_threshold():
         # Move every non-trivial argument through the object store (zero-copy shm)
         # instead of copying it through the control pipe with every dispatch.
         def enc_big(a):
@@ -158,7 +164,7 @@ class RemoteFunction:
             # lifted only with generator checkpointing, which we don't do)
             max_retries=0 if streaming else opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_resolved_renv(opts.get("runtime_env")),
             trace_ctx=_trace_ctx(),
         )
         refs = ctx.submit(spec)

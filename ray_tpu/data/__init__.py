@@ -27,6 +27,10 @@ from .datasource import (  # noqa: F401
     ParquetDatasource,
     RangeDatasource,
     TextDatasource,
+    BigQueryDatasource,
+    LanceDatasource,
+    TFRecordDatasource,
+    WebDatasetDatasource,
 )
 from .iterator import DataIterator  # noqa: F401
 from .logical import Read
@@ -91,6 +95,32 @@ def read_text(paths, *, parallelism: int = -1, **kw) -> Dataset:
     return _read(TextDatasource(paths, **kw), parallelism)
 
 
+def read_webdataset(paths, *, decode: bool = True, parallelism: int = -1) -> Dataset:
+    """Tar shards of key-grouped samples (reference read_webdataset /
+    webdataset_datasource.py): {"__key__", <ext>: decoded member, ...} rows."""
+    return _read(WebDatasetDatasource(paths, decode=decode), parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    """TFRecord files of tf.train.Example protos -> one column per feature
+    (reference read_tfrecords; needs tensorflow)."""
+    return _read(TFRecordDatasource(paths), parallelism)
+
+
+def read_lance(uri: str, *, columns: Optional[List[str]] = None,
+               parallelism: int = -1) -> Dataset:
+    """Lance table (reference read_lance; needs the optional 'lance' package)."""
+    return _read(LanceDatasource(uri, columns=columns), parallelism)
+
+
+def read_bigquery(project_id: str, *, dataset: Optional[str] = None,
+                  query: Optional[str] = None, parallelism: int = -1) -> Dataset:
+    """BigQuery table or query (reference read_bigquery; needs
+    'google-cloud-bigquery')."""
+    return _read(BigQueryDatasource(project_id, dataset=dataset, query=query),
+                 parallelism)
+
+
 def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
     return _read(ds, parallelism)
 
@@ -115,6 +145,10 @@ __all__ = [
     "read_json",
     "read_binary_files",
     "read_text",
+    "read_webdataset",
+    "read_tfrecords",
+    "read_lance",
+    "read_bigquery",
     "read_datasource",
     "AggregateFn",
     "Count",

@@ -143,8 +143,16 @@ class BlockAccessor:
             col = self._table.column(name)
             shape = self._tensor_shape(name)
             if shape is not None or pa.types.is_fixed_size_list(col.type) or pa.types.is_list(col.type):
-                arr = np.asarray(col.combine_chunks().to_pylist())
-                if shape is not None:
+                vals = col.combine_chunks().to_pylist()
+                try:
+                    arr = np.asarray(vals)
+                except ValueError:
+                    # ragged list column (rows of differing length / None):
+                    # element-wise object fill — np.asarray refuses these
+                    arr = np.empty(len(vals), dtype=object)
+                    for i, v in enumerate(vals):
+                        arr[i] = np.asarray(v) if isinstance(v, list) else v
+                if shape is not None and arr.dtype != object:
                     arr = arr.reshape((len(arr),) + tuple(shape))
             else:
                 try:

@@ -280,6 +280,16 @@ class Dataset:
     def write_json(self, path: str) -> List[str]:
         return self._write(JSONDatasink(path))
 
+    def write_webdataset(self, path: str) -> List[str]:
+        from .datasource import WebDatasetDatasink
+
+        return self._write(WebDatasetDatasink(path))
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        from .datasource import TFRecordDatasink
+
+        return self._write(TFRecordDatasink(path))
+
     # -- conversion -----------------------------------------------------------
     def to_pandas(self):
         return BlockAccessor.concat([ray_tpu.get(b) for b, _ in self._bundles()]).to_pandas()

@@ -39,6 +39,15 @@ class Datasource:
         return type(self).__name__.replace("Datasource", "")
 
 
+def _object_column(vals: List[Any]) -> np.ndarray:
+    """Ragged/mixed values -> 1-D object array. np.asarray(..., dtype=object)
+    raises on inhomogeneous ndarray elements; element-wise fill never does."""
+    out = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        out[i] = v
+    return out
+
+
 def _expand_paths(paths) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]
@@ -101,7 +110,22 @@ class ItemsDatasource(Datasource):
 
             def fn(chunk=chunk):
                 if chunk and isinstance(chunk[0], dict):
-                    yield pa.Table.from_pylist(chunk)
+                    if any(isinstance(v, np.ndarray) and v.ndim >= 1
+                           for v in chunk[0].values()):
+                        # tensor-valued rows: from_pylist can't nest multi-dim
+                        # ndarrays — assemble columns so batch_to_block makes
+                        # FixedSizeList tensor columns
+                        cols = {}
+                        for c in chunk[0]:
+                            vals = [r[c] for r in chunk]
+                            if isinstance(vals[0], np.ndarray) and len(
+                                    {v.shape for v in vals}) == 1:
+                                cols[c] = np.stack(vals)
+                            else:
+                                cols[c] = _object_column(vals)
+                        yield BlockAccessor.batch_to_block(cols)
+                    else:
+                        yield pa.Table.from_pylist(chunk)
                 else:
                     yield BlockAccessor.batch_to_block({"item": np.asarray(chunk)})
 
@@ -197,6 +221,182 @@ class ImageDatasource(_FileDatasource):
         })
 
 
+class WebDatasetDatasource(_FileDatasource):
+    """POSIX-tar shards, one sample per key prefix (reference
+    _internal/datasource/webdataset_datasource.py). Members named
+    ``<key>.<ext>`` group into one row ``{"__key__": key, ext: decoded, ...}``;
+    decoding by extension: jpg/jpeg/png -> HWC uint8 tensor, json -> object,
+    cls -> int, txt -> str, npy -> ndarray, anything else -> raw bytes."""
+
+    def __init__(self, paths, decode: bool = True):
+        super().__init__(paths)
+        self.decode = decode
+
+    def _decode_member(self, ext: str, data: bytes):
+        if not self.decode:
+            return data
+        if ext in ("jpg", "jpeg", "png", "ppm", "bmp"):
+            import io
+
+            from PIL import Image
+
+            with Image.open(io.BytesIO(data)) as im:
+                return np.asarray(im.convert("RGB"))
+        if ext == "json":
+            import json
+
+            return json.loads(data)
+        if ext == "cls":
+            return int(data.decode())
+        if ext in ("txt", "text"):
+            return data.decode()
+        if ext == "npy":
+            import io
+
+            return np.load(io.BytesIO(data), allow_pickle=False)
+        return data
+
+    def _read_file(self, path: str) -> Block:
+        import tarfile
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                if "." not in base:
+                    key, ext = base, ""
+                else:
+                    key, ext = base.split(".", 1)
+                    ext = ext.lower()
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                data = tf.extractfile(member).read()
+                # decode by the FINAL extension segment (webdataset convention:
+                # "seg.png", "img.npy"); a trailing .npy strips off the column
+                # name so ndarray columns round-trip under their own name
+                last = ext.rsplit(".", 1)[-1]
+                col = ext[: -len(".npy")] if ext.endswith(".npy") and ext != "npy" \
+                    else ext
+                samples[key][col or "bin"] = self._decode_member(last, data)
+        rows = [samples[k] for k in order]
+        cols: Dict[str, Any] = {}
+        keys = sorted({c for r in rows for c in r})
+        for c in keys:
+            vals = [r.get(c) for r in rows]
+            shapes = {v.shape for v in vals if isinstance(v, np.ndarray)}
+            # stack only when EVERY row has this column as a same-shape array;
+            # ragged/missing members fall back to an object column
+            if vals and len(shapes) == 1 and all(isinstance(v, np.ndarray)
+                                                 for v in vals):
+                cols[c] = np.stack(vals)
+            else:
+                cols[c] = _object_column(vals)
+        return BlockAccessor.batch_to_block(cols)
+
+
+class TFRecordDatasource(_FileDatasource):
+    """TFRecord files of tf.train.Example protos -> one column per feature
+    (reference _internal/datasource/tfrecords_datasource.py). Requires
+    tensorflow for the record reader + proto parsing."""
+
+    def _read_file(self, path: str) -> Block:
+        try:
+            import tensorflow as tf
+        except ImportError as e:
+            raise ImportError("read_tfrecords requires the 'tensorflow' package") from e
+        cols: Dict[str, List[Any]] = {}
+        n = 0
+        for raw in tf.data.TFRecordDataset(path):
+            ex = tf.train.Example()
+            ex.ParseFromString(raw.numpy())
+            for name, feature in ex.features.feature.items():
+                kind = feature.WhichOneof("kind")
+                vals = list(getattr(feature, kind).value)
+                item = vals[0] if len(vals) == 1 else vals
+                cols.setdefault(name, [None] * n).append(item)
+            n += 1
+            for c in cols.values():
+                if len(c) < n:
+                    c.append(None)
+        return BlockAccessor.batch_to_block(
+            {k: np.asarray(v, dtype=object) for k, v in cols.items()})
+
+
+class LanceDatasource(Datasource):
+    """Lance table read (reference _internal/datasource/lance_datasource.py).
+    The 'lance' package is optional; absence raises at read time."""
+
+    def __init__(self, uri: str, columns: Optional[List[str]] = None):
+        try:
+            import lance  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "read_lance requires the 'lance' package, which is not installed "
+                "in this environment") from e
+        self.uri = uri
+        self.columns = columns
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        import lance
+
+        ds = lance.dataset(self.uri)
+        fragments = list(ds.get_fragments())
+
+        def make_fn(frag):
+            def fn():
+                yield frag.to_table(columns=self.columns)
+
+            return fn
+
+        return [ReadTask(make_fn(f),
+                         BlockMetadata(num_rows=-1, size_bytes=0,
+                                       input_files=[self.uri]))
+                for f in fragments] or [ReadTask(
+                    lambda: iter([ds.to_table(columns=self.columns)]),
+                    BlockMetadata(num_rows=-1, size_bytes=0, input_files=[self.uri]))]
+
+
+class BigQueryDatasource(Datasource):
+    """BigQuery read via the storage API (reference
+    _internal/datasource/bigquery_datasource.py). 'google-cloud-bigquery' is
+    optional; absence raises at read time."""
+
+    def __init__(self, project_id: str, dataset: Optional[str] = None,
+                 query: Optional[str] = None):
+        if bool(dataset) == bool(query):
+            raise ValueError("pass exactly one of dataset= or query=")
+        try:
+            from google.cloud import bigquery  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "read_bigquery requires the 'google-cloud-bigquery' package, "
+                "which is not installed in this environment") from e
+        self.project_id = project_id
+        self.dataset = dataset
+        self.query = query
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        project_id, dataset, query = self.project_id, self.dataset, self.query
+
+        def fn():
+            from google.cloud import bigquery as bq
+
+            client = bq.Client(project=project_id)
+            if query:
+                job = client.query(query)
+                yield job.to_arrow()
+            else:
+                table = client.get_table(dataset)
+                yield client.list_rows(table).to_arrow()
+
+        return [ReadTask(fn, BlockMetadata(num_rows=-1, size_bytes=0,
+                                           input_files=[dataset or "query"]))]
+
+
 class NumpyDatasource(Datasource):
     def __init__(self, arrays: Dict[str, np.ndarray]):
         self.arrays = arrays
@@ -275,4 +475,81 @@ class JSONDatasink(_FileDatasink):
         with open(target, "w") as f:
             for r in rows:
                 f.write(json.dumps({k: (v.tolist() if isinstance(v, np.ndarray) else v) for k, v in r.items()}) + "\n")
+        return target
+
+
+class WebDatasetDatasink(_FileDatasink):
+    """One tar shard per write task; rows must carry ``__key__`` plus
+    extension-named columns (the read-side contract, round-trippable)."""
+
+    extension = "tar"
+
+    def write(self, block: Block, task_index: int) -> str:
+        import io
+        import json
+        import tarfile
+
+        target = self._target(task_index)
+        acc = BlockAccessor.for_block(block)
+        with tarfile.open(target, "w") as tf:
+            for i, row in enumerate(acc.iter_rows()):
+                key = str(row.get("__key__", f"{task_index:06d}{i:06d}"))
+                for col, val in row.items():
+                    if col == "__key__":
+                        continue
+                    if isinstance(val, np.ndarray):
+                        buf = io.BytesIO()
+                        np.save(buf, val)
+                        data = buf.getvalue()
+                        # "<col>.npy" so the reader both decodes the npy bytes
+                        # and restores the original column name
+                        name = f"{key}.npy" if col == "npy" else f"{key}.{col}.npy"
+                    elif isinstance(val, bytes):
+                        data, name = val, f"{key}.{col}"
+                    elif isinstance(val, str):
+                        data, name = val.encode(), f"{key}.{col}"
+                    elif isinstance(val, (int, np.integer)):
+                        data, name = str(int(val)).encode(), f"{key}.{col}"
+                    else:
+                        data, name = json.dumps(val).encode(), f"{key}.{col}"
+                    info = tarfile.TarInfo(name=name)
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+        return target
+
+
+class TFRecordDatasink(_FileDatasink):
+    extension = "tfrecords"
+
+    def write(self, block: Block, task_index: int) -> str:
+        import tensorflow as tf
+
+        target = self._target(task_index)
+        acc = BlockAccessor.for_block(block)
+        with tf.io.TFRecordWriter(target) as w:
+            for row in acc.iter_rows():
+                feats = {}
+                for col, val in row.items():
+                    if isinstance(val, (bytes, str)):
+                        b = val.encode() if isinstance(val, str) else val
+                        feats[col] = tf.train.Feature(
+                            bytes_list=tf.train.BytesList(value=[b]))
+                    elif isinstance(val, (int, np.integer)):
+                        feats[col] = tf.train.Feature(
+                            int64_list=tf.train.Int64List(value=[int(val)]))
+                    elif isinstance(val, (float, np.floating)):
+                        feats[col] = tf.train.Feature(
+                            float_list=tf.train.FloatList(value=[float(val)]))
+                    elif isinstance(val, np.ndarray) and val.dtype.kind in "iu":
+                        feats[col] = tf.train.Feature(
+                            int64_list=tf.train.Int64List(value=[int(x) for x in val]))
+                    elif isinstance(val, np.ndarray) and val.dtype.kind == "f":
+                        feats[col] = tf.train.Feature(
+                            float_list=tf.train.FloatList(value=[float(x) for x in val]))
+                    else:
+                        raise TypeError(
+                            f"column {col!r}: cannot encode {type(val).__name__} "
+                            "as a tf.train.Feature")
+                ex = tf.train.Example(features=tf.train.Features(feature=feats))
+                w.write(ex.SerializeToString())
         return target

@@ -58,6 +58,12 @@ class LLMConfig:
     # prompts longer than this prefill in chunks of this many tokens (peak
     # activation memory = one chunk); None = whole-prompt prefill
     prefill_chunk: Optional[int] = None
+    # fused decode burst: run this many decode+sample iterations on-device per
+    # host sync (lax.scan; vLLM multi-step scheduling). >1 amortizes the
+    # per-step host round trip — decisive over a network tunnel, a few percent
+    # on local chips — at the cost of K-token streaming granularity and up to
+    # K-1 wasted steps after a mid-burst EOS
+    num_decode_steps: int = 1
     # parallelism: mesh axes for the in-process device mesh
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1

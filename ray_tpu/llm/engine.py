@@ -623,11 +623,12 @@ class JaxLLMEngine(LLMEngine):
             self._waiting.put(req)  # prefill_kv still set; stays pending
         return ok is True
 
-    def _grow_or_preempt(self) -> None:
+    def _grow_or_preempt(self, headroom: int = 1) -> None:
         """Before a decode step: every active slot whose next write crosses into
         an unallocated block gets one; when the pool is dry, preempt the
         YOUNGEST request (recompute preemption: blocks freed, request re-queued
-        and later re-prefilled from its token history)."""
+        and later re-prefilled from its token history). headroom > 1 reserves
+        room for a fused K-step burst, whose block tables are frozen."""
         from . import paged
 
         for slot in list(self._active):
@@ -641,7 +642,7 @@ class JaxLLMEngine(LLMEngine):
             # may have preempted this very request — growing a preempted slot
             # would leak blocks into it and corrupt a later occupant's table
             while (self._active[slot] is req
-                   and next_write >= self._blocks.slot_capacity(slot)):
+                   and next_write + headroom - 1 >= self._blocks.slot_capacity(slot)):
                 if self._blocks.num_free > 0:
                     (bid,) = self._blocks.allocate(slot, 1)
                     index = self._blocks.slot_capacity(slot) // self.config.kv_block_size - 1
@@ -697,52 +698,92 @@ class JaxLLMEngine(LLMEngine):
                 self._requests.pop(req.id, None)
                 self._aborted.discard(req.id)
 
+    def _burst_width(self) -> int:
+        """How many decode steps this burst may fuse: the configured K capped
+        by every active slot's remaining KV room and max_tokens budget (a slot
+        that would cross either limit mid-burst caps the whole burst — fused
+        steps can't stop one slot early)."""
+        k = max(1, int(self.config.num_decode_steps))
+        if k == 1:
+            return 1
+        for req in self._active.values():
+            if req is None:
+                continue
+            next_write = len(req.prompt_ids) + req.generated - 1
+            kv_room = (self.config.max_model_len - 1) - next_write
+            budget = req.params.max_tokens - req.generated
+            k = min(k, max(1, min(kv_room, budget)))
+        # quantize to a power of two: every distinct K is its own XLA trace
+        # (rngs shape [K]), so free-running K would compile once per value —
+        # this bounds the engine to log2(num_decode_steps)+1 decode programs
+        return 1 << (k.bit_length() - 1)
+
     def _step_decode(self) -> None:
         cfg = self.model_config
+        k_steps = self._burst_width()
         if self.config.kv_layout == "paged":
             from . import paged
 
-            self._grow_or_preempt()
+            self._grow_or_preempt(headroom=k_steps)
+            k_steps = min(k_steps, self._burst_width())  # preemption changed the set
         active_mask = np.array([r is not None for r in self._active.values()], bool)
         if not active_mask.any():
             return  # preemption may have drained every slot this cycle
-        # Also stop slots that hit cache capacity.
-        if self.config.kv_layout == "paged":
-            self.state, logits = paged.decode_step_paged(
+        if self.config.pipeline_parallel_size > 1:
+            k_steps = 1  # PP decode keeps per-step scheduling (microbatch ticks)
+        if k_steps > 1:
+            # fused burst: K decode+sample iterations, ONE host sync
+            # (vLLM multi-step scheduling; decisive over a network tunnel)
+            rngs = jnp.stack([self._next_rng() for _ in range(k_steps)])
+            fused = (paged.decode_multi_paged if self.config.kv_layout == "paged"
+                     else model_runner.decode_multi)
+            self.state, toks_k = fused(
                 self.params, self.state, jnp.asarray(self._last_tokens),
-                jnp.asarray(active_mask), cfg,
-            )
-        elif self.config.pipeline_parallel_size > 1:
-            self.state, logits = self._decode_pp_jit(
-                self.params, self.state, jnp.asarray(self._last_tokens),
-                jnp.asarray(active_mask),
-            )
+                jnp.asarray(active_mask), cfg, rngs,
+                jnp.asarray(self._temp), jnp.asarray(self._top_p),
+                jnp.asarray(self._top_k))
+            toks_burst = np.asarray(toks_k)  # [K, slots] — the only fetch
         else:
-            self.state, logits = model_runner.decode_step(
-                self.params, self.state, jnp.asarray(self._last_tokens),
-                jnp.asarray(active_mask), cfg,
-            )
-        toks = np.asarray(model_runner.sample_tokens(
-            self._next_rng(), logits, jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._top_k)))
-        for slot, req in list(self._active.items()):
-            if req is None:
-                continue
-            tok = int(toks[slot])
-            self._last_tokens[slot] = tok
-            self._emit(req, tok)
-            r2 = self._active[slot]
-            # host mirror of state.lengths: the last sampled token is not yet
-            # written to KV, so device lengths == prompt + generated - 1.
-            # Mirroring avoids a SECOND device round trip per decode step
-            # (pure overhead; brutal through a network tunnel).
-            if r2 is not None and (len(r2.prompt_ids) + r2.generated - 1
-                                   >= self.config.max_model_len - 1):
-                r2.out_queue.put(RequestOutput(
-                    request_id=r2.id, token_ids=[], finished=True, finish_reason="length",
-                    num_prompt_tokens=len(r2.prompt_ids), num_generated_tokens=r2.generated,
-                ))
-                self._release(r2)
+            if self.config.kv_layout == "paged":
+                self.state, logits = paged.decode_step_paged(
+                    self.params, self.state, jnp.asarray(self._last_tokens),
+                    jnp.asarray(active_mask), cfg,
+                )
+            elif self.config.pipeline_parallel_size > 1:
+                self.state, logits = self._decode_pp_jit(
+                    self.params, self.state, jnp.asarray(self._last_tokens),
+                    jnp.asarray(active_mask),
+                )
+            else:
+                self.state, logits = model_runner.decode_step(
+                    self.params, self.state, jnp.asarray(self._last_tokens),
+                    jnp.asarray(active_mask), cfg,
+                )
+            toks_burst = np.asarray(model_runner.sample_tokens(
+                self._next_rng(), logits, jnp.asarray(self._temp),
+                jnp.asarray(self._top_p), jnp.asarray(self._top_k)))[None, :]
+        burst_reqs = {slot: req for slot, req in self._active.items() if req is not None}
+        for t in range(toks_burst.shape[0]):
+            for slot, req in burst_reqs.items():
+                if self._active.get(slot) is not req:
+                    continue  # finished (or aborted) earlier in this burst
+                tok = int(toks_burst[t, slot])
+                self._last_tokens[slot] = tok
+                self._emit(req, tok)
+                r2 = self._active[slot]
+                # host mirror of state.lengths: the last sampled token is not yet
+                # written to KV, so device lengths == prompt + generated - 1.
+                # Mirroring avoids a SECOND device round trip per decode step
+                # (pure overhead; brutal through a network tunnel).
+                if r2 is not None and (len(r2.prompt_ids) + r2.generated - 1
+                                       >= self.config.max_model_len - 1):
+                    r2.out_queue.put(RequestOutput(
+                        request_id=r2.id, token_ids=[], finished=True,
+                        finish_reason="length",
+                        num_prompt_tokens=len(r2.prompt_ids),
+                        num_generated_tokens=r2.generated,
+                    ))
+                    self._release(r2)
 
     def _loop(self) -> None:
         while not self._shutdown:

@@ -233,6 +233,35 @@ def decode_step(
     return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
+def decode_multi(
+    params,
+    state: DecodeState,
+    tokens: jax.Array,  # [slots] int32 — last sampled token per slot
+    active: jax.Array,  # [slots] bool — FIXED for the whole burst
+    cfg: ModelConfig,
+    rngs: jax.Array,  # [K] stacked PRNG keys, one per step
+    temperature: jax.Array,  # [slots] f32
+    top_p: jax.Array,  # [slots] f32
+    top_k: jax.Array,  # [slots] i32
+) -> Tuple[DecodeState, jax.Array]:
+    """K fused decode+sample steps per host sync (vLLM multi-step scheduling).
+
+    Returns (state, tokens_k [K, slots]). Slots that hit EOS mid-burst keep
+    decoding (the host discards their tail), so callers cap K by each slot's
+    remaining KV/max_tokens budget before calling.
+    """
+    def body(carry, rng):
+        st, toks = carry
+        st, logits = decode_step(params, st, toks, active, cfg)
+        nxt = sampling.sample(rng, logits, temperature, top_p, top_k)
+        nxt = jnp.where(active, nxt, toks).astype(jnp.int32)
+        return (st, nxt), nxt
+
+    (state, _), toks_k = jax.lax.scan(body, (state, tokens.astype(jnp.int32)), rngs)
+    return state, toks_k
+
+
 # ------------------------------------------------------- pipeline-parallel decode
 
 def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Array,

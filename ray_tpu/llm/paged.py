@@ -363,6 +363,32 @@ def decode_step_paged(
                       lengths=lengths), logits.astype(jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
+def decode_multi_paged(
+    params,
+    state: PagedState,
+    tokens: jax.Array,  # [slots] int32
+    active: jax.Array,  # [slots] bool — FIXED for the whole burst
+    cfg: ModelConfig,
+    rngs: jax.Array,  # [K] stacked PRNG keys
+    temperature: jax.Array,  # [slots] f32
+    top_p: jax.Array,  # [slots] f32
+    top_k: jax.Array,  # [slots] i32
+):
+    """K fused decode+sample steps against the paged pool (one host sync per
+    burst; vLLM multi-step scheduling). Callers pre-grow every active slot's
+    block table by K tokens — block_tables are frozen across the burst."""
+    def body(carry, rng):
+        st, toks = carry
+        st, logits = decode_step_paged(params, st, toks, active, cfg)
+        nxt = sampling.sample(rng, logits, temperature, top_p, top_k)
+        nxt = jnp.where(active, nxt, toks).astype(jnp.int32)
+        return (st, nxt), nxt
+
+    (state, _), toks_k = jax.lax.scan(body, (state, tokens.astype(jnp.int32)), rngs)
+    return state, toks_k
+
+
 # ------------------------------------------------------------------ chunked prefill
 
 def chunked_prefill(params, prompt_ids: List[int], cfg: ModelConfig,

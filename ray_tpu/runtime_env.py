@@ -3,11 +3,12 @@
 Capability parity: reference python/ray/runtime_env/runtime_env.py:157 (RuntimeEnv)
 + _private/runtime_env/ plugins. Supported here: ``env_vars`` (applied around task
 execution; kept for an actor's lifetime), ``py_modules`` (local paths prepended to
-sys.path), ``working_dir`` (chdir for the duration), ``pip`` (per-env venv with
-system site-packages, content-hash cached in the session dir — reference
-_private/runtime_env/pip.py + uri_cache.py; works offline with local package
-paths / --find-links). Network-or-image plugins (conda/container/uv/image_uri)
-are validated and rejected explicitly rather than silently ignored.
+sys.path), ``working_dir`` (chdir for the duration), ``pip`` and ``uv``
+(per-env package overlays, content-hash cached in the session dir — reference
+_private/runtime_env/pip.py + uv.py + uri_cache.py; work offline with local
+package paths / --find-links; ``uv`` requires the uv binary on PATH).
+Image plugins (conda/container/image_uri) are validated and rejected explicitly
+rather than silently ignored.
 """
 from __future__ import annotations
 
@@ -20,8 +21,8 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-_SUPPORTED = {"env_vars", "py_modules", "working_dir", "pip"}
-_UNSUPPORTED = {"conda", "container", "uv", "image_uri"}
+_SUPPORTED = {"env_vars", "py_modules", "working_dir", "pip", "uv"}
+_UNSUPPORTED = {"conda", "container", "image_uri"}
 
 
 class RuntimeEnv(dict):
@@ -30,7 +31,8 @@ class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  py_modules: Optional[List[str]] = None,
                  working_dir: Optional[str] = None,
-                 pip: Optional[Any] = None, **kwargs):
+                 pip: Optional[Any] = None,
+                 uv: Optional[Any] = None, **kwargs):
         super().__init__()
         bad = set(kwargs) & _UNSUPPORTED
         if bad:
@@ -49,13 +51,16 @@ class RuntimeEnv(dict):
             self["py_modules"] = [str(p) for p in py_modules]
         if working_dir:
             self["working_dir"] = str(working_dir)
-        if pip:
+        for field, spec in (("pip", pip), ("uv", uv)):
+            if not spec:
+                continue
             # list of specs, or {"packages": [...], "no_index": bool, "find_links": [...]}
-            if isinstance(pip, (list, tuple)):
-                pip = {"packages": [str(p) for p in pip]}
-            if not isinstance(pip, dict) or not pip.get("packages"):
-                raise TypeError('pip must be a list of specs or {"packages": [...], ...}')
-            self["pip"] = pip
+            if isinstance(spec, (list, tuple)):
+                spec = {"packages": [str(p) for p in spec]}
+            if not isinstance(spec, dict) or not spec.get("packages"):
+                raise TypeError(
+                    f'{field} must be a list of specs or {{"packages": [...], ...}}')
+            self[field] = spec
         self.update(kwargs)
 
 
@@ -67,19 +72,22 @@ def _envs_root() -> str:
     return os.path.join(default_session_dir(), "runtime_envs")
 
 
-def ensure_pip_env(pip: Dict[str, Any], timeout_s: float = 300.0) -> str:
-    """Install a pip spec into a content-hashed --target dir; returns that dir.
+def ensure_pip_env(pip: Dict[str, Any], timeout_s: float = 300.0,
+                   tool: str = "pip") -> str:
+    """Install a pip/uv spec into a content-hashed --target dir; returns that dir.
 
     A --target overlay (not a full venv) layers the requested packages over the
-    base environment: the running interpreter's setuptools/pip do the build, the
+    base environment: the running interpreter's setuptools/pip (or the uv
+    binary, reference _private/runtime_env/uv.py) do the build, the
     overlay dir rides sys.path like py_modules, and the base image's jax/numpy
     stay untouched. Concurrent workers race through a lockdir; losers wait for
     the .ready marker (reference pip.py builds per-env virtualenvs + URI cache)."""
     if isinstance(pip, (list, tuple)):
         # Ray's list shorthand: plain runtime_env dicts reach here un-normalized
         pip = {"packages": [str(p) for p in pip]}
-    key = hashlib.sha256(json.dumps(pip, sort_keys=True).encode()).hexdigest()[:16]
-    root = os.path.join(_envs_root(), f"pip_{key}")
+    key = hashlib.sha256(json.dumps({"tool": tool, **pip}, sort_keys=True)
+                         .encode()).hexdigest()[:16]
+    root = os.path.join(_envs_root(), f"{tool}_{key}")
     ready = os.path.join(root, ".ready")
     if os.path.exists(ready):
         return root
@@ -101,8 +109,20 @@ def ensure_pip_env(pip: Dict[str, Any], timeout_s: float = 300.0) -> str:
                 time.sleep(0.25)
         if os.path.exists(ready):  # built while we waited
             return root
-        cmd = [sys.executable, "-m", "pip", "install", "--target", root,
-               "--no-build-isolation", "--disable-pip-version-check", "--quiet"]
+        if tool == "uv":
+            import shutil as _shutil
+
+            uv_bin = _shutil.which("uv")
+            if uv_bin is None:
+                raise RuntimeError(
+                    'runtime_env {"uv": ...} requires the uv binary on PATH')
+            # --no-build-isolation: sdist builds use this interpreter's
+            # setuptools, so local-path installs work offline like pip's
+            cmd = [uv_bin, "pip", "install", "--target", root,
+                   "--python", sys.executable, "--no-build-isolation", "--quiet"]
+        else:
+            cmd = [sys.executable, "-m", "pip", "install", "--target", root,
+                   "--no-build-isolation", "--disable-pip-version-check", "--quiet"]
         if pip.get("no_index"):
             cmd.append("--no-index")
         for fl in pip.get("find_links", []):
@@ -118,6 +138,49 @@ def ensure_pip_env(pip: Dict[str, Any], timeout_s: float = 300.0) -> str:
         os.close(fd)  # releases the flock if held
 
 
+def merge_runtime_envs(base: Optional[Dict[str, Any]],
+                       override: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Job-default + per-call merge (reference runtime_env override semantics:
+    per-call fields win whole, except env_vars which dict-merge)."""
+    if not base:
+        return dict(override) if override else None
+    if not override:
+        return dict(base)
+    out = dict(base)
+    out.update({k: v for k, v in override.items() if k != "env_vars"})
+    env_vars = {**(base.get("env_vars") or {}), **(override.get("env_vars") or {})}
+    if env_vars:
+        out["env_vars"] = env_vars
+    return out
+
+
+def resolved_runtime_env(per_call: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """per_call merged over the cluster's job-level default, if any. Workers
+    (nested submissions) read the default from the env var the head plants in
+    worker_env, so the job default survives driver -> worker -> task chains."""
+    from ray_tpu.core import global_state
+
+    c = global_state.try_cluster()
+    default = getattr(c, "default_runtime_env", None) if c is not None else None
+    if default is None and c is None:
+        raw = os.environ.get("RAY_TPU_DEFAULT_RUNTIME_ENV")
+        if raw:
+            with contextlib.suppress(ValueError):
+                default = json.loads(raw)
+    return merge_runtime_envs(default, per_call)
+
+
+def prewarm(runtime_env: Optional[Dict[str, Any]]) -> None:
+    """Build this host's pip/uv overlays ahead of the first task (reference:
+    the per-node runtime-env agent materializing envs at job start)."""
+    if not runtime_env:
+        return
+    for tool in ("pip", "uv"):
+        spec = runtime_env.get(tool)
+        if spec:
+            ensure_pip_env(spec, tool=tool)
+
+
 @contextlib.contextmanager
 def applied(runtime_env: Optional[Dict[str, Any]], permanent: bool = False):
     """Apply env_vars/py_modules/working_dir; restore on exit unless permanent
@@ -131,6 +194,8 @@ def applied(runtime_env: Optional[Dict[str, Any]], permanent: bool = False):
     if runtime_env.get("pip"):
         # venv site-packages rides the same sys.path mechanism as py_modules
         py_modules.insert(0, ensure_pip_env(runtime_env["pip"]))
+    if runtime_env.get("uv"):
+        py_modules.insert(0, ensure_pip_env(runtime_env["uv"], tool="uv"))
 
     saved_env = {k: os.environ.get(k) for k in env_vars}
     saved_cwd = os.getcwd() if working_dir else None

@@ -14,7 +14,14 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Sequence, Tuple
 
-REPORT_INTERVAL_S = 2.0
+def _report_interval() -> float:
+    """Read at use: env changes apply live (config.py contract)."""
+    try:
+        from ray_tpu.config import CONFIG
+
+        return CONFIG.metrics_report_interval_s
+    except Exception:
+        return 2.0
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
@@ -54,7 +61,7 @@ class _Registry:
 
         def loop():
             while True:
-                time.sleep(REPORT_INTERVAL_S)
+                time.sleep(_report_interval())
                 try:
                     snap = self.snapshot()
                     if snap:

@@ -210,3 +210,52 @@ def test_sse_generator_close_aborts_engine_request():
             time.sleep(0.05)
     finally:
         srv.shutdown()
+
+
+@pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+def test_multi_step_decode_matches_single_step(kv_layout):
+    """num_decode_steps>1 fuses K decode+sample iterations per host sync
+    (vLLM multi-step scheduling); greedy output must be IDENTICAL to
+    single-step decode, including EOS-mid-burst and max_tokens cut-offs."""
+    params = llama_init_cached(CFG)
+    prompt = [1, 7, 42, 99, 5]
+    want = reference_greedy(params, prompt, 11)
+
+    cfg = LLMConfig(model_id=f"tiny-ms-{kv_layout}", model_source="test-tiny",
+                    max_num_seqs=4, max_model_len=64, tokenizer="byte",
+                    kv_layout=kv_layout, num_decode_steps=4)
+    eng = JaxLLMEngine(cfg)
+    eng.start()
+    try:
+        # 11 tokens with K=4: two full bursts + a 3-step burst (max_tokens cap)
+        out = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=11, temperature=0.0, stop_token_ids=[-1]))
+        assert out.token_ids == want
+        assert out.num_generated_tokens == 11
+        assert out.finish_reason == "length"
+
+        # mid-burst stop: cut the budget so EOS-style stop lands inside a burst
+        stop_tok = want[5]
+        out2 = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=11, temperature=0.0, stop_token_ids=[stop_tok]))
+        assert out2.token_ids == want[:5]
+        assert out2.finish_reason == "stop"
+
+        # concurrent requests with different lengths share bursts correctly
+        prompts = [[1, 2, 3], [1, 9, 8, 7, 6, 5], [1, 50]]
+        wants = [reference_greedy(params, p, 6) for p in prompts]
+        outs = [None] * len(prompts)
+
+        def run(i):
+            outs[i] = eng.generate_sync(prompts[i], SamplingParams(
+                max_tokens=6, temperature=0.0, stop_token_ids=[-1]))
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for got, want_i in zip(outs, wants):
+            assert got.token_ids == want_i
+    finally:
+        eng.shutdown()

@@ -110,3 +110,106 @@ def test_cluster_kv_persistence_end_to_end(tmp_path, monkeypatch):
         monkeypatch.delenv("RAY_TPU_GCS_PERSISTENCE_PATH")
         ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
                      max_workers_per_node=8)
+
+
+def test_uv_env_builds(tmp_path, monkeypatch):
+    """uv plugin: same overlay contract as pip, built by the uv binary
+    (reference _private/runtime_env/uv.py)."""
+    import shutil
+
+    if shutil.which("uv") is None:
+        pytest.skip("no uv binary")
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path / "session"))
+    pkg = _write_dummy_pkg(tmp_path, name="rtenv_uv_dummy")
+    site = ensure_pip_env({"packages": [pkg], "no_index": True}, tool="uv")
+    assert os.path.isdir(os.path.join(site, "rtenv_uv_dummy"))
+
+
+def test_task_with_uv_runtime_env(rt, tmp_path, monkeypatch):
+    import shutil
+
+    if shutil.which("uv") is None:
+        pytest.skip("no uv binary")
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path / "session"))
+    pkg = _write_dummy_pkg(tmp_path, name="rtenv_uv_task")
+
+    @ray_tpu.remote(runtime_env={"uv": {"packages": [pkg], "no_index": True}})
+    def probe():
+        import rtenv_uv_task
+
+        return rtenv_uv_task.MAGIC
+
+    assert ray_tpu.get(probe.remote()) == "rtenv_uv_task-1.0"
+    with pytest.raises(ImportError):
+        import rtenv_uv_task  # noqa: F401  (driver env stays clean)
+
+
+def test_conda_container_still_rejected():
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    for field in ("conda", "container", "image_uri"):
+        with pytest.raises(ValueError, match="infrastructure"):
+            RuntimeEnv(**{field: {"x": 1}})
+
+
+def test_merge_runtime_envs():
+    from ray_tpu.runtime_env import merge_runtime_envs
+
+    base = {"env_vars": {"A": "1", "B": "1"}, "pip": {"packages": ["x"]}}
+    over = {"env_vars": {"B": "2"}, "working_dir": "/w"}
+    m = merge_runtime_envs(base, over)
+    assert m["env_vars"] == {"A": "1", "B": "2"}  # dict-merge, override wins
+    assert m["pip"] == {"packages": ["x"]} and m["working_dir"] == "/w"
+    assert merge_runtime_envs(None, over) == over
+    assert merge_runtime_envs(base, None) == base
+    assert merge_runtime_envs(None, None) is None
+
+
+@pytest.fixture()
+def default_renv_cluster():
+    """Own cluster with a job-level runtime_env (reference ray.init(runtime_env=...)).
+    Restores the session cluster afterwards."""
+    from ray_tpu.core import global_state
+
+    was_up = global_state.is_initialized()
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                 runtime_env={"env_vars": {"RTENV_JOB_DEFAULT": "yes",
+                                           "RTENV_SHARED": "base"}})
+    yield
+    ray_tpu.shutdown()
+    if was_up:
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)
+
+
+def test_job_level_default_runtime_env(default_renv_cluster):
+    """init(runtime_env=...) applies to every task; per-call env_vars dict-merge
+    over it; nested worker->task submissions inherit the default too."""
+    @ray_tpu.remote
+    def read():
+        import os
+
+        return os.environ.get("RTENV_JOB_DEFAULT"), os.environ.get("RTENV_SHARED")
+
+    assert ray_tpu.get(read.remote()) == ("yes", "base")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_SHARED": "override"}})
+    def read_override():
+        import os
+
+        return os.environ.get("RTENV_JOB_DEFAULT"), os.environ.get("RTENV_SHARED")
+
+    assert ray_tpu.get(read_override.remote()) == ("yes", "override")
+
+    @ray_tpu.remote
+    def outer():
+        @ray_tpu.remote
+        def inner():
+            import os
+
+            return os.environ.get("RTENV_JOB_DEFAULT")
+
+        return ray_tpu.get(inner.remote())
+
+    assert ray_tpu.get(outer.remote()) == "yes"
