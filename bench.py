@@ -119,7 +119,9 @@ def main() -> None:
     # historical llama-500m number rides along: its 1536-wide matmuls cap MFU
     # near 49% on a v5e regardless of software (geometry-bound, not
     # framework-bound); at 8B geometry the same stack reaches ~66%.
-    mfu_8b, _ = run_one("llama8b-geom2", 4, 2048, steps, "dots_no_batch")
+    # remat sweep on the chip: dots 66.0%, dots_no_batch 65.7%, full(b8) 65.7%,
+    # none OOMs — "dots" wins by a hair at this geometry
+    mfu_8b, _ = run_one("llama8b-geom2", 4, 2048, steps, "dots")
     mfu_500m, _ = run_one("llama-500m", 8, 2048, steps, "dots_no_batch")
     result = {
         "metric": "train_mfu_llama8b_geometry_b4_s2048",

@@ -11,8 +11,10 @@ template; gated, since cloud CLIs aren't assumed).
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import subprocess
+import uuid
 from typing import Any, Dict, List, Optional
 
 from .autoscaler import Autoscaler, AutoscalingConfig
@@ -128,6 +130,99 @@ class TPUPodProvider(NodeProvider):
         self._nodes.clear()
 
 
+def _rfc1035(name: str) -> str:
+    """Sanitize to an RFC1035 label fragment (GCP resource-name charset)."""
+    import re as _re
+
+    return _re.sub(r"[^a-z0-9-]", "-", name.lower()).strip("-")
+
+
+class GCPTPUProvider(NodeProvider):
+    """First-class GCP TPU-VM provider over the gcloud CLI (reference
+    python/ray/autoscaler/_private/gcp/node_provider.py — which drives the GCP
+    API; at this layer the CLI is the same contract without vendoring the SDK).
+
+    provider config: project, zone, accelerator_type (e.g. v5litepod-8),
+    runtime_version, optional name_prefix + create_extra_args. Discovery goes
+    through `gcloud ... list --format=json` filtered by the name prefix, so
+    non_terminated_nodes reflects cloud truth and `down` can adopt nodes a
+    previous process created."""
+
+    def __init__(self, node_types: List[NodeType], provider_config: Dict[str, Any],
+                 cluster_name: str = ""):
+        super().__init__(node_types)
+        import shutil
+
+        if shutil.which(provider_config.get("gcloud_bin", "gcloud")) is None:
+            raise RuntimeError(
+                "gcp-tpu provider requires the gcloud CLI on PATH "
+                "(or set provider.gcloud_bin)")
+        for key in ("project", "zone", "accelerator_type", "runtime_version"):
+            if not provider_config.get(key):
+                raise ValueError(f"gcp-tpu provider needs provider.{key}")
+        self.cfg = dict(provider_config)
+        self.gcloud = self.cfg.get("gcloud_bin", "gcloud")
+        # prefix scoped by CLUSTER NAME (reference: cluster-name labels) so two
+        # clusters in one project/zone never adopt or delete each other's TPUs
+        default_prefix = _rfc1035("-".join(filter(None, ["ray-tpu", cluster_name])))
+        self.prefix = self.cfg.get("name_prefix", default_prefix)
+        self._counter = 0
+
+    def _base_args(self) -> List[str]:
+        return [self.gcloud, "compute", "tpus", "tpu-vm"]
+
+    def create_node(self, node_type: str) -> NodeInstance:
+        self._counter += 1
+        # GCP resource names are RFC1035 (lowercase/digits/hyphens)
+        name = (f"{self.prefix}-{_rfc1035(node_type)}-{self._counter}-"
+                f"{uuid.uuid4().hex[:6]}")
+        cmd = self._base_args() + [
+            "create", name,
+            "--project", self.cfg["project"],
+            "--zone", self.cfg["zone"],
+            "--accelerator-type", self.cfg["accelerator_type"],
+            "--version", self.cfg["runtime_version"],
+        ] + list(self.cfg.get("create_extra_args", []))
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        return NodeInstance(instance_id=name, node_type=node_type, status="running")
+
+    def terminate_node(self, instance_id: str) -> None:
+        cmd = self._base_args() + [
+            "delete", instance_id,
+            "--project", self.cfg["project"],
+            "--zone", self.cfg["zone"], "--quiet",
+        ]
+        subprocess.run(cmd, check=False, capture_output=True, text=True)
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        cmd = self._base_args() + [
+            "list", "--project", self.cfg["project"], "--zone", self.cfg["zone"],
+            "--format=json",
+        ]
+        proc = subprocess.run(cmd, check=True, capture_output=True, text=True)
+        out: List[NodeInstance] = []
+        for item in json.loads(proc.stdout or "[]"):
+            name = item.get("name", "").rsplit("/", 1)[-1]
+            if not name.startswith(self.prefix + "-"):
+                continue  # not ours: never adopt someone else's TPUs
+            state = item.get("state", "")
+            if state in ("DELETING", "TERMINATED", "PREEMPTED"):
+                continue
+            # name layout: <prefix>-<rfc1035(node_type)>-<counter>-<rand>
+            body = name[len(self.prefix) + 1:]
+            sanitized = body.rsplit("-", 2)[0] if body.count("-") >= 2 else body
+            node_type = next((t for t in self.node_types
+                              if _rfc1035(t) == sanitized), sanitized)
+            out.append(NodeInstance(instance_id=name, node_type=node_type,
+                                    status="running" if state == "READY"
+                                    else "requested"))
+        return out
+
+    def terminate_all(self) -> None:
+        for inst in self.non_terminated_nodes():
+            self.terminate_node(inst.instance_id)
+
+
 def make_provider(config: ClusterConfig) -> NodeProvider:
     ptype = config.provider.get("type", "fake")
     if ptype == "fake":
@@ -135,7 +230,11 @@ def make_provider(config: ClusterConfig) -> NodeProvider:
                                 launch_delay_steps=int(config.provider.get("launch_delay_steps", 0)))
     if ptype == "tpu-pod":
         return TPUPodProvider(config.node_types(), config.provider)
-    raise ValueError(f"unknown provider type {ptype!r} (supported: fake, tpu-pod)")
+    if ptype == "gcp-tpu":
+        return GCPTPUProvider(config.node_types(), config.provider,
+                              cluster_name=config.cluster_name)
+    raise ValueError(
+        f"unknown provider type {ptype!r} (supported: fake, tpu-pod, gcp-tpu)")
 
 
 class ClusterLauncher:

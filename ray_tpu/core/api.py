@@ -54,12 +54,18 @@ def init(
         from ray_tpu.util.client import connect
 
         if runtime_env:
-            import warnings
+            # job-scoped default for THIS client driver: every spec it builds
+            # goes through resolved_runtime_env(), which falls back to this
+            # env var when no in-process cluster exists — so the default rides
+            # each submitted task/actor without any head-side state
+            import json as _json
 
-            warnings.warn(
-                "init(address=..., runtime_env=...): job-level runtime_env is "
-                "not forwarded to the remote head (the head owns job defaults); "
-                "pass runtime_env per task/actor instead", stacklevel=2)
+            from ray_tpu.runtime_env import RuntimeEnv
+
+            os.environ["RAY_TPU_DEFAULT_RUNTIME_ENV"] = _json.dumps(
+                dict(RuntimeEnv(**runtime_env)))
+            global _client_default_renv_set
+            _client_default_renv_set = True
         connect(address.split("://", 1)[1])
         atexit.register(shutdown)
         return
@@ -114,7 +120,15 @@ def init(
     atexit.register(shutdown)
 
 
+_client_default_renv_set = False
+
+
 def shutdown() -> None:
+    global _client_default_renv_set
+    if _client_default_renv_set:
+        # a stale client-job default must not leak into the next session
+        os.environ.pop("RAY_TPU_DEFAULT_RUNTIME_ENV", None)
+        _client_default_renv_set = False
     from ray_tpu.util.client.client import ClientContext
 
     w = global_state.try_worker()

@@ -1597,7 +1597,7 @@ class Cluster:
         """Kill workers that never complete the spawn handshake (reference
         worker_register_timeout_seconds): a wedged interpreter in "starting"
         would otherwise hold a pool slot forever."""
-        timeout = CONFIG.worker_start_timeout_s
+        timeout = _worker_start_timeout()
         now = time.time()
         with self._lock:
             stuck = [w for n in self._nodes.values() for w in n.workers.values()

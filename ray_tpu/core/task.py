@@ -14,12 +14,6 @@ import cloudpickle
 from . import global_state
 from .ids import ObjectID, TaskID
 from .object_ref import ObjectRef
-
-
-def _resolved_renv(per_call):
-    from ray_tpu.runtime_env import resolved_runtime_env
-
-    return resolved_runtime_env(per_call)
 from .object_store import _inline_threshold
 from .task_spec import TaskSpec, _RefMarker
 
@@ -138,6 +132,8 @@ class RemoteFunction:
         return self._remote(args, kwargs, self._options)
 
     def _remote(self, args, kwargs, opts):
+        from ray_tpu.runtime_env import resolved_runtime_env as _renv
+
         ctx = global_state.worker()
         fn_id, fn_bytes = self._ensure_pickled()
         register_function(ctx, fn_id, fn_bytes)
@@ -164,7 +160,7 @@ class RemoteFunction:
             # lifted only with generator checkpointing, which we don't do)
             max_retries=0 if streaming else opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
-            runtime_env=_resolved_renv(opts.get("runtime_env")),
+            runtime_env=_renv(opts.get("runtime_env")),
             trace_ctx=_trace_ctx(),
         )
         refs = ctx.submit(spec)

@@ -111,15 +111,17 @@ class ItemsDatasource(Datasource):
             def fn(chunk=chunk):
                 if chunk and isinstance(chunk[0], dict):
                     if any(isinstance(v, np.ndarray) and v.ndim >= 1
-                           for v in chunk[0].values()):
+                           for r in chunk for v in r.values()):
                         # tensor-valued rows: from_pylist can't nest multi-dim
                         # ndarrays — assemble columns so batch_to_block makes
                         # FixedSizeList tensor columns
                         cols = {}
                         for c in chunk[0]:
-                            vals = [r[c] for r in chunk]
-                            if isinstance(vals[0], np.ndarray) and len(
-                                    {v.shape for v in vals}) == 1:
+                            vals = [r.get(c) for r in chunk]
+                            shapes = {v.shape for v in vals
+                                      if isinstance(v, np.ndarray)}
+                            if len(shapes) == 1 and all(
+                                    isinstance(v, np.ndarray) for v in vals):
                                 cols[c] = np.stack(vals)
                             else:
                                 cols[c] = _object_column(vals)

@@ -64,6 +64,14 @@ class LLMConfig:
     # on local chips — at the cost of K-token streaming granularity and up to
     # K-1 wasted steps after a mid-burst EOS
     num_decode_steps: int = 1
+    # speculative decoding (reference: vLLM ngram / prompt-lookup): propose up
+    # to this many draft tokens per step by matching the trailing n-gram
+    # against earlier context, verify all of them in ONE forward pass, accept
+    # the longest matching prefix + a bonus token. Greedy (temperature=0)
+    # requests only; slot KV layout; dense models. 0 = off
+    num_speculative_tokens: int = 0
+    speculative_method: str = "ngram"
+    ngram_prompt_lookup_max: int = 3
     # parallelism: mesh axes for the in-process device mesh
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1

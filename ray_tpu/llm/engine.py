@@ -105,6 +105,8 @@ class JaxLLMEngine(LLMEngine):
         self.total_generated = 0
         self.num_preemptions = 0
         self.num_aborted = 0
+        self.num_spec_drafted = 0
+        self.num_spec_accepted = 0
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> None:
@@ -175,6 +177,22 @@ class JaxLLMEngine(LLMEngine):
                         "(chunked KV installs block-by-block)")
             elif c.kv_layout != "slot":
                 raise ValueError(f"unknown kv_layout {c.kv_layout!r}")
+            if c.num_speculative_tokens:
+                if c.speculative_method != "ngram":
+                    raise NotImplementedError(
+                        f"speculative_method {c.speculative_method!r}: only "
+                        "'ngram' (prompt lookup) is implemented")
+                if c.kv_layout != "slot":
+                    raise NotImplementedError(
+                        "speculative decoding requires kv_layout='slot' "
+                        "(paged verify-window writes are not wired)")
+                if c.pipeline_parallel_size > 1 or c.num_decode_steps > 1:
+                    raise NotImplementedError(
+                        "speculative decoding composes with neither pp decode "
+                        "nor fused multi-step bursts")
+                if cfg.n_experts > 0:
+                    raise NotImplementedError(
+                        "speculative decoding: dense models only")
             if c.prefill_chunk and c.max_model_len % c.prefill_chunk:
                 # guarantees a chunk-padded prompt never exceeds max_model_len
                 # (the block table / slot cache width)
@@ -382,6 +400,8 @@ class JaxLLMEngine(LLMEngine):
             "total_generated": self.total_generated,
             "num_preemptions": self.num_preemptions,
             "num_aborted": self.num_aborted,
+            "num_spec_drafted": self.num_spec_drafted,
+            "num_spec_accepted": self.num_spec_accepted,
         }
         blocks = getattr(self, "_blocks", None)
         if blocks is not None:
@@ -394,7 +414,30 @@ class JaxLLMEngine(LLMEngine):
                 "prefix_cache_hit_tokens": blocks.hit_tokens,
                 "prefix_cached_blocks": len(blocks.cached),
             })
+        self._export_metrics(out)
         return out
+
+    def _export_metrics(self, snap: Dict[str, Any]) -> None:
+        """Mirror the engine counters into the cluster metric registry so they
+        ride /metrics -> Prometheus/Grafana (reference: vllm stat loggers
+        feeding Ray metrics)."""
+        try:
+            from ray_tpu.util.metrics import Gauge
+
+            tags = {"model": str(self.config.model_id)}
+            for name, value in snap.items():
+                if not isinstance(value, (int, float)):
+                    continue
+                # module-level cache: engines share one gauge per metric name
+                # (the model tag separates them); per-engine gauges would
+                # evict each other from the process registry
+                g = _PROM_GAUGES.get(name)
+                if g is None:
+                    g = Gauge(f"llm_{name}", f"engine {name}", tag_keys=("model",))
+                    _PROM_GAUGES[name] = g
+                g.set(float(value), tags=tags)
+        except Exception:
+            pass  # metrics must never take the engine down
 
     # -- scheduler loop ------------------------------------------------------------
     def _free_slots(self) -> List[int]:
@@ -698,6 +741,79 @@ class JaxLLMEngine(LLMEngine):
                 self._requests.pop(req.id, None)
                 self._aborted.discard(req.id)
 
+    def _propose_ngram(self, req: "_Request", k: int) -> List[int]:
+        """Prompt-lookup drafts (reference vLLM ngram speculator): find the
+        most recent earlier occurrence of the trailing n-gram (longest n
+        first) and propose the tokens that followed it."""
+        ctx = req.token_history  # prompt + every generated token
+        if len(ctx) < 2:
+            return []
+        for n in range(min(self.config.ngram_prompt_lookup_max, len(ctx) - 1), 0, -1):
+            tail = ctx[-n:]
+            # rightmost match strictly before the tail itself
+            for start in range(len(ctx) - n - 1, -1, -1):
+                if ctx[start:start + n] == tail:
+                    cont = ctx[start + n:start + n + k]
+                    if cont:
+                        return cont
+                    break
+        return []
+
+    def _step_decode_spec(self) -> None:
+        """Speculative decode step: host proposes drafts by n-gram lookup, ONE
+        verify forward scores the whole window, accepted prefix + bonus token
+        all emit this step (greedy slots only; others ride along with k=0)."""
+        cfg = self.model_config
+        c = self.config
+        k = c.num_speculative_tokens
+        wlen = k + 1
+        n = c.max_num_seqs
+        window = np.zeros((n, wlen), np.int32)
+        draft_len = np.zeros((n,), np.int32)
+        active_mask = np.zeros((n,), bool)
+        for slot, req in self._active.items():
+            if req is None:
+                continue
+            active_mask[slot] = True
+            window[slot, 0] = self._last_tokens[slot]
+            if self._temp[slot] > 0:
+                continue  # greedy-accept is exact only at temperature 0
+            next_write = len(req.prompt_ids) + req.generated - 1
+            room = (c.max_model_len - 1) - next_write - 1
+            budget = req.params.max_tokens - req.generated - 1
+            cap = max(0, min(k, room, budget))
+            drafts = self._propose_ngram(req, cap) if cap else []
+            draft_len[slot] = len(drafts)
+            if drafts:
+                window[slot, 1:1 + len(drafts)] = drafts
+                self.num_spec_drafted += len(drafts)
+        self.state, out_toks, n_acc = model_runner.spec_verify_step(
+            self.params, self.state, jnp.asarray(window), jnp.asarray(draft_len),
+            jnp.asarray(active_mask), cfg, self._next_rng(),
+            jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._top_k))
+        out_toks, n_acc = jax.device_get((out_toks, n_acc))
+        burst_reqs = {s: r for s, r in self._active.items() if r is not None}
+        for slot, req in burst_reqs.items():
+            acc = int(n_acc[slot])
+            self.num_spec_accepted += min(acc, int(draft_len[slot]))
+            for t in range(acc + 1):
+                if self._active.get(slot) is not req:
+                    break  # finished mid-emit: discard speculated tail
+                tok = int(out_toks[slot, t])
+                self._last_tokens[slot] = tok
+                self._emit(req, tok)
+                r2 = self._active.get(slot)
+                if r2 is not None and (len(r2.prompt_ids) + r2.generated - 1
+                                       >= c.max_model_len - 1):
+                    r2.out_queue.put(RequestOutput(
+                        request_id=r2.id, token_ids=[], finished=True,
+                        finish_reason="length",
+                        num_prompt_tokens=len(r2.prompt_ids),
+                        num_generated_tokens=r2.generated,
+                    ))
+                    self._release(r2)
+
     def _burst_width(self) -> int:
         """How many decode steps this burst may fuse: the configured K capped
         by every active slot's remaining KV room and max_tokens budget (a slot
@@ -720,6 +836,9 @@ class JaxLLMEngine(LLMEngine):
 
     def _step_decode(self) -> None:
         cfg = self.model_config
+        if self.config.num_speculative_tokens:
+            self._step_decode_spec()
+            return
         k_steps = self._burst_width()
         if self.config.kv_layout == "paged":
             from . import paged
@@ -827,6 +946,7 @@ class JaxLLMEngine(LLMEngine):
 
 
 _INIT_CACHE: Dict[str, Any] = {}
+_PROM_GAUGES: Dict[str, Any] = {}  # engine metric name -> shared Gauge
 
 
 def llama_init_cached(cfg):

@@ -233,6 +233,110 @@ def decode_step(
     return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
 
 
+def _verify_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
+    """One layer over a W-token verify window for every slot (speculative
+    decoding): x [S,W,D], K/V written at positions lengths[s]+0..W-1 (writes
+    past max_len dropped), each query w attends to cache positions
+    <= lengths[s]+w (causal within the window, full history before it)."""
+    dt = x.dtype
+    s, wlen, _ = x.shape
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    g = cfg.n_heads // kvh
+    pos = lengths[:, None] + jnp.arange(wlen)[None, :]  # [S,W]
+
+    h = llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("sld,dhk->slhk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("sld,dhk->slhk", h, lp["wk"].astype(dt))
+    vv = jnp.einsum("sld,dhk->slhk", h, lp["wv"].astype(dt))
+    q = llama.rope(q, pos, cfg.rope_theta)
+    k = llama.rope(k, pos, cfg.rope_theta)
+
+    rows = jnp.arange(s)[:, None]
+    nk = ck.at[rows, pos].set(k.astype(ck.dtype), mode="drop")
+    nv = cv.at[rows, pos].set(vv.astype(cv.dtype), mode="drop")
+    max_len = ck.shape[1]
+
+    qg = q.reshape(s, wlen, kvh, g, hd) * (hd**-0.5)
+    scores = jnp.einsum("swkgd,stkd->swkgt", qg.astype(jnp.float32),
+                        nk.astype(jnp.float32))
+    valid = (jnp.arange(max_len)[None, None, :] <= pos[:, :, None])  # [S,W,T]
+    scores = jnp.where(valid[:, :, None, None, :], scores, sampling.NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("swkgt,stkd->swkgd", w, nv.astype(jnp.float32)).astype(dt)
+    o = o.reshape(s, wlen, cfg.n_heads, hd)
+    x = x + jnp.einsum("slhk,hkd->sld", o, lp["wo"].astype(dt))
+
+    h = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("sld,df->slf", h, lp["w_gate"].astype(dt))
+    up = jnp.einsum("sld,df->slf", h, lp["w_up"].astype(dt))
+    down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt))
+    return x + down, nk, nv
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
+def spec_verify_step(
+    params,
+    state: DecodeState,
+    window: jax.Array,  # [S,W] int32 — [last_token, draft_1..draft_k] (0-padded)
+    draft_len: jax.Array,  # [S] int32 — valid drafts per slot (<= W-1)
+    active: jax.Array,  # [S] bool
+    cfg: ModelConfig,
+    rng: jax.Array,
+    temperature: jax.Array,  # [S] f32
+    top_p: jax.Array,  # [S] f32
+    top_k: jax.Array,  # [S] i32
+) -> Tuple[DecodeState, jax.Array, jax.Array]:
+    """Speculative verify (reference: vLLM ngram/prompt-lookup spec decoding):
+    ONE forward over the W-token window scores every draft; greedy
+    accept = longest prefix where draft[i] == argmax(logits[i-1]).
+
+    Returns (state, out_tokens [S,W], n_accepted [S]): out_tokens[s,:n+1] are
+    this step's emitted tokens (n accepted drafts + 1 bonus/correction);
+    lengths advance by n+1 for active slots. Dense models only (MoE routing
+    over the window is not wired)."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError("speculative decoding: dense models only")
+    x = params["embed"].astype(cfg.activation_dtype)[window]  # [S,W,D]
+    wlen = window.shape[1]
+
+    if cfg.scan_layers:
+        def body(carry, xs):
+            h = carry
+            lp, ck, cv = xs
+            h, ck, cv = _verify_block(h, lp, cfg, ck, cv, state.lengths, active)
+            return h, (ck, cv)
+
+        x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], state.k, state.v))
+    else:
+        nk, nv = [], []
+        for i, lp in enumerate(params["layers"]):
+            x, ck, cv = _verify_block(x, lp, cfg, state.k[i], state.v[i],
+                                      state.lengths, active)
+            nk.append(ck)
+            nv.append(cv)
+        nk, nv = jnp.stack(nk), jnp.stack(nv)
+
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))
+    greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)  # [S,W]
+    # sampled (temperature>0) slots carry no drafts, so their one emitted token
+    # is out[:, 0] — draw it properly instead of silently going greedy
+    # (sample() itself falls back to argmax for temperature<=0 rows)
+    tok0 = sampling.sample(rng, logits[:, 0].astype(jnp.float32),
+                           temperature, top_p, top_k)
+    greedy = greedy.at[:, 0].set(jnp.where(temperature > 0, tok0, greedy[:, 0]))
+
+    draft = window[:, 1:]  # [S,W-1]
+    idx = jnp.arange(wlen - 1)[None, :]
+    match = (draft == greedy[:, :-1]) & (idx < draft_len[:, None])
+    # longest all-accepted prefix
+    n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)  # [S]
+    advance = jnp.where(active, n_acc + 1, 0)
+    lengths = state.lengths + advance
+    return DecodeState(k=nk, v=nv, lengths=lengths), greedy, n_acc
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
 def decode_multi(
     params,

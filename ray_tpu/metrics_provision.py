@@ -39,6 +39,9 @@ def default_dashboard() -> dict:
         ("Object store: objects", "ray_tpu_object_store_num_objects", "short"),
         ("Object store: arena bytes", "ray_tpu_object_store_arena_bytes", "bytes"),
         ("Object store: shm bytes", "ray_tpu_object_store_shm_bytes", "bytes"),
+        ("LLM: generated tokens", "ray_tpu_llm_total_generated", "short"),
+        ("LLM: KV pool occupancy", "ray_tpu_llm_kv_pool_occupancy", "percentunit"),
+        ("LLM: preemptions", "ray_tpu_llm_num_preemptions", "short"),
         ("User metrics (ray_tpu_*)", '{__name__=~"ray_tpu_.+"}', "short"),
     ]
     panels = [

@@ -138,3 +138,83 @@ def test_cli_down_adopts_recorded_instances(tmp_path, monkeypatch):
     assert launcher.down() == 2
     terminated = [l for l in log.read_text().splitlines() if l.startswith("terminate")]
     assert len(terminated) == 2
+
+
+def test_gcp_tpu_provider_with_fake_gcloud(tmp_path):
+    """First-class GCP TPU-VM provider (reference autoscaler/_private/gcp):
+    create/list/delete drive the gcloud CLI; discovery is prefix-scoped JSON so
+    the provider only ever adopts its own TPUs."""
+    import json as _json
+    import os
+    import stat
+
+    from ray_tpu.autoscaler.launcher import ClusterConfig, GCPTPUProvider, make_provider
+
+    state = tmp_path / "tpus.json"
+    state.write_text("[]")
+    shim = tmp_path / "gcloud"
+    shim.write_text(f"""#!/usr/bin/env python3
+import json, sys
+state_path = {str(state)!r}
+tpus = json.load(open(state_path))
+args = sys.argv[1:]
+assert args[:4] == ["compute", "tpus", "tpu-vm", args[3]]
+op = args[3]
+if op == "create":
+    name = args[4]
+    assert "--accelerator-type" in args and "--version" in args
+    tpus.append({{"name": "projects/p/locations/z/nodes/" + name, "state": "READY"}})
+elif op == "delete":
+    name = args[4]
+    tpus = [t for t in tpus if not t["name"].endswith("/" + name)]
+elif op == "list":
+    print(json.dumps(tpus))
+json.dump(tpus, open(state_path, "w"))
+""")
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+
+    cfg = ClusterConfig.from_dict({
+        "cluster_name": "g",
+        "provider": {"type": "gcp-tpu", "project": "p", "zone": "z",
+                     "accelerator_type": "v5litepod-8",
+                     "runtime_version": "tpu-ubuntu2204-base",
+                     "gcloud_bin": str(shim), "name_prefix": "rtx"},
+        "available_node_types": {
+            "head": {"resources": {"CPU": 4}, "max_workers": 1},
+            "tpu_worker": {"resources": {"CPU": 8, "TPU": 8}, "max_workers": 4},
+        },
+        "head_node_type": "head",
+    })
+    provider = make_provider(cfg)
+    assert isinstance(provider, GCPTPUProvider)
+
+    a = provider.create_node("tpu_worker")
+    b = provider.create_node("tpu_worker")
+    # GCP names are RFC1035: underscores sanitized; discovery maps back
+    assert a.instance_id.startswith("rtx-tpu-worker-")
+    # a foreign TPU in the same zone must be invisible to discovery
+    tpus = _json.loads(state.read_text())
+    tpus.append({"name": "projects/p/locations/z/nodes/other-team-tpu", "state": "READY"})
+    state.write_text(_json.dumps(tpus))
+
+    live = provider.non_terminated_nodes()
+    assert {i.instance_id for i in live} == {a.instance_id, b.instance_id}
+    assert all(i.node_type == "tpu_worker" for i in live)
+
+    provider.terminate_node(a.instance_id)
+    assert {i.instance_id for i in provider.non_terminated_nodes()} == {b.instance_id}
+    provider.terminate_all()
+    assert provider.non_terminated_nodes() == []
+    # the foreign TPU survived our terminate_all
+    assert any("other-team-tpu" in t["name"] for t in _json.loads(state.read_text()))
+
+
+def test_gcp_tpu_provider_validates_config(tmp_path):
+    from ray_tpu.autoscaler.launcher import GCPTPUProvider
+    from ray_tpu.autoscaler.node_provider import NodeType
+
+    types = [NodeType(name="w", resources={"CPU": 1})]
+    with pytest.raises(RuntimeError, match="gcloud"):
+        GCPTPUProvider(types, {"gcloud_bin": str(tmp_path / "missing"),
+                               "project": "p", "zone": "z",
+                               "accelerator_type": "x", "runtime_version": "y"})

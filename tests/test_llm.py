@@ -259,3 +259,104 @@ def test_multi_step_decode_matches_single_step(kv_layout):
             assert got.token_ids == want_i
     finally:
         eng.shutdown()
+
+
+def test_engine_metrics_export_to_prometheus(engine):
+    """engine.metrics() mirrors its counters into the cluster metric registry
+    (reference: vllm stat loggers -> Ray metrics -> dashboard)."""
+    engine.generate_sync([1, 2, 3], SamplingParams(max_tokens=2, temperature=0.0,
+                                                   stop_token_ids=[-1]))
+    snap = engine.metrics()
+    assert snap["total_generated"] >= 2
+    from ray_tpu.util import metrics as m
+
+    merged = m.merge_snapshots([m._registry.snapshot()])
+    assert "llm_total_generated" in merged
+    assert merged["llm_num_active"]["type"] == "gauge"
+
+
+def test_ngram_speculative_decode_matches_greedy():
+    """Speculative decoding (reference: vLLM ngram spec decode): drafts are
+    verified in one forward pass and greedy output must be IDENTICAL to plain
+    decode whatever the draft quality. An untrained model generates novel
+    tokens, so prompt-lookup rarely fires on its own — the acceptance path is
+    driven with oracle (and deliberately wrong) drafts via the proposer seam."""
+    params = llama_init_cached(CFG)
+    prompt = [1, 10, 11, 12, 13]
+    want = reference_greedy(params, prompt, 12)
+
+    cfg = LLMConfig(model_id="tiny-spec", model_source="test-tiny",
+                    max_num_seqs=2, max_model_len=64, tokenizer="byte",
+                    num_speculative_tokens=4)
+    eng = JaxLLMEngine(cfg)
+    eng.start()
+    try:
+        # 1. real ngram proposer end-to-end (drafts mostly miss; output exact)
+        out = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=12, temperature=0.0, stop_token_ids=[-1]))
+        assert out.token_ids == want
+        assert out.num_generated_tokens == 12
+
+        # 2. oracle speculator: always drafts the true continuation -> every
+        # draft accepted, output still exact, finishes in ~3 verify steps
+        oracle = {tuple(prompt + want[:i]): want[i:i + 4]
+                  for i in range(len(want))}
+
+        def oracle_propose(req, cap):
+            return list(oracle.get(tuple(req.token_history), []))[:cap]
+
+        eng._propose_ngram = oracle_propose
+        out2 = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=12, temperature=0.0, stop_token_ids=[-1]))
+        assert out2.token_ids == want
+        m = eng.metrics()
+        assert m["num_spec_accepted"] >= 8, m  # bulk of tokens via acceptance
+
+        # 3. adversarial speculator: all drafts wrong -> all rejected, output
+        # STILL exact (rejection rolls the window back correctly)
+        eng._propose_ngram = lambda req, cap: [7] * cap
+        accepted_before = eng.metrics()["num_spec_accepted"]
+        out3 = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=12, temperature=0.0, stop_token_ids=[-1]))
+        assert out3.token_ids == want
+        # wrong drafts may collide with the true token by chance; near-zero
+        assert eng.metrics()["num_spec_accepted"] - accepted_before <= 2
+
+        # 4. sampled (temperature>0) requests ride along un-speculated AND
+        # actually sample: at temperature 5 an untrained model is near-uniform
+        # over 256 byte tokens, so matching the greedy continuation would be
+        # astronomically unlikely (regression: spec path silently going argmax)
+        eng._propose_ngram = lambda req, cap: []
+        out4 = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=6, temperature=5.0, stop_token_ids=[-1]))
+        assert out4.num_generated_tokens == 6
+        assert out4.token_ids != want[:6]
+    finally:
+        eng.shutdown()
+
+
+def test_ngram_proposer_lookup():
+    """Prompt-lookup proposes the continuation of the most recent earlier
+    occurrence of the trailing n-gram (longest n first)."""
+    from ray_tpu.llm.engine import JaxLLMEngine, _Request
+
+    eng = JaxLLMEngine(LLMConfig(model_id="pl", model_source="test-tiny",
+                                 num_speculative_tokens=4))
+    req = _Request("r", [1, 10, 11, 12, 13, 10, 11, 12, 13, 10, 11, 12, 13],
+                   SamplingParams(max_tokens=4))
+    assert eng._propose_ngram(req, 4) == [10, 11, 12, 13]
+    req2 = _Request("r2", [1, 2, 3, 4, 5], SamplingParams(max_tokens=4))
+    assert eng._propose_ngram(req2, 4) == []
+
+
+def test_speculative_config_validation():
+    from ray_tpu.llm import JaxLLMEngine, LLMConfig
+
+    eng = JaxLLMEngine(LLMConfig(model_id="sv", model_source="test-tiny",
+                                 kv_layout="paged", num_speculative_tokens=4))
+    with pytest.raises(NotImplementedError, match="slot"):
+        eng.start()
+    eng2 = JaxLLMEngine(LLMConfig(model_id="sv2", model_source="test-tiny",
+                                  num_speculative_tokens=4, num_decode_steps=8))
+    with pytest.raises(NotImplementedError, match="compose"):
+        eng2.start()

@@ -174,3 +174,40 @@ def test_random_authkey_persisted_and_loaded(tmp_path, monkeypatch):
         assert load_authkey() == srv.authkey
     finally:
         srv.close()
+
+
+def _renv_client_driver(port, q):
+    try:
+        import os
+
+        import ray_tpu
+
+        ray_tpu.init(address=f"ray-tpu://127.0.0.1:{port}",
+                     runtime_env={"env_vars": {"CLIENT_JOB_DEFAULT": "set"}})
+
+        @ray_tpu.remote
+        def probe():
+            import os as _os
+
+            return _os.environ.get("CLIENT_JOB_DEFAULT")
+
+        q.put(("ok", ray_tpu.get(probe.remote())))
+    except BaseException:  # noqa: BLE001
+        import traceback
+
+        q.put(("err", traceback.format_exc()))
+
+
+def test_client_driver_job_runtime_env(rt, client_cluster):
+    """init(address=..., runtime_env=...) rides every spec the client builds:
+    job-scoped default env vars reach head-side workers (reference
+    ray.init('ray://...', runtime_env=...))."""
+    port = client_cluster
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_renv_client_driver, args=(port, q))
+    p.start()
+    status, val = q.get(timeout=120)
+    p.join(timeout=30)
+    assert status == "ok", val
+    assert val == "set"
