@@ -208,11 +208,18 @@ class GCPTPUProvider(NodeProvider):
             state = item.get("state", "")
             if state in ("DELETING", "TERMINATED", "PREEMPTED"):
                 continue
-            # name layout: <prefix>-<rfc1035(node_type)>-<counter>-<rand>
+            # name layout: <prefix>-<rfc1035(node_type)>-<counter>-<rand>.
+            # The type segment must be one of OUR node types: a prefix match
+            # alone would adopt cluster "prod-2"'s nodes from cluster "prod"
+            # (prefixes "ray-tpu-prod-2-..." start with "ray-tpu-prod-")
             body = name[len(self.prefix) + 1:]
-            sanitized = body.rsplit("-", 2)[0] if body.count("-") >= 2 else body
+            if body.count("-") < 2:
+                continue
+            sanitized = body.rsplit("-", 2)[0]
             node_type = next((t for t in self.node_types
-                              if _rfc1035(t) == sanitized), sanitized)
+                              if _rfc1035(t) == sanitized), None)
+            if node_type is None:
+                continue  # someone else's TPU: never adopt, never delete
             out.append(NodeInstance(instance_id=name, node_type=node_type,
                                     status="running" if state == "READY"
                                     else "requested"))

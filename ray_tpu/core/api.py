@@ -54,19 +54,22 @@ def init(
         from ray_tpu.util.client import connect
 
         if runtime_env:
+            # validate BEFORE connecting; applied only after connect succeeds
+            from ray_tpu.runtime_env import RuntimeEnv
+
+            runtime_env = dict(RuntimeEnv(**runtime_env))
+        connect(address.split("://", 1)[1])
+        if runtime_env:
             # job-scoped default for THIS client driver: every spec it builds
             # goes through resolved_runtime_env(), which falls back to this
             # env var when no in-process cluster exists — so the default rides
             # each submitted task/actor without any head-side state
             import json as _json
 
-            from ray_tpu.runtime_env import RuntimeEnv
-
-            os.environ["RAY_TPU_DEFAULT_RUNTIME_ENV"] = _json.dumps(
-                dict(RuntimeEnv(**runtime_env)))
-            global _client_default_renv_set
+            global _client_prev_renv, _client_default_renv_set
+            _client_prev_renv = os.environ.get("RAY_TPU_DEFAULT_RUNTIME_ENV")
+            os.environ["RAY_TPU_DEFAULT_RUNTIME_ENV"] = _json.dumps(runtime_env)
             _client_default_renv_set = True
-        connect(address.split("://", 1)[1])
         atexit.register(shutdown)
         return
     from ray_tpu.config import CONFIG
@@ -121,14 +124,20 @@ def init(
 
 
 _client_default_renv_set = False
+_client_prev_renv: Optional[str] = None
 
 
 def shutdown() -> None:
-    global _client_default_renv_set
+    global _client_default_renv_set, _client_prev_renv
     if _client_default_renv_set:
-        # a stale client-job default must not leak into the next session
-        os.environ.pop("RAY_TPU_DEFAULT_RUNTIME_ENV", None)
+        # a stale client-job default must not leak into the next session;
+        # restore whatever (e.g. a worker-inherited default) was there before
+        if _client_prev_renv is None:
+            os.environ.pop("RAY_TPU_DEFAULT_RUNTIME_ENV", None)
+        else:
+            os.environ["RAY_TPU_DEFAULT_RUNTIME_ENV"] = _client_prev_renv
         _client_default_renv_set = False
+        _client_prev_renv = None
     from ray_tpu.util.client.client import ClientContext
 
     w = global_state.try_worker()

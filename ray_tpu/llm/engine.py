@@ -182,10 +182,6 @@ class JaxLLMEngine(LLMEngine):
                     raise NotImplementedError(
                         f"speculative_method {c.speculative_method!r}: only "
                         "'ngram' (prompt lookup) is implemented")
-                if c.kv_layout != "slot":
-                    raise NotImplementedError(
-                        "speculative decoding requires kv_layout='slot' "
-                        "(paged verify-window writes are not wired)")
                 if c.pipeline_parallel_size > 1 or c.num_decode_steps > 1:
                     raise NotImplementedError(
                         "speculative decoding composes with neither pp decode "
@@ -684,8 +680,12 @@ class JaxLLMEngine(LLMEngine):
             # re-check liveness each round: an earlier iteration (or this one)
             # may have preempted this very request — growing a preempted slot
             # would leak blocks into it and corrupt a later occupant's table
+            # clamp at the table width: demanding capacity past max_model_len
+            # would leak blocks (append index off the table) or preempt
+            # innocents forever once the slot is already at full width
+            target = min(next_write + headroom, self.config.max_model_len)
             while (self._active[slot] is req
-                   and next_write + headroom - 1 >= self._blocks.slot_capacity(slot)):
+                   and target - 1 >= self._blocks.slot_capacity(slot)):
                 if self._blocks.num_free > 0:
                     (bid,) = self._blocks.allocate(slot, 1)
                     index = self._blocks.slot_capacity(slot) // self.config.kv_block_size - 1
@@ -748,15 +748,22 @@ class JaxLLMEngine(LLMEngine):
         ctx = req.token_history  # prompt + every generated token
         if len(ctx) < 2:
             return []
-        for n in range(min(self.config.ngram_prompt_lookup_max, len(ctx) - 1), 0, -1):
-            tail = ctx[-n:]
-            # rightmost match strictly before the tail itself
-            for start in range(len(ctx) - n - 1, -1, -1):
-                if ctx[start:start + n] == tail:
-                    cont = ctx[start + n:start + n + k]
-                    if cont:
-                        return cont
-                    break
+        arr = np.asarray(ctx, dtype=np.int32)
+        total = len(arr)
+        for n in range(min(self.config.ngram_prompt_lookup_max, total - 1), 0, -1):
+            tail = arr[-n:]
+            # vectorized shifted-equality scan (O(n*len) numpy, not Python
+            # slicing per position — at 32k context this must not outweigh
+            # the verify step itself); exclude the tail's own occurrence
+            m = np.ones(total - n, dtype=bool)
+            for j in range(n):
+                m &= arr[j:total - n + j] == tail[j]
+            hits = np.flatnonzero(m)
+            if hits.size:
+                start = int(hits[-1])
+                cont = ctx[start + n:start + n + k]
+                if cont:
+                    return cont
         return []
 
     def _step_decode_spec(self) -> None:
@@ -767,6 +774,9 @@ class JaxLLMEngine(LLMEngine):
         c = self.config
         k = c.num_speculative_tokens
         wlen = k + 1
+        if c.kv_layout == "paged":
+            # every window position must land in an owned block
+            self._grow_or_preempt(headroom=wlen)
         n = c.max_num_seqs
         window = np.zeros((n, wlen), np.int32)
         draft_len = np.zeros((n,), np.int32)
@@ -787,7 +797,15 @@ class JaxLLMEngine(LLMEngine):
             if drafts:
                 window[slot, 1:1 + len(drafts)] = drafts
                 self.num_spec_drafted += len(drafts)
-        self.state, out_toks, n_acc = model_runner.spec_verify_step(
+        if c.kv_layout == "paged":
+            from . import paged
+
+            verify = paged.spec_verify_step_paged
+        else:
+            verify = model_runner.spec_verify_step
+        if not active_mask.any():
+            return  # pool-exhaustion preemption may have drained every slot
+        self.state, out_toks, n_acc = verify(
             self.params, self.state, jnp.asarray(window), jnp.asarray(draft_len),
             jnp.asarray(active_mask), cfg, self._next_rng(),
             jnp.asarray(self._temp), jnp.asarray(self._top_p),

@@ -233,11 +233,15 @@ def decode_step(
     return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
 
 
-def _verify_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
+def _verify_core(x, lp, cfg: ModelConfig, lengths, cache_rw):
     """One layer over a W-token verify window for every slot (speculative
-    decoding): x [S,W,D], K/V written at positions lengths[s]+0..W-1 (writes
-    past max_len dropped), each query w attends to cache positions
-    <= lengths[s]+w (causal within the window, full history before it)."""
+    decoding), shared by every cache layout: x [S,W,D], K/V written at
+    positions lengths[s]+0..W-1 through the layout adapter, each query w
+    attends to cache positions <= lengths[s]+w (causal within the window,
+    full history before it).
+
+    cache_rw(k_new [S,W,KV,HD], v_new) -> (ck [S,max_len,KV,HD], cv, storage).
+    """
     dt = x.dtype
     s, wlen, _ = x.shape
     kvh, hd = cfg.n_kv_heads, cfg.head_dim
@@ -251,18 +255,16 @@ def _verify_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
     q = llama.rope(q, pos, cfg.rope_theta)
     k = llama.rope(k, pos, cfg.rope_theta)
 
-    rows = jnp.arange(s)[:, None]
-    nk = ck.at[rows, pos].set(k.astype(ck.dtype), mode="drop")
-    nv = cv.at[rows, pos].set(vv.astype(cv.dtype), mode="drop")
+    ck, cv, storage = cache_rw(k, vv)
     max_len = ck.shape[1]
 
     qg = q.reshape(s, wlen, kvh, g, hd) * (hd**-0.5)
     scores = jnp.einsum("swkgd,stkd->swkgt", qg.astype(jnp.float32),
-                        nk.astype(jnp.float32))
+                        ck.astype(jnp.float32))
     valid = (jnp.arange(max_len)[None, None, :] <= pos[:, :, None])  # [S,W,T]
     scores = jnp.where(valid[:, :, None, None, :], scores, sampling.NEG_INF)
     w = jax.nn.softmax(scores, axis=-1)
-    o = jnp.einsum("swkgt,stkd->swkgd", w, nv.astype(jnp.float32)).astype(dt)
+    o = jnp.einsum("swkgt,stkd->swkgd", w, cv.astype(jnp.float32)).astype(dt)
     o = o.reshape(s, wlen, cfg.n_heads, hd)
     x = x + jnp.einsum("slhk,hkd->sld", o, lp["wo"].astype(dt))
 
@@ -270,7 +272,71 @@ def _verify_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active):
     gate = jnp.einsum("sld,df->slf", h, lp["w_gate"].astype(dt))
     up = jnp.einsum("sld,df->slf", h, lp["w_up"].astype(dt))
     down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt))
-    return x + down, nk, nv
+    return x + down, storage
+
+
+def _verify_block(x, lp, cfg: ModelConfig, ck, cv, lengths):
+    """Slot-layout verify: K/V scattered at absolute positions (writes past
+    max_len dropped)."""
+    pos = lengths[:, None] + jnp.arange(x.shape[1])[None, :]
+    rows = jnp.arange(x.shape[0])[:, None]
+
+    def cache_rw(k_new, v_new):
+        nk = ck.at[rows, pos].set(k_new.astype(ck.dtype), mode="drop")
+        nv = cv.at[rows, pos].set(v_new.astype(cv.dtype), mode="drop")
+        return nk, nv, (nk, nv)
+
+    x, (nk, nv) = _verify_core(x, lp, cfg, lengths, cache_rw)
+    return x, nk, nv
+
+
+def spec_accept(window, greedy, draft_len, active, lengths, rng, temperature,
+                top_p, top_k, logits0):
+    """Shared accept logic: longest draft prefix matching argmax, +1 bonus;
+    temperature>0 slots (no drafts) get a properly SAMPLED first token."""
+    tok0 = sampling.sample(rng, logits0, temperature, top_p, top_k)
+    greedy = greedy.at[:, 0].set(jnp.where(temperature > 0, tok0, greedy[:, 0]))
+    wlen = window.shape[1]
+    draft = window[:, 1:]
+    idx = jnp.arange(wlen - 1)[None, :]
+    match = (draft == greedy[:, :-1]) & (idx < draft_len[:, None])
+    n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    advance = jnp.where(active, n_acc + 1, 0)
+    return greedy, n_acc, lengths + advance
+
+
+def spec_driver(params, k0, v0, lengths, window, draft_len, active, cfg,
+                rng, temperature, top_p, top_k, layer_fn):
+    """Shared speculative-verify pipeline (embed -> layers -> norm -> head ->
+    accept); the cache layout differs only in layer_fn(h, lp, k, v)."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError("speculative decoding: dense models only")
+    x = params["embed"].astype(cfg.activation_dtype)[window]
+
+    if cfg.scan_layers:
+        def body(carry, xs):
+            h = carry
+            lp, a, b = xs
+            h, a, b = layer_fn(h, lp, a, b)
+            return h, (a, b)
+
+        x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], k0, v0))
+    else:
+        nk, nv = [], []
+        for i, lp in enumerate(params["layers"]):
+            x, a, b = layer_fn(x, lp, k0[i], v0[i])
+            nk.append(a)
+            nv.append(b)
+        nk, nv = jnp.stack(nk), jnp.stack(nv)
+
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))
+    greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    greedy, n_acc, new_lengths = spec_accept(
+        window, greedy, draft_len, active, lengths, rng, temperature,
+        top_p, top_k, logits[:, 0].astype(jnp.float32))
+    return nk, nv, new_lengths, greedy, n_acc
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
@@ -294,46 +360,10 @@ def spec_verify_step(
     this step's emitted tokens (n accepted drafts + 1 bonus/correction);
     lengths advance by n+1 for active slots. Dense models only (MoE routing
     over the window is not wired)."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError("speculative decoding: dense models only")
-    x = params["embed"].astype(cfg.activation_dtype)[window]  # [S,W,D]
-    wlen = window.shape[1]
-
-    if cfg.scan_layers:
-        def body(carry, xs):
-            h = carry
-            lp, ck, cv = xs
-            h, ck, cv = _verify_block(h, lp, cfg, ck, cv, state.lengths, active)
-            return h, (ck, cv)
-
-        x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], state.k, state.v))
-    else:
-        nk, nv = [], []
-        for i, lp in enumerate(params["layers"]):
-            x, ck, cv = _verify_block(x, lp, cfg, state.k[i], state.v[i],
-                                      state.lengths, active)
-            nk.append(ck)
-            nv.append(cv)
-        nk, nv = jnp.stack(nk), jnp.stack(nv)
-
-    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))
-    greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)  # [S,W]
-    # sampled (temperature>0) slots carry no drafts, so their one emitted token
-    # is out[:, 0] — draw it properly instead of silently going greedy
-    # (sample() itself falls back to argmax for temperature<=0 rows)
-    tok0 = sampling.sample(rng, logits[:, 0].astype(jnp.float32),
-                           temperature, top_p, top_k)
-    greedy = greedy.at[:, 0].set(jnp.where(temperature > 0, tok0, greedy[:, 0]))
-
-    draft = window[:, 1:]  # [S,W-1]
-    idx = jnp.arange(wlen - 1)[None, :]
-    match = (draft == greedy[:, :-1]) & (idx < draft_len[:, None])
-    # longest all-accepted prefix
-    n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)  # [S]
-    advance = jnp.where(active, n_acc + 1, 0)
-    lengths = state.lengths + advance
+    nk, nv, lengths, greedy, n_acc = spec_driver(
+        params, state.k, state.v, state.lengths, window, draft_len, active,
+        cfg, rng, temperature, top_p, top_k,
+        lambda h, lp, ck, cv: _verify_block(h, lp, cfg, ck, cv, state.lengths))
     return DecodeState(k=nk, v=nv, lengths=lengths), greedy, n_acc
 
 

@@ -363,6 +363,66 @@ def decode_step_paged(
                       lengths=lengths), logits.astype(jnp.float32)
 
 
+def _verify_block_paged(x, lp, cfg: ModelConfig, pk, pv, block_tables, lengths,
+                        active):
+    """Paged verify: the shared W-token window math with block-table writes.
+    The engine pre-grows every active slot's table by the window width, so all
+    window positions map to owned blocks; inactive slots (and any position
+    past the table) write to the scratch block."""
+    from .model_runner import _verify_core
+
+    s, wlen, _ = x.shape
+    nb_slot = block_tables.shape[1]
+    bs = pk.shape[1]
+    max_len = nb_slot * bs
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    pos = lengths[:, None] + jnp.arange(wlen)[None, :]  # [S,W]
+
+    def cache_rw(k_new, v_new):
+        scratch = pk.shape[0] - 1
+        blk_idx = pos // bs  # [S,W]
+        in_table = blk_idx < nb_slot
+        safe_idx = jnp.minimum(blk_idx, nb_slot - 1)
+        rows = jnp.arange(s)[:, None]
+        write_block = jnp.where(active[:, None] & in_table,
+                                block_tables[rows, safe_idx], scratch)
+        write_off = pos % bs
+        nk = pk.at[write_block, write_off].set(k_new.astype(pk.dtype))
+        nv = pv.at[write_block, write_off].set(v_new.astype(pv.dtype))
+        ck = nk[block_tables].reshape(s, max_len, kvh, hd)
+        cv = nv[block_tables].reshape(s, max_len, kvh, hd)
+        return ck, cv, (nk, nv)
+
+    x, (nk, nv) = _verify_core(x, lp, cfg, lengths, cache_rw)
+    return x, nk, nv
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
+def spec_verify_step_paged(
+    params,
+    state: PagedState,
+    window: jax.Array,  # [S,W] int32 — [last_token, draft_1..draft_k]
+    draft_len: jax.Array,  # [S] int32
+    active: jax.Array,  # [S] bool
+    cfg: ModelConfig,
+    rng: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+):
+    """Speculative verify against the paged pool (see
+    model_runner.spec_verify_step for the contract)."""
+    from .model_runner import spec_driver
+
+    nk, nv, lengths, greedy, n_acc = spec_driver(
+        params, state.k, state.v, state.lengths, window, draft_len, active,
+        cfg, rng, temperature, top_p, top_k,
+        lambda h, lp, pk, pv: _verify_block_paged(
+            h, lp, cfg, pk, pv, state.block_tables, state.lengths, active))
+    return PagedState(k=nk, v=nv, block_tables=state.block_tables,
+                      lengths=lengths), greedy, n_acc
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
 def decode_multi_paged(
     params,

@@ -275,19 +275,22 @@ def test_engine_metrics_export_to_prometheus(engine):
     assert merged["llm_num_active"]["type"] == "gauge"
 
 
-def test_ngram_speculative_decode_matches_greedy():
+@pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+def test_ngram_speculative_decode_matches_greedy(kv_layout):
     """Speculative decoding (reference: vLLM ngram spec decode): drafts are
     verified in one forward pass and greedy output must be IDENTICAL to plain
-    decode whatever the draft quality. An untrained model generates novel
-    tokens, so prompt-lookup rarely fires on its own — the acceptance path is
-    driven with oracle (and deliberately wrong) drafts via the proposer seam."""
+    decode whatever the draft quality — for both cache layouts (the paged
+    verify writes the window through pre-grown block tables). An untrained
+    model generates novel tokens, so prompt-lookup rarely fires on its own —
+    the acceptance path is driven with oracle (and deliberately wrong) drafts
+    via the proposer seam."""
     params = llama_init_cached(CFG)
     prompt = [1, 10, 11, 12, 13]
     want = reference_greedy(params, prompt, 12)
 
-    cfg = LLMConfig(model_id="tiny-spec", model_source="test-tiny",
+    cfg = LLMConfig(model_id=f"tiny-spec-{kv_layout}", model_source="test-tiny",
                     max_num_seqs=2, max_model_len=64, tokenizer="byte",
-                    num_speculative_tokens=4)
+                    kv_layout=kv_layout, num_speculative_tokens=4)
     eng = JaxLLMEngine(cfg)
     eng.start()
     try:
@@ -352,11 +355,7 @@ def test_ngram_proposer_lookup():
 def test_speculative_config_validation():
     from ray_tpu.llm import JaxLLMEngine, LLMConfig
 
-    eng = JaxLLMEngine(LLMConfig(model_id="sv", model_source="test-tiny",
-                                 kv_layout="paged", num_speculative_tokens=4))
-    with pytest.raises(NotImplementedError, match="slot"):
-        eng.start()
-    eng2 = JaxLLMEngine(LLMConfig(model_id="sv2", model_source="test-tiny",
-                                  num_speculative_tokens=4, num_decode_steps=8))
+    eng = JaxLLMEngine(LLMConfig(model_id="sv2", model_source="test-tiny",
+                                 num_speculative_tokens=4, num_decode_steps=8))
     with pytest.raises(NotImplementedError, match="compose"):
-        eng2.start()
+        eng.start()
