@@ -129,6 +129,34 @@ def _maybe_remat(body, cfg: ModelConfig):
     return jax.checkpoint(body)
 
 
+def _embed_lookup(table: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Token embedding lookup, sharding-aware.
+
+    When the vocab dim is sharded (tp>1) a plain gather carries a transposed-
+    device-order output sharding that GSPMD can only reconcile with the batch-sharded
+    activation constraint via involuntary full rematerialization (replicate +
+    repartition, wasted HBM/ICI every step). A one-hot matmul instead contracts over
+    the vocab shard — GSPMD turns that into a local dot + psum over tp, the
+    embed/fsdp dim flows through, and the op lands on the MXU. With vocab unsharded
+    (tp=1, incl. single device) the cheaper gather is kept: embed-dim (fsdp) sharding
+    flows through a gather cleanly. Single-token decode (S==1) also keeps the gather
+    — one row per sequence is too small for the resharding cost to matter and the
+    matmul would add vocab*d FLOPs per token. (Sharding-in-types can't see Auto-axis
+    specs, so the gate is the mesh's tp extent, not the table's actual spec.)
+    Semantics note: out-of-range token ids clamp under gather but embed to zeros
+    under the one-hot path; valid inputs (< vocab_size) are identical.
+    """
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        sharded = mesh is not None and not mesh.empty and mesh.shape.get("tp", 1) > 1
+    except Exception:
+        sharded = False
+    if not sharded or tokens.shape[-1] == 1:
+        return table[tokens]
+    onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=table.dtype)
+    return jnp.einsum("bsv,vd->bsd", onehot, table)
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     dtype = x.dtype
     x = x.astype(jnp.float32)
@@ -320,7 +348,7 @@ def forward(
     if positions is None:
         start = cache.length if cache is not None else 0
         positions = jnp.broadcast_to(jnp.arange(s)[None, :] + start, (b, s))
-    x = params["embed"].astype(cfg.activation_dtype)[tokens]
+    x = _embed_lookup(params["embed"].astype(cfg.activation_dtype), tokens)
     x = wsc(x, "batch", "seq", "act_embed")
     aux_total = jnp.zeros((), jnp.float32)
 
@@ -390,8 +418,11 @@ def loss_fn(
         segment_ids=None if seg is None else seg[:, :-1], return_aux=True,
     )
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # target-logit minus logsumexp == log_softmax gathered at the target, without
+    # materializing a second [B,S,vocab] f32 tensor (1 GB/chip at 8B scale).
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ll = tgt - lse
     mask = batch.get("loss_mask")
     mask = jnp.ones_like(ll) if mask is None else mask[:, 1:].astype(ll.dtype)
     denom = jnp.maximum(mask.sum(), 1.0)

@@ -67,6 +67,123 @@ def attention_reference(
     return out.astype(q.dtype)
 
 
+def attention_chunked(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    q_offset: Optional[jax.Array] = None,
+    kv_valid_len: Optional[jax.Array] = None,
+    block_kv: int = 512,
+) -> jax.Array:
+    """Online-softmax attention over KV blocks ("flash in XLA").
+
+    Scans KV in `block_kv` chunks with a running (max, sum, acc) carry, so peak
+    memory is O(B*H*Sq*block_kv) instead of O(B*H*Sq*Skv). Pure lax.scan — compiles
+    on any backend; the fallback for long sequences when the Pallas kernel can't
+    tile the shape (and the path the 8B HBM-budget proof compiles on CPU).
+    Same masking surface as attention_reference.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    n_blk = -(-skv // block_kv)
+    pad = n_blk * block_kv - skv
+    seg_q = None if segment_ids is None else segment_ids[:, -sq:]
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if segment_ids is not None:
+            # Padded slots get segment id -1 (never matches a real segment).
+            segment_ids = jnp.pad(segment_ids, ((0, 0), (0, pad)), constant_values=-1)
+    if q_offset is None:
+        q_offset = skv - sq
+    qi = jnp.arange(sq)[:, None] + q_offset  # [Sq, 1] absolute kv positions
+
+    # Chunk the UN-repeated kv heads; GQA repetition happens per 512-slot block
+    # inside the scan body so the repeated copies never exist over the full Skv.
+    hkv = k.shape[2]
+    kb = k.reshape(b, n_blk, block_kv, hkv, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, n_blk, block_kv, hkv, d).transpose(1, 0, 2, 3, 4)
+    seg_b = (
+        None
+        if segment_ids is None
+        else segment_ids.reshape(b, n_blk, block_kv).transpose(1, 0, 2)
+    )
+    blk_idx = jnp.arange(n_blk)
+
+    def body(carry, xs):
+        m, l, acc = carry
+        if seg_b is None:
+            i, kc, vc = xs
+            seg_c = None
+        else:
+            i, kc, vc, seg_c = xs
+        kc = _repeat_kv(kc, n_rep)
+        vc = _repeat_kv(vc, n_rep)
+        kj = i * block_kv + jnp.arange(block_kv)[None, :]  # [1, blk]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32)
+        logits = logits * scale
+        neg = jnp.float32(-1e30)  # finite: keeps fully-masked rows NaN-free
+        if causal:
+            logits = jnp.where((kj <= qi)[None, None], logits, neg)
+        valid = kv_valid_len if kv_valid_len is not None else skv
+        logits = jnp.where((kj < valid)[None, None], logits, neg)
+        if seg_c is not None:
+            mask = seg_q[:, :, None] == seg_c[:, None, :]  # [B, Sq, blk]
+            logits = jnp.where(mask[:, None], logits, neg)
+        blk_max = jnp.max(logits, axis=-1)  # [B, H, Sq]
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[..., None])  # [B, H, Sq, blk]
+        # Kill masked slots exactly: when a whole row is masked new_m == neg and
+        # exp(logits - new_m) == 1, which would silently average v.
+        p = jnp.where(logits > neg * 0.5, p, 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vc.dtype), vc)
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
+        return (new_m, l, acc), None
+
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    xs = (blk_idx, kb, vb) if seg_b is None else (blk_idx, kb, vb, seg_b)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), xs)
+    l_t = l.transpose(0, 2, 1)[..., None]  # [B, Sq, H, 1]
+    out = jnp.where(l_t > 0, acc / jnp.maximum(l_t, 1e-30), 0.0)
+    return out.astype(q.dtype)
+
+
+# Below this many Sq*Skv logit elements the full [B,H,Sq,Skv] tensor is small enough
+# that the one-shot reference path fuses better than a scan of blocks. A product
+# threshold keeps single-row decode (Sq=1, any cache length) on the fused path —
+# its logits are [B,H,1,Skv], tiny, and a sequential block scan would only add
+# per-token latency.
+CHUNKED_MIN_LOGITS = 1 << 20
+
+_logged_fallbacks: set = set()
+
+
+def _log_fallback_once(q_shape, k_shape, impl: str) -> None:
+    """On-TPU shapes that miss the Pallas kernel get a one-time warning — the
+    perf cliff (Mosaic can't tile e.g. head_dim 64) should be visible, not silent."""
+    key = (tuple(q_shape), tuple(k_shape))
+    if key in _logged_fallbacks:
+        return
+    _logged_fallbacks.add(key)
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "attention: TPU shape q=%s kv=%s is not Mosaic-tileable "
+        "(head_dim %% 128 or seq block alignment); using %s XLA path",
+        tuple(q_shape), tuple(k_shape), impl,
+    )
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -79,7 +196,7 @@ def attention(
     kv_valid_len: Optional[jax.Array] = None,
     impl: str = "auto",
 ) -> jax.Array:
-    """Dispatching attention. impl: auto|pallas|reference.
+    """Dispatching attention. impl: auto|pallas|chunked|reference.
 
     The Pallas path currently covers the training shape (no cache offsets, optional
     segment ids); decode-with-cache shapes use the XLA path, which fuses well anyway.
@@ -102,16 +219,32 @@ def attention(
         tileable = (q.shape[-1] % 128 == 0
                     and seq_ok(q.shape[1], DEFAULT_BLOCK_Q)
                     and seq_ok(k.shape[1], DEFAULT_BLOCK_KV))
-        impl = (
-            "pallas"
-            if (on_tpu and tileable and q_offset is None and kv_valid_len is None
-                and (same_len or not causal))
-            else "reference"
-        )
+        if (on_tpu and tileable and q_offset is None and kv_valid_len is None
+                and (same_len or not causal)):
+            impl = "pallas"
+        elif q.shape[1] * k.shape[1] >= CHUNKED_MIN_LOGITS:
+            # Long sequences that can't take the Pallas kernel: blockwise online
+            # softmax keeps peak memory O(Sq*block) instead of O(Sq*Skv).
+            impl = "chunked"
+        else:
+            impl = "reference"
+        if impl != "pallas" and on_tpu and not tileable:
+            _log_fallback_once(q.shape, k.shape, impl)
     if impl == "pallas":
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+    if impl == "chunked":
+        return attention_chunked(
+            q,
+            k,
+            v,
+            causal=causal,
+            segment_ids=segment_ids,
+            scale=scale,
+            q_offset=q_offset,
+            kv_valid_len=kv_valid_len,
+        )
     return attention_reference(
         q,
         k,
