@@ -70,6 +70,19 @@ _FLAGS: List[Flag] = [
     # -- multi-host control plane
     Flag("agent_heartbeat_s", "RAY_TPU_AGENT_HEARTBEAT_S", "float", 2.0,
          "Node-agent heartbeat period to the head."),
+    # -- device plane (device-to-device tensor transfer between processes)
+    Flag("device_plane", "RAY_TPU_DEVICE_PLANE", "bool", True,
+         "Enable the PJRT transfer-server plane: jax.Arrays move between actor "
+         "processes device-to-device (DCN/ICI on pods) instead of "
+         "device->host->pickle (reference gpu_object_manager + NCCL channels)."),
+    Flag("device_objects", "RAY_TPU_DEVICE_OBJECTS", "str", "fetch",
+         "jax.Arrays in the object store: 'off' = host copy only; 'fetch' "
+         "(default) = host copy kept, consumers pull device-to-device when "
+         "possible; 'native' = stub only, device-resident at the producer "
+         "(reference gpu_object_manager semantics: loss -> reconstruction)."),
+    Flag("device_object_min_bytes", "RAY_TPU_DEVICE_OBJECT_MIN_BYTES", "int", 1 << 20,
+         "Device arrays below this size skip the transfer plane (control-message "
+         "inlining beats an arm round-trip for small tensors)."),
     # -- data plane (direct node-to-node object transfer)
     Flag("transfer_chunk_bytes", "RAY_TPU_TRANSFER_CHUNK_BYTES", "int", 4 * 1024 * 1024,
          "Chunk size for direct node-to-node object transfers "

@@ -1,0 +1,373 @@
+"""Device-native tensor transfer between actor processes (the NCCL-channel analogue).
+
+Capability parity: reference python/ray/experimental/gpu_object_manager/
+gpu_object_manager.py:54 and python/ray/experimental/channel/
+torch_tensor_nccl_channel.py — tensors stay resident on the accelerator and move
+peer-to-peer on demand; only a small descriptor rides the control plane.
+
+TPU shape of the idea: each process runs a PJRT *transfer server*
+(`jax.experimental.transfer`, the DCN cross-slice transfer engine). A producer
+`export()`s a pytree of jax.Arrays, getting a small picklable `DeviceHandle`; any
+number of consumer processes `fetch()` it. Fetch arms a one-shot pull on the
+producer via a per-process *arm server* (each consumer gets its own transfer uuid
+— the PJRT protocol is strictly one pull per uuid), then pulls the buffers
+device-to-device: on TPU pods the bytes ride DCN between hosts and never touch
+Python, pickle, or the object store; the sandbox CPU backend uses the same socket
+bulk-transport path.
+
+Why an arm server instead of arming at export time: a pull consumes its uuid and
+a stale uuid poisons the whole connection, so the number of consumers must not be
+guessed up front. The arm round-trip is a ~1 KB control message; payload bytes
+move exclusively through the transfer server.
+
+Sharding contract: a NamedSharding is re-built on the consumer from (axis names,
+mesh shape, partition spec) over `jax.devices()` in default order — producer and
+consumer must see identically-shaped device sets (true for P/D pools on same-size
+slices and for the CPU test mesh). Anything else falls back to the host path at
+the call site.
+"""
+from __future__ import annotations
+
+import secrets
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.config import CONFIG
+
+
+class DevicePlaneError(RuntimeError):
+    """Fetch could not complete device-natively; callers fall back to host bytes."""
+
+
+# ------------------------------------------------------------------ descriptors
+
+@dataclass(frozen=True)
+class ArraySpec:
+    shape: Tuple[int, ...]
+    dtype: str
+    sharding: Tuple  # ("single",) | ("named", axis_names, mesh_shape, spec_entries)
+    nbytes: int
+
+
+@dataclass(frozen=True)
+class DeviceHandle:
+    """Small picklable descriptor of an exported device pytree."""
+
+    arm_host: str
+    arm_port: int
+    key: bytes
+    specs: Tuple[ArraySpec, ...]
+    treedef_pickle: bytes  # jax treedefs pickle fine; kept opaque here
+    nbytes: int
+
+
+def _describe_sharding(arr) -> Tuple:
+    from jax.sharding import NamedSharding
+
+    sh = arr.sharding
+    if isinstance(sh, NamedSharding) and len(sh.mesh.devices.flat) > 1:
+        spec_entries = tuple(
+            tuple(e) if isinstance(e, (tuple, list)) else e for e in tuple(sh.spec)
+        )
+        return ("named", tuple(sh.mesh.axis_names), tuple(sh.mesh.devices.shape),
+                spec_entries)
+    return ("single",)
+
+
+def _rebuild_sharding(desc: Tuple):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec, SingleDeviceSharding
+
+    if desc[0] == "named":
+        _, axis_names, mesh_shape, spec_entries = desc
+        n = int(np.prod(mesh_shape))
+        devs = jax.devices()
+        if len(devs) < n:
+            raise DevicePlaneError(
+                f"consumer has {len(devs)} devices, producer mesh needs {n}")
+        mesh = Mesh(np.asarray(devs[:n]).reshape(mesh_shape), axis_names)
+        spec = PartitionSpec(*spec_entries)
+        return NamedSharding(mesh, spec)
+    return SingleDeviceSharding(_default_device())
+
+
+def _default_device():
+    import jax
+
+    return jax.devices()[0]
+
+
+def _node_ip() -> str:
+    import os
+
+    ip = os.environ.get("RAY_TPU_NODE_IP")
+    if ip:
+        return ip
+    try:
+        # UDP connect trick: finds the outbound interface without sending.
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+# ------------------------------------------------------------------ the plane
+
+class DevicePlane:
+    """Per-process transfer endpoint: exports, arms, and pulls device pytrees."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._server = None  # PJRT TransferServer
+        self._xfer_addr: Optional[str] = None
+        self._arm_listener = None
+        self._arm_addr: Optional[Tuple[str, int]] = None
+        self._authkey: Optional[bytes] = None
+        self._exports: Dict[bytes, Tuple[List[Any], bytes]] = {}  # key -> (flat, treedef)
+        self._conns: Dict[str, Any] = {}  # xfer addr -> TransferConnection
+        self._uuid_counter = secrets.randbits(48) << 14  # process-unique uuid space
+        self.counters: Dict[str, int] = {
+            "exports": 0, "arms": 0, "pulls": 0, "bytes_pulled": 0, "fallbacks": 0,
+        }
+        self._disabled_reason: Optional[str] = None
+
+    # -- lifecycle ---------------------------------------------------------------
+
+    def _ensure_started(self) -> None:
+        if self._server is not None or self._disabled_reason:
+            return
+        with self._lock:
+            if self._server is not None or self._disabled_reason:
+                return
+            try:
+                self._start_locked()
+            except Exception as e:  # no transfer support on this backend/build
+                self._disabled_reason = f"{type(e).__name__}: {e}"
+
+    def _start_locked(self) -> None:
+        import jax
+        from jax.experimental import transfer
+
+        ip = _node_ip()
+        client = jax.devices()[0].client
+        # Explicit socket transport addresses: the default same-host "local" bulk
+        # transport is not implemented for all backends (CHECK-fails on CPU), and
+        # cross-host always needs routable sockets anyway.
+        server = transfer.start_transfer_server(
+            client, f"{ip}:0", [f"{ip}:0"])
+        addr = server.address()
+        from ray_tpu.util.client.server import generate_authkey, load_authkey
+
+        self._authkey = load_authkey() or generate_authkey()
+        from multiprocessing.connection import Listener
+
+        listener = Listener((ip, 0), backlog=64)
+        self._server = server
+        self._xfer_addr = addr
+        self._arm_listener = listener
+        self._arm_addr = (ip, listener.address[1])
+        threading.Thread(target=self._arm_loop, daemon=True,
+                         name="rt-device-plane-arm").start()
+
+    @property
+    def available(self) -> bool:
+        if not CONFIG.device_plane:
+            return False
+        self._ensure_started()
+        return self._server is not None
+
+    @property
+    def disabled_reason(self) -> Optional[str]:
+        return self._disabled_reason
+
+    # -- producer side -----------------------------------------------------------
+
+    def export(self, tree: Any) -> DeviceHandle:
+        """Register a pytree of jax.Arrays for device-native fetch by peers.
+
+        The plane holds strong references until `release(handle.key)` — exports
+        pin device memory, so producers release as soon as consumers are done
+        (P/D: when the decode side acks; channels: on next write).
+        """
+        if not self.available:
+            raise DevicePlaneError(self._disabled_reason or "device plane disabled")
+        import jax
+        import pickle
+
+        flat, treedef = jax.tree.flatten(tree)
+        if not flat:
+            raise DevicePlaneError("empty pytree")
+        specs = tuple(
+            ArraySpec(tuple(x.shape), str(x.dtype), _describe_sharding(x), x.nbytes)
+            for x in flat
+        )
+        key = secrets.token_bytes(16)
+        with self._lock:
+            self._exports[key] = flat
+            self.counters["exports"] += 1
+        host, port = self._arm_addr
+        return DeviceHandle(
+            arm_host=host, arm_port=port, key=key, specs=specs,
+            treedef_pickle=pickle.dumps(treedef),
+            nbytes=sum(s.nbytes for s in specs))
+
+    def release(self, key: bytes) -> None:
+        with self._lock:
+            self._exports.pop(key, None)
+
+    def _arm_loop(self) -> None:
+        while True:
+            try:
+                conn = self._arm_listener.accept()
+            except (OSError, EOFError):
+                return
+            threading.Thread(target=self._serve_arm, args=(conn,), daemon=True,
+                             name="rt-device-plane-serve").start()
+
+    def _serve_arm(self, conn) -> None:
+        from multiprocessing.connection import deliver_challenge, answer_challenge
+        import pickle
+
+        try:
+            deliver_challenge(conn, self._authkey)
+            answer_challenge(conn, self._authkey)
+            while True:
+                op, key = pickle.loads(conn.recv_bytes())
+                if op == "release":
+                    self.release(key)
+                    conn.send_bytes(pickle.dumps(("ok",)))
+                    continue
+                if op != "arm":
+                    conn.send_bytes(pickle.dumps(("err", f"bad op {op!r}")))
+                    continue
+                with self._lock:
+                    flat = self._exports.get(key)
+                    if flat is None:
+                        conn.send_bytes(pickle.dumps(("gone",)))
+                        continue
+                    self._uuid_counter += 1
+                    uuid = self._uuid_counter
+                    self.counters["arms"] += 1
+                # await_pull holds buffer refs in the server until pulled.
+                self._server.await_pull(uuid, flat)
+                conn.send_bytes(pickle.dumps(("ok", self._xfer_addr, uuid)))
+        except (EOFError, OSError, pickle.UnpicklingError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    # -- consumer side -----------------------------------------------------------
+
+    def fetch(self, handle: DeviceHandle, release: bool = False) -> Any:
+        """Pull an exported pytree device-to-device. Raises DevicePlaneError on any
+        failure (producer gone, topology mismatch) — callers fall back to host.
+
+        release=True acks the producer after a successful pull so it drops its
+        pinned export immediately (single-consumer handoffs like P/D KV)."""
+        if not self.available:
+            self.counters["fallbacks"] += 1
+            raise DevicePlaneError(self._disabled_reason or "device plane disabled")
+        import jax
+        import pickle
+
+        try:
+            xfer_addr, uuid = self._arm(handle)
+            shardings = [_rebuild_sharding(s.sharding) for s in handle.specs]
+            avals = [
+                jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+                for s, sh in zip(handle.specs, shardings)
+            ]
+            conn = self._connection(xfer_addr)
+            try:
+                flat = conn.pull(uuid, avals)
+            except Exception:
+                # A failed pull poisons the PJRT connection: drop it so the next
+                # fetch redials instead of inheriting a dead socket.
+                with self._lock:
+                    self._conns.pop(xfer_addr, None)
+                raise
+            with self._lock:
+                self.counters["pulls"] += 1
+                self.counters["bytes_pulled"] += handle.nbytes
+            if release:
+                try:
+                    self._control(handle, ("release", handle.key))
+                except Exception:
+                    pass  # producer TTL-prunes as backstop
+            treedef = pickle.loads(handle.treedef_pickle)
+            return jax.tree.unflatten(treedef, flat)
+        except DevicePlaneError:
+            with self._lock:
+                self.counters["fallbacks"] += 1
+            raise
+        except Exception as e:
+            with self._lock:
+                self.counters["fallbacks"] += 1
+            raise DevicePlaneError(f"device fetch failed: {type(e).__name__}: {e}") from e
+
+    def _control(self, handle: DeviceHandle, msg: Tuple) -> Tuple:
+        from multiprocessing.connection import Client
+        import pickle
+
+        from ray_tpu.util.client.server import generate_authkey, load_authkey
+
+        authkey = self._authkey or load_authkey() or generate_authkey()
+        try:
+            conn = Client((handle.arm_host, handle.arm_port), authkey=authkey)
+        except Exception as e:
+            raise DevicePlaneError(f"producer unreachable: {e}") from e
+        try:
+            conn.send_bytes(pickle.dumps(msg))
+            return pickle.loads(conn.recv_bytes())
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _arm(self, handle: DeviceHandle) -> Tuple[str, int]:
+        resp = self._control(handle, ("arm", handle.key))
+        if resp[0] == "gone":
+            raise DevicePlaneError("export was released by the producer")
+        if resp[0] != "ok":
+            raise DevicePlaneError(f"arm failed: {resp!r}")
+        return resp[1], resp[2]
+
+    def _connection(self, xfer_addr: str):
+        with self._lock:
+            conn = self._conns.get(xfer_addr)
+        if conn is not None:
+            return conn
+        conn = self._server.connect(xfer_addr)
+        with self._lock:
+            self._conns[xfer_addr] = conn
+        return conn
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self.counters)
+        out["exports_live"] = len(self._exports)
+        return out
+
+
+_plane: Optional[DevicePlane] = None
+_plane_lock = threading.Lock()
+
+
+def plane() -> DevicePlane:
+    global _plane
+    if _plane is None:
+        with _plane_lock:
+            if _plane is None:
+                _plane = DevicePlane()
+    return _plane

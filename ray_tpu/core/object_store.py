@@ -130,8 +130,10 @@ def materialize(obj: Any, oid: ObjectID, is_error: bool = False) -> Location:
 
     if not is_error and device_objects.is_device_array(obj):
         # same-process resolves return the original device array (no host copy);
-        # the serialized host copy below stays the durable/cross-process form
+        # cross-process consumers pull device-to-device via the transfer plane
+        # when enabled (wrap_for_store), else use the serialized host copy
         device_objects.stash(oid.binary(), obj)
+        obj = device_objects.wrap_for_store(oid.binary(), obj)
     ser = serialization.serialize(obj)
     size = ser.frame_bytes
     if size < _inline_threshold():

@@ -7,10 +7,12 @@ type to the transport its compiled graphs use (NCCL for CUDA there). Here the
 registered transports are:
 - "cpu"/"shm": the seqlock shared-memory channel (default)
 - "tpu"/"device": jax.Array-aware channel — a same-process reader receives THE
-  original device array (zero-copy via experimental.device_objects); across
-  processes the host copy embedded in the message is used. True device-to-device
-  between jitted stages should be fused into one pjit program or ride
-  jax.device_put, per the dag module docstring.
+  original device array (zero-copy via experimental.device_objects); a
+  cross-process reader pulls it device-to-device over the transfer plane
+  (core/device_plane.py — the NCCL-channel analogue, reference
+  torch_tensor_nccl_channel.py), with the embedded host copy as fallback only
+  when the plane is off. Fusing stages into one pjit program remains the fastest
+  path when all stages are pure functions, per the dag module docstring.
 """
 from __future__ import annotations
 
@@ -33,10 +35,19 @@ class SharedMemoryCommunicator(Communicator):
 
 
 class DeviceChannel:
-    """ShmChannel wrapper that keeps device arrays resident for local readers."""
+    """ShmChannel wrapper that keeps device arrays resident: same-process readers
+    splice the original array back in; cross-process readers pull device-to-device
+    over the transfer plane (reader acks → writer export released; a small LRU cap
+    bounds pinned HBM when the reader is same-process and never pulls)."""
+
+    # Live exports kept per channel before the oldest is force-released. A reader
+    # lagging within the channel's write capacity still pulls fine; beyond that
+    # only same-process readers (who never pull) are affected.
+    _EXPORT_CAP = 4
 
     def __init__(self, name: str, capacity: int, create: bool = False):
         self._inner = ShmChannel(name, capacity, create=create)
+        self._live_exports: list = []
 
     @property
     def name(self) -> str:
@@ -60,31 +71,66 @@ class DeviceChannel:
         return None, None
 
     def write(self, value: Any, timeout: float = None) -> None:
+        from ray_tpu.core import device_plane
         from ray_tpu.experimental import device_objects
 
         arr, shape = self._device_payload(value)
-        if arr is not None:
-            key = os.urandom(20)
-            device_objects.stash(key, arr)  # same-process readers skip the copy
-            self._inner.write(("__device__", key, shape, value), timeout)
+        if arr is None:
+            self._inner.write(("__host__", None, None, value, None), timeout)
+            return
+        key = os.urandom(20)
+        device_objects.stash(key, arr)  # same-process readers skip the copy
+        handle = None
+        dp = device_plane.plane()
+        if dp.available:
+            try:
+                handle = dp.export(arr)
+            except device_plane.DevicePlaneError:
+                handle = None
+        if handle is not None:
+            # Device-native frame: NO host copy of the payload rides the shm
+            # channel — a cross-process reader pulls the buffers directly.
+            rest = value[0] if shape == "pair" else None
+            self._live_exports.append(handle.key)
+            while len(self._live_exports) > self._EXPORT_CAP:
+                dp.release(self._live_exports.pop(0))
+            self._inner.write(("__device__", key, shape, rest, handle), timeout)
         else:
-            self._inner.write(("__host__", None, None, value), timeout)
+            self._inner.write(("__device_host__", key, shape, value, None), timeout)
 
     def read(self, timeout: float = None) -> Any:
         from ray_tpu.experimental import device_objects
 
-        kind, key, shape, value = self._inner.read(timeout)
-        if kind == "__device__":
-            hit = device_objects.lookup(key)
-            if hit is not None:  # zero-copy: splice THE original jax.Array back in
-                return hit if shape == "bare" else (value[0], hit)
-        return value
+        kind, key, shape, rest, handle = self._inner.read(timeout)
+        if kind == "__host__":
+            return rest
+        # "__device__": rest = status half of a pair (or None); payload via plane.
+        # "__device_host__": rest = the FULL original value (host copy embedded).
+        status = rest[0] if (kind == "__device_host__" and shape == "pair") else rest
+        hit = device_objects.lookup(key)
+        if hit is not None:  # zero-copy: splice THE original jax.Array back in
+            return hit if shape == "bare" else (status, hit)
+        if kind == "__device_host__":
+            return rest  # host copy embedded in the frame (plane off)
+        from ray_tpu.core import device_plane
+
+        arr = device_plane.plane().fetch(handle, release=True)
+        return arr if shape == "bare" else (status, arr)
 
     def close(self) -> None:
+        self._release_all()
         self._inner.close()
 
     def destroy(self) -> None:
+        self._release_all()
         self._inner.destroy()
+
+    def _release_all(self) -> None:
+        from ray_tpu.core import device_plane
+
+        dp = device_plane.plane()
+        while self._live_exports:
+            dp.release(self._live_exports.pop())
 
     def __reduce__(self):
         inner = self._inner.__reduce__()
@@ -94,6 +140,7 @@ class DeviceChannel:
 def _rebuild_device_channel(*args):
     ch = DeviceChannel.__new__(DeviceChannel)
     ch._inner = ShmChannel(*args)
+    ch._live_exports = []
     return ch
 
 

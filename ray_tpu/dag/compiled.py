@@ -6,12 +6,13 @@ edge and (b) one persistent exec loop per participating actor (reference
 ``do_exec_tasks`` :191); ``execute()`` then just writes the input channel and
 reads the output channel (driver ``_execute_until`` :2476).
 
-TPU note (why there is no NCCL-channel analogue): between JAX stages the fast
-path for device data is either (1) fuse the stages into ONE jitted program so
-XLA moves activations over ICI itself — do this whenever all stages are pure
-functions — or (2) pass jax.Arrays through the channel, which hands over a
-host copy (fine for rollouts/weights at DCN scale). Compiled DAGs here exist
-for the orchestration win: pipelines of stateful actors (prefill/decode
+TPU note: between JAX stages the fastest path for device data is (1) fuse the
+stages into ONE jitted program so XLA moves activations over ICI itself — do
+this whenever all stages are pure functions. Otherwise (2) the "device" channel
+type moves jax.Arrays device-to-device over the transfer plane
+(core/device_plane.py — the NCCL-channel analogue; DCN on pods), with
+same-process readers getting the original array zero-copy. Compiled DAGs here
+exist for the orchestration win: pipelines of stateful actors (prefill/decode
 disaggregation, env-runner → learner) dispatched at shared-memory latency.
 """
 from __future__ import annotations

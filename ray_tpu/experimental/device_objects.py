@@ -2,20 +2,29 @@
 
 Capability parity: reference python/ray/experimental/gpu_object_manager/
 (GPUObjectManager gpu_object_manager.py:54 — tensors stay on device, refs travel
-through plasma, NCCL transfer on demand). TPU shape of the idea: a jax.Array put
-into the object store keeps its device buffers alive in the producing process
-(weak registry), so a same-process resolve returns the ORIGINAL array — zero
-copies, zero device↔host traffic. Cross-process consumers fall back to the
-serialized host copy (device_put on deserialize); cross-host transfer rides DCN
-the same way. Weak references mean the fast path never extends object lifetime:
-if the producer drops the array, consumers transparently use the durable copy.
+through plasma, NCCL transfer on demand). TPU shape of the idea, three tiers:
+
+1. Same-process resolve returns the ORIGINAL array via a weak registry — zero
+   copies, zero device↔host traffic.
+2. Cross-process consumers pull device-to-device over the transfer plane
+   (core/device_plane.py: PJRT transfer server, DCN on pods) when
+   ``RAY_TPU_DEVICE_OBJECTS`` is "fetch" (default) or "native"; the producer
+   export is pinned until the object is freed cluster-wide.
+3. Fallback is the serialized host copy (device_put on deserialize) — always
+   present in "fetch" mode, absent in "native" mode where only a stub is stored
+   (the true GPU-objects analogue: producer death surfaces ObjectLostError and
+   lineage reconstruction re-runs the producing task).
+
+Weak references mean the same-process fast path never extends object lifetime;
+the plane export (tier 2) does — it is released when the object is freed.
 """
 from __future__ import annotations
 
 import weakref
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 _registry: "weakref.WeakValueDictionary[bytes, Any]" = weakref.WeakValueDictionary()
+_exports: Dict[bytes, bytes] = {}  # oid bytes -> device-plane export key
 
 
 def is_device_array(obj: Any) -> bool:
@@ -56,3 +65,87 @@ def lookup(oid_bytes: Optional[bytes]) -> Optional[Any]:
 
 def drop(oid_bytes: bytes) -> None:
     _registry.pop(oid_bytes, None)
+    key = _exports.pop(oid_bytes, None)
+    if key is not None:
+        from ray_tpu.core import device_plane
+
+        device_plane.plane().release(key)
+
+
+# ------------------------------------------------------- cross-process device path
+
+def wrap_for_store(oid_bytes: bytes, obj: Any) -> Any:
+    """Called by object_store.materialize: swap a big jax.Array for a form whose
+    deserialization pulls device-to-device instead of rehydrating host bytes.
+
+    "fetch" mode keeps the host copy inside the wrapper (durability unchanged,
+    consumers merely PREFER the device pull); "native" stores only a stub."""
+    from ray_tpu.config import CONFIG
+
+    mode = (CONFIG.device_objects or "off").lower()
+    if mode not in ("fetch", "native") or not is_device_array(obj):
+        return obj
+    if obj.nbytes < CONFIG.device_object_min_bytes:
+        return obj
+    from ray_tpu.core import device_plane
+
+    dp = device_plane.plane()
+    if not dp.available:
+        return obj
+    try:
+        handle = dp.export(obj)
+    except device_plane.DevicePlaneError:
+        return obj
+    _exports[oid_bytes] = handle.key
+    if mode == "native":
+        return _DeviceNative(handle)
+    return _DeviceBacked(handle, obj)
+
+
+class _DeviceBacked:
+    """Serialized form = (handle, host copy). Deserializers try the device pull
+    first and fall back to device_put of the host bytes."""
+
+    def __init__(self, handle, arr):
+        self.handle = handle
+        self.arr = arr
+
+    def __reduce__(self):
+        import numpy as np
+
+        return (_rebuild_fetch, (self.handle, np.asarray(self.arr)))
+
+
+class _DeviceNative:
+    """Serialized form = handle only (no host bytes). Producer death surfaces
+    ObjectLostError so lineage reconstruction can re-run the producing task."""
+
+    def __init__(self, handle):
+        self.handle = handle
+
+    def __reduce__(self):
+        return (_rebuild_native, (self.handle,))
+
+
+def _rebuild_fetch(handle, host_np):
+    from ray_tpu.core import device_plane
+
+    try:
+        return device_plane.plane().fetch(handle)
+    except Exception:
+        import jax
+
+        return jax.device_put(host_np)
+
+
+def _rebuild_native(handle):
+    from ray_tpu.core import device_plane
+
+    try:
+        return device_plane.plane().fetch(handle)
+    except Exception as e:
+        from ray_tpu.core.exceptions import ObjectLostError
+
+        raise ObjectLostError(
+            f"device-native object unavailable ({e}); producer gone — "
+            "reconstruction required") from e

@@ -325,11 +325,16 @@ class JaxLLMEngine(LLMEngine):
                     self._aborted.discard(req.id)
 
     # -- P/D disaggregation (reference: prefill_decode_disagg deployments) ---------
-    def prefill_only(self, prompt, params: SamplingParams) -> Dict[str, Any]:
+    def prefill_only(self, prompt, params: SamplingParams,
+                     force_host: bool = False) -> Dict[str, Any]:
         """Run prefill and return transferable KV + the sampled first token.
         Used by prefill replicas; the result feeds generate_from_prefill on a
-        decode replica (host arrays: the cross-replica hop is host/DCN). Does NOT
-        allocate the decode state — prefill replicas stay KV-cache-free."""
+        decode replica. With the device plane up, the KV stays device-resident
+        here and the decode replica pulls it device-to-device (DCN on pods —
+        reference: NCCL KV handoff in prefill_decode_disagg); only a ~1 KB handle
+        rides the control plane. Otherwise the KV travels as host arrays through
+        the object store. Does NOT allocate the decode state — prefill replicas
+        stay KV-cache-free."""
         self.start()
         prompt_ids = self._encode_prompt(prompt, params)
         # chunk-aware: a P/D prefill replica is exactly where long-prompt
@@ -341,33 +346,87 @@ class JaxLLMEngine(LLMEngine):
             jnp.asarray([params.top_p], jnp.float32),
             jnp.asarray([params.top_k], jnp.int32),
         )[0])
-        return {
-            "k": np.asarray(k), "v": np.asarray(v),
-            "prompt_ids": prompt_ids, "first_token": tok,
-        }
+        out = {"prompt_ids": prompt_ids, "first_token": tok}
+        from ray_tpu.core import device_plane as _dp
+
+        dp = _dp.plane()
+        if dp.available and not force_host:
+            handle = dp.export({"k": k, "v": v})
+            self._track_pd_export(handle.key)
+            out["kv_handle"] = handle
+            out["kv_key"] = handle.key.hex()
+        else:
+            out["k"] = np.asarray(k)
+            out["v"] = np.asarray(v)
+        return out
+
+    def _track_pd_export(self, key: bytes, max_live: int = 128,
+                         ttl_s: float = 300.0) -> None:
+        """Exports pin device KV until the decode side's pull acks (fetch
+        release=True); this LRU/TTL prune is the backstop for crashed consumers.
+        Guarded by the engine lock: prefill and decode-ack run on different
+        request threads."""
+        import time as _time
+
+        from ray_tpu.core import device_plane as _dp
+
+        now = _time.monotonic()
+        stale = []
+        with self._lock:
+            pending = self.__dict__.setdefault("_pd_exports", [])
+            pending.append((now, key))
+            while pending and (len(pending) > max_live or now - pending[0][0] > ttl_s):
+                stale.append(pending.pop(0)[1])
+        for old in stale:
+            _dp.plane().release(old)
+
+    def release_prefill_export(self, key_hex: str) -> None:
+        """Decode-side ack: the KV for this prefill was pulled (or abandoned)."""
+        from ray_tpu.core import device_plane as _dp
+
+        key = bytes.fromhex(key_hex)
+        _dp.plane().release(key)
+        with self._lock:
+            pending = self.__dict__.get("_pd_exports")
+            if pending:
+                pending[:] = [e for e in pending if e[1] != key]
 
     def generate_from_prefill(self, prefill_result: Dict[str, Any],
                               params: SamplingParams,
                               request_id: Optional[str] = None
                               ) -> Iterator[RequestOutput]:
-        """Continue decoding from a transferred prefill (decode replica side)."""
+        """Continue decoding from a transferred prefill (decode replica side).
+
+        The device-plane KV pull happens EAGERLY (not at first next()) so a pull
+        failure raises here — where the P/D router can still fall back to the
+        host path — rather than mid-stream."""
         self.start()
         self._ensure_decode_started()
+        if "kv_handle" in prefill_result:
+            from ray_tpu.core import device_plane as _dp
+
+            kv = _dp.plane().fetch(prefill_result["kv_handle"], release=True)
+            pre_k, pre_v = kv["k"], kv["v"]
+        else:
+            pre_k, pre_v = prefill_result["k"], prefill_result["v"]
         req = _Request(
             request_id or uuid.uuid4().hex, list(prefill_result["prompt_ids"]), params,
-            prefill_kv=(prefill_result["k"], prefill_result["v"],
-                        int(prefill_result["first_token"])),
+            prefill_kv=(pre_k, pre_v, int(prefill_result["first_token"])),
         )
         with self._lock:
             self.num_pending += 1
             self._requests[req.id] = req
         self._waiting.put(req)
         self._wakeup.set()
-        while True:
-            out = req.out_queue.get()
-            yield out
-            if out.finished:
-                return
+
+        def _stream() -> Iterator[RequestOutput]:
+            while True:
+                out = req.out_queue.get()
+                yield out
+                if out.finished:
+                    return
+
+        return _stream()
 
     def generate_sync(self, prompt, params: SamplingParams) -> RequestOutput:
         """Collect the full generation into one RequestOutput."""

@@ -193,7 +193,13 @@ class LLMServer:
 
     # -- P/D disaggregation endpoints (reference prefill_decode_disagg/) ---------
     def prefill(self, prompt: str, body: Dict[str, Any]) -> Dict[str, Any]:
-        return self.engine.prefill_only(prompt, _sampling_from_body(body))
+        return self.engine.prefill_only(
+            prompt, _sampling_from_body(body),
+            force_host=bool(body.get("_kv_host_fallback")))
+
+    def release_prefill(self, kv_key: str) -> None:
+        """Ack from the router after decode pulled the device-resident KV."""
+        self.engine.release_prefill_export(kv_key)
 
     def decode_from_prefill(self, prefill_result: Dict[str, Any],
                             body: Dict[str, Any]) -> Dict[str, Any]:
@@ -265,11 +271,26 @@ class OpenAIRouter:
         return self.handle_http({"path": "/v1/completions", "method": "POST", "body": body})
 
 
+def _is_device_plane_error(e: BaseException) -> bool:
+    """Match a DevicePlaneError surfaced through the actor-RPC boundary (the
+    original may arrive re-raised, wrapped, or as a cause)."""
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if type(cur).__name__ == "DevicePlaneError":
+            return True
+        cur = cur.__cause__ or cur.__context__
+    return "DevicePlaneError" in str(e)
+
+
 class PDRouter:
     """Prefill/decode-disaggregated ingress: prompts prefill on one replica pool,
     the KV crosses to a decode pool that streams the completion (reference
-    python/ray/llm/_internal/serve/deployments/prefill_decode_disagg/). On TPU the
-    hop is a host-array transfer through the object store (DCN across hosts)."""
+    python/ray/llm/_internal/serve/deployments/prefill_decode_disagg/). The KV hop
+    is device-to-device over the transfer plane (core/device_plane.py — DCN on
+    pods) when available; only a ~1 KB handle rides the control message. Host
+    arrays through the object store are the fallback."""
 
     def __init__(self, prefill_handle, decode_handle, model_id: str):
         self.prefill_handle = prefill_handle
@@ -279,8 +300,22 @@ class PDRouter:
     def _run(self, prompt: str, body: Dict[str, Any]) -> Dict[str, Any]:
         pre = self.prefill_handle.options(method_name="prefill").remote(
             prompt, body).result()
-        return self.decode_handle.options(method_name="decode_from_prefill").remote(
-            pre, body).result()
+        # KV release: the decode replica acks the prefill side's device-plane
+        # export right after its pull (fetch(..., release=True)); no router hop.
+        try:
+            return self.decode_handle.options(
+                method_name="decode_from_prefill").remote(pre, body).result()
+        except Exception as e:
+            if "kv_handle" not in pre or not _is_device_plane_error(e):
+                raise
+            # Device pull failed (topology mismatch, prefill replica restarted):
+            # redo the request on the host path — the old always-works behavior.
+            body = dict(body)
+            body["_kv_host_fallback"] = True
+            pre = self.prefill_handle.options(method_name="prefill").remote(
+                prompt, body).result()
+            return self.decode_handle.options(
+                method_name="decode_from_prefill").remote(pre, body).result()
 
     def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
         out = self._run(render_chat_template(body.get("messages", [])), body)

@@ -228,7 +228,11 @@ def attention(
             impl = "chunked"
         else:
             impl = "reference"
-        if impl != "pallas" and on_tpu and not tileable:
+        # Warn only for shapes that WOULD have hit Pallas but for tiling — decode
+        # shapes (offsets/valid-len, Sq != Skv) are deliberately XLA-routed.
+        if (impl != "pallas" and on_tpu and not tileable
+                and q_offset is None and kv_valid_len is None
+                and (same_len or not causal)):
             _log_fallback_once(q.shape, k.shape, impl)
     if impl == "pallas":
         from .flash_attention import flash_attention

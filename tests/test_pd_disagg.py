@@ -14,7 +14,10 @@ def _cfg(**kw):
                      max_model_len=64, **kw)
 
 
-def test_pd_matches_colocated_greedy():
+def test_pd_matches_colocated_greedy(monkeypatch):
+    # Pin the HOST transfer path (device plane off): the device-plane handoff has
+    # its own coverage in test_device_plane.py::test_pd_disagg_kv_rides_device_plane.
+    monkeypatch.setenv("RAY_TPU_DEVICE_PLANE", "0")
     prompt = [1, 7, 42, 99, 5]
     params = SamplingParams(max_tokens=6, temperature=0.0, stop_token_ids=[-1])
 
