@@ -221,7 +221,10 @@ def bench_kv_handoff(nbytes=64 * 1024 * 1024, iters=8):
     """GB/s of a P/D-style KV handoff between two processes: device plane
     (PJRT transfer server pull) vs host path (np + pickle over a pipe)."""
     import multiprocessing as mp
+    import secrets
 
+    # children must share one session authkey (the plane refuses to mint one)
+    os.environ.setdefault("RAY_TPU_CLIENT_AUTHKEY", secrets.token_hex(16))
     ctx = mp.get_context("spawn")
     p_end, c_end = ctx.Pipe()
     res_parent, res_child = ctx.Pipe()
@@ -232,8 +235,14 @@ def bench_kv_handoff(nbytes=64 * 1024 * 1024, iters=8):
     prod.start()
     cons.start()
     try:
-        if not res_parent.poll(600):
-            raise TimeoutError("kv handoff bench timed out")
+        deadline = time.time() + 600
+        while not res_parent.poll(1.0):
+            if time.time() > deadline:
+                raise TimeoutError("kv handoff bench timed out")
+            if not (prod.is_alive() and cons.is_alive()):
+                raise RuntimeError(
+                    f"kv handoff child died (producer rc={prod.exitcode}, "
+                    f"consumer rc={cons.exitcode})")
         t_plane, t_host = res_parent.recv()
     finally:
         prod.join(30)

@@ -160,12 +160,20 @@ class DevicePlane:
         # Explicit socket transport addresses: the default same-host "local" bulk
         # transport is not implemented for all backends (CHECK-fails on CPU), and
         # cross-host always needs routable sockets anyway.
+        from ray_tpu.util.client.server import load_authkey
+
+        authkey = load_authkey()
+        if authkey is None:
+            # Never MINT a key here: two peers racing generate_authkey() would
+            # persist different session keys and every fetch would fail auth.
+            # No cluster session -> no plane (callers fall back to host bytes).
+            raise RuntimeError(
+                "no cluster session authkey (set RAY_TPU_CLIENT_AUTHKEY or "
+                "init a cluster first)")
         server = transfer.start_transfer_server(
             client, f"{ip}:0", [f"{ip}:0"])
         addr = server.address()
-        from ray_tpu.util.client.server import generate_authkey, load_authkey
-
-        self._authkey = load_authkey() or generate_authkey()
+        self._authkey = authkey
         from multiprocessing.connection import Listener
 
         listener = Listener((ip, 0), backlog=64)
@@ -319,9 +327,11 @@ class DevicePlane:
         from multiprocessing.connection import Client
         import pickle
 
-        from ray_tpu.util.client.server import generate_authkey, load_authkey
+        from ray_tpu.util.client.server import load_authkey
 
-        authkey = self._authkey or load_authkey() or generate_authkey()
+        authkey = self._authkey or load_authkey()
+        if authkey is None:
+            raise DevicePlaneError("no cluster session authkey")
         try:
             conn = Client((handle.arm_host, handle.arm_port), authkey=authkey)
         except Exception as e:

@@ -82,7 +82,13 @@ class DeviceChannel:
         device_objects.stash(key, arr)  # same-process readers skip the copy
         handle = None
         dp = device_plane.plane()
-        if dp.available:
+        from ray_tpu.config import CONFIG
+
+        # Small arrays keep the embedded host copy: the arm round-trip isn't
+        # worth it, and the host frame lets ANY reader proceed. Big arrays go
+        # device-native — both endpoints of a "device" channel must then have
+        # the plane up (NCCL-channel semantics in the reference).
+        if dp.available and arr.nbytes >= CONFIG.device_object_min_bytes:
             try:
                 handle = dp.export(arr)
             except device_plane.DevicePlaneError:
@@ -111,10 +117,17 @@ class DeviceChannel:
         if hit is not None:  # zero-copy: splice THE original jax.Array back in
             return hit if shape == "bare" else (status, hit)
         if kind == "__device_host__":
-            return rest  # host copy embedded in the frame (plane off)
+            return rest  # host copy embedded in the frame (plane off / small)
         from ray_tpu.core import device_plane
 
-        arr = device_plane.plane().fetch(handle, release=True)
+        try:
+            arr = device_plane.plane().fetch(handle, release=True)
+        except device_plane.DevicePlaneError as e:
+            raise device_plane.DevicePlaneError(
+                "device channel frame lost: this reader cannot pull from the "
+                "writer's transfer plane (both endpoints of a 'device' channel "
+                f"need RAY_TPU_DEVICE_PLANE and a shared session authkey): {e}"
+            ) from e
         return arr if shape == "bare" else (status, arr)
 
     def close(self) -> None:

@@ -377,8 +377,34 @@ class JaxLLMEngine(LLMEngine):
             pending.append((now, key))
             while pending and (len(pending) > max_live or now - pending[0][0] > ttl_s):
                 stale.append(pending.pop(0)[1])
+            if not self.__dict__.get("_pd_prune_thread"):
+                # TTL enforcement can't depend on the NEXT prefill arriving —
+                # a crashed consumer with no follow-on traffic would pin KV
+                # forever. A lazy daemon sweeps on a timer.
+                import threading as _threading
+
+                t = _threading.Thread(target=self._pd_prune_loop, daemon=True,
+                                      name="rt-pd-export-prune")
+                self.__dict__["_pd_prune_thread"] = t
+                t.start()
         for old in stale:
             _dp.plane().release(old)
+
+    def _pd_prune_loop(self, interval_s: float = 30.0, ttl_s: float = 300.0) -> None:
+        import time as _time
+
+        from ray_tpu.core import device_plane as _dp
+
+        while not getattr(self, "_shutdown", False):
+            _time.sleep(interval_s)
+            now = _time.monotonic()
+            stale = []
+            with self._lock:
+                pending = self.__dict__.get("_pd_exports") or []
+                while pending and now - pending[0][0] > ttl_s:
+                    stale.append(pending.pop(0)[1])
+            for old in stale:
+                _dp.plane().release(old)
 
     def release_prefill_export(self, key_hex: str) -> None:
         """Decode-side ack: the KV for this prefill was pulled (or abandoned)."""

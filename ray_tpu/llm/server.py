@@ -310,6 +310,12 @@ class PDRouter:
                 raise
             # Device pull failed (topology mismatch, prefill replica restarted):
             # redo the request on the host path — the old always-works behavior.
+            # Free the orphaned export now instead of waiting for its TTL.
+            try:
+                self.prefill_handle.options(method_name="release_prefill").remote(
+                    pre["kv_key"])
+            except Exception:
+                pass
             body = dict(body)
             body["_kv_host_fallback"] = True
             pre = self.prefill_handle.options(method_name="prefill").remote(

@@ -239,7 +239,7 @@ def test_device_channel_cross_process_pull(rt):
     name = "devch_" + os.urandom(4).hex()
     ch = DeviceChannel(name, 1 << 20, create=True)
     try:
-        arr = jnp.arange(65536.0)  # 256 KiB
+        arr = jnp.ones((524288,)) * 4.0  # 2 MiB: above the device-native gate
         before = plane().stats()
         ch.write(("ok", arr))
 
@@ -255,7 +255,7 @@ def test_device_channel_cross_process_pull(rt):
 
         status, total, pulls = rt.get(read_side.remote(ch))
         assert status == "ok"
-        assert total == float(np.arange(65536.0).sum())
+        assert total == 4.0 * 524288
         assert pulls >= 1
         assert plane().stats()["arms"] >= before["arms"] + 1
     finally:
