@@ -183,7 +183,14 @@ class GCPTPUProvider(NodeProvider):
             "--accelerator-type", self.cfg["accelerator_type"],
             "--version", self.cfg["runtime_version"],
         ] + list(self.cfg.get("create_extra_args", []))
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            # surface gcloud's actual complaint (quota, zone capacity, bad
+            # version) instead of a bare non-zero-exit error (ADVICE r3)
+            raise RuntimeError(
+                f"gcloud create failed (rc={e.returncode}): "
+                f"{(e.stderr or e.stdout or '').strip()[-2000:]}") from e
         return NodeInstance(instance_id=name, node_type=node_type, status="running")
 
     def terminate_node(self, instance_id: str) -> None:

@@ -70,6 +70,16 @@ _FLAGS: List[Flag] = [
     # -- multi-host control plane
     Flag("agent_heartbeat_s", "RAY_TPU_AGENT_HEARTBEAT_S", "float", 2.0,
          "Node-agent heartbeat period to the head."),
+    # -- transport security
+    Flag("use_tls", "RAY_TPU_USE_TLS", "bool", False,
+         "mTLS on the gRPC agent channel and the data/device-plane listeners; "
+         "plaintext peers are refused (reference tls_utils.py RAY_USE_TLS)."),
+    Flag("tls_ca", "RAY_TPU_TLS_CA", "str", None,
+         "CA certificate path (both trust root and client-auth verifier)."),
+    Flag("tls_cert", "RAY_TPU_TLS_CERT", "str", None,
+         "Cluster certificate path (`ray-tpu tls-init` mints one)."),
+    Flag("tls_key", "RAY_TPU_TLS_KEY", "str", None,
+         "Cluster private key path."),
     # -- device plane (device-to-device tensor transfer between processes)
     Flag("device_plane", "RAY_TPU_DEVICE_PLANE", "bool", True,
          "Enable the PJRT transfer-server plane: jax.Arrays move between actor "

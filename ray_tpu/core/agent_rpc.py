@@ -357,7 +357,15 @@ class AgentRpcServer:
                      ("grpc.max_receive_message_length", 512 * 1024 * 1024),
                      ("grpc.max_send_message_length", 512 * 1024 * 1024)])
         self._server.add_generic_rpc_handlers((handler,))
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        from ray_tpu.core import tls_utils
+
+        if tls_utils.use_tls():
+            # mTLS (reference src/ray/rpc/ TLS-capable GrpcServer): plaintext
+            # dials are refused at the handshake
+            self.port = self._server.add_secure_port(
+                f"{host}:{port}", tls_utils.grpc_server_credentials())
+        else:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
         self._server.start()
 
     def _authed(self, context) -> bool:
@@ -437,11 +445,18 @@ class HeadConnection:
                  connect_timeout: float = 10.0):
         import grpc
 
-        self._channel = grpc.insecure_channel(
-            f"{host}:{port}",
-            options=[("grpc.keepalive_time_ms", 10000),
-                     ("grpc.max_receive_message_length", 512 * 1024 * 1024),
-                     ("grpc.max_send_message_length", 512 * 1024 * 1024)])
+        from ray_tpu.core import tls_utils
+
+        opts = [("grpc.keepalive_time_ms", 10000),
+                ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+                ("grpc.max_send_message_length", 512 * 1024 * 1024)]
+        if tls_utils.use_tls():
+            self._channel = grpc.secure_channel(
+                f"{host}:{port}", tls_utils.grpc_channel_credentials(),
+                options=opts + [("grpc.ssl_target_name_override",
+                                 tls_utils.TLS_TARGET_NAME)])
+        else:
+            self._channel = grpc.insecure_channel(f"{host}:{port}", options=opts)
         grpc.channel_ready_future(self._channel).result(timeout=connect_timeout)
         # bounded for backpressure: a dead/stalled head makes send() RAISE
         # after the grace instead of buffering frames into a void

@@ -58,18 +58,14 @@ def init(
             from ray_tpu.runtime_env import RuntimeEnv
 
             runtime_env = dict(RuntimeEnv(**runtime_env))
-        connect(address.split("://", 1)[1])
+        ctx = connect(address.split("://", 1)[1])
         if runtime_env:
-            # job-scoped default for THIS client driver: every spec it builds
-            # goes through resolved_runtime_env(), which falls back to this
-            # env var when no in-process cluster exists — so the default rides
-            # each submitted task/actor without any head-side state
-            import json as _json
-
-            global _client_prev_renv, _client_default_renv_set
-            _client_prev_renv = os.environ.get("RAY_TPU_DEFAULT_RUNTIME_ENV")
-            os.environ["RAY_TPU_DEFAULT_RUNTIME_ENV"] = _json.dumps(runtime_env)
-            _client_default_renv_set = True
+            # job-scoped default for THIS client context: every spec the driver
+            # builds goes through resolved_runtime_env(), which consults the
+            # active ClientContext — scoping it to the object (not os.environ)
+            # keeps concurrent client contexts in one process from
+            # cross-contaminating each other's job defaults (ADVICE r3)
+            ctx.default_runtime_env = dict(runtime_env)
         atexit.register(shutdown)
         return
     from ray_tpu.config import CONFIG
@@ -123,21 +119,7 @@ def init(
     atexit.register(shutdown)
 
 
-_client_default_renv_set = False
-_client_prev_renv: Optional[str] = None
-
-
 def shutdown() -> None:
-    global _client_default_renv_set, _client_prev_renv
-    if _client_default_renv_set:
-        # a stale client-job default must not leak into the next session;
-        # restore whatever (e.g. a worker-inherited default) was there before
-        if _client_prev_renv is None:
-            os.environ.pop("RAY_TPU_DEFAULT_RUNTIME_ENV", None)
-        else:
-            os.environ["RAY_TPU_DEFAULT_RUNTIME_ENV"] = _client_prev_renv
-        _client_default_renv_set = False
-        _client_prev_renv = None
     from ray_tpu.util.client.client import ClientContext
 
     w = global_state.try_worker()

@@ -106,7 +106,9 @@ class DataServer:
         # handshake INLINE, serializing all dials behind one slow/dead peer.
         # Each connection authenticates on its own thread instead, with
         # fd-level stall bounds.
-        self._listener = Listener((host, port), backlog=128)
+        from ray_tpu.core.secure_transport import make_listener
+
+        self._listener = make_listener((host, port), backlog=128)
         self.port: int = self._listener.address[1]
         self._shutdown = False
         # source-side cap: a broadcast to N nodes serves at most this many
@@ -220,6 +222,20 @@ class DataClient:
         the auth exchange AND every later recv, so a half-dead server can never
         pin a puller thread (multiprocessing's Client() would block forever)."""
         stall = CONFIG.transfer_stall_timeout_s
+        from ray_tpu.core import tls_utils
+
+        if tls_utils.use_tls():
+            from ray_tpu.core.secure_transport import dial
+
+            conn = dial(addr, timeout=min(10.0, stall))
+            try:
+                _set_fd_timeouts(conn.fileno(), stall)
+                answer_challenge(conn, self._authkey)
+                deliver_challenge(conn, self._authkey)
+            except BaseException:
+                conn.close()
+                raise
+            return conn
         s = socket.create_connection(addr, timeout=min(10.0, stall))
         s.settimeout(None)  # hand a blocking fd over; SO_*TIMEO bounds the ops
         conn = Connection(s.detach())

@@ -174,9 +174,9 @@ class DevicePlane:
             client, f"{ip}:0", [f"{ip}:0"])
         addr = server.address()
         self._authkey = authkey
-        from multiprocessing.connection import Listener
+        from ray_tpu.core.secure_transport import make_listener
 
-        listener = Listener((ip, 0), backlog=64)
+        listener = make_listener((ip, 0), backlog=64)
         self._server = server
         self._xfer_addr = addr
         self._arm_listener = listener
@@ -234,8 +234,10 @@ class DevicePlane:
         while True:
             try:
                 conn = self._arm_listener.accept()
-            except (OSError, EOFError):
-                return
+            except EOFError:
+                continue  # one bad/failed dial (TLS probe) must not stop serving
+            except OSError:
+                return  # listener closed
             threading.Thread(target=self._serve_arm, args=(conn,), daemon=True,
                              name="rt-device-plane-serve").start()
 
@@ -324,16 +326,16 @@ class DevicePlane:
             raise DevicePlaneError(f"device fetch failed: {type(e).__name__}: {e}") from e
 
     def _control(self, handle: DeviceHandle, msg: Tuple) -> Tuple:
-        from multiprocessing.connection import Client
         import pickle
 
+        from ray_tpu.core.secure_transport import dial
         from ray_tpu.util.client.server import load_authkey
 
         authkey = self._authkey or load_authkey()
         if authkey is None:
             raise DevicePlaneError("no cluster session authkey")
         try:
-            conn = Client((handle.arm_host, handle.arm_port), authkey=authkey)
+            conn = dial((handle.arm_host, handle.arm_port), authkey=authkey)
         except Exception as e:
             raise DevicePlaneError(f"producer unreachable: {e}") from e
         try:

@@ -25,19 +25,74 @@ class NodeInfo:
     start_time: float = field(default_factory=time.time)
 
 
+class _UriJournal:
+    """Append-log over an fsspec URI "directory": each flush writes a new
+    numbered segment object, replay reads segments in order, startup compaction
+    collapses them into one snapshot segment and deletes the rest.
+
+    This is the EXTERNAL-store half of head HA (reference: RedisStoreClient,
+    gcs_redis_failure_detector.h): with the journal in a bucket, a replacement
+    head on a *different machine/port* replays the same state. Per-mutation
+    segment writes trade object-store round-trip latency for durability — the
+    same trade Redis AOF fsync=always makes; cluster-metadata mutation rates
+    (app configs, named actors, job table) are low."""
+
+    def __init__(self, uri: str):
+        from ray_tpu.train import storage
+
+        self._storage = storage
+        self.uri = uri.rstrip("/")
+        self.seq = 0
+
+    def _segments(self) -> List[str]:
+        return sorted(n for n in self._storage.listdir(self.uri)
+                      if n.startswith("seg-"))
+
+    def replay_lines(self):
+        segs = self._segments()
+        for name in segs:
+            data = self._storage.read_bytes(f"{self.uri}/{name}") or b""
+            yield from data.splitlines()
+        if segs:
+            self.seq = int(segs[-1][4:]) + 1
+
+    def append(self, line: bytes) -> None:
+        self._storage.write_bytes(f"{self.uri}/seg-{self.seq:012d}", line)
+        self.seq += 1
+
+    def compact(self, lines: List[bytes]) -> None:
+        old = self._segments()
+        self.append(b"\n".join(lines))
+        for name in old:
+            self._storage.delete(f"{self.uri}/{name}")
+
+    def close(self) -> None:
+        pass
+
+
 class KVStore:
     """Namespaced key-value store (reference: GcsInternalKVManager, gcs_kv_manager.h:104).
 
     With a persistence path (reference: RedisStoreClient behind GcsTableStorage),
     mutations append to a journal; a fresh KVStore replays it at startup, so
     cluster-level state (serve app configs, job table, user KV) survives a
-    coordinator restart the way GCS state survives via Redis."""
+    coordinator restart the way GCS state survives via Redis. A local file path
+    journals to that file; a URI (``gs://bucket/cluster1/gcs``, or ``mock://``
+    in tests) journals to an external store, so the replacement head can start
+    on a DIFFERENT machine or port."""
 
     def __init__(self, persistence_path: Optional[str] = None):
         self._lock = threading.Lock()
         self._data: Dict[Tuple[str, bytes], bytes] = {}
         self._journal = None
-        if persistence_path:
+        self._uri_journal: Optional[_UriJournal] = None
+        if persistence_path and "://" in persistence_path:
+            self._uri_journal = _UriJournal(persistence_path)
+            for line in self._uri_journal.replay_lines():
+                self._apply_line(line)
+            self._uri_journal.compact(
+                [self._encode("put", ns, k, v) for (ns, k), v in self._data.items()])
+        elif persistence_path:
             import os
 
             os.makedirs(os.path.dirname(persistence_path) or ".", exist_ok=True)
@@ -53,35 +108,46 @@ class KVStore:
             os.replace(tmp, persistence_path)
             self._journal = open(persistence_path, "ab")
 
-    def _replay(self, path: str) -> None:
+    def _apply_line(self, line: bytes) -> None:
         import base64
         import json
+
+        try:
+            rec = json.loads(line)
+            k = (rec["ns"], base64.b64decode(rec["k"]))
+            if rec["op"] == "put":
+                self._data[k] = base64.b64decode(rec["v"])
+            else:
+                self._data.pop(k, None)
+        except (ValueError, KeyError):
+            pass  # torn tail write from a crash: ignore
+
+    def _replay(self, path: str) -> None:
         import os
 
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
             for line in f:
-                try:
-                    rec = json.loads(line)
-                    k = (rec["ns"], base64.b64decode(rec["k"]))
-                    if rec["op"] == "put":
-                        self._data[k] = base64.b64decode(rec["v"])
-                    else:
-                        self._data.pop(k, None)
-                except (ValueError, KeyError):
-                    continue  # torn tail write from a crash: ignore
+                self._apply_line(line)
 
-    def _log(self, op: str, namespace: str, key: bytes, value: Optional[bytes]) -> None:
-        if self._journal is None:
-            return
+    @staticmethod
+    def _encode(op: str, namespace: str, key: bytes, value: Optional[bytes]) -> bytes:
         import base64
         import json
 
         rec = {"op": op, "ns": namespace, "k": base64.b64encode(key).decode()}
         if value is not None:
             rec["v"] = base64.b64encode(value).decode()
-        self._journal.write(json.dumps(rec).encode() + b"\n")
+        return json.dumps(rec).encode()
+
+    def _log(self, op: str, namespace: str, key: bytes, value: Optional[bytes]) -> None:
+        if self._uri_journal is not None:
+            self._uri_journal.append(self._encode(op, namespace, key, value))
+            return
+        if self._journal is None:
+            return
+        self._journal.write(self._encode(op, namespace, key, value) + b"\n")
         self._journal.flush()
 
     def put(self, key: bytes, value: bytes, namespace: str = "", overwrite: bool = True) -> bool:

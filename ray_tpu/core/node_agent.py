@@ -41,7 +41,8 @@ class NodeAgent:
     def __init__(self, head_host: str, head_port: int, authkey: bytes,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None,
+                 fallback_addresses: Optional[list] = None):
         from .resources import normalize_resources
 
         if resources is None:
@@ -65,12 +66,29 @@ class NodeAgent:
         self.max_workers = max_workers or CONFIG.max_workers_per_node
         self._head_host = head_host
         self._head_port = head_port
+        # replacement-head candidates (HA: an external-store journal lets the
+        # head restart on a different machine/port; reference
+        # gcs_redis_failure_detector.h — raylets reconnect to wherever GCS
+        # comes back). Reconnect cycles current + fallbacks.
+        self._head_addresses = [(head_host, head_port)] + list(fallback_addresses or [])
         self._authkey = authkey
         # typed gRPC control stream (reference node_manager.proto): tuples
         # encode to protobuf at the boundary, nothing is pickled on this channel
         from . import agent_rpc
 
-        self.conn = agent_rpc.HeadConnection(head_host, head_port, authkey)
+        # initial dial tries every candidate: an agent (re)started AFTER a head
+        # failover must be able to join the replacement directly
+        last_err: Optional[Exception] = None
+        self.conn = None
+        for host, port in self._head_addresses:
+            try:
+                self.conn = agent_rpc.HeadConnection(host, port, authkey)
+                self._head_host, self._head_port = host, port
+                break
+            except Exception as e:  # noqa: BLE001 — try the next candidate
+                last_err = e
+        if self.conn is None:
+            raise last_err if last_err else OSError("no head address reachable")
         # bulk-object plane: a dedicated listener (chunked pulls from peers /
         # the head) + a pooled puller, so object bytes never ride the control
         # connection (reference object_manager.h:119)
@@ -289,17 +307,24 @@ class NodeAgent:
         delay = 0.3
         from . import agent_rpc
 
+        attempt = 0
         while not self._shutdown and time.monotonic() < deadline:
+            # round-robin over candidate heads: with a URI journal the
+            # replacement head may come back on a different address
+            host, port = self._head_addresses[attempt % len(self._head_addresses)]
+            attempt += 1
             try:
                 conn = agent_rpc.HeadConnection(
-                    self._head_host, self._head_port, self._authkey,
+                    host, port, self._authkey,
                     connect_timeout=min(5.0, delay * 4))
             except Exception:
-                time.sleep(min(delay, max(0.05, deadline - time.monotonic())))
-                delay = min(delay * 2, 3.0)
+                if attempt % len(self._head_addresses) == 0:
+                    time.sleep(min(delay, max(0.05, deadline - time.monotonic())))
+                    delay = min(delay * 2, 3.0)
                 continue
             try:
                 self._reregister(conn)
+                self._head_host, self._head_port = host, port
                 return True
             except Exception:
                 try:
@@ -489,9 +514,13 @@ def agent_main(address: str, authkey: Optional[bytes] = None,
             raise RuntimeError(
                 "no cluster authkey: set RAY_TPU_CLIENT_AUTHKEY or run on a host "
                 "with the head's session dir")
-    host, _, port = address.rpartition(":")
-    agent = NodeAgent(host or "127.0.0.1", int(port), authkey,
-                      resources=resources, labels=labels, max_workers=max_workers)
+    candidates = []
+    for addr in address.split(","):
+        host, _, port = addr.strip().rpartition(":")
+        candidates.append((host or "127.0.0.1", int(port)))
+    agent = NodeAgent(candidates[0][0], candidates[0][1], authkey,
+                      resources=resources, labels=labels, max_workers=max_workers,
+                      fallback_addresses=candidates[1:])
     agent.register()
     agent.serve_forever()
 

@@ -1,0 +1,173 @@
+"""TLS transport for the raw-socket planes (data plane, device-plane arm server).
+
+multiprocessing.connection has no TLS story, so when RAY_TPU_USE_TLS is set the
+listeners/dialers below replace it with ssl-wrapped sockets exposing the same
+duck-typed surface the planes (and mp's deliver/answer_challenge) use:
+send_bytes / recv_bytes / poll / fileno / close. Framing is a 4-byte big-endian
+length prefix. Plaintext or wrong-CA peers fail the TLS handshake — refused
+before a single protocol byte is exchanged (reference tls_utils.py RAY_USE_TLS
+across src/ray/rpc and the object manager).
+"""
+from __future__ import annotations
+
+import select
+import socket
+import struct
+from typing import Optional, Tuple
+
+
+class SecureConnection:
+    """mp.Connection-compatible wrapper over a TLS-wrapped blocking socket.
+
+    Server-side sockets arrive with the handshake PENDING (wrap_socket with
+    do_handshake_on_connect=False): the accept loop must never block on a
+    peer's handshake, so it completes lazily — bounded by a timeout — on the
+    per-connection thread's first operation."""
+
+    _HANDSHAKE_TIMEOUT_S = 15.0
+
+    def __init__(self, sock, handshake_pending: bool = False):
+        self._sock = sock
+        self._handshake_pending = handshake_pending
+
+    def _ensure_handshake(self) -> None:
+        if not self._handshake_pending:
+            return
+        self._handshake_pending = False
+        prev = self._sock.gettimeout()
+        self._sock.settimeout(self._HANDSHAKE_TIMEOUT_S)
+        try:
+            self._sock.do_handshake()
+        except Exception as e:
+            raise EOFError(f"TLS handshake failed: {e}") from e
+        finally:
+            try:
+                self._sock.settimeout(prev)
+            except OSError:
+                pass
+
+    def send_bytes(self, buf) -> None:
+        self._ensure_handshake()
+        data = bytes(buf)
+        self._sock.sendall(struct.pack("!I", len(data)) + data)
+
+    # mp.Connection.send pickles; the planes only use send/recv for small
+    # control tuples (the device-plane handle hop), so mirror that here.
+    def send(self, obj) -> None:
+        import pickle
+
+        self.send_bytes(pickle.dumps(obj))
+
+    def recv(self):
+        import pickle
+
+        return pickle.loads(self.recv_bytes())
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self._sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise EOFError("secure connection closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def recv_bytes(self, maxlength: Optional[int] = None) -> bytes:
+        self._ensure_handshake()
+        (size,) = struct.unpack("!I", self._recv_exact(4))
+        if maxlength is not None and size > maxlength:
+            raise OSError(f"message too large ({size} > {maxlength})")
+        return self._recv_exact(size)
+
+    def poll(self, timeout: float = 0.0) -> bool:
+        self._ensure_handshake()
+        # TLS may hold already-decrypted bytes in its buffer; select alone
+        # would miss them
+        if getattr(self._sock, "pending", lambda: 0)():
+            return True
+        r, _, _ = select.select([self._sock], [], [], timeout)
+        return bool(r)
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SecureListener:
+    """mp.Listener-compatible mTLS listener: accept() completes the handshake
+    and returns a SecureConnection; failed handshakes raise EOFError (matching
+    mp.Listener's bad-dial behavior, which callers already tolerate)."""
+
+    def __init__(self, address: Tuple[str, int], backlog: int = 64):
+        from ray_tpu.core import tls_utils
+
+        self._ctx = tls_utils.server_ssl_context()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(address)
+        self._sock.listen(backlog)
+        self.address = self._sock.getsockname()
+
+    def accept(self) -> SecureConnection:
+        import ssl
+
+        conn, _ = self._sock.accept()
+        try:
+            # handshake deferred: a peer that never sends a ClientHello must
+            # stall only its own connection thread, never the accept loop
+            wrapped = self._ctx.wrap_socket(conn, server_side=True,
+                                            do_handshake_on_connect=False)
+        except (ssl.SSLError, OSError) as e:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise EOFError(f"TLS wrap failed: {e}") from e
+        return SecureConnection(wrapped, handshake_pending=True)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def make_listener(address: Tuple[str, int], backlog: int = 64):
+    """TLS listener when RAY_TPU_USE_TLS, else plain mp.connection.Listener."""
+    from ray_tpu.core import tls_utils
+
+    if tls_utils.use_tls():
+        return SecureListener(address, backlog=backlog)
+    from multiprocessing.connection import Listener
+
+    return Listener(address, backlog=backlog)
+
+
+def dial(address: Tuple[str, int], authkey: Optional[bytes] = None,
+         timeout: Optional[float] = None):
+    """TLS dial when RAY_TPU_USE_TLS, else plain mp.connection.Client. The
+    mp challenge auth still runs over the encrypted channel when authkey is
+    given — TLS authenticates the transport, the authkey scopes the cluster."""
+    from ray_tpu.core import tls_utils
+
+    if tls_utils.use_tls():
+        from multiprocessing.connection import answer_challenge, deliver_challenge
+
+        ctx = tls_utils.client_ssl_context()
+        raw = socket.create_connection(address, timeout=timeout)
+        sock = ctx.wrap_socket(raw)
+        sock.settimeout(None)  # planes manage stall bounds at the fd level
+        conn = SecureConnection(sock)
+        if authkey is not None:
+            answer_challenge(conn, authkey)
+            deliver_challenge(conn, authkey)
+        return conn
+    from multiprocessing.connection import Client
+
+    return Client(address, authkey=authkey)

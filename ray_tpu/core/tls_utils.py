@@ -1,0 +1,185 @@
+"""Cluster TLS: self-signed cert generation + loading.
+
+Capability parity: reference python/ray/_private/tls_utils.py:6 (RAY_USE_TLS,
+RAY_TLS_SERVER_CERT/KEY/CA_CERT). When enabled, the inter-NODE planes run mTLS
+with one shared credential set: the head<->agent gRPC channel, the bulk data
+plane, and the device-plane arm server; plaintext peers are refused at the
+handshake. NOT yet covered: the ray-tpu:// client-driver port and the serve
+HTTP/gRPC ingress (front those with a TLS-terminating proxy, or keep the
+client port on localhost/an SSH tunnel — same posture as the reference
+dashboard). The PJRT transfer-server payload stream is runtime-managed and
+rides the trust of the arm handshake that gates every pull uuid.
+
+`ray-tpu tls-init <dir>` (or generate_self_signed_tls()) mints a CA plus one
+cluster certificate whose SAN covers localhost and this host's addresses;
+distribute the three files to every node and set:
+    RAY_TPU_USE_TLS=1
+    RAY_TPU_TLS_CA=<dir>/ca.crt
+    RAY_TPU_TLS_CERT=<dir>/cluster.crt
+    RAY_TPU_TLS_KEY=<dir>/cluster.key
+"""
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import socket
+from typing import Optional, Tuple
+
+from ray_tpu.config import CONFIG
+
+# gRPC target-name override: clients dial by IP, the cert carries this name.
+TLS_TARGET_NAME = "ray-tpu-cluster"
+
+
+def use_tls() -> bool:
+    return bool(CONFIG.use_tls)
+
+
+def generate_self_signed_tls(out_dir: str, extra_sans: Tuple[str, ...] = ()) -> dict:
+    """Mint ca.crt/ca.key + cluster.crt/cluster.key under out_dir; returns paths."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(out_dir, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def _key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    def _write_key(key, path):
+        with open(os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600),
+                  "wb") as f:
+            f.write(key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption()))
+
+    ca_key = _key()
+    ca_name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "ray-tpu-ca")])
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name).issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    sans = [x509.DNSName(TLS_TARGET_NAME), x509.DNSName("localhost")]
+    ips = {"127.0.0.1"}
+    try:
+        ips.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ips.add(s.getsockname()[0])
+        s.close()
+    except OSError:
+        pass
+    for extra in extra_sans:
+        try:
+            ips.add(str(ipaddress.ip_address(extra)))
+        except ValueError:
+            sans.append(x509.DNSName(extra))
+    for ip in sorted(ips):
+        sans.append(x509.IPAddress(ipaddress.ip_address(ip)))
+
+    key = _key()
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, TLS_TARGET_NAME)]))
+        .issuer_name(ca_name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    paths = {
+        "ca": os.path.join(out_dir, "ca.crt"),
+        "ca_key": os.path.join(out_dir, "ca.key"),
+        "cert": os.path.join(out_dir, "cluster.crt"),
+        "key": os.path.join(out_dir, "cluster.key"),
+    }
+    with open(paths["ca"], "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+    _write_key(ca_key, paths["ca_key"])
+    with open(paths["cert"], "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    _write_key(key, paths["key"])
+    return paths
+
+
+def load_cert_paths() -> Tuple[str, str, str]:
+    """(ca, cert, key) file paths from config; raises if TLS is on but unset."""
+    ca, cert, key = CONFIG.tls_ca, CONFIG.tls_cert, CONFIG.tls_key
+    missing = [n for n, v in (("RAY_TPU_TLS_CA", ca), ("RAY_TPU_TLS_CERT", cert),
+                              ("RAY_TPU_TLS_KEY", key)) if not v]
+    if missing:
+        raise RuntimeError(
+            f"RAY_TPU_USE_TLS=1 but {', '.join(missing)} unset — run "
+            "`ray-tpu tls-init <dir>` and point the env vars at its output")
+    return ca, cert, key
+
+
+def load_cert_bytes() -> Tuple[bytes, bytes, bytes]:
+    ca, cert, key = load_cert_paths()
+    with open(ca, "rb") as f:
+        ca_b = f.read()
+    with open(cert, "rb") as f:
+        cert_b = f.read()
+    with open(key, "rb") as f:
+        key_b = f.read()
+    return ca_b, cert_b, key_b
+
+
+def server_ssl_context():
+    """mTLS server context for raw-socket planes (data plane, device plane)."""
+    import ssl
+
+    ca, cert, key = load_cert_paths()
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    ctx.load_verify_locations(ca)
+    ctx.verify_mode = ssl.CERT_REQUIRED  # mTLS: plaintext/unknown peers refused
+    return ctx
+
+
+def client_ssl_context():
+    import ssl
+
+    ca, cert, key = load_cert_paths()
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(cert, key)
+    ctx.load_verify_locations(ca)
+    ctx.check_hostname = False  # peers dial by IP; the CA pin is the trust root
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def grpc_server_credentials():
+    import grpc
+
+    ca_b, cert_b, key_b = load_cert_bytes()
+    return grpc.ssl_server_credentials(
+        [(key_b, cert_b)], root_certificates=ca_b,
+        require_client_auth=True)
+
+
+def grpc_channel_credentials():
+    import grpc
+
+    ca_b, cert_b, key_b = load_cert_bytes()
+    return grpc.ssl_channel_credentials(
+        root_certificates=ca_b, private_key=key_b, certificate_chain=cert_b)

@@ -548,6 +548,26 @@ class TFRecordDatasink(_FileDatasink):
                     elif isinstance(val, np.ndarray) and val.dtype.kind == "f":
                         feats[col] = tf.train.Feature(
                             float_list=tf.train.FloatList(value=[float(x) for x in val]))
+                    elif (isinstance(val, (list, tuple)) and val
+                          and all(isinstance(x, (int, np.integer)) for x in val)):
+                        # the reader returns multi-value features as lists —
+                        # round-trips must re-encode them (ADVICE r3)
+                        feats[col] = tf.train.Feature(
+                            int64_list=tf.train.Int64List(value=[int(x) for x in val]))
+                    elif (isinstance(val, (list, tuple)) and val
+                          and all(isinstance(x, (float, np.floating)) for x in val)):
+                        feats[col] = tf.train.Feature(
+                            float_list=tf.train.FloatList(value=[float(x) for x in val]))
+                    elif (isinstance(val, (list, tuple)) and val
+                          and all(isinstance(x, bytes) for x in val)):
+                        feats[col] = tf.train.Feature(
+                            bytes_list=tf.train.BytesList(value=list(val)))
+                    elif (isinstance(val, np.ndarray) and val.dtype.kind in "OS"
+                          and len(val) and all(isinstance(x, bytes) for x in val)):
+                        # object-dtype arrays of bytes: block storage turns a
+                        # row's list-of-bytes into one of these
+                        feats[col] = tf.train.Feature(
+                            bytes_list=tf.train.BytesList(value=[bytes(x) for x in val]))
                     else:
                         raise TypeError(
                             f"column {col!r}: cannot encode {type(val).__name__} "

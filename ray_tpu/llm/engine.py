@@ -1008,10 +1008,19 @@ class JaxLLMEngine(LLMEngine):
                     self._release(r2)
 
     def _loop(self) -> None:
+        import time as _time
+
+        next_metrics_push = 0.0
         while not self._shutdown:
             try:
                 self._admit()
                 self._process_aborts()
+                # periodic gauge refresh: /metrics must serve current llm_*
+                # values even when nothing polls engine.metrics() (ADVICE r3)
+                now = _time.monotonic()
+                if now >= next_metrics_push:
+                    next_metrics_push = now + 5.0
+                    self.metrics()
                 if any(r is not None for r in self._active.values()):
                     self._step_decode()
                 else:

@@ -163,6 +163,10 @@ def resolved_runtime_env(per_call: Optional[Dict[str, Any]]) -> Optional[Dict[st
     c = global_state.try_cluster()
     default = getattr(c, "default_runtime_env", None) if c is not None else None
     if default is None and c is None:
+        # client-mode driver: the default lives on the ClientContext object
+        w = global_state.try_worker()
+        default = getattr(w, "default_runtime_env", None)
+    if default is None and c is None:
         raw = os.environ.get("RAY_TPU_DEFAULT_RUNTIME_ENV")
         if raw:
             with contextlib.suppress(ValueError):

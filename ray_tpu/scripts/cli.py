@@ -80,6 +80,21 @@ def cmd_start(args) -> int:
     return 0
 
 
+def cmd_tls_init(args) -> int:
+    from ray_tpu.core.tls_utils import generate_self_signed_tls
+
+    paths = generate_self_signed_tls(args.dir, extra_sans=tuple(args.san))
+    print("wrote:")
+    for name, p in paths.items():
+        print(f"  {name}: {p}")
+    print("enable with:")
+    print("  export RAY_TPU_USE_TLS=1")
+    print(f"  export RAY_TPU_TLS_CA={paths['ca']}")
+    print(f"  export RAY_TPU_TLS_CERT={paths['cert']}")
+    print(f"  export RAY_TPU_TLS_KEY={paths['key']}")
+    return 0
+
+
 def cmd_stop(args) -> int:
     try:
         os.remove(_session_file())
@@ -350,6 +365,14 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("stop", help="clear head session")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("tls-init", help="mint a self-signed cluster CA + cert "
+                        "(then set RAY_TPU_USE_TLS + RAY_TPU_TLS_* and "
+                        "distribute the files to every node)")
+    sp.add_argument("dir", help="output directory for ca.crt/cluster.crt/cluster.key")
+    sp.add_argument("--san", action="append", default=[],
+                    help="extra SAN entry (IP or DNS name; repeatable)")
+    sp.set_defaults(fn=cmd_tls_init)
 
     sp = sub.add_parser("metrics", help="metrics plane provisioning")
     sp.add_argument("action", choices=["launch-config"])

@@ -58,6 +58,10 @@ class ClientContext:
         assert self._call("_ping") == "pong"
         info = self._call("runtime_context")
         self.node_id_hex = info["node_id"]
+        # job-level runtime_env default for specs built by THIS client driver
+        # (set by ray_tpu.init(address=..., runtime_env=...)); object-scoped so
+        # concurrent contexts in one process don't share defaults
+        self.default_runtime_env = None
         self.accel = "client-driver"
 
     # -- transport -------------------------------------------------------------

@@ -1,0 +1,155 @@
+"""Cluster TLS (reference python/ray/_private/tls_utils.py:6, RAY_USE_TLS).
+
+With RAY_TPU_USE_TLS set: the head<->agent gRPC channel and the data-plane
+listeners run mTLS; a real head + agent + remote task flow works end to end,
+and plaintext (or wrong-CA) dials are refused at the handshake.
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture()
+def tls_env(rt, tmp_path):
+    """Mint certs, park the session cluster, export the TLS env."""
+    import ray_tpu
+    from ray_tpu.core.tls_utils import generate_self_signed_tls
+
+    paths = generate_self_signed_tls(str(tmp_path / "tls"))
+    ray_tpu.shutdown()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tls_vars = {
+        "RAY_TPU_USE_TLS": "1",
+        "RAY_TPU_TLS_CA": paths["ca"],
+        "RAY_TPU_TLS_CERT": paths["cert"],
+        "RAY_TPU_TLS_KEY": paths["key"],
+    }
+    env = {**os.environ, **tls_vars,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    os.environ.update(tls_vars)
+    procs = []
+    try:
+        yield env, procs, paths
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for k in tls_vars:
+            os.environ.pop(k, None)
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)
+
+
+def test_multihost_flow_with_tls(tls_env):
+    """Agent joins over mTLS gRPC; remote tasks + a 10MB data-plane transfer
+    run; a plaintext gRPC dial and a plaintext data-plane dial are refused."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.core import global_state
+    from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+    env, procs, _ = tls_env
+    ray_tpu.init(num_cpus=2, node_server_port=0,
+                 worker_env={"JAX_PLATFORMS": "cpu"}, max_workers_per_node=4)
+    cluster = global_state.try_cluster()
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent",
+         "--address", f"127.0.0.1:{cluster.node_server_port}",
+         "--num-cpus", "2"], env=env)
+    procs.append(agent)
+    deadline = time.time() + 45
+    while len([n for n in ray_tpu.nodes() if n["Alive"]]) < 2:
+        assert time.time() < deadline, "agent never joined over TLS"
+        time.sleep(0.2)
+    remote_id = next(n["NodeID"] for n in ray_tpu.nodes()
+                     if n["Alive"] and n["Labels"].get("agent") == "remote")
+    sched = NodeAffinitySchedulingStrategy(node_id=remote_id)
+
+    @ray_tpu.remote(num_cpus=0.5, scheduling_strategy=sched)
+    def touch(x):
+        return float(x[0]) + x.nbytes
+
+    ref = ray_tpu.put(np.full(1_310_720, 3.0))  # 10 MiB -> data plane transfer
+    assert ray_tpu.get(touch.remote(ref), timeout=120) == 3.0 + 10 * 1024 * 1024
+
+    # -- plaintext refused: gRPC ------------------------------------------------
+    import grpc
+
+    from ray_tpu.core.agent_rpc import _METHOD
+    from ray_tpu.protos import node_agent_pb2 as pb
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{cluster.node_server_port}")
+    call = ch.stream_stream(
+        _METHOD, request_serializer=pb.AgentMessage.SerializeToString,
+        response_deserializer=pb.HeadMessage.FromString)
+    with pytest.raises(grpc.RpcError):
+        resp = call(iter([pb.AgentMessage(heartbeat=pb.Heartbeat(time=0.0))]),
+                    timeout=5)
+        next(resp)
+    ch.close()
+
+    # -- plaintext refused: data plane ------------------------------------------
+    data_port = cluster._data_server.port
+    s = socket.create_connection(("127.0.0.1", data_port), timeout=5)
+    s.settimeout(5)
+    s.sendall(b"\x00\x00\x00\x04plna")  # junk frame, no TLS handshake
+    try:
+        got = s.recv(64)
+        # a TLS server answers a non-TLS client with an alert then closes;
+        # it must NOT speak the data-plane protocol
+        assert got == b"" or got[:1] == b"\x15", got  # 0x15 = TLS alert record
+    except (TimeoutError, OSError):
+        pass  # connection dropped without an answer: also a refusal
+    finally:
+        s.close()
+    ray_tpu.shutdown()
+
+
+def test_wrong_ca_client_refused(tls_env):
+    """A peer with certs from a DIFFERENT CA fails the data-plane handshake."""
+    import ssl
+
+    env, procs, _ = tls_env
+    from ray_tpu.core.secure_transport import make_listener
+    from ray_tpu.core.tls_utils import generate_self_signed_tls
+
+    listener = make_listener(("127.0.0.1", 0))
+    port = listener.address[1]
+    import threading
+
+    def serve():
+        try:
+            conn = listener.accept()
+            conn.recv_bytes()  # drives the (deferred) server-side handshake
+        except EOFError:
+            pass  # expected: handshake failure surfaces as a bad dial
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        other = generate_self_signed_tls(d)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(other["cert"], other["key"])
+        ctx.load_verify_locations(other["ca"])
+        ctx.check_hostname = False
+        raw = socket.create_connection(("127.0.0.1", port), timeout=5)
+        with pytest.raises(ssl.SSLError):
+            ctx.wrap_socket(raw)
+        raw.close()
+    t.join(timeout=10)
+    listener.close()
