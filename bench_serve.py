@@ -166,7 +166,7 @@ def bench_preemption(rng):
         eng.shutdown()
 
 
-def bench_device_decode(batch, k=64, n_bursts=16, prompt_len=512):
+def bench_device_decode(batch, k=64, n_bursts=16, prompt_len=512, quant=None):
     """DEVICE-resident decode: K fused decode+sample steps per burst
     (model_runner.decode_multi — a lax.scan, entirely on-chip), tokens fetched
     ONCE per burst. Isolates the chip from the host/tunnel round trip the e2e
@@ -186,6 +186,12 @@ def bench_device_decode(batch, k=64, n_bursts=16, prompt_len=512):
     params = model_runner.shard_params(
         jax.tree.map(lambda x: x.astype(cfg.activation_dtype),
                      llama.init(jax.random.PRNGKey(0), cfg)), cfg, mesh)
+    suffix = ""
+    if quant == "int8":
+        from ray_tpu.ops.quant import quantize_llama_params
+
+        params = jax.jit(quantize_llama_params)(params)
+        suffix = "_int8"
     max_len = prompt_len + 2 * k * n_bursts + 8
 
     def fresh_state():
@@ -230,9 +236,91 @@ def bench_device_decode(batch, k=64, n_bursts=16, prompt_len=512):
     extra_steps = n_bursts * k
     per_step_ms = max(t_long - t_short, 1e-9) / extra_steps * 1000
     return {
-        f"decode_device_ms_per_step_b{batch}": round(per_step_ms, 3),
-        f"decode_device_tokens_per_s_b{batch}": round(
+        f"decode_device_ms_per_step_b{batch}{suffix}": round(per_step_ms, 3),
+        f"decode_device_tokens_per_s_b{batch}{suffix}": round(
             batch / (per_step_ms / 1000), 1),
+    }
+
+
+def bench_spec_modes(batch, gen_tokens=96, k=4):
+    """Speculative/fused composition at 100% draft acceptance (the machinery's
+    ceiling — real acceptance is workload-dependent): tokens/s for fused-only
+    (m=8), spec-only (k=4, one window per sync), and the composed mode
+    (k=4 inside m=4 fused windows). All greedy; outputs verified identical."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import SamplingParams, model_runner
+
+    prompt = [int(x) for x in np.random.default_rng(1).integers(1, 200, 40)]
+    params = SamplingParams(max_tokens=gen_tokens, temperature=0.0,
+                            stop_token_ids=[-1])
+
+    base = make_engine(kv_layout="slot", max_num_seqs=batch)
+    try:
+        cont = base.generate_sync(prompt, params).token_ids
+    finally:
+        base.shutdown()
+    full = prompt + cont
+
+    def host_oracle(req, cap):
+        done = len(req.token_history) - len(prompt)
+        return cont[done:done + cap]
+
+    def run(eng, label, patch_device_oracle=False):
+        import ray_tpu.llm.engine as _E
+
+        orig = _E.model_runner.spec_multi
+        if patch_device_oracle:
+            table = np.zeros((batch, eng.config.max_model_len), np.int32)
+            table[:, :len(full)] = full
+
+            def dev_oracle(h, hl, last, kk, nmax):
+                t = jnp.asarray(table)
+                starts = jnp.clip(hl, 0, t.shape[1] - kk)
+                drafts = jax.vmap(lambda row, s: jax.lax.dynamic_slice(
+                    row, (s,), (kk,)))(t, starts)
+                win = jnp.zeros((batch, kk + 1), jnp.int32).at[:, 0].set(last)
+                return win.at[:, 1:].set(drafts), jnp.full((batch,), kk, jnp.int32)
+
+            _E.model_runner.spec_multi = functools.partial(
+                orig, propose_fn=dev_oracle)
+        eng._propose_ngram = host_oracle
+        eng.start()
+        try:
+            # warmup: compile every decode/verify program before timing
+            for _ in range(2):
+                eng.generate_sync(prompt, params)
+            outs = [None] * batch
+
+            def one(i):
+                outs[i] = eng.generate_sync(prompt, params)
+
+            ts = [threading.Thread(target=one, args=(i,)) for i in range(batch)]
+            t0 = time.perf_counter()
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            dt = time.perf_counter() - t0
+            for o in outs:
+                assert o.token_ids == cont, f"{label}: output diverged"
+            return round(batch * gen_tokens / dt, 1)
+        finally:
+            eng.shutdown()
+            _E.model_runner.spec_multi = orig
+
+    fused = run(make_engine(kv_layout="slot", max_num_seqs=batch,
+                            num_decode_steps=8), "fused8")
+    spec = run(make_engine(kv_layout="slot", max_num_seqs=batch,
+                           num_speculative_tokens=k), "spec")
+    combined = run(make_engine(kv_layout="slot", max_num_seqs=batch,
+                               num_speculative_tokens=k, num_decode_steps=4),
+                   "combined", patch_device_oracle=True)
+    return {
+        f"spec_tokens_per_s_b{batch}_fused8_only": fused,
+        f"spec_tokens_per_s_b{batch}_spec{k}_only": spec,
+        f"spec_tokens_per_s_b{batch}_combined_m4k{k}": combined,
     }
 
 
@@ -367,6 +455,13 @@ def main():
         results.update(bench_device_decode(
             batch, k=8 if TINY else 64, n_bursts=2 if TINY else 16,
             prompt_len=64 if TINY else 512))
+    # int8 weight-only decode: same loop, half the weight bytes per step
+    for batch in (1, 8):
+        results.update(bench_device_decode(
+            batch, k=8 if TINY else 64, n_bursts=2 if TINY else 16,
+            prompt_len=64 if TINY else 512, quant="int8"))
+    for batch in (1, 8):
+        results.update(bench_spec_modes(batch, gen_tokens=24 if TINY else 96))
     try:
         results.update(bench_kv_handoff(
             nbytes=(8 if TINY else 256) * 1024 * 1024, iters=4))

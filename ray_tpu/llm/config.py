@@ -72,6 +72,11 @@ class LLMConfig:
     num_speculative_tokens: int = 0
     speculative_method: str = "ngram"
     ngram_prompt_lookup_max: int = 3
+    # weight-only quantization (reference: vLLM quantization engine_kwargs):
+    #   None   — serve in `dtype` as loaded
+    #   "int8" — per-output-channel int8 weights, bf16 activations (W8A16):
+    #            halves the weight bytes every decode step streams from HBM
+    quantization: Optional[str] = None
     # parallelism: mesh axes for the in-process device mesh
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1

@@ -182,10 +182,14 @@ class JaxLLMEngine(LLMEngine):
                     raise NotImplementedError(
                         f"speculative_method {c.speculative_method!r}: only "
                         "'ngram' (prompt lookup) is implemented")
-                if c.pipeline_parallel_size > 1 or c.num_decode_steps > 1:
+                if c.pipeline_parallel_size > 1:
                     raise NotImplementedError(
-                        "speculative decoding composes with neither pp decode "
-                        "nor fused multi-step bursts")
+                        "speculative decoding does not compose with pp decode")
+                if c.num_decode_steps > 1 and c.kv_layout != "slot":
+                    raise NotImplementedError(
+                        "spec + fused multi-step requires kv_layout='slot' "
+                        "(the fused windows propose on-device against a "
+                        "history buffer; paged verify stays per-step)")
                 if cfg.n_experts > 0:
                     raise NotImplementedError(
                         "speculative decoding: dense models only")
@@ -193,6 +197,14 @@ class JaxLLMEngine(LLMEngine):
                 # guarantees a chunk-padded prompt never exceeds max_model_len
                 # (the block table / slot cache width)
                 raise ValueError("max_model_len must be a multiple of prefill_chunk")
+            if c.quantization:
+                # validate BEFORE any checkpoint load: streaming a full model
+                # onto devices just to reject the config string is hostile
+                if c.quantization != "int8":
+                    raise ValueError(
+                        f"unknown quantization {c.quantization!r} (supported: int8)")
+                if cfg.n_experts > 0:
+                    raise NotImplementedError("quantization: dense models only")
             if self._params_in is not None:
                 self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
             else:
@@ -210,6 +222,13 @@ class JaxLLMEngine(LLMEngine):
                     self.params = model_runner.shard_params(
                         llama_init_cached(cfg), cfg, self._mesh)
             self._params_in = None
+            if c.quantization:
+                from ray_tpu.ops.quant import quantize_llama_params
+
+                # quantize on device AFTER sharding: per-output-channel int8
+                # weights + scales; dequant fuses into each matmul's operand
+                # read (ops/quant.py)
+                self.params = jax.jit(quantize_llama_params)(self.params)
             self._active = {s: None for s in range(c.max_num_seqs)}
             self._admission_counter = itertools.count(1)
             if c.pipeline_parallel_size > 1:
@@ -851,12 +870,102 @@ class JaxLLMEngine(LLMEngine):
                     return cont
         return []
 
+    def _spec_burst_width(self) -> int:
+        """Fused-spec burst cap: each window may emit up to k+1 tokens, so the
+        room/budget math of _burst_width divides by the window length."""
+        c = self.config
+        m = max(1, int(c.num_decode_steps))
+        if m == 1:
+            return 1
+        wlen = c.num_speculative_tokens + 1
+        for req in self._active.values():
+            if req is None:
+                continue
+            next_write = len(req.prompt_ids) + req.generated - 1
+            kv_room = (c.max_model_len - 1) - next_write
+            budget = req.params.max_tokens - req.generated
+            m = min(m, max(1, min(kv_room, budget) // wlen))
+        return 1 << (m.bit_length() - 1)
+
+    def _step_decode_spec_fused(self, m: int) -> None:
+        """m speculative windows fused per host sync (spec + multi-step
+        composed): the n-gram proposal runs ON DEVICE against a per-slot
+        history buffer, so successive windows chain without host round trips
+        (model_runner.spec_multi)."""
+        cfg = self.model_config
+        c = self.config
+        k = c.num_speculative_tokens
+        n = c.max_num_seqs
+        active_mask = np.array([r is not None for r in self._active.values()], bool)
+        if not active_mask.any():
+            return
+        # history width bucketed to a power of two: bounds both the H2D upload
+        # (not max_model_len when contexts are short) and the spec_multi trace
+        # count (one program per width bucket)
+        max_ctx = max(len(r.token_history) for r in self._active.values()
+                      if r is not None)
+        width = min(c.max_model_len,
+                    1 << (max_ctx + m * (k + 1) - 1).bit_length())
+        hist = np.zeros((n, width), np.int32)
+        hlen = np.zeros((n,), np.int32)
+        for slot, req in self._active.items():
+            if req is None:
+                continue
+            ctx = req.token_history
+            hist[slot, :len(ctx)] = ctx
+            hlen[slot] = len(ctx)
+        rngs = jnp.stack([self._next_rng() for _ in range(m)])
+        self.state, toks_m, acc_m, drafted_m = model_runner.spec_multi(
+            self.params, self.state, jnp.asarray(hist), jnp.asarray(hlen),
+            jnp.asarray(active_mask), cfg, rngs,
+            jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._top_k), m, k, c.ngram_prompt_lookup_max)
+        toks_m, acc_m, drafted_m = jax.device_get((toks_m, acc_m, drafted_m))
+        burst_reqs = {s: r for s, r in self._active.items() if r is not None}
+        for step in range(m):
+            for slot, req in burst_reqs.items():
+                self._emit_spec_window(
+                    slot, req, toks_m[step, slot], int(acc_m[step, slot]),
+                    int(drafted_m[step, slot]))
+
+    def _emit_spec_window(self, slot: int, req: "_Request", toks_row,
+                          acc: int, drafted: int) -> None:
+        """Emit one verify window's accepted prefix + bonus token for a slot
+        (shared by the per-window and fused spec paths): counts acceptance,
+        discards tokens past a mid-burst finish, force-finishes at the KV cap."""
+        if self._active.get(slot) is not req:
+            return  # finished (or aborted) earlier in this burst: discard tail
+        c = self.config
+        self.num_spec_drafted += drafted
+        self.num_spec_accepted += min(acc, drafted)
+        for t in range(acc + 1):
+            if self._active.get(slot) is not req:
+                break
+            tok = int(toks_row[t])
+            self._last_tokens[slot] = tok
+            self._emit(req, tok)
+            r2 = self._active.get(slot)
+            if r2 is not None and (len(r2.prompt_ids) + r2.generated - 1
+                                   >= c.max_model_len - 1):
+                r2.out_queue.put(RequestOutput(
+                    request_id=r2.id, token_ids=[], finished=True,
+                    finish_reason="length",
+                    num_prompt_tokens=len(r2.prompt_ids),
+                    num_generated_tokens=r2.generated,
+                ))
+                self._release(r2)
+
     def _step_decode_spec(self) -> None:
         """Speculative decode step: host proposes drafts by n-gram lookup, ONE
         verify forward scores the whole window, accepted prefix + bonus token
         all emit this step (greedy slots only; others ride along with k=0)."""
         cfg = self.model_config
         c = self.config
+        if c.num_decode_steps > 1 and c.kv_layout == "slot":
+            m = self._spec_burst_width()
+            if m > 1:
+                self._step_decode_spec_fused(m)
+                return
         k = c.num_speculative_tokens
         wlen = k + 1
         if c.kv_layout == "paged":
@@ -881,7 +990,6 @@ class JaxLLMEngine(LLMEngine):
             draft_len[slot] = len(drafts)
             if drafts:
                 window[slot, 1:1 + len(drafts)] = drafts
-                self.num_spec_drafted += len(drafts)
         if c.kv_layout == "paged":
             from . import paged
 
@@ -898,24 +1006,8 @@ class JaxLLMEngine(LLMEngine):
         out_toks, n_acc = jax.device_get((out_toks, n_acc))
         burst_reqs = {s: r for s, r in self._active.items() if r is not None}
         for slot, req in burst_reqs.items():
-            acc = int(n_acc[slot])
-            self.num_spec_accepted += min(acc, int(draft_len[slot]))
-            for t in range(acc + 1):
-                if self._active.get(slot) is not req:
-                    break  # finished mid-emit: discard speculated tail
-                tok = int(out_toks[slot, t])
-                self._last_tokens[slot] = tok
-                self._emit(req, tok)
-                r2 = self._active.get(slot)
-                if r2 is not None and (len(r2.prompt_ids) + r2.generated - 1
-                                       >= c.max_model_len - 1):
-                    r2.out_queue.put(RequestOutput(
-                        request_id=r2.id, token_ids=[], finished=True,
-                        finish_reason="length",
-                        num_prompt_tokens=len(r2.prompt_ids),
-                        num_generated_tokens=r2.generated,
-                    ))
-                    self._release(r2)
+            self._emit_spec_window(slot, req, out_toks[slot],
+                                   int(n_acc[slot]), int(draft_len[slot]))
 
     def _burst_width(self) -> int:
         """How many decode steps this burst may fuse: the configured K capped

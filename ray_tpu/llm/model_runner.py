@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models import llama
+from ray_tpu.ops.quant import as_weight as _qw
 from ray_tpu.models.config import ModelConfig
 from ray_tpu.parallel.sharding import INFER_RULES, named_sharding, shard_pytree
 
@@ -147,9 +148,9 @@ def _decode_core(x, lp, cfg: ModelConfig, lengths, active, cache_rw):
     pos = lengths[:, None]  # [S,1] — the new token's position
 
     h = llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("sld,dhk->slhk", h, lp["wq"].astype(dt))
-    k = jnp.einsum("sld,dhk->slhk", h, lp["wk"].astype(dt))
-    vv = jnp.einsum("sld,dhk->slhk", h, lp["wv"].astype(dt))
+    q = jnp.einsum("sld,dhk->slhk", h, _qw(lp["wq"], dt))
+    k = jnp.einsum("sld,dhk->slhk", h, _qw(lp["wk"], dt))
+    vv = jnp.einsum("sld,dhk->slhk", h, _qw(lp["wv"], dt))
     q = llama.rope(q, pos, cfg.rope_theta)
     k = llama.rope(k, pos, cfg.rope_theta)
 
@@ -163,7 +164,7 @@ def _decode_core(x, lp, cfg: ModelConfig, lengths, active, cache_rw):
     w = jax.nn.softmax(scores, axis=-1)
     o = jnp.einsum("skgt,stkd->skgd", w, cv.astype(jnp.float32)).astype(dt)
     o = o.reshape(s, 1, cfg.n_heads, hd)
-    x = x + jnp.einsum("slhk,hkd->sld", o, lp["wo"].astype(dt))
+    x = x + jnp.einsum("slhk,hkd->sld", o, _qw(lp["wo"], dt))
 
     h = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
@@ -173,9 +174,9 @@ def _decode_core(x, lp, cfg: ModelConfig, lengths, active, cache_rw):
                              lp["w_down"], cfg, mask=active.astype(jnp.float32))
         down = y2[:, None, :]
     else:
-        gate = jnp.einsum("sld,df->slf", h, lp["w_gate"].astype(dt))
-        up = jnp.einsum("sld,df->slf", h, lp["w_up"].astype(dt))
-        down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt))
+        gate = jnp.einsum("sld,df->slf", h, _qw(lp["w_gate"], dt))
+        up = jnp.einsum("sld,df->slf", h, _qw(lp["w_up"], dt))
+        down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, _qw(lp["w_down"], dt))
     return x + down, storage
 
 
@@ -228,7 +229,7 @@ def decode_step(
 
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))[:, 0]
+    logits = jnp.einsum("sld,dv->slv", x, _qw(head, cfg.activation_dtype))[:, 0]
     lengths = jnp.where(active, state.lengths + 1, state.lengths)
     return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
 
@@ -249,9 +250,9 @@ def _verify_core(x, lp, cfg: ModelConfig, lengths, cache_rw):
     pos = lengths[:, None] + jnp.arange(wlen)[None, :]  # [S,W]
 
     h = llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("sld,dhk->slhk", h, lp["wq"].astype(dt))
-    k = jnp.einsum("sld,dhk->slhk", h, lp["wk"].astype(dt))
-    vv = jnp.einsum("sld,dhk->slhk", h, lp["wv"].astype(dt))
+    q = jnp.einsum("sld,dhk->slhk", h, _qw(lp["wq"], dt))
+    k = jnp.einsum("sld,dhk->slhk", h, _qw(lp["wk"], dt))
+    vv = jnp.einsum("sld,dhk->slhk", h, _qw(lp["wv"], dt))
     q = llama.rope(q, pos, cfg.rope_theta)
     k = llama.rope(k, pos, cfg.rope_theta)
 
@@ -266,12 +267,12 @@ def _verify_core(x, lp, cfg: ModelConfig, lengths, cache_rw):
     w = jax.nn.softmax(scores, axis=-1)
     o = jnp.einsum("swkgt,stkd->swkgd", w, cv.astype(jnp.float32)).astype(dt)
     o = o.reshape(s, wlen, cfg.n_heads, hd)
-    x = x + jnp.einsum("slhk,hkd->sld", o, lp["wo"].astype(dt))
+    x = x + jnp.einsum("slhk,hkd->sld", o, _qw(lp["wo"], dt))
 
     h = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("sld,df->slf", h, lp["w_gate"].astype(dt))
-    up = jnp.einsum("sld,df->slf", h, lp["w_up"].astype(dt))
-    down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt))
+    gate = jnp.einsum("sld,df->slf", h, _qw(lp["w_gate"], dt))
+    up = jnp.einsum("sld,df->slf", h, _qw(lp["w_up"], dt))
+    down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, _qw(lp["w_down"], dt))
     return x + down, storage
 
 
@@ -331,7 +332,7 @@ def spec_driver(params, k0, v0, lengths, window, draft_len, active, cfg,
 
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))
+    logits = jnp.einsum("sld,dv->slv", x, _qw(head, cfg.activation_dtype))
     greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
     greedy, n_acc, new_lengths = spec_accept(
         window, greedy, draft_len, active, lengths, rng, temperature,
@@ -365,6 +366,104 @@ def spec_verify_step(
         cfg, rng, temperature, top_p, top_k,
         lambda h, lp, ck, cv: _verify_block(h, lp, cfg, ck, cv, state.lengths))
     return DecodeState(k=nk, v=nv, lengths=lengths), greedy, n_acc
+
+
+def propose_ngram_device(hist: jax.Array, hlen: jax.Array, last: jax.Array,
+                         k: int, nmax: int) -> Tuple[jax.Array, jax.Array]:
+    """On-device prompt-lookup proposal (the host-side _propose_ngram, jittable
+    so it can run INSIDE a fused burst): for each slot, find the most recent
+    earlier occurrence of the trailing n-gram (longest n <= nmax first) in the
+    slot's token history and propose the k tokens that followed it.
+
+    hist [S,L] int32 (prompt + emitted tokens), hlen [S] valid length,
+    last [S] == hist[hlen-1]. Returns (window [S,k+1], draft_len [S])."""
+    s_n, L = hist.shape
+    best_start = jnp.zeros((s_n,), jnp.int32)
+    best_n = jnp.zeros((s_n,), jnp.int32)
+    for n in range(nmax, 0, -1):  # static unroll: longest n wins
+        tail = jax.vmap(
+            lambda h, e: jax.lax.dynamic_slice(h, (jnp.maximum(e - n, 0),), (n,))
+        )(hist, hlen)  # [S, n]
+        eq = jnp.ones((s_n, L - n), bool)
+        for i in range(n):
+            eq &= hist[:, i:L - n + i] == tail[:, i:i + 1]
+        j = jnp.arange(L - n)[None, :]
+        eq &= j < (hlen - n)[:, None]  # strictly before the tail's own start
+        start = jnp.max(jnp.where(eq, j, -1), axis=1)  # most recent occurrence
+        # a match whose continuation is empty (occurrence butts against the
+        # tail) is useless — fall through to a shorter n, like the host
+        # proposer's `if cont:` retry
+        found = eq.any(axis=1) & (hlen - (start + n) > 0)
+        pick = found & (best_n == 0)
+        best_start = jnp.where(pick, start.astype(jnp.int32), best_start)
+        best_n = jnp.where(pick, n, best_n)
+    cont = jnp.minimum(best_start + best_n, L - k)  # continuation start, clamped
+    drafts = jax.vmap(
+        lambda h, s: jax.lax.dynamic_slice(h, (s,), (k,)))(hist, cont)  # [S,k]
+    avail = jnp.clip(hlen - (best_start + best_n), 0, k)
+    draft_len = jnp.where(best_n > 0, avail, 0).astype(jnp.int32)
+    keep = jnp.arange(k)[None, :] < draft_len[:, None]
+    window = jnp.zeros((s_n, k + 1), jnp.int32)
+    window = window.at[:, 0].set(last)
+    window = window.at[:, 1:].set(jnp.where(keep, drafts, 0))
+    return window, draft_len
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "m", "k", "nmax", "propose_fn"),
+    donate_argnames=("state",))
+def spec_multi(
+    params,
+    state: DecodeState,
+    hist: jax.Array,  # [S, max_len] int32 — prompt + emitted tokens per slot
+    hlen: jax.Array,  # [S] int32 — valid history length
+    active: jax.Array,  # [S] bool — FIXED for the whole burst
+    cfg: ModelConfig,
+    rngs: jax.Array,  # [m] stacked PRNG keys
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+    m: int,
+    k: int,
+    nmax: int,
+    propose_fn=None,  # test seam: (hist, hlen, last, k, nmax) -> (window, dlen)
+):
+    """m fused speculative windows per host sync: propose (on-device n-gram
+    lookup) -> verify forward -> accept, chained in a lax.scan — composing
+    vLLM's multi-step scheduling with prompt-lookup speculation. Per sync the
+    engine emits between m and m*(k+1) tokens. Greedy slots speculate;
+    temperature>0 slots ride along sampling one token per window.
+
+    Returns (state, toks_m [m,S,k+1], acc_m [m,S], drafted_m [m,S])."""
+    proposer = propose_fn or propose_ngram_device
+
+    def body(carry, rng):
+        st, h, hl, last = carry
+        window, draft_len = proposer(h, hl, last, k, nmax)
+        draft_len = jnp.where(temperature > 0, 0, draft_len)
+        nk, nv, lengths, greedy, n_acc = spec_driver(
+            params, st.k, st.v, st.lengths, window, draft_len, active,
+            cfg, rng, temperature, top_p, top_k,
+            lambda x, lp, ck, cv: _verify_block(x, lp, cfg, ck, cv, st.lengths))
+        st = DecodeState(k=nk, v=nv, lengths=lengths)
+        adv = jnp.where(active, n_acc + 1, 0)
+        rows = jnp.arange(h.shape[0])
+        for t in range(k + 1):  # static: scatter this window's emitted tokens
+            pos = jnp.clip(hl + t, 0, h.shape[1] - 1)
+            h = h.at[rows, pos].set(
+                jnp.where(t < adv, greedy[:, t], h[rows, pos]))
+        new_last = jnp.where(
+            adv > 0,
+            jnp.take_along_axis(
+                greedy, jnp.maximum(adv - 1, 0)[:, None], axis=1)[:, 0],
+            last)
+        return (st, h, hl + adv, new_last), (greedy, n_acc, draft_len)
+
+    last = jnp.take_along_axis(
+        hist, jnp.maximum(hlen - 1, 0)[:, None], axis=1)[:, 0]
+    (state, _, _, _), (toks_m, acc_m, drafted_m) = jax.lax.scan(
+        body, (state, hist, hlen, last), rngs)
+    return state, toks_m, acc_m, drafted_m
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
@@ -497,7 +596,7 @@ def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Ar
 
     h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("sld,dv->slv", h, head.astype(cfg.activation_dtype))[:, 0]
+    logits = jnp.einsum("sld,dv->slv", h, _qw(head, cfg.activation_dtype))[:, 0]
     lengths = jnp.where(active, state.lengths + 1, state.lengths)
     return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
 

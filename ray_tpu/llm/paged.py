@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models import llama
+from ray_tpu.ops.quant import as_weight as _qw
 from ray_tpu.models.config import ModelConfig
 
 from . import sampling
@@ -357,7 +358,7 @@ def decode_step_paged(
 
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("sld,dv->slv", x, head.astype(cfg.activation_dtype))[:, 0]
+    logits = jnp.einsum("sld,dv->slv", x, _qw(head, cfg.activation_dtype))[:, 0]
     lengths = jnp.where(active, state.lengths + 1, state.lengths)
     return PagedState(k=nk, v=nv, block_tables=state.block_tables,
                       lengths=lengths), logits.astype(jnp.float32)

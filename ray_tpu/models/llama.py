@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.ops import attention
+from ray_tpu.ops.quant import as_weight as _w
 from ray_tpu.parallel.sharding import with_sharding_constraint as wsc
 
 from .config import ModelConfig
@@ -211,9 +212,9 @@ def _block(
     """One decoder block. Returns (x, updated (k,v) if caching, moe aux loss)."""
     dt = x.dtype
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
+    q = jnp.einsum("bsd,dhk->bshk", h, _w(lp["wq"], dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, _w(lp["wk"], dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, _w(lp["wv"], dt))
     q = wsc(rope(q, positions, cfg.rope_theta), "batch", "seq", "act_heads", "head_dim")
     k = rope(k, positions, cfg.rope_theta)
 
@@ -245,7 +246,7 @@ def _block(
             )
     else:
         attn = attention(q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl)
-    o = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dt))
+    o = jnp.einsum("bshk,hkd->bsd", attn, _w(lp["wo"], dt))
     x = wsc(x + o, "batch", "seq", "act_embed")
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -260,10 +261,10 @@ def _block(
         )
         down = y2.reshape(b, s, d)
     else:
-        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
-        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
+        gate = jnp.einsum("bsd,df->bsf", h, _w(lp["w_gate"], dt))
+        up = jnp.einsum("bsd,df->bsf", h, _w(lp["w_up"], dt))
         ff = wsc(jax.nn.silu(gate) * up, "batch", "seq", "act_mlp")
-        down = jnp.einsum("bsf,fd->bsd", ff, lp["w_down"].astype(dt))
+        down = jnp.einsum("bsf,fd->bsd", ff, _w(lp["w_down"], dt))
         aux = jnp.zeros((), jnp.float32)
     return wsc(x + down, "batch", "seq", "act_embed"), new_kv, aux
 
@@ -398,7 +399,7 @@ def forward(
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.activation_dtype))
+    logits = jnp.einsum("bsd,dv->bsv", x, _w(head, cfg.activation_dtype))
     logits = wsc(logits.astype(jnp.float32), "batch", "seq", "act_vocab")
     if return_aux:
         return logits, new_cache, aux_total

@@ -1,0 +1,87 @@
+"""Weight-only int8 quantization for serving (W8A16).
+
+Capability parity: the reference serving stack inherits vLLM quantization via
+engine_kwargs pass-through (python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_models.py); a TPU-native engine provides it directly. Decode is HBM-
+bandwidth-bound: storing weights as int8 + per-output-channel scales halves the
+bytes each decode step streams from HBM. XLA fuses the int8->bf16 convert and
+the scale multiply into the dot's operand read, so the MXU still computes in
+bf16 — no accuracy cliff from int8 accumulation, ~2x weight-read bandwidth.
+
+Per-output-channel symmetric quantization: for a weight contracted over its
+FIRST axis (all llama projections are stored [d_in, ...out]), scales are
+max|w| / 127 over d_in, one per output unit — the rank-preserving layout that
+stacks cleanly under lax.scan'd layers.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """int8 weight + per-output-channel scales. A pytree: stacks under scan,
+    shards per-leaf (q like the fp weight, s replicated/matching out axes)."""
+
+    q: jax.Array  # int8, same shape as the original weight
+    s: jax.Array  # f32, original shape with the contraction axis dropped
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.s.nbytes
+
+
+def quantize(w: jax.Array, contract_axis: int = 0) -> QTensor:
+    """Symmetric per-output-channel int8 quantization over contract_axis."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.round(w.astype(jnp.float32) / jnp.expand_dims(scale, contract_axis))
+    return QTensor(q=jnp.clip(q, -127, 127).astype(jnp.int8),
+                   s=scale.astype(jnp.float32))
+
+
+def dequant(t: QTensor, dtype, contract_axis: int = 0) -> jax.Array:
+    """Rehydrate to `dtype`; inside jit XLA fuses convert+scale into the
+    consuming dot's operand read (the int8 bytes are what HBM streams)."""
+    return (t.q.astype(dtype)
+            * jnp.expand_dims(t.s, contract_axis).astype(dtype))
+
+
+def as_weight(p: Any, dtype) -> jax.Array:
+    """THE accessor model code uses: dequants a QTensor, casts a plain array."""
+    if isinstance(p, QTensor):
+        return dequant(p, dtype)
+    return p.astype(dtype)
+
+
+# Llama layer weights eligible for weight-only quantization. All are stored
+# with d_in first (embed lookup table and norms excluded: gathers and
+# elementwise ops do not stream per-token weight bytes the way matmuls do).
+LLAMA_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_llama_params(params: dict) -> dict:
+    """Quantize a llama param tree's layer matmuls in place-shape (scan-stacked
+    layers quantize per layer via vmap so scales stay per-layer)."""
+    out = dict(params)
+    layers = params["layers"]
+
+    def _maybe_quant(name, p):
+        if name not in LLAMA_QUANT_KEYS:
+            return p
+        if isinstance(layers, dict):  # scanned: leading layer axis
+            return jax.vmap(lambda w: quantize(w, 0))(p)
+        return quantize(p, 0)
+
+    if isinstance(layers, dict):
+        out["layers"] = {k: _maybe_quant(k, v) for k, v in layers.items()}
+    else:
+        out["layers"] = [{k: _maybe_quant(k, v) for k, v in lyr.items()}
+                         for lyr in layers]
+    return out
