@@ -143,10 +143,44 @@ _FLAGS: List[Flag] = [
     Flag("data_read_op_min_num_blocks", "RAY_TPU_DATA_READ_OP_MIN_NUM_BLOCKS",
          "int", 8,
          "Default read parallelism when the datasource does not dictate one."),
+    Flag("data_target_max_block_size", "RAY_TPU_DATA_TARGET_MAX_BLOCK_SIZE",
+         "int", 128 * 1024 * 1024,
+         "Blocks above this split on output (reference target_max_block_size)."),
+    Flag("data_target_min_block_size", "RAY_TPU_DATA_TARGET_MIN_BLOCK_SIZE",
+         "int", 1 * 1024 * 1024,
+         "Coalesce blocks below this (reference target_min_block_size)."),
+    Flag("data_default_batch_size", "RAY_TPU_DATA_DEFAULT_BATCH_SIZE", "int", 1024,
+         "map_batches/iter_batches batch size when unspecified."),
+    Flag("data_op_output_buffer_limit", "RAY_TPU_DATA_OP_OUTPUT_BUFFER_LIMIT",
+         "int", 16,
+         "Streaming-executor per-operator output queue cap (backpressure)."),
+    Flag("data_push_based_shuffle", "RAY_TPU_DATA_PUSH_BASED_SHUFFLE", "bool", False,
+         "Staged-merge shuffle for large sorts (reference "
+         "push_based_shuffle_task_scheduler; RAY_DATA_PUSH_BASED_SHUFFLE)."),
+    Flag("data_push_shuffle_merge_factor", "RAY_TPU_DATA_PUSH_SHUFFLE_MERGE_FACTOR",
+         "int", 8,
+         "Map-round width for the push-based shuffle (fan-in bound)."),
     # -- serve
     Flag("serve_replica_wait_s", "RAY_TPU_SERVE_REPLICA_WAIT_S", "float", 30.0,
          "How long a handle call waits for a live replica before failing "
          "(reference handle resolution timeout)."),
+    Flag("serve_health_check_period_s", "RAY_TPU_SERVE_HEALTH_CHECK_PERIOD_S",
+         "float", 5.0,
+         "Default replica health-check period (per-deployment override in "
+         "DeploymentConfig; reference health_check_period_s)."),
+    Flag("serve_health_check_timeout_s", "RAY_TPU_SERVE_HEALTH_CHECK_TIMEOUT_S",
+         "float", 10.0,
+         "Default grace before an unresponsive replica is replaced "
+         "(reference health_check_timeout_s)."),
+    Flag("serve_max_ongoing_requests", "RAY_TPU_SERVE_MAX_ONGOING_REQUESTS",
+         "int", 8,
+         "Default per-replica concurrent-request cap "
+         "(reference max_ongoing_requests)."),
+    # -- llm engine defaults
+    Flag("llm_max_num_seqs", "RAY_TPU_LLM_MAX_NUM_SEQS", "int", 8,
+         "Default decode-slot count for LLMConfig (continuous batching width)."),
+    Flag("llm_max_model_len", "RAY_TPU_LLM_MAX_MODEL_LEN", "int", 1024,
+         "Default per-slot KV capacity for LLMConfig."),
     # -- train
     Flag("train_v2_enabled", "RAY_TPU_TRAIN_V2_ENABLED", "bool", False,
          "Route trainers through the v2 controller (FailurePolicy/"
@@ -156,6 +190,12 @@ _FLAGS: List[Flag] = [
 ]
 
 _BY_NAME: Dict[str, Flag] = {f.name: f for f in _FLAGS}
+
+
+def flag(name: str) -> Any:
+    """Current value of a registry flag — THE accessor for dataclass
+    default_factory lambdas (DataContext, DeploymentConfig, LLMConfig)."""
+    return getattr(CONFIG, name)
 
 
 class _Config:

@@ -9,26 +9,37 @@ from typing import Optional
 
 
 def _flag(name: str):
-    from ray_tpu.config import CONFIG
+    from ray_tpu.config import flag
 
-    return getattr(CONFIG, name)
+    return flag(name)
 
 
 @dataclasses.dataclass
 class DataContext:
-    target_max_block_size: int = 128 * 1024 * 1024
-    target_min_block_size: int = 1 * 1024 * 1024
-    default_batch_size: int = 1024
+    target_max_block_size: int = dataclasses.field(
+        default_factory=lambda: _flag("data_target_max_block_size"))
+    target_min_block_size: int = dataclasses.field(
+        default_factory=lambda: _flag("data_target_min_block_size"))
+    default_batch_size: int = dataclasses.field(
+        default_factory=lambda: _flag("data_default_batch_size"))
     read_op_min_num_blocks: int = dataclasses.field(
         default_factory=lambda: _flag("data_read_op_min_num_blocks"))
     # Streaming executor backpressure: max block refs buffered between operators.
     max_inflight_tasks_per_op: int = dataclasses.field(
         default_factory=lambda: _flag("data_max_inflight_tasks_per_op"))
-    op_output_buffer_limit: int = 16
+    op_output_buffer_limit: int = dataclasses.field(
+        default_factory=lambda: _flag("data_op_output_buffer_limit"))
     actor_pool_min_size: int = 1
     actor_pool_max_size: int = dataclasses.field(
         default_factory=lambda: _flag("data_actor_pool_max_size"))
-    use_push_based_shuffle: bool = False
+    # Push-based shuffle (reference push_based_shuffle_task_scheduler.py): maps
+    # run in rounds, partitions fold eagerly into per-partition merges —
+    # bounded fan-in, map/merge pipelining, early map-output GC. Worth it for
+    # large sorts; the pull-based exchange is simpler at test scale.
+    use_push_based_shuffle: bool = dataclasses.field(
+        default_factory=lambda: _flag("data_push_based_shuffle"))
+    push_shuffle_merge_factor: int = dataclasses.field(
+        default_factory=lambda: _flag("data_push_shuffle_merge_factor"))
     enable_progress_bars: bool = False
     seed: Optional[int] = None
 

@@ -158,6 +158,20 @@ def _merge_shuffled(seed: int, *parts: Block) -> Tuple[Block, BlockMetadata]:
     return out, BlockAccessor.for_block(out).get_metadata()
 
 
+def _concat_blocks(*parts: Block) -> Block:
+    """Merge-stage combiner for the push-based shuffle: plain row-union concat.
+    Every exchange reduce here (_merge_sorted / _merge_shuffled /
+    _agg_partition) is a function of the row UNION of its parts, so pre-
+    concatenating partials is semantics-preserving. An all-empty partition
+    (few distinct sort keys -> repeated boundaries) must keep its SCHEMA: the
+    downstream reduce sorts/groups by column name, and concat of zero-row
+    parts would otherwise collapse to a column-less table."""
+    non_empty = [p for p in parts if BlockAccessor.for_block(p).num_rows() > 0]
+    if not non_empty:
+        return parts[0]  # zero rows, schema intact
+    return BlockAccessor.concat(non_empty)
+
+
 def _hash_partition(block: Block, key: str, n_out: int) -> List[Block]:
     acc = BlockAccessor.for_block(block)
     col = acc.to_numpy([key])[key]
@@ -618,13 +632,46 @@ class StreamingExecutor:
 
         Partition blocks and reduced outputs stay in the object store; the driver only
         routes refs (map side: num_returns=n_parts, reduce side: num_returns=2).
+
+        Pull-based (default): every reduce task fans in ALL n_map partition refs
+        at once — simple, but peak memory is the full map output and reduce
+        can't start until the last map finishes. Push-based
+        (DataContext.use_push_based_shuffle; reference
+        push_based_shuffle_task_scheduler.py): map tasks run in rounds of
+        `merge_factor`, and each round's partitions are eagerly folded into a
+        running per-partition merge — fan-in is bounded by merge_factor+1,
+        merges of round r overlap maps of round r+1, and a round's map outputs
+        become garbage as soon as its merges finish. The final reduce consumes
+        ONE merged block per partition.
         """
+        from .context import DataContext
+
+        ctx = DataContext.get_current()
         rreduce = _remote(reduce_fn).options(num_returns=2)
         out = []
         reduce_refs = []
         if n_parts == 1:
             # Single partition: the map phase is a no-op, reduce over the raw blocks.
             reduce_refs.append(rreduce.remote(*reduce_args, *[b for b, _ in inputs]))
+        elif ctx.use_push_based_shuffle and len(inputs) > 2:
+            rmap = _remote(map_fn).options(num_returns=n_parts)
+            rmerge = _remote(_concat_blocks)
+            per_index_args = map_args if callable(map_args) else (lambda i: map_args)
+            factor = max(2, int(getattr(ctx, "push_shuffle_merge_factor", 8)))
+            merged: List[Optional[Any]] = [None] * n_parts
+            items = list(enumerate(inputs))
+            for start in range(0, len(items), factor):
+                round_items = items[start:start + factor]
+                part_refs = [rmap.remote(b, *per_index_args(i))
+                             for i, (b, _) in round_items]
+                for p in range(n_parts):
+                    parts = [pl[p] for pl in part_refs]
+                    if merged[p] is not None:
+                        parts.insert(0, merged[p])
+                    merged[p] = (parts[0] if len(parts) == 1
+                                 else rmerge.remote(*parts))
+            for p in range(n_parts):
+                reduce_refs.append(rreduce.remote(*reduce_args, merged[p]))
         else:
             rmap = _remote(map_fn).options(num_returns=n_parts)
             per_index_args = map_args if callable(map_args) else (lambda i: map_args)

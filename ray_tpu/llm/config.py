@@ -11,6 +11,12 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Union
 
 
+def _flag(name: str):
+    from ray_tpu.config import flag
+
+    return flag(name)
+
+
 @dataclasses.dataclass
 class SamplingParams:
     """Per-request sampling controls (reference vLLM SamplingParams surface)."""
@@ -40,9 +46,11 @@ class LLMConfig:
 
     model_id: str = "llama"
     model_source: Union[str, Any] = "byte-tiny"
-    # engine
-    max_num_seqs: int = 8  # decode slots (continuous-batching width)
-    max_model_len: int = 1024  # KV capacity per slot
+    # engine (defaults env-overridable via the config registry)
+    max_num_seqs: int = dataclasses.field(  # decode slots (batching width)
+        default_factory=lambda: _flag("llm_max_num_seqs"))
+    max_model_len: int = dataclasses.field(  # KV capacity per slot
+        default_factory=lambda: _flag("llm_max_model_len"))
     prefill_buckets: Optional[List[int]] = None  # pad-to lengths; default powers of 2
     dtype: str = "bfloat16"
     # KV layout (reference: vLLM PagedAttention block tables):

@@ -736,13 +736,15 @@ class JaxLLMEngine(LLMEngine):
             self._blocks.release(slot)
             self._waiting.put(req)
             return None
-        ctx_k, ctx_v = paged.gather_blocks(
-            self.state, jnp.asarray(cached_ids, jnp.int32), n_blocks=len(cached_ids))
         tokens = np.zeros((1, s_pad), np.int32)
         tokens[0, : len(suffix)] = suffix
-        k_suf, v_suf, last_logits = paged.prefill_suffix(
-            self.params, ctx_k, ctx_v, jnp.asarray(tokens),
-            jnp.int32(len(suffix)), cfg)
+        # fused gather+suffix: ONE device dispatch (the split version paid an
+        # extra host->device round trip per warm request — more than the
+        # prefill compute the cache saves, through a network tunnel)
+        k_suf, v_suf, last_logits = paged.prefill_suffix_from_state(
+            self.params, self.state, jnp.asarray(cached_ids, jnp.int32),
+            jnp.asarray(tokens), jnp.int32(len(suffix)), cfg,
+            n_blocks=len(cached_ids))
         new_ids = self._blocks.allocate(slot, needed_new)
         pad_blocks = s_pad // c.kv_block_size
         if pad_blocks < needed_new:

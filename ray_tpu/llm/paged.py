@@ -246,8 +246,21 @@ def gather_blocks(state: PagedState, block_ids: jax.Array, n_blocks: int):
     return kb.reshape(shape), vb.reshape(shape)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def prefill_suffix(params, ctx_k, ctx_v, tokens, true_suffix_len, cfg: ModelConfig):
+@functools.partial(jax.jit, static_argnames=("cfg", "n_blocks"))
+def prefill_suffix_from_state(params, state: PagedState, block_ids: jax.Array,
+                              tokens, true_suffix_len, cfg: ModelConfig,
+                              n_blocks: int):
+    """gather_blocks + prefill_suffix fused into ONE program: the warm
+    (prefix-hit) path previously dispatched gather and suffix separately —
+    an extra host->device round trip per request, which through a network
+    tunnel costs more than the prefill compute it saves."""
+    ctx_k, ctx_v = gather_blocks(state, block_ids, n_blocks)
+    return _prefill_suffix_impl(params, ctx_k, ctx_v, tokens,
+                                true_suffix_len, cfg)
+
+
+def _prefill_suffix_impl(params, ctx_k, ctx_v, tokens, true_suffix_len,
+                         cfg: ModelConfig):
     """Prefill ONLY the uncached suffix, attending over the cached-prefix KV
     context (reference: vLLM prefix caching skips recomputation of shared
     prompt prefixes). ctx_k/ctx_v: [L, 1, C, KV, HD]; tokens [1, S_pad].
@@ -263,6 +276,10 @@ def prefill_suffix(params, ctx_k, ctx_v, tokens, true_suffix_len, cfg: ModelConf
     logits, cache = llama.forward(params, tokens, cfg, cache=cache, token_mask=mask)
     last = logits[0, true_suffix_len - 1].astype(jnp.float32)
     return (cache.k[:, :, cached_len:], cache.v[:, :, cached_len:], last)
+
+
+prefill_suffix = functools.partial(jax.jit, static_argnames=("cfg",))(
+    _prefill_suffix_impl)
 
 
 @functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("n_new",))

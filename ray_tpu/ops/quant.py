@@ -84,4 +84,8 @@ def quantize_llama_params(params: dict) -> dict:
     else:
         out["layers"] = [{k: _maybe_quant(k, v) for k, v in lyr.items()}
                          for lyr in layers]
+    if "lm_head" in params:
+        # the untied head [d_model, vocab] is often the single largest weight
+        # a decode step streams; every head consumer goes through as_weight
+        out["lm_head"] = quantize(params["lm_head"], 0)
     return out

@@ -17,13 +17,22 @@ class AutoscalingConfig:
     metrics_interval_s: float = 1.0
 
 
+def _flag(name: str):
+    from ray_tpu.config import flag
+
+    return flag(name)
+
+
 @dataclasses.dataclass
 class DeploymentConfig:
     num_replicas: Optional[int] = 1
-    max_ongoing_requests: int = 8
+    max_ongoing_requests: int = dataclasses.field(
+        default_factory=lambda: _flag("serve_max_ongoing_requests"))
     autoscaling_config: Optional[AutoscalingConfig] = None
-    health_check_period_s: float = 5.0
-    health_check_timeout_s: float = 10.0
+    health_check_period_s: float = dataclasses.field(
+        default_factory=lambda: _flag("serve_health_check_period_s"))
+    health_check_timeout_s: float = dataclasses.field(
+        default_factory=lambda: _flag("serve_health_check_timeout_s"))
     graceful_shutdown_timeout_s: float = 5.0
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     user_config: Optional[Dict[str, Any]] = None

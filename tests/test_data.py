@@ -77,6 +77,38 @@ def test_sort_and_shuffle(rt):
     assert sorted(r["k"] for r in shuffled.take_all()) == list(range(10))
 
 
+def test_push_based_shuffle_matches_pull(rt):
+    """DataContext.use_push_based_shuffle (reference push_based_shuffle_task_
+    scheduler.py): staged map rounds + eager per-partition merges must produce
+    the SAME sort/shuffle/groupby results as the pull-based exchange, with a
+    merge factor small enough that multiple rounds actually run."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    vals = [(i * 37) % 50 for i in range(50)]
+    ds = rd.from_items([{"k": v} for v in vals], parallelism=10)
+    want_sorted = sorted(vals)
+    want_groups = {g: sum(v for v in vals if v % 3 == g) for g in range(3)}
+
+    saved = (ctx.use_push_based_shuffle, ctx.push_shuffle_merge_factor)
+    ctx.use_push_based_shuffle = True
+    ctx.push_shuffle_merge_factor = 3  # 10 inputs -> 4 merge rounds
+    try:
+        assert [r["k"] for r in ds.sort("k").take_all()] == want_sorted
+        shuffled = ds.random_shuffle(seed=7)
+        assert sorted(r["k"] for r in shuffled.take_all()) == want_sorted
+        gds = rd.from_items([{"g": v % 3, "v": v} for v in vals], parallelism=10)
+        out = {r["g"]: r["sum(v)"] for r in gds.groupby("g").sum("v").take_all()}
+        assert out == want_groups
+        # few distinct keys -> repeated boundaries -> all-empty partitions:
+        # the merge stage must keep block schemas for the downstream sort
+        few = rd.from_items([{"k": v % 2} for v in range(24)], parallelism=8)
+        assert [r["k"] for r in few.sort("k").take_all()] == sorted(
+            v % 2 for v in range(24))
+    finally:
+        ctx.use_push_based_shuffle, ctx.push_shuffle_merge_factor = saved
+
+
 def test_groupby_aggregate(rt):
     ds = rd.from_items([{"g": i % 3, "v": i} for i in range(12)], parallelism=3)
     out = {r["g"]: r["sum(v)"] for r in ds.groupby("g").sum("v").take_all()}
