@@ -119,12 +119,13 @@ def main() -> None:
     # historical llama-500m number rides along: its 1536-wide matmuls cap MFU
     # near 49% on a v5e regardless of software (geometry-bound, not
     # framework-bound); at 8B geometry the same stack reaches ~66%.
-    # remat sweep on the chip: dots 66.0%, dots_no_batch 65.7%, full(b8) 65.7%,
-    # none OOMs — "dots" wins by a hair at this geometry
-    mfu_8b, _ = run_one("llama8b-geom2", 4, 2048, steps, "dots")
+    # sweeps on the chip: remat — dots 66.0% > dots_no_batch 65.7% > full(b8)
+    # 65.7%, none OOMs; batch at dots — b4 67.3% < b6 69.7%, b8 OOMs by 296MB
+    # (16.04G needed). b6+dots is the HBM-filling sweet spot at this geometry.
+    mfu_8b, _ = run_one("llama8b-geom2", 6, 2048, steps, "dots")
     mfu_500m, _ = run_one("llama-500m", 8, 2048, steps, "dots_no_batch")
     result = {
-        "metric": "train_mfu_llama8b_geometry_b4_s2048",
+        "metric": "train_mfu_llama8b_geometry_b6_s2048",
         "value": round(mfu_8b, 4),
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu_8b / 0.40, 4),
