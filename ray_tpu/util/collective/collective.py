@@ -34,6 +34,9 @@ class _GroupState:
     backend: Backend
     coordinator: Any
     seq: Dict[str, int] = field(default_factory=dict)
+    # True only when EVERY member of the group joined one jax.distributed universe
+    # (agreed collectively at bootstrap) — the gate for device-path collectives.
+    xla_device_plane: bool = False
 
     def next_key(self, op: str, extra: str = "") -> str:
         n = self.seq.get(op, 0)
@@ -207,8 +210,72 @@ def _like(result: np.ndarray, tensor):
     return result
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _xla_reduce_program(world_size: int, op: ReduceOp, ndim: int):
+    """(mesh, jitted-reducer) for a one-device-per-process mesh — cached so steady-state
+    allreduce calls hit the jit cache instead of recompiling a cross-process program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = []
+    for rank in range(world_size):
+        d = next((d for d in jax.devices() if d.process_index == rank), None)
+        if d is None:
+            return None, None
+        devs.append(d)
+    mesh = Mesh(np.array(devs), ("rank",))
+    fn = {
+        ReduceOp.SUM: jnp.sum, ReduceOp.PRODUCT: jnp.prod,
+        ReduceOp.MIN: jnp.min, ReduceOp.MAX: jnp.max,
+    }[op]
+    prog = jax.jit(
+        lambda x: fn(x, axis=0),
+        out_shardings=NamedSharding(mesh, PartitionSpec(*([None] * ndim))),
+    )
+    return mesh, prog
+
+
+def _xla_device_allreduce(tensor, st: _GroupState, op: ReduceOp):
+    """Device-path all-reduce for the XLA backend: a compiled reduction over a mesh
+    with one device per member process (collectives ride ICI/DCN, not the host
+    coordinator). Returns None when the group didn't uniformly join one
+    jax.distributed universe (then the caller falls back to the shm plane) or when
+    the dtype needs 64-bit (jax x64 is off; the shm plane preserves dtype).
+
+    Reference capability: NCCL allreduce in python/ray/util/collective/collective.py:295;
+    here the ring is XLA's, launched from one jitted program all members enter.
+    """
+    # Collectively-agreed at bootstrap: EVERY member joined the universe, or NOBODY
+    # takes the device path — a per-call jax.process_count() probe could split the
+    # group across planes and deadlock the compiled reduction.
+    if not st.xla_device_plane:
+        return None
+    t = np.asarray(tensor)
+    if t.dtype.itemsize >= 8:  # float64/int64 would silently downcast under no-x64
+        return None
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh, prog = _xla_reduce_program(st.world_size, op, t.ndim)
+    if mesh is None:
+        return None
+    stacked = NamedSharding(mesh, PartitionSpec("rank", *([None] * t.ndim)))
+    local = jax.device_put(t[None], mesh.devices.flat[st.rank])
+    garr = jax.make_array_from_single_device_arrays(
+        (st.world_size,) + t.shape, stacked, [local])
+    return np.asarray(jax.device_get(prog(garr)))
+
+
 def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     st = _state(group_name)
+    if st.backend is Backend.XLA:
+        out = _xla_device_allreduce(tensor, st, op)
+        if out is not None:
+            return _like(out, tensor)
     key = st.next_key("allreduce")
     st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
     parts = wait_poll(st.coordinator, key, st.rank, timeout_s=30.0)
@@ -298,36 +365,46 @@ def _bootstrap_xla(st: _GroupState) -> None:
         return
     import jax
 
-    if jax.process_count() > 1:  # already bootstrapped
-        return
     import ray_tpu
 
-    if st.rank == 0:
-        import socket
+    # Probe WITHOUT touching the backend: jax.process_count() would itself initialize
+    # XLA, after which jax.distributed.initialize() refuses to run.
+    if not jax.distributed.is_initialized():  # else already bootstrapped (JaxBackend)
+        if st.rank == 0:
+            import socket
 
-        sock = socket.socket()
-        sock.bind(("", 0))
-        port = sock.getsockname()[1]
-        sock.close()
-        addr = f"{socket.gethostbyname(socket.gethostname())}:{port}"
-        ray_tpu.get(st.coordinator.set_meta.remote("xla_coordinator", addr))
-    else:
-        import time
+            sock = socket.socket()
+            sock.bind(("", 0))
+            port = sock.getsockname()[1]
+            sock.close()
+            addr = f"{socket.gethostbyname(socket.gethostname())}:{port}"
+            ray_tpu.get(st.coordinator.set_meta.remote("xla_coordinator", addr))
+        else:
+            import time
 
-        deadline = time.monotonic() + 60
-        addr = None
-        while addr is None:
-            addr = ray_tpu.get(st.coordinator.get_meta.remote("xla_coordinator"))
-            if addr is None:
-                if time.monotonic() > deadline:
-                    raise TimeoutError("xla backend rendezvous timed out")
-                time.sleep(0.05)
-    try:
-        jax.distributed.initialize(
-            coordinator_address=addr, num_processes=st.world_size, process_id=st.rank
-        )
-    except RuntimeError:
-        # Single shared runtime (e.g. all members are threads of one process in tests, or
-        # distributed already initialized by the launcher) — collectives still work via
-        # the shm plane; compiled-path meshes use the locally visible devices.
-        pass
+            deadline = time.monotonic() + 60
+            addr = None
+            while addr is None:
+                addr = ray_tpu.get(st.coordinator.get_meta.remote("xla_coordinator"))
+                if addr is None:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("xla backend rendezvous timed out")
+                    time.sleep(0.05)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr, num_processes=st.world_size, process_id=st.rank
+            )
+        except RuntimeError:
+            # Single shared runtime (e.g. all members are threads of one process in
+            # tests, or distributed already initialized by the launcher) — collectives
+            # still work via the shm plane; compiled-path meshes use local devices.
+            pass
+
+    # Agree on the device plane COLLECTIVELY: every member reports whether it joined a
+    # universe whose size matches the group; all must agree or nobody uses the device
+    # path (a split would deadlock the compiled reduction against the shm plane).
+    joined = jax.distributed.is_initialized() and jax.process_count() == st.world_size
+    key = f"__xla_plane__:{st.name}"
+    st.coordinator.contribute.remote(key, st.rank, bool(joined))
+    flags = wait_poll(st.coordinator, key, st.rank, timeout_s=60.0)
+    st.xla_device_plane = all(bool(f) for f in flags)

@@ -1,7 +1,9 @@
 """Collective API tests (reference analogue: python/ray/util/collective tests).
 
-Host-plane (SHM backend) collectives across actor processes; XLA backend is exercised only
-for its single-universe no-op path (multi-host bootstrap needs real pods).
+Host-plane (SHM backend) collectives across actor processes. The XLA backend's
+cross-process path — jax.distributed bootstrap + device-path psum over a mesh spanning
+two OS processes — is exercised in test_spmd_multiprocess.py (the trainer loop runs
+init_collective_group(backend="xla") inside a real 2-process universe).
 """
 import numpy as np
 import pytest
