@@ -80,9 +80,91 @@ def run_one(model_name: str, batch: int, seq: int, steps: int,
     return mfu, tokens_per_sec
 
 
+def _trainer_loop(config) -> None:
+    """The stock-Trainer-path measurement body: identical model/step/config as
+    run_one, but driven inside a JaxTrainer.fit() worker session (BASELINE.md:25
+    words the north star as MFU 'via a stock Trainer API' — this measures exactly
+    that, not the bare step function)."""
+    import dataclasses
+    import time
+
+    import jax
+
+    import ray_tpu.train as train
+    from ray_tpu.models import get_config
+    from ray_tpu.train import init_state, make_optimizer, make_train_step
+
+    cfg = get_config(config["model"])
+    if config["remat"] != cfg.remat_policy:
+        cfg = dataclasses.replace(cfg, remat_policy=config["remat"])
+    batch, seq, steps = config["batch"], config["seq"], config["steps"]
+    tx = make_optimizer(total_steps=1000)
+    state = init_state(jax.random.PRNGKey(0), cfg, tx)
+    step = make_train_step(cfg, tx)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+    batch_dict = {"tokens": tokens}
+    state, metrics = step(state, batch_dict)
+    float(metrics["loss"])  # fetch = sync (block_until_ready is unreliable on the tunnel)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_dict)
+    final_loss = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    tokens_per_sec = batch * seq / dt
+    mfu = tokens_per_sec * 6 * cfg.n_params / peak_flops_for(jax.devices()[0])
+    train.report({"mfu": mfu, "tokens_per_sec": tokens_per_sec, "loss": final_loss})
+
+
+def run_trainer_path(model_name: str, batch: int, seq: int, steps: int,
+                     remat_policy: str) -> tuple:
+    """Same measurement as run_one but through JaxTrainer.fit() (1 worker owning the
+    chip). Returns (mfu, tokens_per_sec) reported from inside the session."""
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train import JaxConfig, JaxTrainer
+
+    log(f"trainer-path: model={model_name} batch={batch} seq={seq} steps={steps}")
+    import jax
+
+    on_cpu = jax.default_backend() == "cpu"
+    worker_env = {"JAX_PLATFORMS": "cpu"} if on_cpu else None
+    ray_tpu.init(num_cpus=2, worker_env=worker_env)
+    try:
+        # use_tpu: the worker must be spawned with the "tpu" accel tag — plain
+        # CPU workers force JAX_PLATFORMS=cpu and would run the model on host
+        scaling = (ScalingConfig(num_workers=1, cpus_per_worker=1.0) if on_cpu
+                   else ScalingConfig(num_workers=1, use_tpu=True,
+                                      chips_per_worker=1))
+        trainer = JaxTrainer(
+            _trainer_loop,
+            train_loop_config={"model": model_name, "batch": batch, "seq": seq,
+                               "steps": steps, "remat": remat_policy},
+            backend_config=JaxConfig(collective_group=False),
+            scaling_config=scaling,
+            run_config=RunConfig(name="bench_trainer_path",
+                                 storage_path=tempfile.mkdtemp(prefix="bench_tp_")),
+        )
+        result = trainer.fit()
+        if result.error is not None:
+            raise RuntimeError(f"trainer-path bench failed: {result.error}")
+        m = result.metrics
+        log(f"trainer-path: mfu={m['mfu']:.3f} tokens/s={m['tokens_per_sec']:,.0f} "
+            f"loss={m['loss']:.3f}")
+        return m["mfu"], m["tokens_per_sec"]
+    finally:
+        ray_tpu.shutdown()
+
+
 def main() -> None:
     import jax
 
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # honor the env var even when a sitecustomize PJRT plugin forced the
+        # platform at the jax-config level (same dance as __graft_entry__)
+        jax.config.update("jax_platforms", "cpu")
     backend = jax.default_backend()
     on_cpu = backend == "cpu"
     dev = jax.devices()[0]
@@ -98,11 +180,14 @@ def main() -> None:
         model_name = env_model or "test-tiny"
         mfu, tokens_per_sec = run_one(model_name, batch, seq, steps, remat)
         if on_cpu:
+            # smoke the Trainer-path plumbing too (tiny; keeps the TPU-mode code honest)
+            _, trainer_tps = run_trainer_path(model_name, batch, seq, steps, remat)
             result = {
                 "metric": "train_step_tokens_per_sec_cpu_smoke",
                 "value": round(tokens_per_sec, 1),
                 "unit": "tokens/s",
                 "vs_baseline": 0.0,
+                "secondary": {"trainer_fit_tokens_per_sec": round(trainer_tps, 1)},
             }
         else:
             result = {
@@ -122,14 +207,33 @@ def main() -> None:
     # sweeps on the chip: remat — dots 66.0% > dots_no_batch 65.7% > full(b8)
     # 65.7%, none OOMs; batch at dots — b4 67.3% < b6 69.7%, b8 OOMs by 296MB
     # (16.04G needed). b6+dots is the HBM-filling sweet spot at this geometry.
+    # Trainer-path FIRST (its worker process allocates a full model + optimizer
+    # before the in-process bare-step runs fill HBM), then the bare step for
+    # comparison. The axon tunnel shares the chip across processes; on a libtpu
+    # host with a process-exclusive chip lock the worker may fail to initialize
+    # — fall back to the bare-step headline rather than producing no number.
+    try:
+        mfu_fit, _ = run_trainer_path("llama8b-geom2", 6, 2048, steps, "dots")
+    except Exception as e:
+        log(f"trainer-path failed ({type(e).__name__}: {e}); "
+            "falling back to bare-step headline")
+        mfu_fit = None
     mfu_8b, _ = run_one("llama8b-geom2", 6, 2048, steps, "dots")
     mfu_500m, _ = run_one("llama-500m", 8, 2048, steps, "dots_no_batch")
+    # Headline = the STOCK TRAINER API number — exactly how BASELINE.md:25 words
+    # the 40%-MFU north star. The bare step function rides along as secondary.
+    headline = mfu_fit if mfu_fit is not None else mfu_8b
     result = {
-        "metric": "train_mfu_llama8b_geometry_b6_s2048",
-        "value": round(mfu_8b, 4),
+        "metric": ("train_mfu_llama8b_geometry_trainer_fit_b6_s2048"
+                   if mfu_fit is not None
+                   else "train_mfu_llama8b_geometry_b6_s2048"),
+        "value": round(headline, 4),
         "unit": "mfu_fraction",
-        "vs_baseline": round(mfu_8b / 0.40, 4),
-        "secondary": {"train_mfu_llama-500m_b8_s2048": round(mfu_500m, 4)},
+        "vs_baseline": round(headline / 0.40, 4),
+        "secondary": {
+            "train_mfu_llama8b_geometry_bare_step_b6_s2048": round(mfu_8b, 4),
+            "train_mfu_llama-500m_b8_s2048": round(mfu_500m, 4),
+        },
     }
     print(json.dumps(result))
 

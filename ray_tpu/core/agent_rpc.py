@@ -270,6 +270,12 @@ def _drain_batch(q: "queue.Queue", first):
         except queue.Empty:
             break
         if nxt is None:
+            # Re-queued at the BACK: ordering still holds because a None can
+            # only follow close(), and both senders (AgentStream.send,
+            # AgentChannel.send) refuse new frames once their closed flag is
+            # set — so no frame can be enqueued after the sentinel for this
+            # put to jump ahead of. If that send()-after-close guard ever
+            # moves, switch this queue to a deque + appendleft (ADVICE r4).
             q.put(None)
             break
         items.append(nxt)

@@ -21,16 +21,21 @@ guessed up front. The arm round-trip is a ~1 KB control message; payload bytes
 move exclusively through the transfer server.
 
 Sharding contract: a NamedSharding is re-built on the consumer from (axis names,
-mesh shape, partition spec) over `jax.devices()` in default order — producer and
-consumer must see identically-shaped device sets (true for P/D pools on same-size
-slices and for the CPU test mesh). Anything else falls back to the host path at
-the call site.
+mesh shape, partition spec) over `jax.devices()` in default order. When the
+consumer cannot host the producer's mesh (fewer devices — e.g. a small decode
+pool pulling from a big prefill pool), fetch falls back to a RESHARDING pull:
+the producer arms its per-shard pieces, the consumer pulls each piece
+device-to-device onto its own devices, and one compiled assemble program
+scatters the pieces into an array sharded over a consumer-sized mesh (same axis
+names, sizes shrunk to fit). Payload bytes still never touch host pickle.
 """
 from __future__ import annotations
 
+import functools
 import secrets
 import socket
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -94,6 +99,152 @@ def _rebuild_sharding(desc: Tuple):
     return SingleDeviceSharding(_default_device())
 
 
+def _fit_target_sharding(desc: Tuple, shape: Tuple[int, ...]):
+    """A consumer-sized stand-in for a producer sharding the consumer can't
+    host: same axis names and partition spec, mesh sizes shrunk (halving the
+    largest axes) until the consumer's devices suffice. Spec axes that no
+    longer divide the array dims drop to replicated."""
+    import functools
+    import operator
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    _, axis_names, mesh_shape, spec_entries = desc
+    n = len(jax.devices())
+    sizes = list(mesh_shape)
+    while functools.reduce(operator.mul, sizes, 1) > n:
+        i = max(range(len(sizes)), key=lambda j: sizes[j])
+        if sizes[i] <= 1:
+            raise DevicePlaneError("cannot fit producer mesh on consumer")
+        sizes[i] = sizes[i] // 2 if sizes[i] % 2 == 0 else 1
+    total = functools.reduce(operator.mul, sizes, 1)
+    mesh = Mesh(np.asarray(jax.devices()[:total]).reshape(sizes), axis_names)
+    by_name = dict(zip(axis_names, sizes))
+
+    def _entry_ok(entry, dim):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        span = functools.reduce(operator.mul, (by_name.get(a, 1) for a in names), 1)
+        return dim % span == 0
+
+    entries = []
+    for i, entry in enumerate(spec_entries):
+        if entry is None or i >= len(shape):
+            entries.append(None)
+        else:
+            entries.append(entry if _entry_ok(entry, shape[i]) else None)
+    return NamedSharding(mesh, PartitionSpec(*entries))
+
+
+@functools.lru_cache(maxsize=256)
+def _assemble_program(starts_list: Tuple, block_shape: Tuple, dtype: str, dev):
+    """Compiled single-device scatter-assemble: the pieces of ONE target shard
+    (already pulled onto their owning device) -> that shard's block. Cached per
+    (piece layout, shape, device) so steady-state fetches replay."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    sh = SingleDeviceSharding(dev)
+
+    def build(pieces):
+        out = jnp.zeros(block_shape, jnp.dtype(dtype))
+        for p, st in zip(pieces, starts_list):
+            out = jax.lax.dynamic_update_slice(out, p.astype(out.dtype), st)
+        return out
+
+    return jax.jit(build, in_shardings=([sh] * len(starts_list),),
+                   out_shardings=sh)
+
+
+class _ReshardPlan:
+    """Where each producer piece lands and how target shards assemble."""
+
+    def __init__(self, target, pieces, groups):
+        self.target = target
+        # meta-order: (piece_shape, global_starts, owning consumer device)
+        self.pieces = pieces
+        # one per DISTINCT target shard: ((start, stop) per dim, [devices
+        # holding this shard], [piece indices covering it])
+        self.groups = groups
+
+    def assemble(self, pulled: List, spec: ArraySpec):
+        import jax
+
+        shape = tuple(spec.shape)
+        blocks = []
+        for key, devs, pidx in self.groups:
+            local_shape = tuple(b - a for a, b in key)
+            primary = devs[0]
+            if len(pidx) == 1 and tuple(self.pieces[pidx[0]][0]) == local_shape:
+                block = pulled[pidx[0]]
+            else:
+                starts_local = tuple(
+                    tuple(s - a for s, (a, _b) in zip(self.pieces[i][1], key))
+                    for i in pidx)
+                prog = _assemble_program(starts_local, local_shape, spec.dtype,
+                                         primary)
+                block = prog([pulled[i] for i in pidx])
+            blocks.append(block)
+            for extra in devs[1:]:  # replicated target dims: device-to-device copy
+                blocks.append(jax.device_put(block, extra))
+        if len(blocks) == 1 and not isinstance(
+                self.target, jax.sharding.NamedSharding):
+            return blocks[0]
+        return jax.make_array_from_single_device_arrays(
+            shape, self.target, blocks)
+
+
+def _reshard_plan(spec: ArraySpec, per_arr: List) -> _ReshardPlan:
+    """Assign producer pieces to the consumer devices owning their slices of
+    the shrunk-mesh target sharding; raises DevicePlaneError (-> host fallback)
+    when the pieces don't nest exactly."""
+    import math
+
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    shape = tuple(spec.shape)
+    if spec.sharding[0] != "named":
+        dev = jax.devices()[0]
+        pieces = [(tuple(ps), tuple(st), dev) for ps, st in per_arr]
+        key = tuple((0, d) for d in shape)
+        return _ReshardPlan(SingleDeviceSharding(dev), pieces,
+                            [(key, [dev], list(range(len(per_arr))))])
+    target = _fit_target_sharding(spec.sharding, shape)
+    groups: Dict[Tuple, List] = {}
+    order: List[Tuple] = []
+    for dev, idx in target.devices_indices_map(shape).items():
+        key = tuple(
+            (sl.start or 0, sl.stop if sl.stop is not None else dim)
+            for sl, dim in zip(idx, shape))
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(dev)
+    pieces, assign = [], {key: [] for key in order}
+    for pi, (pshape, starts) in enumerate(per_arr):
+        rng = tuple((s, s + d) for s, d in zip(starts, pshape))
+        home = next(
+            (key for key in order
+             if all(a >= ka and b <= kb
+                    for (a, b), (ka, kb) in zip(rng, key))), None)
+        if home is None:
+            raise DevicePlaneError(
+                "producer shard does not nest inside the consumer sharding")
+        assign[home].append(pi)
+        pieces.append((tuple(pshape), tuple(starts), groups[home][0]))
+    for key, pidx in assign.items():
+        vol = sum(math.prod(per_arr[i][0]) for i in pidx)
+        tvol = math.prod(b - a for a, b in key) if key else 1
+        if vol != tvol:
+            raise DevicePlaneError(
+                "target shard not exactly covered by producer pieces")
+    return _ReshardPlan(target, pieces,
+                        [(key, groups[key], assign[key]) for key in order])
+
+
 def _default_device():
     import jax
 
@@ -130,7 +281,15 @@ class DevicePlane:
         self._arm_listener = None
         self._arm_addr: Optional[Tuple[str, int]] = None
         self._authkey: Optional[bytes] = None
-        self._exports: Dict[bytes, Tuple[List[Any], bytes]] = {}  # key -> (flat, treedef)
+        self._exports: Dict[bytes, List[Any]] = {}  # key -> flat arrays (pinned)
+        # Opt-in TTL backstop (ADVICE r4): exports whose consumer might crash
+        # without acking (P/D KV handoffs) pass export(ttl_s=...) and get swept
+        # here if never released. Exports with a live OWNER that releases them
+        # deterministically (device objects freed by the object store,
+        # DeviceChannel values released on the next write) pass no TTL and stay
+        # pinned until release() — a sweep there would DESTROY live data.
+        self._export_deadlines: Dict[bytes, float] = {}
+        self._ttl_thread: Optional[threading.Thread] = None
         self._conns: Dict[str, Any] = {}  # xfer addr -> TransferConnection
         self._uuid_counter = secrets.randbits(48) << 14  # process-unique uuid space
         self.counters: Dict[str, int] = {
@@ -197,12 +356,15 @@ class DevicePlane:
 
     # -- producer side -----------------------------------------------------------
 
-    def export(self, tree: Any) -> DeviceHandle:
+    def export(self, tree: Any, ttl_s: Optional[float] = None) -> DeviceHandle:
         """Register a pytree of jax.Arrays for device-native fetch by peers.
 
         The plane holds strong references until `release(handle.key)` — exports
         pin device memory, so producers release as soon as consumers are done
-        (P/D: when the decode side acks; channels: on next write).
+        (P/D: when the decode side acks; channels: on next write). ttl_s, when
+        given, additionally auto-releases the export after that long — the
+        crashed-consumer backstop for fire-and-forget handoffs; leave it None
+        for exports an owner releases deterministically.
         """
         if not self.available:
             raise DevicePlaneError(self._disabled_reason or "device plane disabled")
@@ -220,6 +382,13 @@ class DevicePlane:
         with self._lock:
             self._exports[key] = flat
             self.counters["exports"] += 1
+            if ttl_s is not None:
+                self._export_deadlines[key] = time.monotonic() + ttl_s
+                if self._ttl_thread is None:
+                    self._ttl_thread = threading.Thread(
+                        target=self._ttl_loop, daemon=True,
+                        name="rt-device-plane-ttl")
+                    self._ttl_thread.start()
         host, port = self._arm_addr
         return DeviceHandle(
             arm_host=host, arm_port=port, key=key, specs=specs,
@@ -229,6 +398,18 @@ class DevicePlane:
     def release(self, key: bytes) -> None:
         with self._lock:
             self._exports.pop(key, None)
+            self._export_deadlines.pop(key, None)
+
+    def _ttl_loop(self, interval_s: float = 30.0) -> None:
+        while True:
+            time.sleep(interval_s)
+            now = time.monotonic()
+            with self._lock:
+                stale = [k for k, d in self._export_deadlines.items()
+                         if now > d]
+                for k in stale:
+                    self._exports.pop(k, None)
+                    self._export_deadlines.pop(k, None)
 
     def _arm_loop(self) -> None:
         while True:
@@ -254,7 +435,7 @@ class DevicePlane:
                     self.release(key)
                     conn.send_bytes(pickle.dumps(("ok",)))
                     continue
-                if op != "arm":
+                if op not in ("arm", "arm_shards"):
                     conn.send_bytes(pickle.dumps(("err", f"bad op {op!r}")))
                     continue
                 with self._lock:
@@ -265,6 +446,25 @@ class DevicePlane:
                     self._uuid_counter += 1
                     uuid = self._uuid_counter
                     self.counters["arms"] += 1
+                if op == "arm_shards":
+                    # resharding pull: arm the per-shard PIECES so a consumer
+                    # with a different device topology can pull them one by
+                    # one and reassemble under its own mesh
+                    pieces, meta = [], []
+                    for arr in flat:
+                        per_arr, seen = [], set()
+                        for sh in arr.addressable_shards:
+                            starts = tuple(int(sl.start or 0) for sl in sh.index)
+                            if starts in seen:  # replicated copy of a piece
+                                continue
+                            seen.add(starts)
+                            pieces.append(sh.data)
+                            per_arr.append((tuple(sh.data.shape), starts))
+                        meta.append(per_arr)
+                    self._server.await_pull(uuid, pieces)
+                    conn.send_bytes(pickle.dumps(
+                        ("ok", self._xfer_addr, uuid, meta)))
+                    continue
                 # await_pull holds buffer refs in the server until pulled.
                 self._server.await_pull(uuid, flat)
                 conn.send_bytes(pickle.dumps(("ok", self._xfer_addr, uuid)))
@@ -291,8 +491,14 @@ class DevicePlane:
         import pickle
 
         try:
+            try:
+                shardings = [_rebuild_sharding(s.sharding) for s in handle.specs]
+            except DevicePlaneError:
+                # consumer can't host the producer's mesh (e.g. a 2-chip decode
+                # pool pulling from a 4-chip prefill pool): per-shard pull +
+                # compiled reassembly under a consumer-sized mesh
+                return self._fetch_reshard(handle, release)
             xfer_addr, uuid = self._arm(handle)
-            shardings = [_rebuild_sharding(s.sharding) for s in handle.specs]
             avals = [
                 jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
                 for s, sh in zip(handle.specs, shardings)
@@ -324,6 +530,62 @@ class DevicePlane:
             with self._lock:
                 self.counters["fallbacks"] += 1
             raise DevicePlaneError(f"device fetch failed: {type(e).__name__}: {e}") from e
+
+    def _fetch_reshard(self, handle: DeviceHandle, release: bool) -> Any:
+        """Pull a producer's per-shard pieces onto this process's devices and
+        assemble them under a consumer-sized sharding — the unequal-topology
+        half of the fetch contract (reference analogue: NCCL channels reshard
+        between different-size P/D pools,
+        experimental/channel/torch_tensor_nccl_channel.py).
+
+        Each piece is pulled STRAIGHT to the consumer device that owns its
+        slice of the target sharding (the shrunk-mesh producer spec always
+        refines it along the same axes), then assembled per-device — payload
+        bytes go producer-device -> owning consumer-device exactly once."""
+        import pickle
+
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        resp = self._control(handle, ("arm_shards", handle.key))
+        if resp[0] == "gone":
+            raise DevicePlaneError("export released by producer")
+        if resp[0] != "ok":
+            raise DevicePlaneError(f"arm_shards failed: {resp!r}")
+        _, xfer_addr, uuid, meta = resp
+        plans = [
+            _reshard_plan(spec, per_arr)
+            for spec, per_arr in zip(handle.specs, meta)
+        ]
+        avals = [
+            jax.ShapeDtypeStruct(shape, spec.dtype,
+                                 sharding=SingleDeviceSharding(dev))
+            for spec, plan in zip(handle.specs, plans)
+            for shape, _starts, dev in plan.pieces
+        ]
+        conn = self._connection(xfer_addr)
+        try:
+            flat_pieces = conn.pull(uuid, avals)
+        except Exception:
+            with self._lock:
+                self._conns.pop(xfer_addr, None)
+            raise
+        with self._lock:
+            self.counters["pulls"] += 1
+            self.counters["reshard_pulls"] = self.counters.get("reshard_pulls", 0) + 1
+            self.counters["bytes_pulled"] += handle.nbytes
+        if release:
+            try:
+                self._control(handle, ("release", handle.key))
+            except Exception:
+                pass  # plane TTL-prunes as backstop
+        arrays, pos = [], 0
+        for spec, plan in zip(handle.specs, plans):
+            pieces = flat_pieces[pos:pos + len(plan.pieces)]
+            pos += len(plan.pieces)
+            arrays.append(plan.assemble(pieces, spec))
+        treedef = pickle.loads(handle.treedef_pickle)
+        return jax.tree.unflatten(treedef, arrays)
 
     def _control(self, handle: DeviceHandle, msg: Tuple) -> Tuple:
         import pickle

@@ -25,6 +25,10 @@ class NodeInfo:
     start_time: float = field(default_factory=time.time)
 
 
+class JournalFencedError(RuntimeError):
+    """This head lost journal ownership to a newer head (split-brain fence)."""
+
+
 class _UriJournal:
     """Append-log over an fsspec URI "directory": each flush writes a new
     numbered segment object, replay reads segments in order, startup compaction
@@ -35,14 +39,40 @@ class _UriJournal:
     head on a *different machine/port* replays the same state. Per-mutation
     segment writes trade object-store round-trip latency for durability — the
     same trade Redis AOF fsync=always makes; cluster-metadata mutation rates
-    (app configs, named actors, job table) are low."""
+    (app configs, named actors, job table) are low.
+
+    Split-brain protection (ADVICE r4): segment names embed a per-writer token,
+    so two heads racing the same URI can never overwrite each other's segments
+    (names cannot collide); and each writer claims an ``owner`` marker at
+    startup — the marker is newest-writer-wins, and an old head discovers it
+    lost ownership (checked before compaction and every OWNER_CHECK_EVERY
+    appends) and stops journaling with JournalFencedError rather than keep
+    interleaving state with the replacement. There is no distributed lock here
+    — the operator contract is still one INTENDED writer per URI; the fence
+    turns an accidental second writer from silent corruption into a loud stop."""
+
+    OWNER_CHECK_EVERY = 32
 
     def __init__(self, uri: str):
+        import secrets
+
         from ray_tpu.train import storage
 
         self._storage = storage
         self.uri = uri.rstrip("/")
         self.seq = 0
+        self.token = secrets.token_hex(8)
+        self._appends_since_check = 0
+        # newest-writer-wins claim; heads that wrote before us are fenced out
+        self._storage.write_bytes(f"{self.uri}/owner", self.token.encode())
+
+    def _check_owner(self) -> None:
+        cur = self._storage.read_bytes(f"{self.uri}/owner")
+        if cur is not None and cur.decode(errors="replace") != self.token:
+            raise JournalFencedError(
+                f"journal {self.uri} is now owned by writer {cur!r} — this "
+                "head lost a failover race and must stop journaling")
+        self._appends_since_check = 0
 
     def _segments(self) -> List[str]:
         return sorted(n for n in self._storage.listdir(self.uri)
@@ -54,13 +84,19 @@ class _UriJournal:
             data = self._storage.read_bytes(f"{self.uri}/{name}") or b""
             yield from data.splitlines()
         if segs:
-            self.seq = int(segs[-1][4:]) + 1
+            # name = seg-{seq:012d}[-{token}]; tokens keep names collision-free
+            self.seq = int(segs[-1][4:16]) + 1
 
     def append(self, line: bytes) -> None:
-        self._storage.write_bytes(f"{self.uri}/seg-{self.seq:012d}", line)
+        self._appends_since_check += 1
+        if self._appends_since_check >= self.OWNER_CHECK_EVERY:
+            self._check_owner()
+        self._storage.write_bytes(
+            f"{self.uri}/seg-{self.seq:012d}-{self.token}", line)
         self.seq += 1
 
     def compact(self, lines: List[bytes]) -> None:
+        self._check_owner()  # never delete segments we may no longer own
         old = self._segments()
         self.append(b"\n".join(lines))
         for name in old:

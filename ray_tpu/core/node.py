@@ -78,12 +78,17 @@ def _system_memory_fraction() -> Optional[float]:
 
 
 class WorkerHandle:
-    def __init__(self, worker_id: WorkerID, process, conn, node: "NodeRuntime", accel: str):
+    def __init__(self, worker_id: WorkerID, process, conn, node: "NodeRuntime",
+                 accel: str, pool_key: Optional[str] = None):
         self.worker_id = worker_id
         self.process = process
         self.conn = conn
         self.node = node
         self.accel = accel
+        # idle-pool bucket: accel, or accel + runtime-env hash for workers
+        # SPAWNED with task-specific env vars (reference: dedicated workers per
+        # runtime env) — they may only be reused by tasks with the same env
+        self.pool_key = pool_key or accel
         self.state = "starting"  # starting | idle | busy | blocked | dead
         self.started_at = time.time()  # start-timeout watchdog reference point
         self.known_fns: set = set()
@@ -121,8 +126,8 @@ class NodeRuntime:
     def num_workers(self) -> int:
         return len(self.workers)
 
-    def pop_idle(self, accel: str) -> Optional[WorkerHandle]:
-        pool = self.idle.get(accel)
+    def pop_idle(self, pool_key: str) -> Optional[WorkerHandle]:
+        pool = self.idle.get(pool_key)
         while pool:
             w = pool.pop()
             if w.alive():
@@ -131,9 +136,10 @@ class NodeRuntime:
 
     def push_idle(self, w: WorkerHandle) -> None:
         w.state = "idle"
-        self.idle.setdefault(w.accel, []).append(w)
+        self.idle.setdefault(w.pool_key, []).append(w)
 
-    def spawn_worker(self, accel: str) -> Optional[WorkerHandle]:
+    def spawn_worker(self, accel: str, extra_env: Optional[Dict[str, str]] = None,
+                     pool_key: Optional[str] = None) -> Optional[WorkerHandle]:
         if len(self.workers) >= self.max_workers:
             return None
         from .worker import worker_main
@@ -141,6 +147,10 @@ class NodeRuntime:
         worker_id = WorkerID.generate()
         parent_conn, child_conn = _mp.Pipe(duplex=True)
         env = dict(self.cluster.worker_env)
+        if extra_env:
+            # runtime_env env_vars present at process SPAWN: process-level vars
+            # (XLA_FLAGS, JAX_PLATFORMS, ...) must exist before first import
+            env.update(extra_env)
         proc = _mp.Process(
             target=worker_main,
             args=(child_conn, self.node_id.hex(), worker_id.hex(), accel, env),
@@ -148,7 +158,8 @@ class NodeRuntime:
         )
         proc.start()
         child_conn.close()
-        w = WorkerHandle(worker_id, proc, parent_conn, self, accel)
+        w = WorkerHandle(worker_id, proc, parent_conn, self, accel,
+                         pool_key=pool_key)
         self.workers[worker_id] = w
         self.cluster._register_conn(w)
         return w
@@ -269,13 +280,17 @@ class RemoteNodeRuntime(NodeRuntime):
         self.agent: Optional[AgentHandle] = None  # set right after construction
         self.host_key = node_id.hex()
 
-    def spawn_worker(self, accel: str) -> Optional[WorkerHandle]:
+    def spawn_worker(self, accel: str, extra_env: Optional[Dict[str, str]] = None,
+                     pool_key: Optional[str] = None) -> Optional[WorkerHandle]:
         if len(self.workers) >= self.max_workers or not self.agent.alive:
             return None
         worker_id = WorkerID.generate()
         w = RemoteWorkerHandle(worker_id, self.agent, self, accel)
+        if pool_key:
+            w.pool_key = pool_key
         try:
-            self.agent.send(("spawn_worker", worker_id.hex(), accel))
+            self.agent.send(("spawn_worker", worker_id.hex(), accel,
+                             dict(extra_env or {})))
         except Exception:
             return None
         self.workers[worker_id] = w
@@ -1298,9 +1313,25 @@ class Cluster:
             self._dispatch_blocked_on_args = True
             return False  # transfer in flight; rescheduled when it lands
         accel = "tpu" if resources.get("TPU", 0) > 0 else "cpu"
-        worker = node.pop_idle(accel)
+        # Tasks with runtime_env env_vars get a DEDICATED worker pool keyed by
+        # the env hash (reference: worker-per-runtime-env): process-level vars
+        # (XLA_FLAGS, JAX_PLATFORMS, ...) only take effect at process spawn, so
+        # a reused plain worker must never serve an env_vars task.
+        env_vars = ((spec.runtime_env or {}).get("env_vars")
+                    if isinstance(spec.runtime_env, dict) else None)
+        if env_vars:
+            import hashlib as _hashlib
+            import json as _json
+
+            ek = _hashlib.sha256(_json.dumps(env_vars, sort_keys=True)
+                                 .encode()).hexdigest()[:10]
+            pool_key = f"{accel}|env:{ek}"
+        else:
+            pool_key = accel
+        worker = node.pop_idle(pool_key)
         if worker is None:
-            worker = node.spawn_worker(accel)
+            worker = node.spawn_worker(accel, extra_env=env_vars or None,
+                                       pool_key=pool_key)
             if worker is None:
                 ledger.release(resources)
                 return False

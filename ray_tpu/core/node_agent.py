@@ -375,8 +375,9 @@ class NodeAgent:
     def _handle_head_message(self, msg) -> None:
         kind = msg[0]
         if kind == "spawn_worker":
-            _, wid_hex, accel = msg
-            self._spawn_worker(wid_hex, accel)
+            _, wid_hex, accel = msg[:3]
+            extra_env = msg[3] if len(msg) > 3 else None
+            self._spawn_worker(wid_hex, accel, extra_env)
         elif kind == "to_worker":
             _, wid_hex, raw = msg
             entry = self._workers.get(wid_hex)
@@ -443,11 +444,14 @@ class NodeAgent:
             pass
 
     # -- worker pool -----------------------------------------------------------------
-    def _spawn_worker(self, wid_hex: str, accel: str) -> None:
+    def _spawn_worker(self, wid_hex: str, accel: str,
+                      extra_env: Optional[Dict[str, str]] = None) -> None:
         from .worker import worker_main
 
         parent_conn, child_conn = _mp.Pipe(duplex=True)
         env = dict(self.worker_env)
+        if extra_env:  # runtime_env env_vars applied at process spawn
+            env.update(extra_env)
         env["RAY_TPU_WORKER_LOG_DIR"] = self._log_dir
         proc = _mp.Process(
             target=worker_main,

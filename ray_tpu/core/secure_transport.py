@@ -21,8 +21,10 @@ class SecureConnection:
 
     Server-side sockets arrive with the handshake PENDING (wrap_socket with
     do_handshake_on_connect=False): the accept loop must never block on a
-    peer's handshake, so it completes lazily — bounded by a timeout — on the
-    per-connection thread's first operation."""
+    peer's handshake, so it completes lazily — bounded by _HANDSHAKE_TIMEOUT_S
+    — on the per-connection thread's first operation. poll() before the
+    handshake returns False immediately while no peer bytes have arrived; once
+    they have, the first poll/recv may block up to the handshake timeout."""
 
     _HANDSHAKE_TIMEOUT_S = 15.0
 
@@ -81,7 +83,19 @@ class SecureConnection:
         return self._recv_exact(size)
 
     def poll(self, timeout: float = 0.0) -> bool:
-        self._ensure_handshake()
+        # A pending server-side handshake must not break poll's timeout
+        # contract for the COMMON stall (a peer that connected but sent
+        # nothing): no bytes waiting -> return False without touching the
+        # handshake (ADVICE r4: poll(0) used to block 15 s there). Once
+        # handshake bytes HAVE arrived, the handshake runs with its full
+        # timeout — shrinking it to the poll timeout would kill healthy
+        # high-RTT peers mid-round-trip; this one case may still block up to
+        # _HANDSHAKE_TIMEOUT_S (documented in the class docstring).
+        if self._handshake_pending:
+            r, _, _ = select.select([self._sock], [], [], timeout)
+            if not r:
+                return False
+            self._ensure_handshake()
         # TLS may hold already-decrypted bytes in its buffer; select alone
         # would miss them
         if getattr(self._sock, "pending", lambda: 0)():

@@ -106,9 +106,16 @@ def generate_self_signed_tls(out_dir: str, extra_sans: Tuple[str, ...] = ()) -> 
         .sign(ca_key, hashes.SHA256())
     )
 
+    # ca.key lives in its own subdirectory: operators distribute out_dir to
+    # every node (ca.crt/cluster.crt/cluster.key are all a node needs), and a
+    # wholesale copy must not hand every node the power to mint valid cluster
+    # certs (ADVICE r4).
+    ca_priv_dir = os.path.join(out_dir, "ca-private")
+    os.makedirs(ca_priv_dir, exist_ok=True)
+    os.chmod(ca_priv_dir, 0o700)
     paths = {
         "ca": os.path.join(out_dir, "ca.crt"),
-        "ca_key": os.path.join(out_dir, "ca.key"),
+        "ca_key": os.path.join(ca_priv_dir, "ca.key"),
         "cert": os.path.join(out_dir, "cluster.crt"),
         "key": os.path.join(out_dir, "cluster.key"),
     }

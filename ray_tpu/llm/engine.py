@@ -163,9 +163,14 @@ class JaxLLMEngine(LLMEngine):
                 raise ValueError("max_num_seqs must be divisible by data_parallel_size")
             if c.kv_layout == "paged":
                 if c.data_parallel_size > 1:
-                    raise NotImplementedError(
-                        "kv_layout='paged' requires data_parallel_size=1 (the "
-                        "shared pool does not shard over dp yet)")
+                    # paged ⊗ dp: per-replica pool partitions (paged.py dp
+                    # section); the pool must split evenly across replicas
+                    num_blocks = c.num_kv_blocks or (
+                        c.max_num_seqs * c.max_model_len // c.kv_block_size)
+                    if num_blocks % c.data_parallel_size:
+                        raise ValueError(
+                            f"num_kv_blocks ({num_blocks}) must divide by "
+                            f"data_parallel_size ({c.data_parallel_size})")
                 if c.max_model_len % c.kv_block_size:
                     raise ValueError("max_model_len must be a multiple of kv_block_size")
                 if any(b % c.kv_block_size for b in c.buckets()):
@@ -185,14 +190,6 @@ class JaxLLMEngine(LLMEngine):
                 if c.pipeline_parallel_size > 1:
                     raise NotImplementedError(
                         "speculative decoding does not compose with pp decode")
-                if c.num_decode_steps > 1 and c.kv_layout != "slot":
-                    raise NotImplementedError(
-                        "spec + fused multi-step requires kv_layout='slot' "
-                        "(the fused windows propose on-device against a "
-                        "history buffer; paged verify stays per-step)")
-                if cfg.n_experts > 0:
-                    raise NotImplementedError(
-                        "speculative decoding: dense models only")
             if c.prefill_chunk and c.max_model_len % c.prefill_chunk:
                 # guarantees a chunk-padded prompt never exceeds max_model_len
                 # (the block table / slot cache width)
@@ -203,8 +200,6 @@ class JaxLLMEngine(LLMEngine):
                 if c.quantization != "int8":
                     raise ValueError(
                         f"unknown quantization {c.quantization!r} (supported: int8)")
-                if cfg.n_experts > 0:
-                    raise NotImplementedError("quantization: dense models only")
             if self._params_in is not None:
                 self.params = model_runner.shard_params(self._params_in, cfg, self._mesh)
             else:
@@ -259,10 +254,13 @@ class JaxLLMEngine(LLMEngine):
 
                     num_blocks = c.num_kv_blocks or (
                         c.max_num_seqs * c.max_model_len // c.kv_block_size)
-                    self._blocks = paged._BlockManager(
+                    self._blocks = paged.make_block_manager(
                         num_blocks, c.kv_block_size,
                         c.max_model_len // c.kv_block_size, c.max_num_seqs,
+                        dp=c.data_parallel_size,
                         enable_prefix_caching=c.enable_prefix_caching)
+                    self._pops = paged.PagedOps(
+                        self.model_config, self._mesh, c.max_num_seqs)
                     self.state = paged.init_paged_state(
                         self.model_config, c.max_num_seqs, c.max_model_len,
                         num_blocks, c.kv_block_size, self._mesh)
@@ -370,7 +368,9 @@ class JaxLLMEngine(LLMEngine):
 
         dp = _dp.plane()
         if dp.available and not force_host:
-            handle = dp.export({"k": k, "v": v})
+            # plane-level ttl: backstop for a decode replica that crashes
+            # before acking (the engine's own tracker prunes sooner)
+            handle = dp.export({"k": k, "v": v}, ttl_s=600.0)
             self._track_pd_export(handle.key)
             out["kv_handle"] = handle
             out["kv_key"] = handle.key.hex()
@@ -541,7 +541,13 @@ class JaxLLMEngine(LLMEngine):
 
     # -- scheduler loop ------------------------------------------------------------
     def _free_slots(self) -> List[int]:
-        return [s for s, r in self._active.items() if r is None]
+        free = [s for s, r in self._active.items() if r is None]
+        c = self.config
+        if c.kv_layout == "paged" and c.data_parallel_size > 1 and free:
+            # admit into the dp replica with the most free blocks first (one
+            # full partition must not head-of-line-block admission to others)
+            free.sort(key=lambda s: -self._blocks.num_free_for(s))
+        return free
 
     def _admit(self) -> None:
         cfg, c = self.model_config, self.config
@@ -635,25 +641,24 @@ class JaxLLMEngine(LLMEngine):
         """Allocate blocks for [L,1,S_pad,...] prefill KV and install it.
         True = installed; False = pool busy (req requeued by the CALLER);
         None = can never fit (request failed here)."""
-        from . import paged
-
         c = self.config
         s_pad = k.shape[2]
         needed = self._blocks.blocks_needed(max(n + 1, s_pad))
-        if needed > min(self._blocks.total_blocks, self._blocks.max_blocks):
-            # exceeds the pool OR this engine's per-slot table width (e.g. a P/D
-            # transfer padded past the decode engine's max_model_len): can never
-            # fit, so fail instead of requeueing forever
+        if needed > self._blocks.max_fit(slot):
+            # exceeds this slot's pool (its dp replica's partition) OR the
+            # per-slot table width (e.g. a P/D transfer padded past the decode
+            # engine's max_model_len): can never fit, so fail instead of
+            # requeueing forever
             self._fail_request(req, n)
             return None
-        if not self._blocks.can_allocate(needed):
+        if not self._blocks.can_allocate_for(slot, needed):
             return False
         block_ids = self._blocks.allocate(slot, needed)
         if s_pad < needed * c.kv_block_size:
             extra = needed * c.kv_block_size - s_pad
             k = jnp.pad(k, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
             v = jnp.pad(v, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
-        self.state = paged.install_prefill(
+        self.state = self._pops.install_prefill(
             self.state, k, v, jnp.asarray(block_ids, jnp.int32), jnp.int32(n),
             jnp.int32(slot), n_blocks=needed)
         return True
@@ -698,10 +703,10 @@ class JaxLLMEngine(LLMEngine):
         s_pad = (-(-n // chunk) * chunk if chunked
                  else next(b for b in self.config.buckets() if b >= n))
         needed = self._blocks.blocks_needed(max(n + 1, s_pad))
-        if needed > min(self._blocks.total_blocks, self._blocks.max_blocks):
+        if needed > self._blocks.max_fit(slot):
             self._fail_request(req, n)
             return None
-        if not self._blocks.can_allocate(needed):
+        if not self._blocks.can_allocate_for(slot, needed):
             self._waiting.put(req)  # stays pending; retried next cycle
             return None
         k, v, last_logits = self._prefill_kv_tensors(prompt)
@@ -713,13 +718,11 @@ class JaxLLMEngine(LLMEngine):
         # publish this prompt's full blocks for future prefix hits (chunked
         # long prompts seed the cache for their shorter siblings too)
         self._blocks.register_blocks(slot, prompt,
-                                     self._blocks.owned[slot], skip_blocks=0)
+                                     self._blocks.owned_for(slot), skip_blocks=0)
         return self._sample_one(last_logits, req.params)
 
     def _prefill_with_prefix(self, req: _Request, slot: int, prompt: List[int],
                              cached_ids: List[int]) -> Optional[int]:
-        from . import paged
-
         cfg, c = self.model_config, self.config
         n = len(prompt)
         cached_tokens = len(cached_ids) * c.kv_block_size
@@ -728,11 +731,11 @@ class JaxLLMEngine(LLMEngine):
         needed_new = self._blocks.blocks_needed(
             max(n + 1 - cached_tokens, s_pad))
         total_blocks = len(cached_ids) + needed_new
-        if total_blocks > min(self._blocks.total_blocks, self._blocks.max_blocks):
+        if total_blocks > self._blocks.max_fit(slot):
             self._blocks.release(slot)  # undo the attached prefix refs
             self._fail_request(req, n)
             return None
-        if not self._blocks.can_allocate(needed_new):
+        if not self._blocks.can_allocate_for(slot, needed_new):
             self._blocks.release(slot)
             self._waiting.put(req)
             return None
@@ -741,10 +744,10 @@ class JaxLLMEngine(LLMEngine):
         # fused gather+suffix: ONE device dispatch (the split version paid an
         # extra host->device round trip per warm request — more than the
         # prefill compute the cache saves, through a network tunnel)
-        k_suf, v_suf, last_logits = paged.prefill_suffix_from_state(
+        k_suf, v_suf, last_logits = self._pops.prefill_suffix_from_state(
             self.params, self.state, jnp.asarray(cached_ids, jnp.int32),
-            jnp.asarray(tokens), jnp.int32(len(suffix)), cfg,
-            n_blocks=len(cached_ids))
+            jnp.asarray(tokens), jnp.int32(len(suffix)),
+            n_blocks=len(cached_ids), slot=slot)
         new_ids = self._blocks.allocate(slot, needed_new)
         pad_blocks = s_pad // c.kv_block_size
         if pad_blocks < needed_new:
@@ -753,12 +756,12 @@ class JaxLLMEngine(LLMEngine):
             v_suf = jnp.pad(v_suf, ((0, 0), (0, 0), (0, extra), (0, 0), (0, 0)))
         row = np.zeros((self._blocks.max_blocks,), np.int32)
         row[: total_blocks] = cached_ids + new_ids
-        self.state = paged.install_with_prefix(
+        self.state = self._pops.install_with_prefix(
             self.state, k_suf, v_suf, jnp.asarray(new_ids, jnp.int32),
             jnp.asarray(row), jnp.int32(n), jnp.int32(slot), n_new=needed_new)
         self._blocks.register_blocks(slot, prompt, cached_ids + new_ids,
                                      skip_blocks=len(cached_ids))
-        self._blocks.hit_tokens += cached_tokens  # counted only on success
+        self._blocks.add_hit_tokens(slot, cached_tokens)  # counted only on success
         return self._sample_one(last_logits, req.params)
 
     def _admit_paged_kv(self, req: _Request, slot: int, k, v) -> bool:
@@ -771,11 +774,11 @@ class JaxLLMEngine(LLMEngine):
     def _grow_or_preempt(self, headroom: int = 1) -> None:
         """Before a decode step: every active slot whose next write crosses into
         an unallocated block gets one; when the pool is dry, preempt the
-        YOUNGEST request (recompute preemption: blocks freed, request re-queued
-        and later re-prefilled from its token history). headroom > 1 reserves
-        room for a fused K-step burst, whose block tables are frozen."""
-        from . import paged
-
+        YOUNGEST request in the SAME pool partition (recompute preemption:
+        blocks freed, request re-queued and later re-prefilled from its token
+        history; with dp>1 only the slot's own replica pool can relieve it).
+        headroom > 1 reserves room for a fused K-step burst, whose block
+        tables are frozen."""
         for slot in list(self._active):
             req = self._active[slot]
             if req is None:
@@ -792,14 +795,15 @@ class JaxLLMEngine(LLMEngine):
             target = min(next_write + headroom, self.config.max_model_len)
             while (self._active[slot] is req
                    and target - 1 >= self._blocks.slot_capacity(slot)):
-                if self._blocks.num_free > 0:
+                if self._blocks.num_free_for(slot) > 0:
                     (bid,) = self._blocks.allocate(slot, 1)
                     index = self._blocks.slot_capacity(slot) // self.config.kv_block_size - 1
-                    self.state = paged.append_block(
+                    self.state = self._pops.append_block(
                         self.state, jnp.int32(slot), jnp.int32(index), jnp.int32(bid))
                     continue
                 victim = max(
-                    (r for r in self._active.values() if r is not None),
+                    (r for r in self._active.values()
+                     if r is not None and self._blocks.same_pool(r.slot, slot)),
                     key=lambda r: r.admitted_at)
                 self._preempt(victim)
                 if victim is req:
@@ -917,11 +921,18 @@ class JaxLLMEngine(LLMEngine):
             hist[slot, :len(ctx)] = ctx
             hlen[slot] = len(ctx)
         rngs = jnp.stack([self._next_rng() for _ in range(m)])
-        self.state, toks_m, acc_m, drafted_m = model_runner.spec_multi(
-            self.params, self.state, jnp.asarray(hist), jnp.asarray(hlen),
-            jnp.asarray(active_mask), cfg, rngs,
-            jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._top_k), m, k, c.ngram_prompt_lookup_max)
+        if c.kv_layout == "paged":
+            self.state, toks_m, acc_m, drafted_m = self._pops.spec_multi(
+                self.params, self.state, jnp.asarray(hist), jnp.asarray(hlen),
+                jnp.asarray(active_mask), rngs,
+                jnp.asarray(self._temp), jnp.asarray(self._top_p),
+                jnp.asarray(self._top_k), m, k, c.ngram_prompt_lookup_max)
+        else:
+            self.state, toks_m, acc_m, drafted_m = model_runner.spec_multi(
+                self.params, self.state, jnp.asarray(hist), jnp.asarray(hlen),
+                jnp.asarray(active_mask), cfg, rngs,
+                jnp.asarray(self._temp), jnp.asarray(self._top_p),
+                jnp.asarray(self._top_k), m, k, c.ngram_prompt_lookup_max)
         toks_m, acc_m, drafted_m = jax.device_get((toks_m, acc_m, drafted_m))
         burst_reqs = {s: r for s, r in self._active.items() if r is not None}
         for step in range(m):
@@ -963,8 +974,12 @@ class JaxLLMEngine(LLMEngine):
         all emit this step (greedy slots only; others ride along with k=0)."""
         cfg = self.model_config
         c = self.config
-        if c.num_decode_steps > 1 and c.kv_layout == "slot":
+        if c.num_decode_steps > 1:
             m = self._spec_burst_width()
+            if m > 1 and c.kv_layout == "paged":
+                # every window position of the burst must land in an owned block
+                self._grow_or_preempt(headroom=m * (c.num_speculative_tokens + 1))
+                m = min(m, self._spec_burst_width())  # preemption changed the set
             if m > 1:
                 self._step_decode_spec_fused(m)
                 return
@@ -992,19 +1007,20 @@ class JaxLLMEngine(LLMEngine):
             draft_len[slot] = len(drafts)
             if drafts:
                 window[slot, 1:1 + len(drafts)] = drafts
-        if c.kv_layout == "paged":
-            from . import paged
-
-            verify = paged.spec_verify_step_paged
-        else:
-            verify = model_runner.spec_verify_step
         if not active_mask.any():
             return  # pool-exhaustion preemption may have drained every slot
-        self.state, out_toks, n_acc = verify(
-            self.params, self.state, jnp.asarray(window), jnp.asarray(draft_len),
-            jnp.asarray(active_mask), cfg, self._next_rng(),
-            jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._top_k))
+        if c.kv_layout == "paged":
+            self.state, out_toks, n_acc = self._pops.spec_verify(
+                self.params, self.state, jnp.asarray(window),
+                jnp.asarray(draft_len), jnp.asarray(active_mask),
+                self._next_rng(), jnp.asarray(self._temp),
+                jnp.asarray(self._top_p), jnp.asarray(self._top_k))
+        else:
+            self.state, out_toks, n_acc = model_runner.spec_verify_step(
+                self.params, self.state, jnp.asarray(window),
+                jnp.asarray(draft_len), jnp.asarray(active_mask), cfg,
+                self._next_rng(), jnp.asarray(self._temp),
+                jnp.asarray(self._top_p), jnp.asarray(self._top_k))
         out_toks, n_acc = jax.device_get((out_toks, n_acc))
         burst_reqs = {s: r for s, r in self._active.items() if r is not None}
         for slot, req in burst_reqs.items():
@@ -1038,8 +1054,6 @@ class JaxLLMEngine(LLMEngine):
             return
         k_steps = self._burst_width()
         if self.config.kv_layout == "paged":
-            from . import paged
-
             self._grow_or_preempt(headroom=k_steps)
             k_steps = min(k_steps, self._burst_width())  # preemption changed the set
         active_mask = np.array([r is not None for r in self._active.values()], bool)
@@ -1051,19 +1065,24 @@ class JaxLLMEngine(LLMEngine):
             # fused burst: K decode+sample iterations, ONE host sync
             # (vLLM multi-step scheduling; decisive over a network tunnel)
             rngs = jnp.stack([self._next_rng() for _ in range(k_steps)])
-            fused = (paged.decode_multi_paged if self.config.kv_layout == "paged"
-                     else model_runner.decode_multi)
-            self.state, toks_k = fused(
-                self.params, self.state, jnp.asarray(self._last_tokens),
-                jnp.asarray(active_mask), cfg, rngs,
-                jnp.asarray(self._temp), jnp.asarray(self._top_p),
-                jnp.asarray(self._top_k))
+            if self.config.kv_layout == "paged":
+                self.state, toks_k = self._pops.decode_multi(
+                    self.params, self.state, jnp.asarray(self._last_tokens),
+                    jnp.asarray(active_mask), rngs,
+                    jnp.asarray(self._temp), jnp.asarray(self._top_p),
+                    jnp.asarray(self._top_k))
+            else:
+                self.state, toks_k = model_runner.decode_multi(
+                    self.params, self.state, jnp.asarray(self._last_tokens),
+                    jnp.asarray(active_mask), cfg, rngs,
+                    jnp.asarray(self._temp), jnp.asarray(self._top_p),
+                    jnp.asarray(self._top_k))
             toks_burst = np.asarray(toks_k)  # [K, slots] — the only fetch
         else:
             if self.config.kv_layout == "paged":
-                self.state, logits = paged.decode_step_paged(
+                self.state, logits = self._pops.decode_step(
                     self.params, self.state, jnp.asarray(self._last_tokens),
-                    jnp.asarray(active_mask), cfg,
+                    jnp.asarray(active_mask),
                 )
             elif self.config.pipeline_parallel_size > 1:
                 self.state, logits = self._decode_pp_jit(

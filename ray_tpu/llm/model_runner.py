@@ -234,7 +234,7 @@ def decode_step(
     return DecodeState(k=nk, v=nv, lengths=lengths), logits.astype(jnp.float32)
 
 
-def _verify_core(x, lp, cfg: ModelConfig, lengths, cache_rw):
+def _verify_core(x, lp, cfg: ModelConfig, lengths, cache_rw, active=None):
     """One layer over a W-token verify window for every slot (speculative
     decoding), shared by every cache layout: x [S,W,D], K/V written at
     positions lengths[s]+0..W-1 through the layout adapter, each query w
@@ -242,6 +242,8 @@ def _verify_core(x, lp, cfg: ModelConfig, lengths, cache_rw):
     full history before it).
 
     cache_rw(k_new [S,W,KV,HD], v_new) -> (ck [S,max_len,KV,HD], cv, storage).
+    active [S] bool (MoE only): inactive slots' window tokens must not claim
+    expert capacity.
     """
     dt = x.dtype
     s, wlen, _ = x.shape
@@ -270,13 +272,23 @@ def _verify_core(x, lp, cfg: ModelConfig, lengths, cache_rw):
     x = x + jnp.einsum("slhk,hkd->sld", o, _qw(lp["wo"], dt))
 
     h = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("sld,df->slf", h, _qw(lp["w_gate"], dt))
-    up = jnp.einsum("sld,df->slf", h, _qw(lp["w_up"], dt))
-    down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, _qw(lp["w_down"], dt))
+    if cfg.n_experts > 0:
+        from ray_tpu.models import moe as _moe
+
+        tok_mask = None
+        if active is not None:
+            tok_mask = jnp.repeat(active.astype(jnp.float32), wlen)
+        y2, _ = _moe.moe_mlp(h.reshape(s * wlen, -1), lp["router"], lp["w_gate"],
+                             lp["w_up"], lp["w_down"], cfg, mask=tok_mask)
+        down = y2.reshape(s, wlen, -1)
+    else:
+        gate = jnp.einsum("sld,df->slf", h, _qw(lp["w_gate"], dt))
+        up = jnp.einsum("sld,df->slf", h, _qw(lp["w_up"], dt))
+        down = jnp.einsum("slf,fd->sld", jax.nn.silu(gate) * up, _qw(lp["w_down"], dt))
     return x + down, storage
 
 
-def _verify_block(x, lp, cfg: ModelConfig, ck, cv, lengths):
+def _verify_block(x, lp, cfg: ModelConfig, ck, cv, lengths, active=None):
     """Slot-layout verify: K/V scattered at absolute positions (writes past
     max_len dropped)."""
     pos = lengths[:, None] + jnp.arange(x.shape[1])[None, :]
@@ -287,7 +299,7 @@ def _verify_block(x, lp, cfg: ModelConfig, ck, cv, lengths):
         nv = cv.at[rows, pos].set(v_new.astype(cv.dtype), mode="drop")
         return nk, nv, (nk, nv)
 
-    x, (nk, nv) = _verify_core(x, lp, cfg, lengths, cache_rw)
+    x, (nk, nv) = _verify_core(x, lp, cfg, lengths, cache_rw, active=active)
     return x, nk, nv
 
 
@@ -309,9 +321,9 @@ def spec_accept(window, greedy, draft_len, active, lengths, rng, temperature,
 def spec_driver(params, k0, v0, lengths, window, draft_len, active, cfg,
                 rng, temperature, top_p, top_k, layer_fn):
     """Shared speculative-verify pipeline (embed -> layers -> norm -> head ->
-    accept); the cache layout differs only in layer_fn(h, lp, k, v)."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError("speculative decoding: dense models only")
+    accept); the cache layout differs only in layer_fn(h, lp, k, v). MoE models
+    verify too: _verify_core routes the whole window through moe_mlp with
+    inactive slots masked out of expert capacity."""
     x = params["embed"].astype(cfg.activation_dtype)[window]
 
     if cfg.scan_layers:
@@ -364,7 +376,8 @@ def spec_verify_step(
     nk, nv, lengths, greedy, n_acc = spec_driver(
         params, state.k, state.v, state.lengths, window, draft_len, active,
         cfg, rng, temperature, top_p, top_k,
-        lambda h, lp, ck, cv: _verify_block(h, lp, cfg, ck, cv, state.lengths))
+        lambda h, lp, ck, cv: _verify_block(h, lp, cfg, ck, cv, state.lengths,
+                                            active=active))
     return DecodeState(k=nk, v=nv, lengths=lengths), greedy, n_acc
 
 
@@ -409,6 +422,42 @@ def propose_ngram_device(hist: jax.Array, hlen: jax.Array, last: jax.Array,
     return window, draft_len
 
 
+def spec_multi_impl(params, state, hist, hlen, active, cfg, rngs, temperature,
+                    top_p, top_k, m, k, nmax, proposer, layer_fn_for,
+                    advance_state):
+    """Layout-generic fused speculation: m propose->verify->accept windows
+    chained in one lax.scan. The cache layout differs only in
+    layer_fn_for(state) (the verify layer adapter) and
+    advance_state(state, nk, nv, lengths) (how the storage threads forward)."""
+
+    def body(carry, rng):
+        st, h, hl, last = carry
+        window, draft_len = proposer(h, hl, last, k, nmax)
+        draft_len = jnp.where(temperature > 0, 0, draft_len)
+        nk, nv, lengths, greedy, n_acc = spec_driver(
+            params, st.k, st.v, st.lengths, window, draft_len, active,
+            cfg, rng, temperature, top_p, top_k, layer_fn_for(st))
+        st = advance_state(st, nk, nv, lengths)
+        adv = jnp.where(active, n_acc + 1, 0)
+        rows = jnp.arange(h.shape[0])
+        for t in range(k + 1):  # static: scatter this window's emitted tokens
+            pos = jnp.clip(hl + t, 0, h.shape[1] - 1)
+            h = h.at[rows, pos].set(
+                jnp.where(t < adv, greedy[:, t], h[rows, pos]))
+        new_last = jnp.where(
+            adv > 0,
+            jnp.take_along_axis(
+                greedy, jnp.maximum(adv - 1, 0)[:, None], axis=1)[:, 0],
+            last)
+        return (st, h, hl + adv, new_last), (greedy, n_acc, draft_len)
+
+    last = jnp.take_along_axis(
+        hist, jnp.maximum(hlen - 1, 0)[:, None], axis=1)[:, 0]
+    (state, _, _, _), (toks_m, acc_m, drafted_m) = jax.lax.scan(
+        body, (state, hist, hlen, last), rngs)
+    return state, toks_m, acc_m, drafted_m
+
+
 @functools.partial(
     jax.jit, static_argnames=("cfg", "m", "k", "nmax", "propose_fn"),
     donate_argnames=("state",))
@@ -435,35 +484,12 @@ def spec_multi(
     temperature>0 slots ride along sampling one token per window.
 
     Returns (state, toks_m [m,S,k+1], acc_m [m,S], drafted_m [m,S])."""
-    proposer = propose_fn or propose_ngram_device
-
-    def body(carry, rng):
-        st, h, hl, last = carry
-        window, draft_len = proposer(h, hl, last, k, nmax)
-        draft_len = jnp.where(temperature > 0, 0, draft_len)
-        nk, nv, lengths, greedy, n_acc = spec_driver(
-            params, st.k, st.v, st.lengths, window, draft_len, active,
-            cfg, rng, temperature, top_p, top_k,
-            lambda x, lp, ck, cv: _verify_block(x, lp, cfg, ck, cv, st.lengths))
-        st = DecodeState(k=nk, v=nv, lengths=lengths)
-        adv = jnp.where(active, n_acc + 1, 0)
-        rows = jnp.arange(h.shape[0])
-        for t in range(k + 1):  # static: scatter this window's emitted tokens
-            pos = jnp.clip(hl + t, 0, h.shape[1] - 1)
-            h = h.at[rows, pos].set(
-                jnp.where(t < adv, greedy[:, t], h[rows, pos]))
-        new_last = jnp.where(
-            adv > 0,
-            jnp.take_along_axis(
-                greedy, jnp.maximum(adv - 1, 0)[:, None], axis=1)[:, 0],
-            last)
-        return (st, h, hl + adv, new_last), (greedy, n_acc, draft_len)
-
-    last = jnp.take_along_axis(
-        hist, jnp.maximum(hlen - 1, 0)[:, None], axis=1)[:, 0]
-    (state, _, _, _), (toks_m, acc_m, drafted_m) = jax.lax.scan(
-        body, (state, hist, hlen, last), rngs)
-    return state, toks_m, acc_m, drafted_m
+    return spec_multi_impl(
+        params, state, hist, hlen, active, cfg, rngs, temperature, top_p,
+        top_k, m, k, nmax, propose_fn or propose_ngram_device,
+        lambda st: lambda x, lp, ck, cv: _verify_block(
+            x, lp, cfg, ck, cv, st.lengths, active=active),
+        lambda st, nk, nv, lengths: DecodeState(k=nk, v=nv, lengths=lengths))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))

@@ -48,25 +48,52 @@ class PagedState(NamedTuple):
 
 
 POOL_SPEC = P(None, None, None, "tp", None)
+# dp>1: the BLOCK axis shards over dp — each replica owns an independent pool
+# partition (plus its own scratch block) and its slots' tables hold replica-
+# LOCAL block ids; tables/lengths shard over dp on the slot axis.
+POOL_SPEC_DP = P(None, "dp", None, "tp", None)
+TABLE_SPEC_DP = P("dp", None)
+LENGTHS_SPEC_DP = P("dp")
+
+
+def _dp_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get("dp", 1))
 
 
 def init_paged_state(cfg: ModelConfig, slots: int, max_len: int, num_blocks: int,
                      block_size: int, mesh: Optional[Mesh] = None) -> PagedState:
-    """The pool gets ONE extra physical block (index num_blocks): inactive slots'
-    decode writes are redirected there — their block-table entries may reference
-    blocks already released and re-owned by other requests."""
+    """Each pool (partition) gets ONE extra physical block (its last index):
+    inactive slots' decode writes are redirected there — their block-table
+    entries may reference blocks already released and re-owned by other
+    requests. With dp>1 `num_blocks` is the TOTAL across replicas; each replica
+    owns num_blocks/dp blocks + a scratch, and the block axis shards over dp
+    (vLLM analogue: one independent KV pool per dp engine replica)."""
+    dp = _dp_size(mesh)
     max_blocks = max_len // block_size
-    shape = (cfg.n_layers, num_blocks + 1, block_size, cfg.n_kv_heads, cfg.head_dim)
+    if dp > 1:
+        if num_blocks % dp or slots % dp:
+            raise ValueError(
+                f"num_blocks ({num_blocks}) and slots ({slots}) must divide by "
+                f"data_parallel_size ({dp})")
+        n_block_axis = num_blocks + dp  # one scratch per replica partition
+    else:
+        n_block_axis = num_blocks + 1
+    shape = (cfg.n_layers, n_block_axis, block_size, cfg.n_kv_heads, cfg.head_dim)
     dtype = cfg.activation_dtype
     k = jnp.zeros(shape, dtype)
     v = jnp.zeros(shape, dtype)
     bt = jnp.zeros((slots, max_blocks), jnp.int32)
     lengths = jnp.zeros((slots,), jnp.int32)
     if mesh is not None:
-        k = jax.device_put(k, NamedSharding(mesh, POOL_SPEC))
-        v = jax.device_put(v, NamedSharding(mesh, POOL_SPEC))
-        bt = jax.device_put(bt, NamedSharding(mesh, P()))
-        lengths = jax.device_put(lengths, NamedSharding(mesh, P()))
+        pool_spec = POOL_SPEC_DP if dp > 1 else POOL_SPEC
+        k = jax.device_put(k, NamedSharding(mesh, pool_spec))
+        v = jax.device_put(v, NamedSharding(mesh, pool_spec))
+        bt = jax.device_put(bt, NamedSharding(
+            mesh, TABLE_SPEC_DP if dp > 1 else P()))
+        lengths = jax.device_put(lengths, NamedSharding(
+            mesh, LENGTHS_SPEC_DP if dp > 1 else P()))
     return PagedState(k=k, v=v, block_tables=bt, lengths=lengths)
 
 
@@ -198,6 +225,140 @@ class _BlockManager:
 
     def slot_capacity(self, slot: int) -> int:
         return len(self.owned[slot]) * self.block_size
+
+    # slot-aware forms (trivial here; _ShardedBlockManager scopes them to the
+    # slot's replica pool) — engine call sites use ONLY these where pool
+    # locality matters, so dp>1 composes without engine-side branching
+    def can_allocate_for(self, slot: int, n: int) -> bool:
+        return self.can_allocate(n)
+
+    def num_free_for(self, slot: int) -> int:
+        return self.num_free
+
+    def max_fit(self, slot: int) -> int:
+        """Largest block count a request in this slot could ever hold."""
+        return min(self.total_blocks, self.max_blocks)
+
+    def same_pool(self, slot_a: int, slot_b: int) -> bool:
+        return True
+
+    def owned_for(self, slot: int):
+        return self.owned[slot]
+
+    def add_hit_tokens(self, slot: int, n: int) -> None:
+        self.hit_tokens += n
+
+
+class _ShardedBlockManager:
+    """dp independent per-replica block pools (reference capability: one vLLM
+    engine replica per dp rank, each with its own KV pool — here one host-side
+    manager per replica partition inside the single engine). Slot s maps to
+    replica s // slots_per; handed-out block ids are replica-LOCAL (the device
+    tables are read inside the per-replica shard_map body). The prefix cache is
+    per-replica too: a cached block can only serve slots whose tables can
+    reference its pool partition."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 max_blocks_per_slot: int, slots: int, dp: int,
+                 enable_prefix_caching: bool = True):
+        assert num_blocks % dp == 0 and slots % dp == 0
+        self.dp = dp
+        self.block_size = block_size
+        self.max_blocks = max_blocks_per_slot
+        self.slots_per = slots // dp
+        self.per_replica_blocks = num_blocks // dp
+        self.subs = [
+            _BlockManager(num_blocks // dp, block_size, max_blocks_per_slot,
+                          self.slots_per, enable_prefix_caching)
+            for _ in range(dp)
+        ]
+
+    def _sub(self, slot: int):
+        return self.subs[slot // self.slots_per], slot % self.slots_per
+
+    # -- aggregates (metrics / config introspection) --
+    @property
+    def total_blocks(self) -> int:
+        return sum(s.total_blocks for s in self.subs)
+
+    @property
+    def num_free(self) -> int:
+        return sum(s.num_free for s in self.subs)
+
+    @property
+    def hit_tokens(self) -> int:
+        return sum(s.hit_tokens for s in self.subs)
+
+    @hit_tokens.setter
+    def hit_tokens(self, value: int) -> None:
+        # engine increments on prefix hits; attribute the delta to replica 0's
+        # counter is wrong — engine uses add_hit_tokens instead. Setter kept
+        # only for symmetry with reads; reject silent use.
+        raise AttributeError("use add_hit_tokens(slot, n)")
+
+    def add_hit_tokens(self, slot: int, n: int) -> None:
+        sub, _ = self._sub(slot)
+        sub.hit_tokens += n
+
+    @property
+    def cached(self):
+        out = {}
+        for r, s in enumerate(self.subs):
+            for key, bid in s.cached.items():
+                out[(r, key)] = bid
+        return out
+
+    # -- slot-scoped API --
+    def blocks_needed(self, tokens: int) -> int:
+        return -(-tokens // self.block_size)
+
+    def can_allocate_for(self, slot: int, n: int) -> bool:
+        sub, _ = self._sub(slot)
+        return sub.can_allocate(n)
+
+    def num_free_for(self, slot: int) -> int:
+        sub, _ = self._sub(slot)
+        return sub.num_free
+
+    def max_fit(self, slot: int) -> int:
+        return min(self.per_replica_blocks, self.max_blocks)
+
+    def same_pool(self, slot_a: int, slot_b: int) -> bool:
+        return slot_a // self.slots_per == slot_b // self.slots_per
+
+    def allocate(self, slot: int, n: int):
+        sub, local = self._sub(slot)
+        return sub.allocate(local, n)
+
+    def release(self, slot: int) -> None:
+        sub, local = self._sub(slot)
+        sub.release(local)
+
+    def match_prefix(self, slot: int, prompt):
+        sub, local = self._sub(slot)
+        return sub.match_prefix(local, prompt)
+
+    def register_blocks(self, slot: int, prompt, block_ids, skip_blocks) -> None:
+        sub, local = self._sub(slot)
+        sub.register_blocks(local, prompt, block_ids, skip_blocks)
+
+    def slot_capacity(self, slot: int) -> int:
+        sub, local = self._sub(slot)
+        return sub.slot_capacity(local)
+
+    def owned_for(self, slot: int):
+        sub, local = self._sub(slot)
+        return sub.owned[local]
+
+
+def make_block_manager(num_blocks: int, block_size: int,
+                       max_blocks_per_slot: int, slots: int, dp: int = 1,
+                       enable_prefix_caching: bool = True):
+    if dp > 1:
+        return _ShardedBlockManager(num_blocks, block_size, max_blocks_per_slot,
+                                    slots, dp, enable_prefix_caching)
+    return _BlockManager(num_blocks, block_size, max_blocks_per_slot, slots,
+                         enable_prefix_caching)
 
 
 # ----------------------------------------------------------------- prefill install
@@ -344,6 +505,38 @@ def _decode_block_paged(x, lp, cfg: ModelConfig, pk, pv, block_tables, lengths,
     return x, nk, nv
 
 
+def _decode_step_impl(params, k, v, block_tables, lengths, tokens, active,
+                      cfg: ModelConfig):
+    """One decode step against ONE pool (the whole pool, or — inside the dp
+    shard_map — one replica's local shard). Raw arrays in/out so the same math
+    serves the single-pool jit and the per-replica body."""
+    x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]
+
+    if cfg.scan_layers:
+        def body(carry, xs):
+            h = carry
+            lp, pk, pv = xs
+            h, pk, pv = _decode_block_paged(h, lp, cfg, pk, pv,
+                                            block_tables, lengths, active)
+            return h, (pk, pv)
+
+        x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], k, v))
+    else:
+        nk, nv = [], []
+        for i, lp in enumerate(params["layers"]):
+            x, pk, pv = _decode_block_paged(x, lp, cfg, k[i], v[i],
+                                            block_tables, lengths, active)
+            nk.append(pk)
+            nv.append(pv)
+        nk, nv = jnp.stack(nk), jnp.stack(nv)
+
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("sld,dv->slv", x, _qw(head, cfg.activation_dtype))[:, 0]
+    new_lengths = jnp.where(active, lengths + 1, lengths)
+    return nk, nv, new_lengths, logits.astype(jnp.float32)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
 def decode_step_paged(
     params,
@@ -353,32 +546,11 @@ def decode_step_paged(
     cfg: ModelConfig,
 ) -> Tuple[PagedState, jax.Array]:
     """One decode step for every slot against the paged pool."""
-    x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]
-
-    if cfg.scan_layers:
-        def body(carry, xs):
-            h = carry
-            lp, pk, pv = xs
-            h, pk, pv = _decode_block_paged(h, lp, cfg, pk, pv,
-                                            state.block_tables, state.lengths, active)
-            return h, (pk, pv)
-
-        x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], state.k, state.v))
-    else:
-        nk, nv = [], []
-        for i, lp in enumerate(params["layers"]):
-            x, pk, pv = _decode_block_paged(x, lp, cfg, state.k[i], state.v[i],
-                                            state.block_tables, state.lengths, active)
-            nk.append(pk)
-            nv.append(pv)
-        nk, nv = jnp.stack(nk), jnp.stack(nv)
-
-    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("sld,dv->slv", x, _qw(head, cfg.activation_dtype))[:, 0]
-    lengths = jnp.where(active, state.lengths + 1, state.lengths)
+    nk, nv, lengths, logits = _decode_step_impl(
+        params, state.k, state.v, state.block_tables, state.lengths,
+        tokens, active, cfg)
     return PagedState(k=nk, v=nv, block_tables=state.block_tables,
-                      lengths=lengths), logits.astype(jnp.float32)
+                      lengths=lengths), logits
 
 
 def _verify_block_paged(x, lp, cfg: ModelConfig, pk, pv, block_tables, lengths,
@@ -411,7 +583,7 @@ def _verify_block_paged(x, lp, cfg: ModelConfig, pk, pv, block_tables, lengths,
         cv = nv[block_tables].reshape(s, max_len, kvh, hd)
         return ck, cv, (nk, nv)
 
-    x, (nk, nv) = _verify_core(x, lp, cfg, lengths, cache_rw)
+    x, (nk, nv) = _verify_core(x, lp, cfg, lengths, cache_rw, active=active)
     return x, nk, nv
 
 
@@ -441,6 +613,42 @@ def spec_verify_step_paged(
                       lengths=lengths), greedy, n_acc
 
 
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "m", "k", "nmax", "propose_fn"),
+    donate_argnames=("state",))
+def spec_multi_paged(
+    params,
+    state: PagedState,
+    hist: jax.Array,  # [S, width] int32 — prompt + emitted tokens per slot
+    hlen: jax.Array,  # [S] int32
+    active: jax.Array,  # [S] bool — FIXED for the whole burst
+    cfg: ModelConfig,
+    rngs: jax.Array,  # [m] stacked PRNG keys
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+    m: int,
+    k: int,
+    nmax: int,
+    propose_fn=None,
+):
+    """m fused speculative windows against the PAGED pool (spec x multi-step x
+    paged composed): same propose->verify->accept scan as model_runner.spec_multi
+    with block-table writes. Callers pre-grow every active slot's table by
+    m*(k+1) tokens — block_tables are frozen across the burst; window positions
+    past a slot's table land in the scratch block (never read back, because
+    lengths only advance over accepted tokens that DO have table entries)."""
+    from .model_runner import propose_ngram_device, spec_multi_impl
+
+    return spec_multi_impl(
+        params, state, hist, hlen, active, cfg, rngs, temperature, top_p,
+        top_k, m, k, nmax, propose_fn or propose_ngram_device,
+        lambda st: lambda x, lp, pk, pv: _verify_block_paged(
+            x, lp, cfg, pk, pv, st.block_tables, st.lengths, active),
+        lambda st, nk, nv, lengths: PagedState(
+            k=nk, v=nv, block_tables=st.block_tables, lengths=lengths))
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
 def decode_multi_paged(
     params,
@@ -465,6 +673,345 @@ def decode_multi_paged(
 
     (state, _), toks_k = jax.lax.scan(body, (state, tokens.astype(jnp.int32)), rngs)
     return state, toks_k
+
+
+# ------------------------------------------------- data-parallel (dp) composition
+#
+# kv_layout="paged" with data_parallel_size > 1 (the vLLM capability of one KV
+# pool per dp engine replica, here inside ONE SPMD program): every paged device
+# op runs under a shard_map whose manual axis is "dp" — each replica owns an
+# independent pool partition + scratch block, its slots' tables hold replica-
+# LOCAL block ids, and decode/verify touch no cross-replica data at all (tp
+# stays a GSPMD auto axis inside the body). Slot-targeted ops (installs, table
+# appends) are replica-masked: non-owners redirect their writes to their own
+# scratch block, so nothing is ever selected over the full pool.
+
+POOL_DP = P(None, "dp", None, None, None)  # manual-axis view of POOL_SPEC_DP
+TABLE_DP = P("dp", None)
+VEC_DP = P("dp")
+
+
+def _rep_specs(tree):
+    """Replicated-in-dp specs for a params pytree (tp shardings stay auto)."""
+    return jax.tree.map(lambda _: P(), tree)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"),
+                   donate_argnames=("state",))
+def decode_step_paged_dp(params, state: PagedState, tokens, active,
+                         cfg: ModelConfig, mesh: Mesh):
+    from ray_tpu.parallel.sharding import manual_axes
+
+    def body(p, k, v, bt, ln, toks, act):
+        return _decode_step_impl(p, k, v, bt, ln, toks, act, cfg)
+
+    with manual_axes("dp"):
+        nk, nv, lengths, logits = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(_rep_specs(params), POOL_DP, POOL_DP, TABLE_DP, VEC_DP,
+                      VEC_DP, VEC_DP),
+            out_specs=(POOL_DP, POOL_DP, VEC_DP, P("dp", None)),
+            axis_names={"dp"},
+        )(params, state.k, state.v, state.block_tables, state.lengths,
+          tokens, active)
+    return PagedState(k=nk, v=nv, block_tables=state.block_tables,
+                      lengths=lengths), logits
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"),
+                   donate_argnames=("state",))
+def decode_multi_paged_dp(params, state: PagedState, tokens, active,
+                          cfg: ModelConfig, rngs, temperature, top_p, top_k,
+                          mesh: Mesh):
+    from ray_tpu.parallel.sharding import manual_axes
+
+    def body(p, k, v, bt, ln, toks, act, rr, tt, tp_, tk):
+        # distinct sampling streams per replica
+        rr = jax.vmap(lambda r: jax.random.fold_in(r, jax.lax.axis_index("dp")))(rr)
+
+        def step(carry, rng):
+            kk, vv, lln, t = carry
+            kk, vv, lln, logits = _decode_step_impl(p, kk, vv, bt, lln, t, act, cfg)
+            nxt = sampling.sample(rng, logits, tt, tp_, tk)
+            nxt = jnp.where(act, nxt, t).astype(jnp.int32)
+            return (kk, vv, lln, nxt), nxt
+
+        (kk, vv, lln, _), toks_k = jax.lax.scan(
+            step, (k, v, ln, toks.astype(jnp.int32)), rr)
+        return kk, vv, lln, toks_k
+
+    with manual_axes("dp"):
+        nk, nv, lengths, toks_k = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(_rep_specs(params), POOL_DP, POOL_DP, TABLE_DP, VEC_DP,
+                      VEC_DP, VEC_DP, P(), VEC_DP, VEC_DP, VEC_DP),
+            out_specs=(POOL_DP, POOL_DP, VEC_DP, P(None, "dp")),
+            axis_names={"dp"},
+        )(params, state.k, state.v, state.block_tables, state.lengths,
+          tokens, active, rngs, temperature, top_p, top_k)
+    return PagedState(k=nk, v=nv, block_tables=state.block_tables,
+                      lengths=lengths), toks_k
+
+
+def _install_dp(state: PagedState, k, v, new_ids, table_row, true_len, slot,
+                n_new: int, mesh: Mesh, slots_per: int):
+    """Shared dp-sharded install: scatter n_new fresh KV blocks + set the
+    slot's table row and length — only on the OWNING replica's shard;
+    non-owners redirect the scatter into their own scratch block (cheap, never
+    read) so nothing is ever selected over the full pool."""
+    from ray_tpu.parallel.sharding import manual_axes
+
+    replica = slot // slots_per
+    local_slot = slot % slots_per
+
+    def body(pk, pv, bt, ln, kk, vv, ids, row):
+        mine = jax.lax.axis_index("dp") == replica
+        scratch = pk.shape[1] - 1
+        ids_eff = jnp.where(mine, ids, scratch)
+        L = pk.shape[0]
+        bs = pk.shape[2]
+        kb = kk[:, 0].reshape(L, n_new, bs, *kk.shape[3:]).astype(pk.dtype)
+        vb = vv[:, 0].reshape(L, n_new, bs, *vv.shape[3:]).astype(pv.dtype)
+        nk = pk.at[:, ids_eff].set(kb)
+        nv = pv.at[:, ids_eff].set(vb)
+        nbt = bt.at[local_slot].set(jnp.where(mine, row, bt[local_slot]))
+        nln = ln.at[local_slot].set(jnp.where(mine, true_len, ln[local_slot]))
+        return nk, nv, nbt, nln
+
+    with manual_axes("dp"):
+        nk, nv, bt, ln = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(POOL_DP, POOL_DP, TABLE_DP, VEC_DP, P(), P(), P(), P()),
+            out_specs=(POOL_DP, POOL_DP, TABLE_DP, VEC_DP),
+            axis_names={"dp"},
+        )(state.k, state.v, state.block_tables, state.lengths,
+          k, v, new_ids, table_row)
+    return PagedState(k=nk, v=nv, block_tables=bt, lengths=ln)
+
+
+@functools.partial(jax.jit, static_argnames=("n_blocks", "mesh", "slots_per"),
+                   donate_argnames=("state",))
+def install_prefill_dp(state: PagedState, k, v, block_ids, true_len, slot,
+                       n_blocks: int, mesh: Mesh, slots_per: int):
+    """install_prefill with the pool dp-sharded: the table row is just the
+    fresh block ids (whole-prompt install)."""
+    row = jnp.zeros((state.block_tables.shape[1],), jnp.int32)
+    row = jax.lax.dynamic_update_slice(row, block_ids, (0,))
+    return _install_dp(state, k, v, block_ids, row, true_len, slot,
+                       n_new=n_blocks, mesh=mesh, slots_per=slots_per)
+
+
+@functools.partial(jax.jit, static_argnames=("n_new", "mesh", "slots_per"),
+                   donate_argnames=("state",))
+def install_with_prefix_dp(state: PagedState, k_suf, v_suf, new_ids, table_row,
+                           true_len, slot, n_new: int, mesh: Mesh,
+                           slots_per: int):
+    """install_with_prefix with the pool dp-sharded: only the suffix KV
+    scatters (the cached-prefix blocks are already in the replica's pool); the
+    caller-built table row carries cached + new ids."""
+    return _install_dp(state, k_suf, v_suf, new_ids, table_row, true_len, slot,
+                       n_new=n_new, mesh=mesh, slots_per=slots_per)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "slots_per"),
+                   donate_argnames=("state",))
+def append_block_dp(state: PagedState, slot, index, block_id, mesh: Mesh,
+                    slots_per: int):
+    from ray_tpu.parallel.sharding import manual_axes
+
+    replica = slot // slots_per
+    local_slot = slot % slots_per
+
+    def body(bt):
+        mine = jax.lax.axis_index("dp") == replica
+        new = bt.at[local_slot, index].set(block_id)
+        return jnp.where(mine, new, bt)
+
+    with manual_axes("dp"):
+        bt = jax.shard_map(body, mesh=mesh, in_specs=(TABLE_DP,),
+                           out_specs=TABLE_DP, axis_names={"dp"},
+                           )(state.block_tables)
+    return state._replace(block_tables=bt)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_blocks", "mesh",
+                                             "slots_per"))
+def prefill_suffix_from_state_dp(params, state: PagedState, block_ids, tokens,
+                                 true_suffix_len, cfg: ModelConfig,
+                                 n_blocks: int, mesh: Mesh, slots_per: int,
+                                 slot=None):
+    """Prefix-cache warm path under dp: the owning replica gathers its cached
+    blocks (others contribute zeros), a psum replicates the context, and the
+    suffix prefill runs in auto mode — still ONE device dispatch."""
+    from ray_tpu.parallel.sharding import manual_axes
+
+    replica = slot // slots_per
+
+    def gather(pk, pv, ids):
+        mine = jax.lax.axis_index("dp") == replica
+        ids_eff = jnp.where(mine, ids, pk.shape[1] - 1)
+        kb = jnp.where(mine, pk[:, ids_eff], 0)
+        vb = jnp.where(mine, pv[:, ids_eff], 0)
+        return jax.lax.psum(kb, "dp"), jax.lax.psum(vb, "dp")
+
+    with manual_axes("dp"):
+        kb, vb = jax.shard_map(
+            gather, mesh=mesh, in_specs=(POOL_DP, POOL_DP, P()),
+            out_specs=(P(), P()), axis_names={"dp"},
+        )(state.k, state.v, block_ids)
+    L, _, bs = kb.shape[0], kb.shape[1], kb.shape[2]
+    shape = (L, 1, n_blocks * bs) + kb.shape[3:]
+    return _prefill_suffix_impl(params, kb.reshape(shape), vb.reshape(shape),
+                                tokens, true_suffix_len, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"),
+                   donate_argnames=("state",))
+def spec_verify_step_paged_dp(params, state: PagedState, window, draft_len,
+                              active, cfg: ModelConfig, rng, temperature,
+                              top_p, top_k, mesh: Mesh):
+    from ray_tpu.parallel.sharding import manual_axes
+
+    from .model_runner import spec_driver
+
+    def body(p, k, v, bt, ln, win, dl, act, rr, tt, tp_, tk):
+        rr = jax.random.fold_in(rr, jax.lax.axis_index("dp"))
+        nk, nv, lengths, greedy, n_acc = spec_driver(
+            p, k, v, ln, win, dl, act, cfg, rr, tt, tp_, tk,
+            lambda h, lp, pk, pv: _verify_block_paged(h, lp, cfg, pk, pv,
+                                                      bt, ln, act))
+        return nk, nv, lengths, greedy, n_acc
+
+    with manual_axes("dp"):
+        nk, nv, lengths, greedy, n_acc = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(_rep_specs(params), POOL_DP, POOL_DP, TABLE_DP, VEC_DP,
+                      TABLE_DP, VEC_DP, VEC_DP, P(), VEC_DP, VEC_DP, VEC_DP),
+            out_specs=(POOL_DP, POOL_DP, VEC_DP, TABLE_DP, VEC_DP),
+            axis_names={"dp"},
+        )(params, state.k, state.v, state.block_tables, state.lengths,
+          window, draft_len, active, rng, temperature, top_p, top_k)
+    return PagedState(k=nk, v=nv, block_tables=state.block_tables,
+                      lengths=lengths), greedy, n_acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "m", "k", "nmax", "mesh"),
+    donate_argnames=("state",))
+def spec_multi_paged_dp(params, state: PagedState, hist, hlen, active,
+                        cfg: ModelConfig, rngs, temperature, top_p, top_k,
+                        m: int, k: int, nmax: int, mesh: Mesh):
+    from ray_tpu.parallel.sharding import manual_axes
+
+    from .model_runner import propose_ngram_device, spec_multi_impl
+
+    def body(p, pk, pv, bt, ln, hh, hl, act, rr, tt, tp_, tk):
+        rr = jax.vmap(lambda r: jax.random.fold_in(r, jax.lax.axis_index("dp")))(rr)
+        st = PagedState(k=pk, v=pv, block_tables=bt, lengths=ln)
+        st, toks_m, acc_m, drafted_m = spec_multi_impl(
+            p, st, hh, hl, act, cfg, rr, tt, tp_, tk, m, k, nmax,
+            propose_ngram_device,
+            lambda s: lambda x, lp, kk, vv: _verify_block_paged(
+                x, lp, cfg, kk, vv, s.block_tables, s.lengths, act),
+            lambda s, nk, nv, lengths: PagedState(
+                k=nk, v=nv, block_tables=s.block_tables, lengths=lengths))
+        return st.k, st.v, st.lengths, toks_m, acc_m, drafted_m
+
+    with manual_axes("dp"):
+        nk, nv, lengths, toks_m, acc_m, drafted_m = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(_rep_specs(params), POOL_DP, POOL_DP, TABLE_DP, VEC_DP,
+                      TABLE_DP, VEC_DP, VEC_DP, P(), VEC_DP, VEC_DP, VEC_DP),
+            out_specs=(POOL_DP, POOL_DP, VEC_DP, P(None, "dp", None),
+                       P(None, "dp"), P(None, "dp")),
+            axis_names={"dp"},
+        )(params, state.k, state.v, state.block_tables, state.lengths,
+          hist, hlen, active, rngs, temperature, top_p, top_k)
+    return PagedState(k=nk, v=nv, block_tables=state.block_tables,
+                      lengths=lengths), toks_m, acc_m, drafted_m
+
+
+class PagedOps:
+    """Engine-facing dispatch over the paged device ops: dp=1 delegates to the
+    single-pool jits; dp>1 routes through the shard_map variants (the engine's
+    call sites stay layout- and mesh-agnostic)."""
+
+    def __init__(self, cfg: ModelConfig, mesh: Optional[Mesh], slots: int):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.dp = _dp_size(mesh)
+        self.slots_per = slots // max(self.dp, 1)
+
+    def install_prefill(self, state, k, v, block_ids, true_len, slot, n_blocks):
+        if self.dp > 1:
+            return install_prefill_dp(state, k, v, block_ids, true_len, slot,
+                                      n_blocks=n_blocks, mesh=self.mesh,
+                                      slots_per=self.slots_per)
+        return install_prefill(state, k, v, block_ids, true_len, slot,
+                               n_blocks=n_blocks)
+
+    def install_with_prefix(self, state, k_suf, v_suf, new_ids, table_row,
+                            true_len, slot, n_new):
+        if self.dp > 1:
+            return install_with_prefix_dp(state, k_suf, v_suf, new_ids,
+                                          table_row, true_len, slot,
+                                          n_new=n_new, mesh=self.mesh,
+                                          slots_per=self.slots_per)
+        return install_with_prefix(state, k_suf, v_suf, new_ids, table_row,
+                                   true_len, slot, n_new=n_new)
+
+    def append_block(self, state, slot, index, block_id):
+        if self.dp > 1:
+            return append_block_dp(state, slot, index, block_id,
+                                   mesh=self.mesh, slots_per=self.slots_per)
+        return append_block(state, slot, index, block_id)
+
+    def prefill_suffix_from_state(self, params, state, block_ids, tokens,
+                                  true_suffix_len, n_blocks, slot):
+        if self.dp > 1:
+            return prefill_suffix_from_state_dp(
+                params, state, block_ids, tokens, true_suffix_len, self.cfg,
+                n_blocks=n_blocks, mesh=self.mesh, slots_per=self.slots_per,
+                slot=slot)
+        return prefill_suffix_from_state(params, state, block_ids, tokens,
+                                         true_suffix_len, self.cfg,
+                                         n_blocks=n_blocks)
+
+    def decode_step(self, params, state, tokens, active):
+        if self.dp > 1:
+            return decode_step_paged_dp(params, state, tokens, active,
+                                        self.cfg, self.mesh)
+        return decode_step_paged(params, state, tokens, active, self.cfg)
+
+    def decode_multi(self, params, state, tokens, active, rngs, temperature,
+                     top_p, top_k):
+        if self.dp > 1:
+            return decode_multi_paged_dp(params, state, tokens, active,
+                                         self.cfg, rngs, temperature, top_p,
+                                         top_k, mesh=self.mesh)
+        return decode_multi_paged(params, state, tokens, active, self.cfg,
+                                  rngs, temperature, top_p, top_k)
+
+    def spec_verify(self, params, state, window, draft_len, active, rng,
+                    temperature, top_p, top_k):
+        if self.dp > 1:
+            return spec_verify_step_paged_dp(params, state, window, draft_len,
+                                             active, self.cfg, rng,
+                                             temperature, top_p, top_k,
+                                             mesh=self.mesh)
+        return spec_verify_step_paged(params, state, window, draft_len, active,
+                                      self.cfg, rng, temperature, top_p, top_k)
+
+    def spec_multi(self, params, state, hist, hlen, active, rngs, temperature,
+                   top_p, top_k, m, k, nmax):
+        if self.dp > 1:
+            return spec_multi_paged_dp(params, state, hist, hlen, active,
+                                       self.cfg, rngs, temperature, top_p,
+                                       top_k, m=m, k=k, nmax=nmax,
+                                       mesh=self.mesh)
+        return spec_multi_paged(params, state, hist, hlen, active, self.cfg,
+                                rngs, temperature, top_p, top_k, m=m, k=k,
+                                nmax=nmax)
 
 
 # ------------------------------------------------------------------ chunked prefill

@@ -23,6 +23,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.ops.quant import as_weight as _qw
 from ray_tpu.parallel.sharding import with_sharding_constraint as wsc
 
 from .config import ModelConfig
@@ -55,7 +56,7 @@ def _moe_group(x, mask, router_w, w_gate, w_up, w_down, cfg: ModelConfig):
     c = expert_capacity(cfg, g)
     dt = x.dtype
 
-    logits = jnp.einsum("td,de->te", x, router_w.astype(dt)).astype(jnp.float32)
+    logits = jnp.einsum("td,de->te", x, _qw(router_w, dt)).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)  # [g, E]
 
     gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [g, k]
@@ -82,10 +83,10 @@ def _moe_group(x, mask, router_w, w_gate, w_up, w_down, cfg: ModelConfig):
     # route tokens to expert buffers, run experts, route back
     xin = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x)  # [E, C, D]
     xin = wsc(xin, "act_expert", None, "act_embed")
-    gate = jnp.einsum("ecd,edf->ecf", xin, w_gate.astype(dt))
-    up = jnp.einsum("ecd,edf->ecf", xin, w_up.astype(dt))
+    gate = jnp.einsum("ecd,edf->ecf", xin, _qw(w_gate, dt))
+    up = jnp.einsum("ecd,edf->ecf", xin, _qw(w_up, dt))
     act = wsc(jax.nn.silu(gate) * up, "act_expert", None, "act_mlp")
-    out = jnp.einsum("ecf,efd->ecd", act, w_down.astype(dt))  # [E, C, D]
+    out = jnp.einsum("ecf,efd->ecd", act, _qw(w_down, dt))  # [E, C, D]
     y = jnp.einsum("tec,ecd->td", combine.astype(dt), out)  # [g, D]
 
     # load-balancing loss (Switch eq. 4) over real tokens only: E * sum_e f_e * P_e

@@ -26,7 +26,9 @@ class QTensor(NamedTuple):
     shards per-leaf (q like the fp weight, s replicated/matching out axes)."""
 
     q: jax.Array  # int8, same shape as the original weight
-    s: jax.Array  # f32, original shape with the contraction axis dropped
+    s: jax.Array  # f32, original shape with the contraction axis kept as 1
+    # (broadcast-ready, so dequant needs no axis bookkeeping — the same QTensor
+    # works for dense [D,F] weights and expert-stacked [E,D,F] weights)
 
     @property
     def shape(self):
@@ -39,18 +41,17 @@ class QTensor(NamedTuple):
 
 def quantize(w: jax.Array, contract_axis: int = 0) -> QTensor:
     """Symmetric per-output-channel int8 quantization over contract_axis."""
-    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis)
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis, keepdims=True)
     scale = jnp.maximum(amax / 127.0, 1e-12)
-    q = jnp.round(w.astype(jnp.float32) / jnp.expand_dims(scale, contract_axis))
+    q = jnp.round(w.astype(jnp.float32) / scale)
     return QTensor(q=jnp.clip(q, -127, 127).astype(jnp.int8),
                    s=scale.astype(jnp.float32))
 
 
-def dequant(t: QTensor, dtype, contract_axis: int = 0) -> jax.Array:
+def dequant(t: QTensor, dtype) -> jax.Array:
     """Rehydrate to `dtype`; inside jit XLA fuses convert+scale into the
     consuming dot's operand read (the int8 bytes are what HBM streams)."""
-    return (t.q.astype(dtype)
-            * jnp.expand_dims(t.s, contract_axis).astype(dtype))
+    return t.q.astype(dtype) * t.s.astype(dtype)
 
 
 def as_weight(p: Any, dtype) -> jax.Array:
@@ -63,6 +64,9 @@ def as_weight(p: Any, dtype) -> jax.Array:
 # Llama layer weights eligible for weight-only quantization. All are stored
 # with d_in first (embed lookup table and norms excluded: gathers and
 # elementwise ops do not stream per-token weight bytes the way matmuls do).
+# In MoE layers the same keys hold EXPERT-STACKED weights [E, d_in, out] whose
+# contraction axis is 1 — distinguished by rank below. The router [D, E] stays
+# fp: it is tiny and routing decisions are the accuracy-critical bits.
 LLAMA_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
@@ -76,8 +80,10 @@ def quantize_llama_params(params: dict) -> dict:
         if name not in LLAMA_QUANT_KEYS:
             return p
         if isinstance(layers, dict):  # scanned: leading layer axis
-            return jax.vmap(lambda w: quantize(w, 0))(p)
-        return quantize(p, 0)
+            axis = 1 if p.ndim == 4 else 0  # [L,E,din,out] experts contract din
+            return jax.vmap(lambda w: quantize(w, axis))(p)
+        axis = 1 if p.ndim == 3 else 0  # [E,din,out] experts contract din
+        return quantize(p, axis)
 
     if isinstance(layers, dict):
         out["layers"] = {k: _maybe_quant(k, v) for k, v in layers.items()}

@@ -92,6 +92,9 @@ def cmd_tls_init(args) -> int:
     print(f"  export RAY_TPU_TLS_CA={paths['ca']}")
     print(f"  export RAY_TPU_TLS_CERT={paths['cert']}")
     print(f"  export RAY_TPU_TLS_KEY={paths['key']}")
+    print("WARNING: keep the CA private key OFF cluster nodes — distribute only "
+          "ca.crt, cluster.crt and cluster.key; anyone holding "
+          f"{paths['ca_key']} can mint certificates this cluster trusts.")
     return 0
 
 

@@ -236,3 +236,35 @@ def test_head_restart_new_address_external_journal(restart_env, tmp_path):
         ray_tpu.shutdown()
     finally:
         os.environ.pop("RAY_TPU_MOCK_FS_ROOT", None)
+
+
+def test_uri_journal_split_brain_fence():
+    """Two heads pointed at one journal URI (split-brain during failover):
+    segment names embed writer tokens so appends can never overwrite each
+    other, and the OLD writer is fenced out loudly (JournalFencedError) once a
+    newer head claims the owner marker (ADVICE r4: no silent corruption)."""
+    import uuid
+
+    from ray_tpu.core.gcs import JournalFencedError, _UriJournal
+
+    uri = f"mock://fence-{uuid.uuid4().hex[:8]}"
+    j1 = _UriJournal(uri)
+    j1.append(b"from-j1")
+    j2 = _UriJournal(uri)  # replacement head: newest-writer-wins claim
+    j2.append(b"from-j2")
+    # j1 hits the fence at its next periodic owner check, not silently
+    with pytest.raises(JournalFencedError):
+        for _ in range(j1.OWNER_CHECK_EVERY + 1):
+            j1.append(b"stale")
+    # nothing was overwritten: every append from BOTH writers is a distinct
+    # segment object (names carry the writer token)
+    names = j2._segments()
+    assert len(names) == len(set(names))
+    assert any(j1.token in n for n in names)
+    assert any(j2.token in n for n in names)
+    # the surviving writer's compaction (destructive) also re-checks ownership
+    j2.compact([b"snapshot"])
+    assert len(j2._segments()) == 1
+    # ...and a fenced writer may NOT compact
+    with pytest.raises(JournalFencedError):
+        j1.compact([b"bad"])

@@ -355,12 +355,18 @@ def test_ngram_proposer_lookup():
 def test_speculative_config_validation():
     from ray_tpu.llm import JaxLLMEngine, LLMConfig
 
-    # spec + fused composes on the slot layout; paged still refuses
+    # only ngram (prompt-lookup) proposers exist; a draft-model config refuses
     eng = JaxLLMEngine(LLMConfig(model_id="sv2", model_source="test-tiny",
-                                 kv_layout="paged", num_speculative_tokens=4,
-                                 num_decode_steps=8))
-    with pytest.raises(NotImplementedError, match="slot"):
+                                 num_speculative_tokens=4,
+                                 speculative_method="draft_model"))
+    with pytest.raises(NotImplementedError, match="ngram"):
         eng.start()
+    # spec decoding composes with paged + fused multi-step now; pp remains out
+    eng2 = JaxLLMEngine(LLMConfig(model_id="sv3", model_source="test-tiny",
+                                  pipeline_parallel_size=2,
+                                  num_speculative_tokens=4))
+    with pytest.raises(NotImplementedError, match="pp"):
+        eng2.start()
 
 
 def test_device_ngram_proposer_matches_host():
@@ -387,19 +393,22 @@ def test_device_ngram_proposer_matches_host():
     assert dlen[1] == 0
 
 
-def test_spec_fused_multi_step_matches_greedy():
-    """spec + fused multi-step (the composed mode): output is EXACTLY the plain
-    greedy continuation. An untrained model emits novel tokens, so the real
-    n-gram proposer rarely fires (same caveat as the host-path test) — exact
-    equivalence across misses IS the correctness property here; acceptance
-    inside fused bursts is driven by the oracle test below."""
+@pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+def test_spec_fused_multi_step_matches_greedy(kv_layout):
+    """spec + fused multi-step (the composed mode), on BOTH cache layouts
+    (paged: spec_multi_paged writes windows through pre-grown block tables):
+    output is EXACTLY the plain greedy continuation. An untrained model emits
+    novel tokens, so the real n-gram proposer rarely fires (same caveat as the
+    host-path test) — exact equivalence across misses IS the correctness
+    property here; acceptance inside fused bursts is driven by the oracle test
+    below."""
     params = llama_init_cached(CFG)
     prompt = [1, 10, 11, 12, 13, 10, 11, 12, 13, 10, 11, 12, 13]
     want = reference_greedy(params, prompt, 12)
 
     eng = JaxLLMEngine(LLMConfig(
-        model_id="spec-fused", model_source="test-tiny", max_num_seqs=2,
-        max_model_len=64, tokenizer="byte", kv_layout="slot",
+        model_id=f"spec-fused-{kv_layout}", model_source="test-tiny",
+        max_num_seqs=2, max_model_len=64, tokenizer="byte", kv_layout=kv_layout,
         num_speculative_tokens=4, num_decode_steps=4))
     eng.start()
     try:

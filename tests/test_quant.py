@@ -14,12 +14,26 @@ from ray_tpu.ops.quant import QTensor, as_weight, dequant, quantize, \
 def test_quantize_dequant_error_bounded():
     w = jax.random.normal(jax.random.PRNGKey(0), (256, 64)) * 0.02
     qt = quantize(w, 0)
-    assert qt.q.dtype == jnp.int8 and qt.s.shape == (64,)
+    # scales keep the contraction axis as 1 (broadcast-ready for dequant)
+    assert qt.q.dtype == jnp.int8 and qt.s.shape == (1, 64)
     back = dequant(qt, jnp.float32)
     # symmetric int8: max error is half a quantization step per channel
     step = np.asarray(qt.s)
     err = np.abs(np.asarray(back) - np.asarray(w))
-    assert (err <= 0.5 * step[None, :] + 1e-8).all()
+    assert (err <= 0.5 * step + 1e-8).all()
+
+
+def test_quantize_expert_stacked_contract_axis():
+    """Expert weights [E, d_in, out] quantize over axis 1 with per-(expert,
+    out-channel) scales, exactly like vmapping dense per-expert quantization."""
+    w = jax.random.normal(jax.random.PRNGKey(2), (4, 32, 16)) * 0.05
+    qt = quantize(w, 1)
+    assert qt.s.shape == (4, 1, 16)
+    per_expert = jax.vmap(lambda e: quantize(e, 0))(w)
+    np.testing.assert_array_equal(np.asarray(qt.q), np.asarray(per_expert.q))
+    back = dequant(qt, jnp.float32)
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err <= 0.5 * np.asarray(qt.s) + 1e-8).all()
 
 
 def test_as_weight_passthrough():
