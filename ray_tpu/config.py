@@ -70,6 +70,19 @@ _FLAGS: List[Flag] = [
     # -- multi-host control plane
     Flag("agent_heartbeat_s", "RAY_TPU_AGENT_HEARTBEAT_S", "float", 2.0,
          "Node-agent heartbeat period to the head."),
+    Flag("agent_batch_max", "RAY_TPU_AGENT_BATCH_MAX", "int", 128,
+         "Max frames coalesced into one gRPC agent-stream message (batching "
+         "packs only already-queued frames: zero added latency)."),
+    Flag("agent_queue_depth", "RAY_TPU_AGENT_QUEUE_DEPTH", "int", 4096,
+         "Outbound frame buffer per agent stream; a stalled peer exerts "
+         "backpressure once full instead of accumulating frames in RAM."),
+    Flag("agent_send_timeout_s", "RAY_TPU_AGENT_SEND_TIMEOUT_S", "float", 30.0,
+         "How long send() blocks on a backed-up agent stream before raising."),
+    Flag("tls_handshake_timeout_s", "RAY_TPU_TLS_HANDSHAKE_TIMEOUT_S", "float",
+         15.0, "Deferred server-side TLS handshake deadline per connection."),
+    Flag("collective_op_timeout_s", "RAY_TPU_COLLECTIVE_OP_TIMEOUT_S", "float",
+         30.0, "Host-plane collective op timeout (allreduce/broadcast/...); "
+         "barriers wait 2x this."),
     # -- transport security
     Flag("use_tls", "RAY_TPU_USE_TLS", "bool", False,
          "mTLS on the gRPC agent channel and the data/device-plane listeners; "
@@ -80,6 +93,29 @@ _FLAGS: List[Flag] = [
          "Cluster certificate path (`ray-tpu tls-init` mints one)."),
     Flag("tls_key", "RAY_TPU_TLS_KEY", "str", None,
          "Cluster private key path."),
+    Flag("container_runtime", "RAY_TPU_CONTAINER_RUNTIME", "str", None,
+         "Container launcher binary for container/image_uri runtime envs "
+         "(default: docker, then podman, from PATH). Point it at a recording "
+         "stub to test invocations without a real runtime."),
+    Flag("serve_ingress_tls", "RAY_TPU_SERVE_INGRESS_TLS", "bool", False,
+         "Serve the HTTP and gRPC ingress proxies over TLS using the cluster "
+         "certificate (server-side TLS: external clients verify against "
+         "ca.crt but need no client cert, unlike the inter-node mTLS planes)."),
+    Flag("pd_export_ttl_s", "RAY_TPU_PD_EXPORT_TTL_S", "float", 600.0,
+         "Device-plane auto-release backstop for P/D prefill KV exports whose "
+         "decode consumer crashed before acking."),
+    Flag("pd_export_max_live", "RAY_TPU_PD_EXPORT_MAX_LIVE", "int", 128,
+         "Max un-acked P/D KV exports a prefill engine pins before LRU "
+         "pruning (each pins device memory until the decode side pulls)."),
+    Flag("llm_engine_idle_wait_s", "RAY_TPU_LLM_ENGINE_IDLE_WAIT_S", "float",
+         0.05, "Engine scheduler-loop sleep when no slot is active (admission "
+         "latency floor for the first request of a burst)."),
+    Flag("moe_group_size", "RAY_TPU_MOE_GROUP_SIZE", "int", 4096,
+         "Tokens per MoE dispatch group: dispatch/combine tensors are "
+         "[group, experts, capacity], so memory is O(tokens x group)."),
+    Flag("serve_reconcile_interval_s", "RAY_TPU_SERVE_RECONCILE_INTERVAL_S",
+         "float", 0.2, "Serve controller reconciliation loop period (replica "
+         "create/kill, health checks, autoscale decisions)."),
     # -- device plane (device-to-device tensor transfer between processes)
     Flag("device_plane", "RAY_TPU_DEVICE_PLANE", "bool", True,
          "Enable the PJRT transfer-server plane: jax.Arrays move between actor "

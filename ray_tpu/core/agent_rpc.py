@@ -93,8 +93,14 @@ def decode_loc(m: pb.Location) -> Optional[tuple]:
 def encode_head_msg(msg: tuple) -> pb.HeadMessage:
     kind = msg[0]
     if kind == "spawn_worker":
-        return pb.HeadMessage(spawn_worker=pb.SpawnWorker(worker_id=msg[1],
-                                                         accel=msg[2]))
+        sw = pb.SpawnWorker(worker_id=msg[1], accel=msg[2])
+        if len(msg) > 3 and msg[3]:
+            sw.extra_env.update(msg[3])
+        if len(msg) > 4 and msg[4]:
+            sw.has_container = True
+            sw.container_image = msg[4]["image"]
+            sw.container_run_options.extend(msg[4].get("run_options") or ())
+        return pb.HeadMessage(spawn_worker=sw)
     if kind == "to_worker":
         return pb.HeadMessage(to_worker=pb.ToWorker(worker_id=msg[1],
                                                     payload=msg[2]))
@@ -128,7 +134,12 @@ def encode_head_msg(msg: tuple) -> pb.HeadMessage:
 def decode_head_msg(m: pb.HeadMessage) -> tuple:
     kind = m.WhichOneof("msg")
     if kind == "spawn_worker":
-        return ("spawn_worker", m.spawn_worker.worker_id, m.spawn_worker.accel)
+        sw = m.spawn_worker
+        container = ({"image": sw.container_image,
+                      "run_options": list(sw.container_run_options)}
+                     if sw.has_container else None)
+        return ("spawn_worker", sw.worker_id, sw.accel,
+                dict(sw.extra_env) or None, container)
     if kind == "to_worker":
         return ("to_worker", m.to_worker.worker_id, m.to_worker.payload)
     if kind == "kill_worker":
@@ -252,10 +263,26 @@ def decode_agent_msg(m: pb.AgentMessage) -> tuple:
 
 # ---- transport: head-side gRPC server ------------------------------------------
 
-# Max frames coalesced into one gRPC message. Batching only packs what is
-# ALREADY queued when the writer wakes (never waits), so it adds zero latency
-# while amortizing grpc-python's ~0.15-0.2 ms per-message cost under load.
-_BATCH_MAX = 128
+# Max frames coalesced into one gRPC message (CONFIG.agent_batch_max; read at
+# use so env changes apply live). Batching only packs what is ALREADY queued
+# when the writer wakes (never waits), so it adds zero latency while
+# amortizing grpc-python's ~0.15-0.2 ms per-message cost under load.
+def _batch_max() -> int:
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.agent_batch_max
+
+
+def _queue_depth() -> int:
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.agent_queue_depth
+
+
+def _send_timeout_s() -> float:
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.agent_send_timeout_s
 
 
 def _drain_batch(q: "queue.Queue", first):
@@ -264,7 +291,8 @@ def _drain_batch(q: "queue.Queue", first):
     shutdown sentinel found mid-drain is re-queued so the caller's next get
     still sees it after the collected frames are flushed."""
     items = [first]
-    while len(items) < _BATCH_MAX:
+    cap = _batch_max()
+    while len(items) < cap:
         try:
             nxt = q.get_nowait()
         except queue.Empty:
@@ -290,13 +318,16 @@ class AgentStream:
 
     # bounded outbound buffers: a stalled/dead peer must exert BACKPRESSURE
     # (send raises after the grace) instead of accumulating frames in RAM
-    QUEUE_DEPTH = 4096
-    SEND_TIMEOUT_S = 30.0
+    # CONFIG-backed via the module helpers below (read at use; env changes
+    # apply live). Plain functions, NOT properties: HeadConnection reads these
+    # at CLASS level, where a property object would silently replace the number.
+    QUEUE_DEPTH = None  # use _queue_depth()
+    SEND_TIMEOUT_S = None  # use _send_timeout_s()
 
     def __init__(self, peer_ip: Optional[str]):
         self.peer_ip = peer_ip
         self._out: "queue.Queue[Optional[pb.HeadMessage]]" = queue.Queue(
-            maxsize=self.QUEUE_DEPTH)
+            maxsize=_queue_depth())
         self.closed = threading.Event()
         # set by the Cluster during on_connect, before the reader starts
         self.on_message = None
@@ -306,7 +337,7 @@ class AgentStream:
         if self.closed.is_set():
             raise OSError("agent stream closed")
         try:
-            self._out.put(encode_head_msg(msg), timeout=self.SEND_TIMEOUT_S)
+            self._out.put(encode_head_msg(msg), timeout=_send_timeout_s())
         except queue.Full:
             raise OSError("agent stream backed up (peer stalled)")
 
@@ -467,7 +498,7 @@ class HeadConnection:
         # bounded for backpressure: a dead/stalled head makes send() RAISE
         # after the grace instead of buffering frames into a void
         self._out: "queue.Queue[Optional[pb.AgentMessage]]" = queue.Queue(
-            maxsize=AgentStream.QUEUE_DEPTH)
+            maxsize=_queue_depth())
         self._closed = threading.Event()
         call = self._channel.stream_stream(
             _METHOD, request_serializer=pb.AgentMessage.SerializeToString,
@@ -493,7 +524,7 @@ class HeadConnection:
             raise OSError("head stream closed")
         try:
             self._out.put(encode_agent_msg(msg),
-                          timeout=AgentStream.SEND_TIMEOUT_S)
+                          timeout=_send_timeout_s())
         except queue.Full:
             raise OSError("head stream backed up (head stalled)")
 

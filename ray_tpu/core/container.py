@@ -1,0 +1,206 @@
+"""Containerized worker processes for the container/image_uri runtime env.
+
+Capability parity: reference python/ray/_private/runtime_env/image_uri.py — a
+task/actor whose runtime_env names a container image runs its worker INSIDE
+that image (podman there; docker or podman here, or any drop-in via
+RAY_TPU_CONTAINER_RUNTIME — which is also the fake-runtime seam tests use to
+record the exact invocation).
+
+Transport: an in-container worker cannot inherit the head's multiprocessing
+pipe, so the node listens on an authkey'd loopback socket and the container
+(run with --network host) dials back into the SAME worker protocol
+(`python -m ray_tpu.core.worker --connect host:port ...`). The session dir is
+mounted so the worker shares the object-store arena and session authkey; the
+ray_tpu package dir is mounted read-only and prepended to PYTHONPATH so any
+image with a compatible python works without baking the framework in.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.config import CONFIG
+
+
+class ContainerRuntimeError(RuntimeError):
+    """Container worker could not be launched (no runtime, bad spec, ...)."""
+
+
+def normalize_container_spec(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """{"image": str, "run_options": [str, ...]} from the container/image_uri
+    runtime_env fields; None when neither is present."""
+    if not runtime_env:
+        return None
+    container = runtime_env.get("container")
+    image_uri = runtime_env.get("image_uri")
+    if container:
+        if not isinstance(container, dict) or not container.get("image"):
+            raise ValueError('runtime_env["container"] must be {"image": ..., '
+                             '"run_options": [...]}')
+        return {"image": str(container["image"]),
+                "run_options": [str(o) for o in container.get("run_options") or []]}
+    if image_uri:
+        return {"image": str(image_uri), "run_options": []}
+    return None
+
+
+def find_runtime() -> Optional[str]:
+    """The container launcher binary: RAY_TPU_CONTAINER_RUNTIME overrides (the
+    test seam), else docker, else podman."""
+    override = CONFIG.container_runtime
+    if override:
+        return override
+    return shutil.which("docker") or shutil.which("podman")
+
+
+def _package_root() -> str:
+    import ray_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+
+
+def build_run_command(runtime: str, spec: Dict[str, Any], connect_addr: str,
+                      node_id_hex: str, wid_hex: str, accel: str,
+                      env: Dict[str, str], authkey_hex: str,
+                      session_dir: str) -> List[str]:
+    pkg = _package_root()
+    cmd = [runtime, "run", "--rm", "--network", "host",
+           "-v", f"{session_dir}:{session_dir}",
+           "-v", f"{pkg}:{pkg}:ro"]
+    for k, v in {**env,
+                 "RAY_TPU_WORKER_AUTHKEY": authkey_hex,
+                 "PYTHONPATH": pkg + os.pathsep + env.get("PYTHONPATH", "")}.items():
+        cmd += ["--env", f"{k}={v}"]
+    cmd += spec["run_options"]
+    cmd += [spec["image"], "python", "-m", "ray_tpu.core.worker",
+            "--connect", connect_addr, "--node-id", node_id_hex,
+            "--worker-id", wid_hex, "--accel", accel]
+    return cmd
+
+
+def launch_worker_container(spec: Dict[str, Any], connect_addr: str,
+                            node_id_hex: str, wid_hex: str, accel: str,
+                            env: Dict[str, str], authkey_hex: str) -> subprocess.Popen:
+    runtime = find_runtime()
+    if runtime is None:
+        raise ContainerRuntimeError(
+            "runtime_env requests a container image but no container runtime "
+            "was found (need docker or podman on PATH, or "
+            "RAY_TPU_CONTAINER_RUNTIME)")
+    from ray_tpu.job.manager import default_session_dir
+
+    cmd = build_run_command(runtime, spec, connect_addr, node_id_hex, wid_hex,
+                            accel, env, authkey_hex, default_session_dir())
+    try:
+        return subprocess.Popen(cmd)
+    except OSError as e:
+        raise ContainerRuntimeError(f"failed to exec {runtime!r}: {e}") from e
+
+
+class PopenProc:
+    """mp.Process-shaped adapter over the container runtime Popen."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self._proc = proc
+        self.pid = proc.pid
+
+    def is_alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def terminate(self) -> None:
+        try:
+            self._proc.terminate()
+        except OSError:
+            pass
+
+    kill = terminate
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        try:
+            self._proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def spawn_with_dialback(container: Dict[str, Any], node_id_hex: str,
+                        wid_hex: str, accel: str, env: Dict[str, str],
+                        on_attach, on_fail, timeout_s: Optional[float] = None):
+    """The shared container-worker launch sequence (head node and agent):
+    create an authkey'd loopback Listener, launch the image pointing back at
+    it, and hand the dial-back connection to on_attach(conn) from a waiter
+    thread — or on_fail(err) when the container never dials back within
+    timeout_s (default: the worker-start timeout, so slow image pulls respect
+    RAY_TPU_WORKER_START_TIMEOUT_S). Raises ContainerRuntimeError (listener
+    closed) when the launch cannot even start. Returns a PopenProc."""
+    import threading
+
+    from multiprocessing.connection import Listener
+
+    from ray_tpu.util.client.server import generate_authkey, load_authkey
+
+    if timeout_s is None:
+        timeout_s = CONFIG.worker_start_timeout_s
+    key = load_authkey() or generate_authkey()
+    listener = Listener(("127.0.0.1", 0), authkey=key)
+    try:
+        proc = launch_worker_container(
+            container, f"127.0.0.1:{listener.address[1]}", node_id_hex,
+            wid_hex, accel, env, key.hex())
+    except Exception:
+        listener.close()
+        raise
+
+    def _wait() -> None:
+        listener._listener._socket.settimeout(timeout_s)
+        try:
+            conn = listener.accept()
+        except Exception as e:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+            on_fail(e)
+            return
+        finally:
+            listener.close()
+        on_attach(conn)
+
+    threading.Thread(target=_wait, daemon=True,
+                     name="rt-container-dialback").start()
+    return PopenProc(proc)
+
+
+class PendingConn:
+    """Send-buffering proxy for the worker pipe until the container dials
+    back: pre-attach sends buffer, attach() flushes them into the real
+    connection and forwards everything after. Recv-side registration (the
+    cluster/agent wait loops need a real fileno) happens at attach time."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._real = None
+        self.buffered: List[bytes] = []
+
+    def attach(self, conn) -> None:
+        with self._lock:
+            for data in self.buffered:
+                conn.send_bytes(data)
+            self.buffered.clear()
+            self._real = conn
+
+    def send_bytes(self, data: bytes) -> None:
+        with self._lock:
+            if self._real is not None:
+                self._real.send_bytes(data)
+            else:
+                self.buffered.append(bytes(data))
+
+    def close(self) -> None:
+        with self._lock:
+            self.buffered.clear()
+            if self._real is not None:
+                self._real.close()

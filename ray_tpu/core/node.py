@@ -139,9 +139,13 @@ class NodeRuntime:
         self.idle.setdefault(w.pool_key, []).append(w)
 
     def spawn_worker(self, accel: str, extra_env: Optional[Dict[str, str]] = None,
-                     pool_key: Optional[str] = None) -> Optional[WorkerHandle]:
+                     pool_key: Optional[str] = None,
+                     container: Optional[Dict] = None) -> Optional[WorkerHandle]:
         if len(self.workers) >= self.max_workers:
             return None
+        if container is not None:
+            return self._spawn_container_worker(accel, container, extra_env,
+                                                pool_key)
         from .worker import worker_main
 
         worker_id = WorkerID.generate()
@@ -162,6 +166,49 @@ class NodeRuntime:
                          pool_key=pool_key)
         self.workers[worker_id] = w
         self.cluster._register_conn(w)
+        return w
+
+    def _spawn_container_worker(self, accel: str, container: Dict,
+                                extra_env: Optional[Dict[str, str]],
+                                pool_key: Optional[str]) -> WorkerHandle:
+        """Launch a worker INSIDE a container image (runtime_env container/
+        image_uri — reference _private/runtime_env/image_uri.py): the node
+        listens on an authkey'd loopback socket, the container dials back, and
+        from then on the worker is indistinguishable from a pipe worker.
+        Dispatches sent before the dial-back buffer in a PendingConn; the
+        handle joins the cluster recv loop at attach. A container that never
+        dials back goes through the normal worker-death bookkeeping (task
+        retried/failed, slot freed)."""
+        from . import container as _ctr
+
+        worker_id = WorkerID.generate()
+        env = dict(self.cluster.worker_env)
+        if extra_env:
+            env.update(extra_env)
+        handle_ready = threading.Event()
+        holder: Dict[str, WorkerHandle] = {}
+
+        def on_attach(conn) -> None:
+            handle_ready.wait(timeout=30)
+            w = holder["w"]
+            with w._send_lock:
+                w.conn.attach(conn)
+                w.conn = conn
+            self.cluster._register_conn(w)
+
+        def on_fail(err) -> None:
+            handle_ready.wait(timeout=30)
+            self.cluster._on_worker_death(holder["w"], _ctr.ContainerRuntimeError(
+                f"container worker never dialed back: {err}"))
+
+        proc = _ctr.spawn_with_dialback(
+            container, self.node_id.hex(), worker_id.hex(), accel, env,
+            on_attach, on_fail, timeout_s=_worker_start_timeout())
+        w = WorkerHandle(worker_id, proc, _ctr.PendingConn(), self, accel,
+                         pool_key=pool_key)
+        holder["w"] = w
+        handle_ready.set()
+        self.workers[worker_id] = w
         return w
 
 
@@ -281,7 +328,8 @@ class RemoteNodeRuntime(NodeRuntime):
         self.host_key = node_id.hex()
 
     def spawn_worker(self, accel: str, extra_env: Optional[Dict[str, str]] = None,
-                     pool_key: Optional[str] = None) -> Optional[WorkerHandle]:
+                     pool_key: Optional[str] = None,
+                     container: Optional[Dict] = None) -> Optional[WorkerHandle]:
         if len(self.workers) >= self.max_workers or not self.agent.alive:
             return None
         worker_id = WorkerID.generate()
@@ -290,7 +338,7 @@ class RemoteNodeRuntime(NodeRuntime):
             w.pool_key = pool_key
         try:
             self.agent.send(("spawn_worker", worker_id.hex(), accel,
-                             dict(extra_env or {})))
+                             dict(extra_env or {}), container))
         except Exception:
             return None
         self.workers[worker_id] = w
@@ -1317,21 +1365,38 @@ class Cluster:
         # the env hash (reference: worker-per-runtime-env): process-level vars
         # (XLA_FLAGS, JAX_PLATFORMS, ...) only take effect at process spawn, so
         # a reused plain worker must never serve an env_vars task.
-        env_vars = ((spec.runtime_env or {}).get("env_vars")
-                    if isinstance(spec.runtime_env, dict) else None)
-        if env_vars:
+        renv = spec.runtime_env if isinstance(spec.runtime_env, dict) else None
+        env_vars = (renv or {}).get("env_vars")
+        from .container import (ContainerRuntimeError, normalize_container_spec)
+
+        try:
+            container = normalize_container_spec(renv)
+        except ValueError as e:
+            ledger.release(resources)
+            self._fail_returns(spec, e)
+            return True
+        if env_vars or container:
             import hashlib as _hashlib
             import json as _json
 
-            ek = _hashlib.sha256(_json.dumps(env_vars, sort_keys=True)
-                                 .encode()).hexdigest()[:10]
+            ek = _hashlib.sha256(_json.dumps(
+                {"env": env_vars, "container": container}, sort_keys=True)
+                .encode()).hexdigest()[:10]
             pool_key = f"{accel}|env:{ek}"
         else:
             pool_key = accel
         worker = node.pop_idle(pool_key)
         if worker is None:
-            worker = node.spawn_worker(accel, extra_env=env_vars or None,
-                                       pool_key=pool_key)
+            try:
+                worker = node.spawn_worker(accel, extra_env=env_vars or None,
+                                           pool_key=pool_key,
+                                           container=container)
+            except ContainerRuntimeError as e:
+                # env setup failure fails the TASK (reference: runtime-env
+                # agent setup errors), not the scheduler
+                ledger.release(resources)
+                self._fail_returns(spec, e)
+                return True
             if worker is None:
                 ledger.release(resources)
                 return False

@@ -377,7 +377,8 @@ class NodeAgent:
         if kind == "spawn_worker":
             _, wid_hex, accel = msg[:3]
             extra_env = msg[3] if len(msg) > 3 else None
-            self._spawn_worker(wid_hex, accel, extra_env)
+            container = msg[4] if len(msg) > 4 else None
+            self._spawn_worker(wid_hex, accel, extra_env, container)
         elif kind == "to_worker":
             _, wid_hex, raw = msg
             entry = self._workers.get(wid_hex)
@@ -445,9 +446,13 @@ class NodeAgent:
 
     # -- worker pool -----------------------------------------------------------------
     def _spawn_worker(self, wid_hex: str, accel: str,
-                      extra_env: Optional[Dict[str, str]] = None) -> None:
+                      extra_env: Optional[Dict[str, str]] = None,
+                      container: Optional[Dict] = None) -> None:
         from .worker import worker_main
 
+        if container is not None:
+            self._spawn_container_worker(wid_hex, accel, extra_env, container)
+            return
         parent_conn, child_conn = _mp.Pipe(duplex=True)
         env = dict(self.worker_env)
         if extra_env:  # runtime_env env_vars applied at process spawn
@@ -466,6 +471,52 @@ class NodeAgent:
             self._wakeup_w.send_bytes(b"x")
         except Exception:
             pass
+
+    def _spawn_container_worker(self, wid_hex: str, accel: str,
+                                extra_env: Optional[Dict[str, str]],
+                                container: Dict) -> None:
+        """Agent-side container worker (runtime_env container/image_uri): same
+        shared dial-back sequence as the head node (core/container.py), with
+        the connection spliced into the agent's normal worker relay. Sends
+        buffer in a PendingConn until the container dials back."""
+        from . import container as _ctr
+
+        env = dict(self.worker_env)
+        if extra_env:
+            env.update(extra_env)
+        env["RAY_TPU_WORKER_LOG_DIR"] = self._log_dir
+        pending = _ctr.PendingConn()
+        entry_ready = threading.Event()
+
+        def on_attach(conn) -> None:
+            entry_ready.wait(timeout=30)
+            pending.attach(conn)
+            # recv side joins the relay loop on the REAL conn (fileno needed)
+            entry = self._workers.get(wid_hex)
+            if entry is None:  # killed while dialing back
+                conn.close()
+                return
+            self._workers[wid_hex] = (entry[0], conn, accel)
+            self._pipe_to_wid[conn] = wid_hex
+            try:
+                self._wakeup_w.send_bytes(b"x")
+            except Exception:
+                pass
+
+        def on_fail(err) -> None:
+            entry_ready.wait(timeout=30)
+            # head sees the worker die in "starting" and fails/retries the task
+            self._on_local_worker_death(wid_hex)
+
+        try:
+            proc = _ctr.spawn_with_dialback(
+                container, self.node_id_hex, wid_hex, accel, env,
+                on_attach, on_fail)
+        except _ctr.ContainerRuntimeError:
+            self._on_local_worker_death(wid_hex)
+            return
+        self._workers[wid_hex] = (proc, pending, accel)
+        entry_ready.set()
 
     def _on_local_worker_death(self, wid_hex: str) -> None:
         self._dead_worker_logs[wid_hex] = time.monotonic()

@@ -26,7 +26,11 @@ class SecureConnection:
     handshake returns False immediately while no peer bytes have arrived; once
     they have, the first poll/recv may block up to the handshake timeout."""
 
-    _HANDSHAKE_TIMEOUT_S = 15.0
+    @property
+    def _HANDSHAKE_TIMEOUT_S(self):  # CONFIG-backed (read at use)
+        from ray_tpu.config import CONFIG
+
+        return CONFIG.tls_handshake_timeout_s
 
     def __init__(self, sock, handshake_pending: bool = False):
         self._sock = sock

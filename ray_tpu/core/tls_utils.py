@@ -175,6 +175,28 @@ def client_ssl_context():
     return ctx
 
 
+def ingress_ssl_context():
+    """Server-side-TLS context for the serve HTTP ingress: external clients
+    verify the cluster cert against ca.crt but present no client cert (they
+    are end users, not cluster nodes — unlike the mTLS inter-node planes)."""
+    import ssl
+
+    _ca, cert, key = load_cert_paths()
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    return ctx
+
+
+def ingress_grpc_credentials():
+    """Server-side-TLS credentials for the serve gRPC ingress (no client-cert
+    requirement)."""
+    import grpc
+
+    _ca_b, cert_b, key_b = load_cert_bytes()
+    return grpc.ssl_server_credentials([(key_b, cert_b)],
+                                       require_client_auth=False)
+
+
 def grpc_server_credentials():
     import grpc
 

@@ -712,3 +712,27 @@ def _format_thread_stacks() -> str:
         out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
         out.extend(l.rstrip() for l in traceback.format_stack(frame))
     return "\n".join(out)
+
+
+def _main_connect() -> None:
+    """Socket-connect worker entry (containerized workers: the in-image process
+    cannot inherit the node's mp pipe, so it dials back over an authkey'd
+    loopback socket and speaks the identical worker protocol)."""
+    import argparse
+
+    from multiprocessing.connection import Client
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--connect", required=True)
+    p.add_argument("--node-id", required=True)
+    p.add_argument("--worker-id", required=True)
+    p.add_argument("--accel", default="cpu")
+    args = p.parse_args()
+    host, _, port = args.connect.rpartition(":")
+    key = bytes.fromhex(os.environ["RAY_TPU_WORKER_AUTHKEY"])
+    conn = Client((host or "127.0.0.1", int(port)), authkey=key)
+    worker_main(conn, args.node_id, args.worker_id, args.accel, {})
+
+
+if __name__ == "__main__":
+    _main_connect()

@@ -28,7 +28,11 @@ from .datasource import (  # noqa: F401
     RangeDatasource,
     TextDatasource,
     BigQueryDatasource,
+    DeltaSharingDatasource,
+    IcebergDatasource,
     LanceDatasource,
+    MongoDatasource,
+    SQLDatasource,
     TFRecordDatasource,
     WebDatasetDatasource,
 )
@@ -121,6 +125,40 @@ def read_bigquery(project_id: str, *, dataset: Optional[str] = None,
                  parallelism)
 
 
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1) -> Dataset:
+    """Any DBAPI-2 database via a zero-arg connection factory (reference
+    read_sql / _internal/datasource/sql_datasource.py — sqlite3, psycopg2,
+    mysql-connector, ... all satisfy the protocol)."""
+    return _read(SQLDatasource(sql, connection_factory), parallelism)
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[dict]] = None,
+               parallelism: int = -1) -> Dataset:
+    """MongoDB collection (reference read_mongo; needs the optional
+    'pymongo' package)."""
+    return _read(MongoDatasource(uri, database, collection, pipeline=pipeline),
+                 parallelism)
+
+
+def read_iceberg(table_identifier: str, *, catalog_kwargs: Optional[dict] = None,
+                 row_filter=None, selected_fields: Optional[List[str]] = None,
+                 parallelism: int = -1) -> Dataset:
+    """Iceberg table scan (reference read_iceberg; needs the optional
+    'pyiceberg' package)."""
+    return _read(IcebergDatasource(table_identifier,
+                                   catalog_kwargs=catalog_kwargs,
+                                   row_filter=row_filter,
+                                   selected_fields=selected_fields), parallelism)
+
+
+def read_delta_sharing_tables(url: str, *, limit: Optional[int] = None,
+                              parallelism: int = -1) -> Dataset:
+    """Delta Sharing table (reference read_delta_sharing_tables; needs the
+    optional 'delta-sharing' package)."""
+    return _read(DeltaSharingDatasource(url, limit=limit), parallelism)
+
+
 def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
     return _read(ds, parallelism)
 
@@ -149,6 +187,10 @@ __all__ = [
     "read_tfrecords",
     "read_lance",
     "read_bigquery",
+    "read_sql",
+    "read_mongo",
+    "read_iceberg",
+    "read_delta_sharing_tables",
     "read_datasource",
     "AggregateFn",
     "Count",

@@ -399,6 +399,143 @@ class BigQueryDatasource(Datasource):
                                            input_files=[dataset or "query"]))]
 
 
+class SQLDatasource(Datasource):
+    """Any DBAPI-2 database via a connection factory (reference
+    _internal/datasource/sql_datasource.py: read_sql(sql, connection_factory)
+    — sqlite3, psycopg2, mysql-connector, ... all satisfy the protocol).
+    Unpartitioned single read task, like the reference's default."""
+
+    def __init__(self, sql: str, connection_factory):
+        if not callable(connection_factory):
+            raise TypeError("connection_factory must be a zero-arg callable "
+                            "returning a DBAPI-2 connection")
+        self.sql = sql
+        self.connection_factory = connection_factory
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        sql, factory = self.sql, self.connection_factory
+
+        def fn():
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                cols = [d[0] for d in cur.description]
+                rows = cur.fetchall()
+                yield BlockAccessor.batch_to_block(
+                    {c: np.asarray([r[i] for r in rows])
+                     for i, c in enumerate(cols)})
+            finally:
+                conn.close()
+
+        return [ReadTask(fn, BlockMetadata(num_rows=-1, size_bytes=0,
+                                           input_files=["sql"]))]
+
+
+class MongoDatasource(Datasource):
+    """MongoDB collection read (reference _internal/datasource/
+    mongo_datasource.py). 'pymongo' is optional; absence raises at read time."""
+
+    def __init__(self, uri: str, database: str, collection: str,
+                 pipeline: Optional[List[Dict]] = None):
+        try:
+            import pymongo  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "read_mongo requires the 'pymongo' package, which is not "
+                "installed in this environment") from e
+        self.uri, self.database, self.collection = uri, database, collection
+        self.pipeline = pipeline
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        uri, db, coll, pipeline = (self.uri, self.database, self.collection,
+                                   self.pipeline)
+
+        def fn():
+            import pymongo
+
+            client = pymongo.MongoClient(uri)
+            try:
+                c = client[db][coll]
+                docs = list(c.aggregate(pipeline) if pipeline else c.find())
+                for d in docs:
+                    d.pop("_id", None)
+                cols = sorted({k for d in docs for k in d})
+                yield BlockAccessor.batch_to_block(
+                    {k: np.asarray([d.get(k) for d in docs], dtype=object)
+                     for k in cols})
+            finally:
+                client.close()
+
+        return [ReadTask(fn, BlockMetadata(num_rows=-1, size_bytes=0,
+                                           input_files=[f"{db}.{coll}"]))]
+
+
+class IcebergDatasource(Datasource):
+    """Iceberg table scan (reference _internal/datasource/iceberg_datasource.py).
+    'pyiceberg' is optional; absence raises at read time."""
+
+    def __init__(self, table_identifier: str, catalog_kwargs: Optional[Dict] = None,
+                 row_filter=None, selected_fields: Optional[List[str]] = None):
+        try:
+            import pyiceberg  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "read_iceberg requires the 'pyiceberg' package, which is not "
+                "installed in this environment") from e
+        self.table_identifier = table_identifier
+        self.catalog_kwargs = catalog_kwargs or {}
+        self.row_filter = row_filter
+        self.selected_fields = selected_fields
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        ident, ckw = self.table_identifier, self.catalog_kwargs
+        row_filter, fields = self.row_filter, self.selected_fields
+
+        def fn():
+            from pyiceberg.catalog import load_catalog
+
+            table = load_catalog(**ckw).load_table(ident)
+            scan_kw = {}
+            if row_filter is not None:
+                scan_kw["row_filter"] = row_filter
+            if fields:
+                scan_kw["selected_fields"] = tuple(fields)
+            yield table.scan(**scan_kw).to_arrow()
+
+        return [ReadTask(fn, BlockMetadata(num_rows=-1, size_bytes=0,
+                                           input_files=[ident]))]
+
+
+class DeltaSharingDatasource(Datasource):
+    """Delta Sharing table read (reference _internal/datasource/
+    delta_sharing_datasource.py). 'delta-sharing' is optional; absence raises
+    at read time."""
+
+    def __init__(self, url: str, limit: Optional[int] = None):
+        try:
+            import delta_sharing  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "read_delta_sharing_tables requires the 'delta-sharing' "
+                "package, which is not installed in this environment") from e
+        self.url = url
+        self.limit = limit
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        url, limit = self.url, self.limit
+
+        def fn():
+            import delta_sharing
+
+            df = delta_sharing.load_as_pandas(url, limit=limit)
+            yield BlockAccessor.batch_to_block(
+                {c: df[c].to_numpy() for c in df.columns})
+
+        return [ReadTask(fn, BlockMetadata(num_rows=-1, size_bytes=0,
+                                           input_files=[url]))]
+
+
 class NumpyDatasource(Datasource):
     def __init__(self, arrays: Dict[str, np.ndarray]):
         self.arrays = arrays

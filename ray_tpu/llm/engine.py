@@ -370,7 +370,9 @@ class JaxLLMEngine(LLMEngine):
         if dp.available and not force_host:
             # plane-level ttl: backstop for a decode replica that crashes
             # before acking (the engine's own tracker prunes sooner)
-            handle = dp.export({"k": k, "v": v}, ttl_s=600.0)
+            from ray_tpu.config import CONFIG as _CFG
+
+            handle = dp.export({"k": k, "v": v}, ttl_s=_CFG.pd_export_ttl_s)
             self._track_pd_export(handle.key)
             out["kv_handle"] = handle
             out["kv_key"] = handle.key.hex()
@@ -379,16 +381,22 @@ class JaxLLMEngine(LLMEngine):
             out["v"] = np.asarray(v)
         return out
 
-    def _track_pd_export(self, key: bytes, max_live: int = 128,
-                         ttl_s: float = 300.0) -> None:
+    def _track_pd_export(self, key: bytes, max_live: int = None,
+                         ttl_s: float = None) -> None:
         """Exports pin device KV until the decode side's pull acks (fetch
         release=True); this LRU/TTL prune is the backstop for crashed consumers.
         Guarded by the engine lock: prefill and decode-ack run on different
-        request threads."""
+        request threads. Defaults from CONFIG: pd_export_max_live, and half of
+        pd_export_ttl_s so the engine prunes before the plane-level backstop."""
         import time as _time
 
+        from ray_tpu.config import CONFIG as _CFG
         from ray_tpu.core import device_plane as _dp
 
+        if max_live is None:
+            max_live = _CFG.pd_export_max_live
+        if ttl_s is None:
+            ttl_s = _CFG.pd_export_ttl_s / 2
         now = _time.monotonic()
         stale = []
         with self._lock:
@@ -409,10 +417,15 @@ class JaxLLMEngine(LLMEngine):
         for old in stale:
             _dp.plane().release(old)
 
-    def _pd_prune_loop(self, interval_s: float = 30.0, ttl_s: float = 300.0) -> None:
+    def _pd_prune_loop(self, interval_s: float = 30.0,
+                       ttl_s: float = None) -> None:
         import time as _time
 
+        from ray_tpu.config import CONFIG as _CFG
         from ray_tpu.core import device_plane as _dp
+
+        if ttl_s is None:
+            ttl_s = _CFG.pd_export_ttl_s / 2
 
         while not getattr(self, "_shutdown", False):
             _time.sleep(interval_s)
@@ -1137,7 +1150,9 @@ class JaxLLMEngine(LLMEngine):
                 if any(r is not None for r in self._active.values()):
                     self._step_decode()
                 else:
-                    self._wakeup.wait(timeout=0.05)
+                    from ray_tpu.config import CONFIG as _CFG
+
+                    self._wakeup.wait(timeout=_CFG.llm_engine_idle_wait_s)
                     self._wakeup.clear()
             except Exception:
                 import traceback

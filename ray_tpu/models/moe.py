@@ -31,7 +31,10 @@ from .config import ModelConfig
 # Tokens per dispatch group: dispatch/combine tensors are [g, E, C] with C ∝ g/E,
 # so per-group memory is O(g²) and total is O(T·g) — bounded, unlike one [T, E, C]
 # block whose memory grows as O(T²).
-MOE_GROUP_SIZE = 4096
+def _moe_group_size() -> int:
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.moe_group_size
 
 
 def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
@@ -40,10 +43,11 @@ def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
 
 
 def _group_size(t: int) -> int:
-    """Largest divisor of t that is <= MOE_GROUP_SIZE (t and groups stay static)."""
-    if t <= MOE_GROUP_SIZE:
+    """Largest divisor of t that is <= the group-size flag (static shapes)."""
+    cap = _moe_group_size()
+    if t <= cap:
         return t
-    for g in range(MOE_GROUP_SIZE, 0, -1):
+    for g in range(cap, 0, -1):
         if t % g == 0:
             return g
     return t

@@ -76,19 +76,24 @@ def quantize_llama_params(params: dict) -> dict:
     out = dict(params)
     layers = params["layers"]
 
-    def _maybe_quant(name, p):
+    def _maybe_quant(name, p, layer_keys):
         if name not in LLAMA_QUANT_KEYS:
             return p
+        # Expert-stacked weights exist only in MoE layers (marked by their
+        # "router") and only for the MLP keys — the attention projections are
+        # rank-3 too ([d_in, heads, head_dim]), so rank alone cannot decide.
+        expert = "router" in layer_keys and name in ("w_gate", "w_up", "w_down")
+        axis = 1 if expert else 0  # experts: [E, d_in, out] contracts d_in
         if isinstance(layers, dict):  # scanned: leading layer axis
-            axis = 1 if p.ndim == 4 else 0  # [L,E,din,out] experts contract din
             return jax.vmap(lambda w: quantize(w, axis))(p)
-        axis = 1 if p.ndim == 3 else 0  # [E,din,out] experts contract din
         return quantize(p, axis)
 
     if isinstance(layers, dict):
-        out["layers"] = {k: _maybe_quant(k, v) for k, v in layers.items()}
+        out["layers"] = {k: _maybe_quant(k, v, layers.keys())
+                         for k, v in layers.items()}
     else:
-        out["layers"] = [{k: _maybe_quant(k, v) for k, v in lyr.items()}
+        out["layers"] = [{k: _maybe_quant(k, v, lyr.keys())
+                          for k, v in lyr.items()}
                          for lyr in layers]
     if "lm_head" in params:
         # the untied head [d_model, vocab] is often the single largest weight

@@ -29,17 +29,20 @@ class Learner(CollectiveActorMixin):
         self.metrics: Dict[str, Any] = {}
 
     def build(self) -> None:
-        import jax
         import optax
 
+        # params/opt_state stay DEVICE-RESIDENT between updates: fetching them
+        # to host every update() (and re-uploading every minibatch) costs more
+        # than the update itself on real accelerators — brutal via a network
+        # tunnel. get_weights/get_state materialize numpy on demand.
         self.params = self.module.init_params(seed=self.config.seed or 0)
-        self.params = jax.tree_util.tree_map(lambda a: np.asarray(a), self.params)
         clip = self.config.grad_clip
         tx = [optax.clip_by_global_norm(clip)] if clip else []
         tx.append(optax.adam(self.config.lr))
         self.optimizer = optax.chain(*tx)
         self.opt_state = self.optimizer.init(self.params)
         self._update_fn = self._build_update_fn()
+        self._fused_update_fn = self._build_fused_update_fn()
 
     # -- to be provided by algo-specific learners ------------------------------
     def compute_losses(self, params, batch: Dict[str, Any]):
@@ -61,6 +64,28 @@ class Learner(CollectiveActorMixin):
             return loss, aux, grads
 
         return update
+
+    def _build_fused_update_fn(self):
+        """Single-learner fast path: loss -> grads -> optax -> new params in
+        ONE jitted program (one device dispatch per minibatch). Multi-learner
+        keeps the split path so the grad allreduce can run between."""
+        import jax
+        import optax
+
+        def loss_fn(params, batch):
+            loss, aux = self.compute_losses(params, batch)
+            return loss, aux
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        @jax.jit
+        def step(params, opt_state, batch):
+            (loss, aux), grads = grad_fn(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        return step
 
     # -- collective group (multi-learner DDP analog) ---------------------------
     def setup_collective(self, group_name: str) -> None:
@@ -101,21 +126,38 @@ class Learner(CollectiveActorMixin):
             for start in range(0, n - mb + 1, mb):
                 idx = perm[start : start + mb]
                 mbatch = {k: v[idx] for k, v in batch.items() if isinstance(v, np.ndarray) and len(v) == n}
-                loss, aux, grads = self._update_fn(self.params, mbatch)
-                grads = self._sync_grads(grads)
-                updates, self.opt_state = self.optimizer.update(grads, self.opt_state, self.params)
-                import optax
+                if self._group_name is not None:
+                    loss, aux, grads = self._update_fn(self.params, mbatch)
+                    grads = self._sync_grads(grads)
+                    updates, self.opt_state = self.optimizer.update(
+                        grads, self.opt_state, self.params)
+                    import optax
 
-                self.params = optax.apply_updates(self.params, updates)
-                losses.append(float(loss))
-                aux_out = {k: float(v) for k, v in aux.items()}
-        self.params = jax.tree_util.tree_map(lambda a: np.asarray(a), self.params)
-        self.metrics = {"total_loss": float(np.mean(losses)), **aux_out}
+                    self.params = optax.apply_updates(self.params, updates)
+                else:
+                    self.params, self.opt_state, loss, aux = self._fused_update_fn(
+                        self.params, self.opt_state, mbatch)
+                losses.append(loss)
+                aux_out = aux
+        # ONE host sync for the whole update, after every minibatch dispatched
+        self.metrics = {
+            "total_loss": float(np.mean([float(l) for l in losses])),
+            **{k: float(v) for k, v in aux_out.items()},
+        }
         return self.metrics
 
     # -- state ----------------------------------------------------------------
+    def _host_params(self):
+        import jax
+
+        return jax.tree_util.tree_map(lambda a: np.asarray(a), self.params)
+
     def get_state(self) -> Dict[str, Any]:
-        return {"params": self.params, "opt_state": self.opt_state}
+        import jax
+
+        return {"params": self._host_params(),
+                "opt_state": jax.tree_util.tree_map(lambda a: np.asarray(a),
+                                                    self.opt_state)}
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.params = state["params"]
@@ -123,7 +165,7 @@ class Learner(CollectiveActorMixin):
             self.opt_state = state["opt_state"]
 
     def get_weights(self):
-        return self.params
+        return self._host_params()
 
     def ping(self) -> bool:
         return True

@@ -6,9 +6,11 @@ execution; kept for an actor's lifetime), ``py_modules`` (local paths prepended 
 sys.path), ``working_dir`` (chdir for the duration), ``pip`` and ``uv``
 (per-env package overlays, content-hash cached in the session dir — reference
 _private/runtime_env/pip.py + uv.py + uri_cache.py; work offline with local
-package paths / --find-links; ``uv`` requires the uv binary on PATH).
-Image plugins (conda/container/image_uri) are validated and rejected explicitly
-rather than silently ignored.
+package paths / --find-links; ``uv`` requires the uv binary on PATH),
+``container``/``image_uri`` (the worker runs INSIDE the named image via
+docker/podman with the session dir mounted — core/container.py; reference
+_private/runtime_env/image_uri.py). ``conda`` is validated and rejected
+explicitly rather than silently ignored (no conda in this environment).
 """
 from __future__ import annotations
 
@@ -21,8 +23,9 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-_SUPPORTED = {"env_vars", "py_modules", "working_dir", "pip", "uv"}
-_UNSUPPORTED = {"conda", "container", "image_uri"}
+_SUPPORTED = {"env_vars", "py_modules", "working_dir", "pip", "uv",
+              "container", "image_uri"}
+_UNSUPPORTED = {"conda"}
 
 
 class RuntimeEnv(dict):
@@ -32,7 +35,9 @@ class RuntimeEnv(dict):
                  py_modules: Optional[List[str]] = None,
                  working_dir: Optional[str] = None,
                  pip: Optional[Any] = None,
-                 uv: Optional[Any] = None, **kwargs):
+                 uv: Optional[Any] = None,
+                 container: Optional[Dict[str, Any]] = None,
+                 image_uri: Optional[str] = None, **kwargs):
         super().__init__()
         bad = set(kwargs) & _UNSUPPORTED
         if bad:
@@ -51,6 +56,15 @@ class RuntimeEnv(dict):
             self["py_modules"] = [str(p) for p in py_modules]
         if working_dir:
             self["working_dir"] = str(working_dir)
+        if container or image_uri:
+            from ray_tpu.core.container import normalize_container_spec
+
+            normalize_container_spec(  # validate eagerly (raises ValueError)
+                {"container": container, "image_uri": image_uri})
+            if container:
+                self["container"] = dict(container)
+            if image_uri:
+                self["image_uri"] = str(image_uri)
         for field, spec in (("pip", pip), ("uv", uv)):
             if not spec:
                 continue

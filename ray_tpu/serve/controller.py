@@ -368,7 +368,9 @@ class ServeController:
                 self._publish_changes()
             except Exception:
                 pass
-            time.sleep(0.2)
+            from ray_tpu.config import CONFIG as _CFG
+
+            time.sleep(_CFG.serve_reconcile_interval_s)
 
     # -- long-poll host (reference LongPollHost) --------------------------------
     def _publish_changes(self) -> None:

@@ -143,7 +143,15 @@ class GrpcProxyActor:
         # against a router that forwards typed messages to deployments
         for add_fn in grpc_servicer_functions or ():
             add_fn(_RoutingServicer(route_typed), self._server)
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        from ray_tpu.config import CONFIG
+
+        if CONFIG.serve_ingress_tls:
+            from ray_tpu.core.tls_utils import ingress_grpc_credentials
+
+            self.port = self._server.add_secure_port(
+                f"{host}:{port}", ingress_grpc_credentials())
+        else:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
         if self.port == 0:
             raise OSError(f"gRPC proxy failed to bind {host}:{port}")
         self._server.start()

@@ -198,7 +198,14 @@ class ProxyActor:
         app.router.add_route("*", "/{tail:.*}", handler)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, self.host, self.port)
+        ssl_ctx = None
+        from ray_tpu.config import CONFIG
+
+        if CONFIG.serve_ingress_tls:
+            from ray_tpu.core.tls_utils import ingress_ssl_context
+
+            ssl_ctx = ingress_ssl_context()
+        site = web.TCPSite(runner, self.host, self.port, ssl_context=ssl_ctx)
         loop.run_until_complete(site.start())
         self._ready.set()
         loop.run_forever()

@@ -256,3 +256,72 @@ class TPESearcher(Searcher):
 # search/bohb/bohb_search.py): compose TPESearcher with
 # schedulers.HyperBandScheduler for that behavior. There is deliberately no
 # TuneBOHB name here — an alias would promise an algorithm that isn't one.
+
+
+class OptunaSearch(Searcher):
+    """Adapter onto an Optuna study — the external-searcher seam the reference
+    exposes (python/ray/tune/search/optuna/optuna_search.py: OptunaSearch maps
+    Tune spaces onto optuna distributions via study.ask()/tell()). The native
+    search-space Domains translate directly; `optuna` is an OPTIONAL dependency
+    and importing this class without it raises with an install hint.
+
+    Usage: Tuner(trainable, param_space=space,
+                 tune_config=TuneConfig(search_alg=OptunaSearch(space))).fit()
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str = "loss",
+                 mode: str = "min", seed: Optional[int] = None,
+                 sampler: Any = None, study: Any = None):
+        try:
+            import optuna
+        except ImportError as e:  # pragma: no cover - exercised when installed
+            raise ImportError(
+                "OptunaSearch requires the optional 'optuna' package "
+                "(pip install optuna); the native TPESearcher needs no extra "
+                "dependency and covers the same algorithm family") from e
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        for k, dom in param_space.items():
+            if isinstance(dom, GridSearch):
+                raise ValueError(
+                    f"OptunaSearch does not support grid_search (key {k!r}); "
+                    "use BasicVariantGenerator for grids")
+        self.space = dict(param_space)
+        self.metric, self.mode = metric, mode
+        optuna.logging.set_verbosity(optuna.logging.WARNING)
+        self.study = study or optuna.create_study(
+            direction="minimize" if mode == "min" else "maximize",
+            sampler=sampler or optuna.samplers.TPESampler(seed=seed))
+        self._rng = random.Random(seed)
+        self._live: Dict[str, Any] = {}  # trial_id -> optuna trial
+
+    def _suggest_param(self, trial, key: str, dom: Any):
+        if isinstance(dom, LogUniform):
+            return trial.suggest_float(key, dom.low, dom.high, log=True)
+        if isinstance(dom, Uniform):
+            return trial.suggest_float(key, dom.low, dom.high)
+        if isinstance(dom, RandInt):
+            return trial.suggest_int(key, dom.low, dom.high - 1)  # high exclusive
+        if isinstance(dom, Choice):
+            return trial.suggest_categorical(key, dom.categories)
+        if isinstance(dom, Function):
+            return dom.sample(self._rng)  # opaque to the optuna model
+        return dom  # constant
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        trial = self.study.ask()
+        self._live[trial_id] = trial
+        return {k: self._suggest_param(trial, k, dom)
+                for k, dom in self.space.items()}
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None) -> None:
+        trial = self._live.pop(trial_id, None)
+        if trial is None:
+            return
+        import optuna
+
+        value = (result or {}).get(self.metric)
+        if value is None:  # errored/early-stopped with no metric: tell FAIL
+            self.study.tell(trial, state=optuna.trial.TrialState.FAIL)
+        else:
+            self.study.tell(trial, float(value))

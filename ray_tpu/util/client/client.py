@@ -30,8 +30,6 @@ _NO_REPLY = {"decref", "kill_actor", "push_metrics", "push_spans", "push_tqdm",
 class ClientContext:
     def __init__(self, address: str, authkey: Optional[bytes] = None,
                  timeout: Optional[float] = None):
-        from multiprocessing.connection import Client
-
         import queue
 
         if authkey is None:
@@ -40,7 +38,12 @@ class ClientContext:
             # for loopback servers started with an explicit DEFAULT_AUTHKEY
             authkey = load_authkey() or DEFAULT_AUTHKEY
         host, _, port = address.rpartition(":")
-        self._conn = Client((host or "127.0.0.1", int(port)), authkey=authkey)
+        # secure_transport.dial: mTLS under RAY_TPU_USE_TLS (the server refuses
+        # plaintext there), plain mp Client otherwise
+        from ray_tpu.core.secure_transport import dial
+
+        self._conn = dial((host or "127.0.0.1", int(port)), authkey=authkey,
+                          timeout=timeout)
         self._req_counter = itertools.count()
         self._pending: Dict[int, Tuple[threading.Event, list]] = {}
         self._pending_lock = threading.Lock()

@@ -7,9 +7,11 @@ doesn't starve others on the same connection.
 """
 from __future__ import annotations
 
+import multiprocessing as _mp
 import os
 import secrets
 import threading
+import time
 from typing import Optional
 
 # legacy well-known key: acceptable only on loopback (anyone reaching the port
@@ -105,7 +107,20 @@ class ClientServer:
                     "session dir; share via RAY_TPU_CLIENT_AUTHKEY on remote drivers).")
             _persist_authkey(authkey)  # keep session-dir discovery in sync
         self.authkey = authkey
-        self._listener = Listener((host, port), authkey=authkey)  # port 0 = ephemeral
+        from ray_tpu.core import tls_utils
+
+        # Under RAY_TPU_USE_TLS the ray-tpu:// port speaks mTLS like every
+        # other inter-node plane (reference: the gRPC client proxy inherits
+        # RAY_USE_TLS, python/ray/_private/tls_utils.py:68); plaintext dials
+        # fail the handshake before a single protocol byte. The mp challenge
+        # auth still runs over the encrypted channel.
+        self._tls = tls_utils.use_tls()
+        if self._tls:
+            from ray_tpu.core.secure_transport import make_listener
+
+            self._listener = make_listener((host, port))
+        else:
+            self._listener = Listener((host, port), authkey=authkey)  # port 0 = ephemeral
         self.address = self._listener.address
         self.port = self.address[1]
         self._shutdown = False
@@ -118,13 +133,38 @@ class ClientServer:
         while not self._shutdown:
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError):
-                break
+            except (OSError, EOFError, _mp.AuthenticationError):
+                if self._shutdown:
+                    break
+                time.sleep(0.05)  # bad dial / wrong key: keep serving others
+                continue
             self._conns.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="client-server-conn").start()
 
     def _serve_conn(self, conn) -> None:
+        if self._tls:
+            # the deferred TLS handshake + mp challenge run HERE, on the
+            # per-connection thread — a silent or plaintext dialer must stall
+            # only its own connection, never the accept loop (mp.Listener runs
+            # the challenge inside accept(); the TLS listener defers it).
+            try:
+                from multiprocessing.connection import (
+                    answer_challenge, deliver_challenge)
+
+                deliver_challenge(conn, self.authkey)
+                answer_challenge(conn, self.authkey)
+            except (OSError, EOFError, _mp.AuthenticationError):
+                # close the half-open socket so the failed dialer sees EOF
+                # instead of blocking forever
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                return
+        self._serve_authed(conn)
+
+    def _serve_authed(self, conn) -> None:
         from ray_tpu.core import global_state
 
         send_lock = threading.Lock()

@@ -26,6 +26,12 @@ from .types import Backend, ReduceOp
 _NAMESPACE = "ray_tpu.collective"
 
 
+def _op_timeout() -> float:
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.collective_op_timeout_s
+
+
 @dataclass
 class _GroupState:
     name: str
@@ -278,7 +284,7 @@ def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
             return _like(out, tensor)
     key = st.next_key("allreduce")
     st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=30.0)
+    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout())
     return _like(_reduce(parts, op), tensor)
 
 
@@ -286,7 +292,7 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp 
     st = _state(group_name)
     key = st.next_key("reduce")
     st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=30.0)
+    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout())
     if st.rank == dst_rank:
         return _like(_reduce(parts, op), tensor)
     return tensor
@@ -297,7 +303,7 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     key = st.next_key("broadcast")
     if st.rank == src_rank:
         st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=30.0, expected=1)
+    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout(), expected=1)
     return _like(np.asarray(parts[0]), tensor)
 
 
@@ -307,7 +313,7 @@ def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     st = _state(group_name)
     key = st.next_key("allgather")
     st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    return wait_poll(st.coordinator, key, st.rank, timeout_s=30.0)
+    return wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout())
 
 
 def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
@@ -315,7 +321,7 @@ def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.S
     st = _state(group_name)
     key = st.next_key("reducescatter")
     st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=30.0)
+    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout())
     full = _reduce(parts, op)
     if full.shape[0] % st.world_size != 0:
         raise ValueError(
@@ -333,7 +339,7 @@ def barrier(group_name: str = "default") -> None:
 def _barrier_impl(st: _GroupState, key: Optional[str] = None) -> None:
     key = key or st.next_key("barrier")
     st.coordinator.contribute.remote(key, st.rank, None)
-    wait_poll(st.coordinator, key, st.rank, timeout_s=60.0)
+    wait_poll(st.coordinator, key, st.rank, timeout_s=2 * _op_timeout())
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
@@ -345,7 +351,7 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
 def recv(tensor, src_rank: int, group_name: str = "default"):
     st = _state(group_name)
     key = st.next_key("p2p", extra=f"{src_rank}->{st.rank}")
-    payload = wait_poll_one(st.coordinator, key, st.rank, src_rank, timeout_s=30.0)
+    payload = wait_poll_one(st.coordinator, key, st.rank, src_rank, timeout_s=_op_timeout())
     return _like(np.asarray(payload), tensor)
 
 
@@ -406,5 +412,5 @@ def _bootstrap_xla(st: _GroupState) -> None:
     joined = jax.distributed.is_initialized() and jax.process_count() == st.world_size
     key = f"__xla_plane__:{st.name}"
     st.coordinator.contribute.remote(key, st.rank, bool(joined))
-    flags = wait_poll(st.coordinator, key, st.rank, timeout_s=60.0)
+    flags = wait_poll(st.coordinator, key, st.rank, timeout_s=2 * _op_timeout())
     st.xla_device_plane = all(bool(f) for f in flags)

@@ -107,3 +107,25 @@ def test_engine_rejects_unknown_quantization():
     with pytest.raises(ValueError, match="quantization"):
         eng.start()
     eng.shutdown()
+
+
+def test_attention_projections_quantize_over_d_in():
+    """wq [d_in, heads, head_dim] is rank-3 like an expert stack but must
+    quantize over d_in (axis 0) — per-(head, head_dim-unit) scales, NOT
+    per-(head-as-expert) (regression: rank-based axis detection)."""
+    cfg = get_config("test-tiny", dtype="float32")
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    q = quantize_llama_params(params)
+    wq = q["layers"]["wq"]  # scanned: [L, d_in, heads, head_dim]
+    L, d_in, H, HD = params["layers"]["wq"].shape
+    assert wq.s.shape == (L, 1, H, HD), wq.s.shape
+    # and MoE expert weights still contract d_in (axis 1 of [E, d_in, out])
+    moe_cfg = get_config("moe-tiny")
+    moe_params = llama.init(jax.random.PRNGKey(1), moe_cfg)
+    mq = quantize_llama_params(moe_params)
+    wg = mq["layers"]["w_gate"]  # scanned: [L, E, d_in, d_ff]
+    Lm, E, D, F = moe_params["layers"]["w_gate"].shape
+    assert wg.s.shape == (Lm, E, 1, F), wg.s.shape
+    # attention in the MoE model is dense: contracts d_in
+    mwq = mq["layers"]["wq"]
+    assert mwq.s.shape[1] == 1, mwq.s.shape

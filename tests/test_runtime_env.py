@@ -144,12 +144,13 @@ def test_task_with_uv_runtime_env(rt, tmp_path, monkeypatch):
         import rtenv_uv_task  # noqa: F401  (driver env stays clean)
 
 
-def test_conda_container_still_rejected():
+def test_conda_still_rejected():
+    """conda remains unsupported (no conda in this environment); container and
+    image_uri are real features now (see the container tests below)."""
     from ray_tpu.runtime_env import RuntimeEnv
 
-    for field in ("conda", "container", "image_uri"):
-        with pytest.raises(ValueError, match="infrastructure"):
-            RuntimeEnv(**{field: {"x": 1}})
+    with pytest.raises(ValueError, match="infrastructure"):
+        RuntimeEnv(conda={"x": 1})
 
 
 def test_merge_runtime_envs():
@@ -213,3 +214,100 @@ def test_job_level_default_runtime_env(default_renv_cluster):
         return ray_tpu.get(inner.remote())
 
     assert ray_tpu.get(outer.remote()) == "yes"
+
+
+def test_container_runtime_env_records_invocation_and_runs(rt, tmp_path, monkeypatch):
+    """container/image_uri runtime env (reference
+    _private/runtime_env/image_uri.py): the worker is launched through the
+    container runtime with the session dir mounted and dials back over the
+    socket protocol. A recording fake runtime (RAY_TPU_CONTAINER_RUNTIME — the
+    documented test seam) captures the exact docker-style invocation, then
+    execs the worker command so the task completes end to end."""
+    import json
+    import stat
+    import sys
+
+    fake = tmp_path / "fake_docker.py"
+    log = tmp_path / "invocations.jsonl"
+    fake.write_text(f"""#!{sys.executable}
+import json, os, sys
+args = sys.argv[1:]
+with open({str(log)!r}, "a") as f:
+    f.write(json.dumps(args) + "\\n")
+assert args[0] == "run"
+i = 1
+env = {{}}
+while i < len(args):
+    a = args[i]
+    if a == "--rm":
+        i += 1
+    elif a in ("--network",):
+        i += 2
+    elif a == "-v":
+        i += 2
+    elif a == "--env":
+        k, _, v = args[i + 1].partition("=")
+        env[k] = v
+        i += 2
+    elif a.startswith("--"):
+        i += 1
+    else:
+        break
+image = args[i]
+cmd = args[i + 1:]
+os.environ.update(env)
+os.execvp(cmd[0], cmd)
+""")
+    fake.chmod(fake.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv("RAY_TPU_CONTAINER_RUNTIME", str(fake))
+
+    @rt.remote(num_cpus=0.5, runtime_env={
+        "image_uri": "example.com/tpu-image:1",
+        "env_vars": {"CONTAINER_MARK": "inside"}})
+    def inside():
+        import os
+
+        return os.environ.get("CONTAINER_MARK"), os.getpid()
+
+    mark, pid = rt.get(inside.remote(), timeout=120)
+    assert mark == "inside" and pid != 0
+
+    # the recorded invocation is a real docker/podman-shaped command line
+    lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+    assert len(lines) == 1
+    argv = lines[0]
+    assert argv[0] == "run" and "--rm" in argv and "--network" in argv
+    assert "example.com/tpu-image:1" in argv
+    from ray_tpu.job.manager import default_session_dir
+
+    sess = default_session_dir()
+    assert f"{sess}:{sess}" in argv  # session dir mounted
+    img_i = argv.index("example.com/tpu-image:1")
+    assert argv[img_i + 1:img_i + 4] == ["python", "-m", "ray_tpu.core.worker"]
+
+    # container/conda validation: conda still refused, bad container rejected
+    import pytest as _pytest
+
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    with _pytest.raises(ValueError, match="conda"):
+        RuntimeEnv(conda={"dependencies": ["x"]})
+    with _pytest.raises(ValueError, match="container"):
+        RuntimeEnv(container={"run_options": ["--gpus=all"]})  # no image
+    RuntimeEnv(image_uri="img:1")  # accepted
+
+
+def test_container_runtime_missing_fails_task_cleanly(rt, monkeypatch):
+    """No docker/podman anywhere: the task fails with a clear error instead of
+    pending forever (reference: runtime-env agent setup errors fail the task)."""
+    monkeypatch.setenv("RAY_TPU_CONTAINER_RUNTIME", "")
+    monkeypatch.setenv("PATH", "/nonexistent")
+    try:
+        @rt.remote(num_cpus=0.5, runtime_env={"image_uri": "img:1"})
+        def f():
+            return 1
+
+        with pytest.raises(Exception, match="container runtime"):
+            rt.get(f.remote(), timeout=60)
+    finally:
+        pass

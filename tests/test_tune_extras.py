@@ -109,3 +109,34 @@ def test_tuner_with_tpe_searcher_end_to_end(rt):
     results = tuner.fit()
     best = results.get_best_result(metric="loss", mode="min")
     assert best.metrics["loss"] < 0.2
+
+
+def test_optuna_search_adapter_end_to_end(rt):
+    """OptunaSearch (reference search/optuna/optuna_search.py): the external
+    searcher drives Tuner suggestions via study.ask()/tell()."""
+    pytest.importorskip("optuna", reason="optuna not installed "
+                        "(optional external-searcher dependency)")
+    space = {"x": tune.uniform(0.0, 1.0),
+             "opt": tune.choice(["adam", "sgd"]),
+             "lr": tune.loguniform(1e-5, 1e-1),
+             "layers": tune.randint(1, 4)}
+    s = tune.OptunaSearch(space, metric="loss", mode="min", seed=3)
+    for i in range(15):
+        cfg = s.suggest(f"t{i}")
+        assert 0.0 <= cfg["x"] <= 1.0 and cfg["opt"] in ("adam", "sgd")
+        assert 1e-5 <= cfg["lr"] <= 1e-1 and cfg["layers"] in (1, 2, 3)
+        s.on_trial_complete(f"t{i}", {"loss": (cfg["x"] - 0.7) ** 2})
+    assert len(s.study.trials) >= 15
+
+    def objective(config):
+        tune.report({"loss": (config["x"] - 0.5) ** 2})
+
+    tuner = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(
+            num_samples=6, metric="loss", mode="min",
+            search_alg=tune.OptunaSearch(space, metric="loss", mode="min",
+                                         seed=4)))
+    results = tuner.fit()
+    best = results.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 0.25
