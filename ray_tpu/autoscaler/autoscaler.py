@@ -13,12 +13,15 @@ slices must fit atomically, the TPU analogue of STRICT_PACK on `TPU-...-head`).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
 from .node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -81,6 +84,45 @@ class Autoscaler:
         self._stop = threading.Event()
         self._idle_since: Dict[object, float] = {}
         self._thread: Optional[threading.Thread] = None
+        # per-node-type launch backoff (quota/stockout/transient failures):
+        # {node_type: (next_attempt_ts, current_backoff_s)}
+        self._launch_backoff: Dict[str, tuple] = {}
+        # last classified failure per node type, for observability/tests
+        self.launch_failures: Dict[str, str] = {}
+
+    def _launch(self, node_type: str) -> bool:
+        """create_node with classified-failure handling: on a retryable
+        NodeLaunchError (quota/stockout/rate-limit/unknown) the node type goes
+        into capped exponential backoff instead of being hammered every
+        reconcile tick; on a permanent one it backs off at the cap so a
+        misconfigured type cannot spin the loop, while the error stays visible
+        in launch_failures. Reference: autoscaler v2 instance_manager's launch
+        failure handling + node_launcher exponential backoff."""
+        from ray_tpu.config import CONFIG
+
+        from .launcher import NodeLaunchError
+
+        now = time.time()
+        entry = self._launch_backoff.get(node_type)
+        if entry is not None and now < entry[0]:
+            return False  # still cooling down
+        try:
+            self.provider.create_node(node_type)
+        except NodeLaunchError as e:
+            prev = entry[1] if entry is not None else 0.0
+            base = max(e.backoff_hint_s, float(CONFIG.provision_backoff_s))
+            cap = float(CONFIG.launch_backoff_max_s)
+            backoff = min(cap, max(base, prev * 2.0))
+            if not e.retryable:
+                backoff = cap
+            self._launch_backoff[node_type] = (now + backoff, backoff)
+            self.launch_failures[node_type] = f"{e.kind}: {e}"
+            logger.warning("launch of %s failed (%s); backing off %.0fs",
+                           node_type, e.kind, backoff)
+            return False
+        self._launch_backoff.pop(node_type, None)
+        self.launch_failures.pop(node_type, None)
+        return True
 
     # -- demand/cluster views ----------------------------------------------------
     def pending_demands(self) -> List[Dict[str, float]]:
@@ -122,16 +164,15 @@ class Autoscaler:
                 have = self._provider_count(node_type)
                 count = min(count, max(0, t.max_nodes - have),
                             self.config.max_concurrent_launches)
-                for _ in range(count):
-                    self.provider.create_node(node_type)
-                if count:
-                    launched[node_type] = count
+                done = sum(1 for _ in range(count) if self._launch(node_type))
+                if done:
+                    launched[node_type] = done
 
         # min_nodes floors
         for t in self.provider.node_types.values():
             deficit = t.min_nodes - self._provider_count(t.name)
             for _ in range(max(0, deficit)):
-                self.provider.create_node(t.name)
+                self._launch(t.name)
 
         self._terminate_idle()
         return launched

@@ -13,14 +13,82 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import random
 import subprocess
+import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .autoscaler import Autoscaler, AutoscalingConfig
 from .node_provider import FakeNodeProvider, NodeInstance, NodeProvider, NodeType
 
 logger = logging.getLogger(__name__)
+
+
+class NodeLaunchError(RuntimeError):
+    """A classified node-provision failure (reference: autoscaler v2
+    instance_manager launch-failure handling + node_launcher.py's
+    NodeLaunchException). `kind` is the taxonomy bucket, `retryable` says
+    whether launching the same node type later can succeed without operator
+    action, and `backoff_hint_s` is the provider's suggested wait."""
+
+    def __init__(self, message: str, *, kind: str, retryable: bool,
+                 backoff_hint_s: float = 30.0):
+        super().__init__(message)
+        self.kind = kind
+        self.retryable = retryable
+        self.backoff_hint_s = backoff_hint_s
+
+
+# Provision-failure taxonomy: (kind, inline_retry, retryable, backoff_hint_s,
+# lowercase stderr substrings). Order matters — first match wins. Patterns come
+# from GCP API error semantics (googleapis.com error model) as surfaced through
+# gcloud stderr; the reference's GCP provider classifies the same families in
+# python/ray/autoscaler/_private/gcp/node.py (GCPNodeError / retry loops).
+_PROVISION_TAXONOMY: List[Tuple[str, bool, bool, float, Tuple[str, ...]]] = [
+    # API rate limiting: short inline retry with jitter is the correct cure.
+    # Must precede quota: "Rate Limit Exceeded" would otherwise match the
+    # quota bucket's "limit exceeded" and stall scale-up for minutes.
+    ("rate_limit", True, True, 15.0,
+     ("ratelimitexceeded", "rate limit", "too many requests", "429")),
+    # Quota: retrying in seconds never helps; the autoscaler should back off
+    # long and keep the demand queued (operator may raise quota meanwhile).
+    ("quota", False, True, 300.0,
+     ("quota", "resource_exhausted", "limit exceeded")),
+    # Stockout: zone has no capacity for the accelerator right now. Same
+    # handling as quota but distinct for observability — operators respond
+    # differently (wait/queued-resources vs quota increase request).
+    ("stockout", False, True, 120.0,
+     ("no more capacity", "resource pool exhausted",
+      "zone_resource_pool_exhausted", "not enough resources",
+      "insufficient capacity", "stockout", "resources_unavailable",
+      "capacity in the zone")),
+    # Transient service/network hiccups: inline retry.
+    ("transient", True, True, 15.0,
+     ("unavailable", "deadline_exceeded", "deadline exceeded", "timed out",
+      "timeout",
+      "connection reset", "internal error", "backend error",
+      "temporarily", " 500", " 502", " 503")),
+    # Operator-actionable misconfiguration: fail fast, never retry.
+    ("permanent", False, False, 0.0,
+     ("permission_denied", "permission denied", "forbidden", "unauthenticated",
+      "invalid_argument", "invalid value", "not_found", "not found",
+      "does not exist", "already_exists", "already exists", "unsupported")),
+]
+
+
+def classify_provision_error(stderr: str) -> Tuple[str, bool, bool, float]:
+    """Map provider CLI stderr -> (kind, inline_retry, retryable, backoff_hint_s).
+
+    Unknown errors are treated as retryable-with-backoff (not inline): an
+    autoscaler that gives up on demand because of an unrecognized message
+    strands the workload, while capped exponential backoff bounds the cost of
+    retrying a genuinely permanent failure."""
+    low = " " + (stderr or "").lower()
+    for kind, inline, retryable, hint, pats in _PROVISION_TAXONOMY:
+        if any(p in low for p in pats):
+            return kind, inline, retryable, hint
+    return "unknown", False, True, 60.0
 
 
 @dataclasses.dataclass
@@ -167,31 +235,53 @@ class GCPTPUProvider(NodeProvider):
         default_prefix = _rfc1035("-".join(filter(None, ["ray-tpu", cluster_name])))
         self.prefix = self.cfg.get("name_prefix", default_prefix)
         self._counter = 0
+        self._preempted: set = set()  # instance names seen PREEMPTED, un-reaped
 
     def _base_args(self) -> List[str]:
         return [self.gcloud, "compute", "tpus", "tpu-vm"]
 
     def create_node(self, node_type: str) -> NodeInstance:
-        self._counter += 1
-        # GCP resource names are RFC1035 (lowercase/digits/hyphens)
-        name = (f"{self.prefix}-{_rfc1035(node_type)}-{self._counter}-"
-                f"{uuid.uuid4().hex[:6]}")
-        cmd = self._base_args() + [
-            "create", name,
-            "--project", self.cfg["project"],
-            "--zone", self.cfg["zone"],
-            "--accelerator-type", self.cfg["accelerator_type"],
-            "--version", self.cfg["runtime_version"],
-        ] + list(self.cfg.get("create_extra_args", []))
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError as e:
-            # surface gcloud's actual complaint (quota, zone capacity, bad
-            # version) instead of a bare non-zero-exit error (ADVICE r3)
-            raise RuntimeError(
-                f"gcloud create failed (rc={e.returncode}): "
-                f"{(e.stderr or e.stdout or '').strip()[-2000:]}") from e
-        return NodeInstance(instance_id=name, node_type=node_type, status="running")
+        from ray_tpu.config import CONFIG
+
+        max_attempts = max(1, int(self.cfg.get(
+            "create_max_attempts", CONFIG.provision_max_attempts)))
+        backoff = float(CONFIG.provision_backoff_s)
+        for attempt in range(1, max_attempts + 1):
+            self._counter += 1
+            # GCP resource names are RFC1035 (lowercase/digits/hyphens). A
+            # FRESH name per attempt: a timed-out create whose server-side LRO
+            # later completes must not turn the retry into "already exists"
+            # (the orphan from the earlier attempt is invisible to
+            # non_terminated_nodes only until the next list; prefix-scoped
+            # discovery adopts it, and terminate_all/down clean it up).
+            name = (f"{self.prefix}-{_rfc1035(node_type)}-{self._counter}-"
+                    f"{uuid.uuid4().hex[:6]}")
+            cmd = self._base_args() + [
+                "create", name,
+                "--project", self.cfg["project"],
+                "--zone", self.cfg["zone"],
+                "--accelerator-type", self.cfg["accelerator_type"],
+                "--version", self.cfg["runtime_version"],
+            ] + list(self.cfg.get("create_extra_args", []))
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+                return NodeInstance(instance_id=name, node_type=node_type,
+                                    status="running")
+            except subprocess.CalledProcessError as e:
+                # surface gcloud's actual complaint (quota, zone capacity, bad
+                # version) instead of a bare non-zero-exit error (ADVICE r3)
+                stderr = (e.stderr or e.stdout or "").strip()
+                kind, inline, retryable, hint = classify_provision_error(stderr)
+                msg = (f"gcloud create failed (rc={e.returncode}, kind={kind}, "
+                       f"attempt {attempt}/{max_attempts}): {stderr[-2000:]}")
+                if inline and attempt < max_attempts:
+                    sleep_s = backoff * (2 ** (attempt - 1)) * random.uniform(0.7, 1.3)
+                    logger.warning("%s; retrying in %.1fs", msg, sleep_s)
+                    time.sleep(sleep_s)
+                    continue
+                raise NodeLaunchError(msg, kind=kind, retryable=retryable,
+                                      backoff_hint_s=hint) from e
+        raise AssertionError("unreachable")  # loop always returns or raises
 
     def terminate_node(self, instance_id: str) -> None:
         cmd = self._base_args() + [
@@ -208,17 +298,17 @@ class GCPTPUProvider(NodeProvider):
         ]
         proc = subprocess.run(cmd, check=True, capture_output=True, text=True)
         out: List[NodeInstance] = []
+        seen_preempted: set = set()
         for item in json.loads(proc.stdout or "[]"):
             name = item.get("name", "").rsplit("/", 1)[-1]
             if not name.startswith(self.prefix + "-"):
                 continue  # not ours: never adopt someone else's TPUs
-            state = item.get("state", "")
-            if state in ("DELETING", "TERMINATED", "PREEMPTED"):
-                continue
             # name layout: <prefix>-<rfc1035(node_type)>-<counter>-<rand>.
             # The type segment must be one of OUR node types: a prefix match
             # alone would adopt cluster "prod-2"'s nodes from cluster "prod"
-            # (prefixes "ray-tpu-prod-2-..." start with "ray-tpu-prod-")
+            # (prefixes "ray-tpu-prod-2-..." start with "ray-tpu-prod-").
+            # This ownership check gates EVERYTHING below — including the
+            # preemption reaper, which deletes what lands in the set.
             body = name[len(self.prefix) + 1:]
             if body.count("-") < 2:
                 continue
@@ -227,14 +317,52 @@ class GCPTPUProvider(NodeProvider):
                               if _rfc1035(t) == sanitized), None)
             if node_type is None:
                 continue  # someone else's TPU: never adopt, never delete
+            state = item.get("state", "")
+            if state == "PREEMPTED":
+                # remember it so poll() can reap the husk and the autoscaler
+                # relaunches (preempted TPU-VMs stay listed until deleted)
+                seen_preempted.add(name)
+            if state in ("DELETING", "TERMINATED", "PREEMPTED"):
+                continue
             out.append(NodeInstance(instance_id=name, node_type=node_type,
                                     status="running" if state == "READY"
                                     else "requested"))
+        # rebuilt per listing: names deleted out-of-band don't linger forever
+        self._preempted = seen_preempted
         return out
+
+    def preempted_nodes(self) -> List[str]:
+        """Instance names observed in PREEMPTED state since the last reap."""
+        return sorted(self._preempted)
+
+    def reap_preempted(self) -> List[str]:
+        """Delete preempted TPU-VM husks so their names free up and capacity
+        accounting reflects reality; the autoscaler's next bin-pack relaunches
+        for the demand they were serving. Reference: the GCP provider treats
+        preempted instances as dead and the StandardAutoscaler recreates them."""
+        reaped = sorted(self._preempted)
+        for name in reaped:
+            logger.warning("reaping preempted TPU %s", name)
+            self.terminate_node(name)
+            self._preempted.discard(name)
+        return reaped
+
+    def poll(self) -> None:
+        """Autoscaler per-step hook: refresh cloud view and reap preemptions."""
+        try:
+            self.non_terminated_nodes()
+        except subprocess.CalledProcessError:
+            return  # listing hiccup: keep last view, reap on a later pass
+        if self.cfg.get("reap_preempted", True):
+            self.reap_preempted()
 
     def terminate_all(self) -> None:
         for inst in self.non_terminated_nodes():
             self.terminate_node(inst.instance_id)
+        # the listing above also refreshed the preempted set: teardown must
+        # delete those husks too, not just live nodes (they stay listed in the
+        # project until explicitly deleted)
+        self.reap_preempted()
 
 
 def make_provider(config: ClusterConfig) -> NodeProvider:

@@ -61,6 +61,23 @@ _FLAGS: List[Flag] = [
     Flag("inline_threshold_bytes", "RAY_TPU_INLINE_THRESHOLD_BYTES", "int", 100 * 1024,
          "Objects below this travel inline in control messages instead of the "
          "arena (reference max_direct_call_object_size)."),
+    Flag("oob_threshold_bytes", "RAY_TPU_OOB_THRESHOLD_BYTES", "int", 1 << 16,
+         "Pickle buffers at or above this serialize out-of-band (zero-copy "
+         "into the arena) instead of inline in the pickle stream."),
+    Flag("object_location_timeout_s", "RAY_TPU_OBJECT_LOCATION_TIMEOUT_S",
+         "float", 60.0,
+         "How long a get() waits for a recovering object's new location "
+         "after lineage resubmission before failing."),
+    Flag("localize_pull_timeout_s", "RAY_TPU_LOCALIZE_PULL_TIMEOUT_S",
+         "float", 120.0,
+         "Deadline for pulling a task's missing arguments to its assigned "
+         "node; expiry triggers lineage reconstruction or task failure."),
+    Flag("task_max_retries", "RAY_TPU_TASK_MAX_RETRIES", "int", 3,
+         "Default max_retries for @remote tasks when unspecified "
+         "(reference task_max_retries / TASK_MAX_RETRIES default)."),
+    Flag("actor_max_restarts", "RAY_TPU_ACTOR_MAX_RESTARTS", "int", 0,
+         "Default max_restarts for actors when unspecified (reference "
+         "actor restart semantics: 0 = never restart)."),
     Flag("worker_start_timeout_s", "RAY_TPU_WORKER_START_TIMEOUT_S", "float", 60.0,
          "How long the pool waits for a spawned worker's handshake "
          "(reference worker_register_timeout_seconds)."),
@@ -159,6 +176,29 @@ _FLAGS: List[Flag] = [
          "persisted in the session dir)."),
     Flag("gcs_persistence_path", "RAY_TPU_GCS_PERSISTENCE_PATH", "str", None,
          "Journal file for GCS KV persistence across restarts (default: off)."),
+    Flag("gcs_owner_check_every", "RAY_TPU_GCS_OWNER_CHECK_EVERY", "int", 32,
+         "URI-journal split-brain fencing: re-verify lease ownership every N "
+         "appends (lower = faster usurper detection, more object reads)."),
+    Flag("job_stop_grace_s", "RAY_TPU_JOB_STOP_GRACE_S", "float", 5.0,
+         "SIGTERM-to-SIGKILL grace when stopping a submitted job's process "
+         "group (reference: job stop_timeout)."),
+    Flag("dag_channel_buffer_bytes", "RAY_TPU_DAG_CHANNEL_BUFFER_BYTES", "int",
+         4 * 1024 * 1024,
+         "Default seqlock shm channel capacity for compiled DAGs "
+         "(experimental_compile buffer_size_bytes; reference "
+         "ChannelContext buffer sizing)."),
+    # -- ops (kernel tiling; trace-time reads, safe to tune per-run)
+    Flag("flash_block_q", "RAY_TPU_FLASH_BLOCK_Q", "int", 512,
+         "Pallas flash-attention query-tile rows (MXU-aligned multiple of 8; "
+         "512 saturates v5e at head_dim 64-128)."),
+    Flag("flash_block_kv", "RAY_TPU_FLASH_BLOCK_KV", "int", 512,
+         "Pallas flash-attention key/value-tile rows."),
+    Flag("chunked_attention_min_logits", "RAY_TPU_CHUNKED_ATTENTION_MIN_LOGITS",
+         "int", 1 << 20,
+         "Sq*Skv above which non-pallas attention switches to the chunked "
+         "online-softmax path (bounds the logits buffer on long context)."),
+    Flag("tqdm_render_interval_s", "RAY_TPU_TQDM_RENDER_INTERVAL_S", "float",
+         0.1, "Min seconds between driver-side tqdm_ray re-renders."),
     # -- observability
     Flag("tracing", "RAY_TPU_TRACING", "bool", False,
          "Enable OpenTelemetry-style span recording at init."),
@@ -169,6 +209,17 @@ _FLAGS: List[Flag] = [
          "Verbose serve long-poll client logging."),
     Flag("dashboard_port", "RAY_TPU_DASHBOARD_PORT", "int", 8265,
          "Dashboard HTTP port (JSON API, /metrics exposition, web UI)."),
+    # -- autoscaler / provisioning
+    Flag("provision_max_attempts", "RAY_TPU_PROVISION_MAX_ATTEMPTS", "int", 4,
+         "Inline create_node attempts for rate-limit/transient cloud errors "
+         "before the failure escalates to the autoscaler backoff (reference "
+         "gcp node.py retry loops)."),
+    Flag("provision_backoff_s", "RAY_TPU_PROVISION_BACKOFF_S", "float", 2.0,
+         "Base for the jittered exponential inline-retry backoff in "
+         "create_node."),
+    Flag("launch_backoff_max_s", "RAY_TPU_LAUNCH_BACKOFF_MAX_S", "float", 600.0,
+         "Cap on the autoscaler's per-node-type launch backoff after "
+         "quota/stockout/permanent provision failures."),
     # -- data (DataContext defaults; per-driver overrides via DataContext)
     Flag("data_max_inflight_tasks_per_op", "RAY_TPU_DATA_MAX_INFLIGHT_TASKS_PER_OP",
          "int", 8,
@@ -279,3 +330,24 @@ class _Config:
 
 
 CONFIG = _Config()
+
+
+def memoized_flag(name: str):
+    """A zero-arg reader for flag `name`, memoized against the raw env string.
+
+    For HOT paths only (per-put / per-serialize / per-render): env changes
+    still apply live, but the parse + registry lookup (~1.7us through
+    CONFIG.__getattr__) is paid once per env-string change (~0.1us after).
+    Everything else should read CONFIG.<name> directly."""
+    f = _BY_NAME[name]
+    memo = [object(), None]  # sentinel: first call always parses
+
+    def read() -> Any:
+        raw = os.environ.get(f.env)
+        if raw == memo[0]:
+            return memo[1]
+        val = f.default if raw is None or raw == "" else f.parse(raw)
+        memo[0], memo[1] = raw, val
+        return val
+
+    return read

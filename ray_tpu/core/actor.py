@@ -21,7 +21,7 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     num_cpus=1.0,
     num_tpus=0.0,
     resources=None,
-    max_restarts=0,
+    max_restarts=None,  # resolved from CONFIG.actor_max_restarts at decoration
     max_task_retries=0,
     name=None,
     namespace="",
@@ -167,6 +167,10 @@ class ActorClass:
     def __init__(self, cls, **options):
         self._cls = cls
         self._options = {**_DEFAULT_ACTOR_OPTIONS, **options}
+        if self._options.get("max_restarts") is None:
+            from ray_tpu.config import CONFIG
+
+            self._options["max_restarts"] = CONFIG.actor_max_restarts
         self._cls_bytes: Optional[bytes] = None
         self._cls_id: Optional[bytes] = None
         self.__name__ = getattr(cls, "__name__", "ActorClass")

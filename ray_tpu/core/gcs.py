@@ -45,19 +45,19 @@ class _UriJournal:
     so two heads racing the same URI can never overwrite each other's segments
     (names cannot collide); and each writer claims an ``owner`` marker at
     startup — the marker is newest-writer-wins, and an old head discovers it
-    lost ownership (checked before compaction and every OWNER_CHECK_EVERY
+    lost ownership (checked before compaction and every owner_check_every
     appends) and stops journaling with JournalFencedError rather than keep
     interleaving state with the replacement. There is no distributed lock here
     — the operator contract is still one INTENDED writer per URI; the fence
     turns an accidental second writer from silent corruption into a loud stop."""
 
-    OWNER_CHECK_EVERY = 32
-
     def __init__(self, uri: str):
         import secrets
 
+        from ray_tpu.config import CONFIG
         from ray_tpu.train import storage
 
+        self.owner_check_every = int(CONFIG.gcs_owner_check_every)
         self._storage = storage
         self.uri = uri.rstrip("/")
         self.seq = 0
@@ -89,7 +89,7 @@ class _UriJournal:
 
     def append(self, line: bytes) -> None:
         self._appends_since_check += 1
-        if self._appends_since_check >= self.OWNER_CHECK_EVERY:
+        if self._appends_since_check >= self.owner_check_every:
             self._check_owner()
         self._storage.write_bytes(
             f"{self.uri}/seg-{self.seq:012d}-{self.token}", line)

@@ -1476,7 +1476,8 @@ class Cluster:
 
             def pull(missing=missing, spec=spec, host=host):
                 try:
-                    self._pull_batch(missing, host, timeout=120.0)
+                    self._pull_batch(missing, host,
+                                     timeout=CONFIG.localize_pull_timeout_s)
                     self._pull_failures.pop(spec.task_id, None)
                 except object_store.ObjectLost as e:
                     # unreconstructible (no lineage): the task can never run
@@ -1828,7 +1829,8 @@ class Cluster:
                 # rebalance submit's extra incref: existing ObjectRefs already hold one
                 for out_oid in respec.return_ids:
                     self.store.decref(out_oid)
-            return self.store.location(oid, timeout=60.0)
+            return self.store.location(
+                oid, timeout=CONFIG.object_location_timeout_s)
         finally:
             if resubmit:
                 with self._lock:

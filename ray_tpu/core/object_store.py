@@ -20,25 +20,11 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import serialization
 from .ids import ObjectID
 
-# Objects below this many serialized bytes travel inline through control pipes.
-_inline_memo = (object(), 0)  # sentinel: first call always consults CONFIG
+from ray_tpu.config import memoized_flag
 
-
-def _inline_threshold() -> int:
-    """Read at use so env changes apply live (config.py contract) — but this
-    sits on the per-put fast path, so the parsed value is memoized against the
-    raw env string (~0.1us) instead of going through CONFIG.__getattr__
-    (~1.7us, a measurable tax at 80k+ puts/s)."""
-    global _inline_memo
-    raw = os.environ.get("RAY_TPU_INLINE_THRESHOLD_BYTES")
-    cached_raw, val = _inline_memo
-    if raw == cached_raw:
-        return val
-    from ray_tpu.config import CONFIG
-
-    val = CONFIG.inline_threshold_bytes
-    _inline_memo = (raw, val)
-    return val
+# Objects below this many serialized bytes travel inline through control
+# pipes. Per-put fast path (~80k+ puts/s): memoized against the raw env string.
+_inline_threshold = memoized_flag("inline_threshold_bytes")
 
 # Location tuples:
 #   ("inline", frame_bytes, is_error)

@@ -15,7 +15,10 @@ from typing import Any, List, Sequence
 import cloudpickle
 
 # Buffers smaller than this stay inline in the pickle stream (header overhead not worth it).
-_OOB_THRESHOLD = 1 << 16
+from ray_tpu.config import memoized_flag
+
+# per-serialize fast path: memoized against the raw env string
+_oob_threshold = memoized_flag("oob_threshold_bytes")
 
 
 @dataclass
@@ -68,7 +71,7 @@ def serialize(obj: Any) -> SerializedObject:
     buffers: List[pickle.PickleBuffer] = []
 
     def callback(buf: pickle.PickleBuffer) -> bool:
-        if buf.raw().nbytes >= _OOB_THRESHOLD:
+        if buf.raw().nbytes >= _oob_threshold():
             buffers.append(buf)
             return False  # out-of-band
         return True  # keep inline

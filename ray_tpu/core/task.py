@@ -22,7 +22,7 @@ _DEFAULT_TASK_OPTIONS = dict(
     num_tpus=0.0,
     resources=None,
     num_returns=1,
-    max_retries=3,
+    max_retries=None,  # resolved from CONFIG.task_max_retries at decoration
     retry_exceptions=False,
     scheduling_strategy="DEFAULT",
     name=None,
@@ -111,6 +111,10 @@ class RemoteFunction:
     def __init__(self, fn, **options):
         self._fn = fn
         self._options = {**_DEFAULT_TASK_OPTIONS, **options}
+        if self._options.get("max_retries") is None:
+            from ray_tpu.config import CONFIG
+
+            self._options["max_retries"] = CONFIG.task_max_retries
         self._fn_bytes: Optional[bytes] = None
         self._fn_id: Optional[bytes] = None
         self.__name__ = getattr(fn, "__name__", "anonymous")

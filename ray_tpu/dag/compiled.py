@@ -24,20 +24,23 @@ from typing import Any, Dict, List, Optional
 
 from .channel import ShmChannel
 
-_DEFAULT_BUFFER = 4 * 1024 * 1024
 
 
 class DAGNode:
     def __init__(self):
         self._id = uuid.uuid4().hex
 
-    def experimental_compile(self, *, buffer_size_bytes: int = _DEFAULT_BUFFER,
+    def experimental_compile(self, *, buffer_size_bytes: Optional[int] = None,
                              submit_timeout: float = 30.0,
                              max_inflight_executions: int = 2,
                              channel_type: str = "shm") -> "CompiledDAG":
         """channel_type selects the registered Communicator ("shm" default;
         "device" keeps jax.Arrays resident for same-process readers — reference
         accelerator_context.py registry)."""
+        if buffer_size_bytes is None:
+            from ray_tpu.config import CONFIG
+
+            buffer_size_bytes = CONFIG.dag_channel_buffer_bytes
         return CompiledDAG(self, buffer_size_bytes, submit_timeout,
                            max_inflight_executions, channel_type)
 

@@ -135,6 +135,15 @@ class Dashboard:
 
         self.host = host
         self.port = port if port is not None else CONFIG.dashboard_port
+        # resolve TLS BEFORE the serving thread starts: a missing cert must
+        # fail fast with the tls-init hint, not a 10s 'failed to start' hang
+        self._ssl_ctx = None
+        if CONFIG.serve_ingress_tls:
+            # same server-side-TLS posture as the serve HTTP/gRPC ingress:
+            # browsers/scrapers verify against ca.crt, no client cert needed
+            from ray_tpu.core.tls_utils import ingress_ssl_context
+
+            self._ssl_ctx = ingress_ssl_context()
         self._ready = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread = threading.Thread(target=self._serve, daemon=True,
@@ -202,7 +211,8 @@ class Dashboard:
         app.router.add_get("/metrics", metrics)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, self.host, self.port)
+        site = web.TCPSite(runner, self.host, self.port,
+                           ssl_context=self._ssl_ctx)
         loop.run_until_complete(site.start())
         self._ready.set()
         loop.run_forever()

@@ -13,7 +13,10 @@ import time
 import uuid
 from typing import Any, Dict, Optional
 
-_RENDER_MIN_INTERVAL = 0.1
+from ray_tpu.config import memoized_flag
+
+# read on every update() — memoized against the raw env string
+_render_min_interval = memoized_flag("tqdm_render_interval_s")
 
 
 class tqdm:  # noqa: N801 - reference exports the lowercase name
@@ -62,7 +65,7 @@ class tqdm:  # noqa: N801 - reference exports the lowercase name
 
     def _emit(self, force: bool = False) -> None:
         now = time.monotonic()
-        if not force and now - self._last_render < _RENDER_MIN_INTERVAL:
+        if not force and now - self._last_render < _render_min_interval():
             return
         self._last_render = now
         from ray_tpu.core import global_state

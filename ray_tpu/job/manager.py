@@ -164,7 +164,9 @@ class JobManager:
         except ProcessLookupError:
             pass
         try:
-            proc.wait(timeout=5)
+            from ray_tpu.config import CONFIG
+
+            proc.wait(timeout=CONFIG.job_stop_grace_s)
         except subprocess.TimeoutExpired:
             with __import__("contextlib").suppress(ProcessLookupError):
                 os.killpg(os.getpgid(proc.pid), signal.SIGKILL)

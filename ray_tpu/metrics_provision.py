@@ -73,13 +73,21 @@ def provision(session_dir: Optional[str] = None,
 
     prom_dir = os.path.join(root, "prometheus")
     os.makedirs(prom_dir, exist_ok=True)
+    scrape: dict = {
+        "job_name": "ray-tpu",
+        "metrics_path": "/metrics",
+        "static_configs": [{"targets": targets}],
+    }
+    if CONFIG.serve_ingress_tls:
+        # the dashboard serves only TLS under this flag: scrape https and
+        # verify against the cluster CA (certs carry IP SANs, not hostnames)
+        scrape["scheme"] = "https"
+        if CONFIG.tls_ca:
+            scrape["tls_config"] = {"ca_file": CONFIG.tls_ca,
+                                    "insecure_skip_verify": False}
     prom = {
         "global": {"scrape_interval": "10s", "evaluation_interval": "10s"},
-        "scrape_configs": [{
-            "job_name": "ray-tpu",
-            "metrics_path": "/metrics",
-            "static_configs": [{"targets": targets}],
-        }],
+        "scrape_configs": [scrape],
     }
     # prometheus reads YAML; this subset of YAML is exactly JSON
     with open(os.path.join(prom_dir, "prometheus.yml"), "w") as f:

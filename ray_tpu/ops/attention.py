@@ -163,7 +163,11 @@ def attention_chunked(
 # threshold keeps single-row decode (Sq=1, any cache length) on the fused path —
 # its logits are [B,H,1,Skv], tiny, and a sequential block scan would only add
 # per-token latency.
-CHUNKED_MIN_LOGITS = 1 << 20
+def _chunked_min_logits() -> int:
+    """CONFIG.chunked_attention_min_logits, read at trace time."""
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.chunked_attention_min_logits
 
 _logged_fallbacks: set = set()
 
@@ -214,15 +218,15 @@ def attention(
         def seq_ok(n: int, block: int) -> bool:
             return n % 8 == 0 and (n <= block or n % block == 0)
 
-        from .flash_attention import DEFAULT_BLOCK_KV, DEFAULT_BLOCK_Q
+        from ray_tpu.config import CONFIG
 
         tileable = (q.shape[-1] % 128 == 0
-                    and seq_ok(q.shape[1], DEFAULT_BLOCK_Q)
-                    and seq_ok(k.shape[1], DEFAULT_BLOCK_KV))
+                    and seq_ok(q.shape[1], CONFIG.flash_block_q)
+                    and seq_ok(k.shape[1], CONFIG.flash_block_kv))
         if (on_tpu and tileable and q_offset is None and kv_valid_len is None
                 and (same_len or not causal)):
             impl = "pallas"
-        elif q.shape[1] * k.shape[1] >= CHUNKED_MIN_LOGITS:
+        elif q.shape[1] * k.shape[1] >= _chunked_min_logits():
             # Long sequences that can't take the Pallas kernel: blockwise online
             # softmax keeps peak memory O(Sq*block) instead of O(Sq*Skv).
             impl = "chunked"

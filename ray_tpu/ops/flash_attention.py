@@ -24,8 +24,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_KV = 512
+# tile sizes live in the flag registry: CONFIG.flash_block_q / flash_block_kv
 NEG_INF = -1e30
 
 
@@ -449,10 +448,15 @@ def flash_attention(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,  # [B, Skv]
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_kv: int = DEFAULT_BLOCK_KV,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> jax.Array:
     """BSHD flash attention. Sq must equal Skv when segment_ids are used."""
+    if block_q is None or block_kv is None:
+        from ray_tpu.config import CONFIG
+
+        block_q = block_q if block_q is not None else CONFIG.flash_block_q
+        block_kv = block_kv if block_kv is not None else CONFIG.flash_block_kv
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d**0.5)
     qt = q.transpose(0, 2, 1, 3)

@@ -70,7 +70,8 @@ def cmd_start(args) -> int:
             print(f"node server: {args.node_server_host}:{port} "
                   "(join with `ray-tpu start --address=HOST:PORT`)")
         dash = Dashboard(port=dashboard_port)
-        print(f"dashboard: http://127.0.0.1:{dashboard_port}/api/summary")
+        scheme = "https" if CONFIG.serve_ingress_tls else "http"
+        print(f"dashboard: {scheme}://127.0.0.1:{dashboard_port}/api/summary")
         try:
             while True:
                 time.sleep(3600)

@@ -16,6 +16,7 @@ from .schedulers import (  # noqa: F401
 )
 from .search import (  # noqa: F401
     BasicVariantGenerator,
+    HyperOptSearch,
     OptunaSearch,
     Searcher,
     TPESearcher,

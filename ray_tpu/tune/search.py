@@ -325,3 +325,115 @@ class OptunaSearch(Searcher):
             self.study.tell(trial, state=optuna.trial.TrialState.FAIL)
         else:
             self.study.tell(trial, float(value))
+
+
+class HyperOptSearch(Searcher):
+    """Adapter onto hyperopt's TPE — the second external-searcher seam the
+    reference exposes (python/ray/tune/search/hyperopt/hyperopt_search.py:
+    HyperOptSearch drives hyperopt.tpe.suggest over a Trials object). Native
+    Domains map onto hp.* distributions; `hyperopt` is an OPTIONAL dependency
+    (>= 0.2.4 for 3-arg hp.randint; declared in the tune-searchers extra) and
+    importing this class without it raises with an install hint. The e2e test
+    (test_tune_extras.py) importorskips, so environments without hyperopt
+    never execute this adapter — install the extra before relying on it.
+
+    Usage: Tuner(trainable, param_space=space,
+                 tune_config=TuneConfig(search_alg=HyperOptSearch(space))).fit()
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str = "loss",
+                 mode: str = "min", seed: Optional[int] = None,
+                 n_initial_points: int = 20, gamma: float = 0.25):
+        try:
+            import hyperopt as hpo
+        except ImportError as e:  # pragma: no cover - exercised when installed
+            raise ImportError(
+                "HyperOptSearch requires the optional 'hyperopt' package "
+                "(pip install hyperopt); the native TPESearcher needs no extra "
+                "dependency and covers the same algorithm family") from e
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self._hpo = hpo
+        self.metric, self.mode = metric, mode
+        self.space = dict(param_space)
+        self._choices: Dict[str, List[Any]] = {}  # hp.choice returns indices
+        self._functions: Dict[str, Function] = {}  # opaque to the model
+        hp_space: Dict[str, Any] = {}
+        for k, dom in param_space.items():
+            if isinstance(dom, GridSearch):
+                raise ValueError(
+                    f"HyperOptSearch does not support grid_search (key {k!r}); "
+                    "use BasicVariantGenerator for grids")
+            hp_dom = self._to_hp(k, dom)
+            if hp_dom is not None:
+                hp_space[k] = hp_dom
+        # Domain wants the objective; suggestions never call it (ask/tell use)
+        self.domain = hpo.Domain(lambda spc: 0.0, hp_space)
+        self.trials = hpo.Trials()
+        import functools
+
+        self._suggest_fn = functools.partial(
+            hpo.tpe.suggest, n_startup_jobs=n_initial_points, gamma=gamma)
+        self._rng = random.Random(seed)
+        self._live: Dict[str, int] = {}  # trial_id -> hyperopt tid
+
+    def _to_hp(self, key: str, dom: Any):
+        hp = self._hpo.hp
+        import math as _m
+
+        if isinstance(dom, LogUniform):
+            return hp.loguniform(key, _m.log(dom.low), _m.log(dom.high))
+        if isinstance(dom, Uniform):
+            return hp.uniform(key, dom.low, dom.high)
+        if isinstance(dom, RandInt):
+            return hp.randint(key, dom.low, dom.high)  # high exclusive, as ours
+        if isinstance(dom, Choice):
+            self._choices[key] = dom.categories
+            return hp.choice(key, list(range(len(dom.categories))))
+        if isinstance(dom, Function):
+            self._functions[key] = dom
+            return None
+        return None  # constant: carried through verbatim in suggest()
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        hpo = self._hpo
+        new_ids = self.trials.new_trial_ids(1)
+        self.trials.refresh()
+        docs = self._suggest_fn(new_ids, self.domain, self.trials,
+                                self._rng.randrange(2 ** 31 - 1))
+        self.trials.insert_trial_docs(docs)
+        self.trials.refresh()
+        tid = docs[0]["tid"]
+        self._live[trial_id] = tid
+        vals = hpo.base.spec_from_misc(docs[0]["misc"])
+        cfg: Dict[str, Any] = {}
+        for k, dom in self.space.items():
+            if k in self._choices:
+                cfg[k] = self._choices[k][int(vals[k])]
+            elif k in self._functions:
+                cfg[k] = self._functions[k].sample(self._rng)
+            elif k in vals:
+                v = vals[k]
+                cfg[k] = int(v) if isinstance(dom, RandInt) else float(v)
+            else:
+                cfg[k] = dom  # constant
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None) -> None:
+        hpo = self._hpo
+        tid = self._live.pop(trial_id, None)
+        if tid is None:
+            return
+        value = (result or {}).get(self.metric)
+        for trial in self.trials._dynamic_trials:
+            if trial["tid"] != tid:
+                continue
+            if value is None:  # errored/early-stopped with no metric
+                trial["state"] = hpo.JOB_STATE_ERROR
+                trial["result"] = {"status": hpo.STATUS_FAIL}
+            else:
+                loss = float(value) if self.mode == "min" else -float(value)
+                trial["state"] = hpo.JOB_STATE_DONE
+                trial["result"] = {"loss": loss, "status": hpo.STATUS_OK}
+            break
+        self.trials.refresh()

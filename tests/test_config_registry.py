@@ -40,3 +40,26 @@ def test_cli_list_config():
     assert "RAY_TPU_MAX_WORKERS_PER_NODE" in out.stdout
     assert "RAY_TPU_OBJECT_STORE_BYTES" in out.stdout
     assert "[default" in out.stdout
+
+
+def test_task_actor_default_flags(monkeypatch):
+    """task_max_retries / actor_max_restarts registry flags feed the @remote
+    defaults at decoration time; explicit options still win."""
+    from ray_tpu.core.actor import ActorClass
+    from ray_tpu.core.task import RemoteFunction
+
+    def f():
+        return 1
+
+    class A:
+        pass
+
+    assert RemoteFunction(f)._options["max_retries"] == 3
+    monkeypatch.setenv("RAY_TPU_TASK_MAX_RETRIES", "7")
+    assert RemoteFunction(f)._options["max_retries"] == 7
+    assert RemoteFunction(f, max_retries=0)._options["max_retries"] == 0
+
+    assert ActorClass(A)._options["max_restarts"] == 0
+    monkeypatch.setenv("RAY_TPU_ACTOR_MAX_RESTARTS", "2")
+    assert ActorClass(A)._options["max_restarts"] == 2
+    assert ActorClass(A, max_restarts=-1)._options["max_restarts"] == -1

@@ -254,7 +254,7 @@ def test_uri_journal_split_brain_fence():
     j2.append(b"from-j2")
     # j1 hits the fence at its next periodic owner check, not silently
     with pytest.raises(JournalFencedError):
-        for _ in range(j1.OWNER_CHECK_EVERY + 1):
+        for _ in range(j1.owner_check_every + 1):
             j1.append(b"stale")
     # nothing was overwritten: every append from BOTH writers is a distinct
     # segment object (names carry the writer token)

@@ -218,3 +218,221 @@ def test_gcp_tpu_provider_validates_config(tmp_path):
         GCPTPUProvider(types, {"gcloud_bin": str(tmp_path / "missing"),
                                "project": "p", "zone": "z",
                                "accelerator_type": "x", "runtime_version": "y"})
+
+
+# -- provision-failure taxonomy + retry/backoff (reference: autoscaler v2
+# instance-manager launch-failure handling; gcp node.py retry loops) ----------
+
+def _retry_shim(tmp_path, fail_times, stderr_msg):
+    """gcloud stand-in: fails `create` with stderr_msg the first N calls, then
+    succeeds; records every invocation op in calls.log."""
+    import stat
+
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"left": fail_times}))
+    calls = tmp_path / "calls.log"
+    calls.write_text("")
+    shim = tmp_path / "gcloud"
+    shim.write_text(f"""#!/usr/bin/env python3
+import json, sys
+plan_path = {str(plan)!r}
+op = sys.argv[4]
+with open({str(calls)!r}, "a") as f:
+    f.write(op + "\\n")
+if op == "list":
+    print("[]")
+    sys.exit(0)
+if op == "create":
+    plan = json.load(open(plan_path))
+    if plan["left"] > 0:
+        plan["left"] -= 1
+        json.dump(plan, open(plan_path, "w"))
+        sys.stderr.write({stderr_msg!r})
+        sys.exit(1)
+sys.exit(0)
+""")
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    return shim, calls
+
+
+def _gcp_provider(shim):
+    from ray_tpu.autoscaler.launcher import GCPTPUProvider
+
+    return GCPTPUProvider(
+        [NodeType(name="tpu_worker", resources={"TPU": 8})],
+        {"gcloud_bin": str(shim), "project": "p", "zone": "z",
+         "accelerator_type": "v5litepod-8", "runtime_version": "v2",
+         "name_prefix": "rtx"})
+
+
+def test_provision_error_taxonomy():
+    from ray_tpu.autoscaler.launcher import classify_provision_error
+
+    cases = {
+        "Quota 'TPUV5sLitepodPerProjectPerZone' exceeded": ("quota", False, True),
+        "There is no more capacity in the zone \"us-west4-a\"": ("stockout", False, True),
+        "ERROR: ZONE_RESOURCE_POOL_EXHAUSTED": ("stockout", False, True),
+        "rateLimitExceeded: too many requests": ("rate_limit", True, True),
+        # must NOT fall into quota via its "limit exceeded" pattern
+        "ERROR: Rate Limit Exceeded": ("rate_limit", True, True),
+        "ERROR: gcloud crashed: Deadline Exceeded": ("transient", True, True),
+        "HttpError 503 backend error": ("transient", True, True),
+        "PERMISSION_DENIED: caller lacks tpu.nodes.create": ("permanent", False, False),
+        "Invalid value for field 'acceleratorType'": ("permanent", False, False),
+        "gremlins in the datacenter": ("unknown", False, True),
+    }
+    for stderr, (kind, inline, retryable) in cases.items():
+        got = classify_provision_error(stderr)
+        assert got[:3] == (kind, inline, retryable), (stderr, got)
+
+
+def test_gcp_create_retries_transient_inline(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_PROVISION_BACKOFF_S", "0.01")
+    shim, calls = _retry_shim(tmp_path, fail_times=2,
+                              stderr_msg="HttpError 503 backend error")
+    provider = _gcp_provider(shim)
+    inst = provider.create_node("tpu_worker")
+    assert inst.instance_id.startswith("rtx-tpu-worker-")
+    assert calls.read_text().split() == ["create"] * 3  # 2 failures + success
+
+
+def test_gcp_create_quota_escalates_without_inline_retry(tmp_path, monkeypatch):
+    from ray_tpu.autoscaler.launcher import NodeLaunchError
+
+    monkeypatch.setenv("RAY_TPU_PROVISION_BACKOFF_S", "0.01")
+    shim, calls = _retry_shim(tmp_path, fail_times=99,
+                              stderr_msg="Quota 'TPUS_PER_PROJECT' exceeded")
+    provider = _gcp_provider(shim)
+    with pytest.raises(NodeLaunchError) as ei:
+        provider.create_node("tpu_worker")
+    assert ei.value.kind == "quota" and ei.value.retryable
+    assert ei.value.backoff_hint_s >= 60
+    assert calls.read_text().split() == ["create"]  # quota never retries inline
+
+
+def test_gcp_create_permanent_fails_fast(tmp_path):
+    from ray_tpu.autoscaler.launcher import NodeLaunchError
+
+    shim, calls = _retry_shim(tmp_path, fail_times=99,
+                              stderr_msg="PERMISSION_DENIED on projects/p")
+    provider = _gcp_provider(shim)
+    with pytest.raises(NodeLaunchError) as ei:
+        provider.create_node("tpu_worker")
+    assert ei.value.kind == "permanent" and not ei.value.retryable
+    assert calls.read_text().split() == ["create"]
+
+
+def test_gcp_preempted_nodes_are_reaped(tmp_path):
+    """A PREEMPTED TPU of ours is invisible to non_terminated_nodes, reported
+    via preempted_nodes, and deleted by poll() so the autoscaler relaunches."""
+    import stat
+
+    state = tmp_path / "tpus.json"
+    state.write_text(json.dumps([
+        {"name": "projects/p/locations/z/nodes/rtx-tpu-worker-1-abc123",
+         "state": "PREEMPTED"},
+        {"name": "projects/p/locations/z/nodes/rtx-tpu-worker-2-def456",
+         "state": "READY"},
+    ]))
+    shim = tmp_path / "gcloud"
+    shim.write_text(f"""#!/usr/bin/env python3
+import json, sys
+state_path = {str(state)!r}
+tpus = json.load(open(state_path))
+op = sys.argv[4]
+if op == "list":
+    print(json.dumps(tpus))
+elif op == "delete":
+    name = sys.argv[5]
+    tpus = [t for t in tpus if not t["name"].endswith("/" + name)]
+json.dump(tpus, open(state_path, "w"))
+""")
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    provider = _gcp_provider(shim)
+
+    live = provider.non_terminated_nodes()
+    assert [i.instance_id for i in live] == ["rtx-tpu-worker-2-def456"]
+    assert provider.preempted_nodes() == ["rtx-tpu-worker-1-abc123"]
+    provider.poll()
+    assert provider.preempted_nodes() == []
+    names = [t["name"] for t in json.loads(state.read_text())]
+    assert names == ["projects/p/locations/z/nodes/rtx-tpu-worker-2-def456"]
+
+
+def test_autoscaler_backs_off_failed_node_type(rt, monkeypatch):
+    """Quota failures put the node type on capped exponential backoff instead
+    of hammering create_node every reconcile tick; success clears it."""
+    import time as _time
+
+    from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig, FakeNodeProvider
+    from ray_tpu.autoscaler.launcher import NodeLaunchError
+
+    monkeypatch.setenv("RAY_TPU_PROVISION_BACKOFF_S", "0.01")
+
+    class FlakyProvider(FakeNodeProvider):
+        def __init__(self):
+            super().__init__([NodeType(name="t", resources={"CPU": 1},
+                                       min_nodes=1)])
+            self.create_calls = 0
+            self.fail = True
+
+        def create_node(self, node_type):
+            self.create_calls += 1
+            if self.fail:
+                raise NodeLaunchError("quota exceeded", kind="quota",
+                                      retryable=True, backoff_hint_s=0.05)
+            return super().create_node(node_type)
+
+    provider = FlakyProvider()
+    scaler = Autoscaler(provider, AutoscalingConfig(idle_timeout_s=3600))
+
+    scaler.step()  # min_nodes floor -> first attempt fails
+    assert provider.create_calls == 1
+    assert "quota" in scaler.launch_failures["t"]
+    scaler.step()  # inside the backoff window: no new attempt
+    assert provider.create_calls == 1
+
+    _time.sleep(0.06)
+    scaler.step()  # window expired -> retry (fails again, backoff doubles)
+    assert provider.create_calls == 2
+
+    provider.fail = False
+    _time.sleep(0.12)
+    scaler.step()  # retry succeeds; failure record cleared
+    assert provider.create_calls == 3
+    assert "t" not in scaler.launch_failures
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_gcp_preempted_foreign_tpu_never_reaped(tmp_path):
+    """The ownership check gates the preemption reaper too: a PREEMPTED TPU
+    whose name merely shares our prefix (cluster 'prod' vs 'prod-2') or has an
+    unknown node type must never land in the reap set."""
+    import stat
+
+    state = tmp_path / "tpus.json"
+    state.write_text(json.dumps([
+        # shares the "rtx-" prefix but the type segment is not ours
+        {"name": "projects/p/locations/z/nodes/rtx-other-team-3-abc123",
+         "state": "PREEMPTED"},
+        {"name": "projects/p/locations/z/nodes/rtx-tpu-worker-1-def456",
+         "state": "PREEMPTED"},
+    ]))
+    shim = tmp_path / "gcloud"
+    shim.write_text(f"""#!/usr/bin/env python3
+import json, sys
+state_path = {str(state)!r}
+tpus = json.load(open(state_path))
+op = sys.argv[4]
+if op == "list":
+    print(json.dumps(tpus))
+elif op == "delete":
+    name = sys.argv[5]
+    tpus = [t for t in tpus if not t["name"].endswith("/" + name)]
+json.dump(tpus, open(state_path, "w"))
+""")
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    provider = _gcp_provider(shim)
+    provider.poll()  # list + reap
+    names = [t["name"].rsplit("/", 1)[-1] for t in json.loads(state.read_text())]
+    assert names == ["rtx-other-team-3-abc123"]  # ours reaped, foreign kept

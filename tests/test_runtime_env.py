@@ -311,3 +311,108 @@ def test_container_runtime_missing_fails_task_cleanly(rt, monkeypatch):
             rt.get(f.remote(), timeout=60)
     finally:
         pass
+
+
+def test_container_runtime_env_on_remote_agent(rt, tmp_path):
+    """The container path on a REMOTE node agent (separate OS process tree):
+    the agent — not the head — launches the containerized worker via its own
+    container runtime, the worker dials back into the agent's relay, and the
+    task completes. The recorded invocation proves the agent's session-dir
+    mount and worker command line (reference: per-node runtime-env agents in
+    _private/runtime_env/agent.py launching image_uri workers on their host)."""
+    import json
+    import stat
+    import subprocess
+    import sys
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.core import global_state
+    from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+    fake = tmp_path / "fake_docker.py"
+    log = tmp_path / "agent_invocations.jsonl"
+    fake.write_text(f"""#!{sys.executable}
+import json, os, sys
+args = sys.argv[1:]
+with open({str(log)!r}, "a") as f:
+    f.write(json.dumps(args) + "\\n")
+assert args[0] == "run"
+i = 1
+env = {{}}
+while i < len(args):
+    a = args[i]
+    if a == "--rm":
+        i += 1
+    elif a in ("--network",):
+        i += 2
+    elif a == "-v":
+        i += 2
+    elif a == "--env":
+        k, _, v = args[i + 1].partition("=")
+        env[k] = v
+        i += 2
+    elif a.startswith("--"):
+        i += 1
+    else:
+        break
+cmd = args[i + 1:]
+os.environ.update(env)
+os.execvp(cmd[0], cmd)
+""")
+    fake.chmod(fake.stat().st_mode | stat.S_IXUSR)
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, node_server_port=0,
+                 worker_env={"JAX_PLATFORMS": "cpu"})
+    cluster = global_state.try_cluster()
+    head_id = next(n["NodeID"] for n in ray_tpu.nodes())
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent",
+         "--address", f"127.0.0.1:{cluster.node_server_port}",
+         "--num-cpus", "2"],
+        # the CONTAINER RUNTIME override rides the AGENT's environment: the
+        # head process never sees the shim, so a head-side launch would fail
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "RAY_TPU_CONTAINER_RUNTIME": str(fake)},
+    )
+    try:
+        deadline = _time.time() + 30
+        while len([x for x in ray_tpu.nodes() if x["Alive"]]) < 2:
+            assert _time.time() < deadline, "node agent never registered"
+            _time.sleep(0.2)
+        remote_id = next(n["NodeID"] for n in ray_tpu.nodes()
+                         if n["Alive"] and n["NodeID"] != head_id)
+
+        @ray_tpu.remote(
+            num_cpus=1,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=remote_id),
+            runtime_env={"image_uri": "example.com/tpu-image:2",
+                         "env_vars": {"CONTAINER_MARK": "on-agent"}})
+        def inside():
+            import os
+
+            return (os.environ.get("CONTAINER_MARK"),
+                    ray_tpu.get_runtime_context().node_id)
+
+        mark, node_id = ray_tpu.get(inside.remote(), timeout=120)
+        assert mark == "on-agent"
+        assert node_id == remote_id  # ran on the agent's node, not the head
+
+        lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+        assert len(lines) == 1
+        argv = lines[0]
+        assert argv[0] == "run" and "example.com/tpu-image:2" in argv
+        img_i = argv.index("example.com/tpu-image:2")
+        assert argv[img_i + 1:img_i + 4] == ["python", "-m", "ray_tpu.core.worker"]
+        assert "--connect" in argv  # dial-back into the AGENT's relay
+    finally:
+        if agent.poll() is None:
+            agent.terminate()
+            try:
+                agent.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                agent.kill()
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)

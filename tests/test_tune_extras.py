@@ -140,3 +140,47 @@ def test_optuna_search_adapter_end_to_end(rt):
     results = tuner.fit()
     best = results.get_best_result(metric="loss", mode="min")
     assert best.metrics["loss"] < 0.25
+
+
+def test_hyperopt_search_adapter_end_to_end(rt):
+    """HyperOptSearch (reference search/hyperopt/hyperopt_search.py): the
+    second external searcher seam, driving hyperopt.tpe.suggest ask/tell."""
+    pytest.importorskip("hyperopt", reason="hyperopt not installed "
+                        "(optional external-searcher dependency)")
+    space = {"x": tune.uniform(0.0, 1.0),
+             "opt": tune.choice(["adam", "sgd"]),
+             "lr": tune.loguniform(1e-5, 1e-1),
+             "layers": tune.randint(1, 4)}
+    s = tune.HyperOptSearch(space, metric="loss", mode="min", seed=3,
+                            n_initial_points=4)
+    for i in range(15):
+        cfg = s.suggest(f"t{i}")
+        assert 0.0 <= cfg["x"] <= 1.0 and cfg["opt"] in ("adam", "sgd")
+        assert 1e-5 <= cfg["lr"] <= 1e-1 and cfg["layers"] in (1, 2, 3)
+        s.on_trial_complete(f"t{i}", {"loss": (cfg["x"] - 0.7) ** 2})
+    assert len(s.trials.trials) >= 15
+
+    def objective(config):
+        tune.report({"loss": (config["x"] - 0.5) ** 2})
+
+    tuner = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(
+            num_samples=6, metric="loss", mode="min",
+            search_alg=tune.HyperOptSearch(space, metric="loss", mode="min",
+                                           seed=4, n_initial_points=4)))
+    results = tuner.fit()
+    best = results.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 0.25
+
+
+def test_hyperopt_search_import_error_message():
+    """Without hyperopt installed the adapter raises a clear install hint."""
+    try:
+        import hyperopt  # noqa: F401
+
+        pytest.skip("hyperopt installed; error-path not reachable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="hyperopt"):
+        tune.HyperOptSearch({"x": tune.uniform(0, 1)})
