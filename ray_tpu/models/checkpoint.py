@@ -52,14 +52,42 @@ def config_from_hf(source_dir: str, **overrides) -> ModelConfig:
         norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
     )
+    # Mixtral-style sparse MoE (num_local_experts in MixtralConfig). Our top-k
+    # gating (softmax over all E, keep top-k, renormalize to sum 1) is
+    # mathematically identical to Mixtral's softmax-over-the-top-k-logits: the
+    # full-softmax normalizer cancels in the renormalization; top-1 needs the
+    # explicit renorm flag (Switch convention differs there). Mixtral has no
+    # capacity concept (dropless), so the faithful default is capacity_factor
+    # = E/k, which makes expert capacity cover the worst-case routing (every
+    # token to one expert) — moe.py then drops nothing. Our own round-tripped
+    # checkpoints carry the trained factor in config.json instead.
+    if hf.get("num_local_experts", 0):
+        e = int(hf["num_local_experts"])
+        k = int(hf.get("num_experts_per_tok", 2))
+        fields["n_experts"] = e
+        fields["moe_top_k"] = k
+        fields["moe_top1_renorm"] = bool(hf.get("moe_top1_renorm", True))
+        fields["moe_capacity_factor"] = float(
+            hf.get("moe_capacity_factor", e / k))
     fields.update(overrides)
     return ModelConfig(**fields)
 
 
 def config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
+    moe = cfg.n_experts > 0
+    extra = (
+        # moe_capacity_factor/moe_top1_renorm are our extension keys (ignored by
+        # HF): they persist the trained dispatch semantics through a round-trip
+        # instead of resetting to the dropless Mixtral defaults on reload.
+        {"num_local_experts": cfg.n_experts, "num_experts_per_tok": cfg.moe_top_k,
+         "moe_capacity_factor": cfg.moe_capacity_factor,
+         "moe_top1_renorm": cfg.moe_top1_renorm}
+        if moe else {}
+    )
     return {
-        "architectures": ["LlamaForCausalLM"],
-        "model_type": "llama",
+        "architectures": ["MixtralForCausalLM" if moe else "LlamaForCausalLM"],
+        "model_type": "mixtral" if moe else "llama",
+        **extra,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.d_model,
         "num_hidden_layers": cfg.n_layers,
@@ -135,13 +163,35 @@ def _leaf_readers(cfg: ModelConfig, rd: _ShardedReader) -> Dict[str, Any]:
         def o(i):
             return rd.get(f"model.layers.{i}.self_attn.o_proj.weight").T.reshape(nh, hd, d)
 
+        if cfg.n_experts > 0:
+            # Mixtral layout: block_sparse_moe.gate (router, [E, D]) +
+            # experts.{e}.{w1,w3,w2} (gate/up/down, torch Linear orientation).
+            e_ = cfg.n_experts
+            moe_pre = "model.layers.{}.block_sparse_moe."
+
+            def expert_stack(i: int, w: str) -> np.ndarray:
+                return np.stack([
+                    rd.get(moe_pre.format(i) + f"experts.{j}.{w}.weight").T
+                    for j in range(e_)
+                ])
+
+            mlp_readers = {
+                "router": lambda i: rd.get(moe_pre.format(i) + "gate.weight").T,
+                "w_gate": lambda i: expert_stack(i, "w1"),  # [E, D, F]
+                "w_up": lambda i: expert_stack(i, "w3"),    # [E, D, F]
+                "w_down": lambda i: expert_stack(i, "w2"),  # [E, F, D]
+            }
+        else:
+            mlp_readers = {
+                "w_gate": lambda i: rd.get(pre.format(i) + "mlp.gate_proj.weight").T,
+                "w_up": lambda i: rd.get(pre.format(i) + "mlp.up_proj.weight").T,
+                "w_down": lambda i: rd.get(pre.format(i) + "mlp.down_proj.weight").T,
+            }
         return {
             "attn_norm": lambda i: rd.get(pre.format(i) + "input_layernorm.weight"),
             "mlp_norm": lambda i: rd.get(pre.format(i) + "post_attention_layernorm.weight"),
             "wq": q, "wk": k, "wv": v, "wo": o,
-            "w_gate": lambda i: rd.get(pre.format(i) + "mlp.gate_proj.weight").T,
-            "w_up": lambda i: rd.get(pre.format(i) + "mlp.up_proj.weight").T,
-            "w_down": lambda i: rd.get(pre.format(i) + "mlp.down_proj.weight").T,
+            **mlp_readers,
         }[field]
 
     return {
@@ -154,6 +204,12 @@ def _leaf_readers(cfg: ModelConfig, rd: _ShardedReader) -> Dict[str, Any]:
 
 _LAYER_FIELDS = ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
                  "w_gate", "w_up", "w_down")
+_MOE_LAYER_FIELDS = ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+                     "router", "w_gate", "w_up", "w_down")
+
+
+def _layer_fields(cfg: ModelConfig):
+    return _MOE_LAYER_FIELDS if cfg.n_experts > 0 else _LAYER_FIELDS
 
 
 def load_llama_params(
@@ -171,8 +227,6 @@ def load_llama_params(
     jnp arrays (single-process tests / single chip)."""
     if cfg is None:
         cfg = config_from_hf(source_dir)
-    if cfg.n_experts > 0:
-        raise NotImplementedError("HF MoE checkpoint loading is not supported yet")
     from . import llama
 
     rd = _ShardedReader(source_dir)
@@ -189,9 +243,10 @@ def load_llama_params(
         "embed": put(readers["embed"](), axes["embed"]),
         "final_norm": put(readers["final_norm"](), axes["final_norm"]),
     }
+    fields = _layer_fields(cfg)
     if cfg.scan_layers:
         layers = {}
-        for field in _LAYER_FIELDS:
+        for field in fields:
             read = readers["layer"](field)
             stacked = np.stack([np.asarray(read(i)) for i in range(cfg.n_layers)])
             layers[field] = put(stacked, axes["layers"][field])
@@ -201,7 +256,7 @@ def load_llama_params(
         params["layers"] = [
             {field: put(np.asarray(readers["layer"](field)(i)),
                         axes["layers"][i][field])
-             for field in _LAYER_FIELDS}
+             for field in fields}
             for i in range(cfg.n_layers)
         ]
     if not cfg.tie_embeddings:
@@ -213,8 +268,6 @@ def save_llama_params(params: Params, cfg: ModelConfig, out_dir: str) -> str:
     """Write the pytree as an HF-layout safetensors checkpoint + config.json."""
     from safetensors.numpy import save_file
 
-    if cfg.n_experts > 0:
-        raise NotImplementedError("HF MoE checkpoint saving is not supported yet")
     os.makedirs(out_dir, exist_ok=True)
     d = cfg.d_model
 
@@ -227,7 +280,7 @@ def save_llama_params(params: Params, cfg: ModelConfig, out_dir: str) -> str:
     def layer(i):
         if cfg.scan_layers:
             return {f: jax.tree.map(lambda x: x[i], params["layers"][f])
-                    for f in _LAYER_FIELDS}
+                    for f in _layer_fields(cfg)}
         return params["layers"][i]
 
     tensors: Dict[str, np.ndarray] = {
@@ -245,9 +298,19 @@ def save_llama_params(params: Params, cfg: ModelConfig, out_dir: str) -> str:
         tensors[pre + "self_attn.k_proj.weight"] = host(ly["wk"]).reshape(d, -1).T
         tensors[pre + "self_attn.v_proj.weight"] = host(ly["wv"]).reshape(d, -1).T
         tensors[pre + "self_attn.o_proj.weight"] = host(ly["wo"]).reshape(-1, d).T
-        tensors[pre + "mlp.gate_proj.weight"] = host(ly["w_gate"]).T
-        tensors[pre + "mlp.up_proj.weight"] = host(ly["w_up"]).T
-        tensors[pre + "mlp.down_proj.weight"] = host(ly["w_down"]).T
+        if cfg.n_experts > 0:
+            moe_pre = pre + "block_sparse_moe."
+            tensors[moe_pre + "gate.weight"] = host(ly["router"]).T
+            wg, wu, wd = host(ly["w_gate"]), host(ly["w_up"]), host(ly["w_down"])
+            for j in range(cfg.n_experts):
+                ex = moe_pre + f"experts.{j}."
+                tensors[ex + "w1.weight"] = wg[j].T
+                tensors[ex + "w3.weight"] = wu[j].T
+                tensors[ex + "w2.weight"] = wd[j].T
+        else:
+            tensors[pre + "mlp.gate_proj.weight"] = host(ly["w_gate"]).T
+            tensors[pre + "mlp.up_proj.weight"] = host(ly["w_up"]).T
+            tensors[pre + "mlp.down_proj.weight"] = host(ly["w_down"]).T
     tensors = {k: np.ascontiguousarray(v) for k, v in tensors.items()}
     save_file(tensors, os.path.join(out_dir, "model.safetensors"))
     with open(os.path.join(out_dir, "config.json"), "w") as f:

@@ -44,6 +44,12 @@ class ModelConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # top-1 gate convention: False = raw top-1 softmax prob (Switch; keeps the
+    # router differentiable through the task loss), True = renormalize to 1.0
+    # (Mixtral inference semantics — what HF MixtralForCausalLM computes for
+    # num_experts_per_tok=1). checkpoint.config_from_hf sets True for
+    # model_type=mixtral; irrelevant when moe_top_k > 1 (both renormalize).
+    moe_top1_renorm: bool = False
 
     @property
     def head_dim(self) -> int:

@@ -64,10 +64,11 @@ def _moe_group(x, mask, router_w, w_gate, w_up, w_down, cfg: ModelConfig):
     probs = jax.nn.softmax(logits, axis=-1)  # [g, E]
 
     gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [g, k]
-    if k > 1:
+    if k > 1 or cfg.moe_top1_renorm:
         gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
-    # k == 1: raw top-1 prob gates the output (Switch) so the router receives
-    # task-loss gradient; renormalizing would pin the gate to exactly 1.0.
+    # k == 1 without moe_top1_renorm: raw top-1 prob gates the output (Switch) so
+    # the router receives task-loss gradient; renormalizing pins the gate to 1.0
+    # (Mixtral inference semantics — set by config_from_hf for HF checkpoints).
 
     # Position of each (token, slot) within its expert's capacity. Slot-major order
     # (all top-1 picks get priority over top-2 picks, GShard convention). Masked

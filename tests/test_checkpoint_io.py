@@ -166,3 +166,80 @@ def test_train_init_state_from_checkpoint(tmp_path):
     np.testing.assert_array_equal(np.asarray(state.params["embed"]),
                                   np.asarray(params["embed"]))
     assert state.opt_state is not None
+
+
+# ------------------------------------------------------------------------- MoE
+
+MOE_TINY = dict(**TINY, n_experts=4, moe_top_k=2)
+
+
+def test_moe_roundtrip_exact(tmp_path):
+    """Mixtral-layout MoE checkpoints round-trip (router + per-expert w1/w2/w3),
+    and config.json carries num_local_experts/num_experts_per_tok."""
+    cfg = _cfg(**MOE_TINY)
+    params = llama.init(jax.random.PRNGKey(6), cfg)
+    src = str(tmp_path / "ckpt")
+    ckpt_io.save_llama_params(params, cfg, src)
+    with open(os.path.join(src, "config.json")) as f:
+        hf = json.load(f)
+    assert hf["model_type"] == "mixtral"
+    assert hf["num_local_experts"] == 4 and hf["num_experts_per_tok"] == 2
+    # trained dispatch semantics survive the round-trip (extension keys beat
+    # the dropless mixtral defaults)
+    re_cfg = ckpt_io.config_from_hf(src)
+    assert re_cfg.moe_capacity_factor == cfg.moe_capacity_factor
+    assert re_cfg.moe_top1_renorm == cfg.moe_top1_renorm
+    # cfg reconstructed from config.json, not passed in
+    loaded = ckpt_io.load_llama_params(src, param_dtype=jnp.float32)
+    flat_a = jax.tree.leaves(params)
+    flat_b = jax.tree.leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_moe_roundtrip_unscanned(tmp_path):
+    cfg = _cfg(**MOE_TINY, scan_layers=False)
+    params = llama.init(jax.random.PRNGKey(7), cfg)
+    src = str(tmp_path / "ckpt")
+    ckpt_io.save_llama_params(params, cfg, src)
+    loaded = ckpt_io.load_llama_params(src, cfg=cfg, param_dtype=jnp.float32)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_hf_mixtral_parity(tmp_path, top_k):
+    """Weights exported by the REAL transformers MixtralForCausalLM load into our
+    MoE pytree and reproduce its logits WITH DEFAULT load options. Gating parity:
+    softmax over all E + top-k renormalization equals Mixtral's softmax over the
+    top-k logits (the normalizer cancels); k=1 exercises moe_top1_renorm (the
+    Switch convention would underweight every MLP output). Dropless capacity
+    (factor E/k) is the config_from_hf default for mixtral checkpoints — no
+    override needed, matching how the engine loads a real model dir."""
+    torch = pytest.importorskip("torch")
+    tr = pytest.importorskip("transformers")
+
+    hf_cfg = tr.MixtralConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=48,
+        num_local_experts=4, num_experts_per_tok=top_k,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, sliding_window=None,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = tr.MixtralForCausalLM(hf_cfg).eval()
+    src = str(tmp_path / "hf")
+    model.save_pretrained(src, safe_serialization=True)
+
+    cfg = ckpt_io.config_from_hf(src, remat=False, dtype="float32")
+    assert cfg.n_experts == 4 and cfg.moe_top_k == top_k
+    assert cfg.moe_top1_renorm and cfg.moe_capacity_factor == 4.0 / top_k
+    params = ckpt_io.load_llama_params(src, cfg, param_dtype=jnp.float32)
+
+    ids = [[1, 7, 23, 40, 5, 61]]
+    with torch.no_grad():
+        ref = model(torch.tensor(ids)).logits.numpy()
+    got, _ = llama.forward(params, jnp.asarray(ids, jnp.int32), cfg)
+    np.testing.assert_allclose(np.asarray(got, np.float32), ref, rtol=2e-3, atol=2e-3)
