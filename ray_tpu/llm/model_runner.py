@@ -585,18 +585,10 @@ def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Ar
             x_send = jax.lax.ppermute(h, "pp", fwd) if pp_size > 1 else h
             return (x_send, k, v, outs), None
 
+        from ray_tpu.parallel.sharding import vary_like
+
         def _vary(z):
-            try:
-                want = set(jax.typeof(x_mb).vma) | {"pp"}
-                have = set(jax.typeof(z).vma)
-            except Exception:
-                want, have = {"pp"}, set()
-            need = tuple(want - have)
-            if not need:
-                return z
-            if hasattr(jax.lax, "pcast"):
-                return jax.lax.pcast(z, need, to="varying")
-            return jax.lax.pvary(z, need)
+            return vary_like(z, x_mb, extra=("pp",))
 
         buf0 = _vary(jnp.zeros_like(x_mb[0]))
         outs0 = _vary(jnp.zeros_like(x_mb))

@@ -268,6 +268,18 @@ def save_llama_params(params: Params, cfg: ModelConfig, out_dir: str) -> str:
     """Write the pytree as an HF-layout safetensors checkpoint + config.json."""
     from safetensors.numpy import save_file
 
+    if cfg.n_experts > 0 and not cfg.moe_top1_renorm and cfg.moe_top_k == 1:
+        import warnings
+
+        # HF ignores our extension keys: MixtralForCausalLM renormalizes the
+        # single gate to 1.0 while this model was trained gating by the raw
+        # top-1 prob — a transformers consumer of this export gets different
+        # forward math. Our own loader reads the keys back faithfully.
+        warnings.warn(
+            "exporting a Switch-gated MoE (moe_top_k=1, moe_top1_renorm=False) "
+            "in Mixtral layout: transformers will renormalize the gate to 1.0 "
+            "and produce different logits; only ray_tpu's loader reproduces "
+            "the trained semantics", stacklevel=2)
     os.makedirs(out_dir, exist_ok=True)
     d = cfg.d_model
 

@@ -275,23 +275,20 @@ def _pipeline_layers(
     cfg: ModelConfig,
     positions: jax.Array,
     segment_ids: Optional[jax.Array],
-) -> jax.Array:
+) -> Tuple[jax.Array, jax.Array]:
     """Run the layer stack as cfg.pipeline_stages pipeline stages over the "pp" axis.
 
     Stage-stacks the scanned layer params [L, ...] -> [pp, L/pp, ...] and feeds the
     GPipe schedule (parallel/pipeline.py). Training path only (no KV cache); packed
-    sequences (segment_ids) are not yet microbatch-aware.
+    sequences (segment_ids) are not yet microbatch-aware. Returns (x, moe aux loss):
+    MoE composes with pp — each stage threads its layers' load-balancing aux through
+    the schedule (bubble ticks masked; see pipeline_spmd with_aux).
     """
     from ray_tpu.parallel.pipeline import pipeline
 
     pp = cfg.pipeline_stages
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by pipeline_stages {pp}")
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "MoE with pipeline_stages > 1 is not supported yet: the pipeline body "
-            "cannot thread the load-balancing aux loss, and silently dropping it "
-            "would let experts collapse")
     if not cfg.scan_layers:
         raise ValueError("pipeline_stages > 1 requires scan_layers=True (stacked params)")
     if segment_ids is not None:
@@ -302,6 +299,8 @@ def _pipeline_layers(
     )
     seq_manual = cfg.attention_impl in ("ring", "ulysses")
 
+    moe = cfg.n_experts > 0
+
     def stage_fn(stage_params, xm):
         # Positions rebuilt per microbatch (the no-cache path is always 0..S-1); under
         # a seq-manual stage, xm holds only this device's chunk of the sequence.
@@ -310,24 +309,32 @@ def _pipeline_layers(
         pos = jnp.broadcast_to(start + jnp.arange(s_loc)[None, :], (xm.shape[0], s_loc))
 
         def body(carry, lp):
-            h, _, _ = _block(carry, lp, cfg, pos, None)  # aux loss unsupported w/ pp
-            return h, None
+            h, aux_acc = carry
+            h, _, aux = _block(h, lp, cfg, pos, None)
+            return (h, aux_acc + aux), None
 
+        # aux carry must match the loop body's varying-manual-axes type (it
+        # inherits xm's vma plus pp)
+        from ray_tpu.parallel.sharding import vary_like
+
+        aux0 = vary_like(jnp.zeros((), jnp.float32), xm)
         fn = _maybe_remat(body, cfg)
-        out, _ = jax.lax.scan(fn, xm, stage_params)
-        return out
+        (out, aux), _ = jax.lax.scan(fn, (xm, aux0), stage_params)
+        return (out, aux) if moe else out
 
     m = cfg.pipeline_microbatches or pp
     from jax.sharding import PartitionSpec as P
 
-    return pipeline(
+    out = pipeline(
         stage_fn,
         stacked,
         x,
         num_microbatches=m,
         x_spec=P(None, "sp", None) if seq_manual else None,
         extra_manual=("sp",) if seq_manual else (),
+        with_aux=moe,
     )
+    return out if moe else (out, jnp.zeros((), jnp.float32))
 
 
 def forward(
@@ -354,7 +361,13 @@ def forward(
     aux_total = jnp.zeros((), jnp.float32)
 
     if cfg.pipeline_stages > 1 and cache is None:
-        x = _pipeline_layers(x, params, cfg, positions, segment_ids)
+        if token_mask is not None:
+            # would be silently dropped below: pad tokens would claim expert
+            # capacity and skew the aux loss (same microbatching gap as
+            # segment_ids — _pipeline_layers splits only the activations)
+            raise NotImplementedError(
+                "token_mask with pipeline_stages > 1 not supported yet")
+        x, aux_total = _pipeline_layers(x, params, cfg, positions, segment_ids)
         new_cache = None
     elif cfg.scan_layers:
         if cache is not None:

@@ -91,15 +91,10 @@ def ring_attention(
 
     # The scan carry must carry q's full varying-axes set (sp, plus any outer manual
     # axes like pp when nested inside a pipeline stage) or scan rejects the carry types.
-    try:
-        vma = tuple(jax.typeof(q).vma)
-    except Exception:
-        vma = (axis_name,)
-    _vary = (
-        (lambda z: lax.pcast(z, vma, to="varying"))
-        if hasattr(lax, "pcast")
-        else (lambda z: lax.pvary(z, vma))
-    ) if vma else (lambda z: z)
+    from ray_tpu.parallel.sharding import vary_like
+
+    def _vary(z):
+        return vary_like(z, q, extra=(axis_name,))
     m0 = _vary(jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, s_loc), jnp.float32))
     acc0 = _vary(jnp.zeros((b, h, s_loc, d), jnp.float32))

@@ -27,12 +27,20 @@ def pipeline_spmd(
     x_mb: jax.Array,
     *,
     axis_name: str = "pp",
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Collective pipeline schedule; call inside shard_map manual over `axis_name`.
 
     stage_fn(params, x) -> y with y.shape == x.shape (a transformer block stack).
     stage_params: THIS stage's params. x_mb: [M, ...] microbatches (same array on every
     stage; only stage 0 consumes it). Returns [M, ...] outputs on every stage.
+
+    with_aux=True: stage_fn returns (y, aux_scalar) — e.g. a MoE load-balancing
+    loss. Bubble ticks run on zero inputs, so each stage's aux only counts ticks
+    where it holds a real microbatch (its valid window is t - stage in [0, M));
+    the return is then (y, psum-over-stages of the per-microbatch MEAN aux) —
+    matching the non-pipelined sum-over-layers of a full-batch mean, since
+    microbatches are equal-sized.
     """
     pp = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
@@ -41,37 +49,38 @@ def pipeline_spmd(
     # pcast-to-varying: the carry is device-varying from tick 1 on; the init must match
     # the full varying set (pp plus any other manual axes x_mb carries, e.g. sp) —
     # adding only the axes the value doesn't already vary over.
+    from .sharding import vary_like
+
     def _vary(z):
-        try:
-            want = set(jax.typeof(x_mb).vma) | {axis_name}
-            have = set(jax.typeof(z).vma)
-        except Exception:
-            want, have = {axis_name}, set()
-        need = tuple(want - have)
-        if not need:
-            return z
-        if hasattr(lax, "pcast"):
-            return lax.pcast(z, need, to="varying")
-        return lax.pvary(z, need)
+        return vary_like(z, x_mb, extra=(axis_name,))
 
     y0 = _vary(jnp.zeros_like(x_mb))
     buf0 = _vary(jnp.zeros_like(x_mb[0]))
+    aux0 = _vary(jnp.zeros((), jnp.float32))
     fwd = [(i, i + 1) for i in range(pp - 1)]  # non-circular: stage 0 receives zeros
 
     def body(carry, t):
-        buf, y = carry
+        buf, y, aux_acc = carry
         inp = jnp.where(stage == 0, x_mb[jnp.clip(t, 0, m - 1)], buf)
-        out = stage_fn(stage_params, inp)
+        if with_aux:
+            out, aux = stage_fn(stage_params, inp)
+            valid = (t >= stage) & (t - stage < m)
+            aux_acc = aux_acc + jnp.where(valid, aux.astype(jnp.float32), 0.0)
+        else:
+            out = stage_fn(stage_params, inp)
         mb = t - (pp - 1)
         done = lax.dynamic_update_index_in_dim(y, out, jnp.clip(mb, 0, m - 1), 0)
         y = jnp.where((stage == pp - 1) & (mb >= 0), done, y)
         buf_next = lax.ppermute(out, axis_name, fwd) if pp > 1 else buf
-        return (buf_next, y), None
+        return (buf_next, y, aux_acc), None
 
-    (_, y), _ = lax.scan(body, (buf0, y0), jnp.arange(ticks))
+    (_, y, aux_acc), _ = lax.scan(body, (buf0, y0, aux0), jnp.arange(ticks))
     # Hand the last stage's outputs to every stage (loss is then computed redundantly —
     # the SPMD idiom; XLA keeps one copy per pp group member).
-    return lax.psum(jnp.where(stage == pp - 1, y, jnp.zeros_like(y)), axis_name)
+    y = lax.psum(jnp.where(stage == pp - 1, y, jnp.zeros_like(y)), axis_name)
+    if with_aux:
+        return y, lax.psum(aux_acc, axis_name) / m
+    return y
 
 
 def pipeline(
@@ -84,11 +93,15 @@ def pipeline(
     axis_name: str = "pp",
     x_spec: P = None,
     extra_manual: tuple = (),
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Driver-level wrapper: global [B, ...] input, stage-stacked params.
 
     stacked_params: pytree whose leaves have leading dim pp, sharded P("pp", ...).
-    Splits x into `num_microbatches`, runs the schedule, returns [B, ...] outputs.
+    Splits x into `num_microbatches`, runs the schedule, returns [B, ...] outputs
+    (or (outputs, aux scalar) when with_aux — see pipeline_spmd; aux is pmean'd
+    over `extra_manual` axes, since e.g. sp shards hold disjoint token chunks
+    whose shard-mean auxes average to the global mean).
     Jit-friendly: trace under use_mesh(mesh) or pass mesh explicitly.
 
     `extra_manual` names additional mesh axes the stage itself handles collectively
@@ -116,15 +129,25 @@ def pipeline(
 
         local = jax.tree_util.tree_map(lambda p: p[0], params)  # drop stage axis (len 1)
         with manual_axes(*manual):
-            return pipeline_spmd(stage_fn, local, x_mb, axis_name=axis_name)
+            out = pipeline_spmd(stage_fn, local, x_mb, axis_name=axis_name,
+                                with_aux=with_aux)
+            if with_aux:
+                y, aux = out
+                for ax in extra_manual:
+                    aux = lax.pmean(aux, ax)
+                return y, aux
+            return out
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     mapped = jax.shard_map(
         inner,
         mesh=mesh,
         in_specs=(param_specs, mb_spec),
-        out_specs=mb_spec,
+        out_specs=(mb_spec, P()) if with_aux else mb_spec,
         axis_names=manual,
     )
+    if with_aux:
+        y_mb, aux = mapped(stacked_params, x_mb)
+        return y_mb.reshape(b, *x.shape[1:]), aux
     y_mb = mapped(stacked_params, x_mb)
     return y_mb.reshape(b, *x.shape[1:])

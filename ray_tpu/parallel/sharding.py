@@ -158,3 +158,29 @@ def manual_axes(*names: str):
         yield
     finally:
         _MANUAL_AXES.reset(token)
+
+
+def vary_like(z, ref=None, *, extra: Sequence[str] = ()):
+    """Cast `z` to vary over the manual axes `ref` varies over, plus `extra`.
+
+    The shard_map vma type system requires loop carries/inits to match the body's
+    varying-axes set; this is the one shared implementation of the
+    pcast/pvary-to-varying idiom (jax moved pvary -> pcast(..., to="varying")
+    across versions, hence the feature probe). ref=None means "just `extra`".
+    """
+    want = set(extra)
+    if ref is not None:
+        try:
+            want |= set(jax.typeof(ref).vma)
+        except Exception:
+            pass
+    try:
+        have = set(jax.typeof(z).vma)
+    except Exception:
+        have = set()
+    need = tuple(want - have)
+    if not need:
+        return z
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(z, need, to="varying")
+    return jax.lax.pvary(z, need)
