@@ -239,6 +239,11 @@ def _block(
             if cfg.attention_impl == "ring":
                 attn = ra.ring_attention(q, k, v, causal=True, segment_ids=segment_ids)
             else:
+                if segment_ids is not None:
+                    # mirror ring_attention_sharded's refusal — dropping the
+                    # packing mask here would silently attend across documents
+                    raise NotImplementedError(
+                        "segment_ids only supported with impl='ring'")
                 attn = ra.ulysses_attention(q, k, v, causal=True)
         else:
             attn = ra.ring_attention_sharded(
@@ -275,14 +280,16 @@ def _pipeline_layers(
     cfg: ModelConfig,
     positions: jax.Array,
     segment_ids: Optional[jax.Array],
+    token_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run the layer stack as cfg.pipeline_stages pipeline stages over the "pp" axis.
 
     Stage-stacks the scanned layer params [L, ...] -> [pp, L/pp, ...] and feeds the
-    GPipe schedule (parallel/pipeline.py). Training path only (no KV cache); packed
-    sequences (segment_ids) are not yet microbatch-aware. Returns (x, moe aux loss):
-    MoE composes with pp — each stage threads its layers' load-balancing aux through
-    the schedule (bubble ticks masked; see pipeline_spmd with_aux).
+    GPipe schedule (parallel/pipeline.py). Training path only (no KV cache). Packed
+    sequences (segment_ids) and MoE token masks ride the schedule as microbatched
+    side inputs (pipeline side=...). Returns (x, moe aux loss): MoE composes with
+    pp — each stage threads its layers' load-balancing aux through the schedule
+    (bubble ticks masked; see pipeline_spmd with_aux).
     """
     from ray_tpu.parallel.pipeline import pipeline
 
@@ -291,8 +298,6 @@ def _pipeline_layers(
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by pipeline_stages {pp}")
     if not cfg.scan_layers:
         raise ValueError("pipeline_stages > 1 requires scan_layers=True (stacked params)")
-    if segment_ids is not None:
-        raise NotImplementedError("segment_ids with pipeline_stages > 1 not supported yet")
     layers = params["layers"]
     stacked = jax.tree_util.tree_map(
         lambda p: p.reshape(pp, cfg.n_layers // pp, *p.shape[1:]), layers
@@ -300,17 +305,30 @@ def _pipeline_layers(
     seq_manual = cfg.attention_impl in ("ring", "ulysses")
 
     moe = cfg.n_experts > 0
+    from jax.sharding import PartitionSpec as P
 
-    def stage_fn(stage_params, xm):
-        # Positions rebuilt per microbatch (the no-cache path is always 0..S-1); under
-        # a seq-manual stage, xm holds only this device's chunk of the sequence.
-        s_loc = xm.shape[1]
-        start = jax.lax.axis_index("sp") * s_loc if seq_manual else 0
-        pos = jnp.broadcast_to(start + jnp.arange(s_loc)[None, :], (xm.shape[0], s_loc))
+    side = {}
+    side_spec = {}
+    seq_spec = P(None, "sp") if seq_manual else P()
+    # positions ride as a side input too — caller-supplied offsets (e.g. a
+    # nonzero RoPE start) reach every stage instead of being rebuilt as 0..S-1
+    side["positions"] = jnp.broadcast_to(positions, x.shape[:2])
+    side_spec["positions"] = seq_spec
+    if segment_ids is not None:
+        side["segment_ids"] = segment_ids
+        side_spec["segment_ids"] = seq_spec
+    if token_mask is not None:
+        side["token_mask"] = token_mask
+        side_spec["token_mask"] = seq_spec
+
+    def stage_fn(stage_params, xm, side_now):
+        pos = side_now["positions"]
+        seg = side_now.get("segment_ids")
+        mask = side_now.get("token_mask")
 
         def body(carry, lp):
             h, aux_acc = carry
-            h, _, aux = _block(h, lp, cfg, pos, None)
+            h, _, aux = _block(h, lp, cfg, pos, seg, token_mask=mask)
             return (h, aux_acc + aux), None
 
         # aux carry must match the loop body's varying-manual-axes type (it
@@ -323,7 +341,6 @@ def _pipeline_layers(
         return (out, aux) if moe else out
 
     m = cfg.pipeline_microbatches or pp
-    from jax.sharding import PartitionSpec as P
 
     out = pipeline(
         stage_fn,
@@ -333,6 +350,8 @@ def _pipeline_layers(
         x_spec=P(None, "sp", None) if seq_manual else None,
         extra_manual=("sp",) if seq_manual else (),
         with_aux=moe,
+        side=side,
+        side_spec=side_spec,
     )
     return out if moe else (out, jnp.zeros((), jnp.float32))
 
@@ -361,13 +380,8 @@ def forward(
     aux_total = jnp.zeros((), jnp.float32)
 
     if cfg.pipeline_stages > 1 and cache is None:
-        if token_mask is not None:
-            # would be silently dropped below: pad tokens would claim expert
-            # capacity and skew the aux loss (same microbatching gap as
-            # segment_ids — _pipeline_layers splits only the activations)
-            raise NotImplementedError(
-                "token_mask with pipeline_stages > 1 not supported yet")
-        x, aux_total = _pipeline_layers(x, params, cfg, positions, segment_ids)
+        x, aux_total = _pipeline_layers(x, params, cfg, positions, segment_ids,
+                                        token_mask)
         new_cache = None
     elif cfg.scan_layers:
         if cache is not None:

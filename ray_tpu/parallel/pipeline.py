@@ -28,6 +28,7 @@ def pipeline_spmd(
     *,
     axis_name: str = "pp",
     with_aux: bool = False,
+    side_mb: Any = None,
 ):
     """Collective pipeline schedule; call inside shard_map manual over `axis_name`.
 
@@ -41,6 +42,11 @@ def pipeline_spmd(
     the return is then (y, psum-over-stages of the per-microbatch MEAN aux) —
     matching the non-pipelined sum-over-layers of a full-batch mean, since
     microbatches are equal-sized.
+
+    side_mb: optional pytree of [M, ...] per-microbatch side inputs that do NOT
+    flow stage-to-stage (segment_ids, token masks). Unlike x_mb, every stage
+    reads the side slice of the microbatch it is CURRENTLY processing (t - stage),
+    and stage_fn is called as stage_fn(params, x, side).
     """
     pp = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
@@ -62,12 +68,16 @@ def pipeline_spmd(
     def body(carry, t):
         buf, y, aux_acc = carry
         inp = jnp.where(stage == 0, x_mb[jnp.clip(t, 0, m - 1)], buf)
+        args = (stage_params, inp)
+        if side_mb is not None:
+            mb_now = jnp.clip(t - stage, 0, m - 1)
+            args += (jax.tree_util.tree_map(lambda a: a[mb_now], side_mb),)
         if with_aux:
-            out, aux = stage_fn(stage_params, inp)
+            out, aux = stage_fn(*args)
             valid = (t >= stage) & (t - stage < m)
             aux_acc = aux_acc + jnp.where(valid, aux.astype(jnp.float32), 0.0)
         else:
-            out = stage_fn(stage_params, inp)
+            out = stage_fn(*args)
         mb = t - (pp - 1)
         done = lax.dynamic_update_index_in_dim(y, out, jnp.clip(mb, 0, m - 1), 0)
         y = jnp.where((stage == pp - 1) & (mb >= 0), done, y)
@@ -94,6 +104,8 @@ def pipeline(
     x_spec: P = None,
     extra_manual: tuple = (),
     with_aux: bool = False,
+    side: Any = None,
+    side_spec: Any = None,
 ):
     """Driver-level wrapper: global [B, ...] input, stage-stacked params.
 
@@ -108,10 +120,20 @@ def pipeline(
     (e.g. "sp" when the stage runs ring attention); `x_spec` is the PartitionSpec of one
     microbatch [B/M, ...] over those axes. Nested shard_map is not composable (sdy
     rejects re-bound axes), so pp and sp share ONE manual region here.
+
+    `side`: optional pytree of [B, ...] per-example side inputs (segment_ids,
+    token masks) split into microbatches alongside x; stage_fn then receives a
+    third argument holding its current microbatch's slice (see pipeline_spmd).
+    `side_spec`: matching pytree of per-microbatch PartitionSpecs over the
+    manual axes (default: replicated).
     """
     b = x.shape[0]
     if b % num_microbatches:
         raise ValueError(f"batch {b} not divisible by num_microbatches {num_microbatches}")
+    for leaf in jax.tree_util.tree_leaves(side):
+        if leaf.shape[0] != b:
+            raise ValueError(
+                f"side input leading dim {leaf.shape[0]} != batch {b}")
     env_mesh = mesh if mesh is not None else jax.sharding.get_abstract_mesh()
     pp_size = env_mesh.shape.get(axis_name) if getattr(env_mesh, "shape", None) else None
     leading = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stacked_params)}
@@ -121,16 +143,23 @@ def pipeline(
             f"size {pp_size}; a mismatch would silently drop pipeline stages"
         )
     x_mb = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    side_mb = jax.tree_util.tree_map(
+        lambda a: a.reshape(num_microbatches, b // num_microbatches, *a.shape[1:]),
+        side)
     manual = {axis_name, *extra_manual}
     mb_spec = P(None, *(x_spec or P())) if (x_spec or extra_manual) else P()
+    side_specs = (jax.tree_util.tree_map(
+        lambda s: P(None, *s), side_spec, is_leaf=lambda s: isinstance(s, P))
+        if side_spec is not None
+        else jax.tree_util.tree_map(lambda _: P(), side))
 
-    def inner(params, x_mb):
+    def inner(params, x_mb, side_mb):
         from .sharding import manual_axes
 
         local = jax.tree_util.tree_map(lambda p: p[0], params)  # drop stage axis (len 1)
         with manual_axes(*manual):
             out = pipeline_spmd(stage_fn, local, x_mb, axis_name=axis_name,
-                                with_aux=with_aux)
+                                with_aux=with_aux, side_mb=side_mb)
             if with_aux:
                 y, aux = out
                 for ax in extra_manual:
@@ -142,12 +171,12 @@ def pipeline(
     mapped = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(param_specs, mb_spec),
+        in_specs=(param_specs, mb_spec, side_specs),
         out_specs=(mb_spec, P()) if with_aux else mb_spec,
         axis_names=manual,
     )
     if with_aux:
-        y_mb, aux = mapped(stacked_params, x_mb)
+        y_mb, aux = mapped(stacked_params, x_mb, side_mb)
         return y_mb.reshape(b, *x.shape[1:]), aux
-    y_mb = mapped(stacked_params, x_mb)
+    y_mb = mapped(stacked_params, x_mb, side_mb)
     return y_mb.reshape(b, *x.shape[1:])
