@@ -148,15 +148,18 @@ class JaxLLMEngine(LLMEngine):
                         ("dp", "ep", "tp"),
                     )
             if c.pipeline_parallel_size > 1:
-                if (c.data_parallel_size > 1 or c.expert_parallel_size > 1
-                        or c.kv_layout == "paged"):
+                if c.kv_layout == "paged":
                     raise NotImplementedError(
-                        "pipeline_parallel_size > 1 composes with tp only "
-                        "(dp/ep/paged-KV pipelining not implemented yet)")
+                        "pipeline_parallel_size > 1 composes with tp/ep/dp on "
+                        "the slot layout (paged-KV pipelining not implemented "
+                        "yet)")
+                if c.max_num_seqs % (c.pipeline_parallel_size
+                                     * c.data_parallel_size):
+                    raise ValueError(
+                        "max_num_seqs must divide by pp*dp (slots shard over "
+                        "dp replicas, then microbatch over pp stages)")
                 if cfg.n_layers % c.pipeline_parallel_size:
                     raise ValueError("n_layers must divide by pipeline_parallel_size")
-                if c.max_num_seqs % c.pipeline_parallel_size:
-                    raise ValueError("max_num_seqs must divide by pipeline_parallel_size")
                 if not cfg.scan_layers:
                     raise ValueError("pipeline_parallel_size > 1 requires scan_layers")
             if c.max_num_seqs % c.data_parallel_size:

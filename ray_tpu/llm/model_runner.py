@@ -37,17 +37,24 @@ class DecodeState(NamedTuple):
 
 
 CACHE_SPEC = P(None, "dp", None, "tp", None)
-# pipelined engines: each pp stage holds its layers' cache slice
-CACHE_SPEC_PP = P("pp", None, None, "tp", None)
+# pipelined engines: each pp stage holds its layers' cache slice; slots still
+# shard over dp replicas (pp x dp composition)
+CACHE_SPEC_PP = P("pp", "dp", None, "tp", None)
 LENGTHS_SPEC = P("dp")
+
+
+def _present(mesh: Mesh, spec: P) -> P:
+    """Drop axis names the mesh doesn't have (engine-built meshes carry all of
+    pp/dp/ep/tp; user-supplied meshes may name only a subset)."""
+    return P(*((ax if ax in mesh.shape else None) for ax in spec))
 
 
 def init_state(cfg: ModelConfig, slots: int, max_len: int, mesh: Mesh) -> DecodeState:
     shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
     dtype = cfg.activation_dtype
     pp = "pp" in mesh.shape and mesh.shape["pp"] > 1
-    kv_sh = NamedSharding(mesh, CACHE_SPEC_PP if pp else CACHE_SPEC)
-    len_sh = NamedSharding(mesh, P() if pp else LENGTHS_SPEC)
+    kv_sh = NamedSharding(mesh, _present(mesh, CACHE_SPEC_PP if pp else CACHE_SPEC))
+    len_sh = NamedSharding(mesh, _present(mesh, LENGTHS_SPEC))
     return DecodeState(
         k=jax.device_put(jnp.zeros(shape, dtype), kv_sh),
         v=jax.device_put(jnp.zeros(shape, dtype), kv_sh),
@@ -532,30 +539,34 @@ def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Ar
     Layout: params["layers"] leaves and the KV cache are sharded P("pp") on the
     layer axis, so each stage holds L/pp layers and THEIR cache — the point of
     inference PP is fitting a model + cache that one device group can't. Slots
-    split into pp microbatches; activations hop stage→stage via ppermute while
-    stages work different microbatches (GPipe-style fill/drain per step). tp
-    stays a GSPMD auto axis inside the stage. Embedding/head run outside in
-    auto mode. Not yet composed with dp/ep or the paged layout.
+    first shard over dp replicas (cache slot axis is P("dp"); each replica's
+    slots are a contiguous range), then split into pp microbatches within the
+    replica; activations hop stage→stage via ppermute while stages work
+    different microbatches (GPipe-style fill/drain per step). tp and ep stay
+    GSPMD auto axes inside the stage. Embedding/head run outside in auto mode.
+    Not yet composed with the paged layout.
     """
     from functools import partial
 
     from ray_tpu.parallel.sharding import manual_axes
 
     pp = mesh.shape["pp"]
+    dp = mesh.shape.get("dp", 1)
     s = tokens.shape[0]
-    if s % pp:
-        raise ValueError(f"max_num_seqs {s} must be divisible by pp {pp}")
-    smb = s // pp
+    if s % (pp * dp):
+        raise ValueError(f"max_num_seqs {s} must be divisible by pp*dp {pp * dp}")
     m = pp  # microbatch count = stages (fills the pipe)
 
     x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
-    x_mb = x.reshape(m, smb, 1, x.shape[-1])
 
-    def inner(layers_local, k_local, v_local, x_mb, lengths, active_i):
+    def inner(layers_local, k_local, v_local, x_local, lengths, active_i):
         pp_size = jax.lax.psum(1, "pp")
         stage = jax.lax.axis_index("pp")
         ticks = m + pp_size - 1
         fwd = [(i, i + 1) for i in range(pp_size - 1)]
+        s_l = x_local.shape[0]  # this dp replica's slot count
+        smb = s_l // m
+        x_mb = x_local.reshape(m, smb, 1, x_local.shape[-1])
 
         def tick(carry, t):
             x_recv, k, v, outs = carry
@@ -598,18 +609,21 @@ def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Ar
         outs = jax.lax.psum(
             jnp.where(jax.lax.axis_index("pp") == pp_size - 1, outs,
                       jnp.zeros_like(outs)), "pp")
-        return outs.reshape(s, 1, outs.shape[-1]), k, v
+        return outs.reshape(s_l, 1, outs.shape[-1]), k, v
 
     layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params["layers"])
+    dp_ax = "dp" if "dp" in mesh.shape else None
+    manual = {"pp", "dp"} if dp_ax else {"pp"}
     mapped = jax.shard_map(
         lambda ly, k, v, xm, ln, ac: inner(ly, k, v, xm, ln, ac),
         mesh=mesh,
-        in_specs=(layer_specs, P("pp"), P("pp"), P(), P(), P()),
-        out_specs=(P(), P("pp"), P("pp")),
-        axis_names={"pp"},
+        in_specs=(layer_specs, P("pp", dp_ax), P("pp", dp_ax), P(dp_ax),
+                  P(dp_ax), P(dp_ax)),
+        out_specs=(P(dp_ax), P("pp", dp_ax), P("pp", dp_ax)),
+        axis_names=manual,
     )
-    with manual_axes("pp"):
-        h, nk, nv = mapped(params["layers"], state.k, state.v, x_mb,
+    with manual_axes(*manual):
+        h, nk, nv = mapped(params["layers"], state.k, state.v, x,
                            state.lengths, active.astype(jnp.int32))
 
     h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
