@@ -138,6 +138,23 @@ class NodeRuntime:
         w.state = "idle"
         self.idle.setdefault(w.pool_key, []).append(w)
 
+    def steal_idle_slot(self, exclude_key: str) -> Optional[WorkerHandle]:
+        """Pop one alive idle worker from a DIFFERENT pool so its slot can be
+        re-used for a new pool key (reference: raylet WorkerPool idle-worker
+        eviction). Without this, a node whose worker cap is filled by idle
+        env-pinned workers can never admit a task with a new runtime env — the
+        task queues forever. Env-keyed pools are evicted first (they are
+        per-job specials; plain pools are the shared fast path)."""
+        for key in sorted(self.idle, key=lambda k: ("|env:" not in k, k)):
+            if key == exclude_key:
+                continue
+            pool = self.idle[key]
+            while pool:
+                w = pool.pop()
+                if w.alive():
+                    return w
+        return None
+
     def spawn_worker(self, accel: str, extra_env: Optional[Dict[str, str]] = None,
                      pool_key: Optional[str] = None,
                      container: Optional[Dict] = None) -> Optional[WorkerHandle]:
@@ -1391,6 +1408,20 @@ class Cluster:
                 worker = node.spawn_worker(accel, extra_env=env_vars or None,
                                            pool_key=pool_key,
                                            container=container)
+                if worker is None and len(node.workers) >= node.max_workers:
+                    # cap reached with every slot held by other pools' idle
+                    # workers: evict one to admit this pool, else the task
+                    # would queue forever (the eviction victim is idle — no
+                    # inflight work is lost). Guarded on the cap so a remote
+                    # spawn failure (dead agent, send error) doesn't drain
+                    # warm workers for nothing.
+                    victim = node.steal_idle_slot(pool_key)
+                    if victim is not None:
+                        self._kill_worker(victim, WorkerCrashedError(
+                            "idle worker evicted to admit a new worker pool"))
+                        worker = node.spawn_worker(
+                            accel, extra_env=env_vars or None,
+                            pool_key=pool_key, container=container)
             except ContainerRuntimeError as e:
                 # env setup failure fails the TASK (reference: runtime-env
                 # agent setup errors), not the scheduler
@@ -2002,7 +2033,10 @@ class Cluster:
             if isinstance(w, RemoteWorkerHandle):
                 w.agent.workers.pop(w.worker_id.hex(), None)
             w.node.workers.pop(w.worker_id, None)
-            pool = w.node.idle.get(w.accel)
+            # env-keyed workers idle under pool_key, not accel — removing by
+            # accel left dead handles in env pools (benign: pop_idle skips
+            # dead, but the handles pinned memory until popped)
+            pool = w.node.idle.get(w.pool_key or w.accel)
             if pool and w in pool:
                 pool.remove(w)
             inflight = list(w.inflight)

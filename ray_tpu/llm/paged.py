@@ -54,12 +54,22 @@ POOL_SPEC = P(None, None, None, "tp", None)
 POOL_SPEC_DP = P(None, "dp", None, "tp", None)
 TABLE_SPEC_DP = P("dp", None)
 LENGTHS_SPEC_DP = P("dp")
+# pp>1: the LAYER axis shards over pp — each stage holds its layers' slice of
+# the block pool (the fitting-a-bigger-model point of inference pp); tables/
+# lengths are shared (block ids are layer-independent).
+POOL_SPEC_PP = P("pp", None, None, "tp", None)
 
 
 def _dp_size(mesh: Optional[Mesh]) -> int:
     if mesh is None:
         return 1
     return int(mesh.shape.get("dp", 1))
+
+
+def _pp_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get("pp", 1))
 
 
 def init_paged_state(cfg: ModelConfig, slots: int, max_len: int, num_blocks: int,
@@ -87,7 +97,12 @@ def init_paged_state(cfg: ModelConfig, slots: int, max_len: int, num_blocks: int
     bt = jnp.zeros((slots, max_blocks), jnp.int32)
     lengths = jnp.zeros((slots,), jnp.int32)
     if mesh is not None:
-        pool_spec = POOL_SPEC_DP if dp > 1 else POOL_SPEC
+        if dp > 1:
+            pool_spec = POOL_SPEC_DP
+        elif _pp_size(mesh) > 1:
+            pool_spec = POOL_SPEC_PP
+        else:
+            pool_spec = POOL_SPEC
         k = jax.device_put(k, NamedSharding(mesh, pool_spec))
         v = jax.device_put(v, NamedSharding(mesh, pool_spec))
         bt = jax.device_put(bt, NamedSharding(
@@ -553,6 +568,96 @@ def decode_step_paged(
                       lengths=lengths), logits
 
 
+def decode_step_paged_pp(params, state: PagedState, tokens, active,
+                         cfg: ModelConfig, mesh: Mesh):
+    """Paged decode with the layer stack + pool split across "pp" stages.
+
+    Mirror of model_runner.decode_step_pp on the paged layout: each stage holds
+    its L/pp layers and THEIR slice of the block pool (POOL_SPEC_PP); slots
+    split into pp microbatches and activations hop stage->stage via ppermute.
+    Block tables/lengths are layer-independent, so every stage reads the same
+    (replicated) tables. Bubble ticks run a clipped microbatch with
+    active=False, so their scatter lands in the scratch block — no whole-pool
+    select per tick is needed to discard them. tp/ep stay GSPMD auto axes
+    inside the stage.
+    """
+    from ray_tpu.parallel.sharding import manual_axes, vary_like
+
+    pp = mesh.shape["pp"]
+    s = tokens.shape[0]
+    if s % pp:
+        raise ValueError(f"max_num_seqs {s} must be divisible by pp {pp}")
+    smb = s // pp
+    m = pp
+    nb_slot = state.block_tables.shape[1]
+
+    x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
+    x_mb = x.reshape(m, smb, 1, x.shape[-1])
+
+    def inner(layers_local, k_local, v_local, x_mb, bt, lengths, active_i):
+        pp_size = jax.lax.psum(1, "pp")
+        stage = jax.lax.axis_index("pp")
+        ticks = m + pp_size - 1
+        fwd = [(i, i + 1) for i in range(pp_size - 1)]
+
+        def tick(carry, t):
+            x_recv, k, v, outs = carry
+            j = t - stage
+            jc = jnp.clip(j, 0, m - 1)
+            valid = (j >= 0) & (j < m)
+            x_in = jnp.where(stage == 0, x_mb[jc], x_recv)
+            bt_mb = jax.lax.dynamic_slice(bt, (jc * smb, 0), (smb, nb_slot))
+            ln_mb = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
+            act_mb = (jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0)
+            act_eff = act_mb & valid  # bubble ticks write only the scratch block
+
+            def lbody(c, xs):
+                lp, pk, pv = xs
+                h, pk, pv = _decode_block_paged(c, lp, cfg, pk, pv, bt_mb,
+                                                ln_mb, act_eff)
+                return h, (pk, pv)
+
+            h, (nk, nv) = jax.lax.scan(lbody, x_in, (layers_local, k, v))
+            out_j = t - (pp_size - 1)
+            outs_new = jax.lax.dynamic_update_index_in_dim(
+                outs, h, jnp.clip(out_j, 0, m - 1), 0)
+            outs = jnp.where((stage == pp_size - 1) & (out_j >= 0), outs_new, outs)
+            x_send = jax.lax.ppermute(h, "pp", fwd) if pp_size > 1 else h
+            return (x_send, nk, nv, outs), None
+
+        def _vary(z):
+            return vary_like(z, x_mb, extra=("pp",))
+
+        buf0 = _vary(jnp.zeros_like(x_mb[0]))
+        outs0 = _vary(jnp.zeros_like(x_mb))
+        (_, k, v, outs), _ = jax.lax.scan(
+            tick, (buf0, k_local, v_local, outs0), jnp.arange(ticks))
+        outs = jax.lax.psum(
+            jnp.where(jax.lax.axis_index("pp") == pp_size - 1, outs,
+                      jnp.zeros_like(outs)), "pp")
+        return outs.reshape(s, 1, outs.shape[-1]), k, v
+
+    layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params["layers"])
+    mapped = jax.shard_map(
+        lambda ly, k, v, xm, bt, ln, ac: inner(ly, k, v, xm, bt, ln, ac),
+        mesh=mesh,
+        in_specs=(layer_specs, P("pp"), P("pp"), P(), P(), P(), P()),
+        out_specs=(P(), P("pp"), P("pp")),
+        axis_names={"pp"},
+    )
+    with manual_axes("pp"):
+        h, nk, nv = mapped(params["layers"], state.k, state.v, x_mb,
+                           state.block_tables, state.lengths,
+                           active.astype(jnp.int32))
+
+    h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("sld,dv->slv", h, _qw(head, cfg.activation_dtype))[:, 0]
+    lengths = jnp.where(active, state.lengths + 1, state.lengths)
+    return PagedState(k=nk, v=nv, block_tables=state.block_tables,
+                      lengths=lengths), logits.astype(jnp.float32)
+
+
 def _verify_block_paged(x, lp, cfg: ModelConfig, pk, pv, block_tables, lengths,
                         active):
     """Paged verify: the shared W-token window math with block-table writes.
@@ -940,7 +1045,14 @@ class PagedOps:
         self.cfg = cfg
         self.mesh = mesh
         self.dp = _dp_size(mesh)
+        self.pp = _pp_size(mesh)
         self.slots_per = slots // max(self.dp, 1)
+        if self.pp > 1:
+            # jit + pool donation for the hot decode loop (parity with the
+            # decode_step_paged jit and the engine's slot-pp _decode_pp_jit)
+            self._decode_pp = jax.jit(
+                functools.partial(decode_step_paged_pp, cfg=cfg, mesh=mesh),
+                donate_argnames=("state",))
 
     def install_prefill(self, state, k, v, block_ids, true_len, slot, n_blocks):
         if self.dp > 1:
@@ -981,6 +1093,8 @@ class PagedOps:
         if self.dp > 1:
             return decode_step_paged_dp(params, state, tokens, active,
                                         self.cfg, self.mesh)
+        if self.pp > 1:
+            return self._decode_pp(params, state, tokens, active)
         return decode_step_paged(params, state, tokens, active, self.cfg)
 
     def decode_multi(self, params, state, tokens, active, rngs, temperature,

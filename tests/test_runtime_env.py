@@ -416,3 +416,36 @@ os.execvp(cmd[0], cmd)
         ray_tpu.shutdown()
         ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
                      max_workers_per_node=8)
+
+
+def test_idle_env_worker_evicted_at_cap():
+    """A node whose worker cap is entirely held by IDLE env-pinned workers must
+    still admit a task with a NEW runtime env: the scheduler evicts one idle
+    worker to free the slot (reference: raylet WorkerPool idle eviction).
+    Regression: before the fix the new-env task queued forever and a full-suite
+    session deadlocked at test_pd_disagg_unequal_pools_device_path."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                 max_workers_per_node=2)
+    try:
+        @ray_tpu.remote
+        def probe():
+            return os.getpid()
+
+        # fill BOTH slots with idle workers from two distinct env pools
+        for i in range(2):
+            env = {"env_vars": {"RAY_TPU_TEST_POOL": str(i)}}
+            pid = ray_tpu.get(probe.options(runtime_env=env).remote(),
+                              timeout=60)
+            assert pid
+
+        # a third, NEW env must still run (one idle env worker gets evicted)
+        out = ray_tpu.get(
+            probe.options(runtime_env={"env_vars": {
+                "RAY_TPU_TEST_POOL": "fresh"}}).remote(),
+            timeout=60)
+        assert out
+    finally:
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)
