@@ -148,11 +148,6 @@ class JaxLLMEngine(LLMEngine):
                         ("dp", "ep", "tp"),
                     )
             if c.pipeline_parallel_size > 1:
-                if c.kv_layout == "paged" and c.data_parallel_size > 1:
-                    raise NotImplementedError(
-                        "pipeline_parallel_size > 1 with the paged layout "
-                        "composes with tp/ep but not dp (per-replica pool "
-                        "partitions + stage microbatching not implemented yet)")
                 if c.max_num_seqs % (c.pipeline_parallel_size
                                      * c.data_parallel_size):
                     raise ValueError(

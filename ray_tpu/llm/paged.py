@@ -56,8 +56,11 @@ TABLE_SPEC_DP = P("dp", None)
 LENGTHS_SPEC_DP = P("dp")
 # pp>1: the LAYER axis shards over pp — each stage holds its layers' slice of
 # the block pool (the fitting-a-bigger-model point of inference pp); tables/
-# lengths are shared (block ids are layer-independent).
+# lengths are shared (block ids are layer-independent). With dp too, the block
+# axis additionally shards over dp (independent per-replica partitions, as in
+# POOL_SPEC_DP) and tables/lengths shard over dp on the slot axis.
 POOL_SPEC_PP = P("pp", None, None, "tp", None)
+POOL_SPEC_PP_DP = P("pp", "dp", None, "tp", None)
 
 
 def _dp_size(mesh: Optional[Mesh]) -> int:
@@ -97,9 +100,12 @@ def init_paged_state(cfg: ModelConfig, slots: int, max_len: int, num_blocks: int
     bt = jnp.zeros((slots, max_blocks), jnp.int32)
     lengths = jnp.zeros((slots,), jnp.int32)
     if mesh is not None:
-        if dp > 1:
+        pp = _pp_size(mesh)
+        if dp > 1 and pp > 1:
+            pool_spec = POOL_SPEC_PP_DP
+        elif dp > 1:
             pool_spec = POOL_SPEC_DP
-        elif _pp_size(mesh) > 1:
+        elif pp > 1:
             pool_spec = POOL_SPEC_PP
         else:
             pool_spec = POOL_SPEC
@@ -576,29 +582,34 @@ def decode_step_paged_pp(params, state: PagedState, tokens, active,
     its L/pp layers and THEIR slice of the block pool (POOL_SPEC_PP); slots
     split into pp microbatches and activations hop stage->stage via ppermute.
     Block tables/lengths are layer-independent, so every stage reads the same
-    (replicated) tables. Bubble ticks run a clipped microbatch with
-    active=False, so their scatter lands in the scratch block — no whole-pool
-    select per tick is needed to discard them. tp/ep stay GSPMD auto axes
-    inside the stage.
+    tables. Bubble ticks run a clipped microbatch with active=False, so their
+    scatter lands in the scratch block — no whole-pool select per tick is
+    needed to discard them. tp/ep stay GSPMD auto axes inside the stage. With
+    dp>1, slots and the block axis additionally shard over dp replicas
+    (POOL_SPEC_PP_DP): each replica owns an independent pool partition with
+    replica-local block ids and its own scratch (the partition's last block),
+    so the manual-region body is unchanged — it just sees local arrays.
     """
     from ray_tpu.parallel.sharding import manual_axes, vary_like
 
     pp = mesh.shape["pp"]
+    dp = mesh.shape.get("dp", 1)
     s = tokens.shape[0]
-    if s % pp:
-        raise ValueError(f"max_num_seqs {s} must be divisible by pp {pp}")
-    smb = s // pp
+    if s % (pp * dp):
+        raise ValueError(f"max_num_seqs {s} must be divisible by pp*dp {pp * dp}")
     m = pp
     nb_slot = state.block_tables.shape[1]
 
     x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
-    x_mb = x.reshape(m, smb, 1, x.shape[-1])
 
-    def inner(layers_local, k_local, v_local, x_mb, bt, lengths, active_i):
+    def inner(layers_local, k_local, v_local, x_local, bt, lengths, active_i):
         pp_size = jax.lax.psum(1, "pp")
         stage = jax.lax.axis_index("pp")
         ticks = m + pp_size - 1
         fwd = [(i, i + 1) for i in range(pp_size - 1)]
+        s_l = x_local.shape[0]  # this dp replica's slot count
+        smb = s_l // m
+        x_mb = x_local.reshape(m, smb, 1, x_local.shape[-1])
 
         def tick(carry, t):
             x_recv, k, v, outs = carry
@@ -635,18 +646,21 @@ def decode_step_paged_pp(params, state: PagedState, tokens, active,
         outs = jax.lax.psum(
             jnp.where(jax.lax.axis_index("pp") == pp_size - 1, outs,
                       jnp.zeros_like(outs)), "pp")
-        return outs.reshape(s, 1, outs.shape[-1]), k, v
+        return outs.reshape(s_l, 1, outs.shape[-1]), k, v
 
     layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params["layers"])
+    dp_ax = "dp" if "dp" in mesh.shape else None
+    manual = {"pp", "dp"} if dp_ax else {"pp"}
     mapped = jax.shard_map(
         lambda ly, k, v, xm, bt, ln, ac: inner(ly, k, v, xm, bt, ln, ac),
         mesh=mesh,
-        in_specs=(layer_specs, P("pp"), P("pp"), P(), P(), P(), P()),
-        out_specs=(P(), P("pp"), P("pp")),
-        axis_names={"pp"},
+        in_specs=(layer_specs, P("pp", dp_ax), P("pp", dp_ax), P(dp_ax),
+                  P(dp_ax), P(dp_ax), P(dp_ax)),
+        out_specs=(P(dp_ax), P("pp", dp_ax), P("pp", dp_ax)),
+        axis_names=manual,
     )
-    with manual_axes("pp"):
-        h, nk, nv = mapped(params["layers"], state.k, state.v, x_mb,
+    with manual_axes(*manual):
+        h, nk, nv = mapped(params["layers"], state.k, state.v, x,
                            state.block_tables, state.lengths,
                            active.astype(jnp.int32))
 
@@ -1090,11 +1104,13 @@ class PagedOps:
                                          n_blocks=n_blocks)
 
     def decode_step(self, params, state, tokens, active):
+        if self.pp > 1:
+            # handles dp>1 too (slots + pool partition per replica inside the
+            # same manual region)
+            return self._decode_pp(params, state, tokens, active)
         if self.dp > 1:
             return decode_step_paged_dp(params, state, tokens, active,
                                         self.cfg, self.mesh)
-        if self.pp > 1:
-            return self._decode_pp(params, state, tokens, active)
         return decode_step_paged(params, state, tokens, active, self.cfg)
 
     def decode_multi(self, params, state, tokens, active, rngs, temperature,

@@ -488,3 +488,40 @@ def test_pipeline_parallel_paged_with_tp_long_decode():
     assert _greedy(ref, prompt, n=24) == _greedy(pptp, prompt, n=24)
     ref.shutdown()
     pptp.shutdown()
+
+
+def test_pipeline_parallel_paged_with_dp():
+    """The full pp x dp x paged composition: layers+pool slices over pp stages,
+    slots + independent pool partitions over dp replicas, one manual region.
+    Tokens match the single-device slot engine; concurrent requests land on
+    both replicas."""
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(name="tiny-pp-dp-paged", **TINY)
+    params = llama.init(jax.random.PRNGKey(6), cfg)
+    ref = JaxLLMEngine(LLMConfig(model_source=cfg, kv_layout="slot", **COMMON),
+                       params=params)
+    eng = JaxLLMEngine(LLMConfig(model_source=cfg, kv_layout="paged",
+                                 pipeline_parallel_size=2,
+                                 data_parallel_size=2, kv_block_size=16,
+                                 **COMMON), params=params)
+    for prompt in ("full composition", "replica stage pools"):
+        assert _greedy(ref, prompt) == _greedy(eng, prompt)
+    # long decode crosses a block boundary (kv_block_size=16): mid-generation
+    # append_block under the pp x dp pool layout still matches
+    long_prompt = "decode across block boundaries in both axes " * 2
+    assert _greedy(ref, long_prompt, n=24) == _greedy(eng, long_prompt, n=24)
+    assert len(eng.state.k.sharding.device_set) == 4
+    outs = []
+    threads = [threading.Thread(target=lambda p=p: outs.append(_greedy(eng, p)))
+               for p in ("aa bb", "cc dd", "ee ff", "gg hh")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(outs) == 4 and all(len(o) == 8 for o in outs)
+    ref.shutdown()
+    eng.shutdown()
