@@ -185,9 +185,10 @@ class JaxLLMEngine(LLMEngine):
                     raise NotImplementedError(
                         f"speculative_method {c.speculative_method!r}: only "
                         "'ngram' (prompt lookup) is implemented")
-                if c.pipeline_parallel_size > 1:
+                if c.pipeline_parallel_size > 1 and c.kv_layout == "paged":
                     raise NotImplementedError(
-                        "speculative decoding does not compose with pp decode")
+                        "speculative decoding composes with pp on the slot "
+                        "layout only (paged spec x pp not implemented yet)")
             if c.prefill_chunk and c.max_model_len % c.prefill_chunk:
                 # guarantees a chunk-padded prompt never exceeds max_model_len
                 # (the block table / slot cache width)
@@ -231,6 +232,11 @@ class JaxLLMEngine(LLMEngine):
                     functools.partial(model_runner.decode_step_pp,
                                       cfg=cfg, mesh=self._mesh),
                     donate_argnames=("state",))
+                if c.num_speculative_tokens:
+                    self._spec_pp_jit = jax.jit(
+                        functools.partial(model_runner.spec_verify_step_pp,
+                                          cfg=cfg, mesh=self._mesh),
+                        donate_argnames=("state",))
             self._rng = jax.random.PRNGKey(0)
             # host mirrors of per-slot sampling params
             n = c.max_num_seqs
@@ -985,7 +991,9 @@ class JaxLLMEngine(LLMEngine):
         all emit this step (greedy slots only; others ride along with k=0)."""
         cfg = self.model_config
         c = self.config
-        if c.num_decode_steps > 1:
+        if c.num_decode_steps > 1 and c.pipeline_parallel_size == 1:
+            # pp keeps per-step scheduling (microbatch ticks), as in the plain
+            # decode path — spec windows go through the single-verify schedule
             m = self._spec_burst_width()
             if m > 1 and c.kv_layout == "paged":
                 # every window position of the burst must land in an owned block
@@ -1022,6 +1030,12 @@ class JaxLLMEngine(LLMEngine):
             return  # pool-exhaustion preemption may have drained every slot
         if c.kv_layout == "paged":
             self.state, out_toks, n_acc = self._pops.spec_verify(
+                self.params, self.state, jnp.asarray(window),
+                jnp.asarray(draft_len), jnp.asarray(active_mask),
+                self._next_rng(), jnp.asarray(self._temp),
+                jnp.asarray(self._top_p), jnp.asarray(self._top_k))
+        elif c.pipeline_parallel_size > 1:
+            self.state, out_toks, n_acc = self._spec_pp_jit(
                 self.params, self.state, jnp.asarray(window),
                 jnp.asarray(draft_len), jnp.asarray(active_mask),
                 self._next_rng(), jnp.asarray(self._temp),

@@ -326,14 +326,18 @@ def spec_accept(window, greedy, draft_len, active, lengths, rng, temperature,
 
 
 def spec_driver(params, k0, v0, lengths, window, draft_len, active, cfg,
-                rng, temperature, top_p, top_k, layer_fn):
+                rng, temperature, top_p, top_k, layer_fn=None,
+                layers_pass=None):
     """Shared speculative-verify pipeline (embed -> layers -> norm -> head ->
     accept); the cache layout differs only in layer_fn(h, lp, k, v). MoE models
     verify too: _verify_core routes the whole window through moe_mlp with
-    inactive slots masked out of expert capacity."""
+    inactive slots masked out of expert capacity. `layers_pass(x) -> (x, nk,
+    nv)` replaces the whole layer loop (the pp schedule owns its own loop)."""
     x = params["embed"].astype(cfg.activation_dtype)[window]
 
-    if cfg.scan_layers:
+    if layers_pass is not None:
+        x, nk, nv = layers_pass(x)
+    elif cfg.scan_layers:
         def body(carry, xs):
             h = carry
             lp, a, b = xs
@@ -385,6 +389,77 @@ def spec_verify_step(
         cfg, rng, temperature, top_p, top_k,
         lambda h, lp, ck, cv: _verify_block(h, lp, cfg, ck, cv, state.lengths,
                                             active=active))
+    return DecodeState(k=nk, v=nv, lengths=lengths), greedy, n_acc
+
+
+def spec_verify_step_pp(params, state: DecodeState, window, draft_len, active,
+                        rng, temperature, top_p, top_k, *,
+                        cfg: ModelConfig, mesh: Mesh):
+    """Speculative verify through the pipeline schedule (slot layout): same
+    tick structure as decode_step_pp but the microbatch payload is the whole
+    [smb, W, D] verify window. Slots shard over dp replicas, layers + cache
+    over pp stages; bubble-tick cache writes are discarded with the same
+    valid-mask select the decode schedule uses. The accept logic is
+    spec_driver's, via its layers_pass seam."""
+    from ray_tpu.parallel.sharding import manual_axes
+
+    pp = mesh.shape["pp"]
+    dp = mesh.shape.get("dp", 1)
+    s, w = window.shape
+    if s % (pp * dp):
+        raise ValueError(f"max_num_seqs {s} must be divisible by pp*dp {pp * dp}")
+    m = pp
+
+    def layers_pass(x):  # [S, W, D]
+        def inner(layers_local, k_local, v_local, x_local, lengths, active_i):
+            s_l = x_local.shape[0]
+            smb = s_l // m
+            x_mb = x_local.reshape(m, smb, w, x_local.shape[-1])
+
+            def step_mb(x_in, kv, jc, valid):
+                k, v = kv
+                mb_len = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
+                mb_act = jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0
+                k_mb = jax.lax.dynamic_slice_in_dim(k, jc * smb, smb, axis=1)
+                v_mb = jax.lax.dynamic_slice_in_dim(v, jc * smb, smb, axis=1)
+
+                def lbody(c, xs):
+                    lp, ck, cv = xs
+                    h, ck, cv = _verify_block(c, lp, cfg, ck, cv, mb_len,
+                                              active=mb_act)
+                    return h, (ck, cv)
+
+                h, (nk_mb, nv_mb) = jax.lax.scan(
+                    lbody, x_in, (layers_local, k_mb, v_mb))
+                k_new = jax.lax.dynamic_update_slice_in_dim(k, nk_mb, jc * smb,
+                                                            axis=1)
+                v_new = jax.lax.dynamic_update_slice_in_dim(v, nv_mb, jc * smb,
+                                                            axis=1)
+                return h, (jnp.where(valid, k_new, k),
+                           jnp.where(valid, v_new, v))
+
+            outs, (k, v) = _pp_schedule(x_mb, (k_local, v_local), step_mb)
+            return outs.reshape(s_l, w, outs.shape[-1]), k, v
+
+        layer_specs = jax.tree_util.tree_map(lambda _: P("pp"),
+                                             params["layers"])
+        dp_ax = "dp" if "dp" in mesh.shape else None
+        manual = {"pp", "dp"} if dp_ax else {"pp"}
+        mapped = jax.shard_map(
+            lambda ly, k, v, xm, ln, ac: inner(ly, k, v, xm, ln, ac),
+            mesh=mesh,
+            in_specs=(layer_specs, P("pp", dp_ax), P("pp", dp_ax), P(dp_ax),
+                      P(dp_ax), P(dp_ax)),
+            out_specs=(P(dp_ax), P("pp", dp_ax), P("pp", dp_ax)),
+            axis_names=manual,
+        )
+        with manual_axes(*manual):
+            return mapped(params["layers"], state.k, state.v, x,
+                          state.lengths, active.astype(jnp.int32))
+
+    nk, nv, lengths, greedy, n_acc = spec_driver(
+        params, state.k, state.v, state.lengths, window, draft_len, active,
+        cfg, rng, temperature, top_p, top_k, layers_pass=layers_pass)
     return DecodeState(k=nk, v=nv, lengths=lengths), greedy, n_acc
 
 
@@ -530,6 +605,52 @@ def decode_multi(
 
 # ------------------------------------------------------- pipeline-parallel decode
 
+def _pp_schedule(x_mb, kv, step_mb, *, axis_name: str = "pp"):
+    """Shared GPipe-style inference tick skeleton (call inside a shard_map
+    manual over `axis_name`): M microbatches through pp stages, activations
+    hopping stage->stage via ppermute while stages work different microbatches.
+
+    step_mb(x_in, kv, jc, valid) -> (h, kv): one stage's work on its CURRENT
+    microbatch jc (clipped; `valid` is False on fill/drain bubble ticks — the
+    callback must discard or redirect its cache writes then). kv is an
+    arbitrary pytree threaded through the scan (slot caches, block pools).
+    Returns (outs [M, ...] — the last stage's outputs psum-broadcast to every
+    stage — and the final kv). One implementation so the slot-decode,
+    spec-verify, and paged-decode pp variants cannot drift apart.
+    """
+    from ray_tpu.parallel.sharding import vary_like
+
+    pp_size = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    ticks = m + pp_size - 1
+    fwd = [(i, i + 1) for i in range(pp_size - 1)]
+
+    def tick(carry, t):
+        x_recv, kv, outs = carry
+        j = t - stage
+        jc = jnp.clip(j, 0, m - 1)
+        valid = (j >= 0) & (j < m)
+        x_in = jnp.where(stage == 0, x_mb[jc], x_recv)
+        h, kv = step_mb(x_in, kv, jc, valid)
+        out_j = t - (pp_size - 1)
+        outs_new = jax.lax.dynamic_update_index_in_dim(
+            outs, h, jnp.clip(out_j, 0, m - 1), 0)
+        outs = jnp.where((stage == pp_size - 1) & (out_j >= 0), outs_new, outs)
+        x_send = jax.lax.ppermute(h, axis_name, fwd) if pp_size > 1 else h
+        return (x_send, kv, outs), None
+
+    def _vary(z):
+        return vary_like(z, x_mb, extra=(axis_name,))
+
+    buf0 = _vary(jnp.zeros_like(x_mb[0]))
+    outs0 = _vary(jnp.zeros_like(x_mb))
+    (_, kv, outs), _ = jax.lax.scan(tick, (buf0, kv, outs0), jnp.arange(ticks))
+    outs = jax.lax.psum(
+        jnp.where(stage == pp_size - 1, outs, jnp.zeros_like(outs)), axis_name)
+    return outs, kv
+
+
 def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Array,
                    cfg: ModelConfig, mesh: Mesh):
     """Decode with the layer stack split across the "pp" mesh axis, microbatched
@@ -560,20 +681,12 @@ def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Ar
     x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
 
     def inner(layers_local, k_local, v_local, x_local, lengths, active_i):
-        pp_size = jax.lax.psum(1, "pp")
-        stage = jax.lax.axis_index("pp")
-        ticks = m + pp_size - 1
-        fwd = [(i, i + 1) for i in range(pp_size - 1)]
         s_l = x_local.shape[0]  # this dp replica's slot count
         smb = s_l // m
         x_mb = x_local.reshape(m, smb, 1, x_local.shape[-1])
 
-        def tick(carry, t):
-            x_recv, k, v, outs = carry
-            j = t - stage
-            jc = jnp.clip(j, 0, m - 1)
-            valid = (j >= 0) & (j < m)
-            x_in = jnp.where(stage == 0, x_mb[jc], x_recv)
+        def step_mb(x_in, kv, jc, valid):
+            k, v = kv
             mb_lengths = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
             mb_active = jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0
             k_mb = jax.lax.dynamic_slice_in_dim(k, jc * smb, smb, axis=1)
@@ -587,28 +700,10 @@ def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Ar
             h, (nk_mb, nv_mb) = jax.lax.scan(lbody, x_in, (layers_local, k_mb, v_mb))
             k_new = jax.lax.dynamic_update_slice_in_dim(k, nk_mb, jc * smb, axis=1)
             v_new = jax.lax.dynamic_update_slice_in_dim(v, nv_mb, jc * smb, axis=1)
-            k = jnp.where(valid, k_new, k)
-            v = jnp.where(valid, v_new, v)
-            out_j = t - (pp_size - 1)
-            outs_new = jax.lax.dynamic_update_index_in_dim(
-                outs, h, jnp.clip(out_j, 0, m - 1), 0)
-            outs = jnp.where((stage == pp_size - 1) & (out_j >= 0), outs_new, outs)
-            x_send = jax.lax.ppermute(h, "pp", fwd) if pp_size > 1 else h
-            return (x_send, k, v, outs), None
+            # bubble ticks: discard the (garbage) writes wholesale
+            return h, (jnp.where(valid, k_new, k), jnp.where(valid, v_new, v))
 
-        from ray_tpu.parallel.sharding import vary_like
-
-        def _vary(z):
-            return vary_like(z, x_mb, extra=("pp",))
-
-        buf0 = _vary(jnp.zeros_like(x_mb[0]))
-        outs0 = _vary(jnp.zeros_like(x_mb))
-        (_, k, v, outs), _ = jax.lax.scan(
-            tick, (buf0, k_local, v_local, outs0), jnp.arange(ticks))
-        # last stage holds the real outputs; broadcast to every stage
-        outs = jax.lax.psum(
-            jnp.where(jax.lax.axis_index("pp") == pp_size - 1, outs,
-                      jnp.zeros_like(outs)), "pp")
+        outs, (k, v) = _pp_schedule(x_mb, (k_local, v_local), step_mb)
         return outs.reshape(s_l, 1, outs.shape[-1]), k, v
 
     layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params["layers"])

@@ -590,7 +590,7 @@ def decode_step_paged_pp(params, state: PagedState, tokens, active,
     replica-local block ids and its own scratch (the partition's last block),
     so the manual-region body is unchanged — it just sees local arrays.
     """
-    from ray_tpu.parallel.sharding import manual_axes, vary_like
+    from ray_tpu.parallel.sharding import manual_axes
 
     pp = mesh.shape["pp"]
     dp = mesh.shape.get("dp", 1)
@@ -603,20 +603,14 @@ def decode_step_paged_pp(params, state: PagedState, tokens, active,
     x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
 
     def inner(layers_local, k_local, v_local, x_local, bt, lengths, active_i):
-        pp_size = jax.lax.psum(1, "pp")
-        stage = jax.lax.axis_index("pp")
-        ticks = m + pp_size - 1
-        fwd = [(i, i + 1) for i in range(pp_size - 1)]
+        from ray_tpu.llm.model_runner import _pp_schedule
+
         s_l = x_local.shape[0]  # this dp replica's slot count
         smb = s_l // m
         x_mb = x_local.reshape(m, smb, 1, x_local.shape[-1])
 
-        def tick(carry, t):
-            x_recv, k, v, outs = carry
-            j = t - stage
-            jc = jnp.clip(j, 0, m - 1)
-            valid = (j >= 0) & (j < m)
-            x_in = jnp.where(stage == 0, x_mb[jc], x_recv)
+        def step_mb(x_in, kv, jc, valid):
+            k, v = kv
             bt_mb = jax.lax.dynamic_slice(bt, (jc * smb, 0), (smb, nb_slot))
             ln_mb = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
             act_mb = (jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0)
@@ -629,23 +623,9 @@ def decode_step_paged_pp(params, state: PagedState, tokens, active,
                 return h, (pk, pv)
 
             h, (nk, nv) = jax.lax.scan(lbody, x_in, (layers_local, k, v))
-            out_j = t - (pp_size - 1)
-            outs_new = jax.lax.dynamic_update_index_in_dim(
-                outs, h, jnp.clip(out_j, 0, m - 1), 0)
-            outs = jnp.where((stage == pp_size - 1) & (out_j >= 0), outs_new, outs)
-            x_send = jax.lax.ppermute(h, "pp", fwd) if pp_size > 1 else h
-            return (x_send, nk, nv, outs), None
+            return h, (nk, nv)
 
-        def _vary(z):
-            return vary_like(z, x_mb, extra=("pp",))
-
-        buf0 = _vary(jnp.zeros_like(x_mb[0]))
-        outs0 = _vary(jnp.zeros_like(x_mb))
-        (_, k, v, outs), _ = jax.lax.scan(
-            tick, (buf0, k_local, v_local, outs0), jnp.arange(ticks))
-        outs = jax.lax.psum(
-            jnp.where(jax.lax.axis_index("pp") == pp_size - 1, outs,
-                      jnp.zeros_like(outs)), "pp")
+        outs, (k, v) = _pp_schedule(x_mb, (k_local, v_local), step_mb)
         return outs.reshape(s_l, 1, outs.shape[-1]), k, v
 
     layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params["layers"])

@@ -361,9 +361,10 @@ def test_speculative_config_validation():
                                  speculative_method="draft_model"))
     with pytest.raises(NotImplementedError, match="ngram"):
         eng.start()
-    # spec decoding composes with paged + fused multi-step now; pp remains out
+    # spec composes with paged, fused multi-step, and slot-layout pp; the one
+    # remaining spec fence is the paged layout under pp
     eng2 = JaxLLMEngine(LLMConfig(model_id="sv3", model_source="test-tiny",
-                                  pipeline_parallel_size=2,
+                                  pipeline_parallel_size=2, kv_layout="paged",
                                   num_speculative_tokens=4))
     with pytest.raises(NotImplementedError, match="pp"):
         eng2.start()
@@ -483,3 +484,43 @@ def test_spec_fused_oracle_accepts_inside_burst():
     assert list(acc_m[:, 0]) == [k, k]
     emitted = [int(toks_m[s, 0, t]) for s in range(m) for t in range(k + 1)]
     assert emitted == want[1:1 + m * (k + 1)]
+
+
+@pytest.mark.parametrize("parallel", [
+    dict(pipeline_parallel_size=2),
+    dict(pipeline_parallel_size=2, data_parallel_size=2),
+])
+def test_spec_decode_through_pipeline_matches_greedy(parallel):
+    """Speculative verify rides the pp schedule (slot layout): the verify
+    window is the microbatch payload; greedy output is IDENTICAL to plain
+    decode with oracle drafts (all accepted) and adversarial drafts (all
+    rejected), with or without dp replicas."""
+    params = llama_init_cached(CFG)
+    prompt = [1, 10, 11, 12, 13]
+    want = reference_greedy(params, prompt, 12)
+
+    eng = JaxLLMEngine(LLMConfig(
+        model_id=f"spec-pp-{len(parallel)}", model_source="test-tiny",
+        max_num_seqs=4, max_model_len=64, tokenizer="byte",
+        num_speculative_tokens=4, **parallel), params=params)
+    eng.start()
+    try:
+        out = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=12, temperature=0.0, stop_token_ids=[-1]))
+        assert out.token_ids == want
+
+        oracle = {tuple(prompt + want[:i]): want[i:i + 4]
+                  for i in range(len(want))}
+        eng._propose_ngram = lambda req, cap: list(
+            oracle.get(tuple(req.token_history), []))[:cap]
+        out2 = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=12, temperature=0.0, stop_token_ids=[-1]))
+        assert out2.token_ids == want
+        assert eng.metrics()["num_spec_accepted"] >= 8
+
+        eng._propose_ngram = lambda req, cap: [7] * cap
+        out3 = eng.generate_sync(prompt, SamplingParams(
+            max_tokens=12, temperature=0.0, stop_token_ids=[-1]))
+        assert out3.token_ids == want
+    finally:
+        eng.shutdown()
