@@ -185,10 +185,6 @@ class JaxLLMEngine(LLMEngine):
                     raise NotImplementedError(
                         f"speculative_method {c.speculative_method!r}: only "
                         "'ngram' (prompt lookup) is implemented")
-                if c.pipeline_parallel_size > 1 and c.kv_layout == "paged":
-                    raise NotImplementedError(
-                        "speculative decoding composes with pp on the slot "
-                        "layout only (paged spec x pp not implemented yet)")
             if c.prefill_chunk and c.max_model_len % c.prefill_chunk:
                 # guarantees a chunk-padded prompt never exceeds max_model_len
                 # (the block table / slot cache width)

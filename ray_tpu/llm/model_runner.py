@@ -401,61 +401,17 @@ def spec_verify_step_pp(params, state: DecodeState, window, draft_len, active,
     over pp stages; bubble-tick cache writes are discarded with the same
     valid-mask select the decode schedule uses. The accept logic is
     spec_driver's, via its layers_pass seam."""
-    from ray_tpu.parallel.sharding import manual_axes
-
     pp = mesh.shape["pp"]
     dp = mesh.shape.get("dp", 1)
     s, w = window.shape
     if s % (pp * dp):
         raise ValueError(f"max_num_seqs {s} must be divisible by pp*dp {pp * dp}")
-    m = pp
 
     def layers_pass(x):  # [S, W, D]
-        def inner(layers_local, k_local, v_local, x_local, lengths, active_i):
-            s_l = x_local.shape[0]
-            smb = s_l // m
-            x_mb = x_local.reshape(m, smb, w, x_local.shape[-1])
-
-            def step_mb(x_in, kv, jc, valid):
-                k, v = kv
-                mb_len = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
-                mb_act = jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0
-                k_mb = jax.lax.dynamic_slice_in_dim(k, jc * smb, smb, axis=1)
-                v_mb = jax.lax.dynamic_slice_in_dim(v, jc * smb, smb, axis=1)
-
-                def lbody(c, xs):
-                    lp, ck, cv = xs
-                    h, ck, cv = _verify_block(c, lp, cfg, ck, cv, mb_len,
-                                              active=mb_act)
-                    return h, (ck, cv)
-
-                h, (nk_mb, nv_mb) = jax.lax.scan(
-                    lbody, x_in, (layers_local, k_mb, v_mb))
-                k_new = jax.lax.dynamic_update_slice_in_dim(k, nk_mb, jc * smb,
-                                                            axis=1)
-                v_new = jax.lax.dynamic_update_slice_in_dim(v, nv_mb, jc * smb,
-                                                            axis=1)
-                return h, (jnp.where(valid, k_new, k),
-                           jnp.where(valid, v_new, v))
-
-            outs, (k, v) = _pp_schedule(x_mb, (k_local, v_local), step_mb)
-            return outs.reshape(s_l, w, outs.shape[-1]), k, v
-
-        layer_specs = jax.tree_util.tree_map(lambda _: P("pp"),
-                                             params["layers"])
-        dp_ax = "dp" if "dp" in mesh.shape else None
-        manual = {"pp", "dp"} if dp_ax else {"pp"}
-        mapped = jax.shard_map(
-            lambda ly, k, v, xm, ln, ac: inner(ly, k, v, xm, ln, ac),
-            mesh=mesh,
-            in_specs=(layer_specs, P("pp", dp_ax), P("pp", dp_ax), P(dp_ax),
-                      P(dp_ax), P(dp_ax)),
-            out_specs=(P(dp_ax), P("pp", dp_ax), P("pp", dp_ax)),
-            axis_names=manual,
-        )
-        with manual_axes(*manual):
-            return mapped(params["layers"], state.k, state.v, x,
-                          state.lengths, active.astype(jnp.int32))
+        return _pp_slot_layers(
+            params, state.k, state.v, x, state.lengths, active, mesh, width=w,
+            block_fn=lambda c, lp, ck, cv, ln, ac:
+                _verify_block(c, lp, cfg, ck, cv, ln, active=ac))
 
     nk, nv, lengths, greedy, n_acc = spec_driver(
         params, state.k, state.v, state.lengths, window, draft_len, active,
@@ -651,6 +607,69 @@ def _pp_schedule(x_mb, kv, step_mb, *, axis_name: str = "pp"):
     return outs, kv
 
 
+def _pp_shard_map(inner, params_layers, mesh: Mesh, arrays):
+    """Shared shard_map scaffolding for every pp inference variant: layers
+    manual over "pp" (stage-stacked leading axis), k/v over ("pp", dp), every
+    other array over dp on its slot axis; dp joins the manual set only when
+    the mesh names it. inner(layers_local, *local_arrays) -> (outs, k, v)."""
+    from ray_tpu.parallel.sharding import manual_axes
+
+    layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params_layers)
+    dp_ax = "dp" if "dp" in mesh.shape else None
+    manual = {"pp", "dp"} if dp_ax else {"pp"}
+    n_rest = len(arrays) - 2  # beyond k and v
+    mapped = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(layer_specs, P("pp", dp_ax), P("pp", dp_ax))
+                 + (P(dp_ax),) * n_rest,
+        out_specs=(P(dp_ax), P("pp", dp_ax), P("pp", dp_ax)),
+        axis_names=manual,
+    )
+    with manual_axes(*manual):
+        return mapped(params_layers, *arrays)
+
+
+def _pp_slot_layers(params, k0, v0, x, lengths, active, mesh: Mesh, *,
+                    width: int, block_fn):
+    """Slot-cache layer pass through the pp schedule, shared by decode
+    (width=1) and spec verify (width=W). block_fn(h, lp, ck, cv, mb_lengths,
+    mb_active) -> (h, ck, cv) on one microbatch's slot-sliced cache; bubble
+    ticks' cache writes are discarded wholesale by the valid mask."""
+    m = mesh.shape["pp"]
+
+    def inner(layers_local, k_local, v_local, x_local, lengths, active_i):
+        s_l = x_local.shape[0]  # this dp replica's slot count
+        smb = s_l // m
+        x_mb = x_local.reshape(m, smb, width, x_local.shape[-1])
+
+        def step_mb(x_in, kv, jc, valid):
+            k, v = kv
+            mb_lengths = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
+            mb_active = jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0
+            k_mb = jax.lax.dynamic_slice_in_dim(k, jc * smb, smb, axis=1)
+            v_mb = jax.lax.dynamic_slice_in_dim(v, jc * smb, smb, axis=1)
+
+            def lbody(c, xs):
+                lp, ck, cv = xs
+                h, ck, cv = block_fn(c, lp, ck, cv, mb_lengths, mb_active)
+                return h, (ck, cv)
+
+            h, (nk_mb, nv_mb) = jax.lax.scan(lbody, x_in,
+                                             (layers_local, k_mb, v_mb))
+            k_new = jax.lax.dynamic_update_slice_in_dim(k, nk_mb, jc * smb,
+                                                        axis=1)
+            v_new = jax.lax.dynamic_update_slice_in_dim(v, nv_mb, jc * smb,
+                                                        axis=1)
+            return h, (jnp.where(valid, k_new, k), jnp.where(valid, v_new, v))
+
+        outs, (k, v) = _pp_schedule(x_mb, (k_local, v_local), step_mb)
+        return outs.reshape(s_l, width, outs.shape[-1]), k, v
+
+    return _pp_shard_map(inner, params["layers"], mesh,
+                         (k0, v0, x, lengths, active.astype(jnp.int32)))
+
+
 def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Array,
                    cfg: ModelConfig, mesh: Mesh):
     """Decode with the layer stack split across the "pp" mesh axis, microbatched
@@ -665,61 +684,18 @@ def decode_step_pp(params, state: DecodeState, tokens: jax.Array, active: jax.Ar
     replica; activations hop stage→stage via ppermute while stages work
     different microbatches (GPipe-style fill/drain per step). tp and ep stay
     GSPMD auto axes inside the stage. Embedding/head run outside in auto mode.
-    Not yet composed with the paged layout.
     """
-    from functools import partial
-
-    from ray_tpu.parallel.sharding import manual_axes
-
     pp = mesh.shape["pp"]
     dp = mesh.shape.get("dp", 1)
     s = tokens.shape[0]
     if s % (pp * dp):
         raise ValueError(f"max_num_seqs {s} must be divisible by pp*dp {pp * dp}")
-    m = pp  # microbatch count = stages (fills the pipe)
 
     x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
-
-    def inner(layers_local, k_local, v_local, x_local, lengths, active_i):
-        s_l = x_local.shape[0]  # this dp replica's slot count
-        smb = s_l // m
-        x_mb = x_local.reshape(m, smb, 1, x_local.shape[-1])
-
-        def step_mb(x_in, kv, jc, valid):
-            k, v = kv
-            mb_lengths = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
-            mb_active = jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0
-            k_mb = jax.lax.dynamic_slice_in_dim(k, jc * smb, smb, axis=1)
-            v_mb = jax.lax.dynamic_slice_in_dim(v, jc * smb, smb, axis=1)
-
-            def lbody(c, xs):
-                lp, ck, cv = xs
-                h, ck, cv = _decode_block(c, lp, cfg, ck, cv, mb_lengths, mb_active)
-                return h, (ck, cv)
-
-            h, (nk_mb, nv_mb) = jax.lax.scan(lbody, x_in, (layers_local, k_mb, v_mb))
-            k_new = jax.lax.dynamic_update_slice_in_dim(k, nk_mb, jc * smb, axis=1)
-            v_new = jax.lax.dynamic_update_slice_in_dim(v, nv_mb, jc * smb, axis=1)
-            # bubble ticks: discard the (garbage) writes wholesale
-            return h, (jnp.where(valid, k_new, k), jnp.where(valid, v_new, v))
-
-        outs, (k, v) = _pp_schedule(x_mb, (k_local, v_local), step_mb)
-        return outs.reshape(s_l, 1, outs.shape[-1]), k, v
-
-    layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params["layers"])
-    dp_ax = "dp" if "dp" in mesh.shape else None
-    manual = {"pp", "dp"} if dp_ax else {"pp"}
-    mapped = jax.shard_map(
-        lambda ly, k, v, xm, ln, ac: inner(ly, k, v, xm, ln, ac),
-        mesh=mesh,
-        in_specs=(layer_specs, P("pp", dp_ax), P("pp", dp_ax), P(dp_ax),
-                  P(dp_ax), P(dp_ax)),
-        out_specs=(P(dp_ax), P("pp", dp_ax), P("pp", dp_ax)),
-        axis_names=manual,
-    )
-    with manual_axes(*manual):
-        h, nk, nv = mapped(params["layers"], state.k, state.v, x,
-                           state.lengths, active.astype(jnp.int32))
+    h, nk, nv = _pp_slot_layers(
+        params, state.k, state.v, x, state.lengths, active, mesh, width=1,
+        block_fn=lambda c, lp, ck, cv, ln, ac:
+            _decode_block(c, lp, cfg, ck, cv, ln, ac))
 
     h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]

@@ -574,6 +574,46 @@ def decode_step_paged(
                       lengths=lengths), logits
 
 
+def _pp_paged_layers(params, state: PagedState, x, active, mesh: Mesh, *,
+                     width: int, block_fn):
+    """Paged layer pass through the pp schedule, shared by decode (width=1)
+    and spec verify (width=W). Unlike the slot variant the whole (stage-local)
+    pool rides the scan carry; block_fn(h, lp, pk, pv, bt_mb, ln_mb, act_eff)
+    -> (h, pk, pv), where act_eff is False on bubble ticks so those writes
+    land in the scratch block."""
+    from ray_tpu.llm.model_runner import _pp_schedule, _pp_shard_map
+
+    m = mesh.shape["pp"]
+    nb_slot = state.block_tables.shape[1]
+
+    def inner(layers_local, k_local, v_local, x_local, bt, lengths, active_i):
+        s_l = x_local.shape[0]  # this dp replica's slot count
+        smb = s_l // m
+        x_mb = x_local.reshape(m, smb, width, x_local.shape[-1])
+
+        def step_mb(x_in, kv, jc, valid):
+            k, v = kv
+            bt_mb = jax.lax.dynamic_slice(bt, (jc * smb, 0), (smb, nb_slot))
+            ln_mb = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
+            act_mb = (jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0)
+            act_eff = act_mb & valid  # bubble ticks write only the scratch block
+
+            def lbody(c, xs):
+                lp, pk, pv = xs
+                h, pk, pv = block_fn(c, lp, pk, pv, bt_mb, ln_mb, act_eff)
+                return h, (pk, pv)
+
+            h, (nk, nv) = jax.lax.scan(lbody, x_in, (layers_local, k, v))
+            return h, (nk, nv)
+
+        outs, (k, v) = _pp_schedule(x_mb, (k_local, v_local), step_mb)
+        return outs.reshape(s_l, width, outs.shape[-1]), k, v
+
+    return _pp_shard_map(inner, params["layers"], mesh,
+                         (state.k, state.v, x, state.block_tables,
+                          state.lengths, active.astype(jnp.int32)))
+
+
 def decode_step_paged_pp(params, state: PagedState, tokens, active,
                          cfg: ModelConfig, mesh: Mesh):
     """Paged decode with the layer stack + pool split across "pp" stages.
@@ -590,59 +630,17 @@ def decode_step_paged_pp(params, state: PagedState, tokens, active,
     replica-local block ids and its own scratch (the partition's last block),
     so the manual-region body is unchanged — it just sees local arrays.
     """
-    from ray_tpu.parallel.sharding import manual_axes
-
     pp = mesh.shape["pp"]
     dp = mesh.shape.get("dp", 1)
     s = tokens.shape[0]
     if s % (pp * dp):
         raise ValueError(f"max_num_seqs {s} must be divisible by pp*dp {pp * dp}")
-    m = pp
-    nb_slot = state.block_tables.shape[1]
 
     x = params["embed"].astype(cfg.activation_dtype)[tokens[:, None]]  # [S,1,D]
-
-    def inner(layers_local, k_local, v_local, x_local, bt, lengths, active_i):
-        from ray_tpu.llm.model_runner import _pp_schedule
-
-        s_l = x_local.shape[0]  # this dp replica's slot count
-        smb = s_l // m
-        x_mb = x_local.reshape(m, smb, 1, x_local.shape[-1])
-
-        def step_mb(x_in, kv, jc, valid):
-            k, v = kv
-            bt_mb = jax.lax.dynamic_slice(bt, (jc * smb, 0), (smb, nb_slot))
-            ln_mb = jax.lax.dynamic_slice(lengths, (jc * smb,), (smb,))
-            act_mb = (jax.lax.dynamic_slice(active_i, (jc * smb,), (smb,)) > 0)
-            act_eff = act_mb & valid  # bubble ticks write only the scratch block
-
-            def lbody(c, xs):
-                lp, pk, pv = xs
-                h, pk, pv = _decode_block_paged(c, lp, cfg, pk, pv, bt_mb,
-                                                ln_mb, act_eff)
-                return h, (pk, pv)
-
-            h, (nk, nv) = jax.lax.scan(lbody, x_in, (layers_local, k, v))
-            return h, (nk, nv)
-
-        outs, (k, v) = _pp_schedule(x_mb, (k_local, v_local), step_mb)
-        return outs.reshape(s_l, 1, outs.shape[-1]), k, v
-
-    layer_specs = jax.tree_util.tree_map(lambda _: P("pp"), params["layers"])
-    dp_ax = "dp" if "dp" in mesh.shape else None
-    manual = {"pp", "dp"} if dp_ax else {"pp"}
-    mapped = jax.shard_map(
-        lambda ly, k, v, xm, bt, ln, ac: inner(ly, k, v, xm, bt, ln, ac),
-        mesh=mesh,
-        in_specs=(layer_specs, P("pp", dp_ax), P("pp", dp_ax), P(dp_ax),
-                  P(dp_ax), P(dp_ax), P(dp_ax)),
-        out_specs=(P(dp_ax), P("pp", dp_ax), P("pp", dp_ax)),
-        axis_names=manual,
-    )
-    with manual_axes(*manual):
-        h, nk, nv = mapped(params["layers"], state.k, state.v, x,
-                           state.block_tables, state.lengths,
-                           active.astype(jnp.int32))
+    h, nk, nv = _pp_paged_layers(
+        params, state, x, active, mesh, width=1,
+        block_fn=lambda c, lp, pk, pv, bt, ln, ac:
+            _decode_block_paged(c, lp, cfg, pk, pv, bt, ln, ac))
 
     h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
@@ -684,6 +682,35 @@ def _verify_block_paged(x, lp, cfg: ModelConfig, pk, pv, block_tables, lengths,
 
     x, (nk, nv) = _verify_core(x, lp, cfg, lengths, cache_rw, active=active)
     return x, nk, nv
+
+
+def spec_verify_step_paged_pp(params, state: PagedState, window, draft_len,
+                              active, rng, temperature, top_p, top_k, *,
+                              cfg: ModelConfig, mesh: Mesh):
+    """Paged speculative verify through the pipeline schedule: the verify
+    window is the microbatch payload, each stage holds its layers' pool slice,
+    and bubble-tick writes redirect to the scratch block via the same
+    active-mask plumbing _verify_block_paged already has. Composes with dp
+    (replica pool partitions) exactly like decode_step_paged_pp."""
+    from .model_runner import spec_driver
+
+    pp = mesh.shape["pp"]
+    dp = mesh.shape.get("dp", 1)
+    s, w = window.shape
+    if s % (pp * dp):
+        raise ValueError(f"max_num_seqs {s} must be divisible by pp*dp {pp * dp}")
+
+    def layers_pass(x):  # [S, W, D]
+        return _pp_paged_layers(
+            params, state, x, active, mesh, width=w,
+            block_fn=lambda c, lp, pk, pv, bt, ln, ac:
+                _verify_block_paged(c, lp, cfg, pk, pv, bt, ln, ac))
+
+    nk, nv, lengths, greedy, n_acc = spec_driver(
+        params, state.k, state.v, state.lengths, window, draft_len, active,
+        cfg, rng, temperature, top_p, top_k, layers_pass=layers_pass)
+    return PagedState(k=nk, v=nv, block_tables=state.block_tables,
+                      lengths=lengths), greedy, n_acc
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("state",))
@@ -1047,6 +1074,9 @@ class PagedOps:
             self._decode_pp = jax.jit(
                 functools.partial(decode_step_paged_pp, cfg=cfg, mesh=mesh),
                 donate_argnames=("state",))
+            self._spec_pp = jax.jit(
+                functools.partial(spec_verify_step_paged_pp, cfg=cfg, mesh=mesh),
+                donate_argnames=("state",))
 
     def install_prefill(self, state, k, v, block_ids, true_len, slot, n_blocks):
         if self.dp > 1:
@@ -1104,6 +1134,10 @@ class PagedOps:
 
     def spec_verify(self, params, state, window, draft_len, active, rng,
                     temperature, top_p, top_k):
+        if self.pp > 1:
+            # handles dp>1 too (same manual region as the pp decode)
+            return self._spec_pp(params, state, window, draft_len, active,
+                                 rng, temperature, top_p, top_k)
         if self.dp > 1:
             return spec_verify_step_paged_dp(params, state, window, draft_len,
                                              active, self.cfg, rng,

@@ -361,13 +361,8 @@ def test_speculative_config_validation():
                                  speculative_method="draft_model"))
     with pytest.raises(NotImplementedError, match="ngram"):
         eng.start()
-    # spec composes with paged, fused multi-step, and slot-layout pp; the one
-    # remaining spec fence is the paged layout under pp
-    eng2 = JaxLLMEngine(LLMConfig(model_id="sv3", model_source="test-tiny",
-                                  pipeline_parallel_size=2, kv_layout="paged",
-                                  num_speculative_tokens=4))
-    with pytest.raises(NotImplementedError, match="pp"):
-        eng2.start()
+    # spec composes with paged, fused multi-step, and pp on BOTH layouts now —
+    # no composition fence remains in the serving matrix
 
 
 def test_device_ngram_proposer_matches_host():
@@ -489,18 +484,23 @@ def test_spec_fused_oracle_accepts_inside_burst():
 @pytest.mark.parametrize("parallel", [
     dict(pipeline_parallel_size=2),
     dict(pipeline_parallel_size=2, data_parallel_size=2),
+    dict(pipeline_parallel_size=2, kv_layout="paged", kv_block_size=16),
+    dict(pipeline_parallel_size=2, data_parallel_size=2, kv_layout="paged",
+         kv_block_size=16),
 ])
 def test_spec_decode_through_pipeline_matches_greedy(parallel):
-    """Speculative verify rides the pp schedule (slot layout): the verify
-    window is the microbatch payload; greedy output is IDENTICAL to plain
-    decode with oracle drafts (all accepted) and adversarial drafts (all
-    rejected), with or without dp replicas."""
+    """Speculative verify rides the pp schedule on BOTH cache layouts: the
+    verify window is the microbatch payload; greedy output is IDENTICAL to
+    plain decode with oracle drafts (all accepted) and adversarial drafts
+    (all rejected), with or without dp replicas. Paged bubbles write the
+    scratch block; slot bubbles are discarded by the valid mask."""
     params = llama_init_cached(CFG)
     prompt = [1, 10, 11, 12, 13]
     want = reference_greedy(params, prompt, 12)
 
     eng = JaxLLMEngine(LLMConfig(
-        model_id=f"spec-pp-{len(parallel)}", model_source="test-tiny",
+        model_id=f"spec-pp-{hash(tuple(sorted(parallel))) & 0xffff}",
+        model_source="test-tiny",
         max_num_seqs=4, max_model_len=64, tokenizer="byte",
         num_speculative_tokens=4, **parallel), params=params)
     eng.start()
