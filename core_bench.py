@@ -7,6 +7,8 @@ plus 10MB object transfers in both directions over the data plane).
 Run:
     JAX_PLATFORMS=cpu python core_bench.py            # both columns
     JAX_PLATFORMS=cpu python core_bench.py --local    # local only
+    JAX_PLATFORMS=cpu python core_bench.py --collective
+        # host-plane collective board-vs-ring wall clock -> COLLECTIVE_BENCH.json
 """
 import json
 import os
@@ -137,6 +139,62 @@ def transfer_suite(ray_tpu, np, sched):
     return results
 
 
+def collective_suite(ray_tpu, np):
+    """Host-plane allreduce wall clock: the legacy coordinator-board transport
+    (every rank's full tensor through one actor, O(W^2) bytes through a single
+    process) vs the data-plane ring (coordinator carries metadata only,
+    tensor bytes move rank-to-rank chunked). Writes per-size seconds/op for
+    world sizes 2 and 4 at 1/16/64 MB float32 payloads."""
+    from ray_tpu.util import collective as col
+
+    @ray_tpu.remote(num_cpus=0)
+    class Member(col.CollectiveActorMixin):
+        def __init__(self, rank):
+            self.rank = rank
+
+        def bench_allreduce(self, group, n_elems, iters):
+            import numpy as _np
+            import time as _time
+
+            x = _np.full(n_elems, float(self.rank + 1), dtype=_np.float32)
+            col.allreduce(x.copy(), group)  # warmup (plane dial, pools)
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                col.allreduce(x.copy(), group)
+            return (_time.perf_counter() - t0) / iters
+
+    sizes = [("1mb", 1 << 20), ("16mb", 16 << 20), ("64mb", 64 << 20)]
+    results = {}
+    for world in (2, 4):
+        members = [Member.remote(i) for i in range(world)]
+        groups = {"board": 1 << 62, "ring": 0}
+        for name, threshold in groups.items():
+            col.create_collective_group(
+                members, world, list(range(world)), backend="shm",
+                group_name=f"bench_{name}_{world}",
+                ring_threshold_bytes=threshold)
+        col_res = {}
+        for label, nbytes in sizes:
+            n = nbytes // 4
+            iters = 3 if nbytes <= (16 << 20) else 2
+            row = {}
+            for name in groups:
+                per_rank = ray_tpu.get(
+                    [m.bench_allreduce.remote(f"bench_{name}_{world}", n, iters)
+                     for m in members], timeout=600)
+                row[f"{name}_s"] = max(per_rank)  # op completes when ALL ranks do
+            row["speedup"] = row["board_s"] / row["ring_s"]
+            col_res[label] = row
+            print(f"  w{world} {label}: board {row['board_s']:.3f}s  "
+                  f"ring {row['ring_s']:.3f}s  ({row['speedup']:.2f}x)")
+        results[f"world_{world}"] = col_res
+        for name in groups:
+            col.kill_coordinator(f"bench_{name}_{world}")
+        for m in members:
+            ray_tpu.kill(m)
+    return results
+
+
 def main():
     import numpy as np
 
@@ -144,6 +202,18 @@ def main():
 
     mode = sys.argv[1] if len(sys.argv) > 1 else "--all"
     out = {}
+
+    if mode == "--collective":
+        ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=16)
+        bench = collective_suite(ray_tpu, np)
+        ray_tpu.shutdown()
+        path = os.path.join(os.path.dirname(__file__) or ".",
+                            "COLLECTIVE_BENCH.json")
+        with open(path, "w") as f:
+            json.dump(bench, f, indent=2)
+        print("wrote COLLECTIVE_BENCH.json")
+        return
 
     ray_tpu.init(num_cpus=4, node_server_port=0,
                  worker_env={"JAX_PLATFORMS": "cpu"}, max_workers_per_node=8)

@@ -161,6 +161,16 @@ _FLAGS: List[Flag] = [
     Flag("transfer_stall_timeout_s", "RAY_TPU_TRANSFER_STALL_TIMEOUT_S", "float", 60.0,
          "Per-socket-op stall bound on data-plane transfers (a half-dead peer "
          "must not pin admission slots / puller threads forever)."),
+    Flag("collective_ring_threshold_bytes", "RAY_TPU_COLLECTIVE_RING_THRESHOLD_BYTES",
+         "int", 64 * 1024,
+         "SHM-collective payloads at or above this size move peer-to-peer over "
+         "the data plane (ring path, coordinator carries metadata only); "
+         "smaller payloads ride the coordinator board directly."),
+    Flag("collective_server_streams", "RAY_TPU_COLLECTIVE_SERVER_STREAMS", "int", 64,
+         "Concurrent serve streams on a rank's collective data-plane server. "
+         "Ring reads block until the local chunk is published, so this is "
+         "sized above transfer_max_pulls to keep blocked readers from "
+         "starving live ones."),
     Flag("agent_heartbeat_timeout_s", "RAY_TPU_AGENT_HEARTBEAT_TIMEOUT_S", "float", 10.0,
          "Head marks an agent dead after this long without a heartbeat "
          "(reference gcs_health_check_manager.h)."),

@@ -99,7 +99,8 @@ class DataServer:
 
     def __init__(self, authkey: bytes,
                  read_fn: Callable[[Tuple], Tuple[bytes, bool]],
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0,
+                 max_streams: Optional[int] = None):
         self._read_fn = read_fn
         self._authkey = authkey
         # no authkey on the Listener: accept() would then run the auth
@@ -112,8 +113,11 @@ class DataServer:
         self.port: int = self._listener.address[1]
         self._shutdown = False
         # source-side cap: a broadcast to N nodes serves at most this many
-        # concurrent outbound streams (push_manager.h chunked-push pacing)
-        self._slots = threading.Semaphore(CONFIG.transfer_max_pulls)
+        # concurrent outbound streams (push_manager.h chunked-push pacing).
+        # Collective-plane servers pass a larger max_streams: their read_fn
+        # blocks until the requested chunk is published, so a slot can be
+        # held by a waiting reader, not just an active copy.
+        self._slots = threading.Semaphore(max_streams or CONFIG.transfer_max_pulls)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="rt-data-server").start()
 
@@ -259,19 +263,22 @@ class DataClient:
         with self._lock:
             self._pool.setdefault(addr, []).append(conn)
 
-    def pull(self, addr: Tuple[str, int], loc: Tuple) -> Tuple[bytes, bool]:
+    def pull(self, addr: Tuple[str, int], loc: Tuple,
+             retry: bool = True) -> Tuple[bytes, bool]:
         """Fetch the object at loc from the peer's data server, chunked and
         admission-gated. A stale pooled connection (idle-TCP killed by NAT/
         conntrack) gets ONE retry on a fresh dial; real failures raise
         OSError/EOFError/TimeoutError (the caller decides whether to fall back
-        to head relay or reconstruct)."""
+        to head relay or reconstruct). Pass retry=False when the server-side
+        read is NOT idempotent (collective ring buffers count bytes read
+        toward retraction — a replayed range would double-count)."""
         addr = (addr[0], int(addr[1]))
         with self._lock:
             had_pooled = bool(self._pool.get(addr))
         try:
             return self._pull_once(addr, loc)
         except (OSError, EOFError, TimeoutError):
-            if not had_pooled:
+            if not retry or not had_pooled:
                 raise
             return self._pull_once(addr, loc)  # fresh dial (pool was drained)
 

@@ -132,6 +132,11 @@ class NodeRuntime:
             w = pool.pop()
             if w.alive():
                 return w
+            # same reap as steal_idle_slot: a dead idle worker not yet seen by
+            # the router still counts toward max_workers — free its slot now
+            # so the caller's spawn_worker doesn't hit the cap for nothing
+            # (no-op if the death was already processed)
+            self.cluster._on_worker_death(w)
         return None
 
     def push_idle(self, w: WorkerHandle) -> None:
@@ -153,6 +158,11 @@ class NodeRuntime:
                 w = pool.pop()
                 if w.alive():
                     return w
+                # A dead idle worker still holds a node.workers entry, so it
+                # counts toward max_workers and the post-eviction spawn retry
+                # would hit the cap again — reap it through the normal death
+                # path so the slot is actually freed.
+                self.cluster._on_worker_death(w)
         return None
 
     def spawn_worker(self, accel: str, extra_env: Optional[Dict[str, str]] = None,
@@ -539,7 +549,7 @@ class Cluster:
         from . import data_plane
 
         if self._data_server is None:
-            self._data_server = data_plane.DataServer(authkey, object_store.read_raw)
+            self._data_server = data_plane.DataServer(authkey, object_store.read_raw_any)
             self._data_client = data_plane.DataClient(authkey)
         return self.node_server_port
 
@@ -1229,7 +1239,13 @@ class Cluster:
                 if spec.actor_name:
                     ok = self.gcs.register_named_actor(spec.actor_name, spec.actor_namespace, spec.actor_id)
                     if not ok:
-                        self._fail_returns(spec, ValueError(f"actor name {spec.actor_name!r} already taken"))
+                        # Mark the loser DEAD, not pending-forever: method calls
+                        # on its handle must fail fast (ActorDiedError), or a
+                        # name-race loser probing its handle hangs to timeout.
+                        err = ValueError(f"actor name {spec.actor_name!r} already taken")
+                        st.state = "dead"
+                        st.death_cause = err
+                        self._fail_returns(spec, err)
                         return
             # fast path (reference: lease request straight to the local raylet):
             # with no same-shape task queued ahead, dispatch NOW — the common

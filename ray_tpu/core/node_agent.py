@@ -94,7 +94,7 @@ class NodeAgent:
         # connection (reference object_manager.h:119)
         from . import data_plane, object_store
 
-        self._data_server = data_plane.DataServer(authkey, object_store.read_raw)
+        self._data_server = data_plane.DataServer(authkey, object_store.read_raw_any)
         self._data_client = data_plane.DataClient(authkey)
         self._send_lock = threading.Lock()
         self._workers: Dict[str, Any] = {}   # wid_hex -> (proc, pipe)

@@ -185,6 +185,62 @@ def read_raw(loc: Location) -> Tuple[bytes, bool]:
     raise ValueError(f"unknown location kind {kind!r}")
 
 
+def read_raw_slice(loc: Location, offset: int, length: int) -> Tuple[bytes, bool]:
+    """Read `length` bytes at `offset` of an object's serialized frame without
+    materializing (or copying) the rest of the object.
+
+    This is what lets a chunked transfer step — a data-plane pull of one
+    ring-collective chunk, a ranged cross-host fetch — move a byte range of a
+    large object without deserializing or even touching the whole frame:
+    arena/shm reads slice the shared mapping, disk reads seek. Out-of-range
+    requests are clamped to the frame (a zero-length tail read returns b"")."""
+    if offset < 0 or length < 0:
+        raise ValueError(f"negative slice ({offset}, {length})")
+    kind = loc[0]
+    if kind == "inline":
+        return bytes(loc[1][offset:offset + length]), loc[2]
+    if kind == "arena":
+        _, name, oid_bytes, size, is_error = loc
+        arena = _open_arena(name)
+        view = arena.get(oid_bytes)
+        if view is None:
+            raise ObjectLost(f"arena object {oid_bytes.hex()} was freed or lost")
+        try:
+            end = min(offset + length, size)
+            return bytes(view[min(offset, size):end]), is_error
+        finally:
+            view.release()
+            arena.unpin(oid_bytes)
+    if kind == "shm":
+        _, name, size, is_error = loc
+        try:
+            seg = _segment_cache.open(name)
+        except FileNotFoundError:
+            raise ObjectLost(f"shm segment {name} was freed or lost") from None
+        end = min(offset + length, size)
+        return bytes(memoryview(seg.buf)[min(offset, size):end]), is_error
+    if kind == "disk":
+        _, path, size, is_error = loc
+        try:
+            with open(path, "rb") as f:
+                f.seek(min(offset, size))
+                return f.read(max(0, min(offset + length, size) - offset)), is_error
+        except OSError:
+            raise ObjectLost(f"spilled object file {path} was lost") from None
+    raise ValueError(f"unknown location kind {kind!r}")
+
+
+def read_raw_any(loc: Location) -> Tuple[bytes, bool]:
+    """Data-plane read dispatcher: a plain location reads the whole frame, a
+    ``("slice", inner_loc, offset, length)`` wrapper reads only that byte
+    range (pullers use it to fetch chunk k of a large object without the
+    serving node copying the other chunks out of shared memory)."""
+    if loc and loc[0] == "slice":
+        _, inner, offset, length = loc
+        return read_raw_slice(inner, int(offset), int(length))
+    return read_raw(loc)
+
+
 def write_raw(data: bytes, oid: ObjectID, is_error: bool = False) -> Location:
     """Place already-serialized frame bytes locally (receiving side of a
     cross-host transfer): arena first, per-object segment fallback."""

@@ -56,6 +56,27 @@ class LLMEngine(abc.ABC):
     def shutdown(self) -> None: ...
 
 
+_warned_pp_multistep = False
+
+
+def _warn_pp_multistep_once() -> None:
+    """num_decode_steps > 1 with pipeline parallelism is accepted but inert —
+    say so once instead of silently ignoring the knob."""
+    global _warned_pp_multistep
+    if _warned_pp_multistep:
+        return
+    _warned_pp_multistep = True
+    import warnings
+
+    warnings.warn(
+        "num_decode_steps > 1 has no effect when pipeline_parallel_size > 1: "
+        "pp keeps per-step scheduling (microbatch ticks), so the fused "
+        "multi-step decode path is skipped",
+        UserWarning,
+        stacklevel=2,
+    )
+
+
 class _Request:
     def __init__(self, req_id: str, prompt_ids: List[int], params: SamplingParams,
                  prefill_kv=None):
@@ -157,6 +178,8 @@ class JaxLLMEngine(LLMEngine):
                     raise ValueError("n_layers must divide by pipeline_parallel_size")
                 if not cfg.scan_layers:
                     raise ValueError("pipeline_parallel_size > 1 requires scan_layers")
+                if c.num_decode_steps > 1:
+                    _warn_pp_multistep_once()
             if c.max_num_seqs % c.data_parallel_size:
                 raise ValueError("max_num_seqs must be divisible by data_parallel_size")
             if c.kv_layout == "paged":

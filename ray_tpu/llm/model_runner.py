@@ -382,8 +382,7 @@ def spec_verify_step(
 
     Returns (state, out_tokens [S,W], n_accepted [S]): out_tokens[s,:n+1] are
     this step's emitted tokens (n accepted drafts + 1 bonus/correction);
-    lengths advance by n+1 for active slots. Dense models only (MoE routing
-    over the window is not wired)."""
+    lengths advance by n+1 for active slots."""
     nk, nv, lengths, greedy, n_acc = spec_driver(
         params, state.k, state.v, state.lengths, window, draft_len, active,
         cfg, rng, temperature, top_p, top_k,

@@ -62,6 +62,17 @@ def config_from_hf(source_dir: str, **overrides) -> ModelConfig:
     # token to one expert) — moe.py then drops nothing. Our own round-tripped
     # checkpoints carry the trained factor in config.json instead.
     if hf.get("num_local_experts", 0):
+        # Only Mixtral's layout/gating is wired: other HF MoE families that
+        # also carry num_local_experts (e.g. Phi-MoE) have different tensor
+        # layouts and routing conventions — accepting them here would fail
+        # much later at weight load with an opaque missing-tensor error.
+        model_type = hf.get("model_type", "")
+        if model_type != "mixtral":
+            raise ValueError(
+                f"unsupported MoE checkpoint: model_type {model_type!r} with "
+                f"num_local_experts={hf['num_local_experts']}; only "
+                "Mixtral-style sparse MoE (model_type 'mixtral') is supported"
+            )
         e = int(hf["num_local_experts"])
         k = int(hf.get("num_experts_per_tok", 2))
         fields["n_experts"] = e

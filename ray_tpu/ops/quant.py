@@ -61,6 +61,46 @@ def as_weight(p: Any, dtype) -> jax.Array:
     return p.astype(dtype)
 
 
+# -- host-side blockwise int8 (collective wire format) ---------------------------------
+# Same symmetric scheme as quantize() above (scale = max|x|/127, clip to
+# [-127, 127]) but numpy-native and blocked along the flat element order: the
+# host-plane collective ring compresses transfer chunks on CPU, where a jax
+# dispatch per chunk would dominate the quantization itself (EQuARX-style
+# compressed all-reduce, arxiv 2506.17615).
+
+def quantize_np(x: "np.ndarray", block_elems: int = 4096):
+    """Blockwise symmetric int8: returns (q int8 [n], scales f32 [ceil(n/block)])."""
+    import numpy as np
+
+    flat = np.ascontiguousarray(x).reshape(-1).astype(np.float32, copy=False)
+    n = flat.size
+    if n == 0:
+        return np.empty(0, np.int8), np.empty(0, np.float32)
+    nblocks = -(-n // block_elems)
+    pad = nblocks * block_elems - n
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    blocks = flat.reshape(nblocks, block_elems)
+    amax = np.abs(blocks).max(axis=1)
+    scales = np.maximum(amax / 127.0, 1e-12).astype(np.float32)
+    q = np.clip(np.round(blocks / scales[:, None]), -127, 127).astype(np.int8)
+    return q.reshape(-1)[:n], scales
+
+
+def dequant_np(q: "np.ndarray", scales: "np.ndarray", block_elems: int, dtype):
+    """Inverse of quantize_np; returns a 1-D array of `dtype` with q.size elements."""
+    import numpy as np
+
+    n = q.size
+    if n == 0:
+        return np.empty(0, dtype)
+    nblocks = scales.size
+    pad = nblocks * block_elems - n
+    full = np.concatenate([q, np.zeros(pad, np.int8)]) if pad else q
+    out = full.reshape(nblocks, block_elems).astype(np.float32) * scales[:, None]
+    return out.reshape(-1)[:n].astype(dtype)
+
+
 # Llama layer weights eligible for weight-only quantization. All are stored
 # with d_in first (embed lookup table and norms excluded: gathers and
 # elementwise ops do not stream per-token weight bytes the way matmuls do).

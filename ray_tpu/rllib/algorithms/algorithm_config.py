@@ -30,6 +30,9 @@ class AlgorithmConfig:
         # learners
         self.num_learners: int = 1
         self.num_tpus_per_learner: float = 0
+        # opt-in int8 wire compression for the learners' host-plane collective
+        # (grad allreduce rides the data-plane ring; see util/collective)
+        self.collective_compression: Optional[str] = None
         # module
         self.model_config: Dict[str, Any] = {}
         self.rl_module_class: Optional[type] = None
@@ -106,12 +109,15 @@ class AlgorithmConfig:
         return self
 
     def learners(
-        self, *, num_learners: Optional[int] = None, num_tpus_per_learner: Optional[float] = None, **_compat
+        self, *, num_learners: Optional[int] = None, num_tpus_per_learner: Optional[float] = None,
+        collective_compression: Optional[str] = None, **_compat
     ) -> "AlgorithmConfig":
         if num_learners is not None:
             self.num_learners = num_learners
         if num_tpus_per_learner is not None:
             self.num_tpus_per_learner = num_tpus_per_learner
+        if collective_compression is not None:
+            self.collective_compression = collective_compression
         return self
 
     def rl_module(self, *, model_config: Optional[Dict] = None, rl_module_class: Optional[type] = None) -> "AlgorithmConfig":

@@ -33,7 +33,13 @@ class LearnerGroup:
             from ray_tpu.util import collective as col
 
             group = f"learner_group_{id(self):x}"
-            col.create_collective_group(self.learners, n, list(range(n)), backend="shm", group_name=group)
+            # grad/weight sync payloads are model-sized: above the ring
+            # threshold they move learner-to-learner over the data plane (the
+            # coordinator actor carries metadata only); int8 wire compression
+            # is the EQuARX-style opt-in for bandwidth-bound clusters
+            col.create_collective_group(
+                self.learners, n, list(range(n)), backend="shm", group_name=group,
+                compression=getattr(config, "collective_compression", None))
             ray_tpu.get([l.setup_collective.remote(group) for l in self.learners])
             self._group = group
         else:

@@ -22,4 +22,4 @@ from .collective import (  # noqa: F401
     reducescatter,
     send,
 )
-from .types import Backend, ReduceOp  # noqa: F401
+from .types import Backend, Compression, ReduceOp  # noqa: F401

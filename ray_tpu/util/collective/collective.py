@@ -8,9 +8,10 @@ backends (see types.py).
 Design: the hot tensor path on TPU is NOT this API — it is XLA collectives compiled into
 pjit programs (psum over ICI). This API covers what the reference uses NCCL/Gloo process
 groups for *outside* compiled code: weight broadcast to env-runners, metric reduction,
-rendezvous. The SHM backend moves data through the cluster object store via a coordinator
-actor; the XLA backend additionally bootstraps `jax.distributed` across member processes so
-members can jointly build multi-host meshes.
+rendezvous. The SHM backend exchanges tensors over the rank-to-rank data plane with the
+coordinator actor carrying metadata only (ring.py; payloads under the ring threshold ride
+the coordinator board directly); the XLA backend additionally bootstraps `jax.distributed`
+across member processes so members can jointly build multi-host meshes.
 """
 from __future__ import annotations
 
@@ -20,8 +21,9 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .coordinator import GroupCoordinator, wait_poll, wait_poll_one
-from .types import Backend, ReduceOp
+from . import ring
+from .coordinator import GroupCoordinator, wait_poll
+from .types import Backend, Compression, ReduceOp
 
 _NAMESPACE = "ray_tpu.collective"
 
@@ -39,14 +41,23 @@ class _GroupState:
     rank: int
     backend: Backend
     coordinator: Any
+    # ring-path knobs (ring.py): wire compression for large payloads and an
+    # optional per-group override of the board/ring size threshold
+    compression: Optional[str] = None
+    ring_threshold: Optional[int] = None
+    data_plane: Any = None  # lazy ring._Plane (server started on first use)
     seq: Dict[str, int] = field(default_factory=dict)
     # True only when EVERY member of the group joined one jax.distributed universe
     # (agreed collectively at bootstrap) — the gate for device-path collectives.
     xla_device_plane: bool = False
 
     def next_key(self, op: str, extra: str = "") -> str:
-        n = self.seq.get(op, 0)
-        self.seq[op] = n + 1
+        # sequence per (op, extra), not per op: p2p send/recv counters must
+        # advance per src->dst PAIR, or a rank talking to two peers desyncs
+        # its key stream from each of them
+        k = f"{op}:{extra}" if extra else op
+        n = self.seq.get(k, 0)
+        self.seq[k] = n + 1
         return f"{op}:{extra}:{n}" if extra else f"{op}:{n}"
 
 
@@ -58,7 +69,11 @@ def _coordinator_name(group_name: str) -> str:
     return f"coordinator.{group_name}"
 
 
-def _get_or_create_coordinator(group_name: str, world_size: int):
+def _get_or_create_coordinator(group_name: str, world_size: int, rank: int):
+    """Rank 0 creates the group's detached coordinator; everyone else polls for
+    the name. Deterministic creator > create-race: the loser of a name race
+    pays an ActorDiedError round-trip on a doomed handle (and, worse, a worker
+    spawn), so with W ranks racing, init cost scales with the race width."""
     import ray_tpu
 
     name = _coordinator_name(group_name)
@@ -66,19 +81,30 @@ def _get_or_create_coordinator(group_name: str, world_size: int):
         return ray_tpu.get_actor(name, namespace=_NAMESPACE)
     except Exception:
         pass
-    coord_cls = ray_tpu.remote(GroupCoordinator)
-    try:
-        coord = coord_cls.options(
-            name=name, namespace=_NAMESPACE, lifetime="detached", num_cpus=0
-        ).remote(world_size)
-        # Name collisions surface on the first method call, not at .remote() — round-trip
-        # before trusting the handle, else a lost creation race leaves a dead coordinator
-        # and the group rendezvous hangs.
-        ray_tpu.get(coord.world.remote(), timeout=30)
-        return coord
-    except Exception:
-        # Lost the creation race: another rank registered the name first.
-        return ray_tpu.get_actor(name, namespace=_NAMESPACE)
+    if rank == 0:
+        coord_cls = ray_tpu.remote(GroupCoordinator)
+        try:
+            coord = coord_cls.options(
+                name=name, namespace=_NAMESPACE, lifetime="detached", num_cpus=0
+            ).remote(world_size)
+            # Name collisions surface on the first method call, not at .remote() —
+            # round-trip before trusting the handle (a stale detached coordinator
+            # may still own the name).
+            ray_tpu.get(coord.world.remote(), timeout=30)
+            return coord
+        except Exception:
+            return ray_tpu.get_actor(name, namespace=_NAMESPACE)
+    # non-zero ranks: wait for rank 0's coordinator to register
+    import time
+
+    deadline = time.monotonic() + 2 * _op_timeout()
+    while True:
+        try:
+            return ray_tpu.get_actor(name, namespace=_NAMESPACE)
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.02)
 
 
 def init_collective_group(
@@ -86,20 +112,33 @@ def init_collective_group(
     rank: int,
     backend: "Backend | str" = Backend.SHM,
     group_name: str = "default",
+    compression: "Compression | str | None" = None,
+    ring_threshold_bytes: Optional[int] = None,
 ) -> None:
     """Declare membership of the calling process in a collective group.
 
     Reference: collective.py:150. Must be called by every member (typically inside an
     actor method) before any collective op.
+
+    compression: opt-in int8 wire compression for ring-path payloads (lossy;
+    see types.Compression). ring_threshold_bytes: per-group override of
+    CONFIG.collective_ring_threshold_bytes (payloads at/above it move
+    peer-to-peer over the data plane; smaller ones ride the coordinator
+    board). Both must be passed uniformly by every member.
     """
     backend = Backend.parse(backend)
+    comp = Compression.parse(compression)
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
     with _lock:
         if group_name in _groups:
             raise RuntimeError(f"collective group {group_name!r} already initialized here")
-    coord = _get_or_create_coordinator(group_name, world_size)
-    state = _GroupState(group_name, world_size, rank, backend, coord)
+    coord = _get_or_create_coordinator(group_name, world_size, rank)
+    state = _GroupState(
+        group_name, world_size, rank, backend, coord,
+        compression=None if comp is Compression.NONE else comp.value,
+        ring_threshold=ring_threshold_bytes,
+    )
     if backend is Backend.XLA:
         _bootstrap_xla(state)
     with _lock:
@@ -114,6 +153,8 @@ def create_collective_group(
     ranks: List[int],
     backend: "Backend | str" = Backend.SHM,
     group_name: str = "default",
+    compression: "Compression | str | None" = None,
+    ring_threshold_bytes: Optional[int] = None,
 ) -> None:
     """Driver-side declarative form (reference collective.py:187): makes each actor in
     `actors` call `init_collective_group` with its rank."""
@@ -124,10 +165,20 @@ def create_collective_group(
     import ray_tpu
 
     b = str(Backend.parse(backend).value)
-    refs = [
-        actor._ray_tpu_collective_init.remote(world_size, rank, b, group_name)
-        for actor, rank in zip(actors, ranks)
-    ]
+    comp = Compression.parse(compression)
+    if comp is Compression.NONE and ring_threshold_bytes is None:
+        # positional 4-arg call: compatible with actors that define their own
+        # _ray_tpu_collective_init without the ring knobs
+        refs = [
+            actor._ray_tpu_collective_init.remote(world_size, rank, b, group_name)
+            for actor, rank in zip(actors, ranks)
+        ]
+    else:
+        refs = [
+            actor._ray_tpu_collective_init.remote(
+                world_size, rank, b, group_name, comp.value, ring_threshold_bytes)
+            for actor, rank in zip(actors, ranks)
+        ]
     ray_tpu.get(refs)
 
 
@@ -137,13 +188,23 @@ declare_collective_group = create_collective_group
 class CollectiveActorMixin:
     """Mix into an actor class to make it addressable by create_collective_group()."""
 
-    def _ray_tpu_collective_init(self, world_size: int, rank: int, backend: str, group_name: str) -> None:
-        init_collective_group(world_size, rank, backend, group_name)
+    def _ray_tpu_collective_init(self, world_size: int, rank: int, backend: str,
+                                 group_name: str, compression: Optional[str] = None,
+                                 ring_threshold_bytes: Optional[int] = None) -> None:
+        init_collective_group(world_size, rank, backend, group_name,
+                              compression=compression,
+                              ring_threshold_bytes=ring_threshold_bytes)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
     with _lock:
-        _groups.pop(group_name, None)
+        st = _groups.pop(group_name, None)
+    # release the group's ring data plane (listener thread + port + pooled
+    # sockets): planes are keyed by the group's coordinator-issued authkey, so
+    # no other group can share one; callers destroy after their last
+    # collective op, so no peer still pulls from us
+    if st is not None and st.data_plane is not None:
+        ring.release_plane(st.data_plane)
 
 
 def kill_coordinator(group_name: str = "default") -> None:
@@ -184,19 +245,10 @@ def _state(group_name: str) -> _GroupState:
 
 
 # -- ops -------------------------------------------------------------------------------
-def _reduce(arrays: List[np.ndarray], op: ReduceOp) -> np.ndarray:
-    out = np.asarray(arrays[0]).copy()
-    for a in arrays[1:]:
-        a = np.asarray(a)
-        if op is ReduceOp.SUM:
-            out += a
-        elif op is ReduceOp.PRODUCT:
-            out *= a
-        elif op is ReduceOp.MIN:
-            np.minimum(out, a, out=out)
-        elif op is ReduceOp.MAX:
-            np.maximum(out, a, out=out)
-    return out
+# Both the board fast path and the ring path reduce through ring.reduce_parts,
+# so results are bit-exact across paths (compression off). Kept under the old
+# name for callers that reached into the module.
+_reduce = ring.reduce_parts
 
 
 def _to_host(tensor) -> np.ndarray:
@@ -273,7 +325,21 @@ def _xla_device_allreduce(tensor, st: _GroupState, op: ReduceOp):
     local = jax.device_put(t[None], mesh.devices.flat[st.rank])
     garr = jax.make_array_from_single_device_arrays(
         (st.world_size,) + t.shape, stacked, [local])
-    return np.asarray(jax.device_get(prog(garr)))
+    try:
+        return np.asarray(jax.device_get(prog(garr)))
+    except Exception as e:
+        # Narrow fallback: only a backend-capability rejection ("Multiprocess
+        # computations aren't implemented on the CPU backend") is demoted to
+        # the shm plane — that launch check fails identically on every member,
+        # so all ranks demote together and stay on one plane. Any other
+        # runtime error (rank-local OOM, preemption) must surface: silently
+        # falling back on one rank would strand the peers inside the compiled
+        # reduction.
+        msg = str(e).lower()
+        if "multiprocess" in msg and "implemented" in msg:
+            st.xla_device_plane = False
+            return None
+        raise
 
 
 def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
@@ -282,53 +348,33 @@ def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
         out = _xla_device_allreduce(tensor, st, op)
         if out is not None:
             return _like(out, tensor)
-    key = st.next_key("allreduce")
-    st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout())
-    return _like(_reduce(parts, op), tensor)
+    return _like(ring.allreduce(st, _to_host(tensor), op), tensor)
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     st = _state(group_name)
-    key = st.next_key("reduce")
-    st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout())
-    if st.rank == dst_rank:
-        return _like(_reduce(parts, op), tensor)
+    out = ring.reduce(st, _to_host(tensor), dst_rank, op)
+    if st.rank == dst_rank and out is not None:
+        return _like(out, tensor)
     return tensor
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     st = _state(group_name)
-    key = st.next_key("broadcast")
-    if st.rank == src_rank:
-        st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout(), expected=1)
-    return _like(np.asarray(parts[0]), tensor)
+    return _like(np.asarray(ring.broadcast(st, _to_host(tensor), src_rank)), tensor)
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     """Returns the list of every rank's tensor (rank order). The reference fills a
     caller-provided tensor_list (torch idiom); returning is the functional idiom here."""
     st = _state(group_name)
-    key = st.next_key("allgather")
-    st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    return wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout())
+    return ring.allgather(st, _to_host(tensor))
 
 
 def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     """Reduce across ranks, then scatter equal chunks along axis 0; returns this rank's chunk."""
     st = _state(group_name)
-    key = st.next_key("reducescatter")
-    st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
-    parts = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout())
-    full = _reduce(parts, op)
-    if full.shape[0] % st.world_size != 0:
-        raise ValueError(
-            f"reducescatter: leading dim {full.shape[0]} not divisible by world_size {st.world_size}"
-        )
-    chunk = full.shape[0] // st.world_size
-    return full[st.rank * chunk : (st.rank + 1) * chunk]
+    return ring.reducescatter(st, _to_host(tensor), op)
 
 
 def barrier(group_name: str = "default") -> None:
@@ -344,18 +390,31 @@ def _barrier_impl(st: _GroupState, key: Optional[str] = None) -> None:
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     st = _state(group_name)
-    key = st.next_key("p2p", extra=f"{st.rank}->{dst_rank}")
-    st.coordinator.contribute.remote(key, st.rank, _to_host(tensor))
+    ring.send(st, _to_host(tensor), dst_rank)
 
 
 def recv(tensor, src_rank: int, group_name: str = "default"):
     st = _state(group_name)
-    key = st.next_key("p2p", extra=f"{src_rank}->{st.rank}")
-    payload = wait_poll_one(st.coordinator, key, st.rank, src_rank, timeout_s=_op_timeout())
-    return _like(np.asarray(payload), tensor)
+    return _like(np.asarray(ring.recv(st, src_rank)), tensor)
 
 
 # -- XLA backend bootstrap -------------------------------------------------------------
+def _jax_distributed_initialized() -> bool:
+    """jax.distributed.is_initialized() exists only in some jax versions
+    (absent in 0.4.37); fall back to the runtime state's client handle."""
+    import jax
+
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
 def _bootstrap_xla(st: _GroupState) -> None:
     """Bootstrap a jax.distributed universe across group members (multi-host TPU).
 
@@ -375,7 +434,7 @@ def _bootstrap_xla(st: _GroupState) -> None:
 
     # Probe WITHOUT touching the backend: jax.process_count() would itself initialize
     # XLA, after which jax.distributed.initialize() refuses to run.
-    if not jax.distributed.is_initialized():  # else already bootstrapped (JaxBackend)
+    if not _jax_distributed_initialized():  # else already bootstrapped (JaxBackend)
         if st.rank == 0:
             import socket
 
@@ -409,7 +468,7 @@ def _bootstrap_xla(st: _GroupState) -> None:
     # Agree on the device plane COLLECTIVELY: every member reports whether it joined a
     # universe whose size matches the group; all must agree or nobody uses the device
     # path (a split would deadlock the compiled reduction against the shm plane).
-    joined = jax.distributed.is_initialized() and jax.process_count() == st.world_size
+    joined = _jax_distributed_initialized() and jax.process_count() == st.world_size
     key = f"__xla_plane__:{st.name}"
     st.coordinator.contribute.remote(key, st.rank, bool(joined))
     flags = wait_poll(st.coordinator, key, st.rank, timeout_s=2 * _op_timeout())

@@ -1,17 +1,47 @@
-"""Group coordinator actor: rendezvous + host-plane collective data exchange.
+"""Group coordinator actor: rendezvous + host-plane collective metadata exchange.
 
 Reference analogue: the named NCCLUniqueIDStore actor (python/ray/util/collective/util.py:9)
 and the Rendezvous class (collective_group/nccl_collective_group.py:29). Here the coordinator
 does double duty: (1) rendezvous/bootstrap metadata (world size, jax.distributed coordinator
-address for the XLA backend), (2) a poll-based exchange board for SHM-backend collectives.
+address for the XLA backend, the data-plane authkey for the ring path), (2) a poll-based
+exchange board for SHM-backend collectives.
+
+The board is a CONTROL-plane surface: above the ring size threshold ranks post only tiny
+metadata records (data-plane address + buffer key) and move tensor bytes rank-to-rank over
+the data plane (ring.py); below it the tensor itself rides the board (small-tensor fast
+path). `contribute` sizes every payload so tests (and operators) can assert that no
+tensor-sized payload transits this single-threaded actor.
 
 Clients never block inside coordinator methods (the actor is single-threaded FIFO); they
 poll. Entries are garbage-collected once every participant has fetched them.
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+
+def _payload_nbytes(payload: Any) -> int:
+    """Approximate wire size of a board payload — exact for the cases that
+    matter (numpy tensors, raw bytes); containers recurse one level deep
+    because ring metadata is flat."""
+    try:
+        if payload is None or isinstance(payload, (bool, int, float)):
+            return 8
+        if isinstance(payload, (bytes, bytearray, memoryview, str)):
+            return len(payload)
+        nbytes = getattr(payload, "nbytes", None)
+        if isinstance(nbytes, int):
+            return nbytes
+        if isinstance(payload, dict):
+            return sum(_payload_nbytes(k) + _payload_nbytes(v)
+                       for k, v in payload.items())
+        if isinstance(payload, (list, tuple)):
+            return sum(_payload_nbytes(v) for v in payload)
+    except Exception:
+        pass
+    return 64  # opaque object: count something
 
 
 class GroupCoordinator:
@@ -24,6 +54,16 @@ class GroupCoordinator:
         # key -> set of ranks that have fetched the completed board
         self._fetched: Dict[str, set] = {}
         self._meta: Dict[str, Any] = {}
+        # shared secret for the group's rank-to-rank data plane: members fetch
+        # it once at group init and use it for their DataServer/DataClient
+        # pair, so ring pulls are authenticated without any cluster-wide key
+        # distribution (the coordinator IS the group's trust anchor).
+        self._data_authkey = os.urandom(16)
+        # instrumentation: the board must carry metadata, not tensors, above
+        # the ring threshold — these let tests assert exactly that.
+        self._max_contrib_bytes = 0
+        self._total_contrib_bytes = 0
+        self._num_contribs = 0
 
     # -- metadata (rendezvous) ---------------------------------------------------------
     def set_meta(self, key: str, value: Any) -> None:
@@ -32,9 +72,26 @@ class GroupCoordinator:
     def get_meta(self, key: str) -> Any:
         return self._meta.get(key)
 
+    def data_authkey(self) -> bytes:
+        return self._data_authkey
+
     # -- exchange board ----------------------------------------------------------------
     def contribute(self, key: str, rank: int, payload: Any) -> None:
+        n = _payload_nbytes(payload)
+        self._num_contribs += 1
+        self._total_contrib_bytes += n
+        if n > self._max_contrib_bytes:
+            self._max_contrib_bytes = n
         self._boards.setdefault(key, {})[rank] = payload
+
+    def board_stats(self) -> Dict[str, int]:
+        """Bytes that transited this actor's board (tensor bytes on the old
+        path, metadata-only above the ring threshold on the new one)."""
+        return {
+            "max_contrib_bytes": self._max_contrib_bytes,
+            "total_contrib_bytes": self._total_contrib_bytes,
+            "num_contribs": self._num_contribs,
+        }
 
     def poll(self, key: str, rank: int, expected: Optional[int] = None) -> Tuple[bool, Optional[List[Any]]]:
         """Return (ready, payload-list-in-rank-order). Marks `rank` as fetched when ready."""

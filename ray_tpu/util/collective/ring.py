@@ -1,0 +1,849 @@
+"""Chunked peer-to-peer collectives over the data plane (the "ring" path).
+
+The original SHM-backend collectives shipped every rank's full tensor through
+the single-threaded GroupCoordinator actor, and every rank then fetched all
+world_size payloads back — O(W²·bytes) through one Python process, whole
+tensors, with poll sleeps in between. Here the coordinator's board carries only
+tiny metadata (data-plane addresses + buffer keys, see coordinator.py); tensor
+bytes move rank-to-rank through a per-rank DataServer/DataClient pair
+(core/data_plane.py — the same chunked, admission-controlled transport the
+cross-host object plane uses), in transfer_chunk_bytes-sized slices, so the
+bytes through any single process drop to O(W·bytes/W) = O(bytes) and the
+transfer of part k+1 overlaps the reduce of part k.
+
+Algorithms (W = world_size, N = payload bytes):
+
+  allreduce      ring reduce-scatter + allgather. Rank r owns flat chunk r:
+                 it pulls the peers' slices of that chunk concurrently, with
+                 start order staggered ring-wise (rank r starts at peer r+1,
+                 r+2, ... — biasing load away from any single server) and
+                 reduces them IN RANK ORDER as they
+                 stream in; then every rank pulls each reduced chunk straight
+                 from its owner. Per-rank traffic: 2·N·(W-1)/W in and out.
+  reduce         dst pulls every peer's payload (staggered), rank-order reduce.
+  broadcast      binomial tree over the data plane: each non-source rank pulls
+                 from its tree parent chunk-by-chunk and republishes every
+                 chunk as it lands (store-and-forward per CHUNK, not per
+                 tensor), so deep subtrees stream concurrently.
+  allgather      every rank publishes its payload; peers pull directly from
+                 the owner in staggered ring order.
+  reducescatter  each rank pulls only its axis-0 slice from every peer and
+                 reduces in rank order.
+  send/recv      the receiver pulls straight from the sender.
+
+Rank-order reduction (not hop-order accumulation) is deliberate: it makes the
+peer-to-peer path bit-exact with the coordinator-board path — both funnel
+through reduce_parts() over rank-ordered parts — which a hop-accumulating ring
+cannot guarantee for floating-point SUM/PRODUCT. Per-rank byte and FLOP totals
+are identical to the textbook accumulating ring; only the association order of
+the reduction differs.
+
+Payloads below CONFIG.collective_ring_threshold_bytes keep the coordinator
+board as a fast path: one actor round-trip beats peer rendezvous for
+control-plane-sized tensors (a barrier flag, a scalar metric).
+
+Opt-in wire compression (init_collective_group(..., compression="int8")):
+floating-point payloads on the ring path are blockwise-symmetric-int8
+quantized before publishing (ops/quant.py quantize_np — the same scheme the
+serving stack uses for weights; EQuARX-style compressed all-reduce, arxiv
+2506.17615): ~4x fewer wire bytes for float32 at ~1% error per quantization
+stage (allreduce has two stages: inputs, then reduced chunks). Off by default;
+integer/bool payloads always travel raw.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .coordinator import wait_poll, wait_poll_one
+from .types import ReduceOp
+
+# board payload marker: ranks post (RING_META, {addr, dtype, shape, enc, ...})
+# instead of the tensor when the payload takes the ring path.
+RING_META = "__ring_meta__"
+
+_QMAGIC = b"RQ1\0"
+_QBLOCK = 4096  # elements per int8 scale block (~0.1% scale overhead at f32)
+
+
+def _op_timeout() -> float:
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.collective_op_timeout_s
+
+
+def _chunk_bytes() -> int:
+    from ray_tpu.config import CONFIG
+
+    return max(1, CONFIG.transfer_chunk_bytes)
+
+
+def _threshold(st) -> int:
+    t = getattr(st, "ring_threshold", None)
+    if t is not None:
+        return t
+    from ray_tpu.config import CONFIG
+
+    return CONFIG.collective_ring_threshold_bytes
+
+
+# -- reduction kernels (shared by the board and ring paths: bit-exact) -----------------
+def accumulate(out: np.ndarray, a: np.ndarray, op: ReduceOp) -> None:
+    if op is ReduceOp.SUM:
+        out += a
+    elif op is ReduceOp.PRODUCT:
+        out *= a
+    elif op is ReduceOp.MIN:
+        np.minimum(out, a, out=out)
+    elif op is ReduceOp.MAX:
+        np.maximum(out, a, out=out)
+
+
+def reduce_parts(arrays: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+    """Reduce rank-ordered parts; the ONE reduction both paths share."""
+    out = np.asarray(arrays[0]).copy()
+    for a in arrays[1:]:
+        accumulate(out, np.asarray(a), op)
+    return out
+
+
+# -- published-buffer store ------------------------------------------------------------
+class _Buf:
+    __slots__ = ("data", "total", "avail", "exp", "done", "born")
+
+    def __init__(self, data, total, avail, exp):
+        self.data = data
+        self.total = total
+        self.avail = avail
+        self.exp = exp  # expected bytes read by peers; 0 = TTL-GC only
+        self.done = 0
+        self.born = time.monotonic()
+
+
+class _BufStore:
+    """Keyed raw buffers a rank serves to its peers.
+
+    Readers block until the requested byte range is published — that blocking
+    read IS the ring's step synchronization (no second coordinator round-trip
+    for reduced chunks or tree relays). Buffers auto-retract once peers have
+    read the expected number of bytes; a TTL sweep reaps anything a dead peer
+    never finished reading.
+    """
+
+    def __init__(self):
+        self._bufs: Dict[str, _Buf] = {}
+        self._cond = threading.Condition()
+
+    def publish(self, key: str, data, expected_read_bytes: int) -> None:
+        """Publish a complete buffer (bytes/bytearray/memoryview)."""
+        with self._cond:
+            self._gc_locked()
+            self._bufs[key] = _Buf(data, len(data), len(data), expected_read_bytes)
+            self._cond.notify_all()
+
+    def publish_stream(self, key: str, buf: bytearray, expected_read_bytes: int) -> None:
+        """Publish an incrementally-filled buffer: the writer owns `buf`,
+        fills it front-to-back, and calls advance() as ranges land (chunked
+        tree relay). Readers of a not-yet-available range block."""
+        with self._cond:
+            self._gc_locked()
+            self._bufs[key] = _Buf(buf, len(buf), 0, expected_read_bytes)
+            self._cond.notify_all()
+
+    def advance(self, key: str, avail: int) -> None:
+        with self._cond:
+            b = self._bufs.get(key)
+            if b is not None and avail > b.avail:
+                b.avail = avail
+                self._cond.notify_all()
+
+    def read(self, key: str, offset: int, length: int, timeout: float) -> bytes:
+        """Read [offset, offset+length); length < 0 = the whole buffer.
+        Blocks until the range is available (publication IS the sync)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            # sweep here too: publish() alone can't reap a failed op's buffers
+            # in a process that stops publishing (tensor-sized pins otherwise
+            # survive until the next collective, maybe forever)
+            self._gc_locked()
+            while True:
+                b = self._bufs.get(key)
+                if b is not None:
+                    if length < 0:
+                        if b.avail >= b.total:
+                            return self._take_locked(key, b, 0, b.total)
+                    else:
+                        if offset + length > b.total:
+                            raise ValueError(
+                                f"read past end of {key!r}: [{offset}, {offset + length}) "
+                                f"of {b.total}")
+                        if b.avail >= offset + length:
+                            return self._take_locked(key, b, offset, length)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"collective buffer {key!r} not published within {timeout}s")
+                self._cond.wait(min(left, 1.0))
+
+    def _take_locked(self, key: str, b: _Buf, offset: int, length: int) -> bytes:
+        out = bytes(memoryview(b.data)[offset:offset + length])
+        b.done += length
+        if b.exp and b.done >= b.exp:
+            self._bufs.pop(key, None)
+        return out
+
+    def _gc_locked(self) -> None:
+        ttl = 4 * _op_timeout()
+        now = time.monotonic()
+        for key in [k for k, b in self._bufs.items() if now - b.born > ttl]:
+            self._bufs.pop(key, None)
+
+
+# -- per-group data plane --------------------------------------------------------------
+def _local_ip() -> str:
+    """The address peers dial for ring pulls — same resolution (including the
+    RAY_TPU_NODE_IP operator override) as the device transfer plane, so both
+    data planes advertise the same fabric interface."""
+    from ray_tpu.core.device_plane import _node_ip
+
+    return _node_ip()
+
+
+class _Plane:
+    """One rank's slice of the collective data plane: a DataServer serving its
+    published buffers + a DataClient pulling from peers. Auth rides the
+    group's coordinator-issued key, so only group members can pull."""
+
+    def __init__(self, authkey: bytes, min_streams: int = 0):
+        from ray_tpu.config import CONFIG
+        from ray_tpu.core.data_plane import DataClient, DataServer
+
+        self.authkey = authkey
+        self.store = _BufStore()
+        # sized to the group: at world W a server can hold W-1 blocked gather
+        # readers AND W-1 reduce-scatter pulls at once — a fixed cap below
+        # 2(W-1) would let blocked readers starve the pulls that unblock them
+        self.server = DataServer(
+            authkey, self._read,
+            max_streams=max(CONFIG.collective_server_streams, min_streams))
+        self.client = DataClient(authkey)
+        self.addr: Tuple[str, int] = (_local_ip(), self.server.port)
+
+    def _read(self, loc: Tuple) -> Tuple[bytes, bool]:
+        if not (isinstance(loc, tuple) and len(loc) == 4 and loc[0] == "cbuf"):
+            raise ValueError(f"bad collective pull location {loc!r}")
+        _, key, offset, length = loc
+        return self.store.read(key, int(offset), int(length), _op_timeout()), False
+
+    def pull(self, addr, key: str, offset: int, length: int) -> bytes:
+        if length == 0:
+            return b""
+        # retry=False: _BufStore reads count toward exp-based retraction, so a
+        # replayed range would double-count and retract the buffer early
+        data, _ = self.client.pull((addr[0], int(addr[1])),
+                                   ("cbuf", key, int(offset), int(length)),
+                                   retry=False)
+        if length > 0 and len(data) != length:
+            raise OSError(f"short collective pull of {key!r} from {addr}: "
+                          f"{len(data)} != {length}")
+        return data
+
+    def pull_all(self, addr, key: str) -> bytes:
+        data, _ = self.client.pull((addr[0], int(addr[1])), ("cbuf", key, 0, -1),
+                                   retry=False)
+        return data
+
+    def pull_range(self, addr, key: str, offset: int, length: int, out=None):
+        """Pull [offset, offset+length) in transfer_chunk_bytes slices so the
+        caller can overlap downstream compute with the remaining transfer and
+        no single frame materializes more than one chunk. Fills `out`
+        (buffer-protocol writable) or returns a bytearray."""
+        buf = out if out is not None else bytearray(length)
+        # numpy destinations need a frombuffer wrap: ndarray slice assignment
+        # treats a raw bytes RHS as a scalar, not a byte sequence
+        wrap = (lambda d: np.frombuffer(d, np.uint8)) \
+            if isinstance(buf, np.ndarray) else (lambda d: d)
+        step = _chunk_bytes()
+        pos = 0
+        while pos < length:
+            ln = min(step, length - pos)
+            buf[pos:pos + ln] = wrap(self.pull(addr, key, offset + pos, ln))
+            pos += ln
+        return buf
+
+
+_planes: Dict[bytes, _Plane] = {}
+_planes_lock = threading.Lock()
+
+
+def get_plane(authkey: bytes, min_streams: int = 0) -> _Plane:
+    with _planes_lock:
+        plane = _planes.get(authkey)
+        if plane is None:
+            plane = _Plane(authkey, min_streams)
+            _planes[authkey] = plane
+        return plane
+
+
+def release_plane(plane: _Plane) -> None:
+    """Tear down a group's data plane (listener thread, pooled connections).
+    Called by destroy_collective_group once no local group shares the plane —
+    long-lived processes that cycle through many group names must not
+    accumulate one bound port + server thread per retired group."""
+    with _planes_lock:
+        _planes.pop(plane.authkey, None)
+    try:
+        plane.server.close()
+    except Exception:
+        pass
+    try:
+        plane.client.close()
+    except Exception:
+        pass
+
+
+def _ensure_plane(st) -> _Plane:
+    plane = getattr(st, "data_plane", None)
+    if plane is None:
+        import ray_tpu
+
+        key = ray_tpu.get(st.coordinator.data_authkey.remote(),
+                          timeout=_op_timeout())
+        plane = get_plane(bytes(key), min_streams=2 * (st.world_size - 1) + 4)
+        st.data_plane = plane
+    return plane
+
+
+# -- wire compression ------------------------------------------------------------------
+def _enc_for(st, arr: np.ndarray) -> str:
+    comp = getattr(st, "compression", None)
+    comp = getattr(comp, "value", comp)  # Compression enum -> str
+    if comp == "int8" and arr.dtype.kind == "f" and arr.size:
+        return "int8"
+    return "raw"
+
+
+def _compress(flat: np.ndarray) -> bytes:
+    from ray_tpu.ops.quant import quantize_np
+
+    q, scales = quantize_np(flat, block_elems=_QBLOCK)
+    return b"".join([
+        _QMAGIC, struct.pack("<IQ", _QBLOCK, flat.size),
+        scales.tobytes(), q.tobytes(),
+    ])
+
+
+def _decompress(blob: bytes, dtype) -> np.ndarray:
+    if blob[:4] != _QMAGIC:
+        raise OSError("corrupt compressed collective payload")
+    block, n = struct.unpack_from("<IQ", blob, 4)
+    nblocks = -(-n // block) if n else 0
+    off = 4 + 12
+    scales = np.frombuffer(blob, np.float32, nblocks, off)
+    q = np.frombuffer(blob, np.int8, n, off + 4 * nblocks)
+    from ray_tpu.ops.quant import dequant_np
+
+    return dequant_np(q, scales, block, dtype)
+
+
+# -- board exchange helpers ------------------------------------------------------------
+def _exchange(st, key: str, payload, expected: Optional[int] = None) -> List[Any]:
+    st.coordinator.contribute.remote(key, st.rank, payload)
+    return wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout(),
+                     expected=expected)
+
+
+def _is_meta(entry) -> bool:
+    return isinstance(entry, tuple) and len(entry) == 2 and entry[0] == RING_META
+
+
+def _board_tensors(entries: List[Any], key: str) -> List[Any]:
+    if any(_is_meta(e) for e in entries):
+        raise RuntimeError(
+            f"collective {key!r}: some ranks took the ring path and some the "
+            "board path — member payload sizes must agree for this op")
+    return entries
+
+
+def _ring_metas(entries: List[Any], key: str,
+                same_shape: Optional[np.ndarray] = None) -> List[Dict]:
+    metas = []
+    for rank, e in enumerate(entries):
+        if not _is_meta(e):
+            raise RuntimeError(
+                f"collective {key!r}: rank {rank} took the board path while "
+                "others took the ring path — member payload sizes must agree")
+        metas.append(e[1])
+    if same_shape is not None:
+        want = (same_shape.dtype.str, tuple(same_shape.shape))
+        for rank, m in enumerate(metas):
+            if (m["dtype"], tuple(m["shape"])) != want:
+                raise RuntimeError(
+                    f"collective {key!r}: rank {rank} payload "
+                    f"{m['dtype']}{tuple(m['shape'])} != local {want}")
+    return metas
+
+
+# -- shared op plumbing ----------------------------------------------------------------
+def _flat(arr: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(arr).reshape(-1)
+
+
+def _ring_capable(arr: np.ndarray) -> bool:
+    """Raw-wire encodable: the dtype must round-trip through dtype.str.
+    Exotic dtypes (ml_dtypes bfloat16/float8 stringify as raw void '<V2',
+    object/structured dtypes) lose their semantics on a frombuffer rebuild —
+    they keep the pickling board path at any size. The check is a pure
+    function of dtype, so symmetric ops still agree on the path."""
+    return arr.dtype.kind in "biufc" and np.dtype(arr.dtype.str) == arr.dtype
+
+
+def _chunk_bounds(n: int, w: int) -> List[Tuple[int, int]]:
+    base, rem = divmod(n, w)
+    out, start = [], 0
+    for i in range(w):
+        ln = base + (1 if i < rem else 0)
+        out.append((start, start + ln))
+        start += ln
+    return out
+
+
+def _run_threads(fns, deadline: float, what: str) -> None:
+    errs: List[BaseException] = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — propagated below
+            errs.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(fn,), daemon=True) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()) + 0.1)
+    if any(t.is_alive() for t in threads):
+        raise TimeoutError(f"{what} timed out after {_op_timeout()}s")
+    if errs:
+        raise errs[0]
+
+
+def _staggered(rank: int, w: int) -> List[int]:
+    """Peer start order (r+1, r+2, ..., r-1): pulls run concurrently, but the
+    ring-staggered launch order biases the first wave so no single server is
+    the initial target of every rank (server slots are sized for the
+    worst-case 2(W-1) concurrent streams regardless; see _Plane)."""
+    return [(rank + s) % w for s in range(1, w)]
+
+
+def _ordered_stream_reduce(st, op, parts_src, my_part: np.ndarray,
+                           deadline: float, what: str) -> np.ndarray:
+    """Pull peer parts concurrently (staggered ring schedule) and reduce them
+    in RANK order as they land: the reduce of part k overlaps the transfer of
+    part k+1, and the association order matches the board path exactly.
+
+    parts_src: callable(peer_rank) -> np.ndarray (runs on a puller thread).
+    """
+    w, r = st.world_size, st.rank
+    slots: List[Optional[np.ndarray]] = [None] * w
+    slots[r] = my_part
+    cond = threading.Condition()
+    errs: List[BaseException] = []
+
+    def fetch(i):
+        try:
+            part = parts_src(i)
+            with cond:
+                slots[i] = part
+                cond.notify_all()
+        except BaseException as e:  # noqa: BLE001 — surfaced on the op thread
+            with cond:
+                errs.append(e)
+                cond.notify_all()
+
+    threads = [threading.Thread(target=fetch, args=(i,), daemon=True)
+               for i in _staggered(r, w)]
+    for t in threads:
+        t.start()
+    acc: Optional[np.ndarray] = None
+    for i in range(w):
+        with cond:
+            while slots[i] is None and not errs:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"{what}: rank {r} timed out waiting for rank {i}'s part")
+                cond.wait(min(left, 1.0))
+            if errs:
+                raise errs[0]
+            part = slots[i]
+            slots[i] = None  # release as we go: peak extra memory < one input
+        if i == 0:
+            acc = np.asarray(part).copy()
+        else:
+            accumulate(acc, np.asarray(part), op)
+    return acc
+
+
+def _meta(st, plane: _Plane, flat: np.ndarray, shape, enc: str, **extra) -> Tuple:
+    m = {"addr": plane.addr, "dtype": flat.dtype.str, "shape": tuple(shape),
+         "enc": enc}
+    m.update(extra)
+    return (RING_META, m)
+
+
+def _pull_payload(plane: _Plane, meta: Dict, key: str,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Fetch a peer's whole published payload described by its board meta."""
+    dtype = np.dtype(meta["dtype"])
+    shape = tuple(meta["shape"])
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if meta["enc"] == "int8":
+        flat = _decompress(plane.pull_all(meta["addr"], key), dtype)
+    else:
+        nbytes = n * dtype.itemsize
+        if nbytes == 0:
+            flat = np.empty(0, dtype)
+        else:
+            # frombuffer over the pulled bytearray: writable (parity with the
+            # board path's unpickled arrays) and no extra whole-payload copy
+            flat = np.frombuffer(
+                plane.pull_range(meta["addr"], key, 0, nbytes), dtype)
+    arr = flat.reshape(shape)
+    if out is not None:
+        out[...] = arr
+        return out
+    return arr
+
+
+# -- collective ops --------------------------------------------------------------------
+def allreduce(st, tensor, op: ReduceOp) -> np.ndarray:
+    arr = np.asarray(tensor)
+    if st.world_size == 1:
+        # purely local: a board round-trip would pickle the tensor through the
+        # coordinator twice just to produce a copy
+        return reduce_parts([arr], op)
+    flat = _flat(arr)
+    key = st.next_key("allreduce")
+    if flat.nbytes < _threshold(st) or not _ring_capable(flat):
+        return reduce_parts(_board_tensors(_exchange(st, key, arr), key), op)
+    plane = _ensure_plane(st)
+    w, r = st.world_size, st.rank
+    item = flat.dtype.itemsize
+    bounds = _chunk_bounds(flat.size, w)
+    b0, b1 = bounds[r]
+    nchunk = b1 - b0
+    enc = _enc_for(st, flat)
+    if enc == "int8":
+        # per-chunk blobs: chunk i is read whole by rank i only
+        for i, (c0, c1) in enumerate(bounds):
+            if i == r or c1 == c0:
+                continue
+            blob = _compress(flat[c0:c1])
+            plane.store.publish(f"{key}:in{i}", blob, len(blob))
+    else:
+        exp = (flat.size - nchunk) * item  # peers read all chunks but mine
+        if exp:
+            # zero-copy publish of the caller's buffer is safe for allreduce
+            # only: this rank's gather completing proves every peer published
+            # its reduced chunk, hence finished its reduce-scatter, hence will
+            # never read this input again — so by the time allreduce returns
+            # (and the caller may mutate the tensor) all :in reads are done.
+            plane.store.publish(f"{key}:in", memoryview(flat).cast("B"), exp)
+    metas = _ring_metas(_exchange(st, key, _meta(st, plane, flat, arr.shape, enc)),
+                        key, same_shape=flat.reshape(arr.shape))
+    deadline = time.monotonic() + _op_timeout()
+    dtype = flat.dtype
+
+    # -- ring reduce-scatter: stream peers' slices of MY chunk, rank-order reduce
+    def part_src(i):
+        if nchunk == 0:
+            return np.empty(0, dtype)
+        m = metas[i]
+        if enc == "int8":
+            return _decompress(plane.pull_all(m["addr"], f"{key}:in{r}"), dtype)
+        raw = plane.pull_range(m["addr"], f"{key}:in", b0 * item, nchunk * item)
+        return np.frombuffer(raw, dtype)
+
+    reduced = _ordered_stream_reduce(st, op, part_src, flat[b0:b1], deadline,
+                                     f"allreduce {key}")
+
+    # -- allgather of reduced chunks straight from their owners
+    if nchunk:
+        if enc == "int8":
+            blob = _compress(reduced)
+            plane.store.publish(f"{key}:red", blob, (w - 1) * len(blob))
+            # self-consistency: peers receive the quantize->dequantize round
+            # trip of this chunk, so the owner must use the SAME values or
+            # allreduce's all-ranks-identical postcondition breaks (replicas
+            # synced through a compressed group would silently drift)
+            reduced = _decompress(blob, dtype)
+        else:
+            # `reduced` is op-local (never handed to the caller): publish a
+            # zero-copy view; the store entry keeps it alive until retraction
+            plane.store.publish(f"{key}:red", memoryview(reduced).cast("B"),
+                                (w - 1) * nchunk * item)
+    out = np.empty(flat.size, dtype)
+    out[b0:b1] = reduced
+    out_bytes = out.view(np.uint8)
+
+    def gather(j):
+        j0, j1 = bounds[j]
+        if j1 == j0:
+            return
+        m = metas[j]
+        if enc == "int8":
+            out[j0:j1] = _decompress(plane.pull_all(m["addr"], f"{key}:red"), dtype)
+        else:
+            plane.pull_range(m["addr"], f"{key}:red", 0, (j1 - j0) * item,
+                             out=out_bytes[j0 * item:j1 * item])
+
+    _run_threads([lambda j=j: gather(j) for j in _staggered(r, w)], deadline,
+                 f"allreduce gather {key}")
+    return out.reshape(arr.shape)
+
+
+def reduce(st, tensor, dst_rank: int, op: ReduceOp) -> Optional[np.ndarray]:
+    """Returns the reduced tensor on dst_rank, None elsewhere."""
+    arr = np.asarray(tensor)
+    if st.world_size == 1:
+        return reduce_parts([arr], op)
+    key = st.next_key("reduce")
+    flat = _flat(arr)
+    if flat.nbytes < _threshold(st) or not _ring_capable(flat):
+        parts = _board_tensors(_exchange(st, key, arr), key)
+        return reduce_parts(parts, op) if st.rank == dst_rank else None
+    plane = _ensure_plane(st)
+    enc = _enc_for(st, flat)
+    if st.rank != dst_rank:
+        if enc == "int8":
+            blob = _compress(flat)
+            plane.store.publish(f"{key}:in", blob, len(blob))
+        elif flat.nbytes:
+            plane.store.publish(f"{key}:in", flat.tobytes(), flat.nbytes)
+    metas = _ring_metas(_exchange(st, key, _meta(st, plane, flat, arr.shape, enc)),
+                        key, same_shape=flat.reshape(arr.shape))
+    if st.rank != dst_rank:
+        return None
+    deadline = time.monotonic() + _op_timeout()
+    dtype = flat.dtype
+
+    def part_src(i):
+        m = metas[i]
+        if enc == "int8":
+            return _decompress(plane.pull_all(m["addr"], f"{key}:in"), dtype)
+        if flat.nbytes == 0:
+            return np.empty(0, dtype)
+        raw = plane.pull_range(m["addr"], f"{key}:in", 0, flat.nbytes)
+        return np.frombuffer(raw, dtype)
+
+    acc = _ordered_stream_reduce(st, op, part_src, flat, deadline, f"reduce {key}")
+    return acc.reshape(arr.shape)
+
+
+def _tree_addrs(st, plane: _Plane, key: str) -> List[Tuple[str, int]]:
+    """The tree needs every rank's data-plane address, not just the source's.
+    Addresses are immutable for the planes' lifetime, so the O(W) board
+    exchange runs once per group and is cached; every rank takes the same
+    branch (all cache after their first ring broadcast together)."""
+    addrs = getattr(st, "ring_addrs", None)
+    if addrs is None:
+        addrs = _exchange(st, f"{key}:addr", plane.addr)
+        st.ring_addrs = addrs
+    return addrs
+
+
+def _tree_children(v: int, w: int) -> List[int]:
+    """Binomial tree on src-relative labels: parent(v) clears v's highest set
+    bit; children(v) = v + 2^k for 2^k above v's highest bit, while < w."""
+    out = []
+    bit = 1 << v.bit_length()
+    while v + bit < w:
+        out.append(v + bit)
+        bit <<= 1
+    return out
+
+
+def broadcast(st, tensor, src_rank: int) -> np.ndarray:
+    arr = np.asarray(tensor)
+    key = st.next_key("broadcast")
+    w = st.world_size
+    if w == 1:
+        return arr
+    if st.rank == src_rank:
+        flat = _flat(arr)
+        if flat.nbytes < _threshold(st) or not _ring_capable(flat):
+            _exchange(st, key, arr, expected=1)
+            return arr
+        plane = _ensure_plane(st)
+        enc = _enc_for(st, flat)
+        blob = _compress(flat) if enc == "int8" else flat.tobytes()
+        nchild = len(_tree_children(0, w))
+        plane.store.publish(f"{key}:bc", blob, nchild * len(blob))
+        _exchange(st, key,
+                  _meta(st, plane, flat, arr.shape, enc, blob_len=len(blob)),
+                  expected=1)
+        _tree_addrs(st, plane, key)
+        return arr
+    # non-source: the source alone decides board vs ring (it knows the size)
+    entry = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout(),
+                      expected=1)[0]
+    if not _is_meta(entry):
+        return np.asarray(entry)
+    meta = entry[1]
+    plane = _ensure_plane(st)
+    addrs = _tree_addrs(st, plane, key)
+    v = (st.rank - src_rank) % w
+    parent_v = v - (1 << (v.bit_length() - 1))
+    parent_addr = addrs[(parent_v + src_rank) % w]
+    nchild = len(_tree_children(v, w))
+    total = int(meta["blob_len"])
+    buf = bytearray(total)
+    if nchild:
+        plane.store.publish_stream(f"{key}:bc", buf, nchild * total)
+    # chunked store-and-forward: republish each chunk as it lands so children
+    # stream behind us instead of waiting for the whole payload
+    step = _chunk_bytes()
+    deadline = time.monotonic() + _op_timeout()
+    pos = 0
+    while pos < total:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"broadcast {key}: relay from rank {(parent_v + src_rank) % w} "
+                f"exceeded {_op_timeout()}s at byte {pos}/{total}")
+        ln = min(step, total - pos)
+        buf[pos:pos + ln] = plane.pull(parent_addr, f"{key}:bc", pos, ln)
+        pos += ln
+        if nchild:
+            plane.store.advance(f"{key}:bc", pos)
+    dtype = np.dtype(meta["dtype"])
+    if meta["enc"] == "int8":
+        flat = _decompress(buf, dtype)  # fresh array; buf stays children-only
+    elif nchild:
+        # children may still stream chunks out of buf: never hand the caller
+        # a view of it (a non-numpy caller would get it back un-copied)
+        flat = np.frombuffer(buf, dtype).copy()
+    else:
+        flat = np.frombuffer(buf, dtype)
+    return flat.reshape(tuple(meta["shape"]))
+
+
+def allgather(st, tensor) -> List[np.ndarray]:
+    arr = np.asarray(tensor)
+    w, r = st.world_size, st.rank
+    if w == 1:
+        return [np.asarray(arr).copy()]  # board path returned a copy too
+    key = st.next_key("allgather")
+    flat = _flat(arr)
+    # per-rank decision: members may gather different-sized payloads, so small
+    # ones ride the board while large ones go peer-to-peer, in the same op
+    own = None  # compressed publish: the self-consistent (lossy) local value
+    if flat.nbytes < _threshold(st) or not _ring_capable(flat):
+        payload = arr
+    else:
+        plane = _ensure_plane(st)
+        enc = _enc_for(st, flat)
+        blob = _compress(flat) if enc == "int8" else flat.tobytes()
+        plane.store.publish(f"{key}:in", blob, (w - 1) * len(blob))
+        payload = _meta(st, plane, flat, arr.shape, enc)
+        if enc == "int8":
+            # peers decompress this blob; gather the same round-tripped
+            # values locally so every rank's list is identical
+            own = _decompress(blob, flat.dtype).reshape(arr.shape)
+    entries = _exchange(st, key, payload)
+    results: List[Optional[np.ndarray]] = [None] * w
+    deadline = time.monotonic() + _op_timeout()
+
+    def fetch(i):
+        if i == r:
+            # snapshot, not a reference: every other entry (and the board
+            # path) is decoupled from the caller's buffer
+            results[i] = own if own is not None else np.array(arr, copy=True)
+        elif _is_meta(entries[i]):
+            results[i] = _pull_payload(_ensure_plane(st), entries[i][1],
+                                       f"{key}:in")
+        else:
+            results[i] = np.asarray(entries[i])
+
+    fetch(r)
+    _run_threads([lambda i=i: fetch(i) for i in _staggered(r, w)], deadline,
+                 f"allgather {key}")
+    return results
+
+
+def reducescatter(st, tensor, op: ReduceOp) -> np.ndarray:
+    arr = np.asarray(tensor)
+    w, r = st.world_size, st.rank
+    flat = _flat(arr)
+    if w == 1:
+        return reduce_parts([arr], op)
+    key = st.next_key("reducescatter")
+    if flat.nbytes < _threshold(st) or not _ring_capable(flat):
+        full = reduce_parts(_board_tensors(_exchange(st, key, arr), key), op)
+        if full.shape[0] % w != 0:
+            raise ValueError(
+                f"reducescatter: leading dim {full.shape[0]} not divisible by world_size {w}"
+            )
+        chunk = full.shape[0] // w
+        return full[r * chunk: (r + 1) * chunk]
+    if arr.shape[0] % w != 0:
+        raise ValueError(
+            f"reducescatter: leading dim {arr.shape[0]} not divisible by world_size {w}"
+        )
+    plane = _ensure_plane(st)
+    enc = _enc_for(st, flat)
+    per = flat.size // w  # axis-0 slices of a C-contiguous array are flat ranges
+    item = flat.dtype.itemsize
+    if enc == "int8":
+        for i in range(w):
+            if i == r or per == 0:
+                continue
+            blob = _compress(flat[i * per:(i + 1) * per])
+            plane.store.publish(f"{key}:in{i}", blob, len(blob))
+    elif flat.nbytes:
+        plane.store.publish(f"{key}:in", flat.tobytes(), (w - 1) * per * item)
+    metas = _ring_metas(_exchange(st, key, _meta(st, plane, flat, arr.shape, enc)),
+                        key, same_shape=flat.reshape(arr.shape))
+    deadline = time.monotonic() + _op_timeout()
+    dtype = flat.dtype
+
+    def part_src(i):
+        if per == 0:
+            return np.empty(0, dtype)
+        m = metas[i]
+        if enc == "int8":
+            return _decompress(plane.pull_all(m["addr"], f"{key}:in{r}"), dtype)
+        raw = plane.pull_range(m["addr"], f"{key}:in", r * per * item, per * item)
+        return np.frombuffer(raw, dtype)
+
+    acc = _ordered_stream_reduce(st, op, part_src, flat[r * per:(r + 1) * per],
+                                 deadline, f"reducescatter {key}")
+    return acc.reshape((arr.shape[0] // w,) + arr.shape[1:])
+
+
+def send(st, tensor, dst_rank: int) -> None:
+    arr = np.asarray(tensor)
+    key = st.next_key("p2p", extra=f"{st.rank}->{dst_rank}")
+    flat = _flat(arr)
+    if flat.nbytes < _threshold(st) or not _ring_capable(flat):
+        st.coordinator.contribute.remote(key, st.rank, arr)
+        return
+    plane = _ensure_plane(st)
+    enc = _enc_for(st, flat)
+    blob = _compress(flat) if enc == "int8" else flat.tobytes()
+    plane.store.publish(f"{key}:in", blob, len(blob))
+    st.coordinator.contribute.remote(key, st.rank,
+                                     _meta(st, plane, flat, arr.shape, enc))
+
+
+def recv(st, src_rank: int) -> np.ndarray:
+    key = st.next_key("p2p", extra=f"{src_rank}->{st.rank}")
+    payload = wait_poll_one(st.coordinator, key, st.rank, src_rank,
+                            timeout_s=_op_timeout())
+    if _is_meta(payload):
+        return _pull_payload(_ensure_plane(st), payload[1], f"{key}:in")
+    return np.asarray(payload)

@@ -42,6 +42,27 @@ class ReduceOp(Enum):
     MAX = 3
 
 
+class Compression(str, Enum):
+    """Opt-in wire compression for host-plane (ring-path) collective payloads.
+
+    INT8: blockwise symmetric int8 quantization of floating-point payloads at
+    or above the ring threshold (ops/quant.py scheme; EQuARX-style compressed
+    all-reduce). Lossy (~1% per quantization stage) — off by default; results
+    are bit-exact with the coordinator-board path only when compression is off.
+    Integer/bool payloads always travel raw.
+    """
+
+    NONE = "none"
+    INT8 = "int8"
+
+    @classmethod
+    def parse(cls, value: "Compression | str | None") -> "Compression":
+        if value is None or value == "":
+            return Compression.NONE
+        c = cls(value.lower()) if isinstance(value, str) else value
+        return c
+
+
 @dataclass
 class AllReduceOptions:
     reduceOp: ReduceOp = ReduceOp.SUM

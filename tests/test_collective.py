@@ -1,24 +1,40 @@
 """Collective API tests (reference analogue: python/ray/util/collective tests).
 
-Host-plane (SHM backend) collectives across actor processes. The XLA backend's
-cross-process path — jax.distributed bootstrap + device-path psum over a mesh spanning
-two OS processes — is exercised in test_spmd_multiprocess.py (the trainer loop runs
+Host-plane (SHM backend) collectives across actor processes, on both transports:
+the coordinator-board fast path (small tensors) and the ring path (large tensors
+move rank-to-rank over the data plane; the coordinator carries metadata only —
+asserted here via the board instrumentation). The XLA backend's cross-process
+path — jax.distributed bootstrap + device-path psum over a mesh spanning two OS
+processes — is exercised in test_spmd_multiprocess.py (the trainer loop runs
 init_collective_group(backend="xla") inside a real 2-process universe).
+
+Every test kills its detached coordinators and member actors on exit: each one
+pins a worker-pool slot, and the session cluster caps workers per node.
 """
+import contextlib
+
 import numpy as np
 import pytest
 
+# Force-everything-over-the-board threshold: payloads below the threshold ride
+# the coordinator, so a huge threshold pins a group to the legacy path.
+BOARD_ONLY = 1 << 62
+_NS = "ray_tpu.collective"
 
-def _make_workers(rt, n, group="g_test"):
+
+def _make_workers(rt, n):
     @rt.remote(num_cpus=0)
     class Member:
         def __init__(self, rank):
             self.rank = rank
 
-        def _ray_tpu_collective_init(self, world_size, rank, backend, group_name):
+        def _ray_tpu_collective_init(self, world_size, rank, backend, group_name,
+                                     compression=None, ring_threshold_bytes=None):
             from ray_tpu.util import collective as col
 
-            col.init_collective_group(world_size, rank, backend, group_name)
+            col.init_collective_group(world_size, rank, backend, group_name,
+                                      compression=compression,
+                                      ring_threshold_bytes=ring_threshold_bytes)
 
         def do_allreduce(self, group_name):
             from ray_tpu.util import collective as col
@@ -59,41 +75,152 @@ def _make_workers(rt, n, group="g_test"):
             col.barrier(group_name)
             return col.get_rank(group_name), col.get_collective_group_size(group_name)
 
+        # -- parametrized ops for ring-vs-board parity -----------------------
+        def _data(self, n, integer=False):
+            rng = np.random.default_rng(1000 + 31 * self.rank)
+            if integer:
+                return rng.integers(-50, 50, size=n).astype(np.int64)
+            # [0.5, 1.5): PRODUCT across ranks stays in float32 range
+            return (rng.random(n, dtype=np.float32) + 0.5)
+
+        def op_allreduce(self, group_name, n, op_name, integer=False):
+            from ray_tpu.util import collective as col
+
+            op = getattr(col.ReduceOp, op_name)
+            return col.allreduce(self._data(n, integer=integer), group_name, op=op)
+
+        def op_reduce(self, group_name, n, op_name, dst):
+            from ray_tpu.util import collective as col
+
+            op = getattr(col.ReduceOp, op_name)
+            out = col.reduce(self._data(n), dst_rank=dst, group_name=group_name, op=op)
+            return out if self.rank == dst else None
+
+        def op_broadcast(self, group_name, n, src):
+            from ray_tpu.util import collective as col
+
+            return col.broadcast(self._data(n), src_rank=src, group_name=group_name)
+
+        def op_allgather(self, group_name, n):
+            from ray_tpu.util import collective as col
+
+            return col.allgather(self._data(n), group_name)
+
+        def op_allgather_mixed(self, group_name, base_n):
+            """Per-rank payload sizes: rank r gathers base_n * 4**r elements, so
+            some ranks ride the board and some the ring in the SAME op."""
+            from ray_tpu.util import collective as col
+
+            return col.allgather(self._data(base_n * 4 ** self.rank), group_name)
+
+        def op_reducescatter(self, group_name, n, op_name):
+            from ray_tpu.util import collective as col
+
+            op = getattr(col.ReduceOp, op_name)
+            return col.reducescatter(self._data(n), group_name, op=op)
+
+        def op_sendrecv(self, group_name, n):
+            from ray_tpu.util import collective as col
+
+            if self.rank == 0:
+                col.send(self._data(n), dst_rank=1, group_name=group_name)
+                return None
+            if self.rank == 1:
+                return col.recv(np.zeros(n, np.float32), src_rank=0,
+                                group_name=group_name)
+            return None
+
+        def op_p2p_fanout(self, group_name, n):
+            """Rank 0 sends DIFFERENT payloads to ranks 1 and 2, twice each,
+            interleaved — p2p keys must advance per (src,dst) pair or the
+            streams cross."""
+            from ray_tpu.util import collective as col
+
+            if self.rank == 0:
+                for i in range(2):
+                    col.send(np.full(n, 10.0 + i), dst_rank=1, group_name=group_name)
+                    col.send(np.full(n, 20.0 + i), dst_rank=2, group_name=group_name)
+                return None
+            buf = np.zeros(n)
+            return [float(col.recv(buf.copy(), src_rank=0, group_name=group_name)[0])
+                    for _ in range(2)]
+
+        def op_allreduce_cheap(self, group_name, n):
+            """Big-payload allreduce with O(1)-verifiable exact data: every
+            per-element sum is a small integer, exact in float32 regardless of
+            association order."""
+            from ray_tpu.util import collective as col
+
+            x = (np.arange(n, dtype=np.int32) % 1000 + self.rank).astype(np.float32)
+            return col.allreduce(x, group_name)
+
     return [Member.remote(i) for i in range(n)]
 
 
-def test_allreduce_and_barrier(rt):
+@pytest.fixture(scope="module")
+def members(rt):
+    """One pool of 4 member actors shared by every test in this module —
+    worker-process spawns are the dominant cost of these tests, not the
+    collectives themselves. Each member's rank equals its pool index, so any
+    prefix members[:w] forms a valid world of size w."""
+    workers = _make_workers(rt, 4)
+    yield workers
+    for w in workers:
+        try:
+            rt.kill(w)
+        except Exception:
+            pass
+
+
+@contextlib.contextmanager
+def _collective(rt, members, n, *group_specs):
+    """Create one group per (name, kwargs) spec over members[:n]; always kill
+    the detached coordinators on exit (each pins a worker-pool slot)."""
     from ray_tpu.util import collective as col
 
-    workers = _make_workers(rt, 2)
-    col.create_collective_group(workers, 2, [0, 1], backend="shm", group_name="g1")
-    out = rt.get([w.do_allreduce.remote("g1") for w in workers])
-    np.testing.assert_allclose(out[0], np.full((4,), 3.0))
-    np.testing.assert_allclose(out[1], np.full((4,), 3.0))
-    ranks = rt.get([w.do_barrier.remote("g1") for w in workers])
-    assert sorted(ranks) == [(0, 2), (1, 2)]
+    workers = members[:n]
+    names = []
+    try:
+        for name, kwargs in group_specs:
+            col.create_collective_group(workers, n, list(range(n)),
+                                        backend="shm", group_name=name, **kwargs)
+            names.append(name)
+        yield workers
+    finally:
+        for name in names:
+            col.kill_coordinator(name)
 
 
-def test_broadcast_allgather_reducescatter_p2p(rt):
-    from ray_tpu.util import collective as col
+def _board_stats(rt, group):
+    coord = rt.get_actor(f"coordinator.{group}", namespace=_NS)
+    return rt.get(coord.board_stats.remote())
 
-    workers = _make_workers(rt, 2)
-    col.create_collective_group(workers, 2, [0, 1], backend="shm", group_name="g2")
 
-    out = rt.get([w.do_broadcast.remote("g2") for w in workers])
-    np.testing.assert_allclose(out[0], np.full((3,), 1.0))  # src_rank=1's value
-    np.testing.assert_allclose(out[1], np.full((3,), 1.0))
+def test_allreduce_and_barrier(rt, members):
+    with _collective(rt, members, 2, ("g1", {})) as workers:
+        out = rt.get([w.do_allreduce.remote("g1") for w in workers])
+        np.testing.assert_allclose(out[0], np.full((4,), 3.0))
+        np.testing.assert_allclose(out[1], np.full((4,), 3.0))
+        ranks = rt.get([w.do_barrier.remote("g1") for w in workers])
+        assert sorted(ranks) == [(0, 2), (1, 2)]
 
-    gathered = rt.get([w.do_allgather.remote("g2") for w in workers])
-    assert [int(g[0]) for g in gathered[0]] == [0, 1]
 
-    rs = rt.get([w.do_reducescatter.remote("g2") for w in workers])
-    # reduced = arange(4)+0 + arange(4)+1 = [1,3,5,7]; rank0 chunk [1,3], rank1 [5,7]
-    np.testing.assert_allclose(rs[0], [1.0, 3.0])
-    np.testing.assert_allclose(rs[1], [5.0, 7.0])
+def test_broadcast_allgather_reducescatter_p2p(rt, members):
+    with _collective(rt, members, 2, ("g2", {})) as workers:
+        out = rt.get([w.do_broadcast.remote("g2") for w in workers])
+        np.testing.assert_allclose(out[0], np.full((3,), 1.0))  # src_rank=1's value
+        np.testing.assert_allclose(out[1], np.full((3,), 1.0))
 
-    sr = rt.get([w.do_sendrecv.remote("g2") for w in workers])
-    np.testing.assert_allclose(sr[1], [42.0])
+        gathered = rt.get([w.do_allgather.remote("g2") for w in workers])
+        assert [int(g[0]) for g in gathered[0]] == [0, 1]
+
+        rs = rt.get([w.do_reducescatter.remote("g2") for w in workers])
+        # reduced = arange(4)+0 + arange(4)+1 = [1,3,5,7]; rank0 [1,3], rank1 [5,7]
+        np.testing.assert_allclose(rs[0], [1.0, 3.0])
+        np.testing.assert_allclose(rs[1], [5.0, 7.0])
+
+        sr = rt.get([w.do_sendrecv.remote("g2") for w in workers])
+        np.testing.assert_allclose(sr[1], [42.0])
 
 
 def test_unsupported_backends():
@@ -103,3 +230,174 @@ def test_unsupported_backends():
         Backend.parse("nccl")
     with pytest.raises(NotImplementedError):
         Backend.parse("mpi")
+
+
+def test_bad_compression_rejected():
+    from ray_tpu.util.collective.types import Compression
+
+    with pytest.raises(ValueError):
+        Compression.parse("fp4")
+    assert Compression.parse(None) is Compression.NONE
+    assert Compression.parse("int8") is Compression.INT8
+
+
+# -- ring path -------------------------------------------------------------------------
+@pytest.mark.parametrize("world", [2, 3])  # odd world exercises uneven chunks
+def test_ring_board_parity_all_ops(rt, members, world):
+    """The same actors in two groups — one pinned to the board path, one with
+    threshold 0 so every payload takes the ring. Identical per-rank inputs in
+    both groups ⇒ bit-exact results prove transport parity (compression off)."""
+    tag = f"par{world}"
+    board, ring = f"board_{tag}", f"ring_{tag}"
+    n = 40_000  # 160 KB float32: above the default ring threshold too
+    with _collective(rt, members, world,
+                     (board, {"ring_threshold_bytes": BOARD_ONLY}),
+                     (ring, {"ring_threshold_bytes": 0})) as workers:
+        for op_name in ("SUM", "PRODUCT", "MIN", "MAX"):
+            b = rt.get([w.op_allreduce.remote(board, n, op_name) for w in workers])
+            r = rt.get([w.op_allreduce.remote(ring, n, op_name) for w in workers])
+            for bb, rr in zip(b, r):
+                np.testing.assert_array_equal(bb, rr, err_msg=f"allreduce {op_name}")
+            b = rt.get([w.op_reducescatter.remote(board, world * 5_000, op_name)
+                        for w in workers])
+            r = rt.get([w.op_reducescatter.remote(ring, world * 5_000, op_name)
+                        for w in workers])
+            for bb, rr in zip(b, r):
+                np.testing.assert_array_equal(bb, rr, err_msg=f"reducescatter {op_name}")
+
+        b = rt.get([w.op_reduce.remote(board, n, "SUM", world - 1) for w in workers])
+        r = rt.get([w.op_reduce.remote(ring, n, "SUM", world - 1) for w in workers])
+        np.testing.assert_array_equal(b[world - 1], r[world - 1])
+
+        b = rt.get([w.op_broadcast.remote(board, n, world - 1) for w in workers])
+        r = rt.get([w.op_broadcast.remote(ring, n, world - 1) for w in workers])
+        for bb, rr in zip(b, r):
+            np.testing.assert_array_equal(bb, rr)
+
+        b = rt.get([w.op_allgather.remote(board, n) for w in workers])
+        r = rt.get([w.op_allgather.remote(ring, n) for w in workers])
+        for bb, rr in zip(b, r):
+            for bpart, rpart in zip(bb, rr):
+                np.testing.assert_array_equal(bpart, rpart)
+
+        b = rt.get([w.op_sendrecv.remote(board, n) for w in workers])
+        r = rt.get([w.op_sendrecv.remote(ring, n) for w in workers])
+        np.testing.assert_array_equal(b[1], r[1])
+
+        # integer payloads: the ring moves them raw (never quantized)
+        b = rt.get([w.op_allreduce.remote(board, n, "SUM", True) for w in workers])
+        r = rt.get([w.op_allreduce.remote(ring, n, "SUM", True) for w in workers])
+        np.testing.assert_array_equal(b[0], r[0])
+
+        # tiny tensor, fewer elements than ranks: some ranks own empty chunks
+        b = rt.get([w.op_allreduce.remote(board, 2, "SUM") for w in workers])
+        r = rt.get([w.op_allreduce.remote(ring, 2, "SUM") for w in workers])
+        for bb, rr in zip(b, r):
+            np.testing.assert_array_equal(bb, rr)
+
+
+def test_ring_multi_group_same_actors(rt, members):
+    """Two ring groups over the same actors stay isolated (distinct
+    coordinators, authkeys, and buffer stores)."""
+    with _collective(rt, members, 2,
+                     ("mg_a", {"ring_threshold_bytes": 0}),
+                     ("mg_b", {"ring_threshold_bytes": 0})) as workers:
+        ra = [w.op_allreduce.remote("mg_a", 30_000, "SUM") for w in workers]
+        rb = [w.op_allreduce.remote("mg_b", 30_000, "MAX") for w in workers]
+        a, b = rt.get(ra), rt.get(rb)
+        np.testing.assert_array_equal(a[0], a[1])
+        np.testing.assert_array_equal(b[0], b[1])
+        assert not np.array_equal(a[0], b[0])  # SUM vs MAX of the same inputs
+
+
+def test_ring_allgather_mixed_paths(rt, members):
+    """Different payload sizes per rank: small ranks ride the board, large
+    ranks the ring, inside one allgather."""
+    with _collective(rt, members, 3, ("mix", {"ring_threshold_bytes": 64 * 1024})) as workers:
+        # rank payload bytes: 16 KB (board), 64 KB (ring), 256 KB (ring)
+        outs = rt.get([w.op_allgather_mixed.remote("mix", 4_096) for w in workers])
+        for out in outs:
+            assert [len(p) for p in out] == [4_096, 16_384, 65_536]
+            for r, p in enumerate(out):
+                np.testing.assert_array_equal(p, outs[0][r])
+
+
+def test_p2p_fanout_per_pair_counters(rt, members):
+    """One sender, two receivers, interleaved sends on the ring path: the p2p
+    sequence counters are per (src,dst) pair, so each receiver sees its own
+    stream in order."""
+    with _collective(rt, members, 3,
+                     ("p2p3", {"ring_threshold_bytes": 0})) as workers:
+        res = rt.get([w.op_p2p_fanout.remote("p2p3", 30_000) for w in workers])
+        assert res[1] == [10.0, 11.0], res[1]
+        assert res[2] == [20.0, 21.0], res[2]
+
+
+def test_compression_roundtrip_tolerance(rt, members):
+    """int8 wire compression is opt-in and lossy within the blockwise-symmetric
+    bound: |err| <= block_amax/127 per quantization stage (allreduce has two)."""
+    world, n = 3, 50_000
+    with _collective(rt, members, world,
+                     ("q_ref", {"ring_threshold_bytes": BOARD_ONLY}),
+                     ("q_int8", {"ring_threshold_bytes": 0,
+                                 "compression": "int8"})) as workers:
+        exact = rt.get([w.op_allreduce.remote("q_ref", n, "SUM") for w in workers])
+        lossy = rt.get([w.op_allreduce.remote("q_int8", n, "SUM") for w in workers])
+        # inputs in [0.5, 1.5): stage-1 amax ~1.5 per input (x W inputs summed),
+        # stage-2 amax ~W*1.5 → bound ~(W*1.5 + W*1.5)/127; doubled for slack
+        tol = 2 * 2 * world * 1.5 / 127
+        for e, l in zip(exact, lossy):
+            assert np.abs(e - l).max() <= tol
+            assert not np.array_equal(e, l)  # it IS lossy (guards a silent raw path)
+        # lossy but IDENTICAL on every rank: chunk owners must use the same
+        # quantize->dequantize round trip they serve, or replicas synced
+        # through a compressed group drift apart
+        for l in lossy[1:]:
+            np.testing.assert_array_equal(lossy[0], l)
+
+        exact = rt.get([w.op_broadcast.remote("q_ref", n, 0) for w in workers])
+        lossy = rt.get([w.op_broadcast.remote("q_int8", n, 0) for w in workers])
+        for e, l in zip(exact, lossy):
+            assert np.abs(e - l).max() <= 2 * 1.5 / 127  # single stage
+
+        # integer payloads bypass quantization entirely: still bit-exact
+        exact = rt.get([w.op_allreduce.remote("q_ref", n, "SUM", True) for w in workers])
+        lossy = rt.get([w.op_allreduce.remote("q_int8", n, "SUM", True) for w in workers])
+        np.testing.assert_array_equal(exact[0], lossy[0])
+
+
+def test_board_carries_metadata_only_above_threshold(rt, members):
+    """Above the ring threshold NO tensor-sized payload may transit the
+    coordinator actor — the board holds only addresses/keys/dtypes."""
+    threshold = 32 * 1024
+    with _collective(rt, members, 3,
+                     ("meta_only", {"ring_threshold_bytes": threshold})) as workers:
+        n = 500_000  # 2 MB float32 — 60x the threshold
+        rt.get([w.op_allreduce.remote("meta_only", n, "SUM") for w in workers])
+        rt.get([w.op_broadcast.remote("meta_only", n, 0) for w in workers])
+        rt.get([w.op_allgather.remote("meta_only", n) for w in workers])
+        rt.get([w.op_reducescatter.remote("meta_only", 3 * (n // 4), "SUM")
+                for w in workers])
+        rt.get([w.op_sendrecv.remote("meta_only", n) for w in workers])
+        stats = _board_stats(rt, "meta_only")
+        assert stats["num_contribs"] > 0
+        assert stats["max_contrib_bytes"] < threshold, stats
+        # metadata records are O(100) bytes, nowhere near tensor-sized
+        assert stats["max_contrib_bytes"] < 4_096, stats
+
+
+def test_allreduce_64mb_world4_routes_peer_to_peer(rt, members):
+    """Acceptance: a 64 MB float32 allreduce at world_size 4 moves tensor bytes
+    rank-to-rank over the data plane; the coordinator carries metadata only."""
+    with _collective(rt, members, 4, ("big4", {})) as workers:
+        n = 16 * 1024 * 1024  # 64 MiB of float32
+        outs = rt.get([w.op_allreduce_cheap.remote("big4", n) for w in workers],
+                      timeout=240)
+        stats = _board_stats(rt, "big4")
+    # every per-element sum is a small integer (exact in float32), so the
+    # reference is O(n) position-dependent arithmetic — chunk misrouting or
+    # offset bugs would show up immediately
+    want = ((np.arange(n, dtype=np.int32) % 1000) * 4 + 6).astype(np.float32)
+    for out in outs:
+        np.testing.assert_array_equal(out, want)
+    assert stats["max_contrib_bytes"] < 4_096, stats
