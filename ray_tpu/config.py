@@ -100,6 +100,11 @@ _FLAGS: List[Flag] = [
     Flag("collective_op_timeout_s", "RAY_TPU_COLLECTIVE_OP_TIMEOUT_S", "float",
          30.0, "Host-plane collective op timeout (allreduce/broadcast/...); "
          "barriers wait 2x this."),
+    Flag("collective_abort_poll_interval_s",
+         "RAY_TPU_COLLECTIVE_ABORT_POLL_INTERVAL_S", "float", 0.25,
+         "How often ring-path collective waits (stream reduce, gathers, tree "
+         "relays) probe the group coordinator's abort poison flag: a dead "
+         "rank costs survivors one interval, not collective_op_timeout_s."),
     # -- transport security
     Flag("use_tls", "RAY_TPU_USE_TLS", "bool", False,
          "mTLS on the gRPC agent channel and the data/device-plane listeners; "
@@ -282,6 +287,13 @@ _FLAGS: List[Flag] = [
     Flag("train_v2_enabled", "RAY_TPU_TRAIN_V2_ENABLED", "bool", False,
          "Route trainers through the v2 controller (FailurePolicy/"
          "ScalingPolicy; reference RAY_TRAIN_V2_ENABLED)."),
+    Flag("train_restart_backoff_s", "RAY_TPU_TRAIN_RESTART_BACKOFF_S",
+         "float", 1.0,
+         "Base of the bounded exponential backoff between Train worker-group "
+         "restarts (failure N sleeps base*2^(N-1), capped). 0 disables."),
+    Flag("train_restart_backoff_max_s", "RAY_TPU_TRAIN_RESTART_BACKOFF_MAX_S",
+         "float", 30.0,
+         "Cap on the Train restart backoff."),
     Flag("storage_path", "RAY_TPU_STORAGE_PATH", "str", None,
          "Default experiment storage path (default: ~/ray_tpu_results)."),
 ]

@@ -49,6 +49,39 @@ class OutOfMemoryError(WorkerCrashedError):
     raised by MemoryMonitor-driven worker killing, src/ray/common/memory_monitor.h:52)."""
 
 
+class CollectiveAbortError(RayTpuError):
+    """A collective group was poisoned: a member rank died mid-op, the group was
+    re-initialized under the caller (stale epoch), or an operator aborted it.
+    Raised instead of letting survivors burn the full collective_op_timeout_s.
+
+    Carries enough context to act on without parsing the message: the group
+    name, the group epoch the caller was participating in, the rank whose
+    death triggered the abort (None for operator/epoch aborts), and the
+    originating cause when one exists (e.g. the WorkerCrashedError from core
+    worker-death cleanup, or a peer socket error re-labeled by the abort
+    verdict)."""
+
+    def __init__(self, group_name: str, reason: str, failed_rank=None,
+                 epoch=None, cause=None):
+        self.group_name = group_name
+        self.reason = reason
+        self.failed_rank = failed_rank
+        self.epoch = epoch
+        self.cause = cause
+        msg = f"collective group {group_name!r} aborted (epoch {epoch}"
+        if failed_rank is not None:
+            msg += f", failed rank {failed_rank}"
+        msg += f"): {reason}"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        # exceptions cross process boundaries wrapped in TaskError; keep the
+        # typed fields through the pickle round trip
+        return (CollectiveAbortError,
+                (self.group_name, self.reason, self.failed_rank, self.epoch,
+                 self.cause))
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

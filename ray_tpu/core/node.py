@@ -467,6 +467,13 @@ class Cluster:
         # remote worker log rings: wid_hex -> {"node", "lines": deque[(stream, line)]}
         self._worker_logs: Dict[str, Dict[str, Any]] = {}
         self._worker_logs_lock = threading.Lock()
+        # collective-group liveness registry (reference: the GCS knowing which
+        # node holds each NCCL rank): group -> {rank: (WorkerHandle, epoch)},
+        # fed by workers' collective_join/leave notes. Worker death looks up
+        # the dead worker's ranks here and poisons each group's coordinator,
+        # so survivors abort within one poll interval instead of burning the
+        # full collective op timeout.
+        self._collective_members: Dict[str, Dict[int, Tuple[WorkerHandle, int]]] = {}
         self._stream_completion: Dict[ObjectID, TaskID] = {}  # completion oid -> task
         # lineage for reconstruction: return oid -> creating TaskSpec while the
         # object is in scope and the task is retryable (reference
@@ -1097,6 +1104,21 @@ class Cluster:
         elif kind == "metrics":
             # periodic per-worker metric snapshot (util/metrics.py push thread)
             self.metrics_by_worker[w.worker_id] = msg[1]
+        elif kind == "collective_join":
+            _, group, rank, epoch = msg
+            with self._lock:
+                self._collective_members.setdefault(group, {})[rank] = (w, epoch)
+        elif kind == "collective_leave":
+            _, group, rank, epoch = msg
+            with self._lock:
+                members = self._collective_members.get(group)
+                # only the registered incarnation may retract itself: a fresh
+                # join for the same rank (group re-init on another worker) must
+                # not be clobbered by the old member's late destroy
+                if members and members.get(rank) == (w, epoch):
+                    members.pop(rank, None)
+                    if not members:
+                        self._collective_members.pop(group, None)
         elif kind == "tqdm":
             from ray_tpu.experimental.tqdm_ray import _render_local
 
@@ -2079,7 +2101,37 @@ class Cluster:
                 self._fail_returns(spec, err)
         if w.actor_id is not None:
             self._on_actor_worker_death(w.actor_id, err)
+        self._abort_collective_memberships(w, err)
         self._schedule()
+
+    def _abort_collective_memberships(self, w: WorkerHandle, err: Exception) -> None:
+        """Declare a dead worker's collective ranks failed: poison each joined
+        group's coordinator so surviving ranks fail fast with
+        CollectiveAbortError (reference: NCCL comm abort on peer death) within
+        one abort-poll interval rather than at collective_op_timeout_s. The
+        epoch scopes the abort — a late death notice for a rank of an already
+        re-initialized group is rejected by the coordinator, not the board."""
+        dead: List[Tuple[str, int, int]] = []
+        with self._lock:
+            for group, members in list(self._collective_members.items()):
+                for rank, (wh, epoch) in list(members.items()):
+                    if wh is w:
+                        dead.append((group, rank, epoch))
+                        members.pop(rank, None)
+                if not members:
+                    self._collective_members.pop(group, None)
+        for group, rank, epoch in dead:
+            try:
+                coord = self.get_named_actor_handle(
+                    f"coordinator.{group}", "ray_tpu.collective")
+                coord.abort.remote(
+                    f"rank {rank} (worker {w.worker_id.hex()[:8]}) died: {err}",
+                    rank, epoch)
+            except Exception:
+                # coordinator gone (it may have lived on this very worker):
+                # survivors still fail fast — their polls hit ActorDiedError,
+                # which the client loop converts to CollectiveAbortError
+                pass
 
     def _on_actor_worker_death(self, actor_id: ActorID, err: Exception) -> None:
         with self._lock:

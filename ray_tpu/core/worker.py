@@ -238,6 +238,12 @@ class WorkerContext:
         """One-way metric snapshot to the coordinator (util/metrics.py)."""
         self._send(("metrics", snapshot))
 
+    def collective_notify(self, kind: str, group_name: str, rank: int,
+                          epoch: int) -> None:
+        """One-way collective-membership note ("collective_join"/"collective_leave"):
+        the node service keys death-triggered group aborts on these."""
+        self._send((kind, group_name, rank, epoch))
+
     def state_request(self, fn_name: str, *args, **kwargs):
         """State-API aggregation runs on the coordinator (util/state.py)."""
         return self._request("state", fn_name, args, kwargs)

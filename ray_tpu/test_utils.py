@@ -96,6 +96,51 @@ class NodeKiller:
         return node.node_id.hex()
 
 
+class CollectiveRankKiller:
+    """Kill the worker process holding a specific rank of a collective group
+    (SIGKILL, mid-op by design) — the chaos injection for the collective
+    abort path, alongside WorkerKiller (any busy worker) and NodeKiller
+    (whole nodes).
+
+    Resolves rank -> worker through the head's collective-membership registry
+    (fed by collective_join notes at init_collective_group), so it kills the
+    exact process whose death must poison the group's coordinator and fail
+    the surviving ranks fast with CollectiveAbortError.
+    """
+
+    def __init__(self, group_name: str = "default", rank: int = 0):
+        self.group_name = group_name
+        self.rank = rank
+
+    def registered(self) -> bool:
+        """True once the target rank has joined (the kill can land)."""
+        return self._target() is not None
+
+    def _target(self):
+        c = _cluster()
+        with c._lock:
+            members = c._collective_members.get(self.group_name, {})
+            entry = members.get(self.rank)
+        return entry[0] if entry is not None else None
+
+    def kill(self) -> bool:
+        w = self._target()
+        if w is None:
+            return False
+        try:
+            w.process.kill()
+            return True
+        except Exception:
+            return False
+
+    def kill_when_registered(self, timeout: float = 10.0) -> bool:
+        """Block until the rank joins its group, then kill it."""
+        wait_for_condition(self.registered, timeout=timeout,
+                           message=f"rank {self.rank} never joined group "
+                                   f"{self.group_name!r}")
+        return self.kill()
+
+
 def kill_worker_running(task_name: str) -> bool:
     """Kill the worker currently executing a dispatched task with this name
     (deterministic chaos: reference WorkerKillerActor targets by task)."""

@@ -34,3 +34,11 @@ class Backend:
 
     def on_shutdown(self, worker_group: "WorkerGroup", backend_config: BackendConfig) -> None:
         """Before workers are torn down."""
+
+    def on_failure(self, worker_group: "WorkerGroup", backend_config: BackendConfig,
+                   error: BaseException) -> None:
+        """After a worker-group failure, before the non-graceful teardown.
+
+        Must not raise and must not block on the (possibly half-dead) group:
+        used to abort collective state so surviving ranks blocked in an op
+        fail fast instead of pinning the restart behind the op timeout."""

@@ -26,7 +26,26 @@ logger = logging.getLogger(__name__)
 
 
 class TrainingFailedError(RuntimeError):
-    """Raised when training fails beyond the failure policy's budget."""
+    """A training worker (or the whole group) failed.
+
+    worker_rank / error_type carry the first failed rank and its exception's
+    type name (e.g. "CollectiveAbortError" when a peer rank died mid-op) so
+    failure policies can classify without parsing tracebacks."""
+
+    worker_rank: Optional[int] = None
+    error_type: Optional[str] = None
+
+
+def restart_backoff_s(failure_count: int) -> float:
+    """Bounded exponential backoff before worker-group restart N: a crash loop
+    (bad checkpoint, flapping node) must not hot-spin group construction."""
+    from ray_tpu.config import CONFIG
+
+    base = CONFIG.train_restart_backoff_s
+    if base <= 0:
+        return 0.0
+    return min(CONFIG.train_restart_backoff_max_s,
+               base * (2 ** max(0, failure_count - 1)))
 
 
 class BackendExecutor:
@@ -101,14 +120,7 @@ class BackendExecutor:
         polls = ray_tpu.get([w.poll_session.remote() for w in self.worker_group.workers])
         # Drain reports BEFORE surfacing errors: checkpoints reported ahead of a crash are
         # exactly what the restart resumes from. Metrics: rank 0 is canonical.
-        rank0_reports = polls[0]["reports"]
-        for rep in rank0_reports:
-            metrics = rep["metrics"]
-            self._latest_metrics = metrics
-            self._history.append(metrics)
-            ckpt = rep["checkpoint"]
-            if ckpt is not None and self.checkpoint_manager is not None:
-                self.checkpoint_manager.register(ckpt, metrics)
+        self._register_rank0_reports(polls[0]["reports"])
         metas = self.worker_group.metadata
         for rank, p in enumerate(polls):
             if p["reports"]:
@@ -120,13 +132,71 @@ class BackendExecutor:
                     "rank": rank, "node": metas[rank].node_id}
         for rank, p in enumerate(polls):
             if p["error"]:
-                raise TrainingFailedError(f"worker rank {rank} failed:\n{p['error']}")
+                e = TrainingFailedError(f"worker rank {rank} failed:\n{p['error']}")
+                e.worker_rank = rank
+                e.error_type = p.get("error_type")
+                raise e
         return {"finished": all(p["finished"] for p in polls)}
 
     def all_metrics(self) -> List[Dict[str, Any]]:
         """Last reported metrics of every worker rank, each tagged with its
         node id."""
         return [self._per_worker[r] for r in sorted(self._per_worker)]
+
+    def _register_rank0_reports(self, reports: List[Dict[str, Any]]) -> None:
+        """Record rank 0's canonical reports (metrics history + durable
+        checkpoints) — shared by poll() and the post-failure salvage drain so
+        what a restart resumes from never diverges from what polling records."""
+        for rep in reports:
+            metrics = rep["metrics"]
+            self._latest_metrics = metrics
+            self._history.append(metrics)
+            ckpt = rep["checkpoint"]
+            if ckpt is not None and self.checkpoint_manager is not None:
+                self.checkpoint_manager.register(ckpt, metrics)
+
+    def drain_after_failure(self, grace_s: float = 2.0) -> None:
+        """Salvage surviving ranks' last reports before tearing the group down.
+
+        A worker failure races the other ranks' reporting: rank 0's checkpoint
+        for step N may be staged (durable) but not yet polled when another
+        rank's error surfaces — and losing it restarts the run from a much
+        older step, or from nothing. Give surviving sessions a bounded grace
+        period to settle (the backend's abort hook has already unblocked any
+        rank stuck in a collective), drain their queues, and register what was
+        reported. Best-effort: dead actors and still-hung sessions are skipped.
+        """
+        if self.worker_group is None:
+            return
+        deadline = time.monotonic() + grace_s
+        while True:
+            settled = True
+            for rank, w in enumerate(self.worker_group.workers):
+                try:
+                    p = ray_tpu.get(w.poll_session.remote(),
+                                    timeout=max(0.1, deadline - time.monotonic()))
+                except Exception:
+                    continue  # dead/unreachable: nothing to salvage there
+                if rank == 0:
+                    self._register_rank0_reports(p["reports"])
+                if not p["finished"]:
+                    settled = False
+            if settled or time.monotonic() >= deadline:
+                return
+            time.sleep(self.poll_interval_s)
+
+    def salvage_after_failure(self, error: BaseException) -> None:
+        """The one failure-salvage sequence both the v1 run loop and the v2
+        TrainController use: unblock survivors stuck in a collective (the
+        backend's abort hook beats the op timeout), then drain their
+        already-reported checkpoints before a non-graceful teardown discards
+        them. Best-effort — the group is about to be torn down regardless."""
+        try:
+            if self.worker_group is not None:
+                self.backend.on_failure(self.worker_group, self.backend_config, error)
+            self.drain_after_failure()
+        except Exception:
+            pass
 
     def run_until_complete(
         self,
@@ -141,6 +211,7 @@ class BackendExecutor:
         if checkpoint is None and self.checkpoint_manager is not None:
             checkpoint = self.checkpoint_manager.latest_checkpoint
         error: Optional[str] = None
+        failure_count = 0
         while True:
             try:
                 if self.worker_group is None:
@@ -154,6 +225,8 @@ class BackendExecutor:
                 break  # success
             except (TrainingFailedError, ActorError, RayTpuError) as e:
                 logger.warning("training worker group failed: %s", e)
+                failure_count += 1
+                self.salvage_after_failure(e)
                 self.shutdown(graceful=False)
                 if failures_allowed == 0:
                     error = str(e)
@@ -163,6 +236,7 @@ class BackendExecutor:
                 # Restart from the most recent durable checkpoint.
                 if self.checkpoint_manager is not None:
                     checkpoint = self.checkpoint_manager.latest_checkpoint or resume_checkpoint
+                time.sleep(restart_backoff_s(failure_count))
         latest_ckpt = (
             self.checkpoint_manager.latest_checkpoint if self.checkpoint_manager else None
         )

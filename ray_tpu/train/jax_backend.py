@@ -111,6 +111,28 @@ class JaxBackend(Backend):
                 group_name=group_name,
             )
 
+    def on_failure(self, worker_group: WorkerGroup, backend_config: JaxConfig,
+                   error: BaseException) -> None:
+        """Poison the run's collective group before the non-graceful teardown.
+
+        When one rank's session dies (an exception in the user loop — no
+        process death, so core worker-death cleanup never fires), its peers
+        may be blocked mid-allreduce with nobody left to arrive. The abort
+        converts that wait into a fast CollectiveAbortError, so survivors
+        finish their sessions in time for the executor's salvage drain and
+        the group restart is not pinned behind collective_op_timeout_s."""
+        if backend_config.collective_group and backend_config.collective_group_name:
+            from ray_tpu.util import collective as col
+
+            # wait=False: on_failure must not block on the (possibly half-
+            # dead) group — a wedged coordinator host would otherwise pin the
+            # restart behind the op timeout, the exact stall this hook exists
+            # to avoid
+            col.abort_collective_group(
+                backend_config.collective_group_name,
+                reason=f"training worker group failed: {error}",
+                wait=False)
+
     def on_shutdown(self, worker_group: WorkerGroup, backend_config: JaxConfig) -> None:
         def _shutdown():
             import jax

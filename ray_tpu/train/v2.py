@@ -21,11 +21,20 @@ from typing import Any, Callable, Dict, Optional
 import ray_tpu
 from ray_tpu.core.exceptions import ActorError, RayTpuError
 
-from .backend_executor import BackendExecutor, TrainingFailedError
+from .backend_executor import BackendExecutor, TrainingFailedError, restart_backoff_s
 from .checkpoint import Checkpoint
 from .result import Result
 
 logger = logging.getLogger(__name__)
+
+
+def _failure_kind(e: Exception) -> str:
+    """Classify a worker-group failure for policies/logs without parsing
+    tracebacks: a TrainingFailedError carries the failed worker's exception
+    type (e.g. "CollectiveAbortError" — a peer rank died mid-op and the
+    group was poisoned); anything else classifies as its own type."""
+    kind = getattr(e, "error_type", None)
+    return kind or type(e).__name__
 
 
 class TrainControllerState(enum.Enum):
@@ -195,6 +204,11 @@ class TrainController:
                               TrainControllerState.RESIZING):
                 decision = self.scaling_policy.make_decision_for_non_running_worker_group()
                 self._transition(TrainControllerState.SCHEDULING)
+                # resume from whatever is durable NOW — the failure path's
+                # salvage drain may have registered checkpoints after the
+                # caller's last refresh
+                if self.checkpoint_manager is not None:
+                    checkpoint = self.checkpoint_manager.latest_checkpoint or checkpoint
                 self.executor = self._build_executor(decision.num_workers)
                 try:
                     self.executor.start()
@@ -240,11 +254,19 @@ class TrainController:
         """Returns True if retrying. Shuts the group down either way."""
         self.failure_count += 1
         decision = self.failure_policy.make_decision(e, self.failure_count)
-        logger.warning("TrainController failure #%d (%s): %s",
-                       self.failure_count, decision.value, e)
+        logger.warning("TrainController failure #%d (%s, %s): %s",
+                       self.failure_count, decision.value, _failure_kind(e), e)
+        if self.executor is not None:
+            # Unblock survivors stuck in a collective (abort beats the op
+            # timeout), then salvage their already-reported checkpoints
+            # before the non-graceful teardown discards the workers.
+            self.executor.salvage_after_failure(e)
         self._retire_executor(graceful=False)
         if decision == FailureDecision.RETRY:
             self._transition(TrainControllerState.RESTARTING)
+            # bounded exponential backoff: a flapping node or bad checkpoint
+            # must not hot-spin worker-group construction
+            time.sleep(restart_backoff_s(self.failure_count))
             return True
         self._transition(TrainControllerState.ERRORED)
         return False

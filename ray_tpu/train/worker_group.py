@@ -59,14 +59,20 @@ class RayTrainWorker:
     def poll_session(self) -> Dict[str, Any]:
         s = self._session
         if s is None:
-            return {"reports": [], "finished": True, "error": None}
+            return {"reports": [], "finished": True, "error": None,
+                    "error_type": None}
         reports = s.drain()
-        err = None
+        err = err_type = None
         if s.finished.is_set() and s.error is not None:
             import traceback
 
             err = "".join(traceback.format_exception(s.error)).strip()
-        return {"reports": reports, "finished": s.finished.is_set(), "error": err}
+            # the exception's type name rides alongside the formatted traceback
+            # so the executor can classify the failure (e.g. CollectiveAbortError
+            # = a peer rank died mid-op) without parsing text
+            err_type = type(s.error).__name__
+        return {"reports": reports, "finished": s.finished.is_set(), "error": err,
+                "error_type": err_type}
 
     def end_session(self) -> None:
         self._session = None

@@ -3,8 +3,11 @@
 Reference capability: python/ray/util/collective/. See collective.py module docstring for
 the TPU-native backend design.
 """
+from ray_tpu.core.exceptions import CollectiveAbortError  # noqa: F401
+
 from .collective import (  # noqa: F401
     CollectiveActorMixin,
+    abort_collective_group,
     allgather,
     allreduce,
     barrier,

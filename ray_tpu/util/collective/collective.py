@@ -16,6 +16,7 @@ across member processes so members can jointly build multi-host meshes.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -50,6 +51,10 @@ class _GroupState:
     # True only when EVERY member of the group joined one jax.distributed universe
     # (agreed collectively at bootstrap) — the gate for device-path collectives.
     xla_device_plane: bool = False
+    # the coordinator epoch this member belongs to (assigned by join() at
+    # init): every contribute/poll is tagged with it so stale members of a
+    # destroyed-and-recreated group are rejected instead of corrupting boards
+    epoch: int = 0
 
     def next_key(self, op: str, extra: str = "") -> str:
         # sequence per (op, extra), not per op: p2p send/recv counters must
@@ -86,7 +91,7 @@ def _get_or_create_coordinator(group_name: str, world_size: int, rank: int):
         try:
             coord = coord_cls.options(
                 name=name, namespace=_NAMESPACE, lifetime="detached", num_cpus=0
-            ).remote(world_size)
+            ).remote(world_size, group_name)
             # Name collisions surface on the first method call, not at .remote() —
             # round-trip before trusting the handle (a stale detached coordinator
             # may still own the name).
@@ -133,18 +138,77 @@ def init_collective_group(
     with _lock:
         if group_name in _groups:
             raise RuntimeError(f"collective group {group_name!r} already initialized here")
+    import ray_tpu
+
     coord = _get_or_create_coordinator(group_name, world_size, rank)
     state = _GroupState(
         group_name, world_size, rank, backend, coord,
         compression=None if comp is Compression.NONE else comp.value,
         ring_threshold=ring_threshold_bytes,
     )
-    if backend is Backend.XLA:
-        _bootstrap_xla(state)
-    with _lock:
-        _groups[group_name] = state
-    # Rendezvous barrier: nobody proceeds until all members have declared.
-    _barrier_impl(state, key=f"__init__:{group_name}")
+    # Join the coordinator's roster. The returned epoch tags every board
+    # exchange of this incarnation; a destroy + re-init cycle advances it, so
+    # stragglers of the old incarnation fail fast instead of poisoning the new
+    # group's boards. The member tag (worker id) is the liveness hook core
+    # worker-death cleanup keys abort propagation on.
+    #
+    # The join/barrier pair retries on a STALE-epoch abort: when a previous
+    # init died half-joined, the retry's re-joins can arrive in an order where
+    # a later join rolls the epoch over an earlier one — the stranded member
+    # re-joins the fresh epoch instead of failing, so concurrent re-inits
+    # converge regardless of join order.
+    from ray_tpu.core.exceptions import CollectiveAbortError
+
+    deadline = time.monotonic() + 2 * _op_timeout()
+    try:
+        while True:
+            state.epoch = ray_tpu.get(
+                coord.join.remote(rank, _member_tag()), timeout=2 * _op_timeout())
+            # Tell the head which worker holds this rank: process death then
+            # aborts the group within one poll interval instead of burning the
+            # op timeout.
+            _notify_head("collective_join", group_name, rank, state.epoch)
+            try:
+                if backend is Backend.XLA:
+                    _bootstrap_xla(state)
+                with _lock:
+                    _groups[group_name] = state
+                # Rendezvous barrier: nobody proceeds until all members declared.
+                _barrier_impl(state, key=f"__init__:{group_name}")
+                return
+            except CollectiveAbortError as e:
+                if not getattr(e, "stale", False) or time.monotonic() > deadline:
+                    raise
+    except BaseException:
+        # a failed init must leave no half-registered group behind: the caller
+        # can retry init_collective_group without hitting "already initialized"
+        with _lock:
+            _groups.pop(group_name, None)
+        raise
+
+
+def _member_tag() -> Optional[str]:
+    """This process's worker id (None on the driver): the coordinator's
+    per-rank liveness roster entry."""
+    from ray_tpu.core import global_state
+
+    return getattr(global_state.try_worker(), "worker_id_hex", None)
+
+
+def _notify_head(kind: str, group_name: str, rank: int, epoch: int) -> None:
+    """One-way membership note to the node service (worker processes only —
+    the driver's memberships die with the cluster itself). Best-effort: a
+    race with worker shutdown must not fail the collective op."""
+    from ray_tpu.core import global_state
+
+    w = global_state.try_worker()
+    notify = getattr(w, "collective_notify", None)
+    if notify is None:
+        return
+    try:
+        notify(kind, group_name, rank, epoch)
+    except Exception:
+        pass
 
 
 def create_collective_group(
@@ -197,14 +261,55 @@ class CollectiveActorMixin:
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    """Idempotent and non-blocking: safe to call twice, from a finally block,
+    or while the group is mid-abort — teardown is local state plus one one-way
+    membership note; it never waits on peers or the coordinator."""
     with _lock:
         st = _groups.pop(group_name, None)
+    if st is None:
+        return  # already destroyed (double-destroy, destroy-during-abort)
+    _notify_head("collective_leave", group_name, st.rank, st.epoch)
+    # Epoch-scoped roster retraction on the coordinator itself (fire-and-
+    # forget — destroy never blocks): without it, a PARTIAL roster from a
+    # failed init survives the destroy, and a retry's joins landing in it out
+    # of order strand the first re-joiner in the stale epoch.
+    try:
+        st.coordinator.leave.remote(st.rank, st.epoch)
+    except Exception:
+        pass  # coordinator already gone — nothing to retract
     # release the group's ring data plane (listener thread + port + pooled
     # sockets): planes are keyed by the group's coordinator-issued authkey, so
     # no other group can share one; callers destroy after their last
     # collective op, so no peer still pulls from us
-    if st is not None and st.data_plane is not None:
+    if st.data_plane is not None:
         ring.release_plane(st.data_plane)
+
+
+def abort_collective_group(group_name: str = "default",
+                           reason: str = "aborted by operator",
+                           failed_rank: Optional[int] = None,
+                           wait: bool = True) -> bool:
+    """Poison a group's coordinator: every member's pending and future board
+    waits fail fast with CollectiveAbortError instead of burning the op
+    timeout. Core worker-death cleanup uses the same coordinator entry point;
+    this is the operator/driver-side handle (e.g. a supervisor that decided a
+    training run is wedged). Returns False when the coordinator is already
+    gone — nothing left to poison.
+
+    wait=False fires the poison one-way and returns as soon as the message is
+    posted: failure paths that must not stall behind a wedged coordinator host
+    (Backend.on_failure's contract) use it; True additionally confirms the
+    verdict landed in the current epoch."""
+    import ray_tpu
+
+    try:
+        coord = ray_tpu.get_actor(_coordinator_name(group_name), namespace=_NAMESPACE)
+        ref = coord.abort.remote(reason, failed_rank)
+        if not wait:
+            return True
+        return bool(ray_tpu.get(ref, timeout=_op_timeout()))
+    except Exception:
+        return False
 
 
 def kill_coordinator(group_name: str = "default") -> None:
@@ -384,8 +489,8 @@ def barrier(group_name: str = "default") -> None:
 
 def _barrier_impl(st: _GroupState, key: Optional[str] = None) -> None:
     key = key or st.next_key("barrier")
-    st.coordinator.contribute.remote(key, st.rank, None)
-    wait_poll(st.coordinator, key, st.rank, timeout_s=2 * _op_timeout())
+    st.coordinator.contribute.remote(key, st.rank, None, st.epoch)
+    wait_poll(st, key, timeout_s=2 * _op_timeout())
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
@@ -470,6 +575,6 @@ def _bootstrap_xla(st: _GroupState) -> None:
     # path (a split would deadlock the compiled reduction against the shm plane).
     joined = _jax_distributed_initialized() and jax.process_count() == st.world_size
     key = f"__xla_plane__:{st.name}"
-    st.coordinator.contribute.remote(key, st.rank, bool(joined))
-    flags = wait_poll(st.coordinator, key, st.rank, timeout_s=2 * _op_timeout())
+    st.coordinator.contribute.remote(key, st.rank, bool(joined), st.epoch)
+    flags = wait_poll(st, key, timeout_s=2 * _op_timeout())
     st.xla_device_plane = all(bool(f) for f in flags)

@@ -2,9 +2,10 @@
 
 Reference analogue: the named NCCLUniqueIDStore actor (python/ray/util/collective/util.py:9)
 and the Rendezvous class (collective_group/nccl_collective_group.py:29). Here the coordinator
-does double duty: (1) rendezvous/bootstrap metadata (world size, jax.distributed coordinator
+does triple duty: (1) rendezvous/bootstrap metadata (world size, jax.distributed coordinator
 address for the XLA backend, the data-plane authkey for the ring path), (2) a poll-based
-exchange board for SHM-backend collectives.
+exchange board for SHM-backend collectives, (3) the group's failure authority: per-rank
+membership (liveness), an abort poison flag, and an epoch counter.
 
 The board is a CONTROL-plane surface: above the ring size threshold ranks post only tiny
 metadata records (data-plane address + buffer key) and move tensor bytes rank-to-rank over
@@ -12,8 +13,19 @@ the data plane (ring.py); below it the tensor itself rides the board (small-tens
 path). `contribute` sizes every payload so tests (and operators) can assert that no
 tensor-sized payload transits this single-threaded actor.
 
+Failure model: when a member rank dies mid-op, core worker-death cleanup (core/node.py)
+calls `abort(reason, failed_rank, epoch)`. From then on every `poll`/`poll_one` answers
+with an abort verdict instead of "pending", so blocked members fail fast with
+CollectiveAbortError within one client poll interval — not after the full op timeout.
+Members re-initializing the group `join()` again; the first join of a new cycle advances
+the epoch, clears the boards and the poison flag, and everything still tagged with the
+old epoch is rejected (stale contributions dropped, stale polls answered with an abort
+verdict) so a half-dead previous incarnation can never corrupt the new group's boards.
+
 Clients never block inside coordinator methods (the actor is single-threaded FIFO); they
-poll. Entries are garbage-collected once every participant has fetched them.
+poll. Entries are garbage-collected once every participant has fetched them; entries of
+ops that never completed (a timed-out or aborted op whose key is abandoned) fall to a TTL
+sweep so a long-lived group does not accumulate dead boards.
 """
 from __future__ import annotations
 
@@ -47,18 +59,34 @@ def _payload_nbytes(payload: Any) -> int:
 class GroupCoordinator:
     """Per-collective-group named actor. Name: `ray_tpu.collective.<group_name>`."""
 
-    def __init__(self, world_size: int):
+    def __init__(self, world_size: int, name: str = "default"):
         self.world_size = world_size
+        self.name = name
         # key -> {rank: payload}
         self._boards: Dict[str, Dict[int, Any]] = {}
         # key -> set of ranks that have fetched the completed board
         self._fetched: Dict[str, set] = {}
+        # key -> creation time, for the abandoned-op TTL sweep
+        self._board_born: Dict[str, float] = {}
         self._meta: Dict[str, Any] = {}
         # shared secret for the group's rank-to-rank data plane: members fetch
         # it once at group init and use it for their DataServer/DataClient
         # pair, so ring pulls are authenticated without any cluster-wide key
         # distribution (the coordinator IS the group's trust anchor).
         self._data_authkey = os.urandom(16)
+        # -- failure authority state
+        # The epoch starts at a per-incarnation nonce, not 0: a kill-and-
+        # recreate of the coordinator under the same name (Train group
+        # restart) must not let a delayed death notice scoped to the retired
+        # incarnation's epoch match the fresh one and spuriously poison it —
+        # every epoch comparison is equality-only, so any non-colliding start
+        # value works.
+        self._epoch = int.from_bytes(os.urandom(4), "little")
+        # rank -> opaque member tag (worker id hex for actor members): the
+        # group's per-rank liveness roster for the CURRENT epoch
+        self._members: Dict[int, Any] = {}
+        self._cycle_complete = False
+        self._abort: Optional[Dict[str, Any]] = None
         # instrumentation: the board must carry metadata, not tensors, above
         # the ring threshold — these let tests assert exactly that.
         self._max_contrib_bytes = 0
@@ -75,14 +103,83 @@ class GroupCoordinator:
     def data_authkey(self) -> bytes:
         return self._data_authkey
 
+    # -- membership / epochs -----------------------------------------------------------
+    def join(self, rank: int, member: Any = None) -> int:
+        """Declare membership; returns the epoch the caller belongs to.
+
+        The first join after a completed cycle, after an abort, or by a rank
+        already present in the current roster starts a NEW epoch: boards and
+        the poison flag are cleared, and everything tagged with the old epoch
+        is rejected from here on. Concurrent joins of the same incarnation all
+        land in the same epoch (only the first one rolls it over)."""
+        if self._cycle_complete or self._abort is not None or rank in self._members:
+            self._epoch += 1
+            self._members = {}
+            self._boards.clear()
+            self._fetched.clear()
+            self._board_born.clear()
+            self._abort = None
+            self._cycle_complete = False
+        self._members[rank] = member
+        if len(self._members) >= self.world_size:
+            self._cycle_complete = True
+        return self._epoch
+
+    def leave(self, rank: int, epoch: int) -> None:
+        """Retract a rank from the current roster (destroy_collective_group's
+        one-way note, epoch-scoped like the head-registry retraction). Without
+        this, a PARTIAL roster from a failed init survives the destroy, and
+        the retry's joins land in it out of order — the first re-joiner gets
+        stranded in the stale epoch when a later re-join rolls it over."""
+        if epoch == self._epoch:
+            self._members.pop(rank, None)
+
+    def members(self) -> Dict[int, Any]:
+        """Current-epoch roster: rank -> member tag (per-rank liveness view)."""
+        return dict(self._members)
+
+    def current_epoch(self) -> int:
+        return self._epoch
+
+    def abort(self, reason: str, failed_rank: Optional[int] = None,
+              epoch: Optional[int] = None) -> bool:
+        """Poison the group: every subsequent poll answers with this verdict.
+
+        `epoch` scopes the abort: a late death notification for a rank of an
+        already-retired incarnation must not poison the re-initialized group.
+        Returns False when the abort was stale and ignored."""
+        if epoch is not None and epoch != self._epoch:
+            return False
+        if self._abort is None:  # first verdict wins (first failure is the cause)
+            self._abort = {"reason": str(reason), "failed_rank": failed_rank,
+                           "epoch": self._epoch}
+        return True
+
+    def check_abort(self, epoch: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """The abort verdict for a caller participating at `epoch`, else None.
+        A caller from a retired epoch gets a stale-epoch verdict even after
+        the poison flag was cleared by a re-init."""
+        if epoch is not None and epoch != self._epoch:
+            return {"reason": f"group re-initialized (stale epoch {epoch}, "
+                              f"current {self._epoch})",
+                    "failed_rank": None, "epoch": self._epoch, "stale": True}
+        return self._abort
+
     # -- exchange board ----------------------------------------------------------------
-    def contribute(self, key: str, rank: int, payload: Any) -> None:
+    def contribute(self, key: str, rank: int, payload: Any,
+                   epoch: Optional[int] = None) -> None:
+        if epoch is not None and epoch != self._epoch:
+            return  # stale incarnation: must not corrupt the new group's board
+        self._gc_abandoned()
         n = _payload_nbytes(payload)
         self._num_contribs += 1
         self._total_contrib_bytes += n
         if n > self._max_contrib_bytes:
             self._max_contrib_bytes = n
-        self._boards.setdefault(key, {})[rank] = payload
+        if key not in self._boards:
+            self._boards[key] = {}
+            self._board_born[key] = time.monotonic()
+        self._boards[key][rank] = payload
 
     def board_stats(self) -> Dict[str, int]:
         """Bytes that transited this actor's board (tensor bytes on the old
@@ -93,12 +190,24 @@ class GroupCoordinator:
             "num_contribs": self._num_contribs,
         }
 
-    def poll(self, key: str, rank: int, expected: Optional[int] = None) -> Tuple[bool, Optional[List[Any]]]:
-        """Return (ready, payload-list-in-rank-order). Marks `rank` as fetched when ready."""
+    def board_keys(self) -> List[str]:
+        """Live board keys (test/debug introspection: board-cleanup audits)."""
+        return sorted(self._boards)
+
+    def poll(self, key: str, rank: int, expected: Optional[int] = None,
+             epoch: Optional[int] = None) -> Tuple[str, Any]:
+        """Returns one of:
+          ("ready", payload-list-in-rank-order)  — marks `rank` as fetched
+          ("pending", arrived-rank-list)         — for debuggable timeouts
+          ("abort", verdict-dict)                — group poisoned / stale epoch
+        """
+        verdict = self.check_abort(epoch)
+        if verdict is not None:
+            return "abort", verdict
         want = expected if expected is not None else self.world_size
         board = self._boards.get(key)
         if board is None or len(board) < want:
-            return False, None
+            return "pending", sorted(board) if board else []
         out = [board[r] for r in sorted(board)]
         fetched = self._fetched.setdefault(key, set())
         fetched.add(rank)
@@ -107,48 +216,130 @@ class GroupCoordinator:
         if len(fetched) >= self.world_size:
             self._boards.pop(key, None)
             self._fetched.pop(key, None)
-        return True, out
+            self._board_born.pop(key, None)
+        return "ready", out
 
-    def poll_one(self, key: str, rank: int, src_rank: int) -> Tuple[bool, Any]:
-        """Point-to-point fetch: wait for src_rank's payload only (send/recv)."""
+    def poll_one(self, key: str, rank: int, src_rank: int,
+                 epoch: Optional[int] = None) -> Tuple[str, Any]:
+        """Point-to-point fetch: wait for src_rank's payload only (send/recv).
+        Same status contract as poll()."""
+        verdict = self.check_abort(epoch)
+        if verdict is not None:
+            return "abort", verdict
         board = self._boards.get(key)
         if board is None or src_rank not in board:
-            return False, None
+            return "pending", sorted(board) if board else []
         payload = board.pop(src_rank)
         if not board:
             self._boards.pop(key, None)
-        return True, payload
+            self._board_born.pop(key, None)
+        return "ready", payload
 
     def world(self) -> int:
         return self.world_size
 
+    def _gc_abandoned(self) -> None:
+        """Reap boards of ops that never completed (timed out / aborted and
+        the key abandoned): without this a long-lived group accumulates one
+        dead board per failed op. Epoch rollovers clear everything anyway;
+        this covers within-epoch retries under fresh keys."""
+        try:
+            from ray_tpu.config import CONFIG
 
-def wait_poll(coordinator, key: str, rank: int, timeout_s: float, expected: Optional[int] = None):
-    """Client-side poll loop against the coordinator actor handle."""
+            ttl = max(60.0, 4 * CONFIG.collective_op_timeout_s)
+        except Exception:
+            ttl = 120.0
+        now = time.monotonic()
+        for key in [k for k, born in self._board_born.items() if now - born > ttl]:
+            self._boards.pop(key, None)
+            self._fetched.pop(key, None)
+            self._board_born.pop(key, None)
+
+
+def _abort_error(st, verdict: Dict[str, Any], key: str):
+    from ray_tpu.core.exceptions import CollectiveAbortError
+
+    err = CollectiveAbortError(
+        getattr(st, "name", "?"),
+        f"op {key!r} aborted: {verdict.get('reason', 'unknown')}",
+        failed_rank=verdict.get("failed_rank"),
+        epoch=verdict.get("epoch", getattr(st, "epoch", None)),
+    )
+    # stale-epoch verdicts are retryable (the group moved on without us);
+    # init_collective_group re-joins on them instead of failing the member
+    err.stale = bool(verdict.get("stale"))
+    return err
+
+
+def _coordinator_lost_error(st, key: str, e: BaseException):
+    from ray_tpu.core.exceptions import CollectiveAbortError
+
+    return CollectiveAbortError(
+        getattr(st, "name", "?"),
+        f"group coordinator unreachable during op {key!r}: {e}",
+        epoch=getattr(st, "epoch", None), cause=e,
+    )
+
+
+def wait_poll(st, key: str, timeout_s: float, expected: Optional[int] = None):
+    """Client-side poll loop against the group's coordinator actor.
+
+    `st` is the caller's group state (coordinator handle, rank, name,
+    world_size, epoch). Fails fast with CollectiveAbortError on an abort
+    verdict or coordinator death; a genuine timeout names the group, world
+    size, epoch, and the ranks that HAD arrived, so a stuck op is debuggable
+    from the exception alone."""
+    from ray_tpu.core.exceptions import ActorError
+
     from ... import get  # late import to avoid cycle
 
     deadline = time.monotonic() + timeout_s
     sleep = 0.0005
+    epoch = getattr(st, "epoch", None)
+    arrived: List[int] = []
     while True:
-        ready, out = get(coordinator.poll.remote(key, rank, expected))
-        if ready:
+        try:
+            status, out = get(st.coordinator.poll.remote(key, st.rank, expected, epoch))
+        except (ActorError, ConnectionError, OSError) as e:
+            raise _coordinator_lost_error(st, key, e) from e
+        if status == "ready":
             return out
+        if status == "abort":
+            raise _abort_error(st, out, key)
+        arrived = out
         if time.monotonic() > deadline:
-            raise TimeoutError(f"collective op {key!r} timed out after {timeout_s}s (rank {rank})")
+            raise TimeoutError(
+                f"collective op {key!r} in group {getattr(st, 'name', '?')!r} "
+                f"timed out after {timeout_s}s (rank {st.rank}, "
+                f"world_size {getattr(st, 'world_size', '?')}, epoch {epoch}; "
+                f"arrived ranks: {arrived})")
         time.sleep(sleep)
         sleep = min(sleep * 2, 0.01)
 
 
-def wait_poll_one(coordinator, key: str, rank: int, src_rank: int, timeout_s: float):
+def wait_poll_one(st, key: str, src_rank: int, timeout_s: float):
+    """wait_poll for point-to-point recv: same fail-fast and timeout contract."""
+    from ray_tpu.core.exceptions import ActorError
+
     from ... import get
 
     deadline = time.monotonic() + timeout_s
     sleep = 0.0005
+    epoch = getattr(st, "epoch", None)
     while True:
-        ready, out = get(coordinator.poll_one.remote(key, rank, src_rank))
-        if ready:
+        try:
+            status, out = get(st.coordinator.poll_one.remote(key, st.rank, src_rank, epoch))
+        except (ActorError, ConnectionError, OSError) as e:
+            raise _coordinator_lost_error(st, key, e) from e
+        if status == "ready":
             return out
+        if status == "abort":
+            raise _abort_error(st, out, key)
         if time.monotonic() > deadline:
-            raise TimeoutError(f"recv {key!r} from rank {src_rank} timed out (rank {rank})")
+            raise TimeoutError(
+                f"recv {key!r} from rank {src_rank} in group "
+                f"{getattr(st, 'name', '?')!r} timed out after {timeout_s}s "
+                f"(rank {st.rank}, world_size {getattr(st, 'world_size', '?')}, "
+                f"epoch {epoch}; arrived ranks: {out})")
         time.sleep(sleep)
         sleep = min(sleep * 2, 0.01)

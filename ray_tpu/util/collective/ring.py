@@ -234,19 +234,38 @@ class _Plane:
         self.addr: Tuple[str, int] = (_local_ip(), self.server.port)
 
     def _read(self, loc: Tuple) -> Tuple[bytes, bool]:
-        if not (isinstance(loc, tuple) and len(loc) == 4 and loc[0] == "cbuf"):
+        if not (isinstance(loc, tuple) and len(loc) in (4, 5) and loc[0] == "cbuf"):
             raise ValueError(f"bad collective pull location {loc!r}")
-        _, key, offset, length = loc
-        return self.store.read(key, int(offset), int(length), _op_timeout()), False
+        _, key, offset, length = loc[:4]
+        # 5-tuple = bounded probe: wait at most loc[4] for the range, then
+        # answer "not published yet" (empty frame) instead of erroring — the
+        # store took no bytes, so the caller re-asks without double-counting
+        # toward exp-based retraction. Tree-relay children use this so a
+        # stalled upstream costs them one abort poll interval per probe, not
+        # the full op timeout pinned inside a single pull.
+        timeout = float(loc[4]) if len(loc) == 5 else _op_timeout()
+        try:
+            return self.store.read(key, int(offset), int(length), timeout), False
+        except TimeoutError:
+            if len(loc) == 5 and int(length) > 0:
+                return b"", False
+            raise
 
-    def pull(self, addr, key: str, offset: int, length: int) -> bytes:
+    def pull(self, addr, key: str, offset: int, length: int,
+             timeout: Optional[float] = None) -> Optional[bytes]:
+        """Pull [offset, offset+length) from a peer. With `timeout` set, the
+        server waits at most that long for the range and this returns None if
+        it wasn't published yet (bounded probe, see _read)."""
         if length == 0:
             return b""
+        loc = ("cbuf", key, int(offset), int(length))
+        if timeout is not None:
+            loc += (float(timeout),)
         # retry=False: _BufStore reads count toward exp-based retraction, so a
         # replayed range would double-count and retract the buffer early
-        data, _ = self.client.pull((addr[0], int(addr[1])),
-                                   ("cbuf", key, int(offset), int(length)),
-                                   retry=False)
+        data, _ = self.client.pull((addr[0], int(addr[1])), loc, retry=False)
+        if timeout is not None and length > 0 and len(data) == 0:
+            return None
         if length > 0 and len(data) != length:
             raise OSError(f"short collective pull of {key!r} from {addr}: "
                           f"{len(data)} != {length}")
@@ -350,11 +369,56 @@ def _decompress(blob: bytes, dtype) -> np.ndarray:
     return dequant_np(q, scales, block, dtype)
 
 
+# -- abort fail-fast -------------------------------------------------------------------
+class _AbortCheck:
+    """Throttled abort probe for the ring path's data-plane waits.
+
+    Board waits learn about an abort through poll() itself; the data-plane
+    phases (stream reduce, gathers, tree relay) block on local conditions and
+    peer sockets instead, so they consult the coordinator's poison flag at
+    most once per CONFIG.collective_abort_poll_interval_s and raise
+    CollectiveAbortError the moment a verdict lands — a dead peer costs one
+    poll interval, not the full op timeout."""
+
+    def __init__(self, st):
+        from ray_tpu.config import CONFIG
+
+        self.st = st
+        self.interval = max(0.05, CONFIG.collective_abort_poll_interval_s)
+        self._last = time.monotonic()
+
+    def check(self, force: bool = False, cause: Optional[BaseException] = None) -> None:
+        """Raise CollectiveAbortError if the group is poisoned (or the
+        coordinator itself died). `force` skips the throttle — used when a
+        peer pull already failed, so the abort verdict (the disease) outranks
+        the socket error (the symptom)."""
+        now = time.monotonic()
+        if not force and now - self._last < self.interval:
+            return
+        self._last = now
+        from ray_tpu.core.exceptions import ActorError, CollectiveAbortError
+
+        from ... import get
+
+        epoch = getattr(self.st, "epoch", None)
+        try:
+            verdict = get(self.st.coordinator.check_abort.remote(epoch))
+        except (ActorError, ConnectionError, OSError) as e:
+            raise CollectiveAbortError(
+                self.st.name, f"group coordinator unreachable: {e}",
+                epoch=epoch, cause=e) from e
+        if verdict is not None:
+            raise CollectiveAbortError(
+                self.st.name, verdict.get("reason", "aborted"),
+                failed_rank=verdict.get("failed_rank"),
+                epoch=verdict.get("epoch", epoch), cause=cause)
+
+
 # -- board exchange helpers ------------------------------------------------------------
 def _exchange(st, key: str, payload, expected: Optional[int] = None) -> List[Any]:
-    st.coordinator.contribute.remote(key, st.rank, payload)
-    return wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout(),
-                     expected=expected)
+    st.coordinator.contribute.remote(key, st.rank, payload,
+                                     getattr(st, "epoch", None))
+    return wait_poll(st, key, timeout_s=_op_timeout(), expected=expected)
 
 
 def _is_meta(entry) -> bool:
@@ -412,7 +476,7 @@ def _chunk_bounds(n: int, w: int) -> List[Tuple[int, int]]:
     return out
 
 
-def _run_threads(fns, deadline: float, what: str) -> None:
+def _run_threads(fns, deadline: float, what: str, st=None) -> None:
     errs: List[BaseException] = []
 
     def wrap(fn):
@@ -424,11 +488,22 @@ def _run_threads(fns, deadline: float, what: str) -> None:
     threads = [threading.Thread(target=wrap, args=(fn,), daemon=True) for fn in fns]
     for t in threads:
         t.start()
+    abort = _AbortCheck(st) if st is not None else None
     for t in threads:
-        t.join(max(0.0, deadline - time.monotonic()) + 0.1)
+        while t.is_alive():
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break
+            if abort is not None:
+                abort.check()  # raises: puller threads are daemons, safe to abandon
+            t.join(min(left + 0.1, abort.interval if abort is not None else 1.0))
     if any(t.is_alive() for t in threads):
         raise TimeoutError(f"{what} timed out after {_op_timeout()}s")
     if errs:
+        if abort is not None:
+            # a failed peer pull may be the SYMPTOM of a rank death: prefer
+            # the typed abort verdict when one is pending
+            abort.check(force=True, cause=errs[0])
         raise errs[0]
 
 
@@ -469,19 +544,32 @@ def _ordered_stream_reduce(st, op, parts_src, my_part: np.ndarray,
                for i in _staggered(r, w)]
     for t in threads:
         t.start()
+    abort = _AbortCheck(st)
     acc: Optional[np.ndarray] = None
     for i in range(w):
-        with cond:
-            while slots[i] is None and not errs:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    raise TimeoutError(
-                        f"{what}: rank {r} timed out waiting for rank {i}'s part")
-                cond.wait(min(left, 1.0))
-            if errs:
-                raise errs[0]
-            part = slots[i]
-            slots[i] = None  # release as we go: peak extra memory < one input
+        # The abort probe is a blocking coordinator RPC: it must run OUTSIDE
+        # the parts lock, or every probe stalls puller threads trying to
+        # deposit finished chunks (cond.wait already drops the lock; the RPC
+        # would hold it for a control-plane round-trip per poll interval).
+        part = err = None
+        while part is None and err is None:
+            with cond:
+                if errs:
+                    err = errs[0]
+                elif slots[i] is not None:
+                    part = slots[i]
+                    slots[i] = None  # release as we go: peak extra mem < one input
+                else:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise TimeoutError(
+                            f"{what}: rank {r} timed out waiting for rank {i}'s part")
+                    cond.wait(min(left, abort.interval))
+            if part is None:
+                # fail fast on a dead peer (pullers are daemons, safe to abandon)
+                abort.check(force=(err is not None), cause=err)
+                if err is not None:
+                    raise err
         if i == 0:
             acc = np.asarray(part).copy()
         else:
@@ -603,7 +691,7 @@ def allreduce(st, tensor, op: ReduceOp) -> np.ndarray:
                              out=out_bytes[j0 * item:j1 * item])
 
     _run_threads([lambda j=j: gather(j) for j in _staggered(r, w)], deadline,
-                 f"allreduce gather {key}")
+                 f"allreduce gather {key}", st=st)
     return out.reshape(arr.shape)
 
 
@@ -690,8 +778,7 @@ def broadcast(st, tensor, src_rank: int) -> np.ndarray:
         _tree_addrs(st, plane, key)
         return arr
     # non-source: the source alone decides board vs ring (it knows the size)
-    entry = wait_poll(st.coordinator, key, st.rank, timeout_s=_op_timeout(),
-                      expected=1)[0]
+    entry = wait_poll(st, key, timeout_s=_op_timeout(), expected=1)[0]
     if not _is_meta(entry):
         return np.asarray(entry)
     meta = entry[1]
@@ -709,14 +796,27 @@ def broadcast(st, tensor, src_rank: int) -> np.ndarray:
     # stream behind us instead of waiting for the whole payload
     step = _chunk_bytes()
     deadline = time.monotonic() + _op_timeout()
+    abort = _AbortCheck(st)
     pos = 0
     while pos < total:
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"broadcast {key}: relay from rank {(parent_v + src_rank) % w} "
                 f"exceeded {_op_timeout()}s at byte {pos}/{total}")
+        abort.check()  # a dead relay parent must not cost the whole deadline
         ln = min(step, total - pos)
-        buf[pos:pos + ln] = plane.pull(parent_addr, f"{key}:bc", pos, ln)
+        try:
+            # bounded probe (see _Plane.pull): an upstream death that stalls
+            # the parent's stream must not pin us inside one pull for the op
+            # timeout — the abort verdict has to win within ~one poll interval
+            data = plane.pull(parent_addr, f"{key}:bc", pos, ln,
+                              timeout=abort.interval)
+        except (OSError, EOFError, TimeoutError) as e:
+            abort.check(force=True, cause=e)
+            raise
+        if data is None:
+            continue  # range not relayed yet: re-probe abort, then re-ask
+        buf[pos:pos + ln] = data
         pos += ln
         if nchild:
             plane.store.advance(f"{key}:bc", pos)
@@ -771,7 +871,7 @@ def allgather(st, tensor) -> List[np.ndarray]:
 
     fetch(r)
     _run_threads([lambda i=i: fetch(i) for i in _staggered(r, w)], deadline,
-                 f"allgather {key}")
+                 f"allgather {key}", st=st)
     return results
 
 
@@ -829,21 +929,21 @@ def send(st, tensor, dst_rank: int) -> None:
     arr = np.asarray(tensor)
     key = st.next_key("p2p", extra=f"{st.rank}->{dst_rank}")
     flat = _flat(arr)
+    epoch = getattr(st, "epoch", None)
     if flat.nbytes < _threshold(st) or not _ring_capable(flat):
-        st.coordinator.contribute.remote(key, st.rank, arr)
+        st.coordinator.contribute.remote(key, st.rank, arr, epoch)
         return
     plane = _ensure_plane(st)
     enc = _enc_for(st, flat)
     blob = _compress(flat) if enc == "int8" else flat.tobytes()
     plane.store.publish(f"{key}:in", blob, len(blob))
     st.coordinator.contribute.remote(key, st.rank,
-                                     _meta(st, plane, flat, arr.shape, enc))
+                                     _meta(st, plane, flat, arr.shape, enc), epoch)
 
 
 def recv(st, src_rank: int) -> np.ndarray:
     key = st.next_key("p2p", extra=f"{src_rank}->{st.rank}")
-    payload = wait_poll_one(st.coordinator, key, st.rank, src_rank,
-                            timeout_s=_op_timeout())
+    payload = wait_poll_one(st, key, src_rank, timeout_s=_op_timeout())
     if _is_meta(payload):
         return _pull_payload(_ensure_plane(st), payload[1], f"{key}:in")
     return np.asarray(payload)

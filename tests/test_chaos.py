@@ -1,13 +1,17 @@
 """Chaos suite: workloads must survive injected worker/node kills.
 
 Reference analog: python/ray/tests/chaos/ + setup_chaos.py kill policies
-(SURVEY.md §4 fault-tolerance tests)."""
+(SURVEY.md §4 fault-tolerance tests). The collective cases inject rank death
+mid-op (CollectiveRankKiller) and assert the abort path: survivors fail fast
+with a typed CollectiveAbortError — never by burning the full op timeout —
+and elastic Train recovers from its last checkpoint."""
 import time
 
 import pytest
 
 import ray_tpu
-from ray_tpu.test_utils import NodeKiller, WorkerKiller, wait_for_condition
+from ray_tpu.test_utils import (CollectiveRankKiller, NodeKiller, WorkerKiller,
+                                wait_for_condition)
 
 
 
@@ -74,6 +78,227 @@ def test_node_kill_reschedules_tasks(rt):
     killed = nk.kill_node(extra.node_id)
     assert killed is not None
     assert sorted(rt.get(refs, timeout=120)) == [i * 2 for i in range(10)]
+
+
+# -- collective abort propagation ------------------------------------------------------
+def _make_collective_members(rt, n):
+    @rt.remote(num_cpus=0)
+    class ChaosMember:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def _ray_tpu_collective_init(self, world_size, rank, backend, group_name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world_size, rank, backend, group_name)
+
+        def timed_allreduce(self, group_name, nelem):
+            """Returns (status, elapsed_s, failed_rank): survivors of a rank
+            death must observe a typed abort, fast."""
+            import numpy as np
+
+            from ray_tpu.util import collective as col
+            from ray_tpu.util.collective import CollectiveAbortError
+
+            x = np.full((nelem,), float(self.rank + 1), dtype=np.float32)
+            t0 = time.monotonic()
+            try:
+                col.allreduce(x, group_name)
+                return ("ok", time.monotonic() - t0, None)
+            except CollectiveAbortError as e:
+                return ("abort", time.monotonic() - t0, e.failed_rank)
+            except TimeoutError:
+                return ("timeout", time.monotonic() - t0, None)
+
+        def abort_then_destroy(self, group_name):
+            """Block in an op until the group is aborted, then destroy the
+            group — twice — while it is still mid-abort. Must not hang."""
+            import numpy as np
+
+            from ray_tpu.util import collective as col
+            from ray_tpu.util.collective import CollectiveAbortError
+
+            t0 = time.monotonic()
+            try:
+                col.allreduce(np.ones(4, np.float32), group_name)
+                return ("ok", time.monotonic() - t0)
+            except CollectiveAbortError:
+                col.destroy_collective_group(group_name)
+                col.destroy_collective_group(group_name)  # idempotent
+                return ("abort", time.monotonic() - t0)
+
+        def destroy(self, group_name):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group(group_name)
+            return True
+
+    return [ChaosMember.remote(i) for i in range(n)]
+
+
+@pytest.mark.parametrize("nelem", [64, 200_000])  # board path / ring path
+def test_rank_death_mid_allreduce_aborts_survivors_fast(rt, nelem):
+    """Acceptance: kill a rank mid-allreduce at world size 4 — every surviving
+    rank observes CollectiveAbortError naming the dead rank, well inside 25%
+    of collective_op_timeout_s (worker death propagates through the head's
+    membership registry to the group coordinator's poison flag; nobody burns
+    the deadline)."""
+    from ray_tpu.config import CONFIG
+    from ray_tpu.util import collective as col
+
+    group = f"chaos_ar_{nelem}"
+    members = _make_collective_members(rt, 4)
+    try:
+        col.create_collective_group(members, 4, [0, 1, 2, 3],
+                                    backend="shm", group_name=group)
+        killer = CollectiveRankKiller(group, rank=3)
+        assert killer.registered()
+        # survivors enter the op; rank 3 never does, then dies
+        refs = [w.timed_allreduce.remote(group, nelem) for w in members[:3]]
+        time.sleep(0.3)
+        assert killer.kill()
+        results = rt.get(refs, timeout=60)
+        budget = 0.25 * CONFIG.collective_op_timeout_s
+        for status, elapsed, failed_rank in results:
+            assert status == "abort", results
+            assert elapsed < budget, (elapsed, budget)
+            assert failed_rank == 3
+    finally:
+        for w in members:
+            try:
+                rt.kill(w)
+            except Exception:
+                pass
+        col.kill_coordinator(group)
+
+
+def test_destroy_during_abort_idempotent_then_reinit(rt):
+    """destroy_collective_group while the group is mid-abort returns promptly
+    (no peer waits), double-destroy is a no-op, and the same group name
+    re-initializes cleanly on a fresh epoch afterwards."""
+    import numpy as np
+
+    from ray_tpu.util import collective as col
+
+    group = "chaos_destroy"
+    members = _make_collective_members(rt, 2)
+    try:
+        col.create_collective_group(members, 2, [0, 1],
+                                    backend="shm", group_name=group)
+        # rank 0 blocks in an allreduce rank 1 never joins; then the group is
+        # aborted out from under it
+        ref = members[0].abort_then_destroy.remote(group)
+        time.sleep(0.2)
+        assert col.abort_collective_group(group, reason="operator abort")
+        status, elapsed = rt.get(ref, timeout=20)
+        assert status == "abort"
+        assert elapsed < 10  # failed fast, did not burn the op deadline
+        # the idle member's destroy must not hang either — and is idempotent
+        assert rt.get(members[1].destroy.remote(group), timeout=10)
+        assert rt.get(members[1].destroy.remote(group), timeout=10)
+        # same name, same actors, fresh epoch: the aborted incarnation's state
+        # must not leak into the new group
+        col.create_collective_group(members, 2, [0, 1],
+                                    backend="shm", group_name=group)
+        out = rt.get([w.timed_allreduce.remote(group, 8) for w in members],
+                     timeout=30)
+        assert [s for s, _, _ in out] == ["ok", "ok"]
+    finally:
+        for w in members:
+            try:
+                rt.kill(w)
+            except Exception:
+                pass
+        col.kill_coordinator(group)
+
+
+def _chaos_train_loop(config):
+    import json
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import ray_tpu.train as train
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.util import collective as col
+    from ray_tpu.util.collective import CollectiveAbortError
+
+    ctx = train.get_context()
+    group = os.environ["RAY_TPU_TRAIN_COLLECTIVE_GROUP"]
+    ckpt = train.get_checkpoint()
+    start = 0
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            start = json.load(open(os.path.join(d, "state.json")))["step"] + 1
+    for step in range(start, config["steps"]):
+        try:
+            out = col.allreduce(np.ones(8, np.float32), group)
+        except CollectiveAbortError:
+            # survivors of the injected rank death see the typed abort, not a
+            # bare timeout; leave a marker so the driver can assert it
+            open(os.path.join(config["marker_dir"],
+                              f"abort_rank{ctx.get_world_rank()}"), "w").close()
+            raise
+        assert float(out[0]) == float(ctx.get_world_size())
+        checkpoint = None
+        if ctx.get_world_rank() == 0:
+            d = tempfile.mkdtemp(prefix="chaos_ckpt_")
+            json.dump({"step": step}, open(os.path.join(d, "state.json"), "w"))
+            checkpoint = Checkpoint.from_directory(d)
+        train.report({"step": step, "start": start}, checkpoint=checkpoint)
+        time.sleep(config["step_s"])
+
+
+def test_train_v2_recovers_from_rank_death(rt, tmp_path):
+    """Acceptance: a Train v2 run with max_failures=1 whose rank 1 is killed
+    mid-run restarts automatically and finishes with correct results from its
+    last checkpoint."""
+    import threading
+
+    from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train import JaxConfig, TrainController
+    from ray_tpu.train.checkpoint_manager import CheckpointManager
+
+    group = "chaos_train"
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    mgr = CheckpointManager(str(tmp_path / "run"), CheckpointConfig())
+    ctl = TrainController(
+        _chaos_train_loop,
+        backend_config=JaxConfig(collective_group=True,
+                                 collective_group_name=group),
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=0.5),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+        checkpoint_manager=mgr,
+        train_loop_config={"steps": 10, "step_s": 0.2,
+                           "marker_dir": str(marker_dir)},
+    )
+    done = {}
+
+    def run():
+        done["result"] = ctl.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # kill rank 1 only after a checkpoint is durable, so "resume from latest
+    # checkpoint" is the path under test
+    killer = CollectiveRankKiller(group, rank=1)
+    wait_for_condition(
+        lambda: killer.registered() and mgr.latest_checkpoint is not None,
+        timeout=30, message="no checkpoint before injection window closed")
+    assert killer.kill()
+    t.join(timeout=90)
+    assert not t.is_alive(), "controller hung after rank death"
+    result = done["result"]
+    assert result.error is None, result.error
+    assert ctl.failure_count == 1
+    assert result.metrics["step"] == 9  # ran to completion
+    # the second attempt resumed from a checkpoint, not from scratch
+    assert any(m.get("start", 0) > 0 for m in result.metrics_dataframe)
+    # the surviving rank observed the typed abort (not a bare timeout)
+    assert (marker_dir / "abort_rank0").exists()
 
 
 def test_unretryable_task_fails_cleanly(rt):

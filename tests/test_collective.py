@@ -386,6 +386,145 @@ def test_board_carries_metadata_only_above_threshold(rt, members):
         assert stats["max_contrib_bytes"] < 4_096, stats
 
 
+# -- failure authority: epochs, aborts, debuggable timeouts ----------------------------
+def test_coordinator_epoch_rejects_stale_board_entries():
+    """Regression for board reuse across group incarnations: after an abort
+    and a re-init, entries tagged with the old epoch must be dropped (never
+    satisfying a retried op that reuses the same key), stale pollers must get
+    an abort verdict (not data), and a late abort for the old epoch must not
+    poison the new group."""
+    from ray_tpu.util.collective.coordinator import GroupCoordinator
+
+    coord = GroupCoordinator(2, "epoch_g")
+    e = coord.join(0, "w0")
+    assert coord.join(1, "w1") == e
+
+    # rank 0 contributes, then rank 1 "dies": the abort poisons this epoch
+    coord.contribute("allreduce:0", 0, 1.0, e)
+    assert coord.abort("rank 1 died", failed_rank=1, epoch=e) is True
+    status, verdict = coord.poll("allreduce:0", 0, None, e)
+    assert status == "abort" and verdict["failed_rank"] == 1
+
+    # re-init: the first join after an abort starts a fresh epoch and clears
+    # the poison flag and every board
+    e2 = coord.join(0, "w0")
+    assert e2 == e + 1
+    assert coord.join(1, "w2") == e2
+    assert coord.check_abort(e2) is None
+
+    # a late death notice scoped to the retired epoch is rejected
+    assert coord.abort("late death notice", failed_rank=0, epoch=e) is False
+    assert coord.check_abort(e2) is None
+
+    # a stale contribution reusing the SAME key cannot satisfy the retried op
+    coord.contribute("allreduce:0", 0, "stale", e)
+    status, arrived = coord.poll("allreduce:0", 1, None, e2)
+    assert status == "pending" and arrived == []
+
+    # fresh contributions complete; a poller from the old epoch gets an abort
+    # verdict even though the current epoch is healthy
+    coord.contribute("allreduce:0", 0, "x", e2)
+    coord.contribute("allreduce:0", 1, "y", e2)
+    status, verdict = coord.poll("allreduce:0", 1, None, e)
+    assert status == "abort" and verdict.get("stale")
+    status, out = coord.poll("allreduce:0", 0, None, e2)
+    assert status == "ready" and out == ["x", "y"]
+    status, out = coord.poll("allreduce:0", 1, None, e2)
+    assert status == "ready" and out == ["x", "y"]
+    assert coord.board_keys() == []  # fully fetched boards are reaped
+
+
+def test_join_rollover_on_rejoin_clears_boards():
+    """A rank re-joining (crash-restart re-init without destroy) rolls the
+    epoch even with no abort: half-finished boards of the previous incarnation
+    must not leak into the new one."""
+    from ray_tpu.util.collective.coordinator import GroupCoordinator
+
+    coord = GroupCoordinator(2, "rejoin_g")
+    e = coord.join(0, "w0")
+    coord.join(1, "w1")
+    coord.contribute("barrier:0", 0, None, e)
+    assert coord.board_keys() == ["barrier:0"]
+    e2 = coord.join(0, "w0b")  # rank 0 again: new incarnation
+    assert e2 == e + 1
+    assert coord.board_keys() == []
+
+
+def test_recreated_coordinator_rejects_previous_generation_abort():
+    """Kill-and-recreate of the coordinator under the same name (Train group
+    restart) starts the epoch at a fresh nonce: a delayed death notice scoped
+    to the RETIRED incarnation's epoch must not poison the healthy new group
+    (with max_failures=1 a spurious abort would consume the whole budget)."""
+    from ray_tpu.util.collective.coordinator import GroupCoordinator
+
+    old = GroupCoordinator(2, "gen_g")
+    e_old = old.join(0, "w0")
+    old.join(1, "w1")
+    new = GroupCoordinator(2, "gen_g")  # same name, fresh incarnation
+    e_new = new.join(0, "w0b")
+    new.join(1, "w1b")
+    assert e_new != e_old
+    # the old generation's late death notice misses the new epoch space
+    assert new.abort("late death notice from old generation", 1, e_old) is False
+    assert new.check_abort(e_new) is None
+    st, _ = new.poll("op:0", 0, None, e_new)
+    assert st == "pending"  # healthy: no abort verdict
+
+
+def test_timeout_message_is_debuggable(rt):
+    """A genuine collective timeout names the group, world size, epoch, and
+    the ranks that HAD arrived — a stuck op is diagnosable from the exception
+    alone."""
+    import types
+
+    from ray_tpu.util.collective.coordinator import (GroupCoordinator,
+                                                     wait_poll, wait_poll_one)
+
+    coord = rt.remote(GroupCoordinator).options(num_cpus=0).remote(3, "slowgrp")
+    try:
+        # the epoch starts at a per-incarnation nonce: fetch it, don't assume 0
+        epoch = rt.get(coord.current_epoch.remote())
+        st = types.SimpleNamespace(coordinator=coord, rank=0, name="slowgrp",
+                                   world_size=3, epoch=epoch)
+        coord.contribute.remote("op:0", 0, 1.0, epoch)
+        with pytest.raises(TimeoutError) as ei:
+            wait_poll(st, "op:0", timeout_s=0.4)
+        msg = str(ei.value)
+        assert "slowgrp" in msg and "world_size 3" in msg
+        assert f"epoch {epoch}" in msg and "arrived ranks: [0]" in msg
+        with pytest.raises(TimeoutError) as ei:
+            wait_poll_one(st, "p2p:0", src_rank=2, timeout_s=0.3)
+        msg = str(ei.value)
+        assert "slowgrp" in msg and "rank 2" in msg
+    finally:
+        rt.kill(coord)
+
+
+def test_abort_check_raises_typed_error(rt):
+    """The ring path's throttled abort probe converts a coordinator verdict
+    into CollectiveAbortError with the failed rank attached."""
+    import types
+
+    from ray_tpu.util.collective import CollectiveAbortError, ring
+    from ray_tpu.util.collective.coordinator import GroupCoordinator
+
+    coord = rt.remote(GroupCoordinator).options(num_cpus=0).remote(2, "ac_g")
+    try:
+        epoch = rt.get(coord.current_epoch.remote())
+        st = types.SimpleNamespace(coordinator=coord, rank=0, name="ac_g",
+                                   world_size=2, epoch=epoch)
+        chk = ring._AbortCheck(st)
+        chk.check(force=True)  # healthy group: no raise
+        rt.get(coord.abort.remote("injected fault", 1, epoch))
+        with pytest.raises(CollectiveAbortError) as ei:
+            chk.check(force=True)
+        assert ei.value.failed_rank == 1
+        assert ei.value.group_name == "ac_g"
+        assert "injected fault" in str(ei.value)
+    finally:
+        rt.kill(coord)
+
+
 def test_allreduce_64mb_world4_routes_peer_to_peer(rt, members):
     """Acceptance: a 64 MB float32 allreduce at world_size 4 moves tensor bytes
     rank-to-rank over the data plane; the coordinator carries metadata only."""
