@@ -9,6 +9,9 @@ Run:
     JAX_PLATFORMS=cpu python core_bench.py --local    # local only
     JAX_PLATFORMS=cpu python core_bench.py --collective
         # host-plane collective board-vs-ring wall clock -> COLLECTIVE_BENCH.json
+    JAX_PLATFORMS=cpu python core_bench.py --transfer
+        # data-plane pull sweep (1/10/100 MB x stripe counts)
+        # -> TRANSFER_BENCH.json
 """
 import json
 import os
@@ -139,6 +142,78 @@ def transfer_suite(ray_tpu, np, sched):
     return results
 
 
+def transfer_sweep_suite(ray_tpu, np, sched):
+    """Data-plane pull sweep: agent-resident objects of 1/10/100 MB pulled to
+    the driver (the head's DataClient -> pull_to_store path). Two sections:
+
+    - "wire": the striped zero-copy TCP path at stripe counts 1/2/4/8, with
+      the same-host map shortcut disabled so bytes genuinely cross sockets
+      (what two real hosts pay).
+    - "mapped": the default same-host configuration, where the destination
+      adopts the source's shm mapping in place (reference: one plasma store
+      per node) — the path this single-host topology actually runs.
+
+    Fresh objects every measurement — the replica cache would otherwise
+    short-circuit the transfer. Knobs are env vars read at access time, so the
+    sweep just toggles them between rounds."""
+    sizes = [("1mb", 1 << 20), ("10mb", 10 << 20), ("100mb", 100 << 20)]
+    stripe_counts = [1, 2, 4, 8]
+    reps = {"1mb": 8, "10mb": 6, "100mb": 3}
+
+    @ray_tpu.remote(num_cpus=0.1, scheduling_strategy=sched)
+    def produce(nbytes, seed):
+        import numpy as _np
+
+        return _np.full(nbytes // 8, float(seed))
+
+    def measure(label, nbytes):
+        refs = [produce.remote(nbytes, i) for i in range(reps[label])]
+        _, pending = ray_tpu.wait(refs, num_returns=len(refs), timeout=300)
+        # a produce still running would fold task time into the timed get
+        assert not pending, f"{len(pending)} produce tasks missed the deadline"
+        times = []
+        for r in refs:
+            t0 = time.perf_counter()
+            ray_tpu.get(r, timeout=300)
+            times.append(time.perf_counter() - t0)
+        return nbytes / min(times) / 1e9
+
+    results = {"wire": {}, "mapped": {}}
+    os.environ["RAY_TPU_TRANSFER_STRIPE_THRESHOLD_BYTES"] = str(512 * 1024)
+    # 128 KiB floor so even the 1 MB rows genuinely split into all swept
+    # stripe counts (the 2 MiB default would silently cap them at 1 stream)
+    os.environ["RAY_TPU_TRANSFER_STRIPE_MIN_BYTES"] = str(128 * 1024)
+    os.environ["RAY_TPU_TRANSFER_SAME_HOST_MAP"] = "0"
+    try:
+        for label, nbytes in sizes:
+            row = {}
+            for nstripes in stripe_counts:
+                os.environ["RAY_TPU_TRANSFER_STRIPES"] = str(nstripes)
+                row[f"stripes_{nstripes}_gbps"] = round(
+                    measure(label, nbytes), 3)
+            best = max(stripe_counts,
+                       key=lambda n: row[f"stripes_{n}_gbps"])
+            row["best_stripes"] = best
+            row["speedup"] = round(
+                row[f"stripes_{best}_gbps"] / row["stripes_1_gbps"], 2)
+            results["wire"][label] = row
+            print(f"  wire {label}: " + "  ".join(
+                f"s{n}={row[f'stripes_{n}_gbps']:.2f}GB/s"
+                for n in stripe_counts) + f"  ({row['speedup']:.2f}x)")
+        os.environ.pop("RAY_TPU_TRANSFER_SAME_HOST_MAP", None)  # default: on
+        os.environ.pop("RAY_TPU_TRANSFER_STRIPES", None)
+        for label, nbytes in sizes:
+            gbps = round(measure(label, nbytes), 3)
+            results["mapped"][label] = {"gbps": gbps}
+            print(f"  mapped {label}: {gbps:.2f} GB/s")
+    finally:
+        os.environ.pop("RAY_TPU_TRANSFER_STRIPE_THRESHOLD_BYTES", None)
+        os.environ.pop("RAY_TPU_TRANSFER_STRIPE_MIN_BYTES", None)
+        os.environ.pop("RAY_TPU_TRANSFER_STRIPES", None)
+        os.environ.pop("RAY_TPU_TRANSFER_SAME_HOST_MAP", None)
+    return results
+
+
 def collective_suite(ray_tpu, np):
     """Host-plane allreduce wall clock: the legacy coordinator-board transport
     (every rank's full tensor through one actor, O(W^2) bytes through a single
@@ -195,6 +270,31 @@ def collective_suite(ray_tpu, np):
     return results
 
 
+def _spawn_remote_agent(ray_tpu):
+    """Start a real node agent on localhost and return (proc, sched) — the
+    relay hop a multi-host pod pays, used by the remote/transfer columns."""
+    from ray_tpu.core import global_state
+    from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+    cluster = global_state.try_cluster()
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent",
+         "--address", f"127.0.0.1:{cluster.node_server_port}",
+         "--num-cpus", "4"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        deadline = time.time() + 30
+        while len([x for x in ray_tpu.nodes() if x["Alive"]]) < 2:
+            assert time.time() < deadline, "agent never registered"
+            time.sleep(0.2)
+        remote_id = next(x["NodeID"] for x in ray_tpu.nodes()
+                         if x["Alive"] and x["Labels"].get("agent") == "remote")
+    except BaseException:
+        agent.terminate()
+        raise
+    return agent, NodeAffinitySchedulingStrategy(node_id=remote_id)
+
+
 def main():
     import numpy as np
 
@@ -202,6 +302,28 @@ def main():
 
     mode = sys.argv[1] if len(sys.argv) > 1 else "--all"
     out = {}
+
+    if mode == "--transfer":
+        ray_tpu.init(num_cpus=4, node_server_port=0,
+                     worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=8)
+        agent, sched = _spawn_remote_agent(ray_tpu)
+        try:
+            bench = transfer_sweep_suite(ray_tpu, np, sched)
+            bench.update(transfer_suite(ray_tpu, np, sched))
+        finally:
+            agent.terminate()
+            try:
+                agent.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                agent.kill()
+        ray_tpu.shutdown()
+        path = os.path.join(os.path.dirname(__file__) or ".",
+                            "TRANSFER_BENCH.json")
+        with open(path, "w") as f:
+            json.dump(bench, f, indent=2)
+        print("wrote TRANSFER_BENCH.json")
+        return
 
     if mode == "--collective":
         ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"},
@@ -220,23 +342,8 @@ def main():
     out["local"] = suite(ray_tpu, np)
 
     if mode != "--local":
-        from ray_tpu.core import global_state
-        from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
-
-        cluster = global_state.try_cluster()
-        agent = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.node_agent",
-             "--address", f"127.0.0.1:{cluster.node_server_port}",
-             "--num-cpus", "4"],
-            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        agent, sched = _spawn_remote_agent(ray_tpu)
         try:
-            deadline = time.time() + 30
-            while len([x for x in ray_tpu.nodes() if x["Alive"]]) < 2:
-                assert time.time() < deadline, "agent never registered"
-                time.sleep(0.2)
-            remote_id = next(x["NodeID"] for x in ray_tpu.nodes()
-                             if x["Alive"] and x["Labels"].get("agent") == "remote")
-            sched = NodeAffinitySchedulingStrategy(node_id=remote_id)
             out["remote"] = suite(ray_tpu, np, sched=sched, n=1000,
                                   object_ops=False)
             out["remote"].update(transfer_suite(ray_tpu, np, sched))

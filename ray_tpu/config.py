@@ -161,6 +161,24 @@ _FLAGS: List[Flag] = [
          "(reference pull_manager.h admission control)."),
     Flag("transfer_max_pulls", "RAY_TPU_TRANSFER_MAX_PULLS", "int", 8,
          "Max concurrent pulls a node issues (and streams it serves)."),
+    Flag("transfer_stripe_threshold_bytes",
+         "RAY_TPU_TRANSFER_STRIPE_THRESHOLD_BYTES", "int", 8 * 1024 * 1024,
+         "Objects at or above this size pull as concurrent byte-range stripes "
+         "over pooled connections (0 disables striping). All stripes of one "
+         "pull share a single admission grant."),
+    Flag("transfer_stripes", "RAY_TPU_TRANSFER_STRIPES", "int", 4,
+         "Max concurrent range streams per striped pull."),
+    Flag("transfer_stripe_min_bytes", "RAY_TPU_TRANSFER_STRIPE_MIN_BYTES",
+         "int", 2 * 1024 * 1024,
+         "Never split a pull so finely that a stripe falls below this many "
+         "bytes (each stripe pays a request/admission handshake)."),
+    Flag("transfer_same_host_map", "RAY_TPU_TRANSFER_SAME_HOST_MAP", "bool",
+         True,
+         "When the source's shm/arena/spill location is directly readable "
+         "from the pulling process (source shares this machine's /dev/shm — "
+         "colocated node processes), map it in place instead of copying the "
+         "bytes over loopback TCP (reference: one plasma store per node). "
+         "The striped wire path is for genuinely-remote peers."),
     Flag("transfer_timeout_s", "RAY_TPU_TRANSFER_TIMEOUT_S", "float", 300.0,
          "Deadline for one direct object transfer before head-relay fallback."),
     Flag("transfer_stall_timeout_s", "RAY_TPU_TRANSFER_STALL_TIMEOUT_S", "float", 60.0,

@@ -14,6 +14,24 @@ authkey-authenticated length-prefixed framing as the control plane
 (multiprocessing.connection), but on a dedicated listener so bulk bytes never
 queue behind control traffic.
 
+Copy discipline (the whole point of this module's design):
+  server   read_fn may return a PinnedRead — a memoryview straight over the
+           shm/arena mapping, pinned so a concurrent spill/free cannot
+           invalidate it mid-transfer. Chunk frames are sent as slices of that
+           view; multiprocessing's framing writes large buffers straight from
+           the view (no staging copy).
+  client   pull(..., into=sink) lands chunk frames with recv_bytes_into
+           directly in a caller-provided buffer — typically the destination's
+           own pre-created shm segment — so a pulled object is sealed in place
+           with zero intermediate bytes objects.
+  stripes  objects whose size the caller already knows (store location tuples
+           carry it) split above CONFIG.transfer_stripe_threshold_bytes into
+           up to CONFIG.transfer_stripes byte ranges pulled concurrently over
+           pooled connections, using the same ("slice", loc, off, len) ranged
+           reads the ring collectives use. All stripes of one pull count as
+           ONE admission (one pull slot, total bytes), matching the reference
+           PullManager accounting.
+
 Protocol (one pull per connection at a time; connections are reused):
   client -> ("pull", loc)
   server -> ("ok", total_len, is_error) | ("err", message)
@@ -44,15 +62,110 @@ def _set_fd_timeouts(fd: int, seconds: float, send_only: bool = False) -> None:
     """SO_RCVTIMEO/SO_SNDTIMEO at the fd level: recv/send syscalls fail with
     EAGAIN after `seconds` of stall, so a half-dead peer cannot pin a puller
     thread (and its admission budget) forever. fd-level because
-    multiprocessing.Connection bypasses Python socket timeouts."""
+    multiprocessing.Connection bypasses Python socket timeouts.
+
+    Also sets TCP_NODELAY: every chunk frame is a tiny length-prefix write
+    followed by a bulk write, and Nagle holding the prefix back until the
+    previous bulk segment is ACKed serializes the stream at RTT granularity —
+    measured 2-4x throughput loss per stream on loopback."""
     s = socket.socket(fileno=os.dup(fd))
     try:
         tv = struct.pack("ll", int(seconds), int((seconds % 1) * 1_000_000))
         s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
         if not send_only:
             s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # non-TCP transport (unix socket test listeners)
     finally:
         s.close()
+
+
+class PinnedRead:
+    """A readable buffer a server read_fn hands the transport, pinned for the
+    transfer's lifetime.
+
+    `view` is a memoryview over the object's backing storage (shm segment,
+    arena mapping, mmap'd spill file): the server streams chunk-sized slices
+    of it with no staging copy. `release()` drops whatever pin keeps that
+    storage valid (an arena reader pin, the view itself for shm segments —
+    unlink/close defer while exported views exist) and is idempotent; the
+    server calls it once streaming ends, success or not, so a concurrent
+    spill_lru/free_local during a pull can never serve torn bytes."""
+
+    __slots__ = ("view", "is_error", "_release")
+
+    def __init__(self, view, is_error: bool = False,
+                 release: Optional[Callable[[], None]] = None):
+        self.view = view if isinstance(view, memoryview) else memoryview(view)
+        self.is_error = bool(is_error)
+        self._release = release
+
+    @property
+    def nbytes(self) -> int:
+        return self.view.nbytes
+
+    def release(self) -> None:
+        rel, self._release = self._release, None
+        try:
+            self.view.release()
+        except BufferError:
+            pass  # sub-slices still in flight keep the mapping alive
+        if rel is not None:
+            try:
+                rel()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "PinnedRead":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def _recv_frame_into(conn, mv: memoryview) -> int:
+    """Receive one length-prefixed frame straight into `mv`, returning its
+    size — the true recv-into the stdlib Connection lacks (its
+    recv_bytes_into stages the whole frame in a BytesIO and then copies).
+    Connection does no user-space read buffering, so reading the framing
+    header off the fd and readv'ing the payload directly into the destination
+    mapping is safe between its own recvs."""
+    if not isinstance(conn, Connection):
+        return conn.recv_bytes_into(mv)  # SecureConnection: real recv-into
+    fd = conn.fileno()
+
+    def read_exact(n: int) -> bytes:
+        b = bytearray()
+        while len(b) < n:
+            piece = os.read(fd, n - len(b))
+            if not piece:
+                raise EOFError("connection closed mid-frame")
+            b += piece
+        return bytes(b)
+
+    (size,) = struct.unpack("!i", read_exact(4))
+    if size == -1:  # extended header (frames over 2 GiB)
+        (size,) = struct.unpack("!Q", read_exact(8))
+    if size > mv.nbytes:
+        raise OSError(f"frame of {size} bytes exceeds buffer room ({mv.nbytes})")
+    got = 0
+    while got < size:
+        n = os.readv(fd, [mv[got:size]])
+        if n <= 0:
+            raise EOFError("connection closed mid-frame")
+        got += n
+    return size
+
+
+def _as_pinned(res) -> PinnedRead:
+    """Normalize a read_fn result: PinnedRead passes through, the legacy
+    (bytes, is_error) tuple gets wrapped (the bytes object itself is the pin)."""
+    if isinstance(res, PinnedRead):
+        return res
+    data, is_error = res
+    return PinnedRead(memoryview(data), is_error)
 
 
 class Admission:
@@ -61,14 +174,24 @@ class Admission:
     FIFO: requests admit in arrival order, so a full-budget pull (a huge
     object) cannot be starved indefinitely by a stream of small pulls slicing
     the budget out from under it — matching the reference PullManager's
-    in-order activation of pull requests."""
+    in-order activation of pull requests.
+
+    Wakeups are precise: release() (and a successful acquire, which may unblock
+    the next-in-line) notify the condition, so a freed budget admits the FIFO
+    head immediately instead of on the next poll tick. One coarse timeout
+    remains purely as a shutdown/leak guard — it never gates admission latency."""
+
+    # shutdown guard only: a waiter re-checks at least this often even if a
+    # notify was lost to an interpreter teardown; NOT an admission latency bound
+    _GUARD_TIMEOUT_S = 5.0
 
     def __init__(self, max_bytes: int, max_pulls: int):
         from collections import deque
 
         self.max_bytes = max(1, max_bytes)
         self._bytes = self.max_bytes
-        self._pulls = max(1, max_pulls)
+        self.max_pulls = max(1, max_pulls)
+        self._pulls = self.max_pulls
         self._cond = threading.Condition()
         self._queue: "deque" = deque()
 
@@ -80,7 +203,7 @@ class Admission:
         with self._cond:
             self._queue.append(me)
             while self._queue[0] is not me or self._pulls <= 0 or self._bytes < n:
-                self._cond.wait(timeout=1.0)
+                self._cond.wait(timeout=self._GUARD_TIMEOUT_S)
             self._queue.popleft()
             self._pulls -= 1
             self._bytes -= n
@@ -93,12 +216,21 @@ class Admission:
             self._bytes += n
             self._cond.notify_all()
 
+    def snapshot(self) -> Tuple[int, int]:
+        """(bytes_available, pull_slots_available) — test/diagnostic seam for
+        asserting the budget returned to full after failures."""
+        with self._cond:
+            return self._bytes, self._pulls
+
 
 class DataServer:
-    """Serves chunked object reads from this node's local store."""
+    """Serves chunked object reads from this node's local store.
+
+    read_fn(loc) returns either the legacy (bytes, is_error) tuple or a
+    PinnedRead whose view is streamed zero-copy (see module docstring)."""
 
     def __init__(self, authkey: bytes,
-                 read_fn: Callable[[Tuple], Tuple[bytes, bool]],
+                 read_fn: Callable[[Tuple], object],
                  host: str = "0.0.0.0", port: int = 0,
                  max_streams: Optional[int] = None):
         self._read_fn = read_fn
@@ -169,32 +301,39 @@ class DataServer:
                     conn.send_bytes(cloudpickle.dumps(("err", f"bad op {req[0]!r}")))
                     continue
                 # slot held from BEFORE the object read: at most
-                # transfer_max_pulls full in-memory copies exist on the source
-                # at once, even when a broadcast fans out to far more peers
-                # (otherwise N waiting-for-go connections = N copies = OOM)
+                # transfer_max_pulls streams exist on the source at once, even
+                # when a broadcast fans out to far more peers; with pinned
+                # reads a stream is a pinned mapping, not a full copy
                 with self._slots:
                     try:
-                        data, is_error = self._read_fn(req[1])
+                        pr = _as_pinned(self._read_fn(req[1]))
                     except BaseException as e:  # noqa: BLE001 — report, keep serving
                         conn.send_bytes(cloudpickle.dumps(("err", repr(e))))
                         continue
-                    conn.send_bytes(cloudpickle.dumps(("ok", len(data), is_error)))
-                    # the puller acquires admission between "ok" and "go", and
-                    # under contention that wait is legitimate (budget pinned by
-                    # other transfers) — so allow the full transfer deadline,
-                    # not just the stall bound, before declaring the puller
-                    # dead. This timeout is also the breaker for the theoretical
-                    # cross-node slot/admission wait cycle.
-                    if not conn.poll(CONFIG.transfer_timeout_s):
-                        break  # puller gone (or starved past the deadline)
-                    go = cloudpickle.loads(conn.recv_bytes())
-                    if go[0] != "go":
-                        break  # protocol desync: drop the connection
-                    view = memoryview(data)
-                    for off in range(0, len(data), chunk):
-                        conn.send_bytes(view[off:off + chunk])
-                    if not data:
-                        conn.send_bytes(b"")  # zero-length objects: one empty frame
+                    try:
+                        total = pr.nbytes
+                        conn.send_bytes(
+                            cloudpickle.dumps(("ok", total, pr.is_error)))
+                        # the puller acquires admission between "ok" and "go",
+                        # and under contention that wait is legitimate (budget
+                        # pinned by other transfers) — so allow the full
+                        # transfer deadline, not just the stall bound, before
+                        # declaring the puller dead. This timeout is also the
+                        # breaker for the theoretical cross-node slot/admission
+                        # wait cycle, and it bounds how long a pin can defer a
+                        # spill/free of the object being served.
+                        if not conn.poll(CONFIG.transfer_timeout_s):
+                            break  # puller gone (or starved past the deadline)
+                        go = cloudpickle.loads(conn.recv_bytes())
+                        if go[0] != "go":
+                            break  # protocol desync: drop the connection
+                        view = pr.view
+                        for off in range(0, total, chunk):
+                            conn.send_bytes(view[off:off + chunk])
+                        if not total:
+                            conn.send_bytes(b"")  # zero-length: one empty frame
+                    finally:
+                        pr.release()
         except (EOFError, OSError):
             pass
         finally:
@@ -209,6 +348,35 @@ class DataServer:
             self._listener.close()
         except Exception:
             pass
+
+
+def plan_stripes(size: Optional[int]) -> int:
+    """How many concurrent byte-range streams a pull of `size` bytes should
+    use. 1 (single-stream) when the size is unknown, below the stripe
+    threshold, or striping is disabled; otherwise up to CONFIG.transfer_stripes,
+    never so many that a stripe would shrink below transfer_stripe_min_bytes
+    (a handshake per stripe has to buy real overlap)."""
+    if size is None:
+        return 1
+    threshold = CONFIG.transfer_stripe_threshold_bytes
+    n = CONFIG.transfer_stripes
+    if threshold <= 0 or n <= 1 or size < threshold:
+        return 1
+    stripe_min = max(1, CONFIG.transfer_stripe_min_bytes)
+    return max(1, min(n, size // stripe_min))
+
+
+def stripe_ranges(total: int, n: int) -> List[Tuple[int, int]]:
+    """Split [0, total) into n even (offset, length) ranges (last takes the
+    remainder). Servers chunk any range length, so no alignment is needed."""
+    per = -(-total // n)  # ceil
+    ranges = []
+    off = 0
+    while off < total:
+        ln = min(per, total - off)
+        ranges.append((off, ln))
+        off += ln
+    return ranges
 
 
 class DataClient:
@@ -252,38 +420,75 @@ class DataClient:
             raise
         return conn
 
-    def _checkout(self, addr: Tuple[str, int]) -> Connection:
+    def _checkout(self, addr: Tuple[str, int]) -> Tuple[Connection, bool]:
+        """Returns (conn, from_pool). from_pool is recorded HERE, not sampled
+        by the caller beforehand: a concurrent puller can drain (or refill) the
+        pool between a peek and the checkout, and the stale-connection retry
+        must key on what this pull actually used."""
         with self._lock:
             free = self._pool.get(addr)
             if free:
-                return free.pop()
-        return self._dial(addr)
+                return free.pop(), True
+        return self._dial(addr), False
 
     def _checkin(self, addr: Tuple[str, int], conn: Connection) -> None:
         with self._lock:
             self._pool.setdefault(addr, []).append(conn)
 
-    def pull(self, addr: Tuple[str, int], loc: Tuple,
-             retry: bool = True) -> Tuple[bytes, bool]:
+    def pull(self, addr: Tuple[str, int], loc: Tuple, retry: bool = True,
+             into: Optional[Callable[[int, bool], memoryview]] = None,
+             size_hint: Optional[int] = None) -> Tuple[Optional[bytes], bool]:
         """Fetch the object at loc from the peer's data server, chunked and
-        admission-gated. A stale pooled connection (idle-TCP killed by NAT/
-        conntrack) gets ONE retry on a fresh dial; real failures raise
-        OSError/EOFError/TimeoutError (the caller decides whether to fall back
-        to head relay or reconstruct). Pass retry=False when the server-side
-        read is NOT idempotent (collective ring buffers count bytes read
-        toward retraction — a replayed range would double-count)."""
-        addr = (addr[0], int(addr[1]))
-        with self._lock:
-            had_pooled = bool(self._pool.get(addr))
-        try:
-            return self._pull_once(addr, loc)
-        except (OSError, EOFError, TimeoutError):
-            if not retry or not had_pooled:
-                raise
-            return self._pull_once(addr, loc)  # fresh dial (pool was drained)
+        admission-gated.
 
-    def _pull_once(self, addr: Tuple[str, int], loc: Tuple) -> Tuple[bytes, bool]:
-        conn = self._checkout(addr)
+        into: optional sink factory. Called once per attempt as
+        into(total_len, is_error) -> writable memoryview of exactly total_len
+        bytes; chunk frames land directly in it (recv_bytes_into — no
+        intermediate bytes) and pull returns (None, is_error). It may be called
+        again on a retry (same arguments) and must then return a buffer that is
+        safe to overwrite from offset 0.
+
+        size_hint: the object's frame size when the caller already knows it
+        (store location tuples carry it). Sizes at or above
+        CONFIG.transfer_stripe_threshold_bytes split into plan_stripes()
+        concurrent byte-range pulls — ("slice", loc, off, len) ranged reads —
+        that together count as ONE admission. Only pass it for locations the
+        server reads idempotently through a slice-aware read_fn
+        (object_store.read_pinned_any / read_raw_any).
+
+        A stale pooled connection (idle-TCP killed by NAT/conntrack) gets ONE
+        retry on a fresh dial; real failures raise OSError/EOFError/
+        TimeoutError (the caller decides whether to fall back to head relay or
+        reconstruct). Pass retry=False when the server-side read is NOT
+        idempotent (collective ring buffers count bytes read toward
+        retraction — a replayed range would double-count)."""
+        addr = (addr[0], int(addr[1]))
+        nstripes = plan_stripes(size_hint)
+        if nstripes > 1:
+            return self._pull_striped(addr, loc, int(size_hint), nstripes,
+                                      into, retry)
+        return self._pull_guarded(addr, loc, retry, into=into)
+
+    def _pull_guarded(self, addr, loc, retry, into=None, admitted_by_caller=False):
+        """One logical pull with the stale-pool retry: retries exactly when the
+        failing attempt ran on a pooled (possibly NAT-reaped) connection."""
+        try:
+            return self._pull_once(addr, loc, into=into,
+                                   admitted_by_caller=admitted_by_caller)
+        except _StalePooledConnection as e:
+            if not retry:
+                raise e.cause
+            return self._pull_once(addr, loc, into=into,
+                                   admitted_by_caller=admitted_by_caller,
+                                   fresh=True)
+
+    def _pull_once(self, addr: Tuple[str, int], loc: Tuple,
+                   into=None, admitted_by_caller=False,
+                   fresh: bool = False) -> Tuple[Optional[bytes], bool]:
+        if fresh:
+            conn, from_pool = self._dial(addr), False
+        else:
+            conn, from_pool = self._checkout(addr)
         admitted = 0
 
         def recv(timeout: float) -> bytes:
@@ -300,24 +505,51 @@ class DataClient:
             if hdr[0] != "ok":
                 raise OSError(f"data server {addr}: {hdr[1]}")
             total, is_error = int(hdr[1]), bool(hdr[2])
-            admitted = self._admission.acquire(total)
+            if not admitted_by_caller:
+                admitted = self._admission.acquire(total)
             conn.send_bytes(cloudpickle.dumps(("go",)))
-            buf = bytearray(total)
+            # destination buffer: sink factory (recv straight into the final
+            # shm mapping / a stripe's window of it), or a plain bytearray for
+            # the legacy bytes return
+            out = None
+            if into is not None:
+                try:
+                    mv = into(total, is_error)
+                except (OSError, EOFError, TimeoutError) as e:
+                    # deterministic local failure (e.g. a stripe range
+                    # mismatch from a stale recorded size), NOT a transport
+                    # error: a fresh-dial retry would fail identically
+                    e._rt_local_error = True
+                    raise
+            else:
+                out = bytearray(total)
+                mv = memoryview(out)
+            if mv.nbytes < total:
+                e = OSError(f"pull sink too small: {mv.nbytes} < {total} bytes")
+                e._rt_local_error = True
+                raise e
             got = 0
             first = True
             while got < total or total == 0:
                 # first chunk may wait behind the server's slot queue; later
                 # chunks stream continuously, so a long gap means a dead peer
-                frame = recv(CONFIG.transfer_timeout_s if first
-                             else CONFIG.transfer_stall_timeout_s)
+                if not conn.poll(CONFIG.transfer_timeout_s if first
+                                 else CONFIG.transfer_stall_timeout_s):
+                    raise TimeoutError(f"data server {addr} stalled")
                 first = False
                 if total == 0:
+                    conn.recv_bytes()
                     break
-                buf[got:got + len(frame)] = frame
-                got += len(frame)
+                got += _recv_frame_into(conn, mv[got:])
             self._checkin(addr, conn)
             conn = None
-            return bytes(buf), is_error
+            return (bytes(out) if out is not None else None), is_error
+        except (OSError, EOFError, TimeoutError) as e:
+            if from_pool and not getattr(e, "_rt_local_error", False):
+                # nothing landed yet that a fresh attempt can't redo: surface
+                # the provenance so _pull_guarded retries exactly once
+                raise _StalePooledConnection(e) from e
+            raise
         finally:
             if admitted:
                 self._admission.release(admitted)
@@ -326,6 +558,62 @@ class DataClient:
                     conn.close()
                 except Exception:
                     pass
+
+    def _pull_striped(self, addr, loc, total, nstripes, into, retry):
+        """Pull [0, total) as nstripes concurrent ranged sub-pulls. One
+        admission covers all stripes; any stripe failure aborts the pull (each
+        stripe still gets the single stale-pool retry — ranged store reads are
+        idempotent). The sink (or fallback bytearray) is shared: stripes write
+        disjoint ranges, so no ordering between them matters."""
+        ranges = stripe_ranges(total, nstripes)
+        admitted = self._admission.acquire(total)
+        out: Optional[bytearray] = None
+        sink_holder: Dict[str, memoryview] = {}
+        sink_lock = threading.Lock()
+        errors: List[BaseException] = []
+        is_error_box: List[bool] = [False]
+
+        def stripe_sink(range_off: int, range_len: int):
+            def make(rlen: int, is_err: bool):
+                # first header wins: allocate the full-object sink once, every
+                # stripe then writes its own disjoint window of it
+                with sink_lock:
+                    if "mv" not in sink_holder:
+                        if into is not None:
+                            sink_holder["mv"] = into(total, is_err)
+                        else:
+                            nonlocal out
+                            out = bytearray(total)
+                            sink_holder["mv"] = memoryview(out)
+                        is_error_box[0] = is_err
+                if rlen != range_len:
+                    raise OSError(
+                        f"striped pull range mismatch at +{range_off}: "
+                        f"server has {rlen}, expected {range_len}")
+                return sink_holder["mv"][range_off:range_off + range_len]
+            return make
+
+        def run(off: int, ln: int) -> None:
+            try:
+                self._pull_guarded(addr, ("slice", loc, off, ln), retry,
+                                   into=stripe_sink(off, ln),
+                                   admitted_by_caller=True)
+            except BaseException as e:  # noqa: BLE001 — joined + re-raised below
+                errors.append(e)
+
+        try:
+            threads = [threading.Thread(target=run, args=r, daemon=True,
+                                        name="rt-stripe") for r in ranges[1:]]
+            for t in threads:
+                t.start()
+            run(ranges[0][0], ranges[0][1])
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+            return (bytes(out) if out is not None else None), is_error_box[0]
+        finally:
+            self._admission.release(admitted)
 
     def close(self) -> None:
         with self._lock:
@@ -338,3 +626,11 @@ class DataClient:
                     pass
 
 
+class _StalePooledConnection(Exception):
+    """Internal marker: a pull attempt failed on a connection that came out of
+    the pool (so the failure may just be idle-TCP reaped by NAT/conntrack).
+    Carries the real transport error for callers that opt out of the retry."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause

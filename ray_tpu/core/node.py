@@ -482,6 +482,7 @@ class Cluster:
         self._recovering: set = set()  # oids with an in-flight reconstruction
         self._stack_dumps: Dict[str, Dict[str, str]] = {}  # token -> worker -> text
         self.store.on_free = self._on_object_freed
+        self.store.on_spill = self._on_object_spilled
         self._object_store_capacity = object_store_memory
         self.spill_dir = os.path.join(
             CONFIG.spill_dir,
@@ -556,7 +557,10 @@ class Cluster:
         from . import data_plane
 
         if self._data_server is None:
-            self._data_server = data_plane.DataServer(authkey, object_store.read_raw_any)
+            # read_pinned_any: chunk frames stream straight from the shm/arena
+            # mapping (pinned against spill/free) — no per-pull copy on the head
+            self._data_server = data_plane.DataServer(
+                authkey, object_store.read_pinned_any)
             self._data_client = data_plane.DataClient(authkey)
         return self.node_server_port
 
@@ -939,11 +943,13 @@ class Cluster:
                 raise object_store.ObjectLost(
                     f"object {oid.hex()[:12]} lives on dead node {src_host[:8]}")
         if dest_host == "local":
-            # the head itself needs the bytes: pull chunked from the source
+            # the head itself needs the bytes: striped zero-copy pull straight
+            # from the source's data server into this process's own backing
+            # (object_store.pull_to_store — no intermediate bytes object)
             if src_agent.data_addr is not None and self._data_client is not None:
                 try:
-                    data, is_error = self._data_client.pull(src_agent.data_addr, inner)
-                    return object_store.write_raw(data, oid, is_error)
+                    return object_store.pull_to_store(
+                        self._data_client, src_agent.data_addr, inner, oid)
                 except (OSError, EOFError, TimeoutError):
                     pass  # relay fallback below keeps the old error semantics
             data, is_error = self._relay_fetch(src_agent, inner, oid, src_host)
@@ -1832,6 +1838,18 @@ class Cluster:
                 f"{self.memory_usage_threshold:.0%})"))
 
     # -- lineage reconstruction --------------------------------------------------------
+    def _on_object_spilled(self, oid: ObjectID, old_loc) -> None:
+        """spill_lru moved a head-local object to disk: adopted same-host-map
+        replicas (pull_to_store shared the head's mapping instead of copying)
+        cache old_loc verbatim and now point at a deleted arena entry /
+        unlinked segment — drop them so the next use re-transfers from the
+        spilled primary instead of raising ObjectLost. Physical replica copies
+        live at their own locations and are untouched."""
+        with self._transfer_lock:
+            for key in [k for k, v in self._replicas.items()
+                        if k[0] == oid and v == old_loc]:
+                self._replicas.pop(key, None)
+
     def _on_object_freed(self, oid: ObjectID) -> None:
         """Drop the lineage entry, release its argument pins, free replicas."""
         with self._lock:

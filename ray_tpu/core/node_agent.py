@@ -94,7 +94,10 @@ class NodeAgent:
         # connection (reference object_manager.h:119)
         from . import data_plane, object_store
 
-        self._data_server = data_plane.DataServer(authkey, object_store.read_raw_any)
+        # read_pinned_any: served chunk frames are pinned views of the local
+        # shm/arena mapping, never a per-pull copy
+        self._data_server = data_plane.DataServer(
+            authkey, object_store.read_pinned_any)
         self._data_client = data_plane.DataClient(authkey)
         self._send_lock = threading.Lock()
         self._workers: Dict[str, Any] = {}   # wid_hex -> (proc, pipe)
@@ -426,8 +429,10 @@ class NodeAgent:
                 oid, src_loc, src_addr = args
                 if src_addr[0] is None:
                     src_addr = (self._head_host, src_addr[1])
-                data, is_error = self._data_client.pull(src_addr, src_loc)
-                value = object_store.write_raw(data, oid, is_error)
+                # striped zero-copy pull: bytes land directly in this node's
+                # pre-created arena/shm backing and seal in place
+                value = object_store.pull_to_store(
+                    self._data_client, src_addr, src_loc, oid)
             elif op == "gc_dead_owners":
                 (keep,) = args
                 arena = object_store._default_arena()

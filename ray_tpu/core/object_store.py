@@ -147,128 +147,307 @@ def materialize(obj: Any, oid: ObjectID, is_error: bool = False) -> Location:
     return ("shm", name, size, is_error)
 
 
-def read_raw(loc: Location) -> Tuple[bytes, bool]:
-    """Read an object's serialized frame bytes at a local location.
+from ray_tpu.core.data_plane import PinnedRead
 
-    Used by the cross-host object transfer path (reference ObjectManager chunked
-    push/pull, src/ray/object_manager/object_manager.h:119): the holding host
-    reads raw bytes, the requesting host writes them with write_raw. Returns
-    (frame_bytes, is_error)."""
+
+def read_pinned(loc: Location, offset: int = 0,
+                length: Optional[int] = None) -> PinnedRead:
+    """Zero-copy read: a PinnedRead whose view maps the object's frame bytes
+    (or the clamped [offset, offset+length) range of them) STRAIGHT from the
+    backing storage — no bytes materialized.
+
+    The view is pinned against concurrent spill_lru/free_local for its
+    lifetime: arena reads hold a C++ reader pin (delete defers the free to the
+    last unpin, shm_store.cc kCondemned), shm/disk reads hold the mapping
+    itself (unlink leaves live mappings valid; close defers while views are
+    exported). Callers MUST release() — the data plane does so when the
+    transfer ends, so a pull in flight can never observe torn bytes."""
+    if offset < 0 or (length is not None and length < 0):
+        raise ValueError(f"negative slice ({offset}, {length})")
     kind = loc[0]
+
+    def clamp(size: int) -> Tuple[int, int]:
+        end = size if length is None else min(offset + length, size)
+        return min(offset, size), end
+
     if kind == "inline":
-        return loc[1], loc[2]
+        _, frame, is_error = loc
+        start, end = clamp(len(frame))
+        return PinnedRead(memoryview(frame)[start:end], is_error)
     if kind == "arena":
         _, name, oid_bytes, size, is_error = loc
         arena = _open_arena(name)
-        view = arena.get(oid_bytes)
+        view = arena.get(oid_bytes)  # reader pin held until release()
         if view is None:
             raise ObjectLost(f"arena object {oid_bytes.hex()} was freed or lost")
-        try:
-            return bytes(view[:size]), is_error
-        finally:
-            view.release()
-            arena.unpin(oid_bytes)
+        start, end = clamp(size)
+
+        def unpin(v=view, a=arena, o=bytes(oid_bytes)):
+            try:
+                v.release()
+            except BufferError:
+                pass
+            a.unpin(o)
+
+        return PinnedRead(view[start:end], is_error, release=unpin)
     if kind == "shm":
         _, name, size, is_error = loc
         try:
             seg = _segment_cache.open(name)
         except FileNotFoundError:
             raise ObjectLost(f"shm segment {name} was freed or lost") from None
-        return bytes(memoryview(seg.buf)[:size]), is_error
+        start, end = clamp(size)
+        # the exported view IS the pin: a concurrent drop()/unlink leaves this
+        # mapping valid (close raises BufferError and the handle is parked)
+        return PinnedRead(memoryview(seg.buf)[start:end], is_error)
     if kind == "disk":
         _, path, size, is_error = loc
+        import mmap as _mmap
+
         try:
-            with open(path, "rb") as f:
-                return f.read(size), is_error
+            f = open(path, "rb")
         except OSError:
             raise ObjectLost(f"spilled object file {path} was lost") from None
+        try:
+            try:
+                m = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            except (ValueError, OSError):
+                raise ObjectLost(
+                    f"spilled object file {path} was lost") from None
+        finally:
+            f.close()
+        start, end = clamp(size)
+
+        def close_map(mm=m):
+            try:
+                mm.close()
+            except BufferError:
+                pass
+
+        return PinnedRead(memoryview(m)[start:end], is_error, release=close_map)
     raise ValueError(f"unknown location kind {kind!r}")
+
+
+def read_pinned_any(loc: Location) -> PinnedRead:
+    """Zero-copy data-plane read dispatcher (the read_fn node/agent DataServers
+    serve with): a plain location pins the whole frame, a
+    ``("slice", inner_loc, offset, length)`` wrapper pins only that byte range
+    — striped pulls and ring steps fetch range k of a large object without the
+    serving node copying anything out of shared memory."""
+    if loc and loc[0] == "slice":
+        _, inner, offset, length = loc
+        return read_pinned(inner, int(offset), int(length))
+    return read_pinned(loc)
+
+
+def read_raw(loc: Location) -> Tuple[bytes, bool]:
+    """Read an object's serialized frame bytes at a local location.
+
+    Materializing fallback for paths that need an owned bytes object (head
+    relay, agent fetch_object); the data plane itself streams read_pinned_any
+    views without this copy. Returns (frame_bytes, is_error)."""
+    if loc[0] == "inline":
+        return loc[1], loc[2]
+    with read_pinned(loc) as pr:
+        return bytes(pr.view), pr.is_error
 
 
 def read_raw_slice(loc: Location, offset: int, length: int) -> Tuple[bytes, bool]:
     """Read `length` bytes at `offset` of an object's serialized frame without
-    materializing (or copying) the rest of the object.
-
-    This is what lets a chunked transfer step — a data-plane pull of one
-    ring-collective chunk, a ranged cross-host fetch — move a byte range of a
-    large object without deserializing or even touching the whole frame:
-    arena/shm reads slice the shared mapping, disk reads seek. Out-of-range
-    requests are clamped to the frame (a zero-length tail read returns b"")."""
-    if offset < 0 or length < 0:
-        raise ValueError(f"negative slice ({offset}, {length})")
-    kind = loc[0]
-    if kind == "inline":
-        return bytes(loc[1][offset:offset + length]), loc[2]
-    if kind == "arena":
-        _, name, oid_bytes, size, is_error = loc
-        arena = _open_arena(name)
-        view = arena.get(oid_bytes)
-        if view is None:
-            raise ObjectLost(f"arena object {oid_bytes.hex()} was freed or lost")
-        try:
-            end = min(offset + length, size)
-            return bytes(view[min(offset, size):end]), is_error
-        finally:
-            view.release()
-            arena.unpin(oid_bytes)
-    if kind == "shm":
-        _, name, size, is_error = loc
-        try:
-            seg = _segment_cache.open(name)
-        except FileNotFoundError:
-            raise ObjectLost(f"shm segment {name} was freed or lost") from None
-        end = min(offset + length, size)
-        return bytes(memoryview(seg.buf)[min(offset, size):end]), is_error
-    if kind == "disk":
-        _, path, size, is_error = loc
-        try:
-            with open(path, "rb") as f:
-                f.seek(min(offset, size))
-                return f.read(max(0, min(offset + length, size) - offset)), is_error
-        except OSError:
-            raise ObjectLost(f"spilled object file {path} was lost") from None
-    raise ValueError(f"unknown location kind {kind!r}")
+    materializing (or copying) the rest of the object. Out-of-range requests
+    are clamped to the frame (a zero-length tail read returns b"")."""
+    with read_pinned(loc, offset, length) as pr:
+        return bytes(pr.view), pr.is_error
 
 
 def read_raw_any(loc: Location) -> Tuple[bytes, bool]:
-    """Data-plane read dispatcher: a plain location reads the whole frame, a
-    ``("slice", inner_loc, offset, length)`` wrapper reads only that byte
-    range (pullers use it to fetch chunk k of a large object without the
-    serving node copying the other chunks out of shared memory)."""
-    if loc and loc[0] == "slice":
+    """Materializing twin of read_pinned_any (legacy data-plane read fn)."""
+    with read_pinned_any(loc) as pr:
+        return bytes(pr.view), pr.is_error
+
+
+def loc_meta(loc: Location) -> Tuple[Optional[int], bool]:
+    """(frame_size, is_error) as recorded in a location tuple, without touching
+    the bytes — (None, False) when the location doesn't carry a size. Pullers
+    use the size to plan stripes BEFORE dialing and to pre-create the
+    destination mapping."""
+    kind = loc[0] if loc else None
+    if kind == "inline":
+        return len(loc[1]), loc[2]
+    if kind == "arena":
+        return loc[3], loc[4]
+    if kind in ("shm", "disk"):
+        return loc[2], loc[3]
+    if kind == "slice":
         _, inner, offset, length = loc
-        return read_raw_slice(inner, int(offset), int(length))
-    return read_raw(loc)
+        size, is_error = loc_meta(inner)
+        if size is None:
+            return None, is_error
+        start = min(int(offset), size)
+        return max(0, min(start + int(length), size) - start), is_error
+    return None, False
 
 
 def write_raw(data: bytes, oid: ObjectID, is_error: bool = False) -> Location:
     """Place already-serialized frame bytes locally (receiving side of a
-    cross-host transfer): arena first, per-object segment fallback."""
-    size = len(data)
+    cross-host transfer): create_raw's allocation policy (arena first,
+    per-object segment fallback), filled from an owned buffer and sealed."""
+    tgt = create_raw(oid, len(data))
+    try:
+        tgt.view[:len(data)] = data
+    except BaseException:
+        tgt.abort()
+        raise
+    return tgt.seal(is_error)
+
+
+class RawTarget:
+    """A pre-created local destination for an incoming object's frame bytes.
+
+    The receiving side of a zero-copy transfer: create_raw() allocates the
+    final backing (arena slot / shm segment / small-object buffer) BEFORE any
+    byte arrives, the data plane recv's chunk frames straight into `view`, and
+    seal() publishes the location — the pulled object is never staged in an
+    intermediate bytes object. abort() tears the allocation down if the
+    transfer fails (arena delete defers to any late reader unpin)."""
+
+    def __init__(self, kind: str, size: int, view: memoryview, *,
+                 arena=None, oid_bytes: bytes = b"", seg=None, name: str = ""):
+        self.kind = kind
+        self.size = size
+        self.view = view
+        self._arena = arena
+        self._oid_bytes = oid_bytes
+        self._seg = seg
+        self._name = name
+        self._done = False
+
+    def _release_view(self) -> None:
+        try:
+            self.view.release()
+        except BufferError:
+            pass
+
+    def seal(self, is_error: bool = False) -> Location:
+        if self._done:
+            raise RuntimeError("RawTarget already sealed or aborted")
+        self._done = True
+        if self.kind == "inline":
+            frame = bytes(self.view)
+            self._release_view()
+            return ("inline", frame, is_error)
+        if self.kind == "arena":
+            self._release_view()
+            self._arena.seal(self._oid_bytes)
+            if is_error:
+                self._arena.set_flags(self._oid_bytes, 1)
+            return ("arena", self._arena.name, self._oid_bytes, self.size,
+                    is_error)
+        self._release_view()
+        try:
+            self._seg.close()
+        except BufferError:
+            _unclosable_segments.append(self._seg)
+        return ("shm", self._name, self.size, is_error)
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._release_view()
+        if self.kind == "arena":
+            try:
+                self._arena.delete(self._oid_bytes)
+            except Exception:
+                pass
+        elif self.kind == "shm":
+            try:
+                self._seg.close()
+            except BufferError:
+                _unclosable_segments.append(self._seg)
+            except Exception:
+                pass
+            try:
+                shared_memory.SharedMemory(name=self._name).unlink()
+            except Exception:
+                pass
+
+
+def create_raw(oid: ObjectID, size: int) -> RawTarget:
+    """Allocate the local backing an incoming frame of `size` bytes will land
+    in (arena first, per-object segment fallback, plain buffer below the
+    inline threshold) — the write side of write_raw, split out so transfers
+    can fill it in place instead of handing over a finished bytes object."""
     if size < _inline_threshold():
-        return ("inline", bytes(data), is_error)
+        return RawTarget("inline", size, memoryview(bytearray(size)))
     arena = _default_arena()
     if arena is not None:
         buf = arena.create_object(oid.binary(), size)
         if buf is not None:
-            try:
-                buf[:size] = data
-            finally:
-                buf.release()
-            arena.seal(oid.binary())
-            if is_error:
-                arena.set_flags(oid.binary(), 1)
-            return ("arena", arena.name, oid.binary(), size, is_error)
-    # randomized suffix: the source side's materialize() segment for this oid may
-    # share this machine's /dev/shm namespace (same-host "multi-host" test
+            return RawTarget("arena", size, buf, arena=arena,
+                             oid_bytes=oid.binary())
+    # randomized suffix: the source side's materialize() segment for this oid
+    # may share this machine's /dev/shm namespace (same-host "multi-host" test
     # topology), so the deterministic name would collide
     name = "rt_" + oid.hex()[:16] + os.urandom(4).hex()
     seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+    return RawTarget("shm", size, memoryview(seg.buf)[:size], seg=seg, name=name)
+
+
+def try_map_local(loc: Location) -> bool:
+    """Probe whether `loc`'s backing storage is directly readable from THIS
+    process — true exactly when the "remote" source shares this machine's
+    shm/disk namespace (colocated node processes: head + agent on one host,
+    the single-host pod test topology). The successful probe leaves the
+    segment/arena handle cached, so later reads keep working even if the
+    source node later unlinks the name. Names are oid-derived + random, so a
+    cross-host name collision is not a practical concern."""
     try:
-        seg.buf[:size] = data
-    finally:
-        seg.close()
-    return ("shm", name, size, is_error)
+        pr = read_pinned(loc, 0, 0)
+    except (ObjectLost, OSError, ValueError, KeyError):
+        return False
+    pr.release()
+    return True
+
+
+def pull_to_store(client, addr, loc: Location, oid: ObjectID) -> Location:
+    """Destination side of a direct node-to-node transfer, zero-copy end to
+    end: plan stripes from the location's recorded size, pre-create the local
+    backing, land every chunk frame straight in it (DataClient recv-into), and
+    seal in place. Replaces the pull-bytes-then-write_raw two-copy dance on the
+    head and node-agent transfer routes.
+
+    Fully zero-byte fast path: when the source location is readable in place
+    (same-host topology, see try_map_local) the destination adopts it outright
+    — the mapping is shared, nothing moves, matching the local get path's
+    zero-copy semantics. Frees stay correct because both sides' free of the
+    same segment/arena entry is idempotent and only fires at global refcount
+    zero."""
+    from ray_tpu.config import CONFIG
+
+    if CONFIG.transfer_same_host_map and try_map_local(loc):
+        return loc
+    size, _ = loc_meta(loc)
+    cache: dict = {}
+
+    def sink(total: int, is_error: bool) -> memoryview:
+        tgt = cache.get("t")
+        if tgt is not None:
+            if tgt.size == total:
+                return tgt.view  # retry attempt: overwrite in place
+            tgt.abort()
+        tgt = create_raw(oid, total)
+        cache["t"] = tgt
+        return tgt.view
+
+    try:
+        _, is_error = client.pull(addr, loc, into=sink, size_hint=size)
+        return cache["t"].seal(is_error)
+    except BaseException:
+        tgt = cache.get("t")
+        if tgt is not None:
+            tgt.abort()
+        raise
 
 
 def free_local(loc: Location) -> None:
@@ -452,6 +631,11 @@ class ObjectStore:
         # callback(loc) for ("remote", host, inner) locations — the cluster
         # forwards the free to the hosting node agent (multi-host plane)
         self.on_remote_free = None
+        # callback(oid, old_loc) after spill_lru moves an object to disk:
+        # adopted same-host-map replicas (pull_to_store shares the source's
+        # mapping instead of copying) cache old_loc verbatim and must be
+        # invalidated — the arena entry / segment name they point at is gone
+        self.on_spill = None
 
     # -- directory -----------------------------------------------------------------
     def add(self, oid: ObjectID, loc: Location) -> None:
@@ -603,6 +787,11 @@ class ObjectStore:
                 except OSError:
                     pass
                 continue
+            if self.on_spill is not None:
+                try:
+                    self.on_spill(oid, loc)
+                except Exception:
+                    pass
             spilled += new_loc[2]
         return spilled
 

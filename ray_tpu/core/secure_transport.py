@@ -54,8 +54,15 @@ class SecureConnection:
 
     def send_bytes(self, buf) -> None:
         self._ensure_handshake()
-        data = bytes(buf)
-        self._sock.sendall(struct.pack("!I", len(data)) + data)
+        mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+        n = mv.nbytes
+        if n > 16384:
+            # large frames (data-plane chunks): header then the caller's buffer
+            # directly — no staging copy of the payload
+            self._sock.sendall(struct.pack("!I", n))
+            self._sock.sendall(mv)
+        else:
+            self._sock.sendall(struct.pack("!I", n) + bytes(mv))
 
     # mp.Connection.send pickles; the planes only use send/recv for small
     # control tuples (the device-plane handle hop), so mirror that here.
@@ -85,6 +92,26 @@ class SecureConnection:
         if maxlength is not None and size > maxlength:
             raise OSError(f"message too large ({size} > {maxlength})")
         return self._recv_exact(size)
+
+    def recv_bytes_into(self, buf, offset: int = 0) -> int:
+        """mp.Connection-compatible recv-into: the next frame lands directly in
+        `buf` (a writable buffer) at `offset` — the data plane uses this to
+        stream chunks straight into a destination shm mapping with no
+        intermediate bytes object."""
+        self._ensure_handshake()
+        (size,) = struct.unpack("!I", self._recv_exact(4))
+        mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+        mv = mv[offset:]
+        if size > mv.nbytes:
+            raise BufferError(
+                f"frame of {size} bytes exceeds buffer room ({mv.nbytes})")
+        got = 0
+        while got < size:
+            n = self._sock.recv_into(mv[got:], min(size - got, 1 << 20))
+            if n == 0:
+                raise EOFError("secure connection closed")
+            got += n
+        return size
 
     def poll(self, timeout: float = 0.0) -> bool:
         # A pending server-side handshake must not break poll's timeout

@@ -7,7 +7,6 @@ readers instead of being copied through the pickle stream.
 """
 from __future__ import annotations
 
-import io
 import pickle
 from dataclasses import dataclass
 from typing import Any, List, Sequence
@@ -33,17 +32,15 @@ class SerializedObject:
         return len(self.meta) + sum(b.raw().nbytes for b in self.buffers)
 
     def to_bytes(self) -> bytes:
-        """Flatten into one contiguous frame: [n][meta_len][meta][buf_len buf]*."""
-        out = io.BytesIO()
-        nbufs = len(self.buffers)
-        out.write(nbufs.to_bytes(4, "little"))
-        out.write(len(self.meta).to_bytes(8, "little"))
-        out.write(self.meta)
-        for b in self.buffers:
-            raw = b.raw()
-            out.write(raw.nbytes.to_bytes(8, "little"))
-            out.write(raw)
-        return out.getvalue()
+        """Flatten into one contiguous frame: [n][meta_len][meta][buf_len buf]*.
+
+        Preallocates the exact frame and fills it with write_into — no BytesIO
+        grow-and-copy churn. Large puts never even come here: materialize()
+        calls write_into straight on the arena/segment mapping (one copy
+        total); this covers inline-threshold frames and dumps()."""
+        out = bytearray(self.frame_bytes)
+        self.write_into(memoryview(out))
+        return bytes(out)
 
     def write_into(self, mv: memoryview) -> None:
         """Write the flattened frame into a preallocated buffer (e.g. shared memory)."""

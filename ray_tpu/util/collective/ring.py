@@ -161,9 +161,13 @@ class _BufStore:
                 b.avail = avail
                 self._cond.notify_all()
 
-    def read(self, key: str, offset: int, length: int, timeout: float) -> bytes:
+    def read(self, key: str, offset: int, length: int, timeout: float):
         """Read [offset, offset+length); length < 0 = the whole buffer.
-        Blocks until the range is available (publication IS the sync)."""
+        Blocks until the range is available (publication IS the sync).
+        Returns a memoryview of the published buffer — zero-copy to serve: a
+        range at or below `avail` is never rewritten (stream writers only
+        append), and retraction just drops the store's reference, which the
+        view outlives."""
         deadline = time.monotonic() + timeout
         with self._cond:
             # sweep here too: publish() alone can't reap a failed op's buffers
@@ -189,8 +193,8 @@ class _BufStore:
                         f"collective buffer {key!r} not published within {timeout}s")
                 self._cond.wait(min(left, 1.0))
 
-    def _take_locked(self, key: str, b: _Buf, offset: int, length: int) -> bytes:
-        out = bytes(memoryview(b.data)[offset:offset + length])
+    def _take_locked(self, key: str, b: _Buf, offset: int, length: int):
+        out = memoryview(b.data)[offset:offset + length]
         b.done += length
         if b.exp and b.done >= b.exp:
             self._bufs.pop(key, None)
@@ -276,21 +280,50 @@ class _Plane:
                                    retry=False)
         return data
 
+    def pull_into(self, addr, key: str, offset: int, length: int,
+                  out: memoryview, timeout: Optional[float] = None) -> Optional[int]:
+        """Pull [offset, offset+length) from a peer straight into `out` (a
+        writable memoryview of at least `length` bytes): chunk frames land via
+        recv-into with no intermediate bytes object. Returns the byte count,
+        or None on a bounded-probe miss (see _read) — nothing written then."""
+        if length == 0:
+            return 0
+        got: Dict[str, int] = {}
+
+        def sink(total: int, _is_err: bool) -> memoryview:
+            got["n"] = total
+            return out[:total]
+
+        loc = ("cbuf", key, int(offset), int(length))
+        if timeout is not None:
+            loc += (float(timeout),)
+        # retry=False: _BufStore reads count toward exp-based retraction, so a
+        # replayed range would double-count and retract the buffer early
+        self.client.pull((addr[0], int(addr[1])), loc, retry=False, into=sink)
+        n = got.get("n", 0)
+        if timeout is not None and n == 0:
+            return None
+        if n != length:
+            raise OSError(f"short collective pull of {key!r} from {addr}: "
+                          f"{n} != {length}")
+        return n
+
     def pull_range(self, addr, key: str, offset: int, length: int, out=None):
         """Pull [offset, offset+length) in transfer_chunk_bytes slices so the
         caller can overlap downstream compute with the remaining transfer and
         no single frame materializes more than one chunk. Fills `out`
-        (buffer-protocol writable) or returns a bytearray."""
+        (buffer-protocol writable, e.g. the destination ndarray's uint8 view)
+        or returns a bytearray; either way every chunk recv's directly into
+        the final buffer."""
         buf = out if out is not None else bytearray(length)
-        # numpy destinations need a frombuffer wrap: ndarray slice assignment
-        # treats a raw bytes RHS as a scalar, not a byte sequence
-        wrap = (lambda d: np.frombuffer(d, np.uint8)) \
-            if isinstance(buf, np.ndarray) else (lambda d: d)
+        mv = memoryview(buf)
+        if mv.format != "B" or not mv.c_contiguous:
+            mv = mv.cast("B")
         step = _chunk_bytes()
         pos = 0
         while pos < length:
             ln = min(step, length - pos)
-            buf[pos:pos + ln] = wrap(self.pull(addr, key, offset + pos, ln))
+            self.pull_into(addr, key, offset + pos, ln, mv[pos:pos + ln])
             pos += ln
         return buf
 
@@ -808,15 +841,17 @@ def broadcast(st, tensor, src_rank: int) -> np.ndarray:
         try:
             # bounded probe (see _Plane.pull): an upstream death that stalls
             # the parent's stream must not pin us inside one pull for the op
-            # timeout — the abort verdict has to win within ~one poll interval
-            data = plane.pull(parent_addr, f"{key}:bc", pos, ln,
-                              timeout=abort.interval)
+            # timeout — the abort verdict has to win within ~one poll interval.
+            # recv-into: the relayed chunk lands straight in the buffer the
+            # children stream out of, no staging bytes
+            n = plane.pull_into(parent_addr, f"{key}:bc", pos, ln,
+                                memoryview(buf)[pos:pos + ln],
+                                timeout=abort.interval)
         except (OSError, EOFError, TimeoutError) as e:
             abort.check(force=True, cause=e)
             raise
-        if data is None:
+        if n is None:
             continue  # range not relayed yet: re-probe abort, then re-ask
-        buf[pos:pos + ln] = data
         pos += ln
         if nchild:
             plane.store.advance(f"{key}:bc", pos)
