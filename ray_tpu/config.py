@@ -234,7 +234,11 @@ _FLAGS: List[Flag] = [
          0.1, "Min seconds between driver-side tqdm_ray re-renders."),
     # -- observability
     Flag("tracing", "RAY_TPU_TRACING", "bool", False,
-         "Enable OpenTelemetry-style span recording at init."),
+         "Enable OpenTelemetry-style span recording AND the hot-path "
+         "telemetry event recorder (util/telemetry.py) at init."),
+    Flag("telemetry_ring_size", "RAY_TPU_TELEMETRY_RING_SIZE", "int", 8192,
+         "Per-process telemetry ring-buffer capacity (events). Overflow drops "
+         "the oldest events and logs a throttled warning at flush."),
     Flag("usage_stats", "RAY_TPU_USAGE_STATS", "bool", False,
          "Record a local-only feature-usage summary in the session dir "
          "(never leaves the machine)."),

@@ -56,6 +56,42 @@ from typing import Callable, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu.config import CONFIG
+from ray_tpu.util import telemetry
+
+
+def _record_pull(nbytes: int, dt_s: float, nstripes: int, path: str,
+                 admission_wait_s: float) -> None:
+    """Per-logical-pull load signals: byte/second counters feed the live
+    GB/s figures in `ray-tpu status` / cluster_status(); the timeline event
+    (only when telemetry is on) carries the per-pull shape.
+
+    Zero-byte pulls are NOT recorded: the collective plane's bounded relay
+    probes (ring.py pull_into with a short timeout) legitimately return empty
+    when the range isn't published yet, and each miss would otherwise log a
+    fake pull carrying ~a poll interval of 0-byte 'transfer seconds' —
+    cratering the reported GB/s exactly when a rank is waiting."""
+    if nbytes <= 0:
+        return
+    tags = {"path": path}
+    telemetry.get_counter(
+        "transfer_bytes_total", "object bytes pulled over the data plane",
+        tag_keys=("path",)).inc(float(max(nbytes, 0)), tags=tags)
+    telemetry.get_counter(
+        "transfer_seconds_total", "wall seconds spent in data-plane pulls",
+        tag_keys=("path",)).inc(max(dt_s, 0.0), tags=tags)
+    telemetry.get_counter(
+        "transfer_pulls_total", "completed data-plane pulls",
+        tag_keys=("path",)).inc(1.0, tags=tags)
+    if admission_wait_s > 0:
+        telemetry.get_histogram(
+            "transfer_admission_wait_s",
+            "time pulls spent queued behind the admission byte budget").observe(
+            admission_wait_s)
+    if telemetry.enabled():
+        telemetry.event(
+            "transfer.pull", "transfer", bytes=int(nbytes), stripes=nstripes,
+            path=path, gbps=round(nbytes / dt_s / 1e9, 3) if dt_s > 0 else 0.0,
+            admission_wait_ms=round(admission_wait_s * 1e3, 3))
 
 
 def _set_fd_timeouts(fd: int, seconds: float, send_only: bool = False) -> None:
@@ -306,10 +342,12 @@ class DataServer:
                 # reads a stream is a pinned mapping, not a full copy
                 with self._slots:
                     try:
-                        pr = _as_pinned(self._read_fn(req[1]))
+                        res = self._read_fn(req[1])
                     except BaseException as e:  # noqa: BLE001 — report, keep serving
                         conn.send_bytes(cloudpickle.dumps(("err", repr(e))))
                         continue
+                    served_pinned = isinstance(res, PinnedRead)
+                    pr = _as_pinned(res)
                     try:
                         total = pr.nbytes
                         conn.send_bytes(
@@ -328,10 +366,25 @@ class DataServer:
                         if go[0] != "go":
                             break  # protocol desync: drop the connection
                         view = pr.view
+                        t_serve = time.perf_counter()
                         for off in range(0, total, chunk):
                             conn.send_bytes(view[off:off + chunk])
                         if not total:
                             conn.send_bytes(b"")  # zero-length: one empty frame
+                        if total > 0:  # relay-probe misses serve empty: skip
+                            path = "pinned" if served_pinned else "staged"
+                            telemetry.get_counter(
+                                "transfer_served_bytes_total",
+                                "object bytes streamed out by this data server",
+                                tag_keys=("path",)).inc(float(total),
+                                                        tags={"path": path})
+                            if telemetry.enabled():
+                                dt = time.perf_counter() - t_serve
+                                telemetry.event(
+                                    "transfer.serve", "transfer", bytes=total,
+                                    path=path,
+                                    gbps=round(total / dt / 1e9, 3) if dt > 0
+                                    else 0.0)
                     finally:
                         pr.release()
         except (EOFError, OSError):
@@ -380,10 +433,16 @@ def stripe_ranges(total: int, n: int) -> List[Tuple[int, int]]:
 
 
 class DataClient:
-    """Pulls objects from peer DataServers; one pooled connection set per peer."""
+    """Pulls objects from peer DataServers; one pooled connection set per peer.
 
-    def __init__(self, authkey: bytes):
+    stats_path labels this client's pulls in the transfer metrics/events:
+    "wire" for the object plane, "collective" for ring-collective planes —
+    without it, chunk pulls inside one allreduce would double-count as object
+    transfers in `ray-tpu status` and drown the timeline's transfer row."""
+
+    def __init__(self, authkey: bytes, stats_path: str = "wire"):
         self._authkey = authkey
+        self.stats_path = stats_path
         self._pool: Dict[Tuple[str, int], List[Connection]] = {}
         self._lock = threading.Lock()
         self._admission = Admission(CONFIG.transfer_inflight_bytes,
@@ -485,6 +544,8 @@ class DataClient:
     def _pull_once(self, addr: Tuple[str, int], loc: Tuple,
                    into=None, admitted_by_caller=False,
                    fresh: bool = False) -> Tuple[Optional[bytes], bool]:
+        t_start = time.perf_counter()
+        admission_wait = 0.0
         if fresh:
             conn, from_pool = self._dial(addr), False
         else:
@@ -506,7 +567,9 @@ class DataClient:
                 raise OSError(f"data server {addr}: {hdr[1]}")
             total, is_error = int(hdr[1]), bool(hdr[2])
             if not admitted_by_caller:
+                t_adm = time.perf_counter()
                 admitted = self._admission.acquire(total)
+                admission_wait = time.perf_counter() - t_adm
             conn.send_bytes(cloudpickle.dumps(("go",)))
             # destination buffer: sink factory (recv straight into the final
             # shm mapping / a stripe's window of it), or a plain bytearray for
@@ -543,6 +606,10 @@ class DataClient:
                 got += _recv_frame_into(conn, mv[got:])
             self._checkin(addr, conn)
             conn = None
+            if not admitted_by_caller:
+                # stripe sub-pulls are accounted once by _pull_striped
+                _record_pull(total, time.perf_counter() - t_start, 1,
+                             self.stats_path, admission_wait)
             return (bytes(out) if out is not None else None), is_error
         except (OSError, EOFError, TimeoutError) as e:
             if from_pool and not getattr(e, "_rt_local_error", False):
@@ -566,7 +633,9 @@ class DataClient:
         idempotent). The sink (or fallback bytearray) is shared: stripes write
         disjoint ranges, so no ordering between them matters."""
         ranges = stripe_ranges(total, nstripes)
+        t_start = time.perf_counter()
         admitted = self._admission.acquire(total)
+        admission_wait = time.perf_counter() - t_start
         out: Optional[bytearray] = None
         sink_holder: Dict[str, memoryview] = {}
         sink_lock = threading.Lock()
@@ -611,6 +680,8 @@ class DataClient:
                 t.join()
             if errors:
                 raise errors[0]
+            _record_pull(total, time.perf_counter() - t_start, nstripes,
+                         self.stats_path, admission_wait)
             return (bytes(out) if out is not None else None), is_error_box[0]
         finally:
             self._admission.release(admitted)

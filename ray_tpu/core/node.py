@@ -429,6 +429,10 @@ class Cluster:
         self.metrics_by_worker: Dict[Any, list] = {}
         self.task_events: deque = deque(maxlen=10000)
         self.trace_spans: deque = deque(maxlen=10000)
+        # merged hot-path telemetry events (util/telemetry.py): worker batches
+        # arrive clock-aligned (ts_ns += the batch's measured head-clock
+        # offset) and proc-tagged, so readers get ONE comparable timeline
+        self.telemetry_events: deque = deque(maxlen=50000)
         self.actors: Dict[ActorID, ActorState] = {}
         self.tasks: Dict[TaskID, TaskState] = {}
         self.pending: deque = deque()  # TaskSpecs waiting for dispatch
@@ -1132,6 +1136,14 @@ class Cluster:
         elif kind == "spans":
             with self._lock:  # readers iterate under the same lock (state.get_trace)
                 self.trace_spans.extend(msg[1])
+        elif kind == "telemetry":
+            # hot-path event batch (util/telemetry.py flush): clock-align and
+            # proc-tag here, once, so every reader sees one merged timeline
+            from ray_tpu.util import telemetry as _tel
+
+            aligned = _tel.align_batch(msg[1], f"worker-{w.worker_id.hex()[:8]}")
+            with self._lock:
+                self.telemetry_events.extend(aligned)
         elif kind == "kv":
             _, req_id, op = msg[:3]
             args = msg[3:]
@@ -2138,7 +2150,26 @@ class Cluster:
                         members.pop(rank, None)
                 if not members:
                     self._collective_members.pop(group, None)
+        counted_groups = set()
         for group, rank, epoch in dead:
+            # the head is the failure authority, so the abort counter + the
+            # timeline event live here: one increment per poisoned GROUP (a
+            # worker holding several ranks of one group dies once), not one
+            # per rank entry or per surviving observer
+            if group not in counted_groups:
+                counted_groups.add(group)
+                try:
+                    from ray_tpu.util import telemetry as _tel
+
+                    _tel.get_counter(
+                        "collective_aborts_total",
+                        "collective groups poisoned after a rank death",
+                        tag_keys=("group",)).inc(1.0, tags={"group": group})
+                    _tel.event("collective.abort", "collective", group=group,
+                               epoch=epoch, failed_rank=rank,
+                               reason=f"worker {w.worker_id.hex()[:8]} died")
+                except Exception:
+                    pass
             try:
                 coord = self.get_named_actor_handle(
                     f"coordinator.{group}", "ray_tpu.collective")
@@ -2450,6 +2481,13 @@ class DriverContext:
     def push_spans(self, spans: list) -> None:
         with self.cluster._lock:
             self.cluster.trace_spans.extend(spans)
+
+    def push_telemetry(self, batch: dict) -> None:
+        from ray_tpu.util import telemetry as _tel
+
+        with self.cluster._lock:
+            self.cluster.telemetry_events.extend(
+                _tel.align_batch(batch, "client-driver"))
 
     def push_tqdm(self, state: dict) -> None:
         from ray_tpu.experimental.tqdm_ray import _render_local

@@ -424,8 +424,20 @@ def pull_to_store(client, addr, loc: Location, oid: ObjectID) -> Location:
     same segment/arena entry is idempotent and only fires at global refcount
     zero."""
     from ray_tpu.config import CONFIG
+    from ray_tpu.util import telemetry
 
     if CONFIG.transfer_same_host_map and try_map_local(loc):
+        size, _ = loc_meta(loc)
+        telemetry.get_counter(
+            "transfer_bytes_total", "object bytes pulled over the data plane",
+            tag_keys=("path",)).inc(float(size or 0), tags={"path": "mapped"})
+        telemetry.get_counter(
+            "transfer_pulls_total", "completed data-plane pulls",
+            tag_keys=("path",)).inc(1.0, tags={"path": "mapped"})
+        if telemetry.enabled():
+            telemetry.event("transfer.pull", "transfer",
+                            bytes=int(size or 0), stripes=0, path="mapped",
+                            gbps=0.0, admission_wait_ms=0.0)
         return loc
     size, _ = loc_meta(loc)
     cache: dict = {}

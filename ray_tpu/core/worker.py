@@ -256,6 +256,11 @@ class WorkerContext:
         """One-way trace-span batch to the coordinator (util/tracing.py)."""
         self._send(("spans", spans))
 
+    def push_telemetry(self, batch: dict) -> None:
+        """One-way telemetry event batch ({clock_offset_ns, events}) to the
+        coordinator (util/telemetry.py flush thread)."""
+        self._send(("telemetry", batch))
+
     def push_tqdm(self, state: dict) -> None:
         """One-way progress-bar state to the coordinator (experimental/tqdm_ray.py)."""
         self._send(("tqdm", state))

@@ -13,7 +13,12 @@ Endpoints:
     GET /api/nodes|workers|actors|tasks|objects|placement_groups
     GET /api/logs           remote-worker log index
     GET /api/log?worker_id=&tail=  one worker's captured lines
-    GET /api/timeline       chrome-trace JSON
+    GET /api/timeline       chrome-trace JSON (finished tasks)
+    GET /api/telemetry_timeline  merged cross-worker chrome trace: hot-path
+                            telemetry spans (transfers/collectives/serve/
+                            train) + tasks, clock-aligned
+    GET /api/status         live load summary (transfer GB/s, collective
+                            ops/aborts, serve TTFT + queue depth, train MFU)
     GET /metrics            Prometheus exposition text
 """
 from __future__ import annotations
@@ -174,8 +179,15 @@ class Dashboard:
             name = request.match_info["name"]
             if name == "summary":
                 return web.json_response(st.summarize_cluster())
+            if name == "status":
+                # cluster load summary: transfer GB/s, collective ops/aborts,
+                # serve TTFT + queue depths, train MFU (util/state.cluster_status)
+                return web.json_response(st.cluster_status())
             if name == "timeline":
                 return web.json_response(st.timeline())
+            if name == "telemetry_timeline":
+                # merged cross-worker chrome trace (telemetry spans + tasks)
+                return web.json_response(st.telemetry_timeline())
             if name == "logs":
                 return web.json_response(st.list_logs())
             if name == "log":

@@ -84,6 +84,21 @@ _render_lock = threading.Lock()
 _last_rendered_uuid: list = [None]
 
 
+def ensure_newline() -> None:
+    """Finalize an in-progress bar line before other stderr output.
+
+    Bars re-render with a trailing "\\r", so the cursor normally sits ON the
+    bar line between updates; a logger/warning writing to stderr at that
+    moment (e.g. the telemetry ring-overflow warning) would splice into the
+    bar. Call this first: if a bar line is pending, it is closed with a
+    newline and the next bar update redraws on a fresh line."""
+    with _render_lock:
+        if _last_rendered_uuid[0] is not None:
+            sys.stderr.write("\n")
+            sys.stderr.flush()
+            _last_rendered_uuid[0] = None
+
+
 def _render_local(state: Dict[str, Any]) -> None:
     """Driver-side render. Concurrent bars interleave: when a different bar than
     the previous one renders, the old line is finalized with a newline first so

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.util import telemetry
+
 from .config import LLMConfig, SamplingParams
 from . import model_runner
 from .tokenizer import get_tokenizer
@@ -92,6 +94,16 @@ class _Request:
         # exhausted) re-prefills from this history so decoding continues exactly
         self.token_history: List[int] = list(prompt_ids)
         self.admitted_at = 0  # admission sequence number (preemption picks youngest)
+        # request-lifecycle telemetry (queue -> prefill -> decode spans, TTFT,
+        # tokens/s) + the prefix-cache evidence the Serve decode work needs:
+        # how many prompt tokens the cache served vs how many prefill computed
+        self.created_wall_ns = time.time_ns()
+        self.created_perf_ns = time.perf_counter_ns()
+        self.first_token_perf_ns = 0
+        self.queue_recorded = False
+        self.finish_recorded = False
+        self.prefix_hit_tokens = -1  # -1 = no paged prefill ran (yet)
+        self.prefill_tokens = 0  # tokens the model actually prefilled
 
 
 class JaxLLMEngine(LLMEngine):
@@ -575,6 +587,99 @@ class JaxLLMEngine(LLMEngine):
         except Exception:
             pass  # metrics must never take the engine down
 
+    # -- request-lifecycle telemetry ----------------------------------------------
+    def _model_tag(self) -> Dict[str, str]:
+        return {"model": str(self.config.model_id)}
+
+    @staticmethod
+    def _prefill_tokens_of(req: _Request) -> int:
+        """Tokens the model actually prefilled. Only the paged path tracks a
+        cached/computed split (prefix_hit_tokens >= 0); every other layout
+        prefills the whole prompt."""
+        if req.prefix_hit_tokens >= 0:
+            return req.prefill_tokens
+        return len(req.prompt_ids)
+
+    def _record_prefill(self, req: _Request, t_admit_perf: int) -> None:
+        """Prefill-phase signals, recorded once per successful admission:
+        latency, computed-vs-cached token counts, and the per-request
+        hit/miss evidence behind prefix_cache_ttft_speedup (why does the
+        cache win or lose? the spans now say).
+
+        Guarded like _export_metrics: these run inside the scheduler loop,
+        and metrics must never take the engine down."""
+        try:
+            self._record_prefill_inner(req, t_admit_perf)
+        except Exception:
+            pass
+
+    def _record_prefill_inner(self, req: _Request, t_admit_perf: int) -> None:
+        dur = time.perf_counter_ns() - t_admit_perf
+        tags = self._model_tag()
+        telemetry.get_histogram(
+            "llm_prefill_seconds", "engine prefill latency per admission",
+            tag_keys=("model",)).observe(dur / 1e9, tags=tags)
+        if req.prefix_hit_tokens >= 0:  # a paged prefill ran for this admission
+            name = ("llm_prefix_cache_hits_total" if req.prefix_hit_tokens > 0
+                    else "llm_prefix_cache_misses_total")
+            telemetry.get_counter(
+                name, "paged prefills that hit/missed the prefix cache",
+                tag_keys=("model",)).inc(1.0, tags=tags)
+        if telemetry.enabled():
+            telemetry.complete(
+                "llm.prefill", "llm",
+                req.created_wall_ns + (t_admit_perf - req.created_perf_ns),
+                dur, request_id=req.id, prompt_tokens=len(req.prompt_ids),
+                prefix_hit_tokens=max(req.prefix_hit_tokens, 0),
+                prefill_tokens=self._prefill_tokens_of(req),
+                cache_hit=req.prefix_hit_tokens > 0)
+
+    def _record_first_token(self, req: _Request) -> None:
+        req.first_token_perf_ns = time.perf_counter_ns()
+        try:
+            ttft_s = (req.first_token_perf_ns - req.created_perf_ns) / 1e9
+            telemetry.get_histogram(
+                "llm_ttft_seconds", "engine time-to-first-token",
+                tag_keys=("model",)).observe(ttft_s, tags=self._model_tag())
+        except Exception:
+            pass  # metrics must never take the engine down
+
+    def _record_finish(self, req: _Request) -> None:
+        if req.first_token_perf_ns == 0 or req.finish_recorded:
+            return
+        req.finish_recorded = True
+        try:
+            self._record_finish_inner(req)
+        except Exception:
+            pass  # metrics must never take the engine down
+
+    def _record_finish_inner(self, req: _Request) -> None:
+        now = time.perf_counter_ns()
+        decode_ns = now - req.first_token_perf_ns
+        decode_s = decode_ns / 1e9
+        # decode throughput = tokens AFTER the first / decode time: dividing
+        # by the full lifetime would fold queue+prefill in and understate the
+        # engine exactly when it is loaded. Single-token requests have no
+        # decode phase to rate.
+        rate = ((req.generated - 1) / decode_s
+                if decode_s > 0 and req.generated > 1 else None)
+        if rate is not None:
+            telemetry.get_histogram(
+                "llm_tokens_per_s", "per-request decode throughput",
+                tag_keys=("model",),
+                boundaries=[1, 5, 10, 25, 50, 100, 250, 500, 1000]).observe(
+                rate, tags=self._model_tag())
+        if telemetry.enabled():
+            wall_first = req.created_wall_ns + (req.first_token_perf_ns
+                                                - req.created_perf_ns)
+            telemetry.complete(
+                "llm.decode", "llm", wall_first, decode_ns,
+                request_id=req.id, generated=req.generated,
+                prompt_tokens=len(req.prompt_ids),
+                prefix_hit_tokens=max(req.prefix_hit_tokens, 0),
+                prefill_tokens=self._prefill_tokens_of(req),
+                tokens_per_s=round(rate, 2) if rate is not None else 0.0)
+
     # -- scheduler loop ------------------------------------------------------------
     def _free_slots(self) -> List[int]:
         free = [s for s, r in self._active.items() if r is None]
@@ -602,6 +707,19 @@ class JaxLLMEngine(LLMEngine):
             # visible to the loop's crash handler: this request is in neither
             # _waiting nor _active right now, and must still be failed on error
             self._admitting = req
+            t_admit_perf = time.perf_counter_ns()
+            if not req.queue_recorded:
+                # queue span: creation to FIRST admission attempt, once — a
+                # request requeued on pool exhaustion (or preempted) must not
+                # emit a later, longer llm.queue span. Marked even when
+                # telemetry is off, so mid-flight enabling can't fabricate
+                # queue time that includes a previous admission's decode.
+                req.queue_recorded = True
+                if telemetry.enabled():
+                    telemetry.complete(
+                        "llm.queue", "llm", req.created_wall_ns,
+                        t_admit_perf - req.created_perf_ns, request_id=req.id,
+                        prompt_tokens=len(req.prompt_ids))
             p = req.params
             if req.prefill_kv is not None:
                 # P/D disaggregation: KV computed by a prefill replica; install it
@@ -642,6 +760,7 @@ class JaxLLMEngine(LLMEngine):
                     jnp.int32(len(req.prompt_ids)), jnp.int32(slot), cfg,
                 )
                 tok = self._sample_one(last_logits, p)
+            self._record_prefill(req, t_admit_perf)
             req.slot = slot
             req.admitted_at = next(self._admission_counter)
             self._active[slot] = req
@@ -725,6 +844,10 @@ class JaxLLMEngine(LLMEngine):
         chunk = self.config.prefill_chunk
         chunked = bool(chunk and n > chunk)
         cached_ids = self._blocks.match_prefix(slot, prompt)
+        # telemetry groundwork for the prefix-cache speedup mystery: record
+        # what the cache SERVED vs what the model computed, per request
+        req.prefix_hit_tokens = len(cached_ids) * self.config.kv_block_size
+        req.prefill_tokens = n - req.prefix_hit_tokens
         if cached_ids:
             suffix_len = n - len(cached_ids) * self.config.kv_block_size
             if not chunk or suffix_len <= chunk:
@@ -733,6 +856,7 @@ class JaxLLMEngine(LLMEngine):
             # suffix still too long for one pass: fall back to chunked prefill
             # (no context support there yet) but release the attached prefix
             self._blocks.release(slot)
+            req.prefix_hit_tokens, req.prefill_tokens = 0, n  # cache unused
         chunked = bool(chunk and n > chunk)
         # cheap pre-check before running the model (the padded length is at most
         # one bucket/chunk above n, so needed here is exact)
@@ -859,6 +983,8 @@ class JaxLLMEngine(LLMEngine):
     def _emit(self, req: _Request, tok: int) -> None:
         req.generated += 1
         req.token_history.append(tok)
+        if req.first_token_perf_ns == 0:
+            self._record_first_token(req)
         self.total_generated += 1
         stops = req.params.stop_token_ids or [self.tokenizer.eos_token_id]
         finished, reason = False, None
@@ -877,6 +1003,7 @@ class JaxLLMEngine(LLMEngine):
             self._release(req)
 
     def _release(self, req: _Request) -> None:
+        self._record_finish(req)
         if req.slot >= 0:
             if self.config.kv_layout == "paged":
                 self._blocks.release(req.slot)

@@ -108,15 +108,82 @@ def cmd_stop(args) -> int:
     return 0
 
 
+def _render_status(s: dict) -> str:
+    """Human-facing render of util/state.cluster_status(): one short block per
+    subsystem, omitting rows with no signal yet."""
+    lines = []
+    c = s.get("cluster", {})
+    lines.append(f"cluster    nodes={c.get('nodes')} workers={c.get('workers')} "
+                 f"actors={c.get('actors')} pending_tasks={c.get('pending_tasks')}")
+    tr = s.get("transfer", {})
+    for path, row in sorted(tr.items()):
+        gbps = f"{row['gbps']:.2f} GB/s" if row.get("gbps") is not None else "-"
+        lines.append(f"transfer   [{path}] pulls={row['pulls']} "
+                     f"bytes={row['bytes']:,} rate={gbps}")
+    col = s.get("collective", {})
+    if col.get("ops") or col.get("aborts"):
+        ops = " ".join(f"{k}={v}" for k, v in sorted(col.get("ops", {}).items()))
+        lines.append(f"collective ops: {ops or '-'}  aborts={col.get('aborts', 0)} "
+                     f"observed={col.get('aborts_observed', 0)} "
+                     f"epoch_rollovers={col.get('epoch_rollovers', 0)}")
+    sv = s.get("serve", {})
+    if sv.get("requests") or sv.get("queue_depth"):
+        def ms(v):
+            return f"{v * 1e3:.1f}ms" if v is not None else "-"
+
+        depth = " ".join(f"{k}:{int(v)}" for k, v in sorted(
+            sv.get("queue_depth", {}).items()))
+        lines.append(f"serve      requests={sv.get('requests', 0)} "
+                     f"ttft_p50={ms(sv.get('ttft_p50_s'))} "
+                     f"ttft_p99={ms(sv.get('ttft_p99_s'))} "
+                     f"queue_depth[{depth or '-'}]")
+    llm = s.get("llm", {})
+    if llm.get("prefix_cache_hits") or llm.get("active") or llm.get("pending"):
+        lines.append(f"llm        pending={llm.get('pending')} "
+                     f"active={llm.get('active')} "
+                     f"prefix_cache hit/miss="
+                     f"{llm.get('prefix_cache_hits', 0)}/"
+                     f"{llm.get('prefix_cache_misses', 0)}")
+    tn = s.get("train", {})
+    if tn.get("mfu") or tn.get("step_phases_s"):
+        mfu = " ".join(f"{k}:{v:.3f}" for k, v in sorted(tn.get("mfu", {}).items()))
+        phases = " ".join(f"{k}:{v * 1e3:.1f}ms"
+                          for k, v in sorted(tn.get("step_phases_s", {}).items()))
+        lines.append(f"train      mfu[{mfu or '-'}] step_phases[{phases or '-'}]")
+    return "\n".join(lines)
+
+
 def cmd_status(args) -> int:
+    """Head-session info plus — when a cluster is reachable (in-process or via
+    --address) — the live telemetry summary: per-path transfer GB/s,
+    collective ops/aborts, serve TTFT p50/p99 + queue depths, train MFU."""
+    import ray_tpu
+
+    rc = 0
     try:
         with open(_session_file()) as f:
             info = json.load(f)
         print(json.dumps(info, indent=2))
     except FileNotFoundError:
         print("no head session; run `ray-tpu start`")
-        return 1
-    return 0
+        rc = 1
+    if getattr(args, "address", None):
+        try:
+            ray_tpu.init(address=args.address)
+        except Exception as e:  # noqa: BLE001 — keep the session-info contract
+            print(f"(could not reach {args.address}: {e!r})", file=sys.stderr)
+    if ray_tpu.is_initialized():
+        from ray_tpu.util import state as rs
+
+        print(_render_status(rs.cluster_status()))
+    else:
+        # stderr: standalone `ray-tpu status` must keep stdout pure JSON for
+        # scripts that parse the session info
+        print("(no live cluster for a load summary: pass --address "
+              "ray-tpu://host:port or run inside a driver)", file=sys.stderr)
+    # rc reflects the head session (the original `status` contract) — a live
+    # in-process cluster adds the load summary but doesn't fake a session
+    return rc
 
 
 def cmd_submit(args) -> int:
@@ -390,7 +457,12 @@ def main(argv=None) -> int:
     sp.add_argument("-o", "--output", default="ray_tpu_profile.json")
     sp.set_defaults(fn=cmd_profile)
 
-    sp = sub.add_parser("status", help="show head session")
+    sp = sub.add_parser("status", help="show head session + live load summary "
+                        "(transfer GB/s, collective ops/aborts, serve TTFT, "
+                        "train MFU)")
+    sp.add_argument("--address", default=None,
+                    help="connect as a client driver for the live summary, "
+                         "e.g. ray-tpu://127.0.0.1:10001")
     sp.set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("submit", help="run a python script as a job")

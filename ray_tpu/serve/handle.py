@@ -13,6 +13,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.util import telemetry
 
 from .controller import CONTROLLER_NAME
 
@@ -200,6 +201,10 @@ class _LongPollClient:
                         entry.replicas = snapshot
 
 
+# process-wide in-flight accounting behind the serve_queue_depth gauge
+_inflight_lock = threading.Lock()
+_inflight_by_dep: Dict[tuple, int] = {}
+
 _long_poll_client = _LongPollClient()
 _lp_registry = _long_poll_client.entries  # introspection/tests
 
@@ -275,6 +280,31 @@ class DeploymentHandle:
             router._metrics_thread = threading.Thread(target=push, daemon=True)
             router._metrics_thread.start()
 
+    def _adjust_queue_depth(self, delta: int) -> None:
+        """Live load signal for routing/autoscaling and `ray-tpu status`.
+
+        Accounting is PROCESS-wide per deployment (not per router): several
+        handles to one deployment in one process would otherwise last-write
+        each other's gauge. The `proc` tag keeps each process's value distinct
+        through the gauge merge (which is last-write per tag set), so
+        cluster_status can SUM them into the true cluster-wide depth."""
+        key = (self.app_name, self.deployment_name)
+        with _inflight_lock:
+            n = max(0, _inflight_by_dep.get(key, 0) + delta)
+            _inflight_by_dep[key] = n
+        try:
+            import os as _os
+
+            telemetry.get_gauge(
+                "serve_queue_depth",
+                "in-flight handle requests (per deployment, per process)",
+                tag_keys=("app", "deployment", "proc")).set(
+                float(n), tags={"app": self.app_name,
+                                "deployment": self.deployment_name,
+                                "proc": str(_os.getpid())})
+        except Exception:
+            pass  # load signals must never fail a request
+
     # -- public ----------------------------------------------------------------
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
@@ -313,6 +343,8 @@ class DeploymentHandle:
             self._last_refresh = 0.0  # force re-poll
         replica = self._router.pick(self._replicas, self._multiplexed_model_id or None)
         self._router.on_send(replica)
+        self._adjust_queue_depth(+1)
+        t0_wall, t0_perf = time.time_ns(), time.perf_counter_ns()
         if self._multiplexed_model_id:
             from .multiplex import MULTIPLEX_KWARG
 
@@ -326,6 +358,7 @@ class DeploymentHandle:
             ref = method.remote(self._method, args, kwargs)
         except Exception:
             self._router.on_done(replica)
+            self._adjust_queue_depth(-1)  # the send never happened
             raise
 
         done_ref = ref.completed if self._stream else ref
@@ -339,6 +372,19 @@ class DeploymentHandle:
                 pass
             finally:
                 self._router.on_done(replica)
+                self._adjust_queue_depth(-1)
+                dur = time.perf_counter_ns() - t0_perf
+                telemetry.get_histogram(
+                    "serve_request_seconds",
+                    "handle-call latency (send to completion)",
+                    tag_keys=("app", "deployment")).observe(
+                    dur / 1e9, tags={"app": self.app_name,
+                                     "deployment": self.deployment_name})
+                if telemetry.enabled():
+                    telemetry.complete(
+                        "serve.request", "serve", t0_wall, dur,
+                        app=self.app_name, deployment=self.deployment_name,
+                        method=self._method, stream=self._stream)
 
         threading.Thread(target=_done_watcher, daemon=True).start()
         return resp

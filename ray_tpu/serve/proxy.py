@@ -11,12 +11,23 @@ import asyncio
 import concurrent.futures
 import json
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.util import telemetry
 
 from .controller import CONTROLLER_NAME
 from .handle import DeploymentHandle
+
+
+def _observe_ttft(route: str, seconds: float) -> None:
+    """Time-to-first-byte at the ingress: first stream chunk for SSE requests,
+    the full response for unary ones — the p50/p99 rows in `ray-tpu status`
+    and the SLO input for autoscaling."""
+    telemetry.get_histogram(
+        "serve_ttft_seconds", "HTTP ingress time-to-first-token/response",
+        tag_keys=("route",)).observe(seconds, tags={"route": route})
 
 
 class ProxyActor:
@@ -52,6 +63,7 @@ class ProxyActor:
         asyncio.set_event_loop(loop)
 
         async def handler(request: "web.Request") -> "web.Response":
+            t0_wall, t0_perf = time.time_ns(), time.perf_counter_ns()
             self._refresh_routes()
             m = self._match(request.path)
             if m is None:
@@ -116,6 +128,8 @@ class ProxyActor:
                         gen = await loop.run_in_executor(stream_exec, start_stream)
                         pull = make_pull(gen)
                         first = await loop.run_in_executor(stream_exec, pull)
+                        _observe_ttft(prefix,
+                                      (time.perf_counter_ns() - t0_perf) / 1e9)
                         # "stream": true is an OpenAI convention; a deployment
                         # that returned one plain JSON value was not actually
                         # streaming — answer with ordinary JSON instead of a
@@ -161,6 +175,11 @@ class ProxyActor:
                         except Exception:  # noqa: BLE001 — socket already closed
                             pass
                     await resp.write_eof()
+                    if telemetry.enabled():
+                        telemetry.complete(
+                            "serve.http", "serve", t0_wall,
+                            time.perf_counter_ns() - t0_perf,
+                            route=prefix, method=request.method, stream=True)
                     return resp
                 finally:
                     if gen is not None:
@@ -174,6 +193,12 @@ class ProxyActor:
                 result = await loop.run_in_executor(None, call)
             except Exception as e:  # noqa: BLE001 - surface as 500
                 return web.Response(status=500, text=repr(e))
+            _observe_ttft(prefix, (time.perf_counter_ns() - t0_perf) / 1e9)
+            if telemetry.enabled():
+                telemetry.complete(
+                    "serve.http", "serve", t0_wall,
+                    time.perf_counter_ns() - t0_perf,
+                    route=prefix, method=request.method, stream=False)
             from .asgi import RAW_RESPONSE_KEY
 
             if isinstance(result, dict) and result.get(RAW_RESPONSE_KEY):

@@ -50,6 +50,7 @@ from .session import (  # noqa: F401
     get_context,
     get_dataset_shard,
     report,
+    step_phase,
 )
 from .step import TrainState, init_state, make_optimizer, make_train_step  # noqa: F401
 from .v2 import (  # noqa: F401  (Train v2: controller + policies, SURVEY §2.4)

@@ -99,17 +99,20 @@ class JaxBackend(Backend):
 
         if backend_config.collective_group:
             from ray_tpu.util import collective as col
+            from ray_tpu.util import telemetry
 
             # Clear any stale coordinator (e.g. from a crashed prior generation of this
             # run) so the new generation's sequence numbers start on clean boards.
-            col.kill_coordinator(group_name)
-            col.create_collective_group(
-                worker_group.workers,
-                len(worker_group),
-                list(range(len(worker_group))),
-                backend="shm",
-                group_name=group_name,
-            )
+            with telemetry.span("train.collective_init", "train",
+                                group=group_name, world=len(worker_group)):
+                col.kill_coordinator(group_name)
+                col.create_collective_group(
+                    worker_group.workers,
+                    len(worker_group),
+                    list(range(len(worker_group))),
+                    backend="shm",
+                    group_name=group_name,
+                )
 
     def on_failure(self, worker_group: WorkerGroup, backend_config: JaxConfig,
                    error: BaseException) -> None:
@@ -123,7 +126,15 @@ class JaxBackend(Backend):
         the group restart is not pinned behind collective_op_timeout_s."""
         if backend_config.collective_group and backend_config.collective_group_name:
             from ray_tpu.util import collective as col
+            from ray_tpu.util import telemetry
 
+            telemetry.get_counter(
+                "train_group_failures_total",
+                "training worker-group failures that poisoned the run's "
+                "collective group").inc()
+            telemetry.event("train.abort", "train",
+                            group=backend_config.collective_group_name,
+                            reason=str(error)[:200])
             # wait=False: on_failure must not block on the (possibly half-
             # dead) group — a wedged coordinator host would otherwise pin the
             # restart behind the op timeout, the exact stall this hook exists

@@ -10,13 +10,17 @@ directory immediately after reporting.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import queue
 import shutil
 import threading
+import time
 import uuid
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.util import telemetry
 
 from .checkpoint import Checkpoint
 
@@ -104,7 +108,33 @@ class _TrainSession:
                                     f"staged_{uuid.uuid4().hex[:12]}")
             storage.persist_dir(checkpoint.path, dest)
             checkpoint = Checkpoint(dest)
+        self._record_report(metrics)
         self.results.put({"metrics": metrics, "checkpoint": checkpoint})
+
+    def _record_report(self, metrics: Dict[str, Any]) -> None:
+        """Train load signals: an MFU gauge whenever the loop reports one
+        (bench.py's trainer path does), plus a timeline event per report."""
+        try:
+            tags = {"rank": str(self.context.world_rank)}
+            mfu = metrics.get("mfu")
+            if isinstance(mfu, (int, float)):
+                telemetry.get_gauge(
+                    "train_mfu", "model FLOPs utilization reported by the "
+                    "training loop", tag_keys=("rank",)).set(float(mfu),
+                                                             tags=tags)
+            tps = metrics.get("tokens_per_sec")
+            if isinstance(tps, (int, float)):
+                telemetry.get_gauge(
+                    "train_tokens_per_s", "training tokens/s reported by the "
+                    "training loop", tag_keys=("rank",)).set(float(tps),
+                                                             tags=tags)
+            if telemetry.enabled():
+                telemetry.event(
+                    "train.report", "train", rank=self.context.world_rank,
+                    **{k: v for k, v in metrics.items()
+                       if isinstance(v, (int, float, str, bool))})
+        except Exception:
+            pass  # telemetry must never fail a report
 
     def drain(self, max_items: Optional[int] = None) -> list:
         out = []
@@ -148,6 +178,28 @@ def get_checkpoint() -> Optional[Checkpoint]:
     if s is None:
         raise RuntimeError("ray_tpu.train.get_checkpoint() called outside a training worker")
     return s.starting_checkpoint
+
+
+@contextlib.contextmanager
+def step_phase(name: str):
+    """Time one phase of a training step — the step-composition breakdown
+    (`data` / `forward_backward` / `allreduce` / `optimizer`) behind the
+    train row of `ray-tpu status` and the chrome-trace timeline.
+
+    Usage inside a train loop:
+        with train.step_phase("forward_backward"):
+            loss, grads = value_and_grad(...)
+
+    Works outside a session too (bench scripts): rank then reports as -1."""
+    s = _get_session()
+    rank = s.context.world_rank if s is not None else -1
+    t0 = time.perf_counter()
+    with telemetry.span(f"train.phase.{name}", "train", rank=rank):
+        yield
+    telemetry.get_histogram(
+        "train_step_phase_seconds", "per-phase training step time",
+        tag_keys=("phase",)).observe(time.perf_counter() - t0,
+                                     tags={"phase": name})
 
 
 def get_dataset_shard(dataset_name: str = "train"):

@@ -23,8 +23,8 @@ _FORWARDED = {
 }
 # fire-and-forget: callable from __del__/GC finalizers (possibly ON the recv
 # thread), so they must never wait for a response or touch the socket directly
-_NO_REPLY = {"decref", "kill_actor", "push_metrics", "push_spans", "push_tqdm",
-             "drop_stream"}
+_NO_REPLY = {"decref", "kill_actor", "push_metrics", "push_spans",
+             "push_telemetry", "push_tqdm", "drop_stream"}
 
 
 class ClientContext:

@@ -356,6 +356,47 @@ def _state(group_name: str) -> _GroupState:
 _reduce = ring.reduce_parts
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _instrumented(op: str, st: _GroupState, tensor):
+    """Per-op load signals: ops/aborts counters + per-op latency histogram
+    (the `ray-tpu status` collective row), and — when telemetry is on — one
+    timeline span per op; ring.py adds the phase sub-spans inside it."""
+    from ray_tpu.core.exceptions import CollectiveAbortError
+    from ray_tpu.util import telemetry
+
+    # getattr, NOT np.asarray: asarray on an XLA-backend device array would
+    # force a blocking device->host copy of the whole tensor per op just to
+    # label a span; numpy and jax arrays both expose nbytes directly
+    nbytes = int(getattr(tensor, "nbytes", 0) or 0) if tensor is not None else 0
+    t0 = time.perf_counter()
+    try:
+        with telemetry.span(f"collective.{op}", "collective", group=st.name,
+                            rank=st.rank, world=st.world_size, bytes=nbytes):
+            yield
+    except CollectiveAbortError as e:
+        # the head counts one abort per poisoned group; this counts each
+        # surviving rank's observation (rates how much work aborts interrupt)
+        telemetry.get_counter(
+            "collective_aborts_observed_total",
+            "collective ops that failed with CollectiveAbortError",
+            tag_keys=("group",)).inc(1.0, tags={"group": st.name})
+        if telemetry.enabled():
+            telemetry.event("collective.abort_observed", "collective",
+                            group=st.name, epoch=e.epoch,
+                            failed_rank=e.failed_rank, op=op, rank=st.rank)
+        raise
+    else:
+        telemetry.get_counter(
+            "collective_ops_total", "completed host-plane collective ops",
+            tag_keys=("op",)).inc(1.0, tags={"op": op})
+        telemetry.get_histogram(
+            "collective_op_seconds", "host-plane collective op wall time",
+            tag_keys=("op",)).observe(time.perf_counter() - t0, tags={"op": op})
+
+
 def _to_host(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
@@ -449,16 +490,18 @@ def _xla_device_allreduce(tensor, st: _GroupState, op: ReduceOp):
 
 def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     st = _state(group_name)
-    if st.backend is Backend.XLA:
-        out = _xla_device_allreduce(tensor, st, op)
-        if out is not None:
-            return _like(out, tensor)
-    return _like(ring.allreduce(st, _to_host(tensor), op), tensor)
+    with _instrumented("allreduce", st, tensor):
+        if st.backend is Backend.XLA:
+            out = _xla_device_allreduce(tensor, st, op)
+            if out is not None:
+                return _like(out, tensor)
+        return _like(ring.allreduce(st, _to_host(tensor), op), tensor)
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     st = _state(group_name)
-    out = ring.reduce(st, _to_host(tensor), dst_rank, op)
+    with _instrumented("reduce", st, tensor):
+        out = ring.reduce(st, _to_host(tensor), dst_rank, op)
     if st.rank == dst_rank and out is not None:
         return _like(out, tensor)
     return tensor
@@ -466,25 +509,29 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     st = _state(group_name)
-    return _like(np.asarray(ring.broadcast(st, _to_host(tensor), src_rank)), tensor)
+    with _instrumented("broadcast", st, tensor):
+        return _like(np.asarray(ring.broadcast(st, _to_host(tensor), src_rank)), tensor)
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     """Returns the list of every rank's tensor (rank order). The reference fills a
     caller-provided tensor_list (torch idiom); returning is the functional idiom here."""
     st = _state(group_name)
-    return ring.allgather(st, _to_host(tensor))
+    with _instrumented("allgather", st, tensor):
+        return ring.allgather(st, _to_host(tensor))
 
 
 def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     """Reduce across ranks, then scatter equal chunks along axis 0; returns this rank's chunk."""
     st = _state(group_name)
-    return ring.reducescatter(st, _to_host(tensor), op)
+    with _instrumented("reducescatter", st, tensor):
+        return ring.reducescatter(st, _to_host(tensor), op)
 
 
 def barrier(group_name: str = "default") -> None:
     st = _state(group_name)
-    _barrier_impl(st)
+    with _instrumented("barrier", st, None):
+        _barrier_impl(st)
 
 
 def _barrier_impl(st: _GroupState, key: Optional[str] = None) -> None:

@@ -120,6 +120,17 @@ class GroupCoordinator:
             self._board_born.clear()
             self._abort = None
             self._cycle_complete = False
+            try:
+                from ray_tpu.util import telemetry
+
+                telemetry.get_counter(
+                    "collective_epoch_rollovers_total",
+                    "collective group epoch rollovers (re-inits)",
+                    tag_keys=("group",)).inc(1.0, tags={"group": self.name})
+                telemetry.event("collective.epoch_rollover", "collective",
+                                group=self.name, epoch=self._epoch)
+            except Exception:
+                pass  # telemetry must never fail a group re-init
         self._members[rank] = member
         if len(self._members) >= self.world_size:
             self._cycle_complete = True

@@ -59,6 +59,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_tpu.util import telemetry
+
 from .coordinator import wait_poll, wait_poll_one
 from .types import ReduceOp
 
@@ -234,7 +236,7 @@ class _Plane:
         self.server = DataServer(
             authkey, self._read,
             max_streams=max(CONFIG.collective_server_streams, min_streams))
-        self.client = DataClient(authkey)
+        self.client = DataClient(authkey, stats_path="collective")
         self.addr: Tuple[str, int] = (_local_ip(), self.server.port)
 
     def _read(self, loc: Tuple) -> Tuple[bytes, bool]:
@@ -690,8 +692,10 @@ def allreduce(st, tensor, op: ReduceOp) -> np.ndarray:
         raw = plane.pull_range(m["addr"], f"{key}:in", b0 * item, nchunk * item)
         return np.frombuffer(raw, dtype)
 
-    reduced = _ordered_stream_reduce(st, op, part_src, flat[b0:b1], deadline,
-                                     f"allreduce {key}")
+    with telemetry.span("collective.phase.reduce_scatter", "collective",
+                        key=key, bytes=flat.nbytes, chunk_bytes=nchunk * item):
+        reduced = _ordered_stream_reduce(st, op, part_src, flat[b0:b1],
+                                         deadline, f"allreduce {key}")
 
     # -- allgather of reduced chunks straight from their owners
     if nchunk:
@@ -723,8 +727,10 @@ def allreduce(st, tensor, op: ReduceOp) -> np.ndarray:
             plane.pull_range(m["addr"], f"{key}:red", 0, (j1 - j0) * item,
                              out=out_bytes[j0 * item:j1 * item])
 
-    _run_threads([lambda j=j: gather(j) for j in _staggered(r, w)], deadline,
-                 f"allreduce gather {key}", st=st)
+    with telemetry.span("collective.phase.allgather", "collective",
+                        key=key, bytes=flat.nbytes):
+        _run_threads([lambda j=j: gather(j) for j in _staggered(r, w)], deadline,
+                     f"allreduce gather {key}", st=st)
     return out.reshape(arr.shape)
 
 
@@ -831,30 +837,33 @@ def broadcast(st, tensor, src_rank: int) -> np.ndarray:
     deadline = time.monotonic() + _op_timeout()
     abort = _AbortCheck(st)
     pos = 0
-    while pos < total:
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"broadcast {key}: relay from rank {(parent_v + src_rank) % w} "
-                f"exceeded {_op_timeout()}s at byte {pos}/{total}")
-        abort.check()  # a dead relay parent must not cost the whole deadline
-        ln = min(step, total - pos)
-        try:
-            # bounded probe (see _Plane.pull): an upstream death that stalls
-            # the parent's stream must not pin us inside one pull for the op
-            # timeout — the abort verdict has to win within ~one poll interval.
-            # recv-into: the relayed chunk lands straight in the buffer the
-            # children stream out of, no staging bytes
-            n = plane.pull_into(parent_addr, f"{key}:bc", pos, ln,
-                                memoryview(buf)[pos:pos + ln],
-                                timeout=abort.interval)
-        except (OSError, EOFError, TimeoutError) as e:
-            abort.check(force=True, cause=e)
-            raise
-        if n is None:
-            continue  # range not relayed yet: re-probe abort, then re-ask
-        pos += ln
-        if nchild:
-            plane.store.advance(f"{key}:bc", pos)
+    with telemetry.span("collective.phase.relay", "collective", key=key,
+                        bytes=total, children=nchild,
+                        chunks=-(-total // step) if step else 0):
+        while pos < total:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"broadcast {key}: relay from rank {(parent_v + src_rank) % w} "
+                    f"exceeded {_op_timeout()}s at byte {pos}/{total}")
+            abort.check()  # a dead relay parent must not cost the whole deadline
+            ln = min(step, total - pos)
+            try:
+                # bounded probe (see _Plane.pull): an upstream death that stalls
+                # the parent's stream must not pin us inside one pull for the op
+                # timeout — the abort verdict has to win within ~one poll interval.
+                # recv-into: the relayed chunk lands straight in the buffer the
+                # children stream out of, no staging bytes
+                n = plane.pull_into(parent_addr, f"{key}:bc", pos, ln,
+                                    memoryview(buf)[pos:pos + ln],
+                                    timeout=abort.interval)
+            except (OSError, EOFError, TimeoutError) as e:
+                abort.check(force=True, cause=e)
+                raise
+            if n is None:
+                continue  # range not relayed yet: re-probe abort, then re-ask
+            pos += ln
+            if nchild:
+                plane.store.advance(f"{key}:bc", pos)
     dtype = np.dtype(meta["dtype"])
     if meta["enc"] == "int8":
         flat = _decompress(buf, dtype)  # fresh array; buf stays children-only
@@ -905,8 +914,10 @@ def allgather(st, tensor) -> List[np.ndarray]:
             results[i] = np.asarray(entries[i])
 
     fetch(r)
-    _run_threads([lambda i=i: fetch(i) for i in _staggered(r, w)], deadline,
-                 f"allgather {key}", st=st)
+    with telemetry.span("collective.phase.gather", "collective", key=key,
+                        bytes=flat.nbytes):
+        _run_threads([lambda i=i: fetch(i) for i in _staggered(r, w)], deadline,
+                     f"allgather {key}", st=st)
     return results
 
 
@@ -955,8 +966,11 @@ def reducescatter(st, tensor, op: ReduceOp) -> np.ndarray:
         raw = plane.pull_range(m["addr"], f"{key}:in", r * per * item, per * item)
         return np.frombuffer(raw, dtype)
 
-    acc = _ordered_stream_reduce(st, op, part_src, flat[r * per:(r + 1) * per],
-                                 deadline, f"reducescatter {key}")
+    with telemetry.span("collective.phase.reduce_scatter", "collective",
+                        key=key, bytes=flat.nbytes, chunk_bytes=per * item):
+        acc = _ordered_stream_reduce(st, op, part_src,
+                                     flat[r * per:(r + 1) * per],
+                                     deadline, f"reducescatter {key}")
     return acc.reshape((arr.shape[0] // w,) + arr.shape[1:])
 
 

@@ -184,8 +184,33 @@ class Histogram(Metric):
 
 # ------------------------------------------------------------------- aggregation
 
+def _rebin(counts: List[int], src_bounds: List[float],
+           dst_bounds: List[float]) -> List[int]:
+    """Map bucket counts from one boundary set onto another: each source
+    bucket's count lands in the destination bucket containing the source
+    bucket's upper edge (the overflow bucket stays overflow). Lossy only in
+    the sense any re-binning is — counts and sums are preserved exactly."""
+    out = [0] * (len(dst_bounds) + 1)
+    for i, cnt in enumerate(counts):
+        if not cnt:
+            continue
+        if i < len(src_bounds):
+            edge = src_bounds[i]
+            j = 0
+            while j < len(dst_bounds) and edge > dst_bounds[j]:
+                j += 1
+        else:
+            j = len(dst_bounds)
+        out[j] += cnt
+    return out
+
+
 def merge_snapshots(snaps: List[List[dict]]) -> Dict[str, dict]:
-    """Merge per-process snapshots (driver registry + worker pushes) by metric name."""
+    """Merge per-process snapshots (driver registry + worker pushes) by metric
+    name. Histograms carry their own per-metric `boundaries` through the
+    worker->coordinator push; when two processes registered the same histogram
+    with DIFFERENT boundaries, the incoming buckets are re-binned onto the
+    first-seen set instead of being zip-truncated into corruption."""
     out: Dict[str, dict] = {}
     for snap in snaps:
         for m in snap:
@@ -201,16 +226,48 @@ def merge_snapshots(snaps: List[List[dict]]) -> Dict[str, dict]:
             elif m["type"] == "gauge":
                 cur["values"].update(m["values"])
             elif m["type"] == "histogram":
+                src_bounds = list(m.get("boundaries", DEFAULT_HISTOGRAM_BOUNDARIES))
+                dst_bounds = list(cur.get("boundaries", DEFAULT_HISTOGRAM_BOUNDARIES))
+                same = src_bounds == dst_bounds
                 for k, v in m["values"].items():
+                    buckets = (list(v["buckets"]) if same
+                               else _rebin(v["buckets"], src_bounds, dst_bounds))
                     tgt = cur["values"].get(k)
                     if tgt is None:
-                        cur["values"][k] = {"buckets": list(v["buckets"]),
+                        cur["values"][k] = {"buckets": buckets,
                                             "sum": v["sum"], "count": v["count"]}
                     else:
-                        tgt["buckets"] = [a + b for a, b in zip(tgt["buckets"], v["buckets"])]
+                        tgt["buckets"] = [a + b for a, b in zip(tgt["buckets"], buckets)]
                         tgt["sum"] += v["sum"]
                         tgt["count"] += v["count"]
     return out
+
+
+def histogram_quantile(merged: dict, q: float) -> Optional[float]:
+    """Estimate the q-quantile (0..1) of a merged histogram metric across ALL
+    its tag sets, Prometheus histogram_quantile-style: find the bucket where
+    the cumulative count crosses q and interpolate linearly inside it. The
+    overflow bucket answers with its lower edge (no upper bound to lerp to).
+    Returns None for an empty histogram."""
+    bounds = merged.get("boundaries", [])
+    agg = [0] * (len(bounds) + 1)
+    for v in merged.get("values", {}).values():
+        for i, c in enumerate(v["buckets"]):
+            agg[i] += c
+    total = sum(agg)
+    if total <= 0:
+        return None
+    target = max(0.0, min(1.0, q)) * total
+    cum = 0
+    for i, c in enumerate(agg):
+        if cum + c >= target and c > 0:
+            if i >= len(bounds):
+                return float(bounds[-1]) if bounds else None
+            lo = bounds[i - 1] if i > 0 else 0.0
+            frac = (target - cum) / c
+            return float(lo + (bounds[i] - lo) * frac)
+        cum += c
+    return float(bounds[-1]) if bounds else None
 
 
 def prometheus_text(merged: Dict[str, dict], prefix: str = "ray_tpu") -> str:

@@ -278,6 +278,165 @@ def get_trace() -> List[Dict[str, Any]]:
         return list(c.trace_spans)
 
 
+# ------------------------------------------------------------------- telemetry
+
+@_remoteable
+def head_clock_ns() -> int:
+    """The head's wall clock, for the NTP-style offset handshake worker
+    telemetry flushers run once per process (util/telemetry.clock_offset_ns):
+    merged timeline timestamps are comparable because every worker batch is
+    shifted onto THIS clock."""
+    import time as _time
+
+    return _time.time_ns()
+
+
+@_remoteable
+def get_telemetry() -> List[Dict[str, Any]]:
+    """All collected hot-path telemetry events (util/telemetry.py), oldest
+    first: worker-pushed batches (already clock-aligned and proc-tagged by the
+    head) + the in-process driver's ring, folded in on read like get_trace."""
+    from ray_tpu.util import telemetry
+
+    c = _cluster()
+    local = telemetry.align_batch(
+        {"clock_offset_ns": 0, "events": telemetry.drain()}, "driver")
+    with c._lock:
+        c.telemetry_events.extend(local)
+        return list(c.telemetry_events)
+
+
+@_remoteable
+def telemetry_timeline_events() -> List[Dict[str, Any]]:
+    """Telemetry events rendered as chrome-trace events (no file IO — remotely
+    callable). Spans become complete ('X') events, instants become 'i'; the
+    `pid` lane is the producing process, the `tid` lane its thread."""
+    events = []
+    for ev in get_telemetry():
+        out = {
+            "cat": ev.get("cat", "app"),
+            "name": ev.get("name", "?"),
+            "pid": ev.get("proc", "driver"),
+            "tid": ev.get("tid", "main"),
+            "ts": ev["ts_ns"] / 1e3,  # chrome-trace microseconds
+            "args": ev.get("args", {}),
+        }
+        if ev.get("dur_ns") is None:
+            out["ph"] = "i"
+            out["s"] = "p"  # instant scope: process
+        else:
+            out["ph"] = "X"
+            out["dur"] = ev["dur_ns"] / 1e3
+        events.append(out)
+    return events
+
+
+def telemetry_timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Cross-worker chrome-trace timeline: hot-path telemetry spans (transfers,
+    collective phases, serve/llm request lifecycles, train steps) merged with
+    the task timeline, clocks aligned via the head handshake. Load the JSON in
+    chrome://tracing / Perfetto. The file, if requested, is written by THIS
+    process (a remote client's filename never touches the head's filesystem)."""
+    events = telemetry_timeline_events() + timeline_events()
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+@_remoteable
+def cluster_status() -> Dict[str, Any]:
+    """Live load summary for `ray-tpu status` / the dashboard: per-path
+    transfer GB/s, collective op/abort counts, serve TTFT p50/p99 + queue
+    depths, llm engine gauges, train MFU — all derived from the merged metric
+    registry, so it reflects every process that pushed within the report
+    interval."""
+    from ray_tpu.util import metrics as m
+
+    merged = get_metrics()
+
+    def counter_by_tag(name: str, tag: str) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for key, v in merged.get(name, {}).get("values", {}).items():
+            label = dict(key).get(tag, "")
+            out[label] = out.get(label, 0.0) + v
+        return out
+
+    def counter_total(name: str) -> float:
+        return sum(merged.get(name, {}).get("values", {}).values())
+
+    def gauges(name: str) -> Dict[str, float]:
+        return {",".join(f"{k}={v}" for k, v in key) or "_": val
+                for key, val in merged.get(name, {}).get("values", {}).items()}
+
+    status: Dict[str, Any] = {"cluster": summarize_cluster()}
+
+    # -- transfers: counters accumulate (bytes, busy-seconds) per path
+    bytes_by_path = counter_by_tag("transfer_bytes_total", "path")
+    secs_by_path = counter_by_tag("transfer_seconds_total", "path")
+    pulls_by_path = counter_by_tag("transfer_pulls_total", "path")
+    transfer = {}
+    for path in sorted(set(bytes_by_path) | set(pulls_by_path)):
+        b, s = bytes_by_path.get(path, 0.0), secs_by_path.get(path, 0.0)
+        transfer[path] = {
+            "pulls": int(pulls_by_path.get(path, 0)),
+            "bytes": int(b),
+            "gbps": round(b / s / 1e9, 3) if s > 0 else None,
+        }
+    status["transfer"] = transfer
+
+    # -- collectives
+    status["collective"] = {
+        "ops": {k: int(v) for k, v in
+                counter_by_tag("collective_ops_total", "op").items()},
+        "aborts": int(counter_total("collective_aborts_total")),
+        "aborts_observed": int(counter_total("collective_aborts_observed_total")),
+        "epoch_rollovers": int(counter_total("collective_epoch_rollovers_total")),
+    }
+
+    # -- serve (queue depth: each process publishes its own proc-tagged gauge;
+    # the cluster-wide depth is their SUM per deployment)
+    depth_by_dep: Dict[str, float] = {}
+    for key, v in merged.get("serve_queue_depth", {}).get("values", {}).items():
+        tags = dict(key)
+        label = f"{tags.get('app', '?')}/{tags.get('deployment', '?')}"
+        depth_by_dep[label] = depth_by_dep.get(label, 0.0) + v
+    ttft = merged.get("serve_ttft_seconds")
+    status["serve"] = {
+        "ttft_p50_s": m.histogram_quantile(ttft, 0.5) if ttft else None,
+        "ttft_p99_s": m.histogram_quantile(ttft, 0.99) if ttft else None,
+        "queue_depth": depth_by_dep,
+        "requests": int(sum(v["count"] for v in merged.get(
+            "serve_request_seconds", {}).get("values", {}).values())),
+    }
+
+    # -- llm engines
+    llm_ttft = merged.get("llm_ttft_seconds")
+    tok_rate = merged.get("llm_tokens_per_s")
+    status["llm"] = {
+        "ttft_p50_s": m.histogram_quantile(llm_ttft, 0.5) if llm_ttft else None,
+        "ttft_p99_s": m.histogram_quantile(llm_ttft, 0.99) if llm_ttft else None,
+        "tokens_per_s_p50": m.histogram_quantile(tok_rate, 0.5) if tok_rate else None,
+        "pending": gauges("llm_num_pending"),
+        "active": gauges("llm_num_active"),
+        "prefix_cache_hits": int(counter_total("llm_prefix_cache_hits_total")),
+        "prefix_cache_misses": int(counter_total("llm_prefix_cache_misses_total")),
+    }
+
+    # -- train
+    status["train"] = {
+        "mfu": gauges("train_mfu"),
+        "tokens_per_s": gauges("train_tokens_per_s"),
+        "step_phases_s": {
+            dict(key).get("phase", "?"): round(v["sum"] / v["count"], 6)
+            for key, v in merged.get("train_step_phase_seconds",
+                                     {}).get("values", {}).items()
+            if v["count"]
+        },
+    }
+    return status
+
+
 # -------------------------------------------------------------------- timeline
 
 @_remoteable

@@ -1,0 +1,367 @@
+"""Hot-path telemetry: a per-process lock-light ring buffer of timeline events.
+
+The metrics registry (util/metrics.py) answers "how much / how fast" with
+counters and histograms; this module answers "when and for how long" with
+nanosecond-timestamped events that merge into ONE cross-worker chrome-trace
+timeline (util/state.telemetry_timeline). Instrumentation points live on the
+hottest paths in the system — data-plane pulls, collective phases, serve
+request lifecycles, train steps — so the recorder is built around two rules:
+
+  near-zero when disabled   every probe is `if telemetry.enabled():` around a
+                            memoized env read (~0.1us) plus nothing. span()
+                            returns a shared no-op context manager, never a
+                            fresh generator frame.
+  bounded when enabled      events land in a deque(maxlen=ring_size): memory
+                            is capped, the hot path never blocks on a slow
+                            consumer, and overflow silently drops the OLDEST
+                            events (the flush thread logs — never print()s —
+                            a throttled warning with the drop count so lost
+                            history is visible without corrupting worker
+                            stdout or tqdm progress bars).
+
+Enablement rides the tracing switch: RAY_TPU_TRACING=1 (or
+tracing.enable_tracing() / telemetry.enable()) turns both the span tracer and
+this recorder on. Ring capacity: RAY_TPU_TELEMETRY_RING_SIZE.
+
+Transport: worker processes flush their ring to the head over the same
+control-pipe push the metrics registry uses (core/worker.py push_telemetry ->
+core/node.py "telemetry" message), tagged with a clock offset measured against
+the head via an NTP-style state_request("head_clock_ns") handshake — so the
+merged timeline's timestamps are comparable across processes. The in-process
+driver keeps events local; util/state folds them in on read.
+
+Usage:
+    from ray_tpu.util import telemetry
+    with telemetry.span("transfer.pull", "transfer", bytes=n):
+        ...
+    telemetry.event("collective.abort", "collective", group=g, epoch=e)
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.config import memoized_flag
+
+logger = logging.getLogger("ray_tpu.telemetry")
+
+_tracing_flag = memoized_flag("tracing")
+_ring_size_flag = memoized_flag("telemetry_ring_size")
+
+# tri-state override: None = the RAY_TPU_TRACING env decides; True/False from
+# enable()/disable() wins (bench toggles between rounds without re-spawning)
+_forced: Optional[bool] = None
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=8192)
+_dropped = 0  # events lost to ring overflow since the last flush/drain
+_flush_thread: Optional[threading.Thread] = None
+_clock_offset_ns: Optional[int] = None  # head_clock - local_clock (workers)
+
+
+def enabled() -> bool:
+    """THE hot-path gate: a memoized env read + one comparison."""
+    if _forced is not None:
+        return _forced
+    return bool(_tracing_flag())
+
+
+def enable() -> None:
+    """Force-enable in this process (bench/test toggle; env untouched)."""
+    global _forced
+    _forced = True
+
+
+def disable() -> None:
+    global _forced
+    _forced = False
+
+
+def reset_forced() -> None:
+    """Back to env-driven enablement (RAY_TPU_TRACING)."""
+    global _forced
+    _forced = None
+
+
+def _resize_ring_locked() -> None:
+    global _ring
+    want = max(64, int(_ring_size_flag() or 8192))
+    if _ring.maxlen != want:
+        _ring = deque(_ring, maxlen=want)
+
+
+# ------------------------------------------------------------------ recording
+
+def _append(rec: dict) -> None:
+    global _dropped
+    with _lock:
+        _resize_ring_locked()
+        if len(_ring) == _ring.maxlen:
+            _dropped += 1
+        _ring.append(rec)
+    _ensure_flush_thread()
+
+
+def event(name: str, cat: str = "app", **args: Any) -> None:
+    """Record an instant event (chrome-trace 'i' phase) at now."""
+    if not enabled():
+        return
+    _append({
+        "name": name, "cat": cat, "ts_ns": time.time_ns(), "dur_ns": None,
+        "tid": threading.current_thread().name, "args": args or {},
+    })
+
+
+class _Span:
+    """A lightweight timed region. Duration from perf_counter_ns (monotonic,
+    ns resolution); the wall anchor from time_ns at entry places it on the
+    shared timeline. Extra attributes may be attached mid-span via set()."""
+
+    __slots__ = ("name", "cat", "args", "_t0_wall", "_t0_perf")
+
+    def __init__(self, name: str, cat: str, args: Dict[str, Any]):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def set(self, **kw: Any) -> None:
+        self.args.update(kw)
+
+    def __enter__(self) -> "_Span":
+        self._t0_wall = time.time_ns()
+        self._t0_perf = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur = time.perf_counter_ns() - self._t0_perf
+        if exc_type is not None:
+            self.args["error"] = exc_type.__name__
+        _append({
+            "name": self.name, "cat": self.cat, "ts_ns": self._t0_wall,
+            "dur_ns": dur, "tid": threading.current_thread().name,
+            "args": self.args,
+        })
+
+
+class _NoopSpan:
+    """Shared disabled-path context manager: no allocation per probe."""
+
+    __slots__ = ()
+
+    def set(self, **kw: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, cat: str = "app", **args: Any):
+    """Context manager recording a complete ('X') event around the block."""
+    if not enabled():
+        return _NOOP
+    return _Span(name, cat, dict(args))
+
+
+def complete(name: str, cat: str, start_wall_ns: int, dur_ns: int,
+             **args: Any) -> None:
+    """Record a complete event whose timing the caller already measured
+    (request lifecycles that start and end on different threads)."""
+    if not enabled():
+        return
+    _append({
+        "name": name, "cat": cat, "ts_ns": int(start_wall_ns),
+        "dur_ns": int(dur_ns), "tid": threading.current_thread().name,
+        "args": args or {},
+    })
+
+
+# ------------------------------------------------------------------- draining
+
+def drain() -> List[dict]:
+    """Pop every buffered event (oldest first). Used by the flush thread and
+    by util/state for the in-process driver's ring."""
+    global _dropped
+    with _lock:
+        out = list(_ring)
+        _ring.clear()
+        n_dropped, _dropped = _dropped, 0
+    if n_dropped:
+        # logger, NEVER print(): worker stdout/stderr interleaves with tqdm
+        # progress bars and the head's log capture — a raw print here would
+        # corrupt both. Finalize any in-progress bar line first so the warning
+        # starts on its own line.
+        try:
+            from ray_tpu.experimental.tqdm_ray import ensure_newline
+
+            ensure_newline()
+        except Exception:
+            pass
+        logger.warning(
+            "telemetry ring overflowed: %d event(s) dropped (raise "
+            "RAY_TPU_TELEMETRY_RING_SIZE or flush more often)", n_dropped)
+    return out
+
+
+def pending() -> int:
+    with _lock:
+        return len(_ring)
+
+
+# -------------------------------------------------------------------- flushing
+
+def clock_offset_ns() -> int:
+    """head_clock - local_clock, measured once per process with an NTP-style
+    request/response handshake against the head (midpoint of the round trip
+    taken as the simultaneity point). The driver holding the cluster IS the
+    head clock: offset 0."""
+    global _clock_offset_ns
+    if _clock_offset_ns is not None:
+        return _clock_offset_ns
+    from ray_tpu.core import global_state
+
+    if global_state.try_cluster() is not None:
+        _clock_offset_ns = 0
+        return 0
+    w = global_state.try_worker()
+    if w is None or not hasattr(w, "state_request"):
+        _clock_offset_ns = 0
+        return 0
+    try:
+        t0 = time.time_ns()
+        head_ns = int(w.state_request("head_clock_ns"))
+        t1 = time.time_ns()
+        _clock_offset_ns = head_ns - (t0 + t1) // 2
+    except Exception:
+        _clock_offset_ns = 0
+    return _clock_offset_ns
+
+
+def flush() -> None:
+    """Push buffered events to the head now (worker / remote client driver);
+    the in-process driver keeps its ring local for util/state to fold in."""
+    from ray_tpu.core import global_state
+
+    w = global_state.try_worker()
+    if (w is None or not hasattr(w, "push_telemetry")
+            or global_state.try_cluster() is not None):
+        return
+    offset = clock_offset_ns()
+    events = drain()
+    if not events:
+        return
+    try:
+        w.push_telemetry({"clock_offset_ns": offset, "events": events,
+                          "pid": os.getpid()})
+    except Exception:
+        pass  # pipe closed: worker exiting
+
+
+def _flush_interval() -> float:
+    """Telemetry rides the metrics push cadence — same helper, not a copy."""
+    from ray_tpu.util.metrics import _report_interval
+
+    return _report_interval()
+
+
+_flush_na = False  # cached "this process never flushes" verdict
+
+
+def _ensure_flush_thread() -> None:
+    """Called per append: after the first resolution this is one global read.
+    The in-process driver/head never flushes (util/state reads its ring
+    directly) — cache that verdict instead of probing global_state per event.
+    A process with NO runtime context yet (telemetry before ray_tpu.init) is
+    left unresolved: a remote client driver must still get its flusher once
+    init lands."""
+    global _flush_thread, _flush_na
+    if _flush_thread is not None or _flush_na:
+        return
+    from ray_tpu.core import global_state
+
+    if global_state.try_cluster() is not None:
+        _flush_na = True  # in-process driver/head: the ring is read locally
+        return
+    w = global_state.try_worker()
+    if w is None:
+        return  # pre-init: can't decide yet
+    if not hasattr(w, "push_telemetry"):
+        _flush_na = True
+        return
+
+    def loop():
+        while True:
+            time.sleep(_flush_interval())
+            try:
+                flush()
+            except Exception:
+                return
+
+    with _lock:
+        if _flush_thread is None:
+            _flush_thread = threading.Thread(target=loop, daemon=True,
+                                             name="telemetry-flush")
+            _flush_thread.start()
+
+
+def align_batch(batch: dict, proc: str) -> List[dict]:
+    """Head-side merge step: apply the batch's measured clock offset to every
+    event timestamp and tag the producing process, so the cluster ring holds
+    ONE timeline whose ts_ns values are directly comparable."""
+    off = int(batch.get("clock_offset_ns") or 0)
+    out = []
+    for ev in batch.get("events", ()):
+        ev = dict(ev)
+        ev["ts_ns"] = int(ev["ts_ns"]) + off
+        ev["proc"] = proc
+        out.append(ev)
+    return out
+
+
+# --------------------------------------------------------------- lazy metrics
+
+_metric_cache: Dict[str, Any] = {}
+_metric_cache_lock = threading.Lock()
+
+
+def get_counter(name: str, description: str = "", tag_keys=None):
+    """Process-wide metric singletons for instrumentation points: creating a
+    Counter/Gauge/Histogram registers it forever, so hot paths must reuse one
+    instance per name instead of re-instantiating per call."""
+    return _get_metric("counter", name, description, tag_keys)
+
+
+def get_gauge(name: str, description: str = "", tag_keys=None):
+    return _get_metric("gauge", name, description, tag_keys)
+
+
+def get_histogram(name: str, description: str = "", tag_keys=None,
+                  boundaries=None):
+    return _get_metric("histogram", name, description, tag_keys, boundaries)
+
+
+def _get_metric(kind: str, name: str, description: str, tag_keys,
+                boundaries=None):
+    with _metric_cache_lock:
+        m = _metric_cache.get(name)
+        if m is None:
+            from ray_tpu.util import metrics as rm
+
+            if kind == "counter":
+                m = rm.Counter(name, description, tag_keys=tag_keys)
+            elif kind == "gauge":
+                m = rm.Gauge(name, description, tag_keys=tag_keys)
+            else:
+                m = rm.Histogram(name, description, boundaries=boundaries,
+                                 tag_keys=tag_keys)
+            _metric_cache[name] = m
+        return m

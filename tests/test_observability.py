@@ -1,5 +1,8 @@
-"""Metrics + state API + timeline + dashboard tests (reference: ray.util.metrics,
-python/ray/util/state, ray.timeline; SURVEY.md §5)."""
+"""Metrics + state API + timeline + dashboard + hot-path telemetry tests
+(reference: ray.util.metrics, python/ray/util/state, ray.timeline; SURVEY.md
+§5). The telemetry-plane tests (ring recorder, cross-worker chrome trace,
+abort counters, queue-depth gauges, cluster_status) are all tier-1: the
+marker audit at the bottom asserts none of them is marked slow."""
 import time
 
 import pytest
@@ -228,3 +231,320 @@ def test_metrics_provisioning(tmp_path):
     with open(dash) as f:
         panels = json.load(f)["panels"]
     assert len(panels) >= 6
+
+
+# -- hot-path telemetry plane ----------------------------------------------------------
+
+def test_telemetry_ring_bounded_and_drop_logged(caplog):
+    """The recorder is bounded memory: overflow drops the oldest events, and
+    the loss is reported through the LOGGER at drain (never print(), which
+    would corrupt tqdm bars / captured worker stdout)."""
+    import logging
+    import os
+
+    from ray_tpu.util import telemetry
+
+    os.environ["RAY_TPU_TELEMETRY_RING_SIZE"] = "64"
+    telemetry.enable()
+    try:
+        telemetry.drain()  # start from an empty ring
+        for i in range(200):
+            telemetry.event("t_ring", "test", i=i)
+        assert telemetry.pending() <= 64
+        with caplog.at_level(logging.WARNING, logger="ray_tpu.telemetry"):
+            events = telemetry.drain()
+        assert len(events) <= 64
+        # the survivors are the NEWEST events
+        assert events[-1]["args"]["i"] == 199
+        assert any("dropped" in r.message for r in caplog.records)
+    finally:
+        os.environ.pop("RAY_TPU_TELEMETRY_RING_SIZE", None)
+        telemetry.reset_forced()
+        telemetry.drain()
+
+
+def test_telemetry_disabled_is_noop():
+    from ray_tpu.util import telemetry
+
+    telemetry.disable()
+    try:
+        telemetry.drain()
+        with telemetry.span("t_off", "test") as sp:
+            assert sp is telemetry._NOOP or sp.__class__.__name__ == "_NoopSpan"
+        telemetry.event("t_off_event", "test")
+        assert telemetry.pending() == 0
+    finally:
+        telemetry.reset_forced()
+
+
+def test_histogram_boundaries_survive_push_roundtrip(rt):
+    """Satellite check: a worker-side histogram with CUSTOM boundaries keeps
+    them (labels included) through the worker->coordinator delta push and the
+    driver-side merge — they are not flattened onto the process-wide default."""
+    @rt.remote
+    def emit():
+        from ray_tpu.core import global_state
+        from ray_tpu.util import metrics as m
+
+        h = m.Histogram("t_custom_bounds", boundaries=[0.25, 2.5, 25.0],
+                        tag_keys=("stage",))
+        h.observe(0.1, tags={"stage": "a"})
+        h.observe(3.0, tags={"stage": "a"})
+        h.observe(100.0, tags={"stage": "b"})
+        global_state.worker().push_metrics(m._registry.snapshot())
+        return True
+
+    assert rt.get(emit.remote())
+    deadline = time.time() + 10
+    merged = {}
+    while time.time() < deadline:
+        merged = rs.get_metrics()
+        if "t_custom_bounds" in merged:
+            break
+        time.sleep(0.1)
+    hm = merged["t_custom_bounds"]
+    assert hm["boundaries"] == [0.25, 2.5, 25.0]
+    va = hm["values"][(("stage", "a"),)]
+    assert va["buckets"] == [1, 0, 1, 0] and va["count"] == 2
+    vb = hm["values"][(("stage", "b"),)]
+    assert vb["buckets"] == [0, 0, 0, 1]
+    # p50 of {0.1, 3.0} interpolates inside the custom buckets, not defaults
+    q = rm.histogram_quantile({"boundaries": hm["boundaries"], "values": {
+        (): {"buckets": [a + b for a, b in zip(va["buckets"], vb["buckets"])],
+             "sum": 0, "count": 3}}}, 0.5)
+    assert 0.0 < q <= 25.0
+
+
+def test_histogram_merge_rebins_on_boundary_mismatch():
+    """Two processes registering the SAME histogram name with different
+    boundaries must merge without zip-truncation corruption: counts re-bin
+    onto the first-seen boundary set, totals preserved exactly."""
+    snap_a = [{"name": "h", "type": "histogram", "description": "",
+               "boundaries": [1.0, 10.0],
+               "values": {(): {"buckets": [2, 3, 1], "sum": 30.0, "count": 6}}}]
+    snap_b = [{"name": "h", "type": "histogram", "description": "",
+               "boundaries": [0.5, 1.0, 5.0, 10.0, 50.0],
+               "values": {(): {"buckets": [1, 1, 2, 0, 1, 1],
+                               "sum": 60.0, "count": 6}}}]
+    merged = rm.merge_snapshots([snap_a, snap_b])["h"]
+    assert merged["boundaries"] == [1.0, 10.0]
+    v = merged["values"][()]
+    assert sum(v["buckets"]) == 12  # every observation survives the re-bin
+    assert v["count"] == 12 and v["sum"] == 90.0
+    assert len(v["buckets"]) == 3  # shaped like the kept boundaries
+
+
+def test_telemetry_chrome_trace_cross_worker(rt):
+    """Acceptance: the merged chrome-trace timeline carries spans from >= 2
+    worker processes with clock-offset-aligned, monotonic timestamps."""
+    from ray_tpu.util import telemetry
+
+    @rt.remote
+    def emit_spans(i):
+        import os as _os
+
+        from ray_tpu.util import telemetry as t
+
+        t.enable()
+        try:
+            with t.span("t_worker_span", "test", idx=i, seq=0):
+                time.sleep(0.05)
+            with t.span("t_worker_span", "test", idx=i, seq=1):
+                time.sleep(0.01)
+            t.flush()
+        finally:
+            t.reset_forced()
+        return _os.getpid()
+
+    telemetry.enable()
+    t0_us = time.time() * 1e6
+    try:
+        with telemetry.span("t_driver_span", "test"):
+            pids = rt.get([emit_spans.remote(i) for i in range(4)], timeout=60)
+        assert len(set(pids)) >= 2, f"need >=2 worker processes, got {pids}"
+
+        deadline = time.time() + 15
+        mine = []
+        while time.time() < deadline:
+            events = rs.telemetry_timeline()
+            mine = [e for e in events if e["name"] == "t_worker_span"]
+            if len({e["pid"] for e in mine}) >= 2 and any(
+                    e["name"] == "t_driver_span" for e in events):
+                break
+            time.sleep(0.2)
+        t1_us = time.time() * 1e6
+        lanes = {e["pid"] for e in mine}
+        assert len(lanes) >= 2, f"spans from one process only: {lanes}"
+        by_lane = {}
+        for e in mine:
+            assert e["ph"] == "X" and e["dur"] >= 0
+            # aligned clocks: every worker timestamp lands inside the driver's
+            # observation window (generous slack for handshake error)
+            assert t0_us - 5e6 <= e["ts"] <= t1_us + 5e6, (e["ts"], t0_us, t1_us)
+            by_lane.setdefault((e["pid"], e["tid"]), []).append(e)
+        for evs in by_lane.values():
+            seqs = [e["args"]["seq"] for e in sorted(evs, key=lambda e: e["ts"])]
+            assert seqs == sorted(seqs), "timestamps not monotonic within a lane"
+        first = [e for e in mine if e["args"]["seq"] == 0]
+        assert all(e["dur"] >= 0.04e6 for e in first)  # the 50ms sleep is visible
+    finally:
+        telemetry.reset_forced()
+
+
+def test_collective_abort_counter_and_event(rt):
+    """Acceptance: a killed rank increments the collective abort counter and
+    the abort event carries group/epoch/failed-rank."""
+    from ray_tpu.test_utils import CollectiveRankKiller
+    from ray_tpu.util import collective as col
+    from ray_tpu.util import telemetry
+
+    @rt.remote(num_cpus=0)
+    class Member:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def _ray_tpu_collective_init(self, world_size, rank, backend, group_name):
+            col.init_collective_group(world_size, rank, backend, group_name)
+
+        def timed_allreduce(self, group_name, nelem):
+            import numpy as np
+
+            from ray_tpu.util.collective import CollectiveAbortError
+
+            x = np.full((nelem,), float(self.rank + 1), dtype=np.float32)
+            try:
+                col.allreduce(x, group_name)
+                return ("ok", None)
+            except CollectiveAbortError as e:
+                return ("abort", e.failed_rank)
+
+    def aborts_total():
+        merged = rs.get_metrics()
+        return sum(merged.get("collective_aborts_total",
+                              {}).get("values", {}).values())
+
+    group = "obs_abort"
+    members = [Member.remote(i) for i in range(2)]
+    telemetry.enable()
+    try:
+        col.create_collective_group(members, 2, [0, 1], backend="shm",
+                                    group_name=group)
+        before = aborts_total()
+        killer = CollectiveRankKiller(group, rank=1)
+        assert killer.registered()
+        ref = members[0].timed_allreduce.remote(group, 200_000)
+        time.sleep(0.3)
+        assert killer.kill()
+        status, failed_rank = rt.get(ref, timeout=60)
+        assert status == "abort" and failed_rank == 1
+        deadline = time.time() + 10
+        while time.time() < deadline and aborts_total() <= before:
+            time.sleep(0.1)
+        assert aborts_total() >= before + 1
+        events = [e for e in rs.get_telemetry()
+                  if e["name"] == "collective.abort"
+                  and e["args"].get("group") == group]
+        assert events, "no collective.abort telemetry event recorded"
+        ev = events[-1]["args"]
+        assert ev["failed_rank"] == 1
+        assert isinstance(ev["epoch"], int)
+        assert ev["group"] == group
+    finally:
+        telemetry.reset_forced()
+        col.kill_coordinator(group)
+        for m in members:
+            try:
+                rt.kill(m)
+            except Exception:
+                pass
+
+
+def test_serve_queue_depth_gauge(rt):
+    """Acceptance: the serve_queue_depth gauge tracks in-flight requests
+    across concurrent handle.remote() calls, and returns to zero."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.8)
+            return "done"
+
+    def depth():
+        merged = rs.get_metrics()
+        vals = merged.get("serve_queue_depth", {}).get("values", {})
+        return max((v for k, v in vals.items()
+                    if dict(k).get("app") == "obs-slow"), default=0.0)
+
+    try:
+        handle = serve.run(Slow.bind(), name="obs-slow")
+        assert handle.remote(None).result() == "done"  # warm the replica
+        resps = [handle.remote(None) for _ in range(3)]
+        deadline = time.time() + 5
+        peak = 0.0
+        while time.time() < deadline and peak < 2.0:
+            peak = max(peak, depth())
+            time.sleep(0.02)
+        assert peak >= 2.0, f"gauge never saw concurrent in-flight: {peak}"
+        assert [r.result() for r in resps] == ["done"] * 3
+        deadline = time.time() + 10
+        while time.time() < deadline and depth() > 0:
+            time.sleep(0.05)
+        assert depth() == 0.0
+    finally:
+        serve.shutdown()
+
+
+def test_cluster_status_summary(rt):
+    """cluster_status() aggregates the live load signals (the `ray-tpu
+    status` payload) and the CLI renderer accepts it."""
+    status = rs.cluster_status()
+    assert status["cluster"]["nodes"] >= 1
+    for section in ("transfer", "collective", "serve", "llm", "train"):
+        assert section in status
+    assert "aborts" in status["collective"]
+    assert "queue_depth" in status["serve"]
+    from ray_tpu.scripts.cli import _render_status
+
+    text = _render_status(status)
+    assert "cluster" in text and "nodes=" in text
+
+
+def test_telemetry_overhead_dry_run(tmp_path):
+    """CI harness smoke: `core_bench.py --telemetry-overhead --dry-run` must
+    be invocable without a cluster and write the OBS_BENCH gate file."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "OBS_BENCH.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "core_bench.py"),
+         "--telemetry-overhead", "--dry-run", "--out", str(out)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["dry_run"] is True
+    assert doc["threshold_pct"] > 0
+    assert set(doc["rows"]) == {"transfer_10mb_wire", "allreduce_16mb_w4"}
+
+
+def test_telemetry_tests_are_tier1():
+    """Marker audit: every telemetry test in this module runs under the
+    tier-1 `-m 'not slow'` selection (none may be marked slow)."""
+    import sys
+
+    mod = sys.modules[__name__]
+    offenders = []
+    for name in dir(mod):
+        if not name.startswith("test_"):
+            continue
+        fn = getattr(mod, name)
+        for mark in getattr(fn, "pytestmark", []):
+            if mark.name == "slow":
+                offenders.append(name)
+    assert not offenders, f"telemetry tests excluded from tier-1: {offenders}"
