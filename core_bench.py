@@ -17,6 +17,11 @@ Run:
         # the 16 MB W=4 ring allreduce; asserts the overhead stays under
         # RAY_TPU_TELEMETRY_OVERHEAD_PCT (default 3%) -> OBS_BENCH.json.
         # --dry-run skips cluster+timing (CI harness smoke check).
+    JAX_PLATFORMS=cpu python core_bench.py --scrape-overhead [--dry-run]
+        # metrics-history scraper on (aggressive 0.25s interval) vs off,
+        # paired per-sample, on the 10 MB wire transfer; asserts the scraper
+        # costs <= RAY_TPU_SCRAPE_OVERHEAD_PCT (default 1%). Appends the
+        # "scrape_overhead" section to OBS_BENCH.json (telemetry rows kept).
 """
 import json
 import os
@@ -443,6 +448,179 @@ def telemetry_overhead_suite(ray_tpu, np, sched):
             "passed": max(o1, o2) <= threshold}
 
 
+def _scrape_overhead_threshold_pct() -> float:
+    return float(os.environ.get("RAY_TPU_SCRAPE_OVERHEAD_PCT", "1.0"))
+
+
+def scrape_overhead_suite(ray_tpu, np, sched):
+    """Scraper-on vs scraper-off delta on the 10 MB forced-wire pull
+    (agent -> driver), the hottest CORE_BENCH transfer row. The scraper runs
+    in THIS (head) process, so the toggle is just the interval env var —
+    scraper_loop re-reads it every tick: "off" parks the thread, "on" scrapes
+    every 0.25 s (20x the default cadence, adversarial on purpose).
+
+    Estimator: the scraper adds NO per-pull code — it can only cost through
+    background CPU/GIL competition while a scrape overlaps a pull. Direct
+    paired off/on pull timing cannot resolve that: measured null experiments
+    (scraper fully off on BOTH sides) showed +1-10% position/ordering bias,
+    1000x the scraper's real cost, so a 1% gate on raw pair deltas is a coin
+    flip. Instead the suite measures the interference channel where it is
+    actually visible and scales it by exposure:
+
+      stress_delta   pull slowdown with a thread scraping CONTINUOUSLY
+                     (100% duty — a worst case far beyond any real cadence),
+                     min-over-N against interleaved plain pulls
+      duty_cycle     measured scrape wall time / the 0.25s adversarial
+                     interval (20x the default cadence)
+      overhead       max(stress_delta, 0) * duty + duty  — what continuous-
+                     scraping interference costs at the real exposure, plus
+                     the scraper's own CPU share
+
+    Both factors are measured, the extrapolation is linear in exposure, and
+    the raw off/on pair delta is still reported as a diagnostic."""
+    import statistics
+    import threading
+
+    mb10 = 10 * 1024 * 1024
+    scrape_interval_s = 0.25
+
+    @ray_tpu.remote(num_cpus=0.1, scheduling_strategy=sched)
+    def produce(i):
+        import numpy as _np
+
+        return _np.full(1_310_720, float(i))  # 10 MiB
+
+    from ray_tpu.core import global_state
+
+    cluster = global_state.try_cluster()
+
+    def measure_min(refs):
+        times = []
+        for r in refs:
+            t0 = time.perf_counter()
+            ray_tpu.get(r, timeout=300)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    def fresh(n):
+        refs = [produce.remote(i) for i in range(n)]
+        _, pending = ray_tpu.wait(refs, num_returns=n, timeout=300)
+        assert not pending, "produce tasks missed the deadline"
+        return refs
+
+    stress_stop = threading.Event()
+
+    def stress_loop():
+        while not stress_stop.is_set():
+            cluster._scrape_merged_metrics()
+
+    try:
+        # force the wire path — the mapped shortcut copies nothing, so it
+        # could neither show nor hide scraper interference
+        os.environ["RAY_TPU_TRANSFER_SAME_HOST_MAP"] = "0"
+        os.environ["RAY_TPU_METRICS_SCRAPE_INTERVAL_S"] = "0"
+        measure_min(fresh(2))  # warm pools/paths outside the timing
+
+        # plain / stressed / plain: the bracketing plain rounds absorb drift
+        plain_a = measure_min(fresh(8))
+        stress_thread = threading.Thread(target=stress_loop, daemon=True)
+        stress_thread.start()
+        try:
+            stressed = measure_min(fresh(8))
+        finally:
+            stress_stop.set()
+            stress_thread.join(timeout=10)
+        plain_b = measure_min(fresh(8))
+        plain = min(plain_a, plain_b)
+        stress_delta_pct = (stressed - plain) / plain * 100.0
+
+        # the scrape's own wall time against the live registries (driver +
+        # every pushed worker snapshot)
+        scrape_times = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            cluster._scrape_merged_metrics()
+            scrape_times.append(time.perf_counter() - t0)
+        scrape_ms = statistics.median(scrape_times) * 1e3
+
+        # diagnostic only: raw interleaved off/on pairs at the adversarial
+        # cadence (noise floor documented above)
+        refs = fresh(12)
+        pair_deltas, cur = [], None
+        for i, r in enumerate(refs):
+            on = i % 2 == 1
+            os.environ["RAY_TPU_METRICS_SCRAPE_INTERVAL_S"] = (
+                str(scrape_interval_s) if on else "0")
+            t0 = time.perf_counter()
+            ray_tpu.get(r, timeout=300)
+            dt = time.perf_counter() - t0
+            if on:
+                pair_deltas.append((dt - cur) / cur * 100.0)
+            else:
+                cur = dt
+    finally:
+        os.environ.pop("RAY_TPU_TRANSFER_SAME_HOST_MAP", None)
+        os.environ.pop("RAY_TPU_METRICS_SCRAPE_INTERVAL_S", None)
+
+    duty = scrape_ms / (scrape_interval_s * 1e3)
+    overhead = max(stress_delta_pct, 0.0) * duty + duty * 100.0
+    threshold = _scrape_overhead_threshold_pct()
+    row = {
+        "plain_s": round(plain, 6),
+        "stressed_s": round(stressed, 6),
+        "plain_gbps": round(mb10 / plain / 1e9, 3),
+        "stressed_gbps": round(mb10 / stressed / 1e9, 3),
+        "stress_delta_pct": round(stress_delta_pct, 2),
+        "scrape_cost_ms": round(scrape_ms, 4),
+        "scrape_interval_s": scrape_interval_s,
+        "duty_cycle_pct": round(duty * 100.0, 4),
+        "median_pair_delta_pct": round(statistics.median(pair_deltas), 2),
+        "frames_scraped": len(cluster.metrics_history),
+        "overhead_pct": round(overhead, 4),
+    }
+    print(f"  transfer_10mb_wire: plain={plain * 1e3:.1f}ms "
+          f"stressed(100% duty)={stressed * 1e3:.1f}ms "
+          f"({stress_delta_pct:+.2f}%), scrape {scrape_ms:.3f}ms @ "
+          f"{scrape_interval_s}s -> duty {duty * 100:.4f}%, "
+          f"overhead {overhead:.4f}% (diag median pair "
+          f"{row['median_pair_delta_pct']:+.2f}%)")
+    return {"rows": {"transfer_10mb_wire": row}, "threshold_pct": threshold,
+            "max_overhead_pct": round(overhead, 4),
+            "passed": overhead <= threshold}
+
+
+def _write_telemetry_obs_bench(out_path: str, result: dict) -> None:
+    """The telemetry gate keeps its historical top-level schema (rows/
+    threshold_pct/...); carry the scrape-overhead section across the rewrite
+    so the two gates sharing OBS_BENCH.json don't clobber each other."""
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                prev = json.load(f)
+            if "scrape_overhead" in prev:
+                result = {**result, "scrape_overhead": prev["scrape_overhead"]}
+        except Exception:
+            pass
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+
+
+def _update_obs_bench(out_path: str, section: str, result: dict) -> None:
+    """Merge one gate section into OBS_BENCH.json without clobbering the
+    other gates' evidence (telemetry-overhead and scrape-overhead share the
+    file)."""
+    doc = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                doc = json.load(f)
+        except Exception:
+            doc = {}
+    doc[section] = result
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+
 def _spawn_remote_agent(ray_tpu):
     """Start a real node agent on localhost and return (proc, sched) — the
     relay hop a multi-host pod pays, used by the remote/transfer columns."""
@@ -471,6 +649,45 @@ def _spawn_remote_agent(ray_tpu):
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "--all"
 
+    if mode == "--scrape-overhead":
+        out_path = "OBS_BENCH.json"
+        if "--out" in sys.argv:
+            out_path = sys.argv[sys.argv.index("--out") + 1]
+        elif not os.path.isabs(out_path):
+            out_path = os.path.join(os.path.dirname(__file__) or ".", out_path)
+        if "--dry-run" in sys.argv:
+            result = {
+                "dry_run": True,
+                "threshold_pct": _scrape_overhead_threshold_pct(),
+                "rows": {"transfer_10mb_wire": None},
+            }
+            _update_obs_bench(out_path, "scrape_overhead", result)
+            print(f"dry run: updated {out_path} (no measurements)")
+            return
+        import numpy as np
+
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=4, node_server_port=0,
+                     worker_env={"JAX_PLATFORMS": "cpu"},
+                     max_workers_per_node=16)
+        agent, sched = _spawn_remote_agent(ray_tpu)
+        try:
+            result = scrape_overhead_suite(ray_tpu, np, sched)
+        finally:
+            agent.terminate()
+            try:
+                agent.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                agent.kill()
+        ray_tpu.shutdown()
+        _update_obs_bench(out_path, "scrape_overhead", result)
+        print(f"updated {out_path}")
+        assert result["passed"], (
+            f"history-scraper overhead {result['max_overhead_pct']:.2f}% "
+            f"exceeds the {result['threshold_pct']}% gate")
+        return
+
     if mode == "--telemetry-overhead":
         out_path = "OBS_BENCH.json"
         if "--out" in sys.argv:
@@ -485,8 +702,7 @@ def main():
                 "threshold_pct": _overhead_threshold_pct(),
                 "rows": {"transfer_10mb_wire": None, "allreduce_16mb_w4": None},
             }
-            with open(out_path, "w") as f:
-                json.dump(result, f, indent=2)
+            _write_telemetry_obs_bench(out_path, result)
             print(f"dry run: wrote {out_path} (no measurements)")
             return
         import numpy as np
@@ -506,8 +722,7 @@ def main():
             except subprocess.TimeoutExpired:
                 agent.kill()
         ray_tpu.shutdown()
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=2)
+        _write_telemetry_obs_bench(out_path, result)
         print(f"wrote {out_path}")
         assert result["passed"], (
             f"telemetry overhead {result['max_overhead_pct']:.2f}% exceeds "

@@ -239,6 +239,15 @@ _FLAGS: List[Flag] = [
     Flag("telemetry_ring_size", "RAY_TPU_TELEMETRY_RING_SIZE", "int", 8192,
          "Per-process telemetry ring-buffer capacity (events). Overflow drops "
          "the oldest events and logs a throttled warning at flush."),
+    Flag("metrics_scrape_interval_s", "RAY_TPU_METRICS_SCRAPE_INTERVAL_S",
+         "float", 5.0,
+         "Head-side metrics-history scrape period: the merged cross-worker "
+         "snapshot is sampled into a timestamped frame ring this often, "
+         "feeding windowed rates/quantiles and the SLO engine. 0 disables "
+         "the scraper."),
+    Flag("metrics_history_size", "RAY_TPU_METRICS_HISTORY_SIZE", "int", 360,
+         "Frames retained in the metrics-history ring (at the default 5 s "
+         "scrape interval, 360 frames = 30 min of windowed history)."),
     Flag("usage_stats", "RAY_TPU_USAGE_STATS", "bool", False,
          "Record a local-only feature-usage summary in the session dir "
          "(never leaves the machine)."),

@@ -507,6 +507,32 @@ class Cluster:
         self._maint_thread = threading.Thread(
             target=self._maintenance_loop, daemon=True, name="rt-maintenance")
         self._maint_thread.start()
+        # metrics history + SLO engine (util/metrics_history.py, util/slo.py):
+        # the head samples the merged cross-worker snapshot into a bounded
+        # frame ring every CONFIG.metrics_scrape_interval_s, then re-evaluates
+        # the registered SLOs — the windowed-signal layer behind
+        # state.metrics_history()/slo_status(), /api/history, /api/slo and
+        # `ray-tpu status --watch`
+        from ray_tpu.util.metrics_history import MetricsHistory, scraper_loop
+        from ray_tpu.util.slo import SLOEngine
+
+        self.metrics_history = MetricsHistory()
+        self.slo_engine = SLOEngine(self.metrics_history)
+        self._scraper_thread = threading.Thread(
+            target=scraper_loop, daemon=True, name="rt-metrics-scraper",
+            args=(self.metrics_history, self._scrape_merged_metrics,
+                  lambda: self._shutdown, self.slo_engine.evaluate))
+        self._scraper_thread.start()
+
+    def _scrape_merged_metrics(self) -> Dict[str, Any]:
+        """One merged cross-worker snapshot for the history scraper: the
+        head's own registry + every worker's latest push (the same merge
+        state.get_metrics serves, reachable without the state-API guard)."""
+        from ray_tpu.util import metrics as _m
+
+        snaps = [_m._registry.snapshot()]
+        snaps.extend(list(self.metrics_by_worker.values()))
+        return _m.merge_snapshots(snaps)
 
     # -- topology --------------------------------------------------------------------
     def add_node(self, resources: Dict[str, float], labels: Optional[Dict[str, str]] = None,

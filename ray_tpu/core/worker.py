@@ -384,6 +384,7 @@ class WorkerContext:
 
     def _execute_inner(self, spec: TaskSpec, resolved_locs: List) -> None:
         self.current_task_id = spec.task_id
+        ctx_token = None
         try:
             from ray_tpu.runtime_env import applied as _renv_applied
 
@@ -392,10 +393,11 @@ class WorkerContext:
             if spec.trace_ctx is not None:
                 from ray_tpu.util import tracing
 
-                # a propagated context IS the enable signal (workers may have been
-                # spawned before the driver called enable_tracing)
-                tracing.enable_tracing()
-                tracing.set_trace_context(spec.trace_ctx)
+                # a propagated context IS the enable signal for THIS task:
+                # is_tracing_enabled honors an active context, so no global
+                # flag needs flipping (one traced request must not turn a
+                # long-lived worker's tracing on forever)
+                ctx_token = tracing.set_trace_context(spec.trace_ctx)
                 span_cm = tracing.span(f"task::{spec.name}", {"kind": spec.kind})
             else:
                 span_cm = contextlib.nullcontext()
@@ -412,6 +414,13 @@ class WorkerContext:
         except BaseException as e:  # noqa: BLE001
             self._send_error(spec, e)
         finally:
+            if ctx_token is not None:
+                # reset the POOLED dispatch/method thread: a leaked context
+                # would stitch the next (untraced) request on this thread
+                # into this trace and mis-tag its telemetry
+                from ray_tpu.util import tracing
+
+                tracing._ctx.reset(ctx_token)
             self.current_task_id = None
 
     def _send_error(self, spec: TaskSpec, e: BaseException) -> None:
@@ -516,7 +525,8 @@ class WorkerContext:
             if spec.trace_ctx is not None:
                 from ray_tpu.util import tracing
 
-                tracing.enable_tracing()
+                # per-asyncio.Task context: no reset needed, and the active
+                # context is itself the enable signal (see _execute_inner)
                 tracing.set_trace_context(spec.trace_ctx)
                 span_cm = tracing.span(f"task::{spec.name}", {"kind": spec.kind})
             else:

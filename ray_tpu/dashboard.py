@@ -19,6 +19,11 @@ Endpoints:
                             train) + tasks, clock-aligned
     GET /api/status         live load summary (transfer GB/s, collective
                             ops/aborts, serve TTFT + queue depth, train MFU)
+    GET /api/history        metrics-history time series (windowed rates and
+                            frame-over-frame quantiles; ?window=seconds)
+    GET /api/slo            SLO engine status (burn rates, ok|burning)
+    GET /api/trace?trace_id=  request-scoped critical path (span tree +
+                            queue/prefill/decode/transfer/other attribution)
     GET /metrics            Prometheus exposition text
 """
 from __future__ import annotations
@@ -183,6 +188,27 @@ class Dashboard:
                 # cluster load summary: transfer GB/s, collective ops/aborts,
                 # serve TTFT + queue depths, train MFU (util/state.cluster_status)
                 return web.json_response(st.cluster_status())
+            if name == "history":
+                # retained metrics history as JSON-safe per-frame time series
+                # (windowed rates + frame-over-frame quantiles; sparkline feed)
+                try:
+                    window = float(request.query.get("window", "300"))
+                except ValueError:
+                    window = float("nan")
+                if not window > 0:  # rejects NaN, 0, and negatives alike
+                    return web.Response(
+                        status=400, text="window must be a positive number "
+                        "of seconds")
+                return web.json_response(st.history_series(window_s=window))
+            if name == "slo":
+                # SLO engine status: burn rates + ok|burning per objective
+                return web.json_response(st.slo_status())
+            if name == "trace":
+                # request-scoped critical path: /api/trace?trace_id=...
+                tid = request.query.get("trace_id", "")
+                if not tid:
+                    return web.Response(status=400, text="trace_id required")
+                return web.json_response(st.request_trace(tid))
             if name == "timeline":
                 return web.json_response(st.timeline())
             if name == "telemetry_timeline":

@@ -104,6 +104,15 @@ class _Request:
         self.finish_recorded = False
         self.prefix_hit_tokens = -1  # -1 = no paged prefill ran (yet)
         self.prefill_tokens = 0  # tokens the model actually prefilled
+        # request-scoped trace: captured at creation (the caller's thread —
+        # serve replica / router with the propagated context); the scheduler
+        # loop that records the queue/prefill/decode spans has no context
+        try:
+            from ray_tpu.util.tracing import current_trace_id
+
+            self.trace_id = current_trace_id()
+        except Exception:
+            self.trace_id = None
 
 
 class JaxLLMEngine(LLMEngine):
@@ -632,7 +641,8 @@ class JaxLLMEngine(LLMEngine):
                 dur, request_id=req.id, prompt_tokens=len(req.prompt_ids),
                 prefix_hit_tokens=max(req.prefix_hit_tokens, 0),
                 prefill_tokens=self._prefill_tokens_of(req),
-                cache_hit=req.prefix_hit_tokens > 0)
+                cache_hit=req.prefix_hit_tokens > 0,
+                trace_id=req.trace_id)
 
     def _record_first_token(self, req: _Request) -> None:
         req.first_token_perf_ns = time.perf_counter_ns()
@@ -678,7 +688,8 @@ class JaxLLMEngine(LLMEngine):
                 prompt_tokens=len(req.prompt_ids),
                 prefix_hit_tokens=max(req.prefix_hit_tokens, 0),
                 prefill_tokens=self._prefill_tokens_of(req),
-                tokens_per_s=round(rate, 2) if rate is not None else 0.0)
+                tokens_per_s=round(rate, 2) if rate is not None else 0.0,
+                trace_id=req.trace_id)
 
     # -- scheduler loop ------------------------------------------------------------
     def _free_slots(self) -> List[int]:
@@ -719,7 +730,8 @@ class JaxLLMEngine(LLMEngine):
                     telemetry.complete(
                         "llm.queue", "llm", req.created_wall_ns,
                         t_admit_perf - req.created_perf_ns, request_id=req.id,
-                        prompt_tokens=len(req.prompt_ids))
+                        prompt_tokens=len(req.prompt_ids),
+                        trace_id=req.trace_id)
             p = req.params
             if req.prefill_kv is not None:
                 # P/D disaggregation: KV computed by a prefill replica; install it

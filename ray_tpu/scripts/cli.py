@@ -153,10 +153,75 @@ def _render_status(s: dict) -> str:
     return "\n".join(lines)
 
 
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width: int = 30) -> str:
+    """Render a numeric series (None = no data) as unicode block bars.
+    Scaled against the RENDERED slice only — an old spike outside the last
+    `width` points must not flatten every visible bar."""
+    values = values[-width:]
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return "-" * min(width, 8)
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+        else:
+            out.append(_SPARK_BLOCKS[min(7, int((v - lo) / span * 7.999))])
+    return "".join(out)
+
+
+def _render_history(hist: dict) -> str:
+    """Sparkline block for `ray-tpu status --watch`: one row per history
+    series that has any signal, latest value alongside."""
+    ts, series = hist.get("ts", []), hist.get("series", {})
+    if len(ts) < 2:
+        return "history    (warming up: <2 frames scraped yet)"
+    lines = []
+    for name, vals in series.items():
+        live = [v for v in vals if v is not None]
+        if not live:
+            continue
+        latest = live[-1]
+        if name.endswith("_per_s"):
+            shown = f"{latest:,.1f}/s"
+        elif name.endswith("_s"):
+            shown = f"{latest * 1e3:.1f}ms"
+        else:
+            shown = f"{latest:,.1f}"
+        lines.append(f"  {name:<24} {_sparkline(vals)} {shown}")
+    if not lines:
+        return "history    (no series with data yet)"
+    span = ts[-1] - ts[0]
+    return "\n".join([f"history    last {span:.0f}s, {len(ts)} frames:"] + lines)
+
+
+def _render_slo(status: dict) -> str:
+    if not status:
+        return ""
+    lines = ["slo"]
+    for name, row in sorted(status.items()):
+        state = row.get("state", "?")
+        mark = {"ok": "·", "burning": "!", "no_data": "?"}.get(state, "?")
+        bl, bs = row.get("burn_rate_long"), row.get("burn_rate_short")
+        fmt = lambda b: f"{b:.2f}" if b is not None else "-"
+        lines.append(f"  [{mark}] {name:<16} {state:<8} "
+                     f"burn long/short={fmt(bl)}/{fmt(bs)} "
+                     f"(objective {row.get('objective')}, "
+                     f"window {row.get('window_s')}s)")
+    return "\n".join(lines)
+
+
 def cmd_status(args) -> int:
     """Head-session info plus — when a cluster is reachable (in-process or via
     --address) — the live telemetry summary: per-path transfer GB/s,
-    collective ops/aborts, serve TTFT p50/p99 + queue depths, train MFU."""
+    collective ops/aborts, serve TTFT p50/p99 + queue depths, train MFU.
+    --watch re-renders every few seconds with metrics-history sparklines and
+    SLO burn state."""
     import ray_tpu
 
     rc = 0
@@ -175,7 +240,22 @@ def cmd_status(args) -> int:
     if ray_tpu.is_initialized():
         from ray_tpu.util import state as rs
 
+        if getattr(args, "watch", False):
+            try:
+                while True:
+                    block = [_render_status(rs.cluster_status()),
+                             _render_history(rs.history_series())]
+                    slo = _render_slo(rs.slo_status())
+                    if slo:
+                        block.append(slo)
+                    print("\x1b[2J\x1b[H" + "\n".join(block), flush=True)
+                    time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return rc
         print(_render_status(rs.cluster_status()))
+        slo = _render_slo(rs.slo_status())
+        if slo:
+            print(slo)
     else:
         # stderr: standalone `ray-tpu status` must keep stdout pure JSON for
         # scripts that parse the session info
@@ -184,6 +264,50 @@ def cmd_status(args) -> int:
     # rc reflects the head session (the original `status` contract) — a live
     # in-process cluster adds the load summary but doesn't fake a session
     return rc
+
+
+def cmd_trace(args) -> int:
+    """`ray-tpu trace <trace_id>`: render one request's critical path — the
+    cross-process span tree plus wall-time attribution over queue / prefill /
+    decode / transfer / other. The trace id comes from the serve ingress's
+    `traceparent` response header (or the caller's own traceparent)."""
+    import ray_tpu
+
+    if args.address:
+        ray_tpu.init(address=args.address)
+    elif not ray_tpu.is_initialized():
+        print("no cluster: pass --address ray-tpu://host:port (or run inside a driver)")
+        return 1
+    from ray_tpu.util import state as rs
+
+    doc = rs.request_trace(args.trace_id)
+    if args.json:
+        print(json.dumps(doc, indent=2, default=str))
+        return 0 if doc.get("found") else 1
+    if not doc.get("found"):
+        print(f"no spans or events for trace {args.trace_id!r} (is tracing "
+              "enabled, and did the request finish?)")
+        return 1
+    total = doc["total_s"]
+    print(f"trace {doc['trace_id']}  total={total * 1e3:.1f}ms  "
+          f"processes={len(doc['processes'])} ({', '.join(doc['processes'])})")
+    print("spans:")
+    for s in doc["spans"]:
+        bar = "  " * s["depth"]
+        print(f"  {bar}{s['name']}  +{s['start_s'] * 1e3:.1f}ms "
+              f"{s['dur_s'] * 1e3:.1f}ms  (pid {s['pid']})")
+    if doc["events"]:
+        print("events:")
+        for e in doc["events"]:
+            phase = f" [{e['phase']}]" if e.get("phase") else ""
+            print(f"  {e['name']}{phase}  +{e['start_s'] * 1e3:.1f}ms "
+                  f"{e['dur_s'] * 1e3:.1f}ms  ({e['proc']})")
+    print("critical path:")
+    for phase, secs in doc["attribution"].items():
+        pct = secs / total * 100 if total > 0 else 0.0
+        if secs > 0 or phase == "other":
+            print(f"  {phase:<9} {secs * 1e3:8.1f}ms  {pct:5.1f}%")
+    return 0
 
 
 def cmd_submit(args) -> int:
@@ -459,11 +583,26 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("status", help="show head session + live load summary "
                         "(transfer GB/s, collective ops/aborts, serve TTFT, "
-                        "train MFU)")
+                        "train MFU); --watch adds history sparklines + SLOs")
     sp.add_argument("--address", default=None,
                     help="connect as a client driver for the live summary, "
                          "e.g. ray-tpu://127.0.0.1:10001")
+    sp.add_argument("--watch", action="store_true",
+                    help="re-render every --interval seconds with "
+                         "metrics-history sparklines and SLO burn state")
+    sp.add_argument("--interval", type=float, default=3.0)
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("trace", help="render one request's critical path "
+                        "(span tree + queue/prefill/decode/transfer/other "
+                        "attribution) from its trace id")
+    sp.add_argument("trace_id", help="32-hex trace id (from the serve "
+                    "ingress's traceparent response header)")
+    sp.add_argument("--address", default=None,
+                    help="connect as a client driver, e.g. ray-tpu://127.0.0.1:10001")
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw state.request_trace document")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("submit", help="run a python script as a job")
     sp.add_argument("script")

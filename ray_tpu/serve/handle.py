@@ -345,6 +345,14 @@ class DeploymentHandle:
         self._router.on_send(replica)
         self._adjust_queue_depth(+1)
         t0_wall, t0_perf = time.time_ns(), time.perf_counter_ns()
+        # captured HERE, on the caller's thread: the done-watcher thread that
+        # records the lifecycle event has no request context of its own
+        try:
+            from ray_tpu.util.tracing import current_trace_id
+
+            trace_id = current_trace_id()
+        except Exception:
+            trace_id = None
         if self._multiplexed_model_id:
             from .multiplex import MULTIPLEX_KWARG
 
@@ -384,7 +392,8 @@ class DeploymentHandle:
                     telemetry.complete(
                         "serve.request", "serve", t0_wall, dur,
                         app=self.app_name, deployment=self.deployment_name,
-                        method=self._method, stream=self._stream)
+                        method=self._method, stream=self._stream,
+                        trace_id=trace_id)
 
         threading.Thread(target=_done_watcher, daemon=True).start()
         return resp

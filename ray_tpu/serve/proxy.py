@@ -69,6 +69,77 @@ class ProxyActor:
             if m is None:
                 return web.Response(status=404, text=f"no route for {request.path}")
             prefix, info = m
+
+            # -- request-scoped tracing (W3C traceparent in / out) ---------
+            # an incoming traceparent enables tracing for THIS request only:
+            # the context set under _in_ctx is itself the enable signal
+            # (tracing.is_tracing_enabled honors an active context), so one
+            # unauthenticated probe cannot flip a process-wide switch. With
+            # tracing globally on, requests without a header root a fresh
+            # trace. The proxy span's id becomes the parent the handle->
+            # replica->engine chain inherits, so state.request_trace(trace_id)
+            # sees one tree spanning proxy and replica processes.
+            from ray_tpu.util import tracing
+
+            incoming = tracing.parse_traceparent(
+                request.headers.get("traceparent"))
+            traced = incoming is not None or tracing.is_tracing_enabled()
+            if traced:
+                import uuid as _uuid
+
+                trace_id = (incoming["trace_id"] if incoming
+                            else _uuid.uuid4().hex)
+                upstream_parent = incoming["parent_span_id"] if incoming else ""
+                proxy_span_id = tracing.new_span_id()
+                child_ctx = {"trace_id": trace_id,
+                             "parent_span_id": proxy_span_id}
+                traceparent_out = tracing.format_traceparent(
+                    trace_id, proxy_span_id)
+            else:
+                child_ctx = traceparent_out = None
+
+            span_fired = []
+
+            def _finish_span(stream: bool, status: int) -> None:
+                # once-only: the streaming path also fires from its finally
+                # so a client disconnect mid-stream still records the root
+                # span (those aborted requests are the ones worth tracing)
+                if not traced or span_fired:
+                    return
+                span_fired.append(True)
+                end_wall_ns = t0_wall + (time.perf_counter_ns() - t0_perf)
+                tracing.record_complete_span(
+                    "serve.http", t0_wall / 1e9, end_wall_ns / 1e9,
+                    trace_id, proxy_span_id, upstream_parent,
+                    {"route": prefix, "method": request.method,
+                     "path": request.path, "stream": stream,
+                     "status": status})
+
+            def _in_ctx(fn):
+                """Run fn under the request's trace context and restore the
+                (pooled) executor thread afterwards — a leaked contextvar
+                would stitch unrelated requests into this trace."""
+                if child_ctx is None:
+                    return fn
+
+                def wrapped(*a, **kw):
+                    token = tracing.set_trace_context(child_ctx)
+                    try:
+                        return fn(*a, **kw)
+                    finally:
+                        tracing._ctx.reset(token)
+                return wrapped
+
+            def _respond(resp, stream: bool):
+                """Close the ingress span and echo the traceparent so callers
+                (and tests) learn the trace id to hand request_trace()."""
+                if traced:
+                    try:
+                        resp.headers["traceparent"] = traceparent_out
+                    except Exception:  # noqa: BLE001 — already-prepared stream
+                        pass
+                    _finish_span(stream, getattr(resp, "status", 200))
+                return resp
             key = f"{info['app']}/{info['deployment']}"
             if key not in self._handles:
                 self._handles[key] = DeploymentHandle(info["app"], info["deployment"])
@@ -125,7 +196,8 @@ class ProxyActor:
                 gen = None
                 try:
                     try:
-                        gen = await loop.run_in_executor(stream_exec, start_stream)
+                        gen = await loop.run_in_executor(
+                            stream_exec, _in_ctx(start_stream))
                         pull = make_pull(gen)
                         first = await loop.run_in_executor(stream_exec, pull)
                         _observe_ttft(prefix,
@@ -137,15 +209,19 @@ class ProxyActor:
                         if isinstance(first, (dict, list)):
                             second = await loop.run_in_executor(stream_exec, pull)
                             if second is _end:
-                                return web.json_response(first)
+                                return _respond(web.json_response(first),
+                                                stream=False)
                             pending = [first, second]
                         else:
                             pending = [] if first is _end else [first]
                     except Exception as e:  # noqa: BLE001 - surface as 500
-                        return web.Response(status=500, text=repr(e))
-                    resp = web.StreamResponse(
-                        headers={"Content-Type": "text/event-stream",
-                                 "Cache-Control": "no-cache"})
+                        return _respond(web.Response(status=500, text=repr(e)),
+                                        stream=True)
+                    hdrs = {"Content-Type": "text/event-stream",
+                            "Cache-Control": "no-cache"}
+                    if traced:  # StreamResponse headers are fixed at prepare()
+                        hdrs["traceparent"] = traceparent_out
+                    resp = web.StreamResponse(headers=hdrs)
                     await resp.prepare(request)
 
                     async def write_chunk(chunk):
@@ -179,9 +255,15 @@ class ProxyActor:
                         telemetry.complete(
                             "serve.http", "serve", t0_wall,
                             time.perf_counter_ns() - t0_perf,
-                            route=prefix, method=request.method, stream=True)
+                            route=prefix, method=request.method, stream=True,
+                            trace_id=trace_id if traced else None)
+                    _finish_span(True, 200)
                     return resp
                 finally:
+                    # covers abrupt exits (client disconnect raising out of
+                    # prepare/write, task cancellation): the ingress span is
+                    # recorded exactly once either way
+                    _finish_span(True, 499)
                     if gen is not None:
                         stream_exec.submit(gen.close)
                     stream_exec.shutdown(wait=False)
@@ -190,15 +272,17 @@ class ProxyActor:
                 return handle.options(method_name="__http__").remote(request_dict).result()
 
             try:
-                result = await loop.run_in_executor(None, call)
+                result = await loop.run_in_executor(None, _in_ctx(call))
             except Exception as e:  # noqa: BLE001 - surface as 500
-                return web.Response(status=500, text=repr(e))
+                return _respond(web.Response(status=500, text=repr(e)),
+                                stream=False)
             _observe_ttft(prefix, (time.perf_counter_ns() - t0_perf) / 1e9)
             if telemetry.enabled():
                 telemetry.complete(
                     "serve.http", "serve", t0_wall,
                     time.perf_counter_ns() - t0_perf,
-                    route=prefix, method=request.method, stream=False)
+                    route=prefix, method=request.method, stream=False,
+                    trace_id=trace_id if traced else None)
             from .asgi import RAW_RESPONSE_KEY
 
             if isinstance(result, dict) and result.get(RAW_RESPONSE_KEY):
@@ -211,13 +295,14 @@ class ProxyActor:
                 for k, v in result["headers"]:
                     if k.lower() != "content-length":
                         hdrs.add(k, v)
-                return web.Response(status=result["status"], body=result["body"],
-                                    headers=hdrs)
+                return _respond(web.Response(status=result["status"],
+                                             body=result["body"], headers=hdrs),
+                                stream=False)
             if isinstance(result, (dict, list)):
-                return web.json_response(result)
+                return _respond(web.json_response(result), stream=False)
             if isinstance(result, bytes):
-                return web.Response(body=result)
-            return web.Response(text=str(result))
+                return _respond(web.Response(body=result), stream=False)
+            return _respond(web.Response(text=str(result)), stream=False)
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", handler)

@@ -42,6 +42,19 @@ class Replica:
     # -- request path ----------------------------------------------------------
     def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
         self._num_served += 1
+        from ray_tpu.util import tracing
+
+        if tracing.is_tracing_enabled():
+            # a named replica span under the worker's task:: span: the trace
+            # tree shows WHICH deployment served the request, and engine /
+            # data-plane telemetry recorded inside inherits the trace id
+            with tracing.span(f"replica.{self.deployment_name}",
+                              {"method": method_name or "__call__"}):
+                return self._handle_request_inner(method_name, args, kwargs)
+        return self._handle_request_inner(method_name, args, kwargs)
+
+    def _handle_request_inner(self, method_name: str, args: tuple,
+                              kwargs: dict) -> Any:
         from .multiplex import MULTIPLEX_KWARG, _set_multiplexed_model_id
 
         model_id = kwargs.pop(MULTIPLEX_KWARG, None)

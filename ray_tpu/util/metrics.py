@@ -49,12 +49,19 @@ class _Registry:
             return [m._export() for m in self._metrics.values()]
 
     def _ensure_push_thread(self) -> None:
-        """Workers push snapshots to the coordinator (no-op on the driver, whose
-        registry is read directly by the state API)."""
+        """Workers and remote client drivers push snapshots to the head; the
+        process HOLDING the cluster (in-process driver/head) must not — its
+        registry is read directly by the state API, and a self-push would
+        land a periodically-frozen copy in metrics_by_worker["driver"] that
+        the merge then counts AGAIN (doubling driver counters) and, for
+        gauges, writes over the live value with one up to a report interval
+        stale (same keying rule as telemetry._ensure_flush_thread)."""
         if self._push_thread is not None:
             return
         from ray_tpu.core import global_state
 
+        if global_state.try_cluster() is not None:
+            return
         w = global_state.try_worker()
         if w is None or not hasattr(w, "push_metrics"):
             return
@@ -243,17 +250,63 @@ def merge_snapshots(snaps: List[List[dict]]) -> Dict[str, dict]:
     return out
 
 
-def histogram_quantile(merged: dict, q: float) -> Optional[float]:
-    """Estimate the q-quantile (0..1) of a merged histogram metric across ALL
-    its tag sets, Prometheus histogram_quantile-style: find the bucket where
-    the cumulative count crosses q and interpolate linearly inside it. The
-    overflow bucket answers with its lower edge (no upper bound to lerp to).
-    Returns None for an empty histogram."""
+def _tags_match(key_tuple: Tuple, where: Optional[Dict[str, str]]) -> bool:
+    """Does this tag-set key (tuple of (k, v) pairs) satisfy the label filter?"""
+    if not where:
+        return True
+    tags = dict(key_tuple)
+    return all(tags.get(k) == v for k, v in where.items())
+
+
+def aggregate_buckets(merged: dict,
+                      where: Optional[Dict[str, str]] = None) -> List[int]:
+    """Sum a histogram metric's per-tag-set bucket counts into one vector,
+    optionally restricted to tag sets matching the `where` label filter
+    (e.g. {"route": "/chat"} to quantile serve_ttft_seconds per-route)."""
     bounds = merged.get("boundaries", [])
     agg = [0] * (len(bounds) + 1)
-    for v in merged.get("values", {}).values():
+    for key, v in merged.get("values", {}).items():
+        if not _tags_match(key, where):
+            continue
         for i, c in enumerate(v["buckets"]):
             agg[i] += c
+    return agg
+
+
+def histogram_counts_below(merged: dict, threshold: float,
+                           where: Optional[Dict[str, str]] = None
+                           ) -> Tuple[float, int]:
+    """(estimated observations <= threshold, total observations) for a merged
+    histogram — the good/total split behind latency SLO burn rates. The count
+    inside the bucket containing the threshold is linearly interpolated, like
+    histogram_quantile's inverse."""
+    bounds = merged.get("boundaries", [])
+    agg = aggregate_buckets(merged, where)
+    total = sum(agg)
+    if total <= 0:
+        return 0.0, 0
+    good = 0.0
+    for i, c in enumerate(agg):
+        lo = bounds[i - 1] if i > 0 else 0.0
+        hi = bounds[i] if i < len(bounds) else float("inf")
+        if threshold >= hi:
+            good += c
+        elif threshold > lo:
+            good += c * (threshold - lo) / (hi - lo)
+    return good, total
+
+
+def histogram_quantile(merged: dict, q: float,
+                       where: Optional[Dict[str, str]] = None
+                       ) -> Optional[float]:
+    """Estimate the q-quantile (0..1) of a merged histogram metric,
+    Prometheus histogram_quantile-style: find the bucket where the cumulative
+    count crosses q and interpolate linearly inside it. The overflow bucket
+    answers with its lower edge (no upper bound to lerp to). Aggregates
+    across ALL tag sets unless `where` narrows them (label filter, e.g.
+    {"route": "/chat"}). Returns None for an empty histogram."""
+    bounds = merged.get("boundaries", [])
+    agg = aggregate_buckets(merged, where)
     total = sum(agg)
     if total <= 0:
         return None

@@ -1,0 +1,278 @@
+"""Metrics history: the time dimension of the observability stack.
+
+util/metrics.py answers "how much since process start" — lifetime counters and
+lifetime histogram quantiles, which go stale the moment load changes. This
+module retains a bounded ring of timestamped FRAMES (each frame one merged
+cross-worker snapshot, sampled by the head-side scraper every
+RAY_TPU_METRICS_SCRAPE_INTERVAL_S) and answers the windowed questions control
+loops actually need:
+
+    rate("serve_request_seconds", 60)     requests/s over the last minute
+    delta("llm_prefix_cache_hits_total", 60)
+    quantile("serve_ttft_seconds", 0.99, 60)
+                                          windowed p99: bucket-DIFFERENCE the
+                                          frame nearest (now-60s) from the
+                                          latest frame, then quantile the
+                                          difference — the recent regime, not
+                                          the lifetime blur
+
+The ring is bounded (RAY_TPU_METRICS_HISTORY_SIZE frames) and the scraper
+lives in core/node.py's Cluster (head process), so every consumer —
+`state.metrics_history()`, dashboard `/api/history`, `ray-tpu status --watch`
+sparklines, the SLO engine (util/slo.py) — reads ONE retained history.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.util import metrics as _m
+
+logger = logging.getLogger("ray_tpu.metrics_history")
+
+
+def diff_histogram(new: Dict[str, Any],
+                   prev: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """new-minus-prev for ONE histogram metric dict: per tag set,
+    new.buckets - prev.buckets (clamped at 0; missing-in-prev = all new),
+    with prev re-binned first when its boundaries drifted (same rule as
+    merge_snapshots — never zip-truncate). prev=None means "everything is
+    new". THE single bucket-differencing implementation: windowed quantiles
+    (histogram_delta) and the dashboard's frame-over-frame series
+    (state.history_series) both call this, so the edge-case rules cannot
+    diverge. Returns a merged-metric-shaped dict; tag sets with no new
+    observations are dropped."""
+    dst_bounds = list(new.get("boundaries", []))
+    old_values = (prev or {}).get("values", {})
+    src_bounds = list((prev or {}).get("boundaries", dst_bounds))
+    rebin = src_bounds != dst_bounds
+    out_values: Dict[Tuple, Dict[str, Any]] = {}
+    for key, nv in new.get("values", {}).items():
+        ov = old_values.get(key)
+        if ov is None:
+            buckets = list(nv["buckets"])
+            s, c = nv["sum"], nv["count"]
+        else:
+            ob = (_m._rebin(ov["buckets"], src_bounds, dst_bounds)
+                  if rebin else ov["buckets"])
+            buckets = [max(0, a - b) for a, b in zip(nv["buckets"], ob)]
+            s = max(0.0, nv["sum"] - ov["sum"])
+            c = max(0, nv["count"] - ov["count"])
+        if c > 0:
+            out_values[key] = {"buckets": buckets, "sum": s, "count": c}
+    return {"name": new.get("name"), "type": "histogram",
+            "description": new.get("description", ""),
+            "boundaries": dst_bounds, "values": out_values}
+
+
+class MetricsHistory:
+    """Bounded ring of {ts, metrics} frames with windowed readers.
+
+    Thread-safe: the scraper records while readers (state API, SLO engine,
+    dashboard) difference frames concurrently.
+    """
+
+    def __init__(self, maxlen: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._frames: deque = deque(maxlen=self._want_maxlen(maxlen))
+        self._fixed_maxlen = maxlen
+
+    @staticmethod
+    def _want_maxlen(explicit: Optional[int]) -> int:
+        if explicit is not None:
+            return max(2, int(explicit))
+        try:
+            from ray_tpu.config import CONFIG
+
+            return max(2, int(CONFIG.metrics_history_size))
+        except Exception:
+            return 360
+
+    # ------------------------------------------------------------- recording
+
+    def record(self, merged: Dict[str, dict],
+               ts: Optional[float] = None) -> Dict[str, Any]:
+        """Append one frame (a merged metrics snapshot as produced by
+        metrics.merge_snapshots). Returns the stored frame."""
+        frame = {"ts": time.time() if ts is None else float(ts),
+                 "metrics": merged}
+        with self._lock:
+            want = self._want_maxlen(self._fixed_maxlen)
+            if self._frames.maxlen != want:
+                self._frames = deque(self._frames, maxlen=want)
+            self._frames.append(frame)
+        return frame
+
+    def clear(self) -> None:
+        with self._lock:
+            self._frames.clear()
+
+    # --------------------------------------------------------------- reading
+
+    def frames(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._frames)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._frames)
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._frames[-1] if self._frames else None
+
+    def window_pair(self, window_s: float, now: Optional[float] = None
+                    ) -> Optional[Tuple[Dict[str, Any], Dict[str, Any]]]:
+        """(old, new): the newest frame and the frame closest to
+        new.ts - window_s (clamped to the oldest retained). None with fewer
+        than two frames — a window needs two points to difference."""
+        with self._lock:
+            if len(self._frames) < 2:
+                return None
+            frames = list(self._frames)
+        new = frames[-1]
+        target = (new["ts"] if now is None else float(now)) - float(window_s)
+        # closest-to-target frame (excluding the newest): a frame 0.1s after
+        # the window boundary beats one a full scrape interval before it
+        old = min(frames[:-1], key=lambda f: abs(f["ts"] - target))
+        return old, new
+
+    # ------------------------------------------------- windowed counter math
+
+    @staticmethod
+    def _counter_total(frame: Dict[str, Any], name: str,
+                       where: Optional[Dict[str, str]] = None) -> float:
+        m = frame["metrics"].get(name)
+        if m is None:
+            return 0.0
+        if m["type"] == "histogram":
+            return float(sum(v["count"] for k, v in m["values"].items()
+                             if _m._tags_match(k, where)))
+        return float(sum(v for k, v in m["values"].items()
+                         if _m._tags_match(k, where)))
+
+    def delta(self, name: str, window_s: float,
+              where: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Counter (or histogram count) increase across the window. None when
+        the history can't answer (fewer than 2 frames)."""
+        pair = self.window_pair(window_s)
+        if pair is None:
+            return None
+        old, new = pair
+        return max(0.0, self._counter_total(new, name, where)
+                   - self._counter_total(old, name, where))
+
+    def rate(self, name: str, window_s: float,
+             where: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Per-second increase over the window (delta / ACTUAL frame spacing,
+        not the nominal window — frames land where the scraper put them)."""
+        pair = self.window_pair(window_s)
+        if pair is None:
+            return None
+        old, new = pair
+        dt = new["ts"] - old["ts"]
+        if dt <= 0:
+            return None
+        d = max(0.0, self._counter_total(new, name, where)
+                - self._counter_total(old, name, where))
+        return d / dt
+
+    # ----------------------------------------------- windowed histogram math
+
+    def histogram_delta(self, name: str, window_s: float
+                        ) -> Optional[Dict[str, Any]]:
+        """The histogram of ONLY the window's observations, built by
+        bucket-differencing the two frames bracketing the window: for each tag
+        set, new.buckets - old.buckets (missing-in-old = all new). Boundary
+        drift between frames (a process re-registered with different buckets)
+        re-bins the old frame onto the new frame's boundaries first. Returns a
+        merged-metric-shaped dict usable with metrics.histogram_quantile."""
+        pair = self.window_pair(window_s)
+        if pair is None:
+            return None
+        old_f, new_f = pair
+        new = new_f["metrics"].get(name)
+        if new is None or new.get("type") != "histogram":
+            return None
+        out = diff_histogram(new, old_f["metrics"].get(name))
+        out["window_s"] = new_f["ts"] - old_f["ts"]
+        return out
+
+    def quantile(self, name: str, q: float, window_s: float,
+                 where: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Windowed quantile: p99 of the LAST window_s seconds of
+        observations, not the process lifetime."""
+        diff = self.histogram_delta(name, window_s)
+        if diff is None:
+            return None
+        return _m.histogram_quantile(diff, q, where=where)
+
+    def counts_below(self, name: str, threshold: float, window_s: float,
+                     where: Optional[Dict[str, str]] = None
+                     ) -> Optional[Tuple[float, int]]:
+        """(observations <= threshold, total) within the window — the
+        good/total split latency SLO burn rates are computed from."""
+        diff = self.histogram_delta(name, window_s)
+        if diff is None:
+            return None
+        return _m.histogram_counts_below(diff, threshold, where=where)
+
+    def gauge_values(self, name: str, window_s: float,
+                     where: Optional[Dict[str, str]] = None) -> List[float]:
+        """Per-frame aggregate (sum across matching tag sets) of a gauge over
+        the window — queue-depth saturation SLOs sample these."""
+        frames = self.frames()
+        if not frames:
+            return []
+        now = frames[-1]["ts"]
+        out = []
+        for f in frames:
+            if f["ts"] < now - window_s:
+                continue
+            m = f["metrics"].get(name)
+            if m is None:
+                continue
+            out.append(float(sum(v for k, v in m["values"].items()
+                                 if _m._tags_match(k, where))))
+        return out
+
+
+# ----------------------------------------------------------------- scraper
+
+def scraper_loop(history: MetricsHistory, snapshot_fn, is_shutdown,
+                 on_frame=None, tick_s: float = 0.25) -> None:
+    """Head-side scrape loop body (run on a daemon thread by core/node.py):
+    every CONFIG.metrics_scrape_interval_s, sample snapshot_fn() into the
+    ring and invoke on_frame (the SLO engine's evaluate hook). The interval
+    is re-read each tick so tests/operators can retune a live cluster; an
+    interval <= 0 disables scraping but keeps the thread parked cheaply."""
+    from ray_tpu.config import CONFIG
+
+    last = 0.0
+    last_warn = 0.0
+    while not is_shutdown():
+        try:
+            interval = float(CONFIG.metrics_scrape_interval_s)
+        except Exception:
+            interval = 5.0
+        now = time.time()
+        if interval > 0 and now - last >= interval:
+            last = now
+            try:
+                history.record(snapshot_fn(), ts=now)
+                if on_frame is not None:
+                    on_frame()
+            except Exception as e:  # noqa: BLE001
+                # observability must never take the head down — but a
+                # persistently failing scrape silently freezes the history
+                # AND every SLO, so log it (throttled, same convention as
+                # tracing's dropped-span warning)
+                if time.monotonic() - last_warn >= 30.0:
+                    last_warn = time.monotonic()
+                    logger.warning("metrics-history scrape failed (history "
+                                   "frozen, SLOs stale until it recovers): %r",
+                                   e)
+        time.sleep(min(tick_s, interval) if interval > 0 else tick_s)

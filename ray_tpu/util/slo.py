@@ -1,0 +1,263 @@
+"""Declarative SLOs evaluated over the metrics history, with Google-SRE-style
+multi-window burn rates.
+
+An SLO says "objective fraction of events must be good over window seconds":
+
+    SLO("ttft", metric="serve_ttft_seconds", objective=0.99,
+        threshold=0.5, window_s=60.0)                      # latency: p99<=500ms
+    SLO("errors", metric="serve_errors_total", objective=0.999,
+        total_metric="serve_requests_total", kind="error_rate")
+    SLO("queue", metric="serve_queue_depth", objective=0.9,
+        threshold=16, kind="gauge")                        # saturation
+
+Evaluation (util/metrics_history.py frames, refreshed by the head scraper):
+the bad-event fraction over the window is divided by the error budget
+(1 - objective) to give a BURN RATE — 1.0 means budget consumed exactly at
+the sustainable pace, 10 means the budget gone in window/10. Following the
+SRE-workbook multi-window rule, an SLO only flips to "burning" when BOTH the
+long window (window_s) and the short window (window_s / 4, floor one scrape
+interval) exceed burn_threshold — the short window makes the signal fast, the
+long window keeps a single straggler from paging. This status is the control
+input the serve autoscaler / router closed loop consumes: read it via
+state.slo_status(), poll /api/slo, or register a subscribe_slo() callback to
+get transitions pushed (called from the scraper thread, head process).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("ray_tpu.slo")
+
+VALID_KINDS = ("latency", "error_rate", "gauge")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One objective. kind:
+    - "latency": `metric` is a histogram; good = observation <= threshold
+      seconds. objective 0.99 + threshold 0.5 reads "p99 of TTFT <= 500 ms".
+    - "error_rate": `metric` counts bad events, `total_metric` all events;
+      good fraction = 1 - delta(metric)/delta(total_metric).
+    - "gauge": good = frames where the (summed) gauge <= threshold;
+      objective is the fraction of frames that must be good.
+    `where` narrows to matching tag sets (e.g. {"route": "/chat"})."""
+
+    name: str
+    metric: str
+    objective: float
+    threshold: float = 0.0
+    window_s: float = 60.0
+    kind: str = "latency"
+    total_metric: Optional[str] = None
+    where: Optional[Dict[str, str]] = None
+    burn_threshold: float = 1.0
+    short_window_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.kind not in VALID_KINDS:
+            raise ValueError(f"SLO kind must be one of {VALID_KINDS}, "
+                             f"got {self.kind!r}")
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError("objective must be in (0, 1) — it is the GOOD "
+                             "fraction, e.g. 0.99")
+        if self.kind == "error_rate" and not self.total_metric:
+            raise ValueError("error_rate SLOs need total_metric (the "
+                             "denominator counter)")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+    def short_window(self, scrape_interval_s: float) -> float:
+        if self.short_window_s is not None:
+            return self.short_window_s
+        # floor at one scrape interval: a shorter window than the frame
+        # spacing would always difference the same two frames as "long"
+        return max(self.window_s / 4.0, scrape_interval_s)
+
+
+class SLOEngine:
+    """Registry + evaluator. evaluate() runs after every scrape (head-side
+    scraper thread); status transitions fan out to subscribe() callbacks."""
+
+    def __init__(self, history):
+        self._history = history
+        self._lock = threading.Lock()
+        self._slos: Dict[str, SLO] = {}
+        self._subs: List[Callable[[dict], None]] = []
+        self._status: Dict[str, Dict[str, Any]] = {}
+
+    # ------------------------------------------------------------- registry
+
+    def register(self, slo: SLO) -> SLO:
+        with self._lock:
+            self._slos[slo.name] = slo
+            self._status.pop(slo.name, None)  # re-registering resets state
+        return slo
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            self._status.pop(name, None)
+            return self._slos.pop(name, None) is not None
+
+    def slos(self) -> List[SLO]:
+        with self._lock:
+            return list(self._slos.values())
+
+    def subscribe(self, callback: Callable[[dict], None]) -> Callable[[], None]:
+        """callback(transition_dict) on every ok<->burning flip, invoked from
+        the scraper thread. Returns an unsubscribe function."""
+        with self._lock:
+            self._subs.append(callback)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._subs.remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    # ------------------------------------------------------------ evaluation
+
+    def _bad_fraction(self, slo: SLO, window_s: float) -> Optional[float]:
+        """Fraction of bad events in the window, or None when the history
+        has no signal for it (no traffic / not enough frames)."""
+        h = self._history
+        if slo.kind == "latency":
+            split = h.counts_below(slo.metric, slo.threshold, window_s,
+                                   where=slo.where)
+            if split is None:
+                return None
+            good, total = split
+            if total <= 0:
+                return None
+            return max(0.0, 1.0 - good / total)
+        if slo.kind == "error_rate":
+            bad = h.delta(slo.metric, window_s, where=slo.where)
+            total = h.delta(slo.total_metric, window_s, where=slo.where)
+            if bad is None or total is None or total <= 0:
+                return None
+            return min(1.0, bad / total)
+        # gauge saturation: fraction of frames over the threshold
+        vals = h.gauge_values(slo.metric, window_s, where=slo.where)
+        if not vals:
+            return None
+        return sum(1 for v in vals if v > slo.threshold) / len(vals)
+
+    def _evaluate_one(self, slo: SLO, scrape_interval_s: float
+                      ) -> Dict[str, Any]:
+        long_bad = self._bad_fraction(slo, slo.window_s)
+        short_bad = self._bad_fraction(slo, slo.short_window(scrape_interval_s))
+        budget = slo.budget
+
+        def burn(bad):
+            return None if bad is None else bad / budget
+
+        burn_long, burn_short = burn(long_bad), burn(short_bad)
+        if burn_long is None:
+            state = "no_data"
+        elif (burn_long >= slo.burn_threshold
+              and burn_short is not None
+              and burn_short >= slo.burn_threshold):
+            # multi-window rule: BOTH windows must exceed the threshold. A
+            # short window with no events means the burn is not still
+            # happening — staying "burning" on long-window residue alone
+            # would keep paging/scaling for a full window after recovery
+            state = "burning"
+        else:
+            state = "ok"
+        out: Dict[str, Any] = {
+            "name": slo.name, "metric": slo.metric, "kind": slo.kind,
+            "objective": slo.objective, "threshold": slo.threshold,
+            "window_s": slo.window_s, "state": state,
+            "burn_rate_long": burn_long, "burn_rate_short": burn_short,
+            "bad_fraction": long_bad, "budget": budget,
+        }
+        if slo.kind == "latency":
+            out["observed"] = self._history.quantile(
+                slo.metric, slo.objective, slo.window_s, where=slo.where)
+        return out
+
+    def evaluate(self) -> Dict[str, Dict[str, Any]]:
+        """Evaluate every registered SLO against the current history; fire
+        subscriber callbacks for state transitions. Called by the scraper
+        after each frame; safe to call ad hoc (tests, state API)."""
+        try:
+            from ray_tpu.config import CONFIG
+
+            interval = max(0.05, float(CONFIG.metrics_scrape_interval_s))
+        except Exception:
+            interval = 5.0
+        with self._lock:
+            slos = list(self._slos.values())
+            prev = {k: v.get("state") for k, v in self._status.items()}
+            subs = list(self._subs)
+        transitions = []
+        status = {}
+        for slo in slos:
+            try:
+                row = self._evaluate_one(slo, interval)
+            except Exception as e:  # a malformed metric must not stop the rest
+                row = {"name": slo.name, "state": "error", "error": repr(e)}
+            row["evaluated_at"] = time.time()
+            status[slo.name] = row
+            was, now = prev.get(slo.name), row["state"]
+            # a just-registered SLO (was None) fires only when it lands
+            # BURNING: registering mid-incident must reach the subscriber
+            # immediately, while a healthy first evaluation stays quiet
+            if was != now and (was is not None or now == "burning"):
+                transitions.append({"name": slo.name, "from": was, "to": now,
+                                    "at": row["evaluated_at"], "status": row})
+        with self._lock:
+            self._status = status
+        for t in transitions:
+            for cb in subs:
+                try:
+                    cb(t)
+                except Exception:
+                    logger.warning("slo subscriber %r raised for %s",
+                                   cb, t["name"], exc_info=True)
+        return status
+
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return dict(self._status)
+
+
+# ------------------------------------------------------- module-level surface
+
+def _engine() -> SLOEngine:
+    from ray_tpu.core import global_state
+
+    c = global_state.try_cluster()
+    if c is None:
+        raise RuntimeError("ray_tpu is not initialized (SLOs are registered "
+                           "on the head; call ray_tpu.init() first)")
+    return c.slo_engine
+
+
+def register(slo: SLO) -> SLO:
+    """Register (or replace) an SLO on the head's engine."""
+    return _engine().register(slo)
+
+
+def remove(name: str) -> bool:
+    return _engine().remove(name)
+
+
+def subscribe_slo(callback: Callable[[dict], None]) -> Callable[[], None]:
+    """Push-mode SLO transitions: callback({name, from, to, at, status}) on
+    every ok<->burning flip (invoked from the head's scraper thread — keep it
+    quick and never raise). The autoscaler/router closed loop hangs off this
+    hook. Returns an unsubscribe function. Head-process only."""
+    return _engine().subscribe(callback)
+
+
+def slo_status() -> Dict[str, Dict[str, Any]]:
+    return _engine().status()

@@ -437,6 +437,274 @@ def cluster_status() -> Dict[str, Any]:
     return status
 
 
+# ------------------------------------------------------------ metrics history
+
+@_remoteable
+def metrics_history(window_s: float = 60.0) -> Dict[str, Any]:
+    """The head's retained metrics-history frames plus the windowed signals
+    derived from them (util/metrics_history.py). Each frame is one merged
+    cross-worker snapshot sampled by the background scraper
+    (RAY_TPU_METRICS_SCRAPE_INTERVAL_S); `windowed` carries the
+    bucket-differenced quantiles/rates over the last `window_s` seconds —
+    the recent regime, not the lifetime blur lifetime counters give."""
+    from ray_tpu.config import CONFIG
+
+    c = _cluster()
+    h = c.metrics_history
+    windowed = {
+        "serve_ttft_p50_s": h.quantile("serve_ttft_seconds", 0.5, window_s),
+        "serve_ttft_p99_s": h.quantile("serve_ttft_seconds", 0.99, window_s),
+        "serve_requests_per_s": h.rate("serve_request_seconds", window_s),
+        "llm_ttft_p99_s": h.quantile("llm_ttft_seconds", 0.99, window_s),
+        "transfer_bytes_per_s": h.rate("transfer_bytes_total", window_s),
+        "collective_ops_per_s": h.rate("collective_ops_total", window_s),
+    }
+    return {
+        "frames": h.frames(),
+        "scrape_interval_s": CONFIG.metrics_scrape_interval_s,
+        "window_s": window_s,
+        "windowed": windowed,
+    }
+
+
+@_remoteable
+def history_series(window_s: float = 300.0) -> Dict[str, Any]:
+    """JSON-safe per-frame time series for dashboards/sparklines
+    (`/api/history`, `ray-tpu status --watch`): one timestamp list plus one
+    value list per signal (None where a frame has no data). Derived signals
+    (rates, windowed quantiles) are computed FRAME-over-frame so the series
+    shows load shifts, not lifetime averages."""
+    from ray_tpu.util import metrics as m
+
+    c = _cluster()
+    h = c.metrics_history
+    all_frames = h.frames()
+    # frame-over-frame values need each frame's PREDECESSOR, so include ONE
+    # frame before the window as a differencing seed (its own output is
+    # discarded) — without it the first in-window point would difference
+    # against nothing and show a lifetime value (a phantom spike at the
+    # window edge); deriving over the ENTIRE ring instead would do
+    # history_size/window times the needed bucket-difference work per hit
+    if all_frames:
+        newest = all_frames[-1]["ts"]
+        keep = [i for i, f in enumerate(all_frames)
+                if f["ts"] >= newest - window_s]
+    else:
+        keep = []
+    start = max(0, keep[0] - 1) if keep else 0
+    frames = all_frames[start:]
+    keep = [i - start for i in keep]
+    ts = [round(frames[i]["ts"], 3) for i in keep]
+
+    def sliced(series):
+        return [series[i] for i in keep]
+
+    def counter_total(frame, name):
+        mm = frame["metrics"].get(name)
+        if mm is None:
+            return None
+        if mm["type"] == "histogram":
+            return float(sum(v["count"] for v in mm["values"].values()))
+        return float(sum(mm["values"].values()))
+
+    def gauge_sum(frame, name):
+        mm = frame["metrics"].get(name)
+        if mm is None:
+            return None
+        return float(sum(mm["values"].values()))
+
+    def per_s(name):
+        out, prev = [], None
+        for f in frames:
+            cur = counter_total(f, name)
+            if cur is None or prev is None or f["ts"] <= prev[0]:
+                out.append(None)
+            else:
+                out.append(round(max(0.0, cur - prev[1]) / (f["ts"] - prev[0]), 3))
+            if cur is not None:
+                prev = (f["ts"], cur)
+        return out
+
+    def frame_quantile(name, q):
+        """q-quantile of each frame's NEW observations (bucket difference
+        against the previous frame that carried the histogram — ONE shared
+        implementation: metrics_history.diff_histogram). The very first
+        retained frame has no predecessor -> None, never a lifetime value; a
+        metric first appearing later differences against the implicit zero
+        of "didn't exist yet", which is exact."""
+        from ray_tpu.util.metrics_history import diff_histogram
+
+        out, prev = [], None
+        for i, f in enumerate(frames):
+            mm = f["metrics"].get(name)
+            if mm is None or mm.get("type") != "histogram":
+                out.append(None)
+                continue
+            if prev is None and i == 0:
+                # the ring may have evicted history: differencing the first
+                # retained frame would show a lifetime value
+                out.append(None)
+                prev = mm
+                continue
+            q_v = m.histogram_quantile(diff_histogram(mm, prev), q)
+            out.append(round(q_v, 6) if q_v is not None else None)
+            prev = mm
+        return out
+
+    return {
+        "ts": ts,
+        "series": {
+            "serve_ttft_p99_s": sliced(frame_quantile("serve_ttft_seconds", 0.99)),
+            "serve_requests_per_s": sliced(per_s("serve_request_seconds")),
+            "llm_ttft_p99_s": sliced(frame_quantile("llm_ttft_seconds", 0.99)),
+            "transfer_bytes_per_s": sliced(per_s("transfer_bytes_total")),
+            "collective_ops_per_s": sliced(per_s("collective_ops_total")),
+            "serve_queue_depth": sliced([gauge_sum(f, "serve_queue_depth")
+                                         for f in frames]),
+        },
+    }
+
+
+@_remoteable
+def slo_status() -> Dict[str, Dict[str, Any]]:
+    """Current state of every registered SLO (util/slo.py): burn rates over
+    the long/short windows, ok|burning|no_data, the windowed observed value.
+    The autoscaler/router closed loop polls this (or subscribes head-side via
+    slo.subscribe_slo)."""
+    return _cluster().slo_engine.status()
+
+
+# -------------------------------------------------------- request-scoped trace
+
+_PHASES = ("queue", "prefill", "decode", "transfer")
+
+
+def _phase_of(name: str, cat: str = "") -> Optional[str]:
+    """Critical-path bucket for a span/event name. Container spans (serve
+    ingress, task execution) stay None — they ARE the wall clock being
+    attributed, not a phase of it."""
+    if name == "llm.queue":
+        return "queue"
+    if name == "llm.prefill":
+        return "prefill"
+    if name == "llm.decode":
+        return "decode"
+    if name.startswith("transfer.") or cat == "transfer":
+        return "transfer"
+    return None
+
+
+def _attribute(intervals: List, t0: float, t1: float) -> Dict[str, float]:
+    """Sweep [t0, t1]: each elementary segment is charged to the
+    highest-priority phase covering it (queue > prefill > decode > transfer),
+    remainder to "other" — phases stay disjoint, so the attribution sums to
+    the window EXACTLY even when phase spans overlap."""
+    marks = {t0, t1}
+    clipped = []
+    for s, e, phase in intervals:
+        s, e = max(s, t0), min(e, t1)
+        if e > s:
+            clipped.append((s, e, phase))
+            marks.add(s)
+            marks.add(e)
+    pts = sorted(marks)
+    out = {p: 0.0 for p in _PHASES}
+    out["other"] = 0.0
+    prio = {p: i for i, p in enumerate(_PHASES)}
+    for a, b in zip(pts, pts[1:]):
+        mid = (a + b) / 2
+        covering = [phase for s, e, phase in clipped if s <= mid < e]
+        phase = min(covering, key=lambda p: prio[p]) if covering else "other"
+        out[phase] += b - a
+    return {k: round(v, 6) for k, v in out.items()}
+
+
+@_remoteable
+def request_trace(trace_id: str) -> Dict[str, Any]:
+    """Reconstruct one request's critical path: every tracing span with this
+    trace_id (proxy ingress -> handle -> replica -> engine, across
+    processes), every telemetry event tagged with it (data-plane pulls,
+    engine queue/prefill/decode phases), the span tree, and a wall-time
+    attribution over queue/prefill/decode/transfer/other that sums to the
+    root span's duration. `ray-tpu trace <trace_id>` renders this."""
+    spans = [s for s in get_trace() if s.get("trace_id") == trace_id]
+    events = [e for e in get_telemetry()
+              if (e.get("args") or {}).get("trace_id") == trace_id]
+    if not spans and not events:
+        return {"trace_id": trace_id, "found": False, "spans": [],
+                "events": [], "processes": [], "attribution": {},
+                "total_s": 0.0}
+
+    by_id = {s["span_id"]: s for s in spans}
+    children: Dict[str, List[dict]] = {}
+    roots = []
+    for s in spans:
+        parent = s.get("parent_span_id", "")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    for kids in children.values():
+        kids.sort(key=lambda s: s["start_time"])
+    roots.sort(key=lambda s: s["start_time"])
+
+    # the attribution window: the earliest root span (the ingress) — or the
+    # envelope of everything collected when only telemetry events matched
+    ev_bounds = [(e["ts_ns"] / 1e9, (e["ts_ns"] + (e["dur_ns"] or 0)) / 1e9)
+                 for e in events]
+    if roots:
+        t0 = roots[0]["start_time"]
+        t1 = max(r.get("end_time", t0) for r in roots)
+    else:
+        t0 = min(b[0] for b in ev_bounds)
+        t1 = max(b[1] for b in ev_bounds)
+
+    intervals = []
+    for e in events:
+        phase = _phase_of(e.get("name", ""), e.get("cat", ""))
+        if phase and e.get("dur_ns"):
+            s = e["ts_ns"] / 1e9
+            intervals.append((s, s + e["dur_ns"] / 1e9, phase))
+    for s in spans:
+        phase = _phase_of(s.get("name", ""))
+        if phase and "end_time" in s:
+            intervals.append((s["start_time"], s["end_time"], phase))
+
+    tree = []
+
+    def walk(span, depth):
+        tree.append({
+            "name": span["name"], "span_id": span["span_id"],
+            "parent_span_id": span.get("parent_span_id", ""),
+            "depth": depth, "pid": span.get("pid"),
+            "start_s": round(span["start_time"] - t0, 6),
+            "dur_s": round(span.get("end_time", span["start_time"])
+                           - span["start_time"], 6),
+            "attributes": span.get("attributes", {}),
+        })
+        for kid in children.get(span["span_id"], ()):
+            walk(kid, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+
+    procs = sorted({f"pid-{s['pid']}" for s in spans if s.get("pid")}
+                   | {e["proc"] for e in events if e.get("proc")})
+    return {
+        "trace_id": trace_id,
+        "found": True,
+        "total_s": round(t1 - t0, 6),
+        "attribution": _attribute(intervals, t0, t1),
+        "spans": tree,
+        "events": [{"name": e.get("name"), "cat": e.get("cat"),
+                    "proc": e.get("proc"), "start_s": round(e["ts_ns"] / 1e9 - t0, 6),
+                    "dur_s": round((e.get("dur_ns") or 0) / 1e9, 6),
+                    "phase": _phase_of(e.get("name", ""), e.get("cat", ""))}
+                   for e in sorted(events, key=lambda e: e["ts_ns"])],
+        "processes": procs,
+    }
+
+
 # -------------------------------------------------------------------- timeline
 
 @_remoteable

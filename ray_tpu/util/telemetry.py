@@ -96,6 +96,29 @@ def _resize_ring_locked() -> None:
 
 # ------------------------------------------------------------------ recording
 
+def _active_trace_id() -> Optional[str]:
+    """The caller's request-scoped trace id (util/tracing.py contextvar), or
+    None. Events recorded inside a traced request are tagged with it so
+    state.request_trace can attribute data-plane pulls / engine phases to the
+    request's critical path. Pure read — never starts a trace."""
+    try:
+        from ray_tpu.util import tracing
+
+        return tracing.current_trace_id()
+    except Exception:
+        return None
+
+
+def _tag_trace(args: Dict[str, Any]) -> Dict[str, Any]:
+    if "trace_id" not in args:
+        tid = _active_trace_id()
+        if tid is not None:
+            args["trace_id"] = tid
+    elif args["trace_id"] is None:
+        del args["trace_id"]  # explicit "untraced" from a lifecycle recorder
+    return args
+
+
 def _append(rec: dict) -> None:
     global _dropped
     with _lock:
@@ -112,7 +135,7 @@ def event(name: str, cat: str = "app", **args: Any) -> None:
         return
     _append({
         "name": name, "cat": cat, "ts_ns": time.time_ns(), "dur_ns": None,
-        "tid": threading.current_thread().name, "args": args or {},
+        "tid": threading.current_thread().name, "args": _tag_trace(args or {}),
     })
 
 
@@ -132,6 +155,9 @@ class _Span:
         self.args.update(kw)
 
     def __enter__(self) -> "_Span":
+        # the trace tag is captured at ENTRY (the request thread); __exit__
+        # may run after the contextvar was reset
+        _tag_trace(self.args)
         self._t0_wall = time.time_ns()
         self._t0_perf = time.perf_counter_ns()
         return self
@@ -181,7 +207,7 @@ def complete(name: str, cat: str, start_wall_ns: int, dur_ns: int,
     _append({
         "name": name, "cat": cat, "ts_ns": int(start_wall_ns),
         "dur_ns": int(dur_ns), "tid": threading.current_thread().name,
-        "args": args or {},
+        "args": _tag_trace(args or {}),
     })
 
 

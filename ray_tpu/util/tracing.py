@@ -17,11 +17,15 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import logging
 import os
+import re
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("ray_tpu.tracing")
 
 _ENV = "RAY_TPU_TRACING"
 _enabled = False
@@ -30,6 +34,11 @@ _lock = threading.Lock()
 
 # (trace_id, span_id) of the active span in this thread/task
 _ctx: contextvars.ContextVar = contextvars.ContextVar("rt_trace_ctx", default=None)
+
+# W3C Trace Context (https://www.w3.org/TR/trace-context/):
+# traceparent = version "-" trace-id(32 hex) "-" parent-id(16 hex) "-" flags
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
 
 
 def enable_tracing() -> None:
@@ -40,9 +49,14 @@ def enable_tracing() -> None:
 
 
 def is_tracing_enabled() -> bool:
+    """Globally enabled, OR a trace context is actively set in this thread/
+    task — an explicitly propagated context (serve ingress traceparent,
+    TaskSpec.trace_ctx) is self-sufficient for ITS request without flipping
+    any process-wide switch: no feedback loop, because get_trace_context only
+    MINTS a fresh context when one of the global switches is on."""
     from ray_tpu.config import CONFIG
 
-    return _enabled or CONFIG.tracing
+    return _enabled or CONFIG.tracing or _ctx.get() is not None
 
 
 def get_trace_context() -> Optional[Dict[str, str]]:
@@ -61,6 +75,42 @@ def set_trace_context(ctx: Optional[Dict[str, str]]):
     if ctx is None:
         return None
     return _ctx.set((ctx["trace_id"], ctx.get("parent_span_id", "")))
+
+
+def current_trace_id() -> Optional[str]:
+    """The active trace id in this thread/task, or None — a pure read: unlike
+    get_trace_context it never STARTS a trace, so hot-path probes (telemetry
+    event tagging) can call it per event without minting contexts."""
+    if not is_tracing_enabled():
+        return None
+    cur = _ctx.get()
+    return cur[0] if cur else None
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Dict[str, str]]:
+    """W3C `traceparent` header -> a propagatable trace context (the serve
+    HTTP ingress accepts these so external callers can stitch our spans into
+    their own traces). Malformed headers are ignored, per spec."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    trace_id, parent_id = m.group(1), m.group(2)
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None  # all-zero ids are invalid per spec
+    return {"trace_id": trace_id, "parent_span_id": parent_id}
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """Render a context as a W3C traceparent (version 00, sampled flag).
+    span_id shorter than 16 hex (or empty, a root) is zero-padded LEFT so the
+    header stays spec-shaped."""
+    return f"00-{trace_id:0>32}-{(span_id or '0'):0>16}-01"
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
 
 
 @contextlib.contextmanager
@@ -92,11 +142,46 @@ def span(name: str, attributes: Optional[Dict[str, Any]] = None):
         _maybe_flush()
 
 
+def record_complete_span(name: str, start_time: float, end_time: float,
+                         trace_id: str, span_id: str, parent_span_id: str = "",
+                         attributes: Optional[Dict[str, Any]] = None) -> dict:
+    """Record a span whose timing the caller measured itself — request
+    lifecycles that start and end on different threads (the serve HTTP proxy
+    brackets a request across its event loop and executor threads)."""
+    rec = {
+        "name": name, "trace_id": trace_id, "span_id": span_id,
+        "parent_span_id": parent_span_id, "start_time": float(start_time),
+        "end_time": float(end_time), "attributes": dict(attributes or {}),
+        "pid": os.getpid(),
+    }
+    with _lock:
+        _local_spans.append(rec)
+    _maybe_flush()
+    return rec
+
+
 def drain_local_spans() -> List[dict]:
     with _lock:
         out = list(_local_spans)
         _local_spans.clear()
     return out
+
+
+def _clock_offset_s() -> float:
+    """head_clock - local_clock, from the telemetry plane's one-per-process
+    NTP-style handshake: span timestamps are shifted onto the HEAD's clock at
+    push, so request spans from different hosts land correctly on the merged
+    telemetry_timeline instead of skewing per-host."""
+    try:
+        from ray_tpu.util import telemetry
+
+        return telemetry.clock_offset_ns() / 1e9
+    except Exception:
+        return 0.0
+
+
+_flush_warn_interval_s = 30.0
+_last_flush_warning = [0.0]  # monotonic stamp of the last logged push failure
 
 
 def _maybe_flush() -> None:
@@ -110,8 +195,22 @@ def _maybe_flush() -> None:
     if w is None or not hasattr(w, "push_spans") or global_state.try_cluster() is not None:
         return
     spans = drain_local_spans()
-    if spans:
-        try:
-            w.push_spans(spans)
-        except Exception:
-            pass
+    if not spans:
+        return
+    off = _clock_offset_s()
+    if off:
+        for s in spans:
+            s["start_time"] += off
+            if "end_time" in s:
+                s["end_time"] += off
+    try:
+        w.push_spans(spans)
+    except Exception as e:  # noqa: BLE001 — pipe closed / head gone
+        # the spans are already drained, i.e. LOST: log it (throttled, same
+        # convention as the telemetry ring's overflow warning) so dropped
+        # traces are diagnosable instead of silently vanishing
+        now = time.monotonic()
+        if now - _last_flush_warning[0] >= _flush_warn_interval_s:
+            _last_flush_warning[0] = now
+            logger.warning(
+                "push_spans failed, %d span(s) dropped: %r", len(spans), e)

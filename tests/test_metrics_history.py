@@ -1,0 +1,194 @@
+"""Metrics history: windowed rates/quantiles over retained frames, the
+label-filtered quantile satellite, and the live head-side scraper
+(ISSUE 8 tentpole part 1; util/metrics_history.py)."""
+import os
+import time
+
+import pytest
+
+from ray_tpu.util import metrics as rm
+from ray_tpu.util.metrics_history import MetricsHistory
+
+
+def _hist_snapshot(name, samples, boundaries, tags=None):
+    """Build a merged-metrics dict holding one histogram observed with the
+    given samples — the direct-computation side of the bucket-differencing
+    acceptance check."""
+    h = {"name": name, "type": "histogram", "description": "",
+         "boundaries": sorted(boundaries), "values": {}}
+    key = tuple(sorted((tags or {}).items()))
+    buckets = [0] * (len(boundaries) + 1)
+    for v in samples:
+        i = 0
+        while i < len(boundaries) and v > sorted(boundaries)[i]:
+            i += 1
+        buckets[i] += 1
+    h["values"][key] = {"buckets": buckets, "sum": float(sum(samples)),
+                        "count": len(samples)}
+    return {name: h}
+
+
+def _merge_frames(*metric_dicts):
+    return rm.merge_snapshots([list(d.values()) for d in metric_dicts])
+
+
+BOUNDS = [0.01, 0.05, 0.1, 0.5, 1.0]
+
+
+def test_ring_is_bounded():
+    h = MetricsHistory(maxlen=5)
+    for i in range(20):
+        h.record({}, ts=float(i))
+    assert len(h) == 5
+    assert [f["ts"] for f in h.frames()] == [15.0, 16.0, 17.0, 18.0, 19.0]
+
+
+def test_counter_rate_and_delta():
+    h = MetricsHistory(maxlen=16)
+
+    def frame(ts, total):
+        return h.record({"reqs": {"name": "reqs", "type": "counter",
+                                  "description": "",
+                                  "values": {(): float(total)}}}, ts=ts)
+
+    frame(0.0, 0)
+    frame(10.0, 50)
+    frame(20.0, 150)
+    # 60s window clamps to the oldest frame: 150 events over 20s
+    assert h.delta("reqs", 60.0) == 150.0
+    assert h.rate("reqs", 60.0) == pytest.approx(7.5)
+    # 10s window differences the last two frames: 100 events over 10s
+    assert h.delta("reqs", 10.0) == 100.0
+    assert h.rate("reqs", 10.0) == pytest.approx(10.0)
+    # unknown metric answers 0-delta (never None once 2 frames exist)
+    assert h.delta("nope", 60.0) == 0.0
+
+
+def test_windowed_quantile_matches_direct_computation():
+    """Acceptance: the windowed p99 computed by bucket-DIFFERENCING two
+    frames equals histogram_quantile run directly on a histogram holding
+    only the window's samples."""
+    h = MetricsHistory(maxlen=16)
+    before = [0.02] * 40  # lifetime-so-far samples (must NOT leak in)
+    window_samples = [0.3] * 30 + [0.7] * 10
+    h.record(_hist_snapshot("lat", before, BOUNDS), ts=100.0)
+    h.record(_hist_snapshot("lat", before + window_samples, BOUNDS), ts=160.0)
+
+    direct = rm.histogram_quantile(
+        _hist_snapshot("lat", window_samples, BOUNDS)["lat"], 0.99)
+    windowed = h.quantile("lat", 0.99, 60.0)
+    assert windowed == pytest.approx(direct)
+    # and the window's count is exactly the injected samples
+    diff = h.histogram_delta("lat", 60.0)
+    assert sum(v["count"] for v in diff["values"].values()) == len(window_samples)
+
+
+def test_windowed_p99_tracks_load_shift_lifetime_lags():
+    """Satellite: fast-then-slow regime shift. The 60s windowed p99 tracks
+    the recent slow regime; the lifetime quantile stays diluted by the fast
+    history and lags far below it."""
+    h = MetricsHistory(maxlen=64)
+    # a long fast history, then a brief slow regime: the slow tail is <1% of
+    # lifetime (so the lifetime p99 stays diluted) but 100% of the window
+    fast = [0.02] * 10000
+    slow = [0.8] * 50
+    h.record(_hist_snapshot("lat", fast, BOUNDS), ts=0.0)
+    h.record(_hist_snapshot("lat", fast, BOUNDS), ts=60.0)
+    h.record(_hist_snapshot("lat", fast + slow, BOUNDS), ts=120.0)
+
+    lifetime = rm.histogram_quantile(
+        _hist_snapshot("lat", fast + slow, BOUNDS)["lat"], 0.99)
+    windowed = h.quantile("lat", 0.99, 60.0)
+    assert windowed > 0.5, f"windowed p99 missed the slow regime: {windowed}"
+    assert lifetime < 0.1, f"lifetime p99 unexpectedly jumped: {lifetime}"
+    assert windowed > lifetime * 5
+
+
+def test_histogram_quantile_where_filter():
+    """Satellite: the where= label filter quantiles one route's tag set;
+    filtered and unfiltered agree when only that tag set exists, and diverge
+    once a second route with different latencies lands."""
+    one_route = _hist_snapshot("ttft", [0.05] * 10, BOUNDS,
+                               tags={"route": "/a"})["ttft"]
+    assert (rm.histogram_quantile(one_route, 0.5, where={"route": "/a"})
+            == pytest.approx(rm.histogram_quantile(one_route, 0.5)))
+    # no tag set matches -> empty -> None
+    assert rm.histogram_quantile(one_route, 0.5, where={"route": "/nope"}) is None
+
+    both = _merge_frames(
+        _hist_snapshot("ttft", [0.05] * 10, BOUNDS, tags={"route": "/a"}),
+        _hist_snapshot("ttft", [0.9] * 10, BOUNDS, tags={"route": "/b"}))["ttft"]
+    qa = rm.histogram_quantile(both, 0.5, where={"route": "/a"})
+    qb = rm.histogram_quantile(both, 0.5, where={"route": "/b"})
+    q_all = rm.histogram_quantile(both, 0.5)
+    assert qa < 0.1 < qb
+    assert qa < q_all  # the blended quantile sits between the two routes
+    assert rm.histogram_quantile(one_route, 0.5) == pytest.approx(qa)
+
+
+def test_counts_below_interpolates():
+    m = _hist_snapshot("lat", [0.3] * 8 + [0.9] * 2, BOUNDS)["lat"]
+    good, total = rm.histogram_counts_below(m, 0.5)
+    assert total == 10
+    assert good == pytest.approx(8.0)  # 0.3s samples sit in (0.1, 0.5]
+    good_half, _ = rm.histogram_counts_below(m, 0.3)
+    assert 0 < good_half < 8  # interpolated inside the bucket
+
+
+def test_boundary_drift_rebins_old_frame():
+    """A process re-registering the histogram with different boundaries must
+    not corrupt the difference: the old frame re-bins onto the new frame's
+    boundary set first."""
+    h = MetricsHistory(maxlen=8)
+    h.record(_hist_snapshot("lat", [0.02] * 5, [0.1, 1.0]), ts=0.0)
+    h.record(_hist_snapshot("lat", [0.02] * 5 + [0.3] * 7, BOUNDS), ts=60.0)
+    diff = h.histogram_delta("lat", 60.0)
+    assert sum(v["count"] for v in diff["values"].values()) == 7
+
+
+def test_live_scraper_two_frames_and_windowed_p99(rt):
+    """Acceptance: after two scrape intervals state.metrics_history() holds
+    >=2 frames, and the windowed serve_ttft_seconds p99 (bucket-differenced
+    across the injection) matches a direct computation on the injected
+    samples."""
+    from ray_tpu.core import global_state
+    from ray_tpu.util import state as rs
+    from ray_tpu.util import telemetry
+
+    os.environ["RAY_TPU_METRICS_SCRAPE_INTERVAL_S"] = "0.2"
+    try:
+        hist = global_state.try_cluster().metrics_history
+
+        def n_frames():
+            return len(rs.metrics_history()["frames"])
+
+        deadline = time.time() + 10
+        while time.time() < deadline and n_frames() < 2:
+            time.sleep(0.05)
+        assert n_frames() >= 2, "scraper produced <2 frames in 10s"
+
+        # baseline frame BEFORE injection, then inject a known sample set
+        baseline_n = n_frames()
+        baseline_ts = rs.metrics_history()["frames"][-1]["ts"]
+        samples = [0.2] * 20 + [0.45] * 19 + [0.9]
+        hgram = telemetry.get_histogram(
+            "serve_ttft_seconds", "HTTP ingress time-to-first-token/response",
+            tag_keys=("route",))
+        for s in samples:
+            hgram.observe(s, tags={"route": "/hist-test"})
+        deadline = time.time() + 10
+        while time.time() < deadline and n_frames() < baseline_n + 2:
+            time.sleep(0.05)
+        doc = rs.metrics_history()
+        assert len(doc["frames"]) >= baseline_n + 2
+
+        latest_ts = doc["frames"][-1]["ts"]
+        window = latest_ts - baseline_ts  # brackets exactly the injection
+        windowed = hist.quantile("serve_ttft_seconds", 0.99, window,
+                                 where={"route": "/hist-test"})
+        bounds = hist.latest()["metrics"]["serve_ttft_seconds"]["boundaries"]
+        direct = rm.histogram_quantile(
+            _hist_snapshot("x", samples, bounds)["x"], 0.99)
+        assert windowed == pytest.approx(direct), (windowed, direct)
+    finally:
+        os.environ.pop("RAY_TPU_METRICS_SCRAPE_INTERVAL_S", None)

@@ -1,0 +1,185 @@
+"""SLO engine: declarative objectives over the metrics history, multi-window
+burn rates, transition callbacks (ISSUE 8 tentpole part 2; util/slo.py)."""
+import os
+import time
+
+import pytest
+
+from ray_tpu.util.metrics_history import MetricsHistory
+from ray_tpu.util.slo import SLO, SLOEngine
+
+
+def _hist(name, samples, boundaries, tags=None):
+    bounds = sorted(boundaries)
+    buckets = [0] * (len(bounds) + 1)
+    for v in samples:
+        i = 0
+        while i < len(bounds) and v > bounds[i]:
+            i += 1
+        buckets[i] += 1
+    return {name: {"name": name, "type": "histogram", "description": "",
+                   "boundaries": bounds,
+                   "values": {tuple(sorted((tags or {}).items())):
+                              {"buckets": buckets, "sum": float(sum(samples)),
+                               "count": len(samples)}}}}
+
+
+BOUNDS = [0.01, 0.05, 0.1, 0.5, 1.0]
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        SLO("x", metric="m", objective=1.5)
+    with pytest.raises(ValueError):
+        SLO("x", metric="m", objective=0.99, kind="nope")
+    with pytest.raises(ValueError):
+        SLO("x", metric="m", objective=0.99, kind="error_rate")  # no denominator
+    s = SLO("ttft", metric="serve_ttft_seconds", objective=0.99, threshold=0.5)
+    assert s.budget == pytest.approx(0.01)
+
+
+def test_latency_slo_ok_then_burning_with_subscriber():
+    h = MetricsHistory(maxlen=32)
+    eng = SLOEngine(h)
+    eng.register(SLO("ttft", metric="lat", objective=0.9, threshold=0.1,
+                     window_s=60.0))
+    transitions = []
+    unsub = eng.subscribe(transitions.append)
+
+    fast = [0.02] * 100
+    h.record(_hist("lat", fast, BOUNDS), ts=0.0)
+    h.record(_hist("lat", fast + [0.02] * 20, BOUNDS), ts=30.0)
+    status = eng.evaluate()
+    assert status["ttft"]["state"] == "ok"
+    assert status["ttft"]["burn_rate_long"] == pytest.approx(0.0)
+
+    # slow regime: every new sample blows the 100ms threshold
+    h.record(_hist("lat", fast + [0.02] * 20 + [0.8] * 50, BOUNDS), ts=60.0)
+    status = eng.evaluate()
+    assert status["ttft"]["state"] == "burning"
+    assert status["ttft"]["burn_rate_long"] > 1.0
+    assert status["ttft"]["observed"] > 0.5  # windowed p90 sees the slow tail
+    assert [t["to"] for t in transitions] == ["burning"]
+    assert transitions[0]["from"] == "ok" and transitions[0]["name"] == "ttft"
+
+    # recovery: a flood of fast samples pushes the windowed bad fraction down
+    h.record(_hist("lat", fast + [0.02] * 2000 + [0.8] * 50, BOUNDS), ts=120.0)
+    h.record(_hist("lat", fast + [0.02] * 4000 + [0.8] * 50, BOUNDS), ts=150.0)
+    status = eng.evaluate()
+    assert status["ttft"]["state"] == "ok"
+    assert [t["to"] for t in transitions] == ["burning", "ok"]
+    unsub()
+    h.record(_hist("lat", fast + [0.02] * 4000 + [0.8] * 500, BOUNDS), ts=180.0)
+    eng.evaluate()
+    assert len(transitions) == 2  # unsubscribed: no more deliveries
+
+
+def test_error_rate_slo():
+    h = MetricsHistory(maxlen=16)
+    eng = SLOEngine(h)
+    eng.register(SLO("errors", metric="errs", objective=0.95,
+                     total_metric="reqs", kind="error_rate", window_s=60.0))
+
+    def frame(ts, errs, reqs):
+        h.record({
+            "errs": {"name": "errs", "type": "counter", "description": "",
+                     "values": {(): float(errs)}},
+            "reqs": {"name": "reqs", "type": "counter", "description": "",
+                     "values": {(): float(reqs)}},
+        }, ts=ts)
+
+    frame(0.0, 0, 0)
+    frame(30.0, 1, 100)  # 1% errors, budget 5% -> burn 0.2
+    st = eng.evaluate()
+    assert st["errors"]["state"] == "ok"
+    assert st["errors"]["burn_rate_long"] == pytest.approx(0.2, abs=0.05)
+    frame(60.0, 31, 200)  # 30 new errors / 100 new requests -> burn 6
+    st = eng.evaluate()
+    assert st["errors"]["state"] == "burning"
+
+
+def test_gauge_saturation_slo():
+    h = MetricsHistory(maxlen=16)
+    eng = SLOEngine(h)
+    eng.register(SLO("queue", metric="depth", objective=0.5, threshold=10.0,
+                     kind="gauge", window_s=60.0))
+
+    def frame(ts, depth):
+        h.record({"depth": {"name": "depth", "type": "gauge", "description": "",
+                            "values": {(): float(depth)}}}, ts=ts)
+
+    for i, d in enumerate([2, 3, 2, 4]):
+        frame(i * 10.0, d)
+    assert eng.evaluate()["queue"]["state"] == "ok"
+    for i, d in enumerate([50, 60, 70, 80]):
+        frame(40.0 + i * 10.0, d)
+    st = eng.evaluate()
+    assert st["queue"]["state"] == "burning"  # most retained frames saturated
+
+
+def test_no_data_state():
+    h = MetricsHistory(maxlen=8)
+    eng = SLOEngine(h)
+    eng.register(SLO("ttft", metric="lat", objective=0.99, threshold=0.1))
+    assert eng.evaluate()["ttft"]["state"] == "no_data"
+
+
+def test_live_slo_flips_burning_within_one_interval(rt):
+    """Acceptance (chaos-style): a TTFT-p99 SLO over the live history flips
+    to burning within ~one scrape interval of injected slow requests, and
+    subscribe_slo() delivers the transition."""
+    from ray_tpu.util import slo as slo_mod
+    from ray_tpu.util import state as rs
+    from ray_tpu.util import telemetry
+
+    os.environ["RAY_TPU_METRICS_SCRAPE_INTERVAL_S"] = "0.2"
+    transitions = []
+    unsub = None
+    try:
+        slo_mod.register(SLO(
+            "ttft-p99", metric="serve_ttft_seconds", objective=0.99,
+            threshold=0.05, window_s=8.0, where={"route": "/slo-test"}))
+        unsub = slo_mod.subscribe_slo(transitions.append)
+
+        # let the engine evaluate the SLO once with no traffic
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = rs.slo_status().get("ttft-p99")
+            if st is not None:
+                assert st["state"] == "no_data"
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("SLO never evaluated")
+
+        # inject slow requests: every sample over the 50ms threshold
+        hgram = telemetry.get_histogram(
+            "serve_ttft_seconds", "HTTP ingress time-to-first-token/response",
+            tag_keys=("route",))
+        t_inject = time.time()
+        for _ in range(30):
+            hgram.observe(0.5, tags={"route": "/slo-test"})
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = rs.slo_status().get("ttft-p99")
+            if st and st["state"] == "burning":
+                break
+            time.sleep(0.02)
+        st = rs.slo_status()["ttft-p99"]
+        assert st["state"] == "burning", st
+        # "within one scrape interval": generous 5x bound for a loaded box —
+        # the mechanism being asserted is frame-granularity detection, and
+        # one frame is 0.2s here
+        assert time.time() - t_inject < 1.0, "burn detection took >1s at 0.2s scrape"
+        assert st["burn_rate_long"] > 1.0
+        assert transitions and transitions[-1]["to"] == "burning"
+        assert transitions[-1]["name"] == "ttft-p99"
+    finally:
+        os.environ.pop("RAY_TPU_METRICS_SCRAPE_INTERVAL_S", None)
+        if unsub is not None:
+            unsub()
+        try:
+            slo_mod.remove("ttft-p99")
+        except Exception:
+            pass
