@@ -117,16 +117,21 @@ def _trainer_loop(config) -> None:
 
 
 def run_trainer_path(model_name: str, batch: int, seq: int, steps: int,
-                     remat_policy: str) -> tuple:
+                     remat_policy: str, grad_sync=None) -> tuple:
     """Same measurement as run_one but through JaxTrainer.fit() (1 worker owning the
-    chip). Returns (mfu, tokens_per_sec) reported from inside the session."""
+    chip). Returns (mfu, tokens_per_sec) reported from inside the session.
+
+    grad_sync: GradSyncConfig handed to the workers via JaxConfig — how the
+    winning `--grad-sync` row reaches the default trainer-path MFU run (the
+    worker's make_train_step picks it up from env)."""
     import tempfile
 
     import ray_tpu
     from ray_tpu.air import RunConfig, ScalingConfig
     from ray_tpu.train import JaxConfig, JaxTrainer
 
-    log(f"trainer-path: model={model_name} batch={batch} seq={seq} steps={steps}")
+    log(f"trainer-path: model={model_name} batch={batch} seq={seq} steps={steps} "
+        f"grad_sync={grad_sync}")
     import jax
 
     on_cpu = jax.default_backend() == "cpu"
@@ -142,7 +147,7 @@ def run_trainer_path(model_name: str, batch: int, seq: int, steps: int,
             _trainer_loop,
             train_loop_config={"model": model_name, "batch": batch, "seq": seq,
                                "steps": steps, "remat": remat_policy},
-            backend_config=JaxConfig(collective_group=False),
+            backend_config=JaxConfig(collective_group=False, grad_sync=grad_sync),
             scaling_config=scaling,
             run_config=RunConfig(name="bench_trainer_path",
                                  storage_path=tempfile.mkdtemp(prefix="bench_tp_")),
@@ -156,6 +161,290 @@ def run_trainer_path(model_name: str, batch: int, seq: int, steps: int,
         return m["mfu"], m["tokens_per_sec"]
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- grad-sync bench
+# `bench.py --grad-sync`: paired device-plane gradient-sync rows (monolithic vs
+# bucketed vs bucketed+int8 vs +sharded-update) -> TRAIN_SYNC_BENCH.json, the
+# evidence behind train/grad_sync.py. The device-mesh section runs in a child
+# with its own 8-device CPU platform (the multichip dryrun mesh); the
+# loss-parity section runs on the native backend (llama-500m when a TPU is
+# attached); the sharded-HBM section is analytic at llama3-8b fsdp-pod
+# geometry. The winning mesh-section config is wired into the default
+# trainer-path MFU row via JaxConfig(grad_sync=...).
+
+GRAD_SYNC_MODES = {
+    "monolithic": {},
+    "bucketed": {"mode": "bucketed"},
+    "bucketed_int8": {"mode": "bucketed", "compression": "int8"},
+    "bucketed_int8_sharded": {"mode": "bucketed", "compression": "int8",
+                              "sharded_update": True},
+    "sharded_update": {"sharded_update": True},
+}
+
+
+def _grad_sync_child() -> None:
+    """Child body for the device-mesh section: dp=8 virtual-CPU mesh, every
+    mode stepped in interleaved rounds (drift-fair), one JSON line out."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import get_config
+    from ray_tpu.parallel import MeshSpec, build_mesh, use_mesh
+    from ray_tpu.parallel.sharding import named_sharding
+    from ray_tpu.train import (GradSyncConfig, grad_sync, init_state,
+                               make_optimizer, make_train_step)
+
+    model = os.environ.get("BENCH_SYNC_MODEL", "test-tiny")
+    batch = int(os.environ.get("BENCH_SYNC_BATCH", "16"))
+    seq = int(os.environ.get("BENCH_SYNC_SEQ", "64"))
+    steps = int(os.environ.get("BENCH_SYNC_STEPS", "8"))
+    rounds = int(os.environ.get("BENCH_SYNC_ROUNDS", "3"))
+    ndev = len(jax.devices())
+    cfg = get_config(model)
+    mesh = build_mesh(MeshSpec(dp=-1).resolve(ndev), jax.devices())
+    tx = make_optimizer(total_steps=10_000)
+    tokens_host = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+
+    runs = {}
+    with use_mesh(mesh):
+        tokens = jax.device_put(tokens_host, named_sharding(mesh, "batch", None))
+        batch_dict = {"tokens": tokens}
+        for name, kw in GRAD_SYNC_MODES.items():
+            sync = GradSyncConfig(**kw)
+            state = init_state(jax.random.PRNGKey(0), cfg, tx, mesh=mesh, sync=sync)
+            step = make_train_step(cfg, tx, donate=False, sync=sync)
+            overlap = None
+            if not sync.is_default:
+                overlap = grad_sync.overlap_report(
+                    step.lower(state, batch_dict).compile())
+            state, metrics = step(state, batch_dict)  # compile + step 1
+            losses = [float(metrics["loss"])]
+            runs[name] = {"sync": sync, "step": step, "state": state,
+                          "losses": losses, "overlap": overlap, "best_dt": None}
+        for _ in range(rounds):
+            for name, run in runs.items():
+                step, state = run["step"], run["state"]
+                t0 = _time.perf_counter()
+                for _ in range(steps):
+                    state, metrics = step(state, batch_dict)
+                loss = float(metrics["loss"])  # fetch = sync point
+                dt = (_time.perf_counter() - t0) / steps
+                run["state"] = state
+                run["losses"].append(loss)
+                if run["best_dt"] is None or dt < run["best_dt"]:
+                    run["best_dt"] = dt
+
+    out = {}
+    for name, run in runs.items():
+        payload = grad_sync.sync_payload_bytes(run["state"].params, run["sync"])
+        out[name] = {
+            "tokens_per_sec": round(batch * seq / run["best_dt"], 1),
+            "step_ms": round(run["best_dt"] * 1e3, 2),
+            "losses": [round(v, 6) for v in run["losses"]],
+            "payload_f32_bytes": payload["f32_bytes"],
+            "payload_bytes": payload["compressed_bytes"],
+            "overlap": run["overlap"],
+        }
+    print("GRAD_SYNC_RESULT " + json.dumps(
+        {"model": model, "batch": batch, "seq": seq, "steps": steps,
+         "world": ndev, "modes": out}))
+
+
+def _grad_sync_hbm_child() -> None:
+    """Analytic sharded-optimizer HBM rows at llama3-8b pod geometry (needs a
+    64-device platform; nothing compiles or materializes)."""
+    import jax
+
+    from ray_tpu.models import get_config
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.train import grad_sync, make_optimizer
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import __graft_entry__ as ge
+
+    gib = 1024**3
+    cfg = get_config("llama3-8b", dtype="bfloat16", remat_policy="full")
+    tx = make_optimizer(total_steps=10)
+    mesh = build_mesh(MeshSpec(dp=8, fsdp=8).resolve(64), jax.devices()[:64])
+    state = ge._abstract_train_state(cfg, mesh, tx)
+    base = grad_sync.opt_state_bytes_per_shard(
+        grad_sync.abstract_sharded_opt_state(tx, state.params, mesh, axes=()))
+    sharded = grad_sync.opt_state_bytes_per_shard(
+        grad_sync.abstract_sharded_opt_state(
+            tx, state.params, mesh, axes=("dp", "fsdp")))
+    print("GRAD_SYNC_HBM " + json.dumps({
+        "mesh": "dp8xfsdp8", "model": "llama3-8b",
+        "opt_state_gib_inherited": round(base / gib, 3),
+        "opt_state_gib_sharded_update": round(sharded / gib, 3),
+        "cut_factor": round(base / max(sharded, 1), 2),
+    }))
+
+
+def _run_child(target: str, n_devices: int, timeout: int = 1200) -> dict:
+    """Run a child bench body on a fresh virtual-CPU platform, parse its
+    marker line."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    marker = {"mesh": "GRAD_SYNC_RESULT ", "hbm": "GRAD_SYNC_HBM "}[target]
+    fn = {"mesh": "_grad_sync_child", "hbm": "_grad_sync_hbm_child"}[target]
+    proc = subprocess.run(
+        [sys.executable, "-c", f"import bench; bench.{fn}()"],
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        log(proc.stderr[-4000:])
+        raise RuntimeError(f"grad-sync child {target} failed rc={proc.returncode}")
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith(marker)), None)
+    if line is None:
+        log(proc.stderr[-4000:])
+        raise RuntimeError(f"grad-sync child {target} printed no {marker!r}")
+    return json.loads(line[len(marker):])
+
+
+def _loss_parity_section() -> dict:
+    """f32 vs int8 grad-sync loss curves on the native backend — llama-500m on
+    an accelerator, test-tiny on CPU — plus the analytic payload-bytes cut."""
+    import time as _time
+
+    import jax
+
+    from ray_tpu.models import get_config
+    from ray_tpu.parallel import MeshSpec, build_mesh, use_mesh
+    from ray_tpu.parallel.sharding import named_sharding
+    from ray_tpu.train import (GradSyncConfig, grad_sync, init_state,
+                               make_optimizer, make_train_step)
+
+    on_cpu = jax.default_backend() == "cpu"
+    model = os.environ.get("BENCH_SYNC_PARITY_MODEL",
+                           "test-tiny" if on_cpu else "llama-500m")
+    batch = int(os.environ.get("BENCH_SYNC_PARITY_BATCH", "8" if on_cpu else "4"))
+    seq = int(os.environ.get("BENCH_SYNC_PARITY_SEQ", "64" if on_cpu else "512"))
+    steps = int(os.environ.get("BENCH_SYNC_PARITY_STEPS", "10"))
+    cfg = get_config(model)
+    mesh = build_mesh(MeshSpec(dp=-1).resolve(len(jax.devices())), jax.devices())
+    tx = make_optimizer(total_steps=10_000)
+    tokens_host = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+
+    curves = {}
+    payload = {}
+    with use_mesh(mesh):
+        tokens = jax.device_put(tokens_host, named_sharding(mesh, "batch", None))
+        batch_dict = {"tokens": tokens}
+        for name, kw in (("f32", {"mode": "bucketed"}),
+                         ("int8", {"mode": "bucketed", "compression": "int8",
+                                   "stochastic_rounding": True})):
+            sync = GradSyncConfig(**kw)
+            state = init_state(jax.random.PRNGKey(0), cfg, tx, mesh=mesh, sync=sync)
+            step = make_train_step(cfg, tx, donate=False, sync=sync)
+            losses = []
+            for _ in range(steps):
+                state, metrics = step(state, batch_dict)
+                losses.append(float(metrics["loss"]))
+            curves[name] = losses
+            payload[name] = grad_sync.sync_payload_bytes(state.params, sync)
+    max_rel = max(abs(a - b) / max(abs(a), 1e-9)
+                  for a, b in zip(curves["f32"], curves["int8"]))
+    return {
+        "model": model, "batch": batch, "seq": seq, "steps": steps,
+        "world": len(jax.devices()),
+        "loss_f32": [round(v, 5) for v in curves["f32"]],
+        "loss_int8": [round(v, 5) for v in curves["int8"]],
+        "max_rel_divergence": round(max_rel, 6),
+        "payload_f32_bytes": payload["int8"]["f32_bytes"],
+        "payload_int8_bytes": payload["int8"]["compressed_bytes"],
+        "bytes_cut_factor": round(
+            payload["int8"]["f32_bytes"]
+            / max(payload["int8"]["compressed_bytes"], 1), 2),
+    }
+
+
+def run_grad_sync_bench() -> None:
+    log("grad-sync bench: device-mesh section (8-device virtual-CPU child)")
+    mesh_rows = _run_child("mesh", 8)
+    log("grad-sync bench: loss-parity section (native backend)")
+    parity = _loss_parity_section()
+    log("grad-sync bench: sharded-optimizer HBM section (analytic, 64 devices)")
+    hbm = _run_child("hbm", 64, timeout=900)
+
+    modes = mesh_rows["modes"]
+    mono = modes["monolithic"]
+    # f32 modes must track the monolithic loss curve bit-for-bit-ish; int8
+    # modes within the documented tolerance
+    checks = {
+        "bucketed_matches_monolithic": max(
+            abs(a - b) for a, b in zip(modes["bucketed"]["losses"],
+                                       mono["losses"])) < 1e-5,
+        "bucketed_ge_monolithic_tokens_per_sec":
+            modes["bucketed"]["tokens_per_sec"]
+            >= mono["tokens_per_sec"] * 0.999,
+        "int8_halves_payload_bytes":
+            modes["bucketed_int8"]["payload_bytes"] * 2
+            <= modes["bucketed_int8"]["payload_f32_bytes"],
+        "int8_loss_parity": parity["max_rel_divergence"] < 0.02,
+        "sharded_update_cuts_opt_hbm_2x": hbm["cut_factor"] >= 2.0,
+        "bucketed_reductions_not_sunk":
+            not modes["bucketed"]["overlap"]["all_sunk_to_end"],
+    }
+    ranked = sorted(
+        (name for name in modes
+         if name in ("monolithic", "bucketed")),  # f32-exact candidates only
+        key=lambda n: modes[n]["tokens_per_sec"], reverse=True)
+    winning = ranked[0]
+    result = {
+        "device_mesh": mesh_rows,
+        "loss_parity": parity,
+        "sharded_hbm": hbm,
+        "checks": checks,
+        "winning": {"name": winning,
+                    "config": GRAD_SYNC_MODES[winning]},
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "TRAIN_SYNC_BENCH.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    for name, ok in checks.items():
+        log(f"grad-sync check {name}: {'PASS' if ok else 'FAIL'}")
+    print(json.dumps({
+        "metric": "grad_sync_bucketed_tokens_per_sec_dp8",
+        "value": modes["bucketed"]["tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": round(modes["bucketed"]["tokens_per_sec"]
+                             / max(mono["tokens_per_sec"], 1e-9), 4),
+        "secondary": {
+            "monolithic_tokens_per_sec": mono["tokens_per_sec"],
+            "int8_payload_cut_factor": parity["bytes_cut_factor"],
+            "int8_max_rel_loss_divergence": parity["max_rel_divergence"],
+            "sharded_opt_hbm_cut_factor": hbm["cut_factor"],
+            "checks_passed": sum(checks.values()),
+            "checks_total": len(checks),
+        },
+    }))
+
+
+def _winning_grad_sync():
+    """The winning --grad-sync config (TRAIN_SYNC_BENCH.json), as a
+    GradSyncConfig for the trainer-path MFU row; None when the bench has not
+    run or the stock config won."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "TRAIN_SYNC_BENCH.json")
+    try:
+        with open(path) as f:
+            kw = json.load(f)["winning"]["config"]
+        if not kw:
+            return None
+        from ray_tpu.train import GradSyncConfig
+
+        return GradSyncConfig(**kw)
+    except Exception:
+        return None
 
 
 def main() -> None:
@@ -213,7 +502,8 @@ def main() -> None:
     # host with a process-exclusive chip lock the worker may fail to initialize
     # — fall back to the bare-step headline rather than producing no number.
     try:
-        mfu_fit, _ = run_trainer_path("llama8b-geom2", 6, 2048, steps, "dots")
+        mfu_fit, _ = run_trainer_path("llama8b-geom2", 6, 2048, steps, "dots",
+                                      grad_sync=_winning_grad_sync())
     except Exception as e:
         log(f"trainer-path failed ({type(e).__name__}: {e}); "
             "falling back to bare-step headline")
@@ -239,4 +529,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--grad-sync" in sys.argv[1:]:
+        run_grad_sync_bench()
+    else:
+        main()

@@ -15,7 +15,7 @@ stacks cleanly under lax.scan'd layers.
 """
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +59,43 @@ def as_weight(p: Any, dtype) -> jax.Array:
     if isinstance(p, QTensor):
         return dequant(p, dtype)
     return p.astype(dtype)
+
+
+# -- device-side blockwise int8 (gradient-sync reduction format) -----------------------
+# The jnp analogue of quantize_np below: same symmetric block-scale scheme
+# (scale = max|x|/127 per block of the flat element order, clip to [-127,127])
+# but traced into the train step, where the compressed all-reduce of
+# train/grad_sync.py quantizes each rank's gradient contribution before the
+# device collective (EQuARX-style in-XLA compression, arxiv 2506.17615).
+
+def quantize_blockwise(x: jax.Array, block_elems: int = 1024,
+                       key: Optional[jax.Array] = None):
+    """Blockwise symmetric int8 of any-shape `x` (flattened): returns
+    (q int8 [nblocks, block_elems], scales f32 [nblocks, 1]); the tail block is
+    zero-padded. `key` switches round-nearest to stochastic rounding
+    (floor(x/scale + u), u~U[0,1)) — unbiased, so quantization error averages
+    out across steps instead of accumulating as bias."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.size
+    nblocks = max(1, -(-n // block_elems))
+    pad = nblocks * block_elems - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(nblocks, block_elems)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    scaled = blocks / scale
+    if key is not None:
+        q = jnp.floor(scaled + jax.random.uniform(key, blocks.shape))
+    else:
+        q = jnp.round(scaled)
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequant_blockwise(q: jax.Array, scales: jax.Array, n: int, dtype) -> jax.Array:
+    """Inverse of quantize_blockwise: flat [n] array of `dtype`."""
+    out = q.astype(jnp.float32) * scales
+    return out.reshape(-1)[:n].astype(dtype)
 
 
 # -- host-side blockwise int8 (collective wire format) ---------------------------------

@@ -91,4 +91,8 @@ def use_mesh(mesh: Mesh):
     """Context manager installing `mesh` as the ambient mesh (jax version compat)."""
     if hasattr(jax.sharding, "set_mesh"):
         return jax.sharding.set_mesh(mesh)
-    return jax.sharding.use_mesh(mesh)  # pragma: no cover - older jax
+    if hasattr(jax.sharding, "use_mesh"):
+        return jax.sharding.use_mesh(mesh)
+    # jax<=0.4.x: Mesh is itself the context manager (thread-local physical
+    # mesh env; sharding.py's ambient-mesh probe reads it back)
+    return mesh

@@ -134,7 +134,9 @@ def pipeline(
         if leaf.shape[0] != b:
             raise ValueError(
                 f"side input leading dim {leaf.shape[0]} != batch {b}")
-    env_mesh = mesh if mesh is not None else jax.sharding.get_abstract_mesh()
+    from .sharding import ambient_mesh
+
+    env_mesh = mesh if mesh is not None else ambient_mesh()
     pp_size = env_mesh.shape.get(axis_name) if getattr(env_mesh, "shape", None) else None
     leading = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stacked_params)}
     if pp_size is not None and leading and leading != {pp_size}:

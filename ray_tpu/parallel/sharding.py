@@ -110,21 +110,47 @@ def shard_pytree(tree, axes_tree, mesh: Mesh, rules: AxisRules = TRAIN_RULES):
 _MANUAL_AXES: "contextvars.ContextVar[frozenset]" = None  # initialized below
 
 
+def ambient_mesh():
+    """The mesh in scope, across jax versions: the abstract mesh
+    (use_mesh/set_mesh on jax>=0.5) or the entered physical mesh
+    (`with mesh:` on jax<=0.4.x). None when no mesh is active."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is not None and not mesh.empty:
+            return mesh
+    except AttributeError:
+        pass
+    except Exception:
+        return None
+    try:
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        if mesh is not None and not mesh.empty:
+            return mesh
+    except Exception:
+        pass
+    return None
+
+
 def with_sharding_constraint(x, *logical_axes: LogicalAxis, rules: AxisRules = TRAIN_RULES):
     """In-jit sharding hint using logical names. No-op outside jit or without a mesh.
 
     Mesh axes currently bound manually (inside a shard_map region entered via
     `manual_axes()`) are dropped from the constraint — GSPMD may only constrain auto axes.
     """
-    try:
-        mesh = jax.sharding.get_abstract_mesh()  # jax>=0.4.35 path
-        if mesh is None or mesh.empty:
-            return x
-    except Exception:
+    mesh = ambient_mesh()
+    if mesh is None:
         return x
     spec = rules.spec(logical_axes)
     manual = active_manual_axes()
     if manual:
+        if isinstance(mesh, Mesh):
+            # jax<=0.4.x: constraining auto axes from inside a partial-manual
+            # shard_map region trips the partitioner's IsManualSubgroup check —
+            # skip the hint entirely (it is an optimization, not semantics).
+            return x
+
         def _filt(entry):
             if entry is None:
                 return None
@@ -134,6 +160,9 @@ def with_sharding_constraint(x, *logical_axes: LogicalAxis, rules: AxisRules = T
             return None if entry in manual else entry
 
         spec = P(*(_filt(e) for e in spec))
+    if isinstance(mesh, Mesh):
+        # concrete (physical) mesh: the constraint needs a full NamedSharding
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     return jax.lax.with_sharding_constraint(x, spec)
 
 

@@ -53,6 +53,8 @@ from .session import (  # noqa: F401
     step_phase,
 )
 from .step import TrainState, init_state, make_optimizer, make_train_step  # noqa: F401
+from . import grad_sync  # noqa: F401
+from .grad_sync import GradSyncConfig  # noqa: F401
 from .v2 import (  # noqa: F401  (Train v2: controller + policies, SURVEY §2.4)
     DefaultFailurePolicy,
     ElasticScalingPolicy,

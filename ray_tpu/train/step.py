@@ -17,6 +17,8 @@ from ray_tpu.models import ModelConfig, llama
 from ray_tpu.parallel import build_mesh, MeshSpec, use_mesh
 from ray_tpu.parallel.sharding import AxisRules, TRAIN_RULES, named_sharding, shard_pytree
 
+from . import grad_sync
+
 
 class TrainState(NamedTuple):
     step: jax.Array
@@ -32,13 +34,19 @@ def make_optimizer(
     grad_clip: float = 1.0,
     warmup_steps: int = 100,
     total_steps: int = 10000,
+    mu_dtype=None,
 ) -> optax.GradientTransformation:
+    """mu_dtype: dtype of Adam's first moment (e.g. jnp.bfloat16 halves that
+    third of optimizer HBM; the second moment stays f32 — its dynamic range is
+    the one that cannot survive bf16). Used with the sharded optimizer update
+    on HBM-tight pod budgets (__graft_entry__.hbm_budget_sharded_opt)."""
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
     )
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 
@@ -50,13 +58,18 @@ def init_state(
     rules: AxisRules = TRAIN_RULES,
     checkpoint_dir: Optional[str] = None,
     param_dtype=None,
+    sync: Optional["grad_sync.GradSyncConfig"] = None,
 ) -> TrainState:
     """Fresh (or checkpoint-warm-started) sharded TrainState.
 
     checkpoint_dir: HF-layout safetensors dir (models/checkpoint.py) — streams
     real weights into the sharded pytree instead of random init, so fine-tuning
     starts from a released model (reference: model loading is the engine/trainer
-    contract, vllm_engine.py:180)."""
+    contract, vllm_engine.py:180).
+
+    sync: with `sharded_update=True` the optimizer state materializes sharded
+    over the update axes from the start (train/grad_sync.py) instead of being
+    re-laid-out on the first step."""
     if checkpoint_dir is not None:
         from ray_tpu.models import checkpoint as ckpt_io
 
@@ -67,9 +80,13 @@ def init_state(
         params = llama.init(rng, cfg)
         if mesh is not None:
             params = shard_pytree(params, llama.param_axes(cfg), mesh, rules)
+    sync = sync or grad_sync.GradSyncConfig.from_env()
     if mesh is not None:
         with use_mesh(mesh):
             opt_state = jax.jit(tx.init)(params)
+            if sync.sharded_update:
+                opt_state = grad_sync.shard_opt_state(
+                    tx, params, opt_state, sync, mesh)
     else:
         opt_state = tx.init(params)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
@@ -80,8 +97,18 @@ def make_train_step(
     tx: optax.GradientTransformation,
     loss_fn: Optional[Callable] = None,
     donate: bool = True,
+    sync: Optional["grad_sync.GradSyncConfig"] = None,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """sync=None reads GradSyncConfig.from_env() — how a JaxTrainer backend
+    config (`JaxConfig(grad_sync=...)`) reaches user train loops that build
+    their own step. The default (env unset) is the stock fused jit below,
+    byte-identical to the historical behavior; non-default configs delegate to
+    train/grad_sync.py (bucketed overlapped all-reduce, int8 reduction,
+    cross-replica sharded optimizer update)."""
     loss_fn = loss_fn or llama.loss_fn
+    sync = sync or grad_sync.GradSyncConfig.from_env()
+    if not sync.is_default:
+        return grad_sync.make_step(cfg, tx, loss_fn, sync, donate)
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(

@@ -433,6 +433,14 @@ def cluster_status() -> Dict[str, Any]:
                                      {}).get("values", {}).items()
             if v["count"]
         },
+        # grad-sync phase breakdown (train/grad_sync.py telemetry mode):
+        # mean seconds per phase — forward_backward / bucket_wait / optimizer
+        "grad_sync_phases_s": {
+            dict(key).get("phase", "?"): round(v["sum"] / v["count"], 6)
+            for key, v in merged.get("train_grad_sync_seconds",
+                                     {}).get("values", {}).items()
+            if v["count"]
+        },
     }
     return status
 
