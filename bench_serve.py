@@ -562,6 +562,273 @@ def bench_kv_handoff(nbytes=64 * 1024 * 1024, iters=8):
     }
 
 
+# --------------------------------------------------------------------------
+# Serve-plane chaos bench (--chaos): the robustness half of the serving
+# control loop. Open-loop HTTP load against a replicated deployment, then
+# (1) SIGKILL a replica mid-stream: the handle retry plane + controller
+#     reconcile must absorb it — zero lost requests, subscribe_slo() sees
+#     burning -> ok, windowed p99 back within 1.5x pre-kill inside the
+#     recovery window;
+# (2) offer 2x saturation load at a shed-configured deployment: the proxy
+#     must reject with 503 + Retry-After while goodput for admitted requests
+#     holds within 20% of the unsaturated rate.
+# Writes SERVE_CHAOS_BENCH.json. Pure host-path (no TPU/jax needed).
+# --------------------------------------------------------------------------
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))
+    return xs[i]
+
+
+class _LoadGen:
+    """Open-loop HTTP load: arrivals on a fixed schedule, independent of
+    completions (closed-loop generators hide overload by self-throttling)."""
+
+    def __init__(self, url, max_workers=128):
+        import concurrent.futures
+
+        self.url = url
+        self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_workers)
+        self.records = []  # (t_submit, latency_s, status, retry_after or None)
+        self._lock = threading.Lock()
+
+    def _one(self, t_sched):
+        import urllib.error
+        import urllib.request
+
+        t0 = time.perf_counter()
+        status, ra = 0, None
+        try:
+            resp = urllib.request.urlopen(self.url, timeout=30)
+            resp.read()
+            status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+            ra = e.headers.get("Retry-After")
+        except Exception:  # noqa: BLE001 — connection-level failure
+            status = -1
+        lat = time.perf_counter() - t0
+        with self._lock:
+            self.records.append((t_sched, lat, status, ra))
+
+    def run(self, rps, duration_s):
+        """Blocking: submit for duration_s at rps, then wait for stragglers."""
+        interval = 1.0 / rps
+        t0 = time.perf_counter()
+        next_t = t0
+        while True:
+            now = time.perf_counter()
+            if now - t0 >= duration_s:
+                break
+            if now < next_t:
+                time.sleep(next_t - now)
+            self.pool.submit(self._one, time.perf_counter() - t0)
+            next_t += interval
+
+    def drain(self):
+        self.pool.shutdown(wait=True)
+
+    def window(self, t_lo, t_hi, status=None):
+        with self._lock:
+            return [r for r in self.records
+                    if t_lo <= r[0] < t_hi and (status is None or r[2] == status)]
+
+
+def _make_chaos_app(service_s):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class ChaosTarget:
+        def __call__(self, _body):
+            time.sleep(service_s)
+            return {"ok": True}
+
+    return ChaosTarget
+
+
+def run_chaos_kill(port, *, replicas=3, moq=2, service_s=0.08, rps=55.0,
+                   warm_s=4.0, post_kill_s=12.0, recovery_window_s=10.0,
+                   app="chaos-kill"):
+    """Kill one of `replicas` replicas under open-loop load sized ABOVE the
+    survivors' capacity: latency must burn the SLO until the control loop
+    replaces the replica, then recover. Returns the result dict."""
+    from ray_tpu import serve
+    from ray_tpu.util import slo as slo_mod
+    from ray_tpu.util.fault_injection import ChaosController
+
+    Target = _make_chaos_app(service_s)
+    serve.run(Target.options(num_replicas=replicas, max_ongoing_requests=moq,
+                             health_check_period_s=0.5).bind(),
+              name=app, route_prefix=f"/{app}")
+    gen = _LoadGen(f"http://127.0.0.1:{port}/{app}?x=1")
+    transitions = []
+    run_t0 = time.perf_counter()
+
+    load = threading.Thread(
+        target=gen.run, args=(rps, warm_s + post_kill_s), daemon=True)
+    load.start()
+    time.sleep(warm_s * 0.75)
+    warm = gen.window(1.0, time.perf_counter() - run_t0)
+    base_lat = [r[1] for r in warm if r[2] == 200]
+    if not base_lat:
+        raise RuntimeError(
+            f"chaos warm-up produced no successful samples ({len(warm)} "
+            "requests recorded) — serve bring-up failed before the kill")
+    base_p50, base_p99 = _percentile(base_lat, 0.5), _percentile(base_lat, 0.99)
+    # threshold between healthy p50 and the queueing blowup a lost replica
+    # causes at this utilization: steady state is ~0% bad, saturation is >50%
+    thr = max(2.5 * base_p50, 1.2 * base_p99)
+    slo_mod.register(slo_mod.SLO(
+        "chaos_ttft", metric="serve_ttft_seconds", objective=0.85,
+        threshold=thr, window_s=3.0, kind="latency"))
+    unsub = slo_mod.subscribe_slo(lambda ev: transitions.append(
+        (time.perf_counter() - run_t0, ev["from"], ev["to"])))
+    time.sleep(warm_s * 0.25)
+
+    t_kill = time.perf_counter() - run_t0
+    assert ChaosController().kill_replica(app, "ChaosTarget", index=0)
+    load.join()
+    gen.drain()
+    unsub()
+    slo_mod.remove("chaos_ttft")
+    # requests submitted before the kill that were still in flight when it
+    # landed — the ones only the retry plane can save
+    inflight_at_kill = sum(1 for t_s, lat, _, _ in gen.records
+                           if t_s < t_kill < t_s + lat)
+
+    pre = [r[1] for r in gen.window(t_kill - 3.0, t_kill, status=200)]
+    pre_p99 = _percentile(pre, 0.99) or _percentile(base_lat, 0.99)
+    # rolling 2s windows after the kill: recovery = first window whose p99 is
+    # back within 1.5x of pre-kill (and the window actually has data)
+    recovery_s = None
+    t = t_kill + 1.0
+    t_end = t_kill + post_kill_s
+    while t + 2.0 <= t_end:
+        w = [r[1] for r in gen.window(t, t + 2.0, status=200)]
+        if w and _percentile(w, 0.99) <= 1.5 * pre_p99:
+            recovery_s = round(t - t_kill, 2)
+            break
+        t += 0.5
+    failed = [r for r in gen.records if r[2] != 200]
+    burn_seen = any(to == "burning" for _, _, to in transitions)
+    recovered_ok = any(to == "ok" and frm == "burning"
+                       for _, frm, to in transitions)
+    return {
+        "kill_offered_rps": rps,
+        "kill_replicas": replicas,
+        "kill_requests_total": len(gen.records),
+        "kill_requests_failed": len(failed),
+        "kill_inflight_at_kill": max(0, inflight_at_kill),
+        "kill_zero_lost": len(failed) == 0,
+        "kill_baseline_p50_ms": round(base_p50 * 1e3, 1),
+        "kill_pre_kill_p99_ms": round(pre_p99 * 1e3, 1),
+        "kill_slo_threshold_ms": round(thr * 1e3, 1),
+        "kill_slo_transitions": [(round(t, 2), f, to)
+                                 for t, f, to in transitions],
+        "kill_slo_burn_observed": burn_seen,
+        "kill_slo_recovery_observed": recovered_ok,
+        "kill_p99_recovery_s": recovery_s,
+        "kill_p99_recovered_in_window": (recovery_s is not None
+                                         and recovery_s <= recovery_window_s),
+    }
+
+
+def run_chaos_shed(port, *, moq=2, max_queued=2, service_s=0.05,
+                   phase_s=5.0, app="chaos-shed"):
+    """Admission control under 2x saturation: the proxy must shed with 503 +
+    Retry-After while admitted-request goodput holds within 20% of the
+    unsaturated rate (overload degrades to fast rejections, not collapse)."""
+    from ray_tpu import serve
+
+    capacity_rps = moq / service_s  # one replica: moq slots x 1/service each
+    Target = _make_chaos_app(service_s)
+    serve.run(Target.options(num_replicas=1, max_ongoing_requests=moq,
+                             max_queued_requests=max_queued).bind(),
+              name=app, route_prefix=f"/{app}")
+    url = f"http://127.0.0.1:{port}/{app}?x=1"
+
+    def phase(rps):
+        gen = _LoadGen(url)
+        gen.run(rps, phase_s)
+        gen.drain()
+        ok = [r for r in gen.records if r[2] == 200]
+        shed = [r for r in gen.records if r[2] == 503]
+        return {
+            "offered_rps": rps,
+            "goodput_rps": round(len(ok) / phase_s, 1),
+            "shed": len(shed),
+            "shed_with_retry_after": sum(1 for r in shed if r[3]),
+            "other_failures": len(gen.records) - len(ok) - len(shed),
+            "p99_ms": round((_percentile([r[1] for r in ok], 0.99) or 0) * 1e3, 1),
+        }
+
+    unsat = phase(0.8 * capacity_rps)
+    time.sleep(1.0)  # queue fully drains between phases
+    sat = phase(2.0 * capacity_rps)
+    goodput_ratio = (sat["goodput_rps"] / unsat["goodput_rps"]
+                     if unsat["goodput_rps"] else 0.0)
+    return {
+        "shed_capacity_rps_nominal": round(capacity_rps, 1),
+        "shed_unsaturated": unsat,
+        "shed_saturated_2x": sat,
+        "shed_goodput_ratio": round(goodput_ratio, 3),
+        "shed_goodput_within_20pct": goodput_ratio >= 0.8,
+        "shed_rejections_observed": sat["shed"] > 0,
+        "shed_retry_after_present": (sat["shed"] > 0
+                                     and sat["shed_with_retry_after"] == sat["shed"]),
+        "shed_no_other_failures": (unsat["other_failures"] == 0
+                                   and sat["other_failures"] == 0),
+    }
+
+
+def chaos_main():
+    # fast control loop for a ~30s bench: scrape + worker metric pushes at
+    # 250ms so the SLO engine sees the burn while it is happening
+    os.environ.setdefault("RAY_TPU_METRICS_SCRAPE_INTERVAL_S", "0.25")
+    os.environ.setdefault("RAY_TPU_METRICS_REPORT_INTERVAL_S", "0.25")
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, max_workers_per_node=12)
+    port = 18440
+    results = {"config": "serve-plane chaos (host path, open-loop HTTP load)"}
+    try:
+        serve.start(http_options={"port": port})
+        if TINY:
+            results.update(run_chaos_kill(
+                port, rps=30.0, service_s=0.06, warm_s=3.0, post_kill_s=9.0))
+            results.update(run_chaos_shed(port, phase_s=3.0))
+        else:
+            results.update(run_chaos_kill(port))
+            results.update(run_chaos_shed(port))
+        gates = {
+            "zero_lost_requests": results["kill_zero_lost"],
+            "slo_burn_and_recovery": (results["kill_slo_burn_observed"]
+                                      and results["kill_slo_recovery_observed"]),
+            "p99_recovered_within_window": results["kill_p99_recovered_in_window"],
+            "shed_503_with_retry_after": (results["shed_rejections_observed"]
+                                          and results["shed_retry_after_present"]),
+            "goodput_within_20pct_at_2x": results["shed_goodput_within_20pct"],
+        }
+        results["gates"] = gates
+        results["all_gates_pass"] = all(gates.values())
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+    for k, v in results.items():
+        print(f"{k}: {v}")
+    out = os.path.join(os.path.dirname(__file__) or ".", "SERVE_CHAOS_BENCH.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {out}")
+    return results
+
+
 def main():
     import jax
 
@@ -625,4 +892,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--chaos" in sys.argv:
+        chaos_main()
+    else:
+        main()

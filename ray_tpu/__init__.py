@@ -27,10 +27,13 @@ from .core.api import (  # noqa: F401
 from .core.exceptions import (  # noqa: F401
     ActorDiedError,
     ActorError,
+    BackPressureError,
+    FaultInjectedError,
     GetTimeoutError,
     ObjectLostError,
     OutOfMemoryError,
     RayTpuError,
+    ReplicaUnavailableError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
@@ -72,6 +75,9 @@ __all__ = [
     "ObjectLostError",
     "OutOfMemoryError",
     "RayTpuError",
+    "ReplicaUnavailableError",
+    "BackPressureError",
+    "FaultInjectedError",
     "NodeAffinitySchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
 ]

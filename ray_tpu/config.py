@@ -309,6 +309,38 @@ _FLAGS: List[Flag] = [
          "int", 8,
          "Default per-replica concurrent-request cap "
          "(reference max_ongoing_requests)."),
+    Flag("serve_max_queued_requests", "RAY_TPU_SERVE_MAX_QUEUED_REQUESTS",
+         "int", -1,
+         "Default per-deployment queue cap beyond replica capacity "
+         "(max_ongoing_requests x replicas): excess handle calls are shed "
+         "with BackPressureError / HTTP 503 + Retry-After instead of "
+         "queueing into latency collapse. -1 = unbounded (no shedding)."),
+    Flag("serve_request_retries", "RAY_TPU_SERVE_REQUEST_RETRIES", "int", 3,
+         "Max times a handle call is re-sent to a DIFFERENT replica after a "
+         "replica-death/unavailable failure (deployments with "
+         "retryable=False never retry). User-code exceptions never retry."),
+    Flag("serve_retry_backoff_s", "RAY_TPU_SERVE_RETRY_BACKOFF_S", "float",
+         0.05,
+         "Base of the jittered exponential backoff between serve request "
+         "retries (attempt N sleeps ~base*2^(N-1), capped)."),
+    Flag("serve_retry_backoff_max_s", "RAY_TPU_SERVE_RETRY_BACKOFF_MAX_S",
+         "float", 2.0,
+         "Cap on the serve request retry backoff."),
+    Flag("serve_suspect_ttl_s", "RAY_TPU_SERVE_SUSPECT_TTL_S", "float", 30.0,
+         "How long the handle router excludes a replica after a "
+         "replica-death classified failure (the suspect list bridges the gap "
+         "until the controller's health check removes it from the long-poll "
+         "view)."),
+    Flag("serve_drain_timeout_s", "RAY_TPU_SERVE_DRAIN_TIMEOUT_S", "float",
+         30.0,
+         "Default grace a DRAINING replica gets to finish in-flight requests "
+         "on scale-down/rolling update/shutdown before it is killed anyway "
+         "(per-deployment override: drain_timeout_s)."),
+    Flag("fault_injection", "RAY_TPU_FAULT_INJECTION", "str", None,
+         "Arm util/fault_injection.py fail points from the environment: "
+         "'site=mode[@p=0.5][@n=3][@delay=0.1][@seed=7][;site2=...]' with "
+         "mode error|delay|kill. Deterministic chaos for tests/drills; "
+         "unset = every fail point is a no-op."),
     # -- llm engine defaults
     Flag("llm_max_num_seqs", "RAY_TPU_LLM_MAX_NUM_SEQS", "int", 8,
          "Default decode-slot count for LLMConfig (continuous batching width)."),

@@ -521,6 +521,10 @@ class DataClient:
         reconstruct). Pass retry=False when the server-side read is NOT
         idempotent (collective ring buffers count bytes read toward
         retraction — a replayed range would double-count)."""
+        from ray_tpu.util import fault_injection
+
+        fault_injection.fail_point("data_plane.pull", addr=addr,
+                                   size_hint=size_hint)
         addr = (addr[0], int(addr[1]))
         nstripes = plan_stripes(size_hint)
         if nstripes > 1:

@@ -82,6 +82,76 @@ class CollectiveAbortError(RayTpuError):
                  self.cause))
 
 
+class ReplicaUnavailableError(RayTpuError):
+    """A serve replica could not take (or finish) a request: its actor died,
+    its worker crashed, or it is draining ahead of a scale-down. The handle's
+    retry plane classifies these as safe to resend to a DIFFERENT replica
+    (for deployments with retryable=True); user-code exceptions never are.
+
+    Typed fields survive the cross-process pickle round trip (the
+    CollectiveAbortError convention) so callers can act without parsing."""
+
+    def __init__(self, app_name: str, deployment_name: str, replica: str = "",
+                 reason: str = "", cause=None):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.replica = replica
+        self.cause = cause
+        self.reason = reason
+        msg = f"replica unavailable for {app_name}/{deployment_name}"
+        if replica:
+            msg += f" (replica {replica})"
+        if reason:
+            msg += f": {reason}"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (ReplicaUnavailableError,
+                (self.app_name, self.deployment_name, self.replica,
+                 self.reason, self.cause))
+
+
+class BackPressureError(RayTpuError):
+    """Load shed: the deployment's queue limit (max_ongoing_requests x replicas
+    + max_queued_requests) is exceeded, so the request is rejected FAST instead
+    of queueing into latency collapse. `retry_after_s` is the caller's hint for
+    when capacity is likely to free (the proxies surface it as a Retry-After
+    header on a 503 / RESOURCE_EXHAUSTED)."""
+
+    def __init__(self, app_name: str, deployment_name: str, queue_depth: int = 0,
+                 limit: int = 0, retry_after_s: float = 1.0):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.queue_depth = queue_depth
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"request to {app_name}/{deployment_name} shed: {queue_depth} "
+            f"in flight >= limit {limit} (retry after {retry_after_s:.1f}s)")
+
+    def __reduce__(self):
+        return (BackPressureError,
+                (self.app_name, self.deployment_name, self.queue_depth,
+                 self.limit, self.retry_after_s))
+
+
+class FaultInjectedError(RayTpuError):
+    """Raised by an armed `util/fault_injection.py` fail point in "error" mode.
+
+    Chaos tooling's stand-in for infrastructure failure (NOT a user-code
+    error): the serve retry plane treats it like a replica death so injection
+    drives the same recovery paths a real crash would."""
+
+    def __init__(self, site: str, context=None):
+        self.site = site
+        self.context = dict(context or {})
+        super().__init__(f"fault injected at {site!r}"
+                         + (f" ({self.context})" if self.context else ""))
+
+    def __reduce__(self):
+        return (FaultInjectedError, (self.site, self.context))
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

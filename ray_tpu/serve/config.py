@@ -28,6 +28,19 @@ class DeploymentConfig:
     num_replicas: Optional[int] = 1
     max_ongoing_requests: int = dataclasses.field(
         default_factory=lambda: _flag("serve_max_ongoing_requests"))
+    # queue cap beyond replica capacity (max_ongoing x replicas): excess
+    # handle calls shed with BackPressureError / 503 + Retry-After instead of
+    # queueing into latency collapse. -1 = unbounded (never shed).
+    max_queued_requests: int = dataclasses.field(
+        default_factory=lambda: _flag("serve_max_queued_requests"))
+    # replica-death/unavailable failures resend the request to a DIFFERENT
+    # replica (bounded exponential backoff). Set False for non-idempotent
+    # methods whose double execution is worse than a surfaced error.
+    retryable: bool = True
+    # grace a DRAINING replica gets to finish in-flight requests on
+    # scale-down/rolling update/shutdown before it is killed anyway
+    drain_timeout_s: float = dataclasses.field(
+        default_factory=lambda: _flag("serve_drain_timeout_s"))
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = dataclasses.field(
         default_factory=lambda: _flag("serve_health_check_period_s"))

@@ -16,6 +16,9 @@ from ray_tpu.core.actor import method as _actor_method
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 STARTING, RUNNING, STOPPING = "STARTING", "RUNNING", "STOPPING"
+# graceful exit: out of the long-poll view immediately (no new requests),
+# in-flight requests get up to drain_timeout_s to finish, then the kill
+DRAINING = "DRAINING"
 
 
 import itertools as _it
@@ -32,6 +35,8 @@ class _ReplicaState:
         self.health_ref = None
         self.last_health_ok = time.time()
         self.node_id: Optional[str] = None  # packing assignment (soft affinity)
+        self.drain_deadline: Optional[float] = None
+        self.drain_ref = None  # outstanding drain()/num_inflight() poll
 
 
 class _DeploymentState:
@@ -48,9 +53,16 @@ class _DeploymentState:
             self.target_num = max(ac.min_replicas, 1)
         self.autoscale_metric: float = 0.0
         self._last_scale_change = 0.0
+        self.deleting = False  # drain-down in progress; reap when empty
 
     def running(self) -> List[_ReplicaState]:
         return [r for r in self.replicas if r.state == RUNNING]
+
+    def drain_timeout_s(self) -> float:
+        # pre-upgrade KV checkpoints may lack the field (unpickle skips
+        # defaults); 0 is a real value ("no grace, kill immediately")
+        v = getattr(self.info["config"], "drain_timeout_s", None)
+        return 30.0 if v is None else v
 
 
 class ServeController:
@@ -129,12 +141,17 @@ class ServeController:
             for d in deployments:
                 key = f"{app_name}/{d['name']}"
                 existing = self.deployments.get(key)
+                if existing is not None and existing.deleting:
+                    # re-deploy racing a drain-down: resurrect as a rolling
+                    # update (old draining replicas finish; fresh ones start)
+                    existing.deleting = False
                 if existing is not None and existing.info["config"].version != d["config"].version:
-                    # version change -> rolling update: mark old replicas for replacement
+                    # version change -> rolling update: old replicas DRAIN
+                    # (finish in-flight work) while replacements start
                     existing.info = d
                     for r in existing.replicas:
-                        if r.version != d["config"].version:
-                            r.state = STOPPING
+                        if r.version != d["config"].version and r.state in (STARTING, RUNNING):
+                            self._drain_replica(r, existing)
                     existing.target_num = d["config"].num_replicas or existing.target_num
                 elif existing is None:
                     self.deployments[key] = _DeploymentState(d["name"], app_name, d)
@@ -142,8 +159,13 @@ class ServeController:
                     existing.info = d
                     if d["config"].num_replicas:
                         existing.target_num = d["config"].num_replicas
+        # draining replicas must leave the long-poll view NOW, not a reconcile
+        # tick later — handles stop picking them before the kill window opens
+        self._publish_changes()
 
     def delete_application(self, app_name: str) -> None:
+        """Drain-down, not a massacre: replicas finish in-flight requests (up
+        to drain_timeout_s) before the reconcile loop reaps them."""
         with self._lock:
             try:
                 self._drop_checkpoint(app_name)
@@ -153,18 +175,82 @@ class ServeController:
             if not app:
                 return
             for dname in app["deployments"]:
-                ds = self.deployments.pop(f"{app_name}/{dname}", None)
+                ds = self.deployments.get(f"{app_name}/{dname}")
                 if ds:
+                    ds.deleting = True
+                    ds.target_num = 0
                     for r in ds.replicas:
-                        self._stop_replica(r)
+                        if r.state in (STARTING, RUNNING):
+                            self._drain_replica(r, ds)
+        self._publish_changes()
 
     def shutdown(self) -> None:
+        """Graceful stop: every replica drains (bounded by its deployment's
+        drain_timeout_s) before the kill. Idle replicas cost one RPC round."""
+        import ray_tpu
+
+        for app in list(self.apps):
+            self.delete_application(app)
         with self._lock:
-            for app in list(self.apps):
-                self.delete_application(app)
-            self._shutdown = True
+            self._shutdown = True  # reconcile loop stops; we finish the drain
+        # let any in-progress reconcile pass finish before we touch replica
+        # state (drain_ref and the kill below run without the lock held)
+        try:
+            self._reconcile_thread.join(timeout=10)
+        except Exception:
+            pass
+        with self._lock:
+            pending = [(r, ds) for ds in self.deployments.values()
+                       for r in ds.replicas]
+        now = time.time()
+        # honor drains already in progress (delete_application stamped their
+        # deadline): shutdown must not grant a wedged replica a fresh window
+        deadline_of = {id(r): (r.drain_deadline if r.drain_deadline is not None
+                               else now + ds.drain_timeout_s())
+                       for r, ds in pending}
+        while pending:
+            now = time.time()
+            still = []
+            polls = []
+            for r, ds in pending:
+                if now > deadline_of[id(r)]:
+                    self._stop_replica(r)  # drain deadline burned: kill anyway
+                    continue
+                try:
+                    polls.append((r, ds, r.drain_ref or r.actor.num_inflight.remote()))
+                except Exception:
+                    self._stop_replica(r)  # handle already unusable
+            for r, ds, ref in polls:
+                r.drain_ref = None
+                try:
+                    n = ray_tpu.get(ref, timeout=2.0)
+                except Exception:
+                    n = 0  # replica already gone: nothing left to drain
+                if n == 0:
+                    self._stop_replica(r)
+                else:
+                    still.append((r, ds))
+            pending = still
+            if pending:
+                time.sleep(0.05)
+        with self._lock:
+            for ds in self.deployments.values():
+                ds.replicas.clear()
+            self.deployments.clear()
         with self._lp_cond:  # wake parked listeners so they return promptly
             self._lp_cond.notify_all()
+
+    def _drain_replica(self, r: _ReplicaState, ds: _DeploymentState) -> None:
+        """RUNNING/STARTING -> DRAINING (caller holds the lock). The drain()
+        RPC flips the replica's gate so racing sends bounce to live replicas;
+        its reply doubles as the first in-flight poll."""
+        r.state = DRAINING
+        r.drain_deadline = time.time() + ds.drain_timeout_s()
+        r.health_ref = None
+        try:
+            r.drain_ref = r.actor.drain.remote()
+        except Exception:
+            r.drain_ref = None  # dead already; reconcile reaps it
 
     # -- read APIs (handles/proxies poll these; reference LongPollHost) ---------
     def get_routing_table(self) -> Dict[str, Any]:
@@ -196,6 +282,21 @@ class ServeController:
                 "states": [r.state for r in ds.replicas],
             }
 
+    def get_deployment_limits(self, app_name: str,
+                              deployment_name: str) -> Optional[Dict[str, Any]]:
+        """Admission/retry knobs the handle enforces client-side (cached there;
+        getattr guards cover pre-upgrade KV checkpoints missing new fields)."""
+        with self._lock:
+            ds = self.deployments.get(f"{app_name}/{deployment_name}")
+            if ds is None:
+                return None
+            cfg = ds.info["config"]
+            return {
+                "max_ongoing_requests": getattr(cfg, "max_ongoing_requests", 8),
+                "max_queued_requests": getattr(cfg, "max_queued_requests", -1),
+                "retryable": getattr(cfg, "retryable", True),
+            }
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -210,6 +311,29 @@ class ServeController:
 
     def ping(self) -> bool:
         return True
+
+    def report_replica_failure(self, app_name: str, deployment_name: str,
+                               actor_id) -> bool:
+        """Handle-side death push: a client observed an authoritative
+        ActorDiedError/WorkerCrashedError on this replica. Mark it STOPPING
+        and republish NOW instead of letting it sit in the routing view for
+        up to health_check_period_s — the window where a scale-down could
+        otherwise drain the healthy replicas and keep the dead one."""
+        marked = False
+        with self._lock:
+            ds = self.deployments.get(f"{app_name}/{deployment_name}")
+            if ds is None:
+                return False
+            for r in ds.replicas:
+                if r.actor._actor_id == actor_id and r.state in (STARTING,
+                                                                 RUNNING,
+                                                                 DRAINING):
+                    r.state = STOPPING
+                    r.health_ref = None
+                    marked = True
+        if marked:
+            self._publish_changes()  # dead replica leaves the view immediately
+        return marked
 
     # -- autoscaling input (handles push router stats; reference autoscaling_state) --
     def record_handle_metrics(self, app_name: str, deployment_name: str, ongoing: float) -> None:
@@ -256,10 +380,14 @@ class ServeController:
         actor_opts = {"num_cpus": opts.get("num_cpus", 1)}
         if opts.get("num_tpus"):
             actor_opts["num_tpus"] = opts["num_tpus"]
-        # replicas serve concurrent requests up to max_ongoing_requests (threaded actor)
+        # replicas serve concurrent requests up to max_ongoing_requests
+        # (threaded actor) — the replica-side half of admission control: the
+        # runtime caps executing user requests at moq, excess queues in the
+        # mailbox. Control RPCs (health/drain/fault-arming) run on their own
+        # unbounded group so a saturated replica still answers the controller.
         moq = ds.info["config"].max_ongoing_requests
-        if moq and moq > 1:
-            actor_opts["max_concurrency"] = moq
+        actor_opts["max_concurrency"] = max(1, moq or 1)
+        actor_opts["concurrency_groups"] = {"control": 0}
         node_id = self._choose_replica_node(ds, actor_opts["num_cpus"])
         if node_id is not None:
             from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
@@ -271,7 +399,10 @@ class ServeController:
         from .replica import Replica
 
         cls = ray_tpu.remote(**actor_opts)(Replica)
-        actor = cls.remote(ds.name, ds.info["serialized_init"], ds.info["config"].user_config)
+        actor = cls.remote(ds.name, ds.info["serialized_init"],
+                           ds.info["config"].user_config,
+                           app_name=ds.app_name,
+                           max_ongoing_requests=max(0, moq or 0))
         r = _ReplicaState(actor, ds.info["config"].version)
         r.node_id = node_id
         r.health_ref = actor.check_health.remote()
@@ -340,21 +471,48 @@ class ServeController:
                         elif now - r.last_health_ok > period + ds.info["config"].health_check_timeout_s:
                             r.state = STOPPING
                             r.health_ref = None
+                # DRAINING: poll in-flight; drained (or past deadline) -> STOPPING
+                for r in [x for x in ds.replicas if x.state == DRAINING]:
+                    if r.drain_ref is None:
+                        try:
+                            r.drain_ref = r.actor.num_inflight.remote()
+                        except Exception:
+                            r.state = STOPPING  # handle unusable: reap now
+                            continue
+                    done, _ = ray_tpu.wait([r.drain_ref], num_returns=1, timeout=0)
+                    if done:
+                        try:
+                            n = ray_tpu.get(r.drain_ref)
+                        except Exception:
+                            n = 0  # replica died mid-drain: nothing left to wait on
+                        r.drain_ref = None
+                        if n == 0:
+                            r.state = STOPPING
+                    if r.state == DRAINING and r.drain_deadline is not None \
+                            and now > r.drain_deadline:
+                        r.state = STOPPING  # grace burned: kill anyway
                 # remove STOPPING
                 for r in [x for x in ds.replicas if x.state == STOPPING]:
                     self._stop_replica(r)
                     ds.replicas.remove(r)
-                # scale to target: count live (non-stopping) replicas of current version
+                # scale to target: count live (non-stopping, non-draining)
+                # replicas of the current version
                 live = [r for r in ds.replicas if r.state in (STARTING, RUNNING)]
-                for _ in range(ds.target_num - len(live)):
-                    self._start_replica(ds)
+                if not ds.deleting:
+                    for _ in range(ds.target_num - len(live)):
+                        self._start_replica(ds)
                 extra = len(live) - ds.target_num
                 for r in reversed(live):
                     if extra <= 0:
                         break
                     if r.state == RUNNING or r.state == STARTING:
-                        r.state = STOPPING
+                        self._drain_replica(r, ds)  # graceful scale-down
                         extra -= 1
+        # reap deployments whose drain-down finished (app already deleted)
+        with self._lock:
+            for key in [k for k, ds in self.deployments.items()
+                        if ds.deleting and not ds.replicas]:
+                del self.deployments[key]
 
     def _reconcile_loop(self) -> None:
         while not self._shutdown:

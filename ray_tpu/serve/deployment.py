@@ -40,6 +40,9 @@ class Deployment:
         name: Optional[str] = None,
         num_replicas: Optional[Union[int, str]] = None,
         max_ongoing_requests: Optional[int] = None,
+        max_queued_requests: Optional[int] = None,
+        retryable: Optional[bool] = None,
+        drain_timeout_s: Optional[float] = None,
         autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
         ray_actor_options: Optional[Dict[str, Any]] = None,
         user_config: Optional[Dict[str, Any]] = None,
@@ -56,6 +59,12 @@ class Deployment:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        if retryable is not None:
+            cfg.retryable = retryable
+        if drain_timeout_s is not None:
+            cfg.drain_timeout_s = drain_timeout_s
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -88,6 +97,9 @@ def deployment(
     name: Optional[str] = None,
     num_replicas: Optional[Union[int, str]] = None,
     max_ongoing_requests: int = 8,
+    max_queued_requests: Optional[int] = None,
+    retryable: bool = True,
+    drain_timeout_s: Optional[float] = None,
     autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
     ray_actor_options: Optional[Dict[str, Any]] = None,
     user_config: Optional[Dict[str, Any]] = None,
@@ -104,12 +116,17 @@ def deployment(
         cfg = DeploymentConfig(
             num_replicas=1,
             max_ongoing_requests=max_ongoing_requests,
+            retryable=retryable,
             ray_actor_options=ray_actor_options or {},
             user_config=user_config,
             version=version,
             health_check_period_s=health_check_period_s,
             placement_strategy=placement_strategy,
         )
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        if drain_timeout_s is not None:
+            cfg.drain_timeout_s = drain_timeout_s
         d = Deployment(target, name or getattr(target, "__name__", "deployment"), cfg)
         if num_replicas is not None or autoscaling_config is not None:
             d = d.options(num_replicas=num_replicas, autoscaling_config=autoscaling_config)

@@ -25,6 +25,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core.exceptions import BackPressureError
 
 SERVICE = "rayserve.Generic"
 METHOD = "Call"
@@ -78,9 +79,21 @@ class GrpcProxyActor:
                     self._handles[key] = handle
             return result
 
+        def set_retry_after(context, e: BackPressureError) -> None:
+            """The gRPC analog of the HTTP Retry-After header: trailing
+            metadata, clamped to a whole positive second."""
+            try:
+                context.set_trailing_metadata(
+                    (("retry-after", str(max(1, int(e.retry_after_s)))),))
+            except Exception:  # noqa: BLE001 — context already finalized
+                pass
+
         def route_with_retry(app: str, method: str, args, kwargs):
             try:
                 return route(app, method, args, kwargs)
+            except BackPressureError:
+                raise  # shed by admission control: a stale-cache retry would
+                # just shed again — surface the typed rejection immediately
             except Exception:
                 with self._handles_lock:
                     was_cached = self._handles.pop((app, method), None) is not None
@@ -100,6 +113,11 @@ class GrpcProxyActor:
                 result = route_with_retry(app, method, req.get("args") or [],
                                           req.get("kwargs") or {})
                 return json.dumps({"ok": True, "result": result}).encode()
+            except BackPressureError as e:
+                # typed shed: retry_after_s in the envelope AND as metadata
+                set_retry_after(context, e)
+                return json.dumps({"ok": False, "error": repr(e), "shed": True,
+                                   "retry_after_s": e.retry_after_s}).encode()
             except Exception as e:  # noqa: BLE001
                 return json.dumps({"ok": False, "error": repr(e)}).encode()
 
@@ -130,6 +148,9 @@ class GrpcProxyActor:
                 app = apps[0]
             try:
                 return route_with_retry(app, method_name, (request,), {})
+            except BackPressureError as e:
+                set_retry_after(context, e)
+                context.abort(_grpc.StatusCode.RESOURCE_EXHAUSTED, repr(e))
             except Exception as e:  # noqa: BLE001 — surface as gRPC status
                 context.abort(_grpc.StatusCode.INTERNAL, repr(e))
 

@@ -1,50 +1,159 @@
-"""DeploymentHandle + router: client-side load balancing.
+"""DeploymentHandle + router: client-side load balancing and fault tolerance.
 
 Capability parity: reference python/ray/serve/handle.py:639 (DeploymentHandle),
 _private/router.py + request_router/pow_2_router.py:27 (power-of-two-choices on
-in-flight counts), DeploymentResponse futures. Handles refresh their replica set from
-the controller (long-poll analog) and push autoscaling metrics back.
+in-flight counts), DeploymentResponse futures. Handles refresh their replica set
+from the controller (long-poll analog) and push autoscaling metrics back.
+
+Self-healing additions (reference _private/replica_scheduler backoff +
+request retries):
+- replica-death/unavailable failures (typed: ActorError / WorkerCrashedError /
+  ReplicaUnavailableError / FaultInjectedError) are retried against a
+  DIFFERENT replica with bounded exponential backoff; user-code exceptions
+  never retry, and deployments declare `retryable=False` to opt out entirely.
+- a failure feeds the router's SUSPECT list, so the next pick avoids the dying
+  replica before the controller's health check removes it from the long-poll
+  view. Streaming calls retry only while no chunk has been yielded.
+- handle-side admission control: beyond max_ongoing_requests x replicas +
+  max_queued_requests, calls shed with BackPressureError (the proxies turn it
+  into 503 + Retry-After) instead of queueing into latency collapse.
+- one shared completion waiter per router batches ray_tpu.wait over all
+  outstanding requests (one thread, not one per request).
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 import ray_tpu
-from ray_tpu.util import telemetry
+from ray_tpu.core.exceptions import (
+    ActorError,
+    BackPressureError,
+    FaultInjectedError,
+    ReplicaUnavailableError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.util import fault_injection, telemetry
 
 from .controller import CONTROLLER_NAME
 
+logger = logging.getLogger("ray_tpu.serve")
+
+_warn_interval_s = 30.0
+_last_warn = [0.0]  # monotonic stamp (tracing._maybe_flush convention)
+
+
+def _throttled_warn(msg: str, *args) -> None:
+    now = time.monotonic()
+    if now - _last_warn[0] >= _warn_interval_s:
+        _last_warn[0] = now
+        logger.warning(msg + " (further warnings muted for %.0fs)",
+                       *args, _warn_interval_s)
+
+
+def retry_after_from_latency(latency_s: Optional[float],
+                             fallback: float = 1.0) -> float:
+    """Shed-hint policy, shared by the handle's BackPressureError and the
+    proxies' Retry-After header: ~two recent service times (the queue drains
+    one per slot), clamped to a sane wire range."""
+    return min(30.0, max(0.5, 2.0 * latency_s)) if latency_s else fallback
+
+
+def _rid(replica) -> Any:
+    """Stable replica identity: the actor id. Long-poll snapshots deliver NEW
+    ActorHandle objects for the same replica, so object identity would orphan
+    in-flight counts / suspicions on every view change."""
+    return replica._actor_id
+
+
+def is_replica_failure(err: BaseException) -> bool:
+    """True when the failure means THE REPLICA (not the request) is bad, so
+    resending to a different replica can succeed: actor death, worker crash,
+    a draining replica's bounce, or an armed fail point standing in for one.
+    User-code exceptions arrive as TaskError and are never retried."""
+    if isinstance(err, TaskError):
+        return isinstance(err.cause, (FaultInjectedError, ReplicaUnavailableError))
+    return isinstance(err, (ActorError, WorkerCrashedError,
+                            ReplicaUnavailableError, FaultInjectedError,
+                            ConnectionError))
+
 
 class DeploymentResponse:
-    """Future-like wrapper over the underlying ObjectRef (reference handle.py)."""
+    """Future-like wrapper over the underlying ObjectRef (reference handle.py).
 
-    def __init__(self, ref):
+    result() drives the retry plane: a replica-death classified failure
+    resends the request to a different replica (bounded backoff, suspect
+    feedback) before surfacing anything to the caller."""
+
+    def __init__(self, ref, session: Optional["_RetrySession"] = None):
         self._ref = ref
+        self._session = session
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
-        return ray_tpu.get(self._ref) if timeout_s is None else ray_tpu.get(self._ref)
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        if self._session is not None:
+            # bound the WHOLE retry journey (backoff sleeps, replica
+            # re-discovery), not just the get below
+            self._session.deadline = deadline
+        while True:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                val = ray_tpu.get(self._ref, timeout=remaining)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if self._session is None:
+                    raise
+                self._session.prepare_retry(e)  # re-raises when not retryable
+                self._ref = self._session.send()
+                continue
+            if self._session is not None:
+                self._session.observe_success()
+            return val
 
     @property
     def ref(self):
         return self._ref
 
+    def __reduce__(self):
+        # the retry session holds the handle's router (locks, threads): a
+        # serialized response keeps only the ref — retries stay caller-side
+        return (DeploymentResponse, (self._ref,))
+
 
 class DeploymentResponseGenerator:
     """Streaming handle call: iterate replica-yielded values as they arrive
     (reference handle.py DeploymentResponseGenerator over a streaming ObjectRef
-    generator)."""
+    generator). Retries to a different replica ONLY while no chunk has been
+    yielded — after first output the stream is observable state the caller may
+    have acted on, so mid-stream failures surface."""
 
-    def __init__(self, ref_gen):
+    def __init__(self, ref_gen, session: Optional["_RetrySession"] = None):
         self._gen = ref_gen
+        self._session = session
+        self._yielded = False
 
     def __iter__(self):
         return self
 
     def __next__(self) -> Any:
-        return ray_tpu.get(next(self._gen))
+        while True:
+            try:
+                out = ray_tpu.get(next(self._gen))
+            except StopIteration:
+                if self._session is not None:
+                    self._session.observe_success()  # clean end of stream
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                if self._yielded or self._session is None:
+                    raise
+                self._session.prepare_retry(e)  # re-raises when not retryable
+                self._gen = self._session.send()
+                continue
+            self._yielded = True
+            return out
 
     def close(self) -> None:
         """Abandon the stream: unconsumed items are released and the replica's
@@ -57,55 +166,206 @@ class DeploymentResponseGenerator:
     def completed(self):
         return self._gen.completed
 
+    def __reduce__(self):
+        return (DeploymentResponseGenerator, (self._gen,))
+
+
+class _CompletionWaiter:
+    """ONE daemon thread per router batching ray_tpu.wait over every
+    outstanding request (was: one thread per request). Callbacks run the
+    per-request bookkeeping (router counts, queue-depth gauge, latency
+    telemetry) within ~_POLL_S of completion."""
+
+    _POLL_S = 0.05
+    _IDLE_RETIRE_S = 30.0
+    # consecutive ray_tpu.wait failures before we declare the runtime gone
+    # and release ALL bookkeeping — one transient hiccup must not zero the
+    # in-flight counts that admission control and p2c read
+    _FAIL_FLUSH_THRESHOLD = 3
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._cv = threading.Condition()
+        self._pending: Dict[Any, Callable[[], None]] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, ref, callback: Callable[[], None]) -> None:
+        with self._cv:
+            self._pending[ref] = callback
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="serve-done-waiter")
+                self._thread.start()
+            self._cv.notify()
+
+    def outstanding(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def _loop(self) -> None:
+        wait_failures = 0
+        while True:
+            with self._cv:
+                if not self._pending:
+                    # park until work arrives; retire ATOMICALLY with the
+                    # empty check so repeated run/shutdown cycles don't
+                    # accumulate immortal threads
+                    if not self._cv.wait(timeout=self._IDLE_RETIRE_S) \
+                            and not self._pending:
+                        self._thread = None
+                        return
+                    continue
+                refs = list(self._pending.keys())
+            fire: List[Callable[[], None]] = []
+            try:
+                # num_returns=1: wake on the FIRST completion (the store scan
+                # returns every ref ready at that moment, not just one), so
+                # decrements lag completions by ~1ms instead of a full poll
+                # interval — admission control and p2c read near-live counts.
+                # The timeout keeps the loop responsive to refs added while
+                # this wait was parked on the previous snapshot.
+                ready, _ = ray_tpu.wait(refs, num_returns=1,
+                                        timeout=self._POLL_S)
+                wait_failures = 0
+            except Exception as e:  # noqa: BLE001
+                wait_failures += 1
+                _throttled_warn(
+                    "serve completion wait failed for %s/%s (%d outstanding, "
+                    "%d consecutive): %r", self.app_name, self.deployment_name,
+                    len(refs), wait_failures, e)
+                if wait_failures < self._FAIL_FLUSH_THRESHOLD:
+                    time.sleep(self._POLL_S)
+                    continue
+                # runtime durably gone: parity with the old per-request
+                # watcher's finally — release the bookkeeping rather than
+                # pinning in-flight counts forever
+                wait_failures = 0
+                ready = refs
+            with self._cv:
+                for ref in ready:
+                    cb = self._pending.pop(ref, None)
+                    if cb is not None:
+                        fire.append(cb)
+            for cb in fire:
+                try:
+                    cb()
+                except Exception as e:  # noqa: BLE001 — bookkeeping must not die
+                    _throttled_warn(
+                        "serve completion callback failed for %s/%s: %r",
+                        self.app_name, self.deployment_name, e)
+
 
 class _Router:
-    """Power-of-two-choices over locally tracked in-flight counts, with
-    model-affinity for multiplexed requests (reference: multiplexed replica
-    ranking in request_router)."""
+    """Power-of-two-choices over locally tracked in-flight counts (keyed by
+    actor id so counts survive long-poll snapshot churn), with model-affinity
+    for multiplexed requests (reference: multiplexed replica ranking in
+    request_router) and a suspect list fed by request failures."""
 
     def __init__(self):
-        self.inflight: Dict[Any, int] = {}
-        self.model_map: Dict[str, set] = {}  # model_id -> replicas observed hosting it
+        self.inflight: Dict[Any, int] = {}  # actor id -> in-flight count
+        self.model_map: Dict[str, set] = {}  # model_id -> actor ids hosting it
+        self.suspects: Dict[Any, float] = {}  # actor id -> suspicion expiry
         self.lock = threading.Lock()
+        self.ewma_latency_s = 0.0  # recent request latency (Retry-After input)
+        # shared-per-deployment state anchored here because handle.options()
+        # clones the handle but reuses the router (all guarded by self.lock)
+        self._limits_cache: Optional[tuple] = None  # (expiry, limits dict)
+        self._limits_refreshing = False
+        self._metrics_thread: Optional[threading.Thread] = None
 
     # a model-holder this many requests deeper than an alternative loses affinity
     SPILLOVER_THRESHOLD = 2
 
-    def pick(self, replicas: List[Any], model_id: Optional[str] = None) -> Any:
+    def _load(self, replica) -> int:
+        return self.inflight.get(_rid(replica), 0)
+
+    def pick(self, replicas: List[Any], model_id: Optional[str] = None,
+             exclude: Optional[Set[Any]] = None) -> Any:
         with self.lock:
+            now = time.monotonic()
+            for rid in [r for r, exp in self.suspects.items() if exp <= now]:
+                del self.suspects[rid]
+            avoid = set(self.suspects)
+            if exclude:
+                avoid |= exclude
+            live = [r for r in replicas if _rid(r) not in avoid]
+            if not live:
+                # everything is suspect/excluded: last resort beats no send
+                live = [r for r in replicas if _rid(r) not in (exclude or ())]
+            if not live:
+                live = replicas
+            replicas = live
             if model_id:
-                live = {r for r in self.model_map.get(model_id, ()) if r in replicas}
-                self.model_map[model_id] = live  # prune dead replicas
+                ids = {_rid(r): r for r in replicas}
+                # holders limited to the pickable view for THIS choice only;
+                # the map itself is pruned on long-poll view changes (prune())
+                # — a suspect-filtered view must not erase affinity for
+                # replicas that are alive and still in the view
+                holders = {i for i in self.model_map.get(model_id, ())
+                           if i in ids}
                 choice = None
-                if live:
-                    choice = min(live, key=lambda r: self.inflight.get(r, 0))
-                    others = [r for r in replicas if r not in live]
+                if holders:
+                    cid = min(holders, key=lambda i: self.inflight.get(i, 0))
+                    choice = ids[cid]
+                    others = [r for r in replicas if _rid(r) not in holders]
                     if others:
                         # reference behavior: affinity ranks first but overload
                         # spills to a non-holder (which then loads the model)
                         alt = min(random.sample(others, min(2, len(others))),
-                                  key=lambda r: self.inflight.get(r, 0))
-                        if (self.inflight.get(choice, 0)
-                                > self.inflight.get(alt, 0) + self.SPILLOVER_THRESHOLD):
+                                  key=self._load)
+                        if self._load(choice) > self._load(alt) + self.SPILLOVER_THRESHOLD:
                             choice = alt
                 if choice is None:
                     choice = (replicas[0] if len(replicas) == 1
                               else min(random.sample(replicas, 2),
-                                       key=lambda r: self.inflight.get(r, 0)))
-                self.model_map[model_id].add(choice)
+                                       key=self._load))
+                self.model_map.setdefault(model_id, set()).add(_rid(choice))
                 return choice
             if len(replicas) == 1:
                 return replicas[0]
             a, b = random.sample(replicas, 2)
-            return a if self.inflight.get(a, 0) <= self.inflight.get(b, 0) else b
+            return a if self._load(a) <= self._load(b) else b
 
     def on_send(self, replica) -> None:
         with self.lock:
-            self.inflight[replica] = self.inflight.get(replica, 0) + 1
+            rid = _rid(replica)
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
 
     def on_done(self, replica) -> None:
         with self.lock:
-            self.inflight[replica] = max(0, self.inflight.get(replica, 0) - 1)
+            rid = _rid(replica)
+            if rid in self.inflight:  # pruned replicas must not resurrect
+                self.inflight[rid] = max(0, self.inflight[rid] - 1)
+
+    def suspect(self, replica, ttl_s: float) -> None:
+        """A request against this replica failed with a replica-death class
+        error: stop picking it until the controller's health check catches up
+        (or the TTL expires and it proves healthy again)."""
+        with self.lock:
+            self.suspects[_rid(replica)] = time.monotonic() + ttl_s
+
+    def prune(self, current_ids: Set[Any]) -> None:
+        """Drop state for replicas that left the long-poll view (scale-down,
+        death): stale entries skew p2c and leak under replica churn."""
+        with self.lock:
+            for rid in [i for i in self.inflight if i not in current_ids]:
+                del self.inflight[rid]
+            for rid in [i for i in self.suspects if i not in current_ids]:
+                del self.suspects[rid]
+            for mid in list(self.model_map):
+                kept = {i for i in self.model_map[mid] if i in current_ids}
+                if kept:
+                    self.model_map[mid] = kept
+                else:
+                    del self.model_map[mid]
+
+    def observe_latency(self, seconds: float) -> None:
+        with self.lock:
+            if self.ewma_latency_s == 0.0:
+                self.ewma_latency_s = seconds
+            else:
+                self.ewma_latency_s = 0.8 * self.ewma_latency_s + 0.2 * seconds
 
     def total_inflight(self) -> int:
         with self.lock:
@@ -221,6 +481,106 @@ def _reset_long_poll() -> None:
         _long_poll_client.versions.clear()
 
 
+class _RetrySession:
+    """One logical request's journey across replicas. Owns the retry budget,
+    the per-replica exclusion set, and the backoff schedule; DeploymentResponse
+    / DeploymentResponseGenerator call prepare_retry() + send() when an attempt
+    fails with a replica-death class error."""
+
+    def __init__(self, handle: "DeploymentHandle", args: tuple, kwargs: dict,
+                 retryable: bool, trace_id: Optional[str]):
+        from ray_tpu.config import CONFIG
+
+        self.handle = handle
+        self.args = args
+        self.kwargs = kwargs
+        self.trace_id = trace_id
+        self.attempts_left = CONFIG.serve_request_retries if retryable else 0
+        self.backoff_s = CONFIG.serve_retry_backoff_s
+        self.backoff_max_s = CONFIG.serve_retry_backoff_max_s
+        self.suspect_ttl_s = CONFIG.serve_suspect_ttl_s
+        self.exclude: Set[Any] = set()  # actor ids already tried and failed
+        self.dead_ids: Set[Any] = set()  # subset seen die AUTHORITATIVELY
+        self.replica = None  # replica of the LAST attempt
+        self.attempt = 0
+        self.deadline: Optional[float] = None  # caller's result(timeout_s) bound
+        self.t0_perf = 0  # send time of the last attempt (perf_counter_ns)
+        self.completed_dur_ns: Optional[int] = None  # stamped by the waiter
+        self._observed = False  # EWMA fed at most once per logical request
+
+    def prepare_retry(self, err: BaseException) -> None:
+        """Classify a failed attempt; re-raise when the request must surface
+        (user error, budget exhausted, retryable=False, caller deadline
+        passed), otherwise mark the replica suspect and sleep the backoff."""
+        if not is_replica_failure(err) or self.attempts_left <= 0:
+            raise err
+        if self.deadline is not None and time.monotonic() >= self.deadline:
+            raise err  # the caller's timeout outranks the retry budget
+        self.attempts_left -= 1
+        self.attempt += 1
+        # ActorDiedError/WorkerCrashedError come from the cluster's own death
+        # detection — authoritative, unlike an injected or draining bounce
+        authoritative = isinstance(err, (ActorError, WorkerCrashedError))
+        if self.replica is not None:
+            self.handle._router.suspect(self.replica, self.suspect_ttl_s)
+            self.exclude.add(_rid(self.replica))
+            if authoritative:
+                self.dead_ids.add(_rid(self.replica))
+                # push the death to the controller: the replica must leave the
+                # routing view NOW, not a health_check_period_s later — the
+                # window where a scale-down could drain the healthy replicas
+                # and keep this dead one
+                try:
+                    self.handle._controller().report_replica_failure.remote(
+                        self.handle.app_name, self.handle.deployment_name,
+                        _rid(self.replica))
+                except Exception:  # noqa: BLE001 — best-effort push
+                    pass
+        logger.info(
+            "serve request to %s/%s failed on replica (attempt %d, %s); "
+            "retrying on a different replica",
+            self.handle.app_name, self.handle.deployment_name, self.attempt,
+            type(err.cause if isinstance(err, TaskError) else err).__name__)
+        # bounded exponential backoff with jitter (decorrelates retry storms)
+        delay = min(self.backoff_s * (2 ** (self.attempt - 1)), self.backoff_max_s)
+        delay *= 0.5 + random.random() * 0.5
+        if self.deadline is not None:
+            delay = min(delay, max(0.0, self.deadline - time.monotonic()))
+        time.sleep(delay)
+        if authoritative:
+            # a retry against a KNOWN-dead replica is a wasted attempt: wait
+            # (bounded) for the reported death to propagate into a view that
+            # offers something else before spending the next one
+            self.handle._await_non_dead_replica(self.dead_ids, self.deadline)
+
+    def send(self):
+        """One attempt: pick (excluding failed replicas), send, register with
+        the completion waiter. Synchronous send failures consume retry budget
+        here instead of surfacing half-initialized responses."""
+        while True:
+            try:
+                return self.handle._send_once(self)
+            except Exception as e:  # noqa: BLE001 — classified by prepare_retry
+                self.prepare_retry(e)
+
+    def observe_success(self) -> None:
+        """Feed the router's Retry-After EWMA from a request that SUCCEEDED:
+        fast-error completions (drain bounces, dead replicas, fail points)
+        must not collapse the shed hint exactly when callers should back off.
+        Uses the waiter's true completion duration when it has fired, else
+        send→now (the get that just returned makes them ~equal)."""
+        if self._observed:
+            return
+        self._observed = True
+        dur = self.completed_dur_ns
+        if dur is None:
+            dur = time.perf_counter_ns() - self.t0_perf
+        try:
+            self.handle._router.observe_latency(dur / 1e9)
+        except Exception:  # noqa: BLE001 — load signals must never fail a request
+            pass
+
+
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str, method_name: str = "__call__",
                  multiplexed_model_id: str = "", stream: bool = False):
@@ -230,9 +590,11 @@ class DeploymentHandle:
         self._multiplexed_model_id = multiplexed_model_id
         self._stream = stream
         self._router = _Router()
+        self._waiter = _CompletionWaiter(app_name, deployment_name)
         self._replicas: List[Any] = []
         self._last_refresh = 0.0
         self._refresh_interval = 1.0
+        self._last_view: Optional[List[Any]] = None  # router-prune change detector
 
     # -- plumbing --------------------------------------------------------------
     def _controller(self):
@@ -243,6 +605,7 @@ class DeploymentHandle:
         entry = _lp_registry.get((self.app_name, self.deployment_name))
         if entry is not None and entry.replicas is not None and not force:
             self._replicas = entry.replicas
+            self._maybe_prune(entry.replicas)
             return
         now = time.time()
         if not force and now - self._last_refresh < self._refresh_interval and self._replicas:
@@ -251,13 +614,66 @@ class DeploymentHandle:
             self._controller().get_replicas.remote(self.app_name, self.deployment_name)
         )
         self._replicas = replicas
+        self._maybe_prune(replicas)
         self._last_refresh = now
+
+    def _maybe_prune(self, view: List[Any]) -> None:
+        """On a replica-set change, drop router state for departed replicas
+        (the controller-side health/drain push arrives as exactly this view
+        change). Identity check keeps the per-call cost at one comparison."""
+        if view is self._last_view:
+            return
+        self._last_view = view
+        self._router.prune({_rid(r) for r in view})
+
+    def _fetch_limits(self, now: float) -> None:
+        """Blocking fetch + cache fill (runs on the caller only when no value
+        exists yet; otherwise on a background refresh thread). When the
+        controller is unreachable the fallback FAILS SAFE: retryable=False —
+        re-executing a non-idempotent method is worse than surfacing one
+        error — cached only briefly so recovery is quick."""
+        from ray_tpu.config import CONFIG
+
+        limits = None
+        try:
+            limits = ray_tpu.get(self._controller().get_deployment_limits.remote(
+                self.app_name, self.deployment_name), timeout=5)
+        except Exception:  # noqa: BLE001 — controller busy/gone
+            pass
+        ttl = 30.0
+        if limits is None:
+            ttl = 5.0
+            limits = {"max_ongoing_requests": CONFIG.serve_max_ongoing_requests,
+                      "max_queued_requests": CONFIG.serve_max_queued_requests,
+                      "retryable": False}
+        with self._router.lock:
+            self._router._limits_cache = (now + ttl, limits)
+            self._router._limits_refreshing = False
+
+    def _limits(self) -> Dict[str, Any]:
+        """Deployment admission/retry knobs, cached on the shared router (30s
+        TTL), STALE-WHILE-REVALIDATE: an expired value is served immediately
+        while one background thread refreshes it, so the request hot path
+        never blocks on a busy controller after the first call."""
+        now = time.monotonic()
+        with self._router.lock:
+            cached = self._router._limits_cache
+            if cached is not None:
+                if cached[0] <= now and not self._router._limits_refreshing:
+                    self._router._limits_refreshing = True
+                    threading.Thread(target=self._fetch_limits, args=(now,),
+                                     daemon=True,
+                                     name="serve-limits-refresh").start()
+                return cached[1]
+        self._fetch_limits(now)  # first call: nothing to serve stale
+        with self._router.lock:
+            return self._router._limits_cache[1]
 
     def _ensure_metrics_push(self) -> None:
         # anchored on the shared router under its lock: options() clones and
         # concurrent first-callers reuse one pusher
         with self._router.lock:
-            t = getattr(self._router, "_metrics_thread", None)
+            t = self._router._metrics_thread
             if t is not None and t.is_alive():
                 return
             router = self._router
@@ -305,6 +721,43 @@ class DeploymentHandle:
         except Exception:
             pass  # load signals must never fail a request
 
+    def retry_after_hint_s(self) -> float:
+        """How long a shed caller should wait before retrying, from the
+        router's recent-latency EWMA. The proxies refine this with the head's
+        windowed latency history (same clamp policy, shared helper)."""
+        return retry_after_from_latency(self._router.ewma_latency_s or None)
+
+    def _maybe_shed(self, limits: Dict[str, Any]) -> None:
+        """Handle-side load shedding: past replica capacity plus the queue
+        allowance, fail FAST with a typed, Retry-After-carrying error instead
+        of stacking latency. Accounting is per-process (each proxy/driver
+        sheds on its own view), matching the queue-depth gauge's scope."""
+        max_queued = limits.get("max_queued_requests", -1)
+        if max_queued is None or max_queued < 0:
+            return
+        moq = max(1, limits.get("max_ongoing_requests", 1) or 1)
+        capacity = moq * max(1, len(self._replicas))
+        # PROCESS-wide depth (the queue-depth gauge's accounting), not this
+        # router's: several handles to one deployment must share one limit
+        with _inflight_lock:
+            depth = _inflight_by_dep.get(
+                (self.app_name, self.deployment_name), 0)
+        if depth < capacity + max_queued:
+            return
+        try:
+            telemetry.get_counter(
+                "serve_requests_shed_total",
+                "handle calls rejected by admission control",
+                tag_keys=("app", "deployment")).inc(
+                tags={"app": self.app_name,
+                      "deployment": self.deployment_name})
+        except Exception:
+            pass  # shedding must not depend on telemetry
+        raise BackPressureError(self.app_name, self.deployment_name,
+                                queue_depth=depth,
+                                limit=capacity + max_queued,
+                                retry_after_s=self.retry_after_hint_s())
+
     # -- public ----------------------------------------------------------------
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
@@ -316,8 +769,10 @@ class DeploymentHandle:
             self._stream if stream is None else stream,
         )
         h._router = self._router  # share in-flight + model-affinity view
+        h._waiter = self._waiter  # and the batched completion waiter
         h._replicas = self._replicas
         h._last_refresh = self._last_refresh
+        h._last_view = self._last_view
         return h
 
     def __getattr__(self, name: str):
@@ -325,28 +780,111 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(method_name=name)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
-        self._ensure_metrics_push()
-        _ensure_long_poll(self.app_name, self.deployment_name)
+    def _wait_for_replicas(self, deadline: Optional[float] = None) -> None:
         from ray_tpu.config import CONFIG
 
-        deadline = time.time() + CONFIG.serve_replica_wait_s
+        cap = time.monotonic() + CONFIG.serve_replica_wait_s
+        if deadline is not None:
+            cap = min(cap, deadline)  # the caller's result() timeout wins
         while True:
             self._refresh()
             if self._replicas:
-                break
-            if time.time() > deadline:
+                return
+            if time.monotonic() > cap:
                 raise RuntimeError(
                     f"no running replicas for {self.app_name}/{self.deployment_name}"
                 )
             time.sleep(0.1)
             self._last_refresh = 0.0  # force re-poll
-        replica = self._router.pick(self._replicas, self._multiplexed_model_id or None)
+
+    def _await_non_dead_replica(self, dead_ids: Set[Any],
+                                deadline: Optional[float],
+                                cap_s: float = 10.0) -> None:
+        """Block (bounded) until the view offers a replica NOT known dead —
+        the reconcile loop needs a tick or two to replace a reported death,
+        and spending retry budget on the corpse meanwhile guarantees failure."""
+        cap = time.monotonic() + cap_s
+        if deadline is not None:
+            cap = min(cap, deadline)
+        while time.monotonic() < cap:
+            try:
+                self._refresh(force=True)
+            except Exception:  # noqa: BLE001 — controller briefly unreachable
+                pass
+            if any(_rid(r) not in dead_ids for r in self._replicas):
+                return
+            time.sleep(0.15)
+
+    def _send_once(self, session: _RetrySession):
+        """One attempt: pick a replica (suspects + the session's failed set
+        excluded), send, and register completion bookkeeping with the shared
+        waiter. Returns the raw ref (or streaming ref generator)."""
+        self._wait_for_replicas(deadline=session.deadline)
+        replica = self._router.pick(self._replicas,
+                                    self._multiplexed_model_id or None,
+                                    exclude=session.exclude)
+        session.replica = replica  # before the try: a send-time failure must
+        # suspect the replica it was aimed at, not the previous attempt's
         self._router.on_send(replica)
         self._adjust_queue_depth(+1)
         t0_wall, t0_perf = time.time_ns(), time.perf_counter_ns()
-        # captured HERE, on the caller's thread: the done-watcher thread that
-        # records the lifecycle event has no request context of its own
+        try:
+            fault_injection.fail_point(
+                "serve.handle.send", app=self.app_name,
+                deployment=self.deployment_name, attempt=session.attempt)
+            method = replica.handle_request
+            if self._stream:
+                # replica yields; items stream through the object store as they
+                # are produced (core num_returns="streaming" generators)
+                method = method.options(num_returns="streaming")
+            ref = method.remote(self._method, session.args, session.kwargs)
+        except BaseException:
+            self._router.on_done(replica)
+            self._adjust_queue_depth(-1)  # the send never happened
+            raise
+        done_ref = ref.completed if self._stream else ref
+        router, waiter = self._router, self._waiter
+        app, dep, meth, stream = (self.app_name, self.deployment_name,
+                                  self._method, self._stream)
+        trace_id = session.trace_id
+        session.t0_perf = t0_perf
+        session.completed_dur_ns = None
+        my_attempt = session.attempt
+
+        def on_complete():
+            router.on_done(replica)
+            self._adjust_queue_depth(-1)
+            dur = time.perf_counter_ns() - t0_perf
+            if session.attempt == my_attempt:
+                # true completion duration for observe_success (the EWMA feed
+                # happens there, on KNOWN success — not here, where a fast
+                # error completion is indistinguishable from a fast request)
+                session.completed_dur_ns = dur
+            telemetry.get_histogram(
+                "serve_request_seconds",
+                "handle-call latency (send to completion)",
+                tag_keys=("app", "deployment")).observe(
+                dur / 1e9, tags={"app": app, "deployment": dep})
+            if telemetry.enabled():
+                telemetry.complete(
+                    "serve.request", "serve", t0_wall, dur,
+                    app=app, deployment=dep, method=meth, stream=stream,
+                    trace_id=trace_id)
+
+        waiter.add(done_ref, on_complete)
+        return ref
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._ensure_metrics_push()
+        _ensure_long_poll(self.app_name, self.deployment_name)
+        fault_injection.fail_point(
+            "serve.handle.request", app=self.app_name,
+            deployment=self.deployment_name)
+        self._wait_for_replicas()
+        limits = self._limits()
+        self._maybe_shed(limits)
+        # captured HERE, on the caller's thread: the completion-waiter thread
+        # that records the lifecycle event has no request context of its own
         try:
             from ray_tpu.util.tracing import current_trace_id
 
@@ -357,43 +895,10 @@ class DeploymentHandle:
             from .multiplex import MULTIPLEX_KWARG
 
             kwargs = {**kwargs, MULTIPLEX_KWARG: self._multiplexed_model_id}
-        try:
-            method = replica.handle_request
-            if self._stream:
-                # replica yields; items stream through the object store as they
-                # are produced (core num_returns="streaming" generators)
-                method = method.options(num_returns="streaming")
-            ref = method.remote(self._method, args, kwargs)
-        except Exception:
-            self._router.on_done(replica)
-            self._adjust_queue_depth(-1)  # the send never happened
-            raise
-
-        done_ref = ref.completed if self._stream else ref
-        resp = (DeploymentResponseGenerator(ref) if self._stream
-                else DeploymentResponse(ref))
-
-        def _done_watcher():
-            try:
-                ray_tpu.wait([done_ref], num_returns=1, timeout=None)
-            except Exception:
-                pass
-            finally:
-                self._router.on_done(replica)
-                self._adjust_queue_depth(-1)
-                dur = time.perf_counter_ns() - t0_perf
-                telemetry.get_histogram(
-                    "serve_request_seconds",
-                    "handle-call latency (send to completion)",
-                    tag_keys=("app", "deployment")).observe(
-                    dur / 1e9, tags={"app": self.app_name,
-                                     "deployment": self.deployment_name})
-                if telemetry.enabled():
-                    telemetry.complete(
-                        "serve.request", "serve", t0_wall, dur,
-                        app=self.app_name, deployment=self.deployment_name,
-                        method=self._method, stream=self._stream,
-                        trace_id=trace_id)
-
-        threading.Thread(target=_done_watcher, daemon=True).start()
-        return resp
+        session = _RetrySession(self, args, kwargs,
+                                retryable=bool(limits.get("retryable", True)),
+                                trace_id=trace_id)
+        ref = session.send()
+        if self._stream:
+            return DeploymentResponseGenerator(ref, session)
+        return DeploymentResponse(ref, session)

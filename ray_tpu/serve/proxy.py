@@ -15,6 +15,7 @@ import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.core.exceptions import BackPressureError
 from ray_tpu.util import telemetry
 
 from .controller import CONTROLLER_NAME
@@ -36,6 +37,7 @@ class ProxyActor:
         self.port = port
         self._handles: Dict[str, DeploymentHandle] = {}
         self._routes: Dict[str, Dict[str, Any]] = {}
+        self._hint_cache = (0.0, None)  # (fetched_at, windowed p50 or None)
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._serve_forever, daemon=True)
         self._thread.start()
@@ -43,6 +45,36 @@ class ProxyActor:
     def ready(self) -> bool:
         self._ready.wait(timeout=30)
         return self._ready.is_set()
+
+    def _retry_after_s(self, fallback: float) -> int:
+        """Retry-After for shed responses, derived from the head's WINDOWED
+        request-latency history (the recent regime: one service time ~= how
+        long until a replica slot frees) — the handle's EWMA is the fallback
+        when no history is retained yet. Cached 5s so a shed storm costs one
+        state RPC per window, not one per 503."""
+        import math
+
+        from .handle import retry_after_from_latency
+
+        now = time.monotonic()
+        ts, p50 = self._hint_cache
+        if now - ts > 5.0:
+            p50 = None
+            try:
+                from ray_tpu.util.state import serve_latency_hint
+
+                p50 = serve_latency_hint().get("serve_request_p50_s")
+            except Exception:  # noqa: BLE001 — no history/scraper: use fallback
+                pass
+            self._hint_cache = (now, p50)
+        return max(1, int(math.ceil(retry_after_from_latency(p50, fallback))))
+
+    def _shed_response(self, web, e: BackPressureError):
+        # the handle's _maybe_shed already counted serve_requests_shed_total;
+        # the proxy's job is the wire protocol: 503 + Retry-After
+        return web.Response(
+            status=503, text=str(e),
+            headers={"Retry-After": str(self._retry_after_s(e.retry_after_s))})
 
     def _refresh_routes(self) -> None:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
@@ -214,6 +246,10 @@ class ProxyActor:
                             pending = [first, second]
                         else:
                             pending = [] if first is _end else [first]
+                    except BackPressureError as e:
+                        # shed before the stream started: fast 503 + Retry-After
+                        return _respond(self._shed_response(web, e),
+                                        stream=True)
                     except Exception as e:  # noqa: BLE001 - surface as 500
                         return _respond(web.Response(status=500, text=repr(e)),
                                         stream=True)
@@ -273,6 +309,10 @@ class ProxyActor:
 
             try:
                 result = await loop.run_in_executor(None, _in_ctx(call))
+            except BackPressureError as e:
+                # admission control tripped: degrade to a FAST rejection the
+                # client can back off on, not a queued request that times out
+                return _respond(self._shed_response(web, e), stream=False)
             except Exception as e:  # noqa: BLE001 - surface as 500
                 return _respond(web.Response(status=500, text=repr(e)),
                                 stream=False)

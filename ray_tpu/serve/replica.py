@@ -1,13 +1,22 @@
 """Replica: the actor hosting one copy of a deployment's user code.
 
 Capability parity: reference python/ray/serve/_private/replica.py (1,903 LoC) —
-user callable host, health check, reconfigure via user_config, graceful shutdown.
+user callable host, health check, reconfigure via user_config, graceful
+shutdown + draining, per-replica request accounting for admission control.
+Control-plane methods (health, drain, fault arming) run on their own
+"control" concurrency group so a replica saturated with user requests still
+answers the controller promptly.
 """
 from __future__ import annotations
 
 import inspect
+import threading
 import time
 from typing import Any, Dict, Optional
+
+from ray_tpu.core.actor import method as _actor_method
+from ray_tpu.core.exceptions import ReplicaUnavailableError
+from ray_tpu.util import fault_injection
 
 
 class Replica:
@@ -16,8 +25,11 @@ class Replica:
         deployment_name: str,
         serialized_init: Dict[str, Any],
         user_config: Optional[Dict[str, Any]] = None,
+        app_name: str = "",
+        max_ongoing_requests: int = 0,
     ):
         self.deployment_name = deployment_name
+        self.app_name = app_name
         cls_or_fn = serialized_init["target"]
 
         def decode(v):
@@ -36,22 +48,74 @@ class Replica:
             self.callable = cls_or_fn
         self._num_served = 0
         self._started_at = time.time()
+        self._lock = threading.Lock()
+        self._ongoing = 0  # requests currently executing (streams: until closed)
+        self._draining = False
+        self._max_ongoing = max_ongoing_requests
         if user_config is not None:
             self.reconfigure(user_config)
 
+    # -- request accounting ------------------------------------------------------
+    def _begin_request(self) -> None:
+        with self._lock:
+            if self._draining:
+                # a send that raced the DRAINING transition: bounce it so the
+                # caller's retry plane resends to a live replica instead of
+                # riding this one into the kill
+                raise ReplicaUnavailableError(
+                    self.app_name, self.deployment_name,
+                    replica=self.deployment_name, reason="replica is draining")
+            self._ongoing += 1
+            self._num_served += 1
+
+    def _end_request(self) -> None:
+        with self._lock:
+            self._ongoing = max(0, self._ongoing - 1)
+
+    def _wrap_stream(self, gen):
+        """Streaming responses stay 'ongoing' until the generator is exhausted
+        or closed — draining must wait for the last chunk, not the first."""
+        def run():
+            try:
+                yield from gen
+            finally:
+                self._end_request()
+        return run()
+
+    async def _wrap_async_stream(self, agen):
+        try:
+            async for item in agen:
+                yield item
+        finally:
+            self._end_request()
+
     # -- request path ----------------------------------------------------------
     def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
-        self._num_served += 1
-        from ray_tpu.util import tracing
+        fault_injection.fail_point(
+            "serve.replica.request", app=self.app_name,
+            deployment=self.deployment_name, method=method_name or "__call__")
+        self._begin_request()
+        try:
+            from ray_tpu.util import tracing
 
-        if tracing.is_tracing_enabled():
-            # a named replica span under the worker's task:: span: the trace
-            # tree shows WHICH deployment served the request, and engine /
-            # data-plane telemetry recorded inside inherits the trace id
-            with tracing.span(f"replica.{self.deployment_name}",
-                              {"method": method_name or "__call__"}):
-                return self._handle_request_inner(method_name, args, kwargs)
-        return self._handle_request_inner(method_name, args, kwargs)
+            if tracing.is_tracing_enabled():
+                # a named replica span under the worker's task:: span: the trace
+                # tree shows WHICH deployment served the request, and engine /
+                # data-plane telemetry recorded inside inherits the trace id
+                with tracing.span(f"replica.{self.deployment_name}",
+                                  {"method": method_name or "__call__"}):
+                    out = self._handle_request_inner(method_name, args, kwargs)
+            else:
+                out = self._handle_request_inner(method_name, args, kwargs)
+        except BaseException:
+            self._end_request()
+            raise
+        if inspect.isgenerator(out):
+            return self._wrap_stream(out)
+        if inspect.isasyncgen(out):
+            return self._wrap_async_stream(out)
+        self._end_request()
+        return out
 
     def _handle_request_inner(self, method_name: str, args: tuple,
                               kwargs: dict) -> Any:
@@ -89,21 +153,64 @@ class Replica:
             return asyncio.run(out)
         return out
 
-    # -- control plane ---------------------------------------------------------
+    # -- control plane (own concurrency group: never starved by user requests) --
+    @_actor_method(concurrency_group="control")
     def check_health(self) -> bool:
+        fault_injection.fail_point(
+            "serve.replica.health", app=self.app_name,
+            deployment=self.deployment_name)
         fn = getattr(self.callable, "check_health", None)
         if fn is not None:
             fn()
         return True
 
+    @_actor_method(concurrency_group="control")
+    def drain(self) -> int:
+        """Enter DRAINING: stop accepting new requests (racing sends bounce
+        with ReplicaUnavailableError so callers retry elsewhere) and report
+        how many are still in flight. The controller polls until 0, then
+        kills — zero dropped requests on a routine scale-down."""
+        with self._lock:
+            self._draining = True
+            return self._ongoing
+
+    @_actor_method(concurrency_group="control")
+    def num_inflight(self) -> int:
+        with self._lock:
+            return self._ongoing
+
+    @_actor_method(concurrency_group="control")
+    def _arm_fault(self, site: str, mode: str = "error", prob: float = 1.0,
+                   count: Optional[int] = None, delay_s: float = 0.0,
+                   seed: Optional[int] = None) -> bool:
+        """ChaosController hook: arm a fail point in THIS replica process."""
+        fault_injection.arm(site, mode, prob, count, delay_s, seed)
+        return True
+
+    @_actor_method(concurrency_group="control")
+    def _disarm_fault(self, site: Optional[str] = None) -> bool:
+        fault_injection.disarm(site)
+        return True
+
+    @_actor_method(concurrency_group="control")
     def reconfigure(self, user_config: Dict[str, Any]) -> None:
         fn = getattr(self.callable, "reconfigure", None)
         if fn is not None:
             fn(user_config)
 
+    @_actor_method(concurrency_group="control")
     def stats(self) -> Dict[str, Any]:
-        return {"num_served": self._num_served, "uptime_s": time.time() - self._started_at}
+        with self._lock:
+            ongoing = self._ongoing
+            served = self._num_served
+        # max_ongoing is ENFORCED by the actor's max_concurrency (set by the
+        # controller); reported here so operators can read ongoing vs cap
+        return {"num_served": served, "num_ongoing": ongoing,
+                "max_ongoing": self._max_ongoing,
+                "draining": self._draining,
+                "uptime_s": time.time() - self._started_at}
 
+    @_actor_method(concurrency_group="control")
     def prepare_shutdown(self) -> None:
         fn = getattr(self.callable, "__del__", None)
         # graceful user shutdown hook (reference: replica graceful_shutdown path)

@@ -102,43 +102,30 @@ class CollectiveRankKiller:
     abort path, alongside WorkerKiller (any busy worker) and NodeKiller
     (whole nodes).
 
-    Resolves rank -> worker through the head's collective-membership registry
-    (fed by collective_join notes at init_collective_group), so it kills the
-    exact process whose death must poison the group's coordinator and fail
-    the surviving ranks fast with CollectiveAbortError.
+    Compatibility shim: the logic moved to
+    ray_tpu.util.fault_injection.ChaosController (the unified chaos API,
+    which also kills serve replicas and arms fail points); this wrapper
+    preserves the original call shape for existing drills.
     """
 
     def __init__(self, group_name: str = "default", rank: int = 0):
+        from ray_tpu.util.fault_injection import ChaosController
+
         self.group_name = group_name
         self.rank = rank
+        self._chaos = ChaosController()
 
     def registered(self) -> bool:
         """True once the target rank has joined (the kill can land)."""
-        return self._target() is not None
-
-    def _target(self):
-        c = _cluster()
-        with c._lock:
-            members = c._collective_members.get(self.group_name, {})
-            entry = members.get(self.rank)
-        return entry[0] if entry is not None else None
+        return self._chaos.collective_rank_registered(self.group_name, self.rank)
 
     def kill(self) -> bool:
-        w = self._target()
-        if w is None:
-            return False
-        try:
-            w.process.kill()
-            return True
-        except Exception:
-            return False
+        return self._chaos.kill_collective_rank(self.group_name, self.rank)
 
     def kill_when_registered(self, timeout: float = 10.0) -> bool:
         """Block until the rank joins its group, then kill it."""
-        wait_for_condition(self.registered, timeout=timeout,
-                           message=f"rank {self.rank} never joined group "
-                                   f"{self.group_name!r}")
-        return self.kill()
+        return self._chaos.kill_collective_rank_when_registered(
+            self.group_name, self.rank, timeout)
 
 
 def kill_worker_running(task_name: str) -> bool:

@@ -301,9 +301,13 @@ def wait_poll(st, key: str, timeout_s: float, expected: Optional[int] = None):
     size, epoch, and the ranks that HAD arrived, so a stuck op is debuggable
     from the exception alone."""
     from ray_tpu.core.exceptions import ActorError
+    from ray_tpu.util import fault_injection
 
     from ... import get  # late import to avoid cycle
 
+    fault_injection.fail_point("collective.wait", key=key,
+                               rank=getattr(st, "rank", None),
+                               group=getattr(st, "name", None))
     deadline = time.monotonic() + timeout_s
     sleep = 0.0005
     epoch = getattr(st, "epoch", None)
@@ -331,9 +335,13 @@ def wait_poll(st, key: str, timeout_s: float, expected: Optional[int] = None):
 def wait_poll_one(st, key: str, src_rank: int, timeout_s: float):
     """wait_poll for point-to-point recv: same fail-fast and timeout contract."""
     from ray_tpu.core.exceptions import ActorError
+    from ray_tpu.util import fault_injection
 
     from ... import get
 
+    fault_injection.fail_point("collective.wait", key=key,
+                               rank=getattr(st, "rank", None),
+                               group=getattr(st, "name", None))
     deadline = time.monotonic() + timeout_s
     sleep = 0.0005
     epoch = getattr(st, "epoch", None)

@@ -1,0 +1,334 @@
+"""Deterministic fault injection: fail points + the ChaosController.
+
+Reference analog: Ray's chaos suites wire kill *policies* around the system
+(test_utils WorkerKiller / RayletKiller); production stacks add *fail points*
+inside it (freebsd fail(9), tikv fail-rs, envoy fault filter). This module is
+the unified registry both ride:
+
+- ``fail_point(name, **ctx)`` — a named injection site compiled into hot
+  paths (serve handle send, replica request loop, data-plane pull, collective
+  waits). A no-op unless armed: the fast path is one dict check plus one
+  memoized env read (~0.1us), cheap enough for per-request call sites.
+- Arming is per-process via :func:`arm`, or via the
+  ``RAY_TPU_FAULT_INJECTION`` environment variable so spawned workers inherit
+  specs (``site=mode[@p=0.5][@n=3][@delay=0.1][@seed=7][;site2=...]``).
+  Modes: ``error`` raises :class:`FaultInjectedError`, ``delay`` sleeps
+  ``delay_s``, ``kill`` SIGKILLs the calling process. ``p`` draws from a
+  per-spec seeded RNG (deterministic sequences), ``n`` bounds total firings.
+- :class:`ChaosController` — cluster-level orchestration: kill the worker
+  holding a collective rank (subsumes the PR 3 ``CollectiveRankKiller``),
+  kill a serve replica's process mid-request, arm/disarm fail points inside
+  running replicas.
+
+FaultInjectedError is classified by the serve retry plane like a replica
+death, so ``error`` mode drives the same recovery machinery a real crash
+would — deterministically, in-process, tier-1 fast.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.exceptions import FaultInjectedError
+
+logger = logging.getLogger("ray_tpu.fault_injection")
+
+ENV_VAR = "RAY_TPU_FAULT_INJECTION"
+
+MODES = ("error", "delay", "kill")
+
+
+class _Spec:
+    __slots__ = ("name", "mode", "prob", "count", "delay_s", "rng", "fired",
+                 "skipped")
+
+    def __init__(self, name: str, mode: str = "error", prob: float = 1.0,
+                 count: Optional[int] = None, delay_s: float = 0.0,
+                 seed: Optional[int] = None):
+        if mode not in MODES:
+            raise ValueError(f"fault mode must be one of {MODES}, got {mode!r}")
+        self.name = name
+        self.mode = mode
+        self.prob = float(prob)
+        self.count = count  # None = unlimited firings
+        self.delay_s = float(delay_s)
+        # per-spec RNG: seeded draws give the same hit/miss sequence on every
+        # run — the point of a DETERMINISTIC chaos framework
+        self.rng = random.Random(seed)
+        self.fired = 0
+        self.skipped = 0
+
+    def should_fire(self) -> bool:
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.prob < 1.0 and self.rng.random() >= self.prob:
+            self.skipped += 1
+            return False
+        self.fired += 1
+        return True
+
+
+_lock = threading.Lock()
+_specs: Dict[str, _Spec] = {}  # API-armed (this process)
+# env-armed specs: parsed lazily, cached against the raw env string so count
+# budgets/RNG state persist while the variable is unchanged
+_env_cache: tuple = (None, {})  # (raw_string, {name: _Spec})
+
+
+def arm(name: str, mode: str = "error", prob: float = 1.0,
+        count: Optional[int] = None, delay_s: float = 0.0,
+        seed: Optional[int] = None) -> None:
+    """Arm a fail point in THIS process. Replaces any existing spec for it."""
+    spec = _Spec(name, mode, prob, count, delay_s, seed)
+    with _lock:
+        _specs[name] = spec
+
+
+def disarm(name: Optional[str] = None) -> None:
+    """Disarm one fail point (or all, with no argument) in this process."""
+    with _lock:
+        if name is None:
+            _specs.clear()
+        else:
+            _specs.pop(name, None)
+
+
+def _refresh_env_cache_locked() -> None:
+    """Re-parse RAY_TPU_FAULT_INJECTION when the raw string changed (caller
+    holds _lock): introspection must see env-armed sites before the first
+    fail_point() call populates the cache."""
+    global _env_cache
+    raw = os.environ.get(ENV_VAR)
+    if raw != _env_cache[0]:
+        _env_cache = (raw, parse_env(raw) if raw else {})
+
+
+def fired(name: str) -> int:
+    """How many times the named fail point has fired in this process."""
+    with _lock:
+        _refresh_env_cache_locked()
+        spec = _specs.get(name) or _env_cache[1].get(name)
+    return spec.fired if spec is not None else 0
+
+
+def armed() -> Dict[str, str]:
+    """Introspection: {site: mode} for every armed spec in this process."""
+    with _lock:
+        _refresh_env_cache_locked()
+        out = {n: s.mode for n, s in _env_cache[1].items()}
+        out.update({n: s.mode for n, s in _specs.items()})
+    return out
+
+
+def parse_env(raw: str) -> Dict[str, _Spec]:
+    """``site=mode[@p=][@n=][@delay=][@seed=][;...]`` -> specs. Bad entries
+    are skipped with a warning — a typo'd chaos var must not take down the
+    process it was supposed to test."""
+    specs: Dict[str, _Spec] = {}
+    for entry in raw.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            site, _, rest = entry.partition("=")
+            parts = rest.split("@")
+            kwargs: Dict[str, Any] = {"mode": parts[0].strip()}
+            for p in parts[1:]:
+                k, _, v = p.partition("=")
+                k = k.strip()
+                if k == "p":
+                    kwargs["prob"] = float(v)
+                elif k == "n":
+                    kwargs["count"] = int(v)
+                elif k == "delay":
+                    kwargs["delay_s"] = float(v)
+                elif k == "seed":
+                    kwargs["seed"] = int(v)
+                else:
+                    raise ValueError(f"unknown key {k!r}")
+            specs[site.strip()] = _Spec(site.strip(), **kwargs)
+        except Exception as e:  # noqa: BLE001 — skip the bad entry, keep going
+            logger.warning("ignoring unparseable %s entry %r: %r",
+                           ENV_VAR, entry, e)
+    return specs
+
+
+def _lookup(name: str) -> Optional[_Spec]:
+    with _lock:
+        spec = _specs.get(name)
+        if spec is not None:
+            return spec
+        _refresh_env_cache_locked()
+        return _env_cache[1].get(name)
+
+
+def fail_point(name: str, **context: Any) -> None:
+    """The injection site. A no-op unless a spec for `name` is armed (API or
+    env); armed, it errors/delays/kills per the spec. `context` rides the
+    raised FaultInjectedError for assertions and log forensics."""
+    if not _specs and os.environ.get(ENV_VAR) is None:
+        return  # fast path: nothing armed anywhere
+    spec = _lookup(name)
+    if spec is None:
+        return
+    with _lock:
+        fire = spec.should_fire()
+    if not fire:
+        return
+    if spec.mode == "delay":
+        logger.info("fail point %r: injecting %.3fs delay (%s)",
+                    name, spec.delay_s, context)
+        time.sleep(spec.delay_s)
+        return
+    if spec.mode == "kill":
+        import signal
+
+        logger.warning("fail point %r: SIGKILL pid %d (%s)",
+                       name, os.getpid(), context)
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(10)  # never returns; parachute for exotic platforms
+        return
+    raise FaultInjectedError(name, context)
+
+
+# ---------------------------------------------------------------- ChaosController
+
+def _cluster():
+    from ray_tpu.core import global_state
+
+    c = global_state.try_cluster()
+    if c is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return c
+
+
+class ChaosController:
+    """Cluster-level chaos orchestration over the fail-point registry and the
+    head's process registries. One object subsumes the ad-hoc kill kits:
+
+    - collective ranks: ``kill_collective_rank(group, rank)`` resolves
+      rank -> worker through the head's collective-membership registry (the
+      PR 3 ``CollectiveRankKiller`` path) and SIGKILLs it mid-op.
+    - serve replicas: ``kill_replica(app, deployment)`` SIGKILLs the worker
+      process hosting a replica actor (truer chaos than ``ray_tpu.kill`` —
+      no graceful teardown), ``arm_replica``/``disarm_replica`` arm fail
+      points INSIDE running replica processes via an actor RPC.
+
+    Driver/head-side only (it reads Cluster structures), like the test_utils
+    kill kits it replaces.
+    """
+
+    # -- collective ranks (CollectiveRankKiller parity) ------------------------
+    def _collective_member(self, group_name: str, rank: int):
+        c = _cluster()
+        with c._lock:
+            entry = c._collective_members.get(group_name, {}).get(rank)
+        return entry[0] if entry is not None else None
+
+    def collective_rank_registered(self, group_name: str, rank: int) -> bool:
+        """True once the rank has joined its group (a kill can land)."""
+        return self._collective_member(group_name, rank) is not None
+
+    def kill_collective_rank(self, group_name: str, rank: int) -> bool:
+        """SIGKILL the worker holding `rank` of `group_name` (mid-op by
+        design): survivors must observe a typed CollectiveAbortError fast."""
+        w = self._collective_member(group_name, rank)
+        if w is None:
+            return False
+        try:
+            w.process.kill()
+            return True
+        except Exception:  # noqa: BLE001 — already dead / no local process
+            return False
+
+    def kill_collective_rank_when_registered(self, group_name: str, rank: int,
+                                             timeout: float = 10.0) -> bool:
+        from ray_tpu.test_utils import wait_for_condition
+
+        wait_for_condition(
+            lambda: self.collective_rank_registered(group_name, rank),
+            timeout=timeout,
+            message=f"rank {rank} never joined group {group_name!r}")
+        return self.kill_collective_rank(group_name, rank)
+
+    # -- serve replicas --------------------------------------------------------
+    @staticmethod
+    def _replica_actors(app_name: str, deployment_name: str):
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return ray_tpu.get(
+            controller.get_replicas.remote(app_name, deployment_name))
+
+    def kill_replica(self, app_name: str, deployment_name: str,
+                     index: int = 0) -> bool:
+        """SIGKILL the worker process hosting one running replica of the
+        deployment (falls back to ray_tpu.kill when the process isn't local).
+        In-flight requests fail with ActorDiedError — exactly what the
+        handle's retry plane must absorb."""
+        import ray_tpu
+
+        actors = self._replica_actors(app_name, deployment_name)
+        if not actors or index >= len(actors):
+            return False
+        actor = actors[index]
+        c = _cluster()
+        with c._lock:
+            st = c.actors.get(actor._actor_id)
+            proc = getattr(getattr(st, "worker", None), "process", None)
+        if proc is not None:
+            try:
+                proc.kill()
+                return True
+            except Exception:  # noqa: BLE001 — fall through to the API kill
+                pass
+        try:
+            ray_tpu.kill(actor, no_restart=True)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def arm_replica(self, app_name: str, deployment_name: str, site: str,
+                    mode: str = "error", prob: float = 1.0,
+                    count: Optional[int] = None, delay_s: float = 0.0,
+                    seed: Optional[int] = None,
+                    index: Optional[int] = None) -> int:
+        """Arm a fail point inside running replica processes (all of them, or
+        just `index`). Returns how many replicas were armed. Replacement
+        replicas start clean — arming does not survive a replica's death,
+        which is what makes health-failure injection tests converge."""
+        import ray_tpu
+
+        actors = self._replica_actors(app_name, deployment_name)
+        if index is not None:
+            actors = actors[index:index + 1]
+        refs = [a._arm_fault.remote(site, mode, prob, count, delay_s, seed)
+                for a in actors]
+        done = 0
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=10)
+                done += 1
+            except Exception:  # noqa: BLE001 — replica died meanwhile
+                pass
+        return done
+
+    def disarm_replica(self, app_name: str, deployment_name: str,
+                       site: Optional[str] = None) -> int:
+        import ray_tpu
+
+        actors = self._replica_actors(app_name, deployment_name)
+        refs = [a._disarm_fault.remote(site) for a in actors]
+        done = 0
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=10)
+                done += 1
+            except Exception:  # noqa: BLE001
+                pass
+        return done

@@ -476,6 +476,23 @@ def metrics_history(window_s: float = 60.0) -> Dict[str, Any]:
 
 
 @_remoteable
+def serve_latency_hint(window_s: float = 60.0) -> Dict[str, Optional[float]]:
+    """Tiny windowed latency summary for admission control: the p50/p99 of
+    RECENT serve request/TTFT latency from the metrics-history ring, without
+    shipping the full frame dump metrics_history() returns. The proxies
+    derive Retry-After from this (one recent service time ~= how long until
+    a replica slot frees), cached caller-side between sheds."""
+    c = _cluster()
+    h = c.metrics_history
+    return {
+        "serve_request_p50_s": h.quantile("serve_request_seconds", 0.5, window_s),
+        "serve_request_p99_s": h.quantile("serve_request_seconds", 0.99, window_s),
+        "serve_ttft_p50_s": h.quantile("serve_ttft_seconds", 0.5, window_s),
+        "serve_ttft_p99_s": h.quantile("serve_ttft_seconds", 0.99, window_s),
+    }
+
+
+@_remoteable
 def history_series(window_s: float = 300.0) -> Dict[str, Any]:
     """JSON-safe per-frame time series for dashboards/sparklines
     (`/api/history`, `ray-tpu status --watch`): one timestamp list plus one

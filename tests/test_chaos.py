@@ -2,16 +2,17 @@
 
 Reference analog: python/ray/tests/chaos/ + setup_chaos.py kill policies
 (SURVEY.md §4 fault-tolerance tests). The collective cases inject rank death
-mid-op (CollectiveRankKiller) and assert the abort path: survivors fail fast
-with a typed CollectiveAbortError — never by burning the full op timeout —
-and elastic Train recovers from its last checkpoint."""
+mid-op (ChaosController.kill_collective_rank — util/fault_injection.py, the
+unified chaos API) and assert the abort path: survivors fail fast with a
+typed CollectiveAbortError — never by burning the full op timeout — and
+elastic Train recovers from its last checkpoint."""
 import time
 
 import pytest
 
 import ray_tpu
-from ray_tpu.test_utils import (CollectiveRankKiller, NodeKiller, WorkerKiller,
-                                wait_for_condition)
+from ray_tpu.test_utils import NodeKiller, WorkerKiller, wait_for_condition
+from ray_tpu.util.fault_injection import ChaosController
 
 
 
@@ -151,12 +152,12 @@ def test_rank_death_mid_allreduce_aborts_survivors_fast(rt, nelem):
     try:
         col.create_collective_group(members, 4, [0, 1, 2, 3],
                                     backend="shm", group_name=group)
-        killer = CollectiveRankKiller(group, rank=3)
-        assert killer.registered()
+        chaos = ChaosController()
+        assert chaos.collective_rank_registered(group, rank=3)
         # survivors enter the op; rank 3 never does, then dies
         refs = [w.timed_allreduce.remote(group, nelem) for w in members[:3]]
         time.sleep(0.3)
-        assert killer.kill()
+        assert chaos.kill_collective_rank(group, rank=3)
         results = rt.get(refs, timeout=60)
         budget = 0.25 * CONFIG.collective_op_timeout_s
         for status, elapsed, failed_rank in results:
@@ -284,11 +285,12 @@ def test_train_v2_recovers_from_rank_death(rt, tmp_path):
     t.start()
     # kill rank 1 only after a checkpoint is durable, so "resume from latest
     # checkpoint" is the path under test
-    killer = CollectiveRankKiller(group, rank=1)
+    chaos = ChaosController()
     wait_for_condition(
-        lambda: killer.registered() and mgr.latest_checkpoint is not None,
+        lambda: (chaos.collective_rank_registered(group, rank=1)
+                 and mgr.latest_checkpoint is not None),
         timeout=30, message="no checkpoint before injection window closed")
-    assert killer.kill()
+    assert chaos.kill_collective_rank(group, rank=1)
     t.join(timeout=90)
     assert not t.is_alive(), "controller hung after rank death"
     result = done["result"]

@@ -1,0 +1,95 @@
+"""util/fault_injection.py unit tests: deterministic, in-process, no cluster."""
+import time
+
+import pytest
+
+from ray_tpu.core.exceptions import FaultInjectedError
+from ray_tpu.util import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+def test_unarmed_fail_point_is_noop():
+    fi.fail_point("nowhere")  # nothing armed: must not raise
+    assert fi.fired("nowhere") == 0
+
+
+def test_error_mode_raises_typed_with_context():
+    fi.arm("site.a", mode="error")
+    with pytest.raises(FaultInjectedError) as ei:
+        fi.fail_point("site.a", replica="r1", attempt=2)
+    assert ei.value.site == "site.a"
+    assert ei.value.context == {"replica": "r1", "attempt": 2}
+    assert fi.fired("site.a") == 1
+
+
+def test_count_budget_limits_firings():
+    fi.arm("site.b", mode="error", count=2)
+    for _ in range(2):
+        with pytest.raises(FaultInjectedError):
+            fi.fail_point("site.b")
+    fi.fail_point("site.b")  # budget burned: no-op again
+    assert fi.fired("site.b") == 2
+
+
+def test_delay_mode_sleeps():
+    fi.arm("site.c", mode="delay", delay_s=0.15)
+    t0 = time.monotonic()
+    fi.fail_point("site.c")
+    assert time.monotonic() - t0 >= 0.14
+
+
+def test_seeded_probability_is_deterministic():
+    def run():
+        fi.arm("site.d", mode="error", prob=0.5, seed=7, count=None)
+        hits = []
+        for i in range(20):
+            try:
+                fi.fail_point("site.d")
+                hits.append(i)
+            except FaultInjectedError:
+                pass
+        fi.disarm("site.d")
+        return hits
+
+    first, second = run(), run()
+    assert first == second  # same seed -> same hit/miss sequence
+    assert 0 < len(first) < 20  # probabilistic: some fired, some passed
+
+
+def test_disarm_single_and_all():
+    fi.arm("x", mode="error")
+    fi.arm("y", mode="error")
+    fi.disarm("x")
+    fi.fail_point("x")  # disarmed
+    with pytest.raises(FaultInjectedError):
+        fi.fail_point("y")
+    fi.disarm()
+    fi.fail_point("y")
+    assert set(fi.armed()) == set()
+
+
+def test_env_var_arming(monkeypatch):
+    monkeypatch.setenv(fi.ENV_VAR,
+                       "env.site=error@n=1; env.slow=delay@delay=0.01")
+    with pytest.raises(FaultInjectedError):
+        fi.fail_point("env.site")
+    fi.fail_point("env.site")  # n=1 budget burned (state cached per raw string)
+    fi.fail_point("env.slow")  # delay mode parses and runs
+    assert "env.slow" in fi.armed()
+    # API spec wins over env for the same site
+    fi.arm("env.slow", mode="error")
+    with pytest.raises(FaultInjectedError):
+        fi.fail_point("env.slow")
+
+
+def test_env_var_bad_entry_skipped(monkeypatch):
+    monkeypatch.setenv(fi.ENV_VAR, "broken=nosuchmode; ok=error")
+    with pytest.raises(FaultInjectedError):
+        fi.fail_point("ok")
+    fi.fail_point("broken")  # unparseable entry ignored, not fatal
