@@ -346,6 +346,26 @@ _FLAGS: List[Flag] = [
          "Default decode-slot count for LLMConfig (continuous batching width)."),
     Flag("llm_max_model_len", "RAY_TPU_LLM_MAX_MODEL_LEN", "int", 1024,
          "Default per-slot KV capacity for LLMConfig."),
+    Flag("llm_fused_steps", "RAY_TPU_LLM_FUSED_STEPS", "int", 0,
+         "Default fused decode burst width when LLMConfig.num_decode_steps is "
+         "unset: the engine runs this many decode+sample steps on device per "
+         "host sync. 0 = auto-tune from the measured host round trip vs the "
+         "measured device step time."),
+    Flag("llm_fused_steps_max", "RAY_TPU_LLM_FUSED_STEPS_MAX", "int", 32,
+         "Upper bound for the auto-tuned fused decode burst width (bounds "
+         "both K-token streaming granularity and the log2(K) compiled decode "
+         "program count)."),
+    Flag("llm_fused_sync_target", "RAY_TPU_LLM_FUSED_SYNC_TARGET", "float",
+         0.15,
+         "Auto-tune target for the host-sync share of a decode burst: K is "
+         "raised until host_round_trip/(host_round_trip + K*device_step) "
+         "drops to this fraction (subject to llm_fused_steps_max)."),
+    Flag("llm_prefix_min_hit_tokens", "RAY_TPU_LLM_PREFIX_MIN_HIT_TOKENS",
+         "int", 0,
+         "Prefix-cache pay-or-skip floor: a warm prefill only uses the cache "
+         "when the cached-token count reaches this. 0 = auto — skip when the "
+         "predicted compute saving (hit tokens x measured per-token prefill "
+         "time) is below the measured dispatch round trip."),
     # -- train
     Flag("train_v2_enabled", "RAY_TPU_TRAIN_V2_ENABLED", "bool", False,
          "Route trainers through the v2 controller (FailurePolicy/"

@@ -6,7 +6,7 @@ batching, device-resident KV cache, TP over ICI via pjit), an ``LLMServer``
 Serve deployment exposing OpenAI-compatible chat/completions, a multi-model
 router (``build_openai_app``), and a Ray-Data batch-inference ``Processor``.
 """
-from .config import LLMConfig, SamplingParams
+from .config import LLMConfig, SamplingParams, SpecConfig
 from .engine import JaxLLMEngine, LLMEngine, RequestOutput
 from .server import LLMServer, PDRouter, build_openai_app, build_pd_openai_app
 from .batch import (
@@ -23,6 +23,7 @@ from .batch import (
 __all__ = [
     "LLMConfig",
     "SamplingParams",
+    "SpecConfig",
     "LLMEngine",
     "JaxLLMEngine",
     "RequestOutput",

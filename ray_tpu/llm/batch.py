@@ -217,21 +217,25 @@ class LLMEngineStage:
         self.params = SamplingParams(**(sampling_params or {}))
 
     def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        import queue as _q
-        import threading
+        import concurrent.futures
 
         prompts = list(batch["prompt"])
-        results: List[Any] = [None] * len(prompts)
+        if not prompts:  # an upstream filter can empty a block
+            out = dict(batch)
+            out["generated_text"] = np.array([], dtype=object)
+            out["num_generated_tokens"] = np.array([], np.int64)
+            return out
 
-        # Feed all prompts concurrently so the continuous batcher fills its slots.
-        def worker(i):
-            results[i] = self.engine.generate_sync(str(prompts[i]), self.params)
-
-        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        # Feed prompts concurrently so the continuous batcher fills its slots,
+        # but bound the fan-out: the engine admits at burst boundaries, so 2x
+        # the slot count keeps every freed slot instantly refillable while a
+        # 10k-row block doesn't spawn 10k parked threads.
+        workers = min(len(prompts),
+                      max(1, 2 * self.engine.config.max_num_seqs))
+        with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as pool:
+            results = list(pool.map(
+                lambda p: self.engine.generate_sync(str(p), self.params),
+                prompts))
         out = dict(batch)
         out["generated_text"] = np.array([r.text for r in results], dtype=object)
         out["num_generated_tokens"] = np.array(

@@ -36,6 +36,26 @@ class SamplingParams:
 
 
 @dataclasses.dataclass
+class SpecConfig:
+    """First-class speculative-decoding mode (reference vLLM SpeculativeConfig).
+
+    Composes with fused multi-step decode: each fused window proposes/verifies
+    ``num_tokens`` drafts on device, so per host sync the engine emits between
+    K and K*(num_tokens+1) tokens. ``method`` is the proposer; only "ngram"
+    (prompt lookup) is implemented."""
+
+    num_tokens: int = 4
+    method: str = "ngram"
+    ngram_max: int = 3  # longest trailing n-gram the proposer matches
+
+    def __post_init__(self):
+        if self.num_tokens < 1:
+            raise ValueError("SpecConfig.num_tokens must be >= 1")
+        if self.ngram_max < 1:
+            raise ValueError("SpecConfig.ngram_max must be >= 1")
+
+
+@dataclasses.dataclass
 class LLMConfig:
     """Model + engine knobs for ``JaxLLMEngine`` / ``LLMServer``.
 
@@ -70,8 +90,11 @@ class LLMConfig:
     # host sync (lax.scan; vLLM multi-step scheduling). >1 amortizes the
     # per-step host round trip — decisive over a network tunnel, a few percent
     # on local chips — at the cost of K-token streaming granularity and up to
-    # K-1 wasted steps after a mid-burst EOS
-    num_decode_steps: int = 1
+    # K-1 wasted steps after a mid-burst EOS. None (the default) resolves
+    # RAY_TPU_LLM_FUSED_STEPS, whose 0 default auto-tunes K from the measured
+    # host round trip vs device step time — fused decode is the standard
+    # engine mode, not an opt-in
+    num_decode_steps: Optional[int] = None
     # speculative decoding (reference: vLLM ngram / prompt-lookup): propose up
     # to this many draft tokens per step by matching the trailing n-gram
     # against earlier context, verify all of them in ONE forward pass, accept
@@ -80,6 +103,10 @@ class LLMConfig:
     num_speculative_tokens: int = 0
     speculative_method: str = "ngram"
     ngram_prompt_lookup_max: int = 3
+    # first-class speculation mode: a SpecConfig (or its dict form) here
+    # overrides the three scalar knobs above, which remain as the resolved
+    # values engine code reads
+    speculative: Optional[Union["SpecConfig", Dict[str, Any]]] = None
     # weight-only quantization (reference: vLLM quantization engine_kwargs):
     #   None   — serve in `dtype` as loaded
     #   "int8" — per-output-channel int8 weights, bf16 activations (W8A16):
@@ -97,6 +124,23 @@ class LLMConfig:
     accelerator_type: Optional[str] = None
     deployment_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
     engine_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.speculative is not None:
+            sp = self.speculative
+            if isinstance(sp, dict):
+                sp = SpecConfig(**sp)
+                self.speculative = sp
+            self.num_speculative_tokens = sp.num_tokens
+            self.speculative_method = sp.method
+            self.ngram_prompt_lookup_max = sp.ngram_max
+
+    def resolve_decode_steps(self) -> int:
+        """Configured fused burst width: explicit value, else the
+        RAY_TPU_LLM_FUSED_STEPS flag. 0 means auto-tune (engine-side)."""
+        if self.num_decode_steps is not None:
+            return max(0, int(self.num_decode_steps))
+        return max(0, int(_flag("llm_fused_steps")))
 
     def resolve_model_config(self):
         from ray_tpu.models.config import ModelConfig, get_config

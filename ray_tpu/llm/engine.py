@@ -5,15 +5,21 @@ llm_engine.py:15 (``LLMEngine`` — start, generate stream) and vllm_engine.py:1
 (``VLLMEngine`` — the continuous-batching loop lives in vLLM's AsyncLLMEngine).
 Here the loop is explicit and TPU-shaped: a scheduler thread that (1) admits
 waiting requests into free cache slots via a bucketed prefill jit, (2) advances
-all active slots with one fused decode+sample step, (3) streams tokens out
-through per-request queues. Every device computation has static shapes, so after
-warmup the loop replays cached XLA executables only.
+all active slots with one FUSED K-step decode+sample burst (the default mode —
+K auto-tuned from the measured host round trip vs device step time, so one
+host sync amortizes over K tokens), (3) streams token bursts out through
+per-request queues. Scheduling is barrier-free continuous batching: requests
+admit, retire, and abort at burst boundaries without draining the active
+batch, and a per-slot step budget on device keeps one near-finished request
+from collapsing the burst width for everyone. Every device computation has
+static shapes, so after warmup the loop replays cached XLA executables only.
 """
 from __future__ import annotations
 
 import abc
 import dataclasses
 import itertools
+import logging
 import queue
 import threading
 import time
@@ -29,6 +35,8 @@ from ray_tpu.util import telemetry
 from .config import LLMConfig, SamplingParams
 from . import model_runner
 from .tokenizer import get_tokenizer
+
+LOGGER = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -58,25 +66,11 @@ class LLMEngine(abc.ABC):
     def shutdown(self) -> None: ...
 
 
-_warned_pp_multistep = False
-
-
-def _warn_pp_multistep_once() -> None:
-    """num_decode_steps > 1 with pipeline parallelism is accepted but inert —
-    say so once instead of silently ignoring the knob."""
-    global _warned_pp_multistep
-    if _warned_pp_multistep:
-        return
-    _warned_pp_multistep = True
-    import warnings
-
-    warnings.warn(
-        "num_decode_steps > 1 has no effect when pipeline_parallel_size > 1: "
-        "pp keeps per-step scheduling (microbatch ticks), so the fused "
-        "multi-step decode path is skipped",
-        UserWarning,
-        stacklevel=2,
-    )
+def _pow2_floor(n: int) -> int:
+    """Largest power of two <= n (n >= 1). Burst widths are quantized to
+    powers of two: every distinct K is its own XLA trace, so this bounds the
+    engine to log2(K_max)+1 compiled decode programs."""
+    return 1 << (max(1, int(n)).bit_length() - 1)
 
 
 class _Request:
@@ -141,6 +135,18 @@ class JaxLLMEngine(LLMEngine):
         # the next loop tick (active), cleared on request release
         self._aborted: set = set()
         self.state = None  # decode KV state, allocated on first decode admission
+        # fused-decode fast path (resolved in start(); harmless defaults so an
+        # unstarted engine's helpers — e.g. _propose_ngram in tests — work)
+        self._fused_auto = False
+        self._fused_fixed = 1
+        self._fused_max = 1
+        self._sync_target = 0.15
+        self._host_rt_s = 0.0  # measured tiny dispatch+fetch round trip
+        self._step_s = 0.0  # EWMA of per-decode-step device time
+        self._k_seen: set = set()  # burst widths already compiled (first
+        # burst at a new K carries compile time; skip it in the EWMA)
+        self._prefill_per_tok_s = 0.0  # EWMA: prefill seconds per computed token
+        self._last_tick_monotonic = time.monotonic()  # loop liveness (health)
         # metrics (scraped by LLMServer / autoscaling)
         self.num_pending = 0
         self.num_active = 0
@@ -149,6 +155,7 @@ class JaxLLMEngine(LLMEngine):
         self.num_aborted = 0
         self.num_spec_drafted = 0
         self.num_spec_accepted = 0
+        self.num_prefix_skipped = 0  # pay-or-skip gate declined the cache
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> None:
@@ -189,6 +196,18 @@ class JaxLLMEngine(LLMEngine):
                         ),
                         ("dp", "ep", "tp"),
                     )
+            # fused decode is the default engine mode: explicit
+            # num_decode_steps, else RAY_TPU_LLM_FUSED_STEPS (0 = auto-tune K
+            # from measured host round trip vs device step time)
+            from ray_tpu.config import CONFIG as _CFG
+
+            k_cfg = c.resolve_decode_steps()
+            self._fused_auto = k_cfg == 0
+            # the cap bounds only the AUTO-tuned K (as documented); an
+            # explicitly configured burst width is honored (pow2-quantized)
+            self._fused_max = _pow2_floor(max(1, _CFG.llm_fused_steps_max))
+            self._fused_fixed = 1 if self._fused_auto else _pow2_floor(k_cfg)
+            self._sync_target = min(max(_CFG.llm_fused_sync_target, 0.01), 0.9)
             if c.pipeline_parallel_size > 1:
                 if c.max_num_seqs % (c.pipeline_parallel_size
                                      * c.data_parallel_size):
@@ -199,8 +218,18 @@ class JaxLLMEngine(LLMEngine):
                     raise ValueError("n_layers must divide by pipeline_parallel_size")
                 if not cfg.scan_layers:
                     raise ValueError("pipeline_parallel_size > 1 requires scan_layers")
-                if c.num_decode_steps > 1:
-                    _warn_pp_multistep_once()
+                if self._fused_auto or self._fused_fixed > 1:
+                    # pp decode keeps per-step scheduling (microbatch ticks):
+                    # downgrade cleanly instead of warning about a user knob
+                    LOGGER.info(
+                        "llm.engine model=%s: pipeline_parallel_size=%d keeps "
+                        "per-step decode scheduling; fused multi-step decode "
+                        "(num_decode_steps=%s) downgraded to 1",
+                        c.model_id, c.pipeline_parallel_size,
+                        "auto" if self._fused_auto else self._fused_fixed)
+                    self._fused_auto = False
+                    self._fused_fixed = 1
+                    self._fused_max = 1
             if c.max_num_seqs % c.data_parallel_size:
                 raise ValueError("max_num_seqs must be divisible by data_parallel_size")
             if c.kv_layout == "paged":
@@ -311,9 +340,93 @@ class JaxLLMEngine(LLMEngine):
                 else:
                     self.state = model_runner.init_state(
                         self.model_config, c.max_num_seqs, c.max_model_len, self._mesh)
+            self._measure_host_rt()
             self._loop_thread = threading.Thread(target=self._loop, daemon=True,
                                                  name="llm-engine")
             self._loop_thread.start()
+
+    # -- fused-burst auto-tune ----------------------------------------------------
+    def _measure_host_rt(self, samples: int = 3) -> None:
+        """Measure the fixed per-dispatch host round trip (dispatch + fetch of
+        a scalar): ~100 µs on local chips, ~110 ms through a network tunnel.
+        This is the cost fused bursts amortize, and the dispatch cost the
+        prefix-cache pay-or-skip gate compares savings against."""
+        try:
+            x = jnp.zeros((), jnp.int32)
+            np.asarray(x + 1)  # compile outside the timed region
+            best = float("inf")
+            for _ in range(samples):
+                t0 = time.perf_counter()
+                np.asarray(x + 1)
+                best = min(best, time.perf_counter() - t0)
+            self._host_rt_s = max(best, 1e-7)
+        except Exception:
+            self._host_rt_s = 0.0  # unmeasured: auto-K stays at 1, gate open
+
+    def decode_steps_target(self) -> int:
+        """Current fused burst width target (power of two). Fixed K when
+        configured; in auto mode, the smallest K that brings the host-sync
+        share of a burst — rt/(rt + K*step) — down to the configured target
+        fraction, from the measured round trip and device-step EWMA."""
+        if not self._fused_auto:
+            return self._fused_fixed
+        rt, step = self._host_rt_s, self._step_s
+        if rt <= 0 or step <= 0:
+            return 1  # unmeasured yet: first bursts run per-step and probe
+        f = self._sync_target
+        need = rt * (1.0 - f) / (f * step)
+        if need <= 1.0:
+            return 1  # local chips: syncing every step is already cheap
+        k = 1 << int(np.ceil(np.log2(need)))
+        return max(1, min(k, self._fused_max))
+
+    def _note_burst_device_wall(self, k: int, wall_s: float) -> None:
+        """Fold one burst's dispatch->fetch wall time into the device-step
+        EWMA (wall = rt + K*step). The first burst at each K carries its XLA
+        compile and is skipped."""
+        if k not in self._k_seen:
+            self._k_seen.add(k)
+            return
+        est = max((wall_s - self._host_rt_s) / k, 1e-6)
+        self._step_s = est if self._step_s <= 0 else (
+            0.5 * est + 0.5 * self._step_s)
+
+    def decode_host_sync_fraction(self) -> float:
+        """Estimated share of decode wall time spent on the host round trip
+        at the current burst width (the quantity auto-K minimizes)."""
+        rt, step = self._host_rt_s, self._step_s
+        if rt <= 0 or step <= 0:
+            return 0.0
+        k = self.decode_steps_target()
+        return rt / (rt + k * step)
+
+    def _slot_steps_left(self, req: "_Request") -> int:
+        """Decode steps this request can still take: its remaining max_tokens
+        budget capped by remaining KV room (both >= 1 for a live request —
+        exhaustion finishes it in the previous burst's emit)."""
+        next_write = len(req.prompt_ids) + req.generated - 1
+        kv_room = (self.config.max_model_len - 1) - next_write
+        budget = req.params.max_tokens - req.generated
+        return max(1, min(kv_room, budget))
+
+    def _burst_plan(self):
+        """(k_steps, steps_left[slots]) for the next fused burst. K is the
+        auto/fixed target capped by the LONGEST-running slot's budget (power
+        of two); each slot's own budget rides to the device as steps_left, so
+        short requests stop at their limit without capping the batch — the
+        barrier the old min-over-slots burst width imposed."""
+        n = self.config.max_num_seqs
+        steps = np.ones((n,), np.int32)
+        max_sl = 0
+        for slot, req in self._active.items():
+            if req is None:
+                continue
+            sl = self._slot_steps_left(req)
+            steps[slot] = sl
+            max_sl = max(max_sl, sl)
+        k = _pow2_floor(min(self.decode_steps_target(), max(1, max_sl)))
+        np.minimum(steps, k, out=steps)
+        return k, steps
 
     def _next_rng(self):
         with self._rng_lock:
@@ -368,22 +481,33 @@ class JaxLLMEngine(LLMEngine):
             self._aborted.add(request_id)
         self._wakeup.set()
 
+    def _finish_abort(self, req: "_Request") -> bool:
+        """If `req` was cancelled, finish it now — abort chunk to the client,
+        slot and paged blocks freed immediately — and return True. Called from
+        every burst-boundary emit path, so a request cancelled while a fused
+        burst is in flight on device stops emitting at the boundary (its
+        burst tail is discarded) instead of streaming to max_tokens."""
+        with self._lock:
+            if req.id not in self._aborted:
+                return False
+        req.out_queue.put(RequestOutput(
+            request_id=req.id, token_ids=[], finished=True,
+            finish_reason="abort", num_prompt_tokens=len(req.prompt_ids),
+            num_generated_tokens=req.generated))
+        self.num_aborted += 1
+        self._release(req)
+        with self._lock:
+            self._aborted.discard(req.id)
+        return True
+
     def _process_aborts(self) -> None:
         """Release active slots whose request was aborted (called every tick)."""
         with self._lock:
             if not self._aborted:
                 return
-            aborted = set(self._aborted)
         for slot, req in list(self._active.items()):
-            if req is not None and req.id in aborted:
-                req.out_queue.put(RequestOutput(
-                    request_id=req.id, token_ids=[], finished=True,
-                    finish_reason="abort", num_prompt_tokens=len(req.prompt_ids),
-                    num_generated_tokens=req.generated))
-                self.num_aborted += 1
-                self._release(req)
-                with self._lock:
-                    self._aborted.discard(req.id)
+            if req is not None:
+                self._finish_abort(req)
 
     # -- P/D disaggregation (reference: prefill_decode_disagg deployments) ---------
     def prefill_only(self, prompt, params: SamplingParams,
@@ -559,6 +683,14 @@ class JaxLLMEngine(LLMEngine):
             "num_aborted": self.num_aborted,
             "num_spec_drafted": self.num_spec_drafted,
             "num_spec_accepted": self.num_spec_accepted,
+            "num_prefix_skipped": self.num_prefix_skipped,
+            # fused fast path: current burst width and where the decode wall
+            # time goes (the quantity auto-K minimizes; the bench gates on it)
+            "decode_fused_steps": self.decode_steps_target(),
+            "decode_host_sync_fraction": round(
+                self.decode_host_sync_fraction(), 4),
+            "decode_host_rt_ms": round(self._host_rt_s * 1e3, 4),
+            "decode_device_step_ms": round(self._step_s * 1e3, 4),
         }
         blocks = getattr(self, "_blocks", None)
         if blocks is not None:
@@ -624,6 +756,14 @@ class JaxLLMEngine(LLMEngine):
 
     def _record_prefill_inner(self, req: _Request, t_admit_perf: int) -> None:
         dur = time.perf_counter_ns() - t_admit_perf
+        # per-token prefill cost EWMA (dispatch round trip subtracted): the
+        # prefix-cache pay-or-skip gate's estimate of what a cached token
+        # saves. A first-compile sample inflates it, which only biases the
+        # gate toward USING the cache — the safe direction — and washes out.
+        computed = max(1, self._prefill_tokens_of(req))
+        per_tok = max((dur / 1e9 - self._host_rt_s) / computed, 1e-9)
+        self._prefill_per_tok_s = per_tok if self._prefill_per_tok_s <= 0 else (
+            0.3 * per_tok + 0.7 * self._prefill_per_tok_s)
         tags = self._model_tag()
         telemetry.get_histogram(
             "llm_prefill_seconds", "engine prefill latency per admission",
@@ -854,14 +994,31 @@ class JaxLLMEngine(LLMEngine):
         prompt = req.token_history if req.generated else req.prompt_ids
         n = len(prompt)
         chunk = self.config.prefill_chunk
-        chunked = bool(chunk and n > chunk)
-        cached_ids = self._blocks.match_prefix(slot, prompt)
+        bs = self.config.kv_block_size
+        cached_ids: List[int] = []
+        min_hit = self._prefix_min_hit_tokens()
+        max_hit = ((n - 1) // bs) * bs  # full blocks; last token always computed
+        if self.config.enable_prefix_caching and max_hit >= bs:
+            # pay-or-skip (the prefix_cache_ttft_speedup:0.95 fix): use the
+            # cache only when the predicted compute saving — hit tokens x the
+            # measured per-token prefill time — clears the measured dispatch
+            # round trip. Through a network tunnel the round trip dwarfs the
+            # prefill FLOPs a few cached blocks save, so hashing/refcounting
+            # them is pure overhead; skip the whole machinery then.
+            if max_hit < min_hit:
+                self.num_prefix_skipped += 1
+            else:
+                cached_ids = self._blocks.match_prefix(slot, prompt)
+                if cached_ids and len(cached_ids) * bs < min_hit:
+                    self._blocks.release(slot)  # detach: hit too small to pay
+                    cached_ids = []
+                    self.num_prefix_skipped += 1
         # telemetry groundwork for the prefix-cache speedup mystery: record
         # what the cache SERVED vs what the model computed, per request
-        req.prefix_hit_tokens = len(cached_ids) * self.config.kv_block_size
+        req.prefix_hit_tokens = len(cached_ids) * bs
         req.prefill_tokens = n - req.prefix_hit_tokens
         if cached_ids:
-            suffix_len = n - len(cached_ids) * self.config.kv_block_size
+            suffix_len = n - len(cached_ids) * bs
             if not chunk or suffix_len <= chunk:
                 # cached context + one whole-bucket suffix prefill
                 return self._prefill_with_prefix(req, slot, prompt, cached_ids)
@@ -888,10 +1045,31 @@ class JaxLLMEngine(LLMEngine):
                 self._waiting.put(req)
             return None
         # publish this prompt's full blocks for future prefix hits (chunked
-        # long prompts seed the cache for their shorter siblings too)
-        self._blocks.register_blocks(slot, prompt,
-                                     self._blocks.owned_for(slot), skip_blocks=0)
+        # long prompts seed the cache for their shorter siblings too) — unless
+        # the pay-or-skip gate says a hit of this size could never pay, in
+        # which case hashing the blocks is wasted host work: a longer future
+        # prompt can share at most max_hit tokens with this one
+        if max_hit >= min_hit:
+            self._blocks.register_blocks(slot, prompt,
+                                         self._blocks.owned_for(slot),
+                                         skip_blocks=0)
         return self._sample_one(last_logits, req.params)
+
+    def _prefix_min_hit_tokens(self) -> int:
+        """Cached-token floor below which a prefix hit is skipped. Fixed by
+        RAY_TPU_LLM_PREFIX_MIN_HIT_TOKENS when set; otherwise auto — the hit
+        must save at least one dispatch round trip's worth of prefill compute
+        (hit_tokens * per_token_prefill >= host_rt). Unmeasured timings keep
+        the cache on (the safe direction while EWMAs settle)."""
+        from ray_tpu.config import CONFIG as _CFG
+
+        fixed = _CFG.llm_prefix_min_hit_tokens
+        if fixed > 0:
+            return fixed
+        rt, per_tok = self._host_rt_s, self._prefill_per_tok_s
+        if rt <= 0 or per_tok <= 0:
+            return 0
+        return int(rt / per_tok)
 
     def _prefill_with_prefix(self, req: _Request, slot: int, prompt: List[int],
                              cached_ids: List[int]) -> Optional[int]:
@@ -943,14 +1121,16 @@ class JaxLLMEngine(LLMEngine):
             self._waiting.put(req)  # prefill_kv still set; stays pending
         return ok is True
 
-    def _grow_or_preempt(self, headroom: int = 1) -> None:
+    def _grow_or_preempt(self, headroom: int = 1, steps=None) -> None:
         """Before a decode step: every active slot whose next write crosses into
         an unallocated block gets one; when the pool is dry, preempt the
         YOUNGEST request in the SAME pool partition (recompute preemption:
         blocks freed, request re-queued and later re-prefilled from its token
         history; with dp>1 only the slot's own replica pool can relieve it).
         headroom > 1 reserves room for a fused K-step burst, whose block
-        tables are frozen."""
+        tables are frozen; `steps` (the per-slot burst budget from
+        _burst_plan) caps each slot's reservation at the steps it will
+        actually take, so a near-finished request doesn't grab K blocks."""
         for slot in list(self._active):
             req = self._active[slot]
             if req is None:
@@ -958,13 +1138,15 @@ class JaxLLMEngine(LLMEngine):
             # host mirror of state.lengths (== prompt + generated - 1, the next
             # write position): saves a device fetch per decode step
             next_write = len(req.prompt_ids) + req.generated - 1
+            slot_headroom = (min(headroom, int(steps[slot]))
+                             if steps is not None else headroom)
             # re-check liveness each round: an earlier iteration (or this one)
             # may have preempted this very request — growing a preempted slot
             # would leak blocks into it and corrupt a later occupant's table
             # clamp at the table width: demanding capacity past max_model_len
             # would leak blocks (append index off the table) or preempt
             # innocents forever once the slot is already at full width
-            target = min(next_write + headroom, self.config.max_model_len)
+            target = min(next_write + slot_headroom, self.config.max_model_len)
             while (self._active[slot] is req
                    and target - 1 >= self._blocks.slot_capacity(slot)):
                 if self._blocks.num_free_for(slot) > 0:
@@ -1053,9 +1235,11 @@ class JaxLLMEngine(LLMEngine):
 
     def _spec_burst_width(self) -> int:
         """Fused-spec burst cap: each window may emit up to k+1 tokens, so the
-        room/budget math of _burst_width divides by the window length."""
+        per-slot room/budget math divides by the window length. Spec windows
+        accept a variable token count, so (unlike plain fused decode) the
+        burst width stays capped by the tightest slot."""
         c = self.config
-        m = max(1, int(c.num_decode_steps))
+        m = self.decode_steps_target()
         if m == 1:
             return 1
         wlen = c.num_speculative_tokens + 1
@@ -1066,7 +1250,7 @@ class JaxLLMEngine(LLMEngine):
             kv_room = (c.max_model_len - 1) - next_write
             budget = req.params.max_tokens - req.generated
             m = min(m, max(1, min(kv_room, budget) // wlen))
-        return 1 << (m.bit_length() - 1)
+        return _pow2_floor(m)
 
     def _step_decode_spec_fused(self, m: int) -> None:
         """m speculative windows fused per host sync (spec + multi-step
@@ -1096,6 +1280,7 @@ class JaxLLMEngine(LLMEngine):
             hist[slot, :len(ctx)] = ctx
             hlen[slot] = len(ctx)
         rngs = jnp.stack([self._next_rng() for _ in range(m)])
+        t0_wall, t0_perf = time.time_ns(), time.perf_counter_ns()
         if c.kv_layout == "paged":
             self.state, toks_m, acc_m, drafted_m = self._pops.spec_multi(
                 self.params, self.state, jnp.asarray(hist), jnp.asarray(hlen),
@@ -1109,12 +1294,20 @@ class JaxLLMEngine(LLMEngine):
                 jnp.asarray(self._temp), jnp.asarray(self._top_p),
                 jnp.asarray(self._top_k), m, k, c.ngram_prompt_lookup_max)
         toks_m, acc_m, drafted_m = jax.device_get((toks_m, acc_m, drafted_m))
+        dur_ns = time.perf_counter_ns() - t0_perf
+        # keep the auto-K probe live in fused-spec mode too (per-WINDOW cost,
+        # the unit decode_steps_target counts here): without this the EWMA
+        # would freeze at whatever the single-window phase measured
+        self._note_burst_device_wall(m, dur_ns / 1e9)
+        before = self.total_generated
         burst_reqs = {s: r for s, r in self._active.items() if r is not None}
         for step in range(m):
             for slot, req in burst_reqs.items():
                 self._emit_spec_window(
                     slot, req, toks_m[step, slot], int(acc_m[step, slot]),
                     int(drafted_m[step, slot]))
+        self._record_burst(m, self.total_generated - before,
+                           int(active_mask.sum()), t0_wall, dur_ns)
 
     def _emit_spec_window(self, slot: int, req: "_Request", toks_row,
                           acc: int, drafted: int) -> None:
@@ -1123,6 +1316,8 @@ class JaxLLMEngine(LLMEngine):
         discards tokens past a mid-burst finish, force-finishes at the KV cap."""
         if self._active.get(slot) is not req:
             return  # finished (or aborted) earlier in this burst: discard tail
+        if self._aborted and self._finish_abort(req):
+            return  # cancelled mid-burst: tail discarded, blocks freed now
         c = self.config
         self.num_spec_drafted += drafted
         self.num_spec_accepted += min(acc, drafted)
@@ -1149,9 +1344,9 @@ class JaxLLMEngine(LLMEngine):
         all emit this step (greedy slots only; others ride along with k=0)."""
         cfg = self.model_config
         c = self.config
-        if c.num_decode_steps > 1 and c.pipeline_parallel_size == 1:
-            # pp keeps per-step scheduling (microbatch ticks), as in the plain
-            # decode path — spec windows go through the single-verify schedule
+        if self.decode_steps_target() > 1:
+            # pp engines never reach here with >1 (start() downgrades the
+            # target): pp keeps per-step scheduling (microbatch ticks)
             m = self._spec_burst_width()
             if m > 1 and c.kv_layout == "paged":
                 # every window position of the burst must land in an owned block
@@ -1186,6 +1381,7 @@ class JaxLLMEngine(LLMEngine):
                 window[slot, 1:1 + len(drafts)] = drafts
         if not active_mask.any():
             return  # pool-exhaustion preemption may have drained every slot
+        t0_wall, t0_perf = time.time_ns(), time.perf_counter_ns()
         if c.kv_layout == "paged":
             self.state, out_toks, n_acc = self._pops.spec_verify(
                 self.params, self.state, jnp.asarray(window),
@@ -1205,61 +1401,53 @@ class JaxLLMEngine(LLMEngine):
                 self._next_rng(), jnp.asarray(self._temp),
                 jnp.asarray(self._top_p), jnp.asarray(self._top_k))
         out_toks, n_acc = jax.device_get((out_toks, n_acc))
+        dur_ns = time.perf_counter_ns() - t0_perf
+        # the verify forward is close enough to a decode step to feed the
+        # auto-K probe: once the EWMA settles, single-window spec engines in
+        # auto mode graduate to fused multi-window bursts
+        self._note_burst_device_wall(1, dur_ns / 1e9)
+        before = self.total_generated
         burst_reqs = {s: r for s, r in self._active.items() if r is not None}
         for slot, req in burst_reqs.items():
             self._emit_spec_window(slot, req, out_toks[slot],
                                    int(n_acc[slot]), int(draft_len[slot]))
-
-    def _burst_width(self) -> int:
-        """How many decode steps this burst may fuse: the configured K capped
-        by every active slot's remaining KV room and max_tokens budget (a slot
-        that would cross either limit mid-burst caps the whole burst — fused
-        steps can't stop one slot early)."""
-        k = max(1, int(self.config.num_decode_steps))
-        if k == 1:
-            return 1
-        for req in self._active.values():
-            if req is None:
-                continue
-            next_write = len(req.prompt_ids) + req.generated - 1
-            kv_room = (self.config.max_model_len - 1) - next_write
-            budget = req.params.max_tokens - req.generated
-            k = min(k, max(1, min(kv_room, budget)))
-        # quantize to a power of two: every distinct K is its own XLA trace
-        # (rngs shape [K]), so free-running K would compile once per value —
-        # this bounds the engine to log2(num_decode_steps)+1 decode programs
-        return 1 << (k.bit_length() - 1)
+        self._record_burst(1, self.total_generated - before,
+                           int(np.asarray(active_mask).sum()), t0_wall, dur_ns)
 
     def _step_decode(self) -> None:
         cfg = self.model_config
         if self.config.num_speculative_tokens:
             self._step_decode_spec()
             return
-        k_steps = self._burst_width()
+        k_steps, steps = self._burst_plan()
         if self.config.kv_layout == "paged":
-            self._grow_or_preempt(headroom=k_steps)
-            k_steps = min(k_steps, self._burst_width())  # preemption changed the set
+            self._grow_or_preempt(headroom=k_steps, steps=steps)
+            k_steps, steps = self._burst_plan()  # preemption changed the set
         active_mask = np.array([r is not None for r in self._active.values()], bool)
         if not active_mask.any():
             return  # preemption may have drained every slot this cycle
-        if self.config.pipeline_parallel_size > 1:
-            k_steps = 1  # PP decode keeps per-step scheduling (microbatch ticks)
+        t0_wall, t0_perf = time.time_ns(), time.perf_counter_ns()
         if k_steps > 1:
-            # fused burst: K decode+sample iterations, ONE host sync
-            # (vLLM multi-step scheduling; decisive over a network tunnel)
+            # fused burst: K decode+sample iterations, ONE host sync (vLLM
+            # multi-step scheduling; decisive over a network tunnel). The
+            # per-slot steps budget rides along, so a request one token from
+            # its max_tokens no longer caps the whole batch at K=1 — it stops
+            # advancing on device and retires at the burst boundary while the
+            # rest of the batch runs full-width.
             rngs = jnp.stack([self._next_rng() for _ in range(k_steps)])
+            steps_dev = jnp.asarray(steps)
             if self.config.kv_layout == "paged":
                 self.state, toks_k = self._pops.decode_multi(
                     self.params, self.state, jnp.asarray(self._last_tokens),
                     jnp.asarray(active_mask), rngs,
                     jnp.asarray(self._temp), jnp.asarray(self._top_p),
-                    jnp.asarray(self._top_k))
+                    jnp.asarray(self._top_k), steps_dev)
             else:
                 self.state, toks_k = model_runner.decode_multi(
                     self.params, self.state, jnp.asarray(self._last_tokens),
                     jnp.asarray(active_mask), cfg, rngs,
                     jnp.asarray(self._temp), jnp.asarray(self._top_p),
-                    jnp.asarray(self._top_k))
+                    jnp.asarray(self._top_k), steps_dev)
             toks_burst = np.asarray(toks_k)  # [K, slots] — the only fetch
         else:
             if self.config.kv_layout == "paged":
@@ -1280,14 +1468,22 @@ class JaxLLMEngine(LLMEngine):
             toks_burst = np.asarray(model_runner.sample_tokens(
                 self._next_rng(), logits, jnp.asarray(self._temp),
                 jnp.asarray(self._top_p), jnp.asarray(self._top_k)))[None, :]
+        dur_ns = time.perf_counter_ns() - t0_perf
+        self._note_burst_device_wall(k_steps, dur_ns / 1e9)
         burst_reqs = {slot: req for slot, req in self._active.items() if req is not None}
+        emitted = 0
         for t in range(toks_burst.shape[0]):
             for slot, req in burst_reqs.items():
+                if t >= steps[slot]:
+                    continue  # this slot's own budget ended before the burst
                 if self._active.get(slot) is not req:
                     continue  # finished (or aborted) earlier in this burst
+                if self._aborted and self._finish_abort(req):
+                    continue  # cancelled mid-burst: tail discarded, blocks freed
                 tok = int(toks_burst[t, slot])
                 self._last_tokens[slot] = tok
                 self._emit(req, tok)
+                emitted += 1
                 r2 = self._active[slot]
                 # host mirror of state.lengths: the last sampled token is not yet
                 # written to KV, so device lengths == prompt + generated - 1.
@@ -1302,6 +1498,38 @@ class JaxLLMEngine(LLMEngine):
                         num_generated_tokens=r2.generated,
                     ))
                     self._release(r2)
+        self._record_burst(k_steps, emitted, int(active_mask.sum()),
+                           t0_wall, dur_ns)
+
+    def _record_burst(self, k: int, emitted: int, n_slots: int,
+                      t0_wall_ns: int, dur_ns: int) -> None:
+        """Per-burst decode telemetry: ONE span/observation per K-step burst
+        (tagged with K and tokens emitted) instead of per host step, so the
+        cross-worker timeline and the windowed quantiles stay truthful under
+        fused mode. Guarded like _export_metrics — metrics must never take
+        the engine down."""
+        try:
+            tags = self._model_tag()
+            if emitted:
+                telemetry.get_counter(
+                    "llm_generated_tokens_total",
+                    "tokens emitted by the engine (all requests)",
+                    tag_keys=("model",)).inc(float(emitted), tags=tags)
+                if dur_ns > 0:
+                    telemetry.get_histogram(
+                        "llm_burst_tokens_per_s",
+                        "engine decode throughput per fused burst",
+                        tag_keys=("model",),
+                        boundaries=[10, 50, 100, 250, 500, 1000, 2500, 5000,
+                                    10000, 25000]).observe(
+                        emitted / (dur_ns / 1e9), tags=tags)
+            if telemetry.enabled():
+                telemetry.complete(
+                    "llm.decode_burst", "llm", t0_wall_ns, dur_ns, k=k,
+                    tokens=emitted, slots=n_slots,
+                    model=str(self.config.model_id))
+        except Exception:
+            pass  # metrics must never take the engine down
 
     def _loop(self) -> None:
         import time as _time
@@ -1309,6 +1537,9 @@ class JaxLLMEngine(LLMEngine):
         next_metrics_push = 0.0
         while not self._shutdown:
             try:
+                # liveness heartbeat for LLMServer.check_health: a wedged
+                # device call shows up as a stale tick while requests wait
+                self._last_tick_monotonic = _time.monotonic()
                 self._admit()
                 self._process_aborts()
                 # periodic gauge refresh: /metrics must serve current llm_*

@@ -540,21 +540,29 @@ def decode_multi(
     temperature: jax.Array,  # [slots] f32
     top_p: jax.Array,  # [slots] f32
     top_k: jax.Array,  # [slots] i32
+    steps_left: jax.Array,  # [slots] int32 — per-slot step budget within K
 ) -> Tuple[DecodeState, jax.Array]:
     """K fused decode+sample steps per host sync (vLLM multi-step scheduling).
 
-    Returns (state, tokens_k [K, slots]). Slots that hit EOS mid-burst keep
-    decoding (the host discards their tail), so callers cap K by each slot's
-    remaining KV/max_tokens budget before calling.
+    Returns (state, tokens_k [K, slots]). ``steps_left`` makes the burst
+    barrier-free: a slot near its max_tokens/KV budget stops advancing at its
+    own limit (step t treats it as inactive) instead of capping K for the
+    whole batch — so one short request no longer collapses everyone's burst.
+    Slots that hit EOS mid-burst keep decoding (the host discards their tail);
+    only the first steps_left[s] rows of tokens_k are meaningful for slot s.
     """
-    def body(carry, rng):
+    def body(carry, xs):
+        rng, t = xs
         st, toks = carry
-        st, logits = decode_step(params, st, toks, active, cfg)
+        act_t = active & (t < steps_left)
+        st, logits = decode_step(params, st, toks, act_t, cfg)
         nxt = sampling.sample(rng, logits, temperature, top_p, top_k)
-        nxt = jnp.where(active, nxt, toks).astype(jnp.int32)
+        nxt = jnp.where(act_t, nxt, toks).astype(jnp.int32)
         return (st, nxt), nxt
 
-    (state, _), toks_k = jax.lax.scan(body, (state, tokens.astype(jnp.int32)), rngs)
+    (state, _), toks_k = jax.lax.scan(
+        body, (state, tokens.astype(jnp.int32)),
+        (rngs, jnp.arange(rngs.shape[0], dtype=jnp.int32)))
     return state, toks_k
 
 

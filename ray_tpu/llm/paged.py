@@ -786,18 +786,26 @@ def decode_multi_paged(
     temperature: jax.Array,  # [slots] f32
     top_p: jax.Array,  # [slots] f32
     top_k: jax.Array,  # [slots] i32
+    steps_left: jax.Array,  # [slots] int32 — per-slot step budget within K
 ):
     """K fused decode+sample steps against the paged pool (one host sync per
     burst; vLLM multi-step scheduling). Callers pre-grow every active slot's
-    block table by K tokens — block_tables are frozen across the burst."""
-    def body(carry, rng):
+    block table by min(K, steps_left[s]) tokens — block_tables are frozen
+    across the burst. steps_left makes the burst barrier-free: a slot past its
+    own budget goes inactive for the remaining steps (its writes land in the
+    scratch block) instead of capping K for the whole batch."""
+    def body(carry, xs):
+        rng, t = xs
         st, toks = carry
-        st, logits = decode_step_paged(params, st, toks, active, cfg)
+        act_t = active & (t < steps_left)
+        st, logits = decode_step_paged(params, st, toks, act_t, cfg)
         nxt = sampling.sample(rng, logits, temperature, top_p, top_k)
-        nxt = jnp.where(active, nxt, toks).astype(jnp.int32)
+        nxt = jnp.where(act_t, nxt, toks).astype(jnp.int32)
         return (st, nxt), nxt
 
-    (state, _), toks_k = jax.lax.scan(body, (state, tokens.astype(jnp.int32)), rngs)
+    (state, _), toks_k = jax.lax.scan(
+        body, (state, tokens.astype(jnp.int32)),
+        (rngs, jnp.arange(rngs.shape[0], dtype=jnp.int32)))
     return state, toks_k
 
 
@@ -848,33 +856,37 @@ def decode_step_paged_dp(params, state: PagedState, tokens, active,
                    donate_argnames=("state",))
 def decode_multi_paged_dp(params, state: PagedState, tokens, active,
                           cfg: ModelConfig, rngs, temperature, top_p, top_k,
-                          mesh: Mesh):
+                          steps_left, mesh: Mesh):
     from ray_tpu.parallel.sharding import manual_axes
 
-    def body(p, k, v, bt, ln, toks, act, rr, tt, tp_, tk):
+    def body(p, k, v, bt, ln, toks, act, rr, tt, tp_, tk, sl):
         # distinct sampling streams per replica
         rr = jax.vmap(lambda r: jax.random.fold_in(r, jax.lax.axis_index("dp")))(rr)
 
-        def step(carry, rng):
+        def step(carry, xs):
+            rng, t_i = xs
             kk, vv, lln, t = carry
-            kk, vv, lln, logits = _decode_step_impl(p, kk, vv, bt, lln, t, act, cfg)
+            act_t = act & (t_i < sl)
+            kk, vv, lln, logits = _decode_step_impl(p, kk, vv, bt, lln, t,
+                                                    act_t, cfg)
             nxt = sampling.sample(rng, logits, tt, tp_, tk)
-            nxt = jnp.where(act, nxt, t).astype(jnp.int32)
+            nxt = jnp.where(act_t, nxt, t).astype(jnp.int32)
             return (kk, vv, lln, nxt), nxt
 
         (kk, vv, lln, _), toks_k = jax.lax.scan(
-            step, (k, v, ln, toks.astype(jnp.int32)), rr)
+            step, (k, v, ln, toks.astype(jnp.int32)),
+            (rr, jnp.arange(rr.shape[0], dtype=jnp.int32)))
         return kk, vv, lln, toks_k
 
     with manual_axes("dp"):
         nk, nv, lengths, toks_k = jax.shard_map(
             body, mesh=mesh,
             in_specs=(_rep_specs(params), POOL_DP, POOL_DP, TABLE_DP, VEC_DP,
-                      VEC_DP, VEC_DP, P(), VEC_DP, VEC_DP, VEC_DP),
+                      VEC_DP, VEC_DP, P(), VEC_DP, VEC_DP, VEC_DP, VEC_DP),
             out_specs=(POOL_DP, POOL_DP, VEC_DP, P(None, "dp")),
             axis_names={"dp"},
         )(params, state.k, state.v, state.block_tables, state.lengths,
-          tokens, active, rngs, temperature, top_p, top_k)
+          tokens, active, rngs, temperature, top_p, top_k, steps_left)
     return PagedState(k=nk, v=nv, block_tables=state.block_tables,
                       lengths=lengths), toks_k
 
@@ -1124,13 +1136,13 @@ class PagedOps:
         return decode_step_paged(params, state, tokens, active, self.cfg)
 
     def decode_multi(self, params, state, tokens, active, rngs, temperature,
-                     top_p, top_k):
+                     top_p, top_k, steps_left):
         if self.dp > 1:
             return decode_multi_paged_dp(params, state, tokens, active,
                                          self.cfg, rngs, temperature, top_p,
-                                         top_k, mesh=self.mesh)
+                                         top_k, steps_left, mesh=self.mesh)
         return decode_multi_paged(params, state, tokens, active, self.cfg,
-                                  rngs, temperature, top_p, top_k)
+                                  rngs, temperature, top_p, top_k, steps_left)
 
     def spec_verify(self, params, state, window, draft_len, active, rng,
                     temperature, top_p, top_k):

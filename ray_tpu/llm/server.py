@@ -223,9 +223,26 @@ class LLMServer:
     def metrics(self) -> Dict[str, Any]:
         return self.engine.metrics()
 
+    # scheduler-loop stall bound for check_health: generous enough for a cold
+    # XLA compile of a big model's burst program, far below a wedged device
+    ENGINE_STALL_S = 300.0
+
     def check_health(self) -> None:
         if self.engine._shutdown:
             raise RuntimeError("engine stopped")
+        import time as _time
+
+        eng = self.engine
+        # a live loop ticks every burst; requests in flight with a stale tick
+        # means the scheduler thread is wedged (device hang, deadlock) — fail
+        # health so the serve controller replaces this replica
+        if eng._loop_thread is not None and (eng.num_active or eng.num_pending):
+            stale = _time.monotonic() - eng._last_tick_monotonic
+            if stale > self.ENGINE_STALL_S:
+                raise RuntimeError(
+                    f"engine scheduler loop stalled for {stale:.0f}s with "
+                    f"{eng.num_active} active / {eng.num_pending} pending "
+                    "requests")
 
     def shutdown(self) -> None:
         self.engine.shutdown()

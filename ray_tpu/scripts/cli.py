@@ -139,11 +139,19 @@ def _render_status(s: dict) -> str:
                      f"queue_depth[{depth or '-'}]")
     llm = s.get("llm", {})
     if llm.get("prefix_cache_hits") or llm.get("active") or llm.get("pending"):
+        fused = " ".join(f"{k}:{int(v)}" for k, v in sorted(
+            (llm.get("fused_steps") or {}).items()))
+        burst = llm.get("burst_tokens_per_s_p50")
+        burst_txt = f"{burst:.0f}" if burst else "-"
         lines.append(f"llm        pending={llm.get('pending')} "
                      f"active={llm.get('active')} "
-                     f"prefix_cache hit/miss="
+                     f"tokens={llm.get('generated_tokens', 0)} "
+                     f"burst_tok/s_p50={burst_txt} "
+                     f"fused_k[{fused or '-'}] "
+                     f"prefix_cache hit/miss/skip="
                      f"{llm.get('prefix_cache_hits', 0)}/"
-                     f"{llm.get('prefix_cache_misses', 0)}")
+                     f"{llm.get('prefix_cache_misses', 0)}/"
+                     f"{llm.get('prefix_cache_skipped', 0)}")
     tn = s.get("train", {})
     if tn.get("mfu") or tn.get("step_phases_s"):
         mfu = " ".join(f"{k}:{v:.3f}" for k, v in sorted(tn.get("mfu", {}).items()))

@@ -413,14 +413,24 @@ def cluster_status() -> Dict[str, Any]:
     # -- llm engines
     llm_ttft = merged.get("llm_ttft_seconds")
     tok_rate = merged.get("llm_tokens_per_s")
+    burst_rate = merged.get("llm_burst_tokens_per_s")
     status["llm"] = {
         "ttft_p50_s": m.histogram_quantile(llm_ttft, 0.5) if llm_ttft else None,
         "ttft_p99_s": m.histogram_quantile(llm_ttft, 0.99) if llm_ttft else None,
         "tokens_per_s_p50": m.histogram_quantile(tok_rate, 0.5) if tok_rate else None,
+        # per-burst engine throughput (one observation per fused K-step burst
+        # — truthful under fused decode, where per-host-step numbers would
+        # overcount) + total tokens for windowed rates via metrics_history
+        "burst_tokens_per_s_p50": (m.histogram_quantile(burst_rate, 0.5)
+                                   if burst_rate else None),
+        "generated_tokens": int(counter_total("llm_generated_tokens_total")),
+        "fused_steps": gauges("llm_decode_fused_steps"),
+        "host_sync_fraction": gauges("llm_decode_host_sync_fraction"),
         "pending": gauges("llm_num_pending"),
         "active": gauges("llm_num_active"),
         "prefix_cache_hits": int(counter_total("llm_prefix_cache_hits_total")),
         "prefix_cache_misses": int(counter_total("llm_prefix_cache_misses_total")),
+        "prefix_cache_skipped": int(counter_total("llm_num_prefix_skipped")),
     }
 
     # -- train

@@ -20,13 +20,22 @@ from ray_tpu.models.config import get_config
 CFG = get_config("test-tiny")
 
 
+_REF_CACHE = {}
+
+
 def reference_greedy(params, prompt_ids, n_tokens):
-    """Greedy decode by full recompute each step — the trusted slow path."""
-    ids = list(prompt_ids)
-    for _ in range(n_tokens):
-        logits, _ = llama.forward(params, jnp.asarray([ids]), CFG)
-        ids.append(int(jnp.argmax(logits[0, -1])))
-    return ids[len(prompt_ids):]
+    """Greedy decode by full recompute each step — the trusted slow path.
+    Memoized: every call pays one full forward PER SEQUENCE LENGTH (a fresh
+    XLA compile each), and the [slot]/[paged] parametrizations ask for the
+    same continuations."""
+    key = (id(params), tuple(prompt_ids), n_tokens)
+    if key not in _REF_CACHE:
+        ids = list(prompt_ids)
+        for _ in range(n_tokens):
+            logits, _ = llama.forward(params, jnp.asarray([ids]), CFG)
+            ids.append(int(jnp.argmax(logits[0, -1])))
+        _REF_CACHE[key] = ids[len(prompt_ids):]
+    return _REF_CACHE[key]
 
 
 @pytest.fixture(scope="module")
@@ -35,6 +44,20 @@ def engine():
         model_id="tiny", model_source="test-tiny", max_num_seqs=4, max_model_len=64,
         tokenizer="byte",
     )
+    eng = JaxLLMEngine(cfg)
+    eng.start()
+    yield eng
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def fused_engine():
+    """ONE compiled fused paged engine shared by the continuous-batching
+    tests — same geometry as test_multi_step_decode[paged] so the decode
+    burst programs are reused, holding the tier-1 timing budget."""
+    cfg = LLMConfig(model_id="tiny-fused", model_source="test-tiny",
+                    max_num_seqs=4, max_model_len=64, tokenizer="byte",
+                    kv_layout="paged", kv_block_size=16, num_decode_steps=4)
     eng = JaxLLMEngine(cfg)
     eng.start()
     yield eng
@@ -54,11 +77,14 @@ def test_greedy_matches_full_forward(engine):
 
 
 def test_continuous_batching_concurrent_requests(engine):
-    """Concurrent requests through shared slots must each match the sequential result."""
-    params = llama_init_cached(CFG)
+    """Concurrent requests through shared slots must each match their solo
+    run on the same engine (solo-vs-reference is test_greedy's job; solo
+    oracles here skip ~36 per-length reference forwards — tier-1 budget)."""
     prompts = [[1, 2, 3], [1, 9, 8, 7, 6, 5], [1, 50], [1, 3, 3, 3, 3, 3, 3, 3],
                [1, 100, 101], [1, 60, 61, 62]]  # 6 requests > 4 slots
-    want = [reference_greedy(params, p, 6) for p in prompts]
+    want = [engine.generate_sync(p, SamplingParams(
+        max_tokens=6, temperature=0.0, stop_token_ids=[-1])).token_ids
+        for p in prompts]
     got = [None] * len(prompts)
 
     def run(i):
@@ -164,30 +190,25 @@ def test_batch_processor(rt):
     assert all("generated_text" in r and r["num_generated_tokens"] <= 3 for r in rows)
 
 
-def test_abort_releases_slot():
+def test_abort_releases_slot(engine):
     """abort() mid-generation ends the request with finish_reason="abort" and
     frees its slot instead of decoding to max_tokens (reference: vllm
-    abort_request)."""
-    cfg = LLMConfig(model_id="tiny-abort", model_source="test-tiny",
-                    max_num_seqs=2, max_model_len=512, tokenizer="byte")
-    eng = JaxLLMEngine(cfg)
-    eng.start()
-    try:
-        rid = "abort-me"
-        gen = eng.generate([1, 2, 3], SamplingParams(
-            max_tokens=400, temperature=0.0, stop_token_ids=[-1]), request_id=rid)
-        first = next(gen)
-        assert not first.finished
-        eng.abort(rid)
-        outs = list(gen)
-        assert outs[-1].finished
-        assert outs[-1].finish_reason == "abort"
-        deadline = time.time() + 10
-        while eng.num_active:
-            assert time.time() < deadline, "aborted request still holds a slot"
-            time.sleep(0.05)
-    finally:
-        eng.shutdown()
+    abort_request). Runs on the shared module engine (tier-1 budget: a
+    private 512-len engine compiled its own decode programs for nothing —
+    the subject is abort, not capacity)."""
+    rid = "abort-me"
+    gen = engine.generate([1, 2, 3], SamplingParams(
+        max_tokens=56, temperature=0.0, stop_token_ids=[-1]), request_id=rid)
+    first = next(gen)
+    assert not first.finished
+    engine.abort(rid)
+    outs = list(gen)
+    assert outs[-1].finished
+    assert outs[-1].finish_reason == "abort"
+    deadline = time.time() + 10
+    while engine.num_active:
+        assert time.time() < deadline, "aborted request still holds a slot"
+        time.sleep(0.05)
 
 
 def test_sse_generator_close_aborts_engine_request():
@@ -195,12 +216,14 @@ def test_sse_generator_close_aborts_engine_request():
     slot early via the abort path."""
     from ray_tpu.llm.server import LLMServer
 
+    # max_model_len matches the other byte-tiny server tests so the decode
+    # programs are shared (the subject is stream-close abort, not capacity)
     cfg = LLMConfig(model_id="tiny-abort2", model_source="byte-tiny",
-                    max_num_seqs=2, max_model_len=512)
+                    max_num_seqs=2, max_model_len=64)
     srv = LLMServer(cfg)
     try:
         g = srv.chat({"messages": [{"role": "user", "content": "hi"}],
-                      "stream": True, "max_tokens": 400, "temperature": 1.0})
+                      "stream": True, "max_tokens": 56, "temperature": 1.0})
         next(g)  # role frame
         next(g)  # first delta
         g.close()
@@ -402,10 +425,14 @@ def test_spec_fused_multi_step_matches_greedy(kv_layout):
     prompt = [1, 10, 11, 12, 13, 10, 11, 12, 13, 10, 11, 12, 13]
     want = reference_greedy(params, prompt, 12)
 
+    from ray_tpu.llm import SpecConfig
+
+    # constructed through the first-class SpecConfig mode (resolves into the
+    # scalar engine knobs), composing with fused bursts
     eng = JaxLLMEngine(LLMConfig(
         model_id=f"spec-fused-{kv_layout}", model_source="test-tiny",
         max_num_seqs=2, max_model_len=64, tokenizer="byte", kv_layout=kv_layout,
-        num_speculative_tokens=4, num_decode_steps=4))
+        speculative=SpecConfig(num_tokens=4), num_decode_steps=4))
     eng.start()
     try:
         out = eng.generate_sync(prompt, SamplingParams(
@@ -524,3 +551,213 @@ def test_spec_decode_through_pipeline_matches_greedy(parallel):
         assert out3.token_ids == want
     finally:
         eng.shutdown()
+
+
+# -- continuous batching on the fused default path (barrier-free scheduling) --
+
+
+def test_burst_plan_per_slot_budgets():
+    """The fused burst width is capped by the LONGEST-running slot; a request
+    one step from its max_tokens rides along with its own on-device budget
+    instead of collapsing the whole batch to K=1 (the old min-over-slots
+    barrier)."""
+    from ray_tpu.llm.engine import _Request
+
+    eng = JaxLLMEngine(LLMConfig(model_id="bp", model_source="test-tiny",
+                                 max_num_seqs=4, max_model_len=64))
+    eng._fused_auto, eng._fused_fixed, eng._fused_max = False, 4, 4
+    r_long = _Request("a", [1, 2, 3], SamplingParams(max_tokens=30))
+    r_long.generated, r_long.slot = 2, 0
+    r_short = _Request("b", [1, 2], SamplingParams(max_tokens=5))
+    r_short.generated, r_short.slot = 4, 1
+    eng._active = {0: r_long, 1: r_short, 2: None, 3: None}
+    k, steps = eng._burst_plan()
+    assert k == 4, "short request must not cap the batch's burst width"
+    assert steps[0] == 4 and steps[1] == 1
+    # kv_room caps too: a slot one write from max_model_len gets 1 step
+    r_edge = _Request("c", [1] * 60, SamplingParams(max_tokens=30))
+    r_edge.generated, r_edge.slot = 2, 2
+    eng._active[2] = r_edge
+    k, steps = eng._burst_plan()
+    assert k == 4 and steps[2] == (64 - 1) - (60 + 2 - 1)
+
+
+def test_continuous_batching_admit_during_decode(fused_engine):
+    """A request arriving while others are mid-generation admits at the next
+    burst boundary: its completion is not gated on the longest active
+    request, and both token streams stay exact."""
+    # oracles from the module engines (themselves reference_greedy-validated
+    # above): a fresh reference_greedy sweep pays one full forward per
+    # sequence length — the single biggest tier-1 cost in this file
+    long_prompt, short_prompt = [1, 5, 6], [1, 9, 4]
+    sp24 = SamplingParams(max_tokens=24, temperature=0.0, stop_token_ids=[-1])
+    want_long = fused_engine.generate_sync(long_prompt, sp24).token_ids
+    want_short = fused_engine.generate_sync(short_prompt, SamplingParams(
+        max_tokens=4, temperature=0.0, stop_token_ids=[-1])).token_ids
+    rid = "cb-long"
+    gen = fused_engine.generate(long_prompt, SamplingParams(
+        max_tokens=24, temperature=0.0, stop_token_ids=[-1]), request_id=rid)
+    first = next(gen)
+    assert not first.finished
+    out = fused_engine.generate_sync(short_prompt, SamplingParams(
+        max_tokens=4, temperature=0.0, stop_token_ids=[-1]))
+    assert out.token_ids == want_short
+    # the long request is still mid-flight when the late arrival finished
+    long_req = fused_engine._requests.get(rid)
+    assert long_req is not None and long_req.generated < 24, \
+        "short request's completion was gated on the long one draining"
+    ids = list(first.token_ids)
+    for chunk in gen:
+        ids.extend(chunk.token_ids)
+    assert ids == want_long
+
+
+def test_continuous_batching_finish_and_refill(fused_engine):
+    """More requests than slots with MIXED budgets: slots refill as their
+    occupants finish (no global drain), every stream exactly matching its
+    solo run on the same engine (which test_multi_step checks against
+    reference_greedy — solo oracles here keep the tier-1 budget)."""
+    prompts = [[1, 2, 3], [1, 9, 8, 7], [1, 50], [1, 3, 3, 3],
+               [1, 100, 101], [1, 60, 61, 62]]  # 6 requests > 4 slots
+    budgets = [3, 9, 5, 12, 4, 7]
+    want = [fused_engine.generate_sync(p, SamplingParams(
+        max_tokens=b, temperature=0.0, stop_token_ids=[-1])).token_ids
+        for p, b in zip(prompts, budgets)]
+    got = [None] * len(prompts)
+
+    def run(i):
+        got[i] = fused_engine.generate_sync(prompts[i], SamplingParams(
+            max_tokens=budgets[i], temperature=0.0, stop_token_ids=[-1])
+        ).token_ids
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == want
+    assert fused_engine.num_active == 0 and fused_engine.num_pending == 0
+
+
+def test_abort_mid_burst_frees_blocks(fused_engine):
+    """abort() while a fused burst is in flight: the stream ends with
+    finish_reason="abort" at the next burst boundary (the burst tail is
+    discarded, never emitted) and the paged blocks free immediately."""
+    blocks = fused_engine._blocks
+    free0 = blocks.num_free
+    rid = "abort-burst"
+    gen = fused_engine.generate([2, 40, 41, 42], SamplingParams(
+        max_tokens=56, temperature=0.0, stop_token_ids=[-1]), request_id=rid)
+    first = next(gen)
+    assert not first.finished
+    fused_engine.abort(rid)
+    outs = list(gen)
+    assert outs[-1].finished and outs[-1].finish_reason == "abort"
+    deadline = time.time() + 10
+    while fused_engine.num_active or blocks.num_free < free0:
+        assert time.time() < deadline, "aborted request still holds blocks"
+        time.sleep(0.02)
+
+
+def test_preemption_inside_fused_burst(fused_engine):
+    """Pool exhaustion while reserving a fused burst's block headroom:
+    the youngest request is preempted (recompute), the survivors keep
+    decoding in full-width bursts, and everyone completes exactly. The
+    oracle streams come from the shared ample-pool engine (itself checked
+    against reference_greedy above) — recompute preemption must reproduce
+    them bit-for-bit."""
+    prompts = [[1, 10, 11], [1, 20, 21], [1, 30, 31]]
+    sp = SamplingParams(max_tokens=16, temperature=0.0, stop_token_ids=[-1])
+    want = [fused_engine.generate_sync(p, sp).token_ids for p in prompts]
+    cfg = LLMConfig(model_id="tiny-preempt-burst", model_source="test-tiny",
+                    max_num_seqs=2, max_model_len=64, tokenizer="byte",
+                    kv_layout="paged", kv_block_size=8, num_kv_blocks=4,
+                    num_decode_steps=4, enable_prefix_caching=False)
+    eng = JaxLLMEngine(cfg)
+    eng.start()
+    try:
+        # 16 generated tokens write KV positions up to 17: three 8-token
+        # blocks per slot, and prefill's 16-padded install already takes two
+        # — two slots need 6 > the 4-block pool, so a burst's block headroom
+        # must preempt
+        got = [None] * len(prompts)
+
+        def run(i):
+            got[i] = eng.generate_sync(prompts[i], sp).token_ids
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert got == want
+        assert eng.num_preemptions >= 1, \
+            "pool was sized to force preemption inside a burst"
+    finally:
+        eng.shutdown()
+
+
+def test_pp_fused_downgrade_logs_once(caplog):
+    """pp>1 with fused decode (the default) auto-downgrades to per-step
+    scheduling with ONE structured log line — not a UserWarning about an
+    inert user knob."""
+    import logging
+    import warnings
+
+    eng = JaxLLMEngine(LLMConfig(
+        model_id="pp-downgrade", model_source="test-tiny", max_num_seqs=4,
+        max_model_len=64, tokenizer="byte", pipeline_parallel_size=2,
+        num_decode_steps=4), params=llama_init_cached(CFG))
+    try:
+        with caplog.at_level(logging.INFO, logger="ray_tpu.llm.engine"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")  # any warning fails the test
+                eng.start()
+        msgs = [r for r in caplog.records if "downgraded" in r.getMessage()]
+        assert len(msgs) == 1
+        assert eng.decode_steps_target() == 1
+    finally:
+        eng.shutdown()
+
+
+def test_spec_config_first_class():
+    """SpecConfig on LLMConfig is the first-class speculation mode: it
+    resolves into the scalar engine knobs (dict form included, for JSON
+    deployment configs) and validates its fields."""
+    from ray_tpu.llm import SpecConfig
+
+    cfg = LLMConfig(model_id="sc", model_source="test-tiny",
+                    speculative=SpecConfig(num_tokens=3, ngram_max=2))
+    assert cfg.num_speculative_tokens == 3
+    assert cfg.ngram_prompt_lookup_max == 2
+    cfg2 = LLMConfig(model_id="sc2", model_source="test-tiny",
+                     speculative={"num_tokens": 5})
+    assert cfg2.num_speculative_tokens == 5
+    assert isinstance(cfg2.speculative, SpecConfig)
+    with pytest.raises(ValueError):
+        SpecConfig(num_tokens=0)
+
+
+def test_prefix_cache_pay_or_skip(fused_engine):
+    """The warm prefill path skips the prefix cache entirely when the
+    predicted saving (hit tokens x measured per-token prefill time) is below
+    the measured dispatch round trip, and uses it when it pays."""
+    eng = fused_engine
+    p = [3] + [7, 8, 9, 10] * 7  # 29 tokens: one full cacheable block
+    sp = SamplingParams(max_tokens=2, temperature=0.0, stop_token_ids=[-1])
+    rt0, pt0 = eng._host_rt_s, eng._prefill_per_tok_s
+    try:
+        # never pays: a 10s dispatch round trip dwarfs any prefill saving —
+        # even the matching/registration hashing is skipped
+        eng._host_rt_s, eng._prefill_per_tok_s = 10.0, 1e-6
+        skipped0 = eng.num_prefix_skipped
+        eng.generate_sync(p, sp)
+        assert eng.num_prefix_skipped > skipped0
+        # always pays: free dispatch -> the cache is used again
+        eng._host_rt_s = 1e-9
+        eng.generate_sync(p, sp)  # cold: nothing was registered while skipped
+        hits0 = eng._blocks.hit_tokens
+        eng.generate_sync(p, sp)  # warm: real hit through the fused gather
+        assert eng._blocks.hit_tokens > hits0
+    finally:
+        eng._host_rt_s, eng._prefill_per_tok_s = rt0, pt0
