@@ -214,8 +214,10 @@ class Autoscaler:
             while not self._stop.wait(self.config.upscale_interval_s):
                 try:
                     self.step()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # a silently-dead autoscaler means no scaling at all:
+                    # log every failed step (interval-paced, so not spammy)
+                    logger.warning("autoscaler step failed: %r", e)
 
         self._thread = threading.Thread(target=loop, daemon=True, name="rt-autoscaler")
         self._thread.start()

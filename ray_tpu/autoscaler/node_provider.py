@@ -115,6 +115,7 @@ class NodeAgentProvider(NodeProvider):
         if proc is not None:
             try:
                 proc.terminate()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
 

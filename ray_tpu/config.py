@@ -1,21 +1,27 @@
-"""Central flag registry: every RAY_TPU_* knob, typed and documented.
+"""CONFIG: typed, env-overridable accessors over the central knob registry.
 
 Capability parity: reference src/ray/common/ray_config_def.h (the RAY_CONFIG
 X-macro registry, 219 entries, env-overridable as RAY_<name>) — one place to
 see every flag, its type, default, and where its current value came from.
 `ray-tpu list config` prints the table.
 
+The registry itself lives in `ray_tpu.knobs` (every RAY_TPU_* knob with its
+owning subsystem; graftlint enforces coverage and generates the README knob
+tables from it). This module builds the CONFIG attribute table from the
+registry entries that carry an `attr` — the operator-facing flags; env-only
+worker knobs and internal worker-plumbing variables stay registry-only.
+
 Values are read from the environment AT ACCESS TIME (so tests can monkeypatch
-and long-lived processes can be reconfigured between runs) and fall back to the
-documented default. Worker-plumbing variables the runtime sets for its own
-children (RAY_TPU_ARENA, RAY_TPU_TRAIN_RANK, ...) are internal protocol, not
-operator flags, and are deliberately not listed here.
+and long-lived processes can be reconfigured between runs) and fall back to
+the documented default.
 """
 from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
+
+from ray_tpu.knobs import KNOBS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,348 +43,8 @@ class Flag:
 
 
 _FLAGS: List[Flag] = [
-    # -- resources / topology
-    Flag("num_cpus", "RAY_TPU_NUM_CPUS", "float", None,
-         "CPU capacity this node advertises (default: os.cpu_count())."),
-    Flag("num_tpus", "RAY_TPU_NUM_TPUS", "float", None,
-         "TPU chip capacity this node advertises (default: auto-detect)."),
-    Flag("max_workers_per_node", "RAY_TPU_MAX_WORKERS_PER_NODE", "int", 16,
-         "Worker-process cap per node (reference: raylet worker pool size)."),
-    # -- object store / memory
-    Flag("object_store_bytes", "RAY_TPU_OBJECT_STORE_BYTES", "int", 512 * 1024 * 1024,
-         "Shared-memory arena capacity per node (plasma-equivalent)."),
-    Flag("spill_dir", "RAY_TPU_SPILL_DIR", "str", "/tmp",
-         "Directory for objects spilled from shared memory to disk."),
-    Flag("spill_threshold", "RAY_TPU_SPILL_THRESHOLD", "float", 0.8,
-         "Arena-usage fraction above which LRU spilling starts."),
-    Flag("spill_target", "RAY_TPU_SPILL_TARGET", "float", 0.5,
-         "Arena-usage fraction spilling drives down to."),
-    Flag("memory_usage_threshold", "RAY_TPU_MEMORY_USAGE_THRESHOLD", "float", 0.95,
-         "System-memory fraction that triggers the OOM worker killer "
-         "(reference memory_monitor.h)."),
-    Flag("memory_monitor_refresh_ms", "RAY_TPU_MEMORY_MONITOR_REFRESH_MS", "int", 250,
-         "Memory monitor / spill check period."),
-    Flag("inline_threshold_bytes", "RAY_TPU_INLINE_THRESHOLD_BYTES", "int", 100 * 1024,
-         "Objects below this travel inline in control messages instead of the "
-         "arena (reference max_direct_call_object_size)."),
-    Flag("oob_threshold_bytes", "RAY_TPU_OOB_THRESHOLD_BYTES", "int", 1 << 16,
-         "Pickle buffers at or above this serialize out-of-band (zero-copy "
-         "into the arena) instead of inline in the pickle stream."),
-    Flag("object_location_timeout_s", "RAY_TPU_OBJECT_LOCATION_TIMEOUT_S",
-         "float", 60.0,
-         "How long a get() waits for a recovering object's new location "
-         "after lineage resubmission before failing."),
-    Flag("localize_pull_timeout_s", "RAY_TPU_LOCALIZE_PULL_TIMEOUT_S",
-         "float", 120.0,
-         "Deadline for pulling a task's missing arguments to its assigned "
-         "node; expiry triggers lineage reconstruction or task failure."),
-    Flag("task_max_retries", "RAY_TPU_TASK_MAX_RETRIES", "int", 3,
-         "Default max_retries for @remote tasks when unspecified "
-         "(reference task_max_retries / TASK_MAX_RETRIES default)."),
-    Flag("actor_max_restarts", "RAY_TPU_ACTOR_MAX_RESTARTS", "int", 0,
-         "Default max_restarts for actors when unspecified (reference "
-         "actor restart semantics: 0 = never restart)."),
-    Flag("worker_start_timeout_s", "RAY_TPU_WORKER_START_TIMEOUT_S", "float", 60.0,
-         "How long the pool waits for a spawned worker's handshake "
-         "(reference worker_register_timeout_seconds)."),
-    Flag("metrics_report_interval_s", "RAY_TPU_METRICS_REPORT_INTERVAL_S", "float", 2.0,
-         "Worker metric-snapshot push period to the head "
-         "(reference metrics_report_interval_ms)."),
-    # -- multi-host control plane
-    Flag("agent_heartbeat_s", "RAY_TPU_AGENT_HEARTBEAT_S", "float", 2.0,
-         "Node-agent heartbeat period to the head."),
-    Flag("agent_batch_max", "RAY_TPU_AGENT_BATCH_MAX", "int", 128,
-         "Max frames coalesced into one gRPC agent-stream message (batching "
-         "packs only already-queued frames: zero added latency)."),
-    Flag("agent_queue_depth", "RAY_TPU_AGENT_QUEUE_DEPTH", "int", 4096,
-         "Outbound frame buffer per agent stream; a stalled peer exerts "
-         "backpressure once full instead of accumulating frames in RAM."),
-    Flag("agent_send_timeout_s", "RAY_TPU_AGENT_SEND_TIMEOUT_S", "float", 30.0,
-         "How long send() blocks on a backed-up agent stream before raising."),
-    Flag("tls_handshake_timeout_s", "RAY_TPU_TLS_HANDSHAKE_TIMEOUT_S", "float",
-         15.0, "Deferred server-side TLS handshake deadline per connection."),
-    Flag("collective_op_timeout_s", "RAY_TPU_COLLECTIVE_OP_TIMEOUT_S", "float",
-         30.0, "Host-plane collective op timeout (allreduce/broadcast/...); "
-         "barriers wait 2x this."),
-    Flag("collective_abort_poll_interval_s",
-         "RAY_TPU_COLLECTIVE_ABORT_POLL_INTERVAL_S", "float", 0.25,
-         "How often ring-path collective waits (stream reduce, gathers, tree "
-         "relays) probe the group coordinator's abort poison flag: a dead "
-         "rank costs survivors one interval, not collective_op_timeout_s."),
-    # -- transport security
-    Flag("use_tls", "RAY_TPU_USE_TLS", "bool", False,
-         "mTLS on the gRPC agent channel and the data/device-plane listeners; "
-         "plaintext peers are refused (reference tls_utils.py RAY_USE_TLS)."),
-    Flag("tls_ca", "RAY_TPU_TLS_CA", "str", None,
-         "CA certificate path (both trust root and client-auth verifier)."),
-    Flag("tls_cert", "RAY_TPU_TLS_CERT", "str", None,
-         "Cluster certificate path (`ray-tpu tls-init` mints one)."),
-    Flag("tls_key", "RAY_TPU_TLS_KEY", "str", None,
-         "Cluster private key path."),
-    Flag("container_runtime", "RAY_TPU_CONTAINER_RUNTIME", "str", None,
-         "Container launcher binary for container/image_uri runtime envs "
-         "(default: docker, then podman, from PATH). Point it at a recording "
-         "stub to test invocations without a real runtime."),
-    Flag("serve_ingress_tls", "RAY_TPU_SERVE_INGRESS_TLS", "bool", False,
-         "Serve the HTTP and gRPC ingress proxies over TLS using the cluster "
-         "certificate (server-side TLS: external clients verify against "
-         "ca.crt but need no client cert, unlike the inter-node mTLS planes)."),
-    Flag("pd_export_ttl_s", "RAY_TPU_PD_EXPORT_TTL_S", "float", 600.0,
-         "Device-plane auto-release backstop for P/D prefill KV exports whose "
-         "decode consumer crashed before acking."),
-    Flag("pd_export_max_live", "RAY_TPU_PD_EXPORT_MAX_LIVE", "int", 128,
-         "Max un-acked P/D KV exports a prefill engine pins before LRU "
-         "pruning (each pins device memory until the decode side pulls)."),
-    Flag("llm_engine_idle_wait_s", "RAY_TPU_LLM_ENGINE_IDLE_WAIT_S", "float",
-         0.05, "Engine scheduler-loop sleep when no slot is active (admission "
-         "latency floor for the first request of a burst)."),
-    Flag("moe_group_size", "RAY_TPU_MOE_GROUP_SIZE", "int", 4096,
-         "Tokens per MoE dispatch group: dispatch/combine tensors are "
-         "[group, experts, capacity], so memory is O(tokens x group)."),
-    Flag("serve_reconcile_interval_s", "RAY_TPU_SERVE_RECONCILE_INTERVAL_S",
-         "float", 0.2, "Serve controller reconciliation loop period (replica "
-         "create/kill, health checks, autoscale decisions)."),
-    # -- device plane (device-to-device tensor transfer between processes)
-    Flag("device_plane", "RAY_TPU_DEVICE_PLANE", "bool", True,
-         "Enable the PJRT transfer-server plane: jax.Arrays move between actor "
-         "processes device-to-device (DCN/ICI on pods) instead of "
-         "device->host->pickle (reference gpu_object_manager + NCCL channels)."),
-    Flag("device_objects", "RAY_TPU_DEVICE_OBJECTS", "str", "fetch",
-         "jax.Arrays in the object store: 'off' = host copy only; 'fetch' "
-         "(default) = host copy kept, consumers pull device-to-device when "
-         "possible; 'native' = stub only, device-resident at the producer "
-         "(reference gpu_object_manager semantics: loss -> reconstruction)."),
-    Flag("device_object_min_bytes", "RAY_TPU_DEVICE_OBJECT_MIN_BYTES", "int", 1 << 20,
-         "Device arrays below this size skip the transfer plane (control-message "
-         "inlining beats an arm round-trip for small tensors)."),
-    # -- data plane (direct node-to-node object transfer)
-    Flag("transfer_chunk_bytes", "RAY_TPU_TRANSFER_CHUNK_BYTES", "int", 4 * 1024 * 1024,
-         "Chunk size for direct node-to-node object transfers "
-         "(reference push_manager.h chunked push)."),
-    Flag("transfer_inflight_bytes", "RAY_TPU_TRANSFER_INFLIGHT_BYTES", "int",
-         256 * 1024 * 1024,
-         "Per-node byte budget for concurrent incoming object pulls "
-         "(reference pull_manager.h admission control)."),
-    Flag("transfer_max_pulls", "RAY_TPU_TRANSFER_MAX_PULLS", "int", 8,
-         "Max concurrent pulls a node issues (and streams it serves)."),
-    Flag("transfer_stripe_threshold_bytes",
-         "RAY_TPU_TRANSFER_STRIPE_THRESHOLD_BYTES", "int", 8 * 1024 * 1024,
-         "Objects at or above this size pull as concurrent byte-range stripes "
-         "over pooled connections (0 disables striping). All stripes of one "
-         "pull share a single admission grant."),
-    Flag("transfer_stripes", "RAY_TPU_TRANSFER_STRIPES", "int", 4,
-         "Max concurrent range streams per striped pull."),
-    Flag("transfer_stripe_min_bytes", "RAY_TPU_TRANSFER_STRIPE_MIN_BYTES",
-         "int", 2 * 1024 * 1024,
-         "Never split a pull so finely that a stripe falls below this many "
-         "bytes (each stripe pays a request/admission handshake)."),
-    Flag("transfer_same_host_map", "RAY_TPU_TRANSFER_SAME_HOST_MAP", "bool",
-         True,
-         "When the source's shm/arena/spill location is directly readable "
-         "from the pulling process (source shares this machine's /dev/shm — "
-         "colocated node processes), map it in place instead of copying the "
-         "bytes over loopback TCP (reference: one plasma store per node). "
-         "The striped wire path is for genuinely-remote peers."),
-    Flag("transfer_timeout_s", "RAY_TPU_TRANSFER_TIMEOUT_S", "float", 300.0,
-         "Deadline for one direct object transfer before head-relay fallback."),
-    Flag("transfer_stall_timeout_s", "RAY_TPU_TRANSFER_STALL_TIMEOUT_S", "float", 60.0,
-         "Per-socket-op stall bound on data-plane transfers (a half-dead peer "
-         "must not pin admission slots / puller threads forever)."),
-    Flag("collective_ring_threshold_bytes", "RAY_TPU_COLLECTIVE_RING_THRESHOLD_BYTES",
-         "int", 64 * 1024,
-         "SHM-collective payloads at or above this size move peer-to-peer over "
-         "the data plane (ring path, coordinator carries metadata only); "
-         "smaller payloads ride the coordinator board directly."),
-    Flag("collective_server_streams", "RAY_TPU_COLLECTIVE_SERVER_STREAMS", "int", 64,
-         "Concurrent serve streams on a rank's collective data-plane server. "
-         "Ring reads block until the local chunk is published, so this is "
-         "sized above transfer_max_pulls to keep blocked readers from "
-         "starving live ones."),
-    Flag("agent_heartbeat_timeout_s", "RAY_TPU_AGENT_HEARTBEAT_TIMEOUT_S", "float", 10.0,
-         "Head marks an agent dead after this long without a heartbeat "
-         "(reference gcs_health_check_manager.h)."),
-    Flag("agent_reconnect_timeout_s", "RAY_TPU_AGENT_RECONNECT_TIMEOUT_S", "float", 60.0,
-         "How long a node agent keeps its workers alive while redialing a "
-         "restarted head before giving up (reference: raylets buffering "
-         "through a GCS restart, NotifyGCSRestart)."),
-    # -- session / auth
-    Flag("session_dir", "RAY_TPU_SESSION_DIR", "str", "/tmp/ray_tpu_session",
-         "Session directory (head metadata, jobs, authkey, usage report)."),
-    Flag("client_authkey", "RAY_TPU_CLIENT_AUTHKEY", "str", None,
-         "Cluster authkey for remote drivers/agents (default: generated and "
-         "persisted in the session dir)."),
-    Flag("gcs_persistence_path", "RAY_TPU_GCS_PERSISTENCE_PATH", "str", None,
-         "Journal file for GCS KV persistence across restarts (default: off)."),
-    Flag("gcs_owner_check_every", "RAY_TPU_GCS_OWNER_CHECK_EVERY", "int", 32,
-         "URI-journal split-brain fencing: re-verify lease ownership every N "
-         "appends (lower = faster usurper detection, more object reads)."),
-    Flag("job_stop_grace_s", "RAY_TPU_JOB_STOP_GRACE_S", "float", 5.0,
-         "SIGTERM-to-SIGKILL grace when stopping a submitted job's process "
-         "group (reference: job stop_timeout)."),
-    Flag("dag_channel_buffer_bytes", "RAY_TPU_DAG_CHANNEL_BUFFER_BYTES", "int",
-         4 * 1024 * 1024,
-         "Default seqlock shm channel capacity for compiled DAGs "
-         "(experimental_compile buffer_size_bytes; reference "
-         "ChannelContext buffer sizing)."),
-    # -- ops (kernel tiling; trace-time reads, safe to tune per-run)
-    Flag("flash_block_q", "RAY_TPU_FLASH_BLOCK_Q", "int", 512,
-         "Pallas flash-attention query-tile rows (MXU-aligned multiple of 8; "
-         "512 saturates v5e at head_dim 64-128)."),
-    Flag("flash_block_kv", "RAY_TPU_FLASH_BLOCK_KV", "int", 512,
-         "Pallas flash-attention key/value-tile rows."),
-    Flag("chunked_attention_min_logits", "RAY_TPU_CHUNKED_ATTENTION_MIN_LOGITS",
-         "int", 1 << 20,
-         "Sq*Skv above which non-pallas attention switches to the chunked "
-         "online-softmax path (bounds the logits buffer on long context)."),
-    Flag("tqdm_render_interval_s", "RAY_TPU_TQDM_RENDER_INTERVAL_S", "float",
-         0.1, "Min seconds between driver-side tqdm_ray re-renders."),
-    # -- observability
-    Flag("tracing", "RAY_TPU_TRACING", "bool", False,
-         "Enable OpenTelemetry-style span recording AND the hot-path "
-         "telemetry event recorder (util/telemetry.py) at init."),
-    Flag("telemetry_ring_size", "RAY_TPU_TELEMETRY_RING_SIZE", "int", 8192,
-         "Per-process telemetry ring-buffer capacity (events). Overflow drops "
-         "the oldest events and logs a throttled warning at flush."),
-    Flag("metrics_scrape_interval_s", "RAY_TPU_METRICS_SCRAPE_INTERVAL_S",
-         "float", 5.0,
-         "Head-side metrics-history scrape period: the merged cross-worker "
-         "snapshot is sampled into a timestamped frame ring this often, "
-         "feeding windowed rates/quantiles and the SLO engine. 0 disables "
-         "the scraper."),
-    Flag("metrics_history_size", "RAY_TPU_METRICS_HISTORY_SIZE", "int", 360,
-         "Frames retained in the metrics-history ring (at the default 5 s "
-         "scrape interval, 360 frames = 30 min of windowed history)."),
-    Flag("usage_stats", "RAY_TPU_USAGE_STATS", "bool", False,
-         "Record a local-only feature-usage summary in the session dir "
-         "(never leaves the machine)."),
-    Flag("lp_debug", "RAY_TPU_LP_DEBUG", "bool", False,
-         "Verbose serve long-poll client logging."),
-    Flag("dashboard_port", "RAY_TPU_DASHBOARD_PORT", "int", 8265,
-         "Dashboard HTTP port (JSON API, /metrics exposition, web UI)."),
-    # -- autoscaler / provisioning
-    Flag("provision_max_attempts", "RAY_TPU_PROVISION_MAX_ATTEMPTS", "int", 4,
-         "Inline create_node attempts for rate-limit/transient cloud errors "
-         "before the failure escalates to the autoscaler backoff (reference "
-         "gcp node.py retry loops)."),
-    Flag("provision_backoff_s", "RAY_TPU_PROVISION_BACKOFF_S", "float", 2.0,
-         "Base for the jittered exponential inline-retry backoff in "
-         "create_node."),
-    Flag("launch_backoff_max_s", "RAY_TPU_LAUNCH_BACKOFF_MAX_S", "float", 600.0,
-         "Cap on the autoscaler's per-node-type launch backoff after "
-         "quota/stockout/permanent provision failures."),
-    # -- data (DataContext defaults; per-driver overrides via DataContext)
-    Flag("data_max_inflight_tasks_per_op", "RAY_TPU_DATA_MAX_INFLIGHT_TASKS_PER_OP",
-         "int", 8,
-         "Streaming-executor backpressure: tasks in flight per operator "
-         "(reference backpressure_policy concurrency caps)."),
-    Flag("data_actor_pool_max_size", "RAY_TPU_DATA_ACTOR_POOL_MAX_SIZE", "int", 4,
-         "Default actor-pool size for map_batches(Class) stages."),
-    Flag("data_read_op_min_num_blocks", "RAY_TPU_DATA_READ_OP_MIN_NUM_BLOCKS",
-         "int", 8,
-         "Default read parallelism when the datasource does not dictate one."),
-    Flag("data_target_max_block_size", "RAY_TPU_DATA_TARGET_MAX_BLOCK_SIZE",
-         "int", 128 * 1024 * 1024,
-         "Blocks above this split on output (reference target_max_block_size)."),
-    Flag("data_target_min_block_size", "RAY_TPU_DATA_TARGET_MIN_BLOCK_SIZE",
-         "int", 1 * 1024 * 1024,
-         "Coalesce blocks below this (reference target_min_block_size)."),
-    Flag("data_default_batch_size", "RAY_TPU_DATA_DEFAULT_BATCH_SIZE", "int", 1024,
-         "map_batches/iter_batches batch size when unspecified."),
-    Flag("data_op_output_buffer_limit", "RAY_TPU_DATA_OP_OUTPUT_BUFFER_LIMIT",
-         "int", 16,
-         "Streaming-executor per-operator output queue cap (backpressure)."),
-    Flag("data_push_based_shuffle", "RAY_TPU_DATA_PUSH_BASED_SHUFFLE", "bool", False,
-         "Staged-merge shuffle for large sorts (reference "
-         "push_based_shuffle_task_scheduler; RAY_DATA_PUSH_BASED_SHUFFLE)."),
-    Flag("data_push_shuffle_merge_factor", "RAY_TPU_DATA_PUSH_SHUFFLE_MERGE_FACTOR",
-         "int", 8,
-         "Map-round width for the push-based shuffle (fan-in bound)."),
-    # -- serve
-    Flag("serve_replica_wait_s", "RAY_TPU_SERVE_REPLICA_WAIT_S", "float", 30.0,
-         "How long a handle call waits for a live replica before failing "
-         "(reference handle resolution timeout)."),
-    Flag("serve_health_check_period_s", "RAY_TPU_SERVE_HEALTH_CHECK_PERIOD_S",
-         "float", 5.0,
-         "Default replica health-check period (per-deployment override in "
-         "DeploymentConfig; reference health_check_period_s)."),
-    Flag("serve_health_check_timeout_s", "RAY_TPU_SERVE_HEALTH_CHECK_TIMEOUT_S",
-         "float", 10.0,
-         "Default grace before an unresponsive replica is replaced "
-         "(reference health_check_timeout_s)."),
-    Flag("serve_max_ongoing_requests", "RAY_TPU_SERVE_MAX_ONGOING_REQUESTS",
-         "int", 8,
-         "Default per-replica concurrent-request cap "
-         "(reference max_ongoing_requests)."),
-    Flag("serve_max_queued_requests", "RAY_TPU_SERVE_MAX_QUEUED_REQUESTS",
-         "int", -1,
-         "Default per-deployment queue cap beyond replica capacity "
-         "(max_ongoing_requests x replicas): excess handle calls are shed "
-         "with BackPressureError / HTTP 503 + Retry-After instead of "
-         "queueing into latency collapse. -1 = unbounded (no shedding)."),
-    Flag("serve_request_retries", "RAY_TPU_SERVE_REQUEST_RETRIES", "int", 3,
-         "Max times a handle call is re-sent to a DIFFERENT replica after a "
-         "replica-death/unavailable failure (deployments with "
-         "retryable=False never retry). User-code exceptions never retry."),
-    Flag("serve_retry_backoff_s", "RAY_TPU_SERVE_RETRY_BACKOFF_S", "float",
-         0.05,
-         "Base of the jittered exponential backoff between serve request "
-         "retries (attempt N sleeps ~base*2^(N-1), capped)."),
-    Flag("serve_retry_backoff_max_s", "RAY_TPU_SERVE_RETRY_BACKOFF_MAX_S",
-         "float", 2.0,
-         "Cap on the serve request retry backoff."),
-    Flag("serve_suspect_ttl_s", "RAY_TPU_SERVE_SUSPECT_TTL_S", "float", 30.0,
-         "How long the handle router excludes a replica after a "
-         "replica-death classified failure (the suspect list bridges the gap "
-         "until the controller's health check removes it from the long-poll "
-         "view)."),
-    Flag("serve_drain_timeout_s", "RAY_TPU_SERVE_DRAIN_TIMEOUT_S", "float",
-         30.0,
-         "Default grace a DRAINING replica gets to finish in-flight requests "
-         "on scale-down/rolling update/shutdown before it is killed anyway "
-         "(per-deployment override: drain_timeout_s)."),
-    Flag("fault_injection", "RAY_TPU_FAULT_INJECTION", "str", None,
-         "Arm util/fault_injection.py fail points from the environment: "
-         "'site=mode[@p=0.5][@n=3][@delay=0.1][@seed=7][;site2=...]' with "
-         "mode error|delay|kill. Deterministic chaos for tests/drills; "
-         "unset = every fail point is a no-op."),
-    # -- llm engine defaults
-    Flag("llm_max_num_seqs", "RAY_TPU_LLM_MAX_NUM_SEQS", "int", 8,
-         "Default decode-slot count for LLMConfig (continuous batching width)."),
-    Flag("llm_max_model_len", "RAY_TPU_LLM_MAX_MODEL_LEN", "int", 1024,
-         "Default per-slot KV capacity for LLMConfig."),
-    Flag("llm_fused_steps", "RAY_TPU_LLM_FUSED_STEPS", "int", 0,
-         "Default fused decode burst width when LLMConfig.num_decode_steps is "
-         "unset: the engine runs this many decode+sample steps on device per "
-         "host sync. 0 = auto-tune from the measured host round trip vs the "
-         "measured device step time."),
-    Flag("llm_fused_steps_max", "RAY_TPU_LLM_FUSED_STEPS_MAX", "int", 32,
-         "Upper bound for the auto-tuned fused decode burst width (bounds "
-         "both K-token streaming granularity and the log2(K) compiled decode "
-         "program count)."),
-    Flag("llm_fused_sync_target", "RAY_TPU_LLM_FUSED_SYNC_TARGET", "float",
-         0.15,
-         "Auto-tune target for the host-sync share of a decode burst: K is "
-         "raised until host_round_trip/(host_round_trip + K*device_step) "
-         "drops to this fraction (subject to llm_fused_steps_max)."),
-    Flag("llm_prefix_min_hit_tokens", "RAY_TPU_LLM_PREFIX_MIN_HIT_TOKENS",
-         "int", 0,
-         "Prefix-cache pay-or-skip floor: a warm prefill only uses the cache "
-         "when the cached-token count reaches this. 0 = auto — skip when the "
-         "predicted compute saving (hit tokens x measured per-token prefill "
-         "time) is below the measured dispatch round trip."),
-    # -- train
-    Flag("train_v2_enabled", "RAY_TPU_TRAIN_V2_ENABLED", "bool", False,
-         "Route trainers through the v2 controller (FailurePolicy/"
-         "ScalingPolicy; reference RAY_TRAIN_V2_ENABLED)."),
-    Flag("train_restart_backoff_s", "RAY_TPU_TRAIN_RESTART_BACKOFF_S",
-         "float", 1.0,
-         "Base of the bounded exponential backoff between Train worker-group "
-         "restarts (failure N sleeps base*2^(N-1), capped). 0 disables."),
-    Flag("train_restart_backoff_max_s", "RAY_TPU_TRAIN_RESTART_BACKOFF_MAX_S",
-         "float", 30.0,
-         "Cap on the Train restart backoff."),
-    Flag("storage_path", "RAY_TPU_STORAGE_PATH", "str", None,
-         "Default experiment storage path (default: ~/ray_tpu_results)."),
+    Flag(k.attr, k.env, k.type, k.default, k.doc)
+    for k in KNOBS if k.attr is not None
 ]
 
 _BY_NAME: Dict[str, Flag] = {f.name: f for f in _FLAGS}

@@ -63,6 +63,7 @@ class TPUAcceleratorManager:
             try:
                 if jax.default_backend() == "tpu":
                     return len(jax.local_devices())
+            # graftlint: allow[swallowed-exception] TPU probe: any jax failure here means 'no TPUs visible'
             except Exception:
                 pass
         return 0

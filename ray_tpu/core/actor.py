@@ -134,6 +134,7 @@ class ActorHandle:
 
                 if global_state.try_worker() is not None:
                     global_state.enqueue_gc_action("kill_actor", self._actor_id)
+            # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
             except Exception:
                 pass
 

@@ -135,6 +135,7 @@ def shutdown() -> None:
     global_state.set_worker(None)
     try:
         atexit.unregister(shutdown)
+    # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
     except Exception:
         pass
 

@@ -473,6 +473,7 @@ class DevicePlane:
         finally:
             try:
                 conn.close()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
 
@@ -485,7 +486,8 @@ class DevicePlane:
         release=True acks the producer after a successful pull so it drops its
         pinned export immediately (single-consumer handoffs like P/D KV)."""
         if not self.available:
-            self.counters["fallbacks"] += 1
+            with self._lock:
+                self.counters["fallbacks"] += 1
             raise DevicePlaneError(self._disabled_reason or "device plane disabled")
         import jax
         import pickle
@@ -518,6 +520,7 @@ class DevicePlane:
             if release:
                 try:
                     self._control(handle, ("release", handle.key))
+                # graftlint: allow[swallowed-exception] callback isolation: a throwing subscriber must not break the caller
                 except Exception:
                     pass  # producer TTL-prunes as backstop
             treedef = pickle.loads(handle.treedef_pickle)
@@ -577,6 +580,7 @@ class DevicePlane:
         if release:
             try:
                 self._control(handle, ("release", handle.key))
+            # graftlint: allow[swallowed-exception] callback isolation: a throwing subscriber must not break the caller
             except Exception:
                 pass  # plane TTL-prunes as backstop
         arrays, pos = [], 0
@@ -606,6 +610,7 @@ class DevicePlane:
         finally:
             try:
                 conn.close()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
 

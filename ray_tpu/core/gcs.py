@@ -254,6 +254,7 @@ class PubSub:
         for cb in cbs:
             try:
                 cb(message)
+            # graftlint: allow[swallowed-exception] callback isolation: a throwing subscriber must not break the caller
             except Exception:
                 pass
 

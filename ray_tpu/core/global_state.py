@@ -39,6 +39,7 @@ def _drain_gc_actions() -> None:
                 w.kill_actor(ident, no_restart=True, from_gc=True)
             elif kind == "drop_stream":
                 w.drop_stream(*ident)
+        # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
         except Exception:
             pass
 

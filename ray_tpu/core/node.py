@@ -255,6 +255,7 @@ class _RemoteProc:
         self.dead = True
         try:
             self._agent.send(("kill_worker", self._wid_hex))
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
 
@@ -366,6 +367,7 @@ class RemoteNodeRuntime(NodeRuntime):
         try:
             self.agent.send(("spawn_worker", worker_id.hex(), accel,
                              dict(extra_env or {}), container))
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return None) by design
         except Exception:
             return None
         self.workers[worker_id] = w
@@ -504,6 +506,9 @@ class Cluster:
         self.head_node = self.add_node(resources, max_workers=max_workers_per_node)
         self._router_thread.start()
         self._maint_wakeup = threading.Event()
+        from ray_tpu.util.logutil import LogThrottle
+
+        self._maint_warn = LogThrottle(30.0)
         self._maint_thread = threading.Thread(
             target=self._maintenance_loop, daemon=True, name="rt-maintenance")
         self._maint_thread.start()
@@ -625,6 +630,7 @@ class Cluster:
                 "object_store_memory": self._object_store_capacity,
                 "default_runtime_env": self.default_runtime_env,
             })
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
         except Exception:
             return False
         with self._lock:
@@ -654,6 +660,7 @@ class Cluster:
                 self._worker_logs.pop(next(iter(self._worker_logs)))
         out = _sys.stdout if stream == "out" else _sys.stderr
         for line in lines:
+            # graftlint: allow[no-print] log fan-in contract: remote worker output mirrors verbatim onto the driver's own stdout/stderr
             print(f"({wid_hex[:8]}, node={agent.host_key[:8]}) {line}",
                   file=out)
 
@@ -688,6 +695,7 @@ class Cluster:
         for key in self.gcs.kv.keys(namespace="@actors"):
             try:
                 rec = cloudpickle.loads(self.gcs.kv.get(key, namespace="@actors"))
+            # graftlint: allow[swallowed-exception] corrupt/unreadable journal records are skipped; reattach rebinds the rest
             except Exception:
                 continue
             if rec.get("host") == node_hex:
@@ -706,6 +714,7 @@ class Cluster:
             st = self.actors.get(spec.actor_id)
             if st is None:
                 st = ActorState(spec.actor_id, spec, rec["method_meta"])
+                # graftlint: allow[lock-hygiene] REAL but deferred: reattach mutates the actor table outside self._lock; locking here risks lock-order inversion with gcs/ledger calls (see ROADMAP "head-restart reattach locking")
                 self.actors[spec.actor_id] = st
             st.state = "alive"
             st.worker = w
@@ -729,6 +738,7 @@ class Cluster:
                 self.store.incref(oid)
         try:
             stream.send_welcome_back({"keep_workers": keep})
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
         except Exception:
             return False
         with self._lock:
@@ -739,8 +749,13 @@ class Cluster:
             self._agents_by_key[node_hex] = agent
         self.gcs.register_node(NodeInfo(node_id=node_id, resources=dict(resources),
                                         labels={**(labels or {}), "agent": "remote"}))
-        print(f"[ray_tpu] node {node_hex[:8]} re-attached: {rebound} actors "
-              f"rebound, {len((extras or {}).get('objects', ()))} objects re-added")
+        import logging as _logging
+
+        # warning level: head-restart recovery must stay visible under the
+        # default (unconfigured) logging, like the print it replaced
+        _logging.getLogger("ray_tpu.node").warning(
+            "node %s re-attached: %d actors rebound, %d objects re-added",
+            node_hex[:8], rebound, len((extras or {}).get("objects", ())))
         self._schedule()
         return True
 
@@ -759,12 +774,14 @@ class Cluster:
                 "creation_spec": st.creation_spec,
             })
             self.gcs.kv.put(st.actor_id.binary(), rec, namespace="@actors")
+        # graftlint: allow[swallowed-exception] an unpicklable actor spec must not fail the creation; only head-restart rebind is lost
         except Exception:
             pass  # an unpicklable spec must not fail the creation itself
 
     def _unjournal_actor(self, st: ActorState) -> None:
         try:
             self.gcs.kv.delete(st.actor_id.binary(), namespace="@actors")
+        # graftlint: allow[swallowed-exception] journal delete is best-effort; stale records are skipped on restore
         except Exception:
             pass
 
@@ -856,6 +873,7 @@ class Cluster:
         if agent is not None:
             try:
                 agent.send(("free_object", loc[2]))
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass
 
@@ -1026,6 +1044,7 @@ class Cluster:
             self._conns[w.conn] = w
         try:
             self._wakeup_w.send_bytes(b"x")
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
 
@@ -1040,6 +1059,7 @@ class Cluster:
                 if conn is self._wakeup_r:
                     try:
                         self._wakeup_r.recv_bytes()
+                    # graftlint: allow[swallowed-exception] wakeup-pipe drain: a torn self-pipe only costs one extra poll
                     except Exception:
                         pass
                     continue
@@ -1109,6 +1129,7 @@ class Cluster:
                 if send_cancel:
                     try:
                         w.send(("cancel_stream", task_id))
+                    # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
                     except Exception:
                         pass
             self._schedule()  # tasks may be waiting on this item ref as an arg
@@ -1223,6 +1244,7 @@ class Cluster:
     def _reply(self, w: WorkerHandle, req_id: int, ok: bool, value) -> None:
         try:
             w.send(("reply", req_id, ok, value))
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
 
@@ -1241,7 +1263,8 @@ class Cluster:
                 self._unmark_blocked(w)
             self._reply(w, req_id, ok, value)
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name="node-actor-call").start()
 
     def _mark_blocked(self, w: WorkerHandle) -> None:
         with self._lock:
@@ -1737,13 +1760,14 @@ class Cluster:
                         self._journal_actor(st)
                         if st.kill_on_creation:
                             threading.Thread(
-                                target=self.kill_actor, args=(st.actor_id, True), daemon=True
+                                target=self.kill_actor, args=(st.actor_id, True), daemon=True,
+                                name="node-kill-on-creation",
                             ).start()
                     elif not retry:
                         st.state = "dead"
                         st.death_cause = RuntimeError(f"actor creation failed: {err_info[1]}")
                         self._unjournal_actor(st)
-                        self._drain_actor_queue(st)
+                        self._drain_actor_queue_locked(st)
                 # Actor worker stays busy/pinned; resources held for actor lifetime.
             elif spec is not None and spec.kind == "actor_method":
                 pass  # no per-method resources
@@ -1786,22 +1810,19 @@ class Cluster:
         while not self._shutdown:
             if self._maint_wakeup.wait(interval):
                 break  # shutdown
-            try:
-                self._check_spill()
-            except Exception:
-                pass
-            try:
-                self._check_memory_pressure()
-            except Exception:
-                pass
-            try:
-                self._check_agent_health()
-            except Exception:
-                pass
-            try:
-                self._check_stuck_starting()
-            except Exception:
-                pass
+            for check in (self._check_spill, self._check_memory_pressure,
+                          self._check_agent_health, self._check_stuck_starting):
+                try:
+                    check()
+                except Exception as e:
+                    # a monitor that silently stops firing means spilling/OOM
+                    # protection is off — one throttled line per 30s per check
+                    if self._maint_warn.ready(check.__name__):
+                        import logging as _logging
+
+                        _logging.getLogger("ray_tpu.node").warning(
+                            "maintenance check %s failed (suppressed 30s): %r",
+                            check.__name__, e)
 
     def _check_stuck_starting(self) -> None:
         """Kill workers that never complete the spawn handshake (reference
@@ -1815,6 +1836,7 @@ class Cluster:
         for w in stuck:
             try:
                 w.process.kill()  # death-cleanup path handles bookkeeping
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
 
@@ -1830,6 +1852,7 @@ class Cluster:
         for agent in stale:
             try:
                 agent.conn.close()  # ends the gRPC stream; reader fires death too
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
             self._on_agent_death(agent)
@@ -1918,6 +1941,7 @@ class Cluster:
                 if agent is not None:
                     try:
                         agent.send(("free_object", loc))
+                    # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
                     except Exception:
                         pass
 
@@ -1983,6 +2007,7 @@ class Cluster:
                 return True
             if kind == "disk":
                 return os.path.exists(loc[1])
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
         except Exception:
             return False
         return True  # inline is always alive
@@ -2002,6 +2027,7 @@ class Cluster:
             try:
                 w.send(("dump_stacks", token))
                 sent += 1
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass  # dead pipe: don't wait on a reply that can never come
         deadline = time.monotonic() + timeout_s
@@ -2033,6 +2059,7 @@ class Cluster:
             try:
                 w.send(("profile", token, duration_s, hz))
                 sent += 1
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass
         # the driver samples itself while the workers sample themselves
@@ -2068,6 +2095,7 @@ class Cluster:
             def gc_remote():
                 try:
                     agent.call("gc_dead_owners", keep, timeout=30.0)
+                # graftlint: allow[swallowed-exception] GC hint to a possibly-dead agent; its death reaps the owners anyway
                 except Exception:
                     pass
 
@@ -2080,12 +2108,13 @@ class Cluster:
         def gc():
             try:
                 arena.gc_dead_owners(keep)
+            # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
             except Exception:
                 pass
 
         threading.Thread(target=gc, daemon=True, name="arena-gc").start()
 
-    def _drain_actor_queue(self, st: ActorState) -> None:
+    def _drain_actor_queue_locked(self, st: ActorState) -> None:
         """Fail every pending method of a dead actor (caller holds the lock)."""
         remaining = deque()
         while self.pending:
@@ -2194,6 +2223,7 @@ class Cluster:
                     _tel.event("collective.abort", "collective", group=group,
                                epoch=epoch, failed_rank=rank,
                                reason=f"worker {w.worker_id.hex()[:8]} died")
+                # graftlint: allow[swallowed-exception] telemetry emission is best-effort and must never take the data path down
                 except Exception:
                     pass
             try:
@@ -2202,6 +2232,7 @@ class Cluster:
                 coord.abort.remote(
                     f"rank {rank} (worker {w.worker_id.hex()[:8]}) died: {err}",
                     rank, epoch)
+            # graftlint: allow[swallowed-exception] coordinator died with the worker: survivors still fail fast via ActorDiedError on poll
             except Exception:
                 # coordinator gone (it may have lived on this very worker):
                 # survivors still fail fast — their polls hit ActorDiedError,
@@ -2230,7 +2261,7 @@ class Cluster:
                 st.state = "dead"
                 st.death_cause = err
                 self._unjournal_actor(st)
-                self._drain_actor_queue(st)
+                self._drain_actor_queue_locked(st)
                 if st.name:
                     self.gcs.unregister_named_actor(st.name, st.namespace)
                 if spec.max_restarts != 0:
@@ -2269,6 +2300,7 @@ class Cluster:
         if w is not None:
             try:
                 w.send(("cancel_stream", task_id))
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass
         for i in range(start_index, count):
@@ -2294,6 +2326,7 @@ class Cluster:
             # Graceful: the exit message queues behind already-dispatched methods.
             try:
                 w.send(("exit",))
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass
         else:
@@ -2302,6 +2335,7 @@ class Cluster:
     def _kill_worker(self, w: WorkerHandle, err: Exception) -> None:
         try:
             w.process.terminate()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
         self._on_worker_death(w, err)
@@ -2360,12 +2394,14 @@ class Cluster:
         for a in agents:
             try:
                 a.send(("shutdown",))
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass
             a.fail_all_pending("cluster shutting down")
         if self._node_listener is not None:
             try:
                 self._node_listener.stop()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
         if self._data_server is not None:
@@ -2377,6 +2413,7 @@ class Cluster:
         for w in workers:
             try:
                 w.send(("exit",))
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass
         deadline = time.monotonic() + 2.0
@@ -2388,10 +2425,12 @@ class Cluster:
         for a in agents:
             try:
                 a.conn.close()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
         try:
             self._wakeup_w.send_bytes(b"x")
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
         self._router_thread.join(timeout=2.0)
@@ -2543,7 +2582,8 @@ class DriverContext:
             except Exception as e:  # noqa: BLE001
                 self.cluster.store.mark_failed(oid, e)
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name="node-remote-put").start()
         return ObjectRef(oid, owned=True)
 
     def create_placement_group(self, bundles, strategy, name):
@@ -2563,7 +2603,8 @@ class DriverContext:
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name="node-remote-get").start()
         return fut
 
     def runtime_context(self) -> Dict[str, Any]:

@@ -132,7 +132,10 @@ class NodeAgent:
 
                     prewarm(default_renv)
                 except Exception as e:
-                    print(f"[agent] runtime-env prewarm failed: {e}", flush=True)
+                    import logging
+
+                    logging.getLogger("ray_tpu.node_agent").warning(
+                        "runtime-env prewarm failed: %s", e)
 
             threading.Thread(target=_prewarm, daemon=True,
                              name="agent-renv-prewarm").start()
@@ -163,6 +166,7 @@ class NodeAgent:
             self._data_client.close()
             try:
                 self.conn.close()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
             from . import object_store
@@ -232,6 +236,7 @@ class NodeAgent:
     def _send_log(self, wid: str, stream: str, data: bytes) -> None:
         try:
             self._send(("worker_log", wid, stream, data.decode(errors="replace")))
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass  # head restart in progress: this chunk is lost
 
@@ -239,6 +244,7 @@ class NodeAgent:
         while not self._shutdown:
             try:
                 self._send(("heartbeat", time.time()))
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass  # head restart in progress: resume on the new connection
             time.sleep(CONFIG.agent_heartbeat_s)
@@ -255,6 +261,7 @@ class NodeAgent:
                 if c is self._wakeup_r:
                     try:
                         self._wakeup_r.recv_bytes()
+                    # graftlint: allow[swallowed-exception] peer closed mid-recv; the loop exits via its own stop flag
                     except Exception:
                         pass
                     continue
@@ -268,6 +275,7 @@ class NodeAgent:
                     continue
                 try:
                     self._send(("from_worker", wid, raw))
+                # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
                 except Exception:
                     pass  # head restart in flight: the recv loop reconnects
 
@@ -286,6 +294,7 @@ class NodeAgent:
                 self._shutdown = True  # reconnect window passed: workers die
                 try:
                     self._wakeup_w.send_bytes(b"x")
+                # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
                 except Exception:
                     pass
                 return
@@ -304,6 +313,7 @@ class NodeAgent:
         Returns False when agent_reconnect_timeout_s passes."""
         try:
             self.conn.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
         deadline = time.monotonic() + CONFIG.agent_reconnect_timeout_s
@@ -320,6 +330,7 @@ class NodeAgent:
                 conn = agent_rpc.HeadConnection(
                     host, port, self._authkey,
                     connect_timeout=min(5.0, delay * 4))
+            # graftlint: allow[swallowed-exception] redial loop: failures retry with backoff until the reconnect deadline
             except Exception:
                 if attempt % len(self._head_addresses) == 0:
                     time.sleep(min(delay, max(0.05, deadline - time.monotonic())))
@@ -329,9 +340,11 @@ class NodeAgent:
                 self._reregister(conn)
                 self._head_host, self._head_port = host, port
                 return True
+            # graftlint: allow[swallowed-exception] redial loop: failures retry with backoff until the reconnect deadline
             except Exception:
                 try:
                     conn.close()
+                # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
                 except Exception:
                     pass
                 time.sleep(delay)
@@ -371,6 +384,7 @@ class NodeAgent:
                 entry = self._workers.get(wid)
                 try:
                     entry[0].terminate()
+                # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
                 except Exception:
                     pass
 
@@ -396,6 +410,7 @@ class NodeAgent:
             if entry is not None:
                 try:
                     entry[0].terminate()
+                # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
                 except Exception:
                     pass
         elif kind == "req":
@@ -446,6 +461,7 @@ class NodeAgent:
             ok, value = False, e
         try:
             self._send(("reply", req_id, ok, value))
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
 
@@ -474,6 +490,7 @@ class NodeAgent:
         self._pipe_to_wid[parent_conn] = wid_hex
         try:
             self._wakeup_w.send_bytes(b"x")
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
 
@@ -505,6 +522,7 @@ class NodeAgent:
             self._pipe_to_wid[conn] = wid_hex
             try:
                 self._wakeup_w.send_bytes(b"x")
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass
 
@@ -530,10 +548,12 @@ class NodeAgent:
             self._pipe_to_wid.pop(entry[1], None)
             try:
                 entry[1].close()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
         try:
             self._send(("worker_death", wid_hex))
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
 
@@ -541,6 +561,7 @@ class NodeAgent:
         for entry in list(self._workers.values()):
             try:
                 entry[1].send_bytes(cloudpickle.dumps(("exit",)))
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
             except Exception:
                 pass
         deadline = time.monotonic() + 2.0

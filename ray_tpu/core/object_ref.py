@@ -55,6 +55,7 @@ class ObjectRef:
                 # decref is queued and applied by the gc-action drainer
                 if global_state.try_worker() is not None:
                     global_state.enqueue_gc_action("decref", self.id)
+            # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
             except Exception:
                 pass
 
@@ -136,6 +137,7 @@ class ObjectRefGenerator:
             if global_state.try_worker() is not None:
                 global_state.enqueue_gc_action(
                     "drop_stream", (self._task_id, self._i))
+        # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
         except Exception:
             pass
 
@@ -156,6 +158,7 @@ class ObjectRefGenerator:
             if global_state.try_worker() is not None:
                 global_state.enqueue_gc_action(
                     "drop_stream", (self._task_id, self._i))
+        # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
         except Exception:
             pass
 

@@ -53,6 +53,7 @@ def init_arena(capacity: int) -> Optional[str]:
         from ray_tpu._native.shm_store import Arena
 
         a = Arena.create(name, capacity)
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (_arena_disabled = True) by design
     except Exception:
         _arena_disabled = True
         return None
@@ -72,6 +73,7 @@ def destroy_arena() -> None:
         try:
             a.unlink()
             a.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
         os.environ.pop(_ARENA_ENV, None)
@@ -98,6 +100,7 @@ def _default_arena():
             return None
         try:
             _arena_default = _open_arena(name)
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (_arena_disabled = True) by design
         except Exception:
             _arena_disabled = True
     return _arena_default
@@ -358,6 +361,7 @@ class RawTarget:
         if self.kind == "arena":
             try:
                 self._arena.delete(self._oid_bytes)
+            # graftlint: allow[swallowed-exception] arena slot already deleted by a racing free/spill; refcount owns correctness
             except Exception:
                 pass
         elif self.kind == "shm":
@@ -365,10 +369,12 @@ class RawTarget:
                 self._seg.close()
             except BufferError:
                 _unclosable_segments.append(self._seg)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
             try:
                 shared_memory.SharedMemory(name=self._name).unlink()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
 
@@ -471,6 +477,7 @@ def free_local(loc: Location) -> None:
     if kind == "arena":
         try:
             _open_arena(loc[1]).delete(loc[2])
+        # graftlint: allow[swallowed-exception] remote-free of a location its node may have already dropped
         except Exception:
             pass
     elif kind == "shm":
@@ -480,6 +487,7 @@ def free_local(loc: Location) -> None:
             seg = shared_memory.SharedMemory(name=name)
             seg.close()
             seg.unlink()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
     elif kind == "disk":
@@ -516,6 +524,7 @@ class _SegmentCache:
                 seg.close()
             except BufferError:
                 _unclosable_segments.append(seg)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
 
@@ -754,6 +763,7 @@ class ObjectStore:
         if self.on_free is not None:
             try:
                 self.on_free(oid)
+            # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
             except Exception:
                 pass
         if loc is None:
@@ -762,6 +772,7 @@ class ObjectStore:
             if self.on_remote_free is not None:
                 try:
                     self.on_remote_free(loc)
+                # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
                 except Exception:
                     pass
         else:
@@ -782,6 +793,7 @@ class ObjectStore:
                 break
             try:
                 new_loc = spill_location(loc, spill_dir)
+            # graftlint: allow[swallowed-exception] callback isolation: a throwing subscriber must not break the caller
             except Exception:
                 continue  # skip unspillable objects, keep relieving pressure
             if new_loc is None:
@@ -802,6 +814,7 @@ class ObjectStore:
             if self.on_spill is not None:
                 try:
                     self.on_spill(oid, loc)
+                # graftlint: allow[swallowed-exception] callback isolation: a throwing subscriber must not break the caller
                 except Exception:
                     pass
             spilled += new_loc[2]

@@ -89,6 +89,7 @@ def _rough_size(a) -> int:
 
         if isinstance(a, np.ndarray):
             return a.nbytes
+    # graftlint: allow[swallowed-exception] size probe over arbitrary user objects; falls through to the next estimator
     except Exception:
         pass
     try:

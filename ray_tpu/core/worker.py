@@ -145,6 +145,7 @@ class WorkerContext:
                     try:
                         self._send(("stacks", msg[1], self.worker_id_hex,
                                     _format_thread_stacks()))
+                    # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
                     except Exception:
                         pass
                 elif kind == "profile":
@@ -157,6 +158,7 @@ class WorkerContext:
                         counts = _sample_collapsed_stacks(duration_s, hz)
                         try:
                             self._send(("stacks", token, self.worker_id_hex, counts))
+                        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
                         except Exception:
                             pass
 
@@ -225,12 +227,14 @@ class WorkerContext:
     def decref(self, oid: ObjectID) -> None:
         try:
             self._send(("decref", oid))
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
 
     def drop_stream(self, task_id: TaskID, start_index: int) -> None:
         try:
             self._send(("drop_stream", task_id, start_index))
+        # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
         except Exception:
             pass
 
@@ -308,7 +312,8 @@ class WorkerContext:
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name="worker-async-get").start()
         return fut
 
     def runtime_context(self) -> Dict[str, Any]:
@@ -432,6 +437,7 @@ class WorkerContext:
                 (oid, object_store.materialize(err, oid, is_error=True))
                 for oid in spec.return_ids
             ]
+        # graftlint: allow[swallowed-exception] the error object itself failed to pickle: re-report as a plain TaskError with the traceback text
         except Exception:
             # the exception object itself failed to serialize; report a plain failure
             err2 = TaskError(RuntimeError(f"unserializable error: {tb}"), spec.name)
@@ -586,6 +592,7 @@ class WorkerContext:
                     try:
                         asyncio.run_coroutine_threadsafe(
                             agen.aclose(), loop).result(timeout=10)
+                    # graftlint: allow[swallowed-exception] async-generator close during cancellation: the loop may already be gone
                     except Exception:
                         pass
 
@@ -611,6 +618,7 @@ class WorkerContext:
             if close is not None:
                 try:
                     close()
+                # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
                 except Exception:
                     pass
             self._cancelled_streams.discard(spec.task_id)
@@ -659,6 +667,7 @@ def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dic
                 os.dup2(f.fileno(), fd)
             sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
             sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
     if accel == "cpu":
@@ -674,11 +683,11 @@ def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dic
 
                 jax.config.update("jax_platforms", "cpu")
             except Exception as e:  # noqa: BLE001
-                print(
-                    f"[ray_tpu worker] WARNING: failed to force cpu platform on "
-                    f"pre-imported jax ({e!r}); this cpu worker may grab the TPU",
-                    file=sys.stderr,
-                )
+                import logging
+
+                logging.getLogger("ray_tpu.worker").warning(
+                    "failed to force cpu platform on pre-imported jax (%r); "
+                    "this cpu worker may grab the TPU", e)
     ctx = WorkerContext(conn, node_id_hex, worker_id_hex, accel)
     global_state.set_worker(ctx)
     try:
@@ -688,6 +697,7 @@ def worker_main(conn, node_id_hex: str, worker_id_hex: str, accel: str, env: Dic
     finally:
         try:
             conn.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
         sys.exit(0)

@@ -103,6 +103,7 @@ class ShmChannel:
             seg = shared_memory.SharedMemory(name=self.name)
             seg.unlink()
             seg.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
 

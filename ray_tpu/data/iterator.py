@@ -65,10 +65,12 @@ class DataIterator:
                 if close is not None:
                     try:
                         close()
+                    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
                     except Exception:
                         pass
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="data-iter-producer")
         t.start()
         try:
             while True:

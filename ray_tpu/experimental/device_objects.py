@@ -36,6 +36,7 @@ def is_device_array(obj: Any) -> bool:
         return False
     try:
         return isinstance(obj, jax.Array)
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
     except Exception:
         return False
 
@@ -58,6 +59,7 @@ def lookup(oid_bytes: Optional[bytes]) -> Optional[Any]:
     try:
         if hit.is_deleted():
             return None
+    # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
     except Exception:
         pass
     return hit
@@ -132,6 +134,7 @@ def _rebuild_fetch(handle, host_np):
 
     try:
         return device_plane.plane().fetch(handle)
+    # graftlint: allow[swallowed-exception] device-put fallback: handler re-puts the host copy instead
     except Exception:
         import jax
 

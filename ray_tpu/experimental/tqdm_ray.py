@@ -75,6 +75,7 @@ class tqdm:  # noqa: N801 - reference exports the lowercase name
             try:  # worker: relay to the driver over its one-way channel
                 w.push_tqdm(self._state())
                 return
+            # graftlint: allow[swallowed-exception] progress-bar forwarding is cosmetic; the worker must not die for it
             except Exception:
                 pass
         _render_local(self._state())

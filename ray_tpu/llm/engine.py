@@ -31,12 +31,30 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.util import telemetry
+from ray_tpu.util.hot_path import hot_path
 
 from .config import LLMConfig, SamplingParams
 from . import model_runner
 from .tokenizer import get_tokenizer
 
 LOGGER = logging.getLogger(__name__)
+
+_METRICS_WARN = None
+
+
+def _metrics_guard_warn(where: str, e: BaseException) -> None:
+    """Metrics must never take the engine down — but a broken exporter must
+    not be INVISIBLE either (the PR 8 stale-registry bug hid behind exactly
+    this pattern). One warning per 30s per call site, so one failing
+    exporter does not mute the others' first report."""
+    global _METRICS_WARN
+    if _METRICS_WARN is None:
+        from ray_tpu.util.logutil import LogThrottle
+
+        _METRICS_WARN = LogThrottle(30.0)
+    if _METRICS_WARN.ready(where):
+        LOGGER.warning("engine telemetry export failed in %s (suppressed for "
+                       "30s): %r", where, e)
 
 
 @dataclasses.dataclass
@@ -105,6 +123,7 @@ class _Request:
             from ray_tpu.util.tracing import current_trace_id
 
             self.trace_id = current_trace_id()
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (self.trace_id = None) by design
         except Exception:
             self.trace_id = None
 
@@ -306,6 +325,7 @@ class JaxLLMEngine(LLMEngine):
                         functools.partial(model_runner.spec_verify_step_pp,
                                           cfg=cfg, mesh=self._mesh),
                         donate_argnames=("state",))
+            # graftlint: allow[lock-hygiene] one-time init under _start_lock, before any _next_rng caller exists; steady-state splits hold _rng_lock
             self._rng = jax.random.PRNGKey(0)
             # host mirrors of per-slot sampling params
             n = c.max_num_seqs
@@ -360,8 +380,12 @@ class JaxLLMEngine(LLMEngine):
                 np.asarray(x + 1)
                 best = min(best, time.perf_counter() - t0)
             self._host_rt_s = max(best, 1e-7)
-        except Exception:
+        except Exception as e:
             self._host_rt_s = 0.0  # unmeasured: auto-K stays at 1, gate open
+            LOGGER.warning(
+                "host round-trip measurement failed (%r): fused-decode "
+                "auto-K is disabled, the engine runs per-step synced — "
+                "expect tunnel-era decode throughput", e)
 
     def decode_steps_target(self) -> int:
         """Current fused burst width target (power of two). Fixed K when
@@ -725,8 +749,8 @@ class JaxLLMEngine(LLMEngine):
                     g = Gauge(f"llm_{name}", f"engine {name}", tag_keys=("model",))
                     _PROM_GAUGES[name] = g
                 g.set(float(value), tags=tags)
-        except Exception:
-            pass  # metrics must never take the engine down
+        except Exception as e:
+            _metrics_guard_warn("_export_metrics", e)
 
     # -- request-lifecycle telemetry ----------------------------------------------
     def _model_tag(self) -> Dict[str, str]:
@@ -751,8 +775,8 @@ class JaxLLMEngine(LLMEngine):
         and metrics must never take the engine down."""
         try:
             self._record_prefill_inner(req, t_admit_perf)
-        except Exception:
-            pass
+        except Exception as e:
+            _metrics_guard_warn("_record_prefill", e)
 
     def _record_prefill_inner(self, req: _Request, t_admit_perf: int) -> None:
         dur = time.perf_counter_ns() - t_admit_perf
@@ -791,8 +815,8 @@ class JaxLLMEngine(LLMEngine):
             telemetry.get_histogram(
                 "llm_ttft_seconds", "engine time-to-first-token",
                 tag_keys=("model",)).observe(ttft_s, tags=self._model_tag())
-        except Exception:
-            pass  # metrics must never take the engine down
+        except Exception as e:
+            _metrics_guard_warn("_record_first_token", e)
 
     def _record_finish(self, req: _Request) -> None:
         if req.first_token_perf_ns == 0 or req.finish_recorded:
@@ -800,8 +824,8 @@ class JaxLLMEngine(LLMEngine):
         req.finish_recorded = True
         try:
             self._record_finish_inner(req)
-        except Exception:
-            pass  # metrics must never take the engine down
+        except Exception as e:
+            _metrics_guard_warn("_record_finish", e)
 
     def _record_finish_inner(self, req: _Request) -> None:
         now = time.perf_counter_ns()
@@ -1138,6 +1162,7 @@ class JaxLLMEngine(LLMEngine):
             # host mirror of state.lengths (== prompt + generated - 1, the next
             # write position): saves a device fetch per decode step
             next_write = len(req.prompt_ids) + req.generated - 1
+            # graftlint: allow[host-sync-in-hot-path] steps is the host-side burst plan (numpy), not a device array
             slot_headroom = (min(headroom, int(steps[slot]))
                              if steps is not None else headroom)
             # re-check liveness each round: an earlier iteration (or this one)
@@ -1215,6 +1240,7 @@ class JaxLLMEngine(LLMEngine):
         ctx = req.token_history  # prompt + every generated token
         if len(ctx) < 2:
             return []
+        # graftlint: allow[host-sync-in-hot-path] ngram proposal runs on the host token history (python lists)
         arr = np.asarray(ctx, dtype=np.int32)
         total = len(arr)
         for n in range(min(self.config.ngram_prompt_lookup_max, total - 1), 0, -1):
@@ -1227,6 +1253,7 @@ class JaxLLMEngine(LLMEngine):
                 m &= arr[j:total - n + j] == tail[j]
             hits = np.flatnonzero(m)
             if hits.size:
+                # graftlint: allow[host-sync-in-hot-path] hits is a host numpy array from np.where
                 start = int(hits[-1])
                 cont = ctx[start + n:start + n + k]
                 if cont:
@@ -1252,6 +1279,7 @@ class JaxLLMEngine(LLMEngine):
             m = min(m, max(1, min(kv_room, budget) // wlen))
         return _pow2_floor(m)
 
+    @hot_path
     def _step_decode_spec_fused(self, m: int) -> None:
         """m speculative windows fused per host sync (spec + multi-step
         composed): the n-gram proposal runs ON DEVICE against a per-slot
@@ -1293,6 +1321,7 @@ class JaxLLMEngine(LLMEngine):
                 jnp.asarray(active_mask), cfg, rngs,
                 jnp.asarray(self._temp), jnp.asarray(self._top_p),
                 jnp.asarray(self._top_k), m, k, c.ngram_prompt_lookup_max)
+        # graftlint: allow[host-sync-in-hot-path] the ONE designed fetch per fused spec window (PR 12 contract)
         toks_m, acc_m, drafted_m = jax.device_get((toks_m, acc_m, drafted_m))
         dur_ns = time.perf_counter_ns() - t0_perf
         # keep the auto-K probe live in fused-spec mode too (per-WINDOW cost,
@@ -1304,7 +1333,9 @@ class JaxLLMEngine(LLMEngine):
         for step in range(m):
             for slot, req in burst_reqs.items():
                 self._emit_spec_window(
+                    # graftlint: allow[host-sync-in-hot-path] acc_m/toks_m already fetched by this window's device_get
                     slot, req, toks_m[step, slot], int(acc_m[step, slot]),
+                    # graftlint: allow[host-sync-in-hot-path] drafted_m already fetched by this window's device_get
                     int(drafted_m[step, slot]))
         self._record_burst(m, self.total_generated - before,
                            int(active_mask.sum()), t0_wall, dur_ns)
@@ -1324,6 +1355,7 @@ class JaxLLMEngine(LLMEngine):
         for t in range(acc + 1):
             if self._active.get(slot) is not req:
                 break
+            # graftlint: allow[host-sync-in-hot-path] toks_row is the already-fetched numpy burst row
             tok = int(toks_row[t])
             self._last_tokens[slot] = tok
             self._emit(req, tok)
@@ -1338,6 +1370,7 @@ class JaxLLMEngine(LLMEngine):
                 ))
                 self._release(r2)
 
+    @hot_path
     def _step_decode_spec(self) -> None:
         """Speculative decode step: host proposes drafts by n-gram lookup, ONE
         verify forward scores the whole window, accepted prefix + bonus token
@@ -1400,6 +1433,7 @@ class JaxLLMEngine(LLMEngine):
                 jnp.asarray(draft_len), jnp.asarray(active_mask), cfg,
                 self._next_rng(), jnp.asarray(self._temp),
                 jnp.asarray(self._top_p), jnp.asarray(self._top_k))
+        # graftlint: allow[host-sync-in-hot-path] the ONE designed fetch per spec-decode step
         out_toks, n_acc = jax.device_get((out_toks, n_acc))
         dur_ns = time.perf_counter_ns() - t0_perf
         # the verify forward is close enough to a decode step to feed the
@@ -1410,10 +1444,13 @@ class JaxLLMEngine(LLMEngine):
         burst_reqs = {s: r for s, r in self._active.items() if r is not None}
         for slot, req in burst_reqs.items():
             self._emit_spec_window(slot, req, out_toks[slot],
+                                   # graftlint: allow[host-sync-in-hot-path] n_acc/draft_len already fetched by this step's device_get
                                    int(n_acc[slot]), int(draft_len[slot]))
         self._record_burst(1, self.total_generated - before,
+                           # graftlint: allow[host-sync-in-hot-path] active_mask is a host-side bool array
                            int(np.asarray(active_mask).sum()), t0_wall, dur_ns)
 
+    @hot_path
     def _step_decode(self) -> None:
         cfg = self.model_config
         if self.config.num_speculative_tokens:
@@ -1448,6 +1485,7 @@ class JaxLLMEngine(LLMEngine):
                     jnp.asarray(active_mask), cfg, rngs,
                     jnp.asarray(self._temp), jnp.asarray(self._top_p),
                     jnp.asarray(self._top_k), steps_dev)
+            # graftlint: allow[host-sync-in-hot-path] the ONE designed host sync per K-step fused burst (PR 12)
             toks_burst = np.asarray(toks_k)  # [K, slots] — the only fetch
         else:
             if self.config.kv_layout == "paged":
@@ -1465,6 +1503,7 @@ class JaxLLMEngine(LLMEngine):
                     self.params, self.state, jnp.asarray(self._last_tokens),
                     jnp.asarray(active_mask), cfg,
                 )
+            # graftlint: allow[host-sync-in-hot-path] the designed per-step token fetch on the K=1 path
             toks_burst = np.asarray(model_runner.sample_tokens(
                 self._next_rng(), logits, jnp.asarray(self._temp),
                 jnp.asarray(self._top_p), jnp.asarray(self._top_k)))[None, :]
@@ -1480,6 +1519,7 @@ class JaxLLMEngine(LLMEngine):
                     continue  # finished (or aborted) earlier in this burst
                 if self._aborted and self._finish_abort(req):
                     continue  # cancelled mid-burst: tail discarded, blocks freed
+                # graftlint: allow[host-sync-in-hot-path] toks_burst is the already-fetched numpy burst
                 tok = int(toks_burst[t, slot])
                 self._last_tokens[slot] = tok
                 self._emit(req, tok)
@@ -1514,6 +1554,7 @@ class JaxLLMEngine(LLMEngine):
                 telemetry.get_counter(
                     "llm_generated_tokens_total",
                     "tokens emitted by the engine (all requests)",
+                    # graftlint: allow[host-sync-in-hot-path] emitted is a python int; metric emission is host-side
                     tag_keys=("model",)).inc(float(emitted), tags=tags)
                 if dur_ns > 0:
                     telemetry.get_histogram(
@@ -1528,9 +1569,10 @@ class JaxLLMEngine(LLMEngine):
                     "llm.decode_burst", "llm", t0_wall_ns, dur_ns, k=k,
                     tokens=emitted, slots=n_slots,
                     model=str(self.config.model_id))
-        except Exception:
-            pass  # metrics must never take the engine down
+        except Exception as e:
+            _metrics_guard_warn("_record_burst", e)
 
+    @hot_path
     def _loop(self) -> None:
         import time as _time
 

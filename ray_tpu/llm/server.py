@@ -8,12 +8,15 @@ instead of vLLM.
 """
 from __future__ import annotations
 
+import logging
 import time
 import uuid
 from typing import Any, Dict, List, Optional
 
 from .config import LLMConfig, SamplingParams
 from .engine import JaxLLMEngine
+
+_LOGGER = logging.getLogger(__name__)
 
 
 def _sampling_from_body(body: Dict[str, Any]) -> SamplingParams:
@@ -331,8 +334,11 @@ class PDRouter:
             try:
                 self.prefill_handle.options(method_name="release_prefill").remote(
                     pre["kv_key"])
-            except Exception:
-                pass
+            except Exception as e:
+                _LOGGER.warning(
+                    "could not release prefill KV export %s after host "
+                    "fallback (%r); the prefill engine pins it until the "
+                    "TTL backstop", pre.get("kv_key"), e)
             body = dict(body)
             body["_kv_host_fallback"] = True
             pre = self.prefill_handle.options(method_name="prefill").remote(

@@ -22,6 +22,10 @@ from ..core.rl_module import Columns
 from .algorithm import Algorithm
 from .algorithm_config import AlgorithmConfig
 
+import logging
+
+logger = logging.getLogger("ray_tpu.rllib.impala")
+
 
 class IMPALAConfig(AlgorithmConfig):
     def __init__(self, algo_class: type = None):
@@ -264,7 +268,9 @@ class IMPALA(Algorithm):
                 idx = self._inflight.pop(ref)
                 try:
                     eps = ray_tpu.get(ref)
-                except Exception:
+                except Exception as e:
+                    logger.warning("env runner %d failed a rollout (%r); "
+                                   "restarting it", idx, e)
                     group.restart_runner(idx)
                     self._issue(idx)
                     continue
@@ -291,12 +297,14 @@ class IMPALA(Algorithm):
         for ref in list(self._inflight):
             try:
                 ray_tpu.cancel(ref)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
         self._inflight.clear()
         for a in self._aggregators:
             try:
                 ray_tpu.kill(a)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
         super().cleanup()

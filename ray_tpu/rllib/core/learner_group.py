@@ -73,5 +73,6 @@ class LearnerGroup:
         for l in self.learners:
             try:
                 ray_tpu.kill(l)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass

@@ -122,5 +122,6 @@ class SingleAgentEnvRunner:
     def stop(self) -> None:
         try:
             self.env.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass

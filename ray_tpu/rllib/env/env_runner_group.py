@@ -13,6 +13,10 @@ import ray_tpu
 
 from .env_runner import SingleAgentEnvRunner
 
+import logging
+
+logger = logging.getLogger("ray_tpu.rllib.env_runner_group")
+
 
 class EnvRunnerGroup:
     def __init__(self, config: "AlgorithmConfig", runner_cls: type = None):  # noqa: F821
@@ -36,7 +40,9 @@ class EnvRunnerGroup:
         for i, ref in enumerate(refs):
             try:
                 res = ray_tpu.get(ref)
-            except Exception:
+            except Exception as e:
+                logger.warning("env runner %d died mid-sample (%r); "
+                               "restarting it", i, e)
                 self.restart_runner(i)
                 continue
             if isinstance(res, dict):
@@ -64,6 +70,7 @@ class EnvRunnerGroup:
         for r in self.runners:
             try:
                 out.append(ray_tpu.get(r.get_metrics.remote()))
+            # graftlint: allow[swallowed-exception] metrics from a dead runner are skipped; sampling restarts it elsewhere
             except Exception:
                 pass
         return out
@@ -73,5 +80,6 @@ class EnvRunnerGroup:
             try:
                 ray_tpu.get(r.stop.remote())
                 ray_tpu.kill(r)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass

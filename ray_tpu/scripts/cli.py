@@ -318,6 +318,22 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """`ray-tpu lint [paths] [--write-docs]`: graftlint, the project-invariant
+    static analyzer (ray_tpu/tools/analysis). Pure AST — no jax, no cluster.
+    `--write-docs` regenerates the README knob tables from ray_tpu/knobs.py."""
+    from ray_tpu.tools.analysis.runner import main as lint_main
+
+    forwarded = list(args.lint_args)
+    if args.write_docs:
+        forwarded.append("--write-docs")
+    if args.json:
+        forwarded.append("--json")
+    if args.show_allowed:
+        forwarded.append("--show-allowed")
+    return lint_main(forwarded)
+
+
 def cmd_submit(args) -> int:
     mgr = JobManager()
     entry = " ".join([sys.executable, args.script] + args.script_args)
@@ -611,6 +627,18 @@ def main(argv=None) -> int:
     sp.add_argument("--json", action="store_true",
                     help="print the raw state.request_trace document")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("lint", help="graftlint: AST project-invariant "
+                        "analysis (swallowed errors, hot-path host syncs, "
+                        "blocking control paths, knob registry, thread "
+                        "hygiene, no-print)")
+    sp.add_argument("lint_args", nargs="*", metavar="path",
+                    help="subdirs/files to lint (default: ray_tpu)")
+    sp.add_argument("--write-docs", action="store_true",
+                    help="regenerate README knob tables from ray_tpu/knobs.py")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--show-allowed", action="store_true")
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("submit", help="run a python script as a job")
     sp.add_argument("script")

@@ -161,11 +161,13 @@ def shutdown() -> None:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.shutdown.remote())
         ray_tpu.kill(controller)
+    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
     except Exception:
         pass
     try:
         proxy = ray_tpu.get_actor(_PROXY_NAME)
         ray_tpu.kill(proxy)
+    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
     except Exception:
         pass
     try:
@@ -174,6 +176,7 @@ def shutdown() -> None:
         gproxy = ray_tpu.get_actor(_GRPC_PROXY_NAME)
         ray_tpu.get(gproxy.stop.remote())
         ray_tpu.kill(gproxy)
+    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
     except Exception:
         pass
     _reset_long_poll()  # watches reference the controller we just killed

@@ -19,7 +19,8 @@ class _Batcher:
         self.max_batch_size = max_batch_size
         self.timeout_s = timeout_s
         self.q: _queue.Queue = _queue.Queue()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-batch-loop")
         self._thread.start()
 
     def submit(self, instance, item) -> Future:

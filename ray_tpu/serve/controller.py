@@ -7,6 +7,7 @@ replica health checks, rolling updates on version change, request-rate autoscali
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -22,6 +23,8 @@ DRAINING = "DRAINING"
 
 
 import itertools as _it
+
+logger = logging.getLogger("ray_tpu.serve.controller")
 
 _replica_uid = _it.count(1)
 
@@ -71,6 +74,10 @@ class ServeController:
         self.apps: Dict[str, Dict[str, Any]] = {}  # app -> {route_prefix, ingress, deployments}
         self._lock = threading.RLock()
         self._shutdown = False
+        # reconcile-loop warning throttle (the loop runs several times/s)
+        from ray_tpu.util.logutil import LogThrottle
+
+        self._loop_warn = LogThrottle(30.0)
         # long-poll host state (reference _private/long_poll.py LongPollHost):
         # versioned keys; listeners block until a key they watch moves
         self._lp_versions: Dict[str, int] = {}
@@ -81,9 +88,11 @@ class ServeController:
         # survives full cluster restarts)
         try:
             self._restore_from_kv()
-        except Exception:
-            pass
-        self._reconcile_thread = threading.Thread(target=self._reconcile_loop, daemon=True)
+        except Exception as e:
+            logger.warning("serve state restore from KV failed (%r): "
+                           "starting with no applications", e)
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile")
         self._reconcile_thread.start()
 
     # -- target-state checkpointing (reference: GCS KV-backed serve state) -------
@@ -118,8 +127,12 @@ class ServeController:
                 self.deploy_application(key[len(b"app::"):].decode(),
                                         spec["route_prefix"], spec["deployments"],
                                         _checkpoint=False)
-            except Exception:
-                continue  # a stale/unloadable app must not block the rest
+            except Exception as e:
+                # a stale/unloadable app must not block the rest
+                logger.warning("could not restore serve app %r from its "
+                               "checkpoint (%r); skipping it",
+                               key[len(b"app::"):].decode(), e)
+                continue
 
     # -- deploy API ------------------------------------------------------------
     def deploy_application(self, app_name: str, route_prefix: str,
@@ -131,6 +144,7 @@ class ServeController:
             if _checkpoint:
                 try:
                     self._checkpoint_app(app_name, route_prefix, deployments)
+                # graftlint: allow[swallowed-exception] checkpointing is best-effort; serving must not depend on it
                 except Exception:
                     pass  # checkpointing is best-effort; serving must not depend on it
             self.apps[app_name] = {
@@ -169,6 +183,7 @@ class ServeController:
         with self._lock:
             try:
                 self._drop_checkpoint(app_name)
+            # graftlint: allow[swallowed-exception] checkpoint drop is best-effort; stale blobs are skipped on restore
             except Exception:
                 pass
             app = self.apps.pop(app_name, None)
@@ -197,6 +212,7 @@ class ServeController:
         # state (drain_ref and the kill below run without the lock held)
         try:
             self._reconcile_thread.join(timeout=10)
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
         with self._lock:
@@ -218,12 +234,14 @@ class ServeController:
                     continue
                 try:
                     polls.append((r, ds, r.drain_ref or r.actor.num_inflight.remote()))
+                # graftlint: allow[swallowed-exception] an unusable handle means the replica is gone: it is reaped right here
                 except Exception:
                     self._stop_replica(r)  # handle already unusable
             for r, ds, ref in polls:
                 r.drain_ref = None
                 try:
                     n = ray_tpu.get(ref, timeout=2.0)
+                # graftlint: allow[swallowed-exception] degrades to the coded fallback (n = 0) by design
                 except Exception:
                     n = 0  # replica already gone: nothing left to drain
                 if n == 0:
@@ -249,6 +267,7 @@ class ServeController:
         r.health_ref = None
         try:
             r.drain_ref = r.actor.drain.remote()
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (r.drain_ref = None) by design
         except Exception:
             r.drain_ref = None  # dead already; reconcile reaps it
 
@@ -355,6 +374,7 @@ class ServeController:
             from ray_tpu.util.state import list_nodes
 
             nodes = [n for n in list_nodes() if n["alive"]]
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return None) by design
         except Exception:
             return None
         if len(nodes) <= 1:
@@ -414,6 +434,7 @@ class ServeController:
         try:
             r.actor.prepare_shutdown.remote()
             ray_tpu.kill(r.actor, no_restart=True)
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
 
@@ -451,7 +472,10 @@ class ServeController:
                                 ray_tpu.get(r.health_ref)
                                 r.state = RUNNING
                                 r.last_health_ok = now
-                            except Exception:
+                            except Exception as e:
+                                logger.warning(
+                                    "%s replica #%s failed its startup health "
+                                    "check (%r); replacing it", ds.name, r.uid, e)
                                 r.state = STOPPING
                             r.health_ref = None
                 # periodic health checks on RUNNING replicas
@@ -465,7 +489,10 @@ class ServeController:
                             try:
                                 ray_tpu.get(r.health_ref)
                                 r.last_health_ok = now
-                            except Exception:
+                            except Exception as e:
+                                logger.warning(
+                                    "%s replica #%s failed its health check "
+                                    "(%r); replacing it", ds.name, r.uid, e)
                                 r.state = STOPPING
                             r.health_ref = None
                         elif now - r.last_health_ok > period + ds.info["config"].health_check_timeout_s:
@@ -476,6 +503,7 @@ class ServeController:
                     if r.drain_ref is None:
                         try:
                             r.drain_ref = r.actor.num_inflight.remote()
+                        # graftlint: allow[swallowed-exception] degrades to the coded fallback (r.state = STOPPING) by design
                         except Exception:
                             r.state = STOPPING  # handle unusable: reap now
                             continue
@@ -483,6 +511,7 @@ class ServeController:
                     if done:
                         try:
                             n = ray_tpu.get(r.drain_ref)
+                        # graftlint: allow[swallowed-exception] degrades to the coded fallback (n = 0) by design
                         except Exception:
                             n = 0  # replica died mid-drain: nothing left to wait on
                         r.drain_ref = None
@@ -518,14 +547,18 @@ class ServeController:
         while not self._shutdown:
             try:
                 self._reconcile_once()
-            except Exception:
-                pass
+            except Exception as e:
+                if self._loop_warn.ready("reconcile"):
+                    logger.warning("serve reconcile pass failed (suppressed "
+                                   "for 30s): %r", e)
             try:
                 # never skipped: a throwing reconcile pass (e.g. one poisoned
                 # deployment) must not silence membership publishing for the rest
                 self._publish_changes()
-            except Exception:
-                pass
+            except Exception as e:
+                if self._loop_warn.ready("publish"):
+                    logger.warning("serve long-poll publish failed "
+                                   "(suppressed for 30s): %r", e)
             from ray_tpu.config import CONFIG as _CFG
 
             time.sleep(_CFG.serve_reconcile_interval_s)

@@ -85,6 +85,7 @@ class GrpcProxyActor:
             try:
                 context.set_trailing_metadata(
                     (("retry-after", str(max(1, int(e.retry_after_s)))),))
+            # graftlint: allow[swallowed-exception] context already finalized: retry-after metadata is advisory
             except Exception:  # noqa: BLE001 — context already finalized
                 pass
 

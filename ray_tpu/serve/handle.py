@@ -421,7 +421,9 @@ class _LongPollClient:
                 watched = {self._lp_key(k): self.versions.get(self._lp_key(k), -1)
                            for k in self.entries}
                 if _dbg:
-                    print(f"[lp] watched={watched}", flush=True)
+                    # warning level: RAY_TPU_LP_DEBUG is an explicit opt-in,
+                    # and nothing configures logging, so info() would vanish
+                    logger.warning("[lp] watched=%s", watched)
                 if not watched:
                     # retire ATOMICALLY with the empty check: a concurrent watch()
                     # either sees entries (we keep looping) or sees _thread=None
@@ -432,11 +434,16 @@ class _LongPollClient:
                 controller = ray_tpu.get_actor(CONTROLLER_NAME)
                 res = ray_tpu.get(controller.listen_for_change.remote(watched, 10.0))
                 errors = 0
-            except Exception:
+            except Exception as lp_err:
                 with self.lock:
                     for e in self.entries.values():
                         e.replicas = None  # fall back to interval polling
                 errors += 1
+                if errors == 1:
+                    # one line per outage, not one per second of it
+                    logger.warning("serve long-poll watch failed (%r); "
+                                   "falling back to interval polling while "
+                                   "retrying", lp_err)
                 if errors > 30:
                     # controller gone for ~30s: retire; a later watch() respawns
                     with self.lock:
@@ -445,7 +452,9 @@ class _LongPollClient:
                 time.sleep(1.0)
                 continue
             if _dbg:
-                print(f"[lp] res={ {k: (v, s if s is None else len(s)) for k, (v, s) in res.items()} }", flush=True)
+                logger.warning("[lp] res=%s", {
+                    k: (v, s if s is None else len(s))
+                    for k, (v, s) in res.items()})
             with self.lock:
                 for lp_key, (version, snapshot) in res.items():
                     self.versions[lp_key] = version
@@ -534,6 +543,7 @@ class _RetrySession:
                     self.handle._controller().report_replica_failure.remote(
                         self.handle.app_name, self.handle.deployment_name,
                         _rid(self.replica))
+                # graftlint: allow[swallowed-exception] best-effort death report; the controller's own health check converges anyway
                 except Exception:  # noqa: BLE001 — best-effort push
                     pass
         logger.info(
@@ -577,6 +587,7 @@ class _RetrySession:
             dur = time.perf_counter_ns() - self.t0_perf
         try:
             self.handle._router.observe_latency(dur / 1e9)
+        # graftlint: allow[swallowed-exception] telemetry emission is best-effort and must never take the data path down
         except Exception:  # noqa: BLE001 — load signals must never fail a request
             pass
 
@@ -638,8 +649,9 @@ class DeploymentHandle:
         try:
             limits = ray_tpu.get(self._controller().get_deployment_limits.remote(
                 self.app_name, self.deployment_name), timeout=5)
-        except Exception:  # noqa: BLE001 — controller busy/gone
-            pass
+        except Exception as e:  # noqa: BLE001 — controller busy/gone
+            logger.debug("deployment-limits fetch failed (%r); keeping the "
+                         "cached admission limits", e)
         ttl = 30.0
         if limits is None:
             ttl = 5.0
@@ -689,11 +701,13 @@ class DeploymentHandle:
                         ray_tpu.get_actor(CONTROLLER_NAME).record_handle_metrics.remote(
                             app, dep, float(router.total_inflight()))
                         errors = 0
+                    # graftlint: allow[swallowed-exception] failure is counted; the push loop retries every second and retires after 30
                     except Exception:
                         errors += 1
                     time.sleep(1.0)
 
-            router._metrics_thread = threading.Thread(target=push, daemon=True)
+            router._metrics_thread = threading.Thread(
+                target=push, daemon=True, name="serve-router-metrics")
             router._metrics_thread.start()
 
     def _adjust_queue_depth(self, delta: int) -> None:
@@ -718,6 +732,7 @@ class DeploymentHandle:
                 float(n), tags={"app": self.app_name,
                                 "deployment": self.deployment_name,
                                 "proc": str(_os.getpid())})
+        # graftlint: allow[swallowed-exception] telemetry emission is best-effort and must never take the data path down
         except Exception:
             pass  # load signals must never fail a request
 
@@ -751,6 +766,7 @@ class DeploymentHandle:
                 tag_keys=("app", "deployment")).inc(
                 tags={"app": self.app_name,
                       "deployment": self.deployment_name})
+        # graftlint: allow[swallowed-exception] telemetry emission is best-effort and must never take the data path down
         except Exception:
             pass  # shedding must not depend on telemetry
         raise BackPressureError(self.app_name, self.deployment_name,
@@ -809,6 +825,7 @@ class DeploymentHandle:
         while time.monotonic() < cap:
             try:
                 self._refresh(force=True)
+            # graftlint: allow[swallowed-exception] controller briefly unreachable; the wait loop keeps polling until its deadline
             except Exception:  # noqa: BLE001 — controller briefly unreachable
                 pass
             if any(_rid(r) not in dead_ids for r in self._replicas):
@@ -889,6 +906,7 @@ class DeploymentHandle:
             from ray_tpu.util.tracing import current_trace_id
 
             trace_id = current_trace_id()
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (trace_id = None) by design
         except Exception:
             trace_id = None
         if self._multiplexed_model_id:

@@ -78,6 +78,7 @@ class _MultiplexWrapper:
                 if callable(del_fn):
                     try:
                         del_fn()
+                    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
                     except Exception:
                         pass
         return model

@@ -39,7 +39,8 @@ class ProxyActor:
         self._routes: Dict[str, Dict[str, Any]] = {}
         self._hint_cache = (0.0, None)  # (fetched_at, windowed p50 or None)
         self._ready = threading.Event()
-        self._thread = threading.Thread(target=self._serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self._serve_forever, daemon=True,
+                                        name="serve-http-proxy")
         self._thread.start()
 
     def ready(self) -> bool:
@@ -64,6 +65,7 @@ class ProxyActor:
                 from ray_tpu.util.state import serve_latency_hint
 
                 p50 = serve_latency_hint().get("serve_request_p50_s")
+            # graftlint: allow[swallowed-exception] no metrics history yet: Retry-After keeps the static fallback
             except Exception:  # noqa: BLE001 — no history/scraper: use fallback
                 pass
             self._hint_cache = (now, p50)
@@ -168,6 +170,7 @@ class ProxyActor:
                 if traced:
                     try:
                         resp.headers["traceparent"] = traceparent_out
+                    # graftlint: allow[swallowed-exception] response already streaming: headers immutable, trace header is best-effort
                     except Exception:  # noqa: BLE001 — already-prepared stream
                         pass
                     _finish_span(stream, getattr(resp, "status", 200))
@@ -284,6 +287,7 @@ class ProxyActor:
                             gen = None
                         try:
                             await resp.write(f"\nerror: {e!r}\n".encode())
+                        # graftlint: allow[swallowed-exception] client socket already closed while reporting a stream error
                         except Exception:  # noqa: BLE001 — socket already closed
                             pass
                     await resp.write_eof()

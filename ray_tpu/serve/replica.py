@@ -218,5 +218,6 @@ class Replica:
         if hook is not None:
             try:
                 hook()
+            # graftlint: allow[swallowed-exception] callback isolation: a throwing subscriber must not break the caller
             except Exception:
                 pass

@@ -1,0 +1,2 @@
+"""Developer tooling that ships with the package (static analysis, doc
+generation). Nothing here is imported by the runtime."""

@@ -1,0 +1,21 @@
+"""graftlint — AST-based project-invariant analyzer for ray_tpu.
+
+Six invariants this codebase keeps by machine instead of by review:
+
+1. swallowed-exception — broad excepts must re-raise, log, or use the error
+2. host-sync-in-hot-path — no device->host syncs inside @hot_path functions
+3. blocking-control-path — no blocking calls on control-plane code
+4. knob-registry — every RAY_TPU_* knob registered in ray_tpu/knobs.py,
+   README tables generated from the registry
+5. thread-hygiene / lock-hygiene — named+explicit-daemon threads; no mixed
+   locked/unlocked writes in thread-spawning classes
+6. no-print — runtime code logs via LOGGER
+
+Run: ``ray-tpu lint`` (or ``python -m ray_tpu.tools.analysis``).
+Suppress: ``# graftlint: allow[check-name] reason`` (reason required).
+"""
+from __future__ import annotations
+
+from .base import Allow, Check, Project, SourceFile, Violation  # noqa: F401
+from .checks import ALL_CHECKS, CHECK_NAMES  # noqa: F401
+from .runner import LintResult, main, run_lint, write_docs  # noqa: F401

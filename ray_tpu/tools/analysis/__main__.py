@@ -1,0 +1,6 @@
+"""``python -m ray_tpu.tools.analysis`` == ``ray-tpu lint``."""
+import sys
+
+from .runner import main
+
+sys.exit(main())

@@ -1,0 +1,175 @@
+"""graftlint core: project model, allowlist parsing, check protocol.
+
+The analyzer is pure-AST and import-free with respect to the analyzed tree —
+it never executes or imports runtime modules (and therefore never pulls in
+jax), which is what keeps the tier-1 lint test cheap. The one deliberate
+exception is `ray_tpu/knobs.py`, the stdlib-only knob registry, which the
+knob-registry check loads as a detached module from its file path (see
+checks/knob_registry.py).
+
+Escape hatch: a violation is suppressed by an inline COMMENT (string
+literals never count — comments are recovered via tokenize) on the same line
+or the line directly above it, `# graftlint: allow[<check>] <reason>`. The
+reason is mandatory (an allow without one is itself a violation), and an
+allow that no check fires against is reported as stale — nothing gets
+suppressed silently.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+ALLOW_RE = re.compile(
+    r"#\s*graftlint:\s*allow\[(?P<checks>[a-z0-9_,\- ]+)\]\s*(?P<reason>.*)$")
+
+
+@dataclasses.dataclass
+class Violation:
+    check: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+@dataclasses.dataclass
+class Allow:
+    path: str
+    line: int
+    checks: Tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+class SourceFile:
+    """One analyzed file: text, parsed AST, and its allowlist entries."""
+
+    def __init__(self, root: str, path: str):
+        self.root = root
+        self.path = path  # repo-relative, '/'-separated
+        with open(os.path.join(root, path), encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=path)
+        self.allows: List[Allow] = []
+        self._allow_by_line: Dict[int, List[Allow]] = {}
+        for idx, comment in self._comments():
+            m = ALLOW_RE.search(comment)
+            if not m:
+                continue
+            checks = tuple(c.strip() for c in m.group("checks").split(",")
+                           if c.strip())
+            allow = Allow(self.path, idx, checks, m.group("reason").strip())
+            self.allows.append(allow)
+            self._allow_by_line.setdefault(idx, []).append(allow)
+
+    def _comments(self) -> Iterable[Tuple[int, str]]:
+        """(line, text) for every real comment token — a '#' inside a string
+        literal must never read as an allowlist entry."""
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except tokenize.TokenError:
+            return
+
+    def allow_for(self, check: str, line: int) -> Optional[Allow]:
+        """The allow entry covering `check` at `line`: same line or the line
+        directly above (a standalone comment line)."""
+        for lineno in (line, line - 1):
+            for allow in self._allow_by_line.get(lineno, ()):
+                if check in allow.checks:
+                    return allow
+        return None
+
+
+class Project:
+    """The analyzed file set plus lazily-built cross-file aggregates."""
+
+    def __init__(self, root: str, files: List[SourceFile]):
+        self.root = root
+        self.files = files
+        self.by_path = {f.path: f for f in files}
+        self._env_literals: Optional[Dict[str, List[Tuple[str, int]]]] = None
+        self._attr_names: Optional[set] = None
+        self._str_constants: Optional[set] = None
+
+    ENV_RE = re.compile(r"^RAY_TPU_[A-Z0-9]+(?:_[A-Z0-9]+)*$")
+
+    def _build_aggregates(self) -> None:
+        env: Dict[str, List[Tuple[str, int]]] = {}
+        attrs: set = set()
+        strs: set = set()
+        for f in self.files:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    strs.add(node.value)
+                    if self.ENV_RE.match(node.value):
+                        env.setdefault(node.value, []).append((f.path, node.lineno))
+                elif isinstance(node, ast.Attribute):
+                    attrs.add(node.attr)
+        self._env_literals, self._attr_names, self._str_constants = env, attrs, strs
+
+    @property
+    def env_literals(self) -> Dict[str, List[Tuple[str, int]]]:
+        """Every exact RAY_TPU_* string literal -> [(path, line), ...]."""
+        if self._env_literals is None:
+            self._build_aggregates()
+        return self._env_literals
+
+    @property
+    def attr_names(self) -> set:
+        if self._attr_names is None:
+            self._build_aggregates()
+        return self._attr_names
+
+    @property
+    def str_constants(self) -> set:
+        if self._str_constants is None:
+            self._build_aggregates()
+        return self._str_constants
+
+
+class Check:
+    """Base check: subclasses set `name`, implement run()."""
+
+    name: str = ""
+
+    def skip(self, path: str) -> bool:
+        return False
+
+    def run(self, f: SourceFile, project: Project) -> Iterable[Violation]:
+        raise NotImplementedError
+
+    def run_project(self, project: Project) -> Iterable[Violation]:
+        """Project-level pass (drift checks); default: nothing."""
+        return ()
+
+
+def call_name(node: ast.expr) -> str:
+    """Dotted name of a call target: `a.b.c(...)` -> 'a.b.c', best effort."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def decorator_names(node: ast.AST) -> List[str]:
+    out = []
+    for dec in getattr(node, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        out.append(call_name(target))
+    return out

@@ -1,0 +1,24 @@
+"""graftlint checks, one module per project invariant."""
+from __future__ import annotations
+
+from typing import List
+
+from ..base import Check
+from .blocking_control import BlockingControlPath
+from .host_sync import HostSyncInHotPath
+from .knob_registry import KnobRegistry
+from .no_print import NoPrint
+from .swallowed_exception import SwallowedException
+from .thread_hygiene import LockHygiene, ThreadHygiene
+
+ALL_CHECKS: List[Check] = [
+    SwallowedException(),
+    HostSyncInHotPath(),
+    BlockingControlPath(),
+    KnobRegistry(),
+    ThreadHygiene(),
+    LockHygiene(),
+    NoPrint(),
+]
+
+CHECK_NAMES = [c.name for c in ALL_CHECKS]

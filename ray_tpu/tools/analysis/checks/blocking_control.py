@@ -1,0 +1,104 @@
+"""blocking-control-path: blocking calls where the control plane must stay live.
+
+The PR 11 lesson: a saturated replica must still answer the controller, so
+drain/health/arm RPCs ride a dedicated "control" actor concurrency group —
+and nothing on that group (or in an async handler) may block the thread on
+sleeps, object fetches, or socket reads. Control contexts are:
+
+- ``async def`` functions anywhere in the runtime (the event loop stalls for
+  every other coroutine while a blocking call runs);
+- actor methods declared ``concurrency_group="control"`` (the dedicated
+  control lane must never wait behind data-plane work);
+- functions explicitly registered with ``@control_path``
+  (ray_tpu/util/hot_path.py) — health probes and drain paths that are
+  control-plane by contract even off a concurrency group.
+
+Flagged calls: ``time.sleep``, ``ray_tpu.get`` / ``ray_tpu.wait``,
+``subprocess.run/check_call/check_output``, socket/pipe reads
+(``.recv``/``.recv_bytes``/``.recv_bytes_into``/``.accept``), and
+``.result()`` on futures. In async code the non-blocking spelling exists
+(``await asyncio.sleep``, executors); on the control group the work belongs
+on another group.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Tuple
+
+from ..base import Check, Project, SourceFile, Violation, call_name
+
+BLOCKING_EXACT = {
+    "time.sleep": "time.sleep blocks the thread (asyncio.sleep / move off "
+                  "the control group)",
+    "ray_tpu.get": "ray_tpu.get blocks on object resolution",
+    "ray_tpu.wait": "ray_tpu.wait blocks on object resolution",
+    "subprocess.run": "subprocess.run blocks on the child",
+    "subprocess.check_call": "subprocess.check_call blocks on the child",
+    "subprocess.check_output": "subprocess.check_output blocks on the child",
+}
+BLOCKING_METHODS = {
+    "recv": "socket/pipe recv blocks until the peer sends",
+    "recv_bytes": "pipe recv_bytes blocks until the peer sends",
+    "recv_bytes_into": "pipe recv_bytes_into blocks until the peer sends",
+    "accept": "accept blocks until a peer connects",
+    "result": "Future.result blocks until completion",
+}
+
+
+def _control_contexts(tree: ast.AST) -> List[Tuple[ast.AST, str]]:
+    out: List[Tuple[ast.AST, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            out.append((node, f"async def {node.name}"))
+        elif isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = call_name(target)
+                if name == "control_path" or name.endswith(".control_path"):
+                    out.append((node, f"@control_path {node.name}"))
+                    break
+                if isinstance(dec, ast.Call):
+                    for kw in dec.keywords:
+                        if (kw.arg == "concurrency_group"
+                                and isinstance(kw.value, ast.Constant)
+                                and kw.value.value == "control"):
+                            out.append(
+                                (node, f'control-group method {node.name}'))
+                            break
+                    else:
+                        continue
+                    break
+    return out
+
+
+def _nested_defs(fn: ast.AST) -> set:
+    """ids of function defs nested inside fn (their bodies are NOT part of
+    this control context — a sync helper defined here may run elsewhere)."""
+    nested = set()
+    for node in ast.walk(fn):
+        if node is not fn and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                nested.add(id(sub))
+    return nested
+
+
+class BlockingControlPath(Check):
+    name = "blocking-control-path"
+
+    def run(self, f: SourceFile, project: Project) -> Iterable[Violation]:
+        for fn, label in _control_contexts(f.tree):
+            nested = _nested_defs(fn)
+            for node in ast.walk(fn):
+                if id(node) in nested or not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node.func)
+                if name in BLOCKING_EXACT:
+                    yield Violation(self.name, f.path, node.lineno,
+                                    f"{BLOCKING_EXACT[name]} (in {label})")
+                    continue
+                last = name.rsplit(".", 1)[-1]
+                if last in BLOCKING_METHODS and "." in name:
+                    yield Violation(
+                        self.name, f.path, node.lineno,
+                        f"{BLOCKING_METHODS[last]} (in {label})")

@@ -1,0 +1,129 @@
+"""knob-registry: every RAY_TPU_* knob registered, referenced, and documented.
+
+`ray_tpu/knobs.py` is the single source of truth (name, type, default, doc,
+owning subsystem). This check enforces, without importing the runtime:
+
+- **unregistered**: an exact ``RAY_TPU_*`` string literal anywhere in the
+  tree that names no registry entry (an env read the registry doesn't know,
+  or a typo'd knob name);
+- **stale**: a non-internal registry entry whose env name appears nowhere
+  outside the registry and whose CONFIG attr is never referenced — a knob
+  nothing reads anymore;
+- **README drift**: the generated knob tables in README.md (between
+  ``<!-- knobs:<subsystem> -->`` markers) differ from what the registry
+  renders, or a subsystem has no generated table at all. Fix with
+  ``ray-tpu lint --write-docs``.
+
+The registry module is stdlib-only by design and is loaded as a DETACHED
+module straight from its file path — `import ray_tpu` never happens here.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import Iterable, Optional
+
+from ..base import Check, Project, SourceFile, Violation
+
+_KNOBS_REL = "knobs.py"  # relative to the ray_tpu package dir
+
+
+def load_knobs(pkg_dir: str):
+    """Load ray_tpu/knobs.py as a detached stdlib-only module."""
+    path = os.path.join(pkg_dir, _KNOBS_REL)
+    name = "_graftlint_knobs"
+    cached = sys.modules.get(name)
+    if cached is not None and getattr(cached, "__file__", None) == path:
+        return cached
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # dataclasses resolves cls.__module__ through here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class KnobRegistry(Check):
+    name = "knob-registry"
+
+    def __init__(self, readme: Optional[str] = None):
+        # repo-relative README path; None disables the drift check (fixtures)
+        self.readme = readme if readme is not None else "README.md"
+
+    def run(self, f: SourceFile, project: Project) -> Iterable[Violation]:
+        return ()  # everything is cross-file; see run_project
+
+    def _pkg_dir(self, project: Project) -> Optional[str]:
+        for f in project.files:
+            if f.path.endswith(f"ray_tpu/{_KNOBS_REL}") or f.path == _KNOBS_REL:
+                return os.path.dirname(os.path.join(project.root, f.path))
+        return None
+
+    def run_project(self, project: Project) -> Iterable[Violation]:
+        pkg_dir = self._pkg_dir(project)
+        if pkg_dir is None:
+            return  # no registry in the analyzed set (fixture runs)
+        knobs = load_knobs(pkg_dir)
+        registry_paths = {
+            os.path.relpath(os.path.join(pkg_dir, _KNOBS_REL), project.root)
+            .replace(os.sep, "/")}
+
+        # -- unregistered literals
+        for env, sites in sorted(project.env_literals.items()):
+            if env in knobs.REGISTRY:
+                continue
+            for path, line in sites:
+                if path in registry_paths:
+                    continue
+                yield Violation(
+                    self.name, path, line,
+                    f"{env} is not registered in ray_tpu/knobs.py (add a "
+                    "Knob entry with type/default/doc/subsystem, or fix the "
+                    "name)")
+
+        # -- stale registry entries
+        knobs_rel = next(iter(registry_paths))
+        knobs_file = project.by_path.get(knobs_rel)
+        for k in knobs.KNOBS:
+            used_env = any(path not in registry_paths
+                           for path, _ in project.env_literals.get(k.env, ()))
+            used_attr = k.attr is not None and (
+                k.attr in project.attr_names or k.attr in project.str_constants)
+            if used_env or used_attr or k.internal:
+                continue
+            if k.subsystem == "bench":
+                # read by the repo-root bench drivers (core_bench.py & co),
+                # which live outside the analyzed package tree
+                continue
+            line = 1
+            if knobs_file is not None:
+                for idx, text in enumerate(knobs_file.lines, start=1):
+                    if f'"{k.env}"' in text:
+                        line = idx
+                        break
+            yield Violation(
+                self.name, knobs_rel, line,
+                f"{k.env} is registered but nothing references it anymore "
+                "(drop the entry or wire the knob back up)")
+
+        # -- README drift
+        if self.readme is None:
+            return
+        readme_abs = os.path.join(project.root, self.readme)
+        if not os.path.exists(readme_abs):
+            return
+        with open(readme_abs, encoding="utf-8") as fh:
+            text = fh.read()
+        regenerated = knobs.generate_readme(text)
+        if regenerated != text:
+            yield Violation(
+                self.name, self.readme, 1,
+                "generated knob tables are stale — run "
+                "`ray-tpu lint --write-docs`")
+        for sub in knobs.SUBSYSTEMS:
+            if f"<!-- knobs:{sub} " not in text:
+                yield Violation(
+                    self.name, self.readme, 1,
+                    f"subsystem {sub!r} has no generated knob table in the "
+                    "README (add a `<!-- knobs:" + sub + " ... -->` block "
+                    "and run `ray-tpu lint --write-docs`)")

@@ -1,0 +1,36 @@
+"""no-print: runtime code logs through LOGGER, never print().
+
+print() bypasses log levels, per-process capture, and the driver's log
+fan-in — and tears mid-line tqdm bars (the telemetry convention finalizes a
+bar with `tqdm_ray.ensure_newline()` before logging for exactly that
+reason). The CLI (`ray_tpu/scripts/`) and the progress-bar renderer
+(`experimental/tqdm_ray.py`) own their stdout by design and are out of
+scope; everything else needs LOGGER or an inline allow with a reason (e.g.
+`Dataset.show()`, whose contract IS printing rows to the console).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..base import Check, Project, SourceFile, Violation
+
+SKIP_PARTS = ("ray_tpu/scripts/", "experimental/tqdm_ray.py", "test_utils.py",
+              "ray_tpu/tools/")  # lint/doc tooling reports on stdout by design
+
+
+class NoPrint(Check):
+    name = "no-print"
+
+    def skip(self, path: str) -> bool:
+        return any(part in path for part in SKIP_PARTS)
+
+    def run(self, f: SourceFile, project: Project) -> Iterable[Violation]:
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                yield Violation(
+                    self.name, f.path, node.lineno,
+                    "print() in runtime code — use the module LOGGER "
+                    "(throttled if it can repeat; ensure_newline() first if "
+                    "a tqdm bar may be mid-line)")

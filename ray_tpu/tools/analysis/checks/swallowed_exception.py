@@ -1,0 +1,91 @@
+"""swallowed-exception: broad except handlers that silently eat the error.
+
+The project convention (PR 8/10): an `except:` / `except Exception:` body
+must re-raise, log through a LOGGER (throttled where it can repeat), or at
+minimum DO something with the caught exception object. A handler that
+catches everything and uses none of it is how the engine lost real failures
+behind `pass` 164 times — silence is only acceptable with an inline
+`# graftlint: allow[swallowed-exception] reason`.
+
+A handler counts as NOT silent when its body contains any of:
+
+- a `raise` (re-raise or wrap);
+- a call whose dotted target looks like logging (`logger.warning`,
+  `LOGGER.exception`, `logging.error`, `self._logger.info`, ...) or
+  `traceback.print_exc` / `sys.exit` / `os._exit`;
+- any read of the caught exception name (``except Exception as e`` followed
+  by a use of ``e`` — wrapped, stored, reported somewhere).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..base import Check, Project, SourceFile, Violation, call_name
+
+BROAD = {"Exception", "BaseException"}
+LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+               "critical", "log"}
+EXIT_CALLS = {"traceback.print_exc", "sys.exit", "os._exit"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e for e in t.elts]
+    else:
+        names = [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD:
+            return True
+    return False
+
+
+def _is_log_call(node: ast.Call) -> bool:
+    name = call_name(node.func)
+    if name in EXIT_CALLS:
+        return True
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-1] in LOG_METHODS:
+        receiver = parts[-2].lower()
+        if "log" in receiver:
+            return True
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    caught = handler.name
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and _is_log_call(node):
+            return True
+        if (caught and isinstance(node, ast.Name) and node.id == caught
+                and isinstance(node.ctx, ast.Load)):
+            return True
+    return False
+
+
+class SwallowedException(Check):
+    name = "swallowed-exception"
+
+    def run(self, f: SourceFile, project: Project) -> Iterable[Violation]:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _handles(node):
+                continue
+            what = ("bare except" if node.type is None
+                    else f"except {ast.unparse(node.type)}")
+            yield Violation(
+                self.name, f.path, node.lineno,
+                f"{what} swallows the error silently: re-raise, log via "
+                "LOGGER (throttled if it can repeat), or use the caught "
+                "exception")

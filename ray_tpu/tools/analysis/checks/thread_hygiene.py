@@ -1,0 +1,122 @@
+"""thread-hygiene + lock-hygiene: thread construction and cross-thread state.
+
+thread-hygiene — every ``threading.Thread(...)`` must pass BOTH ``daemon=``
+and ``name=``. Unnamed threads make `ray-tpu list stacks` and py-spy dumps
+unreadable; non-explicit daemonness is how shutdown hangs are born (a
+forgotten non-daemon thread pins the process; an accidental daemon thread
+gets killed mid-write).
+
+lock-hygiene — a heuristic race detector for the PR 8 stale-snapshot /
+PR 11 undeclared-router-field class of bug: in any class that spawns
+threads, an instance attribute assigned BOTH inside ``with self.<lock>:``
+blocks and outside them (excluding ``__init__``/``__new__`` construction and
+``*_locked`` methods, whose callers hold the lock by convention) is flagged
+at each unlocked write site. Either take the lock, move the write into
+``__init__``, or allow it with the reason the unlocked write is safe
+(immutable publish, single-writer field, ...).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..base import Check, Project, SourceFile, Violation, call_name
+
+LOCKISH = ("lock", "_mu", "mutex", "cond")
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    name = call_name(node.func)
+    return name == "threading.Thread" or name.endswith(".Thread") \
+        or name == "Thread"
+
+
+class ThreadHygiene(Check):
+    name = "thread-hygiene"
+
+    def run(self, f: SourceFile, project: Project) -> Iterable[Violation]:
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            missing = [k for k in ("daemon", "name") if k not in kwargs]
+            if missing:
+                yield Violation(
+                    self.name, f.path, node.lineno,
+                    f"threading.Thread without {'/'.join(missing)}= — name "
+                    "threads for stack listings and make daemonness an "
+                    "explicit decision")
+
+
+def _lock_guarded(with_node: ast.With) -> bool:
+    for item in with_node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        name = call_name(expr).lower()
+        if any(tok in name for tok in ("start", "init")):
+            # a start/init gate orders one-time construction; it does not
+            # declare the attributes written inside it lock-protected in
+            # steady state (the llm engine's _start_lock pattern)
+            continue
+        if any(tok in name for tok in LOCKISH):
+            return True
+    return False
+
+
+def _self_writes(method: ast.AST) -> Iterable[Tuple[str, int, bool]]:
+    """(attr, line, locked) for every `self.X = ...` in the method body."""
+
+    def visit(node: ast.AST, locked: bool) -> Iterable[Tuple[str, int, bool]]:
+        if isinstance(node, ast.With) and _lock_guarded(node):
+            locked = True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not method:
+            return  # nested defs run elsewhere
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if (isinstance(sub, ast.Attribute)
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == "self"):
+                        yield sub.attr, sub.lineno, locked
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, locked)
+
+    yield from visit(method, False)
+
+
+class LockHygiene(Check):
+    name = "lock-hygiene"
+
+    def run(self, f: SourceFile, project: Project) -> Iterable[Violation]:
+        for cls in ast.walk(f.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            spawns = any(isinstance(n, ast.Call) and _is_thread_ctor(n)
+                         for n in ast.walk(cls))
+            if not spawns:
+                continue
+            locked_attrs: Set[str] = set()
+            unlocked: Dict[str, List[int]] = {}
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name in ("__init__", "__new__") \
+                        or item.name.endswith("_locked"):
+                    continue
+                for attr, line, locked in _self_writes(item):
+                    if locked:
+                        locked_attrs.add(attr)
+                    else:
+                        unlocked.setdefault(attr, []).append(line)
+            for attr in sorted(locked_attrs & set(unlocked)):
+                for line in unlocked[attr]:
+                    yield Violation(
+                        self.name, f.path, line,
+                        f"self.{attr} is written under a lock elsewhere in "
+                        f"{cls.name} but assigned here without it — take "
+                        "the lock or justify the lock-free write")

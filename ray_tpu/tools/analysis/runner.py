@@ -1,0 +1,200 @@
+"""graftlint runner: walk the tree, run every check, apply the allowlist.
+
+Entry points:
+
+- ``run_lint(root, ...)`` — programmatic (tests/test_lint.py runs it over
+  ``ray_tpu/`` in tier-1);
+- ``main(argv)`` — the ``ray-tpu lint`` CLI (also
+  ``python -m ray_tpu.tools.analysis``): exit 0 = clean, 1 = violations,
+  2 = a file failed to parse. ``--write-docs`` regenerates the README knob
+  tables from the registry instead of failing on drift.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .base import Project, SourceFile, Violation
+from .checks import ALL_CHECKS, CHECK_NAMES
+from .checks.knob_registry import load_knobs
+
+EXCLUDE_PARTS = ("__pycache__", "_pb2")
+
+
+def collect_files(root: str, subdirs: Sequence[str]) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if not os.path.exists(base):
+            # a typo'd path must not become a lint gate that "passes" over
+            # zero files
+            raise SystemExit(f"graftlint: no such path: {base}")
+        if os.path.isfile(base) and base.endswith(".py"):
+            out.append(SourceFile(root, os.path.relpath(base, root)
+                                  .replace(os.sep, "/")))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), root) \
+                    .replace(os.sep, "/")
+                if any(part in rel for part in EXCLUDE_PARTS):
+                    continue
+                out.append(SourceFile(root, rel))
+    return out
+
+
+@dataclasses.dataclass
+class LintResult:
+    violations: List[Violation]  # unallowlisted — these fail the run
+    allowed: List[Violation]  # suppressed by a reasoned inline allow
+    problems: List[Violation]  # allowlist meta-problems (no reason / stale)
+    files: int
+
+    @property
+    def failures(self) -> List[Violation]:
+        return self.violations + self.problems
+
+
+def run_lint(root: str, subdirs: Sequence[str] = ("ray_tpu",),
+             checks=None, readme: Optional[str] = "README.md") -> LintResult:
+    checks = list(ALL_CHECKS) if checks is None else list(checks)
+    for c in checks:
+        if c.name == "knob-registry":
+            c.readme = readme
+    files = collect_files(root, subdirs)
+    project = Project(root, files)
+    violations: List[Violation] = []
+    allowed: List[Violation] = []
+    raw: List[Violation] = []
+    for check in checks:
+        for f in files:
+            if check.skip(f.path):
+                continue
+            raw.extend(check.run(f, project))
+        raw.extend(check.run_project(project))
+    problems: List[Violation] = []
+    for v in raw:
+        f = project.by_path.get(v.path)
+        allow = f.allow_for(v.check, v.line) if f is not None else None
+        if allow is None:
+            violations.append(v)
+            continue
+        allow.used = True
+        if not allow.reason:
+            problems.append(Violation(
+                "allowlist", v.path, allow.line,
+                f"allow[{v.check}] has no reason — every suppression must "
+                "say why the invariant is intentionally bent"))
+        allowed.append(v)
+    for f in files:
+        for allow in f.allows:
+            unknown = [c for c in allow.checks
+                       if c not in CHECK_NAMES and c != "allowlist"]
+            if unknown:
+                problems.append(Violation(
+                    "allowlist", f.path, allow.line,
+                    f"allow[{', '.join(unknown)}] names no known check "
+                    f"(known: {', '.join(CHECK_NAMES)})"))
+            elif not allow.used:
+                problems.append(Violation(
+                    "allowlist", f.path, allow.line,
+                    f"stale allow[{', '.join(allow.checks)}]: no violation "
+                    "fires here anymore — delete the comment"))
+    key = lambda v: (v.path, v.line, v.check)
+    return LintResult(sorted(violations, key=key), sorted(allowed, key=key),
+                      sorted(problems, key=key), len(files))
+
+
+def write_docs(root: str, readme: str = "README.md") -> bool:
+    """Regenerate the README knob tables in place; True if anything changed."""
+    knobs = load_knobs(os.path.join(root, "ray_tpu"))
+    path = os.path.join(root, readme)
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    new = knobs.generate_readme(text)
+    if new != text:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(new)
+        return True
+    return False
+
+
+def find_root(start: Optional[str] = None) -> str:
+    """Nearest ancestor holding the ray_tpu package (repo root)."""
+    cur = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.isfile(os.path.join(cur, "ray_tpu", "__init__.py")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            raise SystemExit("graftlint: no ray_tpu package found above "
+                             f"{start or os.getcwd()}")
+        cur = parent
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ray-tpu lint",
+        description="project-invariant static analysis (graftlint)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="subdirs/files to lint, relative to the repo root "
+                        "(default: ray_tpu)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: walk up to the ray_tpu package)")
+    p.add_argument("--write-docs", action="store_true",
+                   help="regenerate the README knob tables from "
+                        "ray_tpu/knobs.py and exit")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument("--show-allowed", action="store_true",
+                   help="also list allowlisted (suppressed) violations")
+    args = p.parse_args(argv)
+
+    root = args.root or find_root()
+    if args.write_docs:
+        changed = write_docs(root)
+        print("README knob tables " +
+              ("rewritten from ray_tpu/knobs.py" if changed else "already current"))
+        return 0
+
+    subdirs = args.paths or ["ray_tpu"]
+    try:
+        res = run_lint(root, subdirs)
+    except SyntaxError as e:
+        print(f"graftlint: parse failure: {e}", file=sys.stderr)
+        return 2
+    if res.files == 0:
+        print("graftlint: the given paths contain no python files",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "files": res.files,
+            "violations": [dataclasses.asdict(v) for v in res.violations],
+            "problems": [dataclasses.asdict(v) for v in res.problems],
+            "allowed": [dataclasses.asdict(v) for v in res.allowed],
+        }, indent=2))
+        return 1 if res.failures else 0
+
+    for v in res.failures:
+        print(v.render())
+    if args.show_allowed:
+        for v in res.allowed:
+            print(f"(allowed) {v.render()}")
+    ok = not res.failures
+    print(f"graftlint: {res.files} files, "
+          f"{len(res.violations)} violation(s), "
+          f"{len(res.problems)} allowlist problem(s), "
+          f"{len(res.allowed)} allowlisted" + (" — ok" if ok else ""))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -175,6 +175,7 @@ class BackendExecutor:
                 try:
                     p = ray_tpu.get(w.poll_session.remote(),
                                     timeout=max(0.1, deadline - time.monotonic()))
+                # graftlint: allow[swallowed-exception] dead/unreachable worker: nothing to salvage there, survivors carry on
                 except Exception:
                     continue  # dead/unreachable: nothing to salvage there
                 if rank == 0:
@@ -195,8 +196,10 @@ class BackendExecutor:
             if self.worker_group is not None:
                 self.backend.on_failure(self.worker_group, self.backend_config, error)
             self.drain_after_failure()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning("failure-handling hook raised (%r): worker "
+                           "checkpoint salvage may be incomplete for this "
+                           "restart", e)
 
     def run_until_complete(
         self,
@@ -257,6 +260,7 @@ class BackendExecutor:
             try:
                 self.backend.on_shutdown(self.worker_group, self.backend_config)
                 ray_tpu.get([w.end_session.remote() for w in self.worker_group.workers])
+            # graftlint: allow[swallowed-exception] shutdown teardown: workers may already be gone
             except Exception:
                 pass
         self.worker_group.shutdown()

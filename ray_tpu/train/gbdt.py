@@ -100,7 +100,8 @@ class XGBoostBackend(Backend):
         # wait_for holds the tracker open until every worker disconnects;
         # park it on a daemon thread like the reference does.
         self._wait_thread = threading.Thread(
-            target=lambda: self._tracker.wait_for(), daemon=True)
+            target=lambda: self._tracker.wait_for(), daemon=True,
+            name="gbdt-tracker-wait")
         self._wait_thread.start()
         args = dict(self._tracker.worker_args())
         import ray_tpu
@@ -114,6 +115,7 @@ class XGBoostBackend(Backend):
                     backend_config: XGBoostConfig) -> None:
         try:
             worker_group.execute(_clear_rabit_args)
+        # graftlint: allow[swallowed-exception] best-effort worker-env teardown (rabit args)
         except Exception:
             pass
         if self._wait_thread is not None:
@@ -241,6 +243,7 @@ class LightGBMBackend(Backend):
                     backend_config: LightGBMConfig) -> None:
         try:
             worker_group.execute(_clear_lgbm_params)
+        # graftlint: allow[swallowed-exception] best-effort worker-env teardown (lgbm params)
         except Exception:
             pass
 

@@ -70,6 +70,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.util.hot_path import hot_path
+
 DEFAULT_BUCKET_BYTES = 4 << 20  # 4 MiB: ~8 buckets on a 500M-param f32 tree
 
 _TRUE = ("1", "true", "yes", "on")
@@ -570,6 +572,7 @@ class GradSyncStep:
             manual=(sync.axis,))
 
     # -- public surface
+    @hot_path
     def __call__(self, state, batch):
         self._ensure(state, batch)
         return self._fn(state, batch)

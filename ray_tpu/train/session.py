@@ -133,6 +133,7 @@ class _TrainSession:
                     "train.report", "train", rank=self.context.world_rank,
                     **{k: v for k, v in metrics.items()
                        if isinstance(v, (int, float, str, bool))})
+        # graftlint: allow[swallowed-exception] telemetry emission is best-effort; a report must never fail on it
         except Exception:
             pass  # telemetry must never fail a report
 

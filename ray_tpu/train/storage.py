@@ -113,6 +113,7 @@ def delete(uri: str) -> None:
     fs, root = get_fs(uri)
     try:
         fs.rm(root, recursive=True)
+    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
     except Exception:  # noqa: BLE001 — transient object-store errors included
         pass
 

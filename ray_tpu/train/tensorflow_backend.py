@@ -67,6 +67,7 @@ class TensorflowBackend(Backend):
     def on_shutdown(self, worker_group: WorkerGroup, backend_config: TensorflowConfig) -> None:
         try:
             worker_group.execute(_clear_tf_config)
+        # graftlint: allow[swallowed-exception] best-effort worker-env teardown (TF_CONFIG)
         except Exception:
             pass
 

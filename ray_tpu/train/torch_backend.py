@@ -84,6 +84,7 @@ class TorchBackend(Backend):
     def on_shutdown(self, worker_group: WorkerGroup, backend_config: TorchConfig) -> None:
         try:
             worker_group.execute(_teardown_torch_process_group)
+        # graftlint: allow[swallowed-exception] best-effort worker-env teardown (torch process group)
         except Exception:
             pass
 

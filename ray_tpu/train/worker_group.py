@@ -163,12 +163,14 @@ class WorkerGroup:
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
         self.workers = []
         if self._pg is not None:
             try:
                 pg_api.remove_placement_group(self._pg)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
             self._pg = None

@@ -30,6 +30,7 @@ class ResultGrid:
                 if isinstance(t.checkpoint, ObjectRef):
                     try:
                         ckpt = ray_tpu.get(t.checkpoint)
+                    # graftlint: allow[swallowed-exception] degrades to the coded fallback (ckpt = None) by design
                     except Exception:
                         ckpt = None
                 else:
@@ -37,6 +38,7 @@ class ResultGrid:
             df = None
             try:
                 df = t.metrics_dataframe
+            # graftlint: allow[swallowed-exception] metrics dataframe is optional (pandas may be absent)
             except Exception:
                 pass
             self._results.append(

@@ -96,7 +96,8 @@ class FunctionTrainable(Trainable):
                 self._error = e
                 self._results.put({DONE: True, "_error": repr(e)})
 
-        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread = threading.Thread(target=runner, daemon=True,
+                                        name="tune-fn-runner")
         self._started = False
 
     def step(self) -> Dict[str, Any]:

@@ -97,6 +97,7 @@ class ClientContext:
                         ev.set()
                 if isinstance(e, (OSError, EOFError, BrokenPipeError)):
                     # transport is dead: nothing sent after this can complete
+                    # graftlint: allow[lock-hygiene] monotonic shutdown latch: every writer only sets True
                     self._closed = True
                     self._fail_all_pending("client connection lost (send failed)")
                     break
@@ -105,6 +106,7 @@ class ClientContext:
         while not self._closed:
             try:
                 req_id, ok, value = self._conn.recv()
+            # graftlint: allow[swallowed-exception] peer closed mid-recv; the loop exits via its closed flag
             except Exception:
                 # EOF, OSError, or an unpicklable reply (missing class client-side):
                 # the stream position is unrecoverable — fail all pending calls
@@ -115,6 +117,7 @@ class ClientContext:
                 ev, out = slot
                 out.extend((ok, value))
                 ev.set()
+        # graftlint: allow[lock-hygiene] monotonic shutdown latch: every writer only sets True
         self._closed = True
         self._fail_all_pending("client connection closed")
 
@@ -165,14 +168,17 @@ class ClientContext:
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name="client-async-get").start()
         return fut
 
     def close(self) -> None:
+        # graftlint: allow[lock-hygiene] monotonic shutdown latch: every writer only sets True
         self._closed = True
         self._outbox.put(None)  # unblock the sender
         try:
             self._conn.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
 

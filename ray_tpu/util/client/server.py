@@ -159,6 +159,7 @@ class ClientServer:
                 # instead of blocking forever
                 try:
                     conn.close()
+                # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
                 except Exception:
                     pass
                 return
@@ -204,6 +205,7 @@ class ClientServer:
             try:
                 with send_lock:
                     conn.send((req_id, ok, value))
+            # graftlint: allow[swallowed-exception] error reply failed: client is gone, nothing to tell it
             except Exception:
                 # reply unpicklable: send a describable error instead of leaving
                 # the client's _call waiting forever (leases stay recorded and
@@ -212,21 +214,24 @@ class ClientServer:
                     with send_lock:
                         conn.send((req_id, False,
                                    RuntimeError(f"client-server reply failed to serialize: {value!r:.500}")))
+                # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
                 except Exception:
                     pass
 
         while not self._shutdown:
             try:
                 req_id, method, args, kwargs = conn.recv()
+            # graftlint: allow[swallowed-exception] peer closed mid-recv; the connection handler unwinds
             except Exception:  # EOF/OSError/malformed frame all end the session
                 break
             if req_id is None:
                 dispatch(req_id, method, args, kwargs)  # casts are quick: run inline
             else:
                 threading.Thread(target=dispatch, args=(req_id, method, args, kwargs),
-                                 daemon=True).start()
+                                 daemon=True, name="client-server-dispatch").start()
         try:
             conn.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
         # reclaim whatever the client still owned (crash / dropped connection)
@@ -240,11 +245,13 @@ class ClientServer:
         for oid in refs:
             try:
                 ctx.decref(oid)
+            # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
             except Exception:
                 pass
         for aid in actors:
             try:
                 ctx.kill_actor(aid, no_restart=True, from_gc=True)
+            # graftlint: allow[swallowed-exception] GC/decref during teardown: the runtime may already be torn down
             except Exception:
                 pass
 
@@ -252,11 +259,13 @@ class ClientServer:
         self._shutdown = True
         try:
             self._listener.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
         for c in self._conns:
             try:
                 c.close()
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
             except Exception:
                 pass
 

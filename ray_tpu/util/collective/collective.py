@@ -15,6 +15,7 @@ across member processes so members can jointly build multi-host meshes.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -27,6 +28,8 @@ from .coordinator import GroupCoordinator, wait_poll
 from .types import Backend, Compression, ReduceOp
 
 _NAMESPACE = "ray_tpu.collective"
+
+logger = logging.getLogger("ray_tpu.collective")
 
 
 def _op_timeout() -> float:
@@ -84,6 +87,7 @@ def _get_or_create_coordinator(group_name: str, world_size: int, rank: int):
     name = _coordinator_name(group_name)
     try:
         return ray_tpu.get_actor(name, namespace=_NAMESPACE)
+    # graftlint: allow[swallowed-exception] named-actor probe: not-found falls through to coordinator creation
     except Exception:
         pass
     if rank == 0:
@@ -97,6 +101,7 @@ def _get_or_create_coordinator(group_name: str, world_size: int, rank: int):
             # may still own the name).
             ray_tpu.get(coord.world.remote(), timeout=30)
             return coord
+        # graftlint: allow[swallowed-exception] lost the creation race: adopt the coordinator the winning rank registered
         except Exception:
             return ray_tpu.get_actor(name, namespace=_NAMESPACE)
     # non-zero ranks: wait for rank 0's coordinator to register
@@ -207,8 +212,12 @@ def _notify_head(kind: str, group_name: str, rank: int, epoch: int) -> None:
         return
     try:
         notify(kind, group_name, rank, epoch)
-    except Exception:
-        pass
+    except Exception as e:
+        # an unrecorded membership note means worker-death cleanup cannot
+        # resolve this rank later — keep going (the op itself still works)
+        # but say so, or the next abort investigation starts blind
+        logger.warning("collective membership note %s for %s rank %s failed "
+                       "(%r)", kind, group_name, rank, e)
 
 
 def create_collective_group(
@@ -275,6 +284,7 @@ def destroy_collective_group(group_name: str = "default") -> None:
     # of order strand the first re-joiner in the stale epoch.
     try:
         st.coordinator.leave.remote(st.rank, st.epoch)
+    # graftlint: allow[swallowed-exception] best-effort board cleanup on destroy; TTL reaping is the backstop
     except Exception:
         pass  # coordinator already gone — nothing to retract
     # release the group's ring data plane (listener thread + port + pooled
@@ -308,6 +318,7 @@ def abort_collective_group(group_name: str = "default",
         if not wait:
             return True
         return bool(ray_tpu.get(ref, timeout=_op_timeout()))
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
     except Exception:
         return False
 
@@ -321,6 +332,7 @@ def kill_coordinator(group_name: str = "default") -> None:
     try:
         coord = ray_tpu.get_actor(_coordinator_name(group_name), namespace=_NAMESPACE)
         ray_tpu.kill(coord)
+    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
     except Exception:
         pass
 
@@ -563,6 +575,7 @@ def _jax_distributed_initialized() -> bool:
         from jax._src import distributed
 
         return distributed.global_state.client is not None
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
     except Exception:
         return False
 

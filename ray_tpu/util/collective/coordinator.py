@@ -33,6 +33,8 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.util.hot_path import hot_path
+
 
 def _payload_nbytes(payload: Any) -> int:
     """Approximate wire size of a board payload — exact for the cases that
@@ -51,6 +53,7 @@ def _payload_nbytes(payload: Any) -> int:
                        for k, v in payload.items())
         if isinstance(payload, (list, tuple)):
             return sum(_payload_nbytes(v) for v in payload)
+    # graftlint: allow[swallowed-exception] size probe of arbitrary payloads; the 64-byte floor covers opaque objects
     except Exception:
         pass
     return 64  # opaque object: count something
@@ -129,6 +132,7 @@ class GroupCoordinator:
                     tag_keys=("group",)).inc(1.0, tags={"group": self.name})
                 telemetry.event("collective.epoch_rollover", "collective",
                                 group=self.name, epoch=self._epoch)
+            # graftlint: allow[swallowed-exception] telemetry emission is best-effort and must never take the data path down
             except Exception:
                 pass  # telemetry must never fail a group re-init
         self._members[rank] = member
@@ -258,6 +262,7 @@ class GroupCoordinator:
             from ray_tpu.config import CONFIG
 
             ttl = max(60.0, 4 * CONFIG.collective_op_timeout_s)
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (ttl = 120.0) by design
         except Exception:
             ttl = 120.0
         now = time.monotonic()
@@ -292,6 +297,7 @@ def _coordinator_lost_error(st, key: str, e: BaseException):
     )
 
 
+@hot_path
 def wait_poll(st, key: str, timeout_s: float, expected: Optional[int] = None):
     """Client-side poll loop against the group's coordinator actor.
 
@@ -332,6 +338,7 @@ def wait_poll(st, key: str, timeout_s: float, expected: Optional[int] = None):
         sleep = min(sleep * 2, 0.01)
 
 
+@hot_path
 def wait_poll_one(st, key: str, src_rank: int, timeout_s: float):
     """wait_poll for point-to-point recv: same fail-fast and timeout contract."""
     from ray_tpu.core.exceptions import ActorError

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ray_tpu.util import telemetry
+from ray_tpu.util.hot_path import hot_path
 
 from .coordinator import wait_poll, wait_poll_one
 from .types import ReduceOp
@@ -352,10 +353,12 @@ def release_plane(plane: _Plane) -> None:
         _planes.pop(plane.authkey, None)
     try:
         plane.server.close()
+    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
     except Exception:
         pass
     try:
         plane.client.close()
+    # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
     except Exception:
         pass
 
@@ -422,6 +425,7 @@ class _AbortCheck:
         self.interval = max(0.05, CONFIG.collective_abort_poll_interval_s)
         self._last = time.monotonic()
 
+    @hot_path
     def check(self, force: bool = False, cause: Optional[BaseException] = None) -> None:
         """Raise CollectiveAbortError if the group is poisoned (or the
         coordinator itself died). `force` skips the throttle — used when a
@@ -520,7 +524,9 @@ def _run_threads(fns, deadline: float, what: str, st=None) -> None:
         except BaseException as e:  # noqa: BLE001 — propagated below
             errs.append(e)
 
-    threads = [threading.Thread(target=wrap, args=(fn,), daemon=True) for fn in fns]
+    threads = [threading.Thread(target=wrap, args=(fn,), daemon=True,
+                                name=f"ring-par-{i}")
+               for i, fn in enumerate(fns)]
     for t in threads:
         t.start()
     abort = _AbortCheck(st) if st is not None else None
@@ -575,7 +581,8 @@ def _ordered_stream_reduce(st, op, parts_src, my_part: np.ndarray,
                 errs.append(e)
                 cond.notify_all()
 
-    threads = [threading.Thread(target=fetch, args=(i,), daemon=True)
+    threads = [threading.Thread(target=fetch, args=(i,), daemon=True,
+                                name=f"ring-fetch-{i}")
                for i in _staggered(r, w)]
     for t in threads:
         t.start()

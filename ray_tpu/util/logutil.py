@@ -1,0 +1,33 @@
+"""Throttled logging primitive for repeat-prone warning sites.
+
+The project convention (PR 8): a failure path that can fire per-iteration
+(reconcile loops, metric exporters, maintenance checks) logs through a
+throttle so an outage produces one line per window, not one per tick — but
+is never silent. One shared primitive so the window bookkeeping doesn't get
+hand-rolled (and drift) per subsystem.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Hashable
+
+
+class LogThrottle:
+    """`ready(key)` is True at most once per `window_s` per key.
+
+    Not thread-safe by design: a lost race only duplicates one log line.
+    Keys let one throttle instance cover several sites independently (e.g.
+    the engine's per-exporter guards) instead of the first firing site
+    muting the others.
+    """
+
+    def __init__(self, window_s: float = 30.0):
+        self.window_s = window_s
+        self._last: Dict[Hashable, float] = {}
+
+    def ready(self, key: Hashable = None) -> bool:
+        now = time.monotonic()
+        if now - self._last.get(key, 0.0) >= self.window_s:
+            self._last[key] = now
+            return True
+        return False

@@ -20,6 +20,7 @@ def _report_interval() -> float:
         from ray_tpu.config import CONFIG
 
         return CONFIG.metrics_report_interval_s
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (return 2.0) by design
     except Exception:
         return 2.0
 
@@ -73,6 +74,7 @@ class _Registry:
                     snap = self.snapshot()
                     if snap:
                         w.push_metrics(snap)
+                # graftlint: allow[swallowed-exception] degrades to the coded fallback (return) by design
                 except Exception:
                     return  # pipe closed: worker exiting
 

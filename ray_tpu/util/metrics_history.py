@@ -88,6 +88,7 @@ class MetricsHistory:
             from ray_tpu.config import CONFIG
 
             return max(2, int(CONFIG.metrics_history_size))
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return 360) by design
         except Exception:
             return 360
 
@@ -256,6 +257,7 @@ def scraper_loop(history: MetricsHistory, snapshot_fn, is_shutdown,
     while not is_shutdown():
         try:
             interval = float(CONFIG.metrics_scrape_interval_s)
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (interval = 5.0) by design
         except Exception:
             interval = 5.0
         now = time.time()

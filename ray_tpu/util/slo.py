@@ -192,6 +192,7 @@ class SLOEngine:
             from ray_tpu.config import CONFIG
 
             interval = max(0.05, float(CONFIG.metrics_scrape_interval_s))
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (interval = 5.0) by design
         except Exception:
             interval = 5.0
         with self._lock:

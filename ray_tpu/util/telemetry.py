@@ -105,6 +105,7 @@ def _active_trace_id() -> Optional[str]:
         from ray_tpu.util import tracing
 
         return tracing.current_trace_id()
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (return None) by design
     except Exception:
         return None
 
@@ -230,6 +231,7 @@ def drain() -> List[dict]:
             from ray_tpu.experimental.tqdm_ray import ensure_newline
 
             ensure_newline()
+        # graftlint: allow[swallowed-exception] a torn tqdm bar must never block the overflow warning itself
         except Exception:
             pass
         logger.warning(
@@ -267,6 +269,7 @@ def clock_offset_ns() -> int:
         head_ns = int(w.state_request("head_clock_ns"))
         t1 = time.time_ns()
         _clock_offset_ns = head_ns - (t0 + t1) // 2
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (_clock_offset_ns = 0) by design
     except Exception:
         _clock_offset_ns = 0
     return _clock_offset_ns
@@ -288,6 +291,7 @@ def flush() -> None:
     try:
         w.push_telemetry({"clock_offset_ns": offset, "events": events,
                           "pid": os.getpid()})
+    # graftlint: allow[swallowed-exception] telemetry flush is best-effort; the ring re-drains next interval
     except Exception:
         pass  # pipe closed: worker exiting
 
@@ -329,6 +333,7 @@ def _ensure_flush_thread() -> None:
             time.sleep(_flush_interval())
             try:
                 flush()
+            # graftlint: allow[swallowed-exception] degrades to the coded fallback (return) by design
             except Exception:
                 return
 

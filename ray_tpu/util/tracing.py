@@ -176,6 +176,7 @@ def _clock_offset_s() -> float:
         from ray_tpu.util import telemetry
 
         return telemetry.clock_offset_ns() / 1e9
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (return 0.0) by design
     except Exception:
         return 0.0
 

@@ -1,0 +1,416 @@
+"""graftlint (ray_tpu/tools/analysis): the project-invariant analyzer.
+
+Two layers:
+
+1. fixture snippets per check — violating and clean variants, allowlist
+   parsing (mandatory reason, stale-allow detection, comments-only), and
+   knob-registry drift — run against throwaway tree roots;
+2. the real gate: the FULL analyzer over ray_tpu/ must report zero
+   unallowlisted violations and zero allowlist problems.
+
+Everything here is pure AST (the analyzer never imports the analyzed code),
+so this file stays cheap against the tier-1 budget.
+"""
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.tools.analysis import runner
+from ray_tpu.tools.analysis.checks import (
+    ALL_CHECKS,
+    BlockingControlPath,
+    HostSyncInHotPath,
+    KnobRegistry,
+    LockHygiene,
+    NoPrint,
+    SwallowedException,
+    ThreadHygiene,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_snippet(tmp_path, code, checks=None, filename="pkg/mod.py"):
+    path = tmp_path / filename
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    return runner.run_lint(str(tmp_path), subdirs=(filename.split("/")[0],),
+                           checks=checks, readme=None)
+
+
+def names(res):
+    return [(v.check, v.line) for v in res.violations]
+
+
+# -- swallowed-exception ---------------------------------------------------------------
+
+def test_swallowed_exception_flags_silent_broad_handlers(tmp_path):
+    res = lint_snippet(tmp_path, """
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+            try:
+                work()
+            except:
+                return None
+    """, checks=[SwallowedException()])
+    assert [c for c, _ in names(res)] == ["swallowed-exception"] * 2
+
+
+def test_swallowed_exception_accepts_raise_log_or_use(tmp_path):
+    res = lint_snippet(tmp_path, """
+        import logging
+
+        LOGGER = logging.getLogger(__name__)
+
+        def f():
+            try:
+                work()
+            except Exception:
+                raise RuntimeError("wrapped")
+
+        def g():
+            try:
+                work()
+            except Exception:
+                LOGGER.warning("failed")
+
+        def h():
+            try:
+                work()
+            except Exception as e:
+                record(e)  # the error goes somewhere
+
+        def narrow():
+            try:
+                work()
+            except ValueError:
+                pass  # narrow catches are a deliberate decision, not flagged
+    """, checks=[SwallowedException()])
+    assert res.violations == []
+
+
+# -- no-print --------------------------------------------------------------------------
+
+def test_no_print_flags_runtime_print_and_spares_scripts(tmp_path):
+    code = """
+        def f():
+            print("hi")
+    """
+    res = lint_snippet(tmp_path, code, checks=[NoPrint()])
+    assert [c for c, _ in names(res)] == ["no-print"]
+    res = lint_snippet(tmp_path, code, checks=[NoPrint()],
+                       filename="app/ray_tpu/scripts/cli.py")
+    assert res.violations == []
+
+
+# -- thread-hygiene / lock-hygiene -----------------------------------------------------
+
+def test_thread_hygiene_requires_daemon_and_name(tmp_path):
+    res = lint_snippet(tmp_path, """
+        import threading
+
+        def f():
+            threading.Thread(target=f).start()              # both missing
+            threading.Thread(target=f, daemon=True).start() # name missing
+            threading.Thread(target=f, daemon=False, name="ok").start()
+    """, checks=[ThreadHygiene()])
+    assert [c for c, _ in names(res)] == ["thread-hygiene"] * 2
+
+
+def test_lock_hygiene_flags_mixed_locked_unlocked_writes(tmp_path):
+    res = lint_snippet(tmp_path, """
+        import threading
+
+        class Spawner:
+            def __init__(self):
+                self.x = 0          # construction: never flagged
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self.run, daemon=True, name="t").start()
+
+            def run(self):
+                with self._lock:
+                    self.x = 1      # declares x lock-protected
+
+            def poke(self):
+                self.x = 2          # unlocked write -> flagged
+
+            def _poke_locked(self):
+                self.x = 3          # *_locked convention: caller holds it
+    """, checks=[LockHygiene()])
+    assert len(res.violations) == 1
+    assert res.violations[0].check == "lock-hygiene"
+
+
+def test_lock_hygiene_ignores_threadless_classes_and_start_gates(tmp_path):
+    res = lint_snippet(tmp_path, """
+        import threading
+
+        class NoThreads:
+            def a(self):
+                with self._lock:
+                    self.x = 1
+
+            def b(self):
+                self.x = 2   # no threads spawned anywhere in the class
+
+        class StartGate:
+            def start(self):
+                with self._start_lock:
+                    self.state = init()   # one-time init, not a guard
+                threading.Thread(target=self.run, daemon=True, name="t").start()
+
+            def run(self):
+                self.state = step(self.state)
+    """, checks=[LockHygiene()])
+    assert res.violations == []
+
+
+# -- host-sync-in-hot-path -------------------------------------------------------------
+
+def test_host_sync_flags_syncs_in_hot_paths_and_one_level_callees(tmp_path):
+    res = lint_snippet(tmp_path, """
+        import numpy as np
+        from ray_tpu.util.hot_path import hot_path
+
+        class Engine:
+            @hot_path
+            def step(self):
+                x = self.compute()
+                v = float(x)            # scalarize in hot fn
+                self.emit(x)
+
+            def emit(self, x):
+                return np.asarray(x)    # one-level callee
+
+            def cold(self, x):
+                return x.item()         # unregistered: not flagged
+    """, checks=[HostSyncInHotPath()])
+    assert len(res.violations) == 2
+    assert all(v.check == "host-sync-in-hot-path" for v in res.violations)
+
+
+def test_host_sync_quiet_without_hot_path_registration(tmp_path):
+    res = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def anywhere(x):
+            return np.asarray(x).item()
+    """, checks=[HostSyncInHotPath()])
+    assert res.violations == []
+
+
+# -- blocking-control-path -------------------------------------------------------------
+
+def test_blocking_control_flags_async_control_group_and_control_path(tmp_path):
+    res = lint_snippet(tmp_path, """
+        import time
+        import ray_tpu
+        from ray_tpu.util.hot_path import control_path
+
+        async def handler():
+            time.sleep(1)                   # blocks the event loop
+
+        class Replica:
+            def _actor_method(**kw):
+                pass
+
+            @_actor_method(concurrency_group="control")
+            def check_health(self):
+                return ray_tpu.get(self.ref)  # blocks the control lane
+
+        @control_path
+        def drain_poll(sock):
+            sock.recv(1)                    # blocks a health/drain path
+
+        def data_plane(sock):
+            time.sleep(1)                   # ordinary code: not flagged
+            sock.recv(1)
+    """, checks=[BlockingControlPath()])
+    assert [c for c, _ in names(res)] == ["blocking-control-path"] * 3
+
+
+def test_blocking_control_skips_nested_defs(tmp_path):
+    res = lint_snippet(tmp_path, """
+        import time
+
+        async def spawn():
+            def worker():       # runs on its own thread, not the event loop
+                time.sleep(1)
+            return worker
+    """, checks=[BlockingControlPath()])
+    assert res.violations == []
+
+
+# -- allowlist mechanics ---------------------------------------------------------------
+
+def test_allow_suppresses_with_reason_same_line_or_above(tmp_path):
+    res = lint_snippet(tmp_path, """
+        def f():
+            try:
+                work()
+            except Exception:  # graftlint: allow[swallowed-exception] probe only
+                pass
+            try:
+                work()
+            # graftlint: allow[swallowed-exception] second probe
+            except Exception:
+                pass
+    """, checks=[SwallowedException()])
+    assert res.violations == [] and res.problems == []
+    assert len(res.allowed) == 2
+
+
+def test_allow_without_reason_is_a_problem(tmp_path):
+    res = lint_snippet(tmp_path, """
+        def f():
+            try:
+                work()
+            except Exception:  # graftlint: allow[swallowed-exception]
+                pass
+    """, checks=[SwallowedException()])
+    assert res.violations == []
+    assert [p.check for p in res.problems] == ["allowlist"]
+    assert "no reason" in res.problems[0].message
+
+
+def test_stale_and_unknown_allows_are_problems(tmp_path):
+    res = lint_snippet(tmp_path, """
+        # graftlint: allow[swallowed-exception] nothing fires here
+        X = 1
+        # graftlint: allow[not-a-check] bogus
+        Y = 2
+    """, checks=[SwallowedException()])
+    msgs = sorted(p.message for p in res.problems)
+    assert len(msgs) == 2
+    assert any("stale" in m for m in msgs)
+    assert any("no known check" in m for m in msgs)
+
+
+def test_allow_inside_string_literal_does_not_count(tmp_path):
+    res = lint_snippet(tmp_path, '''
+        DOC = "# graftlint: allow[swallowed-exception] inside a string"
+
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+    ''', checks=[SwallowedException()])
+    # the string is not a comment: the violation fires, no stale-allow problem
+    assert [c for c, _ in names(res)] == ["swallowed-exception"]
+    assert res.problems == []
+
+
+# -- knob-registry ---------------------------------------------------------------------
+
+KNOBS_FIXTURE = '''
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    env: str
+    type: str
+    default: Any
+    doc: str
+    subsystem: str
+    attr: Optional[str] = None
+    internal: bool = False
+
+
+KNOBS: List[Knob] = [
+    Knob("RAY_TPU_FIXTURE_USED", "int", 1, "used knob", "core"),
+    Knob("RAY_TPU_FIXTURE_STALE", "int", 2, "stale knob", "core"),
+]
+REGISTRY: Dict[str, Knob] = {k.env: k for k in KNOBS}
+SUBSYSTEMS = ["core"]
+
+
+def generate_readme(text):
+    return text
+'''
+
+
+def knob_tree(tmp_path, reader_code):
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "knobs.py").write_text(KNOBS_FIXTURE)
+    (pkg / "reader.py").write_text(textwrap.dedent(reader_code))
+    return runner.run_lint(str(tmp_path), subdirs=("ray_tpu",),
+                           checks=[KnobRegistry()], readme=None)
+
+
+def test_knob_registry_flags_unregistered_and_stale(tmp_path):
+    res = knob_tree(tmp_path, """
+        import os
+
+        A = os.environ.get("RAY_TPU_FIXTURE_USED")
+        B = os.environ.get("RAY_TPU_FIXTURE_UNKNOWN")
+    """)
+    msgs = sorted(v.message for v in res.violations)
+    assert len(msgs) == 2
+    assert any("RAY_TPU_FIXTURE_UNKNOWN is not registered" in m for m in msgs)
+    assert any("RAY_TPU_FIXTURE_STALE is registered but nothing references"
+               in m for m in msgs)
+
+
+def test_knob_registry_clean_when_all_used(tmp_path):
+    res = knob_tree(tmp_path, """
+        import os
+
+        A = os.environ.get("RAY_TPU_FIXTURE_USED")
+        B = os.environ.get("RAY_TPU_FIXTURE_STALE")
+    """)
+    assert res.violations == []
+
+
+# -- the real registry + README --------------------------------------------------------
+
+def test_registry_covers_every_knob_in_the_tree():
+    """Every RAY_TPU_* literal in the package resolves in ray_tpu/knobs.py,
+    and the registry's own accounting matches CONFIG."""
+    from ray_tpu import knobs
+    from ray_tpu.config import CONFIG
+
+    assert len(knobs.KNOBS) >= 123
+    flags = {f.env: f for f in CONFIG.flags()}
+    regd = {k.env: k for k in knobs.KNOBS if k.attr}
+    assert set(flags) == set(regd)
+    for env, f in flags.items():
+        assert f.type == regd[env].type and f.default == regd[env].default
+    # every knob carries the registry contract
+    for k in knobs.KNOBS:
+        assert k.doc and k.subsystem and k.type in ("int", "float", "bool", "str")
+
+
+def test_readme_tables_are_generated_and_current():
+    from ray_tpu import knobs
+
+    text = open(os.path.join(REPO_ROOT, "README.md"), encoding="utf-8").read()
+    assert knobs.generate_readme(text) == text, (
+        "README knob tables drifted from ray_tpu/knobs.py — run "
+        "`ray-tpu lint --write-docs`")
+    for sub in knobs.SUBSYSTEMS:
+        assert f"<!-- knobs:{sub} " in text
+
+
+# -- the gate: the whole package is clean ----------------------------------------------
+
+def test_ray_tpu_tree_is_lint_clean():
+    res = runner.run_lint(REPO_ROOT, subdirs=("ray_tpu",))
+    rendered = "\n".join(v.render() for v in res.failures[:25])
+    assert not res.failures, f"graftlint violations:\n{rendered}"
+    assert res.files > 150  # the walk actually saw the package
+
+
+def test_cli_lint_entrypoint_runs_clean():
+    # scoped to the analyzer's own package: exercises the CLI surface without
+    # a second full-tree walk (the gate above already did one; tier-1 budget)
+    assert runner.main(["--root", REPO_ROOT, "ray_tpu/tools"]) == 0
