@@ -994,6 +994,204 @@ def run_chaos_shed(port, *, moq=2, max_queued=2, service_s=0.05,
     }
 
 
+def run_chaos_autoscale(port, *, moq=2, service_s=0.08, scrape_interval_s=1.0,
+                        warm_s=6.0, step_s=14.0, app="chaos-auto"):
+    """The closed-loop scenario: a mode="slo" autoscaled deployment under
+    open-loop load. Part A — SIGKILL a replica: the loop (not an operator)
+    must restore the running count to target and the burning SLO must return
+    to ok within 5 scrape intervals of the burn. Part B — step the offered
+    load to 2x: queue depth over target must scale the fleet up and goodput
+    after the scale-up must reach >= 1.2x the pre-scale goodput. Returns the
+    `autoscale` section for SERVE_CHAOS_BENCH.json."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.util import slo as slo_mod
+    from ray_tpu.util.fault_injection import ChaosController
+    from ray_tpu.util.state import serve_autoscaler_status
+
+    prev_scrape = os.environ.get("RAY_TPU_METRICS_SCRAPE_INTERVAL_S")
+    # the recovery budget is denominated in scrape intervals, so pin the
+    # interval for this scenario (the scraper re-reads it live)
+    os.environ["RAY_TPU_METRICS_SCRAPE_INTERVAL_S"] = str(scrape_interval_s)
+    unsub = None
+    gen = gen2 = None
+    try:
+
+        @serve.deployment
+        class AutoTarget:
+            def __call__(self, _body):
+                time.sleep(service_s)
+                return {"ok": True}
+
+        replicas0 = 2
+        cap_per_replica = moq / service_s
+        base_rps = 0.8 * replicas0 * cap_per_replica  # busy but unsaturated at 2
+        serve.run(AutoTarget.options(
+            num_replicas=replicas0, max_ongoing_requests=moq,
+            health_check_period_s=0.5,
+            autoscaling_config=serve.AutoscalingConfig(
+                min_replicas=replicas0, max_replicas=4, mode="slo",
+                target_queue_depth=1.5 * moq)).bind(),
+            name=app, route_prefix=f"/{app}")
+        url = f"http://127.0.0.1:{port}/{app}?x=1"
+        gen = _LoadGen(url, max_workers=256)
+        run_t0 = time.perf_counter()
+        transitions = []
+        controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+
+        def running_count():
+            info = ray_tpu.get(controller.get_deployment_info.remote(
+                app, "AutoTarget"))
+            return (info or {}).get("num_running", 0), \
+                (info or {}).get("target_num_replicas", 0)
+
+        # warm-up at base load, then derive the SLO threshold from measured p50
+        load = threading.Thread(target=gen.run, args=(base_rps, warm_s),
+                                daemon=True, name="bench-autoscale-warm")
+        load.start()
+        time.sleep(warm_s * 0.75)
+        warm = gen.window(1.0, time.perf_counter() - run_t0, status=200)
+        if not warm:
+            raise RuntimeError("autoscale warm-up produced no successful samples "
+                               "— serve bring-up failed before the chaos")
+        base_lat = [r[1] for r in warm]
+        base_p50 = _percentile(base_lat, 0.5)
+        thr = max(2.5 * base_p50, 1.2 * (_percentile(base_lat, 0.99) or base_p50))
+        slo_mod.register(slo_mod.SLO(
+            "autoscale_ttft", metric="serve_ttft_seconds", objective=0.85,
+            threshold=thr, window_s=3.0, kind="latency"))
+        unsub = slo_mod.subscribe_slo(lambda ev: transitions.append(
+            (time.perf_counter() - run_t0, ev["from"], ev["to"])))
+        load.join()
+
+        # -- part A: kill a replica mid-load; the loop must replace it ----------
+        load = threading.Thread(target=gen.run, args=(base_rps, 12.0),
+                                daemon=True, name="bench-autoscale-kill")
+        load.start()
+        time.sleep(0.5)
+        assert ChaosController().kill_replica(app, "AutoTarget", index=0)
+        killed_at = time.perf_counter() - run_t0
+        # first observe the death land in the controller's view (running < target),
+        # THEN time how long the loop takes to get back to target — otherwise the
+        # pre-kill view (2/2) would satisfy the check instantly
+        death_seen = False
+        replaced_s = None
+        t_deadline = time.perf_counter() + 11.0
+        while time.perf_counter() < t_deadline:
+            n, tgt = running_count()
+            if not death_seen:
+                death_seen = n < max(tgt, replicas0)
+            elif n >= tgt >= replicas0:
+                replaced_s = round(time.perf_counter() - run_t0 - killed_at, 2)
+                break
+            time.sleep(0.1)
+        load.join()
+        burn = next((t for t, _f, to in transitions
+                     if to == "burning" and t >= killed_at), None)
+        ok_after = next((t for t, f, to in transitions
+                         if f == "burning" and to == "ok"
+                         and burn is not None and t > burn), None)
+        slo_recovery_s = round(ok_after - burn, 2) if burn and ok_after else None
+        recovery_budget_s = 5 * scrape_interval_s
+
+        # -- part B: 2x load step -> queue pressure -> scale-up -> goodput ------
+        # a FRESH deployment: part A's burn may have already raised the first
+        # app's target, which would pollute the pre-scale baseline
+        serve.delete(app)
+        step_app = f"{app}-step"
+        serve.run(AutoTarget.options(
+            num_replicas=replicas0, max_ongoing_requests=moq,
+            health_check_period_s=0.5,
+            autoscaling_config=serve.AutoscalingConfig(
+                min_replicas=replicas0, max_replicas=4, mode="slo",
+                target_queue_depth=1.5 * moq)).bind(),
+            name=step_app, route_prefix=f"/{step_app}")
+
+        def running_count_step():
+            info = ray_tpu.get(controller.get_deployment_info.remote(
+                step_app, "AutoTarget"))
+            return (info or {}).get("num_running", 0), \
+                (info or {}).get("target_num_replicas", 0)
+
+        step_rps = 2.0 * base_rps  # 2x the two-replica operating point
+        gen2 = _LoadGen(f"http://127.0.0.1:{port}/{step_app}?x=1", max_workers=256)
+        step_started = time.perf_counter()  # gen2 records t_sched relative to this
+        load = threading.Thread(target=gen2.run, args=(step_rps, step_s),
+                                daemon=True, name="bench-autoscale-step")
+        load.start()
+        scale_up_at = None  # seconds into the step, gen2's clock
+        t_deadline = step_started + step_s
+        while time.perf_counter() < t_deadline:
+            n, tgt = running_count_step()
+            if tgt > replicas0 and n >= tgt:
+                scale_up_at = time.perf_counter() - step_started
+                break
+            time.sleep(0.2)
+        load.join()
+    finally:
+        # any mid-scenario failure must not leak the pinned scrape interval,
+        # the derived SLO, or its subscriber into the rest of the process
+        for g in (gen, gen2):
+            if g is not None:
+                g.drain()
+        if unsub is not None:
+            unsub()
+        try:
+            slo_mod.remove("autoscale_ttft")
+        except Exception:
+            pass
+        if prev_scrape is None:
+            os.environ.pop("RAY_TPU_METRICS_SCRAPE_INTERVAL_S", None)
+        else:
+            os.environ["RAY_TPU_METRICS_SCRAPE_INTERVAL_S"] = prev_scrape
+    n_final, tgt_final = running_count_step()
+
+    # goodput before the scale-up landed vs after, windowed on COMPLETION
+    # time (submit + latency): nothing is shed here, so submit-windows would
+    # just echo the offered rate — completions are what capacity bounds
+    with gen2._lock:
+        done_at = [(t + lat) for t, lat, st_, _ in gen2.records if st_ == 200]
+    drain_end = max(done_at) if done_at else step_s
+    split = scale_up_at if scale_up_at is not None else step_s / 3.0
+    split = min(max(split, 1.0), step_s - 2.0)
+    pre_goodput = sum(1 for d in done_at if d < split) / split
+    post_span = max(drain_end, step_s) - split
+    post_goodput = (sum(1 for d in done_at if d >= split) / post_span
+                    if post_span > 0 else 0.0)
+    ratio = post_goodput / pre_goodput if pre_goodput else 0.0
+
+    status = serve_autoscaler_status()
+    scale_events = [d for d in status["decisions"] if d.get("event") == "scale"]
+    section = {
+        "offered_rps_base": round(base_rps, 1),
+        "offered_rps_step": round(step_rps, 1),
+        "scrape_interval_s": scrape_interval_s,
+        "slo_threshold_ms": round(thr * 1e3, 1),
+        "replica_replaced_s": replaced_s,
+        "slo_transitions": [(round(t, 2), f, to) for t, f, to in transitions],
+        "slo_burn_to_ok_s": slo_recovery_s,
+        "recovery_budget_s": recovery_budget_s,
+        "scale_up_at_s": round(scale_up_at, 2) if scale_up_at else None,
+        "final_running": n_final,
+        "final_target": tgt_final,
+        "pre_scale_goodput_rps": round(pre_goodput, 1),
+        "post_scale_goodput_rps": round(post_goodput, 1),
+        "goodput_ratio": round(ratio, 3),
+        "decisions": scale_events[-8:],
+        "loop_alive": status["alive"],
+    }
+    section["gates"] = {
+        "replica_replaced_by_loop": replaced_s is not None,
+        "slo_recovered_within_budget": (
+            slo_recovery_s is not None
+            and slo_recovery_s <= recovery_budget_s),
+        "scale_up_observed": scale_up_at is not None and tgt_final > replicas0,
+        "goodput_ratio_ge_1_2": ratio >= 1.2,
+    }
+    section["all_gates_pass"] = all(section["gates"].values())
+    return section
+
+
 def chaos_main():
     # fast control loop for a ~30s bench: scrape + worker metric pushes at
     # 250ms so the SLO engine sees the burn while it is happening
@@ -1011,9 +1209,12 @@ def chaos_main():
             results.update(run_chaos_kill(
                 port, rps=30.0, service_s=0.06, warm_s=3.0, post_kill_s=9.0))
             results.update(run_chaos_shed(port, phase_s=3.0))
+            results["autoscale"] = run_chaos_autoscale(
+                port, service_s=0.06, warm_s=4.0, step_s=10.0)
         else:
             results.update(run_chaos_kill(port))
             results.update(run_chaos_shed(port))
+            results["autoscale"] = run_chaos_autoscale(port)
         gates = {
             "zero_lost_requests": results["kill_zero_lost"],
             "slo_burn_and_recovery": (results["kill_slo_burn_observed"]
@@ -1022,6 +1223,7 @@ def chaos_main():
             "shed_503_with_retry_after": (results["shed_rejections_observed"]
                                           and results["shed_retry_after_present"]),
             "goodput_within_20pct_at_2x": results["shed_goodput_within_20pct"],
+            "autoscale_loop_closed": results["autoscale"]["all_gates_pass"],
         }
         results["gates"] = gates
         results["all_gates_pass"] = all(gates.values())
@@ -1036,6 +1238,10 @@ def chaos_main():
     with open(out, "w") as f:
         json.dump(results, f, indent=2)
     print(f"wrote {out}")
+    if not results.get("all_gates_pass"):
+        print("CHAOS GATES FAILED:",
+              [k for k, v in results.get("gates", {}).items() if not v])
+        sys.exit(1)
     return results
 
 

@@ -8,7 +8,13 @@ the v1 NodeProvider ABC (node_provider.py) and the fake provider used for tests
 resource), and the provider contract is "provision a slice", not "launch a VM".
 """
 from .node_provider import FakeNodeProvider, NodeAgentProvider, NodeProvider, NodeType
-from .autoscaler import Autoscaler, AutoscalingConfig
+from .autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    clear_demand_hint,
+    demand_hints,
+    post_demand_hint,
+)
 
 __all__ = [
     "NodeProvider",
@@ -17,4 +23,7 @@ __all__ = [
     "NodeType",
     "Autoscaler",
     "AutoscalingConfig",
+    "post_demand_hint",
+    "clear_demand_hint",
+    "demand_hints",
 ]

@@ -35,6 +35,35 @@ def _fits(resources: Dict[str, float], capacity: Dict[str, float]) -> bool:
     return all(capacity.get(k, 0.0) >= v for k, v in resources.items() if v > 0)
 
 
+# -- demand hints: other control planes hand anticipated demand to the node
+# autoscaler BEFORE their actors hit the pending queue (the serve autoscaler
+# posts "serve:<app>/<deployment>" hints for scale-ups no host has room for,
+# so node launch overlaps replica-start retries instead of serializing after
+# them). Module-level so hint producers need no Autoscaler handle.
+_hints_lock = threading.Lock()
+_demand_hints: Dict[str, List[Dict[str, float]]] = {}
+
+
+def post_demand_hint(key: str, shapes: List[Dict[str, float]]) -> None:
+    """Publish (replace) anticipated resource demand under `key`. Each shape
+    is one resource bundle the producer will try to place soon."""
+    with _hints_lock:
+        if shapes:
+            _demand_hints[key] = [dict(s) for s in shapes]
+        else:
+            _demand_hints.pop(key, None)
+
+
+def clear_demand_hint(key: str) -> None:
+    with _hints_lock:
+        _demand_hints.pop(key, None)
+
+
+def demand_hints() -> Dict[str, List[Dict[str, float]]]:
+    with _hints_lock:
+        return {k: [dict(s) for s in v] for k, v in _demand_hints.items()}
+
+
 def bin_pack(demands: List[Dict[str, float]], node_types: List, existing_headroom:
              List[Dict[str, float]]) -> Dict[str, int]:
     """First-fit-decreasing pack of resource demands; returns {node_type: count} to add.
@@ -134,6 +163,11 @@ class Autoscaler:
                     out.append(dict(spec.resources))
             for pg in c.pending_pgs:
                 out.extend(dict(b) for b in pg.bundle_specs)
+        # anticipated demand other control planes handed off (serve
+        # autoscaler scale-ups stuck without room): bin-packed like pending
+        # work so capacity launches before the actors themselves queue up
+        for shapes in demand_hints().values():
+            out.extend(shapes)
         return out
 
     def _headroom(self) -> List[Dict[str, float]]:

@@ -679,7 +679,8 @@ class Cluster:
         # retry instead of hanging forever — then rebuild below. (Journal
         # records deleted by that cleanup won't rebind; a blip on a LIVE head
         # keeps the pre-existing conn-EOF-is-node-death semantics.)
-        old = self._agents_by_key.get(node_hex)
+        with self._lock:
+            old = self._agents_by_key.get(node_hex)
         if old is not None:
             self._on_agent_death(old)
         node = RemoteNodeRuntime(self, node_id, resources, labels, max_workers)
@@ -690,7 +691,9 @@ class Cluster:
             agent.data_addr = (stream.peer_ip, int(data_port))
         stream.on_message = lambda m: self._handle_agent_message(agent, m)
         stream.on_disconnect = lambda: self._on_agent_death(agent)
-        # journaled actor records for this host, by worker id
+        # READ phase — journaled actor records for this host, by worker id.
+        # The KV reads (gcs's own leaf lock, possibly file-journal I/O) stay
+        # OUTSIDE self._lock; only the commit below holds it.
         by_wid: Dict[str, Dict[str, Any]] = {}
         for key in self.gcs.kv.keys(namespace="@actors"):
             try:
@@ -700,32 +703,65 @@ class Cluster:
                 continue
             if rec.get("host") == node_hex:
                 by_wid[rec["wid"]] = rec
-        keep: List[str] = []
+        candidates = [(wid_hex, accel, by_wid[wid_hex])
+                      for wid_hex, accel in (extras or {}).get("workers", ())
+                      if wid_hex in by_wid]
+        # workers without a journal record ran plain tasks for the dead head:
+        # the agent kills everything missing from keep_workers
+        keep = [wid_hex for wid_hex, _, _ in candidates]
+        # COMMIT phase — the scheduler/router threads read the actor table and
+        # worker bindings under self._lock, so every mutation lands inside one
+        # locked block, and it must land BEFORE send_welcome_back: the moment
+        # the agent hears back it may emit worker_death/from_worker messages,
+        # which dispatch through agent.workers on the stream reader thread.
+        # Lock-order audit: node.ledger and the gcs registries guard
+        # themselves with private leaf locks and never call back into
+        # Cluster, so taking them under self._lock cannot invert; the
+        # journal/KV I/O stayed above, outside the lock.
+        named: List[Tuple[Dict[str, Any], Any]] = []
         rebound = 0
-        for wid_hex, accel in (extras or {}).get("workers", ()):
-            rec = by_wid.get(wid_hex)
-            if rec is None:
-                continue  # ran plain tasks for the dead head: agent kills it
-            w = RemoteWorkerHandle(WorkerID.from_hex(wid_hex), agent, node, accel)
-            w.state = "idle"
-            node.workers[w.worker_id] = w
-            agent.workers[wid_hex] = w
-            spec = rec["creation_spec"]
-            st = self.actors.get(spec.actor_id)
-            if st is None:
-                st = ActorState(spec.actor_id, spec, rec["method_meta"])
-                # graftlint: allow[lock-hygiene] REAL but deferred: reattach mutates the actor table outside self._lock; locking here risks lock-order inversion with gcs/ledger calls (see ROADMAP "head-restart reattach locking")
-                self.actors[spec.actor_id] = st
-            st.state = "alive"
-            st.worker = w
-            w.actor_id = spec.actor_id
-            node.ledger.try_acquire(dict(spec.resources))  # actor-lifetime hold
-            w.resources_held = dict(spec.resources)
-            if rec.get("name"):
-                self.gcs.register_named_actor(rec["name"], rec.get("namespace", ""),
-                                              spec.actor_id)
-            keep.append(wid_hex)
-            rebound += 1
+        with self._lock:
+            for wid_hex, accel, rec in candidates:
+                w = RemoteWorkerHandle(WorkerID.from_hex(wid_hex), agent, node,
+                                       accel)
+                w.state = "idle"
+                node.workers[w.worker_id] = w
+                agent.workers[wid_hex] = w
+                spec = rec["creation_spec"]
+                st = self.actors.get(spec.actor_id)
+                if st is None:
+                    st = ActorState(spec.actor_id, spec, rec["method_meta"])
+                    self.actors[spec.actor_id] = st
+                st.state = "alive"
+                st.worker = w
+                w.actor_id = spec.actor_id
+                node.ledger.try_acquire(dict(spec.resources))  # actor-lifetime hold
+                w.resources_held = dict(spec.resources)
+                if rec.get("name"):
+                    named.append((rec, spec.actor_id))
+                rebound += 1
+            self._nodes[node_id] = node
+            if node_id not in self._node_order:
+                self._node_order.append(node_id)
+            self._agent_conns[stream] = agent
+            self._agents_by_key[node_hex] = agent
+        try:
+            stream.send_welcome_back({"keep_workers": keep})
+        except Exception as e:
+            # the stream died between reconnect and welcome-back: unwind the
+            # just-committed state through the normal death path (fails the
+            # rebound workers, drops the node) instead of leaving a live-
+            # looking node bound to a dead stream
+            import logging as _logging
+
+            _logging.getLogger("ray_tpu.node").warning(
+                "node %s reconnect stream died before welcome-back (%r); "
+                "unwinding the reattach", node_hex[:8], e)
+            self._on_agent_death(agent)
+            return False
+        for rec, actor_id in named:
+            self.gcs.register_named_actor(rec["name"], rec.get("namespace", ""),
+                                          actor_id)
         # the agent's arena contents go back into the directory, pinned (their
         # owner refs died with the old head's drivers)
         arena_name = (extras or {}).get("arena")
@@ -736,17 +772,6 @@ class Cluster:
                                      ("arena", arena_name, oid_bytes, size,
                                       bool(flags & 1))))
                 self.store.incref(oid)
-        try:
-            stream.send_welcome_back({"keep_workers": keep})
-        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
-        except Exception:
-            return False
-        with self._lock:
-            self._nodes[node_id] = node
-            if node_id not in self._node_order:
-                self._node_order.append(node_id)
-            self._agent_conns[stream] = agent
-            self._agents_by_key[node_hex] = agent
         self.gcs.register_node(NodeInfo(node_id=node_id, resources=dict(resources),
                                         labels={**(labels or {}), "agent": "remote"}))
         import logging as _logging
@@ -756,6 +781,18 @@ class Cluster:
         _logging.getLogger("ray_tpu.node").warning(
             "node %s re-attached: %d actors rebound, %d objects re-added",
             node_hex[:8], rebound, len((extras or {}).get("objects", ())))
+        if any(rec.get("name") == "SERVE_CONTROLLER" for rec, _ in named):
+            # a rebound serve controller means apps are live again: restart
+            # the head-side autoscaling loop in THIS head process — its
+            # targets re-derive from the controller's restored configs
+            try:
+                from ray_tpu.serve.autoscaler import ensure_serve_autoscaler
+
+                ensure_serve_autoscaler()
+            except Exception as e:  # noqa: BLE001 — serving works unscaled
+                _logging.getLogger("ray_tpu.node").warning(
+                    "could not restart the serve autoscaler after reattach "
+                    "(autoscaling paused until a serve API call): %r", e)
         self._schedule()
         return True
 

@@ -329,6 +329,44 @@ KNOBS: List[Knob] = [
          "on scale-down/rolling update/shutdown before it is killed anyway "
          "(per-deployment override: drain_timeout_s).",
          "serve", attr="serve_drain_timeout_s"),
+    Knob("RAY_TPU_SERVE_AUTOSCALE_INTERVAL_S", "float", 0.0,
+         "Tick period of the head-side serve autoscaling loop "
+         "(serve/autoscaler.py). 0 (default) paces on the metrics-history "
+         "scraper's frames (one decision pass per scrape), which keeps the "
+         "loop and its inputs in lockstep.",
+         "serve", attr="serve_autoscale_interval_s"),
+    Knob("RAY_TPU_SERVE_AUTOSCALE_BURN_TICKS", "int", 2,
+         "Consecutive ticks an SLO burn / queue-over-target signal must "
+         "persist before the loop scales a deployment up (the short half of "
+         "the hysteresis pair: one noisy scrape never resizes the fleet).",
+         "serve", attr="serve_autoscale_burn_ticks"),
+    Knob("RAY_TPU_SERVE_AUTOSCALE_CLEAN_TICKS", "int", 3,
+         "Consecutive clean ticks (no burning SLO, no queue pressure) "
+         "required before a scale-down is considered (the long half of the "
+         "hysteresis pair; scale-down additionally needs the down-cooldown "
+         "elapsed and no replica still DRAINING).",
+         "serve", attr="serve_autoscale_clean_ticks"),
+    Knob("RAY_TPU_SERVE_AUTOSCALE_UP_COOLDOWN_S", "float", 3.0,
+         "Minimum seconds between successive scale-UPs of one deployment "
+         "(lets the previous step's replicas absorb load before adding more).",
+         "serve", attr="serve_autoscale_up_cooldown_s"),
+    Knob("RAY_TPU_SERVE_AUTOSCALE_DOWN_COOLDOWN_S", "float", 30.0,
+         "Minimum seconds after ANY scale change before a scale-down (a "
+         "flapping SLO must not thrash the paged-KV pool with drain/start "
+         "churn).",
+         "serve", attr="serve_autoscale_down_cooldown_s"),
+    Knob("RAY_TPU_SERVE_AUTOSCALE_QUEUE_TARGET", "float", 4.0,
+         "Default desired in-flight requests per replica for mode=\"slo\" "
+         "autoscaling (per-deployment override: "
+         "AutoscalingConfig.target_queue_depth). The loop scales toward "
+         "ceil(queue_depth / target).",
+         "serve", attr="serve_autoscale_queue_target"),
+    Knob("RAY_TPU_SERVE_AUTOSCALE_STARTUP_TIMEOUT_S", "float", 30.0,
+         "How long a scale-up may sit below target before it is declared "
+         "stuck: the deficit is handed to the node autoscaler as a demand "
+         "hint, wedged STARTING replicas restart elsewhere, and the handle's "
+         "anticipated-capacity admission window expires (shedding resumes).",
+         "serve", attr="serve_autoscale_startup_timeout_s"),
     # -- llm
     Knob("RAY_TPU_PD_EXPORT_TTL_S", "float", 600.0,
          "Device-plane auto-release backstop for P/D prefill KV exports whose "

@@ -137,6 +137,24 @@ def _render_status(s: dict) -> str:
                      f"ttft_p50={ms(sv.get('ttft_p50_s'))} "
                      f"ttft_p99={ms(sv.get('ttft_p99_s'))} "
                      f"queue_depth[{depth or '-'}]")
+    asc = sv.get("autoscale") or {}
+    if asc.get("targets") or asc.get("decisions_by_reason"):
+        for key, row in sorted(asc.get("targets", {}).items()):
+            burn = "burning" if row.get("burning") else "ok"
+            lines.append(
+                f"autoscale  {key}: target={row.get('target')} "
+                f"running={row.get('running')} "
+                f"queue={row.get('queue_depth', 0):.0f} {burn} "
+                f"({row.get('reason', '-')})")
+        last = asc.get("last_decision")
+        reasons = " ".join(f"{k}={v}" for k, v in sorted(
+            asc.get("decisions_by_reason", {}).items()))
+        tail = f"  last={last.get('event')}:{last.get('reason', '')}" \
+            if isinstance(last, dict) and last.get("event") != "scale" else ""
+        if last and isinstance(last, dict) and last.get("event") == "scale":
+            tail = (f"  last={last['key']} {last['from']}->{last['to']} "
+                    f"({last['reason']})")
+        lines.append(f"autoscale  decisions[{reasons or '-'}]{tail}")
     llm = s.get("llm", {})
     if llm.get("prefix_cache_hits") or llm.get("active") or llm.get("pending"):
         fused = " ".join(f"{k}:{int(v)}" for k, v in sorted(

@@ -25,6 +25,12 @@ class _HandleMarker:
 
 
 def _get_or_create_controller():
+    # every serve entry point keeps the head-side autoscaling loop alive (it
+    # no-ops off the head process and when already running); the head-restart
+    # reattach path restarts it independently (core/node.py)
+    from .autoscaler import ensure_serve_autoscaler
+
+    ensure_serve_autoscaler()
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
@@ -155,8 +161,10 @@ def get_deployment_handle(deployment_name: str, app_name: str = "default") -> De
 
 
 def shutdown() -> None:
+    from .autoscaler import shutdown_serve_autoscaler
     from .handle import _reset_long_poll
 
+    shutdown_serve_autoscaler()  # before the controller: no scale RPCs mid-kill
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.shutdown.remote())

@@ -1,0 +1,634 @@
+"""Serve autoscaling control loop: SLO-burn-driven replica targets.
+
+PR 8 built the control inputs (windowed quantiles, SRE multi-window burn
+rates, ``subscribe_slo()`` transitions) and PR 11 built the actuators
+(graceful DRAINING, suspect routing, admission control) — this module closes
+the loop. The same decoupled control-plane discipline Podracer (2104.06272)
+applies to RL actors/learners, applied to serve replicas:
+
+    scrape -> metrics_history -> SLO engine -> AutoscalePolicy -> controller
+      ^                                                               |
+      '-------------------- replicas start/DRAIN <-------------------'
+
+Pieces:
+
+- :class:`AutoscalePolicy` — the pure decision core, one instance per loop.
+  Inputs are :class:`DeploymentSnapshot` rows (current target, live/starting/
+  draining counts, cluster-wide queue depth, whether a matching SLO is
+  burning); output is a desired target plus a reason. Hysteresis is built in:
+  scale-up needs the burn/queue pressure sustained for
+  ``RAY_TPU_SERVE_AUTOSCALE_BURN_TICKS`` consecutive ticks, scale-down needs
+  ``RAY_TPU_SERVE_AUTOSCALE_CLEAN_TICKS`` clean ticks AND the down-cooldown
+  elapsed AND no replica still draining (drain capacity exists) — a flapping
+  SLO holds the fleet steady instead of thrashing the paged-KV pool. The
+  floor is ``max(1, min_replicas)``: the loop never kills the last healthy
+  replica.
+- :class:`ServeAutoscalerLoop` — the head-side daemon thread. Paced by the
+  metrics-history scraper (frame subscription) and woken early by
+  ``subscribe_slo()`` transitions; every tick it re-derives the world from
+  the controller (``get_autoscale_state``) and the head's metrics history —
+  NO in-memory target state survives a head restart, so a reattached head
+  resumes from the controller's KV-restored app configs. Decisions are
+  applied through ``controller.set_autoscale_target`` (the existing DRAINING
+  choreography does the rest) and journaled three ways: a bounded in-memory
+  journal (``ray-tpu status``), ``serve.autoscale`` telemetry spans, and the
+  ``serve_autoscale_decisions_total{reason}`` counter.
+- Stuck scale-ups (a target the fleet never reaches — no host has room, or a
+  replica wedges in STARTING) time out after
+  ``RAY_TPU_SERVE_AUTOSCALE_STARTUP_TIMEOUT_S``: the deficit is posted as a
+  demand hint to the node :class:`~ray_tpu.autoscaler.Autoscaler`'s
+  bin-packing (new capacity), wedged STARTING replicas are restarted so they
+  can land elsewhere, and the handle's anticipated-capacity admission window
+  expires so callers are shed again (see handle._maybe_shed).
+
+Fault injection: ``serve.autoscaler.decide`` fires at the top of every tick
+(error mode = decision crash, absorbed + journaled; kill mode = the head
+dies, the reattach path restarts the loop), ``serve.controller.scale`` fires
+inside the controller's apply RPC.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.serve.autoscaler")
+
+AUTOSCALER_THREAD_NAME = "serve-autoscaler"
+
+
+# --------------------------------------------------------------------- policy
+
+@dataclasses.dataclass
+class DeploymentSnapshot:
+    """One deployment's world-state for one policy tick. Built by the loop
+    from the controller's autoscale state + the head's metrics history; in
+    tests, built synthetically — the policy never reads globals."""
+
+    key: str  # "app/deployment"
+    target: int
+    running: int
+    starting: int
+    draining: int
+    min_replicas: int
+    max_replicas: int
+    queue_depth: float  # cluster-wide in-flight for this deployment
+    queue_target: float  # desired in-flight per replica
+    burning: bool  # any matching SLO currently burning
+    now: float  # monotonic seconds (injectable for tests)
+
+
+@dataclasses.dataclass
+class Decision:
+    key: str
+    target: int  # current controller target
+    desired: int
+    reason: str
+
+    @property
+    def changed(self) -> bool:
+        return self.desired != self.target
+
+
+class _DeploymentPolicyState:
+    __slots__ = ("burn_ticks", "clean_ticks", "pressure_ticks",
+                 "last_scale_up", "last_scale_down", "deficit_since")
+
+    def __init__(self):
+        self.burn_ticks = 0
+        self.clean_ticks = 0
+        self.pressure_ticks = 0
+        self.last_scale_up: Optional[float] = None
+        self.last_scale_down: Optional[float] = None
+        self.deficit_since: Optional[float] = None
+
+
+class AutoscalePolicy:
+    """Per-deployment hysteresis + cooldown state around a pure decision
+    rule. ``decide()`` mutates only tick counters; cooldown stamps move in
+    ``commit()`` so a decision the controller RPC LOST does not burn the
+    cooldown (the next tick retries the same decision)."""
+
+    def __init__(self, *, burn_ticks: int = 2, clean_ticks: int = 3,
+                 up_cooldown_s: float = 3.0, down_cooldown_s: float = 30.0,
+                 startup_timeout_s: float = 30.0):
+        self.burn_ticks_needed = max(1, int(burn_ticks))
+        self.clean_ticks_needed = max(1, int(clean_ticks))
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self.startup_timeout_s = float(startup_timeout_s)
+        self._state: Dict[str, _DeploymentPolicyState] = {}
+
+    def _st(self, key: str) -> _DeploymentPolicyState:
+        st = self._state.get(key)
+        if st is None:
+            st = self._state[key] = _DeploymentPolicyState()
+        return st
+
+    def prune(self, live_keys) -> None:
+        """Forget deployments that left the autoscale view (app deleted)."""
+        for key in [k for k in self._state if k not in live_keys]:
+            del self._state[key]
+
+    def decide(self, snap: DeploymentSnapshot) -> Decision:
+        st = self._st(snap.key)
+        floor = max(1, snap.min_replicas)
+        ceil_ = max(floor, snap.max_replicas)
+
+        # -- tick the hysteresis counters
+        if snap.burning:
+            st.burn_ticks += 1
+            st.clean_ticks = 0
+        else:
+            st.burn_ticks = 0
+            st.clean_ticks += 1
+        per_replica = snap.queue_depth / max(1, snap.running)
+        if snap.queue_target > 0 and per_replica > snap.queue_target:
+            st.pressure_ticks += 1
+        else:
+            st.pressure_ticks = 0
+
+        # -- bounds first: a target outside [floor, ceil] is corrected
+        # immediately, cooldowns notwithstanding (a shrunk max must apply)
+        if snap.target < floor:
+            return Decision(snap.key, snap.target, floor, "min_floor")
+        if snap.target > ceil_:
+            return Decision(snap.key, snap.target, ceil_, "max_ceiling")
+
+        # -- scale up: sustained SLO burn or sustained queue pressure
+        burn_up = st.burn_ticks >= self.burn_ticks_needed
+        queue_up = st.pressure_ticks >= self.burn_ticks_needed
+        if (burn_up or queue_up) and snap.target < ceil_:
+            if st.last_scale_up is not None \
+                    and snap.now - st.last_scale_up < self.up_cooldown_s:
+                return Decision(snap.key, snap.target, snap.target,
+                                "up_cooldown")
+            # queue math names the replica count that meets the per-replica
+            # target; an SLO burn without queue signal steps by one
+            desired = snap.target + 1
+            if snap.queue_target > 0:
+                import math
+
+                desired = max(desired, math.ceil(
+                    snap.queue_depth / snap.queue_target))
+            desired = min(ceil_, desired)
+            return Decision(snap.key, snap.target, desired,
+                            "slo_burn" if burn_up else "queue_depth")
+
+        # -- scale down: every window clean, cooldown elapsed, and the drain
+        # plane idle (a pending drain means capacity is ALREADY leaving)
+        if snap.target > floor \
+                and st.clean_ticks >= self.clean_ticks_needed \
+                and st.pressure_ticks == 0 \
+                and snap.draining == 0 \
+                and snap.running > 1:
+            last = max(st.last_scale_down or 0.0, st.last_scale_up or 0.0)
+            if snap.now - last < self.down_cooldown_s:
+                return Decision(snap.key, snap.target, snap.target,
+                                "down_cooldown")
+            # one step at a time, and never below what the queue needs now
+            desired = snap.target - 1
+            if snap.queue_target > 0:
+                import math
+
+                desired = max(desired, math.ceil(
+                    snap.queue_depth / snap.queue_target))
+            desired = max(floor, min(snap.target, desired))
+            if desired == snap.target:
+                return Decision(snap.key, snap.target, snap.target, "hold")
+            return Decision(snap.key, snap.target, desired, "clean_scale_down")
+
+        return Decision(snap.key, snap.target, snap.target, "hold")
+
+    def commit(self, decision: Decision, now: float) -> None:
+        """The controller accepted this decision: stamp the cooldown."""
+        st = self._st(decision.key)
+        if decision.desired > decision.target:
+            st.last_scale_up = now
+            st.clean_ticks = 0
+        elif decision.desired < decision.target:
+            st.last_scale_down = now
+        st.burn_ticks = 0
+        st.pressure_ticks = 0
+
+    def stuck_deficit(self, snap: DeploymentSnapshot) -> bool:
+        """True when the fleet has been below target for longer than the
+        startup timeout — the scale-up never became healthy (no room, or a
+        wedged STARTING replica). Timer resets the moment the deficit closes."""
+        st = self._st(snap.key)
+        if snap.running >= snap.target:
+            st.deficit_since = None
+            return False
+        if st.deficit_since is None:
+            st.deficit_since = snap.now
+            return False
+        return snap.now - st.deficit_since >= self.startup_timeout_s
+
+
+# ----------------------------------------------------------------------- loop
+
+class ServeAutoscalerLoop:
+    """Head-side control loop. One instance per head process, paced by the
+    metrics scraper's frames and woken early by SLO transitions."""
+
+    JOURNAL_SIZE = 128
+
+    def __init__(self, cluster):
+        from ray_tpu.config import CONFIG
+        from ray_tpu.util.logutil import LogThrottle
+
+        self.cluster = cluster
+        self.policy = AutoscalePolicy(
+            burn_ticks=CONFIG.serve_autoscale_burn_ticks,
+            clean_ticks=CONFIG.serve_autoscale_clean_ticks,
+            up_cooldown_s=CONFIG.serve_autoscale_up_cooldown_s,
+            down_cooldown_s=CONFIG.serve_autoscale_down_cooldown_s,
+            startup_timeout_s=CONFIG.serve_autoscale_startup_timeout_s)
+        self._warn = LogThrottle(30.0)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._journal: deque = deque(maxlen=self.JOURNAL_SIZE)
+        self._targets: Dict[str, Dict[str, Any]] = {}  # last-seen view
+        self._hinted: set = set()  # deployments with a posted demand hint
+        self.ticks = 0
+        self._unsub_slo = None
+        self._unsub_frames = None
+        try:
+            self._unsub_slo = cluster.slo_engine.subscribe(self._on_slo)
+            self._unsub_frames = cluster.metrics_history.subscribe_frames(
+                self._on_frame)
+        except Exception as e:  # noqa: BLE001 — loop still paces on its timer
+            logger.warning("serve autoscaler could not subscribe to the "
+                           "scrape plane (%r); pacing on the fallback timer", e)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=AUTOSCALER_THREAD_NAME)
+        self._thread.start()
+
+    # -- wake sources ---------------------------------------------------------
+    def _on_slo(self, transition: dict) -> None:
+        # any burning<->ok flip re-evaluates immediately: scale-ups must not
+        # wait out a sleeping tick
+        self._wake.set()
+
+    def _on_frame(self, _frame: dict) -> None:
+        self._wake.set()
+
+    # -- lifecycle ------------------------------------------------------------
+    def alive(self) -> bool:
+        return self._thread.is_alive() and not self._stop.is_set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        for unsub in (self._unsub_slo, self._unsub_frames):
+            if unsub is not None:
+                try:
+                    unsub()
+                # graftlint: allow[swallowed-exception] unsubscribe from a cluster already torn down; nothing left to detach
+                except Exception:
+                    pass
+        self._thread.join(timeout=2)
+        # retract outstanding demand hints: a stopped loop must not keep
+        # phantom serve demand in the node autoscaler's bin-packing forever
+        with self._lock:
+            hinted, self._hinted = set(self._hinted), set()
+        for key in hinted:
+            self._clear_demand_hint(key)
+
+    def _interval_s(self) -> float:
+        from ray_tpu.config import CONFIG
+
+        explicit = float(CONFIG.serve_autoscale_interval_s)
+        if explicit > 0:
+            return explicit
+        # frame-driven (default): the wait is only the fallback for a stalled
+        # scraper, so pace it at the scrape interval (floored: scraping off)
+        scrape = float(CONFIG.metrics_scrape_interval_s)
+        return max(0.25, scrape) if scrape > 0 else 1.0
+
+    def _run(self) -> None:
+        from ray_tpu.core import global_state
+
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self._interval_s())
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            if getattr(self.cluster, "_shutdown", False) \
+                    or global_state.try_cluster() is not self.cluster:
+                return  # head went away: a fresh head starts a fresh loop
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — decision crash: journaled
+                # the decision path crashing must not kill the only control
+                # loop; journal it so `ray-tpu status` explains the gap
+                self._journal_event({"event": "decide_error",
+                                     "error": repr(e)}, reason="decide_error")
+                if self._warn.ready("tick"):
+                    logger.warning("serve autoscaler tick failed (loop "
+                                   "continues): %r", e)
+
+    # -- journaling -----------------------------------------------------------
+    def _journal_event(self, row: Dict[str, Any], reason: str) -> None:
+        row = {"ts": time.time(), **row}
+        with self._lock:
+            self._journal.append(row)
+        try:
+            from ray_tpu.util import telemetry
+
+            telemetry.get_counter(
+                "serve_autoscale_decisions_total",
+                "serve autoscaler decisions/outcomes by reason",
+                tag_keys=("reason",)).inc(tags={"reason": reason})
+        # graftlint: allow[swallowed-exception] telemetry emission is best-effort and must never take the control loop down
+        except Exception:
+            pass
+
+    def status(self) -> Dict[str, Any]:
+        """Introspection for `ray-tpu status` / state.serve_autoscaler_status:
+        the last-seen per-deployment view plus the recent decision journal."""
+        with self._lock:
+            return {
+                "alive": self.alive(),
+                "ticks": self.ticks,
+                "deployments": {k: dict(v) for k, v in self._targets.items()},
+                "decisions": list(self._journal),
+            }
+
+    # -- one tick -------------------------------------------------------------
+    def _controller(self):
+        import ray_tpu
+        from .controller import CONTROLLER_NAME
+
+        try:
+            return ray_tpu.get_actor(CONTROLLER_NAME)
+        except ValueError:
+            return None  # serve not running: idle tick
+
+    def _burning_names(self) -> Tuple[Dict[str, dict], List[Any]]:
+        status = self.cluster.slo_engine.status()
+        burning = {name: row for name, row in status.items()
+                   if row.get("state") == "burning"}
+        slos = {s.name: s for s in self.cluster.slo_engine.slos()}
+        return burning, [slos.get(n) for n in burning]
+
+    @staticmethod
+    def _slo_matches(slo, row: dict, app: str, deployment: str,
+                     route_prefix: str, slo_names) -> bool:
+        """Does a burning SLO drive THIS deployment? Explicit slo_names pin
+        it; otherwise the SLO's `where` tags scope it (no tags = fleet-wide)."""
+        name = row.get("name") if isinstance(row, dict) else None
+        if slo_names:
+            return name in slo_names
+        where = getattr(slo, "where", None) or {}
+        if not where:
+            return True
+        if where.get("app") not in (None, app):
+            return False
+        if where.get("deployment") not in (None, deployment):
+            return False
+        route = where.get("route")
+        if route is not None and route_prefix:
+            # path-boundary match: "/chat2" must not count as under "/chat"
+            rp = route_prefix.rstrip("/")
+            if rp and route != route_prefix and route != rp \
+                    and not route.startswith(rp + "/"):
+                return False
+        return True
+
+    def _queue_depth(self, app: str, deployment: str) -> float:
+        """Cluster-wide in-flight for the deployment: latest frame's
+        proc-summed serve_queue_depth gauge (the same accounting
+        cluster_status renders)."""
+        from ray_tpu.config import CONFIG
+
+        window = max(2.5 * float(CONFIG.metrics_scrape_interval_s or 1.0), 1.0)
+        vals = self.cluster.metrics_history.gauge_values(
+            "serve_queue_depth", window,
+            where={"app": app, "deployment": deployment})
+        return float(vals[-1]) if vals else 0.0
+
+    def tick(self) -> List[Decision]:
+        """One control pass. The world is re-derived from the controller and
+        the metrics history every time — a restarted head resumes from the
+        KV-restored app configs with no handoff."""
+        import ray_tpu
+        from ray_tpu.config import CONFIG
+        from ray_tpu.util import fault_injection, telemetry
+
+        fault_injection.fail_point("serve.autoscaler.decide")
+        controller = self._controller()
+        if controller is None:
+            return []
+        try:
+            state = ray_tpu.get(controller.get_autoscale_state.remote(),
+                                timeout=5.0)
+        except Exception as e:  # noqa: BLE001 — controller RPC loss
+            self._journal_event({"event": "state_rpc_error",
+                                 "error": repr(e)}, reason="rpc_error")
+            if self._warn.ready("state"):
+                logger.warning("serve autoscaler could not read controller "
+                               "state (retrying next tick): %r", e)
+            return []
+        self.ticks += 1
+        self.policy.prune(state)
+        now = time.monotonic()
+        burning_rows, burning_slos = self._burning_names()
+        decisions: List[Decision] = []
+        with self._lock:
+            self._targets = {k: dict(v) for k, v in state.items()}
+            for key in [k for k in self._hinted if k not in state]:
+                self._hinted.discard(key)
+                self._clear_demand_hint(key)
+        for key, row in state.items():
+            app, deployment = row["app"], row["deployment"]
+            burning = any(
+                self._slo_matches(slo, b_row, app, deployment,
+                                  row.get("route_prefix", ""),
+                                  row.get("slo_names"))
+                for (name, b_row), slo in zip(burning_rows.items(),
+                                              burning_slos))
+            queue_depth = self._queue_depth(app, deployment)
+            queue_target = float(row.get("target_queue_depth") or
+                                 CONFIG.serve_autoscale_queue_target)
+            snap = DeploymentSnapshot(
+                key=key, target=row["target"], running=row["running"],
+                starting=row["starting"], draining=row["draining"],
+                min_replicas=row["min_replicas"],
+                max_replicas=row["max_replicas"],
+                queue_depth=queue_depth, queue_target=queue_target,
+                burning=burning, now=now)
+            decision = self.policy.decide(snap)
+            decisions.append(decision)
+            with self._lock:
+                self._targets[key].update(
+                    queue_depth=queue_depth, burning=burning,
+                    desired=decision.desired, reason=decision.reason)
+            if decision.changed:
+                self._apply(controller, app, deployment, decision, snap)
+            self._handle_deficit(controller, app, deployment, row, snap)
+        if telemetry.enabled() and any(d.changed for d in decisions):
+            telemetry.event(
+                "serve.autoscale.tick", "serve",
+                changed=sum(1 for d in decisions if d.changed),
+                deployments=len(decisions))
+        return decisions
+
+    def _apply(self, controller, app: str, deployment: str,
+               decision: Decision, snap: DeploymentSnapshot) -> None:
+        """Push one accepted decision to the controller; the reconcile loop's
+        DRAINING choreography executes it. An RPC loss is journaled and the
+        cooldown NOT burned, so the next tick retries."""
+        import ray_tpu
+        from ray_tpu.util import telemetry
+
+        t0 = time.time_ns()
+        try:
+            with telemetry.span("serve.autoscale", "serve", app=app,
+                                deployment=deployment, target=decision.target,
+                                desired=decision.desired,
+                                reason=decision.reason):
+                applied = ray_tpu.get(controller.set_autoscale_target.remote(
+                    app, deployment, decision.desired,
+                    reason=decision.reason), timeout=5.0)
+        except Exception as e:  # noqa: BLE001 — controller RPC loss
+            self._journal_event(
+                {"event": "scale_rpc_error", "key": decision.key,
+                 "desired": decision.desired, "error": repr(e)},
+                reason="rpc_error")
+            if self._warn.ready("apply"):
+                logger.warning("serve autoscaler scale RPC to %s failed "
+                               "(will retry next tick): %r", decision.key, e)
+            return
+        if applied is None:
+            # the deployment vanished between the state read and the apply
+            # (delete raced the tick): nothing was scaled, journal it as such
+            self._journal_event(
+                {"event": "deployment_gone", "key": decision.key,
+                 "desired": decision.desired}, reason="gone")
+            return
+        self.policy.commit(decision, snap.now)
+        self._journal_event(
+            {"event": "scale", "key": decision.key, "from": decision.target,
+             "to": applied, "reason": decision.reason,
+             "queue_depth": round(snap.queue_depth, 1),
+             "burning": snap.burning, "latency_ms":
+                 round((time.time_ns() - t0) / 1e6, 1)},
+            reason=decision.reason)
+        try:
+            from ray_tpu.util import telemetry as _t
+
+            _t.get_gauge(
+                "serve_autoscale_target",
+                "current autoscaler replica target per deployment",
+                tag_keys=("app", "deployment")).set(
+                float(applied), tags={"app": app, "deployment": deployment})
+        # graftlint: allow[swallowed-exception] telemetry emission is best-effort and must never take the control loop down
+        except Exception:
+            pass
+        logger.info("serve autoscale %s: %d -> %d (%s, queue_depth=%.1f)",
+                    decision.key, decision.target, applied, decision.reason,
+                    snap.queue_depth)
+
+    # -- stuck scale-up: hand demand to the node autoscaler + retry elsewhere --
+    def _handle_deficit(self, controller, app: str, deployment: str,
+                        row: Dict[str, Any], snap: DeploymentSnapshot) -> None:
+        key = snap.key
+        if not self.policy.stuck_deficit(snap):
+            if snap.running >= snap.target and key in self._hinted:
+                with self._lock:
+                    self._hinted.discard(key)
+                self._clear_demand_hint(key)
+            return
+        with self._lock:
+            first_time = key not in self._hinted
+            self._hinted.add(key)
+        deficit = snap.target - snap.running
+        shape = dict(row.get("resource_shape") or {"CPU": 1.0})
+        self._post_demand_hint(key, [shape] * deficit)
+        if not first_time:
+            return  # hint already posted; restart kicked once per episode
+        self._journal_event(
+            {"event": "scale_up_stuck", "key": key, "target": snap.target,
+             "running": snap.running, "deficit": deficit,
+             "hint_shape": shape}, reason="stuck")
+        logger.warning(
+            "serve autoscale %s stuck below target (%d/%d) past the startup "
+            "timeout: posted node-autoscaler demand hint and restarting "
+            "wedged STARTING replicas elsewhere", key, snap.running,
+            snap.target)
+        try:
+            import ray_tpu
+
+            ray_tpu.get(controller.restart_stuck_replicas.remote(
+                app, deployment,
+                older_than_s=self.policy.startup_timeout_s), timeout=5.0)
+        except Exception as e:  # noqa: BLE001 — best-effort; reconcile retries
+            if self._warn.ready("restart_stuck"):
+                logger.warning("restart_stuck_replicas RPC for %s failed: %r",
+                               key, e)
+
+    @staticmethod
+    def _post_demand_hint(key: str, shapes: List[Dict[str, float]]) -> None:
+        try:
+            from ray_tpu.autoscaler import autoscaler as node_autoscaler
+
+            node_autoscaler.post_demand_hint(f"serve:{key}", shapes)
+        # graftlint: allow[swallowed-exception] the node-autoscaler plane is optional; without it the hint has no consumer
+        except Exception:
+            pass
+
+    @staticmethod
+    def _clear_demand_hint(key: str) -> None:
+        try:
+            from ray_tpu.autoscaler import autoscaler as node_autoscaler
+
+            node_autoscaler.clear_demand_hint(f"serve:{key}")
+        # graftlint: allow[swallowed-exception] the node-autoscaler plane is optional; without it the hint has no consumer
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------- head singleton
+
+_singleton_lock = threading.Lock()
+_loop: Optional[ServeAutoscalerLoop] = None
+
+
+def ensure_serve_autoscaler() -> Optional[ServeAutoscalerLoop]:
+    """Start (or restart) the head-side loop. Safe to call from any serve
+    entry point and from the head-restart reattach path: no-op off the head
+    (no in-process cluster), no-op when the loop is already live, and a loop
+    bound to a DEAD cluster is replaced — the fresh loop re-derives every
+    target from the controller's restored app configs."""
+    global _loop
+    from ray_tpu.core import global_state
+
+    c = global_state.try_cluster()
+    if c is None:
+        return None
+    with _singleton_lock:
+        if _loop is not None and _loop.cluster is c and _loop.alive():
+            return _loop
+        if _loop is not None:
+            _loop.stop()
+        _loop = ServeAutoscalerLoop(c)
+        return _loop
+
+
+def get_serve_autoscaler() -> Optional[ServeAutoscalerLoop]:
+    with _singleton_lock:
+        return _loop
+
+
+def shutdown_serve_autoscaler() -> None:
+    """Stop the loop (serve.shutdown). The next ensure_ call starts fresh."""
+    global _loop
+    with _singleton_lock:
+        loop, _loop = _loop, None
+    if loop is not None:
+        loop.stop()

@@ -7,7 +7,20 @@ from typing import Any, Dict, Optional
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Reference serve/config.py AutoscalingConfig — request-rate driven."""
+    """Two autoscaling modes share this config:
+
+    - ``mode="ongoing"`` (reference serve/config.py AutoscalingConfig):
+      request-rate driven, reconciled inside the controller from
+      handle-pushed ongoing-request counts (``target_ongoing_requests`` +
+      up/downscale delays).
+    - ``mode="slo"``: the head-side closed loop (serve/autoscaler.py) drives
+      the target from ``subscribe_slo()`` burn-rate transitions and the live
+      ``serve_queue_depth`` gauges. ``target_queue_depth`` is the desired
+      in-flight per replica (None = RAY_TPU_SERVE_AUTOSCALE_QUEUE_TARGET);
+      ``slo_names`` pins which registered SLOs drive this deployment (None =
+      any serve SLO whose ``where`` tags match the app/deployment/route).
+      Hysteresis/cooldowns come from the RAY_TPU_SERVE_AUTOSCALE_* knobs.
+    """
 
     min_replicas: int = 1
     max_replicas: int = 4
@@ -15,6 +28,14 @@ class AutoscalingConfig:
     upscale_delay_s: float = 3.0
     downscale_delay_s: float = 10.0
     metrics_interval_s: float = 1.0
+    mode: str = "ongoing"
+    target_queue_depth: Optional[float] = None
+    slo_names: Optional[list] = None
+
+    def __post_init__(self):
+        if self.mode not in ("ongoing", "slo"):
+            raise ValueError(
+                f"autoscaling mode must be 'ongoing' or 'slo', got {self.mode!r}")
 
 
 def _flag(name: str):

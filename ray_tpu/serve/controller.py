@@ -35,6 +35,7 @@ class _ReplicaState:
         self.version = version
         self.uid = next(_replica_uid)  # stable identity (id() can be reused by GC)
         self.state = STARTING
+        self.started_at = time.time()  # stuck-STARTING detection (autoscaler)
         self.health_ref = None
         self.last_health_ok = time.time()
         self.node_id: Optional[str] = None  # packing assignment (soft affinity)
@@ -60,6 +61,9 @@ class _DeploymentState:
 
     def running(self) -> List[_ReplicaState]:
         return [r for r in self.replicas if r.state == RUNNING]
+
+    def in_state(self, state: str) -> List[_ReplicaState]:
+        return [r for r in self.replicas if r.state == state]
 
     def drain_timeout_s(self) -> float:
         # pre-upgrade KV checkpoints may lack the field (unpickle skips
@@ -310,10 +314,21 @@ class ServeController:
             if ds is None:
                 return None
             cfg = ds.info["config"]
+            # target-aware admission: while a scale change is YOUNG the handle
+            # sizes capacity on the target (arriving replicas will absorb the
+            # queue); once the startup window burns without the fleet reaching
+            # it, anticipation expires and shedding resumes on real capacity
+            from ray_tpu.config import CONFIG
+
+            young = (time.time() - ds._last_scale_change
+                     <= CONFIG.serve_autoscale_startup_timeout_s)
+            running = len(ds.running())
             return {
                 "max_ongoing_requests": getattr(cfg, "max_ongoing_requests", 8),
                 "max_queued_requests": getattr(cfg, "max_queued_requests", -1),
                 "retryable": getattr(cfg, "retryable", True),
+                "target_num_replicas": ds.target_num,
+                "anticipated_replicas": ds.target_num if young else running,
             }
 
     def status(self) -> Dict[str, Any]:
@@ -361,6 +376,121 @@ class ServeController:
             if ds is not None:
                 # EWMA smooth so momentary spikes don't flap the replica count
                 ds.autoscale_metric = 0.6 * ds.autoscale_metric + 0.4 * ongoing
+
+    # -- SLO-loop autoscaling surface (head-side serve/autoscaler.py) -----------
+    @staticmethod
+    def _ac_mode(ds: _DeploymentState) -> Optional[str]:
+        ac = ds.info["config"].autoscaling_config
+        if ac is None:
+            return None
+        # pre-upgrade KV checkpoints may lack the field (unpickle skips defaults)
+        return getattr(ac, "mode", "ongoing")
+
+    def get_autoscale_state(self) -> Dict[str, Dict[str, Any]]:
+        """Everything the head-side loop needs to re-derive its decisions,
+        keyed "app/deployment" — only deployments opted into mode="slo".
+        Served fresh on every tick so a restarted head resumes from the
+        KV-restored app configs, not anyone's in-memory state."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for key, ds in self.deployments.items():
+                if ds.deleting or self._ac_mode(ds) != "slo":
+                    continue
+                ac = ds.info["config"].autoscaling_config
+                opts = dict(ds.info["config"].ray_actor_options or {})
+                shape = {"CPU": float(opts.get("num_cpus", 1))}
+                if opts.get("num_tpus"):
+                    shape["TPU"] = float(opts["num_tpus"])
+                route = ""
+                app = self.apps.get(ds.app_name)
+                if app:
+                    route = app.get("route_prefix", "")
+                out[key] = {
+                    "app": ds.app_name,
+                    "deployment": ds.name,
+                    "target": ds.target_num,
+                    "running": len(ds.running()),
+                    "starting": len(ds.in_state(STARTING)),
+                    "draining": len(ds.in_state(DRAINING)),
+                    "min_replicas": ac.min_replicas,
+                    "max_replicas": ac.max_replicas,
+                    "target_queue_depth": getattr(ac, "target_queue_depth",
+                                                  None),
+                    "slo_names": getattr(ac, "slo_names", None),
+                    "resource_shape": shape,
+                    "route_prefix": route,
+                }
+        return out
+
+    def set_autoscale_target(self, app_name: str, deployment_name: str,
+                             target: int, reason: str = "") -> Optional[int]:
+        """Apply one autoscaler decision. Clamped to the deployment's
+        [max(1, min_replicas), max_replicas] — the control loop can never
+        order the last healthy replica killed — and executed by the reconcile
+        loop through the normal DRAINING choreography. Returns the clamped
+        target actually set, or None when the deployment is gone or
+        mid-delete (the caller must not record a scale that never happened)."""
+        from ray_tpu.util import fault_injection
+
+        fault_injection.fail_point(
+            "serve.controller.scale", app=app_name,
+            deployment=deployment_name, target=target, reason=reason)
+        with self._lock:
+            ds = self.deployments.get(f"{app_name}/{deployment_name}")
+            if ds is None or ds.deleting:
+                return None
+            ac = ds.info["config"].autoscaling_config
+            lo = max(1, ac.min_replicas) if ac else 1
+            hi = ac.max_replicas if ac else max(lo, int(target))
+            clamped = max(lo, min(hi, int(target)))
+            if clamped != ds.target_num:
+                logger.info("autoscale target %s/%s: %d -> %d (%s)",
+                            app_name, deployment_name, ds.target_num,
+                            clamped, reason or "unspecified")
+                ds.target_num = clamped
+                ds._last_scale_change = time.time()
+            return clamped
+
+    def restart_stuck_replicas(self, app_name: str, deployment_name: str,
+                               older_than_s: float = 30.0) -> int:
+        """Kill STARTING replicas wedged past `older_than_s` so the reconcile
+        loop reschedules them (the soft node-affinity re-picks placement —
+        possibly a different, newly launched node). The autoscaler calls this
+        when a scale-up never becomes healthy."""
+        now = time.time()
+        n = 0
+        with self._lock:
+            ds = self.deployments.get(f"{app_name}/{deployment_name}")
+            if ds is None:
+                return 0
+            for r in ds.replicas:
+                if r.state == STARTING and now - r.started_at >= older_than_s:
+                    r.state = STOPPING  # reconcile reaps + restarts elsewhere
+                    r.health_ref = None
+                    n += 1
+        if n:
+            logger.warning(
+                "%s/%s: restarting %d replica(s) stuck in STARTING longer "
+                "than %.0fs", app_name, deployment_name, n, older_than_s)
+        return n
+
+    # -- chaos hooks (ChaosController.arm_serve_controller) ---------------------
+    def _arm_fault(self, site: str, mode: str = "error", prob: float = 1.0,
+                   count: Optional[int] = None, delay_s: float = 0.0,
+                   seed: Optional[int] = None) -> bool:
+        """Arm a fail point in the CONTROLLER process (e.g.
+        serve.controller.scale), so chaos runs can kill the scale path
+        mid-decision."""
+        from ray_tpu.util import fault_injection
+
+        fault_injection.arm(site, mode, prob, count, delay_s, seed)
+        return True
+
+    def _disarm_fault(self, site: Optional[str] = None) -> bool:
+        from ray_tpu.util import fault_injection
+
+        fault_injection.disarm(site)
+        return True
 
     # -- reconciliation --------------------------------------------------------
     def _choose_replica_node(self, ds: _DeploymentState,
@@ -441,6 +571,11 @@ class ServeController:
     def _autoscale(self, ds: _DeploymentState, now: float) -> None:
         ac = ds.info["config"].autoscaling_config
         if ac is None:
+            return
+        if self._ac_mode(ds) == "slo":
+            # the head-side SLO loop owns this deployment's target
+            # (set_autoscale_target); the request-rate rule stepping on it
+            # would thrash the replica count between two masters
             return
         desired = ds.autoscale_metric / max(ac.target_ongoing_requests, 1e-6)
         import math

@@ -751,7 +751,13 @@ class DeploymentHandle:
         if max_queued is None or max_queued < 0:
             return
         moq = max(1, limits.get("max_ongoing_requests", 1) or 1)
-        capacity = moq * max(1, len(self._replicas))
+        # target-aware: while a controller scale-up is young, size admission
+        # on the anticipated replica count so the queue builds for capacity
+        # that is arriving instead of shedding through the whole ramp; a
+        # scale-up that never becomes healthy expires the anticipation
+        # controller-side and shedding resumes (the autoscaler's "re-shed")
+        anticipated = int(limits.get("anticipated_replicas") or 0)
+        capacity = moq * max(1, len(self._replicas), anticipated)
         # PROCESS-wide depth (the queue-depth gauge's accounting), not this
         # router's: several handles to one deployment must share one limit
         with _inflight_lock:

@@ -9,6 +9,11 @@ the unified registry both ride:
   paths (serve handle send, replica request loop, data-plane pull, collective
   waits). A no-op unless armed: the fast path is one dict check plus one
   memoized env read (~0.1us), cheap enough for per-request call sites.
+  Registered sites: ``serve.handle.request`` / ``serve.handle.send`` /
+  ``serve.replica.request`` / ``serve.replica.health`` /
+  ``serve.autoscaler.decide`` (head-side control loop, top of every tick) /
+  ``serve.controller.scale`` (controller apply RPC) / ``data_plane.pull`` /
+  ``collective.wait``.
 - Arming is per-process via :func:`arm`, or via the
   ``RAY_TPU_FAULT_INJECTION`` environment variable so spawned workers inherit
   specs (``site=mode[@p=0.5][@n=3][@delay=0.1][@seed=7][;site2=...]``).
@@ -321,6 +326,47 @@ class ChaosController:
             except Exception:  # noqa: BLE001 — replica died meanwhile
                 pass
         return done
+
+    # -- serve control plane ---------------------------------------------------
+    @staticmethod
+    def _controller_actor():
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def arm_serve_controller(self, site: str = "serve.controller.scale",
+                             mode: str = "error", prob: float = 1.0,
+                             count: Optional[int] = None, delay_s: float = 0.0,
+                             seed: Optional[int] = None) -> bool:
+        """Arm a fail point inside the serve CONTROLLER actor process (e.g.
+        ``serve.controller.scale``): chaos runs kill/deny the scale apply
+        mid-decision and the autoscaler must retry next tick."""
+        import ray_tpu
+
+        ref = self._controller_actor()._arm_fault.remote(
+            site, mode, prob, count, delay_s, seed)
+        return bool(ray_tpu.get(ref, timeout=10))
+
+    def disarm_serve_controller(self, site: Optional[str] = None) -> bool:
+        import ray_tpu
+
+        return bool(ray_tpu.get(
+            self._controller_actor()._disarm_fault.remote(site), timeout=10))
+
+    @staticmethod
+    def arm_serve_autoscaler(mode: str = "error", prob: float = 1.0,
+                             count: Optional[int] = None, delay_s: float = 0.0,
+                             seed: Optional[int] = None) -> bool:
+        """Arm ``serve.autoscaler.decide`` in the HEAD process (the loop runs
+        here, not in an actor): error mode crashes the decision path — the
+        loop must absorb and journal it, never die."""
+        arm("serve.autoscaler.decide", mode, prob, count, delay_s, seed)
+        return True
+
+    @staticmethod
+    def disarm_serve_autoscaler() -> None:
+        disarm("serve.autoscaler.decide")
 
     def disarm_replica(self, app_name: str, deployment_name: str,
                        site: Optional[str] = None) -> int:

@@ -31,3 +31,21 @@ class LogThrottle:
             self._last[key] = now
             return True
         return False
+
+
+def guarded_fanout(callbacks, arg, *, throttle: LogThrottle, logger,
+                   what: str, exc_info: bool = False) -> None:
+    """Deliver `arg` to every callback, individually exception-guarded with
+    a per-callback throttled warning. THE fan-out for subscription surfaces
+    that fire from a load-bearing thread (the metrics scraper, the SLO
+    evaluator): one broken subscriber must neither starve the others nor
+    kill the delivering thread, and a persistently-broken one logs once per
+    throttle window, not once per event."""
+    for cb in callbacks:
+        try:
+            cb(arg)
+        except Exception as e:  # noqa: BLE001 — guarded by design
+            if throttle.ready(id(cb)):
+                logger.warning("%s %r raised (suppressed for %.0fs): %r",
+                               what, cb, throttle.window_s, e,
+                               exc_info=exc_info)

@@ -79,6 +79,8 @@ class MetricsHistory:
         self._lock = threading.Lock()
         self._frames: deque = deque(maxlen=self._want_maxlen(maxlen))
         self._fixed_maxlen = maxlen
+        self._frame_subs: List[Any] = []
+        self._sub_warn = None  # lazy LogThrottle (keeps import cost off init)
 
     @staticmethod
     def _want_maxlen(explicit: Optional[int]) -> int:
@@ -105,7 +107,32 @@ class MetricsHistory:
             if self._frames.maxlen != want:
                 self._frames = deque(self._frames, maxlen=want)
             self._frames.append(frame)
+            subs = list(self._frame_subs)
+        if subs:
+            from ray_tpu.util.logutil import LogThrottle, guarded_fanout
+
+            if self._sub_warn is None:
+                self._sub_warn = LogThrottle(30.0)
+            guarded_fanout(subs, frame, throttle=self._sub_warn,
+                           logger=logger, what="metrics-history frame "
+                           "subscriber")
         return frame
+
+    def subscribe_frames(self, callback) -> Any:
+        """callback(frame) after every recorded scrape frame, invoked on the
+        scraper thread (keep it quick — set an event, don't compute). The
+        serve autoscaler paces its ticks on this. Returns an unsubscribe fn."""
+        with self._lock:
+            self._frame_subs.append(callback)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._frame_subs.remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
 
     def clear(self) -> None:
         with self._lock:

@@ -85,11 +85,17 @@ class SLOEngine:
     scraper thread); status transitions fan out to subscribe() callbacks."""
 
     def __init__(self, history):
+        from ray_tpu.util.logutil import LogThrottle
+
         self._history = history
         self._lock = threading.Lock()
         self._slos: Dict[str, SLO] = {}
         self._subs: List[Callable[[dict], None]] = []
         self._status: Dict[str, Dict[str, Any]] = {}
+        # per-subscriber warn throttle: transitions fire from the scraper
+        # thread — the only heartbeat of every loop riding these signals — so
+        # a persistently-broken callback logs once per window, not per flip
+        self._sub_warn = LogThrottle(30.0)
 
     # ------------------------------------------------------------- registry
 
@@ -217,13 +223,15 @@ class SLOEngine:
                                     "at": row["evaluated_at"], "status": row})
         with self._lock:
             self._status = status
+        from ray_tpu.util.logutil import guarded_fanout
+
         for t in transitions:
-            for cb in subs:
-                try:
-                    cb(t)
-                except Exception:
-                    logger.warning("slo subscriber %r raised for %s",
-                                   cb, t["name"], exc_info=True)
+            # delivery rides the scraper thread — the heartbeat of every
+            # control loop downstream — so each subscriber is individually
+            # guarded with a throttled warning (logutil.guarded_fanout)
+            guarded_fanout(subs, t, throttle=self._sub_warn, logger=logger,
+                           what=f"slo subscriber ({t['name']})",
+                           exc_info=True)
         return status
 
     def status(self) -> Dict[str, Dict[str, Any]]:

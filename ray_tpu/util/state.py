@@ -409,6 +409,29 @@ def cluster_status() -> Dict[str, Any]:
         "requests": int(sum(v["count"] for v in merged.get(
             "serve_request_seconds", {}).get("values", {}).values())),
     }
+    # -- serve autoscale loop (head-side): live targets + decision counters
+    decisions_by_reason = {k: int(v) for k, v in counter_by_tag(
+        "serve_autoscale_decisions_total", "reason").items()}
+    autoscale: Dict[str, Any] = {}
+    if global_state.try_cluster() is not None:
+        from ray_tpu.serve.autoscaler import get_serve_autoscaler
+
+        loop = get_serve_autoscaler()
+        if loop is not None:
+            st = loop.status()
+            autoscale = {
+                "alive": st["alive"],
+                "ticks": st["ticks"],
+                "targets": {k: {kk: v.get(kk) for kk in
+                                ("target", "running", "queue_depth",
+                                 "burning", "reason")}
+                            for k, v in st["deployments"].items()},
+                "last_decision": (st["decisions"][-1]
+                                  if st["decisions"] else None),
+            }
+    if autoscale or decisions_by_reason:
+        autoscale["decisions_by_reason"] = decisions_by_reason
+        status["serve"]["autoscale"] = autoscale
 
     # -- llm engines
     llm_ttft = merged.get("llm_ttft_seconds")
@@ -607,6 +630,22 @@ def slo_status() -> Dict[str, Dict[str, Any]]:
     The autoscaler/router closed loop polls this (or subscribes head-side via
     slo.subscribe_slo)."""
     return _cluster().slo_engine.status()
+
+
+@_remoteable
+def serve_autoscaler_status() -> Dict[str, Any]:
+    """The serve autoscaling loop's introspection surface: whether the loop
+    is alive, the last-seen per-deployment view (target/running/queue-depth/
+    burning + the latest decision and reason), and the bounded decision
+    journal — `ray-tpu status` and the chaos bench read this to explain WHY
+    the fleet resized."""
+    _cluster()  # head-side state only
+    from ray_tpu.serve.autoscaler import get_serve_autoscaler
+
+    loop = get_serve_autoscaler()
+    if loop is None:
+        return {"alive": False, "ticks": 0, "deployments": {}, "decisions": []}
+    return loop.status()
 
 
 # -------------------------------------------------------- request-scoped trace

@@ -192,3 +192,23 @@ def test_live_scraper_two_frames_and_windowed_p99(rt):
         assert windowed == pytest.approx(direct), (windowed, direct)
     finally:
         os.environ.pop("RAY_TPU_METRICS_SCRAPE_INTERVAL_S", None)
+
+
+def test_frame_subscription_guarded_unsubscribe():
+    """subscribe_frames: every recorded frame fans out to subscribers on the
+    scraper thread; a raising subscriber neither blocks the others nor fails
+    record(); unsubscribe stops delivery (ISSUE 15 loop-pacing plumbing)."""
+    h = MetricsHistory(maxlen=4)
+    seen = []
+
+    def bad(_frame):
+        raise RuntimeError("boom")
+
+    unsub_bad = h.subscribe_frames(bad)
+    unsub = h.subscribe_frames(lambda f: seen.append(f["ts"]))
+    h.record({}, ts=1.0)  # bad subscriber must not block delivery
+    assert seen == [1.0]
+    unsub_bad()
+    unsub()
+    h.record({}, ts=2.0)
+    assert seen == [1.0]

@@ -278,9 +278,14 @@ def test_controller_crash_recovers_apps_from_kv(rt):
     assert h2.remote(5).result() == 105
 
 
-def test_grpc_proxy_ingress(rt):
+def test_grpc_proxy_ingress(rt, monkeypatch):
     """Reference gRPCProxy (proxy.py:523): gRPC ingress routed to handles."""
     from ray_tpu.serve.grpc_proxy import grpc_call, start_grpc_proxy
+
+    # tier-1 budget: the no-such-app error path below otherwise burns the
+    # full RAY_TPU_SERVE_REPLICA_WAIT_S default (30s) before surfacing —
+    # the behavior under test is THAT it surfaces, not the wait's length
+    monkeypatch.setenv("RAY_TPU_SERVE_REPLICA_WAIT_S", "1.5")
 
     @serve.deployment(num_replicas=1)
     class Calc:

@@ -1,0 +1,267 @@
+"""Serve autoscaling control loop (ISSUE 15 tentpole; serve/autoscaler.py):
+policy hysteresis/cooldowns, controller target plumbing, fail-point
+robustness, stuck-scale-up demand hand-off. The load-generating end-to-end
+chaos run is slow-marked (tier-1 covers the deterministic pieces)."""
+import dataclasses
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.autoscaler import (
+    AutoscalePolicy,
+    DeploymentSnapshot,
+    ensure_serve_autoscaler,
+    get_serve_autoscaler,
+    shutdown_serve_autoscaler,
+)
+from ray_tpu.test_utils import wait_for_condition
+from ray_tpu.util import fault_injection as fi
+
+
+def _snap(now, **kw):
+    d = dict(key="a/D", target=1, running=1, starting=0, draining=0,
+             min_replicas=1, max_replicas=4, queue_depth=0.0,
+             queue_target=4.0, burning=False, now=float(now))
+    d.update(kw)
+    return DeploymentSnapshot(**d)
+
+
+def _policy(**kw):
+    d = dict(burn_ticks=2, clean_ticks=2, up_cooldown_s=1.0,
+             down_cooldown_s=5.0, startup_timeout_s=3.0)
+    d.update(kw)
+    return AutoscalePolicy(**d)
+
+
+# ------------------------------------------------------------ policy (pure)
+
+def test_policy_burn_scales_up_after_hysteresis():
+    p = _policy()
+    assert p.decide(_snap(0, burning=True)).reason == "hold"  # 1 tick: hold
+    d = p.decide(_snap(1, burning=True))  # sustained: scale up
+    assert d.changed and d.desired == 2 and d.reason == "slo_burn"
+
+
+def test_policy_queue_depth_scales_toward_demand():
+    p = _policy()
+    # 9 in flight at target 2/replica -> the fleet needs ceil(9/2) = 5,
+    # capped by max_replicas = 4
+    p.decide(_snap(0, queue_depth=9.0, queue_target=2.0))
+    d = p.decide(_snap(1, queue_depth=9.0, queue_target=2.0))
+    assert d.desired == 4 and d.reason == "queue_depth"
+
+
+def test_policy_up_cooldown_blocks_repeat_up():
+    p = _policy(burn_ticks=1, up_cooldown_s=10.0)
+    d = p.decide(_snap(0, burning=True))
+    assert d.desired == 2
+    p.commit(d, 0)
+    d2 = p.decide(_snap(1, burning=True, target=2, running=2))
+    assert not d2.changed and d2.reason == "up_cooldown"
+    d3 = p.decide(_snap(11, burning=True, target=2, running=2))
+    assert d3.desired == 3  # cooldown elapsed, burn still sustained
+
+
+def test_policy_clean_scale_down_gated_by_cooldown_and_drains():
+    p = _policy(clean_ticks=2, down_cooldown_s=5.0)
+    base = dict(target=3, running=3)
+    assert p.decide(_snap(0, **base)).reason == "hold"  # clean tick 1
+    d = p.decide(_snap(6, **base))  # clean tick 2, cooldown long past
+    assert d.desired == 2 and d.reason == "clean_scale_down"
+    p.commit(d, 6)
+    # next down inside the cooldown window: held
+    p.decide(_snap(7, target=2, running=2))
+    assert p.decide(_snap(8, target=2, running=2)).reason == "down_cooldown"
+    # a replica still DRAINING means capacity is already leaving: no new down
+    p2 = _policy(clean_ticks=1, down_cooldown_s=0.0)
+    assert p2.decide(_snap(0, target=3, running=3, draining=1)).reason == "hold"
+
+
+def test_policy_never_below_min_or_last_replica():
+    p = _policy(clean_ticks=1, down_cooldown_s=0.0)
+    # at the floor: clean windows never push below min_replicas
+    assert not p.decide(_snap(0, target=2, running=2, min_replicas=2)).changed
+    # min_replicas=0 still floors at 1 (never kill the last healthy replica)
+    d = p.decide(_snap(1, target=1, running=1, min_replicas=0))
+    assert d.desired == 1 and not d.changed
+    # a single running replica is never drained even when target allows it
+    assert not p.decide(_snap(2, target=2, running=1, min_replicas=0)).changed
+    # bounds correction applies immediately (shrunk max)
+    d = p.decide(_snap(3, target=6, running=6, max_replicas=4))
+    assert d.desired == 4 and d.reason == "max_ceiling"
+
+
+def test_policy_flapping_slo_holds_steady():
+    p = _policy(burn_ticks=2, clean_ticks=3, down_cooldown_s=0.0)
+    for i in range(12):  # burn/clean alternating: neither side sustains
+        d = p.decide(_snap(i, target=2, running=2, burning=(i % 2 == 0)))
+        assert not d.changed, d
+
+
+def test_policy_stuck_deficit_timer():
+    p = _policy(startup_timeout_s=2.0)
+    assert not p.stuck_deficit(_snap(0, target=3, running=1))  # timer starts
+    assert not p.stuck_deficit(_snap(1, target=3, running=1))
+    assert p.stuck_deficit(_snap(2.5, target=3, running=1))
+    # deficit closes: timer resets
+    assert not p.stuck_deficit(_snap(3, target=3, running=3))
+    assert not p.stuck_deficit(_snap(10, target=3, running=1))
+
+
+# ------------------------------------------------- controller + loop (cluster)
+
+@pytest.fixture()
+def fast_loop(rt):
+    """Fast scrape cadence + a FRESH loop built under it (the session loop
+    may have been created with default knobs)."""
+    env = {"RAY_TPU_METRICS_SCRAPE_INTERVAL_S": "0.2",
+           "RAY_TPU_SERVE_AUTOSCALE_UP_COOLDOWN_S": "0.5"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    shutdown_serve_autoscaler()
+    fi.disarm()
+    yield
+    fi.disarm()
+    serve.shutdown()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    shutdown_serve_autoscaler()
+
+
+@serve.deployment
+class SlowEcho:
+    def __call__(self, x):
+        time.sleep(0.25)
+        return x
+
+
+def test_autoscale_state_and_target_clamping(fast_loop):
+    app = SlowEcho.options(
+        max_ongoing_requests=2,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=2, mode="slo",
+            target_queue_depth=2.0)).bind()
+    serve.run(app, name="asc-clamp")
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    state = ray_tpu.get(controller.get_autoscale_state.remote())
+    row = state["asc-clamp/SlowEcho"]
+    assert row["min_replicas"] == 1 and row["max_replicas"] == 2
+    assert row["target"] == 1 and row["target_queue_depth"] == 2.0
+    # clamped above max, and below the never-below-one floor
+    assert ray_tpu.get(controller.set_autoscale_target.remote(
+        "asc-clamp", "SlowEcho", 99, reason="test")) == 2
+    assert ray_tpu.get(controller.set_autoscale_target.remote(
+        "asc-clamp", "SlowEcho", 0, reason="test")) == 1
+    # an ongoing-mode (default) deployment never enters the slo-loop view
+    serve.run(SlowEcho.options(autoscaling_config=serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=2)).bind(), name="asc-legacy",
+        route_prefix="/asc-legacy")
+    state = ray_tpu.get(controller.get_autoscale_state.remote())
+    assert "asc-clamp/SlowEcho" in state
+    assert "asc-legacy/SlowEcho" not in state
+
+
+def test_loop_scales_up_on_queue_pressure_and_survives_faults(fast_loop):
+    """One cluster round-trip covers three tier-1 behaviors: a decide-path
+    crash is absorbed and journaled, a lost controller scale RPC is retried
+    next tick, and sustained queue pressure still scales the deployment up."""
+    app = SlowEcho.options(
+        max_ongoing_requests=2,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, mode="slo",
+            target_queue_depth=2.0)).bind()
+    h = serve.run(app, name="asc-up")
+    loop = get_serve_autoscaler()
+    assert loop is not None and loop.alive()
+    # 1) crash the decision path: the loop must absorb + journal, not die
+    fi.ChaosController.arm_serve_autoscaler(mode="error", count=2)
+    wait_for_condition(
+        lambda: any(d.get("event") == "decide_error"
+                    for d in loop.status()["decisions"]),
+        timeout=10, message="decide-path crash never journaled")
+    assert loop.alive()
+    # 2) lose the first scale RPC in the controller process
+    fi.ChaosController().arm_serve_controller(count=1)
+    # 3) sustained queue pressure: concurrent slow calls pile in-flight depth
+    deadline = time.time() + 25
+    target = 1
+    while time.time() < deadline and target < 2:
+        resps = [h.remote(i) for i in range(8)]
+        for r in resps:
+            r.result()
+        target = loop.status()["deployments"].get(
+            "asc-up/SlowEcho", {}).get("target", 1)
+    assert target >= 2, loop.status()
+    events = [d["event"] for d in loop.status()["decisions"]]
+    assert "scale_rpc_error" in events  # the lost RPC was journaled...
+    assert "scale" in events  # ...and the next tick's retry landed
+    st = loop.status()["deployments"]["asc-up/SlowEcho"]
+    assert st["running"] >= 1 and st["reason"]
+
+
+def test_stuck_scale_up_posts_demand_hint_and_clears(rt):
+    """A deficit older than the startup timeout hands the missing replicas'
+    shapes to the node autoscaler's bin-packing and journals the episode;
+    closing the deficit clears the hint."""
+    from ray_tpu import autoscaler as node_autoscaler
+
+    loop = ensure_serve_autoscaler()
+    loop.policy.startup_timeout_s = 0.5
+
+    class _DeadController:  # restart RPC must be best-effort
+        pass
+
+    now = time.monotonic()
+    row = {"resource_shape": {"CPU": 2.0}}
+    snap = _snap(now, key="a/Stuck", target=3, running=1)
+    loop._handle_deficit(_DeadController(), "a", "Stuck", row, snap)
+    snap2 = dataclasses.replace(snap, now=now + 1.0)
+    loop._handle_deficit(_DeadController(), "a", "Stuck", row, snap2)
+    hints = node_autoscaler.demand_hints()
+    assert hints.get("serve:a/Stuck") == [{"CPU": 2.0}, {"CPU": 2.0}]
+    assert any(d.get("event") == "scale_up_stuck"
+               for d in loop.status()["decisions"])
+    # deficit closes -> hint cleared
+    snap3 = dataclasses.replace(snap, now=now + 2.0, running=3)
+    loop._handle_deficit(_DeadController(), "a", "Stuck", row, snap3)
+    assert "serve:a/Stuck" not in node_autoscaler.demand_hints()
+
+
+def test_legacy_ongoing_mode_still_owned_by_controller(rt):
+    """mode="ongoing" (default) deployments stay with the controller's
+    request-rate rule and never appear in the slo-loop view."""
+    cfg = serve.AutoscalingConfig(min_replicas=1, max_replicas=2)
+    assert cfg.mode == "ongoing"
+    with pytest.raises(ValueError):
+        serve.AutoscalingConfig(mode="nope")
+
+
+@pytest.mark.slow
+def test_e2e_chaos_kill_and_load_step_closed_loop(rt):
+    """The full closed loop under open-loop HTTP load (slow: tier-1 runs the
+    deterministic variants above): SIGKILL a replica — the loop restores the
+    running count to target with no operator action and the burning SLO
+    returns to ok within the scrape-interval budget; a 2x load step scales
+    the fleet up with goodput recovering >= 1.2x."""
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(__file__)))
+    import bench_serve
+
+    serve.start(http_options={"port": 18446})
+    try:
+        out = bench_serve.run_chaos_autoscale(
+            18446, service_s=0.06, warm_s=4.0, step_s=10.0, app="asc-e2e")
+    finally:
+        serve.shutdown()
+    assert out["gates"]["replica_replaced_by_loop"], out
+    assert out["gates"]["slo_recovered_within_budget"], out
+    assert out["gates"]["scale_up_observed"], out
+    assert out["goodput_ratio"] >= 1.2, out

@@ -183,3 +183,35 @@ def test_live_slo_flips_burning_within_one_interval(rt):
             slo_mod.remove("ttft-p99")
         except Exception:
             pass
+
+
+def test_bad_subscriber_guarded_and_throttled(caplog):
+    """One broken callback must not keep a transition from the other
+    subscribers or kill the evaluating (scraper) thread — and its failure
+    logs through the shared LogThrottle, one line per window, not per flip."""
+    import logging
+
+    h = MetricsHistory(maxlen=32)
+    eng = SLOEngine(h)
+    eng.register(SLO("ttft", metric="lat", objective=0.9, threshold=0.1,
+                     window_s=60.0))
+    seen = []
+
+    def bad(_t):
+        raise RuntimeError("boom")
+
+    eng.subscribe(bad)
+    eng.subscribe(seen.append)
+    fast, slow = [0.02] * 100, [0.8] * 50
+    h.record(_hist("lat", fast, BOUNDS), ts=0.0)
+    h.record(_hist("lat", fast + [0.02] * 20, BOUNDS), ts=30.0)
+    with caplog.at_level(logging.WARNING, logger="ray_tpu.slo"):
+        eng.evaluate()  # ok (no transition)
+        h.record(_hist("lat", fast + [0.02] * 20 + slow, BOUNDS), ts=60.0)
+        eng.evaluate()  # -> burning: both subscribers invoked
+        h.record(_hist("lat", fast + [0.02] * 4000 + slow, BOUNDS), ts=120.0)
+        h.record(_hist("lat", fast + [0.02] * 8000 + slow, BOUNDS), ts=150.0)
+        eng.evaluate()  # -> ok: bad raises AGAIN inside the throttle window
+    assert [t["to"] for t in seen] == ["burning", "ok"]  # deliveries intact
+    warns = [r for r in caplog.records if "slo subscriber" in r.message]
+    assert len(warns) == 1  # throttled: one line for two failures
