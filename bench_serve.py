@@ -13,6 +13,7 @@ every step, so wall-clock spans below are naturally device-synchronized.
 """
 import json
 import os
+import statistics
 import sys
 import threading
 import time
@@ -1245,6 +1246,310 @@ def chaos_main():
     return results
 
 
+# --------------------------------------------------------------------------
+# P/D disaggregation bench (--pd): the per-page overlapped KV handoff and the
+# pooled serving topology it feeds. Three gated sections, merged as a "pd"
+# dict into SERVE_BENCH.json (non-zero exit on any gate failure):
+#   1. paged handoff GB/s at 256 MB between two processes — must clear 3x the
+#      monolithic kv_handoff_device_plane_gbps row (0.58 through the tunnel);
+#   2. disaggregated vs colocated streaming HTTP on the same load — median
+#      TTFT within 1.15x, goodput within 0.95x, zero leaked KV exports;
+#   3. chaos: SIGKILL the prefill replica mid-handoff under concurrent
+#      requests — zero lost requests, zero leaked exports after recovery.
+# --------------------------------------------------------------------------
+
+def _pd_paged_child(role, conn, nbytes, iters):
+    """Paged handoff worker: the paged path host-gathers once and streams
+    per-page ranged pulls over the striped collective plane — no PJRT
+    transfer server needed, unlike the monolithic _kv_handoff_child."""
+    import pickle  # noqa: F401  (spawn children re-import the module)
+
+    from ray_tpu.core.device_plane import plane
+
+    n = nbytes // 4
+    if role == "producer":
+        x = np.ones((n,), np.float32)
+        for _ in range(iters + 1):  # +1 warmup; export, send tiny handle, await ack
+            h = plane().export_paged({"kv": x})
+            conn.send(h)
+            conn.recv()
+    else:
+        conn, result_conn = conn
+        # warmup round (stream connections + puller thread spinup): untimed
+        h = conn.recv()
+        f = plane().fetch_paged(h, release=True)
+        f.wait(timeout=300)
+        f.result()
+        f.recycle()
+        conn.send("ok")
+        durs = []
+        pages = streams = 0
+        for _ in range(iters):
+            h = conn.recv()
+            t0 = time.perf_counter()
+            f = plane().fetch_paged(h, release=True)
+            f.wait(timeout=300)
+            f.result()  # materialize the arrays like a decode admission would
+            durs.append(time.perf_counter() - t0)
+            f.recycle()  # staging pool reuse, as a steady-state decode replica does
+            pages, streams = f.n_pages, f.streams
+            conn.send("ok")
+        result_conn.send((durs, pages, streams))
+
+
+def bench_pd_paged_handoff(nbytes=256 * 1024 * 1024, iters=8):
+    """GB/s of the per-page P/D handoff between two processes (same two-process
+    harness as bench_kv_handoff, so the rows compare like for like)."""
+    import multiprocessing as mp
+    import secrets
+
+    os.environ.setdefault("RAY_TPU_CLIENT_AUTHKEY", secrets.token_hex(16))
+    ctx = mp.get_context("spawn")
+    p_end, c_end = ctx.Pipe()
+    res_parent, res_child = ctx.Pipe()
+    prod = ctx.Process(target=_pd_paged_child,
+                       args=("producer", p_end, nbytes, iters))
+    cons = ctx.Process(target=_pd_paged_child,
+                       args=("consumer", (c_end, res_child), nbytes, iters))
+    prod.start()
+    cons.start()
+    try:
+        deadline = time.time() + 600
+        while not res_parent.poll(1.0):
+            if time.time() > deadline:
+                raise TimeoutError("pd paged handoff bench timed out")
+            if not (prod.is_alive() and cons.is_alive()):
+                raise RuntimeError(
+                    f"pd handoff child died (producer rc={prod.exitcode}, "
+                    f"consumer rc={cons.exitcode})")
+        durs, pages, streams = res_parent.recv()
+    finally:
+        prod.join(30)
+        cons.join(30)
+        for p in (prod, cons):
+            if p.is_alive():
+                p.terminate()
+    # median per-handoff time: one scheduler-noise outlier iteration must not
+    # misreport the steady-state transfer rate
+    t = statistics.median(durs)
+    return {
+        "paged_handoff_mb": nbytes // (1 << 20),
+        "paged_handoff_gbps": round(nbytes / 1e9 / t, 2),
+        "paged_handoff_pages": pages,
+        "paged_handoff_streams": streams,
+    }
+
+
+def _pd_stream_request(url, body):
+    """(ttft_s, total_s, content_chars) for one streaming chat request; TTFT
+    is time to the first CONTENT delta (the role prelude frame is free)."""
+    import urllib.request
+
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    resp = urllib.request.urlopen(req, timeout=600)
+    ttft, chars, buf = None, 0, b""
+    while True:
+        chunk = resp.read(4096)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            frame, buf = buf.split(b"\n\n", 1)
+            text = frame.decode()
+            if not text.startswith("data: ") or text == "data: [DONE]":
+                continue
+            c = json.loads(text[len("data: "):])["choices"][0][
+                "delta"].get("content") or ""
+            if c and ttft is None:
+                ttft = time.perf_counter() - t0
+            chars += len(c)
+    return ttft, time.perf_counter() - t0, chars
+
+
+def _pd_stream_load(url, model, n_requests, concurrency, max_tokens):
+    """Median TTFT + goodput for n streaming requests at fixed concurrency."""
+    import concurrent.futures
+
+    body = {"model": model, "stream": True, "temperature": 0.0,
+            "max_tokens": max_tokens,
+            "messages": [{"role": "user", "content": "benchmark me"}]}
+    # warm every replica's jit caches before timing
+    for _ in range(2):
+        _pd_stream_request(url, body)
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=concurrency) as ex:
+        recs = list(ex.map(lambda _: _pd_stream_request(url, body),
+                           range(n_requests)))
+    elapsed = time.perf_counter() - t0
+    ttfts = sorted(r[0] for r in recs if r[0] is not None)
+    return {
+        "requests": n_requests,
+        "lost": sum(1 for r in recs if r[2] == 0),
+        "ttft_median_ms": round(1e3 * ttfts[len(ttfts) // 2], 1) if ttfts else None,
+        "goodput_rps": round(n_requests / elapsed, 2),
+    }
+
+
+def _pd_exports_live(handle) -> int:
+    return int(handle.options(method_name="metrics").remote().result()[
+        "pd_exports_live"])
+
+
+def _pd_wait_no_leak(handle, timeout_s=15.0) -> int:
+    """Release acks are async: poll the prefill pool's live-export gauge to 0."""
+    deadline = time.monotonic() + timeout_s
+    live = None
+    while time.monotonic() < deadline:
+        live = _pd_exports_live(handle)
+        if live == 0:
+            return 0
+        time.sleep(0.25)
+    return live
+
+
+def _pd_run_chaos(serve, body) -> dict:
+    """SIGKILL the prefill replica while armed delays hold decode pulls open
+    mid-transfer; every in-flight request must complete via host fallback."""
+    from ray_tpu.util.fault_injection import ChaosController
+
+    h = serve.get_app_handle("pd-chaos-bench")
+    want = h.options(method_name="chat").remote(dict(body)).result()
+    chaos = ChaosController()
+    armed = chaos.arm_replica("pd-chaos-bench", "pd-chaos:decode",
+                              "llm.pd.handoff", mode="delay", delay_s=2.0)
+    lost, wrong = 0, 0
+    lock = threading.Lock()
+
+    def run():
+        nonlocal lost, wrong
+        try:
+            resp = h.options(method_name="chat").remote(dict(body)).result()
+            if (resp["choices"][0]["message"]["content"]
+                    != want["choices"][0]["message"]["content"]):
+                with lock:
+                    wrong += 1
+        except Exception:
+            with lock:
+                lost += 1
+
+    threads = [threading.Thread(target=run, daemon=True) for _ in range(4)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(0.8)  # prefills done, decode pulls parked in the armed delay
+    killed = chaos.kill_replica("pd-chaos-bench", "pd-chaos:prefill", index=0)
+    for t in threads:
+        t.join(timeout=180)
+    hung = sum(1 for t in threads if t.is_alive())
+    chaos.disarm_replica("pd-chaos-bench", "pd-chaos:decode")
+    leaked = _pd_wait_no_leak(
+        serve.get_deployment_handle("pd-chaos:prefill", "pd-chaos-bench"))
+    return {
+        "chaos_armed_replicas": armed,
+        "chaos_replica_killed": bool(killed),
+        "chaos_requests": len(threads),
+        "chaos_lost": lost + hung,
+        "chaos_wrong_output": wrong,
+        "chaos_leaked_exports": leaked,
+        "chaos_recovery_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+def pd_main():
+    """--pd: gate the per-page overlapped KV handoff and the disaggregated
+    serving topology against the colocated baseline."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig, build_openai_app, build_pd_openai_app
+
+    out_path = os.path.join(os.path.dirname(__file__) or ".", "SERVE_BENCH.json")
+    try:
+        with open(out_path) as f:
+            results = json.load(f)
+    except (OSError, ValueError):
+        results = {}
+    section = {"config": "test-tiny byte paged" if TINY else
+               "llama-500m bf16 paged(block=32)"}
+
+    # 1 — transfer microbench, always at the baseline row's 256 MB size so the
+    # 3x gate compares like for like (pure host loopback, no model involved)
+    section.update(bench_pd_paged_handoff(
+        nbytes=256 * 1024 * 1024, iters=3 if TINY else 8))
+    mono_gbps = (results.get("kv_handoff_device_plane_gbps")
+                 if results.get("kv_handoff_mb") == 256 else None) or 0.58
+    section["monolithic_baseline_gbps"] = mono_gbps
+    section["paged_vs_monolithic"] = round(
+        section["paged_handoff_gbps"] / mono_gbps, 2)
+
+    # 2 + 3 — serve-level comparisons need a cluster with engine replicas
+    n_req, conc, max_tok = (8, 2, 16) if TINY else (24, 4, 48)
+    cfg_kw = dict(model_source="test-tiny" if TINY else "llama-500m",
+                  tokenizer="byte", max_num_seqs=4,
+                  max_model_len=128 if TINY else 512)
+    port = 18460
+    ray_tpu.init(num_cpus=8, max_workers_per_node=12,
+                 worker_env={"JAX_PLATFORMS": "cpu"} if TINY else None)
+    try:
+        serve.start(http_options={"port": port})
+        serve.run(build_openai_app([LLMConfig(model_id="colo", **cfg_kw)]),
+                  name="pd-colo-bench", route_prefix="/colo")
+        serve.run(build_pd_openai_app(LLMConfig(model_id="pd", **cfg_kw),
+                                      name_prefix="pd-bench"),
+                  name="pd-disagg-bench", route_prefix="/pd")
+        colo = _pd_stream_load(f"http://127.0.0.1:{port}/colo/chat/completions",
+                               "colo", n_req, conc, max_tok)
+        disagg = _pd_stream_load(f"http://127.0.0.1:{port}/pd/chat/completions",
+                                 "pd", n_req, conc, max_tok)
+        section["colocated"] = colo
+        section["disaggregated"] = disagg
+        section["ttft_ratio"] = round(
+            disagg["ttft_median_ms"] / colo["ttft_median_ms"], 3)
+        section["goodput_ratio"] = round(
+            disagg["goodput_rps"] / colo["goodput_rps"], 3)
+        section["leaked_exports_after_load"] = _pd_wait_no_leak(
+            serve.get_deployment_handle("pd-bench:prefill", "pd-disagg-bench"))
+
+        serve.run(build_pd_openai_app(
+            LLMConfig(model_id="pd-chaos", **cfg_kw), name_prefix="pd-chaos"),
+            name="pd-chaos-bench", route_prefix="/pd-chaos")
+        section.update(_pd_run_chaos(serve, {
+            "model": "pd-chaos", "temperature": 0.0, "max_tokens": max_tok,
+            "messages": [{"role": "user", "content": "benchmark me"}]}))
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+    gates = {
+        "paged_3x_monolithic": (
+            section["paged_handoff_gbps"] >= 3 * mono_gbps),
+        "ttft_within_1_15x": section["ttft_ratio"] <= 1.15,
+        "goodput_within_0_95x": section["goodput_ratio"] >= 0.95,
+        "zero_lost_under_load": (colo["lost"] == 0 and disagg["lost"] == 0),
+        "zero_leaked_exports": section["leaked_exports_after_load"] == 0,
+        "chaos_zero_lost": (section["chaos_lost"] == 0
+                            and section["chaos_wrong_output"] == 0
+                            and section["chaos_replica_killed"]),
+        "chaos_zero_leaked": section["chaos_leaked_exports"] == 0,
+    }
+    section["gates"] = {k: bool(v) for k, v in gates.items()}
+    section["all_gates_pass"] = all(section["gates"].values())
+    results["pd"] = section
+    for k, v in sorted(section.items()):
+        print(f"pd.{k}: {v}")
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {out_path}")
+    if not section["all_gates_pass"]:
+        print("PD GATES FAILED:",
+              [k for k, v in section["gates"].items() if not v])
+        sys.exit(1)
+    return results
+
+
 def main():
     import jax
 
@@ -1316,5 +1621,7 @@ if __name__ == "__main__":
         chaos_main()
     elif "--engine" in sys.argv:
         engine_main()
+    elif "--pd" in sys.argv:
+        pd_main()
     else:
         main()

@@ -68,10 +68,47 @@ class DeviceHandle:
     nbytes: int
 
 
+@dataclass(frozen=True)
+class PagedKVHandle:
+    """Descriptor of a block-addressable paged export (P/D KV handoff).
+
+    Unlike DeviceHandle (one whole-buffer PJRT pull), the payload is published
+    on the striped collective data plane as one segment per flat array, and
+    consumers issue ranged multi-stream page pulls against (data_host,
+    data_port). The arm channel is kept for control only: liveness probes
+    ("stat") and release acks ride it, payload bytes never do."""
+
+    arm_host: str
+    arm_port: int
+    data_host: str
+    data_port: int
+    key: bytes
+    specs: Tuple[ArraySpec, ...]
+    treedef_pickle: bytes
+    nbytes: int
+    page_bytes: int
+
+    @property
+    def n_pages(self) -> int:
+        return max(1, -(-self.nbytes // self.page_bytes))
+
+    def segments(self) -> Tuple[Tuple[str, int, int], ...]:
+        """(store_key, global_offset, nbytes) per flat array, in spec order —
+        the region's address map, derived so the handle stays small."""
+        out, off = [], 0
+        hexkey = self.key.hex()
+        for i, s in enumerate(self.specs):
+            out.append((f"pdkv:{hexkey}:{i}", off, s.nbytes))
+            off += s.nbytes
+        return tuple(out)
+
+
 def _describe_sharding(arr) -> Tuple:
+    sh = getattr(arr, "sharding", None)
+    if sh is None:  # host numpy leaf (paged exports accept plain ndarrays)
+        return ("single",)
     from jax.sharding import NamedSharding
 
-    sh = arr.sharding
     if isinstance(sh, NamedSharding) and len(sh.mesh.devices.flat) > 1:
         spec_entries = tuple(
             tuple(e) if isinstance(e, (tuple, list)) else e for e in tuple(sh.spec)
@@ -289,13 +326,23 @@ class DevicePlane:
         # DeviceChannel values released on the next write) pass no TTL and stay
         # pinned until release() — a sweep there would DESTROY live data.
         self._export_deadlines: Dict[bytes, float] = {}
+        # paged exports: key -> collective-plane store keys holding the host
+        # copy of the KV region (one per flat array); released the same ways
+        # _exports is (explicit, consumer ack, TTL sweep)
+        self._paged_exports: Dict[bytes, List[str]] = {}
+        # release subscribers (engine-level export bookkeeping): fired with the
+        # key after ANY release, outside the plane lock
+        self._release_listeners: List[Any] = []
         self._ttl_thread: Optional[threading.Thread] = None
         self._conns: Dict[str, Any] = {}  # xfer addr -> TransferConnection
+        # arm addr -> pooled control conns (see _control: dial+challenge reuse)
+        self._control_pool: Dict[Tuple[str, int], List[Any]] = {}
         self._uuid_counter = secrets.randbits(48) << 14  # process-unique uuid space
         self.counters: Dict[str, int] = {
             "exports": 0, "arms": 0, "pulls": 0, "bytes_pulled": 0, "fallbacks": 0,
         }
         self._disabled_reason: Optional[str] = None
+        self._control_disabled_reason: Optional[str] = None
 
     # -- lifecycle ---------------------------------------------------------------
 
@@ -310,15 +357,23 @@ class DevicePlane:
             except Exception as e:  # no transfer support on this backend/build
                 self._disabled_reason = f"{type(e).__name__}: {e}"
 
-    def _start_locked(self) -> None:
-        import jax
-        from jax.experimental import transfer
+    def _ensure_control_started(self) -> None:
+        """Start just the arm/control channel (authkey + listener). The paged
+        KV handoff moves payload over the striped socket data plane, so it
+        stays available on backends whose jax build lacks PJRT transfer
+        support — only whole-buffer device fetches need the transfer server."""
+        if self._arm_listener is not None or self._control_disabled_reason:
+            return
+        with self._lock:
+            if self._arm_listener is not None or self._control_disabled_reason:
+                return
+            try:
+                self._start_control_locked()
+            except Exception as e:
+                self._control_disabled_reason = f"{type(e).__name__}: {e}"
 
-        ip = _node_ip()
-        client = jax.devices()[0].client
-        # Explicit socket transport addresses: the default same-host "local" bulk
-        # transport is not implemented for all backends (CHECK-fails on CPU), and
-        # cross-host always needs routable sockets anyway.
+    def _start_control_locked(self) -> None:
+        from ray_tpu.core.secure_transport import make_listener
         from ray_tpu.util.client.server import load_authkey
 
         authkey = load_authkey()
@@ -329,19 +384,29 @@ class DevicePlane:
             raise RuntimeError(
                 "no cluster session authkey (set RAY_TPU_CLIENT_AUTHKEY or "
                 "init a cluster first)")
-        server = transfer.start_transfer_server(
-            client, f"{ip}:0", [f"{ip}:0"])
-        addr = server.address()
-        self._authkey = authkey
-        from ray_tpu.core.secure_transport import make_listener
-
+        ip = _node_ip()
         listener = make_listener((ip, 0), backlog=64)
-        self._server = server
-        self._xfer_addr = addr
+        self._authkey = authkey
         self._arm_listener = listener
         self._arm_addr = (ip, listener.address[1])
         threading.Thread(target=self._arm_loop, daemon=True,
                          name="rt-device-plane-arm").start()
+
+    def _start_locked(self) -> None:
+        import jax
+        from jax.experimental import transfer
+
+        if self._arm_listener is None:
+            self._start_control_locked()
+        ip = self._arm_addr[0]
+        client = jax.devices()[0].client
+        # Explicit socket transport addresses: the default same-host "local" bulk
+        # transport is not implemented for all backends (CHECK-fails on CPU), and
+        # cross-host always needs routable sockets anyway.
+        server = transfer.start_transfer_server(
+            client, f"{ip}:0", [f"{ip}:0"])
+        self._server = server
+        self._xfer_addr = server.address()
 
     @property
     def available(self) -> bool:
@@ -349,6 +414,15 @@ class DevicePlane:
             return False
         self._ensure_started()
         return self._server is not None
+
+    @property
+    def paged_available(self) -> bool:
+        """Can this process produce/consume paged exports? Needs only the
+        control channel + striped data plane, not PJRT transfer support."""
+        if not CONFIG.device_plane:
+            return False
+        self._ensure_control_started()
+        return self._arm_listener is not None
 
     @property
     def disabled_reason(self) -> Optional[str]:
@@ -395,10 +469,99 @@ class DevicePlane:
             treedef_pickle=pickle.dumps(treedef),
             nbytes=sum(s.nbytes for s in specs))
 
+    def export_paged(self, tree: Any, ttl_s: Optional[float] = None,
+                     page_bytes: Optional[int] = None) -> PagedKVHandle:
+        """Register a pytree as a block-addressable region for ranged,
+        multi-stream page pulls (the P/D KV handoff fast path).
+
+        PJRT transfer pulls are whole-buffer only, so the region is gathered
+        to host once here and published segment-per-array on the striped
+        collective data plane; consumers pull pages concurrently over
+        CONFIG.pd_pull_streams sockets, overlapped with their own decode
+        bursts. Same lifetime contract as export(): pinned (host-side) until
+        release()/consumer ack, with ttl_s as the crashed-consumer backstop.
+        """
+        if not self.paged_available:
+            raise DevicePlaneError(
+                self._control_disabled_reason or "device plane disabled")
+        import pickle
+
+        import jax
+        import numpy as np
+
+        from ray_tpu.util.collective import ring
+
+        flat, treedef = jax.tree.flatten(tree)
+        if not flat:
+            raise DevicePlaneError("empty pytree")
+        specs = tuple(
+            ArraySpec(tuple(x.shape), str(x.dtype), _describe_sharding(x), x.nbytes)
+            for x in flat
+        )
+        key = secrets.token_bytes(16)
+        page = int(page_bytes or CONFIG.pd_page_bytes)
+        # the producer's data server must carry at least one consumer's worth
+        # of concurrent page streams without starving collective traffic
+        cplane = ring.get_plane(self._authkey,
+                                min_streams=max(1, CONFIG.pd_pull_streams))
+        seg_keys: List[str] = []
+        hexkey = key.hex()
+        for i, x in enumerate(flat):
+            host_arr = np.ascontiguousarray(np.asarray(x))
+            skey = f"pdkv:{hexkey}:{i}"
+            # exp=0: the consumer may re-probe ranges; lifetime is ours —
+            # retracted on release(), TTL sweep is only the backstop
+            cplane.publish(skey, memoryview(host_arr).cast("B"), 0)
+            seg_keys.append(skey)
+        with self._lock:
+            self._paged_exports[key] = seg_keys
+            self.counters["exports"] += 1
+            self.counters["paged_exports"] = (
+                self.counters.get("paged_exports", 0) + 1)
+            if ttl_s is not None:
+                self._export_deadlines[key] = time.monotonic() + ttl_s
+                if self._ttl_thread is None:
+                    self._ttl_thread = threading.Thread(
+                        target=self._ttl_loop, daemon=True,
+                        name="rt-device-plane-ttl")
+                    self._ttl_thread.start()
+        host, port = self._arm_addr
+        return PagedKVHandle(
+            arm_host=host, arm_port=port,
+            data_host=cplane.addr[0], data_port=cplane.addr[1],
+            key=key, specs=specs, treedef_pickle=pickle.dumps(treedef),
+            nbytes=sum(s.nbytes for s in specs), page_bytes=page)
+
+    def add_release_listener(self, cb) -> None:
+        """Subscribe cb(key: bytes) to export releases (explicit, consumer
+        ack over the arm channel, or TTL sweep). Fired outside the plane lock;
+        engine-level export bookkeeping syncs on this instead of polling."""
+        with self._lock:
+            self._release_listeners.append(cb)
+
     def release(self, key: bytes) -> None:
         with self._lock:
-            self._exports.pop(key, None)
+            found = (self._exports.pop(key, None) is not None)
+            seg_keys = self._paged_exports.pop(key, None)
+            found = found or seg_keys is not None
             self._export_deadlines.pop(key, None)
+            listeners = list(self._release_listeners) if found else []
+        if seg_keys:
+            try:
+                from ray_tpu.util.collective import ring
+
+                cplane = ring.get_plane(self._authkey)
+                for skey in seg_keys:
+                    cplane.retract(skey)
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
+            except Exception:
+                pass
+        for cb in listeners:
+            try:
+                cb(key)
+            # graftlint: allow[swallowed-exception] callback isolation: a throwing subscriber must not break the caller
+            except Exception:
+                pass
 
     def _ttl_loop(self, interval_s: float = 30.0) -> None:
         while True:
@@ -407,9 +570,10 @@ class DevicePlane:
             with self._lock:
                 stale = [k for k, d in self._export_deadlines.items()
                          if now > d]
-                for k in stale:
-                    self._exports.pop(k, None)
-                    self._export_deadlines.pop(k, None)
+            for k in stale:
+                # through release(): paged store keys retract and release
+                # listeners fire for TTL sweeps too
+                self.release(k)
 
     def _arm_loop(self) -> None:
         while True:
@@ -426,7 +590,12 @@ class DevicePlane:
         from multiprocessing.connection import deliver_challenge, answer_challenge
         import pickle
 
+        from ray_tpu.core.secure_transport import set_nodelay
+
         try:
+            # control ops are tiny request/response pairs; without NODELAY each
+            # one eats a Nagle + delayed-ACK stall (~40 ms on loopback)
+            set_nodelay(conn.fileno())
             deliver_challenge(conn, self._authkey)
             answer_challenge(conn, self._authkey)
             while True:
@@ -435,8 +604,22 @@ class DevicePlane:
                     self.release(key)
                     conn.send_bytes(pickle.dumps(("ok",)))
                     continue
+                if op == "stat":
+                    # liveness probe for paged fetches: lets the consumer fail
+                    # a dead/released export eagerly instead of blocking a
+                    # ranged pull on a range that will never publish
+                    with self._lock:
+                        live = key in self._exports or key in self._paged_exports
+                    conn.send_bytes(pickle.dumps(("ok",) if live else ("gone",)))
+                    continue
                 if op not in ("arm", "arm_shards"):
                     conn.send_bytes(pickle.dumps(("err", f"bad op {op!r}")))
+                    continue
+                if self._server is None:
+                    # control-only start (paged handoff on a backend without
+                    # PJRT transfer support): whole-buffer pulls can't arm
+                    conn.send_bytes(pickle.dumps(
+                        ("err", "no PJRT transfer server")))
                     continue
                 with self._lock:
                     flat = self._exports.get(key)
@@ -591,9 +774,44 @@ class DevicePlane:
         treedef = pickle.loads(handle.treedef_pickle)
         return jax.tree.unflatten(treedef, arrays)
 
-    def _control(self, handle: DeviceHandle, msg: Tuple) -> Tuple:
-        import pickle
+    def fetch_paged(self, handle: PagedKVHandle, release: bool = False,
+                    on_done=None) -> "PagedKVFetch":
+        """Begin a multi-stream paged pull of an export_paged() region and
+        return immediately with the in-flight PagedKVFetch — the caller
+        overlaps its own work (decode bursts) with the transfer and collects
+        the arrays via result() when it actually needs them.
 
+        Fails EAGERLY (DevicePlaneError raised here) when the export is
+        already gone — a liveness probe on the arm channel — so callers can
+        fall back to the host path before anything streamed. Mid-transfer
+        failures (producer SIGKILL, retraction, deadline) surface as
+        DevicePlaneError from wait()/result() within the bounded-probe stall
+        window, never as an indefinite hang.
+
+        release=True acks the producer over the arm channel once the last
+        page lands (single-consumer handoffs)."""
+        if not self.paged_available:
+            with self._lock:
+                self.counters["fallbacks"] += 1
+            raise DevicePlaneError(
+                self._control_disabled_reason or "device plane disabled")
+        try:
+            resp = self._control(handle, ("stat", handle.key))
+        except DevicePlaneError:
+            with self._lock:
+                self.counters["fallbacks"] += 1
+            raise
+        if resp[0] == "gone":
+            with self._lock:
+                self.counters["fallbacks"] += 1
+            raise DevicePlaneError("export was released by the producer")
+        if resp[0] != "ok":
+            raise DevicePlaneError(f"stat failed: {resp!r}")
+        return PagedKVFetch(self, handle, release=release, on_done=on_done)
+
+    _CONTROL_POOL_MAX = 4  # pooled arm-channel conns kept per producer
+
+    def _dial_control(self, addr: Tuple[str, int]):
         from ray_tpu.core.secure_transport import dial
         from ray_tpu.util.client.server import load_authkey
 
@@ -601,18 +819,56 @@ class DevicePlane:
         if authkey is None:
             raise DevicePlaneError("no cluster session authkey")
         try:
-            conn = dial((handle.arm_host, handle.arm_port), authkey=authkey)
+            return dial(addr, authkey=authkey)
         except Exception as e:
             raise DevicePlaneError(f"producer unreachable: {e}") from e
-        try:
-            conn.send_bytes(pickle.dumps(msg))
-            return pickle.loads(conn.recv_bytes())
-        finally:
+
+    def _control(self, handle: DeviceHandle, msg: Tuple) -> Tuple:
+        """One control round trip (arm/stat/release) on the producer's arm
+        channel. Connections are pooled per producer: every dial pays a TCP
+        connect + 2-round-trip authkey challenge, and the paged handoff path
+        issues two control ops per request (liveness stat + release ack) — at
+        serving rates the handshakes would dominate the ops themselves. A
+        stale pooled connection (producer restarted, idle conn reaped) gets
+        one retry on a fresh dial; the server arm loop serves any number of
+        sequential ops per connection."""
+        import pickle
+
+        addr = (handle.arm_host, handle.arm_port)
+        payload = pickle.dumps(msg)
+        for attempt in (0, 1):
+            conn = None
+            if attempt == 0:  # the retry always dials fresh
+                with self._lock:
+                    free = self._control_pool.get(addr)
+                    conn = free.pop() if free else None
+            from_pool = conn is not None
+            if conn is None:
+                conn = self._dial_control(addr)
             try:
-                conn.close()
-            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
-            except Exception:
-                pass
+                conn.send_bytes(payload)
+                resp = pickle.loads(conn.recv_bytes())
+            except Exception as e:
+                try:
+                    conn.close()
+                # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
+                except Exception:
+                    pass
+                if from_pool and attempt == 0:
+                    continue  # stale pooled conn: retry once on a fresh dial
+                raise DevicePlaneError(f"producer unreachable: {e}") from e
+            with self._lock:
+                pool = self._control_pool.setdefault(addr, [])
+                if len(pool) < self._CONTROL_POOL_MAX:
+                    pool.append(conn)
+                    conn = None
+            if conn is not None:
+                try:
+                    conn.close()
+                # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
+                except Exception:
+                    pass
+            return resp
 
     def _arm(self, handle: DeviceHandle) -> Tuple[str, int]:
         resp = self._control(handle, ("arm", handle.key))
@@ -635,8 +891,294 @@ class DevicePlane:
     def stats(self) -> Dict[str, int]:
         with self._lock:
             out = dict(self.counters)
-        out["exports_live"] = len(self._exports)
+        out["exports_live"] = len(self._exports) + len(self._paged_exports)
         return out
+
+
+_staging_lock = threading.Lock()
+_staging_bufs: List[Any] = []
+
+
+def _staging_checkout(nbytes: int):
+    """A staging buffer of at least `nbytes`: the smallest pooled buffer that
+    fits, else a fresh uninitialized allocation. Pooled buffers matter on the
+    ingest path — a decode replica fetches prefill KV continuously, and a
+    fresh 256 MB destination costs a full zero-fill page-fault pass (~halves
+    loopback throughput) that a recycled, already-faulted buffer skips."""
+    with _staging_lock:
+        best = None
+        for i, b in enumerate(_staging_bufs):
+            if b.nbytes >= nbytes and (
+                    best is None or b.nbytes < _staging_bufs[best].nbytes):
+                best = i
+        if best is not None:
+            return _staging_bufs.pop(best)
+    import numpy as np
+
+    # np.empty, not bytearray: bytearray(n) memsets the whole region up front
+    # before a single page arrives; an uninitialized buffer lets the kernel
+    # zero-fault pages under the readv()s instead, overlapped with the
+    # network wait
+    return np.empty(max(nbytes, 1), dtype=np.uint8)
+
+
+def _staging_recycle(buf) -> None:
+    with _staging_lock:
+        if len(_staging_bufs) < max(0, int(CONFIG.pd_staging_buffers)):
+            _staging_bufs.append(buf)
+
+
+class PagedKVFetch:
+    """One in-flight paged KV pull: up to CONFIG.pd_pull_streams puller
+    threads (clamped to the page count and the host's CPU count — extra
+    streams on a small host only add GIL/context-switch churn) stream the
+    region's pages into a single host buffer while the consumer keeps
+    decoding its active batch. Pages are claimed near-in-order off a shared
+    counter, so the streams naturally load-balance across page-size variance
+    and socket jitter.
+
+    The destination is checked out of a process-level staging pool; call
+    recycle() once the result() arrays have been copied out (device_put /
+    jnp.asarray) so the next handoff reuses the already-faulted pages.
+
+    Failure contract: any puller error (producer SIGKILL -> connection reset,
+    export retracted mid-transfer -> bounded probe + stat says gone, overall
+    CONFIG.pd_fetch_timeout_s deadline) resolves the fetch with a
+    DevicePlaneError raised from wait()/result(); pullers use ~1 s bounded
+    probes rather than full-op-timeout blocking reads, so the stall is
+    detection-bounded, not timeout-bounded."""
+
+    _PROBE_S = 1.0
+
+    def __init__(self, dplane: "DevicePlane", handle: PagedKVHandle,
+                 release: bool = False, on_done=None) -> None:
+        import os
+
+        from ray_tpu.util.collective import ring
+
+        self._plane = dplane
+        self.handle = handle
+        self._release = release
+        self._on_done = on_done
+        self.nbytes = handle.nbytes
+        self.page_bytes = handle.page_bytes
+        self.n_pages = handle.n_pages
+        self._segs = handle.segments()
+        self._buf = _staging_checkout(handle.nbytes)
+        self._mv = memoryview(self._buf)[:handle.nbytes]
+        self._cv = threading.Condition()
+        self._next_page = 0
+        self._pages_done = 0
+        self._error: Optional[DevicePlaneError] = None
+        self._cancelled = False
+        self._finished = False
+        self.t0_wall_ns = time.time_ns()
+        self._t0 = time.perf_counter()
+        self.dur_s: Optional[float] = None
+        self.streams = max(1, min(int(CONFIG.pd_pull_streams), self.n_pages,
+                                  max(2, os.cpu_count() or 1)))
+        self._cplane = ring.get_plane(dplane._authkey, min_streams=self.streams)
+        for i in range(self.streams):
+            threading.Thread(target=self._pull_loop, daemon=True,
+                             name=f"rt-pd-pull-{i}").start()
+
+    # -- puller side -------------------------------------------------------------
+
+    def _pull_loop(self) -> None:
+        from ray_tpu.util.fault_injection import fail_point
+
+        addr = (self.handle.data_host, self.handle.data_port)
+        deadline = self._t0 + float(CONFIG.pd_fetch_timeout_s)
+        # claim contiguous RUNS of pages, not single pages: a failure kills the
+        # whole fetch (there is no per-page retry), so page granularity buys
+        # nothing per-claim — but every ranged pull costs a request/ok/go
+        # handshake, and coalescing a stream's adjacent pages into one pull
+        # amortizes it. ~4 claims per stream keeps the tail load-balanced.
+        run_pages = max(1, -(-self.n_pages // (self.streams * 4)))
+        while True:
+            with self._cv:
+                if (self._error is not None or self._cancelled
+                        or self._next_page >= self.n_pages):
+                    return
+                page = self._next_page
+                run = min(run_pages, self.n_pages - page)
+                self._next_page += run
+            try:
+                # chaos site: armed with mode=delay this stretches the handoff
+                # window (SIGKILL-the-producer tests), mode=error simulates a
+                # torn pull
+                fail_point("llm.pd.handoff", page=page,
+                           key=self.handle.key.hex())
+                self._pull_range(addr, page, run, deadline)
+            except BaseException as e:
+                err = e if isinstance(e, DevicePlaneError) else DevicePlaneError(
+                    f"paged KV pull failed: {type(e).__name__}: {e}")
+                if err is not e:
+                    err.__cause__ = e
+                first = False
+                with self._cv:
+                    if self._error is None and not self._finished:
+                        self._error = err
+                        first = True
+                    self._cv.notify_all()
+                if first:
+                    self._resolve(ok=False)
+                return
+            done = False
+            with self._cv:
+                self._pages_done += run
+                if self._pages_done >= self.n_pages and not self._finished:
+                    self.dur_s = time.perf_counter() - self._t0
+                    done = True
+                self._cv.notify_all()
+            if done:
+                self._resolve(ok=True)
+                return
+
+    def _pull_range(self, addr, page: int, n_run: int, deadline: float) -> None:
+        start = page * self.page_bytes
+        end = min(start + n_run * self.page_bytes, self.nbytes)
+        for skey, seg_off, seg_len in self._segs:
+            lo, hi = max(start, seg_off), min(end, seg_off + seg_len)
+            if lo >= hi:
+                continue
+            while True:
+                with self._cv:
+                    if self._error is not None or self._cancelled:
+                        return
+                n = self._cplane.pull_into(addr, skey, lo - seg_off, hi - lo,
+                                           self._mv[lo:hi],
+                                           timeout=self._PROBE_S)
+                if n is not None:
+                    break
+                # bounded-probe miss: the range is published up front, so a
+                # miss means the export was retracted (or the producer is
+                # wedged) — probe liveness instead of pinning an op timeout
+                resp = self._plane._control(self.handle,
+                                            ("stat", self.handle.key))
+                if resp[0] != "ok":
+                    raise DevicePlaneError(
+                        "export released by producer mid-transfer")
+                if time.perf_counter() > deadline:
+                    raise DevicePlaneError(
+                        f"paged KV fetch exceeded "
+                        f"{CONFIG.pd_fetch_timeout_s}s deadline")
+
+    def _resolve(self, ok: bool) -> None:
+        with self._cv:
+            if self._finished:
+                return
+            self._finished = True
+        with self._plane._lock:
+            if ok:
+                self._plane.counters["pulls"] += 1
+                self._plane.counters["bytes_pulled"] += self.nbytes
+                self._plane.counters["paged_pulls"] = (
+                    self._plane.counters.get("paged_pulls", 0) + 1)
+            else:
+                self._plane.counters["fallbacks"] += 1
+        if ok and self._release:
+            self._ack_release()
+        cb = self._on_done
+        if cb is not None:
+            try:
+                cb()
+            # graftlint: allow[swallowed-exception] callback isolation: a throwing subscriber must not break the caller
+            except Exception:
+                pass
+
+    def _ack_release(self) -> None:
+        try:
+            self._plane._control(self.handle, ("release", self.handle.key))
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
+        except Exception:
+            pass  # producer TTL-prunes as backstop
+
+    # -- consumer side -----------------------------------------------------------
+
+    def failed(self) -> Optional[DevicePlaneError]:
+        with self._cv:
+            return self._error
+
+    def ready(self) -> bool:
+        """All pages landed (does not raise; pair with failed())."""
+        with self._cv:
+            return self._error is None and self._pages_done >= self.n_pages
+
+    def pages_done(self) -> int:
+        with self._cv:
+            return self._pages_done
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until every page landed; raises DevicePlaneError on transfer
+        failure or timeout."""
+        deadline = time.monotonic() + (
+            float(CONFIG.pd_fetch_timeout_s) if timeout is None else timeout)
+        with self._cv:
+            while True:
+                if self._error is not None:
+                    raise self._error
+                if self._pages_done >= self.n_pages:
+                    return
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise DevicePlaneError(
+                        "timed out waiting for paged KV fetch")
+                self._cv.wait(min(left, 1.0))
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """The fetched pytree as zero-copy numpy views over the landed buffer
+        (consumers device_put / jnp.asarray what they install)."""
+        import pickle
+
+        import jax
+        import numpy as np
+
+        self.wait(timeout)
+        arrays = []
+        for (skey, off, ln), spec in zip(self._segs, self.handle.specs):
+            dt = np.dtype(spec.dtype)
+            arrays.append(
+                np.frombuffer(self._buf, dtype=dt, count=ln // dt.itemsize,
+                              offset=off).reshape(spec.shape))
+        treedef = pickle.loads(self.handle.treedef_pickle)
+        return jax.tree.unflatten(treedef, arrays)
+
+    def cancel(self, release: bool = True) -> None:
+        """Abandon the transfer (consumer aborted the request): pullers stop
+        at the next page/probe boundary; release=True still acks the producer
+        so the export unpins without waiting for the TTL backstop."""
+        with self._cv:
+            if self._finished:
+                return
+            self._cancelled = True
+            self._finished = True
+            self._cv.notify_all()
+        if release:
+            self._ack_release()
+
+    def recycle(self) -> None:
+        """Return the staging buffer to the process pool. Call ONLY after the
+        result() views have been copied out — they alias the buffer and the
+        next fetch will overwrite it. No-op for a cancelled or failed fetch
+        (a straggler puller may still be landing bytes into the buffer) and
+        on double-recycle."""
+        with self._cv:
+            if (not self._finished or self._cancelled
+                    or self._error is not None or self._buf is None):
+                return
+            buf, self._buf, self._mv = self._buf, None, None
+        _staging_recycle(buf)
+
+
+def release_remote(handle) -> None:
+    """Release an export by dialing the exporting process's arm channel
+    directly — pool-safe: a pool routes method calls p2c across replicas, so
+    'release via the handle that prefilled' cannot be expressed as a
+    deployment call, but the arm address on the handle pins the right
+    process. Best-effort; raises DevicePlaneError only when no authkey/dial.
+    """
+    plane()._control(handle, ("release", handle.key))
 
 
 _plane: Optional[DevicePlane] = None

@@ -1174,6 +1174,9 @@ class Cluster:
             self.drop_stream(msg[1], msg[2])
         elif kind == "decref":
             self.store.decref(msg[1])
+        elif kind == "incref":
+            # explicit pin (stream handoff): released by the adopter's owned ref
+            self.store.incref(msg[1])
         elif kind == "recover":
             _, req_id, oid = msg
             host = self._worker_host(w)
@@ -2553,6 +2556,9 @@ class DriverContext:
 
     def decref(self, oid: ObjectID) -> None:
         self.cluster.store.decref(oid)
+
+    def incref(self, oid: ObjectID) -> None:
+        self.cluster.store.incref(oid)
 
     def drop_stream(self, task_id: TaskID, start_index: int) -> None:
         self.cluster.drop_stream(task_id, start_index)

@@ -8,7 +8,7 @@ pins the object for the duration of the borrow).
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 from .ids import ObjectID
 
@@ -124,6 +124,38 @@ class ObjectRefGenerator:
                 return ObjectRef(item.id, owned=self._owner)
             # completion landed first: learn the count (or raise the task error)
             self._count = int(ctx.get(self._completion))
+
+    def handoff(self) -> Tuple:
+        """Transfer the stream's REMAINING items to another process: returns
+        the (completion, task_id, cursor, count) state for ``adopt`` and
+        disowns this copy, so drop-on-GC moves with the state instead of
+        firing here while the adopting consumer is still draining. Single
+        consumer only: the caller must stop iterating after handoff.
+
+        The completion object is PINNED here (synchronously, before this
+        process's owned ref can GC-decref it): the head abandons a stream —
+        dropping every item the producer yields from then on — the moment its
+        completion object is freed while the task still runs, so without the
+        pin the hand-off would race this process's GC and strand the adopter
+        mid-stream. ``adopt`` rebuilds the completion as an OWNED ref whose
+        GC-decref releases exactly this pin."""
+        from . import global_state
+
+        global_state.worker().incref(self._completion.id)
+        state = (self._completion, self._task_id, self._i, self._count)
+        self._owner = False
+        return state
+
+    @classmethod
+    def adopt(cls, state: Tuple) -> "ObjectRefGenerator":
+        """Rebuild an OWNING generator from ``handoff`` state: resumes at the
+        handed-off cursor and takes over drop-on-GC/close for the items the
+        original never consumed. The completion ref is rebuilt OWNED so this
+        process's GC releases the pin ``handoff`` took."""
+        completion, task_id, i, count = state
+        g = cls(ObjectRef(completion.id, owned=True), task_id, _owner=True)
+        g._i, g._count = i, count
+        return g
 
     def close(self) -> None:
         """Release unconsumed items NOW (same effect as GC'ing the generator):

@@ -206,6 +206,10 @@ def dial(address: Tuple[str, int], authkey: Optional[bytes] = None,
 
         ctx = tls_utils.client_ssl_context()
         raw = socket.create_connection(address, timeout=timeout)
+        try:
+            raw.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
         sock = ctx.wrap_socket(raw)
         sock.settimeout(None)  # planes manage stall bounds at the fd level
         conn = SecureConnection(sock)
@@ -213,6 +217,27 @@ def dial(address: Tuple[str, int], authkey: Optional[bytes] = None,
             answer_challenge(conn, authkey)
             deliver_challenge(conn, authkey)
         return conn
-    from multiprocessing.connection import Client
+    from multiprocessing.connection import Client, answer_challenge, deliver_challenge
 
-    return Client(address, authkey=authkey)
+    # authkey handled here, not by Client: the challenge must run AFTER
+    # TCP_NODELAY is set, or its tiny request/response writes serialize on
+    # Nagle + delayed-ACK (~40 ms per control round-trip on loopback)
+    conn = Client(address)
+    set_nodelay(conn.fileno())
+    if authkey is not None:
+        answer_challenge(conn, authkey)
+        deliver_challenge(conn, authkey)
+    return conn
+
+
+def set_nodelay(fd: int) -> None:
+    """TCP_NODELAY on a raw fd (mp.Connection hides its socket object)."""
+    import os
+
+    s = socket.socket(fileno=os.dup(fd))
+    try:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # non-TCP transport (unix socket test listeners)
+    finally:
+        s.close()

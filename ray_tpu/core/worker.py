@@ -231,6 +231,13 @@ class WorkerContext:
         except Exception:
             pass
 
+    def incref(self, oid: ObjectID) -> None:
+        """Pin an object on the head node (ObjectRefGenerator.handoff: the pin
+        outlives this process's refs and transfers to the adopting consumer).
+        NOT best-effort: a failed pin must surface so the caller keeps relaying
+        instead of handing off a stream the head may free under the adopter."""
+        self._send(("incref", oid))
+
     def drop_stream(self, task_id: TaskID, start_index: int) -> None:
         try:
             self._send(("drop_stream", task_id, start_index))

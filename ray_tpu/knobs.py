@@ -109,6 +109,11 @@ KNOBS: List[Knob] = [
     Knob("RAY_TPU_TRANSFER_MAX_PULLS", "int", 8,
          "Max concurrent pulls a node issues (and streams it serves).",
          "transfer", attr="transfer_max_pulls"),
+    Knob("RAY_TPU_TRANSFER_UDS", "bool", True,
+         "Same-host data-plane pulls ride an abstract unix socket instead of "
+         "loopback TCP (~1.4x bulk throughput); remote pulls and TLS mode "
+         "always use TCP. The authkey challenge gates both transports.",
+         "transfer", attr="transfer_uds"),
     Knob("RAY_TPU_TRANSFER_STRIPE_THRESHOLD_BYTES", "int", 8 * 1024 * 1024,
          "Objects at or above this size pull as concurrent byte-range stripes "
          "over pooled connections (0 disables striping). All stripes of one "
@@ -376,6 +381,33 @@ KNOBS: List[Knob] = [
          "Max un-acked P/D KV exports a prefill engine pins before LRU "
          "pruning (each pins device memory until the decode side pulls).",
          "llm", attr="pd_export_max_live"),
+    Knob("RAY_TPU_PD_PAGED", "bool", True,
+         "P/D KV handoff rides the paged streaming path: prefill publishes "
+         "the KV region on the striped data plane and decode pulls it "
+         "page-by-page over multiple streams, overlapped with decode bursts. "
+         "Off = the original monolithic single-stream device-plane export.",
+         "llm", attr="pd_paged"),
+    Knob("RAY_TPU_PD_PAGE_BYTES", "int", 1 << 20,
+         "Page size of the paged P/D KV handoff: the unit one puller stream "
+         "fetches per ranged pull. Smaller pages spread better across "
+         "streams; larger pages amortize per-pull framing.",
+         "llm", attr="pd_page_bytes"),
+    Knob("RAY_TPU_PD_PULL_STREAMS", "int", 4,
+         "Concurrent puller streams a decode replica uses for one paged KV "
+         "handoff (also the minimum stream count the prefill side's data "
+         "server is provisioned for).",
+         "llm", attr="pd_pull_streams"),
+    Knob("RAY_TPU_PD_FETCH_TIMEOUT_S", "float", 60.0,
+         "Overall deadline for one paged P/D KV fetch; past it the decode "
+         "side fails the transfer with a typed DevicePlaneError and the "
+         "router replays the request on the host path.",
+         "llm", attr="pd_fetch_timeout_s"),
+    Knob("RAY_TPU_PD_STAGING_BUFFERS", "int", 2,
+         "Max recycled paged-handoff staging buffers a decode process pools. "
+         "A fresh destination buffer costs a zero-fill page-fault pass per "
+         "handoff; recycling skips it. Each pooled buffer holds one "
+         "handoff's KV bytes of host memory; 0 disables pooling.",
+         "llm", attr="pd_staging_buffers"),
     Knob("RAY_TPU_LLM_ENGINE_IDLE_WAIT_S", "float", 0.05,
          "Engine scheduler-loop sleep when no slot is active (admission "
          "latency floor for the first request of a burst).",

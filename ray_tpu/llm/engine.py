@@ -24,7 +24,7 @@ import queue
 import threading
 import time
 import uuid
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -101,6 +101,17 @@ class _Request:
         self.generated = 0
         self.slot = -1
         self.prefill_kv = prefill_kv  # (k, v, first_token): P/D-disagg transfer-in
+        # paged streaming handoff: in-flight PagedKVFetch whose pages stream
+        # concurrently with other requests' decode bursts; admission defers
+        # until it is ready and resolves it into prefill_kv
+        self.kv_fetch = None
+        self.kv_fetch_error = None  # DevicePlaneError a failed fetch resolved to
+        # completed fetch whose staging buffer prefill_kv still aliases;
+        # recycled once the KV is installed (or the request fails)
+        self.kv_staging = None
+        self.kv_first_token = 0
+        self.first_emitted = False  # first token streamed at arrival (TTFT
+        # rides the handle); admission must not emit it again
         self.pending_text: List[int] = []  # undecoded ids (byte tokenizer is stateless)
         # prompt + every sampled token: recompute-preemption (paged pool
         # exhausted) re-prefills from this history so decoding continues exactly
@@ -175,6 +186,13 @@ class JaxLLMEngine(LLMEngine):
         self.num_spec_drafted = 0
         self.num_spec_accepted = 0
         self.num_prefix_skipped = 0  # pay-or-skip gate declined the cache
+        # P/D export bookkeeping (prefill side): (monotonic, key) per un-acked
+        # KV export, LRU/TTL-pruned by _track_pd_export and the lazy prune
+        # daemon; kept in sync with the device plane's own releases (consumer
+        # acks, TTL sweeps) through a plane release listener
+        self._pd_exports: List[Tuple[float, bytes]] = []
+        self._pd_prune_thread: Optional[threading.Thread] = None
+        self._pd_listener_registered = False
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> None:
@@ -556,15 +574,41 @@ class JaxLLMEngine(LLMEngine):
             jnp.asarray([params.top_k], jnp.int32),
         )[0])
         out = {"prompt_ids": prompt_ids, "first_token": tok}
+        # pre-rendered first-token text: lets the P/D router mint the first
+        # SSE content frame the moment this result lands, without waiting for
+        # the decode replica's stream to start (TTFT rides prefill alone).
+        # Stop tokens emit no content and a token that decodes to a partial
+        # UTF-8 codepoint can't be rendered alone — both leave first_text
+        # unset and the router falls back to relaying the decode stream.
+        stops = params.stop_token_ids or [self.tokenizer.eos_token_id]
+        if tok not in stops:
+            txt = self.tokenizer.decode([tok])
+            if txt and not txt.endswith("�"):
+                out["first_text"] = txt
+        from ray_tpu.config import CONFIG as _CFG
         from ray_tpu.core import device_plane as _dp
 
+        if self.config.kv_layout == "paged":
+            # ship only the block-aligned prefix the decode side installs —
+            # the bucket-pad tail is attention-masked garbage it re-pads anyway
+            from .paged import trim_kv_for_transfer
+
+            k, v = trim_kv_for_transfer(k, v, len(prompt_ids),
+                                        self.config.kv_block_size)
         dp = _dp.plane()
-        if dp.available and not force_host:
+        use_paged = bool(_CFG.pd_paged) and dp.paged_available
+        if not force_host and (use_paged or dp.available):
             # plane-level ttl: backstop for a decode replica that crashes
             # before acking (the engine's own tracker prunes sooner)
-            from ray_tpu.config import CONFIG as _CFG
-
-            handle = dp.export({"k": k, "v": v}, ttl_s=_CFG.pd_export_ttl_s)
+            if use_paged:
+                # block-addressable region on the striped data plane: the
+                # decode side pulls it page-by-page over multiple streams,
+                # overlapped with its decode bursts
+                handle = dp.export_paged({"k": k, "v": v},
+                                         ttl_s=_CFG.pd_export_ttl_s,
+                                         page_bytes=_CFG.pd_page_bytes)
+            else:
+                handle = dp.export({"k": k, "v": v}, ttl_s=_CFG.pd_export_ttl_s)
             self._track_pd_export(handle.key)
             out["kv_handle"] = handle
             out["kv_key"] = handle.key.hex()
@@ -589,25 +633,56 @@ class JaxLLMEngine(LLMEngine):
             max_live = _CFG.pd_export_max_live
         if ttl_s is None:
             ttl_s = _CFG.pd_export_ttl_s / 2
+        self._ensure_pd_release_listener()
         now = _time.monotonic()
         stale = []
         with self._lock:
-            pending = self.__dict__.setdefault("_pd_exports", [])
+            pending = self._pd_exports
             pending.append((now, key))
             while pending and (len(pending) > max_live or now - pending[0][0] > ttl_s):
                 stale.append(pending.pop(0)[1])
-            if not self.__dict__.get("_pd_prune_thread"):
+            if self._pd_prune_thread is None:
                 # TTL enforcement can't depend on the NEXT prefill arriving —
                 # a crashed consumer with no follow-on traffic would pin KV
                 # forever. A lazy daemon sweeps on a timer.
-                import threading as _threading
-
-                t = _threading.Thread(target=self._pd_prune_loop, daemon=True,
-                                      name="rt-pd-export-prune")
-                self.__dict__["_pd_prune_thread"] = t
-                t.start()
+                self._pd_prune_thread = threading.Thread(
+                    target=self._pd_prune_loop, daemon=True,
+                    name="rt-pd-export-prune")
+                self._pd_prune_thread.start()
         for old in stale:
             _dp.plane().release(old)
+
+    def _ensure_pd_release_listener(self) -> None:
+        """Keep _pd_exports in lockstep with the device plane: consumer acks
+        ride the arm channel straight to the plane (pool routing cannot
+        address 'the replica that prefilled'), so the engine learns about
+        them through the plane's release listener rather than polling. A
+        WeakMethod keeps retired engines collectable — the plane is a
+        process singleton."""
+        if self._pd_listener_registered:
+            return
+        import weakref
+
+        from ray_tpu.core import device_plane as _dp
+
+        with self._lock:
+            if self._pd_listener_registered:
+                return
+            self._pd_listener_registered = True
+        ref = weakref.WeakMethod(self._on_pd_export_released)
+
+        def _cb(key, _ref=ref):
+            m = _ref()
+            if m is not None:
+                m(key)
+
+        _dp.plane().add_release_listener(_cb)
+
+    def _on_pd_export_released(self, key: bytes) -> None:
+        with self._lock:
+            if self._pd_exports:
+                self._pd_exports[:] = [e for e in self._pd_exports
+                                       if e[1] != key]
 
     def _pd_prune_loop(self, interval_s: float = 30.0,
                        ttl_s: float = None) -> None:
@@ -619,12 +694,12 @@ class JaxLLMEngine(LLMEngine):
         if ttl_s is None:
             ttl_s = _CFG.pd_export_ttl_s / 2
 
-        while not getattr(self, "_shutdown", False):
+        while not self._shutdown:
             _time.sleep(interval_s)
             now = _time.monotonic()
             stale = []
             with self._lock:
-                pending = self.__dict__.get("_pd_exports") or []
+                pending = self._pd_exports
                 while pending and now - pending[0][0] > ttl_s:
                     stale.append(pending.pop(0)[1])
             for old in stale:
@@ -637,9 +712,9 @@ class JaxLLMEngine(LLMEngine):
         key = bytes.fromhex(key_hex)
         _dp.plane().release(key)
         with self._lock:
-            pending = self.__dict__.get("_pd_exports")
-            if pending:
-                pending[:] = [e for e in pending if e[1] != key]
+            if self._pd_exports:
+                self._pd_exports[:] = [e for e in self._pd_exports
+                                       if e[1] != key]
 
     def generate_from_prefill(self, prefill_result: Dict[str, Any],
                               params: SamplingParams,
@@ -647,36 +722,110 @@ class JaxLLMEngine(LLMEngine):
                               ) -> Iterator[RequestOutput]:
         """Continue decoding from a transferred prefill (decode replica side).
 
-        The device-plane KV pull happens EAGERLY (not at first next()) so a pull
-        failure raises here — where the P/D router can still fall back to the
-        host path — rather than mid-stream."""
+        The device-plane handle is validated EAGERLY (not at first next()) so
+        a dead export raises here — where the P/D router can still fall back
+        to the host path — rather than mid-stream.
+
+        Paged handles stream: the first token (sampled prefill-side, riding
+        the ~1 KB handle) is emitted immediately, the KV pages pull over
+        multiple streams concurrently with the active batch's decode bursts,
+        and the request admits at a burst boundary once its pages have
+        landed. A mid-transfer failure surfaces as a typed DevicePlaneError
+        from the stream, which the PDRouter converts into its host-fallback
+        replay."""
         self.start()
         self._ensure_decode_started()
+        fetch = None
         if "kv_handle" in prefill_result:
             from ray_tpu.core import device_plane as _dp
 
-            kv = _dp.plane().fetch(prefill_result["kv_handle"], release=True)
-            pre_k, pre_v = kv["k"], kv["v"]
+            handle = prefill_result["kv_handle"]
+            if isinstance(handle, _dp.PagedKVHandle):
+                # raises DevicePlaneError here if the export is already gone
+                fetch = _dp.plane().fetch_paged(handle, release=True,
+                                                on_done=self._wakeup.set)
+                req = _Request(request_id or uuid.uuid4().hex,
+                               list(prefill_result["prompt_ids"]), params)
+                req.kv_fetch = fetch
+                req.kv_first_token = int(prefill_result["first_token"])
+            else:
+                t0_wall, t0_perf = time.time_ns(), time.perf_counter_ns()
+                kv = _dp.plane().fetch(handle, release=True)
+                self._record_kv_handoff_raw(
+                    handle.nbytes, (time.perf_counter_ns() - t0_perf) / 1e9,
+                    t0_wall, mode="monolithic")
+                req = _Request(
+                    request_id or uuid.uuid4().hex,
+                    list(prefill_result["prompt_ids"]), params,
+                    prefill_kv=(kv["k"], kv["v"],
+                                int(prefill_result["first_token"])),
+                )
         else:
-            pre_k, pre_v = prefill_result["k"], prefill_result["v"]
-        req = _Request(
-            request_id or uuid.uuid4().hex, list(prefill_result["prompt_ids"]), params,
-            prefill_kv=(pre_k, pre_v, int(prefill_result["first_token"])),
-        )
+            req = _Request(
+                request_id or uuid.uuid4().hex,
+                list(prefill_result["prompt_ids"]), params,
+                prefill_kv=(prefill_result["k"], prefill_result["v"],
+                            int(prefill_result["first_token"])),
+            )
         with self._lock:
             self.num_pending += 1
             self._requests[req.id] = req
-        self._waiting.put(req)
-        self._wakeup.set()
+        if fetch is not None and self._emit_prefill_first_token(req):
+            pass  # finished on its first token: never queued, fetch abandoned
+        else:
+            self._waiting.put(req)
+            self._wakeup.set()
 
         def _stream() -> Iterator[RequestOutput]:
             while True:
                 out = req.out_queue.get()
+                if out.finish_reason == "kv_transfer":
+                    from ray_tpu.core.device_plane import DevicePlaneError
+
+                    err = req.kv_fetch_error if req.kv_fetch_error is not None \
+                        else DevicePlaneError("paged KV transfer failed")
+                    raise err
                 yield out
                 if out.finished:
                     return
 
         return _stream()
+
+    def _emit_prefill_first_token(self, req: _Request) -> bool:
+        """Paged P/D handoff: stream the prefill-sampled first token NOW —
+        TTFT rides the handle, not the KV payload. Returns True when that
+        token already finishes the request (stop token or max_tokens == 1);
+        it then never enters the waiting queue and the in-flight fetch is
+        abandoned (with a release ack, so the producer unpins)."""
+        tok = req.kv_first_token
+        req.generated = 1
+        req.token_history.append(tok)
+        req.first_emitted = True
+        self._record_first_token(req)
+        with self._lock:
+            self.total_generated += 1
+        stops = req.params.stop_token_ids or [self.tokenizer.eos_token_id]
+        finished, reason = False, None
+        if tok in stops:
+            finished, reason = True, "stop"
+        elif req.generated >= req.params.max_tokens:
+            finished, reason = True, "length"
+        emit_ids = [] if reason == "stop" else [tok]
+        req.out_queue.put(RequestOutput(
+            request_id=req.id, token_ids=emit_ids,
+            text=self.tokenizer.decode(emit_ids) if emit_ids else "",
+            finished=finished, finish_reason=reason,
+            num_prompt_tokens=len(req.prompt_ids), num_generated_tokens=1,
+        ))
+        if finished:
+            req.kv_fetch.cancel()
+            req.kv_fetch = None
+            self._record_finish(req)
+            with self._lock:
+                self.num_pending -= 1
+                self._requests.pop(req.id, None)
+                self._aborted.discard(req.id)
+        return finished
 
     def generate_sync(self, prompt, params: SamplingParams) -> RequestOutput:
         """Collect the full generation into one RequestOutput."""
@@ -708,6 +857,9 @@ class JaxLLMEngine(LLMEngine):
             "num_spec_drafted": self.num_spec_drafted,
             "num_spec_accepted": self.num_spec_accepted,
             "num_prefix_skipped": self.num_prefix_skipped,
+            # P/D: device-plane KV exports this engine still pins (leak probe
+            # for the chaos gate — consumer acks must drain it, not the TTL)
+            "pd_exports_live": len(self._pd_exports),
             # fused fast path: current burst width and where the decode wall
             # time goes (the quantity auto-K minimizes; the bench gates on it)
             "decode_fused_steps": self.decode_steps_target(),
@@ -808,6 +960,36 @@ class JaxLLMEngine(LLMEngine):
                 cache_hit=req.prefix_hit_tokens > 0,
                 trace_id=req.trace_id)
 
+    def _record_kv_handoff(self, fetch) -> None:
+        self._record_kv_handoff_raw(fetch.nbytes, fetch.dur_s or 0.0,
+                                    fetch.t0_wall_ns, mode="paged",
+                                    pages=fetch.n_pages, streams=fetch.streams)
+
+    def _record_kv_handoff_raw(self, nbytes: int, dur_s: float,
+                               t0_wall_ns: int, mode: str, pages: int = 1,
+                               streams: int = 1) -> None:
+        """P/D KV handoff signals: per-transfer GB/s histogram (surfaced in
+        cluster_status()["llm"]) + an llm.kv_handoff span covering the
+        transfer wall time."""
+        try:
+            if dur_s <= 0 or nbytes <= 0:
+                return
+            gbps = nbytes / dur_s / 1e9
+            tags = dict(self._model_tag(), mode=mode)
+            telemetry.get_histogram(
+                "llm_kv_handoff_gbps",
+                "P/D KV handoff throughput per transfer (GB/s)",
+                tag_keys=("model", "mode"),
+                boundaries=[0.1, 0.25, 0.5, 1, 2, 4, 8, 16, 32]).observe(
+                gbps, tags=tags)
+            if telemetry.enabled():
+                telemetry.complete(
+                    "llm.kv_handoff", "llm", t0_wall_ns, int(dur_s * 1e9),
+                    bytes=nbytes, pages=pages, streams=streams, mode=mode,
+                    gbps=round(gbps, 3))
+        except Exception as e:
+            _metrics_guard_warn("_record_kv_handoff", e)
+
     def _record_first_token(self, req: _Request) -> None:
         req.first_token_perf_ns = time.perf_counter_ns()
         try:
@@ -867,6 +1049,18 @@ class JaxLLMEngine(LLMEngine):
 
     def _admit(self) -> None:
         cfg, c = self.model_config, self.config
+        # paged P/D requests whose pages are still streaming: skipped this
+        # pass, re-queued on exit so they admit at a later burst boundary —
+        # their transfer overlaps the active batch's decode bursts instead of
+        # head-of-line-blocking admission
+        deferred: List[_Request] = []
+        try:
+            self._admit_inner(cfg, c, deferred)
+        finally:
+            for r in deferred:
+                self._waiting.put(r)
+
+    def _admit_inner(self, cfg, c, deferred: List["_Request"]) -> None:
         for slot in self._free_slots():
             try:
                 req = self._waiting.get_nowait()
@@ -877,8 +1071,29 @@ class JaxLLMEngine(LLMEngine):
                 self._aborted.discard(req.id)
             if was_aborted:
                 self.num_aborted += 1
+                if req.kv_fetch is not None:
+                    req.kv_fetch.cancel()
+                    req.kv_fetch = None
                 self._fail_request(req, len(req.prompt_ids), "abort")
                 continue
+            if req.kv_fetch is not None:
+                err = req.kv_fetch.failed()
+                if err is not None:
+                    # mid-transfer failure (producer died, export retracted,
+                    # deadline): typed finish — generate_from_prefill's stream
+                    # re-raises it as DevicePlaneError for the router fallback
+                    req.kv_fetch_error = err
+                    req.kv_fetch = None
+                    self._fail_request(req, len(req.prompt_ids), "kv_transfer")
+                    continue
+                if not req.kv_fetch.ready():
+                    deferred.append(req)
+                    continue
+                fetch, req.kv_fetch = req.kv_fetch, None
+                kv = fetch.result()
+                req.prefill_kv = (kv["k"], kv["v"], req.kv_first_token)
+                req.kv_staging = fetch
+                self._record_kv_handoff(fetch)
             # visible to the loop's crash handler: this request is in neither
             # _waiting nor _active right now, and must still be failed on error
             self._admitting = req
@@ -909,6 +1124,9 @@ class JaxLLMEngine(LLMEngine):
                     # transfer padded past this engine's slot width: fail just
                     # this request (install_kv would crash the whole loop)
                     self._fail_request(req, len(req.prompt_ids))
+                    if req.kv_staging is not None:
+                        req.kv_staging.recycle()
+                        req.kv_staging = None
                     self._admitting = None
                     continue
                 else:
@@ -917,6 +1135,11 @@ class JaxLLMEngine(LLMEngine):
                         jnp.int32(len(req.prompt_ids)), jnp.int32(slot),
                     )
                 req.prefill_kv = None
+                if req.kv_staging is not None:
+                    # jnp.asarray copied the KV out of the staging buffer
+                    # above; hand it back for the next handoff's fetch
+                    req.kv_staging.recycle()
+                    req.kv_staging = None
             elif c.kv_layout == "paged":
                 tok = self._prefill_paged(req, slot)
                 if tok is None:
@@ -947,7 +1170,8 @@ class JaxLLMEngine(LLMEngine):
                 self.num_pending -= 1
                 self.num_active += 1
             self._admitting = None
-            self._emit(req, tok)
+            if not req.first_emitted:
+                self._emit(req, tok)
 
     def _sample_one(self, last_logits, p: SamplingParams) -> int:
         return int(model_runner.sample_tokens(
@@ -1204,7 +1428,9 @@ class JaxLLMEngine(LLMEngine):
         req.token_history.append(tok)
         if req.first_token_perf_ns == 0:
             self._record_first_token(req)
-        self.total_generated += 1
+        with self._lock:
+            # _emit_prefill_first_token bumps this from the request thread
+            self.total_generated += 1
         stops = req.params.stop_token_ids or [self.tokenizer.eos_token_id]
         finished, reason = False, None
         if tok in stops:

@@ -416,6 +416,26 @@ def append_block(state: PagedState, slot: jax.Array, index: jax.Array,
     return state._replace(block_tables=bt)
 
 
+def trim_kv_for_transfer(k, v, n_tokens: int, block_size: int):
+    """Trim bucket-padded prefill KV [L, 1, S_pad, ...] before a P/D handoff
+    to the smallest power-of-two block count covering n_tokens + 1.
+
+    The bucket-pad tail is attention-masked garbage the decode side re-pads
+    on install anyway, so shipping it only burns handoff bandwidth (a short
+    prompt in a coarse bucket can transfer several times its real KV).
+    Power-of-two block counts keep the decode side's install_prefill compile
+    variants log-bounded, exactly as bucketed prefill shapes do."""
+    s_pad = k.shape[2]
+    blocks = max(1, -(-(n_tokens + 1) // block_size))
+    p2 = 1
+    while p2 < blocks:
+        p2 <<= 1
+    s = p2 * block_size
+    if s >= s_pad:
+        return k, v
+    return k[:, :, :s], v[:, :, :s]
+
+
 # ----------------------------------------------------------------- prefix cache
 
 @functools.partial(jax.jit, static_argnames=("n_blocks",))

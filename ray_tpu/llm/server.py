@@ -8,6 +8,7 @@ instead of vLLM.
 """
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 import uuid
@@ -84,9 +85,14 @@ class LLMServer:
         app = serve.deployment(LLMServer).bind(llm_config)
     """
 
-    def __init__(self, llm_config: LLMConfig, engine: Optional[JaxLLMEngine] = None):
+    def __init__(self, llm_config: LLMConfig, engine: Optional[JaxLLMEngine] = None,
+                 prefill_handle=None):
         self.llm_config = llm_config
         self.engine = engine or JaxLLMEngine(llm_config)
+        # decode-pool replicas get a handle to the prefill pool so a
+        # device-plane failure mid-stream can re-prefill over the host path
+        # WITHOUT unwinding through the router (build_pd_openai_app wires it)
+        self.prefill_handle = prefill_handle
         self.engine.start()
 
     # -- OpenAI endpoints --------------------------------------------------------
@@ -116,17 +122,85 @@ class LLMServer:
                 prompt, _sampling_from_body(body), request_id=rid),
             body, chat)
 
-    def decode_stream(self, prefill_result: Dict[str, Any], body: Dict[str, Any],
+    def decode_stream(self, prefill_result, body: Dict[str, Any],
                       chat: bool):
         """Streaming decode side of P/D disaggregation: continue from a
         transferred prefill and yield SSE frames (reference
-        prefill_decode_disagg + ASGI streaming)."""
-        return self._sse_frames(
-            lambda rid: self.engine.generate_from_prefill(
-                prefill_result, _sampling_from_body(body), request_id=rid),
-            body, chat)
+        prefill_decode_disagg + ASGI streaming).
 
-    def _sse_frames(self, start_gen, body: Dict[str, Any], chat: bool):
+        Failure handling lives HERE, not in the router: the router hands this
+        stream straight to the HTTP proxy (StreamHandoff) before the first
+        frame, so nobody upstream can splice in a replacement. A device-plane
+        failure — the prefill result itself, or the KV pull failing mid-page
+        -stream — re-prefills over the host path through ``prefill_handle``
+        and resumes the SAME SSE stream: tokens the first attempt already
+        yielded are skipped by count, which replays exactly under
+        deterministic decoding (greedy or seeded), the caveat the router's
+        unary fallback shares."""
+        sampling = _sampling_from_body(body)
+        pre_err: Optional[BaseException] = None
+        pre: Optional[Dict[str, Any]] = None
+        try:
+            pre = _materialize_prefill(prefill_result)
+        except Exception as e:
+            if self.prefill_handle is None or not _is_device_plane_error(e):
+                raise
+            pre_err = e
+
+        def _host_re_prefill():
+            if pre is not None:
+                _release_orphan_export(pre)
+            prompt = (render_chat_template(body.get("messages", []))
+                      if chat else body.get("prompt", ""))
+            fb_body = dict(body)
+            fb_body["_kv_host_fallback"] = True
+            return self.prefill_handle.options(method_name="prefill").remote(
+                prompt, fb_body).result()
+
+        def start_gen(rid):
+            yielded = 0
+            try:
+                if pre_err is not None:
+                    raise pre_err
+                for out in self.engine.generate_from_prefill(
+                        pre, sampling, request_id=rid):
+                    yielded += len(out.token_ids)
+                    yield out
+                return
+            except GeneratorExit:
+                raise
+            except Exception as e:
+                if self.prefill_handle is None or not _is_device_plane_error(e):
+                    raise
+                _LOGGER.warning(
+                    "device-plane KV handoff failed mid-stream for key %s "
+                    "(%r); resuming over the host path",
+                    (pre or {}).get("kv_key"), e)
+            pre_fb = _host_re_prefill()
+            skip = yielded
+            fb_rid = uuid.uuid4().hex
+            try:
+                for out in self.engine.generate_from_prefill(
+                        pre_fb, sampling, request_id=fb_rid):
+                    ids = out.token_ids
+                    if skip:
+                        k = min(skip, len(ids))
+                        skip -= k
+                        ids = ids[k:]
+                        if not ids and not out.finish_reason:
+                            continue
+                        out = dataclasses.replace(out, token_ids=ids)
+                    yield out
+            except GeneratorExit:
+                self.engine.abort(fb_rid)
+                raise
+
+        return self._sse_frames(
+            start_gen, body, chat,
+            presynth=(pre or {}).get("first_text") or "")
+
+    def _sse_frames(self, start_gen, body: Dict[str, Any], chat: bool,
+                    presynth: str = ""):
         import json as _json
 
         model = body.get("model", self.llm_config.model_id)
@@ -165,6 +239,14 @@ class LLMServer:
                 return frame({"id": rid, "object": obj, "created": created,
                               "model": model, "choices": choices(delta, None)})
 
+            if presynth:
+                # P/D: prefill already sampled AND rendered the first token
+                # (prefill_only's ``first_text``), so emit it before engine
+                # admission — the first content frame doesn't wait for the KV
+                # pull to start. The engine replays the same token id, whose
+                # re-decode lands inside ``emitted`` and yields no frame.
+                yield delta_frame(presynth)
+                emitted = presynth
             eng_rid = uuid.uuid4().hex
             try:
                 for out in start_gen(eng_rid):
@@ -204,8 +286,9 @@ class LLMServer:
         """Ack from the router after decode pulled the device-resident KV."""
         self.engine.release_prefill_export(kv_key)
 
-    def decode_from_prefill(self, prefill_result: Dict[str, Any],
+    def decode_from_prefill(self, prefill_result,
                             body: Dict[str, Any]) -> Dict[str, Any]:
+        prefill_result = _materialize_prefill(prefill_result)
         params = _sampling_from_body(body)
         ids: List[int] = []
         last = None
@@ -291,6 +374,36 @@ class OpenAIRouter:
         return self.handle_http({"path": "/v1/completions", "method": "POST", "body": body})
 
 
+def _materialize_prefill(pre):
+    """Resolve an overlapped prefill handoff on the decode side.
+
+    The PDRouter forwards the prefill pool's response FUTURE straight into the
+    decode call, so decode dispatch/scheduling overlaps prefill execution
+    instead of waiting for the router to materialize the result first — one
+    control round trip off the TTFT critical path. A prefill failure re-raises
+    here and surfaces through the decode call's error path."""
+    return pre.result() if hasattr(pre, "result") else pre
+
+
+def _release_orphan_export(pre: Dict[str, Any]) -> None:
+    """Free an orphaned prefill KV export now instead of waiting for its TTL.
+    Dials the exporting process's arm channel directly off the handle —
+    pool-safe: a ``release_prefill`` deployment call would p2c-route to an
+    arbitrary pool replica, not the one that exported."""
+    handle = pre.get("kv_handle")
+    if handle is None:
+        return
+    try:
+        from ray_tpu.core.device_plane import release_remote
+
+        release_remote(handle)
+    except Exception as rel_err:
+        _LOGGER.warning(
+            "could not release prefill KV export %s after host "
+            "fallback (%r); the prefill engine pins it until the "
+            "TTL backstop", pre.get("kv_key"), rel_err)
+
+
 def _is_device_plane_error(e: BaseException) -> bool:
     """Match a DevicePlaneError surfaced through the actor-RPC boundary (the
     original may arrive re-raised, wrapped, or as a cause)."""
@@ -317,28 +430,52 @@ class PDRouter:
         self.decode_handle = decode_handle
         self.model_id = model_id
 
+    def _release_orphan(self, pre: Dict[str, Any]) -> None:
+        _release_orphan_export(pre)
+
+    def _settle_prefill(self, pre_resp, timeout_s: float = 5.0):
+        """Materialize an overlapped prefill response for fallback handling.
+        Returns the prefill dict, or None when the result is unobtainable
+        (the producer died taking its result object with it) — the fallback
+        path proceeds either way; only the early orphan release is skipped."""
+        try:
+            return pre_resp.result(timeout_s=timeout_s)
+        # graftlint: allow[swallowed-exception] producer gone with its result: the export TTL backstop reaps it
+        except Exception:
+            return None
+
     def _run(self, prompt: str, body: Dict[str, Any]) -> Dict[str, Any]:
-        pre = self.prefill_handle.options(method_name="prefill").remote(
-            prompt, body).result()
+        # the decode call is dispatched IMMEDIATELY with the prefill pool's
+        # response future: the decode replica resolves it itself
+        # (_materialize_prefill), so decode dispatch/scheduling overlaps
+        # prefill execution instead of serializing behind a router-side
+        # result() round trip.
+        pre_resp = self.prefill_handle.options(method_name="prefill").remote(
+            prompt, body)
         # KV release: the decode replica acks the prefill side's device-plane
         # export right after its pull (fetch(..., release=True)); no router hop.
         try:
             return self.decode_handle.options(
-                method_name="decode_from_prefill").remote(pre, body).result()
+                method_name="decode_from_prefill").remote(
+                    pre_resp, body).result()
         except Exception as e:
-            if "kv_handle" not in pre or not _is_device_plane_error(e):
+            if not _is_device_plane_error(e):
+                # a prefill failure is the request's real fate: surface it
+                # (with the handle's replica-retry plane) instead of the
+                # decode-side wrapper it arrived in
+                pre_resp.result()
                 raise
-            # Device pull failed (topology mismatch, prefill replica restarted):
-            # redo the request on the host path — the old always-works behavior.
-            # Free the orphaned export now instead of waiting for its TTL.
-            try:
-                self.prefill_handle.options(method_name="release_prefill").remote(
-                    pre["kv_key"])
-            except Exception as e:
-                _LOGGER.warning(
-                    "could not release prefill KV export %s after host "
-                    "fallback (%r); the prefill engine pins it until the "
-                    "TTL backstop", pre.get("kv_key"), e)
+            # Device pull failed (topology mismatch, prefill replica restarted
+            # or died mid-transfer): redo the request on the host path — the
+            # old always-works behavior.
+            pre = self._settle_prefill(pre_resp)
+            if pre is not None and "kv_handle" not in pre:
+                raise
+            _LOGGER.warning(
+                "device-plane KV handoff failed for key %s (%r); retrying "
+                "over the host path", (pre or {}).get("kv_key"), e)
+            if pre is not None:
+                self._release_orphan(pre)
             body = dict(body)
             body["_kv_host_fallback"] = True
             pre = self.prefill_handle.options(method_name="prefill").remote(
@@ -366,29 +503,95 @@ class PDRouter:
         if not chat and not path.endswith("/completions"):
             raise ValueError(f"unsupported path {path!r}")
         if isinstance(body, dict) and body.get("stream"):
-            # streaming P/D: prefill synchronously (KV transfers through the
-            # object store), then the decode replica streams SSE frames back
-            # through this router's own streaming call (each frame re-streams)
+            # streaming P/D rides the same device-plane handle as unary: the
+            # decode replica pulls KV pages directly from the prefill replica
+            # (~1 KB handle in the control message, no object-store hop) and
+            # the decode stream is handed to the HTTP proxy before its first
+            # frame — SSE frames never re-stream through this router
             prompt = (render_chat_template(body.get("messages", []))
                       if chat else body.get("prompt", ""))
-            pre = self.prefill_handle.options(method_name="prefill").remote(
-                prompt, body).result()
-            return self.decode_handle.options(
-                method_name="decode_stream", stream=True).remote(pre, body, chat)
+            return self._stream_pd(prompt, body, chat)
         return self.chat(body) if chat else self.completions(body)
+
+    def _stream_pd(self, prompt: str, body: Dict[str, Any], chat: bool):
+        """Streaming P/D: dispatch prefill, then hand the decode replica's
+        SSE stream to the HTTP proxy (StreamHandoff) BEFORE its first frame,
+        so frames flow decode -> proxy -> client with no per-frame re-put
+        through this router and nothing router-side on the first-content
+        critical path — the disaggregated stream has the same hop count as
+        the colocated one. The decode replica materializes the prefill
+        future itself (overlapped with its own admission) and owns ALL
+        failure handling: ``decode_stream`` re-prefills over the host path
+        through its own prefill-pool handle on a device-plane failure —
+        whether in the prefill result or mid-KV-pull — and resumes the same
+        SSE stream, mirroring the unary path's fallback. Handing off before
+        the first frame is therefore safe: there is nothing left for this
+        router to splice."""
+        pre_resp = self.prefill_handle.options(method_name="prefill").remote(
+            prompt, body)
+
+        def gen():
+            from ray_tpu.serve.handle import StreamHandoff
+
+            resp = self.decode_handle.options(
+                method_name="decode_stream", stream=True).remote(
+                    pre_resp, body, chat)
+            ho = StreamHandoff.of(resp)
+            if ho is not None:
+                yield ho
+                return
+            # no transferable stream (local-testing handles, or the handoff
+            # pin failed): relay frames through this process instead —
+            # decode_stream's internal fallback still covers failures
+            yield from resp
+
+        return gen()
 
 
 def build_pd_openai_app(llm_config: LLMConfig, *, num_prefill: int = 1,
-                        num_decode: int = 1, name_prefix: str = "llm-pd"):
-    """Prefill/decode-disaggregated serving app (reference build: P/D deployments)."""
+                        num_decode: int = 1, name_prefix: str = "llm-pd",
+                        max_prefill: Optional[int] = None,
+                        max_decode: Optional[int] = None,
+                        ttft_slo_name: Optional[str] = None,
+                        prefill_autoscaling=None, decode_autoscaling=None):
+    """Prefill/decode-disaggregated serving app (reference build: P/D deployments).
+
+    Each pool is an independently autoscaled multi-replica deployment — the
+    two phases have different bottlenecks, so they get different signals:
+
+    - the **prefill pool** scales off TTFT-SLO burn (``mode="slo"`` pinned to
+      ``ttft_slo_name`` when given; register that SLO via
+      ``telemetry.register_slo``). TTFT is prefill-bound, so burning the TTFT
+      budget adds prefill replicas before touching decode.
+    - the **decode pool** scales off live queue depth: decode holds each
+      request for its whole generation, so backlog — not arrival rate — is
+      the capacity signal.
+
+    Autoscaling engages when ``max_prefill``/``max_decode`` exceed the
+    ``num_*`` floors; either policy can be overridden wholesale with
+    ``prefill_autoscaling``/``decode_autoscaling`` (AutoscalingConfig).
+    Without caps the pools stay pinned at ``num_prefill``/``num_decode``.
+    """
     from ray_tpu import serve
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    if prefill_autoscaling is None and (max_prefill or 0) > num_prefill:
+        prefill_autoscaling = AutoscalingConfig.for_slo(
+            min_replicas=num_prefill, max_replicas=max_prefill,
+            slo_names=[ttft_slo_name] if ttft_slo_name else None)
+    if decode_autoscaling is None and (max_decode or 0) > num_decode:
+        decode_autoscaling = AutoscalingConfig.for_slo(
+            min_replicas=num_decode, max_replicas=max_decode)
 
     prefill = serve.deployment(LLMServer).options(
         name=f"{name_prefix}:prefill", num_replicas=num_prefill,
-        max_ongoing_requests=32).bind(llm_config)
+        max_ongoing_requests=32,
+        autoscaling_config=prefill_autoscaling).bind(llm_config)
     decode = serve.deployment(LLMServer).options(
         name=f"{name_prefix}:decode", num_replicas=num_decode,
-        max_ongoing_requests=64).bind(llm_config)
+        max_ongoing_requests=64,
+        autoscaling_config=decode_autoscaling).bind(
+            llm_config, prefill_handle=prefill)
     router = serve.deployment(PDRouter).options(name=f"{name_prefix}-router")
     return router.bind(prefill, decode, llm_config.model_id)
 

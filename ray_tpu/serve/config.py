@@ -37,6 +37,29 @@ class AutoscalingConfig:
             raise ValueError(
                 f"autoscaling mode must be 'ongoing' or 'slo', got {self.mode!r}")
 
+    @classmethod
+    def for_slo(
+        cls,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        slo_names: Optional[list] = None,
+        target_queue_depth: Optional[float] = None,
+    ) -> "AutoscalingConfig":
+        """Closed-loop config: scale off SLO burn and/or live queue depth.
+
+        ``slo_names`` pins the deployment to specific registered SLOs (e.g. a
+        TTFT latency SLO for a prefill pool); ``target_queue_depth`` sets the
+        desired in-flight per replica (e.g. decode pools sized off backlog).
+        """
+        return cls(
+            min_replicas=min_replicas,
+            max_replicas=max_replicas,
+            mode="slo",
+            slo_names=slo_names,
+            target_queue_depth=target_queue_depth,
+        )
+
 
 def _flag(name: str):
     from ray_tpu.config import flag

@@ -170,6 +170,44 @@ class DeploymentResponseGenerator:
         return (DeploymentResponseGenerator, (self._gen,))
 
 
+class StreamHandoff:
+    """Mid-stream splice of a streaming deployment call across processes.
+
+    A relay deployment (e.g. the P/D router re-streaming a decode replica's
+    SSE frames) yields this wrapper as its LAST item to hand the remainder of
+    an upstream stream to whoever is draining it — the HTTP proxy — which
+    then pulls items straight from the producing replica instead of paying a
+    per-item re-put/re-get through the relay process. Construction captures
+    the upstream cursor and disowns the relay's generator copy; ``resume()``
+    on the receiving side rebuilds an OWNING generator, so exactly one
+    process drops unconsumed items on GC/close (a consumer transfer, not a
+    broadcast — the relay must stop iterating once it yields this)."""
+
+    def __init__(self, response_gen: "DeploymentResponseGenerator"):
+        self._state = response_gen._gen.handoff()
+
+    @classmethod
+    def of(cls, stream) -> Optional["StreamHandoff"]:
+        """Wrap ``stream`` for handoff, or None when it is not a transferable
+        deployment stream (local-testing handles, plain generators) or the
+        completion pin could not be taken — the relay then just keeps
+        forwarding frames itself, which is always correct."""
+        gen = getattr(stream, "_gen", None)
+        if isinstance(stream, DeploymentResponseGenerator) and hasattr(
+                gen, "handoff"):
+            try:
+                return cls(stream)
+            # graftlint: allow[swallowed-exception] pin failed (head pipe down): fall back to relaying frames in-process rather than hand off a stream the head may free under the adopter
+            except Exception:
+                return None
+        return None
+
+    def resume(self) -> "DeploymentResponseGenerator":
+        from ray_tpu.core.object_ref import ObjectRefGenerator
+
+        return DeploymentResponseGenerator(ObjectRefGenerator.adopt(self._state))
+
+
 class _CompletionWaiter:
     """ONE daemon thread per router batching ray_tpu.wait over every
     outstanding request (was: one thread per request). Callbacks run the

@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.core.exceptions import BackPressureError
+from ray_tpu.serve.handle import StreamHandoff
 from ray_tpu.util import telemetry
 
 from .controller import CONTROLLER_NAME
@@ -271,13 +272,26 @@ class ProxyActor:
                         else:
                             await resp.write(json.dumps(chunk).encode() + b"\n")
 
+                    handoff = None  # upstream stream adopted from a relay
                     try:
                         for chunk in pending:
-                            await write_chunk(chunk)
+                            if isinstance(chunk, StreamHandoff):
+                                handoff = chunk.resume()
+                                pull = make_pull(handoff)
+                            else:
+                                await write_chunk(chunk)
                         while True:
                             chunk = await loop.run_in_executor(stream_exec, pull)
                             if chunk is _end:
                                 break
+                            if isinstance(chunk, StreamHandoff):
+                                # a relay deployment (P/D router) handed us its
+                                # upstream mid-stream: drain the producing
+                                # replica directly, skipping the relay's
+                                # per-chunk re-put for the rest of the body
+                                handoff = chunk.resume()
+                                pull = make_pull(handoff)
+                                continue
                             await write_chunk(chunk)
                     except Exception as e:  # noqa: BLE001 — mid-stream: terminate body
                         # client gone or replica error: stop the producer so it
@@ -285,6 +299,9 @@ class ProxyActor:
                         if gen is not None:
                             stream_exec.submit(gen.close)
                             gen = None
+                        if handoff is not None:
+                            stream_exec.submit(handoff.close)
+                            handoff = None
                         try:
                             await resp.write(f"\nerror: {e!r}\n".encode())
                         # graftlint: allow[swallowed-exception] client socket already closed while reporting a stream error

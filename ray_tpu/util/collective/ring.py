@@ -203,6 +203,15 @@ class _BufStore:
             self._bufs.pop(key, None)
         return out
 
+    def retract(self, key: str) -> None:
+        """Explicitly drop a published buffer. Publishers that announce with
+        expected_read_bytes=0 (consumer count unknown up front — e.g. paged
+        P/D KV exports re-read under retry) own their buffer's lifetime and
+        must retract it; the TTL sweep is only the dead-publisher backstop."""
+        with self._cond:
+            self._bufs.pop(key, None)
+            self._cond.notify_all()
+
     def _gc_locked(self) -> None:
         ttl = 4 * _op_timeout()
         now = time.monotonic()
@@ -257,6 +266,15 @@ class _Plane:
             if len(loc) == 5 and int(length) > 0:
                 return b"", False
             raise
+
+    def publish(self, key: str, data, expected_read_bytes: int = 0) -> None:
+        """Publish a buffer for peers to pull. exp=0 buffers live until
+        retract() (or the TTL backstop) — used by the paged P/D KV handoff,
+        whose consumer may legitimately re-pull ranges on retry."""
+        self.store.publish(key, data, expected_read_bytes)
+
+    def retract(self, key: str) -> None:
+        self.store.retract(key)
 
     def pull(self, addr, key: str, offset: int, length: int,
              timeout: Optional[float] = None) -> Optional[bytes]:

@@ -13,7 +13,8 @@ the unified registry both ride:
   ``serve.replica.request`` / ``serve.replica.health`` /
   ``serve.autoscaler.decide`` (head-side control loop, top of every tick) /
   ``serve.controller.scale`` (controller apply RPC) / ``data_plane.pull`` /
-  ``collective.wait``.
+  ``collective.wait`` / ``llm.pd.handoff`` (per-page paged KV pull on the
+  decode side — P/D disaggregation's transfer hot path).
 - Arming is per-process via :func:`arm`, or via the
   ``RAY_TPU_FAULT_INJECTION`` environment variable so spawned workers inherit
   specs (``site=mode[@p=0.5][@n=3][@delay=0.1][@seed=7][;site2=...]``).

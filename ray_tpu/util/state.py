@@ -437,6 +437,7 @@ def cluster_status() -> Dict[str, Any]:
     llm_ttft = merged.get("llm_ttft_seconds")
     tok_rate = merged.get("llm_tokens_per_s")
     burst_rate = merged.get("llm_burst_tokens_per_s")
+    kv_handoff = merged.get("llm_kv_handoff_gbps")
     status["llm"] = {
         "ttft_p50_s": m.histogram_quantile(llm_ttft, 0.5) if llm_ttft else None,
         "ttft_p99_s": m.histogram_quantile(llm_ttft, 0.99) if llm_ttft else None,
@@ -454,6 +455,12 @@ def cluster_status() -> Dict[str, Any]:
         "prefix_cache_hits": int(counter_total("llm_prefix_cache_hits_total")),
         "prefix_cache_misses": int(counter_total("llm_prefix_cache_misses_total")),
         "prefix_cache_skipped": int(counter_total("llm_num_prefix_skipped")),
+        # P/D disaggregation: per-handoff KV transfer rate (paged pulls and
+        # monolithic fetches both observe; tagged by mode in the registry)
+        "kv_handoff_gbps_p50": (m.histogram_quantile(kv_handoff, 0.5)
+                                if kv_handoff else None),
+        "kv_handoff_gbps_p99": (m.histogram_quantile(kv_handoff, 0.99)
+                                if kv_handoff else None),
     }
 
     # -- train
