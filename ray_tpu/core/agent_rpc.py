@@ -110,6 +110,9 @@ def encode_head_msg(msg: tuple) -> pb.HeadMessage:
         return pb.HeadMessage(free_object=pb.FreeObject(loc=encode_loc(msg[1])))
     if kind == "shutdown":
         return pb.HeadMessage(shutdown=pb.Shutdown())
+    if kind == "control_backpressure":
+        return pb.HeadMessage(control_backpressure=pb.ControlBackpressure(
+            level=msg[1], min_interval_s=msg[2]))
     if kind == "req":
         _, req_id, op, args = msg
         r = pb.AgentRequest(req_id=req_id, op=op)
@@ -148,6 +151,9 @@ def decode_head_msg(m: pb.HeadMessage) -> tuple:
         return ("free_object", decode_loc(m.free_object.loc))
     if kind == "shutdown":
         return ("shutdown",)
+    if kind == "control_backpressure":
+        return ("control_backpressure", m.control_backpressure.level,
+                m.control_backpressure.min_interval_s)
     if kind == "request":
         r = m.request
         if r.op == "fetch_object":
@@ -189,6 +195,13 @@ def encode_agent_msg(msg: tuple) -> pb.AgentMessage:
     if kind == "worker_log":
         return pb.AgentMessage(worker_log=pb.WorkerLog(worker_id=msg[1],
                                                        stream=msg[2], text=msg[3]))
+    if kind == "node_metrics":
+        _, seq, agent_time, worker_count, metrics_json, telemetry_json, \
+            flush_interval_s = msg
+        return pb.AgentMessage(node_metrics=pb.NodeMetrics(
+            seq=seq, agent_time=agent_time, worker_count=worker_count,
+            metrics_json=metrics_json, telemetry_json=telemetry_json,
+            flush_interval_s=flush_interval_s))
     if kind == "register":
         _, resources, labels, max_workers, extras = msg
         return pb.AgentMessage(register=pb.Register(
@@ -235,6 +248,10 @@ def decode_agent_msg(m: pb.AgentMessage) -> tuple:
     if kind == "worker_log":
         return ("worker_log", m.worker_log.worker_id, m.worker_log.stream,
                 m.worker_log.text)
+    if kind == "node_metrics":
+        nm = m.node_metrics
+        return ("node_metrics", nm.seq, nm.agent_time, nm.worker_count,
+                nm.metrics_json, nm.telemetry_json, nm.flush_interval_s)
     if kind == "register":
         r = m.register
         return ("register", dict(r.resources), dict(r.labels), r.max_workers,

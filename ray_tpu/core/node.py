@@ -247,6 +247,10 @@ class _RemoteProc:
         self._agent = agent
         self._wid_hex = wid_hex
         self.dead = False
+        # the OS pid lives on the agent's host; state.list_workers() (and
+        # anything else duck-typing Process) reads .pid, so carry an honest
+        # "unknown here" instead of AttributeError-ing the whole status call
+        self.pid = None
 
     def is_alive(self) -> bool:
         return not self.dead and self._agent.alive
@@ -429,6 +433,17 @@ class Cluster:
             self.worker_env.setdefault(object_store._ARENA_ENV, self.arena_name)
         self.fn_table: Dict[bytes, bytes] = {}
         self.metrics_by_worker: Dict[Any, list] = {}
+        # per-NODE pre-aggregated deltas (PR 17): upgraded agents merge their
+        # workers' pushes locally and ship one snapshot per flush tick —
+        # entries here REPLACE that agent's per-worker entries above, so the
+        # head-side merge stays O(nodes). Un-upgraded agents keep relaying
+        # per-worker frames and land in metrics_by_worker (automatic fallback).
+        self.metrics_by_node: Dict[str, list] = {}
+        # control-RPC inlet accounting for backpressure: frames seen since
+        # the last scrape tick, evaluated by _evaluate_inlet_backpressure
+        self._inlet_lock = threading.Lock()
+        self._inlet_frames = 0
+        self._bp_level = 0
         self.task_events: deque = deque(maxlen=10000)
         self.trace_spans: deque = deque(maxlen=10000)
         # merged hot-path telemetry events (util/telemetry.py): worker batches
@@ -522,22 +537,141 @@ class Cluster:
         from ray_tpu.util.slo import SLOEngine
 
         self.metrics_history = MetricsHistory()
+        self._restore_history_journal()
         self.slo_engine = SLOEngine(self.metrics_history)
         self._scraper_thread = threading.Thread(
             target=scraper_loop, daemon=True, name="rt-metrics-scraper",
             args=(self.metrics_history, self._scrape_merged_metrics,
-                  lambda: self._shutdown, self.slo_engine.evaluate))
+                  lambda: self._shutdown, self._on_scrape_frame))
         self._scraper_thread.start()
 
     def _scrape_merged_metrics(self) -> Dict[str, Any]:
         """One merged cross-worker snapshot for the history scraper: the
-        head's own registry + every worker's latest push (the same merge
-        state.get_metrics serves, reachable without the state-API guard)."""
+        head's own registry + every worker's latest push + every node's
+        pre-aggregated delta (the same merge state.get_metrics serves,
+        reachable without the state-API guard)."""
         from ray_tpu.util import metrics as _m
 
         snaps = [_m._registry.snapshot()]
         snaps.extend(list(self.metrics_by_worker.values()))
+        snaps.extend(list(self.metrics_by_node.values()))
         return _m.merge_snapshots(snaps)
+
+    def _on_scrape_frame(self) -> None:
+        """Per-scrape-tick control work, invoked by the scraper right after
+        each frame lands: SLO evaluation, the inlet backpressure controller,
+        and the history journal (head-restart durability)."""
+        self.slo_engine.evaluate()
+        self._evaluate_inlet_backpressure()
+        self._journal_history()
+
+    # -- control-plane: inlet accounting + backpressure --------------------------------
+
+    def _note_inlet_frame(self) -> int:
+        """Count one metrics/telemetry frame into the current scrape window;
+        returns the running count so callers can shed past the hard ceiling."""
+        with self._inlet_lock:
+            self._inlet_frames += 1
+            return self._inlet_frames
+
+    def _inlet_shed_ceiling(self) -> int:
+        """Hard per-window ceiling past which telemetry payloads are shed
+        (visibly): 4x the backpressure bound. 0 = never shed."""
+        bound = CONFIG.control_inlet_bound
+        return bound * 4 if bound > 0 else 0
+
+    def _evaluate_inlet_backpressure(self) -> None:
+        """Escalate/clear the typed backpressure signal from the inlet frame
+        count of the scrape window just ended: above the bound agents are
+        told to widen their flush interval (doubling per level, capped at
+        control_backpressure_max_s); below half the bound the level steps
+        back down. Every transition is a counter bump + telemetry event —
+        degradation is never silent."""
+        from ray_tpu.util import telemetry as _tel
+
+        with self._inlet_lock:
+            frames = self._inlet_frames
+            self._inlet_frames = 0
+        _tel.get_gauge(
+            "control_inlet_frames",
+            "metrics/telemetry frames that reached the head's control inlet "
+            "during the last scrape window").set(float(frames))
+        bound = CONFIG.control_inlet_bound
+        level = self._bp_level
+        if bound <= 0:
+            level = 0
+        elif frames > bound:
+            level += 1
+        elif frames < bound // 2 and level > 0:
+            level -= 1
+        base = max(0.1, CONFIG.control_node_flush_s)
+        cap = max(base, CONFIG.control_backpressure_max_s)
+        min_interval = min(base * (2 ** level), cap) if level > 0 else 0.0
+        if level == self._bp_level:
+            return
+        self._bp_level = level
+        _tel.get_gauge(
+            "control_backpressure_level",
+            "current control-inlet backpressure level (0 = none)"
+        ).set(float(level))
+        _tel.get_counter(
+            "control_backpressure_transitions_total",
+            "control-inlet backpressure level changes", tag_keys=("dir",)
+        ).inc(tags={"dir": "up" if frames > bound else "down"})
+        if _tel.enabled():
+            _tel.event("control.backpressure", cat="control", level=level,
+                       inlet_frames=frames, min_interval_s=min_interval)
+        with self._lock:
+            agents = list(self._agent_conns.values())
+        for a in agents:
+            try:
+                a.send(("control_backpressure", level, min_interval))
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
+            except Exception:
+                pass
+
+    # -- control-plane: history journal (head-restart durability) ----------------------
+
+    _HISTORY_JOURNAL_KEY = b"frames"
+    _HISTORY_JOURNAL_NS = "@metrics_history"
+
+    def _journal_history(self) -> None:
+        """Persist the last N scrape frames through the GCS KV path so SLO
+        burn windows and the router's windowed-TTFT latency views survive a
+        head restart (extends PR 15's re-derive discipline: what cannot be
+        re-derived from live agents is journaled)."""
+        n = CONFIG.control_history_journal_frames
+        if n <= 0:
+            return
+        frames = self.metrics_history.frames()[-n:]
+        if not frames:
+            return
+        try:
+            self.gcs.kv.put(self._HISTORY_JOURNAL_KEY,
+                            cloudpickle.dumps(frames),
+                            namespace=self._HISTORY_JOURNAL_NS)
+        # graftlint: allow[swallowed-exception] journal write is best-effort; only head-restart warm-start is lost
+        except Exception:
+            pass
+
+    def _restore_history_journal(self) -> None:
+        if CONFIG.control_history_journal_frames <= 0:
+            return
+        try:
+            raw = self.gcs.kv.get(self._HISTORY_JOURNAL_KEY,
+                                  namespace=self._HISTORY_JOURNAL_NS)
+            if not raw:
+                return
+            restored = self.metrics_history.restore(cloudpickle.loads(raw))
+            if restored:
+                import logging as _logging
+
+                _logging.getLogger("ray_tpu.node").info(
+                    "restored %d metrics-history frames from the journal "
+                    "(SLO windows warm-start)", restored)
+        # graftlint: allow[swallowed-exception] a corrupt journal must not block head start; history simply starts cold
+        except Exception:
+            pass
 
     # -- topology --------------------------------------------------------------------
     def add_node(self, resources: Dict[str, float], labels: Optional[Dict[str, str]] = None,
@@ -839,8 +973,59 @@ class Cluster:
             agent.last_heartbeat = time.time()
         elif kind == "worker_log":
             self._on_worker_log(agent, msg[1], msg[2], msg[3])
+        elif kind == "node_metrics":
+            self._on_node_metrics(agent, msg)
         elif kind == "reply":
             agent.on_reply(msg[1], msg[2], msg[3])
+
+    def _on_node_metrics(self, agent: AgentHandle, msg: Tuple) -> None:
+        """Consume one pre-aggregated per-node delta (JSON payloads — the
+        head never unpickles agent control traffic). The node entry REPLACES
+        this agent's per-worker metric entries so the same series are never
+        counted twice when an agent upgrades mid-flight."""
+        import json as _json
+
+        from ray_tpu.util import metrics as _m
+        from ray_tpu.util import telemetry as _tel
+
+        _, seq, agent_time, worker_count, metrics_json, telemetry_json, \
+            flush_interval_s = msg
+        count = self._note_inlet_frame()
+        try:
+            snap = _m.snapshot_from_wire(_json.loads(metrics_json or b"[]"))
+        # graftlint: allow[swallowed-exception] a malformed delta from one agent must not kill the inlet; the next flush replaces it
+        except Exception:
+            snap = []
+        if snap:
+            self.metrics_by_node[agent.host_key] = snap
+            # retire this agent's per-worker entries: the node delta is now
+            # the canonical source for every series those workers push
+            for w in agent.workers.values():
+                self.metrics_by_worker.pop(w.worker_id, None)
+        ceiling = self._inlet_shed_ceiling()
+        if ceiling and count > ceiling:
+            # past the hard ceiling: shed the telemetry payload (the bulky
+            # part) but keep the cheap metrics snapshot — and say so
+            _tel.get_counter(
+                "control_inlet_shed_total",
+                "telemetry payloads shed at the head's control inlet "
+                "(backpressure hard ceiling)").inc()
+            return
+        try:
+            batches = _json.loads(telemetry_json or b"[]")
+        # graftlint: allow[swallowed-exception] a malformed delta from one agent must not kill the inlet; the next flush replaces it
+        except Exception:
+            batches = []
+        if batches:
+            aligned = []
+            for b in batches:
+                if not isinstance(b, dict):
+                    continue
+                wid = str(b.get("wid") or "")[:8]
+                aligned.extend(_tel.align_batch(b, f"worker-{wid}"))
+            if aligned:
+                with self._lock:
+                    self.telemetry_events.extend(aligned)
 
     def _on_agent_death(self, agent: AgentHandle) -> None:
         """A node agent's connection dropped: fail its workers, drop its objects
@@ -854,6 +1039,7 @@ class Cluster:
             workers = list(agent.workers.values())
             agent.workers.clear()
         agent.fail_all_pending(f"node agent {agent.host_key[:8]} died")
+        self.metrics_by_node.pop(agent.host_key, None)
         err = WorkerCrashedError(f"node {agent.host_key[:8]} died")
         for w in workers:
             w.process.dead = True
@@ -1200,6 +1386,7 @@ class Cluster:
                     self._stack_dumps[token][worker_id_hex] = text
         elif kind == "metrics":
             # periodic per-worker metric snapshot (util/metrics.py push thread)
+            self._note_inlet_frame()
             self.metrics_by_worker[w.worker_id] = msg[1]
         elif kind == "collective_join":
             _, group, rank, epoch = msg
@@ -1228,6 +1415,14 @@ class Cluster:
             # proc-tag here, once, so every reader sees one merged timeline
             from ray_tpu.util import telemetry as _tel
 
+            count = self._note_inlet_frame()
+            ceiling = self._inlet_shed_ceiling()
+            if ceiling and count > ceiling:
+                _tel.get_counter(
+                    "control_inlet_shed_total",
+                    "telemetry payloads shed at the head's control inlet "
+                    "(backpressure hard ceiling)").inc()
+                return
             aligned = _tel.align_batch(msg[1], f"worker-{w.worker_id.hex()[:8]}")
             with self._lock:
                 self.telemetry_events.extend(aligned)

@@ -23,9 +23,12 @@ Run with `ray-tpu start --address=HOST:PORT` (scripts/cli.py) or spawn
 """
 from __future__ import annotations
 
+import collections
+import json
 import multiprocessing
 import multiprocessing.connection
 import os
+import pickle
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -107,6 +110,19 @@ class NodeAgent:
         self._wakeup_r, self._wakeup_w = _mp.Pipe(duplex=False)
         self.worker_env: Dict[str, str] = {}
         self.node_id_hex: Optional[str] = None
+        # observability pre-aggregation (PR 17): instead of relaying every
+        # worker's metrics/telemetry push to the head, intercept them here,
+        # merge, and ship ONE per-node delta per flush tick — head-side
+        # scrape cost becomes O(nodes). Gated by RAY_TPU_CONTROL_NODE_AGG
+        # (off = verbatim relay, the head's automatic fallback path).
+        self._agg_lock = threading.Lock()
+        self._agg_metrics: Dict[str, list] = {}  # wid_hex -> latest snapshot
+        self._agg_telemetry: "collections.deque" = collections.deque(maxlen=256)
+        self._agg_seq = 0
+        self._agg_thread: Optional[threading.Thread] = None
+        # head-imposed minimum flush interval (typed backpressure signal);
+        # 0.0 = no backpressure, agent runs at its own cadence
+        self._bp_min_interval_s = 0.0
 
     # -- transport ----------------------------------------------------------------
     def _send(self, msg) -> None:
@@ -273,11 +289,98 @@ class NodeAgent:
                 except (EOFError, OSError):
                     self._on_local_worker_death(wid)
                     continue
+                if self._maybe_aggregate(wid, raw):
+                    continue
                 try:
                     self._send(("from_worker", wid, raw))
                 # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
                 except Exception:
                     pass  # head restart in flight: the recv loop reconnects
+
+    # -- observability pre-aggregation ----------------------------------------------
+
+    # cloudpickle protocol-5 markers for the two frame kinds we intercept:
+    # ("metrics", ...) / ("telemetry", ...) tuples always carry their kind
+    # string as SHORT_BINUNICODE within the first ~16 bytes. A cheap
+    # substring prefilter avoids unpickling the hot task-result frames; a
+    # false negative merely relays the frame per-worker (correct, just not
+    # aggregated). Unpickling HERE is in-trust-domain: these frames come
+    # from worker processes this agent itself spawned.
+    _METRICS_MARK = b"\x8c\x07metrics\x94"
+    _TELEMETRY_MARK = b"\x8c\ttelemetry\x94"
+
+    def _maybe_aggregate(self, wid: str, raw: bytes) -> bool:
+        """Absorb a worker's metrics/telemetry push into the node-local
+        aggregate instead of relaying it. Returns False (relay verbatim)
+        when aggregation is off or the frame is anything else."""
+        if not CONFIG.control_node_agg:
+            return False
+        head = raw[:24]
+        is_metrics = self._METRICS_MARK in head
+        if not is_metrics and self._TELEMETRY_MARK not in head:
+            return False
+        try:
+            msg = pickle.loads(raw)
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
+        except Exception:
+            return False
+        if not (isinstance(msg, tuple) and len(msg) >= 2):
+            return False
+        if msg[0] == "metrics":
+            with self._agg_lock:
+                # latest CUMULATIVE snapshot per worker: merging fresh copies
+                # each flush keeps counter sums exact across flush ticks
+                self._agg_metrics[wid] = msg[1]
+        elif msg[0] == "telemetry":
+            batch = msg[1] if isinstance(msg[1], dict) else {"events": msg[1]}
+            with self._agg_lock:
+                self._agg_telemetry.append({"wid": wid, **batch})
+        else:
+            return False
+        self._ensure_agg_thread()
+        return True
+
+    def _ensure_agg_thread(self) -> None:
+        if self._agg_thread is not None:
+            return
+        t = threading.Thread(target=self._node_flush_loop, daemon=True,
+                             name="agent-node-flush")
+        self._agg_thread = t
+        t.start()
+
+    def _node_flush_loop(self) -> None:
+        """Ship one merged NodeMetrics delta per flush tick. The effective
+        interval is max(own knob, head's backpressure minimum) — under inlet
+        pressure the head widens everyone's cadence instead of dropping
+        frames silently."""
+        while not self._shutdown:
+            interval = max(CONFIG.control_node_flush_s, self._bp_min_interval_s)
+            time.sleep(max(0.05, interval))
+            try:
+                self._flush_node_delta(interval)
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
+            except Exception:
+                pass  # head restart in flight: next tick retries
+
+    def _flush_node_delta(self, interval: float) -> None:
+        from ray_tpu.util import metrics as _m
+
+        with self._agg_lock:
+            snaps = list(self._agg_metrics.values())
+            worker_count = len(self._agg_metrics)
+            tel = list(self._agg_telemetry)
+            self._agg_telemetry.clear()
+        if not snaps and not tel:
+            return
+        merged = _m.merge_snapshots(snaps)
+        metrics_json = json.dumps(
+            _m.snapshot_to_wire(list(merged.values()))).encode()
+        # telemetry attrs may hold arbitrary values; default=str keeps the
+        # delta JSON-clean without dropping the event
+        telemetry_json = json.dumps(tel, default=str).encode()
+        self._agg_seq += 1
+        self._send(("node_metrics", self._agg_seq, time.time(), worker_count,
+                    metrics_json, telemetry_json, interval))
 
     def _head_recv_loop(self) -> None:
         while not self._shutdown:
@@ -423,6 +526,16 @@ class NodeAgent:
             from . import object_store
 
             object_store.free_local(msg[1])
+        elif kind == "control_backpressure":
+            _, level, min_interval_s = msg
+            new = float(min_interval_s) if level > 0 else 0.0
+            if new != self._bp_min_interval_s:
+                import logging
+
+                logging.getLogger("ray_tpu.node_agent").info(
+                    "head backpressure level=%d: node flush interval >= %.1fs",
+                    level, new)
+            self._bp_min_interval_s = new
         elif kind == "shutdown":
             self._shutdown = True
 
@@ -543,6 +656,8 @@ class NodeAgent:
 
     def _on_local_worker_death(self, wid_hex: str) -> None:
         self._dead_worker_logs[wid_hex] = time.monotonic()
+        with self._agg_lock:
+            self._agg_metrics.pop(wid_hex, None)
         entry = self._workers.pop(wid_hex, None)
         if entry is not None:
             self._pipe_to_wid.pop(entry[1], None)

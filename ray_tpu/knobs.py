@@ -507,6 +507,58 @@ KNOBS: List[Knob] = [
     Knob("RAY_TPU_DASHBOARD_PORT", "int", 8265,
          "Dashboard HTTP port (JSON API, /metrics exposition, web UI).",
          "observability", attr="dashboard_port"),
+    Knob("RAY_TPU_CONTROL_NODE_AGG", "bool", True,
+         "Node-agent metrics/telemetry pre-aggregation: each agent merges "
+         "its local workers' pushes and ships ONE per-node delta per flush "
+         "tick, making head-side scrape cost O(nodes) instead of "
+         "O(workers). Off = agents relay every worker frame verbatim "
+         "(the pre-PR-17 behavior; also the head's fallback for "
+         "un-upgraded agents).",
+         "observability", attr="control_node_agg"),
+    Knob("RAY_TPU_CONTROL_NODE_FLUSH_S", "float", 2.0,
+         "Node-agent aggregated-delta ship period (matches the worker "
+         "metric report interval so history freshness is unchanged). The "
+         "head's backpressure signal can widen the EFFECTIVE interval up "
+         "to RAY_TPU_CONTROL_BACKPRESSURE_MAX_S.",
+         "observability", attr="control_node_flush_s"),
+    Knob("RAY_TPU_CONTROL_MAX_SERIES", "int", 1024,
+         "Bounded-cardinality guard: max distinct label sets per metric "
+         "(per-process registries AND the head-side merge). New label sets "
+         "past the cap are dropped and counted in "
+         "metrics_dropped_series_total — head memory stays bounded even "
+         "when a tag value explodes (e.g. a request id mistakenly used as "
+         "a label).",
+         "observability", attr="control_max_series"),
+    Knob("RAY_TPU_CONTROL_INLET_BOUND", "int", 256,
+         "Control-RPC inlet backpressure bound: when more metrics/"
+         "telemetry frames than this arrive at the head between two scrape "
+         "ticks, the head raises its backpressure level and tells agents "
+         "to widen their flush interval; below half the bound it steps "
+         "back down. 0 disables backpressure.",
+         "observability", attr="control_inlet_bound"),
+    Knob("RAY_TPU_CONTROL_BACKPRESSURE_MAX_S", "float", 30.0,
+         "Widest flush interval the head's backpressure signal may impose "
+         "on node agents (the signal doubles the interval per level; "
+         "level 0 clears back to the agent's own cadence).",
+         "observability", attr="control_backpressure_max_s"),
+    Knob("RAY_TPU_CONTROL_HISTORY_JOURNAL_FRAMES", "int", 24,
+         "Metrics-history frames journaled through the GCS KV path after "
+         "each scrape so SLO burn windows and the router's windowed-TTFT "
+         "inputs survive a head restart (needs "
+         "RAY_TPU_GCS_PERSISTENCE_PATH to persist across processes). "
+         "0 disables the journal.",
+         "observability", attr="control_history_journal_frames"),
+    Knob("RAY_TPU_CONTROL_HISTORY_MAX_POINTS", "int", 120,
+         "Max points per series in state.history_series()/ /api/history: "
+         "longer windows are downsampled (stride-wise, newest kept) and "
+         "the payload marked truncated, so `ray-tpu status --watch` never "
+         "ships megabytes per refresh.",
+         "observability", attr="control_history_max_points"),
+    Knob("RAY_TPU_CONTROL_HISTORY_MAX_SERIES", "int", 64,
+         "Max series entries in state.history_series()/ /api/history "
+         "payloads before the rest are dropped and the payload marked "
+         "truncated.",
+         "observability", attr="control_history_max_series"),
     # -- autoscaler
     Knob("RAY_TPU_PROVISION_MAX_ATTEMPTS", "int", 4,
          "Inline create_node attempts for rate-limit/transient cloud errors "
@@ -611,6 +663,15 @@ KNOBS: List[Knob] = [
     Knob("RAY_TPU_TELEMETRY_OVERHEAD_PCT", "float", 3.0,
          "core_bench --telemetry-overhead gate: max hot-path overhead "
          "percent with telemetry on.",
+         "bench"),
+    Knob("RAY_TPU_CONTROL_P99_MS", "float", 250.0,
+         "core_bench --control-plane gate: max p99 scrape->SLO->autoscaler "
+         "decision latency (ms) at 1024 synthetic replicas.",
+         "bench"),
+    Knob("RAY_TPU_CONTROL_AGG_SPEEDUP", "float", 4.0,
+         "core_bench --control-plane gate: min head-side cost ratio "
+         "(per-worker scrape / node-delta scrape) at 256 synthetic "
+         "replicas — node aggregation must be at least this much cheaper.",
          "bench"),
     Knob("RAY_TPU_SCRAPE_OVERHEAD_PCT", "float", 1.0,
          "core_bench --scrape-overhead gate: max pull-path interference "

@@ -170,6 +170,25 @@ def _render_status(s: dict) -> str:
                      f"{llm.get('prefix_cache_hits', 0)}/"
                      f"{llm.get('prefix_cache_misses', 0)}/"
                      f"{llm.get('prefix_cache_skipped', 0)}")
+    cp = s.get("control_plane", {})
+    if cp.get("scrape_p99_s") is not None or cp.get("nodes_aggregated"):
+        def cms(v):
+            return f"{v * 1e3:.1f}ms" if v is not None else "-"
+
+        dec = " ".join(f"{k}:{cms(v)}" for k, v in sorted(
+            (cp.get("decision_p99_s") or {}).items()))
+        lines.append(f"control    scrape_p99={cms(cp.get('scrape_p99_s'))} "
+                     f"decision_p99[{dec or '-'}] "
+                     f"agg_nodes={cp.get('nodes_aggregated', 0)} "
+                     f"direct_workers={cp.get('workers_direct', 0)}")
+        dropped = sum((cp.get("dropped_series") or {}).values())
+        if (cp.get("backpressure_level") or cp.get("inlet_shed")
+                or cp.get("backpressure_transitions") or dropped):
+            lines.append(
+                f"control    backpressure level={cp.get('backpressure_level', 0) or 0:.0f} "
+                f"transitions={cp.get('backpressure_transitions', 0)} "
+                f"inlet_frames={cp.get('inlet_frames') or 0:.0f} "
+                f"shed={cp.get('inlet_shed', 0)} dropped_series={dropped}")
     tn = s.get("train", {})
     if tn.get("mfu") or tn.get("step_phases_s"):
         mfu = " ".join(f"{k}:{v:.3f}" for k, v in sorted(tn.get("mfu", {}).items()))

@@ -421,6 +421,7 @@ class ServeAutoscalerLoop:
         from ray_tpu.util import fault_injection, telemetry
 
         fault_injection.fail_point("serve.autoscaler.decide")
+        t0 = time.perf_counter()
         controller = self._controller()
         if controller is None:
             return []
@@ -476,6 +477,12 @@ class ServeAutoscalerLoop:
                 "serve.autoscale.tick", "serve",
                 changed=sum(1 for d in decisions if d.changed),
                 deployments=len(decisions))
+        # control-plane self-telemetry: full decide+commit pass wall time
+        telemetry.get_histogram(
+            "control_decision_seconds",
+            "wall time of one control-loop decision pass, by loop",
+            tag_keys=("loop",),
+        ).observe(time.perf_counter() - t0, tags={"loop": "autoscaler"})
         return decisions
 
     def _apply(self, controller, app: str, deployment: str,

@@ -28,6 +28,45 @@ DEFAULT_HISTOGRAM_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
 ]
 
+DROPPED_SERIES_METRIC = "metrics_dropped_series_total"
+
+
+def _max_series() -> int:
+    """Bounded-cardinality cap: max distinct label sets per metric (read at
+    use so env changes apply live). <= 0 disables the guard."""
+    try:
+        from ray_tpu.config import CONFIG
+
+        return CONFIG.control_max_series
+    # graftlint: allow[swallowed-exception] degrades to the coded fallback (return 1024) by design
+    except Exception:
+        return 1024
+
+
+_dropped_lock = threading.Lock()
+_dropped_series: Dict[str, int] = defaultdict(int)
+
+
+def _record_dropped(metric_name: str, n: int = 1) -> None:
+    with _dropped_lock:
+        _dropped_series[metric_name] += n
+
+
+def dropped_series_snapshot() -> Optional[dict]:
+    """Synthetic counter export for the cardinality guard. Kept out of the
+    Metric registry on purpose: the guard must never be subject to itself,
+    and its own cardinality is bounded by the number of metric NAMES."""
+    with _dropped_lock:
+        if not _dropped_series:
+            return None
+        return {
+            "name": DROPPED_SERIES_METRIC, "type": "counter",
+            "description": "label sets dropped by the bounded-cardinality "
+                           "guard (RAY_TPU_CONTROL_MAX_SERIES), by metric",
+            "values": {(("metric", k),): float(v)
+                       for k, v in _dropped_series.items()},
+        }
+
 
 class _Registry:
     """Per-process metric registry; worker side pushes deltas to the coordinator."""
@@ -47,7 +86,11 @@ class _Registry:
 
     def snapshot(self) -> List[dict]:
         with self._lock:
-            return [m._export() for m in self._metrics.values()]
+            out = [m._export() for m in self._metrics.values()]
+        dropped = dropped_series_snapshot()
+        if dropped is not None:
+            out.append(dropped)
+        return out
 
     def _ensure_push_thread(self) -> None:
         """Workers and remote client drivers push snapshots to the head; the
@@ -113,6 +156,18 @@ class Metric:
             out.update(tags)
         return out
 
+    def _admit(self, key: Tuple, existing: Dict) -> bool:
+        """Cardinality guard, called under self._lock: a key already present
+        always updates; a NEW label set past the cap is dropped (and counted)
+        so an exploding tag value can never grow memory unboundedly."""
+        if key in existing:
+            return True
+        cap = _max_series()
+        if cap <= 0 or len(existing) < cap:
+            return True
+        _record_dropped(self.name)
+        return False
+
     def _export(self) -> dict:
         raise NotImplementedError
 
@@ -129,8 +184,10 @@ class Counter(Metric):
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
         if value < 0:
             raise ValueError("Counter.inc() value must be >= 0")
+        key = _tag_key(self._merged(tags))
         with self._lock:
-            self._values[_tag_key(self._merged(tags))] += value
+            if self._admit(key, self._values):
+                self._values[key] += value
 
     def _export(self) -> dict:
         with self._lock:
@@ -148,8 +205,10 @@ class Gauge(Metric):
         super().__init__(name, description, tag_keys)
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tag_key(self._merged(tags))
         with self._lock:
-            self._values[_tag_key(self._merged(tags))] = float(value)
+            if self._admit(key, self._values):
+                self._values[key] = float(value)
 
     def _export(self) -> dict:
         with self._lock:
@@ -172,6 +231,8 @@ class Histogram(Metric):
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = _tag_key(self._merged(tags))
         with self._lock:
+            if not self._admit(key, self._buckets):
+                return
             buckets = self._buckets.setdefault(key, [0] * (len(self.boundaries) + 1))
             i = 0
             while i < len(self.boundaries) and value > self.boundaries[i]:
@@ -215,11 +276,26 @@ def _rebin(counts: List[int], src_bounds: List[float],
 
 
 def merge_snapshots(snaps: List[List[dict]]) -> Dict[str, dict]:
-    """Merge per-process snapshots (driver registry + worker pushes) by metric
-    name. Histograms carry their own per-metric `boundaries` through the
-    worker->coordinator push; when two processes registered the same histogram
-    with DIFFERENT boundaries, the incoming buckets are re-binned onto the
-    first-seen set instead of being zip-truncated into corruption."""
+    """Merge per-process snapshots (driver registry + worker pushes + node
+    deltas) by metric name. Histograms carry their own per-metric
+    `boundaries` through the worker->coordinator push; when two processes
+    registered the same histogram with DIFFERENT boundaries, the incoming
+    buckets are re-binned onto the first-seen set instead of being
+    zip-truncated into corruption. The merged view applies the same
+    bounded-cardinality guard as live registries (a fleet of pre-guard
+    workers must not explode head memory); merge-time drops are folded into
+    the dropped-series counter so degradation is visible."""
+    cap = _max_series()
+    merge_dropped: Dict[str, int] = defaultdict(int)
+
+    def admit(name: str, key: Tuple, existing: Dict) -> bool:
+        if key in existing or name == DROPPED_SERIES_METRIC:
+            return True
+        if cap <= 0 or len(existing) < cap:
+            return True
+        merge_dropped[name] += 1
+        return False
+
     out: Dict[str, dict] = {}
     for snap in snaps:
         for m in snap:
@@ -227,13 +303,22 @@ def merge_snapshots(snaps: List[List[dict]]) -> Dict[str, dict]:
             if cur is None:
                 import copy
 
-                out[m["name"]] = copy.deepcopy(m)
+                cur = copy.deepcopy(m)
+                if cap > 0 and m["name"] != DROPPED_SERIES_METRIC \
+                        and len(cur["values"]) > cap:
+                    keep = list(cur["values"].items())[:cap]
+                    merge_dropped[m["name"]] += len(cur["values"]) - cap
+                    cur["values"] = dict(keep)
+                out[m["name"]] = cur
                 continue
             if m["type"] == "counter":
                 for k, v in m["values"].items():
-                    cur["values"][k] = cur["values"].get(k, 0.0) + v
+                    if admit(m["name"], k, cur["values"]):
+                        cur["values"][k] = cur["values"].get(k, 0.0) + v
             elif m["type"] == "gauge":
-                cur["values"].update(m["values"])
+                for k, v in m["values"].items():
+                    if admit(m["name"], k, cur["values"]):
+                        cur["values"][k] = v
             elif m["type"] == "histogram":
                 src_bounds = list(m.get("boundaries", DEFAULT_HISTOGRAM_BOUNDARIES))
                 dst_bounds = list(cur.get("boundaries", DEFAULT_HISTOGRAM_BOUNDARIES))
@@ -243,12 +328,70 @@ def merge_snapshots(snaps: List[List[dict]]) -> Dict[str, dict]:
                                else _rebin(v["buckets"], src_bounds, dst_bounds))
                     tgt = cur["values"].get(k)
                     if tgt is None:
-                        cur["values"][k] = {"buckets": buckets,
-                                            "sum": v["sum"], "count": v["count"]}
+                        if admit(m["name"], k, cur["values"]):
+                            cur["values"][k] = {"buckets": buckets,
+                                                "sum": v["sum"], "count": v["count"]}
                     else:
                         tgt["buckets"] = [a + b for a, b in zip(tgt["buckets"], buckets)]
                         tgt["sum"] += v["sum"]
                         tgt["count"] += v["count"]
+    if merge_dropped:
+        cur = out.get(DROPPED_SERIES_METRIC)
+        if cur is None:
+            cur = {"name": DROPPED_SERIES_METRIC, "type": "counter",
+                   "description": "label sets dropped by the bounded-"
+                                  "cardinality guard "
+                                  "(RAY_TPU_CONTROL_MAX_SERIES), by metric",
+                   "values": {}}
+            out[DROPPED_SERIES_METRIC] = cur
+        for name, n in merge_dropped.items():
+            k = (("metric", name),)
+            cur["values"][k] = cur["values"].get(k, 0.0) + float(n)
+    return out
+
+
+# --------------------------------------------------------------- wire codecs
+
+def snapshot_to_wire(snap: List[dict]) -> List[dict]:
+    """JSON-safe form of a snapshot: the tag-tuple dict keys (tuples of
+    (k, v) pairs) become explicit `series` lists. Node agents ship their
+    merged per-node delta to the head as JSON bytes in this form — the head
+    never unpickles agent control traffic (core/agent_rpc.py trust
+    posture)."""
+    out = []
+    for m in snap:
+        w = {"name": m["name"], "type": m["type"],
+             "description": m.get("description", "")}
+        if "boundaries" in m:
+            w["boundaries"] = list(m["boundaries"])
+        w["series"] = [
+            {"tags": [[k, v] for k, v in key], "value": val}
+            for key, val in m["values"].items()
+        ]
+        out.append(w)
+    return out
+
+
+def snapshot_from_wire(wire: List[dict]) -> List[dict]:
+    """Inverse of snapshot_to_wire: rebuild the tag-tuple-keyed snapshot
+    shape that merge_snapshots consumes. Tolerant of malformed entries
+    (skips them) — the input crossed a process boundary."""
+    out = []
+    for m in wire:
+        try:
+            d = {"name": m["name"], "type": m["type"],
+                 "description": m.get("description", "")}
+            if "boundaries" in m:
+                d["boundaries"] = list(m["boundaries"])
+            values = {}
+            for s in m.get("series", []):
+                key = tuple((str(k), str(v)) for k, v in s["tags"])
+                values[key] = s["value"]
+            d["values"] = values
+            out.append(d)
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (continue) by design
+        except Exception:
+            continue
     return out
 
 

@@ -138,6 +138,26 @@ class MetricsHistory:
         with self._lock:
             self._frames.clear()
 
+    def restore(self, frames: List[Dict[str, Any]]) -> int:
+        """Seed the ring from journaled frames (head-restart durability):
+        only well-shaped {ts, metrics} frames OLDER than anything already
+        recorded are prepended, so a restore can never reorder or clobber
+        live scrapes. Returns how many frames were accepted."""
+        good = [f for f in frames
+                if isinstance(f, dict) and isinstance(f.get("ts"), float)
+                and isinstance(f.get("metrics"), dict)]
+        good.sort(key=lambda f: f["ts"])
+        with self._lock:
+            if self._frames:
+                oldest = self._frames[0]["ts"]
+                good = [f for f in good if f["ts"] < oldest]
+            if not good:
+                return 0
+            merged = good + list(self._frames)
+            want = self._frames.maxlen or self._want_maxlen(self._fixed_maxlen)
+            self._frames = deque(merged, maxlen=want)
+            return len(good)
+
     # --------------------------------------------------------------- reading
 
     def frames(self) -> List[Dict[str, Any]]:
@@ -291,9 +311,20 @@ def scraper_loop(history: MetricsHistory, snapshot_fn, is_shutdown,
         if interval > 0 and now - last >= interval:
             last = now
             try:
+                t0 = time.perf_counter()
                 history.record(snapshot_fn(), ts=now)
                 if on_frame is not None:
                     on_frame()
+                # control-plane self-telemetry: the scraper measures ITS OWN
+                # wall cost (merge + record + SLO/autoscaler on_frame) — the
+                # head-side number the control-plane bench gates on
+                from ray_tpu.util import telemetry as _tel
+
+                _tel.get_histogram(
+                    "control_scrape_seconds",
+                    "head scrape tick wall time: merged snapshot + history "
+                    "record + on_frame (SLO evaluate) chain",
+                ).observe(time.perf_counter() - t0)
             except Exception as e:  # noqa: BLE001
                 # observability must never take the head down — but a
                 # persistently failing scrape silently freezes the history

@@ -194,6 +194,7 @@ class SLOEngine:
         """Evaluate every registered SLO against the current history; fire
         subscriber callbacks for state transitions. Called by the scraper
         after each frame; safe to call ad hoc (tests, state API)."""
+        t0 = time.perf_counter()
         try:
             from ray_tpu.config import CONFIG
 
@@ -232,6 +233,15 @@ class SLOEngine:
             guarded_fanout(subs, t, throttle=self._sub_warn, logger=logger,
                            what=f"slo subscriber ({t['name']})",
                            exc_info=True)
+        # control-plane self-telemetry: how long one full SLO pass costs the
+        # head (scales with registered SLOs x history window math)
+        from ray_tpu.util import telemetry as _tel
+
+        _tel.get_histogram(
+            "control_decision_seconds",
+            "wall time of one control-loop decision pass, by loop",
+            tag_keys=("loop",),
+        ).observe(time.perf_counter() - t0, tags={"loop": "slo"})
         return status
 
     def status(self) -> Dict[str, Dict[str, Any]]:

@@ -463,6 +463,34 @@ def cluster_status() -> Dict[str, Any]:
                                 if kv_handoff else None),
     }
 
+    # -- control plane: the observability pipeline observing itself (PR 17).
+    # Scrape/decision latency percentiles, inlet pressure, node-aggregation
+    # coverage, cardinality-guard drops — the numbers that say whether the
+    # head itself is the bottleneck at fleet scale.
+    scrape = merged.get("control_scrape_seconds")
+    decision = merged.get("control_decision_seconds")
+    cp: Dict[str, Any] = {
+        "scrape_p50_s": m.histogram_quantile(scrape, 0.5) if scrape else None,
+        "scrape_p99_s": m.histogram_quantile(scrape, 0.99) if scrape else None,
+        "decision_p99_s": {
+            loop: m.histogram_quantile(decision, 0.99, where={"loop": loop})
+            for loop in sorted({dict(key).get("loop", "?")
+                                for key in (decision or {}).get("values", {})})
+        } if decision else {},
+        "inlet_frames": gauges("control_inlet_frames").get("_"),
+        "backpressure_level": gauges("control_backpressure_level").get("_"),
+        "backpressure_transitions": int(counter_total(
+            "control_backpressure_transitions_total")),
+        "inlet_shed": int(counter_total("control_inlet_shed_total")),
+        "dropped_series": {k: int(v) for k, v in counter_by_tag(
+            m.DROPPED_SERIES_METRIC, "metric").items()},
+    }
+    c = global_state.try_cluster()
+    if c is not None:
+        cp["nodes_aggregated"] = len(getattr(c, "metrics_by_node", {}) or {})
+        cp["workers_direct"] = len(getattr(c, "metrics_by_worker", {}) or {})
+    status["control_plane"] = cp
+
     # -- train
     status["train"] = {
         "mfu": gauges("train_mfu"),
@@ -538,12 +566,18 @@ def history_series(window_s: float = 300.0) -> Dict[str, Any]:
     (`/api/history`, `ray-tpu status --watch`): one timestamp list plus one
     value list per signal (None where a frame has no data). Derived signals
     (rates, windowed quantiles) are computed FRAME-over-frame so the series
-    shows load shifts, not lifetime averages."""
+    shows load shifts, not lifetime averages. Payloads are BOUNDED: more
+    in-window frames than RAY_TPU_CONTROL_HISTORY_MAX_POINTS are stride-
+    downsampled (newest kept) and more series than
+    RAY_TPU_CONTROL_HISTORY_MAX_SERIES are dropped, with `truncated` set —
+    a --watch refresh against a 1k-replica fleet must never ship megabytes."""
+    from ray_tpu.config import CONFIG
     from ray_tpu.util import metrics as m
 
     c = _cluster()
     h = c.metrics_history
     all_frames = h.frames()
+    truncated = False
     # frame-over-frame values need each frame's PREDECESSOR, so include ONE
     # frame before the window as a differencing seed (its own output is
     # discarded) — without it the first in-window point would difference
@@ -556,6 +590,13 @@ def history_series(window_s: float = 300.0) -> Dict[str, Any]:
                 if f["ts"] >= newest - window_s]
     else:
         keep = []
+    max_points = CONFIG.control_history_max_points
+    if max_points > 0 and len(keep) > max_points:
+        # stride-downsample anchored at the NEWEST frame: the most recent
+        # point is always retained, older points thin out evenly
+        stride = -(-len(keep) // max_points)  # ceil
+        keep = keep[::-1][::stride][::-1]
+        truncated = True
     start = max(0, keep[0] - 1) if keep else 0
     frames = all_frames[start:]
     keep = [i - start for i in keep]
@@ -616,18 +657,20 @@ def history_series(window_s: float = 300.0) -> Dict[str, Any]:
             prev = mm
         return out
 
-    return {
-        "ts": ts,
-        "series": {
-            "serve_ttft_p99_s": sliced(frame_quantile("serve_ttft_seconds", 0.99)),
-            "serve_requests_per_s": sliced(per_s("serve_request_seconds")),
-            "llm_ttft_p99_s": sliced(frame_quantile("llm_ttft_seconds", 0.99)),
-            "transfer_bytes_per_s": sliced(per_s("transfer_bytes_total")),
-            "collective_ops_per_s": sliced(per_s("collective_ops_total")),
-            "serve_queue_depth": sliced([gauge_sum(f, "serve_queue_depth")
-                                         for f in frames]),
-        },
+    series = {
+        "serve_ttft_p99_s": sliced(frame_quantile("serve_ttft_seconds", 0.99)),
+        "serve_requests_per_s": sliced(per_s("serve_request_seconds")),
+        "llm_ttft_p99_s": sliced(frame_quantile("llm_ttft_seconds", 0.99)),
+        "transfer_bytes_per_s": sliced(per_s("transfer_bytes_total")),
+        "collective_ops_per_s": sliced(per_s("collective_ops_total")),
+        "serve_queue_depth": sliced([gauge_sum(f, "serve_queue_depth")
+                                     for f in frames]),
     }
+    max_series = CONFIG.control_history_max_series
+    if max_series > 0 and len(series) > max_series:
+        series = dict(list(series.items())[:max_series])
+        truncated = True
+    return {"ts": ts, "series": series, "truncated": truncated}
 
 
 @_remoteable

@@ -1,0 +1,450 @@
+"""Fleet-scale control-plane observability (ISSUE 17): node-agent
+pre-aggregation wire codecs, bounded-cardinality guards, head inlet
+backpressure, metrics-history journaling, bounded /api/history payloads, and
+the CONTROL_BENCH harness smoke check.
+
+Most tests here are head-side unit tests on synthetic fleets — the live
+agent relay path is already exercised by test_multihost.py (node aggregation
+is on by default), and the slow-marked e2e test below drives a real agent
+subprocess through the delta path end to end.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+from ray_tpu.util import metrics as M
+from ray_tpu.util import telemetry
+from ray_tpu.util.metrics_history import MetricsHistory
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _key(**tags):
+    return tuple(sorted(tags.items()))
+
+
+def _worker_snapshot(wid, boundaries, ndep=4):
+    """One synthetic worker registry snapshot: shared deployment-tagged
+    series plus a per-process gauge (the shape agents pre-aggregate)."""
+    deps = [f"app/d{j}" for j in range(ndep)]
+    return [
+        {"name": "serve_requests_total", "type": "counter", "description": "",
+         "values": {_key(deployment=d): float(10 + j) for j, d in enumerate(deps)}},
+        {"name": "serve_queue_depth", "type": "gauge", "description": "",
+         "values": {_key(deployment=d): float(j) for j, d in enumerate(deps)}},
+        {"name": "serve_ttft_seconds", "type": "histogram", "description": "",
+         "boundaries": list(boundaries),
+         "values": {_key(deployment=d): {
+             "buckets": [1] * (len(boundaries) + 1),
+             "sum": 0.2 * (len(boundaries) + 1),
+             "count": len(boundaries) + 1} for d in deps}},
+        {"name": "worker_rss_bytes", "type": "gauge", "description": "",
+         "values": {_key(proc=f"w{wid:04d}"): 1e8 + wid}},
+    ]
+
+
+# ----------------------------------------------------------------- wire codec
+
+def test_snapshot_wire_roundtrip():
+    snap = _worker_snapshot(7, [0.1, 0.5, 1.0])
+    back = M.snapshot_from_wire(json.loads(json.dumps(M.snapshot_to_wire(snap))))
+    assert [m["name"] for m in back] == [m["name"] for m in snap]
+    for a, b in zip(snap, back):
+        assert a["values"] == b["values"]
+        if "boundaries" in a:
+            assert b["boundaries"] == a["boundaries"]
+
+
+def test_snapshot_from_wire_skips_malformed():
+    wire = M.snapshot_to_wire(_worker_snapshot(0, [0.5]))
+    wire.insert(1, {"garbage": True})          # no name/type/series
+    wire.insert(0, {"name": "x", "type": "counter", "series": "not-a-list"})
+    back = M.snapshot_from_wire(wire)
+    assert [m["name"] for m in back][-4:] == [
+        "serve_requests_total", "serve_queue_depth", "serve_ttft_seconds",
+        "worker_rss_bytes"]
+
+
+def test_agent_rpc_node_metrics_roundtrip():
+    from ray_tpu.core import agent_rpc
+
+    metrics_json = json.dumps(
+        M.snapshot_to_wire(_worker_snapshot(3, [0.5]))).encode()
+    msg = ("node_metrics", 17, 123.25, 8, metrics_json, b"[]", 2.5)
+    out = agent_rpc.decode_agent_msg(agent_rpc.encode_agent_msg(msg))
+    assert out == msg
+
+
+def test_agent_rpc_control_backpressure_roundtrip():
+    from ray_tpu.core import agent_rpc
+
+    msg = ("control_backpressure", 3, 8.0)
+    assert agent_rpc.decode_head_msg(agent_rpc.encode_head_msg(msg)) == msg
+
+
+# ----------------------------------------------- merge/align at fleet scale
+
+def test_merge_64_workers_mismatched_boundaries_bounded_time():
+    """64 workers, half of them on a DIFFERENT histogram boundary set (a
+    mid-rollout fleet): the merge re-bins instead of corrupting, counter
+    totals stay exact, and the whole merge is comfortably sub-second."""
+    snaps = [_worker_snapshot(w, [0.1, 0.5, 1.0] if w % 2 else [0.25, 1.0])
+             for w in range(64)]
+    t0 = time.perf_counter()
+    merged = M.merge_snapshots(snaps)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"64-worker merge took {dt:.2f}s"
+    # counters: 64 workers x 4 deployments x (10 + j)
+    total = sum(merged["serve_requests_total"]["values"].values())
+    assert total == 64 * sum(10 + j for j in range(4))
+    # histograms: re-binning preserves observation counts exactly
+    hist = merged["serve_ttft_seconds"]["values"]
+    for v in hist.values():
+        assert sum(v["buckets"]) == v["count"]
+    dst_len = len(merged["serve_ttft_seconds"]["boundaries"]) + 1
+    assert all(len(v["buckets"]) == dst_len for v in hist.values())
+    # per-process series all survive (distinct keys)
+    assert len(merged["worker_rss_bytes"]["values"]) == 64
+
+
+def test_align_batch_64_workers_drifted_clocks():
+    """64 workers each with a different measured clock offset: after
+    align_batch every event sits on the head's single timeline and carries
+    its producer tag."""
+    base_ns = 1_000_000_000_000
+    aligned = []
+    for w in range(64):
+        off = (w - 32) * 1_000_000  # -32ms .. +31ms drift
+        batch = {"clock_offset_ns": -off,
+                 "events": [{"name": "e", "ts_ns": base_ns + w + off}]}
+        aligned.extend(telemetry.align_batch(batch, proc=f"worker-{w:04d}"))
+    assert len(aligned) == 64
+    assert [ev["ts_ns"] for ev in aligned] == [base_ns + w for w in range(64)]
+    assert {ev["proc"] for ev in aligned} == {f"worker-{w:04d}" for w in range(64)}
+
+
+# ----------------------------------------------------------- cardinality guard
+
+def test_cardinality_guard_live_metrics(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CONTROL_MAX_SERIES", "5")
+    c = M.Counter("test_guard_counter_a", "x", tag_keys=("k",))
+    for i in range(12):
+        c.inc(1.0, tags={"k": f"v{i}"})
+    assert len(c._values) == 5
+    # existing keys keep updating after the cap is hit
+    c.inc(2.0, tags={"k": "v0"})
+    assert c._values[_key(k="v0")] == 3.0
+    g = M.Gauge("test_guard_gauge_a", "x", tag_keys=("k",))
+    for i in range(9):
+        g.set(float(i), tags={"k": f"v{i}"})
+    assert len(g._values) == 5
+    h = M.Histogram("test_guard_hist_a", "x", boundaries=[1.0], tag_keys=("k",))
+    for i in range(9):
+        h.observe(0.5, tags={"k": f"v{i}"})
+    assert len(h._buckets) == 5
+    # drops are visible: the synthetic counter reports per-metric drop counts
+    dropped = M.dropped_series_snapshot()
+    assert dropped is not None and dropped["name"] == M.DROPPED_SERIES_METRIC
+    by_metric = {dict(k)["metric"]: v for k, v in dropped["values"].items()}
+    assert by_metric["test_guard_counter_a"] >= 7
+    assert by_metric["test_guard_gauge_a"] >= 4
+    assert by_metric["test_guard_hist_a"] >= 4
+
+
+def test_cardinality_guard_merge(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CONTROL_MAX_SERIES", "5")
+    snaps = [[{"name": "exploding", "type": "counter", "description": "",
+               "values": {_key(k=f"w{w}_v{i}"): 1.0 for i in range(4)}}]
+             for w in range(8)]
+    merged = M.merge_snapshots(snaps)
+    assert len(merged["exploding"]["values"]) == 5
+    drops = merged[M.DROPPED_SERIES_METRIC]["values"]
+    assert drops[(("metric", "exploding"),)] == 8 * 4 - 5
+
+
+def test_cardinality_guard_off_when_unset(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CONTROL_MAX_SERIES", "0")
+    snaps = [[{"name": "wide_ok", "type": "counter", "description": "",
+               "values": {_key(k=f"v{w}_{i}"): 1.0 for i in range(10)}}]
+             for w in range(8)]
+    merged = M.merge_snapshots(snaps)
+    assert len(merged["wide_ok"]["values"]) == 80
+    assert M.DROPPED_SERIES_METRIC not in merged
+
+
+# ------------------------------------------------------------- backpressure
+
+def _fake_head(bound_agents=2):
+    """Minimal stand-in carrying exactly the state Cluster's inlet/
+    backpressure methods touch, so the unbound methods run against it."""
+    sent = []
+
+    class _Agent:
+        def send(self, msg):
+            sent.append(msg)
+
+    return types.SimpleNamespace(
+        _inlet_lock=threading.Lock(), _inlet_frames=0, _bp_level=0,
+        _lock=threading.RLock(),
+        _agent_conns={i: _Agent() for i in range(bound_agents)},
+        _sent=sent)
+
+
+def test_backpressure_escalates_and_clears(monkeypatch):
+    from ray_tpu.core.node import Cluster
+
+    monkeypatch.setenv("RAY_TPU_CONTROL_INLET_BOUND", "10")
+    monkeypatch.setenv("RAY_TPU_CONTROL_NODE_FLUSH_S", "1.0")
+    monkeypatch.setenv("RAY_TPU_CONTROL_BACKPRESSURE_MAX_S", "8.0")
+    head = _fake_head()
+    # window 1: 25 frames > bound -> level 1, agents told min interval 2.0
+    for _ in range(25):
+        Cluster._note_inlet_frame(head)
+    Cluster._evaluate_inlet_backpressure(head)
+    assert head._bp_level == 1
+    assert head._sent[-2:] == [("control_backpressure", 1, 2.0)] * 2
+    # window 2: still hot -> level 2 (doubling), interval 4.0
+    head._inlet_frames = 25
+    Cluster._evaluate_inlet_backpressure(head)
+    assert head._bp_level == 2
+    assert head._sent[-1] == ("control_backpressure", 2, 4.0)
+    # widened intervals cap at control_backpressure_max_s
+    for _ in range(4):
+        head._inlet_frames = 25
+        Cluster._evaluate_inlet_backpressure(head)
+    assert head._sent[-1][2] == 8.0
+    # quiet windows (< bound // 2) step the level back down one at a time
+    head._inlet_frames = 2
+    Cluster._evaluate_inlet_backpressure(head)
+    assert head._bp_level == 5
+    while head._bp_level > 0:
+        head._inlet_frames = 0
+        Cluster._evaluate_inlet_backpressure(head)
+    assert head._sent[-1] == ("control_backpressure", 0, 0.0)
+
+
+def test_backpressure_disabled_when_bound_zero(monkeypatch):
+    from ray_tpu.core.node import Cluster
+
+    monkeypatch.setenv("RAY_TPU_CONTROL_INLET_BOUND", "0")
+    head = _fake_head()
+    head._inlet_frames = 10_000
+    Cluster._evaluate_inlet_backpressure(head)
+    assert head._bp_level == 0 and head._sent == []
+    assert Cluster._inlet_shed_ceiling(head) == 0
+    monkeypatch.setenv("RAY_TPU_CONTROL_INLET_BOUND", "100")
+    assert Cluster._inlet_shed_ceiling(head) == 400
+
+
+def test_agent_widens_flush_interval_on_backpressure():
+    """The agent side of the typed signal: a control_backpressure message
+    raises the flush loop's effective minimum interval."""
+    from ray_tpu.core.node_agent import NodeAgent
+
+    agent = types.SimpleNamespace(_bp_min_interval_s=0.0)
+    NodeAgent._handle_head_message(agent, ("control_backpressure", 2, 4.0))
+    assert agent._bp_min_interval_s == 4.0
+    NodeAgent._handle_head_message(agent, ("control_backpressure", 0, 0.0))
+    assert agent._bp_min_interval_s == 0.0
+
+
+# ------------------------------------------------------- history durability
+
+def _frame(ts, reqs=1.0):
+    return {"ts": float(ts), "metrics": {
+        "serve_requests_total": {"name": "serve_requests_total",
+                                 "type": "counter", "description": "",
+                                 "values": {(): reqs}}}}
+
+
+def test_history_restore_prepends_only_older_frames():
+    h = MetricsHistory(maxlen=16)
+    h.record(_frame(100.0)["metrics"], ts=100.0)
+    h.record(_frame(101.0)["metrics"], ts=101.0)
+    # journaled frames: two older (accepted), one newer (must be dropped —
+    # a restore can never reorder or clobber live scrapes), one malformed
+    n = h.restore([_frame(99.0), _frame(98.0), _frame(100.5),
+                   {"ts": "bad"}, "junk"])
+    assert n == 2
+    assert [f["ts"] for f in h.frames()] == [98.0, 99.0, 100.0, 101.0]
+    assert h.restore([_frame(99.5)]) == 0  # nothing older than the oldest
+
+
+def test_history_journal_roundtrip_through_kv(monkeypatch):
+    """_journal_history -> KV -> _restore_history_journal on a fresh history:
+    the restart warm-start path, against an in-memory KV."""
+    from ray_tpu.core.node import Cluster
+
+    monkeypatch.setenv("RAY_TPU_CONTROL_HISTORY_JOURNAL_FRAMES", "3")
+    store = {}
+    kv = types.SimpleNamespace(
+        put=lambda k, v, namespace=None: store.__setitem__((namespace, k), v),
+        get=lambda k, namespace=None: store.get((namespace, k)))
+    def head(history):
+        # _HISTORY_JOURNAL_* are Cluster class attributes; the fake needs
+        # them as instance attributes
+        return types.SimpleNamespace(
+            metrics_history=history, gcs=types.SimpleNamespace(kv=kv),
+            _HISTORY_JOURNAL_KEY=Cluster._HISTORY_JOURNAL_KEY,
+            _HISTORY_JOURNAL_NS=Cluster._HISTORY_JOURNAL_NS)
+
+    old = head(MetricsHistory(maxlen=16))
+    for ts in (10.0, 11.0, 12.0, 13.0, 14.0):
+        old.metrics_history.record(_frame(ts)["metrics"], ts=ts)
+    Cluster._journal_history(old)
+    assert store  # journal landed in the KV
+
+    new = head(MetricsHistory(maxlen=16))
+    Cluster._restore_history_journal(new)
+    # only the last N=3 frames were journaled; all restore into cold history
+    assert [f["ts"] for f in new.metrics_history.frames()] == [12.0, 13.0, 14.0]
+
+
+def test_history_journal_disabled(monkeypatch):
+    from ray_tpu.core.node import Cluster
+
+    monkeypatch.setenv("RAY_TPU_CONTROL_HISTORY_JOURNAL_FRAMES", "0")
+    boom = types.SimpleNamespace()  # any attribute access would raise
+    Cluster._journal_history(boom)
+    Cluster._restore_history_journal(boom)
+
+
+# ------------------------------------------------------ bounded /api/history
+
+def _series_fixture(n_frames):
+    h = MetricsHistory(maxlen=max(n_frames + 4, 8))
+    for i in range(n_frames):
+        h.record({"serve_requests_total": {
+            "name": "serve_requests_total", "type": "counter",
+            "description": "", "values": {(): float(i)}}}, ts=1000.0 + i)
+    return types.SimpleNamespace(metrics_history=h)
+
+
+def test_history_series_downsamples_and_flags(monkeypatch):
+    from ray_tpu.util import state
+
+    monkeypatch.setattr(state, "_cluster", lambda: _series_fixture(50))
+    monkeypatch.setenv("RAY_TPU_CONTROL_HISTORY_MAX_POINTS", "10")
+    out = state.history_series(window_s=1e6)
+    assert out["truncated"] is True
+    assert len(out["ts"]) <= 10
+    assert out["ts"][-1] == 1049.0  # the newest frame is always retained
+    assert all(len(v) == len(out["ts"]) for v in out["series"].values())
+
+
+def test_history_series_unbounded_below_cap(monkeypatch):
+    from ray_tpu.util import state
+
+    monkeypatch.setattr(state, "_cluster", lambda: _series_fixture(20))
+    monkeypatch.setenv("RAY_TPU_CONTROL_HISTORY_MAX_POINTS", "500")
+    out = state.history_series(window_s=1e6)
+    assert out["truncated"] is False and len(out["ts"]) == 20
+
+
+def test_history_series_caps_series_count(monkeypatch):
+    from ray_tpu.util import state
+
+    monkeypatch.setattr(state, "_cluster", lambda: _series_fixture(5))
+    monkeypatch.setenv("RAY_TPU_CONTROL_HISTORY_MAX_SERIES", "2")
+    out = state.history_series(window_s=1e6)
+    assert out["truncated"] is True and len(out["series"]) == 2
+
+
+# ------------------------------------------------------------ bench harness
+
+def test_control_bench_dry_run(tmp_path):
+    """CONTROL_BENCH smoke check inside the tier-1 budget: the mode is wired,
+    the gate file lands, and the gate thresholds come from the env knobs."""
+    out = tmp_path / "CONTROL_BENCH.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "core_bench.py"),
+         "--control-plane", "--dry-run", "--out", str(out)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "RAY_TPU_CONTROL_P99_MS": "123.0"})
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["dry_run"] is True
+    assert doc["gates"]["p99_threshold_ms"] == 123.0
+    assert doc["gates"]["agg_speedup_threshold"] == 4.0
+
+
+def test_control_bench_checked_in_gates_pass():
+    """The committed CONTROL_BENCH.json evidence must show passing gates."""
+    path = os.path.join(REPO, "CONTROL_BENCH.json")
+    doc = json.loads(open(path).read())
+    assert doc["passed"] is True
+    assert doc["gates"]["p99_passed"] and doc["gates"]["agg_passed"]
+    assert set(doc["fleets"]) == {"64", "256", "1024"}
+
+
+# ----------------------------------------------------------------- slow e2e
+
+@pytest.mark.slow
+def test_e2e_node_delta_aggregation():
+    """Full path with a real agent subprocess: workers on the remote node
+    push metrics, the agent coalesces them into ONE node delta, and the head
+    lands them in metrics_by_node (per-worker entries replaced)."""
+    import ray_tpu
+    from ray_tpu.core import global_state
+
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_CONTROL_NODE_FLUSH_S"] = "0.5"
+    os.environ["RAY_TPU_METRICS_REPORT_INTERVAL_S"] = "0.25"
+    try:
+        ray_tpu.init(num_cpus=2, node_server_port=0,
+                     worker_env={"JAX_PLATFORMS": "cpu"})
+        cluster = global_state.try_cluster()
+        agent = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_agent",
+             "--address", f"127.0.0.1:{cluster.node_server_port}",
+             "--num-cpus", "2"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            deadline = time.time() + 30
+            while len([x for x in ray_tpu.nodes() if x["Alive"]]) < 2:
+                assert time.time() < deadline, "agent never registered"
+                time.sleep(0.2)
+            from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+
+            remote_id = next(n["NodeID"] for n in ray_tpu.nodes()
+                             if n["Alive"] and n["Labels"].get("agent") == "remote")
+
+            @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=remote_id))
+            def bump():
+                from ray_tpu.util import metrics as m
+                m.Counter("e2e_agg_total", "x").inc(5)
+                return True
+
+            assert all(ray_tpu.get([bump.remote() for _ in range(2)],
+                                   timeout=60))
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                merged = M.merge_snapshots(
+                    list(cluster.metrics_by_node.values())
+                    + list(cluster.metrics_by_worker.values()))
+                if cluster.metrics_by_node and \
+                        sum(merged.get("e2e_agg_total", {}).get(
+                            "values", {}).values()) >= 10:
+                    break
+                time.sleep(0.25)
+            assert cluster.metrics_by_node, "node delta never reached the head"
+            assert sum(merged["e2e_agg_total"]["values"].values()) == 10
+        finally:
+            if agent.poll() is None:
+                agent.terminate()
+                try:
+                    agent.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    agent.kill()
+    finally:
+        os.environ.pop("RAY_TPU_CONTROL_NODE_FLUSH_S", None)
+        os.environ.pop("RAY_TPU_METRICS_REPORT_INTERVAL_S", None)
+        ray_tpu.shutdown()
