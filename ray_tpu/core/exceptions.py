@@ -111,6 +111,48 @@ class ReplicaUnavailableError(RayTpuError):
                  self.reason, self.cause))
 
 
+class HeadUnavailableError(RayTpuError):
+    """The head (GCS/control plane) is unreachable and bounded reconnection
+    gave up — raised from head-requiring operations (ray_tpu.get/wait
+    resolution, new actor creation, named-actor lookup) instead of raw socket
+    errors or indefinite hangs. Degraded-mode paths (routers pinning their
+    last long-poll view, worker-to-worker data pulls) do NOT raise this; only
+    operations that genuinely need the head do.
+
+    Carries the outage age so callers (the serve retry plane, the chaos
+    bench) can decide whether to keep waiting for a head restart or surface
+    the failure. Typed fields survive the cross-process pickle round trip
+    (the CollectiveAbortError convention)."""
+
+    def __init__(self, outage_started_at: float = 0.0, attempts: int = 0,
+                 reason: str = "", cause=None):
+        self.outage_started_at = outage_started_at  # time.time() at first loss
+        self.attempts = attempts  # reconnect attempts made before giving up
+        self.reason = reason
+        self.cause = cause
+        import time as _time
+
+        age = max(0.0, _time.time() - outage_started_at) if outage_started_at else 0.0
+        msg = (f"head unavailable for {age:.1f}s "
+               f"after {attempts} reconnect attempt(s)")
+        if reason:
+            msg += f": {reason}"
+        super().__init__(msg)
+
+    @property
+    def outage_age_s(self) -> float:
+        import time as _time
+
+        if not self.outage_started_at:
+            return 0.0
+        return max(0.0, _time.time() - self.outage_started_at)
+
+    def __reduce__(self):
+        return (HeadUnavailableError,
+                (self.outage_started_at, self.attempts, self.reason,
+                 self.cause))
+
+
 class BackPressureError(RayTpuError):
     """Load shed: the deployment's queue limit (max_ongoing_requests x replicas
     + max_queued_requests) is exceeded, so the request is rejected FAST instead

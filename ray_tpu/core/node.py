@@ -432,6 +432,15 @@ class Cluster:
         if self.arena_name:
             self.worker_env.setdefault(object_store._ARENA_ENV, self.arena_name)
         self.fn_table: Dict[bytes, bytes] = {}
+        # restart-as-a-non-event: reload the function/class table journaled by
+        # _register_fn. Workers and clients dedup their register_fn sends per
+        # head LIFETIME, so nothing re-ships the bytes to a restarted head —
+        # without this reload, every post-restart actor (re)start dies with
+        # "unknown function".
+        for _fn_key in self.gcs.kv.keys(namespace="@fns"):
+            _fn_val = self.gcs.kv.get(_fn_key, namespace="@fns")
+            if _fn_val is not None:
+                self.fn_table[bytes(_fn_key)] = _fn_val
         self.metrics_by_worker: Dict[Any, list] = {}
         # per-NODE pre-aggregated deltas (PR 17): upgraded agents merge their
         # workers' pushes locally and ship one snapshot per flush tick —
@@ -469,6 +478,15 @@ class Cluster:
         # multi-host plane (reference: GcsNodeManager + ObjectManager):
         self._agent_conns: Dict[Any, AgentHandle] = {}   # agent TCP conn -> handle
         self._agents_by_key: Dict[str, AgentHandle] = {}  # node_id hex -> handle
+        # head-boot stamp: the agent reaper grants RAY_TPU_HEAD_RESTART_GRACE_S
+        # after (re)start so nodes that were healthy through a head outage are
+        # never reaped before they finish reattaching (ISSUE: restart is a
+        # non-event, not a mass node-death event)
+        self._boot_at = time.time()
+        # (node_hex, oid) pairs whose reattach pin (store.incref) was already
+        # taken: journal/reregister replay applied twice must be a no-op, not
+        # a second pin that leaks the object forever
+        self._reattach_pins: set = set()
         self._node_listener = None
         self.node_server_port: Optional[int] = None
         self._data_server = None   # head-side data plane (started with the
@@ -808,11 +826,25 @@ class Cluster:
         gcs_redis_failure_detector.h)."""
         _, node_hex, resources, labels, max_workers, extras = msg
         node_id = NodeID.from_hex(node_hex)
+        # READ phase — journaled actor records for this host, by worker id.
+        # The KV reads (gcs's own leaf lock, possibly file-journal I/O) stay
+        # OUTSIDE self._lock; only the commit below holds it. Read BEFORE the
+        # duplicate-handle death path below: that cleanup unjournals actors it
+        # declares dead, and a doubly-delivered reregister (welcome-back race)
+        # must still rebind from the records the FIRST delivery saw.
+        by_wid: Dict[str, Dict[str, Any]] = {}
+        for key in self.gcs.kv.keys(namespace="@actors"):
+            try:
+                rec = cloudpickle.loads(self.gcs.kv.get(key, namespace="@actors"))
+            # graftlint: allow[swallowed-exception] corrupt/unreadable journal records are skipped; reattach rebinds the rest
+            except Exception:
+                continue
+            if rec.get("host") == node_hex:
+                by_wid[rec["wid"]] = rec
         # a handle for the same node may linger (reconnect raced the death
         # detection): run the full death path first so inflight tasks fail /
-        # retry instead of hanging forever — then rebuild below. (Journal
-        # records deleted by that cleanup won't rebind; a blip on a LIVE head
-        # keeps the pre-existing conn-EOF-is-node-death semantics.)
+        # retry instead of hanging forever — then rebuild below. A blip on a
+        # LIVE head keeps the pre-existing conn-EOF-is-node-death semantics.
         with self._lock:
             old = self._agents_by_key.get(node_hex)
         if old is not None:
@@ -825,18 +857,6 @@ class Cluster:
             agent.data_addr = (stream.peer_ip, int(data_port))
         stream.on_message = lambda m: self._handle_agent_message(agent, m)
         stream.on_disconnect = lambda: self._on_agent_death(agent)
-        # READ phase — journaled actor records for this host, by worker id.
-        # The KV reads (gcs's own leaf lock, possibly file-journal I/O) stay
-        # OUTSIDE self._lock; only the commit below holds it.
-        by_wid: Dict[str, Dict[str, Any]] = {}
-        for key in self.gcs.kv.keys(namespace="@actors"):
-            try:
-                rec = cloudpickle.loads(self.gcs.kv.get(key, namespace="@actors"))
-            # graftlint: allow[swallowed-exception] corrupt/unreadable journal records are skipped; reattach rebinds the rest
-            except Exception:
-                continue
-            if rec.get("host") == node_hex:
-                by_wid[rec["wid"]] = rec
         candidates = [(wid_hex, accel, by_wid[wid_hex])
                       for wid_hex, accel in (extras or {}).get("workers", ())
                       if wid_hex in by_wid]
@@ -896,8 +916,20 @@ class Cluster:
         for rec, actor_id in named:
             self.gcs.register_named_actor(rec["name"], rec.get("namespace", ""),
                                           actor_id)
+        # re-journal ALL rebound actors (named or not): the duplicate-handle
+        # death path above may have unjournaled them, and a THIRD replay (or
+        # the next head restart) must find current records — the KV put is
+        # idempotent
+        with self._lock:
+            for _, _, rec in candidates:
+                st = self.actors.get(rec["creation_spec"].actor_id)
+                if st is not None:
+                    self._journal_actor(st)
         # the agent's arena contents go back into the directory, pinned (their
-        # owner refs died with the old head's drivers)
+        # owner refs died with the old head's drivers). The pin is taken ONCE
+        # per (node, object) — a doubly-delivered reregister re-adds the
+        # location (idempotent) but must not incref a second time, which
+        # would leak the object forever.
         arena_name = (extras or {}).get("arena")
         if arena_name:
             for oid_bytes, size, flags in (extras or {}).get("objects", ()):
@@ -905,7 +937,11 @@ class Cluster:
                 self.store.add(oid, ("remote", node_hex,
                                      ("arena", arena_name, oid_bytes, size,
                                       bool(flags & 1))))
-                self.store.incref(oid)
+                with self._lock:
+                    pinned = (node_hex, oid) in self._reattach_pins
+                    self._reattach_pins.add((node_hex, oid))
+                if not pinned:
+                    self.store.incref(oid)
         self.gcs.register_node(NodeInfo(node_id=node_id, resources=dict(resources),
                                         labels={**(labels or {}), "agent": "remote"}))
         import logging as _logging
@@ -931,11 +967,17 @@ class Cluster:
         return True
 
     def _journal_actor(self, st: ActorState) -> None:
-        """Persist a named/detached actor's placement so a restarted head can
-        rebind it to its still-running worker (reference: GCS actor table in
-        Redis surviving gcs_server restart)."""
+        """Persist a remote actor's placement so a restarted head can rebind
+        it to its still-running worker (reference: GCS actor table in Redis
+        surviving gcs_server restart). EVERY actor hosted on a remote worker
+        is journaled, not just named/detached ones — a head restart must be a
+        non-event for plain actors too (serve replicas especially: killing
+        them at reattach would turn every head blip into a serving gap).
+        Known limitation: a plain actor whose owner died WITH the old head
+        is rebound anyway and lives until explicitly killed — the restarted
+        head has no ownership record to reclaim it by."""
         w = st.worker
-        if not isinstance(w, RemoteWorkerHandle) or not (st.name or st.detached):
+        if not isinstance(w, RemoteWorkerHandle):
             return
         try:
             rec = cloudpickle.dumps({
@@ -1435,7 +1477,7 @@ class Cluster:
                 self._reply(w, req_id, False, e)
         elif kind == "register_fn":
             _, fn_id, fn_bytes = msg
-            self.fn_table[fn_id] = fn_bytes
+            self._register_fn(fn_id, fn_bytes)
             w.known_fns.add(fn_id)
         elif kind == "fetch_fn":
             _, req_id, fn_id = msg
@@ -1529,6 +1571,20 @@ class Cluster:
         return True
 
     # -- submission --------------------------------------------------------------------
+    def _register_fn(self, fn_id: bytes, fn_bytes: bytes) -> None:
+        """Every function-table write lands here so the bytes also reach the
+        GCS KV journal (`@fns`). Senders dedup register_fn per head lifetime;
+        durability is the head's job — a restarted head that forgot a class
+        can never start a replacement replica or restart an actor."""
+        if fn_id in self.fn_table:
+            return
+        self.fn_table[fn_id] = fn_bytes
+        try:
+            self.gcs.kv.put(fn_id, fn_bytes, namespace="@fns")
+        # graftlint: allow[swallowed-exception] journal I/O failure degrades to the in-memory table, not an error on the hot submit path
+        except Exception:
+            pass
+
     def submit(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids:
             self.store.incref(oid)
@@ -1540,8 +1596,8 @@ class Cluster:
         # dependencies for retryable tasks, task_manager.cc).
         for oid in spec.arg_refs:
             self.store.incref(oid)
-        if spec.fn_bytes is not None and spec.fn_id not in self.fn_table:
-            self.fn_table[spec.fn_id] = spec.fn_bytes
+        if spec.fn_bytes is not None:
+            self._register_fn(spec.fn_id, spec.fn_bytes)
         if spec.kind == "task" and spec.max_retries > 0:
             # lineage for reconstruction: snapshot arg_refs now (the live spec's
             # list is cleared when args are unpinned after completion) and pin
@@ -2081,6 +2137,12 @@ class Cluster:
         the fast path; this catches hosts that hang without closing the socket."""
         timeout = CONFIG.agent_heartbeat_timeout_s
         now = time.time()
+        # outage-aware boot grace: right after a head (re)start, agents that
+        # were healthy through the outage are still redialing/reattaching —
+        # reaping them now would turn a survivable restart into a mass
+        # node-death event. Heartbeat reaping arms once the grace passes.
+        if now - self._boot_at < max(timeout, CONFIG.head_restart_grace_s):
+            return
         with self._lock:
             stale = [a for a in self._agent_conns.values()
                      if now - a.last_heartbeat > timeout]
@@ -2798,7 +2860,7 @@ class DriverContext:
         _render_local(state)
 
     def register_fn(self, fn_id: bytes, fn_bytes: bytes) -> None:
-        self.cluster.fn_table[fn_id] = fn_bytes
+        self.cluster._register_fn(fn_id, fn_bytes)
 
     def fn_known(self, fn_id: bytes) -> bool:
         return fn_id in self.cluster.fn_table

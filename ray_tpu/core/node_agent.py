@@ -38,6 +38,8 @@ import cloudpickle
 _mp = multiprocessing.get_context("spawn")
 
 from ray_tpu.config import CONFIG
+from ray_tpu.core.exceptions import FaultInjectedError
+from ray_tpu.util import fault_injection
 
 
 class NodeAgent:
@@ -123,9 +125,17 @@ class NodeAgent:
         # head-imposed minimum flush interval (typed backpressure signal);
         # 0.0 = no backpressure, agent runs at its own cadence
         self._bp_min_interval_s = 0.0
+        # loss-intolerant relay frames (task results, worker decrefs,
+        # collective joins) whose send failed during a head outage: queued
+        # here and replayed IN ORDER after reregister. Only frames that never
+        # left this process are queued, so replay is exactly-once.
+        self._relay_lock = threading.Lock()
+        self._pending_relay: "collections.deque" = collections.deque()
 
     # -- transport ----------------------------------------------------------------
     def _send(self, msg) -> None:
+        fault_injection.fail_point("head.control.send",
+                                   kind=msg[0] if msg else None)
         with self._send_lock:
             self.conn.send(msg)
 
@@ -293,9 +303,31 @@ class NodeAgent:
                     continue
                 try:
                     self._send(("from_worker", wid, raw))
-                # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
-                except Exception:
-                    pass  # head restart in flight: the recv loop reconnects
+                # graftlint: allow[swallowed-exception] loss-intolerant frame queued for replay, not dropped
+                except Exception:  # noqa: BLE001 — head restart in flight
+                    # loss-intolerant frame (a task result, a decref, a
+                    # collective join): queue it for in-order replay once the
+                    # reconnect loop re-registers with the restarted head
+                    self._queue_relay(wid, raw)
+
+    def _queue_relay(self, wid: str, raw: bytes) -> None:
+        """Buffer a worker frame that failed to send (head outage) for replay
+        after reregister. Bounded by RAY_TPU_HEAD_OUTBOX_LIMIT: past it the
+        OLDEST frames fall off with a throttled warning — an unbounded queue
+        under a long outage would OOM the agent, which is strictly worse."""
+        limit = CONFIG.head_outbox_limit
+        with self._relay_lock:
+            self._pending_relay.append((wid, raw))
+            dropped = 0
+            while limit > 0 and len(self._pending_relay) > limit:
+                self._pending_relay.popleft()
+                dropped += 1
+        if dropped:
+            import logging
+
+            logging.getLogger("ray_tpu.node_agent").warning(
+                "head-outage relay outbox overflowed: dropped %d oldest "
+                "frame(s) (limit %d)", dropped, limit)
 
     # -- observability pre-aggregation ----------------------------------------------
 
@@ -385,11 +417,13 @@ class NodeAgent:
     def _head_recv_loop(self) -> None:
         while not self._shutdown:
             try:
+                fault_injection.fail_point("head.control.recv")
                 msg = self.conn.recv()
-            except EOFError:
-                # head is gone: hold workers alive and try to rejoin a
-                # restarted head (reference: raylets buffering through a GCS
-                # restart, NotifyGCSRestart / node_manager.proto:316)
+            except (EOFError, FaultInjectedError):
+                # head is gone (or a chaos fail point simulated exactly that):
+                # hold workers alive and try to rejoin a restarted head
+                # (reference: raylets buffering through a GCS restart,
+                # NotifyGCSRestart / node_manager.proto:316)
                 if self._shutdown:
                     return
                 if self._reconnect():
@@ -490,6 +524,34 @@ class NodeAgent:
                 # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
                 except Exception:
                     pass
+        # replay the outage's loss-intolerant relay backlog IN ORDER, kept
+        # workers only (a killed worker's results relay into a void anyway)
+        with self._relay_lock:
+            backlog = list(self._pending_relay)
+            self._pending_relay.clear()
+        for wid, raw in backlog:
+            if wid not in keep:
+                continue
+            try:
+                self._send(("from_worker", wid, raw))
+            # graftlint: allow[swallowed-exception] re-queued for the next reconnect's replay, not dropped
+            except Exception:  # noqa: BLE001 — outage resumed mid-replay
+                self._queue_relay(wid, raw)
+        # tell surviving workers the head restarted: replies to requests sent
+        # on the OLD head are gone forever — the worker fails those pending
+        # slots with a typed HeadUnavailableError instead of hanging
+        note = cloudpickle.dumps(("head_restarted", time.time()))
+        for wid in list(self._workers):
+            if wid not in keep:
+                continue
+            entry = self._workers.get(wid)
+            if entry is None:
+                continue
+            try:
+                entry[1].send_bytes(note)
+            # graftlint: allow[swallowed-exception] best-effort send to a possibly-dead peer; death is handled by heartbeat/reaper, not here
+            except Exception:
+                pass
 
     # -- head messages --------------------------------------------------------------
     def _handle_head_message(self, msg) -> None:

@@ -168,6 +168,25 @@ class WorkerContext:
                     # consumer abandoned a streaming generator: the producing
                     # thread checks this set at every yield boundary
                     self._cancelled_streams.add(msg[1])
+                elif kind == "head_restarted":
+                    # the agent re-registered with a RESTARTED head: replies to
+                    # requests sent on the old head are gone forever. Fail the
+                    # blocked waiters typed (callers like the serve retry plane
+                    # classify HeadUnavailableError and resend) instead of
+                    # letting them hang on replies that will never come. The
+                    # worker itself stays up — its pipe, actor state, and
+                    # data-plane pulls are intact.
+                    from ray_tpu.core.exceptions import HeadUnavailableError
+
+                    with self._req_lock:
+                        slots = list(self._reply_slots.values())
+                        self._reply_slots.clear()
+                    err = HeadUnavailableError(
+                        msg[1] if len(msg) > 1 else 0.0, 0,
+                        "head restarted; the pending reply was lost")
+                    for slot in slots:
+                        slot[1], slot[2] = False, err
+                        slot[0].set()
                 elif kind == "exit":
                     self._exit = True
                     self._task_queue.put(("exit",))

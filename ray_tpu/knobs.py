@@ -201,6 +201,28 @@ KNOBS: List[Knob] = [
          "restarted head before giving up (reference: raylets buffering "
          "through a GCS restart, NotifyGCSRestart).",
          "control-plane", attr="agent_reconnect_timeout_s"),
+    Knob("RAY_TPU_HEAD_RECONNECT_TIMEOUT_S", "float", 30.0,
+         "How long a driver/worker control context redials an unreachable "
+         "head (jittered backoff) before failing head-requiring calls with "
+         "HeadUnavailableError.",
+         "control-plane", attr="head_reconnect_timeout_s"),
+    Knob("RAY_TPU_HEAD_RECONNECT_BACKOFF_S", "float", 0.25,
+         "Initial redial backoff for a lost head connection; doubles per "
+         "attempt with jitter.",
+         "control-plane", attr="head_reconnect_backoff_s"),
+    Knob("RAY_TPU_HEAD_RECONNECT_BACKOFF_MAX_S", "float", 3.0,
+         "Redial backoff ceiling for a lost head connection.",
+         "control-plane", attr="head_reconnect_backoff_max_s"),
+    Knob("RAY_TPU_HEAD_OUTBOX_LIMIT", "int", 4096,
+         "Max loss-intolerant control messages (decref/kill/drop_stream, "
+         "agent relay frames) buffered for sequence-numbered replay across a "
+         "head outage; beyond it the oldest are dropped with a warning.",
+         "control-plane", attr="head_outbox_limit"),
+    Knob("RAY_TPU_HEAD_RESTART_GRACE_S", "float", 30.0,
+         "Reaper grace window after head boot: agents that were healthy "
+         "through a head outage get this long to reattach before the "
+         "heartbeat reaper may declare them dead.",
+         "control-plane", attr="head_restart_grace_s"),
     Knob("RAY_TPU_SESSION_DIR", "str", "/tmp/ray_tpu_session",
          "Session directory (head metadata, jobs, authkey, usage report).",
          "control-plane", attr="session_dir"),
@@ -580,6 +602,11 @@ KNOBS: List[Knob] = [
          "mode error|delay|kill. Deterministic chaos for tests/drills; "
          "unset = every fail point is a no-op.",
          "chaos", attr="fault_injection"),
+    Knob("RAY_TPU_HEAD_PID", "int", None,
+         "Default target for ChaosController.kill_head() when no pid/Popen "
+         "is passed: the standalone head process to SIGKILL in head-death "
+         "chaos runs. Unset = kill_head requires an explicit target.",
+         "chaos"),
 
     # -- core (worker plumbing + native build)
     Knob("RAY_TPU_NODE_IP", "str", None,

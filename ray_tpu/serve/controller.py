@@ -29,6 +29,19 @@ logger = logging.getLogger("ray_tpu.serve.controller")
 _replica_uid = _it.count(1)
 
 
+def _is_head_unavailable(err: BaseException) -> bool:
+    """Head outage vs replica death: a health probe that failed because the
+    CONTROL PLANE went away says nothing about the replica process, which
+    keeps running on its agent. The reconciler must not turn a head blip into
+    a replica-replacement storm (mirrors handle.is_head_unavailable; kept
+    local so the controller has no import edge into the handle module)."""
+    from ray_tpu.core.exceptions import HeadUnavailableError, TaskError
+
+    if isinstance(err, TaskError):
+        return isinstance(err.cause, HeadUnavailableError)
+    return isinstance(err, HeadUnavailableError)
+
+
 class _ReplicaState:
     def __init__(self, actor, version):
         self.actor = actor
@@ -607,12 +620,21 @@ class ServeController:
                                 ray_tpu.get(r.health_ref)
                                 r.state = RUNNING
                                 r.last_health_ok = now
+                                r.health_ref = None
                             except Exception as e:
-                                logger.warning(
-                                    "%s replica #%s failed its startup health "
-                                    "check (%r); replacing it", ds.name, r.uid, e)
-                                r.state = STOPPING
-                            r.health_ref = None
+                                if _is_head_unavailable(e):
+                                    # control-plane outage, not replica death:
+                                    # the reply died with the old head. The
+                                    # replica process is untouched — ask again
+                                    # instead of replacing a healthy worker.
+                                    r.last_health_ok = now
+                                    r.health_ref = r.actor.check_health.remote()
+                                else:
+                                    logger.warning(
+                                        "%s replica #%s failed its startup health "
+                                        "check (%r); replacing it", ds.name, r.uid, e)
+                                    r.state = STOPPING
+                                    r.health_ref = None
                 # periodic health checks on RUNNING replicas
                 period = ds.info["config"].health_check_period_s
                 for r in ds.replicas:
@@ -625,10 +647,16 @@ class ServeController:
                                 ray_tpu.get(r.health_ref)
                                 r.last_health_ok = now
                             except Exception as e:
-                                logger.warning(
-                                    "%s replica #%s failed its health check "
-                                    "(%r); replacing it", ds.name, r.uid, e)
-                                r.state = STOPPING
+                                if _is_head_unavailable(e):
+                                    # inconclusive: the head blinked, the
+                                    # replica didn't. Grant outage grace and
+                                    # re-check a full period from now.
+                                    r.last_health_ok = now
+                                else:
+                                    logger.warning(
+                                        "%s replica #%s failed its health check "
+                                        "(%r); replacing it", ds.name, r.uid, e)
+                                    r.state = STOPPING
                             r.health_ref = None
                         elif now - r.last_health_ok > period + ds.info["config"].health_check_timeout_s:
                             r.state = STOPPING

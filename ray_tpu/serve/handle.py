@@ -33,6 +33,7 @@ from ray_tpu.core.exceptions import (
     ActorError,
     BackPressureError,
     FaultInjectedError,
+    HeadUnavailableError,
     ReplicaUnavailableError,
     TaskError,
     WorkerCrashedError,
@@ -76,10 +77,20 @@ def is_replica_failure(err: BaseException) -> bool:
     a draining replica's bounce, or an armed fail point standing in for one.
     User-code exceptions arrive as TaskError and are never retried."""
     if isinstance(err, TaskError):
-        return isinstance(err.cause, (FaultInjectedError, ReplicaUnavailableError))
+        return isinstance(err.cause, (FaultInjectedError, ReplicaUnavailableError,
+                                      HeadUnavailableError))
     return isinstance(err, (ActorError, WorkerCrashedError,
                             ReplicaUnavailableError, FaultInjectedError,
-                            ConnectionError))
+                            HeadUnavailableError, ConnectionError))
+
+
+def is_head_unavailable(err: BaseException) -> bool:
+    """True when the failure is a HEAD outage, not a replica problem: the
+    replica may be perfectly healthy, we just cannot reach it through the
+    control plane right now. Retried without consuming the replica budget."""
+    if isinstance(err, TaskError):
+        return isinstance(err.cause, HeadUnavailableError)
+    return isinstance(err, HeadUnavailableError)
 
 
 class DeploymentResponse:
@@ -411,10 +422,19 @@ class _Router:
 
 
 class _LongPollEntry:
-    """Shared push-updated replica view for one deployment in this process."""
+    """Shared push-updated replica view for one deployment in this process.
+
+    stale_since stamps the moment the controller became unreachable while a
+    view was held: the view is PINNED (kept routable) through the outage —
+    degraded-mode serving — and the stamp lets callers report how old the
+    routing decision's information is. Cleared on the next successful poll."""
 
     def __init__(self):
         self.replicas: Optional[List[Any]] = None
+        self.stale_since: Optional[float] = None
+
+    def staleness_s(self) -> Optional[float]:
+        return None if self.stale_since is None else time.time() - self.stale_since
 
 
 class _LongPollClient:
@@ -474,13 +494,20 @@ class _LongPollClient:
                 errors = 0
             except Exception as lp_err:
                 with self.lock:
+                    stamp = time.time()
                     for e in self.entries.values():
-                        e.replicas = None  # fall back to interval polling
+                        # PIN the last-known view through the outage instead
+                        # of dropping it: requests keep routing to the
+                        # replicas we knew about (replica death during the
+                        # window is absorbed by the suspect/retry plane),
+                        # stamped so staleness is observable
+                        if e.replicas is not None and e.stale_since is None:
+                            e.stale_since = stamp
                 errors += 1
                 if errors == 1:
                     # one line per outage, not one per second of it
                     logger.warning("serve long-poll watch failed (%r); "
-                                   "falling back to interval polling while "
+                                   "pinning the last replica view while "
                                    "retrying", lp_err)
                 if errors > 30:
                     # controller gone for ~30s: retire; a later watch() respawns
@@ -502,10 +529,12 @@ class _LongPollClient:
                         continue
                     if snapshot is None:  # deployment deleted: stop watching it
                         entry.replicas = None
+                        entry.stale_since = None
                         del self.entries[tup]
                         self.versions.pop(lp_key, None)
                     else:
                         entry.replicas = snapshot
+                        entry.stale_since = None  # fresh view: outage over
 
 
 # process-wide in-flight accounting behind the serve_queue_depth gauge
@@ -551,6 +580,7 @@ class _RetrySession:
         self.replica = None  # replica of the LAST attempt
         self.attempt = 0
         self.deadline: Optional[float] = None  # caller's result(timeout_s) bound
+        self.head_deadline: Optional[float] = None  # armed on first head outage
         self.t0_perf = 0  # send time of the last attempt (perf_counter_ns)
         self.completed_dur_ns: Optional[int] = None  # stamped by the waiter
         self._observed = False  # EWMA fed at most once per logical request
@@ -559,6 +589,27 @@ class _RetrySession:
         """Classify a failed attempt; re-raise when the request must surface
         (user error, budget exhausted, retryable=False, caller deadline
         passed), otherwise mark the replica suspect and sleep the backoff."""
+        if is_head_unavailable(err):
+            # a head outage is not the replica's fault: retry WITHOUT spending
+            # the replica budget or suspecting anyone, bounded by its own
+            # window (the reconnect horizon plus restart slack) so a head
+            # that never comes back still surfaces the typed error
+            from ray_tpu.config import CONFIG
+            if self.head_deadline is None:
+                self.head_deadline = (time.monotonic()
+                                      + CONFIG.head_reconnect_timeout_s + 10.0)
+            if time.monotonic() >= self.head_deadline:
+                raise err
+            if self.deadline is not None and time.monotonic() >= self.deadline:
+                raise err
+            self.attempt += 1
+            delay = min(self.backoff_s * (2 ** (self.attempt - 1)),
+                        self.backoff_max_s)
+            delay *= 0.5 + random.random() * 0.5
+            if self.deadline is not None:
+                delay = min(delay, max(0.0, self.deadline - time.monotonic()))
+            time.sleep(delay)
+            return
         if not is_replica_failure(err) or self.attempts_left <= 0:
             raise err
         if self.deadline is not None and time.monotonic() >= self.deadline:
@@ -659,9 +710,18 @@ class DeploymentHandle:
         now = time.time()
         if not force and now - self._last_refresh < self._refresh_interval and self._replicas:
             return
-        replicas = ray_tpu.get(
-            self._controller().get_replicas.remote(self.app_name, self.deployment_name)
-        )
+        try:
+            replicas = ray_tpu.get(
+                self._controller().get_replicas.remote(self.app_name, self.deployment_name)
+            )
+        except Exception:
+            # controller/head unreachable: degraded mode keeps serving from
+            # the last-known replica set (dead replicas are absorbed by the
+            # retry plane); only a handle with NO view at all surfaces this
+            if self._replicas:
+                self._last_refresh = now  # don't hammer a dead controller
+                return
+            raise
         self._replicas = replicas
         self._maybe_prune(replicas)
         self._last_refresh = now

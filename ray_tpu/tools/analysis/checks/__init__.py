@@ -4,7 +4,7 @@ from __future__ import annotations
 from typing import List
 
 from ..base import Check
-from .blocking_control import BlockingControlPath
+from .blocking_control import BlockingControlPath, UnboundedReconnect
 from .host_sync import HostSyncInHotPath
 from .knob_registry import KnobRegistry
 from .no_print import NoPrint
@@ -15,6 +15,7 @@ ALL_CHECKS: List[Check] = [
     SwallowedException(),
     HostSyncInHotPath(),
     BlockingControlPath(),
+    UnboundedReconnect(),
     KnobRegistry(),
     ThreadHygiene(),
     LockHygiene(),

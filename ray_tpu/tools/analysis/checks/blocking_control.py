@@ -19,6 +19,16 @@ Flagged calls: ``time.sleep``, ``ray_tpu.get`` / ``ray_tpu.wait``,
 ``.result()`` on futures. In async code the non-blocking spelling exists
 (``await asyncio.sleep``, executors); on the control group the work belongs
 on another group.
+
+The sibling ``unbounded-reconnect`` check (PR 18's head-death lesson) guards
+the other control-path liveness invariant: every reconnect loop must be
+BOUNDED. A ``while True`` that redials forever turns a dead head into a
+silent hang — the caller never gets the typed HeadUnavailableError that lets
+degraded-mode serving and the chaos gate reason about the outage. Flagged:
+a constant-true ``while`` whose body establishes connections (``dial`` /
+``connect`` / ``create_connection`` / ``HeadConnection`` / ``Client``) with
+no deadline/attempt bound in sight (no comparison against a deadline,
+timeout, attempt, retry, or budget value anywhere in the loop).
 """
 from __future__ import annotations
 
@@ -102,3 +112,80 @@ class BlockingControlPath(Check):
                     yield Violation(
                         self.name, f.path, node.lineno,
                         f"{BLOCKING_METHODS[last]} (in {label})")
+
+
+# connection-establishing call names (last dotted segment / bare constructor)
+CONNECT_CALLS = {"dial", "_dial", "connect", "connect_ex", "create_connection",
+                 "open_connection"}
+CONNECT_CTORS = {"Client", "HeadConnection", "SecureClient"}
+
+# an identifier mentioning one of these inside a comparison is taken as
+# evidence the loop is bounded (deadline check, attempt budget, ...)
+_BOUND_HINTS = ("deadline", "timeout", "attempt", "retr", "tries", "budget",
+                "remaining", "expire", "monotonic", "time")
+
+
+def _const_true(test: ast.expr) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value) is True
+
+
+def _mentions_bound(node: ast.AST) -> bool:
+    """True when the comparison references a deadline/attempt-flavored value
+    (by variable name, attribute, or a time.monotonic()/time.time() call)."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is not None:
+            low = name.lower()
+            if any(h in low for h in _BOUND_HINTS):
+                return True
+    return False
+
+
+class UnboundedReconnect(Check):
+    """Flag `while True` loops that establish connections with no visible
+    deadline or attempt bound — the retry must be bounded so a dead peer
+    surfaces as a typed error instead of a hang."""
+
+    name = "unbounded-reconnect"
+
+    def run(self, f: SourceFile, project: Project) -> Iterable[Violation]:
+        for loop in ast.walk(f.tree):
+            if not isinstance(loop, ast.While) or not _const_true(loop.test):
+                continue
+            # nested function bodies run elsewhere; nested constant-true
+            # whiles get their own visit — exclude both from this loop's scan
+            skip = set()
+            for child in ast.iter_child_nodes(loop):
+                for sub in ast.walk(child):
+                    if sub is not child and isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        for inner in ast.walk(sub):
+                            skip.add(id(inner))
+            connects: List[ast.Call] = []
+            bounded = False
+            for child in loop.body:
+                for sub in ast.walk(child):
+                    if id(sub) in skip:
+                        continue
+                    if isinstance(sub, ast.Call):
+                        name = call_name(sub.func)
+                        last = name.rsplit(".", 1)[-1]
+                        if (last in CONNECT_CALLS and "." in name) \
+                                or last in CONNECT_CTORS:
+                            connects.append(sub)
+                    elif isinstance(sub, ast.Compare) and _mentions_bound(sub):
+                        bounded = True
+                    elif isinstance(sub, ast.While) and not _const_true(sub.test) \
+                            and _mentions_bound(sub.test):
+                        bounded = True
+            if connects and not bounded:
+                yield Violation(
+                    self.name, f.path, connects[0].lineno,
+                    "reconnect loop with no deadline/attempt bound: a dead "
+                    "peer must surface as a typed error, not an infinite "
+                    "redial (compare against a deadline or attempt budget, "
+                    "or hoist the dial into a bounded helper)")

@@ -4,12 +4,28 @@ Every runtime-API method (submit/get/put/wait/kill_actor/...) is forwarded as
 (req_id, method, args, kwargs); a demux thread matches responses. ObjectRefs and
 ActorHandles arriving in results re-bind to this context automatically because
 they resolve the process-global worker at call time.
+
+Head fault tolerance: the transport survives a head outage. On connection
+loss the send loop redials with jittered backoff (bounded by
+RAY_TPU_HEAD_RECONNECT_TIMEOUT_S); loss-intolerant casts (decref/kill_actor/
+drop_stream) are sequence-numbered into a bounded replay outbox and re-sent
+on reconnect — the server dedups by per-client high-water seq and acks, so a
+same-head transport blip applies each exactly once and a restarted head
+receives the in-doubt tail. Blocking calls in flight when the transport died
+fail typed (HeadUnavailableError, carrying the outage age) instead of
+hanging; calls issued DURING the outage queue and complete after reconnect.
 """
 from __future__ import annotations
 
+import collections
 import itertools
+import random
+import secrets
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core.exceptions import HeadUnavailableError
 
 from .server import DEFAULT_AUTHKEY, load_authkey
 from .server import REF_RETURNING as _REF_RETURNING  # shared with the server's leasing
@@ -25,6 +41,14 @@ _FORWARDED = {
 # thread), so they must never wait for a response or touch the socket directly
 _NO_REPLY = {"decref", "kill_actor", "push_metrics", "push_spans",
              "push_telemetry", "push_tqdm", "drop_stream"}
+# the loss-INTOLERANT subset: dropping one leaks an object or an actor, so
+# these ride the sequence-numbered replay outbox (acked-or-queued); the
+# telemetry pushes above tolerate loss and stay plain casts
+_REPLAYABLE = {"decref", "kill_actor", "drop_stream"}
+
+# internal wire markers (never collide with int req_ids)
+_ACK_ID = "_seq_ack"
+_HANDSHAKE_ID = "_handshake_ping"
 
 
 class ClientContext:
@@ -38,16 +62,29 @@ class ClientContext:
             # for loopback servers started with an explicit DEFAULT_AUTHKEY
             authkey = load_authkey() or DEFAULT_AUTHKEY
         host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._authkey = authkey
+        self._dial_timeout = timeout
         # secure_transport.dial: mTLS under RAY_TPU_USE_TLS (the server refuses
         # plaintext there), plain mp Client otherwise
         from ray_tpu.core.secure_transport import dial
 
-        self._conn = dial((host or "127.0.0.1", int(port)), authkey=authkey,
-                          timeout=timeout)
+        self._conn = dial(self._addr, authkey=authkey, timeout=timeout)
+        self._conn_gen = 0
+        self._client_id = secrets.token_hex(8)
         self._req_counter = itertools.count()
         self._pending: Dict[int, Tuple[threading.Event, list]] = {}
+        self._sent_gen: Dict[int, int] = {}  # req_id -> conn generation it left on
         self._pending_lock = threading.Lock()
         self._closed = False
+        # head-outage bookkeeping (read by _closed_error for typed raises)
+        self._head_lost_at: Optional[float] = None
+        self._gave_up_attempts = 0
+        self._cv = threading.Condition()  # guards _conn/_conn_gen transitions
+        # sequence-numbered replay outbox for loss-intolerant casts
+        self._seq = itertools.count()
+        self._replay: "collections.deque" = collections.deque()
+        self._replay_lock = threading.Lock()
         # all sends go through the outbox: SimpleQueue.put is reentrant, so GC
         # finalizers (ObjectRef.__del__ -> decref) can enqueue from any thread —
         # including mid-send or on the recv thread — without deadlock/corruption
@@ -58,6 +95,9 @@ class ClientContext:
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="ray-tpu-client-recv")
         self._recv_thread.start()
+        # register this client's identity first so the server anchors leases
+        # and the seq-dedup high-water to it (survives reconnects)
+        self._cast("_hello", self._client_id)
         assert self._call("_ping") == "pong"
         info = self._call("runtime_context")
         self.node_id_hex = info["node_id"]
@@ -68,58 +108,216 @@ class ClientContext:
         self.accel = "client-driver"
 
     # -- transport -------------------------------------------------------------
-    def _fail_all_pending(self, reason: str) -> None:
+    def _closed_error(self) -> Exception:
+        if self._head_lost_at is not None:
+            return HeadUnavailableError(self._head_lost_at,
+                                        self._gave_up_attempts,
+                                        "client gave up redialing the head")
+        return ConnectionError("client connection is closed")
+
+    def _fail_all_pending(self, exc: Exception) -> None:
         with self._pending_lock:
             # _closed flips under the same lock _call registers under, so a call
             # either sees closed and raises, or registers in time to be failed here
             self._closed = True
             pending, self._pending = self._pending, {}
+            self._sent_gen.clear()
         for ev, out in pending.values():
-            out.extend((False, ConnectionError(reason)))
+            out.extend((False, exc))
             ev.set()
 
+    def _fail_sent_pending(self, dead_gen: int, exc: Exception) -> None:
+        """Fail only requests whose frames left on a now-dead connection: their
+        replies are unrecoverable. Requests still queued in the outbox survive
+        the outage and complete after reconnect."""
+        with self._pending_lock:
+            doomed = [rid for rid, g in self._sent_gen.items() if g <= dead_gen]
+            slots = [self._pending.pop(rid, None) for rid in doomed]
+            for rid in doomed:
+                self._sent_gen.pop(rid, None)
+        for slot in slots:
+            if slot is not None:
+                ev, out = slot
+                out.extend((False, exc))
+                ev.set()
+
+    def _trim_replay(self, upto_seq: int) -> None:
+        """Server acked application through upto_seq: those casts are durable
+        and leave the replay window."""
+        with self._replay_lock:
+            while self._replay and self._replay[0][0] <= upto_seq:
+                self._replay.popleft()
+
+    def _handshake(self, conn) -> None:
+        """Run on a FRESH connection before publishing it: re-identify, replay
+        the in-doubt cast window in order, and confirm liveness — all inline
+        (the recv loop is parked until the new generation is published)."""
+        conn.send((None, "_hello", (self._client_id,), {}))
+        with self._replay_lock:
+            backlog = list(self._replay)
+        for seq, method, args, kwargs in backlog:
+            conn.send((None, "_seq_cast",
+                       (self._client_id, seq, method, args), kwargs))
+        conn.send((_HANDSHAKE_ID, "_ping", (), {}))
+        while True:  # acks for the replayed window may precede the ping reply
+            if hasattr(conn, "poll") and not conn.poll(5.0):
+                raise ConnectionError("handshake timed out")
+            rid, ok, value = conn.recv()
+            if rid == _ACK_ID:
+                self._trim_replay(value)
+                continue
+            if rid == _HANDSHAKE_ID:
+                if not ok or value != "pong":
+                    raise ConnectionError(f"handshake failed: {value!r}")
+                return
+
+    def _reconnect(self, dead_conn) -> bool:
+        """Bounded redial with jittered backoff (send-loop only). Returns True
+        once a fresh connection is published; False when the window expired —
+        the context is then closed and every pending call fails typed."""
+        from ray_tpu.config import CONFIG
+        from ray_tpu.core.secure_transport import dial
+
+        with self._cv:
+            if self._closed:
+                return False
+            if self._conn is not dead_conn:
+                return self._conn is not None  # already replaced
+            dead_gen = self._conn_gen
+            self._conn = None
+            if self._head_lost_at is None:
+                self._head_lost_at = time.time()
+        try:
+            dead_conn.close()
+        # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
+        except Exception:
+            pass
+        # frames already on the dead wire lost their replies: fail those calls
+        # typed NOW (serve's retry plane classifies this and resends), rather
+        # than leaving them to hang through the whole outage
+        self._fail_sent_pending(dead_gen, HeadUnavailableError(
+            self._head_lost_at or time.time(), 0,
+            "head connection lost with the reply outstanding"))
+        deadline = time.monotonic() + CONFIG.head_reconnect_timeout_s
+        backoff = CONFIG.head_reconnect_backoff_s
+        attempts = 0
+        while time.monotonic() < deadline and not self._closed:
+            attempts += 1
+            try:
+                conn = dial(self._addr, authkey=self._authkey,
+                            timeout=min(5.0, CONFIG.head_reconnect_timeout_s))
+                self._handshake(conn)
+            # graftlint: allow[swallowed-exception] redial loop: failures retry with backoff until the reconnect deadline
+            except Exception:  # noqa: BLE001 — redial failures drive the backoff
+                delay = min(backoff, max(0.0, deadline - time.monotonic()))
+                backoff = min(backoff * 2, CONFIG.head_reconnect_backoff_max_s)
+                time.sleep(delay * (0.5 + random.random() * 0.5))
+                continue
+            with self._cv:
+                self._conn = conn
+                self._conn_gen += 1
+                self._head_lost_at = None
+                self._cv.notify_all()
+            return True
+        # window expired: the head is durably gone for this context
+        self._gave_up_attempts = attempts
+        with self._cv:
+            self._cv.notify_all()
+        self._fail_all_pending(HeadUnavailableError(
+            self._head_lost_at or time.time(), attempts,
+            "reconnect window expired"))
+        return False
+
     def _send_loop(self) -> None:
-        while not self._closed:
+        while True:
             msg = self._outbox.get()
             if msg is None:
                 break
-            try:
-                self._conn.send(msg)
-            except BaseException as e:  # noqa: BLE001
-                if msg[0] is not None:
-                    # a request failed to serialize/send: fail just that call,
-                    # the channel itself may still be fine for picklable traffic
-                    with self._pending_lock:
-                        slot = self._pending.pop(msg[0], None)
-                    if slot is not None:
-                        ev, out = slot
-                        out.extend((False, e))
-                        ev.set()
-                if isinstance(e, (OSError, EOFError, BrokenPipeError)):
-                    # transport is dead: nothing sent after this can complete
-                    # graftlint: allow[lock-hygiene] monotonic shutdown latch: every writer only sets True
-                    self._closed = True
-                    self._fail_all_pending("client connection lost (send failed)")
+            if msg[0] == "__reconnect__":
+                # recv-loop poke: the transport died while this loop was
+                # parked on an empty outbox — reconnect now
+                with self._cv:
+                    conn, gen = self._conn, self._conn_gen
+                if conn is not None and gen == msg[1]:
+                    if not self._reconnect(conn):
+                        break
+                continue
+            while True:
+                with self._cv:
+                    conn, gen = self._conn, self._conn_gen
+                if conn is None:
+                    if self._closed:
+                        if msg[0] is not None:
+                            self._fail_req(msg[0], self._closed_error())
+                        break
+                    # mid-reconnect (recv poke raced us): retry shortly
+                    time.sleep(0.02)
+                    continue
+                try:
+                    conn.send(msg)
+                    if msg[0] is not None:
+                        with self._pending_lock:
+                            if msg[0] in self._pending:
+                                self._sent_gen[msg[0]] = gen
                     break
+                except BaseException as e:  # noqa: BLE001
+                    if not isinstance(e, (OSError, EOFError, BrokenPipeError)):
+                        # a request failed to serialize: fail just that call,
+                        # the channel itself is still fine for picklable traffic
+                        if msg[0] is not None:
+                            self._fail_req(msg[0], e)
+                        break
+                    # transport is dead: redial (bounded), then re-send this
+                    # frame — it never left, so the retry is at-most-once
+                    if not self._reconnect(conn):
+                        if msg[0] is not None:
+                            self._fail_req(msg[0], self._closed_error())
+                        break
+            if self._closed and self._conn is None:
+                break
+
+    def _fail_req(self, req_id: int, exc: BaseException) -> None:
+        with self._pending_lock:
+            slot = self._pending.pop(req_id, None)
+            self._sent_gen.pop(req_id, None)
+        if slot is not None:
+            ev, out = slot
+            out.extend((False, exc))
+            ev.set()
 
     def _recv_loop(self) -> None:
-        while not self._closed:
+        while True:
+            with self._cv:
+                while self._conn is None and not self._closed:
+                    self._cv.wait(timeout=0.1)
+                if self._closed:
+                    break
+                conn, gen = self._conn, self._conn_gen
             try:
-                req_id, ok, value = self._conn.recv()
-            # graftlint: allow[swallowed-exception] peer closed mid-recv; the loop exits via its closed flag
+                req_id, ok, value = conn.recv()
+            # graftlint: allow[swallowed-exception] peer closed mid-recv; reconnection is poked below and the loop re-parks
             except Exception:
-                # EOF, OSError, or an unpicklable reply (missing class client-side):
-                # the stream position is unrecoverable — fail all pending calls
-                break
+                # EOF/OSError/unpicklable reply: this connection is done. Poke
+                # the send loop to redial and park until a new generation (or
+                # permanent closure) appears.
+                if self._closed:
+                    break
+                self._outbox.put(("__reconnect__", gen))
+                with self._cv:
+                    while self._conn_gen == gen and not self._closed:
+                        self._cv.wait(timeout=0.1)
+                continue
+            if req_id == _ACK_ID:
+                self._trim_replay(value)
+                continue
             with self._pending_lock:
                 slot = self._pending.pop(req_id, None)
+                self._sent_gen.pop(req_id, None)
             if slot is not None:
                 ev, out = slot
                 out.extend((ok, value))
                 ev.set()
-        # graftlint: allow[lock-hygiene] monotonic shutdown latch: every writer only sets True
-        self._closed = True
-        self._fail_all_pending("client connection closed")
+        self._fail_all_pending(self._closed_error())
 
     def _call(self, method: str, *args, **kwargs):
         req_id = next(self._req_counter)
@@ -127,7 +325,7 @@ class ClientContext:
         out: list = []
         with self._pending_lock:
             if self._closed:
-                raise ConnectionError("client connection is closed")
+                raise self._closed_error()
             self._pending[req_id] = (ev, out)
         self._outbox.put((req_id, method, args, kwargs))
         ev.wait()
@@ -141,8 +339,22 @@ class ClientContext:
         return value
 
     def _cast(self, method: str, *args, **kwargs) -> None:
-        """Fire-and-forget (no response; safe from GC finalizers)."""
-        self._outbox.put((None, method, args, kwargs))
+        """Fire-and-forget (no response; safe from GC finalizers). The
+        loss-intolerant subset is sequence-numbered into the replay outbox so
+        a head outage delays it instead of dropping it."""
+        if method in _REPLAYABLE:
+            from ray_tpu.config import CONFIG
+
+            limit = CONFIG.head_outbox_limit
+            with self._replay_lock:
+                seq = next(self._seq)
+                self._replay.append((seq, method, args, kwargs))
+                while limit > 0 and len(self._replay) > limit:
+                    self._replay.popleft()  # oldest in-doubt entries fall off
+            self._outbox.put((None, "_seq_cast",
+                              (self._client_id, seq, method, args), kwargs))
+        else:
+            self._outbox.put((None, method, args, kwargs))
 
     # -- runtime API -----------------------------------------------------------
     def __getattr__(self, name: str):
@@ -173,14 +385,20 @@ class ClientContext:
         return fut
 
     def close(self) -> None:
-        # graftlint: allow[lock-hygiene] monotonic shutdown latch: every writer only sets True
-        self._closed = True
+        with self._cv:
+            # graftlint: allow[lock-hygiene] monotonic shutdown latch: every writer only sets True
+            self._closed = True
+            self._head_lost_at = None  # explicit close, not an outage
+            conn, self._conn = self._conn, None
+            self._cv.notify_all()
         self._outbox.put(None)  # unblock the sender
         try:
-            self._conn.close()
+            if conn is not None:
+                conn.close()
         # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
+        self._fail_all_pending(ConnectionError("client connection is closed"))
 
 
 def connect(address: str, authkey: Optional[bytes] = None) -> ClientContext:

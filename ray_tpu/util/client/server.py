@@ -63,6 +63,37 @@ def load_authkey() -> Optional[bytes]:
 # must NOT be leased — leasing them would reclaim objects the head still owns
 REF_RETURNING = frozenset({"submit", "put", "pg_ready_ref"})
 
+# -- reconnect support (head fault tolerance) ---------------------------------
+# A client that redials after a transport blip announces itself with _hello
+# (a stable per-context client id). Leases are anchored to that id so the OLD
+# connection's teardown never reclaims refs/actors a live, reconnected client
+# still owns; sequence-numbered casts (_seq_cast) dedup against a per-client
+# high-water mark and are acked so the client can trim its replay outbox.
+_client_state_lock = threading.Lock()
+_client_sessions: dict = {}  # client_id -> {"refs", "actors", "gen", "seq_hw"}
+
+
+def _adopt_session(client_id: str) -> tuple:
+    """Register a (re)connection for client_id; returns (session, generation).
+    The newest generation owns the leases — an older connection's disconnect
+    cleanup sees a newer gen and skips reclaim."""
+    with _client_state_lock:
+        sess = _client_sessions.setdefault(
+            client_id, {"refs": set(), "actors": set(), "gen": 0, "seq_hw": -1})
+        sess["gen"] += 1
+        return sess, sess["gen"]
+
+
+def _retire_session(client_id: str, gen: int) -> bool:
+    """True when this connection was the client's LAST (no newer reconnect
+    adopted the leases): the caller must reclaim. Drops the session record."""
+    with _client_state_lock:
+        sess = _client_sessions.get(client_id)
+        if sess is None or sess["gen"] != gen:
+            return False
+        del _client_sessions[client_id]
+        return True
+
 
 def set_ref_ownership(value, owned: bool) -> list:
     """Walk a reply value and flip ObjectRef ownership; returns the ids touched.
@@ -169,12 +200,43 @@ class ClientServer:
         from ray_tpu.core import global_state
 
         send_lock = threading.Lock()
-        # ownership leased to this client: reclaimed if it disconnects uncleanly
+        # ownership leased to this client: reclaimed if it disconnects uncleanly.
+        # A _hello from a reconnect-capable client swaps these for the
+        # session-registry sets anchored to its client id, so leases survive
+        # transport blips (see _adopt_session/_retire_session).
         leak_lock = threading.Lock()
-        leased_refs: set = set()
-        leased_actors: set = set()
+        sess = {"refs": set(), "actors": set(), "cid": None, "gen": 0}
+
+        def _ack(seq: int) -> None:
+            try:
+                with send_lock:
+                    conn.send(("_seq_ack", True, seq))
+            # graftlint: allow[swallowed-exception] best-effort ack; an unacked cast stays in the client's replay outbox and re-applies dedup'd
+            except Exception:
+                pass
 
         def dispatch(req_id, method, args, kwargs):
+            if method == "_hello" and args:
+                registry_sess, gen = _adopt_session(args[0])
+                with leak_lock:
+                    # migrate any leases taken before the hello (normally none)
+                    registry_sess["refs"].update(sess["refs"])
+                    registry_sess["actors"].update(sess["actors"])
+                    sess["refs"] = registry_sess["refs"]
+                    sess["actors"] = registry_sess["actors"]
+                    sess["cid"], sess["gen"] = args[0], gen
+                return
+            if method == "_seq_cast" and args:
+                cid, seq, inner, inner_args = args
+                with _client_state_lock:
+                    reg = _client_sessions.get(cid)
+                    fresh = reg is None or seq > reg["seq_hw"]
+                    if reg is not None and fresh:
+                        reg["seq_hw"] = seq
+                if fresh:
+                    dispatch(None, inner, inner_args, kwargs)
+                _ack(seq)  # re-ack duplicates too, so the client trims
+                return
             try:
                 if method == "_ping":
                     ok, value = True, "pong"
@@ -187,10 +249,10 @@ class ClientServer:
             if req_id is None:
                 if method == "decref" and args:
                     with leak_lock:
-                        leased_refs.discard(args[0])
+                        sess["refs"].discard(args[0])
                 elif method == "kill_actor" and args:
                     with leak_lock:
-                        leased_actors.discard(args[0])
+                        sess["actors"].discard(args[0])
                 return
             if ok and method in REF_RETURNING:
                 # lease BEFORE the reply goes out so a fast client decref can
@@ -198,10 +260,10 @@ class ClientServer:
                 touched = set_ref_ownership(value, False)
                 if touched:
                     with leak_lock:
-                        leased_refs.update(touched)
+                        sess["refs"].update(touched)
                 if method == "submit" and args and getattr(args[0], "kind", "") == "actor_creation":
                     with leak_lock:
-                        leased_actors.add(args[0].actor_id)
+                        sess["actors"].add(args[0].actor_id)
             try:
                 with send_lock:
                     conn.send((req_id, ok, value))
@@ -234,14 +296,21 @@ class ClientServer:
         # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
         except Exception:
             pass
-        # reclaim whatever the client still owned (crash / dropped connection)
+        # reclaim whatever the client still owned (crash / dropped connection).
+        # A reconnect-capable client whose NEWER connection adopted the leases
+        # must NOT be reclaimed here — that would free objects and kill actors
+        # a live client still holds through a transport blip.
+        with leak_lock:
+            cid, gen = sess["cid"], sess["gen"]
+        if cid is not None and not _retire_session(cid, gen):
+            return
         ctx = global_state.try_worker()
         if ctx is None:
             return
         with leak_lock:
-            refs, actors = list(leased_refs), list(leased_actors)
-            leased_refs.clear()
-            leased_actors.clear()
+            refs, actors = list(sess["refs"]), list(sess["actors"])
+            sess["refs"] = set()
+            sess["actors"] = set()
         for oid in refs:
             try:
                 ctx.decref(oid)

@@ -14,7 +14,11 @@ the unified registry both ride:
   ``serve.autoscaler.decide`` (head-side control loop, top of every tick) /
   ``serve.controller.scale`` (controller apply RPC) / ``data_plane.pull`` /
   ``collective.wait`` / ``llm.pd.handoff`` (per-page paged KV pull on the
-  decode side — P/D disaggregation's transfer hot path).
+  decode side — P/D disaggregation's transfer hot path) /
+  ``head.control.recv`` / ``head.control.send`` (the node agent's head
+  connection: error mode simulates a head outage — the agent's bounded
+  reconnect + reattach machinery runs against the live head, making
+  head-death recovery testable without killing any process).
 - Arming is per-process via :func:`arm`, or via the
   ``RAY_TPU_FAULT_INJECTION`` environment variable so spawned workers inherit
   specs (``site=mode[@p=0.5][@n=3][@delay=0.1][@seed=7][;site2=...]``).
@@ -327,6 +331,35 @@ class ChaosController:
             except Exception:  # noqa: BLE001 — replica died meanwhile
                 pass
         return done
+
+    # -- head process ----------------------------------------------------------
+    @staticmethod
+    def kill_head(head: Any = None) -> int:
+        """SIGKILL the HEAD process — the whole point of the head-death chaos
+        gate. `head` is a pid, or anything with a ``.pid`` (subprocess.Popen);
+        when omitted, ``RAY_TPU_HEAD_PID`` names the target. Refuses to kill
+        the calling process: an in-process head (driver owns the Cluster)
+        dying WITH its driver is a different failure than a head outage, and
+        silently killing the test harness helps nobody. Returns the pid."""
+        import signal
+
+        pid = getattr(head, "pid", head)
+        if pid is None:
+            raw = os.environ.get("RAY_TPU_HEAD_PID")
+            pid = int(raw) if raw else None
+        if pid is None:
+            raise RuntimeError(
+                "kill_head needs a target: pass a pid / Popen, or set "
+                "RAY_TPU_HEAD_PID (an in-process head shares this process — "
+                "run the head standalone to chaos-test it)")
+        pid = int(pid)
+        if pid == os.getpid():
+            raise RuntimeError(
+                "refusing to SIGKILL the calling process: the head is "
+                "in-process here; run it standalone for head-death chaos")
+        logger.warning("chaos: SIGKILL head pid %d", pid)
+        os.kill(pid, signal.SIGKILL)
+        return pid
 
     # -- serve control plane ---------------------------------------------------
     @staticmethod

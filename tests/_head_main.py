@@ -17,7 +17,10 @@ if __name__ == "__main__":
     import ray_tpu
 
     node_port, client_port = int(sys.argv[1]), int(sys.argv[2])
-    ray_tpu.init(num_cpus=1, node_server_port=node_port,
+    # optional third arg: head-local CPUs (0 = pure control plane; every
+    # actor/replica schedules onto agents — the head-chaos bench topology)
+    num_cpus = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    ray_tpu.init(num_cpus=num_cpus, node_server_port=node_port,
                  client_server_port=client_port,
                  worker_env={"JAX_PLATFORMS": "cpu"})
     print("HEAD_READY", flush=True)
