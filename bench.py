@@ -292,20 +292,22 @@ def _run_child(target: str, n_devices: int, timeout: int = 1200) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
-    marker = {"mesh": "GRAD_SYNC_RESULT ", "hbm": "GRAD_SYNC_HBM "}[target]
-    fn = {"mesh": "_grad_sync_child", "hbm": "_grad_sync_hbm_child"}[target]
+    marker = {"mesh": "GRAD_SYNC_RESULT ", "hbm": "GRAD_SYNC_HBM ",
+              "pipeline": "PIPELINE_RESULT "}[target]
+    fn = {"mesh": "_grad_sync_child", "hbm": "_grad_sync_hbm_child",
+          "pipeline": "_pipeline_child"}[target]
     proc = subprocess.run(
         [sys.executable, "-c", f"import bench; bench.{fn}()"],
         cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
         capture_output=True, text=True, timeout=timeout)
     if proc.returncode != 0:
         log(proc.stderr[-4000:])
-        raise RuntimeError(f"grad-sync child {target} failed rc={proc.returncode}")
+        raise RuntimeError(f"bench child {target} failed rc={proc.returncode}")
     line = next((ln for ln in proc.stdout.splitlines()
                  if ln.startswith(marker)), None)
     if line is None:
         log(proc.stderr[-4000:])
-        raise RuntimeError(f"grad-sync child {target} printed no {marker!r}")
+        raise RuntimeError(f"bench child {target} printed no {marker!r}")
     return json.loads(line[len(marker):])
 
 
@@ -429,6 +431,173 @@ def run_grad_sync_bench() -> None:
     }))
 
 
+def _pipeline_child() -> None:
+    """Child body for --pipeline: pp=2 multichip dryrun (2 virtual CPU devices).
+
+    Three measurements on the same 2-stage residual-MLP model and microbatch
+    decomposition:
+      spmd_baseline       one jitted program — value_and_grad through
+                          `pipeline_spmd` on a pure-pp mesh, plus SGD
+      mpmd_1f1b           cross-process MPMD runner, 1F1B + prefetch overlap
+      mpmd_gpipe_noprefetch  unoverlapped control (all-fwd-then-all-bwd order,
+                          prefetch off) — the bubble-fraction gate's baseline
+    Tokens/s counts microbatch rows per optimizer step. Prints one
+    PIPELINE_RESULT line to stdout."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    d = int(os.environ.get("BENCH_PIPE_D", "512"))
+    mb = int(os.environ.get("BENCH_PIPE_MB", "16"))
+    m = int(os.environ.get("BENCH_PIPE_M", "4"))
+    steps = int(os.environ.get("BENCH_PIPE_STEPS", "5"))
+    pp, lr = 2, 1e-2
+    rows = m * mb
+    log(f"pipeline child: pp={pp} d={d} mb={mb} m={m} steps={steps}")
+
+    def stage_fn(p, x):
+        return x + jnp.tanh(x @ p["w"]) @ p["w2"]
+
+    def mb_loss(y):
+        return jnp.mean(y ** 2)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    stacked = {"w": jax.random.normal(k1, (pp, d, 2 * d)) * 0.1,
+               "w2": jax.random.normal(k2, (pp, 2 * d, d)) * 0.1}
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (rows, d)),
+                   np.float32)
+
+    # -- (a) single-program pipeline_spmd baseline ------------------------------
+    from jax.sharding import Mesh
+
+    from ray_tpu.parallel import use_mesh
+    from ray_tpu.parallel.pipeline import pipeline
+
+    mesh = Mesh(np.array(jax.devices()[:pp]), ("pp",))
+
+    def full_loss(params, xx):
+        with use_mesh(mesh):
+            y = pipeline(stage_fn, params, xx, num_microbatches=m, mesh=mesh)
+        y_mb = y.reshape(m, mb, d)
+        return jnp.mean(jnp.stack([mb_loss(y_mb[i]) for i in range(m)]))
+
+    @jax.jit
+    def spmd_step(params, xx):
+        loss, g = jax.value_and_grad(full_loss)(params, xx)
+        new = jax.tree_util.tree_map(
+            lambda pv, gv: pv - jnp.float32(lr) * gv, params, g)
+        return new, loss
+
+    params = stacked
+    params, loss = spmd_step(params, x)
+    log(f"spmd baseline compile+first step done loss={float(loss):.5f}")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, loss = spmd_step(params, x)
+    final = float(loss)  # fetch = sync point for the whole dependent chain
+    dt = (time.perf_counter() - t0) / steps
+    spmd_row = {"tokens_per_sec": round(rows / dt, 1),
+                "step_ms": round(dt * 1e3, 2), "loss": final}
+    log(f"spmd baseline: {spmd_row}")
+
+    # -- (b)/(c) cross-process MPMD runner --------------------------------------
+    import ray_tpu
+    from ray_tpu.train.mpmd_pipeline import MPMDPipeline, MPMDPipelineConfig
+
+    ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"})
+    stage_params = [jax.tree_util.tree_map(lambda p: np.asarray(p[s]), stacked)
+                    for s in range(pp)]
+
+    def measure(schedule: str, prefetch: int, group: str) -> dict:
+        cfg = MPMDPipelineConfig(num_microbatches=m, schedule=schedule,
+                                 prefetch=prefetch, learning_rate=lr,
+                                 group_name=group)
+        pipe = MPMDPipeline([stage_fn] * pp, stage_params, loss_fn=mb_loss,
+                            microbatch_spec=((mb, d), np.float32), cfg=cfg)
+        try:
+            pipe.step(0, x)           # compile step
+            pipe.reset_timelines()    # bubble gate wants steady state only
+            t0 = time.perf_counter()
+            out = {}
+            for i in range(steps):
+                out = pipe.step(i + 1, x)
+            dt = (time.perf_counter() - t0) / steps
+            fractions = pipe.bubble_fractions()
+            admission = pipe.admission()
+        finally:
+            pipe.shutdown()
+        row = {"schedule": schedule, "prefetch": prefetch,
+               "tokens_per_sec": round(rows / dt, 1),
+               "step_ms": round(dt * 1e3, 2), "loss": out.get("loss"),
+               "bubble_mean": round(fractions["mean"], 4),
+               "bubble_per_stage": {k: round(v, 4) for k, v in
+                                    fractions.items() if k != "mean"},
+               "admission": admission}
+        log(f"mpmd {schedule}/prefetch={prefetch}: {row}")
+        return row
+
+    try:
+        r_1f1b = measure("1f1b", 2, "mpmd_bench_1f1b")
+        r_gpipe = measure("gpipe", 0, "mpmd_bench_gpipe")
+    finally:
+        ray_tpu.shutdown()
+
+    print("PIPELINE_RESULT " + json.dumps({
+        "pp": pp, "d": d, "microbatch": mb, "num_microbatches": m,
+        "steps": steps, "rows_per_step": rows,
+        "spmd_baseline": spmd_row,
+        "mpmd_1f1b": r_1f1b,
+        "mpmd_gpipe_noprefetch": r_gpipe,
+    }))
+
+
+def run_pipeline_bench() -> None:
+    """--pipeline: MPMD cross-process pipeline vs the single-program
+    pipeline_spmd baseline, multichip-dryrun pp=2 row. Gates (non-zero exit on
+    failure): MPMD tokens/s >= the baseline's, and the overlapped schedule's
+    measured bubble fraction below the unoverlapped control's."""
+    log("pipeline bench: pp=2 multichip-dryrun child (2 virtual CPU devices)")
+    row = _run_child("pipeline", 2, timeout=1500)
+    checks = {
+        "mpmd_tokens_per_sec_ge_spmd_baseline":
+            row["mpmd_1f1b"]["tokens_per_sec"]
+            >= row["spmd_baseline"]["tokens_per_sec"],
+        "overlapped_bubble_below_unoverlapped":
+            row["mpmd_1f1b"]["bubble_mean"]
+            < row["mpmd_gpipe_noprefetch"]["bubble_mean"],
+        "no_leaked_activation_blocks": all(
+            c == {"published": 0, "inflight_pulls": 0}
+            for r in (row["mpmd_1f1b"], row["mpmd_gpipe_noprefetch"])
+            for c in r["admission"]),
+    }
+    result = {"rows": [dict(row, mesh="pp2_multichip_dryrun")],
+              "checks": checks}
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "PIPELINE_BENCH.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    for name, ok in checks.items():
+        log(f"pipeline check {name}: {'PASS' if ok else 'FAIL'}")
+    mpmd = row["mpmd_1f1b"]["tokens_per_sec"]
+    base = row["spmd_baseline"]["tokens_per_sec"]
+    print(json.dumps({
+        "metric": "mpmd_pipeline_tokens_per_sec_pp2",
+        "value": mpmd,
+        "unit": "tokens/s",
+        "vs_baseline": round(mpmd / max(base, 1e-9), 4),
+        "secondary": {
+            "spmd_baseline_tokens_per_sec": base,
+            "bubble_1f1b_prefetch": row["mpmd_1f1b"]["bubble_mean"],
+            "bubble_gpipe_noprefetch":
+                row["mpmd_gpipe_noprefetch"]["bubble_mean"],
+            "checks_passed": sum(checks.values()),
+            "checks_total": len(checks),
+        },
+    }))
+    if not all(checks.values()):
+        sys.exit(1)
+
+
 def _winning_grad_sync():
     """The winning --grad-sync config (TRAIN_SYNC_BENCH.json), as a
     GradSyncConfig for the trainer-path MFU row; None when the bench has not
@@ -531,5 +700,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--grad-sync" in sys.argv[1:]:
         run_grad_sync_bench()
+    elif "--pipeline" in sys.argv[1:]:
+        run_pipeline_bench()
     else:
         main()

@@ -184,3 +184,27 @@ def get_accelerator_context(device_type: str = "cpu") -> Communicator:
         raise ValueError(
             f"no communicator registered for {device_type!r} "
             f"(known: {sorted(_registry)})") from None
+
+
+def resolve_stage_transport(requested: str = "auto") -> str:
+    """Pick the inter-stage block transport for the MPMD pipeline runner
+    (train/mpmd_pipeline.py): "device" rides core/device_plane export/fetch
+    (the same plane DeviceChannel uses) when this process has it; "host" is
+    the striped data-plane byte path; "auto" probes and falls back — so a
+    CPU-only stage and a TPU stage resolve independently, and the publish
+    side degrades to host bytes per-block when an export is rejected."""
+    if requested not in ("auto", "host", "device"):
+        raise ValueError(f"unknown stage transport {requested!r} (auto|host|device)")
+    if requested == "host":
+        return "host"
+    try:
+        from ray_tpu.core import device_plane
+
+        available = bool(device_plane.plane().available)
+    # graftlint: allow[swallowed-exception] transport probe: an unimportable/failed device plane means host path, not an error
+    except Exception:
+        available = False
+    if requested == "device" and not available:
+        raise RuntimeError("transport='device' but the device plane is "
+                           "unavailable in this process")
+    return "device" if available else "host"

@@ -664,6 +664,27 @@ KNOBS: List[Knob] = [
     Knob("RAY_TPU_TRAIN_UPDATE_AXES", "str", "dp,fsdp",
          "Mesh axes the sharded optimizer update shards state over.",
          "train"),
+    # -- MPMD pipeline parallelism (train/mpmd_pipeline.py)
+    Knob("RAY_TPU_PIPELINE_MICROBATCHES", "int", 4,
+         "Microbatches per optimizer step in the MPMD pipeline runner "
+         "(power of two keeps the 1/M cotangent exact in f32).",
+         "train", attr="pipeline_microbatches"),
+    Knob("RAY_TPU_PIPELINE_SCHEDULE", "str", "1f1b",
+         "MPMD pipeline schedule: 1f1b (warmup/steady/cooldown, overlapped) "
+         "or gpipe (all-forwards-then-all-backwards baseline).",
+         "train", attr="pipeline_schedule"),
+    Knob("RAY_TPU_PIPELINE_PREFETCH", "int", 2,
+         "Microbatch blocks each stage pulls ahead of its schedule cursor "
+         "(0 = unoverlapped transfers).",
+         "train", attr="pipeline_prefetch"),
+    Knob("RAY_TPU_PIPELINE_STREAMS", "int", 1,
+         "Concurrent stripes per inter-stage block pull (ranged pull_into "
+         "fan-out; blocks under 64 KiB always ride one stream).",
+         "train", attr="pipeline_streams"),
+    Knob("RAY_TPU_PIPELINE_TRANSPORT", "str", "auto",
+         "Inter-stage activation transport: auto (device plane when this "
+         "process has it, else host), host, or device.",
+         "train", attr="pipeline_transport"),
     Knob("RAY_TPU_TRAIN_GRAD_SYNC_TELEMETRY", "bool", False,
          "Two-stage train step with per-bucket wait spans "
          "(train.step_phase telemetry).",

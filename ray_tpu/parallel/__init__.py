@@ -6,6 +6,10 @@ instead of bootstrapping NCCL communicators, we describe a `jax.sharding.Mesh` o
 let XLA insert collectives (psum/all_gather/reduce_scatter/ppermute) over ICI/DCN.
 """
 from .mesh import MeshSpec, build_mesh, local_mesh, use_mesh  # noqa: F401
+
+# `from ray_tpu.parallel import mpmd` — the cross-process MPMD pipeline facade —
+# is imported on demand, not here: it fronts ray_tpu.train, whose package init
+# imports this one.
 from .sharding import (  # noqa: F401
     AxisRules,
     LogicalAxis,

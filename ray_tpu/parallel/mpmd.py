@@ -1,0 +1,17 @@
+"""MPMD pipeline parallelism facade.
+
+`parallel/pipeline.py` is the IN-program combinator: one GSPMD-traced program,
+stages as mesh shards, activations hopping via lax.ppermute. This module
+fronts the CROSS-process runner (train/mpmd_pipeline.py): each stage is its
+own process compiling its own forward/backward/update programs, microbatch
+blocks streaming stage-to-stage over the collective data plane on a 1F1B
+schedule — for models and topologies one mesh cannot hold (arXiv 2412.14374).
+"""
+from ray_tpu.train.mpmd_pipeline import (  # noqa: F401
+    MPMDPipeline,
+    MPMDPipelineConfig,
+    StageRunner,
+    build_schedule,
+    bubble_fraction,
+    stage_runner_from_train_context,
+)

@@ -170,12 +170,14 @@ def pipeline(
             return out
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    mapped = jax.shard_map(
+    from .sharding import compat_shard_map
+
+    mapped = compat_shard_map(
         inner,
-        mesh=mesh,
-        in_specs=(param_specs, mb_spec, side_specs),
-        out_specs=(mb_spec, P()) if with_aux else mb_spec,
-        axis_names=manual,
+        mesh,
+        (param_specs, mb_spec, side_specs),
+        (mb_spec, P()) if with_aux else mb_spec,
+        manual,
     )
     if with_aux:
         y_mb, aux = mapped(stacked_params, x_mb, side_mb)

@@ -216,4 +216,31 @@ def vary_like(z, ref=None, *, extra: Sequence[str] = ()):
         return z
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(z, need, to="varying")
-    return jax.lax.pvary(z, need)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(z, need)
+    return z  # pre-vma jax: shard_map has no varying-axes type system to satisfy
+
+
+def compat_shard_map(f, mesh: Mesh, in_specs, out_specs, manual: Sequence[str]):
+    """shard_map with the given axes manual and the rest in GSPMD auto mode,
+    across jax versions (jax.shard_map axis_names= vs experimental auto=).
+    One shared implementation for grad_sync's bucketed region, the in-program
+    pipeline combinator, and the MPMD stage runner's stage_dp sharding."""
+    manual = frozenset(manual)
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=set(manual))
+    from jax.experimental.shard_map import shard_map as _sm
+
+    auto = frozenset(mesh.axis_names) - manual
+    bad = [a for a in sorted(auto) if mesh.shape[a] > 1]
+    if bad:
+        # jaxlib<=0.4.x partial-auto shard_map hard-crashes XLA
+        # (IsManualSubgroup check) when a non-trivial auto axis crosses the
+        # region — refuse with a python error instead.
+        raise NotImplementedError(
+            f"shard_map over manual axes {sorted(manual)} with non-trivial "
+            f"auto axes {bad} needs jax.shard_map (jax>=0.5); this jax only "
+            "supports fully-manual meshes here")
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               auto=auto, check_rep=False)

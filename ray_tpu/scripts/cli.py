@@ -195,6 +195,10 @@ def _render_status(s: dict) -> str:
         phases = " ".join(f"{k}:{v * 1e3:.1f}ms"
                           for k, v in sorted(tn.get("step_phases_s", {}).items()))
         lines.append(f"train      mfu[{mfu or '-'}] step_phases[{phases or '-'}]")
+    bubbles = tn.get("pipeline_bubble_fraction") or {}
+    if bubbles:
+        frac = " ".join(f"{k}:{v:.2f}" for k, v in sorted(bubbles.items()))
+        lines.append(f"train      pipeline_bubble[{frac}]")
     return "\n".join(lines)
 
 

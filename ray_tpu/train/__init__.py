@@ -55,6 +55,13 @@ from .session import (  # noqa: F401
 from .step import TrainState, init_state, make_optimizer, make_train_step  # noqa: F401
 from . import grad_sync  # noqa: F401
 from .grad_sync import GradSyncConfig  # noqa: F401
+from . import mpmd_pipeline  # noqa: F401
+from .mpmd_pipeline import (  # noqa: F401  (cross-process MPMD pipeline runner)
+    MPMDPipeline,
+    MPMDPipelineConfig,
+    StageRunner,
+    stage_runner_from_train_context,
+)
 from .v2 import (  # noqa: F401  (Train v2: controller + policies, SURVEY §2.4)
     DefaultFailurePolicy,
     ElasticScalingPolicy,

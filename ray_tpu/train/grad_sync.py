@@ -237,25 +237,10 @@ def _mesh_of(tree: Any) -> Optional[Mesh]:
 
 def _shard_map(f, mesh: Mesh, in_specs, out_specs, manual: Sequence[str]):
     """shard_map with the given axes manual and the rest in GSPMD auto mode,
-    across jax versions (jax.shard_map axis_names= vs experimental auto=)."""
-    manual = frozenset(manual)
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, axis_names=set(manual))
-    from jax.experimental.shard_map import shard_map as _sm
+    across jax versions — shared impl in parallel/sharding.compat_shard_map."""
+    from ray_tpu.parallel.sharding import compat_shard_map
 
-    auto = frozenset(mesh.axis_names) - manual
-    bad = [a for a in sorted(auto) if mesh.shape[a] > 1]
-    if bad:
-        # jaxlib<=0.4.x partial-auto shard_map hard-crashes XLA
-        # (IsManualSubgroup check) when a non-trivial auto axis crosses the
-        # region — refuse with a python error instead.
-        raise NotImplementedError(
-            f"bucketed grad sync over manual axes {sorted(manual)} with "
-            f"non-trivial auto axes {bad} needs jax.shard_map (jax>=0.5); "
-            "this jax only supports it on pure-dp meshes")
-    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-               auto=auto, check_rep=False)
+    return compat_shard_map(f, mesh, in_specs, out_specs, manual)
 
 
 # ----------------------------------------------------- in-jit sync kernels

@@ -509,6 +509,13 @@ def cluster_status() -> Dict[str, Any]:
                                      {}).get("values", {}).items()
             if v["count"]
         },
+        # MPMD pipeline idle fraction per stage (+ mean), published from the
+        # merged train.pipeline_stage span timeline (train/mpmd_pipeline.py)
+        "pipeline_bubble_fraction": {
+            dict(key).get("stage", "?"): round(v, 4)
+            for key, v in merged.get("train_pipeline_bubble_fraction",
+                                     {}).get("values", {}).items()
+        },
     }
     return status
 

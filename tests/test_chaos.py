@@ -316,3 +316,139 @@ def test_unretryable_task_fails_cleanly(rt):
                        message="never saw the stuck task running")
     with pytest.raises(Exception):
         rt.get(ref, timeout=60)
+
+
+def _mpmd_pipeline_train_loop(config):
+    """pp-stage MPMD pipeline train_fn: rank == stage, blocks ride the
+    backend-created Train collective group (stage_runner_from_train_context),
+    so a stage death flows through Train's failure policy unchanged."""
+    import json
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu.train as train
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.train.mpmd_pipeline import (MPMDPipelineConfig,
+                                             stage_runner_from_train_context)
+    from ray_tpu.util.collective import CollectiveAbortError
+
+    ctx = train.get_context()
+    stage, pp = ctx.get_world_rank(), ctx.get_world_size()
+    d, mb = int(config["d"]), int(config["mb"])
+    m = int(config["microbatches"])
+
+    def stage_fn(params, x):
+        return x + jnp.tanh(x @ params["w"]) @ params["w2"]
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(100 + stage))
+    params = {"w": np.asarray(jax.random.normal(k1, (d, 2 * d)) * 0.1),
+              "w2": np.asarray(jax.random.normal(k2, (2 * d, d)) * 0.1)}
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as cd:
+            state = json.load(open(os.path.join(cd, "state.json")))
+            start = state["step"] + 1
+            if stage == 0:  # rank 0's params ride the durable checkpoint
+                params = {k: np.asarray(v, np.float32)
+                          for k, v in state["params"].items()}
+    runner = stage_runner_from_train_context(
+        stage_fn, params,
+        MPMDPipelineConfig(num_microbatches=m,
+                           group_name=os.environ["RAY_TPU_TRAIN_COLLECTIVE_GROUP"]),
+        loss_fn=(lambda y: jnp.mean(y ** 2)) if stage == pp - 1 else None,
+        in_spec=((mb, d), np.float32), out_spec=((mb, d), np.float32))
+    try:
+        for step in range(start, config["steps"]):
+            batch = None
+            if stage == 0:
+                batch = np.random.default_rng(step).standard_normal(
+                    (m * mb, d)).astype(np.float32)
+            try:
+                metrics = runner.run_step(step, batch)
+            except CollectiveAbortError:
+                # survivors observe the typed abort (not a bare timeout) and
+                # leak nothing: run_step's cleanup retracted in-flight blocks
+                with open(os.path.join(config["marker_dir"],
+                                       f"abort_rank{stage}"), "w") as f:
+                    json.dump(runner.comm.admission_counters(), f)
+                raise
+            checkpoint = None
+            if stage == 0:
+                cd = tempfile.mkdtemp(prefix="mpmd_ckpt_")
+                json.dump(
+                    {"step": step,
+                     "params": {k: np.asarray(v).tolist()
+                                for k, v in runner.params_host().items()}},
+                    open(os.path.join(cd, "state.json"), "w"))
+                checkpoint = Checkpoint.from_directory(cd)
+            train.report({"step": step, "start": start,
+                          "loss": metrics.get("loss")}, checkpoint=checkpoint)
+            time.sleep(config["step_s"])
+    finally:
+        runner.close()
+
+
+def test_mpmd_pipeline_survives_stage_kill(rt, tmp_path):
+    """Acceptance (ISSUE 19): SIGKILL the MIDDLE stage of a pp=3 MPMD pipeline
+    mid-schedule. Survivors must raise the typed CollectiveAbortError within
+    the abort-poll bound (their markers appear), admission counters must read
+    zero after cleanup (no leaked in-flight activation blocks), and Train's
+    max_failures=1 restart must complete the run from the latest checkpoint."""
+    import json
+    import threading
+
+    from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                    ScalingConfig)
+    from ray_tpu.train import JaxConfig, TrainController
+    from ray_tpu.train.checkpoint_manager import CheckpointManager
+
+    group = "chaos_mpmd"
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    mgr = CheckpointManager(str(tmp_path / "run"), CheckpointConfig())
+    ctl = TrainController(
+        _mpmd_pipeline_train_loop,
+        backend_config=JaxConfig(collective_group=True,
+                                 collective_group_name=group),
+        scaling_config=ScalingConfig(num_workers=3, cpus_per_worker=0.5),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+        checkpoint_manager=mgr,
+        train_loop_config={"steps": 8, "step_s": 0.2, "d": 4, "mb": 2,
+                           "microbatches": 2, "marker_dir": str(marker_dir)},
+    )
+    done = {}
+
+    def run():
+        done["result"] = ctl.run()
+
+    t = threading.Thread(target=run, daemon=True, name="mpmd-chaos-driver")
+    t.start()
+    chaos = ChaosController()
+    # kill the middle stage only once a checkpoint is durable, so "resume from
+    # latest checkpoint" is the path under test
+    wait_for_condition(
+        lambda: (chaos.collective_rank_registered(group, rank=1)
+                 and mgr.latest_checkpoint is not None),
+        timeout=60, message="no checkpoint before injection window closed")
+    assert chaos.kill_collective_rank(group, rank=1)
+    t.join(timeout=180)
+    assert not t.is_alive(), "controller hung after stage death"
+    result = done["result"]
+    assert result.error is None, result.error
+    assert ctl.failure_count == 1
+    assert result.metrics["step"] == 7  # ran to completion
+    # the second attempt resumed from a checkpoint, not from scratch
+    assert any(m.get("start", 0) > 0 for m in result.metrics_dataframe)
+    # at least one surviving stage observed the typed abort; its admission
+    # counters (published blocks + in-flight pulls) read zero after cleanup
+    markers = [marker_dir / f"abort_rank{r}" for r in (0, 2)]
+    seen = [p for p in markers if p.exists()]
+    assert seen, "no survivor observed the typed CollectiveAbortError"
+    for p in seen:
+        assert json.load(open(p)) == {"published": 0, "inflight_pulls": 0}

@@ -151,24 +151,59 @@ def test_actor_usable_after_teardown(rt, actors):
         raise AssertionError("actor still blocked after teardown")
 
 
+def test_dag_repeated_dispatch_correct(rt, actors):
+    """Correctness half of the old throughput row (tier-1): repeated compiled
+    dispatch returns the right answers — no wall-clock assertion, so CI load
+    cannot flake it."""
+    a, _ = actors
+    with InputNode() as inp:
+        y = a.add.bind(inp)
+    dag = y.experimental_compile()
+    try:
+        for i in range(50):
+            assert dag.execute(i).get() == i + 1
+    finally:
+        dag.teardown()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif((os.cpu_count() or 1) < 2, reason=(
+    "compiled dispatch beats per-call submission only when driver and actor "
+    "can run concurrently; on one core the dag path's shm spin-wait handoff "
+    "is scheduler-bound while the task path blocks in the selector"))
 def test_dag_throughput_beats_task_path(rt, actors):
-    """The compiled path must beat per-call task submission on repeated dispatch."""
+    """Timing half (slow marker — load-flaky under a saturated box since PR 8):
+    paired relative measurement, best-of-3 per path, compiled dispatch must
+    beat per-call task submission."""
     a, _ = actors
     n = 50
-    t0 = time.perf_counter()
-    for i in range(n):
-        rt.get(a.add.remote(i))
-    task_path = time.perf_counter() - t0
+
+    def task_path_once():
+        t0 = time.perf_counter()
+        for i in range(n):
+            rt.get(a.add.remote(i))
+        return time.perf_counter() - t0
+
+    # task path FIRST: while a compiled dag is active the actor's exec loop
+    # owns the actor, and normal method calls block until teardown
+    rt.get(a.add.remote(0))  # warm
+    # best-of-3 per path: each side keeps its least-loaded run, so a
+    # background spike must hit all three of one side to flip the verdict
+    task_path = min(task_path_once() for _ in range(3))
 
     with InputNode() as inp:
         y = a.add.bind(inp)
     dag = y.experimental_compile()
     try:
         dag.execute(0).get()  # warm
-        t0 = time.perf_counter()
-        for i in range(n):
-            dag.execute(i).get()
-        dag_path = time.perf_counter() - t0
+
+        def dag_path_once():
+            t0 = time.perf_counter()
+            for i in range(n):
+                dag.execute(i).get()
+            return time.perf_counter() - t0
+
+        dag_path = min(dag_path_once() for _ in range(3))
     finally:
         dag.teardown()
     assert dag_path < task_path, (dag_path, task_path)
