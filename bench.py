@@ -293,9 +293,9 @@ def _run_child(target: str, n_devices: int, timeout: int = 1200) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
     marker = {"mesh": "GRAD_SYNC_RESULT ", "hbm": "GRAD_SYNC_HBM ",
-              "pipeline": "PIPELINE_RESULT "}[target]
+              "pipeline": "PIPELINE_RESULT ", "rl": "RL_RESULT "}[target]
     fn = {"mesh": "_grad_sync_child", "hbm": "_grad_sync_hbm_child",
-          "pipeline": "_pipeline_child"}[target]
+          "pipeline": "_pipeline_child", "rl": "_rl_child"}[target]
     proc = subprocess.run(
         [sys.executable, "-c", f"import bench; bench.{fn}()"],
         cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
@@ -598,6 +598,275 @@ def run_pipeline_bench() -> None:
         sys.exit(1)
 
 
+# ----------------------------------------------------------- decoupled RL
+
+# One geometry for every --rl row (a lean policy head keeps the bench
+# transport-bound — the regime the rollout plane optimizes; both serialized
+# rows and the decoupled row train the exact same model and SGD schedule).
+_RL_TRAIN = dict(lr=3e-4, gamma=0.99, lambda_=0.95, clip_param=0.3,
+                 entropy_coeff=0.01, train_batch_size=512,
+                 minibatch_size=128, num_epochs=2)
+_RL_MODEL = {"fcnet_hiddens": [8]}
+
+
+def _rl_ppo_config(env):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    return (PPOConfig().environment(env)
+            .training(**_RL_TRAIN)
+            .rl_module(model_config=dict(_RL_MODEL))
+            .debugging(seed=0))
+
+
+def _rl_serialized(host_slicing: bool, iters: int) -> dict:
+    """One serialized PPO cycle: classic sample -> pickle episodes -> GAE ->
+    update loop. host_slicing=True is the seed baseline (host re-slice +
+    re-upload per minibatch); False is the device-resident gather path
+    (`serialized_opt` row)."""
+    import ray_tpu
+    from bench_rllib import SyntheticAtariEnv
+
+    if host_slicing:
+        os.environ["RAY_TPU_RL_HOST_SLICING"] = "1"
+    else:
+        os.environ.pop("RAY_TPU_RL_HOST_SLICING", None)
+    ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"})
+    try:
+        cfg = (_rl_ppo_config(SyntheticAtariEnv)
+               .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                            rollout_fragment_length=64))
+        algo = cfg.build_algo()
+        try:
+            algo.train()  # warmup: compiles sampler + learner
+            t0 = time.perf_counter()
+            rets = []
+            for _ in range(iters):
+                r = algo.train()
+                rets.append(r.get("episode_return_mean") or 0.0)
+            dt = time.perf_counter() - t0
+            batch = _RL_TRAIN["train_batch_size"]
+            mb_per_iter = _RL_TRAIN["num_epochs"] * (
+                batch // _RL_TRAIN["minibatch_size"])
+            return {
+                "env_steps_per_s": round(iters * batch / dt, 1),
+                "updates_per_s": round(iters * mb_per_iter / dt, 1),
+                "episode_return": round(sum(rets[-2:]) / 2, 2),
+            }
+        finally:
+            algo.cleanup()
+    finally:
+        ray_tpu.shutdown()
+
+
+def _rl_decoupled(iters: int) -> dict:
+    """Decoupled cycle: 2 vectorized rollout workers (48 envs each) stream
+    trajectory blocks over the data plane into the device-resident learner;
+    weights broadcast back every 3 updates. Rates are measured at drained
+    steady state (post-warmup backlog consumed before the clock starts)."""
+    import ray_tpu
+    from bench_rllib import SyntheticAtariEnv
+
+    os.environ.pop("RAY_TPU_RL_HOST_SLICING", None)
+    ray_tpu.init(num_cpus=4, worker_env={"JAX_PLATFORMS": "cpu"})
+    try:
+        B = 48
+        cfg = (_rl_ppo_config(SyntheticAtariEnv)
+               .env_runners(num_env_runners=2, num_envs_per_env_runner=B,
+                            rollout_fragment_length=64)
+               .decoupled_rollout(enabled=True, blocks_per_update=1,
+                                  queue_depth=8, max_block_lag=4,
+                                  weight_sync_interval=3))
+        algo = cfg.build_algo()
+        try:
+            algo.train()  # warmup: compiles both sides
+            for _ in range(3):  # drain the block backlog built during compile
+                algo.train()
+            sampled = lambda: sum(  # noqa: E731
+                m.get("num_env_steps_sampled") or 0
+                for m in algo.rollout_plane.worker_metrics())
+            base = sampled()
+            t0 = time.perf_counter()
+            n_upd = 0
+            for _ in range(iters):
+                if algo.train().get("num_env_steps_trained"):
+                    n_upd += 1
+            dt = time.perf_counter() - t0
+            steps = sampled() - base
+            mb_per_round = _RL_TRAIN["num_epochs"] * (
+                (64 * B) // _RL_TRAIN["minibatch_size"])
+            rets = [m["episode_return_mean"]
+                    for m in algo.rollout_plane.worker_metrics()
+                    if m.get("episode_return_mean") is not None]
+            out = {
+                "env_steps_per_s": round(steps / dt, 1),
+                "updates_per_s": round(n_upd * mb_per_round / dt, 1),
+                "episode_return": round(sum(rets) / max(len(rets), 1), 2),
+                "update_rounds": n_upd,
+            }
+        finally:
+            algo.cleanup()
+        out["plane"] = algo.final_plane_stats
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
+def _rl_dry() -> dict:
+    """--dry-run body: tiny CartPole serialized + decoupled cycles. Proves
+    the full path (block transport, staleness filter, weight broadcast,
+    release accounting) end-to-end in seconds; rate/return gates are
+    meaningless at this size and are skipped by the parent."""
+    import ray_tpu
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    def tiny(decoupled):
+        ray_tpu.init(num_cpus=3, worker_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            cfg = (PPOConfig().environment("CartPole-v1")
+                   .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                                rollout_fragment_length=32)
+                   .training(lr=3e-4, train_batch_size=64, minibatch_size=32,
+                             num_epochs=1, gamma=0.99, lambda_=0.95)
+                   .rl_module(model_config={"fcnet_hiddens": [16]})
+                   .debugging(seed=0))
+            if decoupled:
+                cfg = cfg.decoupled_rollout(
+                    enabled=True, blocks_per_update=1, queue_depth=4,
+                    max_block_lag=4, weight_sync_interval=1)
+            algo = cfg.build_algo()
+            n_upd = 0
+            try:
+                for _ in range(3):
+                    if algo.train().get(
+                            "num_env_steps_trained" if decoupled
+                            else "num_env_steps_sampled"):
+                        n_upd += 1
+            finally:
+                algo.cleanup()
+            out = {"update_rounds": n_upd}
+            if decoupled:
+                out["plane"] = algo.final_plane_stats
+            return out
+        finally:
+            ray_tpu.shutdown()
+
+    ser = tiny(decoupled=False)
+    dec = tiny(decoupled=True)
+    return {"dry_run": True,
+            "serialized": dict(ser, env_steps_per_s=0.0, updates_per_s=0.0,
+                               episode_return=0.0),
+            "serialized_opt": None,
+            "decoupled": dict(dec, env_steps_per_s=0.0, updates_per_s=0.0,
+                              episode_return=0.0)}
+
+
+def _rl_child() -> None:
+    """Child body for --rl: three init/shutdown cycles on one process
+    (serialized baseline, serialized_opt, decoupled) so every row sees an
+    identical platform."""
+    if os.environ.get("BENCH_RL_DRY") == "1":
+        print("RL_RESULT " + json.dumps(_rl_dry()), flush=True)
+        return
+    row = {
+        "dry_run": False,
+        "serialized": _rl_serialized(host_slicing=True, iters=4),
+        "serialized_opt": _rl_serialized(host_slicing=False, iters=4),
+        "decoupled": _rl_decoupled(iters=10),
+    }
+    print("RL_RESULT " + json.dumps(row), flush=True)
+
+
+def run_rl_bench() -> None:
+    """--rl: decoupled actor-learner PPO vs the serialized baseline on the
+    synthetic-Atari transport workload. Gates (non-zero exit on failure):
+    decoupled env-steps/s AND learner-updates/s >= 3x the serialized
+    baseline at matched final return, trained-block staleness p99 within
+    the configured bound, and zero leaked block admissions after clean
+    shutdown. --dry-run swaps in a tiny CartPole config and keeps only the
+    structural gates (leaks, staleness, liveness)."""
+    dry = "--dry-run" in sys.argv[1:]
+    if dry:
+        os.environ["BENCH_RL_DRY"] = "1"
+    log("rl bench: decoupled rollout/learn plane vs serialized PPO"
+        + (" [dry-run]" if dry else ""))
+    row = _run_child("rl", 1, timeout=2400)
+    ser, dec = row["serialized"], row["decoupled"]
+    plane = dec["plane"]
+    checks = {
+        "learner_made_progress": dec.get("update_rounds", 0) > 0,
+        "block_lag_p99_within_bound":
+            (plane.get("lag_p99_taken") or 0) <= plane["max_lag"],
+        "zero_leaked_block_admissions":
+            plane["outstanding"] == 0 and plane["unreleased"] == 0
+            and plane.get("worker_outstanding", 0) == 0,
+    }
+    if not dry:
+        checks["env_steps_ge_3x_serialized"] = (
+            dec["env_steps_per_s"] >= 3.0 * ser["env_steps_per_s"])
+        checks["learner_updates_ge_3x_serialized"] = (
+            dec["updates_per_s"] >= 3.0 * ser["updates_per_s"])
+        # decoupled trains on ~3x the data in the window; "matched" means
+        # it must never come out BELOW the serialized run's return
+        checks["matched_final_return"] = (
+            dec["episode_return"] >= ser["episode_return"] - 1.0)
+        _rl_rewrite_bench_json(row)
+    for name, ok in checks.items():
+        log(f"rl check {name}: {'PASS' if ok else 'FAIL'}")
+    print(json.dumps({
+        "metric": "rl_decoupled_env_steps_per_s_atari_synth",
+        "value": dec["env_steps_per_s"],
+        "unit": "env_steps/s",
+        "vs_baseline": round(
+            dec["env_steps_per_s"] / max(ser["env_steps_per_s"], 1e-9), 4)
+            if not dry else 0.0,
+        "secondary": {
+            "decoupled_updates_per_s": dec["updates_per_s"],
+            "serialized_env_steps_per_s": ser["env_steps_per_s"],
+            "serialized_updates_per_s": ser["updates_per_s"],
+            "updates_vs_baseline": round(
+                dec["updates_per_s"] / max(ser["updates_per_s"], 1e-9), 4)
+                if not dry else 0.0,
+            "block_lag_p99_taken": plane.get("lag_p99_taken"),
+            "checks_passed": sum(checks.values()),
+            "checks_total": len(checks),
+        },
+    }))
+    if not all(checks.values()):
+        sys.exit(1)
+
+
+def _rl_rewrite_bench_json(row: dict) -> None:
+    """Rewrite RL_BENCH.json in place: refresh the atari-synth PPO rows,
+    preserve every other row (data pipeline, shuffle, cartpole, tpu_learner,
+    notes) verbatim."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "RL_BENCH.json")
+    try:
+        with open(path) as f:
+            out = json.load(f)
+    except (OSError, ValueError):
+        out = {}
+    out = {k: v for k, v in out.items()
+           if not k.startswith("ppo_atari_synth")
+           and not k.startswith("rl_decoupled")}
+    for name, r in (("ppo_atari_synth_serialized", row["serialized"]),
+                    ("ppo_atari_synth_serialized_opt", row["serialized_opt"]),
+                    ("ppo_atari_synth_decoupled", row["decoupled"])):
+        out[f"{name}_env_steps_per_s"] = r["env_steps_per_s"]
+        out[f"{name}_updates_per_s"] = r["updates_per_s"]
+        out[f"{name}_episode_return"] = r["episode_return"]
+    out["rl_decoupled_plane_stats"] = row["decoupled"]["plane"]
+    out["rl_decoupled_note"] = (
+        "atari-synth rows share one geometry: fcnet [8], batch 512, "
+        "minibatch 128, 2 epochs. serialized = seed host-slicing loop "
+        "(2x4 envs); serialized_opt = device-resident gather SGD; "
+        "decoupled = 2x48-env vectorized rollout plane streaming blocks "
+        "over the zero-copy data plane, weights broadcast every 3 updates.")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    log(f"wrote {path}")
+
+
 def _winning_grad_sync():
     """The winning --grad-sync config (TRAIN_SYNC_BENCH.json), as a
     GradSyncConfig for the trainer-path MFU row; None when the bench has not
@@ -702,5 +971,7 @@ if __name__ == "__main__":
         run_grad_sync_bench()
     elif "--pipeline" in sys.argv[1:]:
         run_pipeline_bench()
+    elif "--rl" in sys.argv[1:]:
+        run_rl_bench()
     else:
         main()

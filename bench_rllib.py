@@ -59,6 +59,51 @@ class SyntheticAtariEnv:
     def close(self):
         pass
 
+    @classmethod
+    def make_vec(cls, num_envs, config=None):
+        return SyntheticAtariVectorEnv(num_envs, config)
+
+
+class SyntheticAtariVectorEnv:
+    """Natively-vectorized SyntheticAtariEnv: one numpy-batched step for all
+    envs instead of gymnasium SyncVectorEnv's per-env Python loop. Semantics
+    match SyncVectorEnv over SyntheticAtariEnv exactly, including gymnasium
+    1.x next-step autoreset (a done env's next step ignores the action and
+    returns the new episode's first obs with zero reward)."""
+
+    def __init__(self, num_envs, config=None):
+        import gymnasium as gym
+
+        config = config or {}
+        self.num_envs = int(num_envs)
+        self.single_observation_space = gym.spaces.Box(0, 255, (84, 84, 4), np.uint8)
+        self.single_action_space = gym.spaces.Discrete(6)
+        self.ep_len = int(config.get("ep_len", 200))
+        self._bank = np.random.default_rng(0).integers(
+            0, 255, size=(16, 84, 84, 4), dtype=np.uint8)
+        self._t = np.zeros(self.num_envs, dtype=np.int64)
+        self._needs_reset = np.zeros(self.num_envs, dtype=bool)
+
+    def reset(self, *, seed=None, options=None):
+        self._t[:] = 0
+        self._needs_reset[:] = False
+        return np.broadcast_to(
+            self._bank[0], (self.num_envs,) + self._bank.shape[1:]).copy(), {}
+
+    def step(self, actions):
+        actions = np.asarray(actions)
+        resetting = self._needs_reset
+        self._t = np.where(resetting, 0, self._t + 1)
+        obs = self._bank[self._t % len(self._bank)]
+        rewards = np.where(resetting, 0.0, (actions == 1).astype(np.float64))
+        term = np.where(resetting, False, self._t >= self.ep_len)
+        trunc = np.zeros(self.num_envs, dtype=bool)
+        self._needs_reset = term.copy()
+        return obs, rewards, term, trunc, {}
+
+    def close(self):
+        pass
+
 
 def bench_ppo(env, name, *, train_batch, minibatch, epochs, iters, model_config=None):
     from ray_tpu.rllib.algorithms.ppo import PPOConfig

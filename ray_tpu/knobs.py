@@ -702,6 +702,41 @@ KNOBS: List[Knob] = [
          "Collective group name a Train worker joins for host-plane sync "
          "(set by the backend).",
          "train", internal=True),
+    # -- rl (decoupled rollout/learn plane: rllib/rollout_plane.py)
+    Knob("RAY_TPU_RL_QUEUE_DEPTH", "int", 8,
+         "Bounded trajectory-block queue depth; when full the OLDEST "
+         "announced block is evicted (freshest-data-wins).",
+         "rl"),
+    Knob("RAY_TPU_RL_MAX_BLOCK_LAG", "int", 4,
+         "Max policy-version lag a block may have at take time; staler "
+         "blocks are dropped (counted `expired`) instead of trained on.",
+         "rl"),
+    Knob("RAY_TPU_RL_CORRECTION", "str", "is_clip",
+         "Off-policy correction for stale blocks: 'is_clip' (PPO ratio "
+         "clipping over behaviour-policy GAE) or 'vtrace' (IMPALA-style "
+         "current-policy V-trace targets).",
+         "rl"),
+    Knob("RAY_TPU_RL_WEIGHT_SYNC_INTERVAL", "int", 1,
+         "Learner updates between weight broadcasts back over the "
+         "zero-copy plane (workers adopt at block boundaries).",
+         "rl"),
+    Knob("RAY_TPU_RL_BLOCKS_PER_UPDATE", "int", 1,
+         "Trajectory blocks consumed per learner update (rounded up to a "
+         "multiple of num_learners).",
+         "rl"),
+    Knob("RAY_TPU_RL_TAKE_TIMEOUT_S", "float", 30.0,
+         "How long one training step polls the block queue before "
+         "returning empty-handed (learner-paced; never blocks workers).",
+         "rl"),
+    Knob("RAY_TPU_RL_PRODUCER_SLACK", "int", 2,
+         "Queue depth beyond which rollout workers pace themselves instead "
+         "of sampling blocks destined for eviction (<= 0: free-run).",
+         "rl"),
+    Knob("RAY_TPU_RL_HOST_SLICING", "bool", False,
+         "Force the legacy host-side minibatch slicing path in "
+         "Learner.update (one H2D copy per minibatch) — bench/debug only; "
+         "default is device-resident gather.",
+         "rl"),
     # -- storage / test hooks
     Knob("RAY_TPU_MOCK_FS_ROOT", "str", None,
          "Backing directory for the mock:// checkpoint filesystem "

@@ -44,10 +44,24 @@ class Algorithm(tune.Trainable):
         record_library_usage("rllib")
         cfg = self._algo_config
         self.metrics = MetricsLogger()
+        self.rollout_plane = None
+        self._policy_version = 0
+        self._updates_total = 0
+        self._updates_since_sync = 0
+        self._ckpt_interval = 10
+        self._learner_failures = 0
+        self._last_failure: Optional[BaseException] = None
+        self._last_ckpt = None
         if cfg.env is not None:
-            # subclasses with custom rollout actors (e.g. DreamerV3's recurrent
-            # runner) override env_runner_cls instead of rebuilding the group
-            self.env_runner_group = EnvRunnerGroup(cfg, runner_cls=self.env_runner_cls)
+            if getattr(cfg, "decoupled", False):
+                # decoupled mode replaces the RPC-sampling group with the
+                # rollout plane (built after the learner group below)
+                self.env_runner_group = None
+            else:
+                # subclasses with custom rollout actors (e.g. DreamerV3's
+                # recurrent runner) override env_runner_cls instead of
+                # rebuilding the group
+                self.env_runner_group = EnvRunnerGroup(cfg, runner_cls=self.env_runner_cls)
             probe = cfg.env_maker()()
             obs_space, act_space = probe.observation_space, probe.action_space
             probe.close()
@@ -70,12 +84,99 @@ class Algorithm(tune.Trainable):
         self._module = self.module_spec.build()
         if self.env_runner_group is not None:
             self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        if getattr(cfg, "decoupled", False) and cfg.env is not None:
+            import os
+
+            from ..rollout_plane import RolloutPlane
+
+            # workers derive version-0 params from the same seeded module
+            # init as the learners, so no initial broadcast is needed
+            self._plane_authkey = os.urandom(16)
+            self.learner_group.setup_decoupled(self._plane_authkey)
+            self.rollout_plane = RolloutPlane(cfg, authkey=self._plane_authkey)
+            self._last_ckpt = self.learner_group.get_state()
 
     def step(self) -> Dict[str, Any]:
         return self.training_step()
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
+
+    # -- decoupled rollout/learn plane -----------------------------------------
+    def _decoupled_training_step(self) -> Dict[str, Any]:
+        """One learner-paced step against the rollout plane: take a batch of
+        trajectory-block handles (staleness-filtered by the queue), update the
+        learner group, release the blocks, broadcast fresh weights. Learner
+        death restarts the group from the last checkpoint (max_failures)."""
+        from ray_tpu.core.exceptions import (ActorError, CollectiveAbortError,
+                                             WorkerCrashedError)
+
+        cfg = self._algo_config
+        n = max(1, cfg.num_learners)
+        want = max(1, cfg.blocks_per_update)
+        want += (-want) % n  # each learner must see the same block count
+        handles = self.rollout_plane.take(
+            want, self._policy_version, timeout_s=cfg.take_timeout_s)
+        if n > 1 and len(handles) % n:
+            extra = handles[-(len(handles) % n):]
+            handles = handles[: len(handles) - len(extra)]
+            self.rollout_plane.release(extra)
+        if not handles:
+            return self.metrics.reduce()
+        try:
+            results = self.learner_group.update_from_blocks(handles)
+        except (CollectiveAbortError, ActorError, WorkerCrashedError,
+                ConnectionError) as e:
+            self.rollout_plane.release(handles)
+            self._restore_learners(e)
+            return self.metrics.reduce()
+        self.rollout_plane.release(handles)
+        self._updates_total += 1
+        self._updates_since_sync += 1
+        if self._updates_since_sync >= max(1, cfg.weight_sync_interval):
+            version, addr, nbytes = self.learner_group.publish_weights()
+            self._policy_version = version
+            self.rollout_plane.set_weights(version, addr, nbytes)
+            self._updates_since_sync = 0
+        if self._updates_total % self._ckpt_interval == 0:
+            self._last_ckpt = self.learner_group.get_state()
+        for lm in results:
+            self.metrics.log_dict(lm)
+        if self._updates_total % 5 == 0:
+            for m in self.rollout_plane.worker_metrics():
+                self.metrics.log_dict(
+                    {k: v for k, v in m.items() if v is not None}, window=20)
+        result = self.metrics.reduce()
+        result["num_env_steps_trained"] = int(
+            sum(h.env_steps for h in handles))
+        result["policy_version"] = self._policy_version
+        result["learner_failures"] = self._learner_failures
+        return result
+
+    def _restore_learners(self, exc: BaseException) -> None:
+        """Learner-rank death: tear the group down and restart it from the
+        last checkpoint, re-attaching it to the rollout plane with version
+        continuity so workers keep accepting newer broadcasts."""
+        cfg = self._algo_config
+        self._learner_failures += 1
+        self._last_failure = exc
+        if self._learner_failures > getattr(cfg, "max_failures", 1):
+            raise exc
+        try:
+            self.learner_group.shutdown()
+        # graftlint: allow[swallowed-exception] group is already (partially) dead — that is the trigger
+        except Exception:
+            pass
+        self.learner_group = LearnerGroup(cfg, self.module_spec, self.learner_class)
+        if self._last_ckpt is not None:
+            self.learner_group.set_state(self._last_ckpt)
+        if self.rollout_plane is not None:
+            self.learner_group.setup_decoupled(
+                self._plane_authkey, start_version=self._policy_version)
+            version, addr, nbytes = self.learner_group.publish_weights()
+            self._policy_version = version
+            self.rollout_plane.set_weights(version, addr, nbytes)
+            self._updates_since_sync = 0
 
     def save_checkpoint(self) -> Any:
         return {"learner": self.learner_group.get_state(), "config": None}
@@ -86,7 +187,12 @@ class Algorithm(tune.Trainable):
             self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
     def cleanup(self) -> None:
+        self.final_plane_stats: Optional[Dict[str, Any]] = None
         try:
+            # getattr: subclasses with a custom setup() never build the plane
+            if getattr(self, "rollout_plane", None) is not None:
+                self.final_plane_stats = self.rollout_plane.shutdown()
+                self.rollout_plane = None
             if self.env_runner_group is not None:
                 self.env_runner_group.stop()
         finally:

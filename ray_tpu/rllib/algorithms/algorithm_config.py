@@ -7,6 +7,7 @@ build_algo(). Only the knobs the TPU build uses are carried.
 from __future__ import annotations
 
 import copy
+import os
 from typing import Any, Callable, Dict, Optional
 
 
@@ -45,6 +46,24 @@ class AlgorithmConfig:
         self.policies: Optional[Dict[str, Any]] = None  # mid -> (obs_space, act_space) | None
         self.policy_mapping_fn: Callable[[Any], str] = lambda agent_id: "default_policy"
         self.base_learner_class: Optional[type] = None  # per-module learner inside MultiAgentLearner
+        # decoupled rollout/learn plane (rllib/rollout_plane.py): env-var
+        # defaults are the registered RAY_TPU_RL_* knobs
+        self.decoupled: bool = False
+        self.decoupled_block_T: Optional[int] = None  # None = rollout_fragment_length
+        self.decoupled_queue_depth: int = int(
+            os.environ.get("RAY_TPU_RL_QUEUE_DEPTH", "8"))
+        self.max_block_lag: int = int(
+            os.environ.get("RAY_TPU_RL_MAX_BLOCK_LAG", "4"))
+        self.correction: str = os.environ.get("RAY_TPU_RL_CORRECTION", "is_clip")
+        self.weight_sync_interval: int = int(
+            os.environ.get("RAY_TPU_RL_WEIGHT_SYNC_INTERVAL", "1"))
+        self.blocks_per_update: int = int(
+            os.environ.get("RAY_TPU_RL_BLOCKS_PER_UPDATE", "1"))
+        self.take_timeout_s: float = float(
+            os.environ.get("RAY_TPU_RL_TAKE_TIMEOUT_S", "30"))
+        self.producer_slack: int = int(
+            os.environ.get("RAY_TPU_RL_PRODUCER_SLACK", "2"))
+        self.max_failures: int = 1  # learner restarts from checkpoint before giving up
         # misc
         self.seed: Optional[int] = 0
         self.explore: bool = True
@@ -163,6 +182,45 @@ class AlgorithmConfig:
                 model_config=self.model_config,
             )
         return specs
+
+    def decoupled_rollout(
+        self,
+        *,
+        enabled: bool = True,
+        block_T: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        max_block_lag: Optional[int] = None,
+        correction: Optional[str] = None,
+        weight_sync_interval: Optional[int] = None,
+        blocks_per_update: Optional[int] = None,
+        take_timeout_s: Optional[float] = None,
+        max_failures: Optional[int] = None,
+        producer_slack: Optional[int] = None,
+    ) -> "AlgorithmConfig":
+        """Opt into the decoupled actor–learner rollout plane.
+
+        `correction` picks the off-policy correction applied to stale blocks:
+        "is_clip" (PPO ratio clipping over behaviour-policy GAE, the default)
+        or "vtrace" (current-policy values + V-trace targets, IMPALA-style).
+        `producer_slack` is the queue depth beyond which workers pace
+        themselves instead of sampling blocks destined for eviction (<= 0
+        disables pacing; workers then free-run).
+        """
+        self.decoupled = bool(enabled)
+        if correction is not None and correction not in ("is_clip", "vtrace"):
+            raise ValueError(
+                f"correction must be 'is_clip' or 'vtrace', got {correction!r}")
+        for k, v in dict(
+            decoupled_block_T=block_T, decoupled_queue_depth=queue_depth,
+            max_block_lag=max_block_lag, correction=correction,
+            weight_sync_interval=weight_sync_interval,
+            blocks_per_update=blocks_per_update,
+            take_timeout_s=take_timeout_s, max_failures=max_failures,
+            producer_slack=producer_slack,
+        ).items():
+            if v is not None:
+                setattr(self, k, v)
+        return self
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
         if seed is not None:

@@ -19,6 +19,7 @@ import ray_tpu
 
 from ..core.learner import Learner
 from ..core.rl_module import Columns
+from ..utils.gae import vtrace_scan
 from .algorithm import Algorithm
 from .algorithm_config import AlgorithmConfig
 
@@ -170,17 +171,7 @@ class IMPALALearner(Learner):
         # at t = len-1 the next value is the bootstrap, not values[t+1] (which is padding)
         v_tp1 = v_tp1 + is_last * bootstrap[:, None]
         deltas = clipped_rho * (batch["rewards"] + discounts * v_tp1 - values)
-
-        def backward(acc, xs):
-            delta_t, disc_t, c_t = xs
-            acc = delta_t + disc_t * c_t * acc
-            return acc, acc
-
-        _, vs_minus_v = jax.lax.scan(
-            backward, jnp.zeros(B),
-            (deltas.T, discounts.T, cs.T), reverse=True,
-        )
-        vs_minus_v = vs_minus_v.T  # [B, T]
+        vs_minus_v = vtrace_scan(deltas.T, discounts.T, cs.T).T  # [B, T]
         vs = values + vs_minus_v
         vs_tp1 = jnp.concatenate([vs[:, 1:], jnp.zeros((B, 1))], axis=1) + is_last * bootstrap[:, None]
         clipped_pg_rho = jnp.minimum(cfg.vtrace_clip_pg_rho_threshold, rhos) * mask

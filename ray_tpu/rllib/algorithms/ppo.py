@@ -51,21 +51,33 @@ class PPOLearner(Learner):
         logp = dist.logp_jax(logits, batch[Columns.ACTIONS])
         ratio = jnp.exp(logp - batch[Columns.ACTION_LOGP])
         adv = batch[Columns.ADVANTAGES]
+
+        # decoupled trajectory blocks carry a validity mask (vector-env
+        # autoreset rows); the serialized path has none -> plain means
+        w = batch.get("loss_mask")
+        if w is None:
+            mmean = jnp.mean
+        else:
+            wsum = jnp.maximum(w.sum(), 1.0)
+
+            def mmean(x):
+                return (x * w).sum() / wsum
+
         surr1 = ratio * adv
         surr2 = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv
-        policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+        policy_loss = -mmean(jnp.minimum(surr1, surr2))
 
         vf = out[Columns.VF_PREDS]
         vf_err = jnp.square(vf - batch[Columns.VALUE_TARGETS])
-        vf_loss = jnp.mean(jnp.clip(vf_err, 0.0, cfg.vf_clip_param**2))
+        vf_loss = mmean(jnp.clip(vf_err, 0.0, cfg.vf_clip_param**2))
 
-        entropy = jnp.mean(dist.entropy_jax(logits))
+        entropy = mmean(dist.entropy_jax(logits))
         total = policy_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy
         aux = {
             "policy_loss": policy_loss,
             "vf_loss": vf_loss,
             "entropy": entropy,
-            "mean_kl": jnp.mean(batch[Columns.ACTION_LOGP] - logp),
+            "mean_kl": mmean(batch[Columns.ACTION_LOGP] - logp),
         }
         return total, aux
 
@@ -84,6 +96,10 @@ class PPO(Algorithm):
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self._algo_config
+        if getattr(cfg, "decoupled", False):
+            # decoupled rollout plane: learner-paced, GAE on device, blocks
+            # stream over the zero-copy data plane (rllib/rollout_plane.py)
+            return self._decoupled_training_step()
         # 1. synchronous parallel sampling (ppo.py:397)
         episodes = self.env_runner_group.sample(cfg.train_batch_size)
         if not episodes:

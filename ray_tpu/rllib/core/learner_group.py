@@ -60,6 +60,40 @@ class LearnerGroup:
         per = n_rows // self.n
         return {k: v[i * per : (i + 1) * per] for k, v in batch.items() if isinstance(v, np.ndarray)}
 
+    # -- decoupled rollout-plane path ------------------------------------------
+    def setup_decoupled(self, authkey: bytes, start_version: int = 0) -> None:
+        """Attach every learner to the rollout plane's data-plane transport;
+        rank 0 becomes the weights publisher. `start_version` keeps the
+        broadcast version monotonic across a restart-from-checkpoint."""
+        ray_tpu.get([
+            l.setup_decoupled.remote(authkey, i == 0, start_version)
+            for i, l in enumerate(self.learners)
+        ])
+
+    def update_from_blocks(self, handles: List[Any]) -> List[Dict[str, Any]]:
+        """Fan block handles out across learners (each pulls its own shard
+        peer-to-peer from the announcing workers — payloads never route
+        through the driver). With n>1 every learner must see the same block
+        count so the grad-allreduce step counts line up; the caller provides
+        len(handles) % n == 0 (BlockQueue.take is asked for a multiple)."""
+        if self.n == 1:
+            return [ray_tpu.get(
+                self.learners[0].update_from_blocks.remote(handles))]
+        per = len(handles) // self.n
+        if per == 0:
+            raise ValueError(
+                f"need >= {self.n} blocks for {self.n} learners, got {len(handles)}")
+        refs = [
+            l.update_from_blocks.remote(handles[i * per:(i + 1) * per])
+            for i, l in enumerate(self.learners)
+        ]
+        return ray_tpu.get(refs)
+
+    def publish_weights(self):
+        """Rank 0 publishes params on its data plane; returns the
+        (version, addr, nbytes) broadcast descriptor for the block queue."""
+        return ray_tpu.get(self.learners[0].publish_weights.remote())
+
     def get_weights(self):
         return ray_tpu.get(self.learners[0].get_weights.remote())
 

@@ -22,7 +22,13 @@ class SingleAgentEnvRunner:
         self.worker_index = worker_index
         self.num_envs = config.num_envs_per_env_runner
         maker = config.env_maker()
-        self.env = gym.vector.SyncVectorEnv([maker for _ in range(self.num_envs)])
+        # envs that expose a natively-vectorized constructor (classmethod
+        # make_vec(num_envs, config) -> object with reset/step/close batched
+        # over envs) skip SyncVectorEnv's per-env Python step loop
+        if isinstance(config.env, type) and hasattr(config.env, "make_vec"):
+            self.env = config.env.make_vec(self.num_envs, dict(config.env_config))
+        else:
+            self.env = gym.vector.SyncVectorEnv([maker for _ in range(self.num_envs)])
         single_env = maker()
         self.module = RLModuleSpec(
             module_class=config.rl_module_class,

@@ -1,0 +1,724 @@
+"""Decoupled actor–learner rollout plane (Podracer-style, arXiv 2104.06272).
+
+The serialized `Algorithm.training_step` interleaves rollout, host GAE, and
+the learner update — each phase idles the others. This module decouples them:
+
+- `VectorizedRolloutWorker`: an actor pool member that steps N envs as one
+  stacked call and writes **fixed-size trajectory blocks** straight into the
+  object store (`create_raw` → fill the numpy views in place → `seal`), then
+  announces a ~1 KB `BlockHandle` to the `BlockQueue`. Block payloads never
+  ride an actor RPC and never touch the head.
+- `BlockQueue`: a bounded queue actor. When full it evicts the oldest block
+  (freshest-data wins); blocks staler than the learner by more than
+  `RAY_TPU_RL_MAX_BLOCK_LAG` policy versions are dropped at take time. It
+  also piggybacks block-release acks and the latest weights-broadcast
+  metadata onto announce responses, so workers need no extra control RPCs.
+- `RolloutPlane`: the driver facade — spawns the pool, polls the queue for
+  the learner, routes releases, and accounts every admitted block so a clean
+  shutdown can assert **zero leaked block admissions**.
+
+Learners consume blocks via `read_block_arrays`: same-host blocks are adopted
+through `try_map_local` + `read_pinned` (no pickle, no copy through the
+plane); cross-host falls back to striped `pull_into` range reads from the
+worker's data plane. Policy weights flow the other way as a versioned
+broadcast (`rlwts:<version>` on the lead learner's plane); workers pick up
+the newest version between blocks and never block mid-episode.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import (ObjectLost, create_raw, free_local,
+                                       read_pinned, try_map_local)
+from ray_tpu.rllib.core.rl_module import Columns
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.util import telemetry
+from ray_tpu.util.collective import ring
+
+_ALIGN = 64
+_MIN_STRIPE = 1 << 20  # below this, striping overhead beats the parallelism
+
+
+# --------------------------------------------------------------- param codec
+
+def _iter_leaves(tree):
+    """Deterministic traversal (dicts by sorted key, sequences by index)."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _iter_leaves(tree[k])
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _iter_leaves(v)
+    else:
+        yield tree
+
+
+def pack_params(tree) -> bytes:
+    """Flatten a params tree to one contiguous byte buffer (leaf order is the
+    deterministic traversal, so any process holding a structurally identical
+    tree can unpack without a schema exchange)."""
+    parts = []
+    for leaf in _iter_leaves(tree):
+        parts.append(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return b"".join(parts)
+
+
+def unpack_params_like(tree, buf) -> Any:
+    """Rebuild a tree structured like `tree` with leaf values read from `buf`
+    (the inverse of pack_params against the receiver's own params tree)."""
+    mv = memoryview(buf)
+    off = 0
+
+    def rebuild(node):
+        nonlocal off
+        if isinstance(node, dict):
+            out = dict(node)
+            for k in sorted(node):
+                out[k] = rebuild(node[k])
+            return out
+        if isinstance(node, (list, tuple)):
+            vals = [rebuild(v) for v in node]
+            return tuple(vals) if isinstance(node, tuple) else vals
+        a = np.asarray(node)
+        n = a.nbytes
+        out = np.frombuffer(mv[off:off + n], dtype=a.dtype).reshape(a.shape)
+        off += n
+        return out.copy()
+
+    return rebuild(tree)
+
+
+# ---------------------------------------------------------------- block spec
+
+@dataclasses.dataclass(frozen=True)
+class TrajectoryBlockSpec:
+    """Fixed [T, B] time-major layout of one trajectory block.
+
+    `obs` is [T+1, B, *obs_shape] in the env's NATIVE dtype (uint8 atari
+    frames ship at 1 byte/px): row t+1 is row t's next observation — under
+    gymnasium 1.x next-step autoreset that makes a done row's successor the
+    episode's true final observation, so bootstraps need no side table.
+    `valid` marks real transitions (0 = the vector env's autoreset row).
+    """
+    T: int
+    B: int
+    obs_shape: Tuple[int, ...]
+    obs_dtype: str
+    act_shape: Tuple[int, ...]
+    act_dtype: str
+
+    def fields(self) -> List[Tuple[str, Tuple[int, ...], str]]:
+        f32, u8 = "float32", "uint8"
+        return [
+            ("obs", (self.T + 1, self.B) + tuple(self.obs_shape), self.obs_dtype),
+            ("actions", (self.T, self.B) + tuple(self.act_shape), self.act_dtype),
+            ("action_logp", (self.T, self.B), f32),
+            ("rewards", (self.T, self.B), f32),
+            ("vf_preds", (self.T, self.B), f32),
+            ("boot_values", (self.T, self.B), f32),
+            ("terminated", (self.T, self.B), u8),
+            ("truncated", (self.T, self.B), u8),
+            ("valid", (self.T, self.B), u8),
+        ]
+
+    def layout(self) -> Tuple[List[Tuple[str, int, Tuple[int, ...], str]], int]:
+        out, off = [], 0
+        for name, shape, dtype in self.fields():
+            out.append((name, off, shape, dtype))
+            nb = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            off = (off + nb + _ALIGN - 1) & ~(_ALIGN - 1)
+        return out, off
+
+    @property
+    def nbytes(self) -> int:
+        return self.layout()[1]
+
+    def views(self, mv: memoryview) -> Dict[str, np.ndarray]:
+        """Numpy views over a block buffer — zero-copy in both directions."""
+        fields, _ = self.layout()
+        out = {}
+        for name, off, shape, dtype in fields:
+            nb = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            out[name] = np.frombuffer(mv[off:off + nb], dtype=dtype).reshape(shape)
+        return out
+
+
+@dataclasses.dataclass
+class BlockHandle:
+    """The ~1 KB announcement for one sealed trajectory block."""
+    worker_index: int
+    generation: int
+    seq: int
+    location: tuple
+    addr: Tuple[str, int]
+    key: str
+    spec: TrajectoryBlockSpec
+    policy_version: int
+    env_steps: int
+    episode_returns: Tuple[float, ...]
+
+    @property
+    def uid(self) -> Tuple[int, int, int]:
+        return (self.worker_index, self.generation, self.seq)
+
+
+def pull_key_into(plane, addr, key: str, out_mv: memoryview, *,
+                  timeout: float = 120.0, probe_s: float = 0.5,
+                  streams: int = 4) -> None:
+    """Striped ranged pull of a published key into a preallocated buffer.
+
+    Bounded-probe loop per stripe (the mpmd StageComm idiom): a `pull_into`
+    miss returns None with nothing written, we re-probe until the deadline.
+    """
+    total = len(out_mv)
+    deadline = time.monotonic() + timeout
+    n_str = max(1, min(streams, total // _MIN_STRIPE or 1))
+    base = total // n_str
+    spans = [(i * base, base if i < n_str - 1 else total - (n_str - 1) * base)
+             for i in range(n_str)]
+
+    def pull_span(off: int, ln: int) -> None:
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pull of {key!r} from {addr} timed out after {timeout}s")
+            try:
+                n = plane.pull_into(addr, key, off, ln, out_mv[off:off + ln],
+                                    timeout=probe_s)
+            except (OSError, ConnectionError):
+                time.sleep(min(probe_s, 0.2))
+                continue
+            if n is not None:
+                return
+
+    if n_str == 1:
+        pull_span(*spans[0])
+        return
+    with ThreadPoolExecutor(max_workers=n_str - 1) as ex:
+        futs = [ex.submit(pull_span, o, ln) for o, ln in spans[1:]]
+        pull_span(*spans[0])
+        for f in futs:
+            f.result()
+
+
+def read_block_arrays(handle: BlockHandle, plane=None, *,
+                      timeout: float = 120.0,
+                      adopt: bool = False) -> Dict[str, np.ndarray]:
+    """Land a block's arrays in this process: same-host mapped adoption of
+    the sealed object (no pickle, no transfer) with a striped `pull_into`
+    fallback from the announcing worker's data plane.
+
+    With ``adopt=True`` (mapped path only) the dominant ``obs`` field is
+    returned as a zero-copy VIEW of the pinned mapping and the pin rides
+    along under the ``"_pin"`` key — the caller must pop and ``release()``
+    it only after the update has fully consumed obs. Small fields are
+    copied out either way."""
+    spec = handle.spec
+    pulls = telemetry.get_counter("rl_block_pulls_total", tag_keys=("path",))
+    if try_map_local(handle.location):
+        pr = read_pinned(handle.location, 0, spec.nbytes)
+        if adopt:
+            out = {k: (v if k == "obs" else np.array(v))
+                   for k, v in spec.views(pr.view).items()}
+            out["_pin"] = pr
+            pulls.inc(1, {"path": "mapped"})
+            return out
+        try:
+            # copy out: the consumer feeds jax, which on the CPU backend may
+            # alias a donated numpy buffer past the pin's release
+            out = {k: np.array(v) for k, v in spec.views(pr.view).items()}
+        finally:
+            pr.release()
+        pulls.inc(1, {"path": "mapped"})
+        return out
+    if plane is None:
+        raise ObjectLost(f"block {handle.key} is remote and no plane was given")
+    buf = np.empty(spec.nbytes, np.uint8)
+    pull_key_into(plane, tuple(handle.addr), handle.key, memoryview(buf),
+                  timeout=timeout)
+    pulls.inc(1, {"path": "striped"})
+    return spec.views(memoryview(buf))
+
+
+# --------------------------------------------------------------- block queue
+
+class BlockQueue:
+    """Bounded block-handle queue actor + weights mailbox + release router.
+
+    Accounting invariant (the leak gate): every announced block ends up in
+    exactly one of {taken, expired, reaped}, and its seq is routed back to
+    its worker for release (or reaped by the driver when the worker died).
+    """
+
+    def __init__(self, max_depth: int = 8, max_lag: int = 4):
+        self._max_depth = int(max_depth)
+        self._max_lag = int(max_lag)
+        self._q: deque = deque()
+        self._release: Dict[int, List[int]] = {}
+        self._pending: Dict[Tuple[int, int, int], BlockHandle] = {}
+        self._weights: Optional[Tuple[int, Tuple[str, int], int]] = None
+        self._stop = False
+        self._counts = {"announced": 0, "taken": 0, "expired": 0,
+                        "released": 0, "reaped": 0}
+        self._lag_max_taken = 0  # worst staleness ever trained on
+        self._taken_lag_counts: Dict[int, int] = {}  # lag -> taken blocks
+        self._blocks = telemetry.get_counter(
+            "rl_blocks_total", tag_keys=("event",))
+        self._depth_gauge = telemetry.get_gauge("rl_queue_depth")
+        self._lag_hist = telemetry.get_histogram(
+            "rl_block_lag", boundaries=[0, 1, 2, 3, 4, 6, 8, 12, 16, 32])
+
+    def _expire(self, handle: BlockHandle) -> None:
+        self._counts["expired"] += 1
+        self._blocks.inc(1, {"event": "expired"})
+        self._release.setdefault(handle.worker_index, []).append(handle.seq)
+
+    def announce(self, handle: BlockHandle) -> Dict[str, Any]:
+        if not self._stop:
+            while len(self._q) >= self._max_depth:
+                old = self._q.popleft()
+                self._pending.pop(old.uid, None)
+                self._expire(old)
+            self._q.append(handle)
+            self._pending[handle.uid] = handle
+            self._counts["announced"] += 1
+            self._blocks.inc(1, {"event": "announced"})
+        else:
+            # shutting down: admit nothing; tell the worker to free it
+            self._release.setdefault(handle.worker_index, []).append(handle.seq)
+        self._depth_gauge.set(float(len(self._q)))
+        return {
+            "released": self._release.pop(handle.worker_index, []),
+            "weights": self._weights,
+            "stop": self._stop,
+            "depth": len(self._q),
+        }
+
+    def take(self, max_n: int, learner_version: int) -> List[BlockHandle]:
+        out: List[BlockHandle] = []
+        while self._q and len(out) < max_n:
+            h = self._q.popleft()
+            lag = max(0, learner_version - h.policy_version)
+            self._lag_hist.observe(float(lag))
+            if lag > self._max_lag:
+                self._pending.pop(h.uid, None)
+                self._expire(h)
+                continue
+            out.append(h)
+            self._counts["taken"] += 1
+            self._lag_max_taken = max(self._lag_max_taken, lag)
+            self._taken_lag_counts[lag] = self._taken_lag_counts.get(lag, 0) + 1
+            self._blocks.inc(1, {"event": "taken"})
+        self._depth_gauge.set(float(len(self._q)))
+        return out
+
+    def release(self, uids: List[Tuple[int, int, int]]) -> None:
+        """Learner is done with these blocks; route the seqs home."""
+        for uid in uids:
+            h = self._pending.pop(tuple(uid), None)
+            if h is not None:
+                self._counts["released"] += 1
+                self._release.setdefault(h.worker_index, []).append(h.seq)
+
+    def reap_worker(self, worker_index: int) -> List[BlockHandle]:
+        """A worker died: hand its un-freed blocks to the driver for cleanup."""
+        dead = [h for h in self._pending.values()
+                if h.worker_index == worker_index]
+        for h in dead:
+            self._pending.pop(h.uid, None)
+            try:
+                self._q.remove(h)
+            except ValueError:
+                pass
+            self._counts["reaped"] += 1
+        self._release.pop(worker_index, None)
+        self._depth_gauge.set(float(len(self._q)))
+        return dead
+
+    def set_weights(self, version: int, addr, nbytes: int) -> None:
+        self._weights = (int(version), tuple(addr), int(nbytes))
+        telemetry.get_counter("rl_weight_broadcasts_total").inc()
+
+    def request_stop(self) -> None:
+        self._stop = True
+        while self._q:
+            h = self._q.popleft()
+            self._pending.pop(h.uid, None)
+            self._expire(h)
+        self._depth_gauge.set(0.0)
+
+    def stats(self) -> Dict[str, Any]:
+        c = dict(self._counts)
+        c["depth"] = len(self._q)
+        c["unreleased"] = len(self._pending)
+        c["lag_max_taken"] = self._lag_max_taken
+        c["lag_p99_taken"] = self._lag_quantile(0.99)
+        c["max_lag"] = self._max_lag
+        c["outstanding"] = (c["announced"] - c["taken"] - c["expired"]
+                            - c["reaped"])
+        return c
+
+    def _lag_quantile(self, q: float) -> Optional[int]:
+        """Exact quantile of the staleness of TAKEN (trained-on) blocks —
+        integer lags make the full distribution a tiny counts dict."""
+        total = sum(self._taken_lag_counts.values())
+        if not total:
+            return None
+        need = q * total
+        run = 0
+        for lag in sorted(self._taken_lag_counts):
+            run += self._taken_lag_counts[lag]
+            if run >= need:
+                return lag
+        return self._lag_max_taken
+
+    def ping(self) -> bool:
+        return True
+
+
+# ------------------------------------------------------------ rollout worker
+
+class VectorizedRolloutWorker(SingleAgentEnvRunner):
+    """Env-runner that streams sealed trajectory blocks from a background
+    rollout loop instead of returning episode lists over RPC."""
+
+    def __init__(self, config, worker_index: int, authkey: bytes, queue,
+                 generation: int = 0):
+        super().__init__(config, worker_index=worker_index)
+        self._authkey = authkey
+        self._queue = queue
+        self._generation = int(generation)
+        self._plane = None
+        self._spec: Optional[TrajectoryBlockSpec] = None
+        self._seq = 0
+        self._policy_version = 0
+        self._blocks: Dict[int, Tuple[tuple, Any, str]] = {}
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ep_ret = np.zeros(self.num_envs, np.float64)
+        self._recent_returns: deque = deque(maxlen=64)
+        self._steps_total = 0
+        self._blocks_built = 0
+        self._last_error: Optional[str] = None
+
+    # -- layout ---------------------------------------------------------------
+    def _build_spec(self) -> TrajectoryBlockSpec:
+        import gymnasium as gym
+
+        T = int(getattr(self.config, "decoupled_block_T", None)
+                or self.config.rollout_fragment_length)
+        obs_space = self.env.single_observation_space
+        act_space = self.env.single_action_space
+        if isinstance(act_space, gym.spaces.Discrete):
+            act_shape, act_dtype = (), "int32"
+        else:
+            act_shape, act_dtype = tuple(act_space.shape), "float32"
+        return TrajectoryBlockSpec(
+            T=T, B=self.num_envs, obs_shape=tuple(obs_space.shape),
+            obs_dtype=str(np.dtype(obs_space.dtype)), act_shape=act_shape,
+            act_dtype=act_dtype)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> bool:
+        if self._thread is not None:
+            return True
+        self._plane = ring.get_plane(self._authkey, min_streams=2)
+        self._spec = self._build_spec()
+        self._thread = threading.Thread(
+            target=self._run, name=f"rollout-worker-{self.worker_index}",
+            daemon=True)
+        self._thread.start()
+        return True
+
+    def _run(self) -> None:
+        slack = int(getattr(self.config, "producer_slack", 2))
+        try:
+            while not self._stop_evt.is_set():
+                t0 = time.monotonic()
+                handle = self._build_block()
+                build_s = time.monotonic() - t0
+                resp = ray_tpu.get(self._queue.announce.remote(handle))
+                for seq in resp.get("released", ()):
+                    self._free_block(seq)
+                w = resp.get("weights")
+                if w is not None and w[0] > self._policy_version:
+                    self._apply_weights(*w)
+                if resp.get("stop"):
+                    break
+                # producer backpressure: a queue holding more than `slack`
+                # un-taken blocks means we are outrunning the learner — every
+                # further block is CPU burned on data that will be evicted.
+                # Pace by the excess, in units of our own build time, so the
+                # pool equilibrates near the slack depth (slack <= 0: off).
+                excess = resp.get("depth", 0) - slack
+                if slack > 0 and excess > 0:
+                    self._stop_evt.wait(min(excess * build_s, 2.0))
+        except Exception as e:  # noqa: BLE001 — thread boundary: recorded, surfaced via health()
+            self._last_error = f"{type(e).__name__}: {e}"
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30.0)
+        for seq in list(self._blocks):
+            self._free_block(seq)
+        super().stop()
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "alive": bool(self._thread and self._thread.is_alive()),
+            "error": self._last_error,
+            "outstanding": len(self._blocks),
+            "policy_version": self._policy_version,
+        }
+
+    def outstanding(self) -> int:
+        return len(self._blocks)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        rets = list(self._recent_returns)
+        return {
+            "num_env_steps_sampled": self._steps_total,
+            "episode_return_mean": float(np.mean(rets)) if rets else None,
+            "num_episodes": len(rets),
+            "num_blocks": self._blocks_built,
+            "policy_version": self._policy_version,
+        }
+
+    # -- block production -----------------------------------------------------
+    def _build_block(self) -> BlockHandle:
+        spec = self._spec
+        with telemetry.span("rl.rollout_block", "rl",
+                            worker=self.worker_index, seq=self._seq):
+            self._reset_if_needed()
+            oid = ObjectID.generate()
+            tgt = create_raw(oid, spec.nbytes)
+            views = spec.views(tgt.view)
+            dist = self.module.action_dist_cls
+            valid_steps = 0
+            returns_done: List[float] = []
+            for t in range(spec.T):
+                out = self.module.forward_exploration(
+                    self.params, {Columns.OBS: self._obs})
+                vf = out[Columns.VF_PREDS]
+                if t > 0:
+                    views["boot_values"][t - 1] = vf
+                dist_inputs = out[Columns.ACTION_DIST_INPUTS]
+                actions = dist.sample_np(dist_inputs, self.rng)
+                logp = dist.logp_np(dist_inputs, actions)
+                was_done = self._prev_done.copy()
+                views["obs"][t] = self._obs
+                views["actions"][t] = actions
+                views["action_logp"][t] = logp
+                views["vf_preds"][t] = vf
+                views["valid"][t] = (~was_done).astype(np.uint8)
+                obs, rewards, terms, truncs, _ = self.env.step(actions)
+                views["rewards"][t] = rewards
+                views["terminated"][t] = np.asarray(terms).astype(np.uint8)
+                views["truncated"][t] = np.asarray(truncs).astype(np.uint8)
+                live = ~was_done
+                self._ep_ret = np.where(
+                    was_done, 0.0, self._ep_ret + np.asarray(rewards))
+                done_now = np.asarray(terms) | np.asarray(truncs)
+                for r in self._ep_ret[live & done_now]:
+                    returns_done.append(float(r))
+                    self._recent_returns.append(float(r))
+                valid_steps += int(live.sum())
+                # next-step autoreset: a row that followed a done row was the
+                # reset itself — its done flags can't be set again
+                self._prev_done = live & done_now
+                self._obs = obs
+            views["obs"][spec.T] = self._obs
+            out = self.module.forward_exploration(
+                self.params, {Columns.OBS: self._obs})
+            views["boot_values"][spec.T - 1] = out[Columns.VF_PREDS]
+            views = None  # drop buffer refs before seal releases the view
+            loc = tgt.seal()
+            pinned = read_pinned(loc, 0, spec.nbytes)
+            key = f"rlblk:{self.worker_index}:{self._generation}:{self._seq}"
+            self._plane.publish(key, pinned.view, expected_read_bytes=0)
+            self._blocks[self._seq] = (loc, pinned, key)
+            handle = BlockHandle(
+                worker_index=self.worker_index, generation=self._generation,
+                seq=self._seq, location=loc, addr=tuple(self._plane.addr),
+                key=key, spec=spec, policy_version=self._policy_version,
+                env_steps=valid_steps,
+                episode_returns=tuple(returns_done[-16:]))
+            self._seq += 1
+            self._blocks_built += 1
+            self._steps_total += valid_steps
+            telemetry.get_counter("rl_env_steps_total").inc(valid_steps)
+            return handle
+
+    def _free_block(self, seq: int) -> None:
+        ent = self._blocks.pop(seq, None)
+        if ent is None:
+            return
+        loc, pinned, key = ent
+        try:
+            self._plane.retract(key)
+        # graftlint: allow[swallowed-exception] plane may already be torn down at shutdown
+        except Exception:
+            pass
+        try:
+            pinned.release()
+        # graftlint: allow[swallowed-exception] view may already be released by a racing stop
+        except Exception:
+            pass
+        try:
+            free_local(loc)
+        # graftlint: allow[swallowed-exception] backing may already be freed by the reaper
+        except Exception:
+            pass
+
+    # -- weights --------------------------------------------------------------
+    def _apply_weights(self, version: int, addr, nbytes: int) -> None:
+        buf = np.empty(nbytes, np.uint8)
+        pull_key_into(self._plane, tuple(addr), f"rlwts:{version}",
+                      memoryview(buf), timeout=60.0)
+        self.params = unpack_params_like(self.params, buf)
+        self._policy_version = int(version)
+
+
+# -------------------------------------------------------------------- driver
+
+class RolloutPlane:
+    """Driver facade over the queue + worker pool."""
+
+    def __init__(self, config, *, authkey: Optional[bytes] = None):
+        import os
+
+        self.config = config
+        self.authkey = authkey or os.urandom(16)
+        depth = int(getattr(config, "decoupled_queue_depth", 8))
+        max_lag = int(getattr(config, "max_block_lag", 4))
+        self._queue_cls = ray_tpu.remote(num_cpus=0)(BlockQueue)
+        self.queue = self._queue_cls.remote(depth, max_lag)
+        self._worker_cls = ray_tpu.remote(num_cpus=1)(VectorizedRolloutWorker)
+        self._generations = [0] * config.num_env_runners
+        self.workers = [
+            self._worker_cls.remote(config, i, self.authkey, self.queue)
+            for i in range(config.num_env_runners)
+        ]
+        ray_tpu.get([w.start.remote() for w in self.workers])
+        self._reaped_locs = 0
+
+    def take(self, max_n: int, learner_version: int,
+             timeout_s: float = 30.0) -> List[BlockHandle]:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            handles = ray_tpu.get(
+                self.queue.take.remote(max_n, learner_version))
+            if handles or time.monotonic() > deadline:
+                return handles
+            time.sleep(0.02)
+
+    def release(self, handles: List[BlockHandle]) -> None:
+        self.queue.release.remote([h.uid for h in handles])
+
+    def set_weights(self, version: int, addr, nbytes: int) -> None:
+        self.queue.set_weights.remote(version, addr, nbytes)
+
+    def worker_metrics(self) -> List[Dict[str, Any]]:
+        out = []
+        for w in self.workers:
+            if w is None:
+                continue
+            try:
+                out.append(ray_tpu.get(w.get_metrics.remote()))
+            # graftlint: allow[swallowed-exception] dead workers are expected under chaos; pool backfills
+            except Exception:
+                continue
+        return out
+
+    def reap_worker(self, i: int) -> int:
+        """Free a dead worker's un-released blocks from the driver (same-host
+        arena/shm backings survive the worker process) and account them."""
+        dead = ray_tpu.get(self.queue.reap_worker.remote(i))
+        freed = 0
+        for h in dead:
+            try:
+                free_local(h.location)
+                freed += 1
+            # graftlint: allow[swallowed-exception] remote or already-freed backing; accounting still records the reap
+            except Exception:
+                continue
+        self._reaped_locs += freed
+        self.workers[i] = None
+        return len(dead)
+
+    def restart_worker(self, i: int) -> None:
+        """Backfill the pool slot with a fresh worker (new generation)."""
+        old = self.workers[i]
+        if old is not None:
+            try:
+                ray_tpu.kill(old)
+            # graftlint: allow[swallowed-exception] worker already dead — that is why we are restarting it
+            except Exception:
+                pass
+            self.reap_worker(i)
+        self._generations[i] += 1
+        w = self._worker_cls.remote(self.config, i, self.authkey, self.queue,
+                                    self._generations[i])
+        ray_tpu.get(w.start.remote())
+        self.workers[i] = w
+
+    def stats(self) -> Dict[str, Any]:
+        s = ray_tpu.get(self.queue.stats.remote())
+        outstanding = 0
+        for w in self.workers:
+            if w is None:
+                continue
+            try:
+                outstanding += ray_tpu.get(w.outstanding.remote())
+            # graftlint: allow[swallowed-exception] dead worker: its blocks are accounted via reap_worker
+            except Exception:
+                continue
+        s["worker_outstanding"] = outstanding
+        s["reaped_freed"] = self._reaped_locs
+        return s
+
+    def shutdown(self) -> Dict[str, Any]:
+        try:
+            ray_tpu.get(self.queue.request_stop.remote())
+        # graftlint: allow[swallowed-exception] queue already dead; workers will notice on announce
+        except Exception:
+            pass
+        for i, w in enumerate(self.workers):
+            if w is None:
+                continue
+            try:
+                ray_tpu.get(w.stop.remote())
+            # graftlint: allow[swallowed-exception] dead worker at shutdown: blocks were reaped or will be
+            except Exception:
+                pass
+        stats = {}
+        try:
+            stats = self.stats()
+        # graftlint: allow[swallowed-exception] stats are best-effort once actors are going away
+        except Exception:
+            pass
+        for w in self.workers:
+            if w is None:
+                continue
+            try:
+                ray_tpu.kill(w)
+            # graftlint: allow[swallowed-exception] already dead
+            except Exception:
+                pass
+        try:
+            ray_tpu.kill(self.queue)
+        # graftlint: allow[swallowed-exception] already dead
+        except Exception:
+            pass
+        return stats

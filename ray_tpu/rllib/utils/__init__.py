@@ -1,0 +1,3 @@
+from ray_tpu.rllib.utils.gae import gae_scan, vtrace_block, vtrace_scan
+
+__all__ = ["gae_scan", "vtrace_block", "vtrace_scan"]

@@ -1,0 +1,133 @@
+"""Device-resident advantage estimation: GAE and the V-trace recursion as
+`lax.scan`s over a trajectory block's time axis.
+
+The serialized PPO path computes GAE on the host — a per-episode reverse
+Python loop inside `connectors.GeneralAdvantageEstimation` — which serializes
+rollout, advantage pass, and learner update. The decoupled rollout plane
+(`rllib/rollout_plane.py`) ships fixed-shape [T, B] time-major trajectory
+blocks instead, and these kernels fold the advantage pass INTO the jitted
+learner update: one scan over the block's time axis, no host round-trip.
+
+Parity contract (tests/test_gae_scan.py): `gae_scan` is bit-close (f32) to
+the host-numpy pass across episode boundaries, truncation bootstraps, and
+`lambda_` in {0, 0.95, 1}. Episode boundaries inside a block are carried by
+the `terminated`/`truncated` row flags — the recursion resets across a done
+row exactly like the host loop's per-episode restart.
+"""
+from __future__ import annotations
+
+from ray_tpu.util.hot_path import hot_path
+
+
+@hot_path(reason="inside the jitted decoupled learner update; pure lax.scan")
+def gae_scan(rewards, values, boot_values, terminated, truncated, *,
+             gamma: float, lambda_: float):
+    """GAE(lambda) over a time-major trajectory block.
+
+    All inputs are [T, B] (f32; the flags may be bool/uint8):
+
+    - ``rewards[t, b]``     reward of step t in column b
+    - ``values[t, b]``      behaviour-policy V(obs_t)
+    - ``boot_values[t, b]`` behaviour-policy V(obs_{t+1}) — the NEXT
+      observation's value, which at an episode's last row is the value of the
+      true final observation (gymnasium 1.x next-step autoreset returns it)
+    - ``terminated[t, b]``  env terminated at step t (bootstrap masked to 0)
+    - ``truncated[t, b]``   env truncated at step t (bootstraps from
+      boot_values, but the accumulation chain still resets)
+
+    Returns ``(advantages, value_targets)``, both [T, B] f32. Rows marked
+    invalid by the caller (autoreset rows) come out as garbage and must be
+    masked in the loss — the chain is already broken at the preceding done
+    row, so they never contaminate a real row.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rewards = jnp.asarray(rewards, jnp.float32)
+    values = jnp.asarray(values, jnp.float32)
+    boot = jnp.asarray(boot_values, jnp.float32)
+    term = jnp.asarray(terminated, jnp.float32)
+    done = jnp.maximum(term, jnp.asarray(truncated, jnp.float32))
+
+    deltas = rewards + gamma * (1.0 - term) * boot - values
+    cont = (1.0 - done) * gamma * lambda_
+
+    def backward(acc, xs):
+        delta_t, cont_t = xs
+        acc = delta_t + cont_t * acc
+        return acc, acc
+
+    _, adv = jax.lax.scan(
+        backward, jnp.zeros(rewards.shape[1], jnp.float32),
+        (deltas, cont), reverse=True)
+    return adv, adv + values
+
+
+@hot_path(reason="shared V-trace core: one reverse scan, no host syncs")
+def vtrace_scan(deltas, discounts, cs):
+    """The V-trace reverse-time recursion (Espeholt et al. 2018, eq. 1):
+
+        acc_t = delta_t + discount_t * c_t * acc_{t+1}
+
+    over time-major [T, B] inputs; returns ``vs - V`` as [T, B]. This is the
+    exact scan IMPALA's learner ran inline — extracted so the decoupled
+    rollout plane's "vtrace" off-policy correction and IMPALALearner share
+    one implementation (both are bit-identical to the previous inline form:
+    same op sequence, same zero init).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def backward(acc, xs):
+        delta_t, disc_t, c_t = xs
+        acc = delta_t + disc_t * c_t * acc
+        return acc, acc
+
+    _, out = jax.lax.scan(
+        backward, jnp.zeros(deltas.shape[1], deltas.dtype),
+        (deltas, discounts, cs), reverse=True)
+    return out
+
+
+def vtrace_block(rewards, values, boot_values, terminated, truncated, rhos,
+                 *, gamma: float, lambda_: float = 1.0,
+                 clip_rho_threshold: float = 1.0,
+                 clip_pg_rho_threshold: float = 1.0):
+    """V-trace targets + policy-gradient advantages for a [T, B] block.
+
+    ``values``/``boot_values`` are the CURRENT policy's value estimates of
+    obs_t / obs_{t+1} (recomputed on device by the decoupled learner), and
+    ``rhos`` the per-step importance ratios pi_cur/pi_behaviour. Episode
+    boundaries (done rows) cut the recursion; the row after a boundary starts
+    a fresh chain. At a block's last row (and at done rows) the next-state
+    target falls back to the bootstrap value — the off-policy tail
+    approximation the staleness bound keeps small.
+
+    Returns ``(pg_advantages, value_targets)``, both [T, B] f32, both
+    stop-gradiented.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rewards = jnp.asarray(rewards, jnp.float32)
+    values = jnp.asarray(values, jnp.float32)
+    term = jnp.asarray(terminated, jnp.float32)
+    done = jnp.maximum(term, jnp.asarray(truncated, jnp.float32))
+    v_next = jnp.asarray(boot_values, jnp.float32) * (1.0 - term)
+
+    clipped_rho = jnp.minimum(clip_rho_threshold, rhos)
+    cs = lambda_ * jnp.minimum(1.0, rhos)
+    discounts = gamma * (1.0 - done)
+    # v_next carries the truncation bootstrap and zeroes out at termination,
+    # so this is delta_t = rho_clip * (r + gamma*V(s_{t+1}) - V(s_t)) with
+    # the recursion itself cut at done rows by `discounts`.
+    deltas = clipped_rho * (rewards + gamma * v_next - values)
+    vs_minus_v = vtrace_scan(deltas, discounts, cs)
+    vs = values + vs_minus_v
+    # next-step target for the pg advantage: vs_{t+1} within a chain, the
+    # bootstrap value across a boundary / at the block tail
+    vs_next = jnp.concatenate([vs[1:], v_next[-1:]], axis=0)
+    vs_next = jnp.where(done > 0, v_next, vs_next)
+    clipped_pg_rho = jnp.minimum(clip_pg_rho_threshold, rhos)
+    pg_adv = clipped_pg_rho * (rewards + gamma * vs_next - values)
+    return jax.lax.stop_gradient(pg_adv), jax.lax.stop_gradient(vs)

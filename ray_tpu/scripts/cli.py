@@ -199,6 +199,17 @@ def _render_status(s: dict) -> str:
     if bubbles:
         frac = " ".join(f"{k}:{v:.2f}" for k, v in sorted(bubbles.items()))
         lines.append(f"train      pipeline_bubble[{frac}]")
+    rl = s.get("rl", {})
+    if rl.get("env_steps") or rl.get("learner_updates"):
+        blocks = " ".join(f"{k}:{v}" for k, v in sorted(
+            (rl.get("blocks") or {}).items()))
+        lag99 = rl.get("block_lag_p99")
+        lines.append(f"rl         env_steps={rl.get('env_steps', 0)} "
+                     f"updates={rl.get('learner_updates', 0)} "
+                     f"broadcasts={rl.get('weight_broadcasts', 0)} "
+                     f"blocks[{blocks or '-'}] "
+                     f"queue_depth={rl.get('queue_depth') or 0:.0f} "
+                     f"lag_p99={f'{lag99:.1f}' if lag99 is not None else '-'}")
     return "\n".join(lines)
 
 

@@ -306,6 +306,34 @@ class ChaosController:
         except Exception:  # noqa: BLE001
             return False
 
+    # -- arbitrary actors ------------------------------------------------------
+    @staticmethod
+    def kill_actor(actor: Any) -> bool:
+        """SIGKILL the worker process hosting an arbitrary actor handle (no
+        graceful teardown — truer chaos than ``ray_tpu.kill``). Used by the
+        decoupled RL chaos gate to drop one env-runner worker or one learner
+        rank mid-stream. Falls back to the API kill when the process isn't
+        local."""
+        import ray_tpu
+
+        c = _cluster()
+        with c._lock:
+            st = c.actors.get(actor._actor_id)
+            proc = getattr(getattr(st, "worker", None), "process", None)
+        if proc is not None:
+            try:
+                proc.kill()
+                return True
+            # graftlint: allow[swallowed-exception] best-effort cleanup of a target that may already be dead/gone
+            except Exception:  # noqa: BLE001 — fall through to the API kill
+                pass
+        try:
+            ray_tpu.kill(actor, no_restart=True)
+            return True
+        # graftlint: allow[swallowed-exception] degrades to the coded fallback (return False) by design
+        except Exception:  # noqa: BLE001
+            return False
+
     def arm_replica(self, app_name: str, deployment_name: str, site: str,
                     mode: str = "error", prob: float = 1.0,
                     count: Optional[int] = None, delay_s: float = 0.0,

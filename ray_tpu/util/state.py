@@ -517,6 +517,26 @@ def cluster_status() -> Dict[str, Any]:
                                      {}).get("values", {}).items()
         },
     }
+
+    # -- rl: decoupled rollout/learn plane (rllib/rollout_plane.py). Block
+    # lifecycle counters, staleness distribution at take time, queue depth —
+    # the numbers that say whether the learner or the env pool is the
+    # bottleneck and whether stale data is being trained on or dropped.
+    block_lag = merged.get("rl_block_lag")
+    status["rl"] = {
+        "env_steps": int(counter_total("rl_env_steps_total")),
+        "learner_updates": int(counter_total("rl_learner_updates_total")),
+        "weight_broadcasts": int(counter_total("rl_weight_broadcasts_total")),
+        "blocks": {k: int(v) for k, v in
+                   counter_by_tag("rl_blocks_total", "event").items()},
+        "block_pulls": {k: int(v) for k, v in
+                        counter_by_tag("rl_block_pulls_total", "path").items()},
+        "queue_depth": gauges("rl_queue_depth").get("_"),
+        "block_lag_p50": (m.histogram_quantile(block_lag, 0.5)
+                          if block_lag else None),
+        "block_lag_p99": (m.histogram_quantile(block_lag, 0.99)
+                          if block_lag else None),
+    }
     return status
 
 
